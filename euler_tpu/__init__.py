@@ -1,0 +1,25 @@
+"""euler_tpu: a TPU-native graph neural network training framework.
+
+Capabilities of Alibaba Euler 2.0 (reference: renyi533/euler), rebuilt
+TPU-first: a native C++ columnar graph engine on the host feeding
+jit-compiled JAX/XLA SPMD training over a jax.sharding.Mesh.
+
+Layering (bottom → top), mirroring SURVEY.md §1:
+  core/        native engine (C++ → libeuler_core.so) + ctypes loader
+  graph/       numpy-facing GraphEngine / GraphBuilder (embedded mode)
+  ops/         host sampling ops + JAX message-passing (gather/scatter)
+  dataflow/    mini-batch subgraph builders (sage/gcn/layerwise/...)
+  convolution/ message-passing conv zoo (flax)
+  mp_utils/    model assembly (BaseGNNNet, supervised/unsupervised)
+  graph_pool/  graph-level readouts
+  utils/       layers, encoders, aggregators, metrics, optimizers
+  solution/    composable industrial pipeline
+  estimator/   training drivers (train/evaluate/infer, orbax checkpoints)
+  dataset/     dataset registry (synthetic + on-disk loaders)
+  parallel/    Mesh/pjit sharding, sharded embedding tables
+  tools/       data prep (json → binary partitions), knn export
+"""
+
+__version__ = "0.1.0"
+
+from euler_tpu.graph import GraphBuilder, GraphEngine  # noqa: F401

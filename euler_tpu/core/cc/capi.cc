@@ -1,0 +1,544 @@
+// extern "C" surface of the graph engine, consumed via ctypes from
+// euler_tpu.core.lib.
+//
+// Capability parity with the reference's ctypes entry points
+// (euler/service/python_api.cc StartService, tf_euler/utils/
+// init_query_proxy.cc) plus the per-op C++ kernels the TF custom ops used
+// (SURVEY.md §2.2) — collapsed into one direct batch API: Python builds or
+// loads a graph, then issues bulk numpy-backed calls. Fixed-shape ops write
+// caller-allocated buffers; variable-shape ops fill an EtResult handle the
+// caller copies out of and frees.
+//
+// Convention: functions return 0 on success, nonzero on error;
+// etg_last_error() returns a thread-local message.
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+#include "common.h"
+#include "graph.h"
+#include "io.h"
+#include "ops.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+int Fail(const std::string& msg) {
+  g_last_error = msg;
+  return 1;
+}
+
+struct Registry {
+  std::mutex mu;
+  int64_t next = 1;
+  std::unordered_map<int64_t, std::shared_ptr<et::GraphBuilder>> builders;
+  std::unordered_map<int64_t, std::shared_ptr<et::Graph>> graphs;
+};
+
+Registry& Reg() {
+  static Registry* r = new Registry();
+  return *r;
+}
+
+// shared_ptr copies keep the object alive for the duration of a call even
+// if another thread concurrently etg_free()s the handle (the Graph itself
+// is immutable, so concurrent readers are safe by design).
+std::shared_ptr<et::GraphBuilder> GetBuilder(int64_t h) {
+  auto& r = Reg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.builders.find(h);
+  return it == r.builders.end() ? nullptr : it->second;
+}
+
+std::shared_ptr<et::Graph> GetGraph(int64_t h) {
+  auto& r = Reg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.graphs.find(h);
+  return it == r.graphs.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Variable-size result carrier.
+struct EtResult {
+  std::vector<uint64_t> offsets;
+  std::vector<uint64_t> u64;
+  std::vector<float> f32;
+  std::vector<int32_t> i32;
+  std::vector<char> bytes;
+};
+
+const char* etg_last_error() { return g_last_error.c_str(); }
+
+void etg_seed(uint64_t seed) { et::SeedGlobalRng(seed); }
+
+void etg_set_log_level(int level) { et::MinLogLevel() = level; }
+
+// ---- builder ----
+int64_t etg_builder_new() {
+  auto& r = Reg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  int64_t h = r.next++;
+  r.builders[h] = std::make_shared<et::GraphBuilder>();
+  return h;
+}
+
+int etg_builder_set_feature(int64_t b, int is_edge, int fid, int kind,
+                            int64_t dim, const char* name) {
+  auto builder = GetBuilder(b);
+  if (!builder) return Fail("bad builder handle");
+  auto* meta = builder->mutable_meta();
+  auto& feats = is_edge ? meta->edge_features : meta->node_features;
+  if (static_cast<size_t>(fid) >= feats.size()) feats.resize(fid + 1);
+  feats[fid].name = name ? name : "";
+  feats[fid].kind = static_cast<et::FeatureKind>(kind);
+  feats[fid].dim = dim;
+  return 0;
+}
+
+int etg_builder_set_num_types(int64_t b, int num_node_types,
+                              int num_edge_types) {
+  auto builder = GetBuilder(b);
+  if (!builder) return Fail("bad builder handle");
+  builder->mutable_meta()->num_node_types = num_node_types;
+  builder->mutable_meta()->num_edge_types = num_edge_types;
+  return 0;
+}
+
+int etg_builder_add_nodes(int64_t b, int64_t n, const uint64_t* ids,
+                          const int32_t* types, const float* weights) {
+  auto builder = GetBuilder(b);
+  if (!builder) return Fail("bad builder handle");
+  builder->AddNodes(ids, types, weights, static_cast<size_t>(n));
+  return 0;
+}
+
+int etg_builder_add_edges(int64_t b, int64_t n, const uint64_t* src,
+                          const uint64_t* dst, const int32_t* types,
+                          const float* weights) {
+  auto builder = GetBuilder(b);
+  if (!builder) return Fail("bad builder handle");
+  builder->AddEdges(src, dst, types, weights, static_cast<size_t>(n));
+  return 0;
+}
+
+int etg_builder_set_node_dense(int64_t b, const uint64_t* ids, int64_t n,
+                               int fid, int64_t dim, const float* values) {
+  auto builder = GetBuilder(b);
+  if (!builder) return Fail("bad builder handle");
+  builder->SetNodeDenseBulk(ids, static_cast<size_t>(n), fid, dim, values);
+  return 0;
+}
+
+int etg_builder_set_node_sparse(int64_t b, const uint64_t* ids, int64_t n,
+                                int fid, const uint64_t* offsets,
+                                const uint64_t* values) {
+  auto builder = GetBuilder(b);
+  if (!builder) return Fail("bad builder handle");
+  builder->SetNodeSparseBulk(ids, static_cast<size_t>(n), fid, offsets,
+                             values);
+  return 0;
+}
+
+int etg_builder_set_node_binary(int64_t b, uint64_t id, int fid,
+                                const char* data, int64_t len) {
+  auto builder = GetBuilder(b);
+  if (!builder) return Fail("bad builder handle");
+  builder->SetNodeBinary(id, fid, data, len);
+  return 0;
+}
+
+int etg_builder_set_edge_dense(int64_t b, const uint64_t* src,
+                               const uint64_t* dst, const int32_t* types,
+                               int64_t n, int fid, int64_t dim,
+                               const float* values) {
+  auto builder = GetBuilder(b);
+  if (!builder) return Fail("bad builder handle");
+  builder->SetEdgeDenseBulk(src, dst, types, static_cast<size_t>(n), fid, dim,
+                            values);
+  return 0;
+}
+
+int etg_builder_set_edge_sparse(int64_t b, uint64_t src, uint64_t dst,
+                                int32_t type, int fid, const uint64_t* values,
+                                int64_t len) {
+  auto builder = GetBuilder(b);
+  if (!builder) return Fail("bad builder handle");
+  builder->SetEdgeSparse(src, dst, type, fid, values, len);
+  return 0;
+}
+
+int64_t etg_builder_finalize(int64_t b, int build_in_adjacency) {
+  auto& r = Reg();
+  std::shared_ptr<et::GraphBuilder> builder;
+  {
+    std::lock_guard<std::mutex> lk(r.mu);
+    auto it = r.builders.find(b);
+    if (it == r.builders.end()) {
+      Fail("bad builder handle");
+      return -1;
+    }
+    builder = std::move(it->second);
+    r.builders.erase(it);
+  }
+  auto g = builder->Finalize(build_in_adjacency != 0);
+  std::lock_guard<std::mutex> lk(r.mu);
+  int64_t h = r.next++;
+  r.graphs[h] = std::move(g);
+  return h;
+}
+
+// ---- load/dump ----
+int64_t etg_load(const char* dir, int shard_idx, int shard_num, int data_type,
+                 int build_in_adjacency) {
+  std::unique_ptr<et::Graph> g;
+  et::Status s = et::LoadShard(dir, shard_idx, shard_num, data_type,
+                               build_in_adjacency != 0, &g);
+  if (!s.ok()) {
+    Fail(s.message());
+    return -1;
+  }
+  auto& r = Reg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  int64_t h = r.next++;
+  r.graphs[h] = std::move(g);
+  return h;
+}
+
+int etg_dump(int64_t h, const char* dir) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  et::Status s = g->Dump(dir);
+  return s.ok() ? 0 : Fail(s.message());
+}
+
+int etg_free(int64_t h) {
+  auto& r = Reg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  r.graphs.erase(h);
+  r.builders.erase(h);
+  return 0;
+}
+
+// ---- introspection ----
+int64_t etg_node_count(int64_t h) {
+  auto g = GetGraph(h);
+  return g ? static_cast<int64_t>(g->node_count()) : -1;
+}
+int64_t etg_edge_count(int64_t h) {
+  auto g = GetGraph(h);
+  return g ? static_cast<int64_t>(g->edge_count()) : -1;
+}
+int etg_num_node_types(int64_t h) {
+  auto g = GetGraph(h);
+  return g ? g->num_node_types() : -1;
+}
+int etg_num_edge_types(int64_t h) {
+  auto g = GetGraph(h);
+  return g ? g->num_edge_types() : -1;
+}
+int etg_num_node_features(int64_t h) {
+  auto g = GetGraph(h);
+  return g ? static_cast<int>(g->meta().node_features.size()) : -1;
+}
+int etg_num_edge_features(int64_t h) {
+  auto g = GetGraph(h);
+  return g ? static_cast<int>(g->meta().edge_features.size()) : -1;
+}
+// kind/dim of feature fid; returns 0 on success.
+int etg_feature_info(int64_t h, int is_edge, int fid, int32_t* kind,
+                     int64_t* dim, char* name_buf, int64_t name_cap) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  const auto& feats =
+      is_edge ? g->meta().edge_features : g->meta().node_features;
+  if (fid < 0 || static_cast<size_t>(fid) >= feats.size()) {
+    return Fail("bad feature id");
+  }
+  *kind = static_cast<int32_t>(feats[fid].kind);
+  *dim = feats[fid].dim;
+  if (name_buf && name_cap > 0) {
+    std::strncpy(name_buf, feats[fid].name.c_str(), name_cap - 1);
+    name_buf[name_cap - 1] = '\0';
+  }
+  return 0;
+}
+
+int etg_all_node_ids(int64_t h, uint64_t* out) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  for (size_t i = 0; i < g->node_count(); ++i) {
+    out[i] = g->node_id(static_cast<uint32_t>(i));
+  }
+  return 0;
+}
+
+int etg_node_weight_sums(int64_t h, float* out) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  const auto& v = g->node_type_weight_sums();
+  std::memcpy(out, v.data(), v.size() * sizeof(float));
+  return 0;
+}
+
+int etg_edge_weight_sums(int64_t h, float* out) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  const auto& v = g->edge_type_weight_sums();
+  std::memcpy(out, v.data(), v.size() * sizeof(float));
+  return 0;
+}
+
+// ---- sampling ----
+int etg_sample_node(int64_t h, int type, int64_t count, uint64_t* out) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  g->SampleNode(type, static_cast<size_t>(count), &et::ThreadLocalRng(), out);
+  return 0;
+}
+
+int etg_sample_node_with_types(int64_t h, const int32_t* types, int64_t count,
+                               uint64_t* out) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  g->SampleNodeWithTypes(types, static_cast<size_t>(count),
+                         &et::ThreadLocalRng(), out);
+  return 0;
+}
+
+int etg_sample_edge(int64_t h, int type, int64_t count, uint64_t* out_src,
+                    uint64_t* out_dst, int32_t* out_type) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  g->SampleEdge(type, static_cast<size_t>(count), &et::ThreadLocalRng(),
+                out_src, out_dst, out_type);
+  return 0;
+}
+
+int etg_get_node_type(int64_t h, const uint64_t* ids, int64_t n,
+                      int32_t* out) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t idx = g->NodeIndex(ids[i]);
+    out[i] = idx == et::kInvalidIndex ? -1 : g->node_type(idx);
+  }
+  return 0;
+}
+
+int etg_sample_neighbor(int64_t h, const uint64_t* ids, int64_t n,
+                        const int32_t* edge_types, int64_t n_et, int64_t count,
+                        uint64_t default_id, uint64_t* out_ids, float* out_w,
+                        int32_t* out_t) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  auto& rng = et::ThreadLocalRng();
+  size_t k = static_cast<size_t>(count);
+  for (int64_t i = 0; i < n; ++i) {
+    g->SampleNeighbor(ids[i], edge_types, static_cast<size_t>(n_et), k,
+                      default_id, &rng, out_ids + i * k,
+                      out_w ? out_w + i * k : nullptr,
+                      out_t ? out_t + i * k : nullptr);
+  }
+  return 0;
+}
+
+int etg_sample_in_neighbor(int64_t h, const uint64_t* ids, int64_t n,
+                           const int32_t* edge_types, int64_t n_et,
+                           int64_t count, uint64_t default_id,
+                           uint64_t* out_ids, float* out_w, int32_t* out_t) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  auto& rng = et::ThreadLocalRng();
+  size_t k = static_cast<size_t>(count);
+  for (int64_t i = 0; i < n; ++i) {
+    g->SampleInNeighbor(ids[i], edge_types, static_cast<size_t>(n_et), k,
+                        default_id, &rng, out_ids + i * k,
+                        out_w ? out_w + i * k : nullptr,
+                        out_t ? out_t + i * k : nullptr);
+  }
+  return 0;
+}
+
+int etg_get_top_k_neighbor(int64_t h, const uint64_t* ids, int64_t n,
+                           const int32_t* edge_types, int64_t n_et, int64_t k,
+                           uint64_t default_id, uint64_t* out_ids,
+                           float* out_w, int32_t* out_t) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  size_t kk = static_cast<size_t>(k);
+  for (int64_t i = 0; i < n; ++i) {
+    g->GetTopKNeighbor(ids[i], edge_types, static_cast<size_t>(n_et), kk,
+                       default_id, out_ids + i * kk, out_w + i * kk,
+                       out_t + i * kk);
+  }
+  return 0;
+}
+
+int etg_sample_fanout(int64_t h, const uint64_t* roots, int64_t n_roots,
+                      const int32_t* counts, int64_t n_hops,
+                      const int32_t* edge_types, const int64_t* et_offsets,
+                      uint64_t default_id, uint64_t** out_ids, float** out_w,
+                      int32_t** out_t) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  std::vector<et::NodeId*> ids(n_hops);
+  std::vector<float*> ws(n_hops);
+  std::vector<int32_t*> ts(n_hops);
+  for (int64_t i = 0; i < n_hops; ++i) {
+    ids[i] = out_ids[i];
+    ws[i] = out_w[i];
+    ts[i] = out_t[i];
+  }
+  et::SampleFanout(*g, roots, static_cast<size_t>(n_roots), counts,
+                   static_cast<size_t>(n_hops), edge_types, et_offsets,
+                   default_id, &et::ThreadLocalRng(), ids, ws, ts);
+  return 0;
+}
+
+int etg_random_walk(int64_t h, const uint64_t* roots, int64_t n, int64_t len,
+                    float p, float q, uint64_t default_id,
+                    const int32_t* edge_types, int64_t n_et, uint64_t* out) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  et::RandomWalk(*g, roots, static_cast<size_t>(n), static_cast<size_t>(len),
+                 p, q, default_id, edge_types, static_cast<size_t>(n_et),
+                 &et::ThreadLocalRng(), out);
+  return 0;
+}
+
+int etg_sample_layerwise(int64_t h, const uint64_t* roots, int64_t n_roots,
+                         const int32_t* layer_sizes, int64_t n_layers,
+                         const int32_t* edge_types, int64_t n_et,
+                         uint64_t default_id, uint64_t** out_layers) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  std::vector<et::NodeId*> layers(n_layers);
+  for (int64_t i = 0; i < n_layers; ++i) layers[i] = out_layers[i];
+  et::SampleLayerwise(*g, roots, static_cast<size_t>(n_roots), layer_sizes,
+                      static_cast<size_t>(n_layers), edge_types,
+                      static_cast<size_t>(n_et), default_id,
+                      &et::ThreadLocalRng(), layers);
+  return 0;
+}
+
+// ---- features ----
+int etg_get_dense_feature(int64_t h, const uint64_t* ids, int64_t n, int fid,
+                          int64_t dim, float* out) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  g->GetDenseFeature(ids, static_cast<size_t>(n), fid, dim, out);
+  return 0;
+}
+
+int etg_get_edge_dense_feature(int64_t h, const uint64_t* src,
+                               const uint64_t* dst, const int32_t* types,
+                               int64_t n, int fid, int64_t dim, float* out) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  g->GetEdgeDenseFeature(src, dst, types, static_cast<size_t>(n), fid, dim,
+                         out);
+  return 0;
+}
+
+// ---- variable-size results ----
+EtResult* etres_new() { return new EtResult(); }
+void etres_free(EtResult* r) { delete r; }
+int64_t etres_offsets_len(EtResult* r) {
+  return static_cast<int64_t>(r->offsets.size());
+}
+const uint64_t* etres_offsets(EtResult* r) { return r->offsets.data(); }
+int64_t etres_u64_len(EtResult* r) { return static_cast<int64_t>(r->u64.size()); }
+const uint64_t* etres_u64(EtResult* r) { return r->u64.data(); }
+int64_t etres_f32_len(EtResult* r) { return static_cast<int64_t>(r->f32.size()); }
+const float* etres_f32(EtResult* r) { return r->f32.data(); }
+int64_t etres_i32_len(EtResult* r) { return static_cast<int64_t>(r->i32.size()); }
+const int32_t* etres_i32(EtResult* r) { return r->i32.data(); }
+int64_t etres_bytes_len(EtResult* r) {
+  return static_cast<int64_t>(r->bytes.size());
+}
+const char* etres_bytes(EtResult* r) { return r->bytes.data(); }
+
+int etg_get_full_neighbor(int64_t h, const uint64_t* ids, int64_t n,
+                          const int32_t* edge_types, int64_t n_et,
+                          int sorted_by_id, int in_edges, EtResult* res) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  res->offsets.assign(1, 0);
+  res->u64.clear();
+  res->f32.clear();
+  res->i32.clear();
+  std::vector<et::NodeId> ids_v;
+  std::vector<float> ws_v;
+  std::vector<int32_t> ts_v;
+  for (int64_t i = 0; i < n; ++i) {
+    ids_v.clear();
+    ws_v.clear();
+    ts_v.clear();
+    if (in_edges) {
+      g->GetFullInNeighbor(ids[i], edge_types, static_cast<size_t>(n_et),
+                           &ids_v, &ws_v, &ts_v);
+    } else {
+      g->GetFullNeighbor(ids[i], edge_types, static_cast<size_t>(n_et), &ids_v,
+                         &ws_v, &ts_v, sorted_by_id != 0);
+    }
+    res->u64.insert(res->u64.end(), ids_v.begin(), ids_v.end());
+    res->f32.insert(res->f32.end(), ws_v.begin(), ws_v.end());
+    res->i32.insert(res->i32.end(), ts_v.begin(), ts_v.end());
+    res->offsets.push_back(res->u64.size());
+  }
+  return 0;
+}
+
+int etg_get_sparse_feature(int64_t h, const uint64_t* ids, int64_t n, int fid,
+                           EtResult* res) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  res->offsets.clear();
+  res->u64.clear();
+  g->GetSparseFeature(ids, static_cast<size_t>(n), fid, &res->offsets,
+                      &res->u64);
+  return 0;
+}
+
+int etg_get_binary_feature(int64_t h, const uint64_t* ids, int64_t n, int fid,
+                           EtResult* res) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  res->offsets.clear();
+  res->bytes.clear();
+  g->GetBinaryFeature(ids, static_cast<size_t>(n), fid, &res->offsets,
+                      &res->bytes);
+  return 0;
+}
+
+int etg_get_edge_sparse_feature(int64_t h, const uint64_t* src,
+                                const uint64_t* dst, const int32_t* types,
+                                int64_t n, int fid, EtResult* res) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  res->offsets.clear();
+  res->u64.clear();
+  g->GetEdgeSparseFeature(src, dst, types, static_cast<size_t>(n), fid,
+                          &res->offsets, &res->u64);
+  return 0;
+}
+
+int etg_get_edge_binary_feature(int64_t h, const uint64_t* src,
+                                const uint64_t* dst, const int32_t* types,
+                                int64_t n, int fid, EtResult* res) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  res->offsets.clear();
+  res->bytes.clear();
+  g->GetEdgeBinaryFeature(src, dst, types, static_cast<size_t>(n), fid,
+                          &res->offsets, &res->bytes);
+  return 0;
+}
+
+}  // extern "C"

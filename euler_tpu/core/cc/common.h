@@ -1,0 +1,156 @@
+// euler_tpu common runtime: status, logging, RNG.
+//
+// Capability parity with the reference's euler/common/{status.h,logging.h,
+// random.cc} (see SURVEY.md §2.1), redesigned: header-only where possible,
+// no singletons beyond the logger level, thread-local PCG32 RNG instead of
+// rand_r (faster, better statistical quality, reproducible via explicit
+// seeding for tests).
+#ifndef EULER_TPU_COMMON_H_
+#define EULER_TPU_COMMON_H_
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <sstream>
+#include <string>
+
+namespace et {
+
+// ---------------------------------------------------------------------------
+// Status
+// ---------------------------------------------------------------------------
+enum class Code : int {
+  kOk = 0,
+  kInvalidArgument = 1,
+  kNotFound = 2,
+  kAlreadyExists = 3,
+  kOutOfRange = 4,
+  kInternal = 5,
+  kIOError = 6,
+  kUnimplemented = 7,
+};
+
+class Status {
+ public:
+  Status() : code_(Code::kOk) {}
+  Status(Code code, std::string msg) : code_(code), msg_(std::move(msg)) {}
+
+  static Status OK() { return Status(); }
+  static Status InvalidArgument(const std::string& m) {
+    return Status(Code::kInvalidArgument, m);
+  }
+  static Status NotFound(const std::string& m) {
+    return Status(Code::kNotFound, m);
+  }
+  static Status Internal(const std::string& m) {
+    return Status(Code::kInternal, m);
+  }
+  static Status IOError(const std::string& m) {
+    return Status(Code::kIOError, m);
+  }
+
+  bool ok() const { return code_ == Code::kOk; }
+  Code code() const { return code_; }
+  const std::string& message() const { return msg_; }
+
+ private:
+  Code code_;
+  std::string msg_;
+};
+
+#define ET_RETURN_IF_ERROR(expr)              \
+  do {                                        \
+    ::et::Status _s = (expr);                 \
+    if (!_s.ok()) return _s;                  \
+  } while (0)
+
+// ---------------------------------------------------------------------------
+// Logging: ET_LOG(INFO) << "..."; levels DEBUG/INFO/WARNING/ERROR/FATAL.
+// ---------------------------------------------------------------------------
+enum class LogLevel : int { kDebug = 0, kInfo = 1, kWarning = 2, kError = 3, kFatal = 4 };
+
+inline int& MinLogLevel() {
+  static int level = 1;  // INFO by default; override with EULER_TPU_LOG_LEVEL.
+  return level;
+}
+
+class LogMessage {
+ public:
+  LogMessage(const char* file, int line, LogLevel level)
+      : level_(level) {
+    const char* names[] = {"D", "I", "W", "E", "F"};
+    stream_ << "[" << names[static_cast<int>(level)] << " " << file << ":"
+            << line << "] ";
+  }
+  ~LogMessage() {
+    if (static_cast<int>(level_) >= MinLogLevel()) {
+      stream_ << "\n";
+      std::fputs(stream_.str().c_str(), stderr);
+    }
+    if (level_ == LogLevel::kFatal) std::abort();
+  }
+  std::ostringstream& stream() { return stream_; }
+
+ private:
+  LogLevel level_;
+  std::ostringstream stream_;
+};
+
+#define ET_LOG_DEBUG ::et::LogMessage(__FILE__, __LINE__, ::et::LogLevel::kDebug).stream()
+#define ET_LOG_INFO ::et::LogMessage(__FILE__, __LINE__, ::et::LogLevel::kInfo).stream()
+#define ET_LOG_WARNING ::et::LogMessage(__FILE__, __LINE__, ::et::LogLevel::kWarning).stream()
+#define ET_LOG_ERROR ::et::LogMessage(__FILE__, __LINE__, ::et::LogLevel::kError).stream()
+#define ET_LOG_FATAL ::et::LogMessage(__FILE__, __LINE__, ::et::LogLevel::kFatal).stream()
+#define ET_LOG(severity) ET_LOG_##severity
+
+#define ET_CHECK(cond)                                              \
+  if (!(cond)) ET_LOG(FATAL) << "Check failed: " #cond " "
+
+// ---------------------------------------------------------------------------
+// RNG: PCG32 — small, fast, statistically solid. Thread-local instance for
+// sampling hot paths; explicit instances for reproducible tests.
+// ---------------------------------------------------------------------------
+class Pcg32 {
+ public:
+  explicit Pcg32(uint64_t seed = 0x853c49e6748fea9bULL,
+                 uint64_t seq = 0xda3e39cb94b95bdbULL) {
+    state_ = 0u;
+    inc_ = (seq << 1u) | 1u;
+    NextU32();
+    state_ += seed;
+    NextU32();
+  }
+
+  uint32_t NextU32() {
+    uint64_t old = state_;
+    state_ = old * 6364136223846793005ULL + inc_;
+    uint32_t xorshifted = static_cast<uint32_t>(((old >> 18u) ^ old) >> 27u);
+    uint32_t rot = static_cast<uint32_t>(old >> 59u);
+    return (xorshifted >> rot) | (xorshifted << ((-rot) & 31));
+  }
+
+  // Uniform in [0, 1).
+  float NextFloat() { return (NextU32() >> 8) * (1.0f / 16777216.0f); }
+
+  // Uniform integer in [0, n).
+  uint64_t NextUInt(uint64_t n) {
+    if (n == 0) return 0;
+    uint64_t hi = (static_cast<uint64_t>(NextU32()) << 32) | NextU32();
+    return hi % n;
+  }
+
+  void Seed(uint64_t seed) { *this = Pcg32(seed); }
+
+ private:
+  uint64_t state_;
+  uint64_t inc_;
+};
+
+Pcg32& ThreadLocalRng();
+// Seed every thread-local RNG deterministically (current thread only; new
+// threads derive from this base). Used for reproducible tests and bench runs.
+void SeedGlobalRng(uint64_t seed);
+
+}  // namespace et
+
+#endif  // EULER_TPU_COMMON_H_

@@ -1,0 +1,899 @@
+#include "graph.h"
+
+#include <algorithm>
+#include <cstring>
+#include <numeric>
+
+namespace et {
+
+// ---------------------------------------------------------------------------
+// GraphBuilder
+// ---------------------------------------------------------------------------
+
+uint32_t GraphBuilder::EnsureNode(NodeId id, int32_t type, float weight,
+                                  bool overwrite) {
+  auto it = node_row_.find(id);
+  if (it != node_row_.end()) {
+    if (overwrite) {
+      nodes_[it->second].type = type;
+      nodes_[it->second].weight = weight;
+    }
+    return it->second;
+  }
+  uint32_t row = static_cast<uint32_t>(nodes_.size());
+  nodes_.push_back({id, type, weight});
+  node_row_.emplace(id, row);
+  return row;
+}
+
+void GraphBuilder::AddNode(NodeId id, int32_t type, float weight) {
+  EnsureNode(id, type, weight, /*overwrite=*/true);
+  if (type >= meta_.num_node_types) meta_.num_node_types = type + 1;
+}
+
+void GraphBuilder::AddEdge(NodeId src, NodeId dst, int32_t type,
+                           float weight) {
+  if (type < 0) {
+    ET_LOG(WARNING) << "AddEdge: negative edge type " << type << " ignored";
+    return;
+  }
+  uint32_t srow = EnsureNode(src, 0, 1.0f, /*overwrite=*/false);
+  if (type >= meta_.num_edge_types) meta_.num_edge_types = type + 1;
+  auto key = std::make_tuple(srow, dst, type);
+  auto it = edge_row_.find(key);
+  if (it != edge_row_.end()) {
+    edges_[it->second].weight = weight;
+    return;
+  }
+  edge_row_.emplace(key, edges_.size());
+  edges_.push_back({src, dst, type, weight});
+}
+
+void GraphBuilder::AddNodes(const NodeId* ids, const int32_t* types,
+                            const float* weights, size_t n) {
+  nodes_.reserve(nodes_.size() + n);
+  for (size_t i = 0; i < n; ++i) {
+    AddNode(ids[i], types ? types[i] : 0, weights ? weights[i] : 1.0f);
+  }
+}
+
+void GraphBuilder::AddEdges(const NodeId* src, const NodeId* dst,
+                            const int32_t* types, const float* weights,
+                            size_t n) {
+  edges_.reserve(edges_.size() + n);
+  for (size_t i = 0; i < n; ++i) {
+    AddEdge(src[i], dst[i], types ? types[i] : 0,
+            weights ? weights[i] : 1.0f);
+  }
+}
+
+std::vector<GraphBuilder::FeatCell>* GraphBuilder::NodeCells(int fid) {
+  if (fid < 0) return nullptr;
+  if (static_cast<size_t>(fid) >= node_feat_cells_.size()) {
+    node_feat_cells_.resize(fid + 1);
+  }
+  if (static_cast<size_t>(fid) >= meta_.node_features.size()) {
+    meta_.node_features.resize(fid + 1);
+  }
+  return &node_feat_cells_[fid];
+}
+
+std::vector<GraphBuilder::FeatCell>* GraphBuilder::EdgeCells(int fid) {
+  if (fid < 0) return nullptr;
+  if (static_cast<size_t>(fid) >= edge_feat_cells_.size()) {
+    edge_feat_cells_.resize(fid + 1);
+  }
+  if (static_cast<size_t>(fid) >= meta_.edge_features.size()) {
+    meta_.edge_features.resize(fid + 1);
+  }
+  return &edge_feat_cells_[fid];
+}
+
+void GraphBuilder::SetNodeDense(NodeId id, int fid, const float* v,
+                                int64_t dim) {
+  uint32_t row = EnsureNode(id, 0, 1.0f, false);
+  auto* cells = NodeCells(fid);
+  FeatCell c;
+  c.row = row;
+  c.f32.assign(v, v + dim);
+  cells->push_back(std::move(c));
+  auto& info = meta_.node_features[fid];
+  info.kind = FeatureKind::kDense;
+  if (dim > info.dim) info.dim = dim;
+}
+
+void GraphBuilder::SetNodeSparse(NodeId id, int fid, const uint64_t* v,
+                                 int64_t len) {
+  uint32_t row = EnsureNode(id, 0, 1.0f, false);
+  auto* cells = NodeCells(fid);
+  FeatCell c;
+  c.row = row;
+  c.u64.assign(v, v + len);
+  cells->push_back(std::move(c));
+  auto& info = meta_.node_features[fid];
+  info.kind = FeatureKind::kSparse;
+  if (len > info.dim) info.dim = len;
+}
+
+void GraphBuilder::SetNodeBinary(NodeId id, int fid, const char* v,
+                                 int64_t len) {
+  uint32_t row = EnsureNode(id, 0, 1.0f, false);
+  auto* cells = NodeCells(fid);
+  FeatCell c;
+  c.row = row;
+  c.bytes.assign(v, v + len);
+  cells->push_back(std::move(c));
+  meta_.node_features[fid].kind = FeatureKind::kBinary;
+}
+
+int64_t GraphBuilder::FindEdgeRow(NodeId src, NodeId dst,
+                                  int32_t type) const {
+  auto nit = node_row_.find(src);
+  if (nit == node_row_.end()) return -1;
+  auto it = edge_row_.find(std::make_tuple(nit->second, dst, type));
+  return it == edge_row_.end() ? -1 : static_cast<int64_t>(it->second);
+}
+
+void GraphBuilder::SetEdgeDense(NodeId src, NodeId dst, int32_t type, int fid,
+                                const float* v, int64_t dim) {
+  int64_t row = FindEdgeRow(src, dst, type);
+  if (row < 0) return;
+  auto* cells = EdgeCells(fid);
+  FeatCell c;
+  c.row = static_cast<uint64_t>(row);
+  c.f32.assign(v, v + dim);
+  cells->push_back(std::move(c));
+  auto& info = meta_.edge_features[fid];
+  info.kind = FeatureKind::kDense;
+  if (dim > info.dim) info.dim = dim;
+}
+
+void GraphBuilder::SetEdgeSparse(NodeId src, NodeId dst, int32_t type,
+                                 int fid, const uint64_t* v, int64_t len) {
+  int64_t row = FindEdgeRow(src, dst, type);
+  if (row < 0) return;
+  auto* cells = EdgeCells(fid);
+  FeatCell c;
+  c.row = static_cast<uint64_t>(row);
+  c.u64.assign(v, v + len);
+  cells->push_back(std::move(c));
+  auto& info = meta_.edge_features[fid];
+  info.kind = FeatureKind::kSparse;
+  if (len > info.dim) info.dim = len;
+}
+
+void GraphBuilder::SetEdgeBinary(NodeId src, NodeId dst, int32_t type,
+                                 int fid, const char* v, int64_t len) {
+  int64_t row = FindEdgeRow(src, dst, type);
+  if (row < 0) return;
+  auto* cells = EdgeCells(fid);
+  FeatCell c;
+  c.row = static_cast<uint64_t>(row);
+  c.bytes.assign(v, v + len);
+  cells->push_back(std::move(c));
+  meta_.edge_features[fid].kind = FeatureKind::kBinary;
+}
+
+void GraphBuilder::SetNodeDenseBulk(const NodeId* ids, size_t n, int fid,
+                                    int64_t dim, const float* values) {
+  for (size_t i = 0; i < n; ++i) {
+    SetNodeDense(ids[i], fid, values + i * dim, dim);
+  }
+}
+
+void GraphBuilder::SetEdgeDenseBulk(const NodeId* src, const NodeId* dst,
+                                    const int32_t* types, size_t n, int fid,
+                                    int64_t dim, const float* values) {
+  for (size_t i = 0; i < n; ++i) {
+    SetEdgeDense(src[i], dst[i], types ? types[i] : 0, fid, values + i * dim,
+                 dim);
+  }
+}
+
+void GraphBuilder::SetNodeSparseBulk(const NodeId* ids, size_t n, int fid,
+                                     const uint64_t* offsets,
+                                     const uint64_t* values) {
+  for (size_t i = 0; i < n; ++i) {
+    SetNodeSparse(ids[i], fid, values + offsets[i],
+                  static_cast<int64_t>(offsets[i + 1] - offsets[i]));
+  }
+}
+
+std::unique_ptr<Graph> GraphBuilder::Finalize(bool build_in_adjacency) {
+  auto g = std::unique_ptr<Graph>(new Graph());
+  const size_t N = nodes_.size();
+  const size_t E = edges_.size();
+  const int ET = std::max(meta_.num_edge_types, 1);
+  const int NT = std::max(meta_.num_node_types, 1);
+  meta_.num_edge_types = ET;
+  meta_.num_node_types = NT;
+  meta_.node_count = N;
+  meta_.edge_count = E;
+  if (meta_.node_type_names.size() < static_cast<size_t>(NT)) {
+    meta_.node_type_names.resize(NT);
+    for (int t = 0; t < NT; ++t) {
+      if (meta_.node_type_names[t].empty()) {
+        meta_.node_type_names[t] = std::to_string(t);
+      }
+    }
+  }
+  if (meta_.edge_type_names.size() < static_cast<size_t>(ET)) {
+    meta_.edge_type_names.resize(ET);
+    for (int t = 0; t < ET; ++t) {
+      if (meta_.edge_type_names[t].empty()) {
+        meta_.edge_type_names[t] = std::to_string(t);
+      }
+    }
+  }
+  g->meta_ = meta_;
+
+  // ---- nodes ----
+  g->node_ids_.resize(N);
+  g->node_types_.resize(N);
+  g->node_weights_.resize(N);
+  for (size_t i = 0; i < N; ++i) {
+    g->node_ids_[i] = nodes_[i].id;
+    g->node_types_[i] = nodes_[i].type;
+    g->node_weights_[i] = nodes_[i].weight;
+  }
+  g->id2idx_ = node_row_;
+
+  // ---- out-adjacency CSR, grouped by (src row, edge type) ----
+  std::vector<uint64_t> group_count(N * ET + 1, 0);
+  std::vector<uint32_t> esrc_row(E);
+  for (size_t e = 0; e < E; ++e) {
+    uint32_t srow = node_row_.at(edges_[e].src);
+    esrc_row[e] = srow;
+    group_count[static_cast<size_t>(srow) * ET + edges_[e].type + 1]++;
+  }
+  g->adj_offsets_.assign(N * ET + 1, 0);
+  for (size_t i = 1; i <= N * ET; ++i) {
+    g->adj_offsets_[i] = g->adj_offsets_[i - 1] + group_count[i];
+  }
+  // Order edges within a group by dst id → deterministic layout and free
+  // sorted-full-neighbor. Sort edge row indices by (group, dst).
+  std::vector<uint64_t> order(E);
+  std::iota(order.begin(), order.end(), 0);
+  std::sort(order.begin(), order.end(), [&](uint64_t a, uint64_t b) {
+    size_t ga = static_cast<size_t>(esrc_row[a]) * ET + edges_[a].type;
+    size_t gb = static_cast<size_t>(esrc_row[b]) * ET + edges_[b].type;
+    if (ga != gb) return ga < gb;
+    return edges_[a].dst < edges_[b].dst;
+  });
+  g->adj_nbr_.resize(E);
+  g->adj_w_.resize(E);
+  g->adj_cumw_.resize(E);
+  std::vector<uint64_t> row2slot(E);  // builder edge row → adjacency slot
+  for (size_t s = 0; s < E; ++s) {
+    const EdgeRow& er = edges_[order[s]];
+    g->adj_nbr_[s] = er.dst;
+    g->adj_w_[s] = er.weight;
+    row2slot[order[s]] = s;
+  }
+  for (size_t gi = 0; gi < N * ET; ++gi) {
+    float run = 0.f;
+    for (uint64_t s = g->adj_offsets_[gi]; s < g->adj_offsets_[gi + 1]; ++s) {
+      run += g->adj_w_[s];
+      g->adj_cumw_[s] = run;
+    }
+  }
+  for (const auto& kv : edge_row_) {
+    g->edge_slot_.emplace(kv.first, row2slot[kv.second]);
+  }
+
+  // ---- in-adjacency (only edges whose dst is a local node) ----
+  if (build_in_adjacency) {
+    std::vector<uint64_t> in_count(N * ET + 1, 0);
+    for (size_t e = 0; e < E; ++e) {
+      auto it = node_row_.find(edges_[e].dst);
+      if (it == node_row_.end()) continue;
+      in_count[static_cast<size_t>(it->second) * ET + edges_[e].type + 1]++;
+    }
+    g->in_adj_offsets_.assign(N * ET + 1, 0);
+    for (size_t i = 1; i <= N * ET; ++i) {
+      g->in_adj_offsets_[i] = g->in_adj_offsets_[i - 1] + in_count[i];
+    }
+    size_t in_total = g->in_adj_offsets_[N * ET];
+    g->in_adj_nbr_.resize(in_total);
+    g->in_adj_w_.resize(in_total);
+    g->in_adj_cumw_.resize(in_total);
+    std::vector<uint64_t> cursor(g->in_adj_offsets_.begin(),
+                                 g->in_adj_offsets_.end() - 1);
+    // Respect the same by-src-id order inside each group for determinism.
+    for (size_t s = 0; s < E; ++s) {
+      const EdgeRow& er = edges_[order[s]];
+      auto it = node_row_.find(er.dst);
+      if (it == node_row_.end()) continue;
+      size_t gi = static_cast<size_t>(it->second) * ET + er.type;
+      uint64_t pos = cursor[gi]++;
+      g->in_adj_nbr_[pos] = er.src;
+      g->in_adj_w_[pos] = er.weight;
+    }
+    for (size_t gi = 0; gi < N * ET; ++gi) {
+      float run = 0.f;
+      for (uint64_t s = g->in_adj_offsets_[gi]; s < g->in_adj_offsets_[gi + 1];
+           ++s) {
+        run += g->in_adj_w_[s];
+        g->in_adj_cumw_[s] = run;
+      }
+    }
+  }
+
+  // ---- global samplers & weight sums ----
+  g->nodes_by_type_.assign(NT, {});
+  g->node_type_wsum_.assign(NT, 0.f);
+  for (size_t i = 0; i < N; ++i) {
+    int32_t t = g->node_types_[i];
+    if (t >= 0 && t < NT) {
+      g->nodes_by_type_[t].push_back(static_cast<uint32_t>(i));
+      g->node_type_wsum_[t] += g->node_weights_[i];
+    }
+  }
+  g->node_sampler_by_type_.resize(NT);
+  std::vector<float> wbuf;
+  for (int t = 0; t < NT; ++t) {
+    wbuf.clear();
+    for (uint32_t i : g->nodes_by_type_[t]) wbuf.push_back(g->node_weights_[i]);
+    g->node_sampler_by_type_[t].Init(wbuf);
+  }
+  g->node_sampler_all_.Init(g->node_weights_);
+
+  g->edges_by_type_.assign(ET, {});
+  g->edge_type_wsum_.assign(ET, 0.f);
+  {
+    // slot → type from group index
+    for (size_t gi = 0; gi < N * ET; ++gi) {
+      int32_t t = static_cast<int32_t>(gi % ET);
+      for (uint64_t s = g->adj_offsets_[gi]; s < g->adj_offsets_[gi + 1];
+           ++s) {
+        g->edges_by_type_[t].push_back(s);
+        g->edge_type_wsum_[t] += g->adj_w_[s];
+      }
+    }
+  }
+  g->edge_sampler_by_type_.resize(ET);
+  for (int t = 0; t < ET; ++t) {
+    wbuf.clear();
+    for (uint64_t s : g->edges_by_type_[t]) wbuf.push_back(g->adj_w_[s]);
+    g->edge_sampler_by_type_[t].Init(wbuf);
+  }
+  g->edge_sampler_all_.Init(g->adj_w_);
+
+  // ---- features ----
+  auto pack_node = [&](int nfids, bool is_node) {
+    auto& cells_by_fid = is_node ? node_feat_cells_ : edge_feat_cells_;
+    auto& infos = is_node ? g->meta_.node_features : g->meta_.edge_features;
+    auto& dense = is_node ? g->node_dense_ : g->edge_dense_;
+    auto& var = is_node ? g->node_var_ : g->edge_var_;
+    size_t rows = is_node ? N : E;
+    dense.resize(infos.size());
+    var.resize(infos.size());
+    for (size_t fid = 0; fid < cells_by_fid.size(); ++fid) {
+      auto& cells = cells_by_fid[fid];
+      const FeatureInfo& info = infos[fid];
+      if (info.kind == FeatureKind::kDense) {
+        int64_t dim = std::max<int64_t>(info.dim, 1);
+        dense[fid].assign(rows * dim, 0.f);
+        for (const auto& c : cells) {
+          uint64_t r = is_node ? c.row : row2slot[c.row];
+          int64_t n = std::min<int64_t>(dim, c.f32.size());
+          std::memcpy(dense[fid].data() + r * dim, c.f32.data(),
+                      n * sizeof(float));
+        }
+      } else {
+        // CSR over rows. A row may have been set twice (last wins) — dedupe
+        // to one cell per row before sizing, or the copy pass would write
+        // a stale longer payload past the row's region.
+        std::unordered_map<uint64_t, const FeatCell*> last_cell;
+        for (const auto& c : cells) {
+          last_cell[is_node ? c.row : row2slot[c.row]] = &c;
+        }
+        auto& vf = var[fid];
+        vf.offsets.assign(rows + 1, 0);
+        bool sparse = info.kind == FeatureKind::kSparse;
+        for (const auto& kv : last_cell) {
+          vf.offsets[kv.first + 1] =
+              sparse ? kv.second->u64.size() : kv.second->bytes.size();
+        }
+        for (size_t r = 0; r < rows; ++r) vf.offsets[r + 1] += vf.offsets[r];
+        if (sparse) {
+          vf.values_u64.resize(vf.offsets[rows]);
+        } else {
+          vf.values_bytes.resize(vf.offsets[rows]);
+        }
+        for (const auto& kv : last_cell) {
+          uint64_t r = kv.first;
+          if (sparse) {
+            std::copy(kv.second->u64.begin(), kv.second->u64.end(),
+                      vf.values_u64.begin() + vf.offsets[r]);
+          } else {
+            std::copy(kv.second->bytes.begin(), kv.second->bytes.end(),
+                      vf.values_bytes.begin() + vf.offsets[r]);
+          }
+        }
+      }
+    }
+    (void)nfids;
+  };
+  pack_node(0, true);
+  pack_node(0, false);
+
+  return g;
+}
+
+// ---------------------------------------------------------------------------
+// Graph: sampling
+// ---------------------------------------------------------------------------
+
+void Graph::SampleNode(int type, size_t count, Pcg32* rng,
+                       NodeId* out_ids) const {
+  if (node_ids_.empty()) {
+    for (size_t i = 0; i < count; ++i) out_ids[i] = 0;
+    return;
+  }
+  if (type < 0) {
+    for (size_t i = 0; i < count; ++i) {
+      out_ids[i] = node_ids_[node_sampler_all_.Sample(rng)];
+    }
+    return;
+  }
+  if (type >= meta_.num_node_types || nodes_by_type_[type].empty()) {
+    for (size_t i = 0; i < count; ++i) out_ids[i] = 0;
+    return;
+  }
+  const auto& pool = nodes_by_type_[type];
+  const auto& sampler = node_sampler_by_type_[type];
+  for (size_t i = 0; i < count; ++i) {
+    out_ids[i] = node_ids_[pool[sampler.Sample(rng)]];
+  }
+}
+
+void Graph::SampleNodeWithTypes(const int32_t* types, size_t count,
+                                Pcg32* rng, NodeId* out_ids) const {
+  for (size_t i = 0; i < count; ++i) {
+    SampleNode(types[i], 1, rng, out_ids + i);
+  }
+}
+
+void Graph::SampleEdge(int type, size_t count, Pcg32* rng, NodeId* out_src,
+                       NodeId* out_dst, int32_t* out_type) const {
+  const int ET = meta_.num_edge_types;
+  auto emit = [&](uint64_t slot, size_t i) {
+    // slot → group via binary search on offsets; src = group / ET.
+    auto it = std::upper_bound(adj_offsets_.begin(), adj_offsets_.end(), slot);
+    size_t gi = static_cast<size_t>(it - adj_offsets_.begin()) - 1;
+    out_src[i] = node_ids_[gi / ET];
+    out_dst[i] = adj_nbr_[slot];
+    out_type[i] = static_cast<int32_t>(gi % ET);
+  };
+  if (adj_nbr_.empty()) {
+    for (size_t i = 0; i < count; ++i) {
+      out_src[i] = out_dst[i] = 0;
+      out_type[i] = -1;
+    }
+    return;
+  }
+  if (type < 0) {
+    for (size_t i = 0; i < count; ++i) emit(edge_sampler_all_.Sample(rng), i);
+    return;
+  }
+  if (type >= ET || edges_by_type_[type].empty()) {
+    for (size_t i = 0; i < count; ++i) {
+      out_src[i] = out_dst[i] = 0;
+      out_type[i] = -1;
+    }
+    return;
+  }
+  const auto& pool = edges_by_type_[type];
+  const auto& sampler = edge_sampler_by_type_[type];
+  for (size_t i = 0; i < count; ++i) {
+    emit(pool[sampler.Sample(rng)], i);
+  }
+}
+
+namespace {
+// Scratch for candidate-group gathering on the sampling hot path:
+// thread-local to avoid per-call allocation, unbounded so graphs with any
+// number of edge types sample correctly.
+struct GroupScratch {
+  std::vector<float> totals;
+  std::vector<size_t> begins, ends;
+  std::vector<int32_t> types;
+  void clear() {
+    totals.clear();
+    begins.clear();
+    ends.clear();
+    types.clear();
+  }
+};
+GroupScratch& TlsGroupScratch() {
+  thread_local GroupScratch s;
+  return s;
+}
+}  // namespace
+
+uint64_t Graph::SampleAdjSlot(uint32_t idx, const int32_t* edge_types,
+                              size_t n_types, Pcg32* rng) const {
+  const int ET = meta_.num_edge_types;
+  // Gather candidate group totals; ET is small so a linear pass beats any
+  // fancier structure.
+  GroupScratch& s = TlsGroupScratch();
+  s.clear();
+  float grand = 0.f;
+  auto consider = [&](int et) {
+    if (et < 0 || et >= ET) return;
+    size_t b, e;
+    GroupRange(idx, et, &b, &e);
+    if (e <= b) return;
+    float t = adj_cumw_[e - 1];
+    if (t <= 0.f) return;
+    s.totals.push_back(t);
+    s.begins.push_back(b);
+    s.ends.push_back(e);
+    grand += t;
+  };
+  if (edge_types == nullptr || n_types == 0) {
+    for (int et = 0; et < ET; ++et) consider(et);
+  } else {
+    for (size_t i = 0; i < n_types; ++i) consider(edge_types[i]);
+  }
+  size_t ng = s.totals.size();
+  if (ng == 0 || grand <= 0.f) return kNoSlot;
+  float r = rng->NextFloat() * grand;
+  size_t gsel = 0;
+  float run = 0.f;
+  for (; gsel < ng; ++gsel) {
+    run += s.totals[gsel];
+    if (r < run) break;
+  }
+  if (gsel >= ng) gsel = ng - 1;
+  return SampleFromCumulative(adj_cumw_.data(), s.begins[gsel], s.ends[gsel],
+                              rng);
+}
+
+void Graph::SampleNeighbor(NodeId id, const int32_t* edge_types,
+                           size_t n_types, size_t count, NodeId default_id,
+                           Pcg32* rng, NodeId* out_ids, float* out_w,
+                           int32_t* out_t) const {
+  uint32_t idx = NodeIndex(id);
+  const int ET = meta_.num_edge_types;
+  for (size_t i = 0; i < count; ++i) {
+    uint64_t slot = idx == kInvalidIndex
+                        ? kNoSlot
+                        : SampleAdjSlot(idx, edge_types, n_types, rng);
+    if (slot == kNoSlot) {
+      out_ids[i] = default_id;
+      if (out_w) out_w[i] = 0.f;
+      if (out_t) out_t[i] = -1;
+    } else {
+      out_ids[i] = adj_nbr_[slot];
+      if (out_w) out_w[i] = adj_w_[slot];
+      if (out_t) {
+        auto it =
+            std::upper_bound(adj_offsets_.begin(), adj_offsets_.end(), slot);
+        size_t gi = static_cast<size_t>(it - adj_offsets_.begin()) - 1;
+        out_t[i] = static_cast<int32_t>(gi % ET);
+      }
+    }
+  }
+}
+
+void Graph::GetFullNeighbor(NodeId id, const int32_t* edge_types,
+                            size_t n_types, std::vector<NodeId>* ids,
+                            std::vector<float>* ws, std::vector<int32_t>* ts,
+                            bool sorted_by_id) const {
+  uint32_t idx = NodeIndex(id);
+  if (idx == kInvalidIndex) return;
+  const int ET = meta_.num_edge_types;
+  auto grab = [&](int et) {
+    if (et < 0 || et >= ET) return;
+    size_t b, e;
+    GroupRange(idx, et, &b, &e);
+    for (size_t s = b; s < e; ++s) {
+      ids->push_back(adj_nbr_[s]);
+      ws->push_back(adj_w_[s]);
+      ts->push_back(et);
+    }
+  };
+  size_t base = ids->size();
+  if (edge_types == nullptr || n_types == 0) {
+    for (int et = 0; et < ET; ++et) grab(et);
+  } else {
+    for (size_t i = 0; i < n_types; ++i) grab(edge_types[i]);
+  }
+  if (sorted_by_id && ids->size() > base) {
+    // Groups are each id-sorted; across groups a merge is needed. Simple
+    // index sort over the appended range keeps the parallel arrays aligned.
+    size_t n = ids->size() - base;
+    std::vector<uint32_t> ord(n);
+    std::iota(ord.begin(), ord.end(), 0);
+    std::sort(ord.begin(), ord.end(), [&](uint32_t a, uint32_t b) {
+      return (*ids)[base + a] < (*ids)[base + b];
+    });
+    std::vector<NodeId> tid(n);
+    std::vector<float> tw(n);
+    std::vector<int32_t> tt(n);
+    for (size_t i = 0; i < n; ++i) {
+      tid[i] = (*ids)[base + ord[i]];
+      tw[i] = (*ws)[base + ord[i]];
+      tt[i] = (*ts)[base + ord[i]];
+    }
+    std::copy(tid.begin(), tid.end(), ids->begin() + base);
+    std::copy(tw.begin(), tw.end(), ws->begin() + base);
+    std::copy(tt.begin(), tt.end(), ts->begin() + base);
+  }
+}
+
+void Graph::GetTopKNeighbor(NodeId id, const int32_t* edge_types,
+                            size_t n_types, size_t k, NodeId default_id,
+                            NodeId* out_ids, float* out_w,
+                            int32_t* out_t) const {
+  std::vector<NodeId> ids;
+  std::vector<float> ws;
+  std::vector<int32_t> ts;
+  GetFullNeighbor(id, edge_types, n_types, &ids, &ws, &ts);
+  std::vector<uint32_t> ord(ids.size());
+  std::iota(ord.begin(), ord.end(), 0);
+  size_t take = std::min(k, ids.size());
+  std::partial_sort(ord.begin(), ord.begin() + take, ord.end(),
+                    [&](uint32_t a, uint32_t b) { return ws[a] > ws[b]; });
+  for (size_t i = 0; i < k; ++i) {
+    if (i < take) {
+      out_ids[i] = ids[ord[i]];
+      out_w[i] = ws[ord[i]];
+      out_t[i] = ts[ord[i]];
+    } else {
+      out_ids[i] = default_id;
+      out_w[i] = 0.f;
+      out_t[i] = -1;
+    }
+  }
+}
+
+void Graph::GetFullInNeighbor(NodeId id, const int32_t* edge_types,
+                              size_t n_types, std::vector<NodeId>* ids,
+                              std::vector<float>* ws,
+                              std::vector<int32_t>* ts) const {
+  uint32_t idx = NodeIndex(id);
+  if (idx == kInvalidIndex || in_adj_offsets_.empty()) return;
+  const int ET = meta_.num_edge_types;
+  auto grab = [&](int et) {
+    if (et < 0 || et >= ET) return;
+    size_t gi = static_cast<size_t>(idx) * ET + et;
+    for (uint64_t s = in_adj_offsets_[gi]; s < in_adj_offsets_[gi + 1]; ++s) {
+      ids->push_back(in_adj_nbr_[s]);
+      ws->push_back(in_adj_w_[s]);
+      ts->push_back(et);
+    }
+  };
+  if (edge_types == nullptr || n_types == 0) {
+    for (int et = 0; et < ET; ++et) grab(et);
+  } else {
+    for (size_t i = 0; i < n_types; ++i) grab(edge_types[i]);
+  }
+}
+
+void Graph::SampleInNeighbor(NodeId id, const int32_t* edge_types,
+                             size_t n_types, size_t count, NodeId default_id,
+                             Pcg32* rng, NodeId* out_ids, float* out_w,
+                             int32_t* out_t) const {
+  // In-adjacency groups share the cumw trick; reuse via a local gather.
+  uint32_t idx = NodeIndex(id);
+  const int ET = meta_.num_edge_types;
+  if (idx == kInvalidIndex || in_adj_offsets_.empty()) {
+    for (size_t i = 0; i < count; ++i) {
+      out_ids[i] = default_id;
+      if (out_w) out_w[i] = 0.f;
+      if (out_t) out_t[i] = -1;
+    }
+    return;
+  }
+  GroupScratch& s = TlsGroupScratch();
+  s.clear();
+  float grand = 0.f;
+  auto consider = [&](int et) {
+    if (et < 0 || et >= ET) return;
+    size_t gi = static_cast<size_t>(idx) * ET + et;
+    uint64_t b = in_adj_offsets_[gi], e = in_adj_offsets_[gi + 1];
+    if (e <= b) return;
+    float t = in_adj_cumw_[e - 1];
+    if (t <= 0.f) return;
+    s.totals.push_back(t);
+    s.begins.push_back(b);
+    s.ends.push_back(e);
+    s.types.push_back(et);
+    grand += t;
+  };
+  if (edge_types == nullptr || n_types == 0) {
+    for (int et = 0; et < ET; ++et) consider(et);
+  } else {
+    for (size_t i = 0; i < n_types; ++i) consider(edge_types[i]);
+  }
+  size_t ng = s.totals.size();
+  for (size_t i = 0; i < count; ++i) {
+    if (ng == 0 || grand <= 0.f) {
+      out_ids[i] = default_id;
+      if (out_w) out_w[i] = 0.f;
+      if (out_t) out_t[i] = -1;
+      continue;
+    }
+    float r = rng->NextFloat() * grand;
+    size_t gsel = 0;
+    float run = 0.f;
+    for (; gsel < ng; ++gsel) {
+      run += s.totals[gsel];
+      if (r < run) break;
+    }
+    if (gsel >= ng) gsel = ng - 1;
+    size_t slot = SampleFromCumulative(in_adj_cumw_.data(), s.begins[gsel],
+                                       s.ends[gsel], rng);
+    out_ids[i] = in_adj_nbr_[slot];
+    if (out_w) out_w[i] = in_adj_w_[slot];
+    if (out_t) out_t[i] = s.types[gsel];
+  }
+}
+
+size_t Graph::OutDegree(NodeId id, const int32_t* edge_types,
+                        size_t n_types) const {
+  uint32_t idx = NodeIndex(id);
+  if (idx == kInvalidIndex) return 0;
+  const int ET = meta_.num_edge_types;
+  size_t total = 0;
+  auto add = [&](int et) {
+    if (et < 0 || et >= ET) return;
+    size_t b, e;
+    GroupRange(idx, et, &b, &e);
+    total += e - b;
+  };
+  if (edge_types == nullptr || n_types == 0) {
+    for (int et = 0; et < ET; ++et) add(et);
+  } else {
+    for (size_t i = 0; i < n_types; ++i) add(edge_types[i]);
+  }
+  return total;
+}
+
+// ---------------------------------------------------------------------------
+// Graph: features
+// ---------------------------------------------------------------------------
+
+void Graph::GetDenseFeature(const NodeId* ids, size_t count, int fid,
+                            int64_t dim, float* out) const {
+  bool have = fid >= 0 && static_cast<size_t>(fid) < node_dense_.size() &&
+              !node_dense_[fid].empty();
+  int64_t stored_dim =
+      have ? std::max<int64_t>(meta_.node_features[fid].dim, 1) : 0;
+  for (size_t i = 0; i < count; ++i) {
+    float* dst = out + i * dim;
+    uint32_t idx = NodeIndex(ids[i]);
+    if (!have || idx == kInvalidIndex) {
+      std::memset(dst, 0, dim * sizeof(float));
+      continue;
+    }
+    int64_t n = std::min(dim, stored_dim);
+    std::memcpy(dst, node_dense_[fid].data() + idx * stored_dim,
+                n * sizeof(float));
+    if (n < dim) std::memset(dst + n, 0, (dim - n) * sizeof(float));
+  }
+}
+
+void Graph::GetSparseFeature(const NodeId* ids, size_t count, int fid,
+                             std::vector<uint64_t>* offsets,
+                             std::vector<uint64_t>* values) const {
+  offsets->resize(count + 1);
+  (*offsets)[0] = 0;
+  bool have = fid >= 0 && static_cast<size_t>(fid) < node_var_.size() &&
+              !node_var_[fid].offsets.empty();
+  for (size_t i = 0; i < count; ++i) {
+    uint32_t idx = have ? NodeIndex(ids[i]) : kInvalidIndex;
+    if (idx == kInvalidIndex) {
+      (*offsets)[i + 1] = (*offsets)[i];
+      continue;
+    }
+    const auto& vf = node_var_[fid];
+    uint64_t b = vf.offsets[idx], e = vf.offsets[idx + 1];
+    values->insert(values->end(), vf.values_u64.begin() + b,
+                   vf.values_u64.begin() + e);
+    (*offsets)[i + 1] = (*offsets)[i] + (e - b);
+  }
+}
+
+void Graph::GetBinaryFeature(const NodeId* ids, size_t count, int fid,
+                             std::vector<uint64_t>* offsets,
+                             std::vector<char>* values) const {
+  offsets->resize(count + 1);
+  (*offsets)[0] = 0;
+  bool have = fid >= 0 && static_cast<size_t>(fid) < node_var_.size() &&
+              !node_var_[fid].offsets.empty();
+  for (size_t i = 0; i < count; ++i) {
+    uint32_t idx = have ? NodeIndex(ids[i]) : kInvalidIndex;
+    if (idx == kInvalidIndex) {
+      (*offsets)[i + 1] = (*offsets)[i];
+      continue;
+    }
+    const auto& vf = node_var_[fid];
+    uint64_t b = vf.offsets[idx], e = vf.offsets[idx + 1];
+    values->insert(values->end(), vf.values_bytes.begin() + b,
+                   vf.values_bytes.begin() + e);
+    (*offsets)[i + 1] = (*offsets)[i] + (e - b);
+  }
+}
+
+uint64_t Graph::EdgeSlot(NodeId src, NodeId dst, int32_t type) const {
+  uint32_t idx = NodeIndex(src);
+  if (idx == kInvalidIndex) return kNoSlot;
+  auto it = edge_slot_.find(std::make_tuple(idx, dst, type));
+  return it == edge_slot_.end() ? kNoSlot : it->second;
+}
+
+float Graph::GetEdgeWeight(NodeId src, NodeId dst, int32_t type) const {
+  uint64_t slot = EdgeSlot(src, dst, type);
+  return slot == kNoSlot ? 0.f : adj_w_[slot];
+}
+
+void Graph::GetEdgeDenseFeature(const NodeId* src, const NodeId* dst,
+                                const int32_t* type, size_t count, int fid,
+                                int64_t dim, float* out) const {
+  bool have = fid >= 0 && static_cast<size_t>(fid) < edge_dense_.size() &&
+              !edge_dense_[fid].empty();
+  int64_t stored_dim =
+      have ? std::max<int64_t>(meta_.edge_features[fid].dim, 1) : 0;
+  for (size_t i = 0; i < count; ++i) {
+    float* dstp = out + i * dim;
+    uint64_t slot = have ? EdgeSlot(src[i], dst[i], type[i]) : kNoSlot;
+    if (slot == kNoSlot) {
+      std::memset(dstp, 0, dim * sizeof(float));
+      continue;
+    }
+    int64_t n = std::min(dim, stored_dim);
+    std::memcpy(dstp, edge_dense_[fid].data() + slot * stored_dim,
+                n * sizeof(float));
+    if (n < dim) std::memset(dstp + n, 0, (dim - n) * sizeof(float));
+  }
+}
+
+void Graph::GetEdgeSparseFeature(const NodeId* src, const NodeId* dst,
+                                 const int32_t* type, size_t count, int fid,
+                                 std::vector<uint64_t>* offsets,
+                                 std::vector<uint64_t>* values) const {
+  offsets->resize(count + 1);
+  (*offsets)[0] = 0;
+  bool have = fid >= 0 && static_cast<size_t>(fid) < edge_var_.size() &&
+              !edge_var_[fid].offsets.empty();
+  for (size_t i = 0; i < count; ++i) {
+    uint64_t slot = have ? EdgeSlot(src[i], dst[i], type[i]) : kNoSlot;
+    if (slot == kNoSlot) {
+      (*offsets)[i + 1] = (*offsets)[i];
+      continue;
+    }
+    const auto& vf = edge_var_[fid];
+    uint64_t b = vf.offsets[slot], e = vf.offsets[slot + 1];
+    values->insert(values->end(), vf.values_u64.begin() + b,
+                   vf.values_u64.begin() + e);
+    (*offsets)[i + 1] = (*offsets)[i] + (e - b);
+  }
+}
+
+void Graph::GetEdgeBinaryFeature(const NodeId* src, const NodeId* dst,
+                                 const int32_t* type, size_t count, int fid,
+                                 std::vector<uint64_t>* offsets,
+                                 std::vector<char>* values) const {
+  offsets->resize(count + 1);
+  (*offsets)[0] = 0;
+  bool have = fid >= 0 && static_cast<size_t>(fid) < edge_var_.size() &&
+              !edge_var_[fid].offsets.empty();
+  for (size_t i = 0; i < count; ++i) {
+    uint64_t slot = have ? EdgeSlot(src[i], dst[i], type[i]) : kNoSlot;
+    if (slot == kNoSlot) {
+      (*offsets)[i + 1] = (*offsets)[i];
+      continue;
+    }
+    const auto& vf = edge_var_[fid];
+    uint64_t b = vf.offsets[slot], e = vf.offsets[slot + 1];
+    values->insert(values->end(), vf.values_bytes.begin() + b,
+                   vf.values_bytes.begin() + e);
+    (*offsets)[i + 1] = (*offsets)[i] + (e - b);
+  }
+}
+
+}  // namespace et

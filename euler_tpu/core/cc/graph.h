@@ -1,0 +1,304 @@
+// Immutable columnar property-graph store.
+//
+// Capability parity with the reference's euler/core/graph/ (Graph, Node,
+// Edge, GraphBuilder, GraphMeta — SURVEY.md §2.1), redesigned for a TPU
+// host feeder: instead of per-node heap objects in hash maps
+// (reference graph.h:189-192, node.h:35-43), the store is struct-of-arrays —
+// one global CSR adjacency partitioned into (node, edge_type) groups with a
+// shared cumulative-weight array, flat zero-filled dense-feature matrices,
+// and CSR sparse/binary features. Batch sampling walks contiguous arrays and
+// emits fixed-shape, default-padded outputs that map 1:1 onto static-shape
+// jax.Arrays (no ragged post-processing on the device path).
+//
+// Thread-safety: Graph is immutable after Finalize(); all Sample*/Get*
+// methods are const and take an explicit RNG → safe for concurrent readers.
+#ifndef EULER_TPU_GRAPH_H_
+#define EULER_TPU_GRAPH_H_
+
+#include <cstdint>
+#include <limits>
+#include <memory>
+#include <string>
+#include <tuple>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "sampling.h"
+
+namespace et {
+
+using NodeId = uint64_t;
+constexpr uint32_t kInvalidIndex = std::numeric_limits<uint32_t>::max();
+
+enum class FeatureKind : int { kDense = 0, kSparse = 1, kBinary = 2 };
+
+struct FeatureInfo {
+  std::string name;
+  FeatureKind kind = FeatureKind::kDense;
+  int64_t dim = 0;  // dense: vector length; sparse/binary: max/advisory
+};
+
+struct GraphMeta {
+  std::string name = "euler_tpu_graph";
+  int num_node_types = 1;
+  int num_edge_types = 1;
+  int partition_num = 1;
+  uint64_t node_count = 0;  // global (all partitions)
+  uint64_t edge_count = 0;
+  std::vector<FeatureInfo> node_features;  // indexed by feature id
+  std::vector<FeatureInfo> edge_features;
+  std::vector<std::string> node_type_names;
+  std::vector<std::string> edge_type_names;
+};
+
+// CSR store for one variable-length feature over all rows.
+struct VarFeature {
+  std::vector<uint64_t> offsets;  // size rows+1
+  std::vector<uint64_t> values_u64;  // sparse kind
+  std::vector<char> values_bytes;    // binary kind
+};
+
+class GraphBuilder;
+
+class Graph {
+ public:
+  // ---- topology ----
+  uint32_t NodeIndex(NodeId id) const {
+    auto it = id2idx_.find(id);
+    return it == id2idx_.end() ? kInvalidIndex : it->second;
+  }
+  size_t node_count() const { return node_ids_.size(); }
+  size_t edge_count() const { return adj_nbr_.size(); }
+  int num_node_types() const { return meta_.num_node_types; }
+  int num_edge_types() const { return meta_.num_edge_types; }
+  const GraphMeta& meta() const { return meta_; }
+  GraphMeta* mutable_meta() { return &meta_; }
+  NodeId node_id(uint32_t idx) const { return node_ids_[idx]; }
+  int32_t node_type(uint32_t idx) const { return node_types_[idx]; }
+  float node_weight(uint32_t idx) const { return node_weights_[idx]; }
+
+  // Sum of node/edge weights, per type — powers weight-proportional
+  // cross-shard sampling (reference query_proxy.cc:77-105).
+  const std::vector<float>& node_type_weight_sums() const {
+    return node_type_wsum_;
+  }
+  const std::vector<float>& edge_type_weight_sums() const {
+    return edge_type_wsum_;
+  }
+
+  // ---- global sampling ----
+  // type < 0 samples across all types ∝ weight. Appends `count` node ids.
+  void SampleNode(int type, size_t count, Pcg32* rng,
+                  NodeId* out_ids) const;
+  // Per-row type array variant (reference sampleNWithTypes).
+  void SampleNodeWithTypes(const int32_t* types, size_t count, Pcg32* rng,
+                           NodeId* out_ids) const;
+  // Samples edges ∝ weight; writes parallel (src, dst, type) triples.
+  void SampleEdge(int type, size_t count, Pcg32* rng, NodeId* out_src,
+                  NodeId* out_dst, int32_t* out_type) const;
+
+  // ---- neighbor access ----
+  // Group range for (node idx, edge type) in the adjacency arrays.
+  inline void GroupRange(uint32_t idx, int et, size_t* begin,
+                         size_t* end) const {
+    size_t g = static_cast<size_t>(idx) * meta_.num_edge_types + et;
+    *begin = adj_offsets_[g];
+    *end = adj_offsets_[g + 1];
+  }
+
+  // Sample `count` neighbors of node `id` restricted to `edge_types`
+  // (nullptr → all), ∝ edge weight across the selected groups. Missing node
+  // or empty neighborhood pads with `default_id` / weight 0 / type -1.
+  void SampleNeighbor(NodeId id, const int32_t* edge_types, size_t n_types,
+                      size_t count, NodeId default_id, Pcg32* rng,
+                      NodeId* out_ids, float* out_w, int32_t* out_t) const;
+
+  // Appends all neighbors (ids, weights, types) for the selected edge types.
+  void GetFullNeighbor(NodeId id, const int32_t* edge_types, size_t n_types,
+                       std::vector<NodeId>* ids, std::vector<float>* ws,
+                       std::vector<int32_t>* ts, bool sorted_by_id = false) const;
+
+  // Top-k by weight (descending). Pads to k with default_id.
+  void GetTopKNeighbor(NodeId id, const int32_t* edge_types, size_t n_types,
+                       size_t k, NodeId default_id, NodeId* out_ids,
+                       float* out_w, int32_t* out_t) const;
+
+  // In-edge variants operate on the reverse adjacency (built at Finalize).
+  void GetFullInNeighbor(NodeId id, const int32_t* edge_types, size_t n_types,
+                         std::vector<NodeId>* ids, std::vector<float>* ws,
+                         std::vector<int32_t>* ts) const;
+  void SampleInNeighbor(NodeId id, const int32_t* edge_types, size_t n_types,
+                        size_t count, NodeId default_id, Pcg32* rng,
+                        NodeId* out_ids, float* out_w, int32_t* out_t) const;
+
+  size_t OutDegree(NodeId id, const int32_t* edge_types, size_t n_types) const;
+
+  // ---- features ----
+  // Dense: writes count*dim floats, zero-filled for missing nodes/features.
+  void GetDenseFeature(const NodeId* ids, size_t count, int fid,
+                       int64_t dim, float* out) const;
+  // Sparse/binary return CSR appended into the out vectors.
+  void GetSparseFeature(const NodeId* ids, size_t count, int fid,
+                        std::vector<uint64_t>* offsets,
+                        std::vector<uint64_t>* values) const;
+  void GetBinaryFeature(const NodeId* ids, size_t count, int fid,
+                        std::vector<uint64_t>* offsets,
+                        std::vector<char>* values) const;
+
+  // Edge features are keyed by (src, dst, type).
+  uint64_t EdgeSlot(NodeId src, NodeId dst, int32_t type) const;  // kNoSlot if absent
+  static constexpr uint64_t kNoSlot = std::numeric_limits<uint64_t>::max();
+  void GetEdgeDenseFeature(const NodeId* src, const NodeId* dst,
+                           const int32_t* type, size_t count, int fid,
+                           int64_t dim, float* out) const;
+  void GetEdgeSparseFeature(const NodeId* src, const NodeId* dst,
+                            const int32_t* type, size_t count, int fid,
+                            std::vector<uint64_t>* offsets,
+                            std::vector<uint64_t>* values) const;
+  void GetEdgeBinaryFeature(const NodeId* src, const NodeId* dst,
+                            const int32_t* type, size_t count, int fid,
+                            std::vector<uint64_t>* offsets,
+                            std::vector<char>* values) const;
+  float GetEdgeWeight(NodeId src, NodeId dst, int32_t type) const;
+
+  // ---- serialization ----
+  Status Dump(const std::string& path) const;  // single-partition binary dump
+
+ private:
+  friend class GraphBuilder;
+  Graph() = default;
+
+  // Weighted choice among the (begin,end) cumw groups selected by edge_types;
+  // returns adjacency slot or kNoSlot when all groups are empty/zero.
+  uint64_t SampleAdjSlot(uint32_t idx, const int32_t* edge_types,
+                         size_t n_types, Pcg32* rng) const;
+
+  GraphMeta meta_;
+  // nodes
+  std::vector<NodeId> node_ids_;
+  std::vector<int32_t> node_types_;
+  std::vector<float> node_weights_;
+  std::unordered_map<NodeId, uint32_t> id2idx_;
+  // out-adjacency: group g = idx*num_edge_types + et
+  std::vector<uint64_t> adj_offsets_;  // size N*ET + 1
+  std::vector<NodeId> adj_nbr_;
+  std::vector<float> adj_w_;
+  std::vector<float> adj_cumw_;  // per-group inclusive prefix sums
+  // in-adjacency (same layout; slot order independent of out slots)
+  std::vector<uint64_t> in_adj_offsets_;
+  std::vector<NodeId> in_adj_nbr_;
+  std::vector<float> in_adj_w_;
+  std::vector<float> in_adj_cumw_;
+  // edge lookup: (src<<?) — use map keyed by (src_idx, dst_id, type)
+  struct EdgeKeyHash {
+    size_t operator()(const std::tuple<uint32_t, NodeId, int32_t>& k) const {
+      uint64_t h = std::get<0>(k) * 0x9e3779b97f4a7c15ULL;
+      h ^= std::get<1>(k) + 0x9e3779b97f4a7c15ULL + (h << 6) + (h >> 2);
+      h ^= static_cast<uint64_t>(std::get<2>(k)) + (h << 6) + (h >> 2);
+      return static_cast<size_t>(h);
+    }
+  };
+  std::unordered_map<std::tuple<uint32_t, NodeId, int32_t>, uint64_t,
+                     EdgeKeyHash>
+      edge_slot_;
+  // global samplers
+  std::vector<std::vector<uint32_t>> nodes_by_type_;  // type → node indices
+  std::vector<AliasSampler> node_sampler_by_type_;
+  AliasSampler node_sampler_all_;  // over node indices 0..N-1
+  std::vector<std::vector<uint64_t>> edges_by_type_;  // type → adj slots
+  std::vector<AliasSampler> edge_sampler_by_type_;
+  AliasSampler edge_sampler_all_;  // over adjacency slots 0..E-1
+  std::vector<float> node_type_wsum_;
+  std::vector<float> edge_type_wsum_;
+  // features: [fid] → flat matrix (dense) or CSR (sparse/binary)
+  std::vector<std::vector<float>> node_dense_;   // size N*dim, zero-filled
+  std::vector<VarFeature> node_var_;
+  std::vector<std::vector<float>> edge_dense_;   // size E*dim (adj slot order)
+  std::vector<VarFeature> edge_var_;
+
+  void FindAdjSlots(NodeId src, NodeId dst, int32_t type, uint64_t* slot) const;
+};
+
+// Accumulates rows, then Finalize() produces the immutable SoA Graph.
+// Parity: reference graph_builder.h:47 (multi-threaded partition loading is
+// in loader.cc; the builder itself is single-threaded row accumulation).
+class GraphBuilder {
+ public:
+  GraphBuilder() { meta_.node_type_names = {"0"}; meta_.edge_type_names = {"0"}; }
+
+  GraphMeta* mutable_meta() { return &meta_; }
+
+  void AddNode(NodeId id, int32_t type, float weight);
+  // src is auto-created (type 0, weight 1) if missing; dst is NOT — in a
+  // sharded graph the destination may live on another shard, and creating a
+  // ghost local node would pollute the global samplers. Negative edge types
+  // are rejected with a warning.
+  void AddEdge(NodeId src, NodeId dst, int32_t type, float weight);
+
+  void SetNodeDense(NodeId id, int fid, const float* v, int64_t dim);
+  void SetNodeSparse(NodeId id, int fid, const uint64_t* v, int64_t len);
+  void SetNodeBinary(NodeId id, int fid, const char* v, int64_t len);
+  void SetEdgeDense(NodeId src, NodeId dst, int32_t type, int fid,
+                    const float* v, int64_t dim);
+  void SetEdgeSparse(NodeId src, NodeId dst, int32_t type, int fid,
+                     const uint64_t* v, int64_t len);
+  void SetEdgeBinary(NodeId src, NodeId dst, int32_t type, int fid,
+                     const char* v, int64_t len);
+
+  // Bulk columnar entry points (zero-copy friendly; used by the ctypes
+  // bridge for dataset ingestion without per-row Python calls).
+  void AddNodes(const NodeId* ids, const int32_t* types, const float* weights,
+                size_t n);
+  void AddEdges(const NodeId* src, const NodeId* dst, const int32_t* types,
+                const float* weights, size_t n);
+  // Column of dense features for n nodes (values is n*dim row-major).
+  void SetNodeDenseBulk(const NodeId* ids, size_t n, int fid, int64_t dim,
+                        const float* values);
+  void SetEdgeDenseBulk(const NodeId* src, const NodeId* dst,
+                        const int32_t* types, size_t n, int fid, int64_t dim,
+                        const float* values);
+  void SetNodeSparseBulk(const NodeId* ids, size_t n, int fid,
+                         const uint64_t* offsets, const uint64_t* values);
+
+  std::unique_ptr<Graph> Finalize(bool build_in_adjacency = true);
+
+ private:
+  struct NodeRow {
+    NodeId id;
+    int32_t type;
+    float weight;
+  };
+  struct EdgeRow {
+    NodeId src, dst;
+    int32_t type;
+    float weight;
+  };
+  struct FeatCell {
+    uint64_t row;  // node row idx or edge row idx
+    std::vector<float> f32;
+    std::vector<uint64_t> u64;
+    std::vector<char> bytes;
+  };
+
+  uint32_t EnsureNode(NodeId id, int32_t type, float weight, bool overwrite);
+  int64_t FindEdgeRow(NodeId src, NodeId dst, int32_t type) const;
+
+  GraphMeta meta_;
+  std::vector<NodeRow> nodes_;
+  std::unordered_map<NodeId, uint32_t> node_row_;
+  std::vector<EdgeRow> edges_;
+  std::unordered_map<std::tuple<uint32_t, NodeId, int32_t>, uint64_t,
+                     Graph::EdgeKeyHash>
+      edge_row_;
+  // feature cells per fid, sorted at finalize
+  std::vector<std::vector<FeatCell>> node_feat_cells_;
+  std::vector<std::vector<FeatCell>> edge_feat_cells_;
+
+  std::vector<FeatCell>* NodeCells(int fid);
+  std::vector<FeatCell>* EdgeCells(int fid);
+};
+
+}  // namespace et
+
+#endif  // EULER_TPU_GRAPH_H_

@@ -1,0 +1,402 @@
+#include "io.h"
+
+#include <cstdio>
+
+namespace et {
+
+namespace {
+constexpr char kMetaMagic[4] = {'E', 'T', 'M', '1'};
+constexpr char kPartMagic[4] = {'E', 'T', 'P', '1'};
+constexpr uint32_t kVersion = 1;
+}  // namespace
+
+Status ReadFileToString(const std::string& path, std::string* out) {
+  std::FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return Status::IOError("cannot open " + path);
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  out->resize(static_cast<size_t>(size));
+  size_t got = size ? std::fread(&(*out)[0], 1, size, f) : 0;
+  std::fclose(f);
+  if (got != static_cast<size_t>(size)) {
+    return Status::IOError("short read on " + path);
+  }
+  return Status::OK();
+}
+
+Status WriteStringToFile(const std::string& path, const char* data,
+                         size_t size) {
+  std::FILE* f = std::fopen(path.c_str(), "wb");
+  if (!f) return Status::IOError("cannot open " + path + " for write");
+  size_t put = size ? std::fwrite(data, 1, size, f) : 0;
+  std::fclose(f);
+  if (put != size) return Status::IOError("short write on " + path);
+  return Status::OK();
+}
+
+Status SaveMeta(const GraphMeta& meta, const std::string& path) {
+  ByteWriter w;
+  w.PutRaw(kMetaMagic, 4);
+  w.Put<uint32_t>(kVersion);
+  w.Put<uint32_t>(meta.num_node_types);
+  w.Put<uint32_t>(meta.num_edge_types);
+  w.Put<uint32_t>(meta.partition_num);
+  w.Put<uint64_t>(meta.node_count);
+  w.Put<uint64_t>(meta.edge_count);
+  w.PutStr(meta.name);
+  w.Put<uint32_t>(static_cast<uint32_t>(meta.node_type_names.size()));
+  for (const auto& s : meta.node_type_names) w.PutStr(s);
+  w.Put<uint32_t>(static_cast<uint32_t>(meta.edge_type_names.size()));
+  for (const auto& s : meta.edge_type_names) w.PutStr(s);
+  auto put_feats = [&](const std::vector<FeatureInfo>& fs) {
+    w.Put<uint32_t>(static_cast<uint32_t>(fs.size()));
+    for (const auto& f : fs) {
+      w.PutStr(f.name);
+      w.Put<int32_t>(static_cast<int32_t>(f.kind));
+      w.Put<int64_t>(f.dim);
+    }
+  };
+  put_feats(meta.node_features);
+  put_feats(meta.edge_features);
+  return WriteStringToFile(path, w.buffer().data(), w.buffer().size());
+}
+
+Status LoadMeta(const std::string& path, GraphMeta* meta) {
+  std::string blob;
+  ET_RETURN_IF_ERROR(ReadFileToString(path, &blob));
+  ByteReader r(blob.data(), blob.size());
+  char magic[4];
+  uint32_t ver, nt, et, pn;
+  if (!r.GetRaw(magic, 4) || std::memcmp(magic, kMetaMagic, 4) != 0) {
+    return Status::IOError("bad meta magic in " + path);
+  }
+  if (!r.Get(&ver) || ver != kVersion) {
+    return Status::IOError("unsupported meta version");
+  }
+  if (!r.Get(&nt) || !r.Get(&et) || !r.Get(&pn)) {
+    return Status::IOError("truncated meta");
+  }
+  meta->num_node_types = nt;
+  meta->num_edge_types = et;
+  meta->partition_num = pn;
+  uint64_t nc, ec;
+  if (!r.Get(&nc) || !r.Get(&ec)) return Status::IOError("truncated meta");
+  meta->node_count = nc;
+  meta->edge_count = ec;
+  if (!r.GetStr(&meta->name)) return Status::IOError("truncated meta");
+  auto get_strs = [&](std::vector<std::string>* out) {
+    uint32_t n;
+    if (!r.Get(&n)) return false;
+    out->resize(n);
+    for (uint32_t i = 0; i < n; ++i) {
+      if (!r.GetStr(&(*out)[i])) return false;
+    }
+    return true;
+  };
+  if (!get_strs(&meta->node_type_names) || !get_strs(&meta->edge_type_names)) {
+    return Status::IOError("truncated meta");
+  }
+  auto get_feats = [&](std::vector<FeatureInfo>* out) {
+    uint32_t n;
+    if (!r.Get(&n)) return false;
+    out->resize(n);
+    for (uint32_t i = 0; i < n; ++i) {
+      int32_t kind;
+      if (!r.GetStr(&(*out)[i].name) || !r.Get(&kind) ||
+          !r.Get(&(*out)[i].dim)) {
+        return false;
+      }
+      (*out)[i].kind = static_cast<FeatureKind>(kind);
+    }
+    return true;
+  };
+  if (!get_feats(&meta->node_features) || !get_feats(&meta->edge_features)) {
+    return Status::IOError("truncated meta");
+  }
+  return Status::OK();
+}
+
+namespace {
+
+struct FeatBlock {
+  std::vector<std::pair<uint16_t, std::vector<float>>> dense;
+  std::vector<std::pair<uint16_t, std::vector<uint64_t>>> sparse;
+  std::vector<std::pair<uint16_t, std::vector<char>>> binary;
+};
+
+bool ReadFeats(ByteReader* r, FeatBlock* fb) {
+  uint16_t nd, ns, nb;
+  if (!r->Get(&nd)) return false;
+  fb->dense.resize(nd);
+  for (uint16_t i = 0; i < nd; ++i) {
+    uint32_t dim;
+    if (!r->Get(&fb->dense[i].first) || !r->Get(&dim)) return false;
+    fb->dense[i].second.resize(dim);
+    if (!r->GetRaw(fb->dense[i].second.data(), dim * sizeof(float))) {
+      return false;
+    }
+  }
+  if (!r->Get(&ns)) return false;
+  fb->sparse.resize(ns);
+  for (uint16_t i = 0; i < ns; ++i) {
+    uint32_t len;
+    if (!r->Get(&fb->sparse[i].first) || !r->Get(&len)) return false;
+    fb->sparse[i].second.resize(len);
+    if (!r->GetRaw(fb->sparse[i].second.data(), len * sizeof(uint64_t))) {
+      return false;
+    }
+  }
+  if (!r->Get(&nb)) return false;
+  fb->binary.resize(nb);
+  for (uint16_t i = 0; i < nb; ++i) {
+    uint32_t len;
+    if (!r->Get(&fb->binary[i].first) || !r->Get(&len)) return false;
+    fb->binary[i].second.resize(len);
+    if (!r->GetRaw(fb->binary[i].second.data(), len)) return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+Status LoadPartitionFile(const std::string& path, int data_type,
+                         GraphBuilder* builder) {
+  std::string blob;
+  ET_RETURN_IF_ERROR(ReadFileToString(path, &blob));
+  ByteReader r(blob.data(), blob.size());
+  char magic[4];
+  uint32_t ver;
+  if (!r.GetRaw(magic, 4) || std::memcmp(magic, kPartMagic, 4) != 0) {
+    return Status::IOError("bad partition magic in " + path);
+  }
+  if (!r.Get(&ver) || ver != kVersion) {
+    return Status::IOError("unsupported partition version");
+  }
+  uint64_t n_nodes;
+  if (!r.Get(&n_nodes)) return Status::IOError("truncated partition");
+  bool want_nodes = data_type == 0 || data_type == 1;
+  bool want_edges = data_type == 0 || data_type == 2;
+  for (uint64_t i = 0; i < n_nodes; ++i) {
+    uint64_t id;
+    int32_t type;
+    float w;
+    FeatBlock fb;
+    if (!r.Get(&id) || !r.Get(&type) || !r.Get(&w) || !ReadFeats(&r, &fb)) {
+      return Status::IOError("truncated node record in " + path);
+    }
+    if (!want_nodes) continue;
+    builder->AddNode(id, type, w);
+    for (auto& d : fb.dense) {
+      builder->SetNodeDense(id, d.first, d.second.data(),
+                            static_cast<int64_t>(d.second.size()));
+    }
+    for (auto& s : fb.sparse) {
+      builder->SetNodeSparse(id, s.first, s.second.data(),
+                             static_cast<int64_t>(s.second.size()));
+    }
+    for (auto& b : fb.binary) {
+      builder->SetNodeBinary(id, b.first, b.second.data(),
+                             static_cast<int64_t>(b.second.size()));
+    }
+  }
+  uint64_t n_edges;
+  if (!r.Get(&n_edges)) return Status::IOError("truncated partition");
+  for (uint64_t i = 0; i < n_edges; ++i) {
+    uint64_t src, dst;
+    int32_t type;
+    float w;
+    FeatBlock fb;
+    if (!r.Get(&src) || !r.Get(&dst) || !r.Get(&type) || !r.Get(&w) ||
+        !ReadFeats(&r, &fb)) {
+      return Status::IOError("truncated edge record in " + path);
+    }
+    if (!want_edges) continue;
+    builder->AddEdge(src, dst, type, w);
+    for (auto& d : fb.dense) {
+      builder->SetEdgeDense(src, dst, type, d.first, d.second.data(),
+                            static_cast<int64_t>(d.second.size()));
+    }
+    for (auto& s : fb.sparse) {
+      builder->SetEdgeSparse(src, dst, type, s.first, s.second.data(),
+                             static_cast<int64_t>(s.second.size()));
+    }
+    for (auto& b : fb.binary) {
+      builder->SetEdgeBinary(src, dst, type, b.first, b.second.data(),
+                             static_cast<int64_t>(b.second.size()));
+    }
+  }
+  return Status::OK();
+}
+
+Status LoadShard(const std::string& dir, int shard_idx, int shard_num,
+                 int data_type, bool build_in_adjacency,
+                 std::unique_ptr<Graph>* out) {
+  if (shard_num <= 0) shard_num = 1;
+  GraphMeta meta;
+  ET_RETURN_IF_ERROR(LoadMeta(dir + "/meta.bin", &meta));
+  GraphBuilder builder;
+  *builder.mutable_meta() = meta;
+  int loaded = 0;
+  for (int p = 0; p < meta.partition_num; ++p) {
+    if (p % shard_num != shard_idx) continue;
+    std::string path = dir + "/part_" + std::to_string(p) + ".dat";
+    ET_RETURN_IF_ERROR(LoadPartitionFile(path, data_type, &builder));
+    ++loaded;
+  }
+  ET_LOG(INFO) << "loaded shard " << shard_idx << "/" << shard_num << " ("
+               << loaded << " partitions) from " << dir;
+  *out = builder.Finalize(build_in_adjacency);
+  return Status::OK();
+}
+
+Status DumpGraph(const Graph& g, const std::string& dir) {
+  GraphMeta meta = g.meta();
+  meta.partition_num = 1;
+  ET_RETURN_IF_ERROR(SaveMeta(meta, dir + "/meta.bin"));
+
+  ByteWriter w;
+  w.PutRaw(kPartMagic, 4);
+  w.Put<uint32_t>(kVersion);
+  const size_t N = g.node_count();
+  w.Put<uint64_t>(N);
+  std::vector<float> dense_buf;
+  std::vector<uint64_t> sp_off, sp_val;
+  std::vector<char> bin_val;
+  for (size_t i = 0; i < N; ++i) {
+    NodeId id = g.node_id(static_cast<uint32_t>(i));
+    w.Put<uint64_t>(id);
+    w.Put<int32_t>(g.node_type(static_cast<uint32_t>(i)));
+    w.Put<float>(g.node_weight(static_cast<uint32_t>(i)));
+    // Collect this node's features by querying the public accessors.
+    std::vector<std::pair<uint16_t, std::vector<float>>> dense;
+    std::vector<std::pair<uint16_t, std::vector<uint64_t>>> sparse;
+    std::vector<std::pair<uint16_t, std::vector<char>>> binary;
+    for (size_t fid = 0; fid < meta.node_features.size(); ++fid) {
+      const auto& info = meta.node_features[fid];
+      if (info.kind == FeatureKind::kDense && info.dim > 0) {
+        dense_buf.assign(info.dim, 0.f);
+        g.GetDenseFeature(&id, 1, static_cast<int>(fid), info.dim,
+                          dense_buf.data());
+        dense.push_back({static_cast<uint16_t>(fid), dense_buf});
+      } else if (info.kind == FeatureKind::kSparse) {
+        sp_off.clear();
+        sp_val.clear();
+        g.GetSparseFeature(&id, 1, static_cast<int>(fid), &sp_off, &sp_val);
+        if (!sp_val.empty()) {
+          sparse.push_back({static_cast<uint16_t>(fid), sp_val});
+        }
+      } else if (info.kind == FeatureKind::kBinary) {
+        sp_off.clear();
+        bin_val.clear();
+        g.GetBinaryFeature(&id, 1, static_cast<int>(fid), &sp_off, &bin_val);
+        if (!bin_val.empty()) {
+          binary.push_back({static_cast<uint16_t>(fid), bin_val});
+        }
+      }
+    }
+    w.Put<uint16_t>(static_cast<uint16_t>(dense.size()));
+    for (auto& d : dense) {
+      w.Put<uint16_t>(d.first);
+      w.Put<uint32_t>(static_cast<uint32_t>(d.second.size()));
+      w.PutRaw(d.second.data(), d.second.size() * sizeof(float));
+    }
+    w.Put<uint16_t>(static_cast<uint16_t>(sparse.size()));
+    for (auto& s : sparse) {
+      w.Put<uint16_t>(s.first);
+      w.Put<uint32_t>(static_cast<uint32_t>(s.second.size()));
+      w.PutRaw(s.second.data(), s.second.size() * sizeof(uint64_t));
+    }
+    w.Put<uint16_t>(static_cast<uint16_t>(binary.size()));
+    for (auto& b : binary) {
+      w.Put<uint16_t>(b.first);
+      w.Put<uint32_t>(static_cast<uint32_t>(b.second.size()));
+      w.PutRaw(b.second.data(), b.second.size());
+    }
+  }
+
+  // Edges: walk every node's full out-neighborhood.
+  std::vector<NodeId> nbr;
+  std::vector<float> ws;
+  std::vector<int32_t> ts;
+  uint64_t edge_total = 0;
+  for (size_t i = 0; i < N; ++i) {
+    nbr.clear();
+    ws.clear();
+    ts.clear();
+    g.GetFullNeighbor(g.node_id(static_cast<uint32_t>(i)), nullptr, 0, &nbr,
+                      &ws, &ts);
+    edge_total += nbr.size();
+  }
+  w.Put<uint64_t>(edge_total);
+  for (size_t i = 0; i < N; ++i) {
+    NodeId src = g.node_id(static_cast<uint32_t>(i));
+    nbr.clear();
+    ws.clear();
+    ts.clear();
+    g.GetFullNeighbor(src, nullptr, 0, &nbr, &ws, &ts);
+    for (size_t e = 0; e < nbr.size(); ++e) {
+      w.Put<uint64_t>(src);
+      w.Put<uint64_t>(nbr[e]);
+      w.Put<int32_t>(ts[e]);
+      w.Put<float>(ws[e]);
+      std::vector<std::pair<uint16_t, std::vector<float>>> dense;
+      std::vector<std::pair<uint16_t, std::vector<uint64_t>>> sparse;
+      std::vector<std::pair<uint16_t, std::vector<char>>> binary;
+      for (size_t fid = 0; fid < meta.edge_features.size(); ++fid) {
+        const auto& info = meta.edge_features[fid];
+        if (info.kind == FeatureKind::kDense && info.dim > 0) {
+          dense_buf.assign(info.dim, 0.f);
+          g.GetEdgeDenseFeature(&src, &nbr[e], &ts[e], 1,
+                                static_cast<int>(fid), info.dim,
+                                dense_buf.data());
+          bool nonzero = false;
+          for (float v : dense_buf) nonzero |= (v != 0.f);
+          if (nonzero) dense.push_back({static_cast<uint16_t>(fid), dense_buf});
+        } else if (info.kind == FeatureKind::kSparse) {
+          sp_off.clear();
+          sp_val.clear();
+          g.GetEdgeSparseFeature(&src, &nbr[e], &ts[e], 1,
+                                 static_cast<int>(fid), &sp_off, &sp_val);
+          if (!sp_val.empty()) {
+            sparse.push_back({static_cast<uint16_t>(fid), sp_val});
+          }
+        } else if (info.kind == FeatureKind::kBinary) {
+          sp_off.clear();
+          bin_val.clear();
+          g.GetEdgeBinaryFeature(&src, &nbr[e], &ts[e], 1,
+                                 static_cast<int>(fid), &sp_off, &bin_val);
+          if (!bin_val.empty()) {
+            binary.push_back({static_cast<uint16_t>(fid), bin_val});
+          }
+        }
+      }
+      w.Put<uint16_t>(static_cast<uint16_t>(dense.size()));
+      for (auto& d : dense) {
+        w.Put<uint16_t>(d.first);
+        w.Put<uint32_t>(static_cast<uint32_t>(d.second.size()));
+        w.PutRaw(d.second.data(), d.second.size() * sizeof(float));
+      }
+      w.Put<uint16_t>(static_cast<uint16_t>(sparse.size()));
+      for (auto& s : sparse) {
+        w.Put<uint16_t>(s.first);
+        w.Put<uint32_t>(static_cast<uint32_t>(s.second.size()));
+        w.PutRaw(s.second.data(), s.second.size() * sizeof(uint64_t));
+      }
+      w.Put<uint16_t>(static_cast<uint16_t>(binary.size()));
+      for (auto& b : binary) {
+        w.Put<uint16_t>(b.first);
+        w.Put<uint32_t>(static_cast<uint32_t>(b.second.size()));
+        w.PutRaw(b.second.data(), b.second.size());
+      }
+    }
+  }
+  return WriteStringToFile(dir + "/part_0.dat", w.buffer().data(),
+                           w.buffer().size());
+}
+
+Status Graph::Dump(const std::string& path) const {
+  return DumpGraph(*this, path);
+}
+
+}  // namespace et

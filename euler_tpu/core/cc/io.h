@@ -1,0 +1,112 @@
+// Binary graph serialization: partitioned data files + binary meta.
+//
+// Capability parity with the reference's euler/common/bytes_io.* +
+// euler/core/graph/graph_builder.cc partition loading + euler/tools data
+// prep (SURVEY.md §2.1/§2.3). Redesigned with a single self-describing
+// little-endian record format written by either the Python prep tool
+// (euler_tpu/tools/generate_data.py) or Graph::Dump, and loaded
+// shard-aware: shard k of n loads partition files p with p % n == k.
+//
+// Layout (all little-endian):
+//   meta.bin   : "ETM1" u32 ver | u32 NT | u32 ET | u32 P | u64 N | u64 E
+//                | str name | NT×str | ET×str
+//                | u32 nf  | nf×(str name, i32 kind, i64 dim)   [node feats]
+//                | u32 nef | nef×(...)                          [edge feats]
+//   part_p.dat : "ETP1" u32 ver | u64 n_nodes | node records
+//                | u64 n_edges | edge records
+//   node rec   : u64 id | i32 type | f32 w | feats
+//   edge rec   : u64 src | u64 dst | i32 type | f32 w | feats
+//   feats      : u16 nd | nd×(u16 fid, u32 dim, f32×dim)
+//                | u16 ns | ns×(u16 fid, u32 len, u64×len)
+//                | u16 nb | nb×(u16 fid, u32 len, bytes)
+#ifndef EULER_TPU_IO_H_
+#define EULER_TPU_IO_H_
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "graph.h"
+
+namespace et {
+
+class ByteWriter {
+ public:
+  void PutRaw(const void* p, size_t n) {
+    const char* c = static_cast<const char*>(p);
+    buf_.insert(buf_.end(), c, c + n);
+  }
+  template <typename T>
+  void Put(T v) {
+    PutRaw(&v, sizeof(T));
+  }
+  void PutStr(const std::string& s) {
+    Put<uint32_t>(static_cast<uint32_t>(s.size()));
+    PutRaw(s.data(), s.size());
+  }
+  const std::vector<char>& buffer() const { return buf_; }
+
+ private:
+  std::vector<char> buf_;
+};
+
+class ByteReader {
+ public:
+  ByteReader(const char* data, size_t size) : p_(data), end_(data + size) {}
+  bool GetRaw(void* out, size_t n) {
+    if (p_ + n > end_) return false;
+    std::memcpy(out, p_, n);
+    p_ += n;
+    return true;
+  }
+  template <typename T>
+  bool Get(T* out) {
+    return GetRaw(out, sizeof(T));
+  }
+  bool GetStr(std::string* out) {
+    uint32_t n;
+    if (!Get(&n) || p_ + n > end_) return false;
+    out->assign(p_, n);
+    p_ += n;
+    return true;
+  }
+  bool Skip(size_t n) {
+    if (p_ + n > end_) return false;
+    p_ += n;
+    return true;
+  }
+  const char* cursor() const { return p_; }
+  size_t remaining() const { return static_cast<size_t>(end_ - p_); }
+
+ private:
+  const char* p_;
+  const char* end_;
+};
+
+Status ReadFileToString(const std::string& path, std::string* out);
+Status WriteStringToFile(const std::string& path, const char* data,
+                         size_t size);
+
+Status SaveMeta(const GraphMeta& meta, const std::string& path);
+Status LoadMeta(const std::string& path, GraphMeta* meta);
+
+// Appends one partition's records into the builder. data_type: 0=all,
+// 1=node-only, 2=edge-only (mirrors reference GraphDataType,
+// graph_builder.h:42-47).
+Status LoadPartitionFile(const std::string& path, int data_type,
+                         GraphBuilder* builder);
+
+// Loads meta + the partitions belonging to (shard_idx, shard_num) from a
+// directory laid out by the data-prep tool: meta.bin + part_*.dat.
+Status LoadShard(const std::string& dir, int shard_idx, int shard_num,
+                 int data_type, bool build_in_adjacency,
+                 std::unique_ptr<Graph>* out);
+
+// Serializes the whole (local) graph as one partition + meta into dir.
+Status DumpGraph(const Graph& g, const std::string& dir);
+
+}  // namespace et
+
+#endif  // EULER_TPU_IO_H_

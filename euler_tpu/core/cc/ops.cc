@@ -1,0 +1,175 @@
+#include "ops.h"
+
+#include <algorithm>
+#include <unordered_map>
+
+namespace et {
+
+bool EdgeExistsAnyType(const Graph& g, NodeId src, NodeId dst,
+                       const int32_t* edge_types, size_t n_types);
+
+void SampleFanout(const Graph& g, const NodeId* roots, size_t n_roots,
+                  const int32_t* counts, size_t n_hops,
+                  const int32_t* edge_types, const int64_t* et_offsets,
+                  NodeId default_id, Pcg32* rng,
+                  const std::vector<NodeId*>& out_ids,
+                  const std::vector<float*>& out_w,
+                  const std::vector<int32_t*>& out_t) {
+  const NodeId* cur = roots;
+  size_t cur_n = n_roots;
+  for (size_t hop = 0; hop < n_hops; ++hop) {
+    const int32_t* et = nullptr;
+    size_t n_et = 0;
+    if (edge_types != nullptr && et_offsets != nullptr) {
+      et = edge_types + et_offsets[hop];
+      n_et = static_cast<size_t>(et_offsets[hop + 1] - et_offsets[hop]);
+    }
+    size_t k = static_cast<size_t>(counts[hop]);
+    NodeId* ids = out_ids[hop];
+    float* ws = out_w.empty() ? nullptr : out_w[hop];
+    int32_t* ts = out_t.empty() ? nullptr : out_t[hop];
+    for (size_t i = 0; i < cur_n; ++i) {
+      g.SampleNeighbor(cur[i], et, n_et, k, default_id, rng, ids + i * k,
+                       ws ? ws + i * k : nullptr, ts ? ts + i * k : nullptr);
+    }
+    cur = ids;
+    cur_n = cur_n * k;
+  }
+}
+
+void RandomWalk(const Graph& g, const NodeId* roots, size_t n_roots,
+                size_t walk_len, float p, float q, NodeId default_id,
+                const int32_t* edge_types, size_t n_types, Pcg32* rng,
+                NodeId* out) {
+  const bool biased = (p != 1.f || q != 1.f);
+  std::vector<NodeId> nbr;
+  std::vector<float> ws;
+  std::vector<int32_t> ts;
+  std::vector<float> biased_w;
+  const size_t W = walk_len + 1;
+  for (size_t i = 0; i < n_roots; ++i) {
+    NodeId* row = out + i * W;
+    row[0] = roots[i];
+    NodeId prev = default_id;
+    NodeId cur = roots[i];
+    for (size_t step = 1; step <= walk_len; ++step) {
+      if (cur == default_id) {
+        row[step] = default_id;
+        continue;
+      }
+      if (!biased || step == 1) {
+        NodeId nxt;
+        g.SampleNeighbor(cur, edge_types, n_types, 1, default_id, rng, &nxt,
+                         nullptr, nullptr);
+        prev = cur;
+        cur = nxt;
+      } else {
+        nbr.clear();
+        ws.clear();
+        ts.clear();
+        g.GetFullNeighbor(cur, edge_types, n_types, &nbr, &ws, &ts);
+        if (nbr.empty()) {
+          prev = cur;
+          cur = default_id;
+          row[step] = default_id;
+          continue;
+        }
+        // node2vec bias: 1/p back to prev, 1 to common neighbors of prev,
+        // 1/q to the rest. Edge existence checked against the store.
+        biased_w.resize(nbr.size());
+        bool prev_has_out = g.OutDegree(prev, edge_types, n_types) > 0;
+        for (size_t j = 0; j < nbr.size(); ++j) {
+          float bias;
+          if (nbr[j] == prev) {
+            bias = 1.f / p;
+          } else if (prev_has_out &&
+                     EdgeExistsAnyType(g, prev, nbr[j], edge_types, n_types)) {
+            bias = 1.f;
+          } else {
+            bias = 1.f / q;
+          }
+          biased_w[j] = ws[j] * bias;
+        }
+        float total = 0.f;
+        for (float v : biased_w) total += v;
+        NodeId nxt = default_id;
+        if (total > 0.f) {
+          float r = rng->NextFloat() * total;
+          float run = 0.f;
+          size_t sel = nbr.size() - 1;
+          for (size_t j = 0; j < nbr.size(); ++j) {
+            run += biased_w[j];
+            if (r < run) {
+              sel = j;
+              break;
+            }
+          }
+          nxt = nbr[sel];
+        }
+        prev = cur;
+        cur = nxt;
+      }
+      row[step] = cur;
+    }
+  }
+}
+
+bool EdgeExistsAnyType(const Graph& g, NodeId src, NodeId dst,
+                       const int32_t* edge_types, size_t n_types) {
+  if (edge_types == nullptr || n_types == 0) {
+    for (int et = 0; et < g.num_edge_types(); ++et) {
+      if (g.EdgeSlot(src, dst, et) != Graph::kNoSlot) return true;
+    }
+    return false;
+  }
+  for (size_t i = 0; i < n_types; ++i) {
+    if (g.EdgeSlot(src, dst, edge_types[i]) != Graph::kNoSlot) return true;
+  }
+  return false;
+}
+
+void SampleLayerwise(const Graph& g, const NodeId* roots, size_t n_roots,
+                     const int32_t* layer_sizes, size_t n_layers,
+                     const int32_t* edge_types, size_t n_types,
+                     NodeId default_id, Pcg32* rng,
+                     const std::vector<NodeId*>& out_layers) {
+  // Frontier = current set of nodes; each layer samples `m` nodes from the
+  // union of the frontier's neighborhoods, ∝ accumulated edge weight.
+  std::vector<NodeId> frontier(roots, roots + n_roots);
+  std::vector<NodeId> cand_ids;
+  std::vector<float> cand_w;
+  std::vector<NodeId> nbr;
+  std::vector<float> ws;
+  std::vector<int32_t> ts;
+  std::unordered_map<NodeId, float> acc;
+  for (size_t layer = 0; layer < n_layers; ++layer) {
+    size_t m = static_cast<size_t>(layer_sizes[layer]);
+    acc.clear();
+    for (NodeId u : frontier) {
+      if (u == default_id) continue;
+      nbr.clear();
+      ws.clear();
+      ts.clear();
+      g.GetFullNeighbor(u, edge_types, n_types, &nbr, &ws, &ts);
+      for (size_t j = 0; j < nbr.size(); ++j) acc[nbr[j]] += ws[j];
+    }
+    cand_ids.clear();
+    cand_w.clear();
+    for (const auto& kv : acc) {
+      cand_ids.push_back(kv.first);
+      cand_w.push_back(kv.second);
+    }
+    NodeId* out = out_layers[layer];
+    if (cand_ids.empty()) {
+      for (size_t j = 0; j < m; ++j) out[j] = default_id;
+      frontier.assign(m, default_id);
+      continue;
+    }
+    AliasSampler sampler;
+    sampler.Init(cand_w);
+    for (size_t j = 0; j < m; ++j) out[j] = cand_ids[sampler.Sample(rng)];
+    frontier.assign(out, out + m);
+  }
+}
+
+}  // namespace et

@@ -1,0 +1,54 @@
+// Composite batch sampling ops over the graph store.
+//
+// These are the engine-side equivalents of the reference's one-round-trip
+// multi-hop ops (tf_euler/kernels/sample_fanout_op.cc:36-48 chained
+// .sampleNB GQL, random_walk_op.cc:34-172 node2vec). Instead of compiling a
+// query DAG per batch, the rebuild exposes them as direct C++ batch loops
+// over the SoA store — the query layer (euler_tpu.gql) lowers to these same
+// entry points. All outputs are fixed-shape and default-padded so the
+// Python side can hand them to jax without ragged handling.
+#ifndef EULER_TPU_OPS_H_
+#define EULER_TPU_OPS_H_
+
+#include <cstdint>
+#include <vector>
+
+#include "graph.h"
+
+namespace et {
+
+// Multi-hop neighbor expansion. Layer i samples counts[i] neighbors for
+// every node of layer i-1 (layer -1 = roots). Edge types may differ per hop:
+// hop i uses edge_types[et_offsets[i] : et_offsets[i+1]] (empty → all).
+// out_ids/out_w/out_t are per-hop buffers sized n_roots * prod(counts[:i+1]).
+void SampleFanout(const Graph& g, const NodeId* roots, size_t n_roots,
+                  const int32_t* counts, size_t n_hops,
+                  const int32_t* edge_types, const int64_t* et_offsets,
+                  NodeId default_id, Pcg32* rng,
+                  const std::vector<NodeId*>& out_ids,
+                  const std::vector<float*>& out_w,
+                  const std::vector<int32_t*>& out_t);
+
+// node2vec-biased random walk. out is [n_roots, walk_len+1] row-major,
+// column 0 = roots. p = return parameter, q = in-out parameter
+// (p = q = 1 → plain weighted walk). Dead ends pad with default_id.
+void RandomWalk(const Graph& g, const NodeId* roots, size_t n_roots,
+                size_t walk_len, float p, float q, NodeId default_id,
+                const int32_t* edge_types, size_t n_types, Pcg32* rng,
+                NodeId* out);
+
+// Layerwise (LADIES-style) sampling: one shared pool of m candidate
+// neighbors per layer for the whole batch, sampled ∝ sum of edge weights
+// from the current layer (importance sampling over the frontier's union
+// neighborhood). Parity: reference API_SAMPLE_L / sampleLNB
+// (euler/core/kernels/sample_layer_op.cc:74). Returns the pool (size m,
+// padded with default_id) for each layer.
+void SampleLayerwise(const Graph& g, const NodeId* roots, size_t n_roots,
+                     const int32_t* layer_sizes, size_t n_layers,
+                     const int32_t* edge_types, size_t n_types,
+                     NodeId default_id, Pcg32* rng,
+                     const std::vector<NodeId*>& out_layers);
+
+}  // namespace et
+
+#endif  // EULER_TPU_OPS_H_

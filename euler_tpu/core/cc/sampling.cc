@@ -1,0 +1,70 @@
+#include "sampling.h"
+
+#include <atomic>
+#include <mutex>
+
+namespace et {
+
+namespace {
+std::atomic<uint64_t> g_rng_base_seed{0x9e3779b97f4a7c15ULL};
+std::atomic<uint64_t> g_rng_thread_counter{0};
+}  // namespace
+
+Pcg32& ThreadLocalRng() {
+  thread_local Pcg32 rng(
+      g_rng_base_seed.load(std::memory_order_relaxed) +
+      0x632be59bd9b4e019ULL *
+          (1 + g_rng_thread_counter.fetch_add(1, std::memory_order_relaxed)));
+  return rng;
+}
+
+void SeedGlobalRng(uint64_t seed) {
+  g_rng_base_seed.store(seed, std::memory_order_relaxed);
+  g_rng_thread_counter.store(0, std::memory_order_relaxed);
+  ThreadLocalRng().Seed(seed);
+}
+
+void AliasSampler::Init(const float* weights, size_t n) {
+  prob_.assign(n, 0.f);
+  alias_.assign(n, 0);
+  total_weight_ = 0.f;
+  if (n == 0) return;
+
+  double sum = 0.0;
+  for (size_t i = 0; i < n; ++i) sum += weights[i];
+  total_weight_ = static_cast<float>(sum);
+  if (sum <= 0.0) {
+    // Degenerate: uniform.
+    for (size_t i = 0; i < n; ++i) {
+      prob_[i] = 1.f;
+      alias_[i] = static_cast<uint32_t>(i);
+    }
+    return;
+  }
+
+  // Vose's algorithm: scaled probabilities partitioned into small/large
+  // worklists, pairing each under-full column with an over-full donor.
+  std::vector<double> scaled(n);
+  for (size_t i = 0; i < n; ++i) scaled[i] = weights[i] * n / sum;
+
+  std::vector<uint32_t> small, large;
+  small.reserve(n);
+  large.reserve(n);
+  for (size_t i = 0; i < n; ++i) {
+    (scaled[i] < 1.0 ? small : large).push_back(static_cast<uint32_t>(i));
+  }
+  while (!small.empty() && !large.empty()) {
+    uint32_t s = small.back();
+    small.pop_back();
+    uint32_t l = large.back();
+    large.pop_back();
+    prob_[s] = static_cast<float>(scaled[s]);
+    alias_[s] = l;
+    scaled[l] = scaled[l] + scaled[s] - 1.0;
+    (scaled[l] < 1.0 ? small : large).push_back(l);
+  }
+  for (uint32_t i : large) prob_[i] = 1.f;
+  for (uint32_t i : small) prob_[i] = 1.f;  // numerical leftovers
+}
+
+}  // namespace et

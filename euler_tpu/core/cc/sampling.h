@@ -1,0 +1,78 @@
+// Weighted sampling primitives.
+//
+// Capability parity with the reference's euler/common/{alias_method.h,
+// fast_weighted_collection.h, compact_weighted_collection.h} (SURVEY.md
+// §2.1): O(1) alias-method sampling for global node/edge samplers, and a
+// memory-compact prefix-sum + binary-search sampler for per-group neighbor
+// sampling. Redesigned around index-based columnar storage: collections
+// sample *indices* into external id arrays rather than owning (id, weight)
+// pairs, which matches the SoA graph store and avoids duplicating ids.
+#ifndef EULER_TPU_SAMPLING_H_
+#define EULER_TPU_SAMPLING_H_
+
+#include <cstdint>
+#include <vector>
+
+#include "common.h"
+
+namespace et {
+
+// O(1) weighted sampling via Vose's alias method. Built once over a weight
+// array; Sample() returns an index in [0, size) with probability
+// weight[i] / sum(weight).
+class AliasSampler {
+ public:
+  AliasSampler() = default;
+
+  void Init(const float* weights, size_t n);
+  void Init(const std::vector<float>& weights) {
+    Init(weights.data(), weights.size());
+  }
+
+  size_t size() const { return prob_.size(); }
+  float total_weight() const { return total_weight_; }
+
+  size_t Sample(Pcg32* rng) const {
+    if (prob_.empty()) return 0;
+    size_t col = static_cast<size_t>(rng->NextUInt(prob_.size()));
+    return rng->NextFloat() < prob_[col] ? col : alias_[col];
+  }
+
+ private:
+  std::vector<float> prob_;
+  std::vector<uint32_t> alias_;
+  float total_weight_ = 0.f;
+};
+
+// Prefix-sum sampler over a *slice* of a shared cumulative-weight array —
+// the per-neighbor-group sampler. The graph store keeps one global cumw
+// array aligned with the adjacency array; each (node, edge_type) group is a
+// [begin, end) range. O(log k) per sample, zero extra memory per group.
+//
+// cumw[i] holds the inclusive prefix sum of weights *within the group*,
+// i.e. cumw[begin] = w0, cumw[end-1] = total.
+inline size_t SampleFromCumulative(const float* cumw, size_t begin, size_t end,
+                                   Pcg32* rng) {
+  size_t n = end - begin;
+  if (n == 0) return begin;  // caller must guard empty groups
+  float total = cumw[end - 1];
+  if (total <= 0.f) {
+    return begin + static_cast<size_t>(rng->NextUInt(n));
+  }
+  float r = rng->NextFloat() * total;
+  // Branchless-ish binary search for first cumw[j] > r.
+  size_t lo = begin, hi = end;
+  while (lo < hi) {
+    size_t mid = lo + ((hi - lo) >> 1);
+    if (cumw[mid] <= r) {
+      lo = mid + 1;
+    } else {
+      hi = mid;
+    }
+  }
+  return lo < end ? lo : end - 1;
+}
+
+}  // namespace et
+
+#endif  // EULER_TPU_SAMPLING_H_

@@ -1,0 +1,132 @@
+"""ctypes loader for the native graph engine (libeuler_core.so).
+
+Parity: the reference loads libeuler_core.so / libtf_euler.so via ctypes
+(euler/python/start_service.py:27-30, tf_euler/python/euler_ops/base.py).
+Here there is a single library exposing the batch C API defined in
+euler_tpu/core/cc/capi.cc; this module declares argtypes once and exposes
+the raw handle-based functions. Use euler_tpu.graph.GraphEngine for the
+numpy-facing wrapper.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libeuler_core.so")
+
+_lib = None
+
+
+def _build() -> None:
+    proc = subprocess.run(
+        ["make", "-C", os.path.join(_HERE, "cc"), "-j", "4"],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            "native engine build failed:\n" + proc.stdout + proc.stderr
+        )
+
+
+def load() -> ctypes.CDLL:
+    """Load (building if necessary) the native engine library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        _build()
+    lib = ctypes.CDLL(_LIB_PATH)
+    _declare(lib)
+    _lib = lib
+    return lib
+
+
+c_u64p = ctypes.POINTER(ctypes.c_uint64)
+c_i64p = ctypes.POINTER(ctypes.c_int64)
+c_i32p = ctypes.POINTER(ctypes.c_int32)
+c_f32p = ctypes.POINTER(ctypes.c_float)
+c_voidp = ctypes.c_void_p
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    i64, i32, u64, f32 = (
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.c_uint64,
+        ctypes.c_float,
+    )
+    sigs = {
+        "etg_last_error": (ctypes.c_char_p, []),
+        "etg_seed": (None, [u64]),
+        "etg_set_log_level": (None, [i32]),
+        "etg_builder_new": (i64, []),
+        "etg_builder_set_feature": (i32, [i64, i32, i32, i32, i64, ctypes.c_char_p]),
+        "etg_builder_set_num_types": (i32, [i64, i32, i32]),
+        "etg_builder_add_nodes": (i32, [i64, i64, c_u64p, c_i32p, c_f32p]),
+        "etg_builder_add_edges": (i32, [i64, i64, c_u64p, c_u64p, c_i32p, c_f32p]),
+        "etg_builder_set_node_dense": (i32, [i64, c_u64p, i64, i32, i64, c_f32p]),
+        "etg_builder_set_node_sparse": (i32, [i64, c_u64p, i64, i32, c_u64p, c_u64p]),
+        "etg_builder_set_node_binary": (i32, [i64, u64, i32, ctypes.c_char_p, i64]),
+        "etg_builder_set_edge_dense": (i32, [i64, c_u64p, c_u64p, c_i32p, i64, i32, i64, c_f32p]),
+        "etg_builder_set_edge_sparse": (i32, [i64, u64, u64, i32, i32, c_u64p, i64]),
+        "etg_builder_finalize": (i64, [i64, i32]),
+        "etg_load": (i64, [ctypes.c_char_p, i32, i32, i32, i32]),
+        "etg_dump": (i32, [i64, ctypes.c_char_p]),
+        "etg_free": (i32, [i64]),
+        "etg_node_count": (i64, [i64]),
+        "etg_edge_count": (i64, [i64]),
+        "etg_num_node_types": (i32, [i64]),
+        "etg_num_edge_types": (i32, [i64]),
+        "etg_num_node_features": (i32, [i64]),
+        "etg_num_edge_features": (i32, [i64]),
+        "etg_feature_info": (i32, [i64, i32, i32, c_i32p, c_i64p, ctypes.c_char_p, i64]),
+        "etg_all_node_ids": (i32, [i64, c_u64p]),
+        "etg_node_weight_sums": (i32, [i64, c_f32p]),
+        "etg_edge_weight_sums": (i32, [i64, c_f32p]),
+        "etg_sample_node": (i32, [i64, i32, i64, c_u64p]),
+        "etg_sample_node_with_types": (i32, [i64, c_i32p, i64, c_u64p]),
+        "etg_sample_edge": (i32, [i64, i32, i64, c_u64p, c_u64p, c_i32p]),
+        "etg_get_node_type": (i32, [i64, c_u64p, i64, c_i32p]),
+        "etg_sample_neighbor": (i32, [i64, c_u64p, i64, c_i32p, i64, i64, u64, c_u64p, c_f32p, c_i32p]),
+        "etg_sample_in_neighbor": (i32, [i64, c_u64p, i64, c_i32p, i64, i64, u64, c_u64p, c_f32p, c_i32p]),
+        "etg_get_top_k_neighbor": (i32, [i64, c_u64p, i64, c_i32p, i64, i64, u64, c_u64p, c_f32p, c_i32p]),
+        "etg_sample_fanout": (i32, [i64, c_u64p, i64, c_i32p, i64, c_i32p, c_i64p, u64, ctypes.POINTER(c_u64p), ctypes.POINTER(c_f32p), ctypes.POINTER(c_i32p)]),
+        "etg_random_walk": (i32, [i64, c_u64p, i64, i64, f32, f32, u64, c_i32p, i64, c_u64p]),
+        "etg_sample_layerwise": (i32, [i64, c_u64p, i64, c_i32p, i64, c_i32p, i64, u64, ctypes.POINTER(c_u64p)]),
+        "etg_get_dense_feature": (i32, [i64, c_u64p, i64, i32, i64, c_f32p]),
+        "etg_get_edge_dense_feature": (i32, [i64, c_u64p, c_u64p, c_i32p, i64, i32, i64, c_f32p]),
+        "etres_new": (c_voidp, []),
+        "etres_free": (None, [c_voidp]),
+        "etres_offsets_len": (i64, [c_voidp]),
+        "etres_offsets": (c_u64p, [c_voidp]),
+        "etres_u64_len": (i64, [c_voidp]),
+        "etres_u64": (c_u64p, [c_voidp]),
+        "etres_f32_len": (i64, [c_voidp]),
+        "etres_f32": (c_f32p, [c_voidp]),
+        "etres_i32_len": (i64, [c_voidp]),
+        "etres_i32": (c_i32p, [c_voidp]),
+        "etres_bytes_len": (i64, [c_voidp]),
+        "etres_bytes": (ctypes.POINTER(ctypes.c_char), [c_voidp]),
+        "etg_get_full_neighbor": (i32, [i64, c_u64p, i64, c_i32p, i64, i32, i32, c_voidp]),
+        "etg_get_sparse_feature": (i32, [i64, c_u64p, i64, i32, c_voidp]),
+        "etg_get_binary_feature": (i32, [i64, c_u64p, i64, i32, c_voidp]),
+        "etg_get_edge_sparse_feature": (i32, [i64, c_u64p, c_u64p, c_i32p, i64, i32, c_voidp]),
+        "etg_get_edge_binary_feature": (i32, [i64, c_u64p, c_u64p, c_i32p, i64, i32, c_voidp]),
+    }
+    for name, (restype, argtypes) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+
+
+class EngineError(RuntimeError):
+    pass
+
+
+def check(lib: ctypes.CDLL, rc: int) -> None:
+    if rc != 0:
+        raise EngineError(lib.etg_last_error().decode())

@@ -1,0 +1,9 @@
+from euler_tpu.graph.api import (  # noqa: F401
+    BINARY,
+    DENSE,
+    SPARSE,
+    EngineError,
+    GraphBuilder,
+    GraphEngine,
+    seed,
+)

@@ -1,0 +1,567 @@
+"""Numpy-facing wrapper over the native graph engine.
+
+This is the embedded (in-process) graph engine interface — capability
+parity with the reference's local mode (euler/client/query_proxy.cc:160-190
+`initialize_embedded_graph`) and the per-op C++ API surface
+(euler/core/api/api.h:44-95). All ops are batch, take/return numpy arrays
+with fixed shapes (padded with `default_id`) so results can be fed straight
+into jax.device_put without ragged handling.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Sequence
+
+import numpy as np
+
+from euler_tpu.core import lib as _libmod
+from euler_tpu.core.lib import EngineError, c_f32p, c_i32p, c_i64p, c_u64p
+
+__all__ = ["GraphEngine", "GraphBuilder", "EngineError"]
+
+DENSE, SPARSE, BINARY = 0, 1, 2
+
+
+def _u64(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.uint64)
+
+
+def _i32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int32)
+
+
+def _f32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.float32)
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctype)
+
+
+def _opt_types(edge_types) -> tuple:
+    """Normalize an edge-type filter to (ptr, n). None/empty → all types."""
+    if edge_types is None:
+        return None, 0
+    et = _i32(edge_types).ravel()
+    if et.size == 0:
+        return None, 0
+    return et, et.size
+
+
+class _Result:
+    """RAII wrapper for the variable-size EtResult handle."""
+
+    def __init__(self, lib):
+        self._lib = lib
+        self.h = lib.etres_new()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._lib.etres_free(self.h)
+
+    def offsets(self) -> np.ndarray:
+        n = self._lib.etres_offsets_len(self.h)
+        if n == 0:
+            return np.zeros(0, dtype=np.uint64)
+        return np.ctypeslib.as_array(self._lib.etres_offsets(self.h), (n,)).copy()
+
+    def u64(self) -> np.ndarray:
+        n = self._lib.etres_u64_len(self.h)
+        if n == 0:
+            return np.zeros(0, dtype=np.uint64)
+        return np.ctypeslib.as_array(self._lib.etres_u64(self.h), (n,)).copy()
+
+    def f32(self) -> np.ndarray:
+        n = self._lib.etres_f32_len(self.h)
+        if n == 0:
+            return np.zeros(0, dtype=np.float32)
+        return np.ctypeslib.as_array(self._lib.etres_f32(self.h), (n,)).copy()
+
+    def i32(self) -> np.ndarray:
+        n = self._lib.etres_i32_len(self.h)
+        if n == 0:
+            return np.zeros(0, dtype=np.int32)
+        return np.ctypeslib.as_array(self._lib.etres_i32(self.h), (n,)).copy()
+
+    def bytes_(self) -> bytes:
+        n = self._lib.etres_bytes_len(self.h)
+        if n == 0:
+            return b""
+        return ctypes.string_at(self._lib.etres_bytes(self.h), n)
+
+
+class GraphBuilder:
+    """Accumulates nodes/edges/features, then .finalize() → GraphEngine."""
+
+    def __init__(self):
+        self._lib = _libmod.load()
+        self.h = self._lib.etg_builder_new()
+        self._feature_names: dict = {"node": {}, "edge": {}}
+
+    def set_num_types(self, num_node_types: int, num_edge_types: int):
+        _libmod.check(
+            self._lib,
+            self._lib.etg_builder_set_num_types(self.h, num_node_types, num_edge_types),
+        )
+        return self
+
+    def set_feature(self, fid: int, kind: int, dim: int, name: str = "", edge: bool = False):
+        _libmod.check(
+            self._lib,
+            self._lib.etg_builder_set_feature(
+                self.h, 1 if edge else 0, fid, kind, dim, name.encode()
+            ),
+        )
+        self._feature_names["edge" if edge else "node"][name or str(fid)] = fid
+        return self
+
+    def add_nodes(self, ids, types=None, weights=None):
+        ids = _u64(ids).ravel()
+        n = ids.size
+        tp = _ptr(_i32(types).ravel(), c_i32p) if types is not None else None
+        wp = _ptr(_f32(weights).ravel(), c_f32p) if weights is not None else None
+        _libmod.check(
+            self._lib,
+            self._lib.etg_builder_add_nodes(self.h, n, _ptr(ids, c_u64p), tp, wp),
+        )
+        return self
+
+    def add_edges(self, src, dst, types=None, weights=None):
+        src = _u64(src).ravel()
+        dst = _u64(dst).ravel()
+        n = src.size
+        tp = _ptr(_i32(types).ravel(), c_i32p) if types is not None else None
+        wp = _ptr(_f32(weights).ravel(), c_f32p) if weights is not None else None
+        _libmod.check(
+            self._lib,
+            self._lib.etg_builder_add_edges(
+                self.h, n, _ptr(src, c_u64p), _ptr(dst, c_u64p), tp, wp
+            ),
+        )
+        return self
+
+    def set_node_dense(self, ids, fid: int, values):
+        ids = _u64(ids).ravel()
+        values = _f32(values).reshape(ids.size, -1)
+        _libmod.check(
+            self._lib,
+            self._lib.etg_builder_set_node_dense(
+                self.h, _ptr(ids, c_u64p), ids.size, fid, values.shape[1],
+                _ptr(values, c_f32p),
+            ),
+        )
+        return self
+
+    def set_node_sparse(self, ids, fid: int, offsets, values):
+        ids = _u64(ids).ravel()
+        offsets = _u64(offsets).ravel()
+        values = _u64(values).ravel()
+        _libmod.check(
+            self._lib,
+            self._lib.etg_builder_set_node_sparse(
+                self.h, _ptr(ids, c_u64p), ids.size, fid,
+                _ptr(offsets, c_u64p), _ptr(values, c_u64p),
+            ),
+        )
+        return self
+
+    def set_node_binary(self, node_id: int, fid: int, data: bytes):
+        _libmod.check(
+            self._lib,
+            self._lib.etg_builder_set_node_binary(self.h, node_id, fid, data, len(data)),
+        )
+        return self
+
+    def set_edge_dense(self, src, dst, types, fid: int, values):
+        src = _u64(src).ravel()
+        dst = _u64(dst).ravel()
+        types = _i32(types if types is not None else np.zeros(src.size)).ravel()
+        values = _f32(values).reshape(src.size, -1)
+        _libmod.check(
+            self._lib,
+            self._lib.etg_builder_set_edge_dense(
+                self.h, _ptr(src, c_u64p), _ptr(dst, c_u64p), _ptr(types, c_i32p),
+                src.size, fid, values.shape[1], _ptr(values, c_f32p),
+            ),
+        )
+        return self
+
+    def set_edge_sparse(self, src: int, dst: int, etype: int, fid: int, values):
+        values = _u64(values).ravel()
+        _libmod.check(
+            self._lib,
+            self._lib.etg_builder_set_edge_sparse(
+                self.h, src, dst, etype, fid, _ptr(values, c_u64p), values.size
+            ),
+        )
+        return self
+
+    def finalize(self, build_in_adjacency: bool = True) -> "GraphEngine":
+        gh = self._lib.etg_builder_finalize(self.h, 1 if build_in_adjacency else 0)
+        if gh < 0:
+            raise EngineError(self._lib.etg_last_error().decode())
+        self.h = None
+        return GraphEngine(gh, feature_names=self._feature_names)
+
+
+class GraphEngine:
+    """Immutable in-process graph; all query/sampling ops live here."""
+
+    def __init__(self, handle: int, feature_names: Optional[dict] = None):
+        self._lib = _libmod.load()
+        self.h = handle
+        self._feature_names = feature_names or {"node": {}, "edge": {}}
+        if not self._feature_names["node"]:
+            self._load_feature_names()
+
+    # -- lifecycle ---------------------------------------------------------
+    @classmethod
+    def load(cls, directory: str, shard_idx: int = 0, shard_num: int = 1,
+             data_type: int = 0, build_in_adjacency: bool = True) -> "GraphEngine":
+        lib = _libmod.load()
+        h = lib.etg_load(directory.encode(), shard_idx, shard_num, data_type,
+                         1 if build_in_adjacency else 0)
+        if h < 0:
+            raise EngineError(lib.etg_last_error().decode())
+        return cls(h)
+
+    def dump(self, directory: str) -> None:
+        import os
+
+        os.makedirs(directory, exist_ok=True)
+        _libmod.check(self._lib, self._lib.etg_dump(self.h, directory.encode()))
+
+    def close(self) -> None:
+        if self.h is not None:
+            self._lib.etg_free(self.h)
+            self.h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _load_feature_names(self):
+        for edge, key in ((0, "node"), (1, "edge")):
+            n = (self._lib.etg_num_edge_features(self.h) if edge
+                 else self._lib.etg_num_node_features(self.h))
+            for fid in range(max(n, 0)):
+                kind = ctypes.c_int32()
+                dim = ctypes.c_int64()
+                buf = ctypes.create_string_buffer(256)
+                rc = self._lib.etg_feature_info(
+                    self.h, edge, fid, ctypes.byref(kind), ctypes.byref(dim), buf, 256
+                )
+                if rc == 0:
+                    name = buf.value.decode() or str(fid)
+                    self._feature_names[key][name] = fid
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def node_count(self) -> int:
+        return self._lib.etg_node_count(self.h)
+
+    @property
+    def edge_count(self) -> int:
+        return self._lib.etg_edge_count(self.h)
+
+    @property
+    def num_node_types(self) -> int:
+        return self._lib.etg_num_node_types(self.h)
+
+    @property
+    def num_edge_types(self) -> int:
+        return self._lib.etg_num_edge_types(self.h)
+
+    def feature_id(self, name, edge: bool = False) -> int:
+        if isinstance(name, (int, np.integer)):
+            return int(name)
+        return self._feature_names["edge" if edge else "node"][name]
+
+    def feature_dim(self, fid_or_name, edge: bool = False) -> int:
+        fid = self.feature_id(fid_or_name, edge)
+        kind = ctypes.c_int32()
+        dim = ctypes.c_int64()
+        _libmod.check(
+            self._lib,
+            self._lib.etg_feature_info(self.h, 1 if edge else 0, fid,
+                                       ctypes.byref(kind), ctypes.byref(dim), None, 0),
+        )
+        return int(dim.value)
+
+    def all_node_ids(self) -> np.ndarray:
+        out = np.zeros(self.node_count, dtype=np.uint64)
+        _libmod.check(self._lib, self._lib.etg_all_node_ids(self.h, _ptr(out, c_u64p)))
+        return out
+
+    def node_weight_sums(self) -> np.ndarray:
+        out = np.zeros(self.num_node_types, dtype=np.float32)
+        _libmod.check(self._lib, self._lib.etg_node_weight_sums(self.h, _ptr(out, c_f32p)))
+        return out
+
+    def edge_weight_sums(self) -> np.ndarray:
+        out = np.zeros(self.num_edge_types, dtype=np.float32)
+        _libmod.check(self._lib, self._lib.etg_edge_weight_sums(self.h, _ptr(out, c_f32p)))
+        return out
+
+    # -- sampling ----------------------------------------------------------
+    def sample_node(self, count: int, node_type: int = -1) -> np.ndarray:
+        out = np.zeros(count, dtype=np.uint64)
+        _libmod.check(
+            self._lib, self._lib.etg_sample_node(self.h, node_type, count, _ptr(out, c_u64p))
+        )
+        return out
+
+    def sample_node_with_types(self, types) -> np.ndarray:
+        types = _i32(types).ravel()
+        out = np.zeros(types.size, dtype=np.uint64)
+        _libmod.check(
+            self._lib,
+            self._lib.etg_sample_node_with_types(
+                self.h, _ptr(types, c_i32p), types.size, _ptr(out, c_u64p)
+            ),
+        )
+        return out
+
+    def sample_edge(self, count: int, edge_type: int = -1):
+        src = np.zeros(count, dtype=np.uint64)
+        dst = np.zeros(count, dtype=np.uint64)
+        tp = np.zeros(count, dtype=np.int32)
+        _libmod.check(
+            self._lib,
+            self._lib.etg_sample_edge(
+                self.h, edge_type, count, _ptr(src, c_u64p), _ptr(dst, c_u64p),
+                _ptr(tp, c_i32p),
+            ),
+        )
+        return src, dst, tp
+
+    def get_node_type(self, ids) -> np.ndarray:
+        ids = _u64(ids).ravel()
+        out = np.zeros(ids.size, dtype=np.int32)
+        _libmod.check(
+            self._lib,
+            self._lib.etg_get_node_type(self.h, _ptr(ids, c_u64p), ids.size, _ptr(out, c_i32p)),
+        )
+        return out
+
+    def sample_neighbor(self, ids, count: int, edge_types=None, default_id: int = 0,
+                        in_edges: bool = False):
+        ids = _u64(ids).ravel()
+        n = ids.size
+        et, n_et = _opt_types(edge_types)
+        etp = _ptr(et, c_i32p) if et is not None else None
+        out_ids = np.zeros((n, count), dtype=np.uint64)
+        out_w = np.zeros((n, count), dtype=np.float32)
+        out_t = np.zeros((n, count), dtype=np.int32)
+        fn = self._lib.etg_sample_in_neighbor if in_edges else self._lib.etg_sample_neighbor
+        _libmod.check(
+            self._lib,
+            fn(self.h, _ptr(ids, c_u64p), n, etp, n_et, count, default_id,
+               _ptr(out_ids, c_u64p), _ptr(out_w, c_f32p), _ptr(out_t, c_i32p)),
+        )
+        return out_ids, out_w, out_t
+
+    def get_top_k_neighbor(self, ids, k: int, edge_types=None, default_id: int = 0):
+        ids = _u64(ids).ravel()
+        n = ids.size
+        et, n_et = _opt_types(edge_types)
+        etp = _ptr(et, c_i32p) if et is not None else None
+        out_ids = np.zeros((n, k), dtype=np.uint64)
+        out_w = np.zeros((n, k), dtype=np.float32)
+        out_t = np.zeros((n, k), dtype=np.int32)
+        _libmod.check(
+            self._lib,
+            self._lib.etg_get_top_k_neighbor(
+                self.h, _ptr(ids, c_u64p), n, etp, n_et, k, default_id,
+                _ptr(out_ids, c_u64p), _ptr(out_w, c_f32p), _ptr(out_t, c_i32p)),
+        )
+        return out_ids, out_w, out_t
+
+    def get_full_neighbor(self, ids, edge_types=None, sorted_by_id: bool = False,
+                          in_edges: bool = False):
+        """Returns (offsets[n+1], nbr_ids, weights, types) CSR arrays."""
+        ids = _u64(ids).ravel()
+        et, n_et = _opt_types(edge_types)
+        etp = _ptr(et, c_i32p) if et is not None else None
+        with _Result(self._lib) as res:
+            _libmod.check(
+                self._lib,
+                self._lib.etg_get_full_neighbor(
+                    self.h, _ptr(ids, c_u64p), ids.size, etp, n_et,
+                    1 if sorted_by_id else 0, 1 if in_edges else 0, res.h),
+            )
+            return res.offsets(), res.u64(), res.f32(), res.i32()
+
+    def sample_fanout(self, roots, counts: Sequence[int], edge_types=None,
+                      default_id: int = 0):
+        """Multi-hop expansion in one native call.
+
+        Returns (ids_per_hop, weights_per_hop, types_per_hop); hop i arrays
+        have shape [n_roots * prod(counts[:i+1])].
+        """
+        roots = _u64(roots).ravel()
+        n = roots.size
+        counts_arr = _i32(counts).ravel()
+        n_hops = counts_arr.size
+        # per-hop edge-type lists: edge_types is None | flat list (shared) |
+        # list of per-hop lists
+        if edge_types is None:
+            et_flat, et_offsets = None, None
+        else:
+            if edge_types and isinstance(edge_types[0], (list, tuple, np.ndarray)):
+                per_hop = [list(h) for h in edge_types]
+                if len(per_hop) != n_hops:
+                    raise ValueError(
+                        f"per-hop edge_types has {len(per_hop)} entries, "
+                        f"expected {n_hops} (one per hop)"
+                    )
+            else:
+                per_hop = [list(edge_types)] * n_hops
+            offs = [0]
+            flat = []
+            for hop_list in per_hop:
+                flat.extend(hop_list)
+                offs.append(len(flat))
+            et_flat = _i32(flat) if flat else None
+            et_offsets = np.asarray(offs, dtype=np.int64)
+        sizes = []
+        m = n
+        for c in counts_arr:
+            m *= int(c)
+            sizes.append(m)
+        ids_bufs = [np.zeros(s, dtype=np.uint64) for s in sizes]
+        w_bufs = [np.zeros(s, dtype=np.float32) for s in sizes]
+        t_bufs = [np.zeros(s, dtype=np.int32) for s in sizes]
+        ids_ptrs = (c_u64p * n_hops)(*[_ptr(b, c_u64p) for b in ids_bufs])
+        w_ptrs = (c_f32p * n_hops)(*[_ptr(b, c_f32p) for b in w_bufs])
+        t_ptrs = (c_i32p * n_hops)(*[_ptr(b, c_i32p) for b in t_bufs])
+        _libmod.check(
+            self._lib,
+            self._lib.etg_sample_fanout(
+                self.h, _ptr(roots, c_u64p), n, _ptr(counts_arr, c_i32p), n_hops,
+                _ptr(et_flat, c_i32p) if et_flat is not None else None,
+                _ptr(et_offsets, c_i64p) if et_offsets is not None else None,
+                default_id, ids_ptrs, w_ptrs, t_ptrs),
+        )
+        return ids_bufs, w_bufs, t_bufs
+
+    def random_walk(self, roots, walk_len: int, p: float = 1.0, q: float = 1.0,
+                    edge_types=None, default_id: int = 0) -> np.ndarray:
+        roots = _u64(roots).ravel()
+        et, n_et = _opt_types(edge_types)
+        etp = _ptr(et, c_i32p) if et is not None else None
+        out = np.zeros((roots.size, walk_len + 1), dtype=np.uint64)
+        _libmod.check(
+            self._lib,
+            self._lib.etg_random_walk(
+                self.h, _ptr(roots, c_u64p), roots.size, walk_len, p, q,
+                default_id, etp, n_et, _ptr(out, c_u64p)),
+        )
+        return out
+
+    def sample_layerwise(self, roots, layer_sizes: Sequence[int], edge_types=None,
+                         default_id: int = 0):
+        roots = _u64(roots).ravel()
+        sizes = _i32(layer_sizes).ravel()
+        n_layers = sizes.size
+        et, n_et = _opt_types(edge_types)
+        etp = _ptr(et, c_i32p) if et is not None else None
+        bufs = [np.zeros(int(s), dtype=np.uint64) for s in sizes]
+        ptrs = (c_u64p * n_layers)(*[_ptr(b, c_u64p) for b in bufs])
+        _libmod.check(
+            self._lib,
+            self._lib.etg_sample_layerwise(
+                self.h, _ptr(roots, c_u64p), roots.size, _ptr(sizes, c_i32p),
+                n_layers, etp, n_et, default_id, ptrs),
+        )
+        return bufs
+
+    # -- features ----------------------------------------------------------
+    def get_dense_feature(self, ids, fids, dims=None) -> list:
+        """Returns [n, dim] float32 per fid (list), zero-filled for misses."""
+        ids = _u64(ids).ravel()
+        single = not isinstance(fids, (list, tuple, np.ndarray))
+        fid_list = [fids] if single else list(fids)
+        fid_list = [self.feature_id(f) for f in fid_list]
+        if dims is None:
+            dim_list = [self.feature_dim(f) for f in fid_list]
+        else:
+            dim_list = [dims] if single else list(dims)
+        outs = []
+        for fid, dim in zip(fid_list, dim_list):
+            out = np.zeros((ids.size, dim), dtype=np.float32)
+            _libmod.check(
+                self._lib,
+                self._lib.etg_get_dense_feature(
+                    self.h, _ptr(ids, c_u64p), ids.size, fid, dim, _ptr(out, c_f32p)),
+            )
+            outs.append(out)
+        return outs[0] if single else outs
+
+    def get_sparse_feature(self, ids, fid) -> tuple:
+        """Returns (offsets[n+1], values) CSR of uint64."""
+        ids = _u64(ids).ravel()
+        fid = self.feature_id(fid)
+        with _Result(self._lib) as res:
+            _libmod.check(
+                self._lib,
+                self._lib.etg_get_sparse_feature(self.h, _ptr(ids, c_u64p), ids.size, fid, res.h),
+            )
+            return res.offsets(), res.u64()
+
+    def get_binary_feature(self, ids, fid) -> tuple:
+        ids = _u64(ids).ravel()
+        fid = self.feature_id(fid)
+        with _Result(self._lib) as res:
+            _libmod.check(
+                self._lib,
+                self._lib.etg_get_binary_feature(self.h, _ptr(ids, c_u64p), ids.size, fid, res.h),
+            )
+            return res.offsets(), res.bytes_()
+
+    def get_edge_dense_feature(self, src, dst, types, fids, dims=None):
+        src = _u64(src).ravel()
+        dst = _u64(dst).ravel()
+        types = _i32(types).ravel()
+        single = not isinstance(fids, (list, tuple, np.ndarray))
+        fid_list = [fids] if single else list(fids)
+        fid_list = [self.feature_id(f, edge=True) for f in fid_list]
+        if dims is None:
+            dim_list = [self.feature_dim(f, edge=True) for f in fid_list]
+        else:
+            dim_list = [dims] if single else list(dims)
+        outs = []
+        for fid, dim in zip(fid_list, dim_list):
+            out = np.zeros((src.size, dim), dtype=np.float32)
+            _libmod.check(
+                self._lib,
+                self._lib.etg_get_edge_dense_feature(
+                    self.h, _ptr(src, c_u64p), _ptr(dst, c_u64p), _ptr(types, c_i32p),
+                    src.size, fid, dim, _ptr(out, c_f32p)),
+            )
+            outs.append(out)
+        return outs[0] if single else outs
+
+    def get_edge_sparse_feature(self, src, dst, types, fid) -> tuple:
+        src = _u64(src).ravel()
+        dst = _u64(dst).ravel()
+        types = _i32(types).ravel()
+        fid = self.feature_id(fid, edge=True)
+        with _Result(self._lib) as res:
+            _libmod.check(
+                self._lib,
+                self._lib.etg_get_edge_sparse_feature(
+                    self.h, _ptr(src, c_u64p), _ptr(dst, c_u64p), _ptr(types, c_i32p),
+                    src.size, fid, res.h),
+            )
+            return res.offsets(), res.u64()
+
+
+def seed(value: int) -> None:
+    """Seed the engine's RNG (current thread) for reproducible sampling."""
+    _libmod.load().etg_seed(value)

@@ -1,0 +1,48 @@
+"""Test configuration: force an 8-device virtual CPU mesh for sharding tests.
+
+Must set XLA flags before jax initializes (hence at conftest import time).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture
+def ring_graph():
+    """10-node, 2-type ring graph with dense + sparse features (the canned
+    in-proc test graph — role of the reference's mock_api.cc EulerGraph)."""
+    from euler_tpu.graph import GraphBuilder, seed
+
+    seed(1234)
+    b = GraphBuilder()
+    b.set_num_types(2, 2)
+    b.set_feature(0, 0, 4, "f_dense")
+    b.set_feature(1, 1, 0, "f_sparse")
+    b.set_feature(0, 0, 2, "e_dense", edge=True)
+    ids = np.arange(1, 11, dtype=np.uint64)
+    b.add_nodes(ids, types=np.array([0, 1] * 5), weights=np.arange(1, 11, dtype=np.float32))
+    src = np.concatenate([ids, ids])
+    dst = np.concatenate([np.roll(ids, -1), np.roll(ids, -2)])
+    et = np.array([0] * 10 + [1] * 10)
+    w = np.arange(1, 21, dtype=np.float32)
+    b.add_edges(src, dst, types=et, weights=w)
+    b.set_node_dense(ids, 0, np.arange(40, dtype=np.float32).reshape(10, 4))
+    b.set_node_sparse(ids, 1, np.arange(11, dtype=np.uint64) * 2,
+                      np.arange(20, dtype=np.uint64))
+    b.set_edge_dense(src, dst, et, 0,
+                     np.stack([w, -w], axis=1).astype(np.float32))
+    return b.finalize()

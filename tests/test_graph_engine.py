@@ -1,0 +1,209 @@
+"""Unit tests for the native graph engine (mirrors the reference's C++ unit
+tiers: common weighted-collection statistics, graph store, features, serde —
+SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from euler_tpu.graph import GraphBuilder, GraphEngine, seed
+
+
+def test_counts(ring_graph):
+    g = ring_graph
+    assert g.node_count == 10
+    assert g.edge_count == 20
+    assert g.num_node_types == 2
+    assert g.num_edge_types == 2
+    assert set(g.all_node_ids()) == set(range(1, 11))
+
+
+def test_node_type_lookup(ring_graph):
+    types = ring_graph.get_node_type([1, 2, 99])
+    assert list(types) == [0, 1, -1]
+
+
+def test_weight_sums(ring_graph):
+    nw = ring_graph.node_weight_sums()
+    # types alternate 0,1 with weights 1..10: type0 gets odds 1+3+5+7+9=25
+    assert nw[0] == pytest.approx(25.0)
+    assert nw[1] == pytest.approx(30.0)
+    ew = ring_graph.edge_weight_sums()
+    assert ew[0] == pytest.approx(sum(range(1, 11)))
+    assert ew[1] == pytest.approx(sum(range(11, 21)))
+
+
+def test_sample_node_distribution(ring_graph):
+    seed(7)
+    n = 20000
+    ids = ring_graph.sample_node(n)
+    # all nodes, ∝ weight 1..10 → node 10 ≈ 10/55
+    counts = np.bincount(ids.astype(int), minlength=11)
+    freq10 = counts[10] / n
+    assert freq10 == pytest.approx(10 / 55, abs=0.02)
+    ids1 = ring_graph.sample_node(n, node_type=1)
+    assert set(np.unique(ids1.astype(int))) <= {2, 4, 6, 8, 10}
+
+
+def test_sample_node_with_types(ring_graph):
+    seed(3)
+    out = ring_graph.sample_node_with_types([0, 1, 0, 1])
+    types = ring_graph.get_node_type(out)
+    assert list(types) == [0, 1, 0, 1]
+
+
+def test_sample_edge_distribution(ring_graph):
+    seed(11)
+    n = 20000
+    src, dst, t = ring_graph.sample_edge(n, edge_type=0)
+    assert set(t) == {0}
+    # edge (10→1) has weight 10 of type-0 total 55
+    hit = np.mean((src == 10) & (dst == 1))
+    assert hit == pytest.approx(10 / 55, abs=0.02)
+
+
+def test_sample_neighbor_weighted(ring_graph):
+    seed(5)
+    # node 1: type0 → 2 (w1), type1 → 3 (w11)
+    nb, w, t = ring_graph.sample_neighbor(np.array([1], dtype=np.uint64), 2000)
+    frac3 = np.mean(nb == 3)
+    assert frac3 == pytest.approx(11 / 12, abs=0.03)
+    # restricted to type 0 only → always node 2
+    nb0, _, t0 = ring_graph.sample_neighbor([1], 10, edge_types=[0])
+    assert set(nb0.ravel()) == {2}
+    assert set(t0.ravel()) == {0}
+
+
+def test_sample_neighbor_missing_pads_default(ring_graph):
+    nb, w, t = ring_graph.sample_neighbor([999], 3, default_id=0)
+    assert list(nb.ravel()) == [0, 0, 0]
+    assert list(t.ravel()) == [-1, -1, -1]
+    assert np.all(w == 0)
+
+
+def test_full_neighbor(ring_graph):
+    off, ids, w, t = ring_graph.get_full_neighbor([1, 2], sorted_by_id=True)
+    assert list(off) == [0, 2, 4]
+    assert list(ids[:2]) == [2, 3]
+    assert list(w[:2]) == [1.0, 11.0]
+    assert list(ids[2:]) == [3, 4]
+
+
+def test_in_neighbor(ring_graph):
+    # in-neighbors of 3: via type0 from 2 (w2), via type1 from 1 (w11)
+    off, ids, w, t = ring_graph.get_full_neighbor([3], in_edges=True)
+    assert list(off) == [0, 2]
+    assert set(ids) == {1, 2}
+    nb, _, _ = ring_graph.sample_neighbor([3], 5, in_edges=True)
+    assert set(nb.ravel()) <= {1, 2}
+
+
+def test_top_k(ring_graph):
+    ids, w, t = ring_graph.get_top_k_neighbor([1], 3, default_id=0)
+    # node 1 has 2 edges: (3, w11), (2, w1), then padding
+    assert list(ids.ravel()) == [3, 2, 0]
+    assert w.ravel()[0] == pytest.approx(11.0)
+    assert t.ravel()[2] == -1
+
+
+def test_fanout_shapes(ring_graph):
+    ids, w, t = ring_graph.sample_fanout([1, 2, 3], [4, 2])
+    assert ids[0].shape == (12,)
+    assert ids[1].shape == (24,)
+    # all sampled ids must be real neighbors (graph is a ring; no default pad)
+    assert np.all(ids[0] > 0)
+
+
+def test_fanout_per_hop_edge_types(ring_graph):
+    ids, w, t = ring_graph.sample_fanout([1], [2, 2], edge_types=[[0], [1]])
+    assert set(t[0]) == {0}
+    assert set(t[1]) == {1}
+
+
+def test_dense_feature(ring_graph):
+    f = ring_graph.get_dense_feature([1, 2, 999], "f_dense")
+    assert f.shape == (3, 4)
+    assert list(f[0]) == [0, 1, 2, 3]
+    assert list(f[2]) == [0, 0, 0, 0]  # missing node zero-fills
+
+
+def test_multi_dense_features(ring_graph):
+    fs = ring_graph.get_dense_feature([1], ["f_dense"])
+    assert isinstance(fs, list) and fs[0].shape == (1, 4)
+
+
+def test_sparse_feature(ring_graph):
+    off, vals = ring_graph.get_sparse_feature([1, 2], "f_sparse")
+    assert list(off) == [0, 2, 4]
+    assert list(vals) == [0, 1, 2, 3]
+
+
+def test_edge_dense_feature(ring_graph):
+    src = np.array([1], dtype=np.uint64)
+    dst = np.array([2], dtype=np.uint64)
+    t = np.array([0], dtype=np.int32)
+    f = ring_graph.get_edge_dense_feature(src, dst, t, "e_dense")
+    assert f.shape == (1, 2)
+    assert f[0][0] == pytest.approx(1.0)
+    assert f[0][1] == pytest.approx(-1.0)
+
+
+def test_random_walk_plain(ring_graph):
+    seed(21)
+    walks = ring_graph.random_walk([1, 2], 4)
+    assert walks.shape == (2, 5)
+    assert walks[0, 0] == 1
+    # every step is a real neighbor of the previous
+    for r in range(2):
+        for s in range(4):
+            off, ids, _, _ = ring_graph.get_full_neighbor([walks[r, s]])
+            assert walks[r, s + 1] in set(ids)
+
+
+def test_random_walk_biased(ring_graph):
+    seed(22)
+    walks = ring_graph.random_walk([1] * 50, 3, p=0.25, q=4.0)
+    assert walks.shape == (50, 4)
+
+
+def test_layerwise(ring_graph):
+    seed(23)
+    layers = ring_graph.sample_layerwise([1, 2], [5, 7])
+    assert layers[0].shape == (5,)
+    assert layers[1].shape == (7,)
+    assert np.all(layers[0] > 0)
+
+
+def test_dump_load_roundtrip(ring_graph, tmp_path):
+    d = str(tmp_path / "g")
+    ring_graph.dump(d)
+    g2 = GraphEngine.load(d)
+    assert g2.node_count == ring_graph.node_count
+    assert g2.edge_count == ring_graph.edge_count
+    f1 = ring_graph.get_dense_feature([1, 2], "f_dense")
+    f2 = g2.get_dense_feature([1, 2], "f_dense")
+    np.testing.assert_array_equal(f1, f2)
+    o1 = ring_graph.get_full_neighbor([5], sorted_by_id=True)
+    o2 = g2.get_full_neighbor([5], sorted_by_id=True)
+    for a, b in zip(o1, o2):
+        np.testing.assert_array_equal(a, b)
+    # sparse + edge features survive
+    s1 = ring_graph.get_sparse_feature([3], "f_sparse")
+    s2 = g2.get_sparse_feature([3], "f_sparse")
+    np.testing.assert_array_equal(s1[1], s2[1])
+
+
+def test_sharded_load(ring_graph, tmp_path):
+    """Dump, then load as 1-of-1 shard (partition filter plumbing)."""
+    d = str(tmp_path / "g")
+    ring_graph.dump(d)
+    g_node_only = GraphEngine.load(d, data_type=1)
+    assert g_node_only.node_count == 10
+    assert g_node_only.edge_count == 0
+
+
+def test_deterministic_seeding(ring_graph):
+    seed(99)
+    a = ring_graph.sample_node(20)
+    seed(99)
+    b = ring_graph.sample_node(20)
+    np.testing.assert_array_equal(a, b)
