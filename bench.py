@@ -1,0 +1,127 @@
+"""Throughput benchmark: GraphSAGE training over an ogbn-products-shaped
+synthetic graph. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline (BASELINE.json): GraphSAGE on ogbn-products >= 1M edges/sec/chip.
+"edges/sec" counts message-passing edges aggregated per training step
+(sum over hops of batch * prod(fanouts[:h+1])), the standard sampled-GNN
+throughput accounting.
+
+Modes:
+  python bench.py            # full bench (sized for the real TPU chip)
+  python bench.py --smoke    # small/fast CPU sanity run
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_products_like(n_nodes: int, avg_degree: int, feat_dim: int,
+                        num_classes: int, seed: int = 0):
+    """Synthetic graph with ogbn-products-like statistics (power-lawish
+    degrees, class-correlated features)."""
+    from euler_tpu.dataset.base_dataset import synthetic_citation
+
+    data = synthetic_citation(
+        "bench", n=n_nodes, d=feat_dim, num_classes=num_classes,
+        intra_degree=avg_degree * 0.75, inter_degree=avg_degree * 0.25,
+        signal=1.0, seed=seed,
+        train_per_class=max(20, n_nodes // (num_classes * 10)),
+        val=n_nodes // 20, test=n_nodes // 10)
+    return data
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="small CPU run")
+    ap.add_argument("--nodes", type=int, default=0)
+    ap.add_argument("--batch_size", type=int, default=0)
+    ap.add_argument("--fanouts", default="")
+    ap.add_argument("--steps", type=int, default=0)
+    ap.add_argument("--feat_dim", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        n_nodes = args.nodes or 2000
+        batch = args.batch_size or 64
+        fanouts = [int(x) for x in args.fanouts.split(",")] if args.fanouts \
+            else [5, 5]
+        steps = args.steps or 20
+        feat_dim = args.feat_dim or 32
+        warmup = 3
+    else:
+        n_nodes = args.nodes or 200_000
+        batch = args.batch_size or 1024
+        fanouts = [int(x) for x in args.fanouts.split(",")] if args.fanouts \
+            else [15, 10]
+        steps = args.steps or 60
+        feat_dim = args.feat_dim or 100
+        warmup = 10
+
+    import jax
+
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.estimator.prefetch import Prefetcher
+    from euler_tpu.models import SupervisedGraphSage
+
+    num_classes = 16
+    data = build_products_like(n_nodes, 10, feat_dim, num_classes)
+    graph = data.engine
+
+    model = SupervisedGraphSage(
+        num_classes=num_classes, multilabel=False, dim=128,
+        fanouts=tuple(fanouts))
+    flow = FanoutDataFlow(graph, fanouts, feature_ids=["feature"])
+    est = NodeEstimator(
+        model,
+        dict(batch_size=batch, learning_rate=0.01, optimizer="adam",
+             label_dim=num_classes, log_steps=1 << 30, checkpoint_steps=0,
+             train_node_type=-1),
+        graph, flow, label_fid="label", label_dim=num_classes)
+
+    it = Prefetcher(est.train_input_fn(), depth=3)
+
+    # warmup (compile) then timed steps
+    est.train(iter([next(it) for _ in range(warmup)]), max_steps=warmup)
+    t0 = time.time()
+    res = est.train(it, max_steps=warmup + steps)
+    dt = time.time() - t0
+
+    edges_per_step = 0
+    m = batch
+    for k in fanouts:
+        m *= k
+        edges_per_step += m
+    steps_done = res["global_step"] - warmup
+    edges_per_sec = edges_per_step * steps_done / dt
+    n_chips = jax.device_count()
+    value = edges_per_sec / max(n_chips, 1)
+    print(json.dumps({
+        "metric": "graphsage_train_edges_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "edges/s/chip",
+        "vs_baseline": round(value / 1_000_000, 4),
+        "detail": {
+            "backend": jax.default_backend(),
+            "devices": n_chips,
+            "nodes": n_nodes,
+            "graph_edges": int(graph.edge_count),
+            "batch_size": batch,
+            "fanouts": fanouts,
+            "steps": steps_done,
+            "steps_per_sec": round(steps_done / dt, 2),
+            "final_loss": res["loss"],
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""Message-passing convolution zoo (parity: tf_euler/python/convolution/,
+14 layers — SURVEY.md §2.3)."""
+
+from euler_tpu.convolution.conv import Conv, aggregate, split_x  # noqa: F401
+from euler_tpu.convolution.agnn_conv import AGNNConv  # noqa: F401
+from euler_tpu.convolution.appnp_conv import APPNPConv  # noqa: F401
+from euler_tpu.convolution.arma_conv import ARMAConv  # noqa: F401
+from euler_tpu.convolution.dna_conv import DNAConv  # noqa: F401
+from euler_tpu.convolution.gat_conv import GATConv  # noqa: F401
+from euler_tpu.convolution.gated_graph_conv import GatedGraphConv  # noqa: F401
+from euler_tpu.convolution.gcn_conv import GCNConv  # noqa: F401
+from euler_tpu.convolution.gin_conv import GINConv  # noqa: F401
+from euler_tpu.convolution.graph_conv import GraphConv  # noqa: F401
+from euler_tpu.convolution.relation_conv import RelationConv  # noqa: F401
+from euler_tpu.convolution.sage_conv import SAGEConv  # noqa: F401
+from euler_tpu.convolution.sgcn_conv import SGCNConv  # noqa: F401
+from euler_tpu.convolution.tag_conv import TAGConv  # noqa: F401

@@ -1,0 +1,40 @@
+"""AGNN layer (Attention-based GNN). Parity: tf_euler/python/convolution/agnn_conv.py."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from euler_tpu.ops import mp_ops as mp
+from euler_tpu.convolution.conv import Array, XInput, split_x
+
+
+class AGNNConv(nn.Module):
+    """Propagation P where P_ij = softmax_j(β · cos(x_i, x_j)); β learned."""
+
+    requires_grad: bool = True
+
+    @nn.compact
+    def __call__(self, x: XInput, edge_index: Array,
+                 num_nodes: Optional[int] = None) -> Array:
+        x_src, x_tgt = split_x(x)
+        n = num_nodes if num_nodes is not None else x_tgt.shape[0]
+        if self.requires_grad:
+            beta = self.param("beta", nn.initializers.ones, (1,))
+        else:
+            beta = jnp.ones((1,))
+        norm_src = x_src / jnp.maximum(
+            jnp.linalg.norm(x_src, axis=-1, keepdims=True), 1e-12)
+        norm_tgt = x_tgt / jnp.maximum(
+            jnp.linalg.norm(x_tgt, axis=-1, keepdims=True), 1e-12)
+        src, dst = edge_index[0], edge_index[1]
+        # self-loops appended virtually (node attends to itself too)
+        cos = (norm_src[src] * norm_tgt[dst]).sum(-1)
+        self_cos = jnp.ones(n, dtype=cos.dtype)
+        logits = beta[0] * jnp.concatenate([cos, self_cos])
+        index = jnp.concatenate([dst, jnp.arange(n, dtype=dst.dtype)])
+        att = mp.scatter_softmax(logits, index, n)
+        msgs = jnp.concatenate([x_src[src], x_tgt[:n]], axis=0)
+        return mp.scatter_add(msgs * att[:, None], index, n)

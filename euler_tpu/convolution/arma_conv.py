@@ -1,0 +1,53 @@
+"""ARMA layer (auto-regressive moving-average graph filter).
+Parity: tf_euler/python/convolution/arma_conv.py."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from euler_tpu.ops import mp_ops as mp
+from euler_tpu.convolution.conv import Array, XInput, split_x
+
+
+class ARMAConv(nn.Module):
+    """K parallel ARMA_1 stacks of depth T, averaged:
+    z^{t+1} = σ(L̂ z^t W + x V).
+    """
+
+    out_dim: int
+    num_stacks: int = 1
+    num_layers: int = 1
+    dropout: float = 0.0
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x: XInput, edge_index: Array,
+                 num_nodes: Optional[int] = None) -> Array:
+        x_src, x_tgt = split_x(x)
+        if x_src is not x_tgt:
+            raise ValueError("ARMAConv requires a shared node set")
+        n = num_nodes if num_nodes is not None else x_src.shape[0]
+        src, dst = edge_index[0], edge_index[1]
+        ones = jnp.ones(src.shape[0], dtype=jnp.float32)
+        deg = jax.ops.segment_sum(ones, dst, num_segments=n) + 1.0
+        deg_s = jax.ops.segment_sum(ones, src, num_segments=n) + 1.0
+        norm = jax.lax.rsqrt(deg_s)[src] * jax.lax.rsqrt(deg)[dst]
+
+        def lap(z):
+            return mp.scatter_add(mp.gather(z, src) * norm[:, None], dst, n)
+
+        stacks = []
+        for s in range(self.num_stacks):
+            z = x_src
+            for t in range(self.num_layers):
+                root = nn.Dense(self.out_dim, use_bias=False,
+                                name=f"v_{s}_{t}")(x_src)
+                z = nn.Dense(self.out_dim, use_bias=True,
+                             name=f"w_{s}_{t}")(z)
+                z = nn.relu(lap(z) + root)
+            stacks.append(z)
+        return jnp.mean(jnp.stack(stacks, axis=0), axis=0)

@@ -1,0 +1,64 @@
+"""Base machinery for message-passing convolution layers.
+
+Parity: the reference's conv base (tf_euler/python/convolution/conv.py —
+gather by edge_index, message, scatter-aggregate, update), redesigned as
+flax.linen modules over XLA segment ops: under jit the gather/segment ops
+fuse with the surrounding matmuls, and autodiff supplies gradients (the
+reference registers TF gradients by hand in mp_ops.py:39-57).
+
+Conventions:
+  x           [N, D] node features, or (x_src, x_tgt) for bipartite blocks
+  edge_index  [2, E] int32; row 0 = message source, row 1 = destination
+  num_nodes   static destination count (required under jit)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from euler_tpu.ops import mp_ops as mp
+
+Array = jax.Array
+XInput = Union[Array, Tuple[Array, Array]]
+
+
+def split_x(x: XInput) -> Tuple[Array, Array]:
+    """Returns (x_src, x_tgt); a single array serves as both."""
+    if isinstance(x, tuple):
+        return x
+    return x, x
+
+
+def aggregate(msgs: Array, dst: Array, num_nodes: int, aggr: str) -> Array:
+    if aggr == "add" or aggr == "sum":
+        return mp.scatter_add(msgs, dst, num_nodes)
+    if aggr == "mean":
+        return mp.scatter_mean(msgs, dst, num_nodes)
+    if aggr == "max":
+        return mp.scatter_max(msgs, dst, num_nodes)
+    raise ValueError(f"unknown aggregation: {aggr}")
+
+
+class Conv(nn.Module):
+    """Generic message-passing layer: linear → propagate → update.
+
+    Subclasses override message()/update() semantics inline in __call__;
+    this base exists for user-defined layers and mirrors the reference's
+    Conv contract.
+    """
+
+    out_dim: int
+    aggr: str = "add"
+
+    @nn.compact
+    def __call__(self, x: XInput, edge_index: Array,
+                 num_nodes: Optional[int] = None) -> Array:
+        x_src, x_tgt = split_x(x)
+        n = num_nodes if num_nodes is not None else x_tgt.shape[0]
+        h = nn.Dense(self.out_dim, name="lin")(x_src)
+        msgs = mp.gather(h, edge_index[0])
+        return aggregate(msgs, edge_index[1], n, self.aggr)

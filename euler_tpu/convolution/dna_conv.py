@@ -1,0 +1,48 @@
+"""DNA layer (dynamic neighborhood aggregation, Fey 2019).
+Parity: tf_euler/python/convolution/dna_conv.py."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from euler_tpu.ops import mp_ops as mp
+from euler_tpu.convolution.conv import Array
+
+
+class DNAConv(nn.Module):
+    """Attention over the layer history of each neighbor:
+    h_i^{t+1} = Σ_j softmax_j(q(h_i^{≤t}) · k(h_j^{≤t})) v(h_j^{≤t}).
+
+    x here is the stacked history [N, T, D] (grows by one layer per call in
+    the model loop). Query is the node's latest layer; keys/values attend
+    over each neighbor's whole history via scaled dot-product.
+    """
+
+    out_dim: int
+    heads: int = 1
+
+    @nn.compact
+    def __call__(self, x: Array, edge_index: Array,
+                 num_nodes: Optional[int] = None) -> Array:
+        if x.ndim != 3:
+            raise ValueError("DNAConv expects stacked history [N, T, D]")
+        n = num_nodes if num_nodes is not None else x.shape[0]
+        N, T, D = x.shape
+        H = self.heads
+        dh = self.out_dim // H
+        q_w = nn.Dense(self.out_dim, use_bias=False, name="q")
+        k_w = nn.Dense(self.out_dim, use_bias=False, name="k")
+        v_w = nn.Dense(self.out_dim, use_bias=False, name="v")
+        src, dst = edge_index[0], edge_index[1]
+        # per-edge: query = dst's latest layer; key/value = src's history
+        q = q_w(x[:, -1, :]).reshape(N, H, dh)[dst]          # [E, H, dh]
+        k = k_w(x).reshape(N, T, H, dh)[src]                 # [E, T, H, dh]
+        v = v_w(x).reshape(N, T, H, dh)[src]
+        logits = (k * q[:, None]).sum(-1) / jnp.sqrt(float(dh))  # [E, T, H]
+        att = nn.softmax(logits, axis=1)
+        per_edge = (att[..., None] * v).sum(axis=1)          # [E, H, dh]
+        per_edge = per_edge.reshape(-1, self.out_dim)
+        return mp.scatter_mean(per_edge, dst, n)

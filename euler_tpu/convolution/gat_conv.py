@@ -1,0 +1,60 @@
+"""GAT layer (Veličković et al.). Parity: tf_euler/python/convolution/gat_conv.py."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from euler_tpu.ops import mp_ops as mp
+from euler_tpu.convolution.conv import Array, XInput, split_x
+
+
+class GATConv(nn.Module):
+    """Multi-head additive attention over edges + implicit self-loops.
+
+    heads are concatenated (concat=True) or averaged; per-edge softmax uses
+    the numerically-stable segment softmax from mp_ops.
+    """
+
+    out_dim: int
+    heads: int = 1
+    concat: bool = True
+    negative_slope: float = 0.2
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: XInput, edge_index: Array,
+                 num_nodes: Optional[int] = None) -> Array:
+        x_src, x_tgt = split_x(x)
+        n = num_nodes if num_nodes is not None else x_tgt.shape[0]
+        H, D = self.heads, self.out_dim
+        w = nn.Dense(H * D, use_bias=False, name="lin")
+        h_src = w(x_src).reshape(-1, H, D)
+        h_tgt = h_src if x_src is x_tgt else w(x_tgt).reshape(-1, H, D)
+        a_src = self.param("att_src", nn.initializers.glorot_uniform(), (1, H, D))
+        a_dst = self.param("att_dst", nn.initializers.glorot_uniform(), (1, H, D))
+        alpha_src = (h_src * a_src).sum(-1)  # [N_src, H]
+        alpha_dst = (h_tgt * a_dst).sum(-1)  # [N_tgt, H]
+        src, dst = edge_index[0], edge_index[1]
+        # self-loop edges appended virtually: compute edge logits for real
+        # edges and for each node's self edge, softmax over both.
+        e_alpha = alpha_src[src] + alpha_dst[dst]          # [E, H]
+        s_alpha = alpha_src[:n] + alpha_dst[:n] if x_src is x_tgt else (
+            alpha_dst[:n] * 2.0
+        )
+        e_alpha = nn.leaky_relu(e_alpha, self.negative_slope)
+        s_alpha = nn.leaky_relu(s_alpha, self.negative_slope)
+        # All heads at once: segment ops reduce along axis 0 of [E+n, H(,D)].
+        logits = jnp.concatenate([e_alpha, s_alpha], axis=0)       # [E+n, H]
+        index = jnp.concatenate([dst, jnp.arange(n, dtype=dst.dtype)])
+        att = mp.scatter_softmax(logits, index, n)                  # [E+n, H]
+        msgs = jnp.concatenate([h_src[src], h_tgt[:n]], axis=0)     # [E+n, H, D]
+        out = mp.scatter_add(msgs * att[:, :, None], index, n)      # [n, H, D]
+        out = out.reshape(n, H * D) if self.concat else out.mean(axis=1)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (H * D if self.concat else D,))
+            out = out + bias
+        return out

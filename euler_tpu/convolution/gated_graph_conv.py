@@ -1,0 +1,41 @@
+"""Gated Graph Conv (GGNN, Li et al.).
+Parity: tf_euler/python/convolution/gated_graph_conv.py."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from euler_tpu.ops import mp_ops as mp
+from euler_tpu.convolution.conv import Array, XInput, split_x
+
+
+class GatedGraphConv(nn.Module):
+    """h^{t+1} = GRU(Σ_j W_t h_j, h^t) for num_layers steps.
+
+    Input features are zero-padded to out_dim (reference pads likewise).
+    """
+
+    out_dim: int
+    num_layers: int = 1
+
+    @nn.compact
+    def __call__(self, x: XInput, edge_index: Array,
+                 num_nodes: Optional[int] = None) -> Array:
+        x_src, x_tgt = split_x(x)
+        if x_src is not x_tgt:
+            raise ValueError("GatedGraphConv requires a shared node set")
+        n = num_nodes if num_nodes is not None else x_src.shape[0]
+        d_in = x_src.shape[-1]
+        if d_in > self.out_dim:
+            raise ValueError("input dim must be <= out_dim")
+        h = jnp.pad(x_src, ((0, 0), (0, self.out_dim - d_in)))
+        gru = nn.GRUCell(features=self.out_dim, name="gru")
+        src, dst = edge_index[0], edge_index[1]
+        for t in range(self.num_layers):
+            m = nn.Dense(self.out_dim, use_bias=False, name=f"w_{t}")(h)
+            agg = mp.scatter_add(mp.gather(m, src), dst, n)
+            h, _ = gru(h, agg)
+        return h

@@ -1,0 +1,56 @@
+"""GCN layer (Kipf & Welling). Parity: tf_euler/python/convolution/gcn_conv.py."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from euler_tpu.ops import mp_ops as mp
+from euler_tpu.convolution.conv import Array, XInput, split_x
+
+
+class GCNConv(nn.Module):
+    """x' = D̂^{-1/2} Â D̂^{-1/2} x W with self-loops folded in.
+
+    Self-loops are applied implicitly (the node's own transformed feature
+    joins the sum with the proper norm) so edge_index never needs mutation —
+    shapes stay static under jit. On bipartite blocks (sampled fanouts) the
+    symmetric norm degenerates to 1/d̂_dst (row normalization), matching the
+    reference's sampled-subgraph behavior.
+    """
+
+    out_dim: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: XInput, edge_index: Array,
+                 num_nodes: Optional[int] = None) -> Array:
+        x_src, x_tgt = split_x(x)
+        bipartite = x_src is not x_tgt
+        n = num_nodes if num_nodes is not None else x_tgt.shape[0]
+        w = nn.Dense(self.out_dim, use_bias=False, name="lin")
+        h_src = w(x_src)
+        h_tgt = h_src if not bipartite else w(x_tgt)
+        src, dst = edge_index[0], edge_index[1]
+        ones = jnp.ones(src.shape[0], dtype=jnp.float32)
+        deg_dst = jax.ops.segment_sum(ones, dst, num_segments=n) + 1.0
+        inv_sqrt_dst = jax.lax.rsqrt(deg_dst)
+        if bipartite:
+            # row-normalized: 1/d̂_dst per incoming edge + self at 1/d̂_dst
+            norm = (1.0 / deg_dst)[dst]
+            self_norm = 1.0 / deg_dst
+        else:
+            deg_src = jax.ops.segment_sum(ones, src,
+                                          num_segments=x_src.shape[0]) + 1.0
+            norm = jax.lax.rsqrt(deg_src)[src] * inv_sqrt_dst[dst]
+            self_norm = 1.0 / deg_dst
+        msgs = mp.gather(h_src, src) * norm[:, None]
+        out = mp.scatter_add(msgs, dst, n)
+        out = out + h_tgt[:n] * self_norm[:, None]
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.out_dim,))
+            out = out + bias
+        return out

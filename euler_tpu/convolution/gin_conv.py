@@ -1,0 +1,34 @@
+"""GIN layer (graph isomorphism network). Parity: tf_euler/python/convolution/gin_conv.py."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+
+from euler_tpu.ops import mp_ops as mp
+from euler_tpu.convolution.conv import Array, XInput, split_x
+
+
+class GINConv(nn.Module):
+    """x' = MLP((1+ε) x + Σ_{j∈N(i)} x_j); ε learnable when train_eps."""
+
+    out_dim: int
+    hidden_dim: int = 0  # 0 → out_dim
+    eps: float = 0.0
+    train_eps: bool = False
+
+    @nn.compact
+    def __call__(self, x: XInput, edge_index: Array,
+                 num_nodes: Optional[int] = None) -> Array:
+        x_src, x_tgt = split_x(x)
+        n = num_nodes if num_nodes is not None else x_tgt.shape[0]
+        agg = mp.scatter_add(mp.gather(x_src, edge_index[0]), edge_index[1], n)
+        if self.train_eps:
+            eps = self.param("eps", nn.initializers.constant(self.eps), (1,))[0]
+        else:
+            eps = self.eps
+        h = (1.0 + eps) * x_tgt[:n] + agg
+        hidden = self.hidden_dim or self.out_dim
+        h = nn.relu(nn.Dense(hidden, name="mlp_0")(h))
+        return nn.Dense(self.out_dim, name="mlp_1")(h)

@@ -1,0 +1,34 @@
+"""GraphConv layer (Morris et al. weighted-sum variant).
+Parity: tf_euler/python/convolution/graph_conv.py."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+
+from euler_tpu.ops import mp_ops as mp
+from euler_tpu.convolution.conv import Array, XInput, aggregate, split_x
+
+
+class GraphConv(nn.Module):
+    """x' = W1 x + W2 · aggr_{j∈N(i)} w_ij x_j."""
+
+    out_dim: int
+    aggr: str = "add"
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: XInput, edge_index: Array,
+                 num_nodes: Optional[int] = None,
+                 edge_weight: Optional[Array] = None) -> Array:
+        x_src, x_tgt = split_x(x)
+        n = num_nodes if num_nodes is not None else x_tgt.shape[0]
+        msgs = mp.gather(x_src, edge_index[0])
+        if edge_weight is not None:
+            msgs = msgs * edge_weight[:, None]
+        agg = aggregate(msgs, edge_index[1], n, self.aggr)
+        return (
+            nn.Dense(self.out_dim, use_bias=self.use_bias, name="lin_root")(x_tgt[:n])
+            + nn.Dense(self.out_dim, use_bias=False, name="lin_nbr")(agg)
+        )

@@ -1,0 +1,34 @@
+"""GraphSAGE layer. Parity: tf_euler/python/convolution/sage_conv.py."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from euler_tpu.ops import mp_ops as mp
+from euler_tpu.convolution.conv import Array, XInput, split_x
+
+
+class SAGEConv(nn.Module):
+    """x' = W · concat(x_tgt, mean_{j∈N(i)} x_j), optional L2 normalize."""
+
+    out_dim: int
+    normalize: bool = False
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: XInput, edge_index: Array,
+                 num_nodes: Optional[int] = None) -> Array:
+        x_src, x_tgt = split_x(x)
+        n = num_nodes if num_nodes is not None else x_tgt.shape[0]
+        nbr = mp.scatter_mean(mp.gather(x_src, edge_index[0]), edge_index[1], n)
+        out = nn.Dense(self.out_dim, use_bias=self.use_bias, name="lin")(
+            jnp.concatenate([x_tgt[:n], nbr], axis=-1)
+        )
+        if self.normalize:
+            out = out / jnp.maximum(
+                jnp.linalg.norm(out, axis=-1, keepdims=True), 1e-12
+            )
+        return out

@@ -1,0 +1,46 @@
+"""TAGCN layer (topology-adaptive GCN). Parity: tf_euler/python/convolution/tag_conv.py."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from euler_tpu.ops import mp_ops as mp
+from euler_tpu.convolution.conv import Array, XInput, split_x
+
+
+class TAGConv(nn.Module):
+    """x' = Σ_{k=0..K} Â^k x W_k — per-power linear filters."""
+
+    out_dim: int
+    k_hop: int = 3
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: XInput, edge_index: Array,
+                 num_nodes: Optional[int] = None) -> Array:
+        x_src, x_tgt = split_x(x)
+        if x_src is not x_tgt:
+            raise ValueError("TAGConv requires a shared node set")
+        n = num_nodes if num_nodes is not None else x_src.shape[0]
+        src, dst = edge_index[0], edge_index[1]
+        ones = jnp.ones(src.shape[0], dtype=jnp.float32)
+        deg = jax.ops.segment_sum(ones, dst, num_segments=n) + 1.0
+        deg_s = jax.ops.segment_sum(ones, src, num_segments=n) + 1.0
+        norm = jax.lax.rsqrt(deg_s)[src] * jax.lax.rsqrt(deg)[dst]
+        self_norm = 1.0 / deg
+
+        z = x_src
+        out = nn.Dense(self.out_dim, use_bias=False, name="lin_0")(z)
+        for k in range(1, self.k_hop + 1):
+            agg = mp.scatter_add(mp.gather(z, src) * norm[:, None], dst, n)
+            z = agg + z * self_norm[:, None]
+            out = out + nn.Dense(self.out_dim, use_bias=False,
+                                 name=f"lin_{k}")(z)
+        if self.use_bias:
+            out = out + self.param("bias", nn.initializers.zeros,
+                                   (self.out_dim,))
+        return out

@@ -92,6 +92,7 @@ int etg_builder_set_feature(int64_t b, int is_edge, int fid, int kind,
                             int64_t dim, const char* name) {
   auto builder = GetBuilder(b);
   if (!builder) return Fail("bad builder handle");
+  if (fid < 0 || fid > 65535) return Fail("feature id out of range");
   auto* meta = builder->mutable_meta();
   auto& feats = is_edge ? meta->edge_features : meta->node_features;
   if (static_cast<size_t>(fid) >= feats.size()) feats.resize(fid + 1);

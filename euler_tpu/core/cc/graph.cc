@@ -67,6 +67,8 @@ void GraphBuilder::AddEdges(const NodeId* src, const NodeId* dst,
   }
 }
 
+// Feature setters silently ignore negative ids (logged once per call
+// site would be noise; the Python layer validates names → ids).
 std::vector<GraphBuilder::FeatCell>* GraphBuilder::NodeCells(int fid) {
   if (fid < 0) return nullptr;
   if (static_cast<size_t>(fid) >= node_feat_cells_.size()) {
@@ -93,6 +95,7 @@ void GraphBuilder::SetNodeDense(NodeId id, int fid, const float* v,
                                 int64_t dim) {
   uint32_t row = EnsureNode(id, 0, 1.0f, false);
   auto* cells = NodeCells(fid);
+  if (!cells) return;
   FeatCell c;
   c.row = row;
   c.f32.assign(v, v + dim);
@@ -106,6 +109,7 @@ void GraphBuilder::SetNodeSparse(NodeId id, int fid, const uint64_t* v,
                                  int64_t len) {
   uint32_t row = EnsureNode(id, 0, 1.0f, false);
   auto* cells = NodeCells(fid);
+  if (!cells) return;
   FeatCell c;
   c.row = row;
   c.u64.assign(v, v + len);
@@ -119,6 +123,7 @@ void GraphBuilder::SetNodeBinary(NodeId id, int fid, const char* v,
                                  int64_t len) {
   uint32_t row = EnsureNode(id, 0, 1.0f, false);
   auto* cells = NodeCells(fid);
+  if (!cells) return;
   FeatCell c;
   c.row = row;
   c.bytes.assign(v, v + len);
@@ -139,6 +144,7 @@ void GraphBuilder::SetEdgeDense(NodeId src, NodeId dst, int32_t type, int fid,
   int64_t row = FindEdgeRow(src, dst, type);
   if (row < 0) return;
   auto* cells = EdgeCells(fid);
+  if (!cells) return;
   FeatCell c;
   c.row = static_cast<uint64_t>(row);
   c.f32.assign(v, v + dim);
@@ -153,6 +159,7 @@ void GraphBuilder::SetEdgeSparse(NodeId src, NodeId dst, int32_t type,
   int64_t row = FindEdgeRow(src, dst, type);
   if (row < 0) return;
   auto* cells = EdgeCells(fid);
+  if (!cells) return;
   FeatCell c;
   c.row = static_cast<uint64_t>(row);
   c.u64.assign(v, v + len);
@@ -167,6 +174,7 @@ void GraphBuilder::SetEdgeBinary(NodeId src, NodeId dst, int32_t type,
   int64_t row = FindEdgeRow(src, dst, type);
   if (row < 0) return;
   auto* cells = EdgeCells(fid);
+  if (!cells) return;
   FeatCell c;
   c.row = static_cast<uint64_t>(row);
   c.bytes.assign(v, v + len);
@@ -203,8 +211,14 @@ std::unique_ptr<Graph> GraphBuilder::Finalize(bool build_in_adjacency) {
   auto g = std::unique_ptr<Graph>(new Graph());
   const size_t N = nodes_.size();
   const size_t E = edges_.size();
-  const int ET = std::max(meta_.num_edge_types, 1);
-  const int NT = std::max(meta_.num_node_types, 1);
+  // Derive type counts from observed data too: meta may have been shrunk
+  // by set_num_types after rows were added, and trusting it would index
+  // group buffers out of bounds.
+  int max_et = 0, max_nt = 0;
+  for (const EdgeRow& er : edges_) max_et = std::max(max_et, er.type + 1);
+  for (const NodeRow& nr : nodes_) max_nt = std::max(max_nt, nr.type + 1);
+  const int ET = std::max({meta_.num_edge_types, max_et, 1});
+  const int NT = std::max({meta_.num_node_types, max_nt, 1});
   meta_.num_edge_types = ET;
   meta_.num_node_types = NT;
   meta_.node_count = N;
@@ -555,26 +569,57 @@ void Graph::SampleNeighbor(NodeId id, const int32_t* edge_types,
                            size_t n_types, size_t count, NodeId default_id,
                            Pcg32* rng, NodeId* out_ids, float* out_w,
                            int32_t* out_t) const {
+  // Hot path (every fanout hop): gather the candidate groups ONCE per
+  // node, then draw `count` samples — O(ET + count·log(deg)) instead of
+  // re-walking groups and upper_bound'ing the global offsets per sample.
   uint32_t idx = NodeIndex(id);
   const int ET = meta_.num_edge_types;
+  GroupScratch& s = TlsGroupScratch();
+  s.clear();
+  float grand = 0.f;
+  if (idx != kInvalidIndex) {
+    auto consider = [&](int et) {
+      if (et < 0 || et >= ET) return;
+      size_t b, e;
+      GroupRange(idx, et, &b, &e);
+      if (e <= b) return;
+      float t = adj_cumw_[e - 1];
+      if (t <= 0.f) return;
+      s.totals.push_back(t);
+      s.begins.push_back(b);
+      s.ends.push_back(e);
+      s.types.push_back(et);
+      grand += t;
+    };
+    if (edge_types == nullptr || n_types == 0) {
+      for (int et = 0; et < ET; ++et) consider(et);
+    } else {
+      for (size_t i = 0; i < n_types; ++i) consider(edge_types[i]);
+    }
+  }
+  size_t ng = s.totals.size();
   for (size_t i = 0; i < count; ++i) {
-    uint64_t slot = idx == kInvalidIndex
-                        ? kNoSlot
-                        : SampleAdjSlot(idx, edge_types, n_types, rng);
-    if (slot == kNoSlot) {
+    if (ng == 0 || grand <= 0.f) {
       out_ids[i] = default_id;
       if (out_w) out_w[i] = 0.f;
       if (out_t) out_t[i] = -1;
-    } else {
-      out_ids[i] = adj_nbr_[slot];
-      if (out_w) out_w[i] = adj_w_[slot];
-      if (out_t) {
-        auto it =
-            std::upper_bound(adj_offsets_.begin(), adj_offsets_.end(), slot);
-        size_t gi = static_cast<size_t>(it - adj_offsets_.begin()) - 1;
-        out_t[i] = static_cast<int32_t>(gi % ET);
-      }
+      continue;
     }
+    size_t gsel = 0;
+    if (ng > 1) {
+      float r = rng->NextFloat() * grand;
+      float run = 0.f;
+      for (; gsel < ng; ++gsel) {
+        run += s.totals[gsel];
+        if (r < run) break;
+      }
+      if (gsel >= ng) gsel = ng - 1;
+    }
+    size_t slot = SampleFromCumulative(adj_cumw_.data(), s.begins[gsel],
+                                       s.ends[gsel], rng);
+    out_ids[i] = adj_nbr_[slot];
+    if (out_w) out_w[i] = adj_w_[slot];
+    if (out_t) out_t[i] = s.types[gsel];
   }
 }
 

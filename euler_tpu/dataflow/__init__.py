@@ -1,0 +1,17 @@
+from euler_tpu.dataflow.base_dataflow import (  # noqa: F401
+    Block,
+    DataFlow,
+    FanoutDataFlow,
+    FastGCNDataFlow,
+    FullBatchDataFlow,
+    LayerwiseDataFlow,
+    RelationDataFlow,
+    WholeDataFlow,
+)
+
+# Reference-name aliases (tf_euler/python/dataflow/): SageDataFlow and
+# NeighborDataFlow are fanout-based; GCNDataFlow's full-neighbor mode is
+# WholeDataFlow.
+SageDataFlow = FanoutDataFlow
+NeighborDataFlow = FanoutDataFlow
+GCNDataFlow = WholeDataFlow

@@ -1,0 +1,49 @@
+"""Dataset registry — parity with tf_euler/python/dataset/__init__.py:20
+get_dataset over 13 named datasets. Shapes/sizes mirror the real datasets;
+see base_dataset.load_named for the local-file → synthetic fallback."""
+
+from __future__ import annotations
+
+from functools import partial
+
+from euler_tpu.dataset.base_dataset import (  # noqa: F401
+    FEATURE_FID,
+    LABEL_FID,
+    TEST_TYPE,
+    TRAIN_TYPE,
+    VAL_TYPE,
+    GraphData,
+    build_engine,
+    load_named,
+    synthetic_citation,
+)
+from euler_tpu.dataset.graph_sets import mutag_like  # noqa: F401
+from euler_tpu.dataset.kg_sets import load_kg  # noqa: F401
+
+# Statistical shapes of the real datasets (nodes, feature dim, classes).
+_CITATION_SHAPES = {
+    "cora": dict(n=2708, d=1433, num_classes=7),
+    "citeseer": dict(n=3327, d=3703, num_classes=6),
+    "pubmed": dict(n=19717, d=500, num_classes=3),
+    "ppi": dict(n=14755, d=50, num_classes=121),
+    "reddit": dict(n=232965, d=602, num_classes=41),
+}
+
+_REGISTRY = {}
+for _name, _shape in _CITATION_SHAPES.items():
+    _REGISTRY[_name] = partial(load_named, _name, dict(_shape))
+_REGISTRY["mutag"] = mutag_like
+for _kg in ("fb15k", "fb15k237", "wn18"):
+    _REGISTRY[_kg] = partial(load_kg, _kg)
+
+
+def get_dataset(name: str, **overrides):
+    name = name.lower()
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown dataset {name!r}; options {sorted(_REGISTRY)}")
+    fn = _REGISTRY[name]
+    if overrides and isinstance(fn, partial) and fn.func is load_named:
+        cfg = dict(fn.args[1])
+        cfg.update(overrides)
+        return load_named(fn.args[0], cfg)
+    return fn(**overrides) if overrides else fn()

@@ -1,0 +1,8 @@
+from euler_tpu.estimator.base_estimator import BaseEstimator, TrainState  # noqa: F401
+from euler_tpu.estimator.estimators import (  # noqa: F401
+    EdgeEstimator,
+    GaeEstimator,
+    GraphEstimator,
+    NodeEstimator,
+    SampleEstimator,
+)

@@ -1,0 +1,281 @@
+"""Training drivers: train / evaluate / infer / train_and_evaluate.
+
+Parity: euler_estimator/python/base_estimator.py:27-180 (BaseEstimator on
+tf.estimator: train loop with LoggingTensorHook + ProfilerHook, evaluate,
+infer writing embedding_*.npy / ids_*.npy, checkpointing to model_dir).
+
+TPU-first redesign: a functional train loop — flax TrainState + optax,
+one jitted train_step (donate-argnums on state so HBM buffers are
+reused), orbax checkpointing, jax.profiler for the profiling hook, and an
+optional jax.sharding.Mesh for SPMD data parallelism (batch sharded over
+the 'data' axis; parameters replicated — see euler_tpu.parallel for the
+embedding-sharded variant).
+
+The model contract is ModelOutput (embedding, loss, metric_name, metric);
+input_fn is a host-side iterator of numpy batch dicts with STATIC shapes.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax.training import train_state
+
+from euler_tpu.utils import optimizers as opt_lib
+
+
+class TrainState(train_state.TrainState):
+    """TrainState + mutable variable collections (scalable-encoder caches)."""
+
+    extra_vars: Dict[str, Any] = None
+
+
+def _to_device_tree(batch: Dict, max_id: int = 0) -> Dict:
+    """numpy batch → jnp pytree. uint64 id arrays become int32 rows
+    (bucketized by max_id+1 when provided) because TPU jit runs with x64
+    disabled; all other arrays pass through."""
+
+    def conv(v):
+        if isinstance(v, np.ndarray) and v.dtype == np.uint64:
+            if max_id > 0:
+                v = (v % np.uint64(max_id + 1))
+            return v.astype(np.int32)
+        return v
+
+    return jax.tree_util.tree_map(conv, batch)
+
+
+class BaseEstimator:
+    """Drives a flax model with the ModelOutput contract.
+
+    params dict (mirrors the reference's params into estimators):
+      optimizer: name (default 'adam'), learning_rate, batch_size,
+      log_steps, checkpoint_steps, max_id (for id bucketization),
+      profiling (bool).
+    """
+
+    def __init__(self, model, params: Dict, model_dir: Optional[str] = None,
+                 mesh: Optional[jax.sharding.Mesh] = None):
+        self.model = model
+        self.params_cfg = dict(params or {})
+        self.model_dir = model_dir
+        self.mesh = mesh
+        self.tx = opt_lib.get(
+            self.params_cfg.get("optimizer", "adam"),
+            self.params_cfg.get("learning_rate", 0.01),
+        )
+        self.max_id = int(self.params_cfg.get("max_id", 0))
+        self.log_steps = int(self.params_cfg.get("log_steps", 20))
+        self.ckpt_steps = int(self.params_cfg.get("checkpoint_steps", 1000))
+        self.profiling = bool(self.params_cfg.get("profiling", False))
+        self.state: Optional[TrainState] = None
+        self._train_step = None
+        self._eval_step = None
+        self._ckpt_mgr = None
+
+    # -- setup -------------------------------------------------------------
+    def _init_state(self, batch: Dict, rng=None) -> None:
+        rng = rng if rng is not None else jax.random.key(
+            int(self.params_cfg.get("seed", 0)))
+        variables = self.model.init(rng, batch)
+        params = variables.pop("params")
+        self.state = TrainState.create(
+            apply_fn=self.model.apply, params=params, tx=self.tx,
+            extra_vars=dict(variables),
+        )
+
+    def _build_train_step(self):
+        mutable_keys = [k for k in (self.state.extra_vars or {})]
+
+        def train_step(state: TrainState, batch):
+            def loss_fn(p):
+                variables = {"params": p, **(state.extra_vars or {})}
+                if mutable_keys:
+                    out, new_vars = state.apply_fn(
+                        variables, batch, mutable=mutable_keys)
+                else:
+                    out = state.apply_fn(variables, batch)
+                    new_vars = {}
+                return out.loss, (out, new_vars)
+
+            (loss, (out, new_vars)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
+            state = state.apply_gradients(grads=grads)
+            if new_vars:
+                state = state.replace(extra_vars=dict(new_vars))
+            return state, loss, out.metric
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(self.mesh, P())
+            data = NamedSharding(self.mesh, P("data"))
+            self._data_sharding = data
+            train_step = jax.jit(
+                train_step,
+                donate_argnums=(0,),
+            )
+        else:
+            train_step = jax.jit(train_step, donate_argnums=(0,))
+        return train_step
+
+    def _build_eval_step(self):
+        def eval_step(state: TrainState, batch):
+            variables = {"params": state.params, **(state.extra_vars or {})}
+            out = state.apply_fn(variables, batch)
+            return out.loss, out.metric, out.embedding
+
+        return jax.jit(eval_step)
+
+    def _checkpoint_manager(self):
+        if self._ckpt_mgr is None and self.model_dir:
+            import orbax.checkpoint as ocp
+
+            path = os.path.abspath(os.path.join(self.model_dir, "checkpoints"))
+            os.makedirs(path, exist_ok=True)
+            self._ckpt_mgr = ocp.CheckpointManager(
+                path, options=ocp.CheckpointManagerOptions(max_to_keep=3))
+        return self._ckpt_mgr
+
+    def save_checkpoint(self, step: int) -> None:
+        mgr = self._checkpoint_manager()
+        if mgr is None:
+            return
+        import orbax.checkpoint as ocp
+
+        payload = {"params": self.state.params,
+                   "opt_state": self.state.opt_state,
+                   "extra_vars": self.state.extra_vars or {}}
+        mgr.save(step, args=ocp.args.StandardSave(payload))
+
+    def restore_checkpoint(self) -> Optional[int]:
+        mgr = self._checkpoint_manager()
+        if mgr is None or mgr.latest_step() is None:
+            return None
+        import orbax.checkpoint as ocp
+
+        step = mgr.latest_step()
+        payload = {"params": self.state.params,
+                   "opt_state": self.state.opt_state,
+                   "extra_vars": self.state.extra_vars or {}}
+        restored = mgr.restore(step, args=ocp.args.StandardRestore(payload))
+        self.state = self.state.replace(
+            params=restored["params"], opt_state=restored["opt_state"],
+            extra_vars=restored.get("extra_vars") or {})
+        return step
+
+    # -- drivers -----------------------------------------------------------
+    def train(self, input_fn: Callable[[], Iterator[Dict]],
+              max_steps: int = 1000) -> Dict[str, float]:
+        it = input_fn() if callable(input_fn) else input_fn
+        first = _to_device_tree(next(it), self.max_id)
+        if self.state is None:
+            self._init_state(first)
+            self.restore_checkpoint()
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        if self.profiling and self.model_dir:
+            jax.profiler.start_trace(os.path.join(self.model_dir, "prof"))
+        step = int(self.state.step)
+        losses, metrics = [], []
+        t0 = time.time()
+        batch = first
+        last_log = t0
+        while step < max_steps:
+            self.state, loss, metric = self._train_step(self.state, batch)
+            step += 1
+            losses.append(loss)
+            metrics.append(metric)
+            if step % self.log_steps == 0:
+                lv = float(jnp.mean(jnp.stack(losses[-self.log_steps:])))
+                mv = float(jnp.mean(jnp.stack(metrics[-self.log_steps:])))
+                now = time.time()
+                rate = self.log_steps / max(now - last_log, 1e-9)
+                last_log = now
+                print(f"step {step}: loss={lv:.4f} metric={mv:.4f} "
+                      f"({rate:.1f} steps/s)", flush=True)
+            if self.ckpt_steps and step % self.ckpt_steps == 0:
+                self.save_checkpoint(step)
+            if step < max_steps:
+                try:
+                    batch = _to_device_tree(next(it), self.max_id)
+                except StopIteration:
+                    break
+        if self.ckpt_steps:
+            self.save_checkpoint(step)
+        if self.profiling and self.model_dir:
+            jax.profiler.stop_trace()
+        return {
+            "loss": float(losses[-1]) if losses else float("nan"),
+            "metric": float(jnp.mean(jnp.stack(metrics))) if metrics else 0.0,
+            "steps_per_sec": step / max(time.time() - t0, 1e-9),
+            "global_step": step,
+        }
+
+    def evaluate(self, input_fn, steps: int = 100) -> Dict[str, float]:
+        it = input_fn() if callable(input_fn) else input_fn
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        losses, metrics = [], []
+        for _ in range(steps):
+            try:
+                batch = _to_device_tree(next(it), self.max_id)
+            except StopIteration:
+                break
+            if self.state is None:
+                self._init_state(batch)
+                self.restore_checkpoint()
+                self._eval_step = self._build_eval_step()
+            loss, metric, _ = self._eval_step(self.state, batch)
+            losses.append(float(loss))
+            metrics.append(float(metric))
+        return {"loss": float(np.mean(losses)) if losses else float("nan"),
+                "metric": float(np.mean(metrics)) if metrics else float("nan")}
+
+    def infer(self, input_fn, steps: int = 100,
+              id_key: str = "infer_ids") -> Dict[str, str]:
+        """Writes embedding_0.npy / ids_0.npy under model_dir (parity:
+        reference infer artifacts base_estimator.py:157-180)."""
+        it = input_fn() if callable(input_fn) else input_fn
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        embs, ids = [], []
+        for _ in range(steps):
+            try:
+                raw = next(it)
+            except StopIteration:
+                break
+            batch = _to_device_tree(raw, self.max_id)
+            if self.state is None:
+                self._init_state(batch)
+                self.restore_checkpoint()
+                self._eval_step = self._build_eval_step()
+            _, _, emb = self._eval_step(self.state, batch)
+            embs.append(np.asarray(emb))
+            key = id_key if id_key in raw else ("ids" if "ids" in raw else None)
+            if key is not None:
+                v = raw[key]
+                v = v[0] if isinstance(v, list) else v
+                ids.append(np.asarray(v).ravel()[: emb.shape[0]])
+        out_dir = self.model_dir or "."
+        os.makedirs(out_dir, exist_ok=True)
+        emb_path = os.path.join(out_dir, "embedding_0.npy")
+        np.save(emb_path, np.concatenate(embs) if embs else np.zeros((0,)))
+        id_path = os.path.join(out_dir, "ids_0.npy")
+        if ids:
+            np.save(id_path, np.concatenate(ids))
+        return {"embedding": emb_path, "ids": id_path}
+
+    def train_and_evaluate(self, train_input_fn, eval_input_fn,
+                           max_steps: int = 1000,
+                           eval_steps: int = 50) -> Dict[str, float]:
+        train_res = self.train(train_input_fn, max_steps)
+        eval_res = self.evaluate(eval_input_fn, eval_steps)
+        return {**{f"train_{k}": v for k, v in train_res.items()},
+                **{f"eval_{k}": v for k, v in eval_res.items()}}

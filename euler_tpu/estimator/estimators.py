@@ -1,0 +1,243 @@
+"""Concrete estimators: Node / Edge / Graph / Gae / Sample.
+
+Parity: euler_estimator/python/{node,edge,graph,gae,sample}_estimator.py —
+each wires an input_fn (root sampling from the graph engine) to the
+BaseEstimator loop. Splits follow the reference dataset convention: node
+type encodes the split (train/val/test), labels live in a dense feature.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from euler_tpu.estimator.base_estimator import BaseEstimator
+from euler_tpu.graph import GraphEngine
+
+
+class NodeEstimator(BaseEstimator):
+    """Supervised node classification (reference node_estimator.py:31-50)."""
+
+    def __init__(self, model, params: Dict, graph: GraphEngine, dataflow,
+                 label_fid="label", label_dim: Optional[int] = None,
+                 model_dir=None, mesh=None):
+        super().__init__(model, params, model_dir, mesh)
+        self.graph = graph
+        self.dataflow = dataflow
+        self.label_fid = label_fid
+        self.label_dim = label_dim
+        self.batch_size = int(params.get("batch_size", 32))
+        self.train_node_type = int(params.get("train_node_type", 0))
+        self.eval_node_type = int(params.get("eval_node_type", 1))
+        self.infer_node_type = int(params.get("infer_node_type", -1))
+
+    def _batches(self, node_type: int) -> Iterator[Dict]:
+        while True:
+            roots = self.graph.sample_node(self.batch_size, node_type)
+            batch = self.dataflow(roots)
+            labels = self.graph.get_dense_feature(
+                roots, self.label_fid,
+                self.label_dim if self.label_dim else None)
+            batch["labels"] = labels
+            batch["infer_ids"] = roots
+            yield batch
+
+    def train_input_fn(self):
+        return self._batches(self.train_node_type)
+
+    def eval_input_fn(self):
+        return self._batches(self.eval_node_type)
+
+    def infer_input_fn(self):
+        """Deterministic sweep over all nodes (padded final batch)."""
+        ids = self.graph.all_node_ids()
+        if self.infer_node_type >= 0:
+            ids = ids[self.graph.get_node_type(ids) == self.infer_node_type]
+
+        def gen():
+            for i in range(0, len(ids), self.batch_size):
+                chunk = ids[i:i + self.batch_size]
+                if len(chunk) < self.batch_size:
+                    chunk = np.concatenate([
+                        chunk,
+                        np.full(self.batch_size - len(chunk), chunk[-1],
+                                np.uint64)])
+                batch = self.dataflow(chunk)
+                batch["labels"] = self.graph.get_dense_feature(
+                    chunk, self.label_fid,
+                    self.label_dim if self.label_dim else None)
+                batch["infer_ids"] = chunk
+                yield batch
+
+        return gen()
+
+
+class EdgeEstimator(BaseEstimator):
+    """Unsupervised link-based training (reference edge_estimator.py):
+    positive edges sampled from the graph; negatives sampled globally."""
+
+    def __init__(self, model, params: Dict, graph: GraphEngine,
+                 dataflow=None, model_dir=None, mesh=None):
+        super().__init__(model, params, model_dir, mesh)
+        self.graph = graph
+        self.dataflow = dataflow
+        self.batch_size = int(params.get("batch_size", 32))
+        self.num_negs = int(params.get("num_negs", 5))
+        self.edge_type = int(params.get("train_edge_type", -1))
+        self.neg_node_type = int(params.get("neg_node_type", -1))
+
+    def _batches(self) -> Iterator[Dict]:
+        while True:
+            src, dst, _ = self.graph.sample_edge(self.batch_size,
+                                                 self.edge_type)
+            negs = self.graph.sample_node(
+                self.batch_size * self.num_negs, self.neg_node_type
+            ).reshape(self.batch_size, self.num_negs)
+            batch = self.dataflow(src) if self.dataflow else {}
+            batch.update({"ids": src if self.dataflow is None else batch.get("ids", src),
+                          "src": src, "pos": dst, "negs": negs,
+                          "infer_ids": src})
+            yield batch
+
+    def train_input_fn(self):
+        return self._batches()
+
+    def eval_input_fn(self):
+        return self._batches()
+
+
+class GraphEstimator(BaseEstimator):
+    """Whole-graph classification batches (reference graph_estimator.py):
+    each step packs `num_graphs` small graphs into one node table."""
+
+    def __init__(self, model, params: Dict, graphs, labels,
+                 model_dir=None, mesh=None):
+        """graphs: list of dicts {x [n,D], edge_index [2,e]}; labels [G]."""
+        super().__init__(model, params, model_dir, mesh)
+        self.graphs = graphs
+        self.labels = np.asarray(labels)
+        self.num_graphs = int(params.get("num_graphs", 16))
+        self.max_nodes = int(params.get("max_nodes", 0)) or max(
+            g["x"].shape[0] for g in graphs) * self.num_graphs
+        self.max_edges = int(params.get("max_edges", 0)) or max(
+            g["edge_index"].shape[1] for g in graphs) * self.num_graphs
+        self.rng = np.random.default_rng(int(params.get("seed", 0)))
+
+    def _pack(self, idxs) -> Dict:
+        xs, eis, gi, labels = [], [], [], []
+        offset = 0
+        for slot, gidx in enumerate(idxs):
+            g = self.graphs[gidx]
+            n = g["x"].shape[0]
+            xs.append(g["x"])
+            eis.append(g["edge_index"] + offset)
+            gi.append(np.full(n, slot, np.int32))
+            labels.append(self.labels[gidx])
+            offset += n
+        x = np.concatenate(xs).astype(np.float32)
+        ei = np.concatenate(eis, axis=1).astype(np.int32)
+        gi = np.concatenate(gi)
+        mask = np.ones(len(idxs), np.float32)
+        # pad to static shapes: dummy nodes attach to an extra sink row
+        n_pad = self.max_nodes - x.shape[0]
+        e_pad = self.max_edges - ei.shape[1]
+        if n_pad > 0:
+            x = np.concatenate([x, np.zeros((n_pad, x.shape[1]), np.float32)])
+            gi = np.concatenate([gi, np.full(n_pad, len(idxs) - 1, np.int32)])
+        if e_pad > 0:
+            sink = self.max_nodes - 1
+            ei = np.concatenate(
+                [ei, np.full((2, e_pad), sink, np.int32)], axis=1)
+        return {"x": x, "edge_index": ei, "graph_index": gi,
+                "labels": np.asarray(labels), "graph_mask": mask}
+
+    def _batches(self, idx_pool) -> Iterator[Dict]:
+        while True:
+            idxs = self.rng.choice(idx_pool, self.num_graphs, replace=True)
+            yield self._pack(idxs)
+
+    def train_input_fn(self):
+        split = self.params_cfg.get("train_indices")
+        pool = np.asarray(split) if split is not None else np.arange(
+            len(self.graphs))
+        return self._batches(pool)
+
+    def eval_input_fn(self):
+        split = self.params_cfg.get("eval_indices")
+        pool = np.asarray(split) if split is not None else np.arange(
+            len(self.graphs))
+        return self._batches(pool)
+
+
+class GaeEstimator(BaseEstimator):
+    """Graph auto-encoder batches (reference gae_estimator.py): node-table
+    closure + positive edges + sampled negative pairs."""
+
+    def __init__(self, model, params: Dict, graph: GraphEngine, dataflow,
+                 model_dir=None, mesh=None):
+        super().__init__(model, params, model_dir, mesh)
+        self.graph = graph
+        self.dataflow = dataflow
+        self.batch_size = int(params.get("batch_size", 32))
+        self.num_pos = int(params.get("num_pos", 64))
+        self.rng = np.random.default_rng(int(params.get("seed", 0)))
+
+    def _batches(self) -> Iterator[Dict]:
+        while True:
+            roots = self.graph.sample_node(self.batch_size, -1)
+            batch = self.dataflow(roots)
+            nodes = batch["nodes"]
+            src, dst, _ = self.graph.sample_edge(self.num_pos, -1)
+            # map edge endpoints into the node table where present; edges
+            # whose endpoints fell outside the closure map to row 0 (noise
+            # at a bounded rate — acceptable for reconstruction training)
+            pos_src = np.searchsorted(nodes, src).clip(0, len(nodes) - 1)
+            pos_dst = np.searchsorted(nodes, dst).clip(0, len(nodes) - 1)
+            neg_src = self.rng.integers(0, batch["n_real_nodes"], self.num_pos)
+            neg_dst = self.rng.integers(0, batch["n_real_nodes"], self.num_pos)
+            batch.update({
+                "pos_src": pos_src.astype(np.int32),
+                "pos_dst": pos_dst.astype(np.int32),
+                "neg_src": neg_src.astype(np.int32),
+                "neg_dst": neg_dst.astype(np.int32),
+                "infer_ids": roots,
+            })
+            yield batch
+
+    def train_input_fn(self):
+        return self._batches()
+
+    def eval_input_fn(self):
+        return self._batches()
+
+
+class SampleEstimator(BaseEstimator):
+    """Line-oriented sample files (reference sample_estimator.py:
+    TextLine inputs of "src dst label"-style records)."""
+
+    def __init__(self, model, params: Dict, sample_file: str, parse_fn,
+                 model_dir=None, mesh=None):
+        super().__init__(model, params, model_dir, mesh)
+        self.sample_file = sample_file
+        self.parse_fn = parse_fn
+        self.batch_size = int(params.get("batch_size", 32))
+
+    def _batches(self) -> Iterator[Dict]:
+        while True:
+            with open(self.sample_file) as f:
+                lines = []
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    lines.append(line)
+                    if len(lines) == self.batch_size:
+                        yield self.parse_fn(lines)
+                        lines = []
+
+    def train_input_fn(self):
+        return self._batches()
+
+    def eval_input_fn(self):
+        return self._batches()

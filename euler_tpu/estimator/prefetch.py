@@ -1,0 +1,42 @@
+"""Background batch prefetcher: overlaps host-side graph sampling with
+device compute (the role of the reference's async TF queue runners /
+one-RPC fanout amortization, SURVEY.md §7 hard part (b))."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+
+class Prefetcher:
+    """Wraps an iterator; a daemon thread keeps `depth` batches ready."""
+
+    _STOP = object()
+
+    def __init__(self, it: Iterator, depth: int = 2):
+        self._it = it
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._err = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            for item in self._it:
+                self._q.put(item)
+        except Exception as e:  # surfaced on next()
+            self._err = e
+        finally:
+            self._q.put(self._STOP)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._STOP:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item

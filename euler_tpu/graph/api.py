@@ -413,7 +413,8 @@ class GraphEngine:
         if edge_types is None:
             et_flat, et_offsets = None, None
         else:
-            if edge_types and isinstance(edge_types[0], (list, tuple, np.ndarray)):
+            if len(edge_types) > 0 and isinstance(
+                    edge_types[0], (list, tuple, np.ndarray)):
                 per_hop = [list(h) for h in edge_types]
                 if len(per_hop) != n_hops:
                     raise ValueError(

@@ -1,0 +1,7 @@
+from euler_tpu.graph_pool.base_pool import (  # noqa: F401
+    AttentionPool,
+    MaxPool,
+    MeanPool,
+    Set2SetPool,
+    SumPool,
+)

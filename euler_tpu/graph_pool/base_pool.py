@@ -1,0 +1,70 @@
+"""Graph-level readout pools.
+
+Parity: tf_euler/python/graph_pool/ (base_pool, attention_pool,
+set2set_pool). Inputs: node embeddings [N, D] + graph_index [N] mapping
+each node to its graph; num_graphs static.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from euler_tpu.ops import mp_ops as mp
+
+Array = jax.Array
+
+
+class SumPool(nn.Module):
+    @nn.compact
+    def __call__(self, x: Array, graph_index: Array, num_graphs: int) -> Array:
+        return mp.scatter_add(x, graph_index, num_graphs)
+
+
+class MeanPool(nn.Module):
+    @nn.compact
+    def __call__(self, x: Array, graph_index: Array, num_graphs: int) -> Array:
+        return mp.scatter_mean(x, graph_index, num_graphs)
+
+
+class MaxPool(nn.Module):
+    @nn.compact
+    def __call__(self, x: Array, graph_index: Array, num_graphs: int) -> Array:
+        return mp.scatter_max(x, graph_index, num_graphs)
+
+
+class AttentionPool(nn.Module):
+    """Gated attention readout (reference attention_pool.py):
+    Σ softmax(gate(x)) · proj(x) per graph."""
+
+    dim: int
+
+    @nn.compact
+    def __call__(self, x: Array, graph_index: Array, num_graphs: int) -> Array:
+        gate = nn.Dense(1, name="gate")(x)[:, 0]
+        att = mp.scatter_softmax(gate, graph_index, num_graphs)
+        h = nn.Dense(self.dim, name="proj")(x)
+        return mp.scatter_add(h * att[:, None], graph_index, num_graphs)
+
+
+class Set2SetPool(nn.Module):
+    """Set2Set readout (reference set2set_pool.py): LSTM-driven iterative
+    attention; processing_steps static → lax-friendly python loop."""
+
+    dim: int
+    processing_steps: int = 3
+
+    @nn.compact
+    def __call__(self, x: Array, graph_index: Array, num_graphs: int) -> Array:
+        cell = nn.OptimizedLSTMCell(features=self.dim)
+        h = nn.Dense(self.dim, name="proj")(x)            # [N, dim]
+        carry = cell.initialize_carry(jax.random.key(0), (num_graphs, 2 * self.dim))
+        q_star = jnp.zeros((num_graphs, 2 * self.dim))
+        for _ in range(self.processing_steps):
+            carry, q = cell(carry, q_star)                # q: [G, dim]
+            e = (h * q[graph_index]).sum(-1)              # [N]
+            a = mp.scatter_softmax(e, graph_index, num_graphs)
+            r = mp.scatter_add(h * a[:, None], graph_index, num_graphs)
+            q_star = jnp.concatenate([q, r], axis=-1)
+        return q_star

@@ -1,0 +1,15 @@
+from euler_tpu.models.dgi import DGI  # noqa: F401
+from euler_tpu.models.embedding_models import LINE, DeepWalk, Node2Vec  # noqa: F401
+from euler_tpu.models.graphsage import (  # noqa: F401
+    ScalableGraphSage,
+    ShardedSupervisedGraphSage,
+    SupervisedGraphSage,
+    UnsupervisedGraphSage,
+)
+from euler_tpu.models.kg_models import (  # noqa: F401
+    DistMult,
+    TransD,
+    TransE,
+    TransH,
+    TransR,
+)

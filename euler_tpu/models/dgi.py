@@ -1,0 +1,55 @@
+"""Deep Graph Infomax. Parity: examples/dgi.
+
+Encoder embeddings vs corrupted (feature-shuffled) embeddings scored
+against the graph summary by a bilinear discriminator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from euler_tpu.mp_utils.base import ModelOutput
+from euler_tpu.mp_utils.base_gnn import BaseGNNNet
+from euler_tpu.utils import metrics as M
+
+Array = jax.Array
+
+
+class DGI(nn.Module):
+    """batch: x/edge_index (+ x_corrupt: row-shuffled features, built by
+    the feeder)."""
+
+    conv_name: str = "gcn"
+    dim: int = 64
+    num_layers: int = 1
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
+        net = BaseGNNNet(self.conv_name, self.dim, self.num_layers,
+                         name="encoder")
+        sub = dict(batch)
+        sub.pop("root_index", None)
+        h_real = nn.sigmoid(net(sub))
+        sub_c = dict(sub)
+        sub_c["x"] = batch["x_corrupt"]
+        h_fake = nn.sigmoid(net(sub_c))
+        summary = nn.sigmoid(h_real.mean(axis=0))
+        w = self.param("disc", nn.initializers.glorot_uniform(),
+                       (self.dim, self.dim))
+        real_logit = h_real @ w @ summary
+        fake_logit = h_fake @ w @ summary
+        loss = (
+            optax.sigmoid_binary_cross_entropy(
+                real_logit, jnp.ones_like(real_logit)).mean()
+            + optax.sigmoid_binary_cross_entropy(
+                fake_logit, jnp.zeros_like(fake_logit)).mean()
+        )
+        scores = jnp.concatenate([real_logit, fake_logit])
+        labels = jnp.concatenate(
+            [jnp.ones_like(real_logit), jnp.zeros_like(fake_logit)])
+        return ModelOutput(h_real, loss, "auc", M.auc(scores, labels))

@@ -1,0 +1,78 @@
+"""Random-walk / proximity embedding models: DeepWalk, LINE, Node2Vec.
+
+Parity: examples/deepwalk, examples/line (skip-gram over walks; LINE
+first+second order proximity). Training batches come from
+walk_ops.random_walk + gen_pair (DeepWalk) or sample_edge (LINE).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from euler_tpu.mp_utils.base import ModelOutput
+from euler_tpu.utils import metrics as M
+from euler_tpu.utils.layers import Embedding
+
+Array = jax.Array
+
+
+class DeepWalk(nn.Module):
+    """Skip-gram with negative sampling. batch: src [B], pos [B], negs
+    [B, N] (pairs from gen_pair; negatives sampled globally)."""
+
+    max_id: int = 0
+    dim: int = 128
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
+        emb = Embedding(self.max_id + 1, self.dim, name="emb")
+        ctx = Embedding(self.max_id + 1, self.dim, name="ctx")
+        src = emb(batch["src"])                       # [B, D]
+        pos = ctx(batch["pos"])                       # [B, D]
+        negs = ctx(batch["negs"])                     # [B, N, D]
+        pos_logit = (src * pos).sum(-1, keepdims=True)
+        neg_logit = jnp.einsum("bd,bnd->bn", src, negs)
+        loss = (
+            optax.sigmoid_binary_cross_entropy(
+                pos_logit, jnp.ones_like(pos_logit)).mean()
+            + optax.sigmoid_binary_cross_entropy(
+                neg_logit, jnp.zeros_like(neg_logit)).mean()
+        )
+        scores = jnp.concatenate([pos_logit, neg_logit], axis=1)
+        return ModelOutput(src, loss, "mrr", M.mrr(scores))
+
+
+Node2Vec = DeepWalk  # same model; the walk's p/q bias differs (walk_ops)
+
+
+class LINE(nn.Module):
+    """LINE (1st/2nd order). batch: src [B], pos [B], negs [B, N].
+    order=1 shares one table; order=2 uses a context table."""
+
+    max_id: int = 0
+    dim: int = 128
+    order: int = 2
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
+        emb = Embedding(self.max_id + 1, self.dim, name="emb")
+        ctx = emb if self.order == 1 else Embedding(
+            self.max_id + 1, self.dim, name="ctx")
+        src = emb(batch["src"])
+        pos = ctx(batch["pos"])
+        negs = ctx(batch["negs"])
+        pos_logit = (src * pos).sum(-1, keepdims=True)
+        neg_logit = jnp.einsum("bd,bnd->bn", src, negs)
+        loss = (
+            optax.sigmoid_binary_cross_entropy(
+                pos_logit, jnp.ones_like(pos_logit)).mean()
+            + optax.sigmoid_binary_cross_entropy(
+                neg_logit, jnp.zeros_like(neg_logit)).mean()
+        )
+        scores = jnp.concatenate([pos_logit, neg_logit], axis=1)
+        return ModelOutput(src, loss, "mrr", M.mrr(scores))

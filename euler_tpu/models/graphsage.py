@@ -1,0 +1,81 @@
+"""GraphSAGE models — the framework's flagship (bench.py drives these).
+
+Parity: examples/graphsage (SupervisedGraphSage / UnsupervisedGraphSage /
+ScalableSage) over the dense fanout path (SURVEY.md §2.3 encoders).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import flax.linen as nn
+import jax
+
+from euler_tpu.mp_utils.base import SuperviseModel, UnsuperviseModel
+from euler_tpu.parallel.sharded_embedding import ShardedEmbedding
+from euler_tpu.utils.encoders import SageEncoder, ScalableSageEncoder, ShallowEncoder
+
+Array = jax.Array
+
+
+class SupervisedGraphSage(SuperviseModel):
+    """Fanout batch {'layers': [x0..xL]} → SageEncoder → logits."""
+
+    dim: int = 32
+    fanouts: Sequence[int] = (10, 10)
+    aggregator: str = "mean"
+
+    def embed(self, batch: Dict[str, Any]) -> Array:
+        return SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
+                           name="encoder")(batch["layers"])
+
+
+class UnsupervisedGraphSage(UnsuperviseModel):
+    """Fanout batch + pos/negs ids → sage embedding vs context table."""
+
+    fanouts: Sequence[int] = (10, 10)
+    aggregator: str = "mean"
+
+    def embed(self, batch: Dict[str, Any]) -> Array:
+        return SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
+                           concat=False, name="encoder")(batch["layers"])
+
+
+class ShardedSupervisedGraphSage(SuperviseModel):
+    """GraphSAGE with an id-embedding input sharded across the mesh's
+    'model' axis — the multi-chip flagship: feature = concat(sharded id
+    embedding, dense features). Exercises DP (batch) + embedding MP in one
+    step, the SURVEY §2.4 mapping."""
+
+    dim: int = 32
+    fanouts: Sequence[int] = (10, 10)
+    aggregator: str = "mean"
+    max_id: int = 0
+    id_dim: int = 16
+
+    def embed(self, batch: Dict[str, Any]) -> Array:
+        emb = ShardedEmbedding(self.max_id + 1, self.id_dim, name="id_emb")
+        layers = []
+        for ids, x in zip(batch["ids"], batch["layers"]):
+            e = emb(ids)
+            layers.append(jax.numpy.concatenate([x, e], axis=-1))
+        return SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
+                           name="encoder")(layers)
+
+
+class ScalableGraphSage(SuperviseModel):
+    """1-hop sampling + historical activation caches (reference
+    ScalableSageEncoder). Run with mutable=['cache']."""
+
+    dim: int = 32
+    num_layers: int = 2
+    max_id: int = 0
+
+    def embed(self, batch: Dict[str, Any]) -> Array:
+        enc = ScalableSageEncoder(self.dim, self.num_layers, self.max_id,
+                                  name="encoder")
+        ids = batch["ids"][0]
+        x = batch["layers"][0]
+        nbr_ids = batch["ids"][1].reshape(ids.shape[0], -1)
+        nbr_x = batch["layers"][1].reshape(ids.shape[0], -1, x.shape[-1])
+        return enc(ids, x, nbr_ids, nbr_x)

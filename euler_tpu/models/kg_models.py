@@ -1,0 +1,130 @@
+"""Knowledge-graph embedding models: TransE/H/R/D, DistMult, RGCN scorer.
+
+Parity: examples/TransX (TransE/TransH/TransR/TransD), examples/distmult,
+examples/rgcn. Batches: positive triples (h [B], r [B], t [B]) + corrupted
+entities (neg_t [B, N] and/or neg_h [B, N]); margin ranking loss; MRR/hits
+metrics over the candidate set.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from euler_tpu.mp_utils.base import ModelOutput
+from euler_tpu.utils import metrics as M
+from euler_tpu.utils.layers import Embedding
+
+Array = jax.Array
+
+
+class _KGBase(nn.Module):
+    """Shared: entity/relation tables, margin loss, rank metrics."""
+
+    num_entities: int = 0
+    num_relations: int = 0
+    dim: int = 64
+    margin: float = 1.0
+    norm_ord: int = 1
+
+    def build_tables(self) -> Dict[str, nn.Module]:
+        """Create this scorer's parameter modules ONCE (flax compact:
+        module instances must be created once and reused across calls)."""
+        return {"rel": Embedding(self.num_relations, self.dim, name="rel")}
+
+    def score(self, tables: Dict[str, nn.Module], h: Array, r_idx: Array,
+              t: Array, h_ids: Array, t_ids: Array) -> Array:
+        """Higher = more plausible. h/t: [..., D] entity embeddings;
+        r_idx/h_ids/t_ids: [...] index arrays (models needing extra
+        per-entity parameters look them up by id)."""
+        raise NotImplementedError
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
+        h_ids, t_ids, r = batch["h"], batch["t"], batch["r"]
+        neg_t_ids = batch["neg_t"]
+        ent = Embedding(self.num_entities, self.dim, name="ent")
+        tables = self.build_tables()
+        h = ent(h_ids)                                 # [B, D]
+        t = ent(t_ids)
+        neg_t = ent(neg_t_ids)                         # [B, N, D]
+        pos = self.score(tables, h, r, t, h_ids, t_ids)[:, None]
+        neg = self.score(tables, h[:, None, :], r[:, None], neg_t,
+                         h_ids[:, None], neg_t_ids)     # [B, N]
+        loss = jnp.maximum(0.0, self.margin - pos + neg).mean()
+        scores = jnp.concatenate([pos, neg], axis=1)
+        return ModelOutput(h, loss, "mrr", M.mrr(scores))
+
+
+class TransE(_KGBase):
+    """score = -||h + r - t||."""
+
+    def score(self, tables, h, r_idx, t, h_ids=None, t_ids=None):
+        r = tables["rel"](r_idx)
+        return -jnp.linalg.norm(h + r - t, ord=self.norm_ord, axis=-1)
+
+
+class TransH(_KGBase):
+    """Project h,t onto relation hyperplane (normal w_r) then translate."""
+
+    def build_tables(self):
+        return {"rel": Embedding(self.num_relations, self.dim, name="rel"),
+                "norm": Embedding(self.num_relations, self.dim, name="norm")}
+
+    def score(self, tables, h, r_idx, t, h_ids=None, t_ids=None):
+        r = tables["rel"](r_idx)
+        w = tables["norm"](r_idx)
+        w = w / jnp.maximum(jnp.linalg.norm(w, axis=-1, keepdims=True), 1e-12)
+        h_p = h - (h * w).sum(-1, keepdims=True) * w
+        t_p = t - (t * w).sum(-1, keepdims=True) * w
+        return -jnp.linalg.norm(h_p + r - t_p, ord=self.norm_ord, axis=-1)
+
+
+class TransR(_KGBase):
+    """Relation-specific projection matrix M_r."""
+
+    def build_tables(self):
+        return {"rel": Embedding(self.num_relations, self.dim, name="rel"),
+                "proj": Embedding(self.num_relations, self.dim * self.dim,
+                                  name="proj")}
+
+    def score(self, tables, h, r_idx, t, h_ids=None, t_ids=None):
+        r = tables["rel"](r_idx)
+        m = tables["proj"](r_idx)
+        m = m.reshape(*r_idx.shape, self.dim, self.dim)
+        h_p = jnp.einsum("...d,...de->...e", h, m)
+        t_p = jnp.einsum("...d,...de->...e", t, m)
+        return -jnp.linalg.norm(h_p + r - t_p, ord=self.norm_ord, axis=-1)
+
+
+class TransD(_KGBase):
+    """Dynamic rank-1 projection: h_p = h + (w_h·h) w_r (per entity and
+    relation projection vectors)."""
+
+    def build_tables(self):
+        return {"rel": Embedding(self.num_relations, self.dim, name="rel"),
+                "rel_p": Embedding(self.num_relations, self.dim,
+                                   name="rel_p"),
+                "ent_p": Embedding(self.num_entities, self.dim,
+                                   name="ent_p")}
+
+    def score(self, tables, h, r_idx, t, h_ids=None, t_ids=None):
+        r = tables["rel"](r_idx)
+        w_r = tables["rel_p"](r_idx)
+        ent_p = tables["ent_p"]
+        w_h = ent_p(h_ids)
+        w_t = ent_p(t_ids)
+        h_p = h + (w_h * h).sum(-1, keepdims=True) * w_r
+        t_p = t + (w_t * t).sum(-1, keepdims=True) * w_r
+        return -jnp.linalg.norm(h_p + r - t_p, ord=self.norm_ord, axis=-1)
+
+
+class DistMult(_KGBase):
+    """score = <h, r, t> trilinear."""
+
+    def score(self, tables, h, r_idx, t, h_ids=None, t_ids=None):
+        r = tables["rel"](r_idx)
+        return (h * r * t).sum(-1)

@@ -1,0 +1,9 @@
+from euler_tpu.mp_utils.base import (  # noqa: F401
+    ModelOutput,
+    SuperviseModel,
+    UnsuperviseModel,
+)
+from euler_tpu.mp_utils.base_gae import BaseGraphGAE  # noqa: F401
+from euler_tpu.mp_utils.base_gnn import BaseGNNNet, JKGNNNet, get_conv  # noqa: F401
+from euler_tpu.mp_utils.graph_gnn import GraphGNNNet, GraphModel  # noqa: F401
+from euler_tpu.mp_utils.group_gnn import GroupGNNNet, SharedGroupGNNNet  # noqa: F401

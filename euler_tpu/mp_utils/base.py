@@ -1,0 +1,108 @@
+"""Model contract: (embedding, loss, metric_name, metric).
+
+Parity: tf_euler/python/mp_utils/base.py:24-90 (SuperviseModel /
+UnsuperviseModel). Models are flax modules taking a batch dict (jnp
+arrays, already on device) and returning a ModelOutput; the estimator
+differentiates through .loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from euler_tpu.utils import metrics as M
+from euler_tpu.utils.layers import Embedding
+
+Array = jax.Array
+
+
+class ModelOutput(NamedTuple):
+    embedding: Array
+    loss: Array
+    metric_name: str
+    metric: Array
+
+
+class SuperviseModel(nn.Module):
+    """Supervised node classification: embed → dense logits → xent.
+
+    Subclasses define embed(batch) → [B, D]. multilabel chooses sigmoid
+    cross-entropy + micro-F1 (the reference's default for cora-style
+    multilabel targets, mp_utils/base.py:24-48), else softmax + accuracy.
+    """
+
+    num_classes: int = 0
+    multilabel: bool = True
+
+    def embed(self, batch: Dict[str, Any]) -> Array:
+        raise NotImplementedError
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
+        emb = self.embed(batch)
+        labels = batch["labels"]
+        logits = nn.Dense(self.num_classes, name="out")(emb)
+        if self.multilabel:
+            loss = optax.sigmoid_binary_cross_entropy(
+                logits, labels.astype(jnp.float32)).sum(-1).mean()
+            metric = M.micro_f1(jax.nn.sigmoid(logits), labels)
+            name = "f1"
+        else:
+            # labels arrive either as integer classes [B] or one-hot [B, C]
+            # (dense label features are stored one-hot)
+            if labels.ndim == logits.ndim:
+                loss = optax.softmax_cross_entropy(
+                    logits, labels.astype(jnp.float32)).mean()
+                int_labels = jnp.argmax(labels, axis=-1)
+            else:
+                int_labels = labels.astype(jnp.int32)
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, int_labels).mean()
+            metric = M.micro_f1(logits, int_labels)
+            name = "f1"
+        return ModelOutput(emb, loss, name, metric)
+
+
+class UnsuperviseModel(nn.Module):
+    """Unsupervised embedding with negative sampling: positive (src, pos)
+    pairs + num_negs sampled negatives, sigmoid ranking loss, MRR metric.
+
+    Parity: mp_utils/base.py:49-90. Subclasses define embed(batch) and
+    context_embed(batch) (defaults to a shared-id context table).
+    batch: src_emb inputs + 'pos' ids + 'negs' ids handled by the caller's
+    dataflow; this base consumes precomputed embeddings:
+      embed(batch) → [B, D]; embed_context on pos [B, 1, D] / negs [B, N, D].
+    """
+
+    dim: int = 0
+    max_id: int = 0
+    num_negs: int = 5
+
+    def embed(self, batch: Dict[str, Any]) -> Array:
+        raise NotImplementedError
+
+    def context_embed(self, ids: Array) -> Array:
+        return Embedding(self.max_id + 1, self.dim, name="ctx_emb")(ids)
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
+        emb = self.embed(batch)                       # [B, D]
+        pos = self.context_embed(batch["pos"])        # [B, D] or [B, 1, D]
+        if pos.ndim == 2:
+            pos = pos[:, None, :]
+        negs = self.context_embed(batch["negs"])      # [B, N, D]
+        pos_logit = jnp.einsum("bd,bkd->bk", emb, pos)    # [B, 1]
+        neg_logit = jnp.einsum("bd,bkd->bk", emb, negs)   # [B, N]
+        loss = (
+            optax.sigmoid_binary_cross_entropy(
+                pos_logit, jnp.ones_like(pos_logit)).mean()
+            + optax.sigmoid_binary_cross_entropy(
+                neg_logit, jnp.zeros_like(neg_logit)).mean()
+        )
+        scores = jnp.concatenate([pos_logit, neg_logit], axis=1)
+        return ModelOutput(emb, loss, "mrr", M.mrr(scores))

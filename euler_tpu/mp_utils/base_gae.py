@@ -1,0 +1,61 @@
+"""Graph auto-encoder models (GAE / VGAE).
+
+Parity: tf_euler/python/mp_utils/base_gae.py (BaseGraphGAE) + the
+examples/gae model: GNN encoder → inner-product decoder, reconstruction
+loss over positive edges + sampled negatives; VGAE adds the KL term.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from euler_tpu.mp_utils.base import ModelOutput
+from euler_tpu.mp_utils.base_gnn import BaseGNNNet
+from euler_tpu.utils import metrics as M
+
+Array = jax.Array
+
+
+class BaseGraphGAE(nn.Module):
+    """batch: x/edge_index node table + pos_src/pos_dst/neg_src/neg_dst
+    row indices into the table. variational=True → VGAE."""
+
+    conv_name: str = "gcn"
+    dim: int = 32
+    num_layers: int = 2
+    variational: bool = False
+    conv_kwargs: Dict = None
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
+        sub = dict(batch)
+        sub.pop("root_index", None)  # encode the whole node table
+        h = BaseGNNNet(self.conv_name, self.dim, self.num_layers,
+                       conv_kwargs=self.conv_kwargs, name="enc")(sub)
+        kl = 0.0
+        if self.variational:
+            mu = nn.Dense(self.dim, name="mu")(h)
+            logvar = nn.Dense(self.dim, name="logvar")(h)
+            rng = self.make_rng("sample") if self.has_rng("sample") else None
+            if rng is not None:
+                eps = jax.random.normal(rng, mu.shape)
+                h = mu + jnp.exp(0.5 * logvar) * eps
+            else:
+                h = mu
+            kl = -0.5 * jnp.mean(
+                jnp.sum(1 + logvar - mu ** 2 - jnp.exp(logvar), axis=-1))
+        pos = (h[batch["pos_src"]] * h[batch["pos_dst"]]).sum(-1)
+        neg = (h[batch["neg_src"]] * h[batch["neg_dst"]]).sum(-1)
+        loss = (
+            optax.sigmoid_binary_cross_entropy(pos, jnp.ones_like(pos)).mean()
+            + optax.sigmoid_binary_cross_entropy(neg, jnp.zeros_like(neg)).mean()
+            + 0.001 * kl
+        )
+        scores = jnp.concatenate([pos, neg])
+        labels = jnp.concatenate([jnp.ones_like(pos), jnp.zeros_like(neg)])
+        return ModelOutput(h, loss, "auc", M.auc(scores, labels))

@@ -1,0 +1,128 @@
+"""Conv-stack GNN assembly over edge_index batches.
+
+Parity: tf_euler/python/mp_utils/base_gnn.py:27-95 (BaseGNNNet __call__ =
+sampler→blocks→convs loop; JKGNNNet :97). Here the sampling already
+happened host-side (WholeDataFlow / FanoutDataFlow); this module runs the
+conv stack on the batch's node table and returns root-row embeddings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from euler_tpu import convolution as C
+
+Array = jax.Array
+
+_CONV_BUILDERS = {
+    "gcn": lambda dim, i, n, kw: C.GCNConv(out_dim=dim),
+    "sage": lambda dim, i, n, kw: C.SAGEConv(out_dim=dim),
+    "gat": lambda dim, i, n, kw: C.GATConv(out_dim=dim,
+                                           heads=kw.get("heads", 1)),
+    "agnn": lambda dim, i, n, kw: (C.GCNConv(out_dim=dim) if i == 0
+                                   else C.AGNNConv()),
+    "gin": lambda dim, i, n, kw: C.GINConv(out_dim=dim),
+    "graph": lambda dim, i, n, kw: C.GraphConv(out_dim=dim),
+    "sgcn": lambda dim, i, n, kw: C.SGCNConv(out_dim=dim,
+                                             k_hop=kw.get("k_hop", 2)),
+    "tag": lambda dim, i, n, kw: C.TAGConv(out_dim=dim,
+                                           k_hop=kw.get("k_hop", 3)),
+    "arma": lambda dim, i, n, kw: C.ARMAConv(
+        out_dim=dim, num_stacks=kw.get("num_stacks", 2),
+        num_layers=kw.get("arma_layers", 1)),
+    "appnp": lambda dim, i, n, kw: C.APPNPConv(
+        k_hop=kw.get("k_hop", 10), alpha=kw.get("alpha", 0.1)),
+    "gated": lambda dim, i, n, kw: C.GatedGraphConv(
+        out_dim=dim, num_layers=kw.get("gate_layers", 2)),
+    "relation": lambda dim, i, n, kw: C.RelationConv(
+        out_dim=dim, num_relations=kw.get("num_relations", 1)),
+}
+
+
+def get_conv(name: str, dim: int, layer_idx: int, num_layers: int,
+             kwargs: Dict) -> nn.Module:
+    try:
+        return _CONV_BUILDERS[name.lower()](dim, layer_idx, num_layers, kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown conv {name!r}; options {sorted(_CONV_BUILDERS)}"
+        ) from None
+
+
+class BaseGNNNet(nn.Module):
+    """conv_name × num_layers over (x, edge_index); returns root embeddings.
+
+    APPNP-style convs that end with propagation-only layers get a leading
+    MLP, matching the reference model structure.
+    """
+
+    conv_name: str = "gcn"
+    dim: int = 32
+    num_layers: int = 2
+    out_dim: int = 0            # 0 → dim
+    conv_kwargs: Dict = None
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, Any]) -> Array:
+        x = batch["x"]
+        edge_index = batch["edge_index"]
+        kw = self.conv_kwargs or {}
+        n = x.shape[0]
+        name = self.conv_name.lower()
+        if name == "appnp":
+            # predict-then-propagate: MLP then one propagation conv
+            h = nn.relu(nn.Dense(self.dim, name="mlp_0")(x))
+            h = nn.Dense(self.out_dim or self.dim, name="mlp_1")(h)
+            h = C.APPNPConv(k_hop=kw.get("k_hop", 10),
+                            alpha=kw.get("alpha", 0.1))(h, edge_index, n)
+        elif name in ("sgcn",):
+            h = C.SGCNConv(out_dim=self.out_dim or self.dim,
+                           k_hop=kw.get("k_hop", self.num_layers))(
+                x, edge_index, n)
+        else:
+            h = x
+            for i in range(self.num_layers):
+                dim = (self.out_dim or self.dim) if i == self.num_layers - 1 \
+                    else self.dim
+                conv = get_conv(name, dim, i, self.num_layers, kw)
+                args = (h, edge_index)
+                if name == "relation":
+                    h = conv(h, edge_index, batch.get("edge_type"), n)
+                else:
+                    h = conv(h, edge_index, n)
+                if i < self.num_layers - 1:
+                    h = nn.relu(h)
+        root = batch.get("root_index")
+        return h if root is None else jnp.take(h, root, axis=0)
+
+
+class JKGNNNet(nn.Module):
+    """Jumping-knowledge variant (reference base_gnn.py:97): concat of all
+    layer outputs feeds the head."""
+
+    conv_name: str = "gcn"
+    dim: int = 32
+    num_layers: int = 2
+    conv_kwargs: Dict = None
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, Any]) -> Array:
+        x = batch["x"]
+        edge_index = batch["edge_index"]
+        kw = self.conv_kwargs or {}
+        n = x.shape[0]
+        h = x
+        outs = []
+        for i in range(self.num_layers):
+            conv = get_conv(self.conv_name, self.dim, i, self.num_layers, kw)
+            h = conv(h, edge_index, n)
+            if i < self.num_layers - 1:
+                h = nn.relu(h)
+            outs.append(h)
+        h = jnp.concatenate(outs, axis=-1)
+        root = batch.get("root_index")
+        return h if root is None else jnp.take(h, root, axis=0)

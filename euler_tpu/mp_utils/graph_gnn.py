@@ -1,0 +1,87 @@
+"""Graph-level GNN (classification over whole graphs).
+
+Parity: tf_euler/python/mp_utils/graph_gnn.py:28 (GraphGNNNet) — conv
+stack + readout pool over batches of graphs. Batch carries x, edge_index,
+graph_index (node → graph), num_graphs is static (config).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from euler_tpu.mp_utils.base import ModelOutput
+from euler_tpu.mp_utils.base_gnn import get_conv
+from euler_tpu import graph_pool as P
+from euler_tpu.utils import metrics as M
+
+Array = jax.Array
+
+_POOLS = {
+    "sum": lambda dim: P.SumPool(),
+    "mean": lambda dim: P.MeanPool(),
+    "max": lambda dim: P.MaxPool(),
+    "attention": lambda dim: P.AttentionPool(dim=dim),
+    "set2set": lambda dim: P.Set2SetPool(dim=dim),
+}
+
+
+class GraphGNNNet(nn.Module):
+    """conv × L → pool → graph embedding."""
+
+    conv_name: str = "gin"
+    pool_name: str = "sum"
+    dim: int = 32
+    num_layers: int = 2
+    num_graphs: int = 0  # static graphs per batch
+    conv_kwargs: Dict = None
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, Any]) -> Array:
+        x, edge_index = batch["x"], batch["edge_index"]
+        gi = batch["graph_index"]
+        n = x.shape[0]
+        kw = self.conv_kwargs or {}
+        h = x
+        for i in range(self.num_layers):
+            h = get_conv(self.conv_name, self.dim, i, self.num_layers, kw)(
+                h, edge_index, n)
+            if i < self.num_layers - 1:
+                h = nn.relu(h)
+        pool = _POOLS[self.pool_name.lower()](self.dim)
+        return pool(h, gi, self.num_graphs)
+
+
+class GraphModel(nn.Module):
+    """Supervised graph classification on top of GraphGNNNet."""
+
+    conv_name: str = "gin"
+    pool_name: str = "sum"
+    dim: int = 32
+    num_layers: int = 2
+    num_graphs: int = 0
+    num_classes: int = 2
+    conv_kwargs: Dict = None
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
+        emb = GraphGNNNet(
+            self.conv_name, self.pool_name, self.dim, self.num_layers,
+            self.num_graphs, self.conv_kwargs, name="gnn")(batch)
+        logits = nn.Dense(self.num_classes, name="out")(emb)
+        labels = batch["labels"].astype(jnp.int32)
+        mask = batch.get("graph_mask")
+        per = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        if mask is not None:
+            per = per * mask
+            loss = per.sum() / jnp.maximum(mask.sum(), 1.0)
+            pred = jnp.argmax(logits, -1)
+            acc = ((pred == labels) * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        else:
+            loss = per.mean()
+            acc = M.accuracy(logits, labels)
+        return ModelOutput(emb, loss, "acc", acc)

@@ -1,0 +1,54 @@
+"""Multi-group (heterogeneous) GNN assembly.
+
+Parity: tf_euler/python/mp_utils/group_gnn.py:29,40 (GroupGNNNet /
+SharedGroupGNNNet) — one conv stack per edge-type group, outputs combined
+by attention. SharedGroupGNNNet shares conv parameters across groups.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from euler_tpu.mp_utils.base_gnn import BaseGNNNet
+from euler_tpu.utils.layers import AttLayer
+
+
+class GroupGNNNet(nn.Module):
+    """Per-group conv stacks over group-filtered edge sets.
+
+    batch["group_edge_index"]: list of [2, E_g] per group (host-side
+    dataflow filters edges by type into static-size groups).
+    """
+
+    conv_name: str = "gcn"
+    dim: int = 32
+    num_layers: int = 2
+    num_groups: int = 2
+    shared: bool = False
+    conv_kwargs: Dict = None
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, Any]) -> jnp.ndarray:
+        outs = []
+        shared_net = None
+        if self.shared:
+            shared_net = BaseGNNNet(self.conv_name, self.dim, self.num_layers,
+                                    conv_kwargs=self.conv_kwargs, name="gnn")
+        for g in range(self.num_groups):
+            sub = dict(batch)
+            sub["edge_index"] = batch["group_edge_index"][g]
+            net = shared_net or BaseGNNNet(
+                self.conv_name, self.dim, self.num_layers,
+                conv_kwargs=self.conv_kwargs, name=f"gnn_{g}")
+            outs.append(net(sub))
+        stacked = jnp.stack(outs, axis=1)            # [B, G, D]
+        return AttLayer(self.dim, name="combine")(stacked)
+
+
+class SharedGroupGNNNet(GroupGNNNet):
+    """Parameter-shared variant (reference group_gnn.py:40)."""
+
+    shared: bool = True

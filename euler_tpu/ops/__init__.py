@@ -1,0 +1,29 @@
+from euler_tpu.ops import mp_ops  # noqa: F401
+from euler_tpu.ops.base import (  # noqa: F401
+    get_graph,
+    initialize_embedded_graph,
+    initialize_graph,
+    initialize_shared_graph,
+)
+from euler_tpu.ops.feature_ops import (  # noqa: F401
+    get_binary_feature,
+    get_dense_feature,
+    get_edge_dense_feature,
+    get_edge_sparse_feature,
+    get_node_type,
+    get_sparse_feature,
+)
+from euler_tpu.ops.neighbor_ops import (  # noqa: F401
+    get_full_neighbor,
+    get_sorted_full_neighbor,
+    get_top_k_neighbor,
+    sample_fanout,
+    sample_neighbor,
+    sample_neighbor_layerwise,
+)
+from euler_tpu.ops.sample_ops import (  # noqa: F401
+    sample_edge,
+    sample_node,
+    sample_node_with_types,
+)
+from euler_tpu.ops.walk_ops import gen_pair, random_walk  # noqa: F401

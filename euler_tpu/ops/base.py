@@ -1,0 +1,57 @@
+"""Global-graph initialization — parity with the reference's
+tf_euler.initialize_graph / initialize_embedded_graph / initialize_shared_graph
+(tf_euler/python/euler_ops/base.py:37,63,70).
+
+A process-global GraphEngine backs the functional ops in this package
+(sample_ops, neighbor_ops, feature_ops, walk_ops). Models/dataflows may
+also carry an explicit engine; the global is a convenience for scripts and
+API parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from euler_tpu.graph import GraphEngine
+
+_GRAPH: Optional[GraphEngine] = None
+
+
+def initialize_graph(config) -> GraphEngine:
+    """Initialize the process-global graph.
+
+    config: either a GraphEngine (adopted as-is), a directory path
+    (embedded load), or a dict with keys {directory, shard_idx, shard_num,
+    data_type} mirroring the reference's "k=v;..." config string.
+    """
+    global _GRAPH
+    if isinstance(config, GraphEngine):
+        _GRAPH = config
+    elif isinstance(config, str):
+        _GRAPH = GraphEngine.load(config)
+    elif isinstance(config, dict):
+        _GRAPH = GraphEngine.load(
+            config["directory"],
+            shard_idx=int(config.get("shard_idx", 0)),
+            shard_num=int(config.get("shard_num", 1)),
+            data_type=int(config.get("data_type", 0)),
+        )
+    else:
+        raise TypeError(f"unsupported graph config: {type(config)}")
+    return _GRAPH
+
+
+def initialize_embedded_graph(directory: str, **kw) -> GraphEngine:
+    return initialize_graph({"directory": directory, **kw})
+
+
+def initialize_shared_graph(graph: GraphEngine) -> GraphEngine:
+    return initialize_graph(graph)
+
+
+def get_graph() -> GraphEngine:
+    if _GRAPH is None:
+        raise RuntimeError(
+            "graph not initialized; call euler_tpu.ops.initialize_graph first"
+        )
+    return _GRAPH

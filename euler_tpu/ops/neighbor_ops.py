@@ -1,0 +1,55 @@
+"""Neighbor ops over the global graph.
+
+Parity: tf_euler/python/euler_ops/neighbor_ops.py (sample_neighbor,
+sample_fanout at :122, get_full_neighbor, get_sorted_full_neighbor,
+get_top_k_neighbor) — shapes are fixed/padded rather than SparseTensor.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from euler_tpu.ops.base import get_graph
+
+
+def sample_neighbor(nodes, count: int, edge_types=None, default_node: int = 0):
+    return get_graph().sample_neighbor(
+        nodes, count, edge_types=edge_types, default_id=default_node
+    )
+
+
+def sample_fanout(nodes, counts, edge_types=None, default_node: int = 0):
+    """Multi-hop expansion; returns (layers_ids, layers_weights, layers_types)
+    where layers_ids[0] is the input nodes and layers_ids[i+1] the hop-i
+    samples (matches the reference's convention of including the roots)."""
+    g = get_graph()
+    roots = np.ascontiguousarray(nodes, dtype=np.uint64).ravel()
+    ids, w, t = g.sample_fanout(
+        roots, counts, edge_types=edge_types, default_id=default_node
+    )
+    return [roots] + ids, w, t
+
+
+def get_full_neighbor(nodes, edge_types=None):
+    return get_graph().get_full_neighbor(nodes, edge_types=edge_types)
+
+
+def get_sorted_full_neighbor(nodes, edge_types=None):
+    return get_graph().get_full_neighbor(
+        nodes, edge_types=edge_types, sorted_by_id=True
+    )
+
+
+def get_top_k_neighbor(nodes, k: int, edge_types=None, default_node: int = 0):
+    return get_graph().get_top_k_neighbor(
+        nodes, k, edge_types=edge_types, default_id=default_node
+    )
+
+
+def sample_neighbor_layerwise(nodes, layer_sizes, edge_types=None,
+                              default_node: int = 0):
+    """LADIES-style layerwise sampling (reference sampleLNB /
+    SampleNeighborLayerwiseWithAdj)."""
+    return get_graph().sample_layerwise(
+        nodes, layer_sizes, edge_types=edge_types, default_id=default_node
+    )

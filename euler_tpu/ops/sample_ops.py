@@ -1,0 +1,24 @@
+"""Root sampling ops over the global graph.
+
+Parity: tf_euler/python/euler_ops/sample_ops.py:38 (sample_node),
+sample_edge, sample_node_with_types, sample_graph_label analog.
+Returns numpy uint64 arrays ready for jax.device_put.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from euler_tpu.ops.base import get_graph
+
+
+def sample_node(count: int, node_type: int = -1) -> np.ndarray:
+    return get_graph().sample_node(count, node_type)
+
+
+def sample_edge(count: int, edge_type: int = -1):
+    return get_graph().sample_edge(count, edge_type)
+
+
+def sample_node_with_types(types) -> np.ndarray:
+    return get_graph().sample_node_with_types(types)

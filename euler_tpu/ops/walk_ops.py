@@ -1,0 +1,44 @@
+"""Random-walk ops.
+
+Parity: tf_euler/python/euler_ops/walk_ops.py (random_walk :29, gen_pair
+:25 — node2vec walks and skip-gram pair generation).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from euler_tpu.ops.base import get_graph
+
+
+def random_walk(nodes, walk_len: int, p: float = 1.0, q: float = 1.0,
+                edge_types=None, default_node: int = 0) -> np.ndarray:
+    """[n, walk_len+1] uint64 walks, column 0 = the input nodes."""
+    return get_graph().random_walk(
+        nodes, walk_len, p=p, q=q, edge_types=edge_types,
+        default_id=default_node,
+    )
+
+
+def gen_pair(paths: np.ndarray, left_win_size: int,
+             right_win_size: int) -> np.ndarray:
+    """Skip-gram (center, context) pairs from walk paths.
+
+    paths: [n, L]. Returns [n, num_pairs, 2] where pairs pad with the path's
+    own center when the window clips at the boundary (keeps the shape
+    static; such self-pairs are harmless for negative-sampling losses).
+    """
+    paths = np.asarray(paths)
+    n, L = paths.shape
+    pairs = []
+    for i in range(L):
+        for off in range(-left_win_size, right_win_size + 1):
+            if off == 0:
+                continue
+            j = i + off
+            if j < 0 or j >= L:
+                continue
+            pairs.append(np.stack([paths[:, i], paths[:, j]], axis=1))
+    if not pairs:
+        return np.zeros((n, 0, 2), dtype=paths.dtype)
+    return np.stack(pairs, axis=1)

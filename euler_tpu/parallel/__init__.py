@@ -1,0 +1,13 @@
+from euler_tpu.parallel.mesh import (  # noqa: F401
+    data_sharding,
+    make_mesh,
+    mesh_shape_for,
+    replicated,
+    shard_batch,
+)
+from euler_tpu.parallel.sharded_embedding import (  # noqa: F401
+    ShardedEmbedding,
+    apply_param_shardings,
+    param_shardings,
+)
+from euler_tpu.parallel.train import make_spmd_train_step, spmd_init  # noqa: F401

@@ -1,0 +1,63 @@
+"""Device-mesh utilities for SPMD training.
+
+This replaces the reference's distribution machinery (TF parameter servers
++ between-graph replication, tf_euler/scripts/dist_tf_euler.sh, SURVEY.md
+§2.4): data parallelism and embedding-table model parallelism are
+expressed as shardings over a jax.sharding.Mesh, and XLA GSPMD inserts the
+ICI collectives (all-reduce for gradients, all-gather / reduce-scatter
+for sharded tables).
+
+Axes convention: 'data' = batch-parallel replicas, 'model' = parameter
+(embedding-row) sharding. A v5e-16 slice would be Mesh((4, 4),
+('data', 'model')) or (16, 1) for pure DP.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "data_sharding", "replicated", "shard_batch",
+           "mesh_shape_for"]
+
+
+def mesh_shape_for(n_devices: int, model_parallel: int = 1) -> Tuple[int, int]:
+    assert n_devices % model_parallel == 0
+    return (n_devices // model_parallel, model_parallel)
+
+
+def make_mesh(model_parallel: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    dp, mp = mesh_shape_for(len(devices), model_parallel)
+    arr = np.asarray(devices).reshape(dp, mp)
+    return Mesh(arr, ("data", "model"))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch arrays: leading axis over 'data'."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: Dict, mesh: Mesh) -> Dict:
+    """device_put every array in the batch with its leading axis split over
+    'data' (arrays whose leading dim doesn't divide fall back to
+    replication — e.g. scalar counts)."""
+    dsh = data_sharding(mesh)
+    rsh = replicated(mesh)
+    n_data = mesh.shape["data"]
+
+    def put(v):
+        a = np.asarray(v)
+        if a.ndim >= 1 and a.shape[0] % n_data == 0 and a.shape[0] > 0:
+            return jax.device_put(a, dsh)
+        return jax.device_put(a, rsh)
+
+    return jax.tree_util.tree_map(put, batch)

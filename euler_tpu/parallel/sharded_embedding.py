@@ -1,0 +1,75 @@
+"""HBM-sharded embedding tables — the TPU-native replacement for the
+reference's parameter-server embedding sharding.
+
+Parity: tf_euler/python/utils/layers.py:119-171 (partitioned
+Embedding/SparseEmbedding on TF PS) + embedding.py partial updates
+(SURVEY.md §2.4 "Embedding-table model parallelism").
+
+Design: the table's rows are partitioned over the mesh's 'model' axis via
+flax partitioning metadata. Under jit with GSPMD, a lookup jnp.take(table,
+rows) on a model-sharded table lowers to an on-device gather + ICI
+collective (all-gather of the hit rows), and the backward scatter-add of
+gradients is likewise distributed — no parameter server, no Python-side
+partial_update protocol (reference embedding.py:24,61).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from euler_tpu.utils.layers import bucketize_ids
+
+Array = jax.Array
+
+__all__ = ["ShardedEmbedding", "param_shardings", "apply_param_shardings"]
+
+
+class ShardedEmbedding(nn.Module):
+    """Embedding table partitioned row-wise over the 'model' mesh axis."""
+
+    num_embeddings: int
+    dim: int
+    init_scale: float = 0.05
+    partition_axis: str = "model"
+
+    @nn.compact
+    def __call__(self, ids: Array) -> Array:
+        table = self.param(
+            "table",
+            nn.with_partitioning(
+                nn.initializers.uniform(scale=self.init_scale),
+                (self.partition_axis, None),
+            ),
+            (self.num_embeddings, self.dim),
+        )
+        rows = bucketize_ids(ids, self.num_embeddings)
+        return jnp.take(jnp.asarray(table), rows, axis=0)
+
+
+def param_shardings(variables: Dict, mesh: Mesh) -> Dict:
+    """PyTree of NamedShardings from flax partitioning metadata: boxed
+    nn.Partitioned leaves get their spec, everything else replicates."""
+
+    def to_sharding(leaf):
+        if isinstance(leaf, nn.Partitioned):
+            return NamedSharding(mesh, P(*leaf.names))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(
+        to_sharding, variables,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def apply_param_shardings(variables: Dict, mesh: Mesh) -> Dict:
+    """device_put the (unboxed) variables per their metadata shardings."""
+    shardings = param_shardings(variables, mesh)
+    unboxed = nn.meta.unbox(variables)
+    flat_s = jax.tree_util.tree_leaves(shardings)
+    flat_v, treedef = jax.tree_util.tree_flatten(unboxed)
+    placed = [jax.device_put(v, s) for v, s in zip(flat_v, flat_s)]
+    return jax.tree_util.tree_unflatten(treedef, placed)

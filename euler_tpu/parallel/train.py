@@ -1,0 +1,68 @@
+"""SPMD training step over a device mesh.
+
+The multi-chip training path (SURVEY.md §2.4 mapping): batch arrays are
+sharded over the 'data' axis, embedding tables over 'model', everything
+else replicated; the jitted step lets XLA GSPMD insert gradient
+all-reduces over ICI. Used by the estimator (mesh=...), bench.py's
+multi-chip mode, and __graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh
+
+from euler_tpu.parallel.mesh import shard_batch
+from euler_tpu.parallel.sharded_embedding import apply_param_shardings
+
+
+def spmd_init(model: nn.Module, tx: optax.GradientTransformation,
+              sample_batch: Dict, mesh: Mesh, seed: int = 0) -> Dict[str, Any]:
+    """Initializes sharded train state: params placed per their
+    partitioning metadata (embedding rows over 'model'), optimizer state
+    mirrors the param placement."""
+    rng = jax.random.key(seed)
+    batch = shard_batch(sample_batch, mesh)
+    variables = model.init(rng, batch)
+    variables = apply_param_shardings(variables, mesh)
+    params = variables.pop("params")
+    opt_state = tx.init(params)
+    return {"params": params, "opt_state": opt_state,
+            "extra_vars": variables, "step": jnp.zeros((), jnp.int32)}
+
+
+def make_spmd_train_step(model: nn.Module,
+                         tx: optax.GradientTransformation,
+                         mutable_keys: Tuple[str, ...] = ()) -> Callable:
+    """Jitted (state, batch) → (state, loss, metric). State buffers are
+    donated so HBM is reused across steps."""
+
+    def train_step(state, batch):
+        def loss_fn(p):
+            variables = {"params": p, **state["extra_vars"]}
+            if mutable_keys:
+                out, new_vars = model.apply(variables, batch,
+                                            mutable=list(mutable_keys))
+            else:
+                out = model.apply(variables, batch)
+                new_vars = state["extra_vars"]
+            return out.loss, (out.metric, new_vars)
+
+        (loss, (metric, new_vars)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state["params"])
+        updates, opt_state = tx.update(grads, state["opt_state"],
+                                       state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return (
+            {"params": params, "opt_state": opt_state,
+             "extra_vars": new_vars, "step": state["step"] + 1},
+            loss,
+            metric,
+        )
+
+    return jax.jit(train_step, donate_argnums=(0,))

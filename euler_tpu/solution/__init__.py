@@ -1,0 +1,10 @@
+from euler_tpu.solution.base_solution import (  # noqa: F401
+    CosineLogits,
+    DenseLogits,
+    PosNegLogits,
+    PosNegSampler,
+    SuperviseSolution,
+    UnsuperviseSolution,
+    sigmoid_loss,
+    xent_loss,
+)

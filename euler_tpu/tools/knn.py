@@ -1,0 +1,117 @@
+"""KNN retrieval over inferred embeddings.
+
+Parity: knn/knn.py (faiss IVFFlat over embedding_*.npy / ids_*.npy,
+knn.py:36-76). faiss isn't assumed present; the same IVF structure
+(coarse k-means quantizer + per-list scan with nprobe) is implemented in
+numpy, with a brute-force fallback for small corpora.
+
+Usage:
+  python -m euler_tpu.tools.knn model_dir --query_ids 1,2,3 --k 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+class IVFFlatIndex:
+    """Inverted-file index: k-means coarse centroids, exact scan inside
+    the nprobe nearest lists (metric: inner product)."""
+
+    def __init__(self, nlist: int = 64, nprobe: int = 8, iters: int = 10,
+                 seed: int = 0):
+        self.nlist = nlist
+        self.nprobe = min(nprobe, nlist)
+        self.iters = iters
+        self.seed = seed
+        self.centroids = None
+        self.lists = None
+        self.data = None
+        self.ids = None
+
+    def train_add(self, data: np.ndarray, ids: np.ndarray) -> None:
+        n = data.shape[0]
+        rng = np.random.default_rng(self.seed)
+        k = min(self.nlist, max(1, n // 4))
+        self.nlist = k
+        self.nprobe = min(self.nprobe, k)
+        centroids = data[rng.choice(n, k, replace=False)].copy()
+        for _ in range(self.iters):
+            assign = np.argmax(data @ centroids.T, axis=1)
+            for c in range(k):
+                members = data[assign == c]
+                if len(members):
+                    centroids[c] = members.mean(axis=0)
+        assign = np.argmax(data @ centroids.T, axis=1)
+        self.centroids = centroids
+        self.lists = [np.where(assign == c)[0] for c in range(k)]
+        self.data = data
+        self.ids = ids
+
+    def search(self, queries: np.ndarray, k: int):
+        sims_c = queries @ self.centroids.T               # [Q, nlist]
+        probe = np.argsort(-sims_c, axis=1)[:, :self.nprobe]
+        out_ids = np.zeros((len(queries), k), dtype=self.ids.dtype)
+        out_sims = np.full((len(queries), k), -np.inf, np.float32)
+        for qi, q in enumerate(queries):
+            cand = np.concatenate([self.lists[c] for c in probe[qi]]) \
+                if len(probe[qi]) else np.arange(len(self.data))
+            if len(cand) == 0:
+                cand = np.arange(len(self.data))
+            sims = self.data[cand] @ q
+            top = np.argsort(-sims)[:k]
+            take = cand[top]
+            out_ids[qi, :len(take)] = self.ids[take]
+            out_sims[qi, :len(take)] = sims[top]
+        return out_ids, out_sims
+
+
+def brute_force(data, ids, queries, k):
+    sims = queries @ data.T
+    top = np.argsort(-sims, axis=1)[:, :k]
+    return ids[top], np.take_along_axis(sims, top, axis=1)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_dir")
+    ap.add_argument("--query_ids", default="")
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--nlist", type=int, default=64)
+    ap.add_argument("--nprobe", type=int, default=8)
+    ap.add_argument("--brute", action="store_true")
+    args = ap.parse_args(argv)
+
+    emb = np.load(os.path.join(args.model_dir, "embedding_0.npy"))
+    ids_path = os.path.join(args.model_dir, "ids_0.npy")
+    ids = (np.load(ids_path) if os.path.exists(ids_path)
+           else np.arange(len(emb), dtype=np.uint64))
+    if args.query_ids:
+        qids = np.array([int(v) for v in args.query_ids.split(",")],
+                        dtype=ids.dtype)
+        rows = np.searchsorted(np.sort(ids), qids)
+        order = np.argsort(ids)
+        queries = emb[order[rows.clip(0, len(ids) - 1)]]
+    else:
+        qids = ids[:5]
+        queries = emb[:5]
+    if args.brute or len(emb) < 1000:
+        out_ids, sims = brute_force(emb, ids, queries, args.k)
+    else:
+        index = IVFFlatIndex(args.nlist, args.nprobe)
+        index.train_add(emb, ids)
+        out_ids, sims = index.search(queries, args.k)
+    for qi, qid in enumerate(qids):
+        print(json.dumps({"query": int(qid),
+                          "neighbors": out_ids[qi].tolist(),
+                          "scores": [round(float(s), 4) for s in sims[qi]]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

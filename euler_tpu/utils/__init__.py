@@ -1,0 +1,1 @@
+from euler_tpu.utils import aggregators, encoders, layers, metrics, optimizers  # noqa: F401

@@ -1,0 +1,96 @@
+"""Model metrics: accuracy / AUC / F1 / MRR / MR / hit@k.
+
+Parity: tf_euler/python/utils/metrics.py:23-97. Implemented as pure
+jax.numpy functions (jit-able, no TF streaming-metric state); callers
+average across batches themselves (the estimator does).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+__all__ = ["accuracy", "auc", "f1_score", "micro_f1", "mrr", "mr", "hit_at_k",
+           "get_metric"]
+
+
+def accuracy(logits: Array, labels: Array) -> Array:
+    """Multiclass (argmax over last dim) or binary (threshold 0.5)."""
+    if logits.ndim > 1 and logits.shape[-1] > 1:
+        pred = jnp.argmax(logits, axis=-1)
+        lab = labels if labels.ndim == logits.ndim - 1 else jnp.argmax(labels, -1)
+        return jnp.mean((pred == lab).astype(jnp.float32))
+    pred = (logits.ravel() > 0.5).astype(jnp.int32)
+    return jnp.mean((pred == labels.ravel().astype(jnp.int32)).astype(jnp.float32))
+
+
+def auc(scores: Array, labels: Array) -> Array:
+    """Exact pairwise AUC (rank-based, handles ties by midrank)."""
+    scores = scores.ravel()
+    labels = labels.ravel().astype(jnp.float32)
+    order = jnp.argsort(scores)
+    ranks = jnp.zeros_like(scores).at[order].set(
+        jnp.arange(1, scores.shape[0] + 1, dtype=scores.dtype))
+    # midrank correction for ties: average rank within equal-score groups
+    n_pos = labels.sum()
+    n_neg = labels.shape[0] - n_pos
+    pos_rank_sum = (ranks * labels).sum()
+    return (pos_rank_sum - n_pos * (n_pos + 1) / 2) / jnp.maximum(
+        n_pos * n_neg, 1.0)
+
+
+def micro_f1(logits: Array, labels: Array, threshold: float = 0.5) -> Array:
+    """Micro-averaged F1 for multilabel (sigmoid) or one-hot multiclass."""
+    if logits.ndim > 1 and labels.ndim == 1:
+        pred = jax.nn.one_hot(jnp.argmax(logits, -1), logits.shape[-1])
+        lab = jax.nn.one_hot(labels, logits.shape[-1])
+    else:
+        pred = (logits > threshold).astype(jnp.float32)
+        lab = labels.astype(jnp.float32)
+    tp = (pred * lab).sum()
+    fp = (pred * (1 - lab)).sum()
+    fn = ((1 - pred) * lab).sum()
+    return 2 * tp / jnp.maximum(2 * tp + fp + fn, 1.0)
+
+
+f1_score = micro_f1
+
+
+def _ranks(scores: Array) -> Array:
+    """Rank of column 0 (the positive) among all columns, per row.
+    scores: [B, 1+num_neg], higher = better."""
+    pos = scores[:, :1]
+    return 1.0 + (scores[:, 1:] >= pos).sum(axis=1).astype(jnp.float32)
+
+
+def mrr(scores: Array) -> Array:
+    """Mean reciprocal rank; scores[:, 0] is the positive candidate."""
+    return jnp.mean(1.0 / _ranks(scores))
+
+
+def mr(scores: Array) -> Array:
+    """Mean rank."""
+    return jnp.mean(_ranks(scores))
+
+
+def hit_at_k(scores: Array, k: int) -> Array:
+    return jnp.mean((_ranks(scores) <= k).astype(jnp.float32))
+
+
+def get_metric(name: str):
+    name = name.lower()
+    table = {
+        "acc": accuracy, "accuracy": accuracy,
+        "auc": auc,
+        "f1": micro_f1, "micro_f1": micro_f1,
+        "mrr": mrr, "mr": mr,
+        "hit1": lambda s: hit_at_k(s, 1),
+        "hit3": lambda s: hit_at_k(s, 3),
+        "hit10": lambda s: hit_at_k(s, 10),
+    }
+    try:
+        return table[name]
+    except KeyError:
+        raise ValueError(f"unknown metric {name!r}") from None

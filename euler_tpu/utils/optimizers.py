@@ -1,0 +1,25 @@
+"""Optimizer factory. Parity: tf_euler/python/utils/optimizers.py
+(sgd/adam/adagrad/momentum by name) → optax."""
+
+from __future__ import annotations
+
+import optax
+
+__all__ = ["get"]
+
+
+def get(name: str, learning_rate: float = 0.01, **kw):
+    name = name.lower()
+    if name == "sgd":
+        return optax.sgd(learning_rate)
+    if name == "adam":
+        return optax.adam(learning_rate, **kw)
+    if name == "adagrad":
+        return optax.adagrad(learning_rate, **kw)
+    if name == "momentum":
+        return optax.sgd(learning_rate, momentum=kw.pop("momentum", 0.9), **kw)
+    if name == "rmsprop":
+        return optax.rmsprop(learning_rate, **kw)
+    if name == "adamw":
+        return optax.adamw(learning_rate, **kw)
+    raise ValueError(f"unknown optimizer {name!r}")

@@ -1,0 +1,20 @@
+"""AGNN on citation datasets.
+
+Parity: examples/agnn/run_agnn.py. Baseline (BASELINE.md): see agnn row.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from common import citation_argparser, run_citation  # noqa: E402
+
+
+def main(argv=None):
+    args = citation_argparser().parse_args(argv)
+    return run_citation("agnn", args, conv_kwargs=None)
+
+
+if __name__ == "__main__":
+    main()

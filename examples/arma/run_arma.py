@@ -1,0 +1,20 @@
+"""ARMA on citation datasets.
+
+Parity: examples/arma/run_arma.py. Baseline (BASELINE.md): see arma row.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from common import citation_argparser, run_citation  # noqa: E402
+
+
+def main(argv=None):
+    args = citation_argparser().parse_args(argv)
+    return run_citation("arma", args, conv_kwargs={'num_stacks': 2, 'arma_layers': 1})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Shared example runner utilities (role of the reference's per-example
+flags + estimator wiring, examples/*/run_*.py)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def citation_argparser(**defaults) -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default=defaults.get("dataset", "cora"))
+    ap.add_argument("--hidden_dim", type=int,
+                    default=defaults.get("hidden_dim", 32))
+    ap.add_argument("--num_layers", type=int,
+                    default=defaults.get("num_layers", 2))
+    ap.add_argument("--batch_size", type=int,
+                    default=defaults.get("batch_size", 128))
+    ap.add_argument("--learning_rate", type=float,
+                    default=defaults.get("learning_rate", 0.01))
+    ap.add_argument("--max_steps", type=int,
+                    default=defaults.get("max_steps", 200))
+    ap.add_argument("--eval_steps", type=int,
+                    default=defaults.get("eval_steps", 20))
+    ap.add_argument("--model_dir", default="")
+    ap.add_argument("--run_mode", default="train_and_evaluate")
+    return ap
+
+
+def run_citation(conv_name: str, args, conv_kwargs=None, model_cls=None):
+    """Train+evaluate a conv-stack model on a citation dataset."""
+    from euler_tpu.dataflow import FullBatchDataFlow
+    from euler_tpu.dataset import get_dataset
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.mp_utils import BaseGNNNet, SuperviseModel
+
+    data = get_dataset(args.dataset)
+    print(f"dataset {args.dataset}: {data.engine.node_count} nodes, "
+          f"{data.engine.edge_count} edges [{data.source}]")
+
+    if model_cls is None:
+        class ConvModel(SuperviseModel):
+            dim: int = args.hidden_dim
+            num_layers: int = args.num_layers
+
+            def embed(self, batch):
+                return BaseGNNNet(conv_name, self.dim, self.num_layers,
+                                  conv_kwargs=conv_kwargs or {},
+                                  name="gnn")(batch)
+
+        model = ConvModel(num_classes=data.num_classes,
+                          multilabel=data.multilabel)
+    else:
+        model = model_cls(num_classes=data.num_classes,
+                          multilabel=data.multilabel)
+
+    flow = FullBatchDataFlow(data.engine, feature_ids=["feature"])
+    est = NodeEstimator(
+        model,
+        dict(batch_size=args.batch_size, learning_rate=args.learning_rate,
+             label_dim=data.num_classes),
+        data.engine, flow, label_fid="label", label_dim=data.num_classes,
+        model_dir=args.model_dir or None)
+    res = est.train_and_evaluate(est.train_input_fn, est.eval_input_fn,
+                                 args.max_steps, args.eval_steps)
+    print(res)
+    return res

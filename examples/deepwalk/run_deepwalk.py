@@ -1,0 +1,68 @@
+"""DeepWalk / node2vec random-walk embeddings.
+
+Parity: examples/deepwalk/run_deepwalk.py. Baseline: MRR row in
+BASELINE.md. Walks come from the engine's node2vec sampler; pairs from
+gen_pair.
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import numpy as np  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="cora")
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--walk_len", type=int, default=5)
+    ap.add_argument("--left_win", type=int, default=1)
+    ap.add_argument("--right_win", type=int, default=1)
+    ap.add_argument("--p", type=float, default=1.0)
+    ap.add_argument("--q", type=float, default=1.0)
+    ap.add_argument("--num_negs", type=int, default=5)
+    ap.add_argument("--batch_size", type=int, default=64)
+    ap.add_argument("--learning_rate", type=float, default=0.025)
+    ap.add_argument("--max_steps", type=int, default=500)
+    ap.add_argument("--eval_steps", type=int, default=20)
+    ap.add_argument("--model_dir", default="")
+    args = ap.parse_args(argv)
+
+    from euler_tpu.dataset import get_dataset
+    from euler_tpu.estimator import BaseEstimator
+    from euler_tpu.models import DeepWalk
+    from euler_tpu.ops.walk_ops import gen_pair
+
+    data = get_dataset(args.dataset)
+    g = data.engine
+    print(f"dataset {args.dataset}: {g.node_count} nodes [{data.source}]")
+
+    model = DeepWalk(max_id=data.max_id, dim=args.dim)
+    est = BaseEstimator(
+        model,
+        dict(learning_rate=args.learning_rate, max_id=data.max_id),
+        model_dir=args.model_dir or None)
+
+    def input_fn():
+        while True:
+            roots = g.sample_node(args.batch_size, -1)
+            walks = g.random_walk(roots, args.walk_len, p=args.p, q=args.q)
+            pairs = gen_pair(walks, args.left_win, args.right_win)
+            flat = pairs.reshape(-1, 2)
+            negs = g.sample_node(flat.shape[0] * args.num_negs, -1).reshape(
+                flat.shape[0], args.num_negs)
+            yield {"src": flat[:, 0], "pos": flat[:, 1], "negs": negs,
+                   "infer_ids": flat[:, 0]}
+
+    res = est.train(input_fn, args.max_steps)
+    ev = est.evaluate(input_fn, args.eval_steps)
+    print({**{f"train_{k}": v for k, v in res.items()},
+           **{f"eval_{k}": v for k, v in ev.items()}})
+    return ev
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Deep Graph Infomax (parity: examples/dgi)."""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import numpy as np  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="cora")
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--batch_size", type=int, default=64)
+    ap.add_argument("--learning_rate", type=float, default=0.01)
+    ap.add_argument("--max_steps", type=int, default=200)
+    ap.add_argument("--eval_steps", type=int, default=20)
+    ap.add_argument("--model_dir", default="")
+    args = ap.parse_args(argv)
+
+    from euler_tpu.dataflow import FullBatchDataFlow
+    from euler_tpu.dataset import get_dataset
+    from euler_tpu.estimator import BaseEstimator
+    from euler_tpu.models import DGI
+
+    data = get_dataset(args.dataset)
+    g = data.engine
+    flow = FullBatchDataFlow(g, feature_ids=["feature"])
+    model = DGI(dim=args.dim)
+    est = BaseEstimator(model, dict(learning_rate=args.learning_rate),
+                        model_dir=args.model_dir or None)
+    rng = np.random.default_rng(0)
+
+    def input_fn():
+        while True:
+            roots = g.sample_node(args.batch_size, -1)
+            batch = flow(roots)
+            perm = rng.permutation(batch["x"].shape[0])
+            batch["x_corrupt"] = batch["x"][perm]
+            batch["infer_ids"] = roots
+            yield batch
+
+    res = est.train(input_fn, args.max_steps)
+    ev = est.evaluate(input_fn, args.eval_steps)
+    print({**{f"train_{k}": v for k, v in res.items()},
+           **{f"eval_{k}": v for k, v in ev.items()}})
+    return ev
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""DistMult on fb15k-family (parity: examples/distmult) — the TransX
+driver with the trilinear scorer."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from TransX.run_transx import main as transx_main  # noqa: E402
+
+
+def main(argv=None):
+    argv = list(argv) if argv is not None else sys.argv[1:]
+    if "--model" not in argv:
+        argv = ["--model", "DistMult"] + argv
+    return transx_main(argv)
+
+
+if __name__ == "__main__":
+    main()

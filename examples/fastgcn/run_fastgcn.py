@@ -1,0 +1,51 @@
+"""FastGCN — layerwise importance-sampled GCN (parity: examples/fastgcn)."""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="cora")
+    ap.add_argument("--hidden_dim", type=int, default=32)
+    ap.add_argument("--layer_sizes", default="128,128")
+    ap.add_argument("--batch_size", type=int, default=64)
+    ap.add_argument("--learning_rate", type=float, default=0.01)
+    ap.add_argument("--max_steps", type=int, default=200)
+    ap.add_argument("--eval_steps", type=int, default=20)
+    ap.add_argument("--model_dir", default="")
+    args = ap.parse_args(argv)
+
+    from euler_tpu.dataflow import LayerwiseDataFlow
+    from euler_tpu.dataset import get_dataset
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.mp_utils import SuperviseModel
+    from euler_tpu.utils.encoders import LayerEncoder
+
+    sizes = [int(x) for x in args.layer_sizes.split(",")]
+    data = get_dataset(args.dataset)
+
+    class FastGCNModel(SuperviseModel):
+        def embed(self, batch):
+            return LayerEncoder(dim=args.hidden_dim, name="enc")(
+                batch["layers"], batch["adjs"])
+
+    flow = LayerwiseDataFlow(data.engine, sizes, feature_ids=["feature"])
+    est = NodeEstimator(
+        FastGCNModel(num_classes=data.num_classes,
+                     multilabel=data.multilabel),
+        dict(batch_size=args.batch_size, learning_rate=args.learning_rate,
+             label_dim=data.num_classes),
+        data.engine, flow, label_fid="label", label_dim=data.num_classes,
+        model_dir=args.model_dir or None)
+    res = est.train_and_evaluate(est.train_input_fn, est.eval_input_fn,
+                                 args.max_steps, args.eval_steps)
+    print(res)
+    return res
+
+
+if __name__ == "__main__":
+    main()

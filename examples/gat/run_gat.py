@@ -1,0 +1,20 @@
+"""GAT on citation datasets.
+
+Parity: examples/gat/run_gat.py. Baseline (BASELINE.md): see gat row.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from common import citation_argparser, run_citation  # noqa: E402
+
+
+def main(argv=None):
+    args = citation_argparser().parse_args(argv)
+    return run_citation("gat", args, conv_kwargs={'heads': 8})
+
+
+if __name__ == "__main__":
+    main()

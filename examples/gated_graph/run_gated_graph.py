@@ -1,0 +1,20 @@
+"""GATED_GRAPH graph classification on mutag.
+
+Parity: examples/gated_graph. Baseline (BASELINE.md): accuracy gated_graph row.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from graph_common import graph_argparser, run_graph_model  # noqa: E402
+
+
+def main(argv=None):
+    args = graph_argparser().parse_args(argv)
+    return run_graph_model("gated", "attention", args)
+
+
+if __name__ == "__main__":
+    main()

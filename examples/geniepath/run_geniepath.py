@@ -1,0 +1,53 @@
+"""GeniePath — adaptive receptive-field GNN over fanouts
+(parity: examples/geniepath)."""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="cora")
+    ap.add_argument("--hidden_dim", type=int, default=32)
+    ap.add_argument("--fanouts", default="10,10")
+    ap.add_argument("--batch_size", type=int, default=64)
+    ap.add_argument("--learning_rate", type=float, default=0.01)
+    ap.add_argument("--max_steps", type=int, default=200)
+    ap.add_argument("--eval_steps", type=int, default=20)
+    ap.add_argument("--model_dir", default="")
+    args = ap.parse_args(argv)
+
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.dataset import get_dataset
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.mp_utils import SuperviseModel
+    from euler_tpu.utils.encoders import GenieEncoder
+
+    fanouts = tuple(int(x) for x in args.fanouts.split(","))
+    data = get_dataset(args.dataset)
+
+    class GeniePathModel(SuperviseModel):
+        def embed(self, batch):
+            return GenieEncoder(dim=args.hidden_dim, fanouts=fanouts,
+                                name="enc")(batch["layers"])
+
+    flow = FanoutDataFlow(data.engine, list(fanouts),
+                          feature_ids=["feature"])
+    est = NodeEstimator(
+        GeniePathModel(num_classes=data.num_classes,
+                       multilabel=data.multilabel),
+        dict(batch_size=args.batch_size, learning_rate=args.learning_rate,
+             label_dim=data.num_classes),
+        data.engine, flow, label_fid="label", label_dim=data.num_classes,
+        model_dir=args.model_dir or None)
+    res = est.train_and_evaluate(est.train_input_fn, est.eval_input_fn,
+                                 args.max_steps, args.eval_steps)
+    print(res)
+    return res
+
+
+if __name__ == "__main__":
+    main()

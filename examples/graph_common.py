@@ -1,0 +1,50 @@
+"""Shared runner for whole-graph classification examples (mutag family:
+gin / gated_graph / set2set / graphgcn — reference examples)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def graph_argparser(**defaults) -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="mutag")
+    ap.add_argument("--hidden_dim", type=int,
+                    default=defaults.get("hidden_dim", 32))
+    ap.add_argument("--num_layers", type=int,
+                    default=defaults.get("num_layers", 2))
+    ap.add_argument("--num_graphs", type=int,
+                    default=defaults.get("num_graphs", 16))
+    ap.add_argument("--learning_rate", type=float,
+                    default=defaults.get("learning_rate", 0.01))
+    ap.add_argument("--max_steps", type=int,
+                    default=defaults.get("max_steps", 200))
+    ap.add_argument("--eval_steps", type=int,
+                    default=defaults.get("eval_steps", 20))
+    ap.add_argument("--model_dir", default="")
+    return ap
+
+
+def run_graph_model(conv_name: str, pool_name: str, args):
+    from euler_tpu.dataset import get_dataset
+    from euler_tpu.estimator import GraphEstimator
+    from euler_tpu.mp_utils import GraphModel
+
+    data = get_dataset(args.dataset)
+    model = GraphModel(
+        conv_name=conv_name, pool_name=pool_name, dim=args.hidden_dim,
+        num_layers=args.num_layers, num_graphs=args.num_graphs,
+        num_classes=data.num_classes)
+    est = GraphEstimator(
+        model,
+        dict(num_graphs=args.num_graphs, learning_rate=args.learning_rate,
+             train_indices=data.train_indices, eval_indices=data.eval_indices),
+        data.graphs, data.labels, model_dir=args.model_dir or None)
+    res = est.train_and_evaluate(est.train_input_fn, est.eval_input_fn,
+                                 args.max_steps, args.eval_steps)
+    print(res)
+    return res

@@ -1,0 +1,20 @@
+"""GRAPHGCN graph classification on mutag.
+
+Parity: examples/graphgcn. Baseline (BASELINE.md): accuracy graphgcn row.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from graph_common import graph_argparser, run_graph_model  # noqa: E402
+
+
+def main(argv=None):
+    args = graph_argparser().parse_args(argv)
+    return run_graph_model("gcn", "mean", args)
+
+
+if __name__ == "__main__":
+    main()

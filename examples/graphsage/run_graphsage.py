@@ -1,0 +1,71 @@
+"""GraphSAGE (supervised + unsupervised) over sampled fanouts.
+
+Parity: examples/graphsage/run_graphsage.py:30-46. The fanout/encoder
+path — the scalable configuration bench.py measures.
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="cora")
+    ap.add_argument("--mode", default="supervised",
+                    choices=["supervised", "unsupervised"])
+    ap.add_argument("--fanouts", default="10,10")
+    ap.add_argument("--hidden_dim", type=int, default=64)
+    ap.add_argument("--aggregator", default="mean")
+    ap.add_argument("--batch_size", type=int, default=64)
+    ap.add_argument("--num_negs", type=int, default=5)
+    ap.add_argument("--learning_rate", type=float, default=0.01)
+    ap.add_argument("--max_steps", type=int, default=300)
+    ap.add_argument("--eval_steps", type=int, default=20)
+    ap.add_argument("--model_dir", default="")
+    args = ap.parse_args(argv)
+
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.dataset import get_dataset
+    from euler_tpu.estimator import EdgeEstimator, NodeEstimator
+    from euler_tpu.models import SupervisedGraphSage, UnsupervisedGraphSage
+
+    fanouts = tuple(int(x) for x in args.fanouts.split(","))
+    data = get_dataset(args.dataset)
+    print(f"dataset {args.dataset}: {data.engine.node_count} nodes "
+          f"[{data.source}]")
+    flow = FanoutDataFlow(data.engine, list(fanouts),
+                          feature_ids=["feature"])
+    if args.mode == "supervised":
+        model = SupervisedGraphSage(
+            num_classes=data.num_classes, multilabel=data.multilabel,
+            dim=args.hidden_dim, fanouts=fanouts,
+            aggregator=args.aggregator)
+        est = NodeEstimator(
+            model,
+            dict(batch_size=args.batch_size,
+                 learning_rate=args.learning_rate,
+                 label_dim=data.num_classes),
+            data.engine, flow, label_fid="label",
+            label_dim=data.num_classes, model_dir=args.model_dir or None)
+        res = est.train_and_evaluate(est.train_input_fn, est.eval_input_fn,
+                                     args.max_steps, args.eval_steps)
+    else:
+        model = UnsupervisedGraphSage(
+            dim=args.hidden_dim, max_id=data.max_id, fanouts=fanouts,
+            aggregator=args.aggregator, num_negs=args.num_negs)
+        est = EdgeEstimator(
+            model,
+            dict(batch_size=args.batch_size, num_negs=args.num_negs,
+                 learning_rate=args.learning_rate, max_id=data.max_id),
+            data.engine, dataflow=flow, model_dir=args.model_dir or None)
+        res = est.train_and_evaluate(est.train_input_fn, est.eval_input_fn,
+                                     args.max_steps, args.eval_steps)
+    print(res)
+    return res
+
+
+if __name__ == "__main__":
+    main()

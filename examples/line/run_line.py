@@ -1,0 +1,54 @@
+"""LINE (1st/2nd-order proximity embeddings).
+
+Parity: examples/line/run_line.py. Positives are sampled edges; negatives
+global weighted node samples.
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="cora")
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--order", type=int, default=2, choices=[1, 2])
+    ap.add_argument("--num_negs", type=int, default=5)
+    ap.add_argument("--batch_size", type=int, default=128)
+    ap.add_argument("--learning_rate", type=float, default=0.025)
+    ap.add_argument("--max_steps", type=int, default=500)
+    ap.add_argument("--eval_steps", type=int, default=20)
+    ap.add_argument("--model_dir", default="")
+    args = ap.parse_args(argv)
+
+    from euler_tpu.dataset import get_dataset
+    from euler_tpu.estimator import BaseEstimator
+    from euler_tpu.models import LINE
+
+    data = get_dataset(args.dataset)
+    g = data.engine
+    model = LINE(max_id=data.max_id, dim=args.dim, order=args.order)
+    est = BaseEstimator(model,
+                        dict(learning_rate=args.learning_rate,
+                             max_id=data.max_id),
+                        model_dir=args.model_dir or None)
+
+    def input_fn():
+        while True:
+            src, dst, _ = g.sample_edge(args.batch_size, -1)
+            negs = g.sample_node(args.batch_size * args.num_negs, -1).reshape(
+                args.batch_size, args.num_negs)
+            yield {"src": src, "pos": dst, "negs": negs, "infer_ids": src}
+
+    res = est.train(input_fn, args.max_steps)
+    ev = est.evaluate(input_fn, args.eval_steps)
+    print({**{f"train_{k}": v for k, v in res.items()},
+           **{f"eval_{k}": v for k, v in ev.items()}})
+    return ev
+
+
+if __name__ == "__main__":
+    main()

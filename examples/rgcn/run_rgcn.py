@@ -1,0 +1,104 @@
+"""R-GCN link prediction on fb15k-family.
+
+Parity: examples/rgcn — relational conv encoder over entity neighborhoods
++ DistMult decoder on triples.
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import flax.linen as nn  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="fb15k237")
+    ap.add_argument("--dim", type=int, default=32)
+    ap.add_argument("--fanout", type=int, default=8)
+    ap.add_argument("--num_rel_sample", type=int, default=8,
+                    help="relations sampled per batch for aggregation")
+    ap.add_argument("--num_negs", type=int, default=16)
+    ap.add_argument("--batch_size", type=int, default=128)
+    ap.add_argument("--learning_rate", type=float, default=0.01)
+    ap.add_argument("--max_steps", type=int, default=300)
+    ap.add_argument("--eval_steps", type=int, default=20)
+    ap.add_argument("--model_dir", default="")
+    args = ap.parse_args(argv)
+
+    from euler_tpu.dataset import get_dataset
+    from euler_tpu.estimator import BaseEstimator
+    from euler_tpu.mp_utils.base import ModelOutput
+    from euler_tpu.utils import metrics as M
+    from euler_tpu.utils.layers import Embedding
+
+    kg = get_dataset(args.dataset)
+    g = kg.engine
+    R = args.num_rel_sample
+
+    class RGCNLinkModel(nn.Module):
+        """Entity embedding refined by per-relation mean of sampled
+        neighbor embeddings (RelationConv semantics on fanout batches),
+        scored by DistMult."""
+
+        @nn.compact
+        def __call__(self, batch):
+            ent = Embedding(kg.num_entities, args.dim, name="ent")
+            rel = Embedding(kg.num_relations, args.dim, name="rel")
+            w_rel = self.param(
+                "w_rel", nn.initializers.glorot_uniform(),
+                (R, args.dim, args.dim))
+
+            def encode(ids, nbr_ids):
+                # nbr_ids: [R, B, K]
+                h = ent(ids)
+                nbr = ent(nbr_ids).mean(axis=2)          # [R, B, D]
+                msg = jnp.einsum("rbd,rde->be", nbr, w_rel) / R
+                return nn.relu(h + msg)
+
+            h = encode(batch["h"], batch["h_nbrs"])
+            t = ent(batch["t"])
+            neg_t = ent(batch["neg_t"])                  # [B, N, D]
+            r = rel(batch["r"])
+            pos = (h * r * t).sum(-1, keepdims=True)
+            neg = jnp.einsum("bd,bnd->bn", h * r, neg_t)
+            loss = jnp.maximum(0.0, 1.0 - pos + neg).mean()
+            scores = jnp.concatenate([pos, neg], axis=1)
+            return ModelOutput(h, loss, "mrr", M.mrr(scores))
+
+    est = BaseEstimator(RGCNLinkModel(),
+                        dict(learning_rate=args.learning_rate),
+                        model_dir=args.model_dir or None)
+    rng = np.random.default_rng(0)
+    rel_pool = np.arange(kg.num_relations)
+
+    def input_fn():
+        while True:
+            h, t, r = g.sample_edge(args.batch_size, -1)
+            rels = rng.choice(rel_pool, R, replace=kg.num_relations < R)
+            nbrs = []
+            for rr in rels:
+                nb, _, _ = g.sample_neighbor(h, args.fanout,
+                                             edge_types=[int(rr)])
+                nbrs.append(nb)
+            neg_t = rng.integers(0, kg.num_entities,
+                                 (args.batch_size, args.num_negs))
+            yield {"h": h.astype(np.int64), "t": t.astype(np.int64),
+                   "r": r.astype(np.int32),
+                   "h_nbrs": np.stack(nbrs).astype(np.int64),
+                   "neg_t": neg_t.astype(np.int64), "infer_ids": h}
+
+    res = est.train(input_fn, args.max_steps)
+    ev = est.evaluate(input_fn, args.eval_steps)
+    print({**{f"train_{k}": v for k, v in res.items()},
+           **{f"eval_{k}": v for k, v in ev.items()}})
+    return ev
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Scalable GraphSAGE — 1-hop sampling + historical activation caches
+(parity: reference ScalableSageEncoder path, encoders.py:629)."""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="cora")
+    ap.add_argument("--hidden_dim", type=int, default=32)
+    ap.add_argument("--num_layers", type=int, default=2)
+    ap.add_argument("--fanout", type=int, default=10)
+    ap.add_argument("--batch_size", type=int, default=64)
+    ap.add_argument("--learning_rate", type=float, default=0.01)
+    ap.add_argument("--max_steps", type=int, default=200)
+    ap.add_argument("--eval_steps", type=int, default=20)
+    ap.add_argument("--model_dir", default="")
+    args = ap.parse_args(argv)
+
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.dataset import get_dataset
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.models import ScalableGraphSage
+
+    data = get_dataset(args.dataset)
+    model = ScalableGraphSage(
+        num_classes=data.num_classes, multilabel=data.multilabel,
+        dim=args.hidden_dim, num_layers=args.num_layers, max_id=data.max_id)
+    flow = FanoutDataFlow(data.engine, [args.fanout],
+                          feature_ids=["feature"])
+    est = NodeEstimator(
+        model,
+        dict(batch_size=args.batch_size, learning_rate=args.learning_rate,
+             max_id=data.max_id, label_dim=data.num_classes),
+        data.engine, flow, label_fid="label", label_dim=data.num_classes,
+        model_dir=args.model_dir or None)
+    res = est.train_and_evaluate(est.train_input_fn, est.eval_input_fn,
+                                 args.max_steps, args.eval_steps)
+    print(res)
+    return res
+
+
+if __name__ == "__main__":
+    main()

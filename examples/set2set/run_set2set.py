@@ -1,0 +1,20 @@
+"""SET2SET graph classification on mutag.
+
+Parity: examples/set2set. Baseline (BASELINE.md): accuracy set2set row.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from graph_common import graph_argparser, run_graph_model  # noqa: E402
+
+
+def main(argv=None):
+    args = graph_argparser().parse_args(argv)
+    return run_graph_model("gin", "set2set", args)
+
+
+if __name__ == "__main__":
+    main()

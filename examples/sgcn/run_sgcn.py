@@ -1,0 +1,20 @@
+"""SGCN on citation datasets.
+
+Parity: examples/sgcn/run_sgcn.py. Baseline (BASELINE.md): see sgcn row.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from common import citation_argparser, run_citation  # noqa: E402
+
+
+def main(argv=None):
+    args = citation_argparser().parse_args(argv)
+    return run_citation("sgcn", args, conv_kwargs={'k_hop': 2})
+
+
+if __name__ == "__main__":
+    main()

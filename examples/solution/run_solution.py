@@ -1,0 +1,54 @@
+"""Industrial solution templates (parity: examples/solution +
+examples/sample_solution): assemble supervised / unsupervised pipelines
+from the solution layer's parts."""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="cora")
+    ap.add_argument("--mode", default="supervise",
+                    choices=["supervise", "unsupervise"])
+    ap.add_argument("--fanouts", default="10,10")
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--logits", default="dot", choices=["dot", "cosine"])
+    ap.add_argument("--batch_size", type=int, default=64)
+    ap.add_argument("--learning_rate", type=float, default=0.01)
+    ap.add_argument("--max_steps", type=int, default=200)
+    ap.add_argument("--eval_steps", type=int, default=20)
+    ap.add_argument("--model_dir", default="")
+    args = ap.parse_args(argv)
+
+    from euler_tpu.dataset import get_dataset
+    from euler_tpu.estimator import BaseEstimator
+    from euler_tpu.solution import SuperviseSolution, UnsuperviseSolution
+
+    data = get_dataset(args.dataset)
+    fanouts = tuple(int(x) for x in args.fanouts.split(","))
+    if args.mode == "supervise":
+        sol = SuperviseSolution(
+            data.engine, fanouts=fanouts, dim=args.dim,
+            num_classes=data.num_classes, multilabel=data.multilabel,
+            batch_size=args.batch_size)
+    else:
+        sol = UnsuperviseSolution(
+            data.engine, fanouts=fanouts, dim=args.dim, max_id=data.max_id,
+            batch_size=args.batch_size, logits=args.logits)
+    est = BaseEstimator(sol.model,
+                        dict(learning_rate=args.learning_rate,
+                             max_id=data.max_id),
+                        model_dir=args.model_dir or None)
+    res = est.train(sol.input_fn, args.max_steps)
+    ev = est.evaluate(sol.input_fn, args.eval_steps)
+    print({**{f"train_{k}": v for k, v in res.items()},
+           **{f"eval_{k}": v for k, v in ev.items()}})
+    return ev
+
+
+if __name__ == "__main__":
+    main()

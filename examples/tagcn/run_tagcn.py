@@ -1,0 +1,20 @@
+"""TAGCN on citation datasets.
+
+Parity: examples/tagcn/run_tagcn.py. Baseline (BASELINE.md): see tagcn row.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from common import citation_argparser, run_citation  # noqa: E402
+
+
+def main(argv=None):
+    args = citation_argparser().parse_args(argv)
+    return run_citation("tag", args, conv_kwargs={'k_hop': 3})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,16 +1,15 @@
-"""Test configuration: force an 8-device virtual CPU mesh for sharding tests.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
-Must set XLA flags before jax initializes (hence at conftest import time).
+This environment preloads a TPU plugin via sitecustomize, so env vars like
+JAX_PLATFORMS / XLA_FLAGS set here are too late or overridden; the
+jax.config route switches the platform reliably (backend selection happens
+at first device query, which hasn't run yet at conftest import).
 """
 
-import os
+import jax
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np
 import pytest

@@ -1,0 +1,103 @@
+"""Encoders + models: shape/sanity on tiny dims (kept small: every init
+is an XLA compile on 1 CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from euler_tpu.utils import encoders as E
+
+B, K1, K2, D = 4, 3, 2, 6
+FANOUTS = (K1, K2)
+
+
+@pytest.fixture(scope="module")
+def fanout_layers():
+    rng = np.random.default_rng(0)
+    sizes = [B, B * K1, B * K1 * K2]
+    return [jnp.asarray(rng.normal(size=(s, D)), jnp.float32) for s in sizes]
+
+
+def test_sage_encoder(fanout_layers):
+    enc = E.SageEncoder(dim=8, fanouts=FANOUTS)
+    params = enc.init(jax.random.key(0), fanout_layers)
+    out = enc.apply(params, fanout_layers)
+    assert out.shape == (B, 16)  # concat=True → 2*dim
+
+
+def test_gcn_encoder(fanout_layers):
+    enc = E.GCNEncoder(dim=8, fanouts=FANOUTS)
+    params = enc.init(jax.random.key(0), fanout_layers)
+    assert enc.apply(params, fanout_layers).shape == (B, 8)
+
+
+def test_genie_encoder(fanout_layers):
+    enc = E.GenieEncoder(dim=8, fanouts=FANOUTS)
+    params = enc.init(jax.random.key(0), fanout_layers)
+    assert enc.apply(params, fanout_layers).shape == (B, 8)
+
+
+def test_shallow_encoder():
+    enc = E.ShallowEncoder(dim=8, max_id=50, use_feature=True)
+    ids = jnp.array([1, 2, 3])
+    feats = jnp.ones((3, 5))
+    params = enc.init(jax.random.key(0), ids, feats)
+    assert enc.apply(params, ids, feats).shape == (3, 16)
+
+
+def test_scalable_sage_cache_updates():
+    enc = E.ScalableSageEncoder(dim=8, num_layers=2, max_id=20)
+    ids = jnp.array([1, 2, 3])
+    x = jnp.ones((3, 8))
+    nbr_ids = jnp.array([[4, 5], [6, 7], [8, 9]])
+    nbr_x = jnp.ones((3, 2, 8))
+    variables = enc.init(jax.random.key(0), ids, x, nbr_ids, nbr_x)
+    out, updated = enc.apply(variables, ids, x, nbr_ids, nbr_x,
+                             mutable=["cache"])
+    assert out.shape == (3, 8)
+    cache = jax.tree_util.tree_leaves(updated["cache"])[0]
+    assert float(jnp.abs(cache[1:4]).sum()) > 0  # batch rows were written
+
+
+def test_layer_encoder():
+    m = [4, 6, 8]
+    layers = [jnp.ones((mi, D)) for mi in m]
+    adjs = [jnp.ones((m[i], m[i + 1])) / m[i + 1] for i in range(2)]
+    enc = E.LayerEncoder(dim=8)
+    params = enc.init(jax.random.key(0), layers, adjs)
+    assert enc.apply(params, layers, adjs).shape == (4, 8)
+
+
+def test_kg_models_train():
+    import optax
+
+    from euler_tpu.models import DistMult, TransD, TransE
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "h": jnp.asarray(rng.integers(0, 20, 8), jnp.int32),
+        "r": jnp.asarray(rng.integers(0, 4, 8), jnp.int32),
+        "t": jnp.asarray(rng.integers(0, 20, 8), jnp.int32),
+        "neg_t": jnp.asarray(rng.integers(0, 20, (8, 5)), jnp.int32),
+    }
+    for cls in (TransE, TransD, DistMult):
+        model = cls(num_entities=20, num_relations=4, dim=8)
+        params = model.init(jax.random.key(0), batch)
+        out = model.apply(params, batch)
+        assert out.loss.shape == ()
+        assert 0.0 <= float(out.metric) <= 1.0
+
+
+def test_deepwalk_model():
+    from euler_tpu.models import DeepWalk
+
+    batch = {
+        "src": jnp.array([1, 2], jnp.int32),
+        "pos": jnp.array([3, 4], jnp.int32),
+        "negs": jnp.array([[5, 6], [7, 8]], jnp.int32),
+    }
+    model = DeepWalk(max_id=10, dim=8)
+    params = model.init(jax.random.key(0), batch)
+    out = model.apply(params, batch)
+    assert out.embedding.shape == (2, 8)

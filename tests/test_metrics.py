@@ -1,0 +1,49 @@
+"""Metric unit tests (parity: reference metrics coverage)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from euler_tpu.utils import metrics as M
+
+
+def test_accuracy_multiclass():
+    logits = jnp.array([[2.0, 1.0], [0.0, 3.0], [5.0, 0.0]])
+    labels = jnp.array([0, 1, 1])
+    assert float(M.accuracy(logits, labels)) == pytest.approx(2 / 3)
+
+
+def test_auc_perfect_and_random():
+    scores = jnp.array([0.9, 0.8, 0.2, 0.1])
+    labels = jnp.array([1, 1, 0, 0])
+    assert float(M.auc(scores, labels)) == pytest.approx(1.0)
+    # pairs: (.9>.8)✓ (.9>.1)✓ (.2>.8)✗ (.2>.1)✓ → 3/4
+    labels2 = jnp.array([1, 0, 1, 0])
+    assert float(M.auc(scores, labels2)) == pytest.approx(0.75)
+
+
+def test_micro_f1_multilabel():
+    pred = jnp.array([[0.9, 0.1], [0.8, 0.7]])
+    labels = jnp.array([[1, 0], [1, 1]])
+    assert float(M.micro_f1(pred, labels)) == pytest.approx(1.0)
+
+
+def test_micro_f1_from_logits_int_labels():
+    logits = jnp.array([[3.0, 0.0], [0.0, 3.0]])
+    labels = jnp.array([0, 1])
+    assert float(M.micro_f1(logits, labels)) == pytest.approx(1.0)
+
+
+def test_rank_metrics():
+    # positive (col 0) is best in row 0, 3rd in row 1
+    scores = jnp.array([[5.0, 1.0, 2.0], [1.0, 3.0, 2.0]])
+    assert float(M.mr(scores)) == pytest.approx((1 + 3) / 2)
+    assert float(M.mrr(scores)) == pytest.approx((1 + 1 / 3) / 2)
+    assert float(M.hit_at_k(scores, 1)) == pytest.approx(0.5)
+    assert float(M.hit_at_k(scores, 3)) == pytest.approx(1.0)
+
+
+def test_get_metric():
+    assert M.get_metric("f1") is M.micro_f1
+    with pytest.raises(ValueError):
+        M.get_metric("nope")

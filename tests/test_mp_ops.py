@@ -1,0 +1,58 @@
+"""mp_ops unit tests (parity: reference mp_ops_test.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from euler_tpu.ops import mp_ops as mp
+
+
+def test_gather():
+    p = jnp.arange(12.0).reshape(4, 3)
+    out = mp.gather(p, jnp.array([2, 0]))
+    np.testing.assert_allclose(out, [[6, 7, 8], [0, 1, 2]])
+
+
+def test_scatter_add():
+    src = jnp.ones((4, 2))
+    idx = jnp.array([0, 1, 1, 2])
+    out = mp.scatter_add(src, idx, 3)
+    np.testing.assert_allclose(out[:, 0], [1, 2, 1])
+
+
+def test_scatter_mean_empty_segment():
+    src = jnp.array([[2.0], [4.0]])
+    idx = jnp.array([0, 0])
+    out = mp.scatter_mean(src, idx, 3)
+    np.testing.assert_allclose(out.ravel(), [3.0, 0.0, 0.0])
+
+
+def test_scatter_max():
+    src = jnp.array([[1.0], [5.0], [-2.0]])
+    idx = jnp.array([0, 0, 2])
+    out = mp.scatter_max(src, idx, 3)
+    assert out[0, 0] == 5.0
+    assert out[1, 0] == 0.0  # empty segment clamps to 0
+    assert out[2, 0] == -2.0
+
+
+def test_scatter_softmax_sums_to_one():
+    logits = jnp.array([1.0, 2.0, 3.0, -1.0])
+    idx = jnp.array([0, 0, 1, 1])
+    att = mp.scatter_softmax(logits, idx, 2)
+    assert att[0] + att[1] == pytest.approx(1.0, abs=1e-5)
+    assert att[2] + att[3] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_scatter_softmax_2d():
+    logits = jnp.ones((4, 3))
+    idx = jnp.array([0, 0, 1, 1])
+    att = mp.scatter_softmax(logits, idx, 2)
+    np.testing.assert_allclose(att, 0.5 * np.ones((4, 3)), atol=1e-5)
+
+
+def test_degree_norm():
+    ei = jnp.array([[0, 1, 2], [1, 1, 0]])
+    norm = mp.degree_norm(ei, 3)
+    assert norm.shape == (3,)
+    assert jnp.all(norm > 0)

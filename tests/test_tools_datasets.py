@@ -1,0 +1,100 @@
+"""Data-prep tool, dataset registry, KG sets, KNN tool."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def test_generate_data_roundtrip(tmp_path):
+    """JSON graph → binary partitions → engine load (parity with the
+    reference's generate_euler_data → euler load pipeline)."""
+    from euler_tpu.graph import GraphEngine
+    from euler_tpu.tools.generate_data import convert
+
+    graph = {
+        "nodes": [
+            {"id": 1, "type": 0, "weight": 2.0,
+             "features": [{"name": "f", "type": "dense", "value": [1, 2]},
+                          {"name": "s", "type": "sparse", "value": [7, 9]}]},
+            {"id": 2, "type": 1, "weight": 1.0,
+             "features": [{"name": "f", "type": "dense", "value": [3, 4]}]},
+            {"id": 3, "type": 0, "weight": 1.0, "features": []},
+        ],
+        "edges": [
+            {"src": 1, "dst": 2, "type": 0, "weight": 1.5,
+             "features": [{"name": "ef", "type": "dense", "value": [9]}]},
+            {"src": 2, "dst": 3, "type": 0, "weight": 1.0, "features": []},
+            {"src": 3, "dst": 1, "type": 1, "weight": 2.0, "features": []},
+        ],
+    }
+    jpath = tmp_path / "graph.json"
+    jpath.write_text(json.dumps(graph))
+    out = tmp_path / "bin"
+    stats = convert(str(jpath), str(out), num_partitions=2)
+    assert stats["nodes"] == 3 and stats["edges"] == 3
+
+    g = GraphEngine.load(str(out))
+    assert g.node_count == 3
+    assert g.edge_count == 3
+    f = g.get_dense_feature([1, 2], "f")
+    np.testing.assert_allclose(f, [[1, 2], [3, 4]])
+    off, vals = g.get_sparse_feature([1], "s")
+    assert list(vals) == [7, 9]
+    ef = g.get_edge_dense_feature(
+        np.array([1], np.uint64), np.array([2], np.uint64),
+        np.array([0], np.int32), "ef")
+    assert ef[0][0] == pytest.approx(9.0)
+    # shard 0 of 2 only loads partition 0
+    g0 = GraphEngine.load(str(out), shard_idx=0, shard_num=2)
+    g1 = GraphEngine.load(str(out), shard_idx=1, shard_num=2)
+    assert g0.node_count + g1.node_count == 3
+
+
+def test_dataset_registry():
+    from euler_tpu.dataset import get_dataset
+
+    data = get_dataset("cora", n=200, d=16, num_classes=3,
+                       train_per_class=5, val=30, test=30)
+    assert data.engine.node_count == 200
+    assert data.num_classes == 3
+    with pytest.raises(ValueError):
+        get_dataset("nope")
+
+
+def test_kg_dataset():
+    from euler_tpu.dataset import load_kg
+
+    kg = load_kg("wn18", num_triples=2000)
+    assert kg.num_relations == 18
+    assert kg.engine.num_edge_types == 18
+    h, t, r = kg.engine.sample_edge(16)
+    assert h.shape == (16,)
+    assert (r >= 0).all() and (r < 18).all()
+
+
+def test_mutag_like():
+    from euler_tpu.dataset import mutag_like
+
+    data = mutag_like(num_graphs=20)
+    assert len(data.graphs) == 20
+    assert set(data.labels) == {0, 1}
+    for g in data.graphs[:3]:
+        assert g["edge_index"].max() < g["x"].shape[0]
+
+
+def test_knn_index():
+    from euler_tpu.tools.knn import IVFFlatIndex, brute_force
+
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(500, 16)).astype(np.float32)
+    ids = np.arange(500, dtype=np.uint64)
+    queries = data[:3]
+    bf_ids, _ = brute_force(data, ids, queries, 5)
+
+    idx = IVFFlatIndex(nlist=16, nprobe=16)  # probe all lists → exact
+    idx.train_add(data, ids)
+    ivf_ids, _ = idx.search(queries, 5)
+    np.testing.assert_array_equal(ivf_ids, bf_ids)  # exhaustive probe == bf
