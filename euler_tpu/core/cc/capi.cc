@@ -17,6 +17,7 @@
 #include <string>
 #include <unordered_map>
 
+#include "capi_internal.h"
 #include "common.h"
 #include "graph.h"
 #include "io.h"
@@ -61,6 +62,14 @@ std::shared_ptr<et::Graph> GetGraph(int64_t h) {
 }
 
 }  // namespace
+
+namespace et {
+namespace capi {
+// Shared with capi_query.cc: resolve a Python-held graph handle.
+std::shared_ptr<Graph> GraphFromHandle(int64_t h) { return GetGraph(h); }
+int FailWith(const std::string& msg) { return Fail(msg); }
+}  // namespace capi
+}  // namespace et
 
 extern "C" {
 
@@ -211,10 +220,10 @@ int64_t etg_load(const char* dir, int shard_idx, int shard_num, int data_type,
   return h;
 }
 
-int etg_dump(int64_t h, const char* dir) {
+int etg_dump(int64_t h, const char* dir, int num_partitions) {
   auto g = GetGraph(h);
   if (!g) return Fail("bad graph handle");
-  et::Status s = g->Dump(dir);
+  et::Status s = et::DumpGraphPartitioned(*g, dir, num_partitions);
   return s.ok() ? 0 : Fail(s.message());
 }
 

@@ -1,0 +1,25 @@
+// Internal seam between the two extern-"C" translation units: capi.cc owns
+// the graph handle registry and thread-local error message; capi_query.cc
+// resolves graph handles and reports errors through it.
+#ifndef EULER_TPU_CAPI_INTERNAL_H_
+#define EULER_TPU_CAPI_INTERNAL_H_
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+namespace et {
+class Graph;
+namespace capi {
+
+// Resolve a Python-held graph handle (nullptr if unknown).
+std::shared_ptr<Graph> GraphFromHandle(int64_t h);
+
+// Record msg as the thread-local last error; returns the nonzero C error
+// code callers propagate.
+int FailWith(const std::string& msg);
+
+}  // namespace capi
+}  // namespace et
+
+#endif  // EULER_TPU_CAPI_INTERNAL_H_

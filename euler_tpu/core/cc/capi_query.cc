@@ -1,0 +1,306 @@
+// extern "C" surface for the query layer: QueryProxy, gremlin execution,
+// and graph service lifecycle.
+//
+// Capability parity with the reference's ctypes entries
+// tf_euler/utils/init_query_proxy.cc (InitQueryProxy) and
+// euler/service/python_api.cc (StartService) — restructured as
+// handle-based objects so one process can host several proxies/servers
+// (e.g. fork-free multi-shard tests).
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+#include "capi_internal.h"
+#include "common.h"
+#include "gql.h"
+#include "graph.h"
+#include "index.h"
+#include "io.h"
+#include "query_proxy.h"
+#include "rpc.h"
+#include "tensor.h"
+
+namespace {
+
+using et::capi::FailWith;
+
+struct QueryRegistry {
+  std::mutex mu;
+  int64_t next = 1;
+  std::unordered_map<int64_t, std::shared_ptr<et::QueryProxy>> proxies;
+  std::unordered_map<int64_t, std::shared_ptr<et::GraphServer>> servers;
+  // servers keep their graph alive
+  std::unordered_map<int64_t, std::shared_ptr<const et::Graph>> server_graphs;
+};
+
+QueryRegistry& QReg() {
+  static QueryRegistry* r = new QueryRegistry();
+  return *r;
+}
+
+// One in-flight query execution: staged inputs → run → held outputs.
+struct Exec {
+  std::shared_ptr<et::QueryProxy> proxy;
+  std::map<std::string, et::Tensor> inputs;
+  std::vector<std::pair<std::string, et::Tensor>> outputs;
+};
+
+struct ExecRegistry {
+  std::mutex mu;
+  int64_t next = 1;
+  std::unordered_map<int64_t, std::shared_ptr<Exec>> execs;
+};
+
+ExecRegistry& EReg() {
+  static ExecRegistry* r = new ExecRegistry();
+  return *r;
+}
+
+std::shared_ptr<Exec> GetExec(int64_t h) {
+  auto& r = EReg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.execs.find(h);
+  return it == r.execs.end() ? nullptr : it->second;
+}
+
+et::Tensor MakeTensor(int dtype, int rank, const int64_t* dims,
+                      const void* data) {
+  std::vector<int64_t> d(dims, dims + rank);
+  et::Tensor t(static_cast<et::DType>(dtype), d);
+  std::memcpy(t.raw(), data, t.ByteSize());
+  return t;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- QueryProxy ----
+int64_t etq_new_local(int64_t graph_handle, const char* index_spec,
+                      uint64_t seed) {
+  auto g = et::capi::GraphFromHandle(graph_handle);
+  if (!g) {
+    FailWith("bad graph handle");
+    return 0;
+  }
+  std::unique_ptr<et::QueryProxy> qp;
+  et::Status s = et::QueryProxy::NewLocal(g, index_spec ? index_spec : "",
+                                          seed, &qp);
+  if (!s.ok()) {
+    FailWith(s.message());
+    return 0;
+  }
+  auto& r = QReg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  int64_t h = r.next++;
+  r.proxies[h] = std::move(qp);
+  return h;
+}
+
+int64_t etq_new_remote(const char* endpoints, uint64_t seed) {
+  std::unique_ptr<et::QueryProxy> qp;
+  et::Status s = et::QueryProxy::NewRemote(endpoints, seed, &qp);
+  if (!s.ok()) {
+    FailWith(s.message());
+    return 0;
+  }
+  auto& r = QReg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  int64_t h = r.next++;
+  r.proxies[h] = std::move(qp);
+  return h;
+}
+
+int etq_free(int64_t h) {
+  auto& r = QReg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  r.proxies.erase(h);
+  return 0;
+}
+
+// ---- query execution ----
+int64_t etq_exec_new(int64_t proxy_handle) {
+  std::shared_ptr<et::QueryProxy> proxy;
+  {
+    auto& r = QReg();
+    std::lock_guard<std::mutex> lk(r.mu);
+    auto it = r.proxies.find(proxy_handle);
+    if (it == r.proxies.end()) {
+      FailWith("bad proxy handle");
+      return 0;
+    }
+    proxy = it->second;
+  }
+  auto e = std::make_shared<Exec>();
+  e->proxy = std::move(proxy);
+  auto& r = EReg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  int64_t h = r.next++;
+  r.execs[h] = std::move(e);
+  return h;
+}
+
+int etq_exec_add_input(int64_t h, const char* name, int dtype, int rank,
+                       const int64_t* dims, const void* data) {
+  auto e = GetExec(h);
+  if (!e) return FailWith("bad exec handle");
+  e->inputs[name] = MakeTensor(dtype, rank, dims, data);
+  return 0;
+}
+
+int etq_exec_run(int64_t h, const char* gremlin) {
+  auto e = GetExec(h);
+  if (!e) return FailWith("bad exec handle");
+  std::map<std::string, et::Tensor> outputs;
+  et::Status s = e->proxy->RunGremlin(gremlin, e->inputs, &outputs);
+  if (!s.ok()) return FailWith(s.message());
+  e->outputs.assign(outputs.begin(), outputs.end());
+  return 0;
+}
+
+int64_t etq_exec_output_count(int64_t h) {
+  auto e = GetExec(h);
+  return e ? static_cast<int64_t>(e->outputs.size()) : -1;
+}
+
+const char* etq_exec_output_name(int64_t h, int64_t i) {
+  auto e = GetExec(h);
+  if (!e || i < 0 || i >= static_cast<int64_t>(e->outputs.size()))
+    return "";
+  return e->outputs[i].first.c_str();
+}
+
+int etq_exec_output_info(int64_t h, int64_t i, int32_t* dtype,
+                         int32_t* rank, int64_t* num_elements) {
+  auto e = GetExec(h);
+  if (!e || i < 0 || i >= static_cast<int64_t>(e->outputs.size()))
+    return FailWith("bad output index");
+  const et::Tensor& t = e->outputs[i].second;
+  *dtype = static_cast<int32_t>(t.dtype());
+  *rank = static_cast<int32_t>(t.rank());
+  *num_elements = t.NumElements();
+  return 0;
+}
+
+int etq_exec_output_dims(int64_t h, int64_t i, int64_t* dims) {
+  auto e = GetExec(h);
+  if (!e || i < 0 || i >= static_cast<int64_t>(e->outputs.size()))
+    return FailWith("bad output index");
+  const et::Tensor& t = e->outputs[i].second;
+  for (size_t k = 0; k < t.rank(); ++k) dims[k] = t.dims()[k];
+  return 0;
+}
+
+const void* etq_exec_output_data(int64_t h, int64_t i) {
+  auto e = GetExec(h);
+  if (!e || i < 0 || i >= static_cast<int64_t>(e->outputs.size()))
+    return nullptr;
+  return e->outputs[i].second.raw();
+}
+
+int etq_exec_free(int64_t h) {
+  auto& r = EReg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  r.execs.erase(h);
+  return 0;
+}
+
+// ---- graph service ----
+// Start serving a shard loaded from a data directory. Returns a server
+// handle; port 0 picks an ephemeral port (query with ets_port).
+int64_t ets_start(const char* data_dir, int shard_idx, int shard_num,
+                  int port, const char* registry_dir, const char* host,
+                  const char* index_spec) {
+  std::unique_ptr<et::Graph> g;
+  et::Status s = et::LoadShard(data_dir, shard_idx, shard_num,
+                               /*data_type=*/0,
+                               /*build_in_adjacency=*/true, &g);
+  if (!s.ok()) {
+    FailWith(s.message());
+    return 0;
+  }
+  std::shared_ptr<const et::Graph> graph(std::move(g));
+  std::shared_ptr<et::IndexManager> index;
+  if (index_spec != nullptr && index_spec[0] != '\0') {
+    index = std::make_shared<et::IndexManager>();
+    s = index->BuildFromSpec(*graph, index_spec);
+    if (!s.ok()) {
+      FailWith(s.message());
+      return 0;
+    }
+  }
+  auto server = std::make_shared<et::GraphServer>(
+      graph, index, shard_idx, shard_num, graph->meta().partition_num);
+  s = server->Start(port);
+  if (!s.ok()) {
+    FailWith(s.message());
+    return 0;
+  }
+  if (registry_dir != nullptr && registry_dir[0] != '\0') {
+    s = server->Register(registry_dir, host && host[0] ? host : "127.0.0.1");
+    if (!s.ok()) {
+      FailWith(s.message());
+      return 0;
+    }
+  }
+  auto& r = QReg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  int64_t h = r.next++;
+  r.servers[h] = server;
+  r.server_graphs[h] = graph;
+  return h;
+}
+
+int ets_port(int64_t h) {
+  auto& r = QReg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.servers.find(h);
+  return it == r.servers.end() ? -1 : it->second->port();
+}
+
+int ets_stop(int64_t h) {
+  std::shared_ptr<et::GraphServer> server;
+  {
+    auto& r = QReg();
+    std::lock_guard<std::mutex> lk(r.mu);
+    auto it = r.servers.find(h);
+    if (it != r.servers.end()) {
+      server = it->second;
+      r.servers.erase(it);
+      r.server_graphs.erase(h);
+    }
+  }
+  if (server) server->Stop();
+  return 0;
+}
+
+// ---- compiler debug (golden structure tests) ----
+// Compile a gremlin under the given sharding options; writes the DAG dump
+// into buf (truncated to buf_len), returns needed length or -1 on error.
+int64_t etq_compile_debug(const char* gremlin, int shard_num,
+                          int partition_num, const char* mode, char* buf,
+                          int64_t buf_len) {
+  et::CompileOptions opts;
+  opts.shard_num = shard_num;
+  opts.partition_num = partition_num;
+  opts.mode = mode;
+  et::GqlCompiler compiler(opts);
+  std::shared_ptr<const et::TranslateResult> plan;
+  et::Status s = compiler.Compile(gremlin, &plan);
+  if (!s.ok()) {
+    FailWith(s.message());
+    return -1;
+  }
+  std::string text = et::DagToString(plan->dag);
+  int64_t n = static_cast<int64_t>(text.size());
+  if (buf != nullptr && buf_len > 0) {
+    int64_t c = std::min(buf_len - 1, n);
+    std::memcpy(buf, text.data(), c);
+    buf[c] = '\0';
+  }
+  return n;
+}
+
+}  // extern "C"

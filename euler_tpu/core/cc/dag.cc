@@ -1,0 +1,168 @@
+#include "dag.h"
+
+#include <condition_variable>
+#include <unordered_map>
+
+namespace et {
+
+// ---------------------------------------------------------------------------
+// Kernel registry
+// ---------------------------------------------------------------------------
+namespace {
+std::unordered_map<std::string, std::unique_ptr<OpKernel>>& Registry() {
+  static auto* m =
+      new std::unordered_map<std::string, std::unique_ptr<OpKernel>>();
+  return *m;
+}
+std::mutex& RegistryMu() {
+  static std::mutex mu;
+  return mu;
+}
+}  // namespace
+
+OpKernel* LookupKernel(const std::string& op) {
+  std::lock_guard<std::mutex> lk(RegistryMu());
+  auto it = Registry().find(op);
+  return it == Registry().end() ? nullptr : it->second.get();
+}
+
+void RegisterKernel(const std::string& op, std::unique_ptr<OpKernel> k) {
+  std::lock_guard<std::mutex> lk(RegistryMu());
+  Registry()[op] = std::move(k);
+}
+
+// ---------------------------------------------------------------------------
+// Dependency resolution
+// ---------------------------------------------------------------------------
+// "SAMPLE_NODE_1:0" → producer node name "SAMPLE_NODE_1". Names without a
+// ":idx" suffix (external inputs) or with an unknown producer resolve to -1.
+static std::string ProducerOf(const std::string& tensor_name) {
+  auto pos = tensor_name.rfind(':');
+  if (pos == std::string::npos) return tensor_name;
+  return tensor_name.substr(0, pos);
+}
+
+bool TopologicSort(const DAGDef& dag, std::vector<int>* order) {
+  std::unordered_map<std::string, int> by_name;
+  for (size_t i = 0; i < dag.nodes.size(); ++i)
+    by_name[dag.nodes[i].name] = static_cast<int>(i);
+  std::vector<int> indeg(dag.nodes.size(), 0);
+  std::vector<std::vector<int>> succ(dag.nodes.size());
+  for (size_t i = 0; i < dag.nodes.size(); ++i) {
+    for (const auto& in : dag.nodes[i].inputs) {
+      auto it = by_name.find(ProducerOf(in));
+      if (it != by_name.end() && it->second != static_cast<int>(i)) {
+        succ[it->second].push_back(static_cast<int>(i));
+        indeg[i]++;
+      }
+    }
+  }
+  order->clear();
+  std::vector<int> stack;
+  for (size_t i = 0; i < indeg.size(); ++i)
+    if (indeg[i] == 0) stack.push_back(static_cast<int>(i));
+  while (!stack.empty()) {
+    int n = stack.back();
+    stack.pop_back();
+    order->push_back(n);
+    for (int s : succ[n])
+      if (--indeg[s] == 0) stack.push_back(s);
+  }
+  return order->size() == dag.nodes.size();
+}
+
+// ---------------------------------------------------------------------------
+// Executor
+// ---------------------------------------------------------------------------
+Executor::Executor(const DAGDef* dag, const QueryEnv& env,
+                   OpKernelContext* ctx)
+    : dag_(dag), env_(env), ctx_(ctx), remaining_nodes_(0), failed_(false) {
+  if (env_.pool == nullptr) env_.pool = GlobalThreadPool();
+  std::unordered_map<std::string, int> by_name;
+  for (size_t i = 0; i < dag->nodes.size(); ++i)
+    by_name[dag->nodes[i].name] = static_cast<int>(i);
+  nodes_.resize(dag->nodes.size());
+  for (size_t i = 0; i < dag->nodes.size(); ++i) {
+    nodes_[i].def = &dag->nodes[i];
+    int deps = 0;
+    for (const auto& in : dag->nodes[i].inputs) {
+      auto it = by_name.find(ProducerOf(in));
+      if (it != by_name.end() && it->second != static_cast<int>(i)) {
+        nodes_[it->second].successors.push_back(static_cast<int>(i));
+        deps++;
+      }
+    }
+    nodes_[i].remaining.store(deps);
+  }
+  remaining_nodes_.store(static_cast<int>(nodes_.size()));
+}
+
+void Executor::Run(std::function<void(Status)> done) {
+  done_ = std::move(done);
+  if (nodes_.empty()) {
+    done_(Status::OK());
+    return;
+  }
+  std::vector<int> ready;
+  for (size_t i = 0; i < nodes_.size(); ++i)
+    if (nodes_[i].remaining.load() == 0) ready.push_back(static_cast<int>(i));
+  if (ready.empty()) {
+    done_(Status::Internal("query DAG has a cycle"));
+    return;
+  }
+  for (int idx : ready) {
+    env_.pool->Schedule([this, idx] { Dispatch(idx); });
+  }
+}
+
+void Executor::Dispatch(int idx) {
+  const NodeDef& def = *nodes_[idx].def;
+  if (failed_.load()) {  // fail fast: skip work, still retire the node
+    OnNodeDone(idx, Status::OK());
+    return;
+  }
+  OpKernel* k = LookupKernel(def.op);
+  if (k == nullptr) {
+    OnNodeDone(idx, Status::NotFound("no kernel for op: " + def.op));
+    return;
+  }
+  k->Compute(def, env_, ctx_, [this, idx](Status s) { OnNodeDone(idx, s); });
+}
+
+void Executor::OnNodeDone(int idx, const Status& s) {
+  if (!s.ok()) {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    if (!failed_.exchange(true)) first_error_ = s;
+  }
+  for (int succ : nodes_[idx].successors) {
+    if (nodes_[succ].remaining.fetch_sub(1) == 1) {
+      env_.pool->Schedule([this, succ] { Dispatch(succ); });
+    }
+  }
+  if (remaining_nodes_.fetch_sub(1) == 1) {
+    Status final = Status::OK();
+    {
+      std::lock_guard<std::mutex> lk(err_mu_);
+      if (failed_.load()) final = first_error_;
+    }
+    done_(final);
+  }
+}
+
+Status Executor::RunSync() {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool finished = false;
+  Status result;
+  Run([&](Status s) {
+    std::lock_guard<std::mutex> lk(mu);
+    result = s;
+    finished = true;
+    cv.notify_one();
+  });
+  std::unique_lock<std::mutex> lk(mu);
+  cv.wait(lk, [&] { return finished; });
+  return result;
+}
+
+}  // namespace et

@@ -1,0 +1,168 @@
+// Query dataflow IR + dependency-counting parallel executor.
+//
+// Capability parity with the reference's euler/core/dag/ (runtime DAG),
+// euler/core/dag_def/ (mutable rewrite IR) and euler/core/framework/
+// executor.cc (SURVEY.md §2.1). Redesigned: one NodeDef struct serves as
+// both the rewrite IR and the runtime node (the reference's DAGProto round
+// trip is replaced by direct construction); dependencies are resolved from
+// tensor names ("producer:idx"), so inserting split/REMOTE/merge nodes is
+// just renaming inputs. The executor is the same design as the reference's
+// (executor.cc:37-95): atomic remaining-dep counters, ready nodes scheduled
+// onto a thread pool, async kernels chain through a done callback.
+#ifndef EULER_TPU_DAG_H_
+#define EULER_TPU_DAG_H_
+
+#include <atomic>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "tensor.h"
+#include "threadpool.h"
+
+namespace et {
+
+class Graph;
+class IndexManager;
+class ClientManager;
+
+// One operator instance in a query plan. `inputs` are tensor names — either
+// another node's output ("SAMPLE_NODE_1:0") or an externally provided query
+// input. Outputs are implicitly named name+":i". Parity: reference
+// DAGNodeProto {name, op, inputs, dnf, post_process, shard_idx, inner_nodes}
+// (euler/proto/dag_node.proto:11-28).
+struct NodeDef {
+  std::string name;
+  std::string op;
+  std::vector<std::string> inputs;
+  // Positional op-specific string attributes (edge types, counts, feature
+  // names...). Parsed by each kernel.
+  std::vector<std::string> attrs;
+  // Filter condition in disjunctive normal form: dnf[i] is a conjunction of
+  // "attr cmp value" terms, e.g. {"price gt 3", "label eq A"}.
+  std::vector<std::vector<std::string>> dnf;
+  // Post-process directives: "order_by <field> <asc|desc>", "limit <k>",
+  // "as <alias>".
+  std::vector<std::string> post_process;
+  // REMOTE only: target shard and the sub-plan to run there.
+  int shard_idx = -1;
+  std::vector<NodeDef> inner;
+
+  std::string OutName(int i) const { return name + ":" + std::to_string(i); }
+};
+
+// A mutable query plan: ordered list of NodeDefs with unique names.
+// The GQL translator emits one, optimizer passes rewrite it in place.
+struct DAGDef {
+  std::vector<NodeDef> nodes;
+  int next_id = 0;
+
+  std::string UniqueName(const std::string& op) {
+    return op + "_" + std::to_string(next_id++);
+  }
+  NodeDef* Find(const std::string& name) {
+    for (auto& n : nodes)
+      if (n.name == name) return &n;
+    return nullptr;
+  }
+  const NodeDef* Find(const std::string& name) const {
+    for (auto& n : nodes)
+      if (n.name == name) return &n;
+    return nullptr;
+  }
+};
+
+// Everything a kernel may touch besides the context. Null members are
+// simply unavailable in that mode (e.g. no ClientManager in local mode).
+struct QueryEnv {
+  const Graph* graph = nullptr;
+  IndexManager* index = nullptr;
+  ClientManager* client = nullptr;
+  ThreadPool* pool = nullptr;
+  uint64_t seed = 0;  // 0 → thread-local RNG; nonzero → deterministic
+  // Per-execution counter mixed into kernel RNG streams so a seeded proxy
+  // still draws fresh samples on every run (only the sequence across runs
+  // is reproducible, not each run identical).
+  uint64_t nonce = 0;
+};
+
+// Stateless kernel; one singleton per op name serves all queries
+// concurrently. Parity: reference OpKernel/AsyncOpKernel
+// (framework/op_kernel.h:38,59) — collapsed into one async signature; sync
+// kernels just call done inline.
+class OpKernel {
+ public:
+  virtual ~OpKernel() = default;
+  virtual void Compute(const NodeDef& node, const QueryEnv& env,
+                       OpKernelContext* ctx,
+                       std::function<void(Status)> done) = 0;
+};
+
+// Global op registry. Parity: REGISTER_OP_KERNEL (op_kernel.h:106).
+OpKernel* LookupKernel(const std::string& op);
+void RegisterKernel(const std::string& op, std::unique_ptr<OpKernel> k);
+
+template <typename K>
+struct KernelRegistrar {
+  explicit KernelRegistrar(const char* op) {
+    RegisterKernel(op, std::unique_ptr<OpKernel>(new K()));
+  }
+};
+#define ET_REGISTER_KERNEL(op, K) \
+  static ::et::KernelRegistrar<K> et_reg_##K(op)
+
+// Executes a DAGDef against a context: resolves tensor-name dependencies,
+// schedules ready nodes on the pool, calls done(status) once all nodes
+// finish (or the first error aborts). One Executor per query; safe to
+// delete after done fires.
+class Executor {
+ public:
+  Executor(const DAGDef* dag, const QueryEnv& env, OpKernelContext* ctx);
+
+  // Asynchronous; done is invoked exactly once, possibly on a pool thread.
+  void Run(std::function<void(Status)> done);
+
+  // Convenience: block until completion.
+  Status RunSync();
+
+ private:
+  struct RtNode {
+    const NodeDef* def;
+    std::atomic<int> remaining;
+    std::vector<int> successors;
+    RtNode() : def(nullptr), remaining(0) {}
+    RtNode(RtNode&& o) noexcept
+        : def(o.def),
+          remaining(o.remaining.load()),
+          successors(std::move(o.successors)) {}
+    RtNode& operator=(RtNode&& o) noexcept {
+      def = o.def;
+      remaining.store(o.remaining.load());
+      successors = std::move(o.successors);
+      return *this;
+    }
+  };
+
+  void Dispatch(int idx);
+  void OnNodeDone(int idx, const Status& s);
+
+  const DAGDef* dag_;
+  QueryEnv env_;
+  OpKernelContext* ctx_;
+  std::vector<RtNode> nodes_;
+  std::atomic<int> remaining_nodes_;
+  std::atomic<bool> failed_;
+  std::mutex err_mu_;
+  Status first_error_;
+  std::function<void(Status)> done_;
+};
+
+// Topological order of node indices; returns false on a cycle.
+bool TopologicSort(const DAGDef& dag, std::vector<int>* order);
+
+}  // namespace et
+
+#endif  // EULER_TPU_DAG_H_

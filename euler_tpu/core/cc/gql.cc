@@ -1,0 +1,839 @@
+#include "gql.h"
+
+#include <algorithm>
+#include <cctype>
+#include <sstream>
+#include <unordered_set>
+
+namespace et {
+
+// ---------------------------------------------------------------------------
+// Lexer + parser
+// ---------------------------------------------------------------------------
+// Tokens: '.', '(', ')', ',' and words (identifiers/numbers/'*'/':'-lists).
+// A chain is call ('.' call)*; call is name '(' arg (',' arg)* ')'; an arg
+// is one or more whitespace-separated words (conditions keep their and/or
+// words: has(price gt 3 and label eq A)).
+Status ParseGql(const std::string& q, std::vector<GqlCall>* calls) {
+  calls->clear();
+  size_t i = 0;
+  auto skip_ws = [&] {
+    while (i < q.size() && std::isspace(static_cast<unsigned char>(q[i]))) ++i;
+  };
+  auto word = [&]() -> std::string {
+    size_t b = i;
+    while (i < q.size() && (std::isalnum(static_cast<unsigned char>(q[i])) ||
+                            q[i] == '_' || q[i] == '*' || q[i] == ':' ||
+                            q[i] == '-' || q[i] == '+'))
+      ++i;
+    return q.substr(b, i - b);
+  };
+  skip_ws();
+  while (i < q.size()) {
+    GqlCall call;
+    skip_ws();
+    call.name = word();
+    if (call.name.empty())
+      return Status::InvalidArgument("expected call name at pos " +
+                                     std::to_string(i) + " in: " + q);
+    skip_ws();
+    if (i >= q.size() || q[i] != '(')
+      return Status::InvalidArgument("expected ( after " + call.name);
+    ++i;  // consume (
+    std::vector<std::string> arg;
+    for (;;) {
+      skip_ws();
+      if (i >= q.size())
+        return Status::InvalidArgument("unterminated ( in: " + q);
+      if (q[i] == ')') {
+        ++i;
+        if (!arg.empty()) call.args.push_back(std::move(arg));
+        break;
+      }
+      if (q[i] == ',') {
+        ++i;
+        if (arg.empty())
+          return Status::InvalidArgument("empty argument in " + call.name);
+        call.args.push_back(std::move(arg));
+        arg.clear();
+        continue;
+      }
+      std::string w = word();
+      if (w.empty())
+        return Status::InvalidArgument("bad character '" +
+                                       std::string(1, q[i]) + "' in: " + q);
+      arg.push_back(std::move(w));
+    }
+    calls->push_back(std::move(call));
+    skip_ws();
+    if (i < q.size()) {
+      if (q[i] != '.')
+        return Status::InvalidArgument("expected . between calls in: " + q);
+      ++i;
+    }
+  }
+  if (calls->empty()) return Status::InvalidArgument("empty query");
+  return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// Translator
+// ---------------------------------------------------------------------------
+namespace {
+
+std::string JoinWords(const std::vector<std::string>& ws) {
+  std::string out;
+  for (size_t i = 0; i < ws.size(); ++i) {
+    if (i) out += " ";
+    out += ws[i];
+  }
+  return out;
+}
+
+// Words like {price, gt, 3, and, a, eq, b, or, x, lt, 2} → DNF
+// {{"price gt 3","a eq b"},{"x lt 2"}}.
+Status WordsToDnf(const std::vector<std::string>& ws,
+                  std::vector<std::vector<std::string>>* dnf) {
+  std::vector<std::vector<std::string>> disj;
+  std::vector<std::string> conj;
+  std::vector<std::string> term;
+  auto flush_term = [&]() -> Status {
+    if (term.size() != 3)
+      return Status::InvalidArgument("condition term must be 'attr op value'"
+                                     ", got: " + JoinWords(term));
+    conj.push_back(term[0] + " " + term[1] + " " + term[2]);
+    term.clear();
+    return Status::OK();
+  };
+  for (const auto& w : ws) {
+    if (w == "and") {
+      ET_RETURN_IF_ERROR(flush_term());
+    } else if (w == "or") {
+      ET_RETURN_IF_ERROR(flush_term());
+      disj.push_back(std::move(conj));
+      conj.clear();
+    } else {
+      term.push_back(w);
+    }
+  }
+  ET_RETURN_IF_ERROR(flush_term());
+  disj.push_back(std::move(conj));
+  *dnf = std::move(disj);
+  return Status::OK();
+}
+
+// AND-combine two DNFs (cross product of conjunctions).
+std::vector<std::vector<std::string>> AndDnf(
+    const std::vector<std::vector<std::string>>& a,
+    const std::vector<std::vector<std::string>>& b) {
+  if (a.empty()) return b;
+  if (b.empty()) return a;
+  std::vector<std::vector<std::string>> out;
+  for (const auto& ca : a)
+    for (const auto& cb : b) {
+      std::vector<std::string> c = ca;
+      c.insert(c.end(), cb.begin(), cb.end());
+      out.push_back(std::move(c));
+    }
+  return out;
+}
+
+struct TransState {
+  DAGDef* dag;
+  // current node-id set tensor (empty if none)
+  std::string cur_ids;
+  // current edge triple (src, dst, type) tensor names (empty if none)
+  std::vector<std::string> cur_edge;
+  // last emitted node + its output tensor names
+  std::string last_node;
+  std::vector<std::string> last_outputs;
+  // last ragged quad outputs (idx, ids, w, t) for post-process/filter
+  std::vector<std::string> last_quad;
+
+  NodeDef* Emit(const std::string& op, std::vector<std::string> inputs,
+                std::vector<std::string> attrs, int n_outputs) {
+    NodeDef n;
+    n.name = dag->UniqueName(op);
+    n.op = op;
+    n.inputs = std::move(inputs);
+    n.attrs = std::move(attrs);
+    dag->nodes.push_back(std::move(n));
+    NodeDef* p = &dag->nodes.back();
+    last_node = p->name;
+    last_outputs.clear();
+    for (int i = 0; i < n_outputs; ++i)
+      last_outputs.push_back(p->OutName(i));
+    return p;
+  }
+};
+
+}  // namespace
+
+Status TranslateGql(const std::vector<GqlCall>& calls, TranslateResult* out) {
+  out->dag = DAGDef();
+  out->aliases.clear();
+  TransState st;
+  st.dag = &out->dag;
+
+  for (size_t ci = 0; ci < calls.size(); ++ci) {
+    const GqlCall& c = calls[ci];
+    auto arg = [&](size_t i) -> std::string {
+      return i < c.args.size() ? JoinWords(c.args[i]) : std::string();
+    };
+    auto argw = [&](size_t i, const std::string& dflt) {
+      std::string v = arg(i);
+      return v.empty() ? dflt : v;
+    };
+
+    if (c.name == "v") {
+      // v(roots) — external u64 id input
+      if (c.args.empty())
+        return Status::InvalidArgument("v() needs an input tensor name");
+      st.cur_ids = arg(0);
+      st.cur_edge.clear();
+      st.last_node.clear();
+      st.last_outputs = {st.cur_ids};
+      st.last_quad.clear();
+    } else if (c.name == "e") {
+      // e(batch) — external (batch:0, batch:1, batch:2) = src, dst, type
+      if (c.args.empty())
+        return Status::InvalidArgument("e() needs an input tensor name");
+      std::string b = arg(0);
+      st.cur_edge = {b + ":0", b + ":1", b + ":2"};
+      st.cur_ids.clear();
+      st.last_node.clear();
+      st.last_outputs = st.cur_edge;
+      st.last_quad.clear();
+    } else if (c.name == "sampleN") {
+      // sampleN(type, count)
+      st.Emit("API_SAMPLE_NODE", {},
+              {argw(1, "0"), argw(0, "-1")}, 1);
+      st.cur_ids = st.last_outputs[0];
+      st.cur_edge.clear();
+      st.last_quad.clear();
+    } else if (c.name == "sampleE") {
+      NodeDef* n = st.Emit("API_SAMPLE_EDGE", {},
+                           {argw(1, "0"), argw(0, "-1")}, 3);
+      st.cur_edge = {n->OutName(0), n->OutName(1), n->OutName(2)};
+      st.cur_ids.clear();
+      st.last_quad.clear();
+    } else if (c.name == "sampleNWithTypes") {
+      if (c.args.empty())
+        return Status::InvalidArgument("sampleNWithTypes needs a types input");
+      st.Emit("API_SAMPLE_N_WITH_TYPES", {arg(0)}, {}, 1);
+      st.cur_ids = st.last_outputs[0];
+      st.cur_edge.clear();
+      st.last_quad.clear();
+    } else if (c.name == "sampleNB") {
+      // sampleNB(edge_types, count, default_id)
+      if (st.cur_ids.empty())
+        return Status::InvalidArgument("sampleNB without a node set");
+      st.Emit("API_SAMPLE_NB", {st.cur_ids},
+              {argw(0, "*"), argw(1, "1"), argw(2, "0")}, 4);
+      st.last_quad = st.last_outputs;
+      st.cur_ids = st.last_outputs[1];
+    } else if (c.name == "sampleLNB") {
+      // sampleLNB(edge_types, layer_sizes m0:m1:..., default_id)
+      if (st.cur_ids.empty())
+        return Status::InvalidArgument("sampleLNB without a node set");
+      std::string sizes = argw(1, "1");
+      int n_layers = 1 + static_cast<int>(std::count(sizes.begin(),
+                                                     sizes.end(), ':'));
+      st.Emit("API_SAMPLE_L", {st.cur_ids},
+              {argw(0, "*"), sizes, argw(2, "0")}, n_layers);
+      st.cur_ids = st.last_outputs.back();
+      st.last_quad.clear();
+    } else if (c.name == "outV" || c.name == "getNB") {
+      if (st.cur_ids.empty())
+        return Status::InvalidArgument(c.name + " without a node set");
+      st.Emit("API_GET_NB_NODE", {st.cur_ids}, {argw(0, "*")}, 4);
+      st.last_quad = st.last_outputs;
+      st.cur_ids = st.last_outputs[1];
+    } else if (c.name == "getSortedNB") {
+      st.Emit("API_GET_SORTED_NB_NODE", {st.cur_ids}, {argw(0, "*")}, 4);
+      st.last_quad = st.last_outputs;
+      st.cur_ids = st.last_outputs[1];
+    } else if (c.name == "inV" || c.name == "getRNB") {
+      if (st.cur_ids.empty())
+        return Status::InvalidArgument(c.name + " without a node set");
+      st.Emit("API_GET_RNB_NODE", {st.cur_ids}, {argw(0, "*")}, 4);
+      st.last_quad = st.last_outputs;
+      st.cur_ids = st.last_outputs[1];
+    } else if (c.name == "getTopKNB") {
+      st.Emit("API_GET_TOPK_NB", {st.cur_ids},
+              {argw(0, "*"), argw(1, "1")}, 4);
+      st.last_quad = st.last_outputs;
+      st.cur_ids = st.last_outputs[1];
+    } else if (c.name == "values" || c.name == "udf") {
+      std::vector<std::string> attrs;
+      size_t a0 = 0;
+      if (c.name == "udf") {
+        attrs.push_back("udf:" + arg(0));
+        a0 = 1;
+      }
+      for (size_t i = a0; i < c.args.size(); ++i) attrs.push_back(arg(i));
+      int nf = static_cast<int>(attrs.size() - a0);
+      if (!st.cur_edge.empty()) {
+        st.Emit("API_GET_EDGE_P", st.cur_edge, attrs, 2 * nf);
+      } else if (!st.cur_ids.empty()) {
+        st.Emit("API_GET_P", {st.cur_ids}, attrs, 2 * nf);
+      } else {
+        return Status::InvalidArgument("values() without a node/edge set");
+      }
+      st.last_quad.clear();
+    } else if (c.name == "label") {
+      if (st.cur_ids.empty())
+        return Status::InvalidArgument("label() without a node set");
+      st.Emit("API_GET_NODE_T", {st.cur_ids}, {}, 1);
+      st.last_quad.clear();
+    } else if (c.name == "has" || c.name == "hasLabel" ||
+               c.name == "hasKey" || c.name == "hasId") {
+      std::vector<std::vector<std::string>> dnf;
+      if (c.name == "has") {
+        if (c.args.empty())
+          return Status::InvalidArgument("empty has()");
+        // args joined by commas are AND-ed conjunctions
+        std::vector<std::string> words;
+        for (size_t i = 0; i < c.args.size(); ++i) {
+          if (i) words.push_back("and");
+          words.insert(words.end(), c.args[i].begin(), c.args[i].end());
+        }
+        ET_RETURN_IF_ERROR(WordsToDnf(words, &dnf));
+      } else if (c.name == "hasLabel") {
+        dnf = {{"node_type eq " + arg(0)}};
+      } else if (c.name == "hasKey") {
+        dnf = {{arg(0) + " hk _"}};
+      } else {  // hasId(x) — membership in an id list "a:b:c"
+        dnf = {{"id in " + arg(0)}};
+      }
+      // Attach to the producing node (condition pushdown): sampling roots
+      // take the dnf directly; a bare v() input gets an API_GET_NODE
+      // filter; a quad gets API_GET_NB_FILTER on the neighbors.
+      NodeDef* target =
+          st.last_node.empty() ? nullptr : st.dag->Find(st.last_node);
+      if (target != nullptr && (target->op == "API_SAMPLE_NODE" ||
+                                target->op == "API_GET_NODE")) {
+        target->dnf = AndDnf(target->dnf, dnf);
+      } else if (!st.last_quad.empty()) {
+        std::vector<std::string> quad = st.last_quad;
+        NodeDef* f = st.Emit("API_GET_NB_FILTER", quad, {}, 4);
+        f->dnf = dnf;
+        st.last_quad = st.last_outputs;
+        st.cur_ids = st.last_outputs[1];
+      } else if (!st.cur_ids.empty()) {
+        NodeDef* f = st.Emit("API_GET_NODE", {st.cur_ids}, {}, 2);
+        f->dnf = dnf;
+        st.cur_ids = st.last_outputs[0];
+      } else {
+        return Status::InvalidArgument(c.name + " with nothing to filter");
+      }
+    } else if (c.name == "orderBy" || c.name == "order_by") {
+      if (st.last_quad.empty())
+        return Status::InvalidArgument("orderBy needs neighbor results");
+      NodeDef* target = st.dag->Find(st.last_node);
+      if (target != nullptr && target->op == "POST_PROCESS") {
+        target->post_process.push_back("order_by " + argw(0, "weight") + " " +
+                                       argw(1, "asc"));
+      } else {
+        std::vector<std::string> quad = st.last_quad;
+        NodeDef* pp = st.Emit("POST_PROCESS", quad, {}, 4);
+        pp->post_process.push_back("order_by " + argw(0, "weight") + " " +
+                                   argw(1, "asc"));
+        st.last_quad = st.last_outputs;
+        st.cur_ids = st.last_outputs[1];
+      }
+    } else if (c.name == "limit") {
+      if (st.last_quad.empty())
+        return Status::InvalidArgument("limit needs neighbor results");
+      NodeDef* target = st.dag->Find(st.last_node);
+      if (target != nullptr && target->op == "POST_PROCESS") {
+        target->post_process.push_back("limit " + argw(0, "0"));
+      } else {
+        std::vector<std::string> quad = st.last_quad;
+        NodeDef* pp = st.Emit("POST_PROCESS", quad, {}, 4);
+        pp->post_process.push_back("limit " + argw(0, "0"));
+        st.last_quad = st.last_outputs;
+        st.cur_ids = st.last_outputs[1];
+      }
+    } else if (c.name == "as") {
+      if (c.args.empty()) return Status::InvalidArgument("as() needs a name");
+      std::vector<std::string> ins = st.last_outputs;
+      NodeDef* n = st.Emit("AS", ins, {arg(0)},
+                           static_cast<int>(ins.size()));
+      (void)n;
+      out->aliases.push_back(arg(0));
+      // as() is transparent: keep cur/last pointing at the aliased data
+      if (!st.last_quad.empty() && ins == st.last_quad) {
+        // keep quad as-is
+      }
+      st.last_outputs = ins;
+    } else {
+      return Status::InvalidArgument("unknown GQL call: " + c.name);
+    }
+  }
+  out->last_outputs = st.last_outputs;
+  return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// Optimizer
+// ---------------------------------------------------------------------------
+namespace {
+
+// Deterministic ops are CSE-safe; sampling ops are not.
+const std::unordered_set<std::string>& DeterministicOps() {
+  static auto* s = new std::unordered_set<std::string>{
+      "API_GET_NODE", "API_GET_NB_NODE", "API_GET_SORTED_NB_NODE",
+      "API_GET_RNB_NODE", "API_GET_TOPK_NB", "API_GET_P", "API_GET_EDGE_P",
+      "API_GET_NODE_T", "ID_UNIQUE", "POST_PROCESS", "API_GET_NB_FILTER"};
+  return *s;
+}
+
+std::string NodeKey(const NodeDef& n) {
+  std::ostringstream os;
+  os << n.op << "|";
+  for (auto& i : n.inputs) os << i << ",";
+  os << "|";
+  for (auto& a : n.attrs) os << a << ",";
+  os << "|";
+  for (auto& c : n.dnf) {
+    for (auto& t : c) os << t << "&";
+    os << ";";
+  }
+  os << "|";
+  for (auto& p : n.post_process) os << p << ",";
+  return os.str();
+}
+
+void RenameInputs(DAGDef* dag, const std::string& from_node,
+                  const std::string& to_node) {
+  std::string prefix = from_node + ":";
+  for (auto& n : dag->nodes) {
+    for (auto& in : n.inputs) {
+      if (in.rfind(prefix, 0) == 0)
+        in = to_node + in.substr(from_node.size());
+    }
+  }
+}
+
+void CsePass(DAGDef* dag) {
+  std::unordered_map<std::string, std::string> seen;  // key → node name
+  std::vector<NodeDef> kept;
+  for (auto& n : dag->nodes) {
+    if (DeterministicOps().count(n.op) == 0) {
+      kept.push_back(std::move(n));
+      continue;
+    }
+    std::string key = NodeKey(n);
+    auto it = seen.find(key);
+    if (it == seen.end()) {
+      seen.emplace(std::move(key), n.name);
+      kept.push_back(std::move(n));
+    } else {
+      // later duplicate → retarget all readers, drop the node
+      RenameInputs(dag, n.name, it->second);
+      // inputs already renamed in remaining `dag->nodes`; also fix kept
+      std::string prefix = n.name + ":";
+      for (auto& k : kept)
+        for (auto& in : k.inputs)
+          if (in.rfind(prefix, 0) == 0)
+            in = it->second + in.substr(n.name.size());
+    }
+  }
+  dag->nodes = std::move(kept);
+}
+
+// The graph-touching ops that must run on the shard owning the data.
+bool IsGraphOp(const std::string& op) {
+  static auto* s = new std::unordered_set<std::string>{
+      "API_SAMPLE_NODE", "API_SAMPLE_EDGE", "API_SAMPLE_N_WITH_TYPES",
+      "API_GET_NODE", "API_SAMPLE_NB", "API_GET_NB_NODE",
+      "API_GET_SORTED_NB_NODE", "API_GET_RNB_NODE", "API_GET_TOPK_NB",
+      "API_GET_P", "API_GET_EDGE_P", "API_GET_NODE_T", "API_SAMPLE_L",
+      "API_GET_NB_FILTER"};
+  return s->count(op) > 0;
+}
+
+// NOTE: `out` reallocates on every Add, so Add returns the node NAME (a
+// copy) — never hold NodeDef pointers across Adds.
+struct Rewriter {
+  const CompileOptions& opts;
+  DAGDef* dag;           // source (for unique naming)
+  std::vector<NodeDef> out;
+
+  std::string Fresh(const std::string& op) { return dag->UniqueName(op); }
+
+  std::string Add(const std::string& name, const std::string& op,
+                  std::vector<std::string> inputs,
+                  std::vector<std::string> attrs) {
+    NodeDef n;
+    n.name = name;
+    n.op = op;
+    n.inputs = std::move(inputs);
+    n.attrs = std::move(attrs);
+    out.push_back(std::move(n));
+    return name;
+  }
+
+  std::string AddRemote(int shard, NodeDef inner,
+                        std::vector<std::string> ship_inputs, int n_outs) {
+    NodeDef r;
+    r.name = Fresh("REMOTE");
+    r.op = "REMOTE";
+    r.shard_idx = shard;
+    r.inputs = std::move(ship_inputs);
+    for (int o = 0; o < n_outs; ++o) r.attrs.push_back(inner.OutName(o));
+    r.inner.push_back(std::move(inner));
+    std::string name = r.name;
+    out.push_back(std::move(r));
+    return name;
+  }
+};
+
+}  // namespace
+
+Status OptimizeDag(const CompileOptions& opts, DAGDef* dag) {
+  CsePass(dag);
+  if (opts.mode != "distribute" || opts.shard_num <= 1) return Status::OK();
+
+  const int S = opts.shard_num;
+  std::string pn = std::to_string(opts.partition_num);
+  std::string sn = std::to_string(S);
+  Rewriter rw{opts, dag, {}};
+
+  std::vector<NodeDef> nodes = std::move(dag->nodes);
+  for (auto& n : nodes) {
+    if (!IsGraphOp(n.op)) {
+      rw.out.push_back(std::move(n));
+      continue;
+    }
+    const std::string orig = n.name;
+
+    if (n.op == "API_SAMPLE_NODE" || n.op == "API_SAMPLE_EDGE") {
+      bool edge = n.op == "API_SAMPLE_EDGE";
+      // SAMPLE_SPLIT -> per-shard count scalars :s
+      std::string split = rw.Add(
+          rw.Fresh("SAMPLE_SPLIT"), "SAMPLE_SPLIT", n.inputs,
+          {edge ? "edge" : "node", n.attrs.size() > 0 ? n.attrs[0] : "0",
+           n.attrs.size() > 1 ? n.attrs[1] : "-1"});
+      int n_outs = edge ? 3 : 1;
+      std::vector<std::string> remotes;
+      for (int s = 0; s < S; ++s) {
+        NodeDef inner = n;
+        inner.name = orig + "_sh" + std::to_string(s);
+        inner.inputs = {split + ":" + std::to_string(s)};
+        inner.attrs[0] = "0";  // count comes from the input scalar
+        remotes.push_back(rw.AddRemote(s, std::move(inner),
+                                       {split + ":" + std::to_string(s)},
+                                       n_outs));
+      }
+      std::vector<std::string> collect_ins;
+      for (int o = 0; o < n_outs; ++o) {
+        std::vector<std::string> ins;
+        for (auto& r : remotes) ins.push_back(r + ":" + std::to_string(o));
+        std::string m =
+            rw.Add(rw.Fresh("APPEND_MERGE"), "APPEND_MERGE", ins, {});
+        collect_ins.push_back(m + ":0");
+      }
+      rw.Add(orig, "COLLECT", collect_ins, {});
+      continue;
+    }
+
+    if (n.op == "API_SAMPLE_N_WITH_TYPES") {
+      std::string split = rw.Add(rw.Fresh("TYPES_SPLIT"), "TYPES_SPLIT",
+                                 {n.inputs[0]}, {sn});
+      std::vector<std::string> remotes;
+      for (int s = 0; s < S; ++s) {
+        NodeDef inner = n;
+        inner.name = orig + "_sh" + std::to_string(s);
+        inner.inputs = {split + ":" + std::to_string(2 * s)};
+        remotes.push_back(rw.AddRemote(s, std::move(inner),
+                                       {split + ":" + std::to_string(2 * s)},
+                                       1));
+      }
+      std::vector<std::string> ins;
+      for (int s = 0; s < S; ++s) {
+        ins.push_back(split + ":" + std::to_string(2 * s + 1));  // pos
+        ins.push_back(remotes[s] + ":0");                        // data
+      }
+      std::string m =
+          rw.Add(rw.Fresh("REGULAR_MERGE"), "REGULAR_MERGE", ins, {"1"});
+      rw.Add(orig, "COLLECT", {m + ":0"}, {});
+      continue;
+    }
+
+    if (n.op == "API_SAMPLE_L") {
+      // broadcast roots to every shard, merge per-layer pools
+      size_t n_layers =
+          1 + std::count(n.attrs[1].begin(), n.attrs[1].end(), ':');
+      std::vector<std::string> sizes;
+      {
+        std::stringstream ss(n.attrs[1]);
+        std::string it;
+        while (std::getline(ss, it, ':')) sizes.push_back(it);
+      }
+      std::vector<std::string> remotes;
+      for (int s = 0; s < S; ++s) {
+        NodeDef inner = n;
+        inner.name = orig + "_sh" + std::to_string(s);
+        remotes.push_back(rw.AddRemote(s, std::move(inner), {n.inputs[0]},
+                                       static_cast<int>(n_layers)));
+      }
+      std::vector<std::string> collect_ins;
+      for (size_t l = 0; l < n_layers; ++l) {
+        std::vector<std::string> ins;
+        for (auto& r : remotes) ins.push_back(r + ":" + std::to_string(l));
+        std::string m =
+            rw.Add(rw.Fresh("POOL_MERGE"), "POOL_MERGE", ins, {sizes[l]});
+        collect_ins.push_back(m + ":0");
+      }
+      rw.Add(orig, "COLLECT", collect_ins, {});
+      continue;
+    }
+
+    if (n.op == "API_GET_NB_FILTER") {
+      // Filter a quad by a dnf evaluated on the shards owning the ids:
+      // unique flat ids -> split -> remote API_GET_NODE(dnf) -> append
+      // surviving ids -> apply membership to the quad.
+      std::string uniq =
+          rw.Add(rw.Fresh("ID_UNIQUE"), "ID_UNIQUE", {n.inputs[1]}, {});
+      std::string split = rw.Add(rw.Fresh("ID_SPLIT"), "ID_SPLIT",
+                                 {uniq + ":0"}, {pn, sn});
+      std::vector<std::string> ins;
+      for (int s = 0; s < S; ++s) {
+        NodeDef inner;
+        inner.name = orig + "_sh" + std::to_string(s);
+        inner.op = "API_GET_NODE";
+        inner.inputs = {split + ":" + std::to_string(2 * s)};
+        inner.dnf = n.dnf;
+        std::string r = rw.AddRemote(s, std::move(inner),
+                                     {split + ":" + std::to_string(2 * s)},
+                                     1);
+        ins.push_back(r + ":0");
+      }
+      std::string m =
+          rw.Add(rw.Fresh("APPEND_MERGE"), "APPEND_MERGE", ins, {});
+      rw.Add(orig, "QUAD_FILTER_APPLY",
+             {n.inputs[0], n.inputs[1], n.inputs[2], n.inputs[3], m + ":0"},
+             {});
+      continue;
+    }
+
+    if (n.op == "API_GET_EDGE_P") {
+      std::string split = rw.Add(rw.Fresh("TRIPLE_SPLIT"), "TRIPLE_SPLIT",
+                                 {n.inputs[0], n.inputs[1], n.inputs[2]},
+                                 {pn, sn});
+      int nf = 0;
+      for (auto& a : n.attrs)
+        if (a.rfind("udf:", 0) != 0) nf++;
+      std::vector<std::string> remotes;
+      for (int s = 0; s < S; ++s) {
+        NodeDef inner = n;
+        inner.name = orig + "_sh" + std::to_string(s);
+        inner.inputs = {split + ":" + std::to_string(4 * s),
+                        split + ":" + std::to_string(4 * s + 1),
+                        split + ":" + std::to_string(4 * s + 2)};
+        std::vector<std::string> ship = inner.inputs;
+        remotes.push_back(
+            rw.AddRemote(s, std::move(inner), std::move(ship), 2 * nf));
+      }
+      std::vector<std::string> collect_ins;
+      for (int f = 0; f < nf; ++f) {
+        std::vector<std::string> ins;
+        for (int s = 0; s < S; ++s) {
+          ins.push_back(split + ":" + std::to_string(4 * s + 3));
+          ins.push_back(remotes[s] + ":" + std::to_string(2 * f));
+          ins.push_back(remotes[s] + ":" + std::to_string(2 * f + 1));
+        }
+        std::string m =
+            rw.Add(rw.Fresh("RAGGED_MERGE"), "RAGGED_MERGE", ins, {"1"});
+        collect_ins.push_back(m + ":0");
+        collect_ins.push_back(m + ":1");
+      }
+      rw.Add(orig, "COLLECT", collect_ins, {});
+      continue;
+    }
+
+    // --- id-keyed node ops ---
+    bool dedup = n.op != "API_SAMPLE_NB";  // unique+gather for GET ops
+    std::string ids_in = n.inputs[0];
+    std::string uniq;
+    if (dedup) {
+      uniq = rw.Add(rw.Fresh("ID_UNIQUE"), "ID_UNIQUE", {ids_in}, {});
+      ids_in = uniq + ":0";
+    }
+    std::string split =
+        rw.Add(rw.Fresh("ID_SPLIT"), "ID_SPLIT", {ids_in}, {pn, sn});
+
+    int n_outs;
+    if (n.op == "API_GET_P") {
+      int nf = 0;
+      for (auto& a : n.attrs)
+        if (a.rfind("udf:", 0) != 0) nf++;
+      n_outs = 2 * nf;
+    } else if (n.op == "API_GET_NODE_T") {
+      n_outs = 1;
+    } else if (n.op == "API_GET_NODE") {
+      n_outs = 2;
+    } else {
+      n_outs = 4;  // quad ops
+    }
+
+    std::vector<std::string> remotes;
+    for (int s = 0; s < S; ++s) {
+      NodeDef inner = n;
+      inner.name = orig + "_sh" + std::to_string(s);
+      inner.inputs[0] = split + ":" + std::to_string(2 * s);
+      remotes.push_back(rw.AddRemote(s, std::move(inner),
+                                     {split + ":" + std::to_string(2 * s)},
+                                     n_outs));
+    }
+
+    if (n.op == "API_GET_NODE") {
+      std::vector<std::string> ins;
+      for (int s = 0; s < S; ++s) {
+        ins.push_back(split + ":" + std::to_string(2 * s + 1));  // pos
+        ins.push_back(remotes[s] + ":0");  // surviving ids
+        ins.push_back(remotes[s] + ":1");  // local positions
+      }
+      // FILTER_MERGE emits (ids, unique-space positions). The surviving
+      // ids are a set, so no gather-through-inv is needed downstream.
+      std::string m =
+          rw.Add(rw.Fresh("FILTER_MERGE"), "FILTER_MERGE", ins, {});
+      rw.Add(orig, "COLLECT", {m + ":0", m + ":1"}, {});
+      continue;
+    }
+
+    if (n.op == "API_GET_NODE_T") {
+      std::vector<std::string> ins;
+      for (int s = 0; s < S; ++s) {
+        ins.push_back(split + ":" + std::to_string(2 * s + 1));
+        ins.push_back(remotes[s] + ":0");
+      }
+      std::string m =
+          rw.Add(rw.Fresh("REGULAR_MERGE"), "REGULAR_MERGE", ins, {"1"});
+      std::string g = rw.Add(rw.Fresh("REGULAR_GATHER"), "REGULAR_GATHER",
+                             {uniq + ":1", m + ":0"}, {"1"});
+      rw.Add(orig, "COLLECT", {g + ":0"}, {});
+      continue;
+    }
+
+    if (n.op == "API_GET_P") {
+      std::vector<std::string> collect_ins;
+      int nf = n_outs / 2;
+      for (int f = 0; f < nf; ++f) {
+        std::vector<std::string> ins;
+        for (int s = 0; s < S; ++s) {
+          ins.push_back(split + ":" + std::to_string(2 * s + 1));
+          ins.push_back(remotes[s] + ":" + std::to_string(2 * f));
+          ins.push_back(remotes[s] + ":" + std::to_string(2 * f + 1));
+        }
+        std::string m =
+            rw.Add(rw.Fresh("RAGGED_MERGE"), "RAGGED_MERGE", ins, {"1"});
+        std::string g =
+            rw.Add(rw.Fresh("RAGGED_GATHER"), "RAGGED_GATHER",
+                   {uniq + ":1", m + ":0", m + ":1"}, {"1"});
+        collect_ins.push_back(g + ":0");
+        collect_ins.push_back(g + ":1");
+      }
+      rw.Add(orig, "COLLECT", collect_ins, {});
+      continue;
+    }
+
+    // quad ops
+    {
+      std::vector<std::string> ins;
+      for (int s = 0; s < S; ++s) {
+        ins.push_back(split + ":" + std::to_string(2 * s + 1));
+        for (int o = 0; o < 4; ++o)
+          ins.push_back(remotes[s] + ":" + std::to_string(o));
+      }
+      std::string m =
+          rw.Add(rw.Fresh("RAGGED_MERGE"), "RAGGED_MERGE", ins, {"3"});
+      if (dedup) {
+        std::string g = rw.Add(
+            rw.Fresh("RAGGED_GATHER"), "RAGGED_GATHER",
+            {uniq + ":1", m + ":0", m + ":1", m + ":2", m + ":3"}, {"3"});
+        rw.Add(orig, "COLLECT", {g + ":0", g + ":1", g + ":2", g + ":3"},
+               {});
+      } else {
+        rw.Add(orig, "COLLECT", {m + ":0", m + ":1", m + ":2", m + ":3"},
+               {});
+      }
+      continue;
+    }
+  }
+  dag->nodes = std::move(rw.out);
+  return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// Compiler with cache
+// ---------------------------------------------------------------------------
+Status GqlCompiler::Compile(const std::string& query,
+                            std::shared_ptr<const TranslateResult>* out) {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = cache_.find(query);
+    if (it != cache_.end()) {
+      *out = it->second;
+      return Status::OK();
+    }
+  }
+  std::vector<GqlCall> calls;
+  ET_RETURN_IF_ERROR(ParseGql(query, &calls));
+  auto result = std::make_shared<TranslateResult>();
+  ET_RETURN_IF_ERROR(TranslateGql(calls, result.get()));
+  ET_RETURN_IF_ERROR(OptimizeDag(opts_, &result->dag));
+  std::vector<int> order;
+  if (!TopologicSort(result->dag, &order))
+    return Status::Internal("compiled DAG has a cycle: " + query);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    cache_[query] = result;
+  }
+  *out = result;
+  return Status::OK();
+}
+
+std::string DagToString(const DAGDef& dag) {
+  std::ostringstream os;
+  std::function<void(const std::vector<NodeDef>&, int)> dump =
+      [&](const std::vector<NodeDef>& nodes, int depth) {
+        std::string ind(depth * 2, ' ');
+        for (const auto& n : nodes) {
+          os << ind << n.name << " = " << n.op << "(";
+          for (size_t i = 0; i < n.inputs.size(); ++i)
+            os << (i ? ", " : "") << n.inputs[i];
+          os << ")";
+          if (!n.attrs.empty()) {
+            os << " attrs[";
+            for (size_t i = 0; i < n.attrs.size(); ++i)
+              os << (i ? ", " : "") << n.attrs[i];
+            os << "]";
+          }
+          if (!n.dnf.empty()) {
+            os << " dnf[";
+            for (size_t i = 0; i < n.dnf.size(); ++i) {
+              if (i) os << " | ";
+              for (size_t j = 0; j < n.dnf[i].size(); ++j)
+                os << (j ? " & " : "") << n.dnf[i][j];
+            }
+            os << "]";
+          }
+          if (!n.post_process.empty()) {
+            os << " pp[";
+            for (size_t i = 0; i < n.post_process.size(); ++i)
+              os << (i ? "; " : "") << n.post_process[i];
+            os << "]";
+          }
+          if (n.shard_idx >= 0) os << " shard=" << n.shard_idx;
+          os << "\n";
+          if (!n.inner.empty()) dump(n.inner, depth + 1);
+        }
+      };
+  dump(dag.nodes, 0);
+  return os.str();
+}
+
+}  // namespace et

@@ -1,0 +1,95 @@
+// GQL: the Gremlin-style graph query language — lexer, parser, translator,
+// optimizer, compile cache.
+//
+// Capability parity with the reference's euler/parser/ (SURVEY.md §2.1):
+// the flex/bison grammar (gremlin.l/gremlin.y) is a hand-rolled lexer +
+// recursive-descent parser here (same token set: v, e, sampleN, sampleE,
+// sampleNWithTypes, outV, inV, sampleNB, sampleLNB, values, label, udf,
+// has, hasKey, hasLabel, limit, orderBy, as, and/or, gt/ge/lt/le/eq/ne);
+// Translator::Translate → translation to a DAGDef of API_* nodes with DNF
+// conditions; Optimizer::Optimize → CSE plus the distribute rewrite
+// (split → per-shard REMOTE → merge, with unique/gather dedup — reference
+// optimizer.h:51-121); Compiler::Compile → cached compilation keyed by
+// query text (reference compiler.h:112).
+//
+// Query chains reference externally supplied input tensors by name:
+//   v(roots).sampleNB(0, 10, -1).as(nb)         — roots: u64 ids input
+//   sampleN(0, 128).values(f_dense).as(feat)
+//   e(batch).values(price).as(p)                — batch:0/1/2 = src/dst/type
+#ifndef EULER_TPU_GQL_H_
+#define EULER_TPU_GQL_H_
+
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "dag.h"
+
+namespace et {
+
+// One parsed call in a query chain: name + comma-separated args, each arg a
+// list of whitespace-separated words (conditions keep and/or structure).
+struct GqlCall {
+  std::string name;
+  std::vector<std::vector<std::string>> args;
+};
+
+Status ParseGql(const std::string& query, std::vector<GqlCall>* calls);
+
+// Translate a parsed chain into an executable DAGDef (local form — no
+// split/REMOTE/merge). Also reports the "as" aliases and the terminal
+// output names so callers know what to fetch.
+struct TranslateResult {
+  DAGDef dag;
+  std::vector<std::string> aliases;       // as() names, in order
+  std::vector<std::string> last_outputs;  // terminal op's output tensors
+};
+Status TranslateGql(const std::vector<GqlCall>& calls, TranslateResult* out);
+
+struct CompileOptions {
+  int shard_num = 1;      // >1 + mode=distribute → shard rewrite
+  int partition_num = 1;  // graph partition count (placement modulus)
+  std::string mode = "local";  // "local" | "distribute"
+};
+
+// Node shard placement. Data prep assigns partition p = id % P and shard k
+// of n loads partitions p % n == k (euler_tpu/tools/generate_data.py,
+// io.cc LoadShard) — so the owner of id is (id % P) % n.
+inline int ShardOf(uint64_t id, int partition_num, int shard_num) {
+  if (partition_num < shard_num) partition_num = shard_num;
+  return static_cast<int>((id % static_cast<uint64_t>(partition_num)) %
+                          static_cast<uint64_t>(shard_num));
+}
+
+// Optimizer passes over a translated DAG (in place):
+//  - CommonSubexpressionElimination: dedup deterministic nodes
+//  - DistributeRewrite: wrap graph-touching ops in split/REMOTE/merge
+Status OptimizeDag(const CompileOptions& opts, DAGDef* dag);
+
+class GqlCompiler {
+ public:
+  explicit GqlCompiler(CompileOptions opts) : opts_(std::move(opts)) {}
+
+  // Parse + translate + optimize, with a query-text cache.
+  Status Compile(const std::string& query,
+                 std::shared_ptr<const TranslateResult>* out);
+
+  const CompileOptions& options() const { return opts_; }
+
+ private:
+  CompileOptions opts_;
+  std::mutex mu_;
+  std::unordered_map<std::string, std::shared_ptr<const TranslateResult>>
+      cache_;
+};
+
+// Debug: render a DAG as indented text (op name, inputs, attrs, dnf, inner)
+// — used by golden structure tests (reference compiler_test.cc style).
+std::string DagToString(const DAGDef& dag);
+
+}  // namespace et
+
+#endif  // EULER_TPU_GQL_H_

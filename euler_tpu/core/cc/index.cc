@@ -1,0 +1,440 @@
+#include "index.h"
+
+#include <algorithm>
+#include <cstdlib>
+#include <sstream>
+
+#include "graph.h"
+
+namespace et {
+
+// ---------------------------------------------------------------------------
+// IndexResult algebra
+// ---------------------------------------------------------------------------
+IndexResult IndexResult::Union(const IndexResult& a, const IndexResult& b) {
+  IndexResult out;
+  out.rows.reserve(a.rows.size() + b.rows.size());
+  size_t i = 0, j = 0;
+  while (i < a.rows.size() || j < b.rows.size()) {
+    if (j >= b.rows.size() || (i < a.rows.size() && a.rows[i] < b.rows[j])) {
+      out.rows.push_back(a.rows[i]);
+      out.weights.push_back(a.weights[i]);
+      ++i;
+    } else if (i >= a.rows.size() || b.rows[j] < a.rows[i]) {
+      out.rows.push_back(b.rows[j]);
+      out.weights.push_back(b.weights[j]);
+      ++j;
+    } else {  // equal row — keep one copy
+      out.rows.push_back(a.rows[i]);
+      out.weights.push_back(a.weights[i]);
+      ++i;
+      ++j;
+    }
+  }
+  return out;
+}
+
+IndexResult IndexResult::Intersect(const IndexResult& a,
+                                   const IndexResult& b) {
+  IndexResult out;
+  size_t i = 0, j = 0;
+  while (i < a.rows.size() && j < b.rows.size()) {
+    if (a.rows[i] < b.rows[j]) {
+      ++i;
+    } else if (b.rows[j] < a.rows[i]) {
+      ++j;
+    } else {
+      out.rows.push_back(a.rows[i]);
+      out.weights.push_back(a.weights[i]);
+      ++i;
+      ++j;
+    }
+  }
+  return out;
+}
+
+bool IndexResult::Contains(uint32_t row) const {
+  return std::binary_search(rows.begin(), rows.end(), row);
+}
+
+float IndexResult::TotalWeight() const {
+  float s = 0;
+  for (float w : weights) s += w;
+  return s;
+}
+
+void IndexResult::Sample(size_t count, Pcg32* rng, uint32_t* out) const {
+  if (rows.empty()) {
+    for (size_t i = 0; i < count; ++i) out[i] = kInvalidRow;
+    return;
+  }
+  std::vector<float> cum(weights.size());
+  float s = 0;
+  for (size_t i = 0; i < weights.size(); ++i) {
+    s += weights[i];
+    cum[i] = s;
+  }
+  if (s <= 0) {  // all-zero weights → uniform
+    for (size_t i = 0; i < count; ++i)
+      out[i] = rows[rng->NextUInt(rows.size())];
+    return;
+  }
+  for (size_t i = 0; i < count; ++i) {
+    float r = rng->NextFloat() * s;
+    size_t idx = std::lower_bound(cum.begin(), cum.end(), r) - cum.begin();
+    if (idx >= rows.size()) idx = rows.size() - 1;
+    out[i] = rows[idx];
+  }
+}
+
+// ---------------------------------------------------------------------------
+// HashSampleIndex
+// ---------------------------------------------------------------------------
+CmpOp ParseCmpOp(const std::string& s) {
+  if (s == "eq") return CmpOp::kEq;
+  if (s == "ne") return CmpOp::kNe;
+  if (s == "lt") return CmpOp::kLt;
+  if (s == "le") return CmpOp::kLe;
+  if (s == "gt") return CmpOp::kGt;
+  if (s == "ge") return CmpOp::kGe;
+  if (s == "in") return CmpOp::kIn;
+  if (s == "hk") return CmpOp::kHasKey;
+  ET_LOG(WARNING) << "unknown cmp op '" << s << "', treating as eq";
+  return CmpOp::kEq;
+}
+
+void HashSampleIndex::Add(const std::string& term, uint32_t row,
+                          float weight) {
+  auto& p = postings_[term];
+  p.rows.push_back(row);
+  p.weights.push_back(weight);
+  all_.rows.push_back(row);
+  all_.weights.push_back(weight);
+}
+
+static void SortResult(IndexResult* r) {
+  std::vector<size_t> order(r->rows.size());
+  for (size_t i = 0; i < order.size(); ++i) order[i] = i;
+  std::sort(order.begin(), order.end(),
+            [&](size_t a, size_t b) { return r->rows[a] < r->rows[b]; });
+  IndexResult sorted;
+  sorted.rows.reserve(order.size());
+  sorted.weights.reserve(order.size());
+  for (size_t i : order) {
+    // drop duplicate rows (a sparse feature can repeat a token)
+    if (!sorted.rows.empty() && sorted.rows.back() == r->rows[i]) continue;
+    sorted.rows.push_back(r->rows[i]);
+    sorted.weights.push_back(r->weights[i]);
+  }
+  *r = std::move(sorted);
+}
+
+void HashSampleIndex::Seal() {
+  for (auto& kv : postings_) SortResult(&kv.second);
+  SortResult(&all_);
+}
+
+static IndexResult Difference(const IndexResult& all, const IndexResult& b) {
+  IndexResult out;
+  size_t j = 0;
+  for (size_t i = 0; i < all.rows.size(); ++i) {
+    while (j < b.rows.size() && b.rows[j] < all.rows[i]) ++j;
+    if (j < b.rows.size() && b.rows[j] == all.rows[i]) continue;
+    out.rows.push_back(all.rows[i]);
+    out.weights.push_back(all.weights[i]);
+  }
+  return out;
+}
+
+IndexResult HashSampleIndex::Lookup(CmpOp op, const std::string& value) const {
+  switch (op) {
+    case CmpOp::kHasKey:
+      return all_;
+    case CmpOp::kEq: {
+      auto it = postings_.find(value);
+      return it == postings_.end() ? IndexResult() : it->second;
+    }
+    case CmpOp::kNe: {
+      auto it = postings_.find(value);
+      return it == postings_.end() ? all_ : Difference(all_, it->second);
+    }
+    case CmpOp::kIn: {
+      IndexResult acc;
+      std::stringstream ss(value);
+      std::string term;
+      while (std::getline(ss, term, ':')) {
+        auto it = postings_.find(term);
+        if (it != postings_.end()) acc = IndexResult::Union(acc, it->second);
+      }
+      return acc;
+    }
+    default:
+      ET_LOG(WARNING) << "hash index does not support range ops";
+      return IndexResult();
+  }
+}
+
+// ---------------------------------------------------------------------------
+// RangeSampleIndex
+// ---------------------------------------------------------------------------
+void RangeSampleIndex::Add(double value, uint32_t row, float weight) {
+  entries_.push_back({value, row, weight});
+}
+
+void RangeSampleIndex::Seal() {
+  std::sort(entries_.begin(), entries_.end(),
+            [](const Entry& a, const Entry& b) {
+              return a.value < b.value ||
+                     (a.value == b.value && a.row < b.row);
+            });
+}
+
+IndexResult RangeSampleIndex::RangeToResult(size_t begin, size_t end) const {
+  IndexResult out;
+  out.rows.reserve(end - begin);
+  for (size_t i = begin; i < end; ++i) {
+    out.rows.push_back(entries_[i].row);
+    out.weights.push_back(entries_[i].weight);
+  }
+  SortResult(&out);
+  return out;
+}
+
+IndexResult RangeSampleIndex::Lookup(CmpOp op,
+                                     const std::string& value) const {
+  auto lb = [this](double v) {
+    return std::lower_bound(entries_.begin(), entries_.end(), v,
+                            [](const Entry& e, double x) {
+                              return e.value < x;
+                            }) -
+           entries_.begin();
+  };
+  auto ub = [this](double v) {
+    return std::upper_bound(entries_.begin(), entries_.end(), v,
+                            [](double x, const Entry& e) {
+                              return x < e.value;
+                            }) -
+           entries_.begin();
+  };
+  if (op == CmpOp::kHasKey) return RangeToResult(0, entries_.size());
+  if (op == CmpOp::kIn) {
+    IndexResult acc;
+    std::stringstream ss(value);
+    std::string term;
+    while (std::getline(ss, term, ':')) {
+      double v = std::atof(term.c_str());
+      acc = IndexResult::Union(acc, RangeToResult(lb(v), ub(v)));
+    }
+    return acc;
+  }
+  double v = std::atof(value.c_str());
+  switch (op) {
+    case CmpOp::kEq: return RangeToResult(lb(v), ub(v));
+    case CmpOp::kLt: return RangeToResult(0, lb(v));
+    case CmpOp::kLe: return RangeToResult(0, ub(v));
+    case CmpOp::kGt: return RangeToResult(ub(v), entries_.size());
+    case CmpOp::kGe: return RangeToResult(lb(v), entries_.size());
+    case CmpOp::kNe: {
+      IndexResult lo = RangeToResult(0, lb(v));
+      IndexResult hi = RangeToResult(ub(v), entries_.size());
+      return IndexResult::Union(lo, hi);
+    }
+    default: return IndexResult();
+  }
+}
+
+// ---------------------------------------------------------------------------
+// IndexManager
+// ---------------------------------------------------------------------------
+Status IndexManager::BuildFromSpec(const Graph& g, const std::string& spec) {
+  std::stringstream ss(spec);
+  std::string item;
+  while (std::getline(ss, item, ',')) {
+    if (item.empty()) continue;
+    auto pos = item.find(':');
+    if (pos == std::string::npos)
+      return Status::InvalidArgument("bad index spec item: " + item);
+    std::string attr = item.substr(0, pos);
+    std::string kind_s = item.substr(pos + 1);
+    IndexKind kind = (kind_s.find("range") != std::string::npos)
+                         ? IndexKind::kRange
+                         : IndexKind::kHash;
+    ET_RETURN_IF_ERROR(Build(g, attr, kind));
+  }
+  return Status::OK();
+}
+
+Status IndexManager::Build(const Graph& g, const std::string& attr,
+                           IndexKind kind) {
+  const GraphMeta& meta = g.meta();
+  size_t n = g.node_count();
+
+  auto add_all = [&](auto* idx, auto&& value_of) {
+    for (uint32_t row = 0; row < n; ++row) value_of(idx, row);
+    idx->Seal();
+  };
+
+  if (attr == "node_type" || attr == "label") {
+    if (kind == IndexKind::kHash) {
+      auto idx = std::make_unique<HashSampleIndex>();
+      add_all(idx.get(), [&](HashSampleIndex* ix, uint32_t row) {
+        int32_t t = g.node_type(row);
+        std::string name = (t >= 0 && t < (int)meta.node_type_names.size())
+                               ? meta.node_type_names[t]
+                               : std::to_string(t);
+        ix->Add(name, row, g.node_weight(row));
+        if (name != std::to_string(t))  // allow numeric form too
+          ix->Add(std::to_string(t), row, g.node_weight(row));
+      });
+      indexes_[attr] = std::move(idx);
+    } else {
+      auto idx = std::make_unique<RangeSampleIndex>();
+      add_all(idx.get(), [&](RangeSampleIndex* ix, uint32_t row) {
+        ix->Add(g.node_type(row), row, g.node_weight(row));
+      });
+      indexes_[attr] = std::move(idx);
+    }
+    return Status::OK();
+  }
+
+  // Feature-backed attribute.
+  int fid = -1;
+  for (size_t i = 0; i < meta.node_features.size(); ++i)
+    if (meta.node_features[i].name == attr) fid = static_cast<int>(i);
+  if (fid < 0) return Status::NotFound("no node feature named " + attr);
+  const FeatureInfo& fi = meta.node_features[fid];
+
+  if (fi.kind == FeatureKind::kDense) {
+    // scalar at dim 0
+    std::vector<float> buf(1);
+    if (kind == IndexKind::kRange) {
+      auto idx = std::make_unique<RangeSampleIndex>();
+      for (uint32_t row = 0; row < n; ++row) {
+        NodeId id = g.node_id(row);
+        g.GetDenseFeature(&id, 1, fid, 1, buf.data());
+        idx->Add(buf[0], row, g.node_weight(row));
+      }
+      idx->Seal();
+      indexes_[attr] = std::move(idx);
+    } else {
+      auto idx = std::make_unique<HashSampleIndex>();
+      for (uint32_t row = 0; row < n; ++row) {
+        NodeId id = g.node_id(row);
+        g.GetDenseFeature(&id, 1, fid, 1, buf.data());
+        std::ostringstream os;
+        os << buf[0];
+        idx->Add(os.str(), row, g.node_weight(row));
+      }
+      idx->Seal();
+      indexes_[attr] = std::move(idx);
+    }
+    return Status::OK();
+  }
+
+  if (fi.kind == FeatureKind::kSparse) {
+    std::vector<uint64_t> offs, vals;
+    if (kind == IndexKind::kRange) {
+      auto idx = std::make_unique<RangeSampleIndex>();
+      for (uint32_t row = 0; row < n; ++row) {
+        NodeId id = g.node_id(row);
+        offs.clear();
+        vals.clear();
+        g.GetSparseFeature(&id, 1, fid, &offs, &vals);
+        for (uint64_t v : vals)
+          idx->Add(static_cast<double>(v), row, g.node_weight(row));
+      }
+      idx->Seal();
+      indexes_[attr] = std::move(idx);
+    } else {
+      auto idx = std::make_unique<HashSampleIndex>();
+      for (uint32_t row = 0; row < n; ++row) {
+        NodeId id = g.node_id(row);
+        offs.clear();
+        vals.clear();
+        g.GetSparseFeature(&id, 1, fid, &offs, &vals);
+        for (uint64_t v : vals)
+          idx->Add(std::to_string(v), row, g.node_weight(row));
+      }
+      idx->Seal();
+      indexes_[attr] = std::move(idx);
+    }
+    return Status::OK();
+  }
+
+  // binary feature → hash of the byte string
+  auto idx = std::make_unique<HashSampleIndex>();
+  std::vector<uint64_t> offs;
+  std::vector<char> bytes;
+  for (uint32_t row = 0; row < n; ++row) {
+    NodeId id = g.node_id(row);
+    offs.clear();
+    bytes.clear();
+    g.GetBinaryFeature(&id, 1, fid, &offs, &bytes);
+    idx->Add(std::string(bytes.begin(), bytes.end()), row,
+             g.node_weight(row));
+  }
+  idx->Seal();
+  indexes_[attr] = std::move(idx);
+  return Status::OK();
+}
+
+const SampleIndex* IndexManager::Find(const std::string& attr) const {
+  auto it = indexes_.find(attr);
+  return it == indexes_.end() ? nullptr : it->second.get();
+}
+
+std::vector<std::string> IndexManager::attrs() const {
+  std::vector<std::string> out;
+  for (auto& kv : indexes_) out.push_back(kv.first);
+  return out;
+}
+
+Status IndexManager::EvalDnf(
+    const Graph* g, const std::vector<std::vector<std::string>>& dnf,
+    IndexResult* out) const {
+  IndexResult acc;
+  bool first_disj = true;
+  for (const auto& conj : dnf) {
+    IndexResult conj_res;
+    bool first_term = true;
+    for (const auto& term : conj) {
+      // "attr op value"
+      std::stringstream ss(term);
+      std::string attr, op_s, value;
+      ss >> attr >> op_s;
+      std::getline(ss, value);
+      if (!value.empty() && value[0] == ' ') value.erase(0, 1);
+      IndexResult r;
+      if (attr == "id") {
+        // direct id membership against the graph — no index required
+        if (g == nullptr)
+          return Status::InvalidArgument("id condition needs a graph");
+        std::stringstream vs(value);
+        std::string one;
+        while (std::getline(vs, one, ':')) {
+          uint64_t id = std::strtoull(one.c_str(), nullptr, 10);
+          uint32_t row = g->NodeIndex(id);
+          if (row != kInvalidIndex) {
+            r.rows.push_back(row);
+            r.weights.push_back(g->node_weight(row));
+          }
+        }
+        std::sort(r.rows.begin(), r.rows.end());
+      } else {
+        const SampleIndex* idx = Find(attr);
+        if (idx == nullptr)
+          return Status::NotFound("no index for attribute " + attr);
+        r = idx->Lookup(ParseCmpOp(op_s), value);
+      }
+      conj_res = first_term ? std::move(r)
+                            : IndexResult::Intersect(conj_res, r);
+      first_term = false;
+    }
+    acc = first_disj ? std::move(conj_res) : IndexResult::Union(acc, conj_res);
+    first_disj = false;
+  }
+  *out = std::move(acc);
+  return Status::OK();
+}
+
+}  // namespace et

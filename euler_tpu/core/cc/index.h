@@ -1,0 +1,131 @@
+// Attribute index subsystem: equality/range postings with weighted sampling.
+//
+// Capability parity with the reference's euler/core/index/ (SURVEY.md §2.1):
+// HashSampleIndex (equality, hash_sample_index.h:41), RangeSampleIndex
+// (lt/le/gt/ge ranges, range_sample_index.h:36), the IndexResult union/
+// intersection algebra with weighted sampling over postings
+// (common_index_result.h), and the IndexManager singleton. Redesigned for
+// the columnar store: postings are sorted node-row u32 arrays (not id
+// vectors), built directly from the graph's feature columns rather than a
+// separate on-disk Index/ directory — `IndexManager::Build` scans the
+// finalized graph once per indexed attribute.
+#ifndef EULER_TPU_INDEX_H_
+#define EULER_TPU_INDEX_H_
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "sampling.h"
+
+namespace et {
+
+class Graph;
+
+// Sorted set of matching node rows + their sampling weights.
+// Union/Intersect keep rows sorted; Sample is weighted (cumulative-sum +
+// binary search, like the reference's CompactWeightedCollection-backed
+// results).
+struct IndexResult {
+  std::vector<uint32_t> rows;   // strictly increasing
+  std::vector<float> weights;   // parallel to rows
+
+  static IndexResult Union(const IndexResult& a, const IndexResult& b);
+  static IndexResult Intersect(const IndexResult& a, const IndexResult& b);
+
+  bool Contains(uint32_t row) const;
+  float TotalWeight() const;
+  // Weighted sample with replacement; writes `count` row indices.
+  // Empty result → writes kInvalidRow.
+  static constexpr uint32_t kInvalidRow = 0xffffffffu;
+  void Sample(size_t count, Pcg32* rng, uint32_t* out) const;
+};
+
+enum class IndexKind : int { kHash = 0, kRange = 1 };
+enum class CmpOp : int { kEq, kNe, kLt, kLe, kGt, kGe, kIn, kHasKey };
+
+// "eq","ne","lt","le","gt","ge","in","hk" (hasKey)
+CmpOp ParseCmpOp(const std::string& s);
+
+// One indexed attribute over all local nodes.
+class SampleIndex {
+ public:
+  virtual ~SampleIndex() = default;
+  virtual IndexKind kind() const = 0;
+  // `value` is the RHS literal; for kIn it is a ::-separated list.
+  virtual IndexResult Lookup(CmpOp op, const std::string& value) const = 0;
+};
+
+// Equality index: term → postings. Terms are stringified attribute values.
+// ne/in supported (ne = all \ postings, computed against the full list).
+class HashSampleIndex : public SampleIndex {
+ public:
+  IndexKind kind() const override { return IndexKind::kHash; }
+  IndexResult Lookup(CmpOp op, const std::string& value) const override;
+
+  void Add(const std::string& term, uint32_t row, float weight);
+  void Seal();  // sort postings, build the all-rows list
+
+ private:
+  std::unordered_map<std::string, IndexResult> postings_;
+  IndexResult all_;
+};
+
+// Ordered index over a numeric attribute: supports the full cmp set via
+// binary search on the sorted (value, row) array.
+class RangeSampleIndex : public SampleIndex {
+ public:
+  IndexKind kind() const override { return IndexKind::kRange; }
+  IndexResult Lookup(CmpOp op, const std::string& value) const override;
+
+  void Add(double value, uint32_t row, float weight);
+  void Seal();
+
+ private:
+  struct Entry {
+    double value;
+    uint32_t row;
+    float weight;
+  };
+  std::vector<Entry> entries_;  // sorted by (value, row) after Seal
+  IndexResult RangeToResult(size_t begin, size_t end) const;
+};
+
+// Owns all indexes for one graph. Attribute sources:
+//   "node_type"          — the node's type id (hash or range)
+//   dense feature name   — scalar value at dim 0 (range) or stringified (hash)
+//   sparse feature name  — every u64 token becomes a hash term
+//   binary feature name  — the byte string as one hash term
+// Parity: reference IndexManager (index_manager.h:34) + the data-prep
+// json2partindex pipeline, collapsed into post-load Build calls.
+class IndexManager {
+ public:
+  // spec: comma-separated "attr:hash_index" / "attr:range_index" pairs,
+  // e.g. "price:range_index,label:hash_index" (reference index_info format,
+  // parser/compiler_test.cc:169).
+  Status BuildFromSpec(const Graph& g, const std::string& spec);
+  Status Build(const Graph& g, const std::string& attr, IndexKind kind);
+
+  const SampleIndex* Find(const std::string& attr) const;
+  bool has(const std::string& attr) const { return Find(attr) != nullptr; }
+  std::vector<std::string> attrs() const;
+
+  // Evaluate one DNF condition (dnf[i] = conjunction of "attr op value"
+  // terms) to a posting set. The special attribute "id" matches node ids
+  // directly against the graph (no index needed); other unknown attributes
+  // → error. `g` may be null if no term uses "id".
+  Status EvalDnf(const Graph* g,
+                 const std::vector<std::vector<std::string>>& dnf,
+                 IndexResult* out) const;
+
+ private:
+  std::map<std::string, std::unique_ptr<SampleIndex>> indexes_;
+};
+
+}  // namespace et
+
+#endif  // EULER_TPU_INDEX_H_

@@ -250,21 +250,26 @@ Status LoadShard(const std::string& dir, int shard_idx, int shard_num,
   return Status::OK();
 }
 
-Status DumpGraph(const Graph& g, const std::string& dir) {
-  GraphMeta meta = g.meta();
-  meta.partition_num = 1;
-  ET_RETURN_IF_ERROR(SaveMeta(meta, dir + "/meta.bin"));
-
+// Writes the records of partition p of P (nodes and source-owned edges
+// with id % P == p) — the same assignment the Python prep tool uses
+// (tools/generate_data.py) so dumped and generated data interoperate.
+static Status DumpOnePartition(const Graph& g, const GraphMeta& meta,
+                               const std::string& path, uint64_t p,
+                               uint64_t P) {
   ByteWriter w;
   w.PutRaw(kPartMagic, 4);
   w.Put<uint32_t>(kVersion);
   const size_t N = g.node_count();
-  w.Put<uint64_t>(N);
+  size_t n_mine = 0;
+  for (size_t i = 0; i < N; ++i)
+    if (g.node_id(static_cast<uint32_t>(i)) % P == p) ++n_mine;
+  w.Put<uint64_t>(n_mine);
   std::vector<float> dense_buf;
   std::vector<uint64_t> sp_off, sp_val;
   std::vector<char> bin_val;
   for (size_t i = 0; i < N; ++i) {
     NodeId id = g.node_id(static_cast<uint32_t>(i));
+    if (id % P != p) continue;
     w.Put<uint64_t>(id);
     w.Put<int32_t>(g.node_type(static_cast<uint32_t>(i)));
     w.Put<float>(g.node_weight(static_cast<uint32_t>(i)));
@@ -321,6 +326,7 @@ Status DumpGraph(const Graph& g, const std::string& dir) {
   std::vector<int32_t> ts;
   uint64_t edge_total = 0;
   for (size_t i = 0; i < N; ++i) {
+    if (g.node_id(static_cast<uint32_t>(i)) % P != p) continue;
     nbr.clear();
     ws.clear();
     ts.clear();
@@ -331,6 +337,7 @@ Status DumpGraph(const Graph& g, const std::string& dir) {
   w.Put<uint64_t>(edge_total);
   for (size_t i = 0; i < N; ++i) {
     NodeId src = g.node_id(static_cast<uint32_t>(i));
+    if (src % P != p) continue;
     nbr.clear();
     ws.clear();
     ts.clear();
@@ -391,8 +398,25 @@ Status DumpGraph(const Graph& g, const std::string& dir) {
       }
     }
   }
-  return WriteStringToFile(dir + "/part_0.dat", w.buffer().data(),
-                           w.buffer().size());
+  return WriteStringToFile(path, w.buffer().data(), w.buffer().size());
+}
+
+Status DumpGraphPartitioned(const Graph& g, const std::string& dir,
+                            int num_partitions) {
+  if (num_partitions < 1) num_partitions = 1;
+  GraphMeta meta = g.meta();
+  meta.partition_num = num_partitions;
+  ET_RETURN_IF_ERROR(SaveMeta(meta, dir + "/meta.bin"));
+  for (int p = 0; p < num_partitions; ++p) {
+    ET_RETURN_IF_ERROR(
+        DumpOnePartition(g, meta, dir + "/part_" + std::to_string(p) + ".dat",
+                         p, num_partitions));
+  }
+  return Status::OK();
+}
+
+Status DumpGraph(const Graph& g, const std::string& dir) {
+  return DumpGraphPartitioned(g, dir, 1);
 }
 
 Status Graph::Dump(const std::string& path) const {

@@ -107,6 +107,12 @@ Status LoadShard(const std::string& dir, int shard_idx, int shard_num,
 // Serializes the whole (local) graph as one partition + meta into dir.
 Status DumpGraph(const Graph& g, const std::string& dir);
 
+// Serializes the graph into `num_partitions` partition files (partition of
+// id = id % num_partitions, matching the data-prep tool) so a dumped graph
+// can be re-served sharded.
+Status DumpGraphPartitioned(const Graph& g, const std::string& dir,
+                            int num_partitions);
+
 }  // namespace et
 
 #endif  // EULER_TPU_IO_H_

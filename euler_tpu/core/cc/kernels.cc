@@ -1,0 +1,749 @@
+// Local graph op kernels — the query "ISA" executed by the DAG executor.
+//
+// Capability parity with euler/core/kernels/ (SURVEY.md §2.1 "Graph op
+// kernels"): root sampling (API_SAMPLE_NODE with index-conditioned DNF,
+// sample_node_op.cc:66-96; API_SAMPLE_EDGE; API_SAMPLE_N_WITH_TYPES),
+// traversal (API_SAMPLE_NB, API_GET_NB_NODE, API_GET_RNB_NODE, API_GET_TOPK,
+// get_nb_filter), features (API_GET_P / API_GET_EDGE_P with UDF hook,
+// get_feature_op.cc), node filtering (API_GET_NODE), layerwise
+// (API_SAMPLE_L), aliasing (AS), post-process (order_by/limit,
+// post_process_op.cc:325), and ID_UNIQUE dedup.
+//
+// Tensor conventions (all batch, row-aligned with the input id tensor):
+//   ragged quad  = idx i32 [n,2] (start,end) | ids u64 | w f32 | t i32
+//   feature pair = idx i32 [n,2] | values (f32 dense / u64 sparse / u8 bin)
+// Fixed-count sampling still emits idx so downstream merge/gather logic is
+// shape-agnostic.
+#include <algorithm>
+#include <cstdlib>
+#include <numeric>
+#include <sstream>
+#include <unordered_map>
+
+#include "dag.h"
+#include "graph.h"
+#include "index.h"
+#include "ops.h"
+#include "tensor.h"
+
+namespace et {
+namespace {
+
+// ---------------------------------------------------------------------------
+// helpers
+// ---------------------------------------------------------------------------
+std::vector<std::string> SplitStr(const std::string& s, char sep) {
+  std::vector<std::string> out;
+  std::stringstream ss(s);
+  std::string item;
+  while (std::getline(ss, item, sep)) out.push_back(item);
+  return out;
+}
+
+// "0:2:5" → {0,2,5}; "*" or "" → empty (= all types).
+std::vector<int32_t> ParseEdgeTypes(const std::string& s) {
+  std::vector<int32_t> out;
+  if (s.empty() || s == "*") return out;
+  for (auto& t : SplitStr(s, ':')) out.push_back(std::atoi(t.c_str()));
+  return out;
+}
+
+Status GetInput(OpKernelContext* ctx, const NodeDef& node, size_t i,
+                Tensor* out) {
+  if (i >= node.inputs.size())
+    return Status::InvalidArgument(node.name + ": missing input " +
+                                   std::to_string(i));
+  if (!ctx->Get(node.inputs[i], out))
+    return Status::NotFound(node.name + ": input tensor '" + node.inputs[i] +
+                            "' not produced");
+  return Status::OK();
+}
+
+Pcg32 NodeRng(const NodeDef& node, const QueryEnv& env) {
+  if (env.seed == 0) return Pcg32(ThreadLocalRng().NextU32());
+  uint64_t h = 1469598103934665603ULL;
+  for (char c : node.name) h = (h ^ static_cast<uint64_t>(c)) * 1099511628211ULL;
+  // seq = per-execution nonce: repeated run()s draw fresh (but replayable)
+  // samples instead of the same batch every time.
+  return Pcg32(env.seed ^ h, env.nonce * 2 + 1);
+}
+
+// Resolve a feature name (or "f<id>") to (kind, fid, dim) from graph meta.
+Status ResolveFeature(const Graph& g, const std::string& name, bool edge,
+                      FeatureKind* kind, int* fid, int64_t* dim) {
+  const auto& feats =
+      edge ? g.meta().edge_features : g.meta().node_features;
+  for (size_t i = 0; i < feats.size(); ++i) {
+    if (feats[i].name == name) {
+      *kind = feats[i].kind;
+      *fid = static_cast<int>(i);
+      *dim = feats[i].dim;
+      return Status::OK();
+    }
+  }
+  // "sparse_f1"-style prefixed or bare integer id: kind from prefix,
+  // default dense.
+  std::string base = name;
+  FeatureKind k = FeatureKind::kDense;
+  if (name.rfind("sparse_", 0) == 0) {
+    k = FeatureKind::kSparse;
+    base = name.substr(7);
+  } else if (name.rfind("binary_", 0) == 0) {
+    k = FeatureKind::kBinary;
+    base = name.substr(7);
+  } else if (name.rfind("dense_", 0) == 0) {
+    base = name.substr(6);
+  }
+  if (!base.empty() && base[0] == 'f') base = base.substr(1);
+  char* end = nullptr;
+  long v = std::strtol(base.c_str(), &end, 10);
+  if (end != base.c_str() && *end == '\0' && v >= 0 &&
+      static_cast<size_t>(v) < feats.size()) {
+    *fid = static_cast<int>(v);
+    *kind = feats[v].kind;
+    (void)k;
+    *dim = feats[v].dim;
+    return Status::OK();
+  }
+  return Status::NotFound("unknown feature: " + name);
+}
+
+Tensor MakeIdx(const std::vector<uint64_t>& offsets) {
+  size_t n = offsets.size() - 1;
+  Tensor idx(DType::kI32, {static_cast<int64_t>(n), 2});
+  int32_t* p = idx.Flat<int32_t>();
+  for (size_t i = 0; i < n; ++i) {
+    p[2 * i] = static_cast<int32_t>(offsets[i]);
+    p[2 * i + 1] = static_cast<int32_t>(offsets[i + 1]);
+  }
+  return idx;
+}
+
+#define ET_K_RETURN_IF_ERROR(expr)   \
+  do {                               \
+    ::et::Status _s = (expr);        \
+    if (!_s.ok()) {                  \
+      done(_s);                      \
+      return;                       \
+    }                                \
+  } while (0)
+
+// ---------------------------------------------------------------------------
+// API_SAMPLE_NODE — attrs: [count, node_type]; optional input 0 overrides
+// count. dnf present → index-conditioned sampling (reference
+// sample_node_op.cc:66-96).
+// out :0 = ids u64 [count]
+// ---------------------------------------------------------------------------
+class SampleNodeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    int64_t count = node.attrs.size() > 0 ? std::atoll(node.attrs[0].c_str()) : 0;
+    int type = node.attrs.size() > 1 ? std::atoi(node.attrs[1].c_str()) : -1;
+    if (!node.inputs.empty()) {
+      Tensor t;
+      if (ctx->Get(node.inputs[0], &t) && t.NumElements() > 0)
+        count = t.AsI64(0);
+    }
+    Pcg32 rng = NodeRng(node, env);
+    Tensor out(DType::kU64, {count});
+    if (!node.dnf.empty()) {
+      if (env.index == nullptr) {
+        done(Status::Internal("conditioned sampling requires an index"));
+        return;
+      }
+      IndexResult res;
+      ET_K_RETURN_IF_ERROR(env.index->EvalDnf(env.graph, node.dnf, &res));
+      if (type >= 0) {
+        // intersect with type postings via direct filter
+        IndexResult typed;
+        for (size_t i = 0; i < res.rows.size(); ++i) {
+          if (env.graph->node_type(res.rows[i]) == type) {
+            typed.rows.push_back(res.rows[i]);
+            typed.weights.push_back(res.weights[i]);
+          }
+        }
+        res = std::move(typed);
+      }
+      std::vector<uint32_t> rows(count);
+      res.Sample(count, &rng, rows.data());
+      uint64_t* ids = out.Flat<uint64_t>();
+      for (int64_t i = 0; i < count; ++i)
+        ids[i] = rows[i] == IndexResult::kInvalidRow
+                     ? 0
+                     : env.graph->node_id(rows[i]);
+    } else {
+      env.graph->SampleNode(type, count, &rng, out.Flat<uint64_t>());
+    }
+    ctx->Put(node.OutName(0), std::move(out));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("API_SAMPLE_NODE", SampleNodeOp);
+
+// API_SAMPLE_N_WITH_TYPES — input 0: i32 types per row → :0 ids u64.
+class SampleNWithTypesOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor types;
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &types));
+    int64_t n = types.NumElements();
+    Pcg32 rng = NodeRng(node, env);
+    Tensor out(DType::kU64, {n});
+    env.graph->SampleNodeWithTypes(types.Flat<int32_t>(), n, &rng,
+                                   out.Flat<uint64_t>());
+    ctx->Put(node.OutName(0), std::move(out));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("API_SAMPLE_N_WITH_TYPES", SampleNWithTypesOp);
+
+// API_SAMPLE_EDGE — attrs [count, edge_type] → :0 src, :1 dst, :2 type.
+class SampleEdgeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    int64_t count = node.attrs.size() > 0 ? std::atoll(node.attrs[0].c_str()) : 0;
+    int type = node.attrs.size() > 1 ? std::atoi(node.attrs[1].c_str()) : -1;
+    if (!node.inputs.empty()) {
+      Tensor t;
+      if (ctx->Get(node.inputs[0], &t) && t.NumElements() > 0)
+        count = t.AsI64(0);
+    }
+    Pcg32 rng = NodeRng(node, env);
+    Tensor src(DType::kU64, {count}), dst(DType::kU64, {count}),
+        et_(DType::kI32, {count});
+    env.graph->SampleEdge(type, count, &rng, src.Flat<uint64_t>(),
+                          dst.Flat<uint64_t>(), et_.Flat<int32_t>());
+    ctx->Put(node.OutName(0), std::move(src));
+    ctx->Put(node.OutName(1), std::move(dst));
+    ctx->Put(node.OutName(2), std::move(et_));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("API_SAMPLE_EDGE", SampleEdgeOp);
+
+// API_GET_NODE — input 0: candidate ids; keeps ids that exist locally and
+// match the dnf (index-backed). Missing/filtered → dropped. Outputs
+// :0 surviving ids, :1 i32 original positions.
+class GetNodeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor ids_t;
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &ids_t));
+    const uint64_t* ids = ids_t.Flat<uint64_t>();
+    int64_t n = ids_t.NumElements();
+    IndexResult res;
+    bool has_dnf = !node.dnf.empty();
+    if (has_dnf) {
+      if (env.index == nullptr) {
+        done(Status::Internal("has() filter requires an index"));
+        return;
+      }
+      ET_K_RETURN_IF_ERROR(env.index->EvalDnf(env.graph, node.dnf, &res));
+    }
+    std::vector<uint64_t> keep;
+    std::vector<int32_t> pos;
+    for (int64_t i = 0; i < n; ++i) {
+      uint32_t row = env.graph->NodeIndex(ids[i]);
+      if (row == kInvalidIndex) continue;
+      if (has_dnf && !res.Contains(row)) continue;
+      keep.push_back(ids[i]);
+      pos.push_back(static_cast<int32_t>(i));
+    }
+    ctx->Put(node.OutName(0), Tensor::FromVector(keep));
+    ctx->Put(node.OutName(1), Tensor::FromVector(pos));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("API_GET_NODE", GetNodeOp);
+
+// API_SAMPLE_NB — input 0: ids; attrs [edge_types, count, default_id]
+// → ragged quad (fixed row length = count).
+class SampleNeighborOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor ids_t;
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &ids_t));
+    auto ets = ParseEdgeTypes(node.attrs.size() > 0 ? node.attrs[0] : "");
+    int64_t count = node.attrs.size() > 1 ? std::atoll(node.attrs[1].c_str()) : 1;
+    uint64_t def = node.attrs.size() > 2 ? std::strtoull(node.attrs[2].c_str(), nullptr, 10) : 0;
+    const uint64_t* ids = ids_t.Flat<uint64_t>();
+    int64_t n = ids_t.NumElements();
+    Pcg32 rng = NodeRng(node, env);
+    Tensor idx(DType::kI32, {n, 2});
+    Tensor nb(DType::kU64, {n * count});
+    Tensor w(DType::kF32, {n * count});
+    Tensor t(DType::kI32, {n * count});
+    int32_t* pidx = idx.Flat<int32_t>();
+    for (int64_t i = 0; i < n; ++i) {
+      env.graph->SampleNeighbor(ids[i], ets.empty() ? nullptr : ets.data(),
+                                ets.size(), count, def, &rng,
+                                nb.Flat<uint64_t>() + i * count,
+                                w.Flat<float>() + i * count,
+                                t.Flat<int32_t>() + i * count);
+      pidx[2 * i] = static_cast<int32_t>(i * count);
+      pidx[2 * i + 1] = static_cast<int32_t>((i + 1) * count);
+    }
+    ctx->Put(node.OutName(0), std::move(idx));
+    ctx->Put(node.OutName(1), std::move(nb));
+    ctx->Put(node.OutName(2), std::move(w));
+    ctx->Put(node.OutName(3), std::move(t));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("API_SAMPLE_NB", SampleNeighborOp);
+
+// Shared impl for full/in/topk neighbor ops → ragged quad.
+void FullNeighborImpl(const NodeDef& node, const QueryEnv& env,
+                      OpKernelContext* ctx, bool in_edges, bool sorted,
+                      int64_t topk, std::function<void(Status)> done) {
+  Tensor ids_t;
+  Status s = GetInput(ctx, node, 0, &ids_t);
+  if (!s.ok()) {
+    done(s);
+    return;
+  }
+  auto ets = ParseEdgeTypes(node.attrs.size() > 0 ? node.attrs[0] : "");
+  const uint64_t* ids = ids_t.Flat<uint64_t>();
+  int64_t n = ids_t.NumElements();
+  std::vector<uint64_t> offsets{0};
+  std::vector<NodeId> nb;
+  std::vector<float> w;
+  std::vector<int32_t> t;
+  for (int64_t i = 0; i < n; ++i) {
+    if (topk > 0) {
+      size_t before = nb.size();
+      nb.resize(before + topk);
+      w.resize(before + topk);
+      t.resize(before + topk);
+      env.graph->GetTopKNeighbor(ids[i], ets.empty() ? nullptr : ets.data(),
+                                 ets.size(), topk, 0, nb.data() + before,
+                                 w.data() + before, t.data() + before);
+    } else if (in_edges) {
+      env.graph->GetFullInNeighbor(ids[i], ets.empty() ? nullptr : ets.data(),
+                                   ets.size(), &nb, &w, &t);
+    } else {
+      env.graph->GetFullNeighbor(ids[i], ets.empty() ? nullptr : ets.data(),
+                                 ets.size(), &nb, &w, &t, sorted);
+    }
+    offsets.push_back(nb.size());
+  }
+  ctx->Put(node.OutName(0), MakeIdx(offsets));
+  ctx->Put(node.OutName(1), Tensor::FromVector(nb));
+  ctx->Put(node.OutName(2), Tensor::FromVector(w));
+  ctx->Put(node.OutName(3), Tensor::FromVector(t));
+  done(Status::OK());
+}
+
+class GetNbNodeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& n, const QueryEnv& e, OpKernelContext* c,
+               std::function<void(Status)> d) override {
+    FullNeighborImpl(n, e, c, false, false, 0, std::move(d));
+  }
+};
+ET_REGISTER_KERNEL("API_GET_NB_NODE", GetNbNodeOp);
+
+class GetSortedNbNodeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& n, const QueryEnv& e, OpKernelContext* c,
+               std::function<void(Status)> d) override {
+    FullNeighborImpl(n, e, c, false, true, 0, std::move(d));
+  }
+};
+ET_REGISTER_KERNEL("API_GET_SORTED_NB_NODE", GetSortedNbNodeOp);
+
+class GetRNbNodeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& n, const QueryEnv& e, OpKernelContext* c,
+               std::function<void(Status)> d) override {
+    FullNeighborImpl(n, e, c, true, false, 0, std::move(d));
+  }
+};
+ET_REGISTER_KERNEL("API_GET_RNB_NODE", GetRNbNodeOp);
+
+class GetTopKNbOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& n, const QueryEnv& e, OpKernelContext* c,
+               std::function<void(Status)> d) override {
+    int64_t k = n.attrs.size() > 1 ? std::atoll(n.attrs[1].c_str()) : 1;
+    FullNeighborImpl(n, e, c, false, false, k, std::move(d));
+  }
+};
+ET_REGISTER_KERNEL("API_GET_TOPK_NB", GetTopKNbOp);
+
+// API_GET_NB_FILTER — ragged quad filtered by a dnf over the *neighbor*
+// nodes (reference get_nb_filter_op.cc:127). Inputs: idx, ids, w, t.
+class GetNbFilterOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor idx_t, ids_t, w_t, t_t;
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &idx_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 1, &ids_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 2, &w_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 3, &t_t));
+    IndexResult res;
+    if (!node.dnf.empty()) {
+      if (env.index == nullptr) {
+        done(Status::Internal("nb filter requires an index"));
+        return;
+      }
+      ET_K_RETURN_IF_ERROR(env.index->EvalDnf(env.graph, node.dnf, &res));
+    }
+    int64_t n = idx_t.dim(0);
+    const int32_t* pidx = idx_t.Flat<int32_t>();
+    const uint64_t* ids = ids_t.Flat<uint64_t>();
+    const float* w = w_t.Flat<float>();
+    const int32_t* t = t_t.Flat<int32_t>();
+    std::vector<uint64_t> offsets{0};
+    std::vector<uint64_t> out_ids;
+    std::vector<float> out_w;
+    std::vector<int32_t> out_t;
+    for (int64_t i = 0; i < n; ++i) {
+      for (int32_t j = pidx[2 * i]; j < pidx[2 * i + 1]; ++j) {
+        uint32_t row = env.graph->NodeIndex(ids[j]);
+        if (row == kInvalidIndex) continue;
+        if (!node.dnf.empty() && !res.Contains(row)) continue;
+        out_ids.push_back(ids[j]);
+        out_w.push_back(w[j]);
+        out_t.push_back(t[j]);
+      }
+      offsets.push_back(out_ids.size());
+    }
+    ctx->Put(node.OutName(0), MakeIdx(offsets));
+    ctx->Put(node.OutName(1), Tensor::FromVector(out_ids));
+    ctx->Put(node.OutName(2), Tensor::FromVector(out_w));
+    ctx->Put(node.OutName(3), Tensor::FromVector(out_t));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("API_GET_NB_FILTER", GetNbFilterOp);
+
+// ---------------------------------------------------------------------------
+// API_GET_P — input 0: ids; attrs: feature names; optional "udf:<name>"
+// first attr applies a value-UDF (reference udf.h:33, applied in
+// API_GET_P). Per feature f: out :2f = idx, :2f+1 = values.
+// ---------------------------------------------------------------------------
+using UdfFn = void (*)(const std::vector<uint64_t>& offsets,
+                       std::vector<float>* values);
+
+void MeanUdf(const std::vector<uint64_t>& offs, std::vector<float>* v) {
+  std::vector<float> out;
+  for (size_t i = 0; i + 1 < offs.size(); ++i) {
+    float s = 0;
+    uint64_t n = offs[i + 1] - offs[i];
+    for (uint64_t j = offs[i]; j < offs[i + 1]; ++j) s += (*v)[j];
+    out.push_back(n ? s / n : 0);
+  }
+  *v = std::move(out);
+}
+void MaxUdf(const std::vector<uint64_t>& offs, std::vector<float>* v) {
+  std::vector<float> out;
+  for (size_t i = 0; i + 1 < offs.size(); ++i) {
+    float m = -std::numeric_limits<float>::infinity();
+    for (uint64_t j = offs[i]; j < offs[i + 1]; ++j) m = std::max(m, (*v)[j]);
+    out.push_back(offs[i + 1] > offs[i] ? m : 0);
+  }
+  *v = std::move(out);
+}
+void MinUdf(const std::vector<uint64_t>& offs, std::vector<float>* v) {
+  std::vector<float> out;
+  for (size_t i = 0; i + 1 < offs.size(); ++i) {
+    float m = std::numeric_limits<float>::infinity();
+    for (uint64_t j = offs[i]; j < offs[i + 1]; ++j) m = std::min(m, (*v)[j]);
+    out.push_back(offs[i + 1] > offs[i] ? m : 0);
+  }
+  *v = std::move(out);
+}
+
+UdfFn LookupUdf(const std::string& name) {
+  if (name == "mean") return MeanUdf;
+  if (name == "max") return MaxUdf;
+  if (name == "min") return MinUdf;
+  return nullptr;
+}
+
+class GetFeatureOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor ids_t;
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &ids_t));
+    const uint64_t* ids = ids_t.Flat<uint64_t>();
+    int64_t n = ids_t.NumElements();
+    UdfFn udf = nullptr;
+    size_t a0 = 0;
+    if (!node.attrs.empty() && node.attrs[0].rfind("udf:", 0) == 0) {
+      udf = LookupUdf(node.attrs[0].substr(4));
+      a0 = 1;
+    }
+    int out_i = 0;
+    for (size_t a = a0; a < node.attrs.size(); ++a, out_i += 2) {
+      FeatureKind kind;
+      int fid;
+      int64_t dim;
+      ET_K_RETURN_IF_ERROR(
+          ResolveFeature(*env.graph, node.attrs[a], false, &kind, &fid, &dim));
+      if (kind == FeatureKind::kDense) {
+        std::vector<float> vals(n * dim);
+        env.graph->GetDenseFeature(ids, n, fid, dim, vals.data());
+        std::vector<uint64_t> offs(n + 1);
+        for (int64_t i = 0; i <= n; ++i) offs[i] = i * dim;
+        if (udf != nullptr) {
+          udf(offs, &vals);
+          for (int64_t i = 0; i <= n; ++i) offs[i] = i;
+        }
+        ctx->Put(node.OutName(out_i), MakeIdx(offs));
+        ctx->Put(node.OutName(out_i + 1),
+                 Tensor::FromVector(vals));
+      } else if (kind == FeatureKind::kSparse) {
+        std::vector<uint64_t> offs, vals;
+        env.graph->GetSparseFeature(ids, n, fid, &offs, &vals);
+        ctx->Put(node.OutName(out_i), MakeIdx(offs));
+        ctx->Put(node.OutName(out_i + 1), Tensor::FromVector(vals));
+      } else {
+        std::vector<uint64_t> offs;
+        std::vector<char> vals;
+        env.graph->GetBinaryFeature(ids, n, fid, &offs, &vals);
+        ctx->Put(node.OutName(out_i), MakeIdx(offs));
+        ctx->Put(node.OutName(out_i + 1), Tensor::FromVector(vals));
+      }
+    }
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("API_GET_P", GetFeatureOp);
+
+// API_GET_EDGE_P — inputs: src, dst, type tensors; attrs: feature names.
+class GetEdgeFeatureOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor src_t, dst_t, type_t;
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &src_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 1, &dst_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 2, &type_t));
+    int64_t n = src_t.NumElements();
+    int out_i = 0;
+    for (size_t a = 0; a < node.attrs.size(); ++a, out_i += 2) {
+      FeatureKind kind;
+      int fid;
+      int64_t dim;
+      ET_K_RETURN_IF_ERROR(
+          ResolveFeature(*env.graph, node.attrs[a], true, &kind, &fid, &dim));
+      if (kind == FeatureKind::kDense) {
+        std::vector<float> vals(n * dim);
+        env.graph->GetEdgeDenseFeature(src_t.Flat<uint64_t>(),
+                                       dst_t.Flat<uint64_t>(),
+                                       type_t.Flat<int32_t>(), n, fid, dim,
+                                       vals.data());
+        std::vector<uint64_t> offs(n + 1);
+        for (int64_t i = 0; i <= n; ++i) offs[i] = i * dim;
+        ctx->Put(node.OutName(out_i), MakeIdx(offs));
+        ctx->Put(node.OutName(out_i + 1), Tensor::FromVector(vals));
+      } else if (kind == FeatureKind::kSparse) {
+        std::vector<uint64_t> offs, vals;
+        env.graph->GetEdgeSparseFeature(src_t.Flat<uint64_t>(),
+                                        dst_t.Flat<uint64_t>(),
+                                        type_t.Flat<int32_t>(), n, fid, &offs,
+                                        &vals);
+        ctx->Put(node.OutName(out_i), MakeIdx(offs));
+        ctx->Put(node.OutName(out_i + 1), Tensor::FromVector(vals));
+      } else {
+        std::vector<uint64_t> offs;
+        std::vector<char> vals;
+        env.graph->GetEdgeBinaryFeature(src_t.Flat<uint64_t>(),
+                                        dst_t.Flat<uint64_t>(),
+                                        type_t.Flat<int32_t>(), n, fid, &offs,
+                                        &vals);
+        ctx->Put(node.OutName(out_i), MakeIdx(offs));
+        ctx->Put(node.OutName(out_i + 1), Tensor::FromVector(vals));
+      }
+    }
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("API_GET_EDGE_P", GetEdgeFeatureOp);
+
+// API_GET_NODE_T — input 0: ids → :0 i32 types (-1 for missing).
+class GetNodeTypeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor ids_t;
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &ids_t));
+    const uint64_t* ids = ids_t.Flat<uint64_t>();
+    int64_t n = ids_t.NumElements();
+    Tensor out(DType::kI32, {n});
+    int32_t* p = out.Flat<int32_t>();
+    for (int64_t i = 0; i < n; ++i) {
+      uint32_t row = env.graph->NodeIndex(ids[i]);
+      p[i] = row == kInvalidIndex ? -1 : env.graph->node_type(row);
+    }
+    ctx->Put(node.OutName(0), std::move(out));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("API_GET_NODE_T", GetNodeTypeOp);
+
+// API_SAMPLE_L — layerwise sampling (reference sample_layer_op.cc:74).
+// input 0: root ids; attrs [edge_types, layer_sizes "m0:m1", default_id].
+// out :l = pool ids for layer l.
+class SampleLayerOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor ids_t;
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &ids_t));
+    auto ets = ParseEdgeTypes(node.attrs.size() > 0 ? node.attrs[0] : "");
+    std::vector<int32_t> sizes;
+    for (auto& s : SplitStr(node.attrs.size() > 1 ? node.attrs[1] : "1", ':'))
+      sizes.push_back(std::atoi(s.c_str()));
+    uint64_t def = node.attrs.size() > 2 ? std::strtoull(node.attrs[2].c_str(), nullptr, 10) : 0;
+    Pcg32 rng = NodeRng(node, env);
+    std::vector<Tensor> layers;
+    std::vector<NodeId*> ptrs;
+    for (int32_t m : sizes) {
+      layers.emplace_back(DType::kU64, std::vector<int64_t>{m});
+      ptrs.push_back(layers.back().Flat<uint64_t>());
+    }
+    SampleLayerwise(*env.graph, ids_t.Flat<uint64_t>(), ids_t.NumElements(),
+                    sizes.data(), sizes.size(),
+                    ets.empty() ? nullptr : ets.data(), ets.size(), def, &rng,
+                    ptrs);
+    for (size_t l = 0; l < layers.size(); ++l)
+      ctx->Put(node.OutName(l), std::move(layers[l]));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("API_SAMPLE_L", SampleLayerOp);
+
+// ---------------------------------------------------------------------------
+// AS — alias all inputs under a new name for final fetch
+// (reference as_op.cc). attrs[0] = alias.
+// ---------------------------------------------------------------------------
+class AsOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    if (node.attrs.empty()) {
+      done(Status::InvalidArgument("AS needs an alias attr"));
+      return;
+    }
+    const std::string& alias = node.attrs[0];
+    for (size_t i = 0; i < node.inputs.size(); ++i) {
+      ctx->AddAlias(alias + ":" + std::to_string(i), node.inputs[i]);
+      ctx->AddAlias(node.OutName(i), node.inputs[i]);
+    }
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("AS", AsOp);
+
+// ---------------------------------------------------------------------------
+// POST_PROCESS — order_by/limit over a ragged quad (reference
+// post_process_op.cc:325). Inputs: idx, ids, w, t. post_process entries:
+// "order_by <id|weight> <asc|desc>", "limit <k>".
+// ---------------------------------------------------------------------------
+class PostProcessOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor idx_t, ids_t, w_t, t_t;
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &idx_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 1, &ids_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 2, &w_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 3, &t_t));
+    std::string order_field;
+    bool desc = false;
+    int64_t limit = -1;
+    for (const auto& pp : node.post_process) {
+      auto parts = SplitStr(pp, ' ');
+      if (parts.empty()) continue;
+      if (parts[0] == "order_by" && parts.size() >= 2) {
+        order_field = parts[1];
+        desc = parts.size() >= 3 && parts[2] == "desc";
+      } else if (parts[0] == "limit" && parts.size() >= 2) {
+        limit = std::atoll(parts[1].c_str());
+      }
+    }
+    int64_t n = idx_t.dim(0);
+    const int32_t* pidx = idx_t.Flat<int32_t>();
+    const uint64_t* ids = ids_t.Flat<uint64_t>();
+    const float* w = w_t.Flat<float>();
+    const int32_t* t = t_t.Flat<int32_t>();
+    std::vector<uint64_t> offsets{0};
+    std::vector<uint64_t> out_ids;
+    std::vector<float> out_w;
+    std::vector<int32_t> out_t;
+    for (int64_t i = 0; i < n; ++i) {
+      std::vector<int32_t> order;
+      for (int32_t j = pidx[2 * i]; j < pidx[2 * i + 1]; ++j)
+        order.push_back(j);
+      if (!order_field.empty()) {
+        std::stable_sort(order.begin(), order.end(),
+                         [&](int32_t a, int32_t b) {
+                           bool lt = order_field == "id"
+                                         ? ids[a] < ids[b]
+                                         : w[a] < w[b];
+                           return desc ? !lt && !(order_field == "id"
+                                                      ? ids[a] == ids[b]
+                                                      : w[a] == w[b])
+                                       : lt;
+                         });
+      }
+      if (limit >= 0 && static_cast<int64_t>(order.size()) > limit)
+        order.resize(limit);
+      for (int32_t j : order) {
+        out_ids.push_back(ids[j]);
+        out_w.push_back(w[j]);
+        out_t.push_back(t[j]);
+      }
+      offsets.push_back(out_ids.size());
+    }
+    ctx->Put(node.OutName(0), MakeIdx(offsets));
+    ctx->Put(node.OutName(1), Tensor::FromVector(out_ids));
+    ctx->Put(node.OutName(2), Tensor::FromVector(out_w));
+    ctx->Put(node.OutName(3), Tensor::FromVector(out_t));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("POST_PROCESS", PostProcessOp);
+
+// ---------------------------------------------------------------------------
+// ID_UNIQUE — input ids → :0 unique ids (first-seen order), :1 i32 inverse
+// positions. Used by the distribute rewrite to dedup before REMOTE.
+// ---------------------------------------------------------------------------
+class IdUniqueOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor ids_t;
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &ids_t));
+    const uint64_t* ids = ids_t.Flat<uint64_t>();
+    int64_t n = ids_t.NumElements();
+    std::vector<uint64_t> uniq;
+    std::vector<int32_t> inv(n);
+    std::unordered_map<uint64_t, int32_t> seen;
+    for (int64_t i = 0; i < n; ++i) {
+      auto it = seen.find(ids[i]);
+      if (it == seen.end()) {
+        it = seen.emplace(ids[i], static_cast<int32_t>(uniq.size())).first;
+        uniq.push_back(ids[i]);
+      }
+      inv[i] = it->second;
+    }
+    ctx->Put(node.OutName(0), Tensor::FromVector(uniq));
+    ctx->Put(node.OutName(1), Tensor::FromVector(inv));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("ID_UNIQUE", IdUniqueOp);
+
+}  // namespace
+}  // namespace et

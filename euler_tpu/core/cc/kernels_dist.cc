@@ -1,0 +1,587 @@
+// Distributed plumbing kernels: split / REMOTE / merge / gather.
+//
+// Capability parity with the reference's distributed kernel set
+// (SURVEY.md §2.1 "Graph op kernels", distributed plumbing:
+// BROAD_CAST_SPLIT, ID_SPLIT hash-mod placement, SAMPLE_NODE_SPLIT
+// weight-proportional count split, ID_UNIQUE, IDX_GATHER/DATA_GATHER,
+// APPEND_MERGE/IDX_MERGE/DATA_MERGE/REGULAR_DATA_MERGE, and the async
+// REMOTE op remote_op.cc:31,60-120). Redesigned around the row-aligned
+// tensor conventions of kernels.cc: every merge is "reassemble rows in
+// original input order from per-shard (positions, data) pairs", every
+// gather is "expand unique-row results through an inverse index".
+#include <algorithm>
+#include <cstdlib>
+#include <cstring>
+#include <unordered_set>
+
+#include "dag.h"
+#include "gql.h"
+#include "graph.h"
+#include "index.h"
+#include "rpc.h"
+#include "tensor.h"
+
+namespace et {
+namespace {
+
+Status GetIn(OpKernelContext* ctx, const NodeDef& node, size_t i,
+             Tensor* out) {
+  if (i >= node.inputs.size())
+    return Status::InvalidArgument(node.name + ": missing input " +
+                                   std::to_string(i));
+  if (!ctx->Get(node.inputs[i], out))
+    return Status::NotFound(node.name + ": input '" + node.inputs[i] +
+                            "' not produced");
+  return Status::OK();
+}
+
+#define ET_K_RETURN_IF_ERROR(expr)   \
+  do {                               \
+    ::et::Status _s = (expr);        \
+    if (!_s.ok()) {                  \
+      done(_s);                      \
+      return;                       \
+    }                                \
+  } while (0)
+
+Pcg32 DistRng(const NodeDef& node, const QueryEnv& env) {
+  if (env.seed == 0) return Pcg32(ThreadLocalRng().NextU32());
+  uint64_t h = 1469598103934665603ULL;
+  for (char c : node.name) h = (h ^ static_cast<uint64_t>(c)) * 1099511628211ULL;
+  // seq = per-execution nonce: repeated run()s draw fresh (but replayable)
+  // samples instead of the same batch every time.
+  return Pcg32(env.seed ^ h, env.nonce * 2 + 1);
+}
+
+// ---------------------------------------------------------------------------
+// COLLECT — rebind inputs as this node's outputs (the rewrite's seam: the
+// merge pipeline ends in a COLLECT named like the original op, so all
+// downstream references keep working).
+// ---------------------------------------------------------------------------
+class CollectOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    for (size_t i = 0; i < node.inputs.size(); ++i)
+      ctx->AddAlias(node.OutName(i), node.inputs[i]);
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("COLLECT", CollectOp);
+
+// ---------------------------------------------------------------------------
+// ID_SPLIT — attrs [partition_num, shard_num]; input ids → per shard s:
+// ids (:2s) and original positions (:2s+1).
+// ---------------------------------------------------------------------------
+class IdSplitOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor ids_t;
+    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 0, &ids_t));
+    int pn = std::atoi(node.attrs[0].c_str());
+    int sn = std::atoi(node.attrs[1].c_str());
+    const uint64_t* ids = ids_t.Flat<uint64_t>();
+    int64_t n = ids_t.NumElements();
+    std::vector<std::vector<uint64_t>> sids(sn);
+    std::vector<std::vector<int32_t>> spos(sn);
+    for (int64_t i = 0; i < n; ++i) {
+      int s = ShardOf(ids[i], pn, sn);
+      sids[s].push_back(ids[i]);
+      spos[s].push_back(static_cast<int32_t>(i));
+    }
+    for (int s = 0; s < sn; ++s) {
+      ctx->Put(node.OutName(2 * s), Tensor::FromVector(sids[s]));
+      ctx->Put(node.OutName(2 * s + 1), Tensor::FromVector(spos[s]));
+    }
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("ID_SPLIT", IdSplitOp);
+
+// TRIPLE_SPLIT — attrs [pn, sn]; inputs src,dst,type → per shard:
+// src(:4s) dst(:4s+1) type(:4s+2) pos(:4s+3). Placement by src owner.
+class TripleSplitOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor src_t, dst_t, tt;
+    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 0, &src_t));
+    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 1, &dst_t));
+    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 2, &tt));
+    int pn = std::atoi(node.attrs[0].c_str());
+    int sn = std::atoi(node.attrs[1].c_str());
+    const uint64_t* src = src_t.Flat<uint64_t>();
+    const uint64_t* dst = dst_t.Flat<uint64_t>();
+    const int32_t* typ = tt.Flat<int32_t>();
+    int64_t n = src_t.NumElements();
+    std::vector<std::vector<uint64_t>> ss(sn), sd(sn);
+    std::vector<std::vector<int32_t>> st(sn), sp(sn);
+    for (int64_t i = 0; i < n; ++i) {
+      int s = ShardOf(src[i], pn, sn);
+      ss[s].push_back(src[i]);
+      sd[s].push_back(dst[i]);
+      st[s].push_back(typ[i]);
+      sp[s].push_back(static_cast<int32_t>(i));
+    }
+    for (int s = 0; s < sn; ++s) {
+      ctx->Put(node.OutName(4 * s), Tensor::FromVector(ss[s]));
+      ctx->Put(node.OutName(4 * s + 1), Tensor::FromVector(sd[s]));
+      ctx->Put(node.OutName(4 * s + 2), Tensor::FromVector(st[s]));
+      ctx->Put(node.OutName(4 * s + 3), Tensor::FromVector(sp[s]));
+    }
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("TRIPLE_SPLIT", TripleSplitOp);
+
+// TYPES_SPLIT — attrs [sn]; input per-row node types; each row is assigned
+// a shard ∝ that shard's weight for the row's type (reference
+// weight-proportional sampling, query_proxy.cc:77-105).
+class TypesSplitOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor types_t;
+    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 0, &types_t));
+    int sn = std::atoi(node.attrs[0].c_str());
+    const int32_t* types = types_t.Flat<int32_t>();
+    int64_t n = types_t.NumElements();
+    Pcg32 rng = DistRng(node, env);
+    std::vector<std::vector<int32_t>> st(sn);
+    std::vector<std::vector<int32_t>> sp(sn);
+    std::vector<float> cum(sn);
+    for (int64_t i = 0; i < n; ++i) {
+      float total = 0;
+      for (int s = 0; s < sn; ++s) {
+        float w = env.client != nullptr ? env.client->NodeWeight(s, types[i])
+                                        : 1.f;
+        total += w;
+        cum[s] = total;
+      }
+      int pick = sn - 1;
+      if (total > 0) {
+        float r = rng.NextFloat() * total;
+        pick = static_cast<int>(
+            std::lower_bound(cum.begin(), cum.end(), r) - cum.begin());
+        if (pick >= sn) pick = sn - 1;
+      }
+      st[pick].push_back(types[i]);
+      sp[pick].push_back(static_cast<int32_t>(i));
+    }
+    for (int s = 0; s < sn; ++s) {
+      ctx->Put(node.OutName(2 * s), Tensor::FromVector(st[s]));
+      ctx->Put(node.OutName(2 * s + 1), Tensor::FromVector(sp[s]));
+    }
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("TYPES_SPLIT", TypesSplitOp);
+
+// SAMPLE_SPLIT — attrs [node|edge, count, type]; optional input count
+// scalar. Outputs per shard :s = i64 count, multinomial ∝ shard weight
+// (reference SAMPLE_NODE_SPLIT, sample_node_split_op.cc).
+class SampleSplitOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    bool edge = node.attrs[0] == "edge";
+    int64_t count = std::atoll(node.attrs[1].c_str());
+    int type = std::atoi(node.attrs[2].c_str());
+    if (!node.inputs.empty()) {
+      Tensor t;
+      if (ctx->Get(node.inputs[0], &t) && t.NumElements() > 0)
+        count = t.AsI64(0);
+    }
+    int sn = env.client != nullptr ? env.client->shard_num() : 1;
+    std::vector<float> cum(sn);
+    float total = 0;
+    for (int s = 0; s < sn; ++s) {
+      float w = 1.f;
+      if (env.client != nullptr)
+        w = edge ? env.client->EdgeWeight(s, type)
+                 : env.client->NodeWeight(s, type);
+      total += w;
+      cum[s] = total;
+    }
+    std::vector<int64_t> counts(sn, 0);
+    Pcg32 rng = DistRng(node, env);
+    for (int64_t i = 0; i < count; ++i) {
+      int pick = sn - 1;
+      if (total > 0) {
+        float r = rng.NextFloat() * total;
+        pick = static_cast<int>(
+            std::lower_bound(cum.begin(), cum.end(), r) - cum.begin());
+        if (pick >= sn) pick = sn - 1;
+      }
+      counts[pick]++;
+    }
+    for (int s = 0; s < sn; ++s)
+      ctx->Put(node.OutName(s), Tensor::Scalar<int64_t>(counts[s]));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("SAMPLE_SPLIT", SampleSplitOp);
+
+// ---------------------------------------------------------------------------
+// merges
+// ---------------------------------------------------------------------------
+// APPEND_MERGE — concat inputs along dim 0.
+class AppendMergeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    std::vector<Tensor> ins(node.inputs.size());
+    int64_t total = 0;
+    for (size_t i = 0; i < node.inputs.size(); ++i) {
+      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, i, &ins[i]));
+      total += ins[i].NumElements();
+    }
+    Tensor out(ins[0].dtype(), {total});
+    uint8_t* p = out.raw();
+    for (auto& t : ins) {
+      std::memcpy(p, t.raw(), t.ByteSize());
+      p += t.ByteSize();
+    }
+    ctx->Put(node.OutName(0), std::move(out));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("APPEND_MERGE", AppendMergeOp);
+
+// REGULAR_MERGE — attrs [row_elems]; inputs per shard (pos, data).
+// Scatter fixed-size rows back to original positions.
+class RegularMergeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    int64_t row = std::atoll(node.attrs[0].c_str());
+    size_t ns = node.inputs.size() / 2;
+    int64_t n = 0;
+    std::vector<Tensor> pos(ns), data(ns);
+    for (size_t s = 0; s < ns; ++s) {
+      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 2 * s, &pos[s]));
+      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 2 * s + 1, &data[s]));
+      n += pos[s].NumElements();
+    }
+    DType dt = data[0].dtype();
+    size_t esz = DTypeSize(dt) * row;
+    Tensor out(dt, {n * row});
+    for (size_t s = 0; s < ns; ++s) {
+      const int32_t* p = pos[s].Flat<int32_t>();
+      for (int64_t j = 0; j < pos[s].NumElements(); ++j)
+        std::memcpy(out.raw() + p[j] * esz, data[s].raw() + j * esz, esz);
+    }
+    ctx->Put(node.OutName(0), std::move(out));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("REGULAR_MERGE", RegularMergeOp);
+
+// RAGGED_MERGE — attrs [P]; inputs per shard: pos, idx, P payloads.
+// Rebuild ragged rows in original order → idx (:0) + payloads (:1..P).
+class RaggedMergeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    int P = std::atoi(node.attrs[0].c_str());
+    size_t stride = 2 + P;
+    size_t ns = node.inputs.size() / stride;
+    std::vector<Tensor> pos(ns), idx(ns);
+    std::vector<std::vector<Tensor>> pay(ns, std::vector<Tensor>(P));
+    int64_t n = 0;
+    for (size_t s = 0; s < ns; ++s) {
+      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, stride * s, &pos[s]));
+      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, stride * s + 1, &idx[s]));
+      for (int p = 0; p < P; ++p)
+        ET_K_RETURN_IF_ERROR(GetIn(ctx, node, stride * s + 2 + p,
+                                   &pay[s][p]));
+      n += pos[s].NumElements();
+    }
+    // global row → (shard, local row)
+    std::vector<std::pair<int32_t, int32_t>> where(n);
+    for (size_t s = 0; s < ns; ++s) {
+      const int32_t* p = pos[s].Flat<int32_t>();
+      for (int64_t j = 0; j < pos[s].NumElements(); ++j)
+        where[p[j]] = {static_cast<int32_t>(s), static_cast<int32_t>(j)};
+    }
+    Tensor out_idx(DType::kI32, {n, 2});
+    int32_t* oi = out_idx.Flat<int32_t>();
+    int64_t cursor = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      auto [s, j] = where[i];
+      const int32_t* si = idx[s].Flat<int32_t>();
+      int64_t len = si[2 * j + 1] - si[2 * j];
+      oi[2 * i] = static_cast<int32_t>(cursor);
+      oi[2 * i + 1] = static_cast<int32_t>(cursor + len);
+      cursor += len;
+    }
+    std::vector<Tensor> out_pay;
+    for (int p = 0; p < P; ++p) {
+      DType dt = pay[0][p].dtype();
+      size_t esz = DTypeSize(dt);
+      Tensor out(dt, {cursor});
+      for (int64_t i = 0; i < n; ++i) {
+        auto [s, j] = where[i];
+        const int32_t* si = idx[s].Flat<int32_t>();
+        int64_t b = si[2 * j], e = si[2 * j + 1];
+        std::memcpy(out.raw() + oi[2 * i] * esz, pay[s][p].raw() + b * esz,
+                    (e - b) * esz);
+      }
+      out_pay.push_back(std::move(out));
+    }
+    ctx->Put(node.OutName(0), std::move(out_idx));
+    for (int p = 0; p < P; ++p)
+      ctx->Put(node.OutName(1 + p), std::move(out_pay[p]));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("RAGGED_MERGE", RaggedMergeOp);
+
+// REGULAR_GATHER — attrs [row_elems]; inputs inv i32[n], data → out row i =
+// data row inv[i].
+class RegularGatherOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor inv_t, data;
+    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 0, &inv_t));
+    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 1, &data));
+    int64_t row = std::atoll(node.attrs[0].c_str());
+    const int32_t* inv = inv_t.Flat<int32_t>();
+    int64_t n = inv_t.NumElements();
+    size_t esz = DTypeSize(data.dtype()) * row;
+    Tensor out(data.dtype(), {n * row});
+    for (int64_t i = 0; i < n; ++i)
+      std::memcpy(out.raw() + i * esz, data.raw() + inv[i] * esz, esz);
+    ctx->Put(node.OutName(0), std::move(out));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("REGULAR_GATHER", RegularGatherOp);
+
+// RAGGED_GATHER — attrs [P]; inputs inv, idx_u, P payloads (unique-aligned)
+// → expanded idx + payloads for the original rows.
+class RaggedGatherOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    int P = std::atoi(node.attrs[0].c_str());
+    Tensor inv_t, idx_t;
+    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 0, &inv_t));
+    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 1, &idx_t));
+    std::vector<Tensor> pay(P);
+    for (int p = 0; p < P; ++p)
+      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 2 + p, &pay[p]));
+    const int32_t* inv = inv_t.Flat<int32_t>();
+    const int32_t* ui = idx_t.Flat<int32_t>();
+    int64_t n = inv_t.NumElements();
+    Tensor out_idx(DType::kI32, {n, 2});
+    int32_t* oi = out_idx.Flat<int32_t>();
+    int64_t cursor = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t len = ui[2 * inv[i] + 1] - ui[2 * inv[i]];
+      oi[2 * i] = static_cast<int32_t>(cursor);
+      oi[2 * i + 1] = static_cast<int32_t>(cursor + len);
+      cursor += len;
+    }
+    for (int p = 0; p < P; ++p) {
+      size_t esz = DTypeSize(pay[p].dtype());
+      Tensor out(pay[p].dtype(), {cursor});
+      for (int64_t i = 0; i < n; ++i) {
+        int64_t b = ui[2 * inv[i]], e = ui[2 * inv[i] + 1];
+        std::memcpy(out.raw() + oi[2 * i] * esz, pay[p].raw() + b * esz,
+                    (e - b) * esz);
+      }
+      ctx->Put(node.OutName(1 + p), std::move(out));
+    }
+    ctx->Put(node.OutName(0), std::move(out_idx));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("RAGGED_GATHER", RaggedGatherOp);
+
+// POOL_MERGE — attrs [m]; concat per-shard candidate pools, dedupe,
+// downsample to m (pad by cycling when short).
+class PoolMergeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    int64_t m = std::atoll(node.attrs[0].c_str());
+    std::vector<uint64_t> all;
+    std::unordered_set<uint64_t> seen;
+    for (size_t i = 0; i < node.inputs.size(); ++i) {
+      Tensor t;
+      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, i, &t));
+      const uint64_t* p = t.Flat<uint64_t>();
+      for (int64_t j = 0; j < t.NumElements(); ++j)
+        if (seen.insert(p[j]).second) all.push_back(p[j]);
+    }
+    Pcg32 rng = DistRng(node, env);
+    Tensor out(DType::kU64, {m});
+    uint64_t* o = out.Flat<uint64_t>();
+    if (all.empty()) {
+      std::memset(o, 0, out.ByteSize());
+    } else if (static_cast<int64_t>(all.size()) <= m) {
+      for (int64_t i = 0; i < m; ++i) o[i] = all[i % all.size()];
+    } else {
+      // partial Fisher–Yates for m distinct picks
+      for (int64_t i = 0; i < m; ++i) {
+        size_t j = i + rng.NextUInt(all.size() - i);
+        std::swap(all[i], all[j]);
+        o[i] = all[i];
+      }
+    }
+    ctx->Put(node.OutName(0), std::move(out));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("POOL_MERGE", PoolMergeOp);
+
+// FILTER_MERGE — inputs per shard (pos, surviving ids, local survivor
+// positions) → (ids, positions) ordered by original position.
+class FilterMergeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    size_t ns = node.inputs.size() / 3;
+    std::vector<std::pair<int32_t, uint64_t>> rows;
+    for (size_t s = 0; s < ns; ++s) {
+      Tensor pos, ids, lpos;
+      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 3 * s, &pos));
+      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 3 * s + 1, &ids));
+      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 3 * s + 2, &lpos));
+      const int32_t* p = pos.Flat<int32_t>();
+      const uint64_t* id = ids.Flat<uint64_t>();
+      const int32_t* lp = lpos.Flat<int32_t>();
+      for (int64_t j = 0; j < ids.NumElements(); ++j)
+        rows.emplace_back(p[lp[j]], id[j]);
+    }
+    std::sort(rows.begin(), rows.end());
+    std::vector<uint64_t> out_ids;
+    std::vector<int32_t> out_pos;
+    for (auto& r : rows) {
+      out_pos.push_back(r.first);
+      out_ids.push_back(r.second);
+    }
+    ctx->Put(node.OutName(0), Tensor::FromVector(out_ids));
+    ctx->Put(node.OutName(1), Tensor::FromVector(out_pos));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("FILTER_MERGE", FilterMergeOp);
+
+// QUAD_FILTER_APPLY — inputs idx, ids, w, t, keep_ids → quad restricted to
+// the membership set.
+class QuadFilterApplyOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor idx_t, ids_t, w_t, t_t, keep_t;
+    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 0, &idx_t));
+    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 1, &ids_t));
+    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 2, &w_t));
+    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 3, &t_t));
+    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 4, &keep_t));
+    std::unordered_set<uint64_t> keep;
+    const uint64_t* kp = keep_t.Flat<uint64_t>();
+    for (int64_t i = 0; i < keep_t.NumElements(); ++i) keep.insert(kp[i]);
+    int64_t n = idx_t.dim(0);
+    const int32_t* pidx = idx_t.Flat<int32_t>();
+    const uint64_t* ids = ids_t.Flat<uint64_t>();
+    const float* w = w_t.Flat<float>();
+    const int32_t* t = t_t.Flat<int32_t>();
+    std::vector<uint64_t> offs{0};
+    std::vector<uint64_t> oid;
+    std::vector<float> ow;
+    std::vector<int32_t> ot;
+    for (int64_t i = 0; i < n; ++i) {
+      for (int32_t j = pidx[2 * i]; j < pidx[2 * i + 1]; ++j) {
+        if (keep.count(ids[j]) == 0) continue;
+        oid.push_back(ids[j]);
+        ow.push_back(w[j]);
+        ot.push_back(t[j]);
+      }
+      offs.push_back(oid.size());
+    }
+    Tensor out_idx(DType::kI32, {n, 2});
+    int32_t* oi = out_idx.Flat<int32_t>();
+    for (int64_t i = 0; i < n; ++i) {
+      oi[2 * i] = static_cast<int32_t>(offs[i]);
+      oi[2 * i + 1] = static_cast<int32_t>(offs[i + 1]);
+    }
+    ctx->Put(node.OutName(0), std::move(out_idx));
+    ctx->Put(node.OutName(1), Tensor::FromVector(oid));
+    ctx->Put(node.OutName(2), Tensor::FromVector(ow));
+    ctx->Put(node.OutName(3), Tensor::FromVector(ot));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("QUAD_FILTER_APPLY", QuadFilterApplyOp);
+
+// ---------------------------------------------------------------------------
+// REMOTE — ship inputs + inner sub-DAG to shard_idx, decode replies
+// (reference remote_op.cc:60-120). Async: the RPC runs on the pool via
+// ClientManager::ExecuteAsync; with no ClientManager (single-process
+// tests) the inner plan runs loopback against the local graph.
+// ---------------------------------------------------------------------------
+class RemoteOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    ExecuteRequest req;
+    for (const auto& in : node.inputs) {
+      Tensor t;
+      if (!ctx->Get(in, &t)) {
+        done(Status::NotFound("REMOTE input not produced: " + in));
+        return;
+      }
+      req.inputs.emplace_back(in, std::move(t));
+    }
+    req.nodes = node.inner;
+    req.outputs = node.attrs;
+
+    if (env.client == nullptr) {
+      // loopback: execute the inner plan against the local graph
+      OpKernelContext inner_ctx;
+      for (auto& kv : req.inputs) inner_ctx.Put(kv.first, kv.second);
+      auto dag = std::make_shared<DAGDef>();
+      dag->nodes = req.nodes;
+      QueryEnv inner_env = env;
+      auto exec = std::make_shared<Executor>(dag.get(), inner_env,
+                                             &inner_ctx);
+      Status s = exec->RunSync();
+      (void)dag;
+      if (s.ok()) {
+        for (size_t i = 0; i < req.outputs.size(); ++i) {
+          Tensor t;
+          if (!inner_ctx.Get(req.outputs[i], &t)) {
+            s = Status::NotFound("REMOTE output missing: " + req.outputs[i]);
+            break;
+          }
+          ctx->Put(node.OutName(static_cast<int>(i)), std::move(t));
+        }
+      }
+      done(s);
+      return;
+    }
+
+    std::string name = node.name;
+    std::vector<std::string> outs = req.outputs;
+    env.client->ExecuteAsync(
+        node.shard_idx, std::move(req),
+        [ctx, name, outs, done](Status s, ExecuteReply rep) {
+          if (s.ok()) {
+            for (size_t i = 0; i < rep.outputs.size() && i < outs.size();
+                 ++i)
+              ctx->Put(name + ":" + std::to_string(i),
+                       std::move(rep.outputs[i].second));
+          }
+          done(s);
+        });
+  }
+};
+ET_REGISTER_KERNEL("REMOTE", RemoteOp);
+
+}  // namespace
+}  // namespace et

@@ -1,0 +1,88 @@
+#include "query_proxy.h"
+
+#include "threadpool.h"
+
+namespace et {
+
+Status QueryProxy::NewLocal(std::shared_ptr<const Graph> graph,
+                            const std::string& index_spec, uint64_t seed,
+                            std::unique_ptr<QueryProxy>* out) {
+  auto qp = std::unique_ptr<QueryProxy>(new QueryProxy());
+  qp->graph_ = std::move(graph);
+  qp->seed_ = seed;
+  if (!index_spec.empty()) {
+    qp->index_ = std::make_shared<IndexManager>();
+    ET_RETURN_IF_ERROR(qp->index_->BuildFromSpec(*qp->graph_, index_spec));
+  }
+  CompileOptions opts;
+  opts.mode = "local";
+  opts.shard_num = 1;
+  qp->compiler_ = std::make_unique<GqlCompiler>(opts);
+  *out = std::move(qp);
+  return Status::OK();
+}
+
+Status QueryProxy::NewRemote(const std::string& endpoints, uint64_t seed,
+                             std::unique_ptr<QueryProxy>* out) {
+  ShardEndpoints eps;
+  if (endpoints.rfind("hosts:", 0) == 0) {
+    ET_RETURN_IF_ERROR(DiscoverFromSpec(endpoints.substr(6), &eps));
+  } else if (endpoints.rfind("dir:", 0) == 0) {
+    ET_RETURN_IF_ERROR(DiscoverFromRegistryAuto(endpoints.substr(4), &eps));
+  } else {
+    return Status::InvalidArgument(
+        "endpoints must be 'hosts:h:p,...' or 'dir:/path'");
+  }
+  auto qp = std::unique_ptr<QueryProxy>(new QueryProxy());
+  qp->seed_ = seed;
+  qp->client_ = std::make_unique<ClientManager>();
+  ET_RETURN_IF_ERROR(qp->client_->Init(eps));
+  CompileOptions opts;
+  opts.mode = "distribute";
+  opts.shard_num = qp->client_->shard_num();
+  opts.partition_num = qp->client_->partition_num();
+  qp->compiler_ = std::make_unique<GqlCompiler>(opts);
+  *out = std::move(qp);
+  return Status::OK();
+}
+
+const GraphMeta& QueryProxy::graph_meta() const {
+  static GraphMeta empty;
+  if (graph_) return graph_->meta();
+  if (client_) return client_->graph_meta();
+  return empty;
+}
+
+Status QueryProxy::RunGremlin(const std::string& query,
+                              const std::map<std::string, Tensor>& inputs,
+                              std::map<std::string, Tensor>* outputs) {
+  std::shared_ptr<const TranslateResult> plan;
+  ET_RETURN_IF_ERROR(compiler_->Compile(query, &plan));
+  OpKernelContext ctx;
+  for (const auto& kv : inputs) ctx.Put(kv.first, kv.second);
+  QueryEnv env;
+  env.graph = graph_.get();
+  env.index = index_.get();
+  env.client = client_.get();
+  env.pool = GlobalThreadPool();
+  env.seed = seed_;
+  env.nonce = run_counter_.fetch_add(1);
+  Executor exec(&plan->dag, env, &ctx);
+  ET_RETURN_IF_ERROR(exec.RunSync());
+  outputs->clear();
+  for (const auto& alias : plan->aliases) {
+    for (int i = 0;; ++i) {
+      std::string name = alias + ":" + std::to_string(i);
+      Tensor t;
+      if (!ctx.Get(name, &t)) break;
+      (*outputs)[name] = std::move(t);
+    }
+  }
+  for (const auto& name : plan->last_outputs) {
+    Tensor t;
+    if (ctx.Get(name, &t)) (*outputs)[name] = std::move(t);
+  }
+  return Status::OK();
+}
+
+}  // namespace et

@@ -1,0 +1,62 @@
+// QueryProxy: one object that turns gremlin strings into result tensors.
+//
+// Capability parity with the reference's euler/client/query_proxy.*
+// (SURVEY.md §2.1): Init picks local vs distribute mode from config
+// (query_proxy.cc:34-41), boots the graph + index locally or a
+// ClientManager remotely, owns the compiler, and RunGremlin compiles
+// (cached) then executes on the shared thread pool (query_proxy.cc:213-233).
+#ifndef EULER_TPU_QUERY_PROXY_H_
+#define EULER_TPU_QUERY_PROXY_H_
+
+#include <atomic>
+#include <map>
+#include <memory>
+#include <string>
+
+#include "common.h"
+#include "gql.h"
+#include "graph.h"
+#include "index.h"
+#include "rpc.h"
+
+namespace et {
+
+class QueryProxy {
+ public:
+  // Local (embedded) mode over an existing in-memory graph.
+  // index_spec: "" or "attr:hash_index,attr2:range_index".
+  static Status NewLocal(std::shared_ptr<const Graph> graph,
+                         const std::string& index_spec, uint64_t seed,
+                         std::unique_ptr<QueryProxy>* out);
+
+  // Distribute mode: endpoints either from a registry dir ("dir:<path>")
+  // or a static spec ("hosts:<h:p,h:p,...>"). shard_num inferred from the
+  // endpoint list.
+  static Status NewRemote(const std::string& endpoints, uint64_t seed,
+                          std::unique_ptr<QueryProxy>* out);
+
+  // Compile + execute. Returns every alias tensor ("<as>:i") plus the
+  // terminal outputs of the chain.
+  Status RunGremlin(const std::string& query,
+                    const std::map<std::string, Tensor>& inputs,
+                    std::map<std::string, Tensor>* outputs);
+
+  const GraphMeta& graph_meta() const;
+  int shard_num() const {
+    return client_ ? client_->shard_num() : 1;
+  }
+
+ private:
+  QueryProxy() = default;
+
+  std::shared_ptr<const Graph> graph_;          // local mode
+  std::shared_ptr<IndexManager> index_;         // local mode
+  std::unique_ptr<ClientManager> client_;       // distribute mode
+  std::unique_ptr<GqlCompiler> compiler_;
+  uint64_t seed_ = 0;
+  std::atomic<uint64_t> run_counter_{0};  // per-run RNG nonce
+};
+
+}  // namespace et
+
+#endif  // EULER_TPU_QUERY_PROXY_H_

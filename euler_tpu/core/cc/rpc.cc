@@ -1,0 +1,542 @@
+#include "rpc.h"
+
+#include <arpa/inet.h>
+#include <dirent.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <sstream>
+
+#include "threadpool.h"
+
+namespace et {
+
+namespace {
+constexpr uint32_t kFrameMagic = 0x52465445;  // 'ETFR'
+
+enum MsgType : uint32_t { kExecute = 0, kMeta = 1, kPing = 2 };
+
+bool WriteAll(int fd, const char* p, size_t n) {
+  while (n > 0) {
+    ssize_t w = ::write(fd, p, n);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool ReadAll(int fd, char* p, size_t n) {
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFrame(int fd, uint32_t msg_type, const char* body, size_t len) {
+  char hdr[16];
+  std::memcpy(hdr, &kFrameMagic, 4);
+  std::memcpy(hdr + 4, &msg_type, 4);
+  uint64_t l = len;
+  std::memcpy(hdr + 8, &l, 8);
+  return WriteAll(fd, hdr, 16) && WriteAll(fd, body, len);
+}
+
+bool ReadFrame(int fd, uint32_t* msg_type, std::vector<char>* body) {
+  char hdr[16];
+  if (!ReadAll(fd, hdr, 16)) return false;
+  uint32_t magic;
+  std::memcpy(&magic, hdr, 4);
+  if (magic != kFrameMagic) return false;
+  std::memcpy(msg_type, hdr + 4, 4);
+  uint64_t len;
+  std::memcpy(&len, hdr + 8, 8);
+  if (len > (1ULL << 33)) return false;  // 8 GiB sanity cap
+  body->resize(len);
+  return len == 0 || ReadAll(fd, body->data(), len);
+}
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// ShardMeta serde
+// ---------------------------------------------------------------------------
+void EncodeShardMeta(const ShardMeta& m, ByteWriter* w) {
+  w->Put<int32_t>(m.shard_idx);
+  w->Put<int32_t>(m.shard_num);
+  w->Put<int32_t>(m.partition_num);
+  w->Put<uint32_t>(static_cast<uint32_t>(m.node_type_wsum.size()));
+  for (float f : m.node_type_wsum) w->Put<float>(f);
+  w->Put<uint32_t>(static_cast<uint32_t>(m.edge_type_wsum.size()));
+  for (float f : m.edge_type_wsum) w->Put<float>(f);
+  const GraphMeta& gm = m.graph_meta;
+  w->PutStr(gm.name);
+  w->Put<int32_t>(gm.num_node_types);
+  w->Put<int32_t>(gm.num_edge_types);
+  w->Put<uint64_t>(gm.node_count);
+  w->Put<uint64_t>(gm.edge_count);
+  auto put_feats = [&](const std::vector<FeatureInfo>& fs) {
+    w->Put<uint32_t>(static_cast<uint32_t>(fs.size()));
+    for (const auto& f : fs) {
+      w->PutStr(f.name);
+      w->Put<int32_t>(static_cast<int32_t>(f.kind));
+      w->Put<int64_t>(f.dim);
+    }
+  };
+  put_feats(gm.node_features);
+  put_feats(gm.edge_features);
+  auto put_names = [&](const std::vector<std::string>& ns) {
+    w->Put<uint32_t>(static_cast<uint32_t>(ns.size()));
+    for (const auto& s : ns) w->PutStr(s);
+  };
+  put_names(gm.node_type_names);
+  put_names(gm.edge_type_names);
+}
+
+Status DecodeShardMeta(ByteReader* r, ShardMeta* m) {
+  uint32_t n;
+  if (!r->Get(&m->shard_idx) || !r->Get(&m->shard_num) ||
+      !r->Get(&m->partition_num) || !r->Get(&n))
+    return Status::IOError("truncated shard meta");
+  m->node_type_wsum.resize(n);
+  for (uint32_t i = 0; i < n; ++i)
+    if (!r->Get(&m->node_type_wsum[i]))
+      return Status::IOError("truncated weights");
+  if (!r->Get(&n)) return Status::IOError("truncated shard meta");
+  m->edge_type_wsum.resize(n);
+  for (uint32_t i = 0; i < n; ++i)
+    if (!r->Get(&m->edge_type_wsum[i]))
+      return Status::IOError("truncated weights");
+  GraphMeta& gm = m->graph_meta;
+  if (!r->GetStr(&gm.name) || !r->Get(&gm.num_node_types) ||
+      !r->Get(&gm.num_edge_types) || !r->Get(&gm.node_count) ||
+      !r->Get(&gm.edge_count))
+    return Status::IOError("truncated graph meta");
+  auto get_feats = [&](std::vector<FeatureInfo>* fs) -> bool {
+    uint32_t k;
+    if (!r->Get(&k)) return false;
+    fs->resize(k);
+    for (uint32_t i = 0; i < k; ++i) {
+      int32_t kind;
+      if (!r->GetStr(&(*fs)[i].name) || !r->Get(&kind) ||
+          !r->Get(&(*fs)[i].dim))
+        return false;
+      (*fs)[i].kind = static_cast<FeatureKind>(kind);
+    }
+    return true;
+  };
+  auto get_names = [&](std::vector<std::string>* ns) -> bool {
+    uint32_t k;
+    if (!r->Get(&k)) return false;
+    ns->resize(k);
+    for (uint32_t i = 0; i < k; ++i)
+      if (!r->GetStr(&(*ns)[i])) return false;
+    return true;
+  };
+  if (!get_feats(&gm.node_features) || !get_feats(&gm.edge_features) ||
+      !get_names(&gm.node_type_names) || !get_names(&gm.edge_type_names))
+    return Status::IOError("truncated graph meta tail");
+  return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// GraphServer
+// ---------------------------------------------------------------------------
+GraphServer::GraphServer(std::shared_ptr<const Graph> graph,
+                         std::shared_ptr<IndexManager> index, int shard_idx,
+                         int shard_num, int partition_num)
+    : graph_(std::move(graph)),
+      index_(std::move(index)),
+      shard_idx_(shard_idx),
+      shard_num_(shard_num),
+      partition_num_(partition_num) {}
+
+GraphServer::~GraphServer() { Stop(); }
+
+Status GraphServer::Start(int port) {
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return Status::IOError("socket() failed");
+  int one = 1;
+  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+      0)
+    return Status::IOError("bind() failed on port " + std::to_string(port));
+  if (::listen(listen_fd_, 128) != 0)
+    return Status::IOError("listen() failed");
+  socklen_t len = sizeof(addr);
+  ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+  port_ = ntohs(addr.sin_port);
+  acceptor_ = std::thread([this] { AcceptLoop(); });
+  ET_LOG(INFO) << "graph shard " << shard_idx_ << "/" << shard_num_
+               << " serving on port " << port_;
+  return Status::OK();
+}
+
+void GraphServer::Stop() {
+  if (stopping_.exchange(true)) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+  }
+  if (acceptor_.joinable()) acceptor_.join();
+  // Shut down open sockets so reader threads unblock, then join outside the
+  // lock (the threads deregister their fds under conn_mu_ on exit).
+  std::vector<Conn> to_join;
+  {
+    std::lock_guard<std::mutex> lk(conn_mu_);
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    to_join = std::move(conns_);
+    conns_.clear();
+  }
+  for (auto& c : to_join)
+    if (c.thread.joinable()) c.thread.join();
+  {
+    std::lock_guard<std::mutex> lk(conn_mu_);
+    conn_fds_.clear();
+  }
+  if (!registered_path_.empty()) std::remove(registered_path_.c_str());
+}
+
+void GraphServer::ReapFinishedLocked() {
+  size_t kept = 0;
+  for (size_t i = 0; i < conns_.size(); ++i) {
+    if (conns_[i].finished->load()) {
+      conns_[i].thread.join();
+    } else {
+      if (kept != i) conns_[kept] = std::move(conns_[i]);
+      ++kept;
+    }
+  }
+  conns_.resize(kept);
+}
+
+Status GraphServer::Register(const std::string& registry_dir,
+                             const std::string& host) {
+  std::ostringstream os;
+  os << registry_dir << "/shard_" << shard_idx_ << "__" << host << "_"
+     << port_;
+  registered_path_ = os.str();
+  return WriteStringToFile(registered_path_, "", 0);
+}
+
+void GraphServer::AcceptLoop() {
+  for (;;) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (stopping_.load()) return;
+      continue;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::lock_guard<std::mutex> lk(conn_mu_);
+    ReapFinishedLocked();
+    Conn c;
+    c.finished = std::make_shared<std::atomic<bool>>(false);
+    auto flag = c.finished;
+    conn_fds_.push_back(fd);
+    c.thread = std::thread([this, fd, flag] {
+      HandleConnection(fd);
+      flag->store(true);
+    });
+    conns_.push_back(std::move(c));
+  }
+}
+
+void GraphServer::HandleConnection(int fd) {
+  std::vector<char> body;
+  uint32_t msg_type;
+  while (!stopping_.load() && ReadFrame(fd, &msg_type, &body)) {
+    ByteWriter w;
+    if (msg_type == kExecute) {
+      ByteReader r(body.data(), body.size());
+      HandleExecute(&r, &w);
+    } else if (msg_type == kMeta) {
+      ShardMeta m;
+      m.shard_idx = shard_idx_;
+      m.shard_num = shard_num_;
+      m.partition_num = partition_num_;
+      m.node_type_wsum = graph_->node_type_weight_sums();
+      m.edge_type_wsum = graph_->edge_type_weight_sums();
+      m.graph_meta = graph_->meta();
+      EncodeShardMeta(m, &w);
+    } else {  // ping
+      w.Put<uint32_t>(0);
+    }
+    if (!WriteFrame(fd, msg_type, w.buffer().data(), w.buffer().size()))
+      break;
+  }
+  ::close(fd);
+  std::lock_guard<std::mutex> lk(conn_mu_);
+  for (size_t i = 0; i < conn_fds_.size(); ++i) {
+    if (conn_fds_[i] == fd) {
+      conn_fds_[i] = conn_fds_.back();
+      conn_fds_.pop_back();
+      break;
+    }
+  }
+}
+
+void GraphServer::HandleExecute(ByteReader* r, ByteWriter* w) {
+  ExecuteRequest req;
+  ExecuteReply rep;
+  Status s = DecodeExecuteRequest(r, &req);
+  if (s.ok()) {
+    // Parity: GrpcWorker::ExecuteAsync (grpc_worker.cc:40-96): ctx from
+    // request inputs → run the DAG on the shared pool → encode outputs.
+    OpKernelContext ctx;
+    for (auto& kv : req.inputs) ctx.Put(kv.first, std::move(kv.second));
+    DAGDef dag;
+    dag.nodes = std::move(req.nodes);
+    QueryEnv env;
+    env.graph = graph_.get();
+    env.index = index_.get();
+    env.pool = GlobalThreadPool();
+    Executor exec(&dag, env, &ctx);
+    s = exec.RunSync();
+    if (s.ok()) {
+      for (const auto& name : req.outputs) {
+        Tensor t;
+        if (!ctx.Get(name, &t)) {
+          s = Status::NotFound("requested output not produced: " + name);
+          break;
+        }
+        rep.outputs.emplace_back(name, std::move(t));
+      }
+    }
+  }
+  rep.status = s;
+  if (!s.ok()) rep.outputs.clear();
+  EncodeExecuteReply(rep, w);
+}
+
+// ---------------------------------------------------------------------------
+// RpcChannel
+// ---------------------------------------------------------------------------
+RpcChannel::RpcChannel(std::string host, int port)
+    : host_(std::move(host)), port_(port) {}
+
+RpcChannel::~RpcChannel() {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (int fd : free_fds_) ::close(fd);
+}
+
+int RpcChannel::Connect() {
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port_);
+  if (::getaddrinfo(host_.c_str(), port_s.c_str(), &hints, &res) != 0)
+    return -1;
+  int fd = -1;
+  for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd >= 0) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+  return fd;
+}
+
+int RpcChannel::Acquire() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!free_fds_.empty()) {
+      int fd = free_fds_.back();
+      free_fds_.pop_back();
+      return fd;
+    }
+  }
+  return Connect();
+}
+
+void RpcChannel::Release(int fd) {
+  std::lock_guard<std::mutex> lk(mu_);
+  free_fds_.push_back(fd);
+}
+
+Status RpcChannel::Call(uint32_t msg_type, const std::vector<char>& body,
+                        std::vector<char>* reply_body) {
+  for (int attempt = 0; attempt < kRetryCount; ++attempt) {
+    int fd = Acquire();
+    if (fd < 0) {
+      ::usleep(1000 * (1 << std::min(attempt, 6)));
+      continue;
+    }
+    uint32_t reply_type;
+    if (WriteFrame(fd, msg_type, body.data(), body.size()) &&
+        ReadFrame(fd, &reply_type, reply_body) && reply_type == msg_type) {
+      Release(fd);
+      return Status::OK();
+    }
+    ::close(fd);  // broken connection — retry on a fresh one
+  }
+  return Status::IOError("rpc to " + host_ + ":" + std::to_string(port_) +
+                         " failed after retries");
+}
+
+// ---------------------------------------------------------------------------
+// Discovery
+// ---------------------------------------------------------------------------
+namespace {
+// One directory scan → (idx, host, port) triples. Duplicate indices (e.g.
+// a stale file left by a crashed server plus its replacement) keep the
+// highest port entry last-wins deterministically by name order.
+Status ScanRegistry(const std::string& registry_dir,
+                    std::map<int, std::pair<std::string, int>>* found) {
+  DIR* d = ::opendir(registry_dir.c_str());
+  if (d == nullptr)
+    return Status::IOError("cannot open registry dir " + registry_dir);
+  dirent* e;
+  while ((e = ::readdir(d)) != nullptr) {
+    std::string name = e->d_name;
+    if (name.rfind("shard_", 0) != 0) continue;
+    // shard_<i>__<host>_<port>
+    auto sep = name.find("__");
+    if (sep == std::string::npos) continue;
+    int idx = std::atoi(name.substr(6, sep - 6).c_str());
+    auto last = name.rfind('_');
+    if (last == std::string::npos || last <= sep + 1) continue;
+    std::string host = name.substr(sep + 2, last - sep - 2);
+    int port = std::atoi(name.substr(last + 1).c_str());
+    if (idx >= 0) (*found)[idx] = {host, port};
+  }
+  ::closedir(d);
+  return Status::OK();
+}
+}  // namespace
+
+Status DiscoverFromRegistry(const std::string& registry_dir, int shard_num,
+                            ShardEndpoints* out) {
+  std::map<int, std::pair<std::string, int>> found;
+  ET_RETURN_IF_ERROR(ScanRegistry(registry_dir, &found));
+  out->endpoints.assign(shard_num, {"", 0});
+  int unique = 0;
+  for (const auto& kv : found) {
+    if (kv.first < shard_num) {
+      out->endpoints[kv.first] = kv.second;
+      ++unique;
+    }
+  }
+  if (unique < shard_num)
+    return Status::NotFound("registry has " + std::to_string(unique) + "/" +
+                            std::to_string(shard_num) + " shards");
+  return Status::OK();
+}
+
+Status DiscoverFromRegistryAuto(const std::string& registry_dir,
+                                ShardEndpoints* out) {
+  std::map<int, std::pair<std::string, int>> found;
+  ET_RETURN_IF_ERROR(ScanRegistry(registry_dir, &found));
+  if (found.empty())
+    return Status::NotFound("no shard files in registry " + registry_dir);
+  int shard_num = found.rbegin()->first + 1;
+  if (static_cast<int>(found.size()) != shard_num)
+    return Status::NotFound("registry " + registry_dir + " has " +
+                            std::to_string(found.size()) + " shards but max "
+                            "index implies " + std::to_string(shard_num));
+  out->endpoints.assign(shard_num, {"", 0});
+  for (const auto& kv : found) out->endpoints[kv.first] = kv.second;
+  return Status::OK();
+}
+
+Status DiscoverFromSpec(const std::string& spec, ShardEndpoints* out) {
+  out->endpoints.clear();
+  std::stringstream ss(spec);
+  std::string item;
+  while (std::getline(ss, item, ',')) {
+    if (item.empty()) continue;
+    auto pos = item.rfind(':');
+    if (pos == std::string::npos)
+      return Status::InvalidArgument("bad host:port: " + item);
+    out->endpoints.emplace_back(item.substr(0, pos),
+                                std::atoi(item.substr(pos + 1).c_str()));
+  }
+  if (out->endpoints.empty())
+    return Status::InvalidArgument("empty endpoint spec");
+  return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// ClientManager
+// ---------------------------------------------------------------------------
+Status ClientManager::Init(const ShardEndpoints& eps) {
+  channels_.clear();
+  metas_.clear();
+  for (const auto& ep : eps.endpoints)
+    channels_.push_back(std::make_unique<RpcChannel>(ep.first, ep.second));
+  metas_.resize(channels_.size());
+  for (size_t s = 0; s < channels_.size(); ++s) {
+    std::vector<char> body, reply;
+    ET_RETURN_IF_ERROR(channels_[s]->Call(kMeta, body, &reply));
+    ByteReader r(reply.data(), reply.size());
+    ET_RETURN_IF_ERROR(DecodeShardMeta(&r, &metas_[s]));
+  }
+  if (!metas_.empty()) {
+    graph_meta_ = metas_[0].graph_meta;
+    partition_num_ = metas_[0].partition_num;
+  }
+  return Status::OK();
+}
+
+float ClientManager::NodeWeight(int shard, int type) const {
+  const auto& w = metas_[shard].node_type_wsum;
+  if (type >= 0)
+    return type < static_cast<int>(w.size()) ? w[type] : 0.f;
+  float s = 0;
+  for (float f : w) s += f;
+  return s;
+}
+
+float ClientManager::EdgeWeight(int shard, int type) const {
+  const auto& w = metas_[shard].edge_type_wsum;
+  if (type >= 0)
+    return type < static_cast<int>(w.size()) ? w[type] : 0.f;
+  float s = 0;
+  for (float f : w) s += f;
+  return s;
+}
+
+Status ClientManager::Execute(int shard, const ExecuteRequest& req,
+                              ExecuteReply* rep) {
+  if (shard < 0 || shard >= shard_num())
+    return Status::InvalidArgument("bad shard index");
+  ByteWriter w;
+  EncodeExecuteRequest(req, &w);
+  std::vector<char> reply;
+  ET_RETURN_IF_ERROR(channels_[shard]->Call(kExecute, w.buffer(), &reply));
+  ByteReader r(reply.data(), reply.size());
+  ET_RETURN_IF_ERROR(DecodeExecuteReply(&r, rep));
+  return rep->status;
+}
+
+void ClientManager::ExecuteAsync(
+    int shard, ExecuteRequest req,
+    std::function<void(Status, ExecuteReply)> done) {
+  GlobalThreadPool()->Schedule(
+      [this, shard, req = std::move(req), done = std::move(done)] {
+        ExecuteReply rep;
+        Status s = Execute(shard, req, &rep);
+        done(s, std::move(rep));
+      });
+}
+
+}  // namespace et

@@ -1,0 +1,171 @@
+// Distributed graph service: framed-TCP RPC server, retrying client,
+// pluggable discovery, per-shard client manager.
+//
+// Capability parity with the reference's euler/service/ (gRPC async server,
+// grpc_worker.cc:40-96 ExecuteAsync) + euler/client/ (RpcClient with retry
+// kRpcRetryCount=10, RpcManager round-robin channel bookkeeping,
+// ClientManager per-shard table — SURVEY.md §2.1) + ZooKeeper discovery
+// (zk_server_monitor/register). Redesigned without external deps: the
+// transport is length-prefixed frames over TCP (the payloads are the serde
+// wire format), the server runs one acceptor + per-connection reader
+// threads that execute requests on the shared executor thread pool, and
+// discovery is a shared-filesystem registry directory (each server writes
+// an ephemeral-ish "shard_<i>__<host>_<port>" file; clients list the
+// directory) with a static "hosts=" fallback — ZooKeeper semantics on
+// plain files, fitting one-host tests and multi-host NFS deployments.
+//
+// Frame: u32 'ETFR' | u32 msg_type | u64 body_len | body
+// msg types: 0 = Execute, 1 = ShardMeta, 2 = Ping.
+#ifndef EULER_TPU_RPC_H_
+#define EULER_TPU_RPC_H_
+
+#include <atomic>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+#include "dag.h"
+#include "graph.h"
+#include "index.h"
+#include "serde.h"
+
+namespace et {
+
+// ---------------------------------------------------------------------------
+// Shard metadata exchanged at client init (reference query_proxy.cc:62-105:
+// graph meta + per-shard weight matrices for proportional sampling).
+// ---------------------------------------------------------------------------
+struct ShardMeta {
+  int shard_idx = 0;
+  int shard_num = 1;
+  int partition_num = 1;
+  std::vector<float> node_type_wsum;  // per node type
+  std::vector<float> edge_type_wsum;  // per edge type
+  GraphMeta graph_meta;
+};
+
+void EncodeShardMeta(const ShardMeta& m, ByteWriter* w);
+Status DecodeShardMeta(ByteReader* r, ShardMeta* m);
+
+// ---------------------------------------------------------------------------
+// Server
+// ---------------------------------------------------------------------------
+class GraphServer {
+ public:
+  // Serves the given graph shard (+ optional index) on port (0 → ephemeral).
+  GraphServer(std::shared_ptr<const Graph> graph,
+              std::shared_ptr<IndexManager> index, int shard_idx,
+              int shard_num, int partition_num);
+  ~GraphServer();
+
+  Status Start(int port);
+  void Stop();
+  int port() const { return port_; }
+
+  // Register under registry_dir as shard_<i>__<host>_<port>; empty → skip.
+  Status Register(const std::string& registry_dir, const std::string& host);
+
+ private:
+  struct Conn {
+    std::thread thread;
+    std::shared_ptr<std::atomic<bool>> finished;
+  };
+
+  void AcceptLoop();
+  void ReapFinishedLocked();  // join + drop exited connection threads
+  void HandleConnection(int fd);
+  void HandleExecute(ByteReader* r, ByteWriter* w);
+
+  std::shared_ptr<const Graph> graph_;
+  std::shared_ptr<IndexManager> index_;
+  int shard_idx_, shard_num_, partition_num_;
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> stopping_{false};
+  std::thread acceptor_;
+  std::mutex conn_mu_;
+  std::vector<Conn> conns_;
+  std::vector<int> conn_fds_;  // open connection sockets (for Stop)
+  std::string registered_path_;
+};
+
+// ---------------------------------------------------------------------------
+// Client
+// ---------------------------------------------------------------------------
+// One logical endpoint ("host:port") with a pool of pooled blocking
+// sockets; Call() is thread-safe, retries up to kRetryCount with
+// reconnects (reference rpc_client.h:46).
+class RpcChannel {
+ public:
+  static constexpr int kRetryCount = 10;
+
+  explicit RpcChannel(std::string host, int port);
+  ~RpcChannel();
+
+  Status Call(uint32_t msg_type, const std::vector<char>& body,
+              std::vector<char>* reply_body);
+
+  const std::string& host() const { return host_; }
+  int port() const { return port_; }
+
+ private:
+  int Acquire();           // pooled or fresh connected socket, -1 on fail
+  void Release(int fd);
+  int Connect();
+
+  std::string host_;
+  int port_;
+  std::mutex mu_;
+  std::vector<int> free_fds_;
+};
+
+// Discovery: resolve shard → endpoints. Two sources, like the reference's
+// ZK monitor + static config:
+//   - registry dir: files "shard_<i>__<host>_<port>"
+//   - static spec: "host:port,host:port,..." (index in list = shard)
+struct ShardEndpoints {
+  std::vector<std::pair<std::string, int>> endpoints;  // per shard
+};
+Status DiscoverFromRegistry(const std::string& registry_dir, int shard_num,
+                            ShardEndpoints* out);
+// Single scan; shard count derived from the max index found (all indices
+// 0..max must be present).
+Status DiscoverFromRegistryAuto(const std::string& registry_dir,
+                                ShardEndpoints* out);
+Status DiscoverFromSpec(const std::string& spec, ShardEndpoints* out);
+
+// Per-shard channel table + aggregated shard weights. Parity: reference
+// ClientManager (client_manager.h:31) + QueryProxy's weight matrices.
+class ClientManager {
+ public:
+  // Connects to every shard, fetches ShardMeta from each, aggregates.
+  Status Init(const ShardEndpoints& eps);
+
+  int shard_num() const { return static_cast<int>(channels_.size()); }
+  int partition_num() const { return partition_num_; }
+  const GraphMeta& graph_meta() const { return graph_meta_; }
+
+  // Per-shard weight sums; type < 0 → total over types.
+  float NodeWeight(int shard, int type) const;
+  float EdgeWeight(int shard, int type) const;
+
+  // Blocking execute on one shard.
+  Status Execute(int shard, const ExecuteRequest& req, ExecuteReply* rep);
+  // Async: schedules on the global pool, invokes done on completion.
+  void ExecuteAsync(int shard, ExecuteRequest req,
+                    std::function<void(Status, ExecuteReply)> done);
+
+ private:
+  std::vector<std::unique_ptr<RpcChannel>> channels_;
+  std::vector<ShardMeta> metas_;
+  GraphMeta graph_meta_;
+  int partition_num_ = 1;
+};
+
+}  // namespace et
+
+#endif  // EULER_TPU_RPC_H_

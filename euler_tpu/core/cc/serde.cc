@@ -1,0 +1,148 @@
+#include "serde.h"
+
+namespace et {
+
+namespace {
+constexpr uint32_t kExecMagic = 0x58455445;  // 'ETEX'
+
+void PutStrList(const std::vector<std::string>& v, ByteWriter* w) {
+  w->Put<uint32_t>(static_cast<uint32_t>(v.size()));
+  for (const auto& s : v) w->PutStr(s);
+}
+
+Status GetStrList(ByteReader* r, std::vector<std::string>* out) {
+  uint32_t n;
+  if (!r->Get(&n)) return Status::IOError("truncated string list");
+  out->resize(n);
+  for (uint32_t i = 0; i < n; ++i)
+    if (!r->GetStr(&(*out)[i])) return Status::IOError("truncated string");
+  return Status::OK();
+}
+}  // namespace
+
+void EncodeTensor(const Tensor& t, ByteWriter* w) {
+  w->Put<int32_t>(static_cast<int32_t>(t.dtype()));
+  w->Put<uint32_t>(static_cast<uint32_t>(t.rank()));
+  for (int64_t d : t.dims()) w->Put<int64_t>(d);
+  w->PutRaw(t.raw(), t.ByteSize());
+}
+
+Status DecodeTensor(ByteReader* r, Tensor* out) {
+  int32_t dt;
+  uint32_t rank;
+  if (!r->Get(&dt) || !r->Get(&rank))
+    return Status::IOError("truncated tensor header");
+  if (dt < 0 || dt > 4) return Status::IOError("bad dtype");
+  std::vector<int64_t> dims(rank);
+  for (uint32_t i = 0; i < rank; ++i)
+    if (!r->Get(&dims[i])) return Status::IOError("truncated dims");
+  Tensor t(static_cast<DType>(dt), dims);
+  if (!r->GetRaw(t.raw(), t.ByteSize()))
+    return Status::IOError("truncated tensor data");
+  *out = std::move(t);
+  return Status::OK();
+}
+
+void EncodeNodeDef(const NodeDef& n, ByteWriter* w) {
+  w->PutStr(n.name);
+  w->PutStr(n.op);
+  PutStrList(n.inputs, w);
+  PutStrList(n.attrs, w);
+  PutStrList(n.post_process, w);
+  w->Put<uint32_t>(static_cast<uint32_t>(n.dnf.size()));
+  for (const auto& conj : n.dnf) PutStrList(conj, w);
+  w->Put<int32_t>(n.shard_idx);
+  w->Put<uint32_t>(static_cast<uint32_t>(n.inner.size()));
+  for (const auto& in : n.inner) EncodeNodeDef(in, w);
+}
+
+Status DecodeNodeDef(ByteReader* r, NodeDef* out) {
+  if (!r->GetStr(&out->name) || !r->GetStr(&out->op))
+    return Status::IOError("truncated node header");
+  ET_RETURN_IF_ERROR(GetStrList(r, &out->inputs));
+  ET_RETURN_IF_ERROR(GetStrList(r, &out->attrs));
+  ET_RETURN_IF_ERROR(GetStrList(r, &out->post_process));
+  uint32_t n_dnf;
+  if (!r->Get(&n_dnf)) return Status::IOError("truncated dnf");
+  out->dnf.resize(n_dnf);
+  for (uint32_t i = 0; i < n_dnf; ++i)
+    ET_RETURN_IF_ERROR(GetStrList(r, &out->dnf[i]));
+  uint32_t n_inner;
+  if (!r->Get(&out->shard_idx) || !r->Get(&n_inner))
+    return Status::IOError("truncated node tail");
+  out->inner.resize(n_inner);
+  for (uint32_t i = 0; i < n_inner; ++i)
+    ET_RETURN_IF_ERROR(DecodeNodeDef(r, &out->inner[i]));
+  return Status::OK();
+}
+
+void EncodeDag(const std::vector<NodeDef>& nodes, ByteWriter* w) {
+  w->Put<uint32_t>(static_cast<uint32_t>(nodes.size()));
+  for (const auto& n : nodes) EncodeNodeDef(n, w);
+}
+
+Status DecodeDag(ByteReader* r, std::vector<NodeDef>* out) {
+  uint32_t n;
+  if (!r->Get(&n)) return Status::IOError("truncated dag");
+  out->resize(n);
+  for (uint32_t i = 0; i < n; ++i)
+    ET_RETURN_IF_ERROR(DecodeNodeDef(r, &(*out)[i]));
+  return Status::OK();
+}
+
+void EncodeExecuteRequest(const ExecuteRequest& req, ByteWriter* w) {
+  w->Put<uint32_t>(kExecMagic);
+  w->Put<uint32_t>(static_cast<uint32_t>(req.inputs.size()));
+  for (const auto& kv : req.inputs) {
+    w->PutStr(kv.first);
+    EncodeTensor(kv.second, w);
+  }
+  EncodeDag(req.nodes, w);
+  PutStrList(req.outputs, w);
+}
+
+Status DecodeExecuteRequest(ByteReader* r, ExecuteRequest* out) {
+  uint32_t magic, n_in;
+  if (!r->Get(&magic) || magic != kExecMagic)
+    return Status::IOError("bad execute request magic");
+  if (!r->Get(&n_in)) return Status::IOError("truncated request");
+  out->inputs.resize(n_in);
+  for (uint32_t i = 0; i < n_in; ++i) {
+    if (!r->GetStr(&out->inputs[i].first))
+      return Status::IOError("truncated input name");
+    ET_RETURN_IF_ERROR(DecodeTensor(r, &out->inputs[i].second));
+  }
+  ET_RETURN_IF_ERROR(DecodeDag(r, &out->nodes));
+  return GetStrList(r, &out->outputs);
+}
+
+void EncodeExecuteReply(const ExecuteReply& rep, ByteWriter* w) {
+  w->Put<uint32_t>(static_cast<uint32_t>(rep.status.code()));
+  w->PutStr(rep.status.message());
+  if (!rep.status.ok()) return;
+  w->Put<uint32_t>(static_cast<uint32_t>(rep.outputs.size()));
+  for (const auto& kv : rep.outputs) {
+    w->PutStr(kv.first);
+    EncodeTensor(kv.second, w);
+  }
+}
+
+Status DecodeExecuteReply(ByteReader* r, ExecuteReply* out) {
+  uint32_t code;
+  std::string msg;
+  if (!r->Get(&code) || !r->GetStr(&msg))
+    return Status::IOError("truncated reply header");
+  out->status = Status(static_cast<Code>(code), msg);
+  if (!out->status.ok()) return Status::OK();
+  uint32_t n;
+  if (!r->Get(&n)) return Status::IOError("truncated reply");
+  out->outputs.resize(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    if (!r->GetStr(&out->outputs[i].first))
+      return Status::IOError("truncated output name");
+    ET_RETURN_IF_ERROR(DecodeTensor(r, &out->outputs[i].second));
+  }
+  return Status::OK();
+}
+
+}  // namespace et

@@ -1,0 +1,59 @@
+// Binary wire format for tensors and query DAGs.
+//
+// Capability parity with the reference's protobuf schemas
+// (euler/proto/{service,worker}.proto, framework/{tensor,dag,dag_node}
+// .proto — SURVEY.md §2.1 "Protos") — replaced by a hand-rolled
+// little-endian format over the same ByteWriter/ByteReader the graph
+// store uses (io.h), removing the protobuf dependency and the
+// encode/decode copies of TensorProto repeated fields.
+//
+// ExecuteRequest  : u32 'ETEX' | u32 n_inputs | n×(str name, tensor)
+//                 | dag | u32 n_outputs | n×str
+// ExecuteReply    : u32 code | str error  (code!=0 → no payload)
+//                 | u32 n_outputs | n×(str name, tensor)
+// tensor          : i32 dtype | u32 rank | rank×i64 dims | bytes
+// dag             : u32 n_nodes | n×node
+// node            : str name | str op | u32×(inputs, attrs, pp) lists
+//                 | u32 n_dnf | per conj: u32 n_terms | terms
+//                 | i32 shard_idx | u32 n_inner | inner nodes
+#ifndef EULER_TPU_SERDE_H_
+#define EULER_TPU_SERDE_H_
+
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "dag.h"
+#include "io.h"
+#include "tensor.h"
+
+namespace et {
+
+void EncodeTensor(const Tensor& t, ByteWriter* w);
+Status DecodeTensor(ByteReader* r, Tensor* out);
+
+void EncodeNodeDef(const NodeDef& n, ByteWriter* w);
+Status DecodeNodeDef(ByteReader* r, NodeDef* out);
+
+void EncodeDag(const std::vector<NodeDef>& nodes, ByteWriter* w);
+Status DecodeDag(ByteReader* r, std::vector<NodeDef>* out);
+
+struct ExecuteRequest {
+  std::vector<std::pair<std::string, Tensor>> inputs;
+  std::vector<NodeDef> nodes;
+  std::vector<std::string> outputs;  // tensor names to return
+};
+
+struct ExecuteReply {
+  Status status;
+  std::vector<std::pair<std::string, Tensor>> outputs;
+};
+
+void EncodeExecuteRequest(const ExecuteRequest& req, ByteWriter* w);
+Status DecodeExecuteRequest(ByteReader* r, ExecuteRequest* out);
+void EncodeExecuteReply(const ExecuteReply& rep, ByteWriter* w);
+Status DecodeExecuteReply(ByteReader* r, ExecuteReply* out);
+
+}  // namespace et
+
+#endif  // EULER_TPU_SERDE_H_

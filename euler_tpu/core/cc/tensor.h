@@ -1,0 +1,174 @@
+// Tensor + OpKernelContext for the query execution framework.
+//
+// Capability parity with the reference's euler/core/framework/{tensor.h,
+// tensor_shape.h,allocator.h,op_kernel.h OpKernelContext} (SURVEY.md §2.1).
+// Redesigned: a Tensor is a shared flat byte buffer + dtype + dims (no
+// ref-counted Buffer class hierarchy — shared_ptr does that job), and the
+// context is a name→Tensor map guarded by one mutex. Kernels are coarse
+// batch ops, so per-access locking is off the hot path.
+#ifndef EULER_TPU_TENSOR_H_
+#define EULER_TPU_TENSOR_H_
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace et {
+
+enum class DType : int32_t {
+  kU64 = 0,  // node ids
+  kI64 = 1,
+  kI32 = 2,
+  kF32 = 3,
+  kU8 = 4,  // raw bytes / strings
+};
+
+inline size_t DTypeSize(DType t) {
+  switch (t) {
+    case DType::kU64:
+    case DType::kI64:
+      return 8;
+    case DType::kI32:
+    case DType::kF32:
+      return 4;
+    case DType::kU8:
+      return 1;
+  }
+  return 1;
+}
+
+template <typename T>
+struct DTypeOf;
+template <> struct DTypeOf<uint64_t> { static constexpr DType v = DType::kU64; };
+template <> struct DTypeOf<int64_t> { static constexpr DType v = DType::kI64; };
+template <> struct DTypeOf<int32_t> { static constexpr DType v = DType::kI32; };
+template <> struct DTypeOf<float> { static constexpr DType v = DType::kF32; };
+template <> struct DTypeOf<uint8_t> { static constexpr DType v = DType::kU8; };
+template <> struct DTypeOf<char> { static constexpr DType v = DType::kU8; };
+
+class Tensor {
+ public:
+  Tensor() : dtype_(DType::kU8) {}
+  Tensor(DType dtype, std::vector<int64_t> dims)
+      : dtype_(dtype), dims_(std::move(dims)) {
+    data_ = std::make_shared<std::vector<uint8_t>>(ByteSize());
+  }
+
+  template <typename T>
+  static Tensor FromVector(const std::vector<T>& v,
+                           std::vector<int64_t> dims = {}) {
+    if (dims.empty()) dims = {static_cast<int64_t>(v.size())};
+    Tensor t(DTypeOf<T>::v, std::move(dims));
+    std::memcpy(t.raw(), v.data(), v.size() * sizeof(T));
+    return t;
+  }
+
+  template <typename T>
+  static Tensor Scalar(T v) {
+    Tensor t(DTypeOf<T>::v, {1});
+    t.Flat<T>()[0] = v;
+    return t;
+  }
+
+  DType dtype() const { return dtype_; }
+  const std::vector<int64_t>& dims() const { return dims_; }
+  int64_t dim(size_t i) const { return dims_[i]; }
+  size_t rank() const { return dims_.size(); }
+
+  int64_t NumElements() const {
+    int64_t n = 1;
+    for (int64_t d : dims_) n *= d;
+    return n;
+  }
+  size_t ByteSize() const { return NumElements() * DTypeSize(dtype_); }
+
+  template <typename T>
+  T* Flat() {
+    ET_CHECK(DTypeOf<T>::v == dtype_) << "dtype mismatch";
+    return reinterpret_cast<T*>(data_->data());
+  }
+  template <typename T>
+  const T* Flat() const {
+    ET_CHECK(DTypeOf<T>::v == dtype_) << "dtype mismatch";
+    return reinterpret_cast<const T*>(data_->data());
+  }
+  uint8_t* raw() { return data_->data(); }
+  const uint8_t* raw() const { return data_ ? data_->data() : nullptr; }
+
+  bool valid() const { return data_ != nullptr; }
+
+  // Values as int64 regardless of integral dtype (query args convenience).
+  int64_t AsI64(int64_t i) const {
+    switch (dtype_) {
+      case DType::kU64: return static_cast<int64_t>(Flat<uint64_t>()[i]);
+      case DType::kI64: return Flat<int64_t>()[i];
+      case DType::kI32: return Flat<int32_t>()[i];
+      default: ET_LOG(FATAL) << "AsI64 on non-integral tensor"; return 0;
+    }
+  }
+
+ private:
+  DType dtype_;
+  std::vector<int64_t> dims_;
+  std::shared_ptr<std::vector<uint8_t>> data_;
+};
+
+// Carries all named intermediate results across one query execution.
+// Parity: reference OpKernelContext (framework/op_kernel.h:73) — a
+// name→Tensor map with Allocate/AddAlias, here thread-safe for the
+// parallel executor.
+class OpKernelContext {
+ public:
+  void Put(const std::string& name, Tensor t) {
+    std::lock_guard<std::mutex> lk(mu_);
+    tensors_[name] = std::move(t);
+  }
+
+  bool Get(const std::string& name, Tensor* out) const {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = tensors_.find(name);
+    if (it == tensors_.end()) return false;
+    *out = it->second;
+    return true;
+  }
+
+  Tensor GetOrDie(const std::string& name) const {
+    Tensor t;
+    ET_CHECK(Get(name, &t)) << "missing tensor: " << name;
+    return t;
+  }
+
+  bool Has(const std::string& name) const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return tensors_.count(name) > 0;
+  }
+
+  // Alias: `alias` resolves to the tensor currently stored under `name`.
+  void AddAlias(const std::string& alias, const std::string& name) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = tensors_.find(name);
+    if (it != tensors_.end()) tensors_[alias] = it->second;
+  }
+
+  std::vector<std::string> Names() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<std::string> out;
+    out.reserve(tensors_.size());
+    for (auto& kv : tensors_) out.push_back(kv.first);
+    return out;
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::unordered_map<std::string, Tensor> tensors_;
+};
+
+}  // namespace et
+
+#endif  // EULER_TPU_TENSOR_H_

@@ -1,0 +1,50 @@
+// Fixed-size work-stealing-free thread pool.
+//
+// Capability parity with the reference's euler/common/env.h:39 ThreadPool
+// (Schedule(fn) onto N posix threads). Redesigned as a single
+// mutex+condvar task queue — the executor schedules coarse batch kernels
+// (thousands of rows each), so queue contention is negligible and
+// simplicity wins.
+#ifndef EULER_TPU_THREADPOOL_H_
+#define EULER_TPU_THREADPOOL_H_
+
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace et {
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(size_t num_threads);
+  ~ThreadPool();
+
+  ThreadPool(const ThreadPool&) = delete;
+  ThreadPool& operator=(const ThreadPool&) = delete;
+
+  // Enqueue fn for execution on some pool thread. Never blocks.
+  void Schedule(std::function<void()> fn);
+
+  size_t num_threads() const { return threads_.size(); }
+
+ private:
+  void WorkerLoop();
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::function<void()>> queue_;
+  bool shutdown_ = false;
+  std::vector<std::thread> threads_;
+};
+
+// Process-wide shared pool for query execution (lazily constructed,
+// hardware_concurrency threads). Parity: reference QueryProxy's 8-thread
+// client pool (query_proxy.cc:209) — sized to the host instead.
+ThreadPool* GlobalThreadPool();
+
+}  // namespace et
+
+#endif  // EULER_TPU_THREADPOOL_H_

@@ -75,7 +75,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etg_builder_set_edge_sparse": (i32, [i64, u64, u64, i32, i32, c_u64p, i64]),
         "etg_builder_finalize": (i64, [i64, i32]),
         "etg_load": (i64, [ctypes.c_char_p, i32, i32, i32, i32]),
-        "etg_dump": (i32, [i64, ctypes.c_char_p]),
+        "etg_dump": (i32, [i64, ctypes.c_char_p, i32]),
         "etg_free": (i32, [i64]),
         "etg_node_count": (i64, [i64]),
         "etg_edge_count": (i64, [i64]),
@@ -116,6 +116,23 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etg_get_binary_feature": (i32, [i64, c_u64p, i64, i32, c_voidp]),
         "etg_get_edge_sparse_feature": (i32, [i64, c_u64p, c_u64p, c_i32p, i64, i32, c_voidp]),
         "etg_get_edge_binary_feature": (i32, [i64, c_u64p, c_u64p, c_i32p, i64, i32, c_voidp]),
+        # query layer (gremlin → DAG → executor; local or distributed)
+        "etq_new_local": (i64, [i64, ctypes.c_char_p, u64]),
+        "etq_new_remote": (i64, [ctypes.c_char_p, u64]),
+        "etq_free": (i32, [i64]),
+        "etq_exec_new": (i64, [i64]),
+        "etq_exec_add_input": (i32, [i64, ctypes.c_char_p, i32, i32, c_i64p, c_voidp]),
+        "etq_exec_run": (i32, [i64, ctypes.c_char_p]),
+        "etq_exec_output_count": (i64, [i64]),
+        "etq_exec_output_name": (ctypes.c_char_p, [i64, i64]),
+        "etq_exec_output_info": (i32, [i64, i64, c_i32p, c_i32p, c_i64p]),
+        "etq_exec_output_dims": (i32, [i64, i64, c_i64p]),
+        "etq_exec_output_data": (c_voidp, [i64, i64]),
+        "etq_exec_free": (i32, [i64]),
+        "ets_start": (i64, [ctypes.c_char_p, i32, i32, i32, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]),
+        "ets_port": (i32, [i64]),
+        "ets_stop": (i32, [i64]),
+        "etq_compile_debug": (i64, [ctypes.c_char_p, i32, i32, ctypes.c_char_p, ctypes.c_char_p, i64]),
     }
     for name, (restype, argtypes) in sigs.items():
         fn = getattr(lib, name)

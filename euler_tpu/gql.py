@@ -1,0 +1,185 @@
+"""GQL query interface: gremlin-style strings against the graph engine.
+
+Capability parity with the reference's euler.Query/QueryProxy surface
+(euler/client/query.h:33, query_proxy.h:39 — SURVEY.md §2.1) and the
+`initialize_graph` remote/local mode switch (tf_euler/python/euler_ops/
+base.py:37). A `Query` object targets either an embedded in-process graph
+(local mode: compile → fuse → execute on the host thread pool) or a set of
+remote graph shards (distribute mode: compile → split/REMOTE/merge over
+framed-TCP RPC), transparently to the caller::
+
+    q = Query.local(engine, index_spec="price:range_index")
+    out = q.run("sampleN(0, 64).has(price gt 3).values(f).as(feat)",
+                )
+    ids = out["feat:1"]
+
+    server = start_service(data_dir, shard_idx=0, shard_num=2, port=9190)
+    q = Query.remote("hosts:127.0.0.1:9190,127.0.0.1:9191")
+
+Supported chain calls (see euler_tpu/core/cc/gql.h for the grammar):
+v, e, sampleN, sampleE, sampleNWithTypes, sampleNB, sampleLNB, getNB/outV,
+getRNB/inV, getSortedNB, getTopKNB, values, udf, label, has, hasLabel,
+hasKey, hasId, orderBy, limit, as.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Optional
+
+import numpy as np
+
+from euler_tpu.core import lib as _libmod
+from euler_tpu.core.lib import EngineError, check
+
+__all__ = ["Query", "GraphService", "start_service", "compile_debug"]
+
+_DTYPES = {
+    0: np.uint64,
+    1: np.int64,
+    2: np.int32,
+    3: np.float32,
+    4: np.uint8,
+}
+_DTYPE_CODES = {
+    np.dtype(np.uint64): 0,
+    np.dtype(np.int64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.float32): 3,
+    np.dtype(np.uint8): 4,
+}
+
+
+class Query:
+    """A query proxy bound to a local engine or a remote shard set."""
+
+    def __init__(self, lib, handle: int):
+        self._lib = lib
+        self._h = handle
+
+    @classmethod
+    def local(cls, engine, index_spec: str = "", seed: int = 0) -> "Query":
+        """Embedded mode over a GraphEngine (euler_tpu.graph.GraphEngine)."""
+        lib = _libmod.load()
+        h = lib.etq_new_local(engine.h, index_spec.encode(), seed)
+        if h == 0:
+            raise EngineError(lib.etg_last_error().decode())
+        return cls(lib, h)
+
+    @classmethod
+    def remote(cls, endpoints: str, seed: int = 0) -> "Query":
+        """Distribute mode. endpoints: "hosts:h:p,h:p" or "dir:/registry"."""
+        lib = _libmod.load()
+        h = lib.etq_new_remote(endpoints.encode(), seed)
+        if h == 0:
+            raise EngineError(lib.etg_last_error().decode())
+        return cls(lib, h)
+
+    def run(self, gremlin: str,
+            inputs: Optional[Dict[str, np.ndarray]] = None
+            ) -> Dict[str, np.ndarray]:
+        """Execute a chain; returns alias outputs ("name:i") + terminals."""
+        lib = self._lib
+        eh = lib.etq_exec_new(self._h)
+        if eh == 0:
+            raise EngineError(lib.etg_last_error().decode())
+        try:
+            for name, arr in (inputs or {}).items():
+                a = np.ascontiguousarray(arr)
+                if a.dtype not in _DTYPE_CODES:
+                    if np.issubdtype(a.dtype, np.integer):
+                        a = a.astype(np.int64)
+                    else:
+                        a = a.astype(np.float32)
+                dims = np.array(a.shape or (1,), dtype=np.int64)
+                check(lib, lib.etq_exec_add_input(
+                    eh, name.encode(), _DTYPE_CODES[a.dtype], dims.size,
+                    dims.ctypes.data_as(_libmod.c_i64p),
+                    a.ctypes.data_as(ctypes.c_void_p)))
+            check(lib, lib.etq_exec_run(eh, gremlin.encode()))
+            out: Dict[str, np.ndarray] = {}
+            n = lib.etq_exec_output_count(eh)
+            for i in range(n):
+                name = lib.etq_exec_output_name(eh, i).decode()
+                dt = ctypes.c_int32()
+                rank = ctypes.c_int32()
+                numel = ctypes.c_int64()
+                check(lib, lib.etq_exec_output_info(
+                    eh, i, ctypes.byref(dt), ctypes.byref(rank),
+                    ctypes.byref(numel)))
+                dims = np.zeros(max(rank.value, 1), dtype=np.int64)
+                check(lib, lib.etq_exec_output_dims(
+                    eh, i, dims.ctypes.data_as(_libmod.c_i64p)))
+                dtype = _DTYPES[dt.value]
+                arr = np.empty(int(numel.value), dtype=dtype)
+                ptr = lib.etq_exec_output_data(eh, i)
+                if arr.size and ptr:
+                    ctypes.memmove(arr.ctypes.data, ptr,
+                                   arr.size * arr.itemsize)
+                out[name] = arr.reshape(dims[:rank.value]
+                                        if rank.value else ())
+            return out
+        finally:
+            lib.etq_exec_free(eh)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.etq_free(self._h)
+            self._h = 0
+
+    def __del__(self):  # best-effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class GraphService:
+    """A serving graph shard (reference euler.start(), python_api.cc:29)."""
+
+    def __init__(self, lib, handle: int):
+        self._lib = lib
+        self._h = handle
+
+    @property
+    def port(self) -> int:
+        return self._lib.ets_port(self._h)
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.ets_stop(self._h)
+            self._h = 0
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+def start_service(data_dir: str, shard_idx: int = 0, shard_num: int = 1,
+                  port: int = 0, registry_dir: str = "",
+                  host: str = "127.0.0.1",
+                  index_spec: str = "") -> GraphService:
+    """Load shard `shard_idx`/`shard_num` from data_dir and serve it."""
+    lib = _libmod.load()
+    h = lib.ets_start(data_dir.encode(), shard_idx, shard_num, port,
+                      registry_dir.encode(), host.encode(),
+                      index_spec.encode())
+    if h == 0:
+        raise EngineError(lib.etg_last_error().decode())
+    return GraphService(lib, h)
+
+
+def compile_debug(gremlin: str, shard_num: int = 1, partition_num: int = 1,
+                  mode: str = "local") -> str:
+    """Compile and render the optimized DAG (golden structure tests)."""
+    lib = _libmod.load()
+    n = lib.etq_compile_debug(gremlin.encode(), shard_num, partition_num,
+                              mode.encode(), None, 0)
+    if n < 0:
+        raise EngineError(lib.etg_last_error().decode())
+    buf = ctypes.create_string_buffer(int(n) + 1)
+    lib.etq_compile_debug(gremlin.encode(), shard_num, partition_num,
+                          mode.encode(), buf, n + 1)
+    return buf.value.decode()

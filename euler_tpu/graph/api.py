@@ -228,11 +228,12 @@ class GraphEngine:
             raise EngineError(lib.etg_last_error().decode())
         return cls(h)
 
-    def dump(self, directory: str) -> None:
+    def dump(self, directory: str, num_partitions: int = 1) -> None:
         import os
 
         os.makedirs(directory, exist_ok=True)
-        _libmod.check(self._lib, self._lib.etg_dump(self.h, directory.encode()))
+        _libmod.check(self._lib, self._lib.etg_dump(self.h, directory.encode(),
+                                                    num_partitions))
 
     def close(self) -> None:
         if self.h is not None:
