@@ -100,14 +100,16 @@ Executor::Executor(const DAGDef* dag, const QueryEnv& env,
 void Executor::Run(std::function<void(Status)> done) {
   done_ = std::move(done);
   if (nodes_.empty()) {
-    done_(Status::OK());
+    auto d = std::move(done_);
+    d(Status::OK());
     return;
   }
   std::vector<int> ready;
   for (size_t i = 0; i < nodes_.size(); ++i)
     if (nodes_[i].remaining.load() == 0) ready.push_back(static_cast<int>(i));
   if (ready.empty()) {
-    done_(Status::Internal("query DAG has a cycle"));
+    auto d = std::move(done_);
+    d(Status::Internal("query DAG has a cycle"));
     return;
   }
   for (int idx : ready) {
@@ -145,7 +147,11 @@ void Executor::OnNodeDone(int idx, const Status& s) {
       std::lock_guard<std::mutex> lk(err_mu_);
       if (failed_.load()) final = first_error_;
     }
-    done_(final);
+    // release the stored callback before invoking: callers capture the
+    // Executor's own shared_ptr in `done` (loopback REMOTE), and a held
+    // copy would cycle exec -> done_ -> exec and leak every inner tensor
+    auto d = std::move(done_);
+    d(final);
   }
 }
 

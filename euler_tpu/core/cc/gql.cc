@@ -250,6 +250,8 @@ Status TranslateGql(const std::vector<GqlCall>& calls, TranslateResult* out) {
       st.last_quad = st.last_outputs;
       st.cur_ids = st.last_outputs[1];
     } else if (c.name == "getSortedNB") {
+      if (st.cur_ids.empty())
+        return Status::InvalidArgument("getSortedNB without a node set");
       st.Emit("API_GET_SORTED_NB_NODE", {st.cur_ids}, {argw(0, "*")}, 4);
       st.last_quad = st.last_outputs;
       st.cur_ids = st.last_outputs[1];
@@ -260,6 +262,8 @@ Status TranslateGql(const std::vector<GqlCall>& calls, TranslateResult* out) {
       st.last_quad = st.last_outputs;
       st.cur_ids = st.last_outputs[1];
     } else if (c.name == "getTopKNB") {
+      if (st.cur_ids.empty())
+        return Status::InvalidArgument("getTopKNB without a node set");
       st.Emit("API_GET_TOPK_NB", {st.cur_ids},
               {argw(0, "*"), argw(1, "1")}, 4);
       st.last_quad = st.last_outputs;
@@ -494,7 +498,10 @@ struct Rewriter {
 
 Status OptimizeDag(const CompileOptions& opts, DAGDef* dag) {
   CsePass(dag);
-  if (opts.mode != "distribute" || opts.shard_num <= 1) return Status::OK();
+  // shard_num == 1 still needs the rewrite in distribute mode: the client
+  // has no local graph, so graph ops must ship to the (single) remote
+  // shard — the generic split/REMOTE/merge path degenerates correctly
+  if (opts.mode != "distribute") return Status::OK();
 
   const int S = opts.shard_num;
   std::string pn = std::to_string(opts.partition_num);
@@ -655,7 +662,13 @@ Status OptimizeDag(const CompileOptions& opts, DAGDef* dag) {
     }
 
     // --- id-keyed node ops ---
-    bool dedup = n.op != "API_SAMPLE_NB";  // unique+gather for GET ops
+    // unique+gather for GET ops. Exceptions: API_SAMPLE_NB draws per
+    // input row (dedup would change the sample), and API_GET_NODE's
+    // outputs are input-position-keyed with duplicates preserved —
+    // deduping would emit unique-space positions, diverging from local
+    // mode (FILTER_MERGE composes split positions, which must be
+    // input-space).
+    bool dedup = n.op != "API_SAMPLE_NB" && n.op != "API_GET_NODE";
     std::string ids_in = n.inputs[0];
     std::string uniq;
     if (dedup) {
@@ -696,8 +709,8 @@ Status OptimizeDag(const CompileOptions& opts, DAGDef* dag) {
         ins.push_back(remotes[s] + ":0");  // surviving ids
         ins.push_back(remotes[s] + ":1");  // local positions
       }
-      // FILTER_MERGE emits (ids, unique-space positions). The surviving
-      // ids are a set, so no gather-through-inv is needed downstream.
+      // FILTER_MERGE emits (ids, input-space positions) ordered by
+      // position — same contract as the local GetNodeOp.
       std::string m =
           rw.Add(rw.Fresh("FILTER_MERGE"), "FILTER_MERGE", ins, {});
       rw.Add(orig, "COLLECT", {m + ":0", m + ":1"}, {});

@@ -411,15 +411,20 @@ Status IndexManager::EvalDnf(
           return Status::InvalidArgument("id condition needs a graph");
         std::stringstream vs(value);
         std::string one;
+        std::vector<std::pair<uint32_t, float>> pairs;
         while (std::getline(vs, one, ':')) {
           uint64_t id = std::strtoull(one.c_str(), nullptr, 10);
           uint32_t row = g->NodeIndex(id);
-          if (row != kInvalidIndex) {
-            r.rows.push_back(row);
-            r.weights.push_back(g->node_weight(row));
-          }
+          if (row != kInvalidIndex)
+            pairs.emplace_back(row, g->node_weight(row));
         }
-        std::sort(r.rows.begin(), r.rows.end());
+        // Intersect/Union assume row-sorted postings; sort keeps each
+        // weight paired with its row
+        std::sort(pairs.begin(), pairs.end());
+        for (const auto& p : pairs) {
+          r.rows.push_back(p.first);
+          r.weights.push_back(p.second);
+        }
       } else {
         const SampleIndex* idx = Find(attr);
         if (idx == nullptr)

@@ -23,6 +23,7 @@
 #include "dag.h"
 #include "graph.h"
 #include "index.h"
+#include "kernels_common.h"
 #include "ops.h"
 #include "tensor.h"
 
@@ -46,26 +47,6 @@ std::vector<int32_t> ParseEdgeTypes(const std::string& s) {
   if (s.empty() || s == "*") return out;
   for (auto& t : SplitStr(s, ':')) out.push_back(std::atoi(t.c_str()));
   return out;
-}
-
-Status GetInput(OpKernelContext* ctx, const NodeDef& node, size_t i,
-                Tensor* out) {
-  if (i >= node.inputs.size())
-    return Status::InvalidArgument(node.name + ": missing input " +
-                                   std::to_string(i));
-  if (!ctx->Get(node.inputs[i], out))
-    return Status::NotFound(node.name + ": input tensor '" + node.inputs[i] +
-                            "' not produced");
-  return Status::OK();
-}
-
-Pcg32 NodeRng(const NodeDef& node, const QueryEnv& env) {
-  if (env.seed == 0) return Pcg32(ThreadLocalRng().NextU32());
-  uint64_t h = 1469598103934665603ULL;
-  for (char c : node.name) h = (h ^ static_cast<uint64_t>(c)) * 1099511628211ULL;
-  // seq = per-execution nonce: repeated run()s draw fresh (but replayable)
-  // samples instead of the same batch every time.
-  return Pcg32(env.seed ^ h, env.nonce * 2 + 1);
 }
 
 // Resolve a feature name (or "f<id>") to (kind, fid, dim) from graph meta.
@@ -119,15 +100,6 @@ Tensor MakeIdx(const std::vector<uint64_t>& offsets) {
   return idx;
 }
 
-#define ET_K_RETURN_IF_ERROR(expr)   \
-  do {                               \
-    ::et::Status _s = (expr);        \
-    if (!_s.ok()) {                  \
-      done(_s);                      \
-      return;                       \
-    }                                \
-  } while (0)
-
 // ---------------------------------------------------------------------------
 // API_SAMPLE_NODE — attrs: [count, node_type]; optional input 0 overrides
 // count. dnf present → index-conditioned sampling (reference
@@ -144,6 +116,10 @@ class SampleNodeOp : public OpKernel {
       Tensor t;
       if (ctx->Get(node.inputs[0], &t) && t.NumElements() > 0)
         count = t.AsI64(0);
+    }
+    if (count < 0) {
+      done(Status::InvalidArgument("sampleN count must be >= 0"));
+      return;
     }
     Pcg32 rng = NodeRng(node, env);
     Tensor out(DType::kU64, {count});
@@ -211,6 +187,10 @@ class SampleEdgeOp : public OpKernel {
       if (ctx->Get(node.inputs[0], &t) && t.NumElements() > 0)
         count = t.AsI64(0);
     }
+    if (count < 0) {
+      done(Status::InvalidArgument("sampleE count must be >= 0"));
+      return;
+    }
     Pcg32 rng = NodeRng(node, env);
     Tensor src(DType::kU64, {count}), dst(DType::kU64, {count}),
         et_(DType::kI32, {count});
@@ -270,6 +250,10 @@ class SampleNeighborOp : public OpKernel {
     ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &ids_t));
     auto ets = ParseEdgeTypes(node.attrs.size() > 0 ? node.attrs[0] : "");
     int64_t count = node.attrs.size() > 1 ? std::atoll(node.attrs[1].c_str()) : 1;
+    if (count < 0) {
+      done(Status::InvalidArgument("sampleNB count must be >= 0"));
+      return;
+    }
     uint64_t def = node.attrs.size() > 2 ? std::strtoull(node.attrs[2].c_str(), nullptr, 10) : 0;
     const uint64_t* ids = ids_t.Flat<uint64_t>();
     int64_t n = ids_t.NumElements();
@@ -371,6 +355,10 @@ class GetTopKNbOp : public OpKernel {
   void Compute(const NodeDef& n, const QueryEnv& e, OpKernelContext* c,
                std::function<void(Status)> d) override {
     int64_t k = n.attrs.size() > 1 ? std::atoll(n.attrs[1].c_str()) : 1;
+    if (k < 0) {
+      d(Status::InvalidArgument("getTopKNB k must be >= 0"));
+      return;
+    }
     FullNeighborImpl(n, e, c, false, false, k, std::move(d));
   }
 };
@@ -602,8 +590,14 @@ class SampleLayerOp : public OpKernel {
     ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &ids_t));
     auto ets = ParseEdgeTypes(node.attrs.size() > 0 ? node.attrs[0] : "");
     std::vector<int32_t> sizes;
-    for (auto& s : SplitStr(node.attrs.size() > 1 ? node.attrs[1] : "1", ':'))
-      sizes.push_back(std::atoi(s.c_str()));
+    for (auto& s : SplitStr(node.attrs.size() > 1 ? node.attrs[1] : "1", ':')) {
+      int32_t m = std::atoi(s.c_str());
+      if (m < 0) {
+        done(Status::InvalidArgument("sampleLNB layer size must be >= 0"));
+        return;
+      }
+      sizes.push_back(m);
+    }
     uint64_t def = node.attrs.size() > 2 ? std::strtoull(node.attrs[2].c_str(), nullptr, 10) : 0;
     Pcg32 rng = NodeRng(node, env);
     std::vector<Tensor> layers;

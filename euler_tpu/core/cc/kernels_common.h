@@ -1,0 +1,48 @@
+// Helpers shared by the kernel translation units (kernels.cc,
+// kernels_dist.cc): input fetch with uniform error text, the per-node
+// deterministic RNG, and the early-return macro for Status-returning
+// expressions inside async Compute bodies.
+#ifndef EULER_TPU_KERNELS_COMMON_H_
+#define EULER_TPU_KERNELS_COMMON_H_
+
+#include <string>
+
+#include "common.h"
+#include "dag.h"
+#include "tensor.h"
+
+namespace et {
+
+inline Status GetInput(OpKernelContext* ctx, const NodeDef& node, size_t i,
+                       Tensor* out) {
+  if (i >= node.inputs.size())
+    return Status::InvalidArgument(node.name + ": missing input " +
+                                   std::to_string(i));
+  if (!ctx->Get(node.inputs[i], out))
+    return Status::NotFound(node.name + ": input tensor '" + node.inputs[i] +
+                            "' not produced");
+  return Status::OK();
+}
+
+inline Pcg32 NodeRng(const NodeDef& node, const QueryEnv& env) {
+  if (env.seed == 0) return Pcg32(ThreadLocalRng().NextU32());
+  uint64_t h = 1469598103934665603ULL;
+  for (char c : node.name)
+    h = (h ^ static_cast<uint64_t>(c)) * 1099511628211ULL;
+  // seq = per-execution nonce: repeated run()s draw fresh (but replayable)
+  // samples instead of the same batch every time.
+  return Pcg32(env.seed ^ h, env.nonce * 2 + 1);
+}
+
+#define ET_K_RETURN_IF_ERROR(expr)   \
+  do {                               \
+    ::et::Status _s = (expr);        \
+    if (!_s.ok()) {                  \
+      done(_s);                      \
+      return;                        \
+    }                                \
+  } while (0)
+
+}  // namespace et
+
+#endif  // EULER_TPU_KERNELS_COMMON_H_

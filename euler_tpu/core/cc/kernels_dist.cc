@@ -18,40 +18,13 @@
 #include "gql.h"
 #include "graph.h"
 #include "index.h"
+#include "kernels_common.h"
 #include "rpc.h"
 #include "tensor.h"
 
 namespace et {
 namespace {
 
-Status GetIn(OpKernelContext* ctx, const NodeDef& node, size_t i,
-             Tensor* out) {
-  if (i >= node.inputs.size())
-    return Status::InvalidArgument(node.name + ": missing input " +
-                                   std::to_string(i));
-  if (!ctx->Get(node.inputs[i], out))
-    return Status::NotFound(node.name + ": input '" + node.inputs[i] +
-                            "' not produced");
-  return Status::OK();
-}
-
-#define ET_K_RETURN_IF_ERROR(expr)   \
-  do {                               \
-    ::et::Status _s = (expr);        \
-    if (!_s.ok()) {                  \
-      done(_s);                      \
-      return;                       \
-    }                                \
-  } while (0)
-
-Pcg32 DistRng(const NodeDef& node, const QueryEnv& env) {
-  if (env.seed == 0) return Pcg32(ThreadLocalRng().NextU32());
-  uint64_t h = 1469598103934665603ULL;
-  for (char c : node.name) h = (h ^ static_cast<uint64_t>(c)) * 1099511628211ULL;
-  // seq = per-execution nonce: repeated run()s draw fresh (but replayable)
-  // samples instead of the same batch every time.
-  return Pcg32(env.seed ^ h, env.nonce * 2 + 1);
-}
 
 // ---------------------------------------------------------------------------
 // COLLECT — rebind inputs as this node's outputs (the rewrite's seam: the
@@ -78,7 +51,7 @@ class IdSplitOp : public OpKernel {
   void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
                std::function<void(Status)> done) override {
     Tensor ids_t;
-    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 0, &ids_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &ids_t));
     int pn = std::atoi(node.attrs[0].c_str());
     int sn = std::atoi(node.attrs[1].c_str());
     const uint64_t* ids = ids_t.Flat<uint64_t>();
@@ -106,9 +79,9 @@ class TripleSplitOp : public OpKernel {
   void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
                std::function<void(Status)> done) override {
     Tensor src_t, dst_t, tt;
-    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 0, &src_t));
-    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 1, &dst_t));
-    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 2, &tt));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &src_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 1, &dst_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 2, &tt));
     int pn = std::atoi(node.attrs[0].c_str());
     int sn = std::atoi(node.attrs[1].c_str());
     const uint64_t* src = src_t.Flat<uint64_t>();
@@ -143,11 +116,11 @@ class TypesSplitOp : public OpKernel {
   void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
                std::function<void(Status)> done) override {
     Tensor types_t;
-    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 0, &types_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &types_t));
     int sn = std::atoi(node.attrs[0].c_str());
     const int32_t* types = types_t.Flat<int32_t>();
     int64_t n = types_t.NumElements();
-    Pcg32 rng = DistRng(node, env);
+    Pcg32 rng = NodeRng(node, env);
     std::vector<std::vector<int32_t>> st(sn);
     std::vector<std::vector<int32_t>> sp(sn);
     std::vector<float> cum(sn);
@@ -205,7 +178,7 @@ class SampleSplitOp : public OpKernel {
       cum[s] = total;
     }
     std::vector<int64_t> counts(sn, 0);
-    Pcg32 rng = DistRng(node, env);
+    Pcg32 rng = NodeRng(node, env);
     for (int64_t i = 0; i < count; ++i) {
       int pick = sn - 1;
       if (total > 0) {
@@ -234,7 +207,7 @@ class AppendMergeOp : public OpKernel {
     std::vector<Tensor> ins(node.inputs.size());
     int64_t total = 0;
     for (size_t i = 0; i < node.inputs.size(); ++i) {
-      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, i, &ins[i]));
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, i, &ins[i]));
       total += ins[i].NumElements();
     }
     Tensor out(ins[0].dtype(), {total});
@@ -260,8 +233,8 @@ class RegularMergeOp : public OpKernel {
     int64_t n = 0;
     std::vector<Tensor> pos(ns), data(ns);
     for (size_t s = 0; s < ns; ++s) {
-      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 2 * s, &pos[s]));
-      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 2 * s + 1, &data[s]));
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 2 * s, &pos[s]));
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 2 * s + 1, &data[s]));
       n += pos[s].NumElements();
     }
     DType dt = data[0].dtype();
@@ -291,10 +264,10 @@ class RaggedMergeOp : public OpKernel {
     std::vector<std::vector<Tensor>> pay(ns, std::vector<Tensor>(P));
     int64_t n = 0;
     for (size_t s = 0; s < ns; ++s) {
-      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, stride * s, &pos[s]));
-      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, stride * s + 1, &idx[s]));
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, stride * s, &pos[s]));
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, stride * s + 1, &idx[s]));
       for (int p = 0; p < P; ++p)
-        ET_K_RETURN_IF_ERROR(GetIn(ctx, node, stride * s + 2 + p,
+        ET_K_RETURN_IF_ERROR(GetInput(ctx, node, stride * s + 2 + p,
                                    &pay[s][p]));
       n += pos[s].NumElements();
     }
@@ -345,8 +318,8 @@ class RegularGatherOp : public OpKernel {
   void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
                std::function<void(Status)> done) override {
     Tensor inv_t, data;
-    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 0, &inv_t));
-    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 1, &data));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &inv_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 1, &data));
     int64_t row = std::atoll(node.attrs[0].c_str());
     const int32_t* inv = inv_t.Flat<int32_t>();
     int64_t n = inv_t.NumElements();
@@ -368,11 +341,11 @@ class RaggedGatherOp : public OpKernel {
                std::function<void(Status)> done) override {
     int P = std::atoi(node.attrs[0].c_str());
     Tensor inv_t, idx_t;
-    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 0, &inv_t));
-    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 1, &idx_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &inv_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 1, &idx_t));
     std::vector<Tensor> pay(P);
     for (int p = 0; p < P; ++p)
-      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 2 + p, &pay[p]));
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 2 + p, &pay[p]));
     const int32_t* inv = inv_t.Flat<int32_t>();
     const int32_t* ui = idx_t.Flat<int32_t>();
     int64_t n = inv_t.NumElements();
@@ -412,12 +385,12 @@ class PoolMergeOp : public OpKernel {
     std::unordered_set<uint64_t> seen;
     for (size_t i = 0; i < node.inputs.size(); ++i) {
       Tensor t;
-      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, i, &t));
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, i, &t));
       const uint64_t* p = t.Flat<uint64_t>();
       for (int64_t j = 0; j < t.NumElements(); ++j)
         if (seen.insert(p[j]).second) all.push_back(p[j]);
     }
-    Pcg32 rng = DistRng(node, env);
+    Pcg32 rng = NodeRng(node, env);
     Tensor out(DType::kU64, {m});
     uint64_t* o = out.Flat<uint64_t>();
     if (all.empty()) {
@@ -448,9 +421,9 @@ class FilterMergeOp : public OpKernel {
     std::vector<std::pair<int32_t, uint64_t>> rows;
     for (size_t s = 0; s < ns; ++s) {
       Tensor pos, ids, lpos;
-      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 3 * s, &pos));
-      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 3 * s + 1, &ids));
-      ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 3 * s + 2, &lpos));
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 3 * s, &pos));
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 3 * s + 1, &ids));
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 3 * s + 2, &lpos));
       const int32_t* p = pos.Flat<int32_t>();
       const uint64_t* id = ids.Flat<uint64_t>();
       const int32_t* lp = lpos.Flat<int32_t>();
@@ -478,11 +451,11 @@ class QuadFilterApplyOp : public OpKernel {
   void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
                std::function<void(Status)> done) override {
     Tensor idx_t, ids_t, w_t, t_t, keep_t;
-    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 0, &idx_t));
-    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 1, &ids_t));
-    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 2, &w_t));
-    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 3, &t_t));
-    ET_K_RETURN_IF_ERROR(GetIn(ctx, node, 4, &keep_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &idx_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 1, &ids_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 2, &w_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 3, &t_t));
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 4, &keep_t));
     std::unordered_set<uint64_t> keep;
     const uint64_t* kp = keep_t.Flat<uint64_t>();
     for (int64_t i = 0; i < keep_t.NumElements(); ++i) keep.insert(kp[i]);
@@ -542,27 +515,34 @@ class RemoteOp : public OpKernel {
     req.outputs = node.attrs;
 
     if (env.client == nullptr) {
-      // loopback: execute the inner plan against the local graph
-      OpKernelContext inner_ctx;
-      for (auto& kv : req.inputs) inner_ctx.Put(kv.first, kv.second);
+      // loopback: execute the inner plan against the local graph. Fully
+      // async — blocking here would park an executor thread while the
+      // inner nodes wait for the same pool (deadlock once every thread
+      // holds a blocked REMOTE).
+      auto inner_ctx = std::make_shared<OpKernelContext>();
+      for (auto& kv : req.inputs) inner_ctx->Put(kv.first, kv.second);
       auto dag = std::make_shared<DAGDef>();
       dag->nodes = req.nodes;
       QueryEnv inner_env = env;
       auto exec = std::make_shared<Executor>(dag.get(), inner_env,
-                                             &inner_ctx);
-      Status s = exec->RunSync();
-      (void)dag;
-      if (s.ok()) {
-        for (size_t i = 0; i < req.outputs.size(); ++i) {
-          Tensor t;
-          if (!inner_ctx.Get(req.outputs[i], &t)) {
-            s = Status::NotFound("REMOTE output missing: " + req.outputs[i]);
-            break;
+                                             inner_ctx.get());
+      auto outputs = req.outputs;
+      std::string out_name = node.name;
+      // exec/dag/inner_ctx stay alive via the callback capture
+      exec->Run([exec, dag, inner_ctx, outputs, out_name, ctx,
+                 done = std::move(done)](Status s) {
+        if (s.ok()) {
+          for (size_t i = 0; i < outputs.size(); ++i) {
+            Tensor t;
+            if (!inner_ctx->Get(outputs[i], &t)) {
+              s = Status::NotFound("REMOTE output missing: " + outputs[i]);
+              break;
+            }
+            ctx->Put(out_name + ":" + std::to_string(i), std::move(t));
           }
-          ctx->Put(node.OutName(static_cast<int>(i)), std::move(t));
         }
-      }
-      done(s);
+        done(s);
+      });
       return;
     }
 

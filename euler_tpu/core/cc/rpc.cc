@@ -531,7 +531,9 @@ Status ClientManager::Execute(int shard, const ExecuteRequest& req,
 void ClientManager::ExecuteAsync(
     int shard, ExecuteRequest req,
     std::function<void(Status, ExecuteReply)> done) {
-  GlobalThreadPool()->Schedule(
+  // the Call() below blocks until the shard replies — it must not occupy
+  // an executor thread (see ClientThreadPool comment in threadpool.h)
+  ClientThreadPool()->Schedule(
       [this, shard, req = std::move(req), done = std::move(done)] {
         ExecuteReply rep;
         Status s = Execute(shard, req, &rep);

@@ -33,9 +33,20 @@ Status DecodeTensor(ByteReader* r, Tensor* out) {
   if (!r->Get(&dt) || !r->Get(&rank))
     return Status::IOError("truncated tensor header");
   if (dt < 0 || dt > 4) return Status::IOError("bad dtype");
+  if (rank > 16) return Status::IOError("bad tensor rank");
   std::vector<int64_t> dims(rank);
-  for (uint32_t i = 0; i < rank; ++i)
+  uint64_t elems = 1;
+  for (uint32_t i = 0; i < rank; ++i) {
     if (!r->Get(&dims[i])) return Status::IOError("truncated dims");
+    if (dims[i] < 0) return Status::IOError("negative tensor dim");
+    if (dims[i] > 0 && elems > (1ull << 40) / static_cast<uint64_t>(dims[i]))
+      return Status::IOError("tensor dims overflow");
+    elems *= static_cast<uint64_t>(dims[i]);
+  }
+  // payload must fit in what's left of the frame — rejects corrupt or
+  // malicious headers before the allocation can throw on a pool thread
+  if (elems * DTypeSize(static_cast<DType>(dt)) > r->remaining())
+    return Status::IOError("tensor payload exceeds frame");
   Tensor t(static_cast<DType>(dt), dims);
   if (!r->GetRaw(t.raw(), t.ByteSize()))
     return Status::IOError("truncated tensor data");

@@ -49,4 +49,12 @@ ThreadPool* GlobalThreadPool() {
   return pool;
 }
 
+ThreadPool* ClientThreadPool() {
+  // 8 threads: parity with the reference's fixed client pool
+  // (query_proxy.cc:209); these threads only do blocking socket I/O, so
+  // sizing by host cores buys nothing
+  static ThreadPool* pool = new ThreadPool(8);
+  return pool;
+}
+
 }  // namespace et

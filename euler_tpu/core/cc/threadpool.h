@@ -41,9 +41,18 @@ class ThreadPool {
 };
 
 // Process-wide shared pool for query execution (lazily constructed,
-// hardware_concurrency threads). Parity: reference QueryProxy's 8-thread
-// client pool (query_proxy.cc:209) — sized to the host instead.
+// hardware_concurrency threads).
+// Invariant: tasks on this pool must never block on other tasks of the
+// same pool (the executor relies on it — a blocked compute thread can
+// starve the DAG and deadlock). Blocking RPC I/O goes on ClientThreadPool.
 ThreadPool* GlobalThreadPool();
+
+// Dedicated pool for blocking client RPC calls (socket send/recv while a
+// remote shard executes). Kept separate from GlobalThreadPool so in-flight
+// remote calls can never starve local kernel execution — in single-process
+// multi-shard setups both sides share GlobalThreadPool and mixing them
+// deadlocks once every thread is parked in a blocking call.
+ThreadPool* ClientThreadPool();
 
 }  // namespace et
 

@@ -254,3 +254,82 @@ def test_remote_node_type(two_shard_cluster):
     roots = np.array([1, 2, 3, 4], dtype=np.uint64)
     out = q.run("v(roots).label().as(t)", {"roots": roots})
     assert list(out["t:0"]) == [0, 1, 0, 1]
+
+
+# ---------------------------------------------------------------------------
+# regression tests
+# ---------------------------------------------------------------------------
+def test_has_id_keeps_weight_pairing(local_q):
+    """hasId postings must keep (row, weight) pairs aligned after the
+    row sort — listing ids in non-row order once swapped the weights."""
+    # ring_graph node weight of id i is i: P(9) = 9/11 vs P(2) = 2/11
+    out = local_q.run("sampleN(-1, 800).hasId(9:2).as(n)")
+    ids = out["n:0"]
+    assert set(ids) <= {2, 9}
+    assert (ids == 9).mean() > 0.6
+
+
+def test_negative_sample_count_raises(local_q):
+    from euler_tpu.core.lib import EngineError
+
+    with pytest.raises(EngineError):
+        local_q.run("sampleN(-1, -4).as(n)")
+    with pytest.raises(EngineError):
+        local_q.run("sampleE(-1, -4).as(e)")
+
+
+def test_sorted_nb_without_node_set_rejected():
+    from euler_tpu.core.lib import EngineError
+
+    with pytest.raises(EngineError):
+        compile_debug("getSortedNB(0)")
+    with pytest.raises(EngineError):
+        compile_debug("getTopKNB(0, 3)")
+
+
+def test_remote_v_has_duplicate_roots_matches_local(priced_graph, tmp_path):
+    """v().has() must produce identical ids/positions in local and
+    distribute mode, including duplicate input ids (the distribute
+    rewrite once deduped the input, emitting unique-space positions)."""
+    gremlin = "v(roots).has(price ge 5).as(kept)"
+    roots = np.array([6, 6, 3, 100, 9], dtype=np.uint64)
+
+    lq = Query.local(priced_graph, index_spec="price:range_index")
+    local_out = lq.run(gremlin, {"roots": roots})
+
+    data_dir = str(tmp_path / "pg")
+    priced_graph.dump(data_dir, num_partitions=2)
+    servers = [
+        start_service(data_dir, shard_idx=i, shard_num=2, port=0,
+                      index_spec="price:range_index")
+        for i in range(2)
+    ]
+    eps = ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    rq = Query.remote(f"hosts:{eps}")
+    try:
+        remote_out = rq.run(gremlin, {"roots": roots})
+        assert list(remote_out["kept:0"]) == list(local_out["kept:0"]) == [6, 6, 9]
+        assert list(remote_out["kept:1"]) == list(local_out["kept:1"]) == [0, 1, 4]
+    finally:
+        rq.close()
+        for s in servers:
+            s.stop()
+
+
+def test_single_shard_remote(ring_graph, tmp_path):
+    """shard_num=1 distribute mode must still ship graph ops to the remote
+    shard (the rewrite once skipped S==1, leaving local ops on a client
+    with no graph — the query hung forever)."""
+    data_dir = str(tmp_path / "g1")
+    ring_graph.dump(data_dir, num_partitions=1)
+    s = start_service(data_dir, shard_idx=0, shard_num=1, port=0)
+    q = Query.remote(f"hosts:127.0.0.1:{s.port}")
+    try:
+        out = q.run("v(roots).getNB(0).as(nb)",
+                    {"roots": np.array([4], dtype=np.uint64)})
+        assert list(out["nb:1"]) == [5]
+        out = q.run("sampleN(-1, 32).as(n)")
+        assert set(out["n:0"]) <= set(range(1, 11))
+    finally:
+        q.close()
+        s.stop()
