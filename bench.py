@@ -45,6 +45,7 @@ def main(argv=None):
     ap.add_argument("--fanouts", default="")
     ap.add_argument("--steps", type=int, default=0)
     ap.add_argument("--feat_dim", type=int, default=0)
+    ap.add_argument("--bf16", action="store_true", default=False)
     args = ap.parse_args(argv)
 
     if args.smoke:
@@ -57,19 +58,21 @@ def main(argv=None):
         warmup = 3
     else:
         n_nodes = args.nodes or 200_000
-        batch = args.batch_size or 1024
+        batch = args.batch_size or 16384
         fanouts = [int(x) for x in args.fanouts.split(",")] if args.fanouts \
             else [15, 10]
-        steps = args.steps or 60
+        steps = args.steps or 30
         feat_dim = args.feat_dim or 100
-        warmup = 10
+        warmup = 5
 
     import jax
 
     from euler_tpu.dataflow import FanoutDataFlow
     from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.estimator.base_estimator import _to_device_tree
     from euler_tpu.estimator.prefetch import Prefetcher
     from euler_tpu.models import SupervisedGraphSage
+    from euler_tpu.parallel import DeviceFeatureStore
 
     num_classes = 16
     data = build_products_like(n_nodes, 10, feat_dim, num_classes)
@@ -78,15 +81,30 @@ def main(argv=None):
     model = SupervisedGraphSage(
         num_classes=num_classes, multilabel=False, dim=128,
         fanouts=tuple(fanouts))
-    flow = FanoutDataFlow(graph, fanouts, feature_ids=["feature"])
+    # TPU-first input path: features live in HBM (DeviceFeatureStore);
+    # the host ships only int32 rows per step (~100× fewer bytes than
+    # shipping the gathered feature arrays)
+    import jax.numpy as jnp
+    store = DeviceFeatureStore(graph, ["feature"], label_fid="label",
+                               label_dim=num_classes,
+                               dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    flow = FanoutDataFlow(graph, fanouts, with_features=False)
     est = NodeEstimator(
         model,
         dict(batch_size=batch, learning_rate=0.01, optimizer="adam",
              label_dim=num_classes, log_steps=1 << 30, checkpoint_steps=0,
              train_node_type=-1),
-        graph, flow, label_fid="label", label_dim=num_classes)
+        graph, flow, label_fid="label", label_dim=num_classes,
+        feature_store=store)
 
-    it = Prefetcher(est.train_input_fn(), depth=3)
+    def to_dev(b):
+        # the estimator already trims store-mode batches to rows (+
+        # infer_ids, host-only); transfer in the prefetch thread so the
+        # main loop never waits on the link
+        return jax.device_put(_to_device_tree(
+            {k: v for k, v in b.items() if k != "infer_ids"}, est.max_id))
+
+    it = Prefetcher(est.train_input_fn(), depth=3, transform=to_dev)
 
     # warmup (compile) then timed steps
     est.train(iter([next(it) for _ in range(warmup)]), max_steps=warmup)

@@ -22,6 +22,7 @@
 #include "graph.h"
 #include "io.h"
 #include "ops.h"
+#include "threadpool.h"
 
 namespace {
 
@@ -285,6 +286,28 @@ int etg_all_node_ids(int64_t h, uint64_t* out) {
   for (size_t i = 0; i < g->node_count(); ++i) {
     out[i] = g->node_id(static_cast<uint32_t>(i));
   }
+  return 0;
+}
+
+// Batch id → engine row (int32). Unknown ids (incl. the default pad id)
+// map to `missing` — callers indexing a device feature table pass the
+// index of a dedicated zero pad row so padded neighbor slots contribute
+// zeros, matching GetDenseFeature's unknown-id behavior. Row-native
+// feeding skips the host-side id translation entirely — the hot path for
+// DeviceFeatureStore training input.
+int etg_node_rows(int64_t h, const uint64_t* ids, int64_t n, int32_t missing,
+                  int32_t* out) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  et::ParallelFor(et::GlobalThreadPool(), n, 8192,
+                  [&](int64_t b, int64_t e, int) {
+                    for (int64_t i = b; i < e; ++i) {
+                      uint32_t row = g->NodeIndex(ids[i]);
+                      out[i] = row == et::kInvalidIndex
+                                   ? missing
+                                   : static_cast<int32_t>(row);
+                    }
+                  });
   return 0;
 }
 

@@ -3,6 +3,8 @@
 #include <algorithm>
 #include <unordered_map>
 
+#include "threadpool.h"
+
 namespace et {
 
 bool EdgeExistsAnyType(const Graph& g, NodeId src, NodeId dst,
@@ -28,9 +30,29 @@ void SampleFanout(const Graph& g, const NodeId* roots, size_t n_roots,
     NodeId* ids = out_ids[hop];
     float* ws = out_w.empty() ? nullptr : out_w[hop];
     int32_t* ts = out_t.empty() ? nullptr : out_t[hop];
-    for (size_t i = 0; i < cur_n; ++i) {
-      g.SampleNeighbor(cur[i], et, n_et, k, default_id, rng, ids + i * k,
-                       ws ? ws + i * k : nullptr, ts ? ts + i * k : nullptr);
+    if (cur_n >= 4096) {
+      // deep hops dominate fanout cost; fan the rows across the pool.
+      // Per-chunk rngs derive from one draw, and ParallelFor's chunk
+      // layout depends only on (n, grain), so results are reproducible
+      // under a fixed seed on any machine
+      uint64_t hop_seed =
+          (static_cast<uint64_t>(rng->NextU32()) << 32) | rng->NextU32();
+      ParallelFor(GlobalThreadPool(), static_cast<int64_t>(cur_n), 2048,
+                  [&](int64_t b, int64_t e, int c) {
+                    Pcg32 local(hop_seed, static_cast<uint64_t>(c) * 2 + 1);
+                    for (int64_t i = b; i < e; ++i) {
+                      g.SampleNeighbor(cur[i], et, n_et, k, default_id,
+                                       &local, ids + i * k,
+                                       ws ? ws + i * k : nullptr,
+                                       ts ? ts + i * k : nullptr);
+                    }
+                  });
+    } else {
+      for (size_t i = 0; i < cur_n; ++i) {
+        g.SampleNeighbor(cur[i], et, n_et, k, default_id, rng, ids + i * k,
+                         ws ? ws + i * k : nullptr,
+                         ts ? ts + i * k : nullptr);
+      }
     }
     cur = ids;
     cur_n = cur_n * k;

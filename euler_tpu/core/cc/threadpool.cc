@@ -49,6 +49,33 @@ ThreadPool* GlobalThreadPool() {
   return pool;
 }
 
+void ParallelFor(ThreadPool* pool, int64_t n, int64_t grain,
+                 const std::function<void(int64_t, int64_t, int)>& fn) {
+  if (n <= 0) return;
+  // chunk layout depends only on (n, grain) — never on the machine's
+  // core count — so callers seeding per-chunk rngs get identical results
+  // everywhere; 64 caps task overhead while keeping any pool busy
+  int64_t chunks = std::min<int64_t>(64, (n + grain - 1) / grain);
+  if (chunks <= 1) {
+    fn(0, n, 0);
+    return;
+  }
+  int64_t per = (n + chunks - 1) / chunks;
+  std::mutex mu;
+  std::condition_variable cv;
+  int remaining = static_cast<int>(chunks);
+  for (int64_t c = 0; c < chunks; ++c) {
+    int64_t b = c * per, e = std::min(n, (c + 1) * per);
+    pool->Schedule([&, b, e, c] {
+      fn(b, e, static_cast<int>(c));
+      std::lock_guard<std::mutex> lk(mu);
+      if (--remaining == 0) cv.notify_one();
+    });
+  }
+  std::unique_lock<std::mutex> lk(mu);
+  cv.wait(lk, [&] { return remaining == 0; });
+}
+
 ThreadPool* ClientThreadPool() {
   // 8 threads: parity with the reference's fixed client pool
   // (query_proxy.cc:209); these threads only do blocking socket I/O, so

@@ -47,6 +47,14 @@ class ThreadPool {
 // starve the DAG and deadlock). Blocking RPC I/O goes on ClientThreadPool.
 ThreadPool* GlobalThreadPool();
 
+// Partition [0, n) into chunks of >= grain and run fn(begin, end, chunk)
+// on the pool, blocking the CALLER until all chunks finish. For use from
+// host entry points (ctypes C API) only — never from a kernel running on
+// GlobalThreadPool itself (a pool task blocking on pool tasks can
+// deadlock; see the invariant above).
+void ParallelFor(ThreadPool* pool, int64_t n, int64_t grain,
+                 const std::function<void(int64_t, int64_t, int)>& fn);
+
 // Dedicated pool for blocking client RPC calls (socket send/recv while a
 // remote shard executes). Kept separate from GlobalThreadPool so in-flight
 // remote calls can never starve local kernel execution — in single-process

@@ -85,6 +85,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etg_num_edge_features": (i32, [i64]),
         "etg_feature_info": (i32, [i64, i32, i32, c_i32p, c_i64p, ctypes.c_char_p, i64]),
         "etg_all_node_ids": (i32, [i64, c_u64p]),
+        "etg_node_rows": (i32, [i64, c_u64p, i64, i32, c_i32p]),
         "etg_node_weight_sums": (i32, [i64, c_f32p]),
         "etg_edge_weight_sums": (i32, [i64, c_f32p]),
         "etg_sample_node": (i32, [i64, i32, i64, c_u64p]),

@@ -51,6 +51,10 @@ def _to_device_tree(batch: Dict, max_id: int = 0) -> Dict:
     return jax.tree_util.tree_map(conv, batch)
 
 
+def _merged(batch: Dict, static_batch: Dict) -> Dict:
+    return {**batch, **static_batch} if static_batch else batch
+
+
 class BaseEstimator:
     """Drives a flax model with the ModelOutput contract.
 
@@ -78,6 +82,10 @@ class BaseEstimator:
         self._train_step = None
         self._eval_step = None
         self._ckpt_mgr = None
+        # device-resident arrays merged into every batch (e.g. a
+        # DeviceFeatureStore table): same jax.Array object each step, so
+        # jit sees a cached on-device arg — no per-step transfer
+        self.static_batch: Dict[str, Any] = {}
 
     # -- setup -------------------------------------------------------------
     def _init_state(self, batch: Dict, rng=None) -> None:
@@ -174,7 +182,8 @@ class BaseEstimator:
     def train(self, input_fn: Callable[[], Iterator[Dict]],
               max_steps: int = 1000) -> Dict[str, float]:
         it = input_fn() if callable(input_fn) else input_fn
-        first = _to_device_tree(next(it), self.max_id)
+        first = _merged(_to_device_tree(next(it), self.max_id),
+                        self.static_batch)
         if self.state is None:
             self._init_state(first)
             self.restore_checkpoint()
@@ -188,7 +197,8 @@ class BaseEstimator:
         batch = first
         last_log = t0
         while step < max_steps:
-            self.state, loss, metric = self._train_step(self.state, batch)
+            self.state, loss, metric = self._train_step(
+                self.state, _merged(batch, self.static_batch))
             step += 1
             losses.append(loss)
             metrics.append(metric)
@@ -229,10 +239,11 @@ class BaseEstimator:
             except StopIteration:
                 break
             if self.state is None:
-                self._init_state(batch)
+                self._init_state(_merged(batch, self.static_batch))
                 self.restore_checkpoint()
                 self._eval_step = self._build_eval_step()
-            loss, metric, _ = self._eval_step(self.state, batch)
+            loss, metric, _ = self._eval_step(
+                self.state, _merged(batch, self.static_batch))
             losses.append(float(loss))
             metrics.append(float(metric))
         return {"loss": float(np.mean(losses)) if losses else float("nan"),
@@ -253,10 +264,11 @@ class BaseEstimator:
                 break
             batch = _to_device_tree(raw, self.max_id)
             if self.state is None:
-                self._init_state(batch)
+                self._init_state(_merged(batch, self.static_batch))
                 self.restore_checkpoint()
                 self._eval_step = self._build_eval_step()
-            _, _, emb = self._eval_step(self.state, batch)
+            _, _, emb = self._eval_step(
+                self.state, _merged(batch, self.static_batch))
             embs.append(np.asarray(emb))
             key = id_key if id_key in raw else ("ids" if "ids" in raw else None)
             if key is not None:

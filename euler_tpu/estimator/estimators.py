@@ -21,7 +21,10 @@ class NodeEstimator(BaseEstimator):
 
     def __init__(self, model, params: Dict, graph: GraphEngine, dataflow,
                  label_fid="label", label_dim: Optional[int] = None,
-                 model_dir=None, mesh=None):
+                 model_dir=None, mesh=None, feature_store=None):
+        """feature_store: optional DeviceFeatureStore — batches then carry
+        int32 'rows' into the device-resident table instead of shipping
+        feature arrays, and the table rides self.static_batch."""
         super().__init__(model, params, model_dir, mesh)
         self.graph = graph
         self.dataflow = dataflow
@@ -31,16 +34,32 @@ class NodeEstimator(BaseEstimator):
         self.train_node_type = int(params.get("train_node_type", 0))
         self.eval_node_type = int(params.get("eval_node_type", 1))
         self.infer_node_type = int(params.get("infer_node_type", -1))
+        self.feature_store = feature_store
+        if feature_store is not None:
+            self.static_batch["feature_table"] = feature_store.features
+            if feature_store.labels is not None:
+                self.static_batch["label_table"] = feature_store.labels
 
     def _batches(self, node_type: int) -> Iterator[Dict]:
+        store = self.feature_store
         while True:
             roots = self.graph.sample_node(self.batch_size, node_type)
             batch = self.dataflow(roots)
-            labels = self.graph.get_dense_feature(
-                roots, self.label_fid,
-                self.label_dim if self.label_dim else None)
-            batch["labels"] = labels
-            batch["infer_ids"] = roots
+            if store is not None:
+                # rows replace ids/weights/types AND (with a label table)
+                # the host label fetch — the device step sees only int32
+                # rows, everything else gathers from HBM-resident tables
+                rows = [store.lookup(i) for i in batch["ids"]]
+                batch = {"rows": rows, "infer_ids": roots}
+                if store.labels is None:
+                    batch["labels"] = self.graph.get_dense_feature(
+                        roots, self.label_fid,
+                        self.label_dim if self.label_dim else None)
+            else:
+                batch["labels"] = self.graph.get_dense_feature(
+                    roots, self.label_fid,
+                    self.label_dim if self.label_dim else None)
+                batch["infer_ids"] = roots
             yield batch
 
     def train_input_fn(self):
@@ -56,6 +75,7 @@ class NodeEstimator(BaseEstimator):
             ids = ids[self.graph.get_node_type(ids) == self.infer_node_type]
 
         def gen():
+            store = self.feature_store
             for i in range(0, len(ids), self.batch_size):
                 chunk = ids[i:i + self.batch_size]
                 if len(chunk) < self.batch_size:
@@ -64,6 +84,12 @@ class NodeEstimator(BaseEstimator):
                         np.full(self.batch_size - len(chunk), chunk[-1],
                                 np.uint64)])
                 batch = self.dataflow(chunk)
+                if store is not None:
+                    batch = {"rows": [store.lookup(j) for j in batch["ids"]],
+                             "infer_ids": chunk}
+                    if store.labels is not None:
+                        yield batch
+                        continue
                 batch["labels"] = self.graph.get_dense_feature(
                     chunk, self.label_fid,
                     self.label_dim if self.label_dim else None)

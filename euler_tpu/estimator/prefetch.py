@@ -14,8 +14,12 @@ class Prefetcher:
 
     _STOP = object()
 
-    def __init__(self, it: Iterator, depth: int = 2):
+    def __init__(self, it: Iterator, depth: int = 2, transform=None):
+        """transform (optional) runs on each batch IN the prefetch thread —
+        pass jax.device_put to overlap host→device transfer with device
+        compute, not just graph sampling."""
         self._it = it
+        self._transform = transform
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._err = None
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -24,6 +28,8 @@ class Prefetcher:
     def _run(self):
         try:
             for item in self._it:
+                if self._transform is not None:
+                    item = self._transform(item)
                 self._q.put(item)
         except Exception as e:  # surfaced on next()
             self._err = e

@@ -294,6 +294,19 @@ class GraphEngine:
         )
         return int(dim.value)
 
+    def node_rows(self, ids, missing: int = 0) -> np.ndarray:
+        """Batch u64 node id → int32 engine row (all_node_ids order);
+        unknown ids map to `missing`. The fast path for device-resident
+        feature-table training input (DeviceFeatureStore passes its zero
+        pad row)."""
+        ids = _u64(ids).ravel()
+        out = np.zeros(ids.size, dtype=np.int32)
+        _libmod.check(
+            self._lib,
+            self._lib.etg_node_rows(self.h, _ptr(ids, c_u64p), ids.size,
+                                    missing, _ptr(out, c_i32p)))
+        return out
+
     def all_node_ids(self) -> np.ndarray:
         out = np.zeros(self.node_count, dtype=np.uint64)
         _libmod.check(self._lib, self._lib.etg_all_node_ids(self.h, _ptr(out, c_u64p)))

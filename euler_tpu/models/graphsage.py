@@ -18,8 +18,23 @@ from euler_tpu.utils.encoders import SageEncoder, ScalableSageEncoder, ShallowEn
 Array = jax.Array
 
 
+def _fanout_layers(batch: Dict[str, Any]):
+    """Per-hop feature arrays from either batch geometry:
+      'layers'               — features shipped from the host (engine path)
+      'rows' + 'feature_table' — int32 rows gathered from a device-resident
+                               table (DeviceFeatureStore path; the gather
+                               runs in-jit, so only ~0.7MB of rows crosses
+                               the host↔device link per step)."""
+    layers = batch.get("layers")
+    if layers is not None:
+        return layers
+    table = batch["feature_table"]
+    return [jax.numpy.take(table, r, axis=0) for r in batch["rows"]]
+
+
 class SupervisedGraphSage(SuperviseModel):
-    """Fanout batch {'layers': [x0..xL]} → SageEncoder → logits."""
+    """Fanout batch {'layers': [x0..xL]} (or rows + device feature table)
+    → SageEncoder → logits."""
 
     dim: int = 32
     fanouts: Sequence[int] = (10, 10)
@@ -27,7 +42,7 @@ class SupervisedGraphSage(SuperviseModel):
 
     def embed(self, batch: Dict[str, Any]) -> Array:
         return SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
-                           name="encoder")(batch["layers"])
+                           name="encoder")(_fanout_layers(batch))
 
 
 class UnsupervisedGraphSage(UnsuperviseModel):
@@ -38,7 +53,7 @@ class UnsupervisedGraphSage(UnsuperviseModel):
 
     def embed(self, batch: Dict[str, Any]) -> Array:
         return SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
-                           concat=False, name="encoder")(batch["layers"])
+                           concat=False, name="encoder")(_fanout_layers(batch))
 
 
 class ShardedSupervisedGraphSage(SuperviseModel):

@@ -45,7 +45,12 @@ class SuperviseModel(nn.Module):
     @nn.compact
     def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
         emb = self.embed(batch)
-        labels = batch["labels"]
+        labels = batch.get("labels")
+        if labels is None:
+            # device-resident label table (DeviceFeatureStore): gather the
+            # root rows in-jit instead of shipping labels from the host
+            labels = jnp.take(batch["label_table"], batch["rows"][0],
+                              axis=0)
         logits = nn.Dense(self.num_classes, name="out")(emb)
         if self.multilabel:
             loss = optax.sigmoid_binary_cross_entropy(
