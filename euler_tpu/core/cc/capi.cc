@@ -221,10 +221,11 @@ int64_t etg_load(const char* dir, int shard_idx, int shard_num, int data_type,
   return h;
 }
 
-int etg_dump(int64_t h, const char* dir, int num_partitions) {
+int etg_dump(int64_t h, const char* dir, int num_partitions, int by_graph) {
   auto g = GetGraph(h);
   if (!g) return Fail("bad graph handle");
-  et::Status s = et::DumpGraphPartitioned(*g, dir, num_partitions);
+  et::Status s = et::DumpGraphPartitioned(*g, dir, num_partitions,
+                                          by_graph != 0);
   return s.ok() ? 0 : Fail(s.message());
 }
 
@@ -496,6 +497,45 @@ int64_t etres_bytes_len(EtResult* r) {
   return static_cast<int64_t>(r->bytes.size());
 }
 const char* etres_bytes(EtResult* r) { return r->bytes.data(); }
+
+// ---- whole-graph labels (graph classification; reference
+// sample_graph_label_op / get_graph_by_label_op) ----
+int etg_builder_set_graph_labels(int64_t h, const uint64_t* ids,
+                                 const uint64_t* labels, int64_t n) {
+  auto b = GetBuilder(h);
+  if (!b) return Fail("bad builder handle");
+  b->SetGraphLabels(ids, labels, static_cast<size_t>(n));
+  return 0;
+}
+
+int64_t etg_graph_label_count(int64_t h) {
+  auto g = GetGraph(h);
+  if (!g) return -1;
+  return static_cast<int64_t>(g->graph_label_count());
+}
+
+int etg_sample_graph_label(int64_t h, int64_t count, uint64_t* out) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  g->SampleGraphLabel(static_cast<size_t>(count), &et::ThreadLocalRng(), out);
+  return 0;
+}
+
+// Ragged: per input label, the node ids of that graph (empty if unknown).
+int etg_get_graph_by_label(int64_t h, const uint64_t* labels, int64_t n,
+                           EtResult* res) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  res->offsets.assign(1, 0);
+  res->u64.clear();
+  for (int64_t i = 0; i < n; ++i) {
+    const std::vector<uint32_t>* rows = g->GraphNodes(labels[i]);
+    if (rows != nullptr)
+      for (uint32_t r : *rows) res->u64.push_back(g->node_id(r));
+    res->offsets.push_back(res->u64.size());
+  }
+  return 0;
+}
 
 int etg_get_full_neighbor(int64_t h, const uint64_t* ids, int64_t n,
                           const int32_t* edge_types, int64_t n_et,

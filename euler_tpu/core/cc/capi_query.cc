@@ -99,9 +99,11 @@ int64_t etq_new_local(int64_t graph_handle, const char* index_spec,
   return h;
 }
 
-int64_t etq_new_remote(const char* endpoints, uint64_t seed) {
+int64_t etq_new_remote(const char* endpoints, uint64_t seed,
+                       const char* mode) {
   std::unique_ptr<et::QueryProxy> qp;
-  et::Status s = et::QueryProxy::NewRemote(endpoints, seed, &qp);
+  et::Status s = et::QueryProxy::NewRemote(
+      endpoints, seed, mode && mode[0] ? mode : "distribute", &qp);
   if (!s.ok()) {
     FailWith(s.message());
     return 0;
@@ -111,6 +113,24 @@ int64_t etq_new_remote(const char* endpoints, uint64_t seed) {
   int64_t h = r.next++;
   r.proxies[h] = std::move(qp);
   return h;
+}
+
+// out: [queries, errors, total_us, last_us]
+int etq_stats(int64_t h, uint64_t* out) {
+  auto& r = QReg();
+  std::shared_ptr<et::QueryProxy> qp;
+  {
+    std::lock_guard<std::mutex> lk(r.mu);
+    auto it = r.proxies.find(h);
+    if (it == r.proxies.end()) return FailWith("bad proxy handle");
+    qp = it->second;
+  }
+  auto st = qp->stats();
+  out[0] = st.queries;
+  out[1] = st.errors;
+  out[2] = st.total_us;
+  out[3] = st.last_us;
+  return 0;
 }
 
 int etq_free(int64_t h) {

@@ -144,6 +144,8 @@ struct TransState {
   std::string cur_ids;
   // current edge triple (src, dst, type) tensor names (empty if none)
   std::vector<std::string> cur_edge;
+  // current whole-graph label set tensor (empty if none)
+  std::string cur_labels;
   // last emitted node + its output tensor names
   std::string last_node;
   std::vector<std::string> last_outputs;
@@ -222,6 +224,30 @@ Status TranslateGql(const std::vector<GqlCall>& calls, TranslateResult* out) {
         return Status::InvalidArgument("sampleNWithTypes needs a types input");
       st.Emit("API_SAMPLE_N_WITH_TYPES", {arg(0)}, {}, 1);
       st.cur_ids = st.last_outputs[0];
+      st.cur_edge.clear();
+      st.last_quad.clear();
+    } else if (c.name == "sampleGL") {
+      // sampleGL(count) — whole-graph labels (graph classification roots)
+      st.Emit("API_SAMPLE_GRAPH_LABEL", {}, {argw(0, "1")}, 1);
+      st.cur_labels = st.last_outputs[0];
+      st.cur_ids.clear();
+      st.cur_edge.clear();
+      st.last_quad.clear();
+    } else if (c.name == "graphNodes") {
+      // graphNodes() — nodes of each labeled graph; needs a label set
+      // (sampleGL or gl(input)). out: pos, idx, node ids.
+      if (st.cur_labels.empty())
+        return Status::InvalidArgument("graphNodes without a label set");
+      st.Emit("API_GET_GRAPH_BY_LABEL", {st.cur_labels}, {"all"}, 3);
+      st.cur_ids = st.last_outputs[2];
+      st.cur_labels.clear();
+      st.last_quad.clear();
+    } else if (c.name == "gl") {
+      // gl(labels) — bind an input tensor as the current label set
+      if (c.args.empty())
+        return Status::InvalidArgument("gl needs a labels input");
+      st.cur_labels = arg(0);
+      st.cur_ids.clear();
       st.cur_edge.clear();
       st.last_quad.clear();
     } else if (c.name == "sampleNB") {
@@ -494,10 +520,218 @@ struct Rewriter {
   }
 };
 
+
+// ---------------------------------------------------------------------------
+// graph_partition rewrite (reference optimizer graph_partition mode +
+// GP_* merge kernels, end2end_gp_test.cc): shards own whole graphs, so id
+// placement is by OWNERSHIP, not hash. Every graph op is broadcast to all
+// shards; each shard first filters the inputs it owns (API_GET_NODE, whose
+// :1 output is the global input positions), runs the op on the owned
+// subset, and returns (positions, outputs); the client reassembles with
+// GP_* merges keyed on the returned positions.
+// ---------------------------------------------------------------------------
+Status GpRewrite(const CompileOptions& opts, DAGDef* dag) {
+  const int S = opts.shard_num;
+  std::string sn = std::to_string(S);
+  Rewriter rw{opts, dag, {}};
+
+  std::vector<NodeDef> nodes = std::move(dag->nodes);
+  for (auto& n : nodes) {
+    bool graph_op = IsGraphOp(n.op) || n.op == "API_SAMPLE_GRAPH_LABEL" ||
+                    n.op == "API_GET_GRAPH_BY_LABEL";
+    if (!graph_op) {
+      rw.out.push_back(std::move(n));
+      continue;
+    }
+    if (n.op == "API_SAMPLE_L" || n.op == "API_GET_EDGE_P" ||
+        n.op == "API_GET_NB_FILTER") {
+      return Status::InvalidArgument(
+          n.op + " is not supported in graph_partition mode");
+    }
+    const std::string orig = n.name;
+
+    // --- root sampling: count split proportional to shard weight ---
+    if (n.op == "API_SAMPLE_NODE" || n.op == "API_SAMPLE_EDGE" ||
+        n.op == "API_SAMPLE_GRAPH_LABEL") {
+      bool edge = n.op == "API_SAMPLE_EDGE";
+      bool glabel = n.op == "API_SAMPLE_GRAPH_LABEL";
+      std::string kind = glabel ? "glabel" : (edge ? "edge" : "node");
+      std::string split = rw.Add(
+          rw.Fresh("SAMPLE_SPLIT"), "SAMPLE_SPLIT", n.inputs,
+          {kind, n.attrs.size() > 0 ? n.attrs[0] : "0",
+           n.attrs.size() > 1 && !glabel ? n.attrs[1] : "-1"});
+      int n_outs = edge ? 3 : 1;
+      std::vector<std::string> remotes;
+      for (int s = 0; s < S; ++s) {
+        NodeDef inner = n;
+        inner.name = orig + "_sh" + std::to_string(s);
+        inner.inputs = {split + ":" + std::to_string(s)};
+        if (inner.attrs.empty()) inner.attrs.push_back("0");
+        inner.attrs[0] = "0";  // count from the input scalar
+        remotes.push_back(rw.AddRemote(s, std::move(inner),
+                                       {split + ":" + std::to_string(s)},
+                                       n_outs));
+      }
+      std::vector<std::string> collect;
+      for (int o = 0; o < n_outs; ++o) {
+        std::vector<std::string> ins;
+        for (int s = 0; s < S; ++s)
+          ins.push_back(remotes[s] + ":" + std::to_string(o));
+        std::string m =
+            rw.Add(rw.Fresh("APPEND_MERGE"), "APPEND_MERGE", ins, {});
+        collect.push_back(m + ":0");
+      }
+      rw.Add(orig, "COLLECT", collect, {});
+      continue;
+    }
+
+    if (n.op == "API_SAMPLE_N_WITH_TYPES") {
+      return Status::InvalidArgument(
+          "API_SAMPLE_N_WITH_TYPES is not supported in graph_partition "
+          "mode");
+    }
+
+    // --- labels → graph nodes: broadcast, shards answer for owned labels ---
+    if (n.op == "API_GET_GRAPH_BY_LABEL") {
+      std::vector<std::string> remotes;
+      for (int s = 0; s < S; ++s) {
+        NodeDef inner = n;
+        inner.name = orig + "_sh" + std::to_string(s);
+        inner.attrs = {"owned"};
+        remotes.push_back(rw.AddRemote(s, std::move(inner), n.inputs, 3));
+      }
+      std::vector<std::string> ins{n.inputs[0]};
+      for (int s = 0; s < S; ++s) {
+        ins.push_back(remotes[s] + ":0");  // pos
+        ins.push_back(remotes[s] + ":1");  // idx
+        ins.push_back(remotes[s] + ":2");  // ids
+      }
+      std::string m =
+          rw.Add(rw.Fresh("GP_RAGGED_MERGE"), "GP_RAGGED_MERGE", ins, {"1"});
+      rw.Add(orig, "COLLECT", {m + ":0", m + ":1", m + ":2"}, {});
+      continue;
+    }
+
+    // --- id-keyed ops: broadcast + shard-side ownership filter ---
+    std::string ids_in = n.inputs[0];
+
+    if (n.op == "API_GET_NODE") {
+      // the op IS the ownership filter; union the per-shard survivors
+      std::vector<std::string> remotes;
+      for (int s = 0; s < S; ++s) {
+        NodeDef inner = n;
+        inner.name = orig + "_sh" + std::to_string(s);
+        remotes.push_back(rw.AddRemote(s, std::move(inner), {ids_in}, 2));
+      }
+      std::vector<std::string> ins;
+      for (int s = 0; s < S; ++s) {
+        ins.push_back(remotes[s] + ":0");
+        ins.push_back(remotes[s] + ":1");
+      }
+      std::string m =
+          rw.Add(rw.Fresh("GP_FILTER_MERGE"), "GP_FILTER_MERGE", ins, {});
+      rw.Add(orig, "COLLECT", {m + ":0", m + ":1"}, {});
+      continue;
+    }
+
+    // generic: inner = own-filter (GET_NODE) → op on owned subset
+    int n_outs;
+    int payloads;  // ragged payload arrays per merge group
+    if (n.op == "API_GET_P") {
+      int nf = 0;
+      for (auto& a : n.attrs)
+        if (a.rfind("udf:", 0) != 0) nf++;
+      n_outs = 2 * nf;
+      payloads = 1;
+    } else if (n.op == "API_GET_NODE_T") {
+      n_outs = 1;
+      payloads = 0;
+    } else {
+      n_outs = 4;  // quad ops
+      payloads = 3;
+    }
+
+    std::vector<std::string> remotes;
+    std::string own_base = orig + "_own_sh";
+    for (int s = 0; s < S; ++s) {
+      NodeDef own;
+      own.name = own_base + std::to_string(s);
+      own.op = "API_GET_NODE";
+      own.inputs = {ids_in};
+      NodeDef inner = n;
+      inner.name = orig + "_sh" + std::to_string(s);
+      inner.inputs[0] = own.OutName(0);
+      // REMOTE with a 2-node inner plan; outputs = own positions + op outs
+      NodeDef r;
+      r.name = rw.Fresh("REMOTE");
+      r.op = "REMOTE";
+      r.shard_idx = s;
+      r.inputs = {ids_in};
+      r.attrs.push_back(own.OutName(1));
+      for (int o = 0; o < n_outs; ++o) r.attrs.push_back(inner.OutName(o));
+      r.inner.push_back(std::move(own));
+      r.inner.push_back(std::move(inner));
+      remotes.push_back(r.name);
+      rw.out.push_back(std::move(r));
+    }
+
+    if (n.op == "API_GET_NODE_T") {
+      std::vector<std::string> ins{ids_in};
+      for (int s = 0; s < S; ++s) {
+        ins.push_back(remotes[s] + ":0");  // pos
+        ins.push_back(remotes[s] + ":1");  // types
+      }
+      std::string m = rw.Add(rw.Fresh("GP_SCATTER_MERGE"),
+                             "GP_SCATTER_MERGE", ins, {});
+      rw.Add(orig, "COLLECT", {m + ":0"}, {});
+      continue;
+    }
+
+    if (n.op == "API_GET_P") {
+      std::vector<std::string> collect;
+      int nf = n_outs / 2;
+      for (int f = 0; f < nf; ++f) {
+        std::vector<std::string> ins{ids_in};
+        for (int s = 0; s < S; ++s) {
+          ins.push_back(remotes[s] + ":0");  // pos
+          ins.push_back(remotes[s] + ":" + std::to_string(1 + 2 * f));
+          ins.push_back(remotes[s] + ":" + std::to_string(2 + 2 * f));
+        }
+        std::string m = rw.Add(rw.Fresh("GP_RAGGED_MERGE"),
+                               "GP_RAGGED_MERGE", ins, {"1"});
+        collect.push_back(m + ":1");
+        collect.push_back(m + ":2");
+      }
+      rw.Add(orig, "COLLECT", collect, {});
+      continue;
+    }
+
+    // quad ops: fixed-count sampling pads uncovered rows like local mode
+    std::vector<std::string> attrs{"3"};
+    if (n.op == "API_SAMPLE_NB") {
+      std::string k = n.attrs.size() > 1 ? n.attrs[1] : "1";
+      std::string def = n.attrs.size() > 2 ? n.attrs[2] : "0";
+      attrs.push_back("pad:" + k + ":" + def);
+    }
+    std::vector<std::string> ins{ids_in};
+    for (int s = 0; s < S; ++s) {
+      ins.push_back(remotes[s] + ":0");  // pos
+      for (int o = 1; o <= 4; ++o)
+        ins.push_back(remotes[s] + ":" + std::to_string(o));
+    }
+    std::string m = rw.Add(rw.Fresh("GP_RAGGED_MERGE"), "GP_RAGGED_MERGE",
+                           ins, attrs);
+    rw.Add(orig, "COLLECT", {m + ":1", m + ":2", m + ":3", m + ":4"}, {});
+  }
+  dag->nodes = std::move(rw.out);
+  return Status::OK();
+}
+
 }  // namespace
 
 Status OptimizeDag(const CompileOptions& opts, DAGDef* dag) {
   CsePass(dag);
+  if (opts.mode == "graph_partition") return GpRewrite(opts, dag);
   // shard_num == 1 still needs the rewrite in distribute mode: the client
   // has no local graph, so graph ops must ship to the (single) remote
   // shard — the generic split/REMOTE/merge path degenerates correctly
@@ -510,6 +744,53 @@ Status OptimizeDag(const CompileOptions& opts, DAGDef* dag) {
 
   std::vector<NodeDef> nodes = std::move(dag->nodes);
   for (auto& n : nodes) {
+    // Whole-graph label ops also need shipping in hash-distribute mode: a
+    // graph's nodes scatter across shards, so sampleGL splits by per-shard
+    // label weight and graphNodes broadcasts + concat-merges the per-shard
+    // member lists (a label may span several shards here, unlike gp mode).
+    if (n.op == "API_SAMPLE_GRAPH_LABEL") {
+      const std::string orig_gl = n.name;
+      std::string split = rw.Add(
+          rw.Fresh("SAMPLE_SPLIT"), "SAMPLE_SPLIT", n.inputs,
+          {"glabel", n.attrs.size() > 0 ? n.attrs[0] : "0", "-1"});
+      std::vector<std::string> remotes;
+      for (int s = 0; s < S; ++s) {
+        NodeDef inner = n;
+        inner.name = orig_gl + "_sh" + std::to_string(s);
+        inner.inputs = {split + ":" + std::to_string(s)};
+        if (inner.attrs.empty()) inner.attrs.push_back("0");
+        inner.attrs[0] = "0";
+        remotes.push_back(rw.AddRemote(s, std::move(inner),
+                                       {split + ":" + std::to_string(s)},
+                                       1));
+      }
+      std::vector<std::string> ins;
+      for (int s = 0; s < S; ++s) ins.push_back(remotes[s] + ":0");
+      std::string m =
+          rw.Add(rw.Fresh("APPEND_MERGE"), "APPEND_MERGE", ins, {});
+      rw.Add(orig_gl, "COLLECT", {m + ":0"}, {});
+      continue;
+    }
+    if (n.op == "API_GET_GRAPH_BY_LABEL") {
+      const std::string orig_gl = n.name;
+      std::vector<std::string> remotes;
+      for (int s = 0; s < S; ++s) {
+        NodeDef inner = n;
+        inner.name = orig_gl + "_sh" + std::to_string(s);
+        inner.attrs = {"owned"};
+        remotes.push_back(rw.AddRemote(s, std::move(inner), n.inputs, 3));
+      }
+      std::vector<std::string> ins{n.inputs[0]};
+      for (int s = 0; s < S; ++s) {
+        ins.push_back(remotes[s] + ":0");
+        ins.push_back(remotes[s] + ":1");
+        ins.push_back(remotes[s] + ":2");
+      }
+      std::string m = rw.Add(rw.Fresh("GP_RAGGED_MERGE"), "GP_RAGGED_MERGE",
+                             ins, {"1", "concat"});
+      rw.Add(orig_gl, "COLLECT", {m + ":0", m + ":1", m + ":2"}, {});
+      continue;
+    }
     if (!IsGraphOp(n.op)) {
       rw.out.push_back(std::move(n));
       continue;

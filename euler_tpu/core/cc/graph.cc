@@ -57,6 +57,11 @@ void GraphBuilder::AddNodes(const NodeId* ids, const int32_t* types,
   }
 }
 
+void GraphBuilder::SetGraphLabels(const NodeId* ids, const uint64_t* labels,
+                                  size_t n) {
+  for (size_t i = 0; i < n; ++i) graph_label_of_[ids[i]] = labels[i];
+}
+
 void GraphBuilder::AddEdges(const NodeId* src, const NodeId* dst,
                             const int32_t* types, const float* weights,
                             size_t n) {
@@ -251,6 +256,23 @@ std::unique_ptr<Graph> GraphBuilder::Finalize(bool build_in_adjacency) {
     g->node_weights_[i] = nodes_[i].weight;
   }
   g->id2idx_ = node_row_;
+
+  // ---- whole-graph labels ----
+  if (!graph_label_of_.empty()) {
+    g->graph_labels_.assign(N, 0);
+    for (const auto& kv : graph_label_of_) {
+      if (kv.second == 0) continue;  // 0 = unlabeled by convention
+      auto it = node_row_.find(kv.first);
+      if (it == node_row_.end()) continue;
+      g->graph_labels_[it->second] = kv.second;
+      g->label_rows_[kv.second].push_back(it->second);
+    }
+    for (auto& kv : g->label_rows_) {
+      std::sort(kv.second.begin(), kv.second.end());
+      g->label_ids_.push_back(kv.first);
+    }
+    std::sort(g->label_ids_.begin(), g->label_ids_.end());
+  }
 
   // ---- out-adjacency CSR, grouped by (src row, edge type) ----
   std::vector<uint64_t> group_count(N * ET + 1, 0);
@@ -941,4 +963,19 @@ void Graph::GetEdgeBinaryFeature(const NodeId* src, const NodeId* dst,
   }
 }
 
+void Graph::SampleGraphLabel(size_t count, Pcg32* rng, uint64_t* out) const {
+  if (label_ids_.empty()) {
+    for (size_t i = 0; i < count; ++i) out[i] = 0;
+    return;
+  }
+  for (size_t i = 0; i < count; ++i)
+    out[i] = label_ids_[rng->NextUInt(label_ids_.size())];
+}
+
+const std::vector<uint32_t>* Graph::GraphNodes(uint64_t label) const {
+  auto it = label_rows_.find(label);
+  return it == label_rows_.end() ? nullptr : &it->second;
+}
+
 }  // namespace et
+

@@ -162,6 +162,21 @@ class Graph {
                             std::vector<char>* values) const;
   float GetEdgeWeight(NodeId src, NodeId dst, int32_t type) const;
 
+  // ---- whole-graph (graph classification) support ----
+  // Each node may belong to one "graph label" (reference graph_label /
+  // API_SAMPLE_GRAPH_LABEL / API_GET_GRAPH_BY_LABEL, sample_graph_label_op
+  // + get_graph_by_label_op): small graphs packed into one store, sampled
+  // and fetched by label for whole-graph batching.
+  size_t graph_label_count() const { return label_ids_.size(); }
+  const std::vector<uint64_t>& graph_label_ids() const { return label_ids_; }
+  uint64_t node_graph_label(uint32_t idx) const {
+    return idx < graph_labels_.size() ? graph_labels_[idx] : 0;
+  }
+  // Uniform over distinct labels; writes `count` labels (0 when none).
+  void SampleGraphLabel(size_t count, Pcg32* rng, uint64_t* out) const;
+  // Node rows of one label; nullptr when unknown.
+  const std::vector<uint32_t>* GraphNodes(uint64_t label) const;
+
   // ---- serialization ----
   Status Dump(const std::string& path) const;  // single-partition binary dump
 
@@ -203,6 +218,10 @@ class Graph {
                      EdgeKeyHash>
       edge_slot_;
   // global samplers
+  // whole-graph labels
+  std::vector<uint64_t> graph_labels_;  // per node row; empty → unlabeled
+  std::vector<uint64_t> label_ids_;     // distinct labels, sorted
+  std::unordered_map<uint64_t, std::vector<uint32_t>> label_rows_;
   std::vector<std::vector<uint32_t>> nodes_by_type_;  // type → node indices
   std::vector<AliasSampler> node_sampler_by_type_;
   AliasSampler node_sampler_all_;  // over node indices 0..N-1
@@ -260,6 +279,8 @@ class GraphBuilder {
                         const float* values);
   void SetNodeSparseBulk(const NodeId* ids, size_t n, int fid,
                          const uint64_t* offsets, const uint64_t* values);
+  // Assign nodes to whole-graph labels (graph classification batching).
+  void SetGraphLabels(const NodeId* ids, const uint64_t* labels, size_t n);
 
   std::unique_ptr<Graph> Finalize(bool build_in_adjacency = true);
 
@@ -294,6 +315,7 @@ class GraphBuilder {
   // feature cells per fid, sorted at finalize
   std::vector<std::vector<FeatCell>> node_feat_cells_;
   std::vector<std::vector<FeatCell>> edge_feat_cells_;
+  std::unordered_map<NodeId, uint64_t> graph_label_of_;
 
   std::vector<FeatCell>* NodeCells(int fid);
   std::vector<FeatCell>* EdgeCells(int fid);

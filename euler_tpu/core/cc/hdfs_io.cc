@@ -1,0 +1,194 @@
+// HDFS file IO via a dlopen'd libhdfs — no compile-time Hadoop
+// dependency.
+//
+// Capability parity with the reference's euler/common/hdfs_file_io.cc:43-71
+// (LibHDFS struct of dlsym'd function pointers; hdfs:// URLs accepted
+// anywhere a path is). The library is resolved at first use from
+// $EULER_TPU_LIBHDFS, then libhdfs.so / libhdfs.so.0.0.0; absence yields a
+// clear IOError instead of a link failure.
+#include "hdfs_io.h"
+
+#include <dlfcn.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+
+namespace et {
+namespace {
+
+// minimal slice of hadoop's hdfs.h ABI
+using hdfsFS = void*;
+using hdfsFile = void*;
+struct hdfsFileInfo {
+  int mKind;
+  char* mName;
+  long mLastMod;
+  long long mSize;
+  short mReplication;
+  long long mBlockSize;
+  char* mOwner;
+  char* mGroup;
+  short mPermissions;
+  long mLastAccess;
+};
+
+constexpr int kORdonly = 0;  // O_RDONLY
+constexpr int kOWronly = 1;  // O_WRONLY
+
+struct LibHDFS {
+  void* handle = nullptr;
+  hdfsFS (*Connect)(const char* host, uint16_t port) = nullptr;
+  int (*Disconnect)(hdfsFS) = nullptr;
+  hdfsFile (*OpenFile)(hdfsFS, const char* path, int flags, int bufferSize,
+                       short replication, int32_t blocksize) = nullptr;
+  int (*CloseFile)(hdfsFS, hdfsFile) = nullptr;
+  int32_t (*Read)(hdfsFS, hdfsFile, void* buffer, int32_t length) = nullptr;
+  int32_t (*Write)(hdfsFS, hdfsFile, const void* buffer,
+                   int32_t length) = nullptr;
+  hdfsFileInfo* (*GetPathInfo)(hdfsFS, const char* path) = nullptr;
+  void (*FreeFileInfo)(hdfsFileInfo*, int numEntries) = nullptr;
+
+  Status Load() {
+    if (handle != nullptr) return Status::OK();
+    const char* override_path = std::getenv("EULER_TPU_LIBHDFS");
+    const char* candidates[] = {override_path, "libhdfs.so",
+                                "libhdfs.so.0.0.0"};
+    for (const char* c : candidates) {
+      if (c == nullptr || c[0] == '\0') continue;
+      handle = ::dlopen(c, RTLD_NOW | RTLD_GLOBAL);
+      if (handle != nullptr) break;
+    }
+    if (handle == nullptr)
+      return Status::IOError(
+          "libhdfs not found (set EULER_TPU_LIBHDFS or install Hadoop "
+          "native libs)");
+#define ET_HDFS_SYM(field, name)                                     \
+  do {                                                               \
+    *reinterpret_cast<void**>(&field) = ::dlsym(handle, name);       \
+    if (field == nullptr)                                            \
+      return Status::IOError("libhdfs missing symbol " name);        \
+  } while (0)
+    ET_HDFS_SYM(Connect, "hdfsConnect");
+    ET_HDFS_SYM(Disconnect, "hdfsDisconnect");
+    ET_HDFS_SYM(OpenFile, "hdfsOpenFile");
+    ET_HDFS_SYM(CloseFile, "hdfsCloseFile");
+    ET_HDFS_SYM(Read, "hdfsRead");
+    ET_HDFS_SYM(Write, "hdfsWrite");
+    ET_HDFS_SYM(GetPathInfo, "hdfsGetPathInfo");
+    ET_HDFS_SYM(FreeFileInfo, "hdfsFreeFileInfo");
+#undef ET_HDFS_SYM
+    return Status::OK();
+  }
+};
+
+LibHDFS& Lib() {
+  static LibHDFS* lib = new LibHDFS();
+  return *lib;
+}
+
+std::mutex g_fs_mu;
+std::map<std::pair<std::string, int>, hdfsFS>& FsCache() {
+  static auto* m = new std::map<std::pair<std::string, int>, hdfsFS>();
+  return *m;
+}
+
+// hdfs://host:port/path | hdfs:///path (default fs) → (host, port, path)
+Status ParseUrl(const std::string& url, std::string* host, int* port,
+                std::string* path) {
+  if (url.rfind("hdfs://", 0) != 0)
+    return Status::InvalidArgument("not an hdfs:// url: " + url);
+  std::string rest = url.substr(7);
+  auto slash = rest.find('/');
+  if (slash == std::string::npos)
+    return Status::InvalidArgument("hdfs url has no path: " + url);
+  std::string authority = rest.substr(0, slash);
+  *path = rest.substr(slash);
+  *host = "default";
+  *port = 0;
+  if (!authority.empty()) {
+    auto colon = authority.rfind(':');
+    if (colon != std::string::npos) {
+      *host = authority.substr(0, colon);
+      *port = std::atoi(authority.substr(colon + 1).c_str());
+    } else {
+      *host = authority;
+    }
+  }
+  return Status::OK();
+}
+
+Status GetFs(const std::string& host, int port, hdfsFS* fs) {
+  std::lock_guard<std::mutex> lk(g_fs_mu);
+  ET_RETURN_IF_ERROR(Lib().Load());
+  auto key = std::make_pair(host, port);
+  auto it = FsCache().find(key);
+  if (it != FsCache().end()) {
+    *fs = it->second;
+    return Status::OK();
+  }
+  hdfsFS f = Lib().Connect(host.c_str(), static_cast<uint16_t>(port));
+  if (f == nullptr)
+    return Status::IOError("hdfsConnect failed for " + host + ":" +
+                           std::to_string(port));
+  FsCache()[key] = f;
+  *fs = f;
+  return Status::OK();
+}
+
+}  // namespace
+
+bool IsHdfsPath(const std::string& path) {
+  return path.rfind("hdfs://", 0) == 0;
+}
+
+Status HdfsReadFile(const std::string& url, std::string* out) {
+  std::string host, path;
+  int port;
+  ET_RETURN_IF_ERROR(ParseUrl(url, &host, &port, &path));
+  hdfsFS fs;
+  ET_RETURN_IF_ERROR(GetFs(host, port, &fs));
+  hdfsFileInfo* info = Lib().GetPathInfo(fs, path.c_str());
+  if (info == nullptr) return Status::IOError("hdfs path not found: " + url);
+  long long size = info->mSize;
+  Lib().FreeFileInfo(info, 1);
+  hdfsFile f = Lib().OpenFile(fs, path.c_str(), kORdonly, 0, 0, 0);
+  if (f == nullptr) return Status::IOError("cannot open " + url);
+  out->resize(static_cast<size_t>(size));
+  long long got = 0;
+  while (got < size) {
+    int32_t chunk = static_cast<int32_t>(
+        std::min<long long>(size - got, 64 << 20));
+    int32_t r = Lib().Read(fs, f, &(*out)[got], chunk);
+    if (r <= 0) break;
+    got += r;
+  }
+  Lib().CloseFile(fs, f);
+  if (got != size) return Status::IOError("short hdfs read on " + url);
+  return Status::OK();
+}
+
+Status HdfsWriteFile(const std::string& url, const char* data, size_t size) {
+  std::string host, path;
+  int port;
+  ET_RETURN_IF_ERROR(ParseUrl(url, &host, &port, &path));
+  hdfsFS fs;
+  ET_RETURN_IF_ERROR(GetFs(host, port, &fs));
+  hdfsFile f = Lib().OpenFile(fs, path.c_str(), kOWronly, 0, 0, 0);
+  if (f == nullptr) return Status::IOError("cannot open " + url + " for write");
+  size_t put = 0;
+  while (put < size) {
+    int32_t chunk = static_cast<int32_t>(
+        std::min<size_t>(size - put, 64 << 20));
+    int32_t w = Lib().Write(fs, f, data + put, chunk);
+    if (w <= 0) break;
+    put += static_cast<size_t>(w);
+  }
+  int rc = Lib().CloseFile(fs, f);
+  if (put != size || rc != 0)
+    return Status::IOError("short hdfs write on " + url);
+  return Status::OK();
+}
+
+}  // namespace et

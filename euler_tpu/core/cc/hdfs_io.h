@@ -1,0 +1,17 @@
+// hdfs:// file IO via dlopen'd libhdfs (reference hdfs_file_io.cc:43-71).
+#ifndef EULER_TPU_HDFS_IO_H_
+#define EULER_TPU_HDFS_IO_H_
+
+#include <string>
+
+#include "common.h"
+
+namespace et {
+
+bool IsHdfsPath(const std::string& path);
+Status HdfsReadFile(const std::string& url, std::string* out);
+Status HdfsWriteFile(const std::string& url, const char* data, size_t size);
+
+}  // namespace et
+
+#endif  // EULER_TPU_HDFS_IO_H_

@@ -1,5 +1,7 @@
 #include "io.h"
 
+#include "hdfs_io.h"
+
 #include <cstdio>
 
 namespace et {
@@ -7,10 +9,13 @@ namespace et {
 namespace {
 constexpr char kMetaMagic[4] = {'E', 'T', 'M', '1'};
 constexpr char kPartMagic[4] = {'E', 'T', 'P', '1'};
-constexpr uint32_t kVersion = 1;
+// v2 adds an optional trailing graph-label section to partition files
+// (whole-graph classification support); v1 files load fine (no labels).
+constexpr uint32_t kVersion = 2;
 }  // namespace
 
 Status ReadFileToString(const std::string& path, std::string* out) {
+  if (IsHdfsPath(path)) return HdfsReadFile(path, out);
   std::FILE* f = std::fopen(path.c_str(), "rb");
   if (!f) return Status::IOError("cannot open " + path);
   std::fseek(f, 0, SEEK_END);
@@ -27,6 +32,7 @@ Status ReadFileToString(const std::string& path, std::string* out) {
 
 Status WriteStringToFile(const std::string& path, const char* data,
                          size_t size) {
+  if (IsHdfsPath(path)) return HdfsWriteFile(path, data, size);
   std::FILE* f = std::fopen(path.c_str(), "wb");
   if (!f) return Status::IOError("cannot open " + path + " for write");
   size_t put = size ? std::fwrite(data, 1, size, f) : 0;
@@ -71,7 +77,7 @@ Status LoadMeta(const std::string& path, GraphMeta* meta) {
   if (!r.GetRaw(magic, 4) || std::memcmp(magic, kMetaMagic, 4) != 0) {
     return Status::IOError("bad meta magic in " + path);
   }
-  if (!r.Get(&ver) || ver != kVersion) {
+  if (!r.Get(&ver) || ver < 1 || ver > kVersion) {
     return Status::IOError("unsupported meta version");
   }
   if (!r.Get(&nt) || !r.Get(&et) || !r.Get(&pn)) {
@@ -170,7 +176,7 @@ Status LoadPartitionFile(const std::string& path, int data_type,
   if (!r.GetRaw(magic, 4) || std::memcmp(magic, kPartMagic, 4) != 0) {
     return Status::IOError("bad partition magic in " + path);
   }
-  if (!r.Get(&ver) || ver != kVersion) {
+  if (!r.Get(&ver) || ver < 1 || ver > kVersion) {
     return Status::IOError("unsupported partition version");
   }
   uint64_t n_nodes;
@@ -226,6 +232,16 @@ Status LoadPartitionFile(const std::string& path, int data_type,
                              static_cast<int64_t>(b.second.size()));
     }
   }
+  if (ver >= 2 && r.remaining() >= sizeof(uint64_t)) {
+    uint64_t n_labeled;
+    if (!r.Get(&n_labeled)) return Status::IOError("truncated label section");
+    for (uint64_t i = 0; i < n_labeled; ++i) {
+      uint64_t id, gl;
+      if (!r.Get(&id) || !r.Get(&gl))
+        return Status::IOError("truncated label record in " + path);
+      if (want_nodes) builder->SetGraphLabels(&id, &gl, 1);
+    }
+  }
   return Status::OK();
 }
 
@@ -253,23 +269,34 @@ Status LoadShard(const std::string& dir, int shard_idx, int shard_num,
 // Writes the records of partition p of P (nodes and source-owned edges
 // with id % P == p) — the same assignment the Python prep tool uses
 // (tools/generate_data.py) so dumped and generated data interoperate.
+// by_graph: partition ownership by graph label (graph_partition mode —
+// whole graphs stay on one shard) instead of node-id hash.
+static uint64_t OwnerOf(const Graph& g, uint32_t row, uint64_t P,
+                        bool by_graph) {
+  if (by_graph) {
+    uint64_t gl = g.node_graph_label(row);
+    if (gl != 0) return gl % P;
+  }
+  return g.node_id(row) % P;
+}
+
 static Status DumpOnePartition(const Graph& g, const GraphMeta& meta,
                                const std::string& path, uint64_t p,
-                               uint64_t P) {
+                               uint64_t P, bool by_graph) {
   ByteWriter w;
   w.PutRaw(kPartMagic, 4);
   w.Put<uint32_t>(kVersion);
   const size_t N = g.node_count();
   size_t n_mine = 0;
   for (size_t i = 0; i < N; ++i)
-    if (g.node_id(static_cast<uint32_t>(i)) % P == p) ++n_mine;
+    if (OwnerOf(g, static_cast<uint32_t>(i), P, by_graph) == p) ++n_mine;
   w.Put<uint64_t>(n_mine);
   std::vector<float> dense_buf;
   std::vector<uint64_t> sp_off, sp_val;
   std::vector<char> bin_val;
   for (size_t i = 0; i < N; ++i) {
     NodeId id = g.node_id(static_cast<uint32_t>(i));
-    if (id % P != p) continue;
+    if (OwnerOf(g, static_cast<uint32_t>(i), P, by_graph) != p) continue;
     w.Put<uint64_t>(id);
     w.Put<int32_t>(g.node_type(static_cast<uint32_t>(i)));
     w.Put<float>(g.node_weight(static_cast<uint32_t>(i)));
@@ -326,7 +353,7 @@ static Status DumpOnePartition(const Graph& g, const GraphMeta& meta,
   std::vector<int32_t> ts;
   uint64_t edge_total = 0;
   for (size_t i = 0; i < N; ++i) {
-    if (g.node_id(static_cast<uint32_t>(i)) % P != p) continue;
+    if (OwnerOf(g, static_cast<uint32_t>(i), P, by_graph) != p) continue;
     nbr.clear();
     ws.clear();
     ts.clear();
@@ -337,7 +364,7 @@ static Status DumpOnePartition(const Graph& g, const GraphMeta& meta,
   w.Put<uint64_t>(edge_total);
   for (size_t i = 0; i < N; ++i) {
     NodeId src = g.node_id(static_cast<uint32_t>(i));
-    if (src % P != p) continue;
+    if (OwnerOf(g, static_cast<uint32_t>(i), P, by_graph) != p) continue;
     nbr.clear();
     ws.clear();
     ts.clear();
@@ -398,11 +425,27 @@ static Status DumpOnePartition(const Graph& g, const GraphMeta& meta,
       }
     }
   }
+
+  // v2 trailing section: graph labels of this partition's nodes
+  uint64_t n_labeled = 0;
+  for (size_t i = 0; i < N; ++i) {
+    if (OwnerOf(g, static_cast<uint32_t>(i), P, by_graph) != p) continue;
+    if (g.node_graph_label(static_cast<uint32_t>(i)) != 0) ++n_labeled;
+  }
+  w.Put<uint64_t>(n_labeled);
+  for (size_t i = 0; i < N; ++i) {
+    NodeId id = g.node_id(static_cast<uint32_t>(i));
+    if (OwnerOf(g, static_cast<uint32_t>(i), P, by_graph) != p) continue;
+    uint64_t gl = g.node_graph_label(static_cast<uint32_t>(i));
+    if (gl == 0) continue;
+    w.Put<uint64_t>(id);
+    w.Put<uint64_t>(gl);
+  }
   return WriteStringToFile(path, w.buffer().data(), w.buffer().size());
 }
 
 Status DumpGraphPartitioned(const Graph& g, const std::string& dir,
-                            int num_partitions) {
+                            int num_partitions, bool by_graph) {
   if (num_partitions < 1) num_partitions = 1;
   GraphMeta meta = g.meta();
   meta.partition_num = num_partitions;
@@ -410,13 +453,13 @@ Status DumpGraphPartitioned(const Graph& g, const std::string& dir,
   for (int p = 0; p < num_partitions; ++p) {
     ET_RETURN_IF_ERROR(
         DumpOnePartition(g, meta, dir + "/part_" + std::to_string(p) + ".dat",
-                         p, num_partitions));
+                         p, num_partitions, by_graph));
   }
   return Status::OK();
 }
 
 Status DumpGraph(const Graph& g, const std::string& dir) {
-  return DumpGraphPartitioned(g, dir, 1);
+  return DumpGraphPartitioned(g, dir, 1, false);
 }
 
 Status Graph::Dump(const std::string& path) const {

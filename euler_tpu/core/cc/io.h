@@ -111,7 +111,7 @@ Status DumpGraph(const Graph& g, const std::string& dir);
 // id = id % num_partitions, matching the data-prep tool) so a dumped graph
 // can be re-served sharded.
 Status DumpGraphPartitioned(const Graph& g, const std::string& dir,
-                            int num_partitions);
+                            int num_partitions, bool by_graph = false);
 
 }  // namespace et
 

@@ -41,12 +41,25 @@ std::vector<std::string> SplitStr(const std::string& s, char sep) {
   return out;
 }
 
-// "0:2:5" → {0,2,5}; "*" or "" → empty (= all types).
+// "0:2:5" → {0,2,5}; "*", "" or any negative entry → empty (= all types,
+// matching sampleN's type=-1 convention).
 std::vector<int32_t> ParseEdgeTypes(const std::string& s) {
   std::vector<int32_t> out;
   if (s.empty() || s == "*") return out;
-  for (auto& t : SplitStr(s, ':')) out.push_back(std::atoi(t.c_str()));
+  for (auto& t : SplitStr(s, ':')) {
+    int32_t v = std::atoi(t.c_str());
+    if (v < 0) return {};
+    out.push_back(v);
+  }
   return out;
+}
+
+// dnf evaluation without a configured index still supports the pure-id
+// branch (hasId) — an empty manager resolves ids against the graph and
+// returns NotFound for real attribute conditions.
+const IndexManager& IndexOrEmpty(const QueryEnv& env) {
+  static IndexManager* empty = new IndexManager();
+  return env.index != nullptr ? *env.index : *empty;
 }
 
 // Resolve a feature name (or "f<id>") to (kind, fid, dim) from graph meta.
@@ -124,12 +137,9 @@ class SampleNodeOp : public OpKernel {
     Pcg32 rng = NodeRng(node, env);
     Tensor out(DType::kU64, {count});
     if (!node.dnf.empty()) {
-      if (env.index == nullptr) {
-        done(Status::Internal("conditioned sampling requires an index"));
-        return;
-      }
       IndexResult res;
-      ET_K_RETURN_IF_ERROR(env.index->EvalDnf(env.graph, node.dnf, &res));
+      ET_K_RETURN_IF_ERROR(
+          IndexOrEmpty(env).EvalDnf(env.graph, node.dnf, &res));
       if (type >= 0) {
         // intersect with type postings via direct filter
         IndexResult typed;
@@ -218,11 +228,8 @@ class GetNodeOp : public OpKernel {
     IndexResult res;
     bool has_dnf = !node.dnf.empty();
     if (has_dnf) {
-      if (env.index == nullptr) {
-        done(Status::Internal("has() filter requires an index"));
-        return;
-      }
-      ET_K_RETURN_IF_ERROR(env.index->EvalDnf(env.graph, node.dnf, &res));
+      ET_K_RETURN_IF_ERROR(
+          IndexOrEmpty(env).EvalDnf(env.graph, node.dnf, &res));
     }
     std::vector<uint64_t> keep;
     std::vector<int32_t> pos;
@@ -738,6 +745,78 @@ class IdUniqueOp : public OpKernel {
   }
 };
 ET_REGISTER_KERNEL("ID_UNIQUE", IdUniqueOp);
+
+
+// ---------------------------------------------------------------------------
+// Whole-graph (graph classification) ops — reference
+// sample_graph_label_op.cc / get_graph_by_label_op.cc.
+// ---------------------------------------------------------------------------
+// API_SAMPLE_GRAPH_LABEL — attrs [count]; optional input overrides count.
+// out :0 = labels u64 [count].
+class SampleGraphLabelOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    int64_t count =
+        node.attrs.size() > 0 ? std::atoll(node.attrs[0].c_str()) : 0;
+    if (!node.inputs.empty()) {
+      Tensor t;
+      if (ctx->Get(node.inputs[0], &t) && t.NumElements() > 0)
+        count = t.AsI64(0);
+    }
+    if (count < 0) {
+      done(Status::InvalidArgument("sampleGL count must be >= 0"));
+      return;
+    }
+    Pcg32 rng = NodeRng(node, env);
+    Tensor out(DType::kU64, {count});
+    env.graph->SampleGraphLabel(static_cast<size_t>(count), &rng,
+                                out.Flat<uint64_t>());
+    ctx->Put(node.OutName(0), std::move(out));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("API_SAMPLE_GRAPH_LABEL", SampleGraphLabelOp);
+
+// API_GET_GRAPH_BY_LABEL — input 0: labels u64. attrs[0] "all" (default):
+// one row per input label, empty when unknown; "owned": only labels this
+// graph holds (the graph_partition inner form — positions select the
+// owner's rows at the client merge).
+// out :0 = pos i32 [m], :1 = idx i32 [m,2], :2 = node ids u64.
+class GetGraphByLabelOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor labels_t;
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &labels_t));
+    bool owned_only = !node.attrs.empty() && node.attrs[0] == "owned";
+    const uint64_t* labels = labels_t.Flat<uint64_t>();
+    int64_t n = labels_t.NumElements();
+    std::vector<int32_t> pos;
+    std::vector<uint64_t> offs{0};
+    std::vector<uint64_t> out_ids;
+    for (int64_t i = 0; i < n; ++i) {
+      const std::vector<uint32_t>* rows = env.graph->GraphNodes(labels[i]);
+      if (rows == nullptr && owned_only) continue;
+      if (rows != nullptr)
+        for (uint32_t r : *rows) out_ids.push_back(env.graph->node_id(r));
+      pos.push_back(static_cast<int32_t>(i));
+      offs.push_back(out_ids.size());
+    }
+    int64_t m = static_cast<int64_t>(pos.size());
+    Tensor idx(DType::kI32, {m, 2});
+    int32_t* pi = idx.Flat<int32_t>();
+    for (int64_t i = 0; i < m; ++i) {
+      pi[2 * i] = static_cast<int32_t>(offs[i]);
+      pi[2 * i + 1] = static_cast<int32_t>(offs[i + 1]);
+    }
+    ctx->Put(node.OutName(0), Tensor::FromVector(pos));
+    ctx->Put(node.OutName(1), std::move(idx));
+    ctx->Put(node.OutName(2), Tensor::FromVector(out_ids));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("API_GET_GRAPH_BY_LABEL", GetGraphByLabelOp);
 
 }  // namespace
 }  // namespace et

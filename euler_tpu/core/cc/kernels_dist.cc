@@ -159,6 +159,7 @@ class SampleSplitOp : public OpKernel {
   void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
                std::function<void(Status)> done) override {
     bool edge = node.attrs[0] == "edge";
+    bool glabel = node.attrs[0] == "glabel";
     int64_t count = std::atoll(node.attrs[1].c_str());
     int type = std::atoi(node.attrs[2].c_str());
     if (!node.inputs.empty()) {
@@ -172,8 +173,9 @@ class SampleSplitOp : public OpKernel {
     for (int s = 0; s < sn; ++s) {
       float w = 1.f;
       if (env.client != nullptr)
-        w = edge ? env.client->EdgeWeight(s, type)
-                 : env.client->NodeWeight(s, type);
+        w = glabel ? env.client->GraphLabelWeight(s)
+                   : (edge ? env.client->EdgeWeight(s, type)
+                           : env.client->NodeWeight(s, type));
       total += w;
       cum[s] = total;
     }
@@ -562,6 +564,175 @@ class RemoteOp : public OpKernel {
   }
 };
 ET_REGISTER_KERNEL("REMOTE", RemoteOp);
+
+
+// ---------------------------------------------------------------------------
+// GP_* merges — graph_partition mode (reference gp_unique_merge_op.cc and
+// friends). Shards return (positions-into-the-broadcast-input, outputs);
+// these kernels reassemble full-size results. Uncovered positions (ids no
+// shard owns) become empty rows, or fixed pads with attr "pad:<k>:<def>".
+// ---------------------------------------------------------------------------
+// GP_RAGGED_MERGE — attrs [P, ("pad:k:def" | "concat")?]; inputs: base
+// (defines n) + per shard (pos, idx, P payloads). out :0 iota pos,
+// :1 idx [n,2], :2..1+P payloads. Default: one owner per position (gp
+// mode). "concat": a position's row is the concatenation of every
+// shard's row (hash-distribute mode, where one graph label's members
+// scatter across shards).
+class GpRaggedMergeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    int P = std::atoi(node.attrs[0].c_str());
+    int64_t pad_k = 0;
+    uint64_t pad_def = 0;
+    bool concat = node.attrs.size() > 1 && node.attrs[1] == "concat";
+    if (node.attrs.size() > 1 && node.attrs[1].rfind("pad:", 0) == 0) {
+      auto rest = node.attrs[1].substr(4);
+      auto colon = rest.find(':');
+      pad_k = std::atoll(rest.substr(0, colon).c_str());
+      if (colon != std::string::npos)
+        pad_def = std::strtoull(rest.substr(colon + 1).c_str(), nullptr, 10);
+    }
+    Tensor base;
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &base));
+    int64_t n = base.dims().empty() ? base.NumElements() : base.dim(0);
+    size_t stride = 2 + P;
+    size_t ns = (node.inputs.size() - 1) / stride;
+    std::vector<Tensor> pos(ns), idx(ns);
+    std::vector<std::vector<Tensor>> pay(ns, std::vector<Tensor>(P));
+    for (size_t s = 0; s < ns; ++s) {
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 1 + stride * s, &pos[s]));
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 1 + stride * s + 1, &idx[s]));
+      for (int p = 0; p < P; ++p)
+        ET_K_RETURN_IF_ERROR(
+            GetInput(ctx, node, 1 + stride * s + 2 + p, &pay[s][p]));
+    }
+    // global row → contributing (shard, local row) pairs; empty =
+    // uncovered. Default mode keeps only the last owner; concat keeps all.
+    std::vector<std::vector<std::pair<int32_t, int32_t>>> where(n);
+    for (size_t s = 0; s < ns; ++s) {
+      const int32_t* p = pos[s].Flat<int32_t>();
+      for (int64_t j = 0; j < pos[s].NumElements(); ++j) {
+        if (p[j] < 0 || p[j] >= n) continue;
+        if (!concat) where[p[j]].clear();
+        where[p[j]].emplace_back(static_cast<int32_t>(s),
+                                 static_cast<int32_t>(j));
+      }
+    }
+    Tensor out_pos(DType::kI32, {n});
+    Tensor out_idx(DType::kI32, {n, 2});
+    int32_t* op_ = out_pos.Flat<int32_t>();
+    int32_t* oi = out_idx.Flat<int32_t>();
+    int64_t cursor = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      op_[i] = static_cast<int32_t>(i);
+      int64_t len = where[i].empty() ? pad_k : 0;
+      for (auto [s, j] : where[i]) {
+        const int32_t* si = idx[s].Flat<int32_t>();
+        len += si[2 * j + 1] - si[2 * j];
+      }
+      oi[2 * i] = static_cast<int32_t>(cursor);
+      oi[2 * i + 1] = static_cast<int32_t>(cursor + len);
+      cursor += len;
+    }
+    for (int p = 0; p < P; ++p) {
+      DType dt = DType::kU64;
+      for (size_t s = 0; s < ns; ++s)
+        if (pay[s][p].NumElements() > 0 || s + 1 == ns) {
+          dt = pay[s][p].dtype();
+          break;
+        }
+      size_t esz = DTypeSize(dt);
+      Tensor out(dt, {cursor});
+      for (int64_t i = 0; i < n; ++i) {
+        uint8_t* dst = out.raw() + oi[2 * i] * esz;
+        if (where[i].empty() && pad_k > 0) {
+          // uncovered + fixed-count: pad like the local kernel would
+          for (int64_t t = 0; t < pad_k; ++t) {
+            if (dt == DType::kU64) {
+              reinterpret_cast<uint64_t*>(dst)[t] = pad_def;
+            } else if (dt == DType::kF32) {
+              reinterpret_cast<float*>(dst)[t] = 0.f;
+            } else {
+              reinterpret_cast<int32_t*>(dst)[t] = -1;
+            }
+          }
+          continue;
+        }
+        for (auto [s, j] : where[i]) {
+          const int32_t* si = idx[s].Flat<int32_t>();
+          int64_t b = si[2 * j], e = si[2 * j + 1];
+          std::memcpy(dst, pay[s][p].raw() + b * esz, (e - b) * esz);
+          dst += (e - b) * esz;
+        }
+      }
+      ctx->Put(node.OutName(2 + p), std::move(out));
+    }
+    ctx->Put(node.OutName(0), std::move(out_pos));
+    ctx->Put(node.OutName(1), std::move(out_idx));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("GP_RAGGED_MERGE", GpRaggedMergeOp);
+
+// GP_FILTER_MERGE — inputs per shard (ids, pos); positions are already
+// global (broadcast input). Union ordered by position.
+class GpFilterMergeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    size_t ns = node.inputs.size() / 2;
+    std::vector<std::pair<int32_t, uint64_t>> rows;
+    for (size_t s = 0; s < ns; ++s) {
+      Tensor ids, pos;
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 2 * s, &ids));
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 2 * s + 1, &pos));
+      const uint64_t* id = ids.Flat<uint64_t>();
+      const int32_t* p = pos.Flat<int32_t>();
+      for (int64_t j = 0; j < ids.NumElements(); ++j)
+        rows.emplace_back(p[j], id[j]);
+    }
+    std::sort(rows.begin(), rows.end());
+    std::vector<uint64_t> out_ids;
+    std::vector<int32_t> out_pos;
+    for (auto& r : rows) {
+      out_pos.push_back(r.first);
+      out_ids.push_back(r.second);
+    }
+    ctx->Put(node.OutName(0), Tensor::FromVector(out_ids));
+    ctx->Put(node.OutName(1), Tensor::FromVector(out_pos));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("GP_FILTER_MERGE", GpFilterMergeOp);
+
+// GP_SCATTER_MERGE — inputs: base + per shard (pos, vals i32). out :0 =
+// i32 [n], -1 where uncovered.
+class GpScatterMergeOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor base;
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &base));
+    int64_t n = base.NumElements();
+    Tensor out(DType::kI32, {n});
+    int32_t* o = out.Flat<int32_t>();
+    for (int64_t i = 0; i < n; ++i) o[i] = -1;
+    size_t ns = (node.inputs.size() - 1) / 2;
+    for (size_t s = 0; s < ns; ++s) {
+      Tensor pos, vals;
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 1 + 2 * s, &pos));
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 1 + 2 * s + 1, &vals));
+      const int32_t* p = pos.Flat<int32_t>();
+      const int32_t* v = vals.Flat<int32_t>();
+      for (int64_t j = 0; j < pos.NumElements(); ++j)
+        if (p[j] >= 0 && p[j] < n) o[p[j]] = v[j];
+    }
+    ctx->Put(node.OutName(0), std::move(out));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("GP_SCATTER_MERGE", GpScatterMergeOp);
 
 }  // namespace
 }  // namespace et

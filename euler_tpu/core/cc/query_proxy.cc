@@ -2,6 +2,8 @@
 
 #include "threadpool.h"
 
+#include <chrono>
+
 namespace et {
 
 Status QueryProxy::NewLocal(std::shared_ptr<const Graph> graph,
@@ -23,12 +25,18 @@ Status QueryProxy::NewLocal(std::shared_ptr<const Graph> graph,
 }
 
 Status QueryProxy::NewRemote(const std::string& endpoints, uint64_t seed,
+                             const std::string& mode,
                              std::unique_ptr<QueryProxy>* out) {
+  if (mode != "distribute" && mode != "graph_partition")
+    return Status::InvalidArgument("remote mode must be distribute or "
+                                   "graph_partition, got " + mode);
   ShardEndpoints eps;
+  std::string watch_dir;
   if (endpoints.rfind("hosts:", 0) == 0) {
     ET_RETURN_IF_ERROR(DiscoverFromSpec(endpoints.substr(6), &eps));
   } else if (endpoints.rfind("dir:", 0) == 0) {
-    ET_RETURN_IF_ERROR(DiscoverFromRegistryAuto(endpoints.substr(4), &eps));
+    watch_dir = endpoints.substr(4);
+    ET_RETURN_IF_ERROR(DiscoverFromRegistryAuto(watch_dir, &eps));
   } else {
     return Status::InvalidArgument(
         "endpoints must be 'hosts:h:p,...' or 'dir:/path'");
@@ -37,8 +45,11 @@ Status QueryProxy::NewRemote(const std::string& endpoints, uint64_t seed,
   qp->seed_ = seed;
   qp->client_ = std::make_unique<ClientManager>();
   ET_RETURN_IF_ERROR(qp->client_->Init(eps));
+  // registry mode gets live membership: restarted shards are picked up
+  // without re-initializing the proxy (ZK watch parity)
+  if (!watch_dir.empty()) qp->client_->WatchRegistry(watch_dir);
   CompileOptions opts;
-  opts.mode = "distribute";
+  opts.mode = mode;
   opts.shard_num = qp->client_->shard_num();
   opts.partition_num = qp->client_->partition_num();
   qp->compiler_ = std::make_unique<GqlCompiler>(opts);
@@ -56,6 +67,21 @@ const GraphMeta& QueryProxy::graph_meta() const {
 Status QueryProxy::RunGremlin(const std::string& query,
                               const std::map<std::string, Tensor>& inputs,
                               std::map<std::string, Tensor>* outputs) {
+  auto t0 = std::chrono::steady_clock::now();
+  Status st = RunGremlinTimed(query, inputs, outputs);
+  uint64_t us = std::chrono::duration_cast<std::chrono::microseconds>(
+                    std::chrono::steady_clock::now() - t0)
+                    .count();
+  queries_.fetch_add(1);
+  if (!st.ok()) errors_.fetch_add(1);
+  total_us_.fetch_add(us);
+  last_us_.store(us);
+  return st;
+}
+
+Status QueryProxy::RunGremlinTimed(const std::string& query,
+                                   const std::map<std::string, Tensor>& inputs,
+                                   std::map<std::string, Tensor>* outputs) {
   std::shared_ptr<const TranslateResult> plan;
   ET_RETURN_IF_ERROR(compiler_->Compile(query, &plan));
   OpKernelContext ctx;

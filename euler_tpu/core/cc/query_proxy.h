@@ -32,7 +32,10 @@ class QueryProxy {
   // Distribute mode: endpoints either from a registry dir ("dir:<path>")
   // or a static spec ("hosts:<h:p,h:p,...>"). shard_num inferred from the
   // endpoint list.
+  // mode: "distribute" (hash-sharded) or "graph_partition" (shards own
+  // whole graphs; ops broadcast + ownership-filtered).
   static Status NewRemote(const std::string& endpoints, uint64_t seed,
+                          const std::string& mode,
                           std::unique_ptr<QueryProxy>* out);
 
   // Compile + execute. Returns every alias tensor ("<as>:i") plus the
@@ -46,8 +49,26 @@ class QueryProxy {
     return client_ ? client_->shard_num() : 1;
   }
 
+  // Per-proxy query timing (aux parity: the reference's ad-hoc
+  // TimmerBegin/GetTimmerInterval, euler/common/timmer.h — surfaced as
+  // counters instead of log lines). All monotonically increasing.
+  struct Stats {
+    uint64_t queries = 0;     // RunGremlin calls completed
+    uint64_t errors = 0;      // ... that returned a non-OK status
+    uint64_t total_us = 0;    // wall time summed over calls
+    uint64_t last_us = 0;     // wall time of the most recent call
+  };
+  Stats stats() const {
+    return {queries_.load(), errors_.load(), total_us_.load(),
+            last_us_.load()};
+  }
+
  private:
   QueryProxy() = default;
+
+  Status RunGremlinTimed(const std::string& query,
+                         const std::map<std::string, Tensor>& inputs,
+                         std::map<std::string, Tensor>* outputs);
 
   std::shared_ptr<const Graph> graph_;          // local mode
   std::shared_ptr<IndexManager> index_;         // local mode
@@ -55,6 +76,7 @@ class QueryProxy {
   std::unique_ptr<GqlCompiler> compiler_;
   uint64_t seed_ = 0;
   std::atomic<uint64_t> run_counter_{0};  // per-run RNG nonce
+  std::atomic<uint64_t> queries_{0}, errors_{0}, total_us_{0}, last_us_{0};
 };
 
 }  // namespace et

@@ -8,6 +8,9 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <sys/stat.h>
+
+#include <chrono>
 #include <cstdio>
 #include <cstring>
 #include <map>
@@ -77,6 +80,7 @@ void EncodeShardMeta(const ShardMeta& m, ByteWriter* w) {
   for (float f : m.node_type_wsum) w->Put<float>(f);
   w->Put<uint32_t>(static_cast<uint32_t>(m.edge_type_wsum.size()));
   for (float f : m.edge_type_wsum) w->Put<float>(f);
+  w->Put<uint64_t>(m.graph_label_count);
   const GraphMeta& gm = m.graph_meta;
   w->PutStr(gm.name);
   w->Put<int32_t>(gm.num_node_types);
@@ -115,6 +119,8 @@ Status DecodeShardMeta(ByteReader* r, ShardMeta* m) {
   for (uint32_t i = 0; i < n; ++i)
     if (!r->Get(&m->edge_type_wsum[i]))
       return Status::IOError("truncated weights");
+  if (!r->Get(&m->graph_label_count))
+    return Status::IOError("truncated shard meta");
   GraphMeta& gm = m->graph_meta;
   if (!r->GetStr(&gm.name) || !r->Get(&gm.num_node_types) ||
       !r->Get(&gm.num_edge_types) || !r->Get(&gm.node_count) ||
@@ -206,6 +212,14 @@ void GraphServer::Stop() {
     std::lock_guard<std::mutex> lk(conn_mu_);
     conn_fds_.clear();
   }
+  {
+    // pair the stopping_ store with hb_mu_ so the notify can't land in
+    // the heartbeat thread's predicate-check window (missed wakeup =
+    // Stop stalls a full heartbeat period)
+    std::lock_guard<std::mutex> lk(hb_mu_);
+  }
+  hb_cv_.notify_all();
+  if (heartbeat_.joinable()) heartbeat_.join();
   if (!registered_path_.empty()) std::remove(registered_path_.c_str());
 }
 
@@ -223,12 +237,24 @@ void GraphServer::ReapFinishedLocked() {
 }
 
 Status GraphServer::Register(const std::string& registry_dir,
-                             const std::string& host) {
+                             const std::string& host, int heartbeat_ms) {
   std::ostringstream os;
   os << registry_dir << "/shard_" << shard_idx_ << "__" << host << "_"
      << port_;
   registered_path_ = os.str();
-  return WriteStringToFile(registered_path_, "", 0);
+  ET_RETURN_IF_ERROR(WriteStringToFile(registered_path_, "", 0));
+  if (heartbeat_ms > 0 && !heartbeat_.joinable()) {
+    heartbeat_ = std::thread([this, heartbeat_ms] {
+      std::unique_lock<std::mutex> lk(hb_mu_);
+      while (!hb_cv_.wait_for(lk, std::chrono::milliseconds(heartbeat_ms),
+                              [this] { return stopping_.load(); })) {
+        // re-touch: monitors treat a fresh mtime as "alive" (ephemeral
+        // ZK-node semantics on plain files)
+        WriteStringToFile(registered_path_, "", 0);
+      }
+    });
+  }
+  return Status::OK();
 }
 
 void GraphServer::AcceptLoop() {
@@ -268,6 +294,7 @@ void GraphServer::HandleConnection(int fd) {
       m.shard_num = shard_num_;
       m.partition_num = partition_num_;
       m.node_type_wsum = graph_->node_type_weight_sums();
+      m.graph_label_count = graph_->graph_label_count();
       m.edge_type_wsum = graph_->edge_type_weight_sums();
       m.graph_meta = graph_->meta();
       EncodeShardMeta(m, &w);
@@ -422,7 +449,88 @@ Status ScanRegistry(const std::string& registry_dir,
   ::closedir(d);
   return Status::OK();
 }
+// Like ScanRegistry but also reports each entry's mtime in ms-since-epoch
+// (for staleness checks against heartbeats).
+Status ScanRegistryWithTimes(
+    const std::string& registry_dir,
+    std::map<int, std::pair<std::string, int>>* found,
+    std::map<int, int64_t>* mtimes) {
+  ET_RETURN_IF_ERROR(ScanRegistry(registry_dir, found));
+  for (const auto& kv : *found) {
+    std::ostringstream os;
+    os << registry_dir << "/shard_" << kv.first << "__" << kv.second.first
+       << "_" << kv.second.second;
+    struct stat st;
+    (*mtimes)[kv.first] =
+        ::stat(os.str().c_str(), &st) == 0
+            ? static_cast<int64_t>(st.st_mtime) * 1000
+            : 0;
+  }
+  return Status::OK();
+}
 }  // namespace
+
+// ---------------------------------------------------------------------------
+// ServerMonitor
+// ---------------------------------------------------------------------------
+ServerMonitor::ServerMonitor(std::string registry_dir, int interval_ms,
+                             int stale_ms)
+    : dir_(std::move(registry_dir)),
+      interval_ms_(interval_ms),
+      stale_ms_(stale_ms) {}
+
+ServerMonitor::~ServerMonitor() { Stop(); }
+
+void ServerMonitor::Start(Callback cb) {
+  cb_ = std::move(cb);
+  thread_ = std::thread([this] { Loop(); });
+}
+
+void ServerMonitor::Stop() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stopping_) return;
+    stopping_ = true;
+  }
+  cv_.notify_all();
+  if (thread_.joinable()) thread_.join();
+}
+
+void ServerMonitor::Loop() {
+  for (;;) {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      if (cv_.wait_for(lk, std::chrono::milliseconds(interval_ms_),
+                       [this] { return stopping_; }))
+        return;
+    }
+    std::map<int, std::pair<std::string, int>> found;
+    std::map<int, int64_t> mtimes;
+    if (!ScanRegistryWithTimes(dir_, &found, &mtimes).ok()) continue;
+    int64_t now = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      std::chrono::system_clock::now().time_since_epoch())
+                      .count();
+    // stale registrations count as down (heartbeat stopped)
+    for (auto it = found.begin(); it != found.end();) {
+      int64_t age = now - mtimes[it->first];
+      if (stale_ms_ > 0 && age > stale_ms_)
+        it = found.erase(it);
+      else
+        ++it;
+    }
+    // diff against last view → up/down callbacks
+    for (const auto& kv : found) {
+      auto prev = live_.find(kv.first);
+      if (prev == live_.end() || prev->second != kv.second)
+        cb_(kv.first, kv.second.first, kv.second.second, true);
+    }
+    for (const auto& kv : live_) {
+      if (found.find(kv.first) == found.end())
+        cb_(kv.first, kv.second.first, kv.second.second, false);
+    }
+    live_ = std::move(found);
+  }
+}
 
 Status DiscoverFromRegistry(const std::string& registry_dir, int shard_num,
                             ShardEndpoints* out) {
@@ -478,11 +586,43 @@ Status DiscoverFromSpec(const std::string& spec, ShardEndpoints* out) {
 // ---------------------------------------------------------------------------
 // ClientManager
 // ---------------------------------------------------------------------------
+ClientManager::~ClientManager() {
+  if (monitor_) monitor_->Stop();
+}
+
+std::shared_ptr<RpcChannel> ClientManager::Channel(int shard) const {
+  std::lock_guard<std::mutex> lk(chan_mu_);
+  return channels_[shard];
+}
+
+void ClientManager::WatchRegistry(const std::string& dir, int interval_ms,
+                                  int stale_ms) {
+  monitor_ = std::make_unique<ServerMonitor>(dir, interval_ms, stale_ms);
+  monitor_->Start([this](int shard, const std::string& host, int port,
+                         bool up) {
+    if (shard < 0 || shard >= shard_num()) return;
+    if (up) {
+      std::lock_guard<std::mutex> lk(chan_mu_);
+      if (channels_[shard]->host() != host ||
+          channels_[shard]->port() != port) {
+        ET_LOG_INFO << "shard " << shard << " re-resolved to " << host
+                    << ":" << port;
+        channels_[shard] = std::make_shared<RpcChannel>(host, port);
+      }
+    } else {
+      ET_LOG_INFO << "shard " << shard << " registration lost (" << host
+                  << ":" << port << ")";
+      // keep the channel: in-flight calls fail+retry and recover when the
+      // shard re-registers (the up path swaps in the new endpoint)
+    }
+  });
+}
+
 Status ClientManager::Init(const ShardEndpoints& eps) {
   channels_.clear();
   metas_.clear();
   for (const auto& ep : eps.endpoints)
-    channels_.push_back(std::make_unique<RpcChannel>(ep.first, ep.second));
+    channels_.push_back(std::make_shared<RpcChannel>(ep.first, ep.second));
   metas_.resize(channels_.size());
   for (size_t s = 0; s < channels_.size(); ++s) {
     std::vector<char> body, reply;
@@ -506,6 +646,10 @@ float ClientManager::NodeWeight(int shard, int type) const {
   return s;
 }
 
+float ClientManager::GraphLabelWeight(int shard) const {
+  return static_cast<float>(metas_[shard].graph_label_count);
+}
+
 float ClientManager::EdgeWeight(int shard, int type) const {
   const auto& w = metas_[shard].edge_type_wsum;
   if (type >= 0)
@@ -522,7 +666,8 @@ Status ClientManager::Execute(int shard, const ExecuteRequest& req,
   ByteWriter w;
   EncodeExecuteRequest(req, &w);
   std::vector<char> reply;
-  ET_RETURN_IF_ERROR(channels_[shard]->Call(kExecute, w.buffer(), &reply));
+  // snapshot: the monitor may swap the channel concurrently
+  ET_RETURN_IF_ERROR(Channel(shard)->Call(kExecute, w.buffer(), &reply));
   ByteReader r(reply.data(), reply.size());
   ET_RETURN_IF_ERROR(DecodeExecuteReply(&r, rep));
   return rep->status;

@@ -20,7 +20,9 @@
 #define EULER_TPU_RPC_H_
 
 #include <atomic>
+#include <condition_variable>
 #include <functional>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -45,6 +47,7 @@ struct ShardMeta {
   int partition_num = 1;
   std::vector<float> node_type_wsum;  // per node type
   std::vector<float> edge_type_wsum;  // per edge type
+  uint64_t graph_label_count = 0;     // whole-graph labels on this shard
   GraphMeta graph_meta;
 };
 
@@ -66,8 +69,13 @@ class GraphServer {
   void Stop();
   int port() const { return port_; }
 
-  // Register under registry_dir as shard_<i>__<host>_<port>; empty → skip.
-  Status Register(const std::string& registry_dir, const std::string& host);
+  // Register under registry_dir as shard_<i>__<host>_<port> and start a
+  // heartbeat thread that re-touches the file every heartbeat_ms — the
+  // ephemeral-node semantics of the reference's ZK registration
+  // (zk_server_register.cc): a crashed server's entry goes stale and
+  // monitors mark the shard down. heartbeat_ms <= 0 disables (tests).
+  Status Register(const std::string& registry_dir, const std::string& host,
+                  int heartbeat_ms = 2000);
 
  private:
   struct Conn {
@@ -91,6 +99,9 @@ class GraphServer {
   std::vector<Conn> conns_;
   std::vector<int> conn_fds_;  // open connection sockets (for Stop)
   std::string registered_path_;
+  std::thread heartbeat_;
+  std::mutex hb_mu_;
+  std::condition_variable hb_cv_;
 };
 
 // ---------------------------------------------------------------------------
@@ -138,12 +149,53 @@ Status DiscoverFromRegistryAuto(const std::string& registry_dir,
                                 ShardEndpoints* out);
 Status DiscoverFromSpec(const std::string& spec, ShardEndpoints* out);
 
+// Live registry watcher — the role of the reference's ZK server monitor
+// (zk_server_monitor.cc, ShardCallback server_monitor.h:33-40): rescans
+// the registry every interval_ms and fires the callback when a shard
+// endpoint appears, changes, or goes stale (file mtime older than
+// stale_ms — the heartbeat stopped) / disappears.
+class ServerMonitor {
+ public:
+  // up=true: shard registered (or re-registered at a new endpoint).
+  // up=false: shard's registration vanished or went stale.
+  using Callback = std::function<void(int shard, const std::string& host,
+                                      int port, bool up)>;
+
+  ServerMonitor(std::string registry_dir, int interval_ms = 1000,
+                int stale_ms = 6000);
+  ~ServerMonitor();
+
+  void Start(Callback cb);
+  void Stop();
+
+ private:
+  void Loop();
+
+  std::string dir_;
+  int interval_ms_, stale_ms_;
+  Callback cb_;
+  std::map<int, std::pair<std::string, int>> live_;
+  std::thread thread_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool stopping_ = false;
+};
+
 // Per-shard channel table + aggregated shard weights. Parity: reference
 // ClientManager (client_manager.h:31) + QueryProxy's weight matrices.
 class ClientManager {
  public:
+  ~ClientManager();
+
   // Connects to every shard, fetches ShardMeta from each, aggregates.
   Status Init(const ShardEndpoints& eps);
+
+  // Live membership: watch the registry; when a shard re-registers at a
+  // new endpoint (server restart), swap its channel so subsequent calls
+  // reach the new server — the reference's ZK add/remove callback path
+  // re-resolving RpcManager channels. Safe to call after Init.
+  void WatchRegistry(const std::string& dir, int interval_ms = 1000,
+                     int stale_ms = 6000);
 
   int shard_num() const { return static_cast<int>(channels_.size()); }
   int partition_num() const { return partition_num_; }
@@ -152,6 +204,8 @@ class ClientManager {
   // Per-shard weight sums; type < 0 → total over types.
   float NodeWeight(int shard, int type) const;
   float EdgeWeight(int shard, int type) const;
+  // Whole-graph label count (graph_partition proportional sampling).
+  float GraphLabelWeight(int shard) const;
 
   // Blocking execute on one shard.
   Status Execute(int shard, const ExecuteRequest& req, ExecuteReply* rep);
@@ -160,10 +214,14 @@ class ClientManager {
                     std::function<void(Status, ExecuteReply)> done);
 
  private:
-  std::vector<std::unique_ptr<RpcChannel>> channels_;
+  std::shared_ptr<RpcChannel> Channel(int shard) const;
+
+  mutable std::mutex chan_mu_;  // guards channels_ swaps from the monitor
+  std::vector<std::shared_ptr<RpcChannel>> channels_;
   std::vector<ShardMeta> metas_;
   GraphMeta graph_meta_;
   int partition_num_ = 1;
+  std::unique_ptr<ServerMonitor> monitor_;
 };
 
 }  // namespace et

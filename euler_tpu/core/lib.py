@@ -32,12 +32,25 @@ def _build() -> None:
         )
 
 
+def _stale() -> bool:
+    """True when any cc/ source is newer than the built .so."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    so_mtime = os.path.getmtime(_LIB_PATH)
+    cc = os.path.join(_HERE, "cc")
+    for name in os.listdir(cc):
+        if name.endswith((".cc", ".h")) or name == "Makefile":
+            if os.path.getmtime(os.path.join(cc, name)) > so_mtime:
+                return True
+    return False
+
+
 def load() -> ctypes.CDLL:
     """Load (building if necessary) the native engine library."""
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH):
+    if _stale():
         _build()
     lib = ctypes.CDLL(_LIB_PATH)
     _declare(lib)
@@ -75,7 +88,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etg_builder_set_edge_sparse": (i32, [i64, u64, u64, i32, i32, c_u64p, i64]),
         "etg_builder_finalize": (i64, [i64, i32]),
         "etg_load": (i64, [ctypes.c_char_p, i32, i32, i32, i32]),
-        "etg_dump": (i32, [i64, ctypes.c_char_p, i32]),
+        "etg_dump": (i32, [i64, ctypes.c_char_p, i32, i32]),
         "etg_free": (i32, [i64]),
         "etg_node_count": (i64, [i64]),
         "etg_edge_count": (i64, [i64]),
@@ -86,6 +99,10 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etg_feature_info": (i32, [i64, i32, i32, c_i32p, c_i64p, ctypes.c_char_p, i64]),
         "etg_all_node_ids": (i32, [i64, c_u64p]),
         "etg_node_rows": (i32, [i64, c_u64p, i64, i32, c_i32p]),
+        "etg_builder_set_graph_labels": (i32, [i64, c_u64p, c_u64p, i64]),
+        "etg_graph_label_count": (i64, [i64]),
+        "etg_sample_graph_label": (i32, [i64, i64, c_u64p]),
+        "etg_get_graph_by_label": (i32, [i64, c_u64p, i64, c_voidp]),
         "etg_node_weight_sums": (i32, [i64, c_f32p]),
         "etg_edge_weight_sums": (i32, [i64, c_f32p]),
         "etg_sample_node": (i32, [i64, i32, i64, c_u64p]),
@@ -119,8 +136,9 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etg_get_edge_binary_feature": (i32, [i64, c_u64p, c_u64p, c_i32p, i64, i32, c_voidp]),
         # query layer (gremlin → DAG → executor; local or distributed)
         "etq_new_local": (i64, [i64, ctypes.c_char_p, u64]),
-        "etq_new_remote": (i64, [ctypes.c_char_p, u64]),
+        "etq_new_remote": (i64, [ctypes.c_char_p, u64, ctypes.c_char_p]),
         "etq_free": (i32, [i64]),
+        "etq_stats": (i32, [i64, c_u64p]),
         "etq_exec_new": (i64, [i64]),
         "etq_exec_add_input": (i32, [i64, ctypes.c_char_p, i32, i32, c_i64p, c_voidp]),
         "etq_exec_run": (i32, [i64, ctypes.c_char_p]),

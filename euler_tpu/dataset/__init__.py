@@ -36,6 +36,10 @@ _REGISTRY["mutag"] = mutag_like
 for _kg in ("fb15k", "fb15k237", "wn18"):
     _REGISTRY[_kg] = partial(load_kg, _kg)
 
+from euler_tpu.dataset.ml_1m import ml_1m  # noqa: E402,F401
+
+_REGISTRY["ml_1m"] = ml_1m
+
 
 def get_dataset(name: str, **overrides):
     name = name.lower()

@@ -17,9 +17,9 @@ framed-TCP RPC), transparently to the caller::
     q = Query.remote("hosts:127.0.0.1:9190,127.0.0.1:9191")
 
 Supported chain calls (see euler_tpu/core/cc/gql.h for the grammar):
-v, e, sampleN, sampleE, sampleNWithTypes, sampleNB, sampleLNB, getNB/outV,
-getRNB/inV, getSortedNB, getTopKNB, values, udf, label, has, hasLabel,
-hasKey, hasId, orderBy, limit, as.
+v, e, gl, sampleN, sampleE, sampleNWithTypes, sampleGL, graphNodes,
+sampleNB, sampleLNB, getNB/outV, getRNB/inV, getSortedNB, getTopKNB,
+values, udf, label, has, hasLabel, hasKey, hasId, orderBy, limit, as.
 """
 
 from __future__ import annotations
@@ -67,10 +67,11 @@ class Query:
         return cls(lib, h)
 
     @classmethod
-    def remote(cls, endpoints: str, seed: int = 0) -> "Query":
+    def remote(cls, endpoints: str, seed: int = 0,
+               mode: str = "distribute") -> "Query":
         """Distribute mode. endpoints: "hosts:h:p,h:p" or "dir:/registry"."""
         lib = _libmod.load()
-        h = lib.etq_new_remote(endpoints.encode(), seed)
+        h = lib.etq_new_remote(endpoints.encode(), seed, mode.encode())
         if h == 0:
             raise EngineError(lib.etg_last_error().decode())
         return cls(lib, h)
@@ -121,6 +122,17 @@ class Query:
             return out
         finally:
             lib.etq_exec_free(eh)
+
+    def stats(self) -> dict:
+        """Per-proxy query counters: queries, errors, total_us, last_us
+        (aux parity: engine-side query timing)."""
+        import numpy as np
+
+        out = np.zeros(4, dtype=np.uint64)
+        check(self._lib, self._lib.etq_stats(
+            self._h, out.ctypes.data_as(_libmod.c_u64p)))
+        return {"queries": int(out[0]), "errors": int(out[1]),
+                "total_us": int(out[2]), "last_us": int(out[3])}
 
     def close(self) -> None:
         if self._h:

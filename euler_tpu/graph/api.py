@@ -199,6 +199,16 @@ class GraphBuilder:
         )
         return self
 
+    def set_graph_labels(self, ids, labels) -> None:
+        """Assign nodes to whole-graph labels (graph classification;
+        reference graph_label batching). Label 0 = unlabeled."""
+        ids = _u64(ids).ravel()
+        labels = _u64(labels).ravel()
+        _libmod.check(
+            self._lib,
+            self._lib.etg_builder_set_graph_labels(
+                self.h, _ptr(ids, c_u64p), _ptr(labels, c_u64p), ids.size))
+
     def finalize(self, build_in_adjacency: bool = True) -> "GraphEngine":
         gh = self._lib.etg_builder_finalize(self.h, 1 if build_in_adjacency else 0)
         if gh < 0:
@@ -228,12 +238,17 @@ class GraphEngine:
             raise EngineError(lib.etg_last_error().decode())
         return cls(h)
 
-    def dump(self, directory: str, num_partitions: int = 1) -> None:
-        import os
+    def dump(self, directory: str, num_partitions: int = 1,
+             by_graph: bool = False) -> None:
+        """by_graph=True partitions by graph label (whole graphs stay on
+        one shard — the graph_partition serving mode)."""
+        if "://" not in directory:  # remote urls (hdfs://) manage dirs
+            import os
 
-        os.makedirs(directory, exist_ok=True)
+            os.makedirs(directory, exist_ok=True)
         _libmod.check(self._lib, self._lib.etg_dump(self.h, directory.encode(),
-                                                    num_partitions))
+                                                    num_partitions,
+                                                    1 if by_graph else 0))
 
     def close(self) -> None:
         if self.h is not None:
@@ -410,6 +425,29 @@ class GraphEngine:
                     1 if sorted_by_id else 0, 1 if in_edges else 0, res.h),
             )
             return res.offsets(), res.u64(), res.f32(), res.i32()
+
+    @property
+    def graph_label_count(self) -> int:
+        return int(self._lib.etg_graph_label_count(self.h))
+
+    def sample_graph_label(self, count: int) -> np.ndarray:
+        """Uniform sample of whole-graph labels (reference
+        SampleGraphLabel)."""
+        out = np.zeros(count, dtype=np.uint64)
+        _libmod.check(self._lib, self._lib.etg_sample_graph_label(
+            self.h, count, _ptr(out, c_u64p)))
+        return out
+
+    def get_graph_by_label(self, labels):
+        """(offsets[n+1], node_ids) CSR: the nodes of each labeled graph
+        (reference GetGraphByLabel)."""
+        labels = _u64(labels).ravel()
+        with _Result(self._lib) as res:
+            _libmod.check(
+                self._lib,
+                self._lib.etg_get_graph_by_label(
+                    self.h, _ptr(labels, c_u64p), labels.size, res.h))
+            return res.offsets(), res.u64()
 
     def sample_fanout(self, roots, counts: Sequence[int], edge_types=None,
                       default_id: int = 0):

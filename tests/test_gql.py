@@ -333,3 +333,181 @@ def test_single_shard_remote(ring_graph, tmp_path):
     finally:
         q.close()
         s.stop()
+
+
+def test_registry_discovery_and_failover(ring_graph, tmp_path):
+    """Registry-dir discovery (ZK parity): clients resolve shards from the
+    registry, and a shard that restarts on a NEW port is picked up live by
+    the watch without re-initializing the proxy."""
+    import time
+
+    data_dir = str(tmp_path / "g")
+    reg_dir = str(tmp_path / "reg")
+    import os
+    os.makedirs(reg_dir)
+    ring_graph.dump(data_dir, num_partitions=2)
+    servers = [
+        start_service(data_dir, shard_idx=i, shard_num=2, port=0,
+                      registry_dir=reg_dir)
+        for i in range(2)
+    ]
+    q = Query.remote(f"dir:{reg_dir}")
+    try:
+        out = q.run("v(roots).getNB(0).as(nb)",
+                    {"roots": np.array([4], dtype=np.uint64)})
+        assert list(out["nb:1"]) == [5]
+
+        # restart shard 0 on a fresh port; the monitor re-resolves it
+        servers[0].stop()
+        servers[0] = start_service(data_dir, shard_idx=0, shard_num=2,
+                                   port=0, registry_dir=reg_dir)
+        deadline = time.time() + 10
+        while True:
+            try:
+                out = q.run("v(roots).getNB(0).as(nb)",
+                            {"roots": np.array([4, 9], dtype=np.uint64)})
+                if list(out["nb:1"]) == [5, 10]:
+                    break
+            except Exception:
+                pass
+            assert time.time() < deadline, "failover did not converge"
+            time.sleep(0.5)
+    finally:
+        q.close()
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# graph_partition mode (whole-graph classification serving)
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def labeled_graph():
+    """4 small ring graphs (labels 100,200,300,400), 3 nodes each, with a
+    1-dim dense feature = node id."""
+    from euler_tpu.graph import GraphBuilder, seed
+
+    seed(5)
+    b = GraphBuilder()
+    b.set_num_types(1, 1)
+    b.set_feature(0, 0, 1, "f")
+    ids = np.arange(1, 13, dtype=np.uint64)
+    b.add_nodes(ids)
+    # ring edges within each graph of 3
+    src, dst = [], []
+    for g0 in range(0, 12, 3):
+        trio = ids[g0:g0 + 3]
+        src.extend(trio)
+        dst.extend(np.roll(trio, -1))
+    b.add_edges(np.array(src, dtype=np.uint64), np.array(dst, dtype=np.uint64))
+    b.set_graph_labels(ids, np.repeat([100, 201, 302, 403], 3))
+    b.set_node_dense(ids, 0, ids.astype(np.float32).reshape(12, 1))
+    return b.finalize()
+
+
+def test_graph_labels_local(labeled_graph):
+    g = labeled_graph
+    assert g.graph_label_count == 4
+    offs, nodes = g.get_graph_by_label(np.array([201, 999], dtype=np.uint64))
+    assert list(offs) == [0, 3, 3]
+    assert set(nodes) == {4, 5, 6}
+
+
+@pytest.fixture
+def gp_cluster(labeled_graph, tmp_path):
+    data_dir = str(tmp_path / "gp")
+    labeled_graph.dump(data_dir, num_partitions=2, by_graph=True)
+    servers = [
+        start_service(data_dir, shard_idx=i, shard_num=2, port=0)
+        for i in range(2)
+    ]
+    eps = ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    q = Query.remote(f"hosts:{eps}", seed=17, mode="graph_partition")
+    yield q, servers
+    q.close()
+    for s in servers:
+        s.stop()
+
+
+def test_gp_sample_graph_label(gp_cluster):
+    q, _ = gp_cluster
+    out = q.run("sampleGL(64).as(l)")
+    labels = out["l:0"]
+    assert labels.shape == (64,)
+    assert set(labels) <= {100, 201, 302, 403}
+    assert len(set(labels)) >= 3  # all shards contribute
+
+
+def test_gp_graph_nodes(gp_cluster):
+    q, _ = gp_cluster
+    out = q.run("gl(labels).graphNodes().as(gn)",
+                {"labels": np.array([302, 100, 999], dtype=np.uint64)})
+    idx = out["gn:1"].reshape(3, 2)
+    ids = out["gn:2"]
+    got = [set(ids[b:e]) for b, e in idx]
+    assert got == [{7, 8, 9}, {1, 2, 3}, set()]
+
+
+def test_gp_values_and_label(gp_cluster, labeled_graph):
+    q, _ = gp_cluster
+    roots = np.array([5, 11, 2, 999], dtype=np.uint64)
+    out = q.run("v(roots).values(f).as(p)", {"roots": roots})
+    idx = out["p:0"].reshape(4, 2)
+    vals = out["p:1"]
+    got = [list(vals[b:e]) for b, e in idx]
+    assert got == [[5.0], [11.0], [2.0], []]  # unknown id → empty row
+
+    out = q.run("v(roots).label().as(t)", {"roots": roots})
+    assert list(out["t:0"]) == [0, 0, 0, -1]
+
+
+def test_gp_neighbors(gp_cluster):
+    q, _ = gp_cluster
+    roots = np.array([4, 10, 1], dtype=np.uint64)
+    out = q.run("v(roots).getNB(-1).as(nb)", {"roots": roots})
+    idx = out["nb:0"].reshape(3, 2)
+    ids = out["nb:1"]
+    got = [list(ids[b:e]) for b, e in idx]
+    assert got == [[5], [11], [2]]
+
+    out = q.run("v(roots).sampleNB(-1, 4, 0).as(s)", {"roots": roots})
+    nb = out["s:1"].reshape(3, 4)
+    assert set(nb[0]) == {5} and set(nb[1]) == {11} and set(nb[2]) == {2}
+
+
+def test_gp_has_filter(gp_cluster):
+    q, _ = gp_cluster
+    roots = np.array([4, 4, 9, 999], dtype=np.uint64)
+    out = q.run("v(roots).as(kept)", {"roots": roots})
+    # plain v().as just aliases; use label() path above for coverage
+    out = q.run("v(roots).has(id in 9:4).as(kept)", {"roots": roots})
+    assert list(out["kept:0"]) == [4, 4, 9]
+    assert list(out["kept:1"]) == [0, 1, 2]
+
+
+def test_graph_label_ops_in_distribute_mode(labeled_graph, tmp_path):
+    """sampleGL/graphNodes must also work against a hash-sharded cluster
+    (graph members scatter across shards → per-position concat merge);
+    this once dereferenced a null local graph on the client."""
+    data_dir = str(tmp_path / "dg")
+    labeled_graph.dump(data_dir, num_partitions=2)  # hash partitioning
+    servers = [
+        start_service(data_dir, shard_idx=i, shard_num=2, port=0)
+        for i in range(2)
+    ]
+    eps = ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    q = Query.remote(f"hosts:{eps}", seed=9)
+    try:
+        out = q.run("sampleGL(32).as(l)")
+        assert set(out["l:0"]) <= {100, 201, 302, 403}
+        out = q.run("gl(labels).graphNodes().as(gn)",
+                    {"labels": np.array([201, 999, 100], dtype=np.uint64)})
+        idx = out["gn:1"].reshape(3, 2)
+        ids = out["gn:2"]
+        got = [set(ids[b:e]) for b, e in idx]
+        # label members are reassembled across both hash shards
+        assert got == [{4, 5, 6}, set(), {1, 2, 3}]
+    finally:
+        q.close()
+        for s in servers:
+            s.stop()
