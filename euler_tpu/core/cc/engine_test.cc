@@ -1,0 +1,275 @@
+// Native engine self-test binary — assert-style unit tests over the C++
+// core, runnable standalone and under sanitizers:
+//
+//   make test        # build + run (O2)
+//   make asan        # AddressSanitizer build + run
+//   make tsan        # ThreadSanitizer build + run (race detection — the
+//                    # CI the reference lacked, SURVEY.md §5)
+//
+// Mirrors the reference's gtest tiers (SURVEY.md §4): common (samplers,
+// threadpool, rng), graph store, serde, executor, index, compiler.
+#include <atomic>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "dag.h"
+#include "gql.h"
+#include "graph.h"
+#include "index.h"
+#include "io.h"
+#include "sampling.h"
+#include "serde.h"
+#include "tensor.h"
+#include "threadpool.h"
+
+namespace et {
+namespace {
+
+int g_failures = 0;
+
+#define CHECK_TRUE(cond)                                              \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,    \
+                   #cond);                                            \
+      ++g_failures;                                                   \
+    }                                                                 \
+  } while (0)
+
+#define CHECK_OK(expr)                                                \
+  do {                                                                \
+    ::et::Status _s = (expr);                                         \
+    if (!_s.ok()) {                                                   \
+      std::fprintf(stderr, "FAIL %s:%d: %s -> %s\n", __FILE__,        \
+                   __LINE__, #expr, _s.message().c_str());            \
+      ++g_failures;                                                   \
+    }                                                                 \
+  } while (0)
+
+// ---- common: rng, samplers, threadpool ----
+void TestPcg32Determinism() {
+  Pcg32 a(42, 1), b(42, 1), c(43, 1);
+  bool same = true, diff = false;
+  for (int i = 0; i < 100; ++i) {
+    uint32_t x = a.NextU32(), y = b.NextU32(), z = c.NextU32();
+    same &= (x == y);
+    diff |= (x != z);
+  }
+  CHECK_TRUE(same);
+  CHECK_TRUE(diff);
+}
+
+void TestAliasSamplerStatistics() {
+  // weights 1,2,3,4 → frequencies ∝ weight (statistical test like the
+  // reference's fast_weighted_collection_test.cc)
+  std::vector<float> w{1, 2, 3, 4};
+  AliasSampler s;
+  s.Init(w);
+  Pcg32 rng(7);
+  std::vector<int> counts(4, 0);
+  const int N = 200000;
+  for (int i = 0; i < N; ++i) counts[s.Sample(&rng)]++;
+  for (int i = 0; i < 4; ++i) {
+    double expect = N * w[i] / 10.0;
+    CHECK_TRUE(std::fabs(counts[i] - expect) < 5 * std::sqrt(expect));
+  }
+}
+
+void TestParallelForCoversAll() {
+  std::vector<std::atomic<int>> hits(10000);
+  ParallelFor(GlobalThreadPool(), 10000, 64,
+              [&](int64_t b, int64_t e, int) {
+                for (int64_t i = b; i < e; ++i) hits[i].fetch_add(1);
+              });
+  for (auto& h : hits) CHECK_TRUE(h.load() == 1);
+}
+
+void TestThreadPoolStress() {
+  // many tiny tasks racing on an atomic — trips TSAN if the queue or
+  // latch were racy
+  std::atomic<int64_t> sum{0};
+  ThreadPool pool(8);
+  std::atomic<int> remaining{10000};
+  std::mutex mu;
+  std::condition_variable cv;
+  for (int i = 0; i < 10000; ++i) {
+    pool.Schedule([&, i] {
+      sum.fetch_add(i);
+      if (remaining.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lk(mu);
+        cv.notify_one();
+      }
+    });
+  }
+  std::unique_lock<std::mutex> lk(mu);
+  cv.wait(lk, [&] { return remaining.load() == 0; });
+  CHECK_TRUE(sum.load() == 10000LL * 9999 / 2);
+}
+
+// ---- graph store ----
+std::unique_ptr<Graph> RingGraph() {
+  GraphBuilder b;
+  for (uint64_t i = 1; i <= 10; ++i)
+    b.AddNode(i, static_cast<int32_t>(i % 2), static_cast<float>(i));
+  for (uint64_t i = 1; i <= 10; ++i)
+    b.AddEdge(i, i % 10 + 1, 0, 1.0f);
+  b.mutable_meta()->node_features.push_back(
+      {"f", FeatureKind::kDense, 2});
+  for (uint64_t i = 1; i <= 10; ++i) {
+    float v[2] = {static_cast<float>(i), -static_cast<float>(i)};
+    b.SetNodeDense(i, 0, v, 2);
+  }
+  return b.Finalize();
+}
+
+void TestGraphStore() {
+  auto g = RingGraph();
+  CHECK_TRUE(g->node_count() == 10);
+  CHECK_TRUE(g->edge_count() == 10);
+  Pcg32 rng(1);
+  NodeId nb;
+  float w;
+  int32_t t;
+  g->SampleNeighbor(4, nullptr, 0, 1, 0, &rng, &nb, &w, &t);
+  CHECK_TRUE(nb == 5);
+  float f[2];
+  NodeId id = 7;
+  g->GetDenseFeature(&id, 1, 0, 2, f);
+  CHECK_TRUE(f[0] == 7.0f && f[1] == -7.0f);
+  // unknown id zero-fills
+  id = 999;
+  g->GetDenseFeature(&id, 1, 0, 2, f);
+  CHECK_TRUE(f[0] == 0.0f && f[1] == 0.0f);
+}
+
+void TestConcurrentSampling() {
+  // immutable graph + per-thread rngs: concurrent readers must be clean
+  // under TSAN
+  auto g = RingGraph();
+  ThreadPool pool(8);
+  std::atomic<int> remaining{64};
+  std::atomic<bool> ok{true};
+  std::mutex mu;
+  std::condition_variable cv;
+  for (int t0 = 0; t0 < 64; ++t0) {
+    pool.Schedule([&, t0] {
+      Pcg32 rng(t0);
+      NodeId out[8];
+      g->SampleNode(-1, 8, &rng, out);
+      for (NodeId id : out)
+        if (id < 1 || id > 10) ok.store(false);
+      if (remaining.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lk(mu);
+        cv.notify_one();
+      }
+    });
+  }
+  std::unique_lock<std::mutex> lk(mu);
+  cv.wait(lk, [&] { return remaining.load() == 0; });
+  CHECK_TRUE(ok.load());
+}
+
+// ---- serde ----
+void TestTensorSerde() {
+  Tensor t(DType::kF32, {2, 3});
+  for (int i = 0; i < 6; ++i) t.Flat<float>()[i] = i * 1.5f;
+  ByteWriter w;
+  EncodeTensor(t, &w);
+  ByteReader r(w.buffer().data(), w.buffer().size());
+  Tensor back;
+  CHECK_OK(DecodeTensor(&r, &back));
+  CHECK_TRUE(back.dims() == t.dims());
+  CHECK_TRUE(std::memcmp(back.raw(), t.raw(), t.ByteSize()) == 0);
+
+  // corrupt header must be rejected, not crash
+  std::vector<char> evil(w.buffer());
+  int64_t huge = 1LL << 50;
+  std::memcpy(evil.data() + 8, &huge, 8);
+  ByteReader r2(evil.data(), evil.size());
+  Tensor bad;
+  CHECK_TRUE(!DecodeTensor(&r2, &bad).ok());
+}
+
+// ---- executor ----
+void TestExecutorRunsDag() {
+  // AS chain through the executor against a real graph
+  auto g = RingGraph();
+  CompileOptions opts;
+  opts.mode = "local";
+  GqlCompiler compiler(opts);
+  std::shared_ptr<const TranslateResult> plan;
+  CHECK_OK(compiler.Compile("v(roots).getNB(*).as(nb)", &plan));
+  OpKernelContext ctx;
+  Tensor roots(DType::kU64, {2});
+  roots.Flat<uint64_t>()[0] = 3;
+  roots.Flat<uint64_t>()[1] = 9;
+  ctx.Put("roots", std::move(roots));
+  QueryEnv env;
+  env.graph = g.get();
+  Executor exec(&plan->dag, env, &ctx);
+  CHECK_OK(exec.RunSync());
+  Tensor out;
+  CHECK_TRUE(ctx.Get("nb:1", &out));
+  CHECK_TRUE(out.NumElements() == 2);
+  CHECK_TRUE(out.Flat<uint64_t>()[0] == 4);
+  CHECK_TRUE(out.Flat<uint64_t>()[1] == 10);
+}
+
+// ---- index ----
+void TestIndexDnf() {
+  auto g = RingGraph();
+  IndexManager idx;
+  CHECK_OK(idx.BuildFromSpec(*g, "f:range_index"));
+  IndexResult res;
+  CHECK_OK(idx.EvalDnf(g.get(), {{"f gt 8"}}, &res));
+  CHECK_TRUE(res.rows.size() == 2);  // f = 9, 10
+  // id membership keeps (row, weight) pairing even out of order
+  IndexResult r2;
+  CHECK_OK(idx.EvalDnf(g.get(), {{"id in 9:2"}}, &r2));
+  CHECK_TRUE(r2.rows.size() == 2);
+  std::map<uint32_t, float> got;
+  for (size_t i = 0; i < r2.rows.size(); ++i) got[r2.rows[i]] = r2.weights[i];
+  CHECK_TRUE(got[g->NodeIndex(9)] == 9.0f);
+  CHECK_TRUE(got[g->NodeIndex(2)] == 2.0f);
+}
+
+// ---- dump/load ----
+void TestDumpLoadRoundtrip() {
+  auto g = RingGraph();
+  std::string dir = "/tmp/et_engine_test_dump";
+  std::string cmd = "mkdir -p " + dir;
+  CHECK_TRUE(std::system(cmd.c_str()) == 0);
+  CHECK_OK(DumpGraphPartitioned(*g, dir, 2));
+  std::unique_ptr<Graph> back;
+  CHECK_OK(LoadShard(dir, 0, 1, 0, true, &back));
+  CHECK_TRUE(back->node_count() == 10);
+  CHECK_TRUE(back->edge_count() == 10);
+}
+
+}  // namespace
+}  // namespace et
+
+int main() {
+  et::MinLogLevel() = 2;  // quiet
+  et::TestPcg32Determinism();
+  et::TestAliasSamplerStatistics();
+  et::TestParallelForCoversAll();
+  et::TestThreadPoolStress();
+  et::TestGraphStore();
+  et::TestConcurrentSampling();
+  et::TestTensorSerde();
+  et::TestExecutorRunsDag();
+  et::TestIndexDnf();
+  et::TestDumpLoadRoundtrip();
+  if (et::g_failures == 0) {
+    std::printf("engine_test: ALL OK\n");
+    return 0;
+  }
+  std::fprintf(stderr, "engine_test: %d failures\n", et::g_failures);
+  return 1;
+}

@@ -15,3 +15,7 @@ from euler_tpu.dataflow.base_dataflow import (  # noqa: F401
 SageDataFlow = FanoutDataFlow
 NeighborDataFlow = FanoutDataFlow
 GCNDataFlow = WholeDataFlow
+# UniqueDataFlow's dedup-per-hop geometry is WholeDataFlow's unique node
+# table + edge_index; LayerwiseEach shares LayerwiseDataFlow's sampler.
+UniqueDataFlow = WholeDataFlow
+LayerwiseEachDataFlow = LayerwiseDataFlow

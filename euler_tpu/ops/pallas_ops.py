@@ -1,0 +1,114 @@
+"""Pallas TPU kernels for the hot host-feeder ops.
+
+The fanout training path gathers each output row's k neighbor feature
+rows from the HBM-resident table and mean-reduces them:
+    out[i] = mean_j table[rows[i, j]]      # rows: [n, k] int32
+XLA expresses this as gather → reshape → mean, materializing the
+[n·k, D] intermediate in HBM (written then re-read: 2·n·k·D·4 bytes of
+traffic). The fused kernel streams each neighbor row HBM→VMEM once and
+accumulates in VMEM, cutting HBM traffic to n·k·D·4 + n·D·4.
+
+gather_mean() defaults to the XLA formulation: on the current v5e
+bench (200k x 128 table, 16384 x 15 rows) the fused kernel is within 2x
+of XLA's gather in either direction depending on dispatch pipelining,
+with no reproducible win — XLA's TPU gather is already tight. The kernel
+stays as the opt-in (use_pallas=True) path and the template for
+neighbor-indexed fusions that XLA can't express (validated in interpret
+mode on CPU, numerics match to float tolerance).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# output rows processed per grid step: amortizes control overhead while
+# keeping k·D scratch well under VMEM
+_TILE_N = 8
+
+
+def _xla_gather_mean(table: Array, rows: Array) -> Array:
+    n, k = rows.shape
+    return jnp.take(table, rows.reshape(-1), axis=0) \
+        .reshape(n, k, table.shape[-1]).mean(axis=1)
+
+
+def _kernel(rows_ref, table_ref, out_ref, scratch, sems):
+    """One grid step: gather k rows for each of _TILE_N outputs, reduce.
+    rows_ref is this step's (_TILE_N, k) index block in SMEM. All
+    _TILE_N·k row fetches are in flight at once (start all, then wait) —
+    serializing them makes the kernel DMA-latency-bound."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    tile_n, k = rows_ref.shape
+
+    def dma_for(idx):
+        row = rows_ref[idx // k, idx % k]
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(row, 1), :],
+            scratch.at[pl.ds(idx, 1), :],
+            sems.at[idx],
+        )
+
+    def start(idx, _):
+        dma_for(idx).start()
+        return 0
+
+    def wait(idx, _):
+        dma_for(idx).wait()
+        return 0
+
+    jax.lax.fori_loop(0, tile_n * k, start, 0)
+    jax.lax.fori_loop(0, tile_n * k, wait, 0)
+    d = scratch.shape[-1]
+    out_ref[:, :] = jnp.mean(scratch[:, :].reshape(tile_n, k, d), axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pallas_gather_mean(table: Array, rows: Array,
+                        interpret: bool = False) -> Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, k = rows.shape
+    d = table.shape[-1]
+    assert n % _TILE_N == 0
+    return pl.pallas_call(
+        _kernel,
+        grid=(n // _TILE_N,),
+        in_specs=[
+            # this step's index block rides SMEM (DMA addresses are
+            # scalar reads); the table stays wherever it lives (HBM)
+            pl.BlockSpec((_TILE_N, k), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((_TILE_N, d), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((_TILE_N * k, d), table.dtype),
+            pltpu.SemaphoreType.DMA((_TILE_N * k,)),
+        ],
+        out_shape=jax.ShapeDtypeStruct((n, d), table.dtype),
+        interpret=interpret,
+    )(rows, table)
+
+
+def gather_mean(table: Array, rows: Array,
+                use_pallas: bool = False) -> Array:
+    """out[i] = mean over k of table[rows[i]]; rows [n, k] int32.
+
+    use_pallas=True runs the fused Pallas kernel on TPU when shapes allow
+    (n divisible by the row tile); default is the XLA gather+mean (see
+    module docstring for the measured tradeoff).
+    """
+    n, k = rows.shape
+    on_tpu = jax.default_backend() == "tpu"
+    if not use_pallas or not on_tpu or n % _TILE_N != 0:
+        return _xla_gather_mean(table, rows)
+    return _pallas_gather_mean(table, rows)

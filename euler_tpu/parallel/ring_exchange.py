@@ -1,0 +1,94 @@
+"""Ring all-to-all embedding exchange over ICI.
+
+The GSPMD path in sharded_embedding lets XLA choose the collective for a
+row-sharded table lookup (typically all-gather of hit rows). For very
+large tables the all-gather of a big lookup batch can spike ICI + HBM;
+the classic alternative is a ring exchange (the pattern ring attention
+uses for KV blocks, applied here to embedding rows — SURVEY.md §5's
+"optional ICI all-to-all embedding exchange"):
+
+  each device holds rows [d·R/K, (d+1)·R/K) of the table and a shard of
+  the lookup ids. In K steps, the id shard ppermutes around the ring;
+  every device answers the ids that fall in its row range, accumulating
+  into a result buffer that travels with the ids. Peak ICI traffic per
+  step is 1/K of the all-gather, and each step's sends overlap the next
+  lookup's compute.
+
+ring_lookup runs under shard_map over a 1-d mesh axis; a pure-jnp
+reference (same math, no collectives) backs the single-device path and
+the tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Array = jax.Array
+
+
+def _local_answer(table_shard: Array, ids: Array, shard_lo: Array) -> Array:
+    """Rows for ids that fall inside this shard's range, zeros elsewhere."""
+    local = ids - shard_lo
+    in_range = (local >= 0) & (local < table_shard.shape[0])
+    rows = jnp.take(table_shard, jnp.clip(local, 0, table_shard.shape[0] - 1),
+                    axis=0)
+    return jnp.where(in_range[:, None], rows, 0.0)
+
+
+def ring_lookup(table: Array, ids: Array, mesh: Mesh,
+                axis: str = "model") -> Array:
+    """Distributed embedding lookup via a K-step ppermute ring.
+
+    table: [R, D] row-sharded over `axis`; ids: [B] int32 in [0, R),
+    sharded over `axis` too (each device starts with B/K ids). Returns
+    [B, D] with the same sharding as ids.
+    """
+    k = mesh.shape[axis]
+    rows_per = table.shape[0] // k
+
+    def body(table_shard, ids_shard):
+        me = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % k) for i in range(k)]
+
+        def step(carry, _):
+            cur_ids, acc = carry
+            # answer the visiting ids that fall in this device's rows
+            shard_lo = (me * rows_per).astype(cur_ids.dtype)
+            acc = acc + _local_answer(table_shard, cur_ids, shard_lo)
+            # pass ids + partial results to the next device in the ring
+            cur_ids = jax.lax.ppermute(cur_ids, axis, perm)
+            acc = jax.lax.ppermute(acc, axis, perm)
+            return (cur_ids, acc), None
+
+        acc0 = jnp.zeros((ids_shard.shape[0], table_shard.shape[1]),
+                         table_shard.dtype)
+        if hasattr(jax.lax, "pvary"):
+            # the new shard_map tracks per-axis varyingness: the carry
+            # must enter the scan already device-varying because ppermute
+            # makes it so on the way out
+            acc0 = jax.lax.pvary(acc0, axis)
+        (_, acc), _ = jax.lax.scan(step, (ids_shard, acc0), None, length=k)
+        # after k hops every id shard (and its answers) is home again
+        return acc
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P(axis)),
+        out_specs=P(axis, None),
+    )
+    return fn(table, ids)
+
+
+def reference_lookup(table: Array, ids: Array) -> Array:
+    """Single-device equivalent: plain take (the numbers ring_lookup must
+    reproduce)."""
+    return jnp.take(table, ids, axis=0)

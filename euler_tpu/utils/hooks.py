@@ -1,0 +1,84 @@
+"""Distributed worker coordination hooks.
+
+Parity: tf_euler/python/utils/hooks.py:25 SyncExitHook — the reference's
+between-graph workers block at end-of-training until every worker arrives,
+so no PS connection drops while stragglers still need variables.
+
+TPU equivalents:
+  * under jax.distributed (multi-host), sync_exit() barriers all
+    processes via the coordination service;
+  * otherwise (or additionally, for host-side graph-service workers) a
+    file barrier over a shared directory — the same mechanism as the
+    server registry — lets heterogeneous workers rendezvous.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def sync_exit(name: str = "exit") -> None:
+    """Block until all jax processes reach this point (no-op single-host)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(f"euler_tpu_sync_{name}")
+
+
+class FileBarrier:
+    """N-party rendezvous over a shared filesystem directory.
+
+    Each worker calls wait(worker_id); returns once all num_workers have
+    arrived. Reusable across rounds via the round counter.
+
+    Marker files are namespaced by run_id — every worker of one job must
+    pass the SAME run_id (e.g. the coordinator-assigned job id), and a
+    restarted job must use a fresh one (or a fresh directory): stale
+    markers from a crashed run would otherwise satisfy the count
+    immediately. Files from two rounds back are garbage-collected (by
+    then every worker has provably passed them).
+    """
+
+    def __init__(self, directory: str, num_workers: int,
+                 run_id: str = "0", poll_ms: int = 100,
+                 timeout_s: float = 600.0):
+        self.dir = directory
+        self.num_workers = num_workers
+        self.run_id = run_id
+        self.poll_ms = poll_ms
+        self.timeout_s = timeout_s
+        self._round = 0
+        os.makedirs(directory, exist_ok=True)
+
+    def _tag(self, rnd: int) -> str:
+        return f"barrier_{self.run_id}_{rnd}_"
+
+    def wait(self, worker_id: int) -> None:
+        tag = self._tag(self._round)
+        mine = os.path.join(self.dir, f"{tag}{worker_id}")
+        with open(mine, "w"):
+            pass
+        deadline = time.time() + self.timeout_s
+        while True:
+            n = sum(1 for f in os.listdir(self.dir) if f.startswith(tag))
+            if n >= self.num_workers:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"barrier timed out: {n}/{self.num_workers} arrived")
+            time.sleep(self.poll_ms / 1000.0)
+        # entering round r proves all workers passed r-1, so nobody can
+        # still be counting r-2 — safe to reclaim those markers
+        if self._round >= 2:
+            old = self._tag(self._round - 2)
+            for f in os.listdir(self.dir):
+                if f.startswith(old):
+                    try:
+                        os.remove(os.path.join(self.dir, f))
+                    except OSError:
+                        pass
+        self._round += 1

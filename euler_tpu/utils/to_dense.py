@@ -1,0 +1,68 @@
+"""Dense-batch conversion utilities.
+
+Parity: tf_euler/python/utils/to_dense_adj.py / to_dense_batch.py — turn
+edge_index/node batches into fixed-shape dense adjacency / node tensors
+for models that want [G, N_max, ...] layouts (DNA, set2set-style readouts).
+Pure jnp, jit-safe with static max_nodes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def to_dense_batch(x: Array, graph_idx: Array, num_graphs: int,
+                   max_nodes: int) -> Tuple[Array, Array]:
+    """Scatter per-node rows into [num_graphs, max_nodes, D] + bool mask.
+
+    x: [N, D]; graph_idx: [N] int graph assignment (rows beyond max_nodes
+    per graph are dropped).
+    """
+    n = x.shape[0]
+    # position of each node within its graph: rank among same-graph rows
+    order = jnp.argsort(graph_idx, stable=True)
+    sorted_gi = graph_idx[order]
+    start_of_graph = jnp.searchsorted(sorted_gi, jnp.arange(num_graphs))
+    pos_sorted = jnp.arange(n) - start_of_graph[sorted_gi]
+    pos = jnp.zeros(n, dtype=pos_sorted.dtype).at[order].set(pos_sorted)
+
+    keep = pos < max_nodes
+    flat = jnp.where(keep, graph_idx * max_nodes + pos, num_graphs * max_nodes)
+    out = jnp.zeros((num_graphs * max_nodes + 1, x.shape[-1]), x.dtype)
+    out = out.at[flat].set(x)
+    dense = out[:-1].reshape(num_graphs, max_nodes, x.shape[-1])
+    mask = jnp.zeros(num_graphs * max_nodes + 1, bool).at[flat].set(keep)
+    return dense, mask[:-1].reshape(num_graphs, max_nodes)
+
+
+def to_dense_adj(edge_index: Array, graph_idx: Array, num_graphs: int,
+                 max_nodes: int,
+                 edge_weight: Optional[Array] = None) -> Array:
+    """Edge list → dense [num_graphs, max_nodes, max_nodes] adjacency.
+
+    edge_index: [2, E] rows into the node table; graph_idx: [N] graph of
+    each node. Edges whose endpoint position exceeds max_nodes drop.
+    """
+    n = graph_idx.shape[0]
+    order = jnp.argsort(graph_idx, stable=True)
+    sorted_gi = graph_idx[order]
+    start_of_graph = jnp.searchsorted(sorted_gi, jnp.arange(num_graphs))
+    pos_sorted = jnp.arange(n) - start_of_graph[sorted_gi]
+    pos = jnp.zeros(n, dtype=pos_sorted.dtype).at[order].set(pos_sorted)
+
+    src, dst = edge_index[0], edge_index[1]
+    g = graph_idx[src]
+    ps, pd = pos[src], pos[dst]
+    keep = (ps < max_nodes) & (pd < max_nodes) & (graph_idx[dst] == g)
+    w = jnp.ones(src.shape[0], jnp.float32) if edge_weight is None \
+        else edge_weight.astype(jnp.float32)
+    flat = jnp.where(keep, (g * max_nodes + ps) * max_nodes + pd,
+                     num_graphs * max_nodes * max_nodes)
+    adj = jnp.zeros(num_graphs * max_nodes * max_nodes + 1, jnp.float32)
+    adj = adj.at[flat].add(jnp.where(keep, w, 0.0))
+    return adj[:-1].reshape(num_graphs, max_nodes, max_nodes)

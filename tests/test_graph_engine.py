@@ -279,3 +279,16 @@ def test_hdfs_io_with_fake_libhdfs(tmp_path, monkeypatch):
     g2 = GraphEngine.load("hdfs://nn:9000/g")
     assert g2.node_count == 5
     assert list(g2.get_full_neighbor([2])[1]) == [3]
+
+
+def test_native_engine_selftest():
+    """Build + run the C++ self-test binary (make test); `make tsan` /
+    `make asan` run the same suite under sanitizers."""
+    import subprocess
+    from pathlib import Path
+
+    cc = Path(__file__).resolve().parents[1] / "euler_tpu" / "core" / "cc"
+    proc = subprocess.run(["make", "-C", str(cc), "test"],
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ALL OK" in proc.stdout
