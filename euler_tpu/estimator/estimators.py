@@ -213,13 +213,14 @@ class GaeEstimator(BaseEstimator):
         while True:
             roots = self.graph.sample_node(self.batch_size, -1)
             batch = self.dataflow(roots)
-            nodes = batch["nodes"]
-            src, dst, _ = self.graph.sample_edge(self.num_pos, -1)
-            # map edge endpoints into the node table where present; edges
-            # whose endpoints fell outside the closure map to row 0 (noise
-            # at a bounded rate — acceptable for reconstruction training)
-            pos_src = np.searchsorted(nodes, src).clip(0, len(nodes) - 1)
-            pos_dst = np.searchsorted(nodes, dst).clip(0, len(nodes) - 1)
+            # positives are REAL edges of this batch's subgraph: sample
+            # columns of its edge_index (rows already index the node
+            # table). Globally sampled edges would mostly fall outside
+            # the closure and train the decoder on noise.
+            ei = batch["edge_index"]
+            cols = self.rng.integers(0, ei.shape[1], self.num_pos)
+            pos_src = ei[0][cols]
+            pos_dst = ei[1][cols]
             neg_src = self.rng.integers(0, batch["n_real_nodes"], self.num_pos)
             neg_dst = self.rng.integers(0, batch["n_real_nodes"], self.num_pos)
             batch.update({

@@ -20,6 +20,7 @@ from euler_tpu.ops.neighbor_ops import (  # noqa: F401
     sample_fanout,
     sample_neighbor,
     sample_neighbor_layerwise,
+    sparse_get_adj,
 )
 from euler_tpu.ops.sample_ops import (  # noqa: F401
     sample_edge,

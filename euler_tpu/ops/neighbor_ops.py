@@ -53,3 +53,33 @@ def sample_neighbor_layerwise(nodes, layer_sizes, edge_types=None,
     return get_graph().sample_layerwise(
         nodes, layer_sizes, edge_types=edge_types, default_id=default_node
     )
+
+
+def sparse_get_adj(roots, nbr_ids, edge_types=None):
+    """Adjacency between a root batch and a candidate neighbor set.
+
+    Parity: reference SparseGetAdj (API_SPARSE_GET_ADJ,
+    ops/euler_ops.cc:22-37; used by layerwise dataflows to connect each
+    layer to the next layer's sampled pool).
+
+    Returns (edge_index [2, E] int32, weights [E]) where edge_index[0]
+    indexes `roots` rows and edge_index[1] indexes `nbr_ids` rows; only
+    edges whose destination is in nbr_ids survive.
+    """
+    import numpy as np
+
+    roots = np.ascontiguousarray(roots, dtype=np.uint64).ravel()
+    nbr_ids = np.ascontiguousarray(nbr_ids, dtype=np.uint64).ravel()
+    pos = {int(v): i for i, v in enumerate(nbr_ids)}
+    off, ids, w, _ = get_graph().get_full_neighbor(roots,
+                                                   edge_types=edge_types)
+    src_rows, dst_rows, ws = [], [], []
+    for i in range(len(roots)):
+        for j in range(int(off[i]), int(off[i + 1])):
+            p = pos.get(int(ids[j]))
+            if p is not None:
+                src_rows.append(i)
+                dst_rows.append(p)
+                ws.append(w[j])
+    return (np.array([src_rows, dst_rows], dtype=np.int32),
+            np.array(ws, dtype=np.float32))

@@ -147,3 +147,16 @@ def test_pallas_gather_mean_interpret():
     # public entry falls back to XLA off-TPU
     np.testing.assert_allclose(np.asarray(gather_mean(table, rows)),
                                np.asarray(ref), atol=1e-6)
+
+
+def test_sparse_get_adj(ring_graph):
+    from euler_tpu.ops import initialize_shared_graph, sparse_get_adj
+
+    initialize_shared_graph(ring_graph)
+    roots = np.array([1, 2], dtype=np.uint64)
+    pool = np.array([3, 4, 99], dtype=np.uint64)
+    # ring: 1→{2(t0),3(t1)}, 2→{3(t0),4(t1)}; only pool members survive
+    ei, w = sparse_get_adj(roots, pool)
+    pairs = set(zip(ei[0].tolist(), ei[1].tolist()))
+    assert pairs == {(0, 0), (1, 0), (1, 1)}  # 1→3, 2→3, 2→4
+    assert w.shape == (3,)
