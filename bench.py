@@ -10,6 +10,11 @@ throughput accounting.
 Modes:
   python bench.py            # full bench (sized for the real TPU chip)
   python bench.py --smoke    # small/fast CPU sanity run
+
+Robustness contract for the driver: this script ALWAYS prints exactly one
+JSON line, even when the TPU backend refuses to initialize — in that case
+the line carries an "error" key (and, when possible, a CPU-fallback
+measurement) instead of nothing.
 """
 
 from __future__ import annotations
@@ -18,6 +23,7 @@ import argparse
 import json
 import sys
 import time
+import traceback
 
 import numpy as np
 
@@ -37,18 +43,15 @@ def build_products_like(n_nodes: int, avg_degree: int, feat_dim: int,
     return data
 
 
-def main(argv=None):
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--smoke", action="store_true", help="small CPU run")
-    ap.add_argument("--nodes", type=int, default=0)
-    ap.add_argument("--batch_size", type=int, default=0)
-    ap.add_argument("--fanouts", default="")
-    ap.add_argument("--steps", type=int, default=0)
-    ap.add_argument("--feat_dim", type=int, default=0)
-    ap.add_argument("--bf16", action="store_true", default=False)
-    args = ap.parse_args(argv)
+def run_bench(args):
+    import jax
 
-    if args.smoke:
+    # If the accelerator fell through to CPU, run smoke-sized shapes —
+    # a full-size CPU run would outlast the driver's patience and lose
+    # the JSON line entirely.
+    cpu_fallback = not args.smoke and jax.default_backend() == "cpu"
+
+    if args.smoke or cpu_fallback:
         n_nodes = args.nodes or 2000
         batch = args.batch_size or 64
         fanouts = [int(x) for x in args.fanouts.split(",")] if args.fanouts \
@@ -64,8 +67,6 @@ def main(argv=None):
         steps = args.steps or 30
         feat_dim = args.feat_dim or 100
         warmup = 5
-
-    import jax
 
     from euler_tpu.dataflow import FanoutDataFlow
     from euler_tpu.estimator import NodeEstimator
@@ -121,7 +122,7 @@ def main(argv=None):
     edges_per_sec = edges_per_step * steps_done / dt
     n_chips = jax.device_count()
     value = edges_per_sec / max(n_chips, 1)
-    print(json.dumps({
+    return {
         "metric": "graphsage_train_edges_per_sec_per_chip",
         "value": round(value, 1),
         "unit": "edges/s/chip",
@@ -136,9 +137,59 @@ def main(argv=None):
             "steps": steps_done,
             "steps_per_sec": round(steps_done / dt, 2),
             "final_loss": res["loss"],
+            "cpu_fallback": cpu_fallback,
         },
-    }))
-    return 0
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="small CPU run")
+    ap.add_argument("--nodes", type=int, default=0)
+    ap.add_argument("--batch_size", type=int, default=0)
+    ap.add_argument("--fanouts", default="")
+    ap.add_argument("--steps", type=int, default=0)
+    ap.add_argument("--feat_dim", type=int, default=0)
+    ap.add_argument("--bf16", action="store_true", default=False)
+    ap.add_argument("--platform", default="",
+                    choices=["", "auto", "tpu", "cpu"],
+                    help="default: cpu for --smoke, auto otherwise")
+    args = ap.parse_args(argv)
+
+    # Eager, bounded backend init BEFORE any heavy work: probe the
+    # accelerator in a subprocess with retries, fall back to CPU rather
+    # than hang or crash (round-1 failure mode: axon init UNAVAILABLE →
+    # rc=1, no JSON).
+    platform = args.platform or ("cpu" if args.smoke else "auto")
+    backend_err = None
+    try:
+        from euler_tpu.platform import init_platform
+
+        # Bound the worst case (hung plugin burns the full timeout every
+        # attempt): 2 × 210s + 10s ≈ 7.2 min before CPU fallback, leaving
+        # room for the fallback run inside a ~10-min driver patience.
+        init_platform(platform, probe_timeout=210.0, retries=2,
+                      retry_delay=10.0, verbose=True)
+    except Exception as e:
+        backend_err = f"platform init: {e}"
+
+    try:
+        if backend_err:
+            raise RuntimeError(backend_err)
+        result = run_bench(args)
+        rc = 0
+    except Exception as e:
+        result = {
+            "metric": "graphsage_train_edges_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "edges/s/chip",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }
+        traceback.print_exc(file=sys.stderr)
+        rc = 1
+    print(json.dumps(result), flush=True)
+    return rc
 
 
 if __name__ == "__main__":

@@ -532,7 +532,6 @@ struct Rewriter {
 // ---------------------------------------------------------------------------
 Status GpRewrite(const CompileOptions& opts, DAGDef* dag) {
   const int S = opts.shard_num;
-  std::string sn = std::to_string(S);
   Rewriter rw{opts, dag, {}};
 
   std::vector<NodeDef> nodes = std::move(dag->nodes);
@@ -636,19 +635,15 @@ Status GpRewrite(const CompileOptions& opts, DAGDef* dag) {
 
     // generic: inner = own-filter (GET_NODE) → op on owned subset
     int n_outs;
-    int payloads;  // ragged payload arrays per merge group
     if (n.op == "API_GET_P") {
       int nf = 0;
       for (auto& a : n.attrs)
         if (a.rfind("udf:", 0) != 0) nf++;
       n_outs = 2 * nf;
-      payloads = 1;
     } else if (n.op == "API_GET_NODE_T") {
       n_outs = 1;
-      payloads = 0;
     } else {
       n_outs = 4;  // quad ops
-      payloads = 3;
     }
 
     std::vector<std::string> remotes;
@@ -752,14 +747,14 @@ Status OptimizeDag(const CompileOptions& opts, DAGDef* dag) {
       const std::string orig_gl = n.name;
       std::string split = rw.Add(
           rw.Fresh("SAMPLE_SPLIT"), "SAMPLE_SPLIT", n.inputs,
-          {"glabel", n.attrs.size() > 0 ? n.attrs[0] : "0", "-1"});
+          {"glabel", n.attrs.size() > 0 ? n.attrs[0] : "0", "-1", "owned"});
       std::vector<std::string> remotes;
       for (int s = 0; s < S; ++s) {
         NodeDef inner = n;
         inner.name = orig_gl + "_sh" + std::to_string(s);
         inner.inputs = {split + ":" + std::to_string(s)};
-        if (inner.attrs.empty()) inner.attrs.push_back("0");
-        inner.attrs[0] = "0";
+        // owned form: shard draws only labels with label % S == s
+        inner.attrs = {"0", "owned", std::to_string(s), sn};
         remotes.push_back(rw.AddRemote(s, std::move(inner),
                                        {split + ":" + std::to_string(s)},
                                        1));
@@ -787,7 +782,7 @@ Status OptimizeDag(const CompileOptions& opts, DAGDef* dag) {
         ins.push_back(remotes[s] + ":2");
       }
       std::string m = rw.Add(rw.Fresh("GP_RAGGED_MERGE"), "GP_RAGGED_MERGE",
-                             ins, {"1", "concat"});
+                             ins, {"1", "concat_sort"});
       rw.Add(orig_gl, "COLLECT", {m + ":0", m + ":1", m + ":2"}, {});
       continue;
     }

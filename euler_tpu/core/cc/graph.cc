@@ -972,6 +972,46 @@ void Graph::SampleGraphLabel(size_t count, Pcg32* rng, uint64_t* out) const {
     out[i] = label_ids_[rng->NextUInt(label_ids_.size())];
 }
 
+std::shared_ptr<const std::vector<uint64_t>> Graph::OwnedLabels(
+    int shard_idx, int shard_num) const {
+  // single-entry cache: a server's (shard_idx, shard_num) never changes,
+  // so the filter scan runs once, not per sampleGL call. Shared-ptr
+  // snapshot keeps a concurrent rebuild (different identity — only
+  // possible in tests) from invalidating a sampler mid-draw.
+  std::lock_guard<std::mutex> lk(owned_mu_);
+  if (owned_ids_ == nullptr || owned_sidx_ != shard_idx ||
+      owned_snum_ != shard_num) {
+    auto ids = std::make_shared<std::vector<uint64_t>>();
+    for (uint64_t id : label_ids_)
+      if (static_cast<int>(id % shard_num) == shard_idx)
+        ids->push_back(id);
+    owned_ids_ = std::move(ids);
+    owned_sidx_ = shard_idx;
+    owned_snum_ = shard_num;
+  }
+  return owned_ids_;
+}
+
+size_t Graph::OwnedGraphLabelCount(int shard_idx, int shard_num) const {
+  if (shard_num <= 1) return label_ids_.size();
+  return OwnedLabels(shard_idx, shard_num)->size();
+}
+
+void Graph::SampleGraphLabelOwned(size_t count, int shard_idx, int shard_num,
+                                  Pcg32* rng, uint64_t* out) const {
+  if (shard_num <= 1) {
+    SampleGraphLabel(count, rng, out);
+    return;
+  }
+  auto owned = OwnedLabels(shard_idx, shard_num);
+  if (owned->empty()) {
+    for (size_t i = 0; i < count; ++i) out[i] = 0;
+    return;
+  }
+  for (size_t i = 0; i < count; ++i)
+    out[i] = (*owned)[rng->NextUInt(owned->size())];
+}
+
 const std::vector<uint32_t>* Graph::GraphNodes(uint64_t label) const {
   auto it = label_rows_.find(label);
   return it == label_rows_.end() ? nullptr : &it->second;

@@ -18,6 +18,7 @@
 #include <cstdint>
 #include <limits>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <tuple>
 #include <unordered_map>
@@ -174,6 +175,16 @@ class Graph {
   }
   // Uniform over distinct labels; writes `count` labels (0 when none).
   void SampleGraphLabel(size_t count, Pcg32* rng, uint64_t* out) const;
+  // Hash-distribute mode only: shard s "owns" labels with
+  // label % shard_num == s; sampling each label from exactly one shard
+  // keeps the global draw uniform even when a label's nodes span shards
+  // (labels whose owner shard holds none of their nodes are invisible —
+  // negligible for labels with more members than shards).
+  size_t OwnedGraphLabelCount(int shard_idx, int shard_num) const;
+  void SampleGraphLabelOwned(size_t count, int shard_idx, int shard_num,
+                             Pcg32* rng, uint64_t* out) const;
+  std::shared_ptr<const std::vector<uint64_t>> OwnedLabels(
+      int shard_idx, int shard_num) const;
   // Node rows of one label; nullptr when unknown.
   const std::vector<uint32_t>* GraphNodes(uint64_t label) const;
 
@@ -222,6 +233,10 @@ class Graph {
   std::vector<uint64_t> graph_labels_;  // per node row; empty → unlabeled
   std::vector<uint64_t> label_ids_;     // distinct labels, sorted
   std::unordered_map<uint64_t, std::vector<uint32_t>> label_rows_;
+  // OwnedLabels single-entry cache (see graph.cc)
+  mutable std::mutex owned_mu_;
+  mutable int owned_sidx_ = -1, owned_snum_ = -1;
+  mutable std::shared_ptr<const std::vector<uint64_t>> owned_ids_;
   std::vector<std::vector<uint32_t>> nodes_by_type_;  // type → node indices
   std::vector<AliasSampler> node_sampler_by_type_;
   AliasSampler node_sampler_all_;  // over node indices 0..N-1

@@ -770,8 +770,16 @@ class SampleGraphLabelOp : public OpKernel {
     }
     Pcg32 rng = NodeRng(node, env);
     Tensor out(DType::kU64, {count});
-    env.graph->SampleGraphLabel(static_cast<size_t>(count), &rng,
-                                out.Flat<uint64_t>());
+    // attrs [count, "owned", shard_idx, shard_num]: hash-distribute inner
+    // form — draw only labels this shard owns (see SampleSplitOp).
+    if (node.attrs.size() > 3 && node.attrs[1] == "owned") {
+      env.graph->SampleGraphLabelOwned(
+          static_cast<size_t>(count), std::atoi(node.attrs[2].c_str()),
+          std::atoi(node.attrs[3].c_str()), &rng, out.Flat<uint64_t>());
+    } else {
+      env.graph->SampleGraphLabel(static_cast<size_t>(count), &rng,
+                                  out.Flat<uint64_t>());
+    }
     ctx->Put(node.OutName(0), std::move(out));
     done(Status::OK());
   }
@@ -804,6 +812,8 @@ class GetGraphByLabelOp : public OpKernel {
       offs.push_back(out_ids.size());
     }
     int64_t m = static_cast<int64_t>(pos.size());
+    ET_K_RETURN_IF_ERROR(
+        CheckI32Offsets(node, static_cast<int64_t>(offs.back())));
     Tensor idx(DType::kI32, {m, 2});
     int32_t* pi = idx.Flat<int32_t>();
     for (int64_t i = 0; i < m; ++i) {

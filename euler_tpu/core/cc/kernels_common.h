@@ -5,6 +5,8 @@
 #ifndef EULER_TPU_KERNELS_COMMON_H_
 #define EULER_TPU_KERNELS_COMMON_H_
 
+#include <cstdint>
+#include <limits>
 #include <string>
 
 #include "common.h"
@@ -12,6 +14,17 @@
 #include "tensor.h"
 
 namespace et {
+
+// Ragged row offsets travel as i32 [n,2] tensors; a merged payload past
+// 2^31 elements would silently wrap, so every producer range-checks the
+// final cursor before casting.
+inline Status CheckI32Offsets(const NodeDef& node, int64_t total) {
+  if (total > std::numeric_limits<int32_t>::max())
+    return Status::InvalidArgument(
+        node.name + ": ragged payload of " + std::to_string(total) +
+        " elements exceeds int32 offset range");
+  return Status::OK();
+}
 
 inline Status GetInput(OpKernelContext* ctx, const NodeDef& node, size_t i,
                        Tensor* out) {

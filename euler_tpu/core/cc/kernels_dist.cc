@@ -162,6 +162,10 @@ class SampleSplitOp : public OpKernel {
     bool glabel = node.attrs[0] == "glabel";
     int64_t count = std::atoll(node.attrs[1].c_str());
     int type = std::atoi(node.attrs[2].c_str());
+    // 4th attr "owned": hash-distribute sampleGL — split by labels each
+    // shard OWNS (label % shard_num), not labels present, so a label
+    // spanning shards isn't drawn multiple times its fair share.
+    bool owned = node.attrs.size() > 3 && node.attrs[3] == "owned";
     if (!node.inputs.empty()) {
       Tensor t;
       if (ctx->Get(node.inputs[0], &t) && t.NumElements() > 0)
@@ -173,7 +177,7 @@ class SampleSplitOp : public OpKernel {
     for (int s = 0; s < sn; ++s) {
       float w = 1.f;
       if (env.client != nullptr)
-        w = glabel ? env.client->GraphLabelWeight(s)
+        w = glabel ? env.client->GraphLabelWeight(s, owned)
                    : (edge ? env.client->EdgeWeight(s, type)
                            : env.client->NodeWeight(s, type));
       total += w;
@@ -291,6 +295,7 @@ class RaggedMergeOp : public OpKernel {
       oi[2 * i + 1] = static_cast<int32_t>(cursor + len);
       cursor += len;
     }
+    ET_K_RETURN_IF_ERROR(CheckI32Offsets(node, cursor));
     std::vector<Tensor> out_pay;
     for (int p = 0; p < P; ++p) {
       DType dt = pay[0][p].dtype();
@@ -360,6 +365,7 @@ class RaggedGatherOp : public OpKernel {
       oi[2 * i + 1] = static_cast<int32_t>(cursor + len);
       cursor += len;
     }
+    ET_K_RETURN_IF_ERROR(CheckI32Offsets(node, cursor));
     for (int p = 0; p < P; ++p) {
       size_t esz = DTypeSize(pay[p].dtype());
       Tensor out(pay[p].dtype(), {cursor});
@@ -479,6 +485,8 @@ class QuadFilterApplyOp : public OpKernel {
       }
       offs.push_back(oid.size());
     }
+    ET_K_RETURN_IF_ERROR(
+        CheckI32Offsets(node, static_cast<int64_t>(offs.back())));
     Tensor out_idx(DType::kI32, {n, 2});
     int32_t* oi = out_idx.Flat<int32_t>();
     for (int64_t i = 0; i < n; ++i) {
@@ -585,7 +593,13 @@ class GpRaggedMergeOp : public OpKernel {
     int P = std::atoi(node.attrs[0].c_str());
     int64_t pad_k = 0;
     uint64_t pad_def = 0;
-    bool concat = node.attrs.size() > 1 && node.attrs[1] == "concat";
+    // concat_sort additionally sorts each merged row's u64 payload, so
+    // shard-spanning rows come out in the same id order local mode emits
+    // (only meaningful for P == 1: a per-payload sort would break
+    // cross-payload row alignment).
+    bool concat = node.attrs.size() > 1 &&
+                  node.attrs[1].rfind("concat", 0) == 0;
+    bool sort_rows = node.attrs.size() > 1 && node.attrs[1] == "concat_sort";
     if (node.attrs.size() > 1 && node.attrs[1].rfind("pad:", 0) == 0) {
       auto rest = node.attrs[1].substr(4);
       auto colon = rest.find(':');
@@ -635,6 +649,7 @@ class GpRaggedMergeOp : public OpKernel {
       oi[2 * i + 1] = static_cast<int32_t>(cursor + len);
       cursor += len;
     }
+    ET_K_RETURN_IF_ERROR(CheckI32Offsets(node, cursor));
     for (int p = 0; p < P; ++p) {
       DType dt = DType::kU64;
       for (size_t s = 0; s < ns; ++s)
@@ -664,6 +679,11 @@ class GpRaggedMergeOp : public OpKernel {
           int64_t b = si[2 * j], e = si[2 * j + 1];
           std::memcpy(dst, pay[s][p].raw() + b * esz, (e - b) * esz);
           dst += (e - b) * esz;
+        }
+        if (sort_rows && P == 1 && dt == DType::kU64) {
+          uint64_t* row = reinterpret_cast<uint64_t*>(
+              out.raw() + oi[2 * i] * esz);
+          std::sort(row, row + (oi[2 * i + 1] - oi[2 * i]));
         }
       }
       ctx->Put(node.OutName(2 + p), std::move(out));

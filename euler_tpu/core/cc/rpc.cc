@@ -81,6 +81,7 @@ void EncodeShardMeta(const ShardMeta& m, ByteWriter* w) {
   w->Put<uint32_t>(static_cast<uint32_t>(m.edge_type_wsum.size()));
   for (float f : m.edge_type_wsum) w->Put<float>(f);
   w->Put<uint64_t>(m.graph_label_count);
+  w->Put<uint64_t>(m.owned_graph_label_count);
   const GraphMeta& gm = m.graph_meta;
   w->PutStr(gm.name);
   w->Put<int32_t>(gm.num_node_types);
@@ -119,7 +120,8 @@ Status DecodeShardMeta(ByteReader* r, ShardMeta* m) {
   for (uint32_t i = 0; i < n; ++i)
     if (!r->Get(&m->edge_type_wsum[i]))
       return Status::IOError("truncated weights");
-  if (!r->Get(&m->graph_label_count))
+  if (!r->Get(&m->graph_label_count) ||
+      !r->Get(&m->owned_graph_label_count))
     return Status::IOError("truncated shard meta");
   GraphMeta& gm = m->graph_meta;
   if (!r->GetStr(&gm.name) || !r->Get(&gm.num_node_types) ||
@@ -295,6 +297,8 @@ void GraphServer::HandleConnection(int fd) {
       m.partition_num = partition_num_;
       m.node_type_wsum = graph_->node_type_weight_sums();
       m.graph_label_count = graph_->graph_label_count();
+      m.owned_graph_label_count =
+          graph_->OwnedGraphLabelCount(shard_idx_, shard_num_);
       m.edge_type_wsum = graph_->edge_type_weight_sums();
       m.graph_meta = graph_->meta();
       EncodeShardMeta(m, &w);
@@ -588,6 +592,9 @@ Status DiscoverFromSpec(const std::string& spec, ShardEndpoints* out) {
 // ---------------------------------------------------------------------------
 ClientManager::~ClientManager() {
   if (monitor_) monitor_->Stop();
+  // block until no pool-scheduled RefreshMeta can touch us anymore
+  std::lock_guard<std::mutex> lk(life_->first);
+  life_->second = true;
 }
 
 std::shared_ptr<RpcChannel> ClientManager::Channel(int shard) const {
@@ -602,12 +609,29 @@ void ClientManager::WatchRegistry(const std::string& dir, int interval_ms,
                          bool up) {
     if (shard < 0 || shard >= shard_num()) return;
     if (up) {
-      std::lock_guard<std::mutex> lk(chan_mu_);
-      if (channels_[shard]->host() != host ||
-          channels_[shard]->port() != port) {
-        ET_LOG_INFO << "shard " << shard << " re-resolved to " << host
-                    << ":" << port;
-        channels_[shard] = std::make_shared<RpcChannel>(host, port);
+      std::shared_ptr<RpcChannel> fresh;
+      {
+        std::lock_guard<std::mutex> lk(chan_mu_);
+        if (channels_[shard]->host() != host ||
+            channels_[shard]->port() != port) {
+          ET_LOG_INFO << "shard " << shard << " re-resolved to " << host
+                      << ":" << port;
+          channels_[shard] = std::make_shared<RpcChannel>(host, port);
+          fresh = channels_[shard];
+        }
+      }
+      if (fresh) {
+        // off the monitor thread: keep the registry poll cadence steady.
+        // The RPC runs before taking the life lock so a slow shard can't
+        // stall ~ClientManager for a whole call timeout.
+        auto life = life_;
+        ClientThreadPool()->Schedule([this, life, shard, fresh] {
+          std::vector<char> body, reply;
+          Status s = fresh->Call(kMeta, body, &reply);
+          std::lock_guard<std::mutex> lk(life->first);
+          if (life->second) return;  // manager destroyed meanwhile
+          RefreshMeta(shard, s, reply);
+        });
       }
     } else {
       ET_LOG_INFO << "shard " << shard << " registration lost (" << host
@@ -620,24 +644,43 @@ void ClientManager::WatchRegistry(const std::string& dir, int interval_ms,
 
 Status ClientManager::Init(const ShardEndpoints& eps) {
   channels_.clear();
-  metas_.clear();
   for (const auto& ep : eps.endpoints)
     channels_.push_back(std::make_shared<RpcChannel>(ep.first, ep.second));
-  metas_.resize(channels_.size());
+  std::vector<ShardMeta> metas(channels_.size());
   for (size_t s = 0; s < channels_.size(); ++s) {
     std::vector<char> body, reply;
     ET_RETURN_IF_ERROR(channels_[s]->Call(kMeta, body, &reply));
     ByteReader r(reply.data(), reply.size());
-    ET_RETURN_IF_ERROR(DecodeShardMeta(&r, &metas_[s]));
+    ET_RETURN_IF_ERROR(DecodeShardMeta(&r, &metas[s]));
   }
-  if (!metas_.empty()) {
-    graph_meta_ = metas_[0].graph_meta;
-    partition_num_ = metas_[0].partition_num;
+  if (!metas.empty()) {
+    graph_meta_ = metas[0].graph_meta;
+    partition_num_ = metas[0].partition_num;
   }
+  std::lock_guard<std::mutex> lk(meta_mu_);  // vs in-flight RefreshMeta
+  metas_ = std::move(metas);
   return Status::OK();
 }
 
+void ClientManager::RefreshMeta(int shard, const Status& call_status,
+                                const std::vector<char>& reply) {
+  Status s = call_status;
+  ShardMeta m;
+  if (s.ok()) {
+    ByteReader r(reply.data(), reply.size());
+    s = DecodeShardMeta(&r, &m);
+  }
+  if (!s.ok()) {
+    ET_LOG_INFO << "shard " << shard
+                << " meta refresh after failover failed: " << s.message();
+    return;
+  }
+  std::lock_guard<std::mutex> lk(meta_mu_);
+  if (shard < static_cast<int>(metas_.size())) metas_[shard] = std::move(m);
+}
+
 float ClientManager::NodeWeight(int shard, int type) const {
+  std::lock_guard<std::mutex> lk(meta_mu_);
   const auto& w = metas_[shard].node_type_wsum;
   if (type >= 0)
     return type < static_cast<int>(w.size()) ? w[type] : 0.f;
@@ -646,11 +689,14 @@ float ClientManager::NodeWeight(int shard, int type) const {
   return s;
 }
 
-float ClientManager::GraphLabelWeight(int shard) const {
-  return static_cast<float>(metas_[shard].graph_label_count);
+float ClientManager::GraphLabelWeight(int shard, bool owned) const {
+  std::lock_guard<std::mutex> lk(meta_mu_);
+  return static_cast<float>(owned ? metas_[shard].owned_graph_label_count
+                                  : metas_[shard].graph_label_count);
 }
 
 float ClientManager::EdgeWeight(int shard, int type) const {
+  std::lock_guard<std::mutex> lk(meta_mu_);
   const auto& w = metas_[shard].edge_type_wsum;
   if (type >= 0)
     return type < static_cast<int>(w.size()) ? w[type] : 0.f;

@@ -48,6 +48,11 @@ struct ShardMeta {
   std::vector<float> node_type_wsum;  // per node type
   std::vector<float> edge_type_wsum;  // per edge type
   uint64_t graph_label_count = 0;     // whole-graph labels on this shard
+  // Labels this shard OWNS under the hash convention (label % shard_num
+  // == shard_idx). Drives sampleGL count splitting in hash-distribute
+  // mode, where a label present on several shards must still be drawn
+  // from exactly one.
+  uint64_t owned_graph_label_count = 0;
   GraphMeta graph_meta;
 };
 
@@ -205,7 +210,8 @@ class ClientManager {
   float NodeWeight(int shard, int type) const;
   float EdgeWeight(int shard, int type) const;
   // Whole-graph label count (graph_partition proportional sampling).
-  float GraphLabelWeight(int shard) const;
+  // owned=true → hash-ownership count (hash-distribute sampleGL split).
+  float GraphLabelWeight(int shard, bool owned = false) const;
 
   // Blocking execute on one shard.
   Status Execute(int shard, const ExecuteRequest& req, ExecuteReply* rep);
@@ -215,10 +221,23 @@ class ClientManager {
 
  private:
   std::shared_ptr<RpcChannel> Channel(int shard) const;
+  // Decode + install a shard's re-fetched ShardMeta after a failover
+  // channel swap, so proportional SAMPLE_SPLIT routing doesn't keep the
+  // dead server's weight sums if the restarted shard serves changed
+  // data. Caller holds the life_ lock (see below).
+  void RefreshMeta(int shard, const Status& call_status,
+                   const std::vector<char>& reply);
 
   mutable std::mutex chan_mu_;  // guards channels_ swaps from the monitor
   std::vector<std::shared_ptr<RpcChannel>> channels_;
+  mutable std::mutex meta_mu_;  // guards metas_ refresh vs weight reads
   std::vector<ShardMeta> metas_;
+  // Lifetime gate for pool-scheduled RefreshMeta tasks: they capture
+  // this shared state, take the lock, and bail if `second` (destroyed)
+  // is set — the destructor flips it under the same lock, so no task
+  // touches a dead ClientManager.
+  std::shared_ptr<std::pair<std::mutex, bool>> life_ =
+      std::make_shared<std::pair<std::mutex, bool>>();
   GraphMeta graph_meta_;
   int partition_num_ = 1;
   std::unique_ptr<ServerMonitor> monitor_;

@@ -33,9 +33,18 @@ def _build() -> None:
 
 
 def _stale() -> bool:
-    """True when any cc/ source is newer than the built .so."""
+    """True when the .so must be (re)built before loading.
+
+    A missing library always triggers a build. The mtime-vs-source check
+    is a developer convenience only, gated behind EULER_TPU_DEV_REBUILD:
+    a fresh checkout or container copy can legitimately carry sources
+    newer than a prebuilt .so, and surprise-compiling at import (or hard-
+    failing where no compiler exists) is worse than using the prebuilt.
+    """
     if not os.path.exists(_LIB_PATH):
         return True
+    if not os.environ.get("EULER_TPU_DEV_REBUILD"):
+        return False
     so_mtime = os.path.getmtime(_LIB_PATH)
     cc = os.path.join(_HERE, "cc")
     for name in os.listdir(cc):

@@ -20,13 +20,29 @@ from euler_tpu.dataset.base_dataset import (  # noqa: F401
 from euler_tpu.dataset.graph_sets import mutag_like  # noqa: F401
 from euler_tpu.dataset.kg_sets import load_kg  # noqa: F401
 
-# Statistical shapes of the real datasets (nodes, feature dim, classes).
+# Statistical shapes of the real datasets (nodes, feature dim, classes)
+# plus CALIBRATED difficulty knobs (signal / informative_dims /
+# confuse_frac / homophily): tuned so a reference-grade 2-layer GCN lands
+# near the published BASELINE.md F1 for each dataset while feature-only
+# and structure-only baselines land far below — i.e. the synthetic
+# stand-in rewards message passing the way the real data does.
+# Measured at seed=0 (the default): cora GCN 0.825 (ref 0.822,
+# feat-only 0.746, label-prop 0.651); pubmed 0.866 (ref 0.871);
+# citeseer 0.762 (ref 0.752). Guarded by tests/test_tools_datasets.py.
 _CITATION_SHAPES = {
-    "cora": dict(n=2708, d=1433, num_classes=7),
-    "citeseer": dict(n=3327, d=3703, num_classes=6),
-    "pubmed": dict(n=19717, d=500, num_classes=3),
-    "ppi": dict(n=14755, d=50, num_classes=121),
-    "reddit": dict(n=232965, d=602, num_classes=41),
+    "cora": dict(n=2708, d=1433, num_classes=7, signal=1.2,
+                 confuse_frac=0.2, informative_dims=48,
+                 intra_degree=3.0, inter_degree=1.5),
+    "citeseer": dict(n=3327, d=3703, num_classes=6, signal=1.12,
+                     confuse_frac=0.21, informative_dims=48,
+                     intra_degree=3.0, inter_degree=1.4),
+    "pubmed": dict(n=19717, d=500, num_classes=3, signal=1.1,
+                   confuse_frac=0.2, informative_dims=32,
+                   intra_degree=3.0, inter_degree=1.5),
+    "ppi": dict(n=14755, d=50, num_classes=121, signal=1.0,
+                confuse_frac=0.2, informative_dims=24),
+    "reddit": dict(n=232965, d=602, num_classes=41, signal=1.2,
+                   confuse_frac=0.15, informative_dims=48),
 }
 
 _REGISTRY = {}

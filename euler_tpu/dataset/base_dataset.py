@@ -83,17 +83,51 @@ def synthetic_citation(name: str, n: int, d: int, num_classes: int,
                        intra_degree: float = 4.0, inter_degree: float = 1.0,
                        signal: float = 1.6, seed: int = 0,
                        train_per_class: int = 20, val: int = 500,
-                       test: int = 1000) -> GraphData:
-    """SBM + class-informative Gaussian features (a Cora-shaped problem).
+                       test: int = 1000, informative_dims: int = 0,
+                       confuse_frac: float = 0.0) -> GraphData:
+    """SBM + class-informative features (a Cora-shaped problem).
 
-    Homophilous edges + feature signal make 2-layer GNNs separate classes
-    at ≈0.8+ micro-F1 — a meaningful regression bar mirroring BASELINE.md.
+    Difficulty is calibrated so a reference-grade 2-layer GNN lands near
+    the published BASELINE.md numbers (≈0.82 on cora-shaped data), NOT at
+    ~1.0 — see dataset/__init__.py for the per-dataset calibrated knobs
+    and tests/test_tools_datasets.py for the regression guard. Two knobs
+    create realistic hardness:
+
+    informative_dims — when > 0, only this many dims carry class signal
+      (bag-of-words-like); the rest are pure noise. When 0, every dim
+      carries `signal` × a Gaussian class center (the easy legacy shape,
+      still used by bench.py where only throughput matters).
+    confuse_frac — fraction of nodes whose FEATURES are drawn from a
+      random other class while the label (and edge homophily) stay true:
+      feature-only classifiers cap near 1-ρ+ρ/C, and a GNN recovers part
+      of the gap through homophilous neighbors — mirroring why real
+      citation graphs reward message passing.
     """
     rng = np.random.default_rng(seed)
     labels = rng.integers(0, num_classes, n)
-    centers = rng.normal(0, 1.0, (num_classes, d))
-    features = (signal * centers[labels]
-                + rng.normal(0, 1.0, (n, d))).astype(np.float32)
+    # feature class: mostly the true label; a ρ-fraction of "confused"
+    # nodes draw features from a different class
+    feat_class = labels.copy()
+    if confuse_frac > 0:
+        flip = rng.random(n) < confuse_frac
+        shift = rng.integers(1, num_classes, n)
+        feat_class = np.where(flip, (labels + shift) % num_classes, labels)
+    if informative_dims and informative_dims < d:
+        k = int(informative_dims)
+        # per-class informative dim sets (drawn independently → overlap)
+        class_dims = np.stack(
+            [rng.choice(d, size=k, replace=False)
+             for _ in range(num_classes)])
+        per_dim_gain = rng.uniform(0.5, 1.5, (num_classes, k))
+        features = rng.normal(0, 1.0, (n, d))
+        bump = signal * per_dim_gain[feat_class]
+        np.add.at(features, (np.arange(n)[:, None], class_dims[feat_class]),
+                  bump)
+        features = features.astype(np.float32)
+    else:
+        centers = rng.normal(0, 1.0, (num_classes, d))
+        features = (signal * centers[feat_class]
+                    + rng.normal(0, 1.0, (n, d))).astype(np.float32)
     # sparse SBM edges via sampled pairs
     n_intra = int(n * intra_degree / 2)
     n_inter = int(n * inter_degree / 2)

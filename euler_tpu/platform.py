@@ -1,0 +1,148 @@
+"""Robust jax platform bootstrap shared by every process entry point
+(bench.py, examples, tools, __graft_entry__).
+
+Why this exists: some hosts inject a TPU plugin via sitecustomize whose
+backend init can hang for minutes or die with UNAVAILABLE. Env vars
+(``JAX_PLATFORMS``/``XLA_FLAGS``) set after interpreter start are too
+late — the injected plugin wins — but the ``jax.config`` route switches
+the platform reliably as long as the backend hasn't been queried yet.
+(Reference analog: euler initializes its engine explicitly at process
+start, euler/client/query_proxy.cc:39; here the accelerator backend is
+the resource that needs guarded init.)
+
+The probe runs ``jax.devices()`` in a *subprocess* first: if the
+injected backend hangs or errors there, this process never queries it
+and can still cleanly fall back to CPU. Probing in-process (even on a
+thread) is unsafe — a hung backend init holds jax's global backend lock
+and would deadlock the CPU fallback too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_PROBE_SRC = (
+    "import json, jax\n"
+    "print(json.dumps({'backend': jax.default_backend(),"
+    " 'n': len(jax.devices())}))\n"
+)
+
+_state = {"initialized": None}
+
+
+def add_platform_flag(parser, default: str = "auto"):
+    """Attach the shared --platform flag to an argparse parser."""
+    parser.add_argument(
+        "--platform", default=default, choices=["auto", "tpu", "cpu"],
+        help="accelerator backend: auto = probe TPU then fall back to "
+             "CPU; tpu = require TPU; cpu = force CPU")
+    return parser
+
+
+def probe_backend(timeout: float = 90.0):
+    """Check in a subprocess whether the default jax backend initializes.
+
+    Returns (ok, info) where info is the probe's parsed JSON on success
+    or an error string on failure. Never touches this process's backend.
+    """
+    env = dict(os.environ)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return False, f"backend probe timed out after {timeout:.0f}s"
+    except OSError as e:  # no child processes allowed, etc.
+        return False, f"backend probe could not run: {e}"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return False, tail[-1] if tail else f"probe rc={proc.returncode}"
+    try:
+        return True, json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return False, f"unparseable probe output: {proc.stdout[:200]!r}"
+
+
+def _force_cpu(n_devices=None):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if n_devices:
+        try:
+            jax.config.update("jax_num_cpu_devices", int(n_devices))
+        except Exception:
+            pass
+
+
+def _backend_live():
+    """True if this process already initialized a backend."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return None  # unknown — treat as not-yet-initialized
+
+
+def init_platform(platform: str = "auto", n_devices=None, *,
+                  probe_timeout: float = 90.0, retries: int = 2,
+                  retry_delay: float = 5.0, verbose: bool = False) -> str:
+    """Initialize the jax backend robustly; returns the backend name.
+
+    platform:
+      cpu  — force the CPU backend (optionally with n_devices virtual
+             devices for sharding tests).
+      tpu  — require the accelerator backend; raise if it won't init.
+      auto — probe the accelerator in a subprocess (bounded time, with
+             retries); fall back to CPU if it hangs or errors.
+
+    Idempotent: repeat calls return the already-chosen backend.
+    """
+    import jax
+
+    if _state["initialized"]:
+        return _state["initialized"]
+
+    def log(msg):
+        if verbose:
+            print(f"[euler_tpu.platform] {msg}", file=sys.stderr)
+
+    env_pick = os.environ.get("EULER_TPU_PLATFORM", "").strip().lower()
+    if platform == "auto" and env_pick in ("cpu", "tpu"):
+        platform = env_pick
+
+    if platform == "cpu":
+        if not _backend_live():
+            _force_cpu(n_devices)
+        backend = jax.default_backend()
+    else:
+        ok, info = False, "no probe attempted"
+        for attempt in range(max(retries, 1)):
+            if attempt:
+                time.sleep(retry_delay)
+            ok, info = probe_backend(timeout=probe_timeout)
+            log(f"probe attempt {attempt + 1}: ok={ok} info={info}")
+            if ok:
+                break
+        if ok and platform == "tpu" and info.get("backend") == "cpu":
+            # the default backend initialized fine but it's only CPU —
+            # that does not satisfy an explicit TPU requirement
+            ok, info = False, f"no accelerator backend (probe saw {info})"
+        if ok:
+            backend = jax.default_backend()  # init for real in-process
+        elif platform == "tpu":
+            raise RuntimeError(
+                f"--platform tpu requested but backend init failed: {info}")
+        else:
+            log(f"falling back to CPU: {info}")
+            if not _backend_live():
+                _force_cpu(n_devices)
+            backend = jax.default_backend()
+
+    _state["initialized"] = backend
+    log(f"backend = {backend}, devices = {jax.device_count()}")
+    return backend

@@ -27,11 +27,17 @@ def citation_argparser(**defaults) -> argparse.ArgumentParser:
                     default=defaults.get("eval_steps", 20))
     ap.add_argument("--model_dir", default="")
     ap.add_argument("--run_mode", default="train_and_evaluate")
+    from euler_tpu.platform import add_platform_flag
+
+    add_platform_flag(ap)
     return ap
 
 
 def run_citation(conv_name: str, args, conv_kwargs=None, model_cls=None):
     """Train+evaluate a conv-stack model on a citation dataset."""
+    from euler_tpu.platform import init_platform
+
+    init_platform(getattr(args, "platform", "auto"))
     from euler_tpu.dataflow import FullBatchDataFlow
     from euler_tpu.dataset import get_dataset
     from euler_tpu.estimator import NodeEstimator

@@ -14,6 +14,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
 
+from euler_tpu.platform import add_platform_flag, init_platform  # noqa: E402
+
 import flax.linen as nn  # noqa: E402
 
 from euler_tpu.dataflow import FullBatchDataFlow  # noqa: E402
@@ -46,7 +48,9 @@ def main(argv=None):
     ap.add_argument("--run_mode", default="train_and_evaluate",
                     choices=["train", "evaluate", "infer",
                              "train_and_evaluate"])
+    add_platform_flag(ap)
     args = ap.parse_args(argv)
+    init_platform(args.platform)
 
     data = get_dataset(args.dataset)
     print(f"dataset {args.dataset}: {data.engine.node_count} nodes, "

@@ -7,6 +7,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
 
+from euler_tpu.platform import add_platform_flag, init_platform  # noqa: E402
+
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
@@ -18,7 +20,9 @@ def main(argv=None):
     ap.add_argument("--max_steps", type=int, default=200)
     ap.add_argument("--eval_steps", type=int, default=20)
     ap.add_argument("--model_dir", default="")
+    add_platform_flag(ap)
     args = ap.parse_args(argv)
+    init_platform(args.platform)
 
     from euler_tpu.dataflow import FanoutDataFlow
     from euler_tpu.dataset import get_dataset

@@ -26,10 +26,16 @@ def graph_argparser(**defaults) -> argparse.ArgumentParser:
     ap.add_argument("--eval_steps", type=int,
                     default=defaults.get("eval_steps", 20))
     ap.add_argument("--model_dir", default="")
+    from euler_tpu.platform import add_platform_flag
+
+    add_platform_flag(ap)
     return ap
 
 
 def run_graph_model(conv_name: str, pool_name: str, args):
+    from euler_tpu.platform import init_platform
+
+    init_platform(getattr(args, "platform", "auto"))
     from euler_tpu.dataset import get_dataset
     from euler_tpu.estimator import GraphEstimator
     from euler_tpu.mp_utils import GraphModel
