@@ -128,7 +128,11 @@ class _ScalableCache(nn.Module):
             "cache", "h", lambda: jnp.zeros((self.max_id + 1, self.dim)))
         out = jnp.take(cache.value, bucketize_ids(read_ids, self.max_id + 1),
                        axis=0)
-        if write_ids is not None and write_vals is not None:
+        if (write_ids is not None and write_vals is not None
+                and self.is_mutable_collection("cache")):
+            # eval/infer apply the module with the cache frozen; historical
+            # activations are read-only there (reference ScalableGCNEncoder
+            # only updates stores inside the training op).
             rows = bucketize_ids(write_ids, self.max_id + 1)
             cache.value = cache.value.at[rows].set(write_vals)
         return out
