@@ -167,6 +167,20 @@ struct TransState {
       last_outputs.push_back(p->OutName(i));
     return p;
   }
+
+  // The semantic producer of the current results: AS nodes are
+  // transparent aliases, so orderBy/limit/has after as() must look
+  // through them to the op that made the data.
+  NodeDef* Producer() {
+    if (last_node.empty()) return nullptr;
+    NodeDef* t = dag->Find(last_node);
+    while (t != nullptr && t->op == "AS" && !t->inputs.empty()) {
+      const std::string& in = t->inputs[0];
+      auto colon = in.rfind(':');
+      t = dag->Find(colon == std::string::npos ? in : in.substr(0, colon));
+    }
+    return t;
+  }
 };
 
 }  // namespace
@@ -258,6 +272,7 @@ Status TranslateGql(const std::vector<GqlCall>& calls, TranslateResult* out) {
               {argw(0, "*"), argw(1, "1"), argw(2, "0")}, 4);
       st.last_quad = st.last_outputs;
       st.cur_ids = st.last_outputs[1];
+      st.cur_edge.clear();
     } else if (c.name == "sampleLNB") {
       // sampleLNB(edge_types, layer_sizes m0:m1:..., default_id)
       if (st.cur_ids.empty())
@@ -269,24 +284,28 @@ Status TranslateGql(const std::vector<GqlCall>& calls, TranslateResult* out) {
               {argw(0, "*"), sizes, argw(2, "0")}, n_layers);
       st.cur_ids = st.last_outputs.back();
       st.last_quad.clear();
+      st.cur_edge.clear();
     } else if (c.name == "outV" || c.name == "getNB") {
       if (st.cur_ids.empty())
         return Status::InvalidArgument(c.name + " without a node set");
       st.Emit("API_GET_NB_NODE", {st.cur_ids}, {argw(0, "*")}, 4);
       st.last_quad = st.last_outputs;
       st.cur_ids = st.last_outputs[1];
+      st.cur_edge.clear();
     } else if (c.name == "getSortedNB") {
       if (st.cur_ids.empty())
         return Status::InvalidArgument("getSortedNB without a node set");
       st.Emit("API_GET_SORTED_NB_NODE", {st.cur_ids}, {argw(0, "*")}, 4);
       st.last_quad = st.last_outputs;
       st.cur_ids = st.last_outputs[1];
+      st.cur_edge.clear();
     } else if (c.name == "inV" || c.name == "getRNB") {
       if (st.cur_ids.empty())
         return Status::InvalidArgument(c.name + " without a node set");
       st.Emit("API_GET_RNB_NODE", {st.cur_ids}, {argw(0, "*")}, 4);
       st.last_quad = st.last_outputs;
       st.cur_ids = st.last_outputs[1];
+      st.cur_edge.clear();
     } else if (c.name == "getTopKNB") {
       if (st.cur_ids.empty())
         return Status::InvalidArgument("getTopKNB without a node set");
@@ -294,6 +313,19 @@ Status TranslateGql(const std::vector<GqlCall>& calls, TranslateResult* out) {
               {argw(0, "*"), argw(1, "1")}, 4);
       st.last_quad = st.last_outputs;
       st.cur_ids = st.last_outputs[1];
+      st.cur_edge.clear();
+    } else if (c.name == "outE" || c.name == "getNBEdge") {
+      // outE(edge_types) — the *edges* to each root's out-neighbors
+      // (reference gremlin.l:21 out_e → API_GET_NB_EDGE). Leaves the
+      // edge triple current so values() chains edge features, and the
+      // neighbor ids current so traversal can continue.
+      if (st.cur_ids.empty())
+        return Status::InvalidArgument(c.name + " without a node set");
+      NodeDef* n = st.Emit("API_GET_NB_EDGE", {st.cur_ids},
+                           {argw(0, "*")}, 5);
+      st.cur_edge = {n->OutName(1), n->OutName(2), n->OutName(3)};
+      st.cur_ids = n->OutName(2);
+      st.last_quad.clear();
     } else if (c.name == "values" || c.name == "udf") {
       std::vector<std::string> attrs;
       size_t a0 = 0;
@@ -338,11 +370,22 @@ Status TranslateGql(const std::vector<GqlCall>& calls, TranslateResult* out) {
       }
       // Attach to the producing node (condition pushdown): sampling roots
       // take the dnf directly; a bare v() input gets an API_GET_NODE
-      // filter; a quad gets API_GET_NB_FILTER on the neighbors.
+      // filter; a quad gets API_GET_NB_FILTER on the neighbors. The
+      // lookup is deliberately NOT through as(): an earlier alias must
+      // keep its unfiltered data, so after as() the fallback paths
+      // (NB_FILTER / GET_NODE) apply a separate filter node instead.
       NodeDef* target =
           st.last_node.empty() ? nullptr : st.dag->Find(st.last_node);
+      if (target != nullptr && target->op == "API_GET_NB_EDGE" &&
+          !target->post_process.empty()) {
+        // the kernel filters before sort/limit; a has() written after
+        // orderBy/limit would silently run in the wrong order
+        return Status::InvalidArgument(
+            "outE: put has() before orderBy()/limit()");
+      }
       if (target != nullptr && (target->op == "API_SAMPLE_NODE" ||
-                                target->op == "API_GET_NODE")) {
+                                target->op == "API_GET_NODE" ||
+                                target->op == "API_GET_NB_EDGE")) {
         target->dnf = AndDnf(target->dnf, dnf);
       } else if (!st.last_quad.empty()) {
         std::vector<std::string> quad = st.last_quad;
@@ -358,6 +401,23 @@ Status TranslateGql(const std::vector<GqlCall>& calls, TranslateResult* out) {
         return Status::InvalidArgument(c.name + " with nothing to filter");
       }
     } else if (c.name == "orderBy" || c.name == "order_by") {
+      NodeDef* direct =
+          st.last_node.empty() ? nullptr : st.dag->Find(st.last_node);
+      if (direct != nullptr && direct->op == "API_GET_NB_EDGE") {
+        // edge results post-process inside the op (reference
+        // get_neighbor_edge_op.cc applies order_by/limit in-kernel)
+        direct->post_process.push_back(
+            "order_by " + argw(0, "weight") + " " + argw(1, "asc"));
+        continue;
+      }
+      NodeDef* prod = st.Producer();
+      if (prod != nullptr && prod->op == "API_GET_NB_EDGE") {
+        // mutating the op here would retroactively change data already
+        // bound by the alias (the reference grammar attaches edge
+        // post-process before AS, gremlin.y:162-165)
+        return Status::InvalidArgument(
+            "outE: put orderBy() before as()");
+      }
       if (st.last_quad.empty())
         return Status::InvalidArgument("orderBy needs neighbor results");
       NodeDef* target = st.dag->Find(st.last_node);
@@ -373,6 +433,16 @@ Status TranslateGql(const std::vector<GqlCall>& calls, TranslateResult* out) {
         st.cur_ids = st.last_outputs[1];
       }
     } else if (c.name == "limit") {
+      NodeDef* direct =
+          st.last_node.empty() ? nullptr : st.dag->Find(st.last_node);
+      if (direct != nullptr && direct->op == "API_GET_NB_EDGE") {
+        direct->post_process.push_back("limit " + argw(0, "0"));
+        continue;
+      }
+      NodeDef* prod = st.Producer();
+      if (prod != nullptr && prod->op == "API_GET_NB_EDGE") {
+        return Status::InvalidArgument("outE: put limit() before as()");
+      }
       if (st.last_quad.empty())
         return Status::InvalidArgument("limit needs neighbor results");
       NodeDef* target = st.dag->Find(st.last_node);
@@ -414,8 +484,9 @@ namespace {
 const std::unordered_set<std::string>& DeterministicOps() {
   static auto* s = new std::unordered_set<std::string>{
       "API_GET_NODE", "API_GET_NB_NODE", "API_GET_SORTED_NB_NODE",
-      "API_GET_RNB_NODE", "API_GET_TOPK_NB", "API_GET_P", "API_GET_EDGE_P",
-      "API_GET_NODE_T", "ID_UNIQUE", "POST_PROCESS", "API_GET_NB_FILTER"};
+      "API_GET_RNB_NODE", "API_GET_TOPK_NB", "API_GET_NB_EDGE", "API_GET_P",
+      "API_GET_EDGE_P", "API_GET_NODE_T", "ID_UNIQUE", "POST_PROCESS",
+      "API_GET_NB_FILTER"};
   return *s;
 }
 
@@ -479,8 +550,8 @@ bool IsGraphOp(const std::string& op) {
       "API_SAMPLE_NODE", "API_SAMPLE_EDGE", "API_SAMPLE_N_WITH_TYPES",
       "API_GET_NODE", "API_SAMPLE_NB", "API_GET_NB_NODE",
       "API_GET_SORTED_NB_NODE", "API_GET_RNB_NODE", "API_GET_TOPK_NB",
-      "API_GET_P", "API_GET_EDGE_P", "API_GET_NODE_T", "API_SAMPLE_L",
-      "API_GET_NB_FILTER"};
+      "API_GET_NB_EDGE", "API_GET_P", "API_GET_EDGE_P", "API_GET_NODE_T",
+      "API_SAMPLE_L", "API_GET_NB_FILTER"};
   return s->count(op) > 0;
 }
 
@@ -642,6 +713,8 @@ Status GpRewrite(const CompileOptions& opts, DAGDef* dag) {
       n_outs = 2 * nf;
     } else if (n.op == "API_GET_NODE_T") {
       n_outs = 1;
+    } else if (n.op == "API_GET_NB_EDGE") {
+      n_outs = 5;  // idx + (src, dst, type, weight)
     } else {
       n_outs = 4;  // quad ops
     }
@@ -698,6 +771,20 @@ Status GpRewrite(const CompileOptions& opts, DAGDef* dag) {
         collect.push_back(m + ":2");
       }
       rw.Add(orig, "COLLECT", collect, {});
+      continue;
+    }
+
+    if (n.op == "API_GET_NB_EDGE") {
+      std::vector<std::string> ins{ids_in};
+      for (int s = 0; s < S; ++s) {
+        ins.push_back(remotes[s] + ":0");  // pos
+        for (int o = 1; o <= 5; ++o)
+          ins.push_back(remotes[s] + ":" + std::to_string(o));
+      }
+      std::string m = rw.Add(rw.Fresh("GP_RAGGED_MERGE"), "GP_RAGGED_MERGE",
+                             ins, {"4"});
+      rw.Add(orig, "COLLECT",
+             {m + ":1", m + ":2", m + ":3", m + ":4", m + ":5"}, {});
       continue;
     }
 
@@ -964,6 +1051,8 @@ Status OptimizeDag(const CompileOptions& opts, DAGDef* dag) {
       n_outs = 1;
     } else if (n.op == "API_GET_NODE") {
       n_outs = 2;
+    } else if (n.op == "API_GET_NB_EDGE") {
+      n_outs = 5;  // idx + (src, dst, type, weight)
     } else {
       n_outs = 4;  // quad ops
     }
@@ -1026,6 +1115,25 @@ Status OptimizeDag(const CompileOptions& opts, DAGDef* dag) {
         collect_ins.push_back(g + ":1");
       }
       rw.Add(orig, "COLLECT", collect_ins, {});
+      continue;
+    }
+
+    if (n.op == "API_GET_NB_EDGE") {
+      // same ragged merge/gather as quads, one more payload column
+      std::vector<std::string> ins;
+      for (int s = 0; s < S; ++s) {
+        ins.push_back(split + ":" + std::to_string(2 * s + 1));
+        for (int o = 0; o < 5; ++o)
+          ins.push_back(remotes[s] + ":" + std::to_string(o));
+      }
+      std::string m =
+          rw.Add(rw.Fresh("RAGGED_MERGE"), "RAGGED_MERGE", ins, {"4"});
+      std::string g = rw.Add(
+          rw.Fresh("RAGGED_GATHER"), "RAGGED_GATHER",
+          {uniq + ":1", m + ":0", m + ":1", m + ":2", m + ":3", m + ":4"},
+          {"4"});
+      rw.Add(orig, "COLLECT",
+             {g + ":0", g + ":1", g + ":2", g + ":3", g + ":4"}, {});
       continue;
     }
 

@@ -419,6 +419,160 @@ class GetNbFilterOp : public OpKernel {
 };
 ET_REGISTER_KERNEL("API_GET_NB_FILTER", GetNbFilterOp);
 
+// API_GET_NB_EDGE — input 0: node ids; attr0: edge_types. Returns the
+// *edges* to each root's out-neighbors (reference
+// get_neighbor_edge_op.cc, GQL `outE` at gremlin.l:21), for
+// edge-feature chains: outputs feed API_GET_EDGE_P as an edge triple.
+// Conditions (dnf) are evaluated inline per edge — supported terms:
+// weight <cmp> v, edge_type <cmp> t, id in a:b:c (neighbor membership).
+// post_process: "order_by id|weight [asc|desc]" and "limit k", applied
+// per root row (reference applies them inside the op too).
+// out :0 idx i32 [n,2] | :1 src u64 | :2 dst u64 | :3 type i32 | :4 w f32
+class GetNbEdgeOp : public OpKernel {
+ public:
+  static bool Cmp(double a, const std::string& op, double b) {
+    if (op == "eq") return a == b;
+    if (op == "ne") return a != b;
+    if (op == "lt") return a < b;
+    if (op == "le") return a <= b;
+    if (op == "gt") return a > b;
+    if (op == "ge") return a >= b;
+    return false;
+  }
+
+  // pre-parsed dnf term: field ∈ {weight, edge_type, id}; id/edge_type
+  // "in"/"eq"/"ne" use the id set, numeric cmps use num.
+  struct Term {
+    enum Field { kWeight, kEdgeType, kId } field;
+    std::string op;
+    double num = 0;
+    std::vector<uint64_t> ids;
+  };
+
+  static Status ParseDnf(const std::vector<std::vector<std::string>>& dnf,
+                         std::vector<std::vector<Term>>* out) {
+    for (const auto& conj : dnf) {
+      std::vector<Term> terms;
+      for (const auto& term : conj) {
+        std::stringstream ss(term);
+        std::string attr, op_s, value;
+        ss >> attr >> op_s;
+        std::getline(ss, value);
+        if (!value.empty() && value[0] == ' ') value.erase(0, 1);
+        Term t;
+        t.op = op_s;
+        bool cmp_op = op_s == "eq" || op_s == "ne" || op_s == "lt" ||
+                      op_s == "le" || op_s == "gt" || op_s == "ge";
+        if (attr == "weight") {
+          if (!cmp_op)
+            return Status::InvalidArgument(
+                "outE weight condition supports eq/ne/lt/le/gt/ge, got: " +
+                op_s);
+          t.field = Term::kWeight;
+          t.num = std::atof(value.c_str());
+        } else if (attr == "edge_type" || attr == "id") {
+          if (!cmp_op && op_s != "in")
+            return Status::InvalidArgument(
+                "outE " + attr + " condition got unknown op: " + op_s);
+          t.field = attr == "id" ? Term::kId : Term::kEdgeType;
+          t.num = std::atof(value.c_str());
+          for (auto& v : SplitStr(value, ':'))
+            t.ids.push_back(std::strtoull(v.c_str(), nullptr, 10));
+          if (attr == "id" && op_s != "in" && op_s != "eq" && op_s != "ne")
+            return Status::InvalidArgument(
+                "outE id condition supports in/eq/ne, got: " + op_s);
+        } else {
+          return Status::InvalidArgument(
+              "outE condition supports weight/edge_type/id, got: " + attr);
+        }
+        terms.push_back(std::move(t));
+      }
+      out->push_back(std::move(terms));
+    }
+    return Status::OK();
+  }
+
+  static bool EdgeMatch(const std::vector<std::vector<Term>>& dnf,
+                        uint64_t dst, float w, int32_t ty) {
+    if (dnf.empty()) return true;
+    for (const auto& conj : dnf) {
+      bool all = true;
+      for (const auto& t : conj) {
+        bool ok;
+        if (t.field == Term::kWeight) {
+          ok = Cmp(w, t.op, t.num);
+        } else if (t.field == Term::kEdgeType) {
+          if (t.op == "in") {
+            ok = std::find(t.ids.begin(), t.ids.end(),
+                           static_cast<uint64_t>(ty)) != t.ids.end();
+          } else {
+            ok = Cmp(ty, t.op, t.num);
+          }
+        } else {  // kId: membership in the listed neighbor ids
+          bool member = std::find(t.ids.begin(), t.ids.end(), dst) !=
+                        t.ids.end();
+          ok = t.op == "ne" ? !member : member;
+        }
+        if (!ok) { all = false; break; }
+      }
+      if (all) return true;
+    }
+    return false;
+  }
+
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    Tensor ids_t;
+    ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &ids_t));
+    auto ets = ParseEdgeTypes(node.attrs.size() > 0 ? node.attrs[0] : "");
+    const uint64_t* ids = ids_t.Flat<uint64_t>();
+    int64_t n = ids_t.NumElements();
+    std::vector<uint64_t> offsets{0};
+    std::vector<uint64_t> src, dst;
+    std::vector<float> w;
+    std::vector<int32_t> t;
+    std::vector<NodeId> nb_row;
+    std::vector<float> w_row;
+    std::vector<int32_t> t_row;
+    RowPostProcess pp = RowPostProcess::Parse(node.post_process);
+    if (!pp.order_field.empty() && pp.order_field != "id" &&
+        pp.order_field != "weight") {
+      done(Status::InvalidArgument("outE order_by supports id|weight, got: " +
+                                   pp.order_field));
+      return;
+    }
+    std::vector<std::vector<Term>> dnf;
+    ET_K_RETURN_IF_ERROR(ParseDnf(node.dnf, &dnf));
+    for (int64_t i = 0; i < n; ++i) {
+      nb_row.clear();
+      w_row.clear();
+      t_row.clear();
+      env.graph->GetFullNeighbor(ids[i], ets.empty() ? nullptr : ets.data(),
+                                 ets.size(), &nb_row, &w_row, &t_row, false);
+      std::vector<size_t> keep;
+      keep.reserve(nb_row.size());
+      for (size_t j = 0; j < nb_row.size(); ++j)
+        if (EdgeMatch(dnf, nb_row[j], w_row[j], t_row[j])) keep.push_back(j);
+      pp.Apply(&keep, [&](size_t j) { return nb_row[j]; },
+               [&](size_t j) { return w_row[j]; });
+      for (size_t j : keep) {
+        src.push_back(ids[i]);
+        dst.push_back(nb_row[j]);
+        w.push_back(w_row[j]);
+        t.push_back(t_row[j]);
+      }
+      offsets.push_back(src.size());
+    }
+    ctx->Put(node.OutName(0), MakeIdx(offsets));
+    ctx->Put(node.OutName(1), Tensor::FromVector(src));
+    ctx->Put(node.OutName(2), Tensor::FromVector(dst));
+    ctx->Put(node.OutName(3), Tensor::FromVector(t));
+    ctx->Put(node.OutName(4), Tensor::FromVector(w));
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("API_GET_NB_EDGE", GetNbEdgeOp);
+
 // ---------------------------------------------------------------------------
 // API_GET_P — input 0: ids; attrs: feature names; optional "udf:<name>"
 // first attr applies a value-UDF (reference udf.h:33, applied in
@@ -660,19 +814,7 @@ class PostProcessOp : public OpKernel {
     ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 1, &ids_t));
     ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 2, &w_t));
     ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 3, &t_t));
-    std::string order_field;
-    bool desc = false;
-    int64_t limit = -1;
-    for (const auto& pp : node.post_process) {
-      auto parts = SplitStr(pp, ' ');
-      if (parts.empty()) continue;
-      if (parts[0] == "order_by" && parts.size() >= 2) {
-        order_field = parts[1];
-        desc = parts.size() >= 3 && parts[2] == "desc";
-      } else if (parts[0] == "limit" && parts.size() >= 2) {
-        limit = std::atoll(parts[1].c_str());
-      }
-    }
+    RowPostProcess pp = RowPostProcess::Parse(node.post_process);
     int64_t n = idx_t.dim(0);
     const int32_t* pidx = idx_t.Flat<int32_t>();
     const uint64_t* ids = ids_t.Flat<uint64_t>();
@@ -686,20 +828,8 @@ class PostProcessOp : public OpKernel {
       std::vector<int32_t> order;
       for (int32_t j = pidx[2 * i]; j < pidx[2 * i + 1]; ++j)
         order.push_back(j);
-      if (!order_field.empty()) {
-        std::stable_sort(order.begin(), order.end(),
-                         [&](int32_t a, int32_t b) {
-                           bool lt = order_field == "id"
-                                         ? ids[a] < ids[b]
-                                         : w[a] < w[b];
-                           return desc ? !lt && !(order_field == "id"
-                                                      ? ids[a] == ids[b]
-                                                      : w[a] == w[b])
-                                       : lt;
-                         });
-      }
-      if (limit >= 0 && static_cast<int64_t>(order.size()) > limit)
-        order.resize(limit);
+      pp.Apply(&order, [&](int32_t j) { return ids[j]; },
+               [&](int32_t j) { return w[j]; });
       for (int32_t j : order) {
         out_ids.push_back(ids[j]);
         out_w.push_back(w[j]);

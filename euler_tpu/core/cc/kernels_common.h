@@ -5,15 +5,59 @@
 #ifndef EULER_TPU_KERNELS_COMMON_H_
 #define EULER_TPU_KERNELS_COMMON_H_
 
+#include <algorithm>
 #include <cstdint>
 #include <limits>
+#include <sstream>
 #include <string>
+#include <vector>
 
 #include "common.h"
 #include "dag.h"
 #include "tensor.h"
 
 namespace et {
+
+// Per-row post-process spec ("order_by <field> [asc|desc]", "limit k") —
+// one parser shared by POST_PROCESS and API_GET_NB_EDGE so the two
+// kernels cannot drift on the wire format.
+struct RowPostProcess {
+  std::string order_field;
+  bool desc = false;
+  int64_t limit = -1;
+
+  static RowPostProcess Parse(const std::vector<std::string>& entries) {
+    RowPostProcess pp;
+    for (const auto& e : entries) {
+      std::stringstream ss(e);
+      std::string kind, a, b;
+      ss >> kind >> a >> b;
+      if (kind == "order_by" && !a.empty()) {
+        pp.order_field = a;
+        pp.desc = b == "desc";
+      } else if (kind == "limit" && !a.empty()) {
+        pp.limit = std::atoll(a.c_str());
+      }
+    }
+    return pp;
+  }
+
+  // Sort + truncate one row's element indices. id_at/w_at map an index to
+  // its sort keys; unknown fields sort by weight (the historical
+  // POST_PROCESS behavior — callers wanting strictness validate first).
+  template <typename Idx, typename IdAt, typename WAt>
+  void Apply(std::vector<Idx>* order, IdAt id_at, WAt w_at) const {
+    if (!order_field.empty()) {
+      bool by_id = order_field == "id";
+      std::stable_sort(order->begin(), order->end(), [&](Idx x, Idx y) {
+        if (by_id) return desc ? id_at(y) < id_at(x) : id_at(x) < id_at(y);
+        return desc ? w_at(y) < w_at(x) : w_at(x) < w_at(y);
+      });
+    }
+    if (limit >= 0 && static_cast<int64_t>(order->size()) > limit)
+      order->resize(limit);
+  }
+};
 
 // Ragged row offsets travel as i32 [n,2] tensors; a merged payload past
 // 2^31 elements would silently wrap, so every producer range-checks the

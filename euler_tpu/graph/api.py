@@ -426,6 +426,19 @@ class GraphEngine:
             )
             return res.offsets(), res.u64(), res.f32(), res.i32()
 
+    def get_neighbor_edges(self, ids, edge_types=None):
+        """The *edges* to each node's out-neighbors (reference
+        get_neighbor_edge_op.cc / GQL outE at gremlin.l:21).
+
+        Returns (offsets[n+1], src, dst, types, weights): CSR arrays where
+        row i's slice holds the (src=ids[i], dst, type) edge triples —
+        directly chainable into get_edge_dense_feature and friends.
+        """
+        ids = _u64(ids).ravel()
+        off, nb, w, t = self.get_full_neighbor(ids, edge_types=edge_types)
+        src = np.repeat(ids, np.diff(off.astype(np.int64)))
+        return off, src, nb, t, w
+
     @property
     def graph_label_count(self) -> int:
         return int(self._lib.etg_graph_label_count(self.h))

@@ -15,6 +15,7 @@ from euler_tpu.ops.feature_ops import (  # noqa: F401
 )
 from euler_tpu.ops.neighbor_ops import (  # noqa: F401
     get_full_neighbor,
+    get_neighbor_edges,
     get_sorted_full_neighbor,
     get_top_k_neighbor,
     sample_fanout,

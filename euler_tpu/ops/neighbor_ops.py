@@ -40,6 +40,13 @@ def get_sorted_full_neighbor(nodes, edge_types=None):
     )
 
 
+def get_neighbor_edges(nodes, edge_types=None):
+    """Edges to each node's out-neighbors (reference API_GET_NB_EDGE /
+    GQL outE): (offsets, src, dst, types, weights) CSR arrays whose
+    triples chain into feature_ops.get_edge_dense_feature."""
+    return get_graph().get_neighbor_edges(nodes, edge_types=edge_types)
+
+
 def get_top_k_neighbor(nodes, k: int, edge_types=None, default_node: int = 0):
     return get_graph().get_top_k_neighbor(
         nodes, k, edge_types=edge_types, default_id=default_node

@@ -511,3 +511,150 @@ def test_graph_label_ops_in_distribute_mode(labeled_graph, tmp_path):
         q.close()
         for s in servers:
             s.stop()
+
+
+# ---------------------------------------------------------------------------
+# outE / neighbor-edge traversal (reference get_neighbor_edge_op.cc +
+# gremlin.l:21 out_e → API_GET_NB_EDGE)
+# ---------------------------------------------------------------------------
+def test_compile_out_e():
+    text = compile_debug("v(roots).outE(*).as(e)")
+    assert "API_GET_NB_EDGE" in text
+    text = compile_debug("v(roots).outE(0).values(e_dense).as(f)")
+    assert "API_GET_NB_EDGE" in text
+    assert "API_GET_EDGE_P" in text
+
+
+def test_compile_out_e_distribute():
+    text = compile_debug("v(roots).outE(*).as(e)", shard_num=2,
+                         partition_num=2, mode="distribute")
+    assert "ID_UNIQUE" in text
+    assert text.count("= REMOTE(") == 2
+    assert "RAGGED_MERGE" in text and "RAGGED_GATHER" in text
+
+
+def test_out_e_local(ring_graph):
+    q = Query.local(ring_graph)
+    out = q.run("v(roots).outE(*).as(e)",
+                {"roots": np.array([1, 4], dtype=np.uint64)})
+    idx = out["e:0"].reshape(2, 2)
+    assert [list(r) for r in idx] == [[0, 2], [2, 4]]
+    # node i has a type-0 edge to i+1 (w=i) and a type-1 edge to i+2 (w=10+i)
+    assert list(out["e:1"]) == [1, 1, 4, 4]          # src
+    assert list(out["e:2"]) == [2, 3, 5, 6]          # dst
+    assert list(out["e:3"]) == [0, 1, 0, 1]          # type
+    np.testing.assert_allclose(out["e:4"], [1, 11, 4, 14])  # weight
+
+
+def test_out_e_typed_condition_order_limit(ring_graph):
+    q = Query.local(ring_graph)
+    # restrict to type 1
+    out = q.run("v(roots).outE(1).as(e)",
+                {"roots": np.array([2], dtype=np.uint64)})
+    assert list(out["e:2"]) == [4]
+    np.testing.assert_allclose(out["e:4"], [12])
+    # inline condition on weight
+    out = q.run("v(roots).outE(*).has(weight gt 10).as(e)",
+                {"roots": np.array([1, 2], dtype=np.uint64)})
+    assert list(out["e:3"]) == [1, 1]
+    # order by weight desc + limit 1 per root row
+    out = q.run("v(roots).outE(*).orderBy(weight, desc).limit(1).as(e)",
+                {"roots": np.array([3, 8], dtype=np.uint64)})
+    assert list(out["e:2"]) == [5, 10]  # the type-1 edge wins (w=10+i)
+    np.testing.assert_allclose(out["e:4"], [13, 18])
+
+
+def test_out_e_edge_feature_chain(ring_graph):
+    q = Query.local(ring_graph)
+    out = q.run("v(roots).outE(*).values(e_dense).as(f)",
+                {"roots": np.array([5], dtype=np.uint64)})
+    # e_dense of edge with weight w is [w, -w]; node 5 → w 5 (t0), 15 (t1)
+    vals = out["f:1"].reshape(2, 2)
+    np.testing.assert_allclose(vals, [[5, -5], [15, -15]])
+
+
+def test_out_e_remote_matches_local(ring_graph, two_shard_cluster):
+    q, _ = two_shard_cluster
+    lq = Query.local(ring_graph)
+    roots = np.array([1, 4, 1, 9], dtype=np.uint64)  # dup exercises gather
+    for gremlin in ("v(roots).outE(*).as(e)",
+                    "v(roots).outE(0).as(e)",
+                    "v(roots).outE(*).orderBy(weight, desc).limit(1).as(e)"):
+        lo = lq.run(gremlin, {"roots": roots})
+        ro = q.run(gremlin, {"roots": roots})
+        for k in ("e:0", "e:1", "e:2", "e:3"):
+            assert list(np.ravel(ro[k])) == list(np.ravel(lo[k])), (gremlin, k)
+        np.testing.assert_allclose(ro["e:4"], lo["e:4"])
+
+
+def test_out_e_remote_edge_features(ring_graph, two_shard_cluster):
+    q, _ = two_shard_cluster
+    out = q.run("v(roots).outE(*).values(e_dense).as(f)",
+                {"roots": np.array([5, 2], dtype=np.uint64)})
+    vals = out["f:1"].reshape(4, 2)
+    np.testing.assert_allclose(vals, [[5, -5], [15, -15], [2, -2], [12, -12]])
+
+
+def test_engine_get_neighbor_edges(ring_graph):
+    off, src, dst, t, w = ring_graph.get_neighbor_edges(
+        np.array([1, 4], dtype=np.uint64))
+    assert list(off) == [0, 2, 4]
+    assert list(src) == [1, 1, 4, 4]
+    assert list(dst) == [2, 3, 5, 6]
+    assert list(t) == [0, 1, 0, 1]
+    np.testing.assert_allclose(w, [1, 11, 4, 14])
+
+
+def test_out_e_then_node_traversal_values(ring_graph):
+    """outE leaves both an edge triple and a node set current; a later
+    node traversal must clear the edge triple so values() fetches NODE
+    features again (once returned stale edge features)."""
+    q = Query.local(ring_graph)
+    out = q.run("v(roots).outE(0).outV(0).values(f_dense).as(f)",
+                {"roots": np.array([1], dtype=np.uint64)})
+    # 1 -outE(0)-> edge to 2, outV(0) from 2 -> 3; f_dense of 3 = [8..11]
+    np.testing.assert_allclose(out["f:1"], [8, 9, 10, 11])
+
+
+def test_out_e_has_after_limit_rejected(ring_graph):
+    from euler_tpu.core.lib import EngineError
+
+    with pytest.raises(EngineError):
+        compile_debug("v(r).outE(*).limit(1).has(weight gt 10)")
+
+
+def test_out_e_id_ne_condition(ring_graph):
+    q = Query.local(ring_graph)
+    out = q.run("v(roots).outE(*).has(id ne 2).as(e)",
+                {"roots": np.array([1], dtype=np.uint64)})
+    assert list(out["e:2"]) == [3]  # edge to 2 excluded
+
+
+def test_out_e_order_limit_after_as_rejected(ring_graph):
+    """orderBy/limit after as() would retroactively mutate the aliased
+    edge set (the op holds the post-process), so it is a compile error —
+    the reference grammar likewise attaches edge post-process before AS
+    (gremlin.y:162-165)."""
+    from euler_tpu.core.lib import EngineError
+
+    with pytest.raises(EngineError, match="before as"):
+        compile_debug("v(r).outE(*).as(all).limit(1)")
+    with pytest.raises(EngineError, match="before as"):
+        compile_debug("v(r).outE(*).as(all).orderBy(weight, desc)")
+    # ordering before as() works and the alias sees the processed set
+    q = Query.local(ring_graph)
+    out = q.run("v(roots).outE(*).orderBy(weight, desc).limit(1).as(top)",
+                {"roots": np.array([3], dtype=np.uint64)})
+    assert list(out["top:2"]) == [5]
+    np.testing.assert_allclose(out["top:4"], [13])
+
+
+def test_out_e_bad_weight_op_rejected(ring_graph):
+    """Unsupported operators on weight terms must error, not silently
+    match nothing."""
+    from euler_tpu.core.lib import EngineError
+
+    q = Query.local(ring_graph)
+    with pytest.raises(EngineError):
+        q.run("v(r).outE(*).has(weight in 1:5).as(e)",
+              {"r": np.array([1], dtype=np.uint64)})
