@@ -115,6 +115,20 @@ int64_t etq_new_remote(const char* endpoints, uint64_t seed,
   return h;
 }
 
+int etq_index_dump(int64_t h, const char* dir) {
+  auto& r = QReg();
+  std::shared_ptr<et::QueryProxy> qp;
+  {
+    std::lock_guard<std::mutex> lk(r.mu);
+    auto it = r.proxies.find(h);
+    if (it == r.proxies.end()) return FailWith("bad proxy handle");
+    qp = it->second;
+  }
+  et::Status s = qp->DumpIndex(dir ? dir : "");
+  if (!s.ok()) return FailWith(s.message());
+  return 0;
+}
+
 // out: [queries, errors, total_us, last_us]
 int etq_stats(int64_t h, uint64_t* out) {
   auto& r = QReg();

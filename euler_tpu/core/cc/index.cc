@@ -1,10 +1,13 @@
 #include "index.h"
 
+#include <sys/stat.h>
+
 #include <algorithm>
 #include <cstdlib>
 #include <sstream>
 
 #include "graph.h"
+#include "io.h"
 
 namespace et {
 
@@ -244,6 +247,27 @@ IndexResult RangeSampleIndex::Lookup(CmpOp op,
 }
 
 // ---------------------------------------------------------------------------
+// HashRangeSampleIndex (reference hash_range_sample_index.h)
+// ---------------------------------------------------------------------------
+void HashRangeSampleIndex::Add(const std::string& term, double value,
+                               uint32_t row, float weight) {
+  sub_[term].Add(value, row, weight);
+}
+
+void HashRangeSampleIndex::Seal() {
+  for (auto& kv : sub_) kv.second.Seal();
+}
+
+IndexResult HashRangeSampleIndex::Lookup(CmpOp op,
+                                         const std::string& value) const {
+  auto p = value.find("::");
+  if (p == std::string::npos) return IndexResult();
+  auto it = sub_.find(value.substr(0, p));
+  if (it == sub_.end()) return IndexResult();
+  return it->second.Lookup(op, value.substr(p + 2));
+}
+
+// ---------------------------------------------------------------------------
 // IndexManager
 // ---------------------------------------------------------------------------
 Status IndexManager::BuildFromSpec(const Graph& g, const std::string& spec) {
@@ -251,23 +275,129 @@ Status IndexManager::BuildFromSpec(const Graph& g, const std::string& spec) {
   std::string item;
   while (std::getline(ss, item, ',')) {
     if (item.empty()) continue;
+    if (item.rfind("load:", 0) == 0) {
+      ET_RETURN_IF_ERROR(Load(item.substr(5)));
+      continue;
+    }
     auto pos = item.find(':');
     if (pos == std::string::npos)
       return Status::InvalidArgument("bad index spec item: " + item);
     std::string attr = item.substr(0, pos);
     std::string kind_s = item.substr(pos + 1);
-    IndexKind kind = (kind_s.find("range") != std::string::npos)
-                         ? IndexKind::kRange
-                         : IndexKind::kHash;
+    IndexKind kind;
+    if (kind_s.find("hash_range") != std::string::npos) {
+      kind = IndexKind::kHashRange;
+    } else if (kind_s.find("range") != std::string::npos) {
+      kind = IndexKind::kRange;
+    } else {
+      kind = IndexKind::kHash;
+    }
     ET_RETURN_IF_ERROR(Build(g, attr, kind));
   }
   return Status::OK();
 }
 
+namespace {
+
+// Per-row attribute accessors shared by the composite build: the hash
+// terms (stringified values) and numeric values of one attribute.
+Status RowHashTerms(const Graph& g, const std::string& attr, uint32_t row,
+                    std::vector<std::string>* out) {
+  out->clear();
+  const GraphMeta& meta = g.meta();
+  if (attr == "node_type" || attr == "label") {
+    int32_t t = g.node_type(row);
+    std::string name = (t >= 0 && t < (int)meta.node_type_names.size())
+                           ? meta.node_type_names[t]
+                           : std::to_string(t);
+    out->push_back(name);
+    if (name != std::to_string(t)) out->push_back(std::to_string(t));
+    return Status::OK();
+  }
+  int fid = -1;
+  for (size_t i = 0; i < meta.node_features.size(); ++i)
+    if (meta.node_features[i].name == attr) fid = static_cast<int>(i);
+  if (fid < 0) return Status::NotFound("no node feature named " + attr);
+  NodeId id = g.node_id(row);
+  const FeatureInfo& fi = meta.node_features[fid];
+  if (fi.kind == FeatureKind::kDense) {
+    float v;
+    g.GetDenseFeature(&id, 1, fid, 1, &v);
+    std::ostringstream os;
+    os << v;
+    out->push_back(os.str());
+  } else if (fi.kind == FeatureKind::kSparse) {
+    std::vector<uint64_t> offs, vals;
+    g.GetSparseFeature(&id, 1, fid, &offs, &vals);
+    for (uint64_t v : vals) out->push_back(std::to_string(v));
+  } else {
+    std::vector<uint64_t> offs;
+    std::vector<char> bytes;
+    g.GetBinaryFeature(&id, 1, fid, &offs, &bytes);
+    out->push_back(std::string(bytes.begin(), bytes.end()));
+  }
+  return Status::OK();
+}
+
+Status RowRangeValues(const Graph& g, const std::string& attr, uint32_t row,
+                      std::vector<double>* out) {
+  out->clear();
+  const GraphMeta& meta = g.meta();
+  if (attr == "node_type" || attr == "label") {
+    out->push_back(g.node_type(row));
+    return Status::OK();
+  }
+  int fid = -1;
+  for (size_t i = 0; i < meta.node_features.size(); ++i)
+    if (meta.node_features[i].name == attr) fid = static_cast<int>(i);
+  if (fid < 0) return Status::NotFound("no node feature named " + attr);
+  const FeatureInfo& fi = meta.node_features[fid];
+  NodeId id = g.node_id(row);
+  if (fi.kind == FeatureKind::kDense) {
+    float v;
+    g.GetDenseFeature(&id, 1, fid, 1, &v);
+    out->push_back(v);
+  } else if (fi.kind == FeatureKind::kSparse) {
+    std::vector<uint64_t> offs, vals;
+    g.GetSparseFeature(&id, 1, fid, &offs, &vals);
+    for (uint64_t v : vals) out->push_back(static_cast<double>(v));
+  } else {
+    return Status::InvalidArgument(
+        "binary feature cannot be the range half of a composite index: " +
+        attr);
+  }
+  return Status::OK();
+}
+
+}  // namespace
+
 Status IndexManager::Build(const Graph& g, const std::string& attr,
                            IndexKind kind) {
   const GraphMeta& meta = g.meta();
   size_t n = g.node_count();
+
+  if (kind == IndexKind::kHashRange) {
+    // composite "A+B": per-term sub-range-index (reference
+    // HashRangeSampleIndex — one lookup serves "A eq x and B cmp v")
+    auto plus = attr.find('+');
+    if (plus == std::string::npos)
+      return Status::InvalidArgument(
+          "hash_range_index needs 'attrA+attrB', got: " + attr);
+    std::string ha = attr.substr(0, plus), ra = attr.substr(plus + 1);
+    auto idx = std::make_unique<HashRangeSampleIndex>();
+    std::vector<std::string> terms;
+    std::vector<double> vals;
+    for (uint32_t row = 0; row < n; ++row) {
+      ET_RETURN_IF_ERROR(RowHashTerms(g, ha, row, &terms));
+      ET_RETURN_IF_ERROR(RowRangeValues(g, ra, row, &vals));
+      float w = g.node_weight(row);
+      for (const auto& t : terms)
+        for (double v : vals) idx->Add(t, v, row, w);
+    }
+    idx->Seal();
+    indexes_[attr] = std::move(idx);
+    return Status::OK();
+  }
 
   auto add_all = [&](auto* idx, auto&& value_of) {
     for (uint32_t row = 0; row < n; ++row) value_of(idx, row);
@@ -395,15 +525,51 @@ Status IndexManager::EvalDnf(
   IndexResult acc;
   bool first_disj = true;
   for (const auto& conj : dnf) {
+    // Parse all terms up front so compound predicates can be paired onto
+    // a composite hash_range index: "A eq X and B cmp V" with an "A+B"
+    // index becomes ONE sub-index lookup (reference
+    // HashRangeSampleIndex) instead of intersecting two posting lists.
+    struct PTerm {
+      std::string attr, op_s, value;
+      bool consumed = false;
+    };
+    std::vector<PTerm> terms;
+    for (const auto& term : conj) {
+      std::stringstream ss(term);
+      PTerm t;
+      ss >> t.attr >> t.op_s;
+      std::getline(ss, t.value);
+      if (!t.value.empty() && t.value[0] == ' ') t.value.erase(0, 1);
+      terms.push_back(std::move(t));
+    }
     IndexResult conj_res;
     bool first_term = true;
-    for (const auto& term : conj) {
-      // "attr op value"
-      std::stringstream ss(term);
-      std::string attr, op_s, value;
-      ss >> attr >> op_s;
-      std::getline(ss, value);
-      if (!value.empty() && value[0] == ' ') value.erase(0, 1);
+    auto fold = [&](IndexResult r) {
+      conj_res = first_term ? std::move(r)
+                            : IndexResult::Intersect(conj_res, r);
+      first_term = false;
+    };
+    for (size_t i = 0; i < terms.size(); ++i) {
+      if (terms[i].consumed || terms[i].op_s != "eq") continue;
+      for (size_t j = 0; j < terms.size(); ++j) {
+        if (i == j || terms[j].consumed) continue;
+        const std::string& jo = terms[j].op_s;
+        if (jo != "lt" && jo != "le" && jo != "gt" && jo != "ge" &&
+            jo != "eq")
+          continue;
+        const SampleIndex* ci = Find(terms[i].attr + "+" + terms[j].attr);
+        if (ci == nullptr || ci->kind() != IndexKind::kHashRange) continue;
+        fold(ci->Lookup(ParseCmpOp(jo),
+                        terms[i].value + "::" + terms[j].value));
+        terms[i].consumed = terms[j].consumed = true;
+        break;
+      }
+    }
+    for (const auto& pt : terms) {
+      if (pt.consumed) continue;
+      const std::string& attr = pt.attr;
+      const std::string& op_s = pt.op_s;
+      const std::string& value = pt.value;
       IndexResult r;
       if (attr == "id") {
         // direct id membership against the graph — no index required
@@ -431,14 +597,153 @@ Status IndexManager::EvalDnf(
           return Status::NotFound("no index for attribute " + attr);
         r = idx->Lookup(ParseCmpOp(op_s), value);
       }
-      conj_res = first_term ? std::move(r)
-                            : IndexResult::Intersect(conj_res, r);
-      first_term = false;
+      fold(std::move(r));
     }
     acc = first_disj ? std::move(conj_res) : IndexResult::Union(acc, conj_res);
     first_disj = false;
   }
   *out = std::move(acc);
+  return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// Persistence (reference index_manager.h:34,54: servers load a serialized
+// Index/ dir instead of rebuilding from columns at every start)
+// ---------------------------------------------------------------------------
+namespace {
+
+void PutResult(const IndexResult& r, ByteWriter* w) {
+  w->Put<uint64_t>(r.rows.size());
+  w->PutRaw(r.rows.data(), r.rows.size() * sizeof(uint32_t));
+  w->PutRaw(r.weights.data(), r.weights.size() * sizeof(float));
+}
+
+Status GetResult(ByteReader* r, IndexResult* out) {
+  uint64_t n;
+  if (!r->Get(&n)) return Status::Internal("index: truncated result");
+  // validate against the remaining payload BEFORE resizing — a corrupt
+  // count must surface as a Status, not a std::length_error abort
+  if (n > r->remaining() / (sizeof(uint32_t) + sizeof(float)))
+    return Status::Internal("index: corrupt result count");
+  out->rows.resize(n);
+  out->weights.resize(n);
+  if (!r->GetRaw(out->rows.data(), n * sizeof(uint32_t)) ||
+      !r->GetRaw(out->weights.data(), n * sizeof(float)))
+    return Status::Internal("index: truncated result payload");
+  return Status::OK();
+}
+
+}  // namespace
+
+void HashSampleIndex::Serialize(ByteWriter* w) const {
+  w->Put<uint64_t>(postings_.size());
+  for (const auto& kv : postings_) {
+    w->PutStr(kv.first);
+    PutResult(kv.second, w);
+  }
+  PutResult(all_, w);
+}
+
+Status HashSampleIndex::Deserialize(ByteReader* r) {
+  uint64_t n;
+  if (!r->Get(&n)) return Status::Internal("hash index: truncated");
+  for (uint64_t i = 0; i < n; ++i) {
+    std::string term;
+    if (!r->GetStr(&term)) return Status::Internal("hash index: bad term");
+    ET_RETURN_IF_ERROR(GetResult(r, &postings_[term]));
+  }
+  return GetResult(r, &all_);
+}
+
+void RangeSampleIndex::Serialize(ByteWriter* w) const {
+  w->Put<uint64_t>(entries_.size());
+  for (const auto& e : entries_) {
+    w->Put<double>(e.value);
+    w->Put<uint32_t>(e.row);
+    w->Put<float>(e.weight);
+  }
+}
+
+Status RangeSampleIndex::Deserialize(ByteReader* r) {
+  uint64_t n;
+  if (!r->Get(&n)) return Status::Internal("range index: truncated");
+  if (n > r->remaining() / (sizeof(double) + sizeof(uint32_t) +
+                            sizeof(float)))
+    return Status::Internal("range index: corrupt entry count");
+  entries_.resize(n);
+  for (uint64_t i = 0; i < n; ++i) {
+    if (!r->Get(&entries_[i].value) || !r->Get(&entries_[i].row) ||
+        !r->Get(&entries_[i].weight))
+      return Status::Internal("range index: truncated entry");
+  }
+  return Status::OK();  // entries were dumped sealed (sorted)
+}
+
+void HashRangeSampleIndex::Serialize(ByteWriter* w) const {
+  w->Put<uint64_t>(sub_.size());
+  for (const auto& kv : sub_) {
+    w->PutStr(kv.first);
+    kv.second.Serialize(w);
+  }
+}
+
+Status HashRangeSampleIndex::Deserialize(ByteReader* r) {
+  uint64_t n;
+  if (!r->Get(&n)) return Status::Internal("hash_range index: truncated");
+  for (uint64_t i = 0; i < n; ++i) {
+    std::string term;
+    if (!r->GetStr(&term))
+      return Status::Internal("hash_range index: bad term");
+    ET_RETURN_IF_ERROR(sub_[term].Deserialize(r));
+  }
+  return Status::OK();
+}
+
+Status IndexManager::Dump(const std::string& dir) const {
+  ::mkdir(dir.c_str(), 0755);  // best-effort; write below reports failure
+  ByteWriter w;
+  w.Put<uint32_t>(0x45544958u);  // 'ETIX'
+  w.Put<uint32_t>(1u);           // version
+  w.Put<uint32_t>(static_cast<uint32_t>(indexes_.size()));
+  for (const auto& kv : indexes_) {
+    w.PutStr(kv.first);
+    w.Put<int32_t>(static_cast<int32_t>(kv.second->kind()));
+    kv.second->Serialize(&w);
+  }
+  return WriteStringToFile(dir + "/index.bin", w.buffer().data(),
+                           w.buffer().size());
+}
+
+Status IndexManager::Load(const std::string& dir) {
+  std::string blob;
+  ET_RETURN_IF_ERROR(ReadFileToString(dir + "/index.bin", &blob));
+  ByteReader r(blob.data(), blob.size());
+  uint32_t magic, ver, count;
+  if (!r.Get(&magic) || magic != 0x45544958u)
+    return Status::InvalidArgument(dir + ": not an index dump");
+  if (!r.Get(&ver) || ver != 1)
+    return Status::InvalidArgument(dir + ": unsupported index version");
+  if (!r.Get(&count)) return Status::Internal("index dump truncated");
+  for (uint32_t i = 0; i < count; ++i) {
+    std::string name;
+    int32_t kind;
+    if (!r.GetStr(&name) || !r.Get(&kind))
+      return Status::Internal("index dump: bad header");
+    std::unique_ptr<SampleIndex> idx;
+    switch (static_cast<IndexKind>(kind)) {
+      case IndexKind::kHash: idx = std::make_unique<HashSampleIndex>(); break;
+      case IndexKind::kRange:
+        idx = std::make_unique<RangeSampleIndex>();
+        break;
+      case IndexKind::kHashRange:
+        idx = std::make_unique<HashRangeSampleIndex>();
+        break;
+      default:
+        return Status::InvalidArgument("index dump: unknown kind");
+    }
+    ET_RETURN_IF_ERROR(idx->Deserialize(&r));
+    indexes_[name] = std::move(idx);
+  }
   return Status::OK();
 }
 

@@ -45,11 +45,14 @@ struct IndexResult {
   void Sample(size_t count, Pcg32* rng, uint32_t* out) const;
 };
 
-enum class IndexKind : int { kHash = 0, kRange = 1 };
+enum class IndexKind : int { kHash = 0, kRange = 1, kHashRange = 2 };
 enum class CmpOp : int { kEq, kNe, kLt, kLe, kGt, kGe, kIn, kHasKey };
 
 // "eq","ne","lt","le","gt","ge","in","hk" (hasKey)
 CmpOp ParseCmpOp(const std::string& s);
+
+class ByteWriter;
+class ByteReader;
 
 // One indexed attribute over all local nodes.
 class SampleIndex {
@@ -58,6 +61,10 @@ class SampleIndex {
   virtual IndexKind kind() const = 0;
   // `value` is the RHS literal; for kIn it is a ::-separated list.
   virtual IndexResult Lookup(CmpOp op, const std::string& value) const = 0;
+  // binary persistence (reference index_manager.h:34,54 loads a
+  // serialized Index/ dir instead of rebuilding from columns)
+  virtual void Serialize(ByteWriter* w) const = 0;
+  virtual Status Deserialize(ByteReader* r) = 0;
 };
 
 // Equality index: term → postings. Terms are stringified attribute values.
@@ -66,6 +73,8 @@ class HashSampleIndex : public SampleIndex {
  public:
   IndexKind kind() const override { return IndexKind::kHash; }
   IndexResult Lookup(CmpOp op, const std::string& value) const override;
+  void Serialize(ByteWriter* w) const override;
+  Status Deserialize(ByteReader* r) override;
 
   void Add(const std::string& term, uint32_t row, float weight);
   void Seal();  // sort postings, build the all-rows list
@@ -81,6 +90,8 @@ class RangeSampleIndex : public SampleIndex {
  public:
   IndexKind kind() const override { return IndexKind::kRange; }
   IndexResult Lookup(CmpOp op, const std::string& value) const override;
+  void Serialize(ByteWriter* w) const override;
+  Status Deserialize(ByteReader* r) override;
 
   void Add(double value, uint32_t row, float weight);
   void Seal();
@@ -95,6 +106,26 @@ class RangeSampleIndex : public SampleIndex {
   IndexResult RangeToResult(size_t begin, size_t end) const;
 };
 
+// Composite equality+range index (reference HashRangeSampleIndex,
+// hash_range_sample_index.h): one RangeSampleIndex per hash term, so a
+// compound predicate "A eq X and B < v" is served by ONE O(log) lookup
+// on the per-term sub-index instead of intersecting two posting lists.
+// Lookup value format mirrors the reference: "<hash term>::<range rhs>",
+// with `op` applying to the range part.
+class HashRangeSampleIndex : public SampleIndex {
+ public:
+  IndexKind kind() const override { return IndexKind::kHashRange; }
+  IndexResult Lookup(CmpOp op, const std::string& value) const override;
+  void Serialize(ByteWriter* w) const override;
+  Status Deserialize(ByteReader* r) override;
+
+  void Add(const std::string& term, double value, uint32_t row, float weight);
+  void Seal();
+
+ private:
+  std::map<std::string, RangeSampleIndex> sub_;
+};
+
 // Owns all indexes for one graph. Attribute sources:
 //   "node_type"          — the node's type id (hash or range)
 //   dense feature name   — scalar value at dim 0 (range) or stringified (hash)
@@ -104,11 +135,19 @@ class RangeSampleIndex : public SampleIndex {
 // json2partindex pipeline, collapsed into post-load Build calls.
 class IndexManager {
  public:
-  // spec: comma-separated "attr:hash_index" / "attr:range_index" pairs,
-  // e.g. "price:range_index,label:hash_index" (reference index_info format,
-  // parser/compiler_test.cc:169).
+  // spec: comma-separated "attr:hash_index" / "attr:range_index" /
+  // "attrA+attrB:hash_range_index" items, e.g.
+  // "price:range_index,att+price:hash_range_index" (reference index_info
+  // format, parser/compiler_test.cc:169, incl. the composite). The
+  // special item "load:<dir>" loads a previously dumped index directory
+  // instead of rebuilding from columns.
   Status BuildFromSpec(const Graph& g, const std::string& spec);
   Status Build(const Graph& g, const std::string& attr, IndexKind kind);
+
+  // Persist/restore all built indexes (reference IndexManager loads a
+  // serialized Index/ dir, index_manager.h:34,54).
+  Status Dump(const std::string& dir) const;
+  Status Load(const std::string& dir);
 
   const SampleIndex* Find(const std::string& attr) const;
   bool has(const std::string& attr) const { return Find(attr) != nullptr; }

@@ -26,6 +26,7 @@
 #include "kernels_common.h"
 #include "ops.h"
 #include "tensor.h"
+#include "udf.h"
 
 namespace et {
 namespace {
@@ -574,49 +575,12 @@ class GetNbEdgeOp : public OpKernel {
 ET_REGISTER_KERNEL("API_GET_NB_EDGE", GetNbEdgeOp);
 
 // ---------------------------------------------------------------------------
-// API_GET_P — input 0: ids; attrs: feature names; optional "udf:<name>"
-// first attr applies a value-UDF (reference udf.h:33, applied in
-// API_GET_P). Per feature f: out :2f = idx, :2f+1 = values.
+// API_GET_P — input 0: ids; attrs: feature names; optional
+// "udf:<name>[:p1:p2...]" first attr applies a registered value-UDF with
+// numeric params (reference udf.h:33-68, applied in API_GET_P; registry
+// + built-ins live in udf.cc). Per feature f: out :2f = idx, :2f+1 =
+// values.
 // ---------------------------------------------------------------------------
-using UdfFn = void (*)(const std::vector<uint64_t>& offsets,
-                       std::vector<float>* values);
-
-void MeanUdf(const std::vector<uint64_t>& offs, std::vector<float>* v) {
-  std::vector<float> out;
-  for (size_t i = 0; i + 1 < offs.size(); ++i) {
-    float s = 0;
-    uint64_t n = offs[i + 1] - offs[i];
-    for (uint64_t j = offs[i]; j < offs[i + 1]; ++j) s += (*v)[j];
-    out.push_back(n ? s / n : 0);
-  }
-  *v = std::move(out);
-}
-void MaxUdf(const std::vector<uint64_t>& offs, std::vector<float>* v) {
-  std::vector<float> out;
-  for (size_t i = 0; i + 1 < offs.size(); ++i) {
-    float m = -std::numeric_limits<float>::infinity();
-    for (uint64_t j = offs[i]; j < offs[i + 1]; ++j) m = std::max(m, (*v)[j]);
-    out.push_back(offs[i + 1] > offs[i] ? m : 0);
-  }
-  *v = std::move(out);
-}
-void MinUdf(const std::vector<uint64_t>& offs, std::vector<float>* v) {
-  std::vector<float> out;
-  for (size_t i = 0; i + 1 < offs.size(); ++i) {
-    float m = std::numeric_limits<float>::infinity();
-    for (uint64_t j = offs[i]; j < offs[i + 1]; ++j) m = std::min(m, (*v)[j]);
-    out.push_back(offs[i + 1] > offs[i] ? m : 0);
-  }
-  *v = std::move(out);
-}
-
-UdfFn LookupUdf(const std::string& name) {
-  if (name == "mean") return MeanUdf;
-  if (name == "max") return MaxUdf;
-  if (name == "min") return MinUdf;
-  return nullptr;
-}
-
 class GetFeatureOp : public OpKernel {
  public:
   void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
@@ -625,10 +589,18 @@ class GetFeatureOp : public OpKernel {
     ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 0, &ids_t));
     const uint64_t* ids = ids_t.Flat<uint64_t>();
     int64_t n = ids_t.NumElements();
-    UdfFn udf = nullptr;
+    ValueUdf udf;
+    std::vector<double> udf_params;
     size_t a0 = 0;
     if (!node.attrs.empty() && node.attrs[0].rfind("udf:", 0) == 0) {
-      udf = LookupUdf(node.attrs[0].substr(4));
+      std::string uname;
+      ET_K_RETURN_IF_ERROR(
+          ParseUdfSpec(node.attrs[0].substr(4), &uname, &udf_params));
+      udf = UdfRegistry::Instance().Find(uname);
+      if (!udf) {
+        done(Status::NotFound("no registered udf named " + uname));
+        return;
+      }
       a0 = 1;
     }
     int out_i = 0;
@@ -643,10 +615,8 @@ class GetFeatureOp : public OpKernel {
         env.graph->GetDenseFeature(ids, n, fid, dim, vals.data());
         std::vector<uint64_t> offs(n + 1);
         for (int64_t i = 0; i <= n; ++i) offs[i] = i * dim;
-        if (udf != nullptr) {
-          udf(offs, &vals);
-          for (int64_t i = 0; i <= n; ++i) offs[i] = i;
-        }
+        if (udf)
+          ET_K_RETURN_IF_ERROR(udf(udf_params, &offs, &vals));
         ctx->Put(node.OutName(out_i), MakeIdx(offs));
         ctx->Put(node.OutName(out_i + 1),
                  Tensor::FromVector(vals));

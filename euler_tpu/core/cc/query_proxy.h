@@ -49,6 +49,13 @@ class QueryProxy {
     return client_ ? client_->shard_num() : 1;
   }
 
+  // Persist the local-mode index (reference: serialized Index/ dir,
+  // index_manager.h:34,54); load back via index_spec "load:<dir>".
+  Status DumpIndex(const std::string& dir) const {
+    if (!index_) return Status::InvalidArgument("no local index to dump");
+    return index_->Dump(dir);
+  }
+
   // Per-proxy query timing (aux parity: the reference's ad-hoc
   // TimmerBegin/GetTimmerInterval, euler/common/timmer.h — surfaced as
   // counters instead of log lines). All monotonically increasing.

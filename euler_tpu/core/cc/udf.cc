@@ -1,0 +1,173 @@
+// Value-UDF registry + built-ins (reference udf.h:33-68, mean_udf.cc,
+// min_udf.cc, max_udf.cc — plus parameterized built-ins `scale` and
+// `clip` demonstrating the reference's param-node mechanism as plain
+// numeric params).
+#include "udf.h"
+
+#include <algorithm>
+#include <cstdlib>
+#include <limits>
+#include <sstream>
+
+namespace et {
+
+UdfRegistry& UdfRegistry::Instance() {
+  static UdfRegistry* r = new UdfRegistry();
+  return *r;
+}
+
+void UdfRegistry::Register(const std::string& name, ValueUdf fn) {
+  std::lock_guard<std::mutex> lk(mu_);
+  fns_[name] = std::move(fn);
+}
+
+ValueUdf UdfRegistry::Find(const std::string& name) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = fns_.find(name);
+  return it == fns_.end() ? ValueUdf() : it->second;
+}
+
+std::vector<std::string> UdfRegistry::Names() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<std::string> out;
+  for (auto& kv : fns_) out.push_back(kv.first);
+  std::sort(out.begin(), out.end());
+  return out;
+}
+
+Status ParseUdfSpec(const std::string& spec, std::string* name,
+                    std::vector<double>* params) {
+  params->clear();
+  std::stringstream ss(spec);
+  std::string part;
+  if (!std::getline(ss, part, ':') || part.empty())
+    return Status::InvalidArgument("empty udf name in spec: " + spec);
+  *name = part;
+  while (std::getline(ss, part, ':')) {
+    char* end = nullptr;
+    double v = std::strtod(part.c_str(), &end);
+    if (end == part.c_str() || *end != '\0')
+      return Status::InvalidArgument("bad udf param '" + part + "' in " +
+                                     spec);
+    params->push_back(v);
+  }
+  return Status::OK();
+}
+
+namespace {
+
+// Per-row reduction helper: out row i is one value.
+template <typename Fold>
+Status Reduce(std::vector<uint64_t>* offs, std::vector<float>* vals,
+              float init, Fold fold, bool mean) {
+  std::vector<float> out;
+  size_t n = offs->size() - 1;
+  out.reserve(n);
+  for (size_t i = 0; i < n; ++i) {
+    float acc = init;
+    uint64_t len = (*offs)[i + 1] - (*offs)[i];
+    for (uint64_t j = (*offs)[i]; j < (*offs)[i + 1]; ++j)
+      acc = fold(acc, (*vals)[j]);
+    if (mean) acc = len ? acc / len : 0.f;
+    out.push_back(len ? acc : 0.f);
+  }
+  *vals = std::move(out);
+  for (size_t i = 0; i <= n; ++i) (*offs)[i] = i;
+  return Status::OK();
+}
+
+struct BuiltinsInstaller {
+  BuiltinsInstaller() {
+    auto& r = UdfRegistry::Instance();
+    r.Register("mean", [](const std::vector<double>&,
+                          std::vector<uint64_t>* o, std::vector<float>* v) {
+      return Reduce(o, v, 0.f, [](float a, float b) { return a + b; }, true);
+    });
+    r.Register("max", [](const std::vector<double>&,
+                         std::vector<uint64_t>* o, std::vector<float>* v) {
+      return Reduce(o, v, -std::numeric_limits<float>::infinity(),
+                    [](float a, float b) { return std::max(a, b); }, false);
+    });
+    r.Register("min", [](const std::vector<double>&,
+                         std::vector<uint64_t>* o, std::vector<float>* v) {
+      return Reduce(o, v, std::numeric_limits<float>::infinity(),
+                    [](float a, float b) { return std::min(a, b); }, false);
+    });
+    // parameterized built-ins (reference param-node parity)
+    r.Register("scale", [](const std::vector<double>& p,
+                           std::vector<uint64_t>*, std::vector<float>* v) {
+      if (p.size() != 1)
+        return Status::InvalidArgument("udf scale needs 1 param (factor)");
+      for (auto& x : *v) x = static_cast<float>(x * p[0]);
+      return Status::OK();
+    });
+    r.Register("clip", [](const std::vector<double>& p,
+                          std::vector<uint64_t>*, std::vector<float>* v) {
+      if (p.size() != 2)
+        return Status::InvalidArgument("udf clip needs 2 params (lo, hi)");
+      for (auto& x : *v)
+        x = std::min(std::max(x, static_cast<float>(p[0])),
+                     static_cast<float>(p[1]));
+      return Status::OK();
+    });
+  }
+};
+BuiltinsInstaller installer;
+
+}  // namespace
+}  // namespace et
+
+// ---------------------------------------------------------------------------
+// C ABI: Python registers custom UDFs through ctypes (the TPU build's
+// version of the reference's compiled-in UDF subclasses).
+// The callback fills the output through et_udf_emit on the handed-out
+// builder pointer; returning nonzero signals failure.
+// ---------------------------------------------------------------------------
+extern "C" {
+
+typedef int (*et_udf_cb)(const double* params, int64_t n_params,
+                         const uint64_t* offs, int64_t n_rows,
+                         const float* vals, int64_t n_vals, void* out);
+
+struct EtUdfOut {
+  std::vector<uint64_t>* offs;
+  std::vector<float>* vals;
+};
+
+void et_udf_emit(void* out, const uint64_t* offs, int64_t n_offs,
+                 const float* vals, int64_t n_vals) {
+  auto* o = static_cast<EtUdfOut*>(out);
+  o->offs->assign(offs, offs + n_offs);
+  o->vals->assign(vals, vals + n_vals);
+}
+
+void etg_register_udf(const char* name, et_udf_cb cb) {
+  std::string n = name;
+  et::UdfRegistry::Instance().Register(
+      n, [cb, n](const std::vector<double>& params,
+                 std::vector<uint64_t>* offs, std::vector<float>* vals) {
+        std::vector<uint64_t> out_offs;
+        std::vector<float> out_vals;
+        EtUdfOut out{&out_offs, &out_vals};
+        int rc = cb(params.data(), static_cast<int64_t>(params.size()),
+                    offs->data(), static_cast<int64_t>(offs->size()) - 1,
+                    vals->data(), static_cast<int64_t>(vals->size()), &out);
+        if (rc != 0)
+          return et::Status::Internal("python udf '" + n + "' failed rc=" +
+                                      std::to_string(rc));
+        if (out_offs.empty())
+          return et::Status::Internal("python udf '" + n +
+                                      "' emitted no output");
+        if (out_offs.front() != 0 ||
+            out_offs.back() != out_vals.size())
+          return et::Status::Internal(
+              "python udf '" + n + "' emitted inconsistent ragged output: "
+              "offsets[-1]=" + std::to_string(out_offs.back()) +
+              " but " + std::to_string(out_vals.size()) + " values");
+        *offs = std::move(out_offs);
+        *vals = std::move(out_vals);
+        return et::Status::OK();
+      });
+}
+
+}  // extern "C"

@@ -1,0 +1,54 @@
+// Registerable value-UDF framework (capability parity with the
+// reference's euler/core/framework/udf.h:33-68: named UDFs resolved by
+// the feature op, with a process-wide cache; built-ins
+// min/max/mean like min_udf.cc / max_udf.cc / mean_udf.cc).
+//
+// Redesign for the TPU build: a UDF is a std::function transforming one
+// ragged float column in place (offsets + values), optionally
+// parameterized — the GQL attr "udf:name:p1:p2" carries numeric params
+// (the reference's ParamsVec). The registry accepts C-ABI callbacks so
+// Python can register custom UDFs through ctypes without recompiling.
+#ifndef EULER_TPU_UDF_H_
+#define EULER_TPU_UDF_H_
+
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace et {
+
+// Transforms a ragged float column in place. offs has n_rows+1 entries;
+// vals has offs.back() entries. Implementations may change both row
+// lengths and values, but must keep offs/vals consistent.
+using ValueUdf = std::function<Status(const std::vector<double>& params,
+                                      std::vector<uint64_t>* offs,
+                                      std::vector<float>* vals)>;
+
+class UdfRegistry {
+ public:
+  static UdfRegistry& Instance();
+
+  // Last registration wins (lets tests/users override built-ins).
+  void Register(const std::string& name, ValueUdf fn);
+  // Returns a COPY under the lock (a pointer into the map would race
+  // with concurrent re-registration); empty function when unknown.
+  ValueUdf Find(const std::string& name) const;
+  std::vector<std::string> Names() const;
+
+ private:
+  mutable std::mutex mu_;
+  std::unordered_map<std::string, ValueUdf> fns_;
+};
+
+// Parse "udf:name:p1:p2" (after the "udf:" prefix) → (name, params).
+Status ParseUdfSpec(const std::string& spec, std::string* name,
+                    std::vector<double>* params);
+
+}  // namespace et
+
+#endif  // EULER_TPU_UDF_H_

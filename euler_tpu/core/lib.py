@@ -148,6 +148,9 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etq_new_remote": (i64, [ctypes.c_char_p, u64, ctypes.c_char_p]),
         "etq_free": (i32, [i64]),
         "etq_stats": (i32, [i64, c_u64p]),
+        "etq_index_dump": (i32, [i64, ctypes.c_char_p]),
+        "etg_register_udf": (None, [ctypes.c_char_p, c_voidp]),
+        "et_udf_emit": (None, [c_voidp, c_u64p, i64, c_f32p, i64]),
         "etq_exec_new": (i64, [i64]),
         "etq_exec_add_input": (i32, [i64, ctypes.c_char_p, i32, i32, c_i64p, c_voidp]),
         "etq_exec_run": (i32, [i64, ctypes.c_char_p]),

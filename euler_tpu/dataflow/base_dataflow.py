@@ -195,13 +195,21 @@ class LayerwiseDataFlow(DataFlow):
     """LADIES-style layerwise batches (reference layerwise_dataflow.py:26):
     per-layer importance-sampled pools + dense inter-pool adjacency."""
 
-    def __init__(self, graph, layer_sizes: Sequence[int], edge_types=None, **kw):
+    def __init__(self, graph, layer_sizes: Sequence[int], edge_types=None,
+                 sample: bool = True, **kw):
+        """sample=False expands exact 1-hop closures instead of sampled
+        pools — FastGCN's standard eval mode (train with importance
+        sampling, evaluate with the full propagation matrix)."""
         super().__init__(graph, **kw)
         self.layer_sizes = list(layer_sizes)
         self.edge_types = edge_types
+        self.sample = sample
 
     def _dense_adj(self, rows: np.ndarray, cols: np.ndarray) -> np.ndarray:
-        """Row-normalized dense adjacency [len(rows), len(cols)]."""
+        """Row-normalized dense adjacency [len(rows), len(cols)] of
+        Â = A + I restricted to the sampled pool (FastGCN/LADIES use the
+        self-loop-augmented GCN propagation matrix — without the diagonal
+        a root whose neighbors missed the pool gets a zero embedding)."""
         col_pos: Dict[int, List[int]] = {}
         for j, c in enumerate(cols):
             col_pos.setdefault(int(c), []).append(j)
@@ -212,15 +220,29 @@ class LayerwiseDataFlow(DataFlow):
             for e in range(int(off[i]), int(off[i + 1])):
                 for j in col_pos.get(int(nbr[e]), ()):
                     adj[i, j] = w[e]
+            for j in col_pos.get(int(rows[i]), ()):  # self-loop
+                adj[i, j] += 1.0
         norm = adj.sum(axis=1, keepdims=True)
         return adj / np.maximum(norm, 1e-12)
 
     def __call__(self, roots: np.ndarray) -> Dict:
         roots = np.ascontiguousarray(roots, dtype=np.uint64).ravel()
-        pools = self.graph.sample_layerwise(
-            roots, self.layer_sizes, edge_types=self.edge_types,
-            default_id=self.default_id)
-        levels = [roots] + pools
+        levels = [roots]
+        if self.sample:
+            pools = self.graph.sample_layerwise(
+                roots, self.layer_sizes, edge_types=self.edge_types,
+                default_id=self.default_id)
+            # LADIES-style connectivity guarantee: each level's pool also
+            # contains the previous level's nodes, so self-loops always
+            # have a column to land on (reference layerwise_dataflow.py
+            # unions the batch into the sampled layer).
+            for p in pools:
+                levels.append(np.concatenate([levels[-1], p]))
+        else:
+            for _ in self.layer_sizes:
+                _, nbr, _, _ = self.graph.get_full_neighbor(
+                    levels[-1], edge_types=self.edge_types)
+                levels.append(np.unique(np.concatenate([levels[-1], nbr])))
         adjs = [self._dense_adj(levels[i], levels[i + 1])
                 for i in range(len(levels) - 1)]
         batch = {"ids": levels, "adjs": adjs}

@@ -73,6 +73,7 @@ class BaseEstimator:
         self.tx = opt_lib.get(
             self.params_cfg.get("optimizer", "adam"),
             self.params_cfg.get("learning_rate", 0.01),
+            weight_decay=float(self.params_cfg.get("weight_decay", 0.0)),
         )
         self.max_id = int(self.params_cfg.get("max_id", 0))
         self.log_steps = int(self.params_cfg.get("log_steps", 20))
@@ -100,15 +101,21 @@ class BaseEstimator:
 
     def _build_train_step(self):
         mutable_keys = [k for k in (self.state.extra_vars or {})]
+        dropout_key = jax.random.key(
+            int(self.params_cfg.get("seed", 0)) + 1)
 
         def train_step(state: TrainState, batch):
+            # per-step dropout rng; eval applies without rngs → dropout
+            # layers run deterministic there
+            rngs = {"dropout": jax.random.fold_in(dropout_key, state.step)}
+
             def loss_fn(p):
                 variables = {"params": p, **(state.extra_vars or {})}
                 if mutable_keys:
                     out, new_vars = state.apply_fn(
-                        variables, batch, mutable=mutable_keys)
+                        variables, batch, mutable=mutable_keys, rngs=rngs)
                 else:
-                    out = state.apply_fn(variables, batch)
+                    out = state.apply_fn(variables, batch, rngs=rngs)
                     new_vars = {}
                 return out.loss, (out, new_vars)
 

@@ -21,13 +21,17 @@ class NodeEstimator(BaseEstimator):
 
     def __init__(self, model, params: Dict, graph: GraphEngine, dataflow,
                  label_fid="label", label_dim: Optional[int] = None,
-                 model_dir=None, mesh=None, feature_store=None):
+                 model_dir=None, mesh=None, feature_store=None,
+                 eval_dataflow=None):
         """feature_store: optional DeviceFeatureStore — batches then carry
         int32 'rows' into the device-resident table instead of shipping
-        feature arrays, and the table rides self.static_batch."""
+        feature arrays, and the table rides self.static_batch.
+        eval_dataflow: optional flow for evaluate/infer (e.g. FastGCN
+        trains on sampled pools but evaluates full-adjacency)."""
         super().__init__(model, params, model_dir, mesh)
         self.graph = graph
         self.dataflow = dataflow
+        self.eval_dataflow = eval_dataflow or dataflow
         self.label_fid = label_fid
         self.label_dim = label_dim
         self.batch_size = int(params.get("batch_size", 32))
@@ -40,11 +44,12 @@ class NodeEstimator(BaseEstimator):
             if feature_store.labels is not None:
                 self.static_batch["label_table"] = feature_store.labels
 
-    def _batches(self, node_type: int) -> Iterator[Dict]:
+    def _batches(self, node_type: int, flow=None) -> Iterator[Dict]:
         store = self.feature_store
+        flow = flow or self.dataflow
         while True:
             roots = self.graph.sample_node(self.batch_size, node_type)
-            batch = self.dataflow(roots)
+            batch = flow(roots)
             if store is not None:
                 # rows replace ids/weights/types AND (with a label table)
                 # the host label fetch — the device step sees only int32
@@ -66,7 +71,7 @@ class NodeEstimator(BaseEstimator):
         return self._batches(self.train_node_type)
 
     def eval_input_fn(self):
-        return self._batches(self.eval_node_type)
+        return self._batches(self.eval_node_type, flow=self.eval_dataflow)
 
     def infer_input_fn(self):
         """Deterministic sweep over all nodes (padded final batch)."""
@@ -83,7 +88,7 @@ class NodeEstimator(BaseEstimator):
                         chunk,
                         np.full(self.batch_size - len(chunk), chunk[-1],
                                 np.uint64)])
-                batch = self.dataflow(chunk)
+                batch = self.eval_dataflow(chunk)
                 if store is not None:
                     batch = {"rows": [store.lookup(j) for j in batch["ids"]],
                              "infer_ids": chunk}

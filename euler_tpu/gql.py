@@ -32,7 +32,8 @@ import numpy as np
 from euler_tpu.core import lib as _libmod
 from euler_tpu.core.lib import EngineError, check
 
-__all__ = ["Query", "GraphService", "start_service", "compile_debug"]
+__all__ = ["Query", "GraphService", "start_service", "compile_debug",
+           "register_udf"]
 
 _DTYPES = {
     0: np.uint64,
@@ -123,6 +124,14 @@ class Query:
         finally:
             lib.etq_exec_free(eh)
 
+    def dump_index(self, directory: str) -> None:
+        """Persist the local-mode index to `directory` (reference:
+        serialized Index/ dir, index_manager.h:34,54). Reload later with
+        Query.local(engine, index_spec="load:<directory>") — or in
+        start_service — instead of rebuilding from columns."""
+        check(self._lib, self._lib.etq_index_dump(self._h,
+                                                  directory.encode()))
+
     def stats(self) -> dict:
         """Per-proxy query counters: queries, errors, total_us, last_us
         (aux parity: engine-side query timing)."""
@@ -181,6 +190,62 @@ def start_service(data_dir: str, shard_idx: int = 0, shard_num: int = 1,
     if h == 0:
         raise EngineError(lib.etg_last_error().decode())
     return GraphService(lib, h)
+
+
+# ctypes callbacks must outlive the engine; keyed by name so
+# re-registration replaces (matching the registry's last-wins rule)
+_UDF_CALLBACKS: Dict[str, object] = {}
+
+_UDF_CBTYPE = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.POINTER(ctypes.c_double), ctypes.c_int64,   # params
+    _libmod.c_u64p, ctypes.c_int64,                    # offsets, n_rows
+    _libmod.c_f32p, ctypes.c_int64,                    # values, n_vals
+    ctypes.c_void_p)                                   # out builder
+
+
+def register_udf(name: str, fn) -> None:
+    """Register a custom value-UDF callable from GQL `udf(name, feat)`
+    (reference udf.h:33-68 UDF registration, here via ctypes so no
+    recompilation is needed).
+
+    fn(params, offsets, values) -> (out_offsets, out_values):
+      params  float64 [P] — numeric params from "udf(name:p1:p2, feat)"
+      offsets uint64 [n+1], values float32 [offsets[-1]] — ragged rows
+    Both outputs are converted with np.asarray; out_offsets must have
+    one more entry than output rows.
+
+    Note: in distribute mode the UDF executes on the shard SERVERS —
+    register it in each server process as well.
+    """
+    lib = _libmod.load()
+
+    @_UDF_CBTYPE
+    def cb(params, n_params, offs, n_rows, vals, n_vals, out):
+        try:
+            p = np.ctypeslib.as_array(params, (n_params,)) if n_params \
+                else np.zeros(0)
+            o = np.ctypeslib.as_array(offs, (n_rows + 1,))
+            v = np.ctypeslib.as_array(vals, (n_vals,)) if n_vals \
+                else np.zeros(0, np.float32)
+            out_o, out_v = fn(p.copy(), o.copy(), v.copy())
+            out_o = np.ascontiguousarray(out_o, dtype=np.uint64)
+            out_v = np.ascontiguousarray(out_v, dtype=np.float32)
+            if out_o.size == 0 or out_o[0] != 0 or out_o[-1] != out_v.size:
+                raise ValueError(
+                    f"udf {name!r}: offsets must start at 0 and end at "
+                    f"len(values) ({out_o[-1] if out_o.size else '?'} != "
+                    f"{out_v.size})")
+            lib.et_udf_emit(out, out_o.ctypes.data_as(_libmod.c_u64p),
+                            out_o.size,
+                            out_v.ctypes.data_as(_libmod.c_f32p),
+                            out_v.size)
+            return 0
+        except Exception:
+            return 1
+
+    _UDF_CALLBACKS[name] = cb
+    lib.etg_register_udf(name.encode(), ctypes.cast(cb, ctypes.c_void_p))
 
 
 def compile_debug(gremlin: str, shard_num: int = 1, partition_num: int = 1,

@@ -38,6 +38,10 @@ class SuperviseModel(nn.Module):
 
     num_classes: int = 0
     multilabel: bool = True
+    # regularization (reference models use dropout 0.5 + L2 on citation
+    # sets, e.g. examples/gat/gat.py): active only when the estimator
+    # provides a "dropout" rng, i.e. during training steps
+    dropout: float = 0.0
 
     def embed(self, batch: Dict[str, Any]) -> Array:
         raise NotImplementedError
@@ -45,6 +49,9 @@ class SuperviseModel(nn.Module):
     @nn.compact
     def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
         emb = self.embed(batch)
+        if self.dropout > 0.0:
+            emb = nn.Dropout(self.dropout)(
+                emb, deterministic=not self.has_rng("dropout"))
         labels = batch.get("labels")
         if labels is None:
             # device-resident label table (DeviceFeatureStore): gather the

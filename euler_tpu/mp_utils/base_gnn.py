@@ -65,6 +65,15 @@ class BaseGNNNet(nn.Module):
     num_layers: int = 2
     out_dim: int = 0            # 0 → dim
     conv_kwargs: Dict = None
+    # input dropout before each conv (reference citation models use 0.5);
+    # active only when a "dropout" rng is provided (training)
+    dropout: float = 0.0
+
+    def _drop(self, h: Array) -> Array:
+        if self.dropout <= 0.0:
+            return h
+        return nn.Dropout(self.dropout)(
+            h, deterministic=not self.has_rng("dropout"))
 
     @nn.compact
     def __call__(self, batch: Dict[str, Any]) -> Array:
@@ -75,7 +84,7 @@ class BaseGNNNet(nn.Module):
         name = self.conv_name.lower()
         if name == "appnp":
             # predict-then-propagate: MLP then one propagation conv
-            h = nn.relu(nn.Dense(self.dim, name="mlp_0")(x))
+            h = nn.relu(nn.Dense(self.dim, name="mlp_0")(self._drop(x)))
             h = nn.Dense(self.out_dim or self.dim, name="mlp_1")(h)
             h = C.APPNPConv(k_hop=kw.get("k_hop", 10),
                             alpha=kw.get("alpha", 0.1))(h, edge_index, n)
@@ -89,7 +98,7 @@ class BaseGNNNet(nn.Module):
                 dim = (self.out_dim or self.dim) if i == self.num_layers - 1 \
                     else self.dim
                 conv = get_conv(name, dim, i, self.num_layers, kw)
-                args = (h, edge_index)
+                h = self._drop(h)
                 if name == "relation":
                     h = conv(h, edge_index, batch.get("edge_type"), n)
                 else:

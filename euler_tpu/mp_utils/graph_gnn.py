@@ -66,12 +66,16 @@ class GraphModel(nn.Module):
     num_graphs: int = 0
     num_classes: int = 2
     conv_kwargs: Dict = None
+    dropout: float = 0.0  # readout dropout, active only in training
 
     @nn.compact
     def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
         emb = GraphGNNNet(
             self.conv_name, self.pool_name, self.dim, self.num_layers,
             self.num_graphs, self.conv_kwargs, name="gnn")(batch)
+        if self.dropout > 0.0:
+            emb = nn.Dropout(self.dropout)(
+                emb, deterministic=not self.has_rng("dropout"))
         logits = nn.Dense(self.num_classes, name="out")(emb)
         labels = batch["labels"].astype(jnp.int32)
         mask = batch.get("graph_mask")

@@ -223,12 +223,16 @@ class LayerEncoder(nn.Module):
     """
 
     dim: int
+    dropout: float = 0.0  # input dropout per layer (standard FastGCN setup)
 
     @nn.compact
     def __call__(self, layers: Sequence[Array], adjs: Sequence[Array]) -> Array:
         h = layers[-1]
         n_layers = len(adjs)
         for i in range(n_layers - 1, -1, -1):
+            if self.dropout > 0.0:
+                h = nn.Dropout(self.dropout)(
+                    h, deterministic=not self.has_rng("dropout"))
             w = nn.Dense(self.dim, use_bias=False, name=f"w_{i}")
             h = adjs[i] @ w(h)
             if i > 0:

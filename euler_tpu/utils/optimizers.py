@@ -10,6 +10,12 @@ __all__ = ["get"]
 
 def get(name: str, learning_rate: float = 0.01, **kw):
     name = name.lower()
+    weight_decay = kw.pop("weight_decay", 0.0)
+    if weight_decay and name in ("adam", "adamw"):
+        return optax.adamw(learning_rate, weight_decay=weight_decay, **kw)
+    if weight_decay:
+        raise ValueError(
+            f"weight_decay is only supported with adam/adamw, got {name!r}")
     if name == "sgd":
         return optax.sgd(learning_rate)
     if name == "adam":

@@ -12,7 +12,8 @@ from common import citation_argparser, run_citation  # noqa: E402
 
 
 def main(argv=None):
-    args = citation_argparser().parse_args(argv)
+    args = citation_argparser(dropout=0.5, weight_decay=0.005,
+                              max_steps=300).parse_args(argv)
     return run_citation("graph", args, conv_kwargs=None)
 
 

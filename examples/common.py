@@ -25,6 +25,10 @@ def citation_argparser(**defaults) -> argparse.ArgumentParser:
                     default=defaults.get("max_steps", 200))
     ap.add_argument("--eval_steps", type=int,
                     default=defaults.get("eval_steps", 20))
+    ap.add_argument("--dropout", type=float,
+                    default=defaults.get("dropout", 0.0))
+    ap.add_argument("--weight_decay", type=float,
+                    default=defaults.get("weight_decay", 0.0))
     ap.add_argument("--model_dir", default="")
     ap.add_argument("--run_mode", default="train_and_evaluate")
     from euler_tpu.platform import add_platform_flag
@@ -47,6 +51,7 @@ def run_citation(conv_name: str, args, conv_kwargs=None, model_cls=None):
     print(f"dataset {args.dataset}: {data.engine.node_count} nodes, "
           f"{data.engine.edge_count} edges [{data.source}]")
 
+    drop = getattr(args, "dropout", 0.0)
     if model_cls is None:
         class ConvModel(SuperviseModel):
             dim: int = args.hidden_dim
@@ -55,7 +60,7 @@ def run_citation(conv_name: str, args, conv_kwargs=None, model_cls=None):
             def embed(self, batch):
                 return BaseGNNNet(conv_name, self.dim, self.num_layers,
                                   conv_kwargs=conv_kwargs or {},
-                                  name="gnn")(batch)
+                                  dropout=drop, name="gnn")(batch)
 
         model = ConvModel(num_classes=data.num_classes,
                           multilabel=data.multilabel)
@@ -67,6 +72,7 @@ def run_citation(conv_name: str, args, conv_kwargs=None, model_cls=None):
     est = NodeEstimator(
         model,
         dict(batch_size=args.batch_size, learning_rate=args.learning_rate,
+             weight_decay=getattr(args, "weight_decay", 0.0),
              label_dim=data.num_classes),
         data.engine, flow, label_fid="label", label_dim=data.num_classes,
         model_dir=args.model_dir or None)

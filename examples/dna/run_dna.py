@@ -23,6 +23,8 @@ def main(argv=None):
     ap.add_argument("--learning_rate", type=float, default=0.01)
     ap.add_argument("--max_steps", type=int, default=200)
     ap.add_argument("--eval_steps", type=int, default=20)
+    ap.add_argument("--dropout", type=float, default=0.5)
+    ap.add_argument("--weight_decay", type=float, default=0.005)
     ap.add_argument("--model_dir", default="")
     add_platform_flag(ap)
     args = ap.parse_args(argv)
@@ -51,8 +53,9 @@ def main(argv=None):
 
     flow = FullBatchDataFlow(data.engine, feature_ids=["feature"])
     est = NodeEstimator(
-        DNAModel(num_classes=data.num_classes, multilabel=data.multilabel),
+        DNAModel(num_classes=data.num_classes, multilabel=data.multilabel, dropout=args.dropout),
         dict(batch_size=args.batch_size, learning_rate=args.learning_rate,
+             weight_decay=args.weight_decay,
              label_dim=data.num_classes),
         data.engine, flow, label_fid="label", label_dim=data.num_classes,
         model_dir=args.model_dir or None)

@@ -16,8 +16,10 @@ def main(argv=None):
     ap.add_argument("--layer_sizes", default="128,128")
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--learning_rate", type=float, default=0.01)
-    ap.add_argument("--max_steps", type=int, default=200)
+    ap.add_argument("--max_steps", type=int, default=800)
     ap.add_argument("--eval_steps", type=int, default=20)
+    ap.add_argument("--dropout", type=float, default=0.5)
+    ap.add_argument("--weight_decay", type=float, default=0.005)
     ap.add_argument("--model_dir", default="")
     add_platform_flag(ap)
     args = ap.parse_args(argv)
@@ -34,17 +36,22 @@ def main(argv=None):
 
     class FastGCNModel(SuperviseModel):
         def embed(self, batch):
-            return LayerEncoder(dim=args.hidden_dim, name="enc")(
-                batch["layers"], batch["adjs"])
+            return LayerEncoder(dim=args.hidden_dim, dropout=args.dropout,
+                                name="enc")(batch["layers"], batch["adjs"])
 
     flow = LayerwiseDataFlow(data.engine, sizes, feature_ids=["feature"])
+    # standard FastGCN protocol: importance-sampled pools for training,
+    # exact 1-hop closures (full propagation matrix) for evaluation
+    eval_flow = LayerwiseDataFlow(data.engine, sizes, sample=False,
+                                  feature_ids=["feature"])
     est = NodeEstimator(
         FastGCNModel(num_classes=data.num_classes,
                      multilabel=data.multilabel),
         dict(batch_size=args.batch_size, learning_rate=args.learning_rate,
+             weight_decay=args.weight_decay,
              label_dim=data.num_classes),
         data.engine, flow, label_fid="label", label_dim=data.num_classes,
-        model_dir=args.model_dir or None)
+        model_dir=args.model_dir or None, eval_dataflow=eval_flow)
     res = est.train_and_evaluate(est.train_input_fn, est.eval_input_fn,
                                  args.max_steps, args.eval_steps)
     print(res)

@@ -22,9 +22,13 @@ def graph_argparser(**defaults) -> argparse.ArgumentParser:
     ap.add_argument("--learning_rate", type=float,
                     default=defaults.get("learning_rate", 0.01))
     ap.add_argument("--max_steps", type=int,
-                    default=defaults.get("max_steps", 200))
+                    default=defaults.get("max_steps", 500))
     ap.add_argument("--eval_steps", type=int,
                     default=defaults.get("eval_steps", 20))
+    ap.add_argument("--dropout", type=float,
+                    default=defaults.get("dropout", 0.5))
+    ap.add_argument("--weight_decay", type=float,
+                    default=defaults.get("weight_decay", 0.005))
     ap.add_argument("--model_dir", default="")
     from euler_tpu.platform import add_platform_flag
 
@@ -44,10 +48,12 @@ def run_graph_model(conv_name: str, pool_name: str, args):
     model = GraphModel(
         conv_name=conv_name, pool_name=pool_name, dim=args.hidden_dim,
         num_layers=args.num_layers, num_graphs=args.num_graphs,
-        num_classes=data.num_classes)
+        num_classes=data.num_classes,
+        dropout=getattr(args, "dropout", 0.0))
     est = GraphEstimator(
         model,
         dict(num_graphs=args.num_graphs, learning_rate=args.learning_rate,
+             weight_decay=getattr(args, "weight_decay", 0.0),
              train_indices=data.train_indices, eval_indices=data.eval_indices),
         data.graphs, data.labels, model_dir=args.model_dir or None)
     res = est.train_and_evaluate(est.train_input_fn, est.eval_input_fn,

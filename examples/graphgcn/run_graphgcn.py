@@ -12,7 +12,8 @@ from graph_common import graph_argparser, run_graph_model  # noqa: E402
 
 
 def main(argv=None):
-    args = graph_argparser().parse_args(argv)
+    args = graph_argparser(num_layers=3, hidden_dim=64,
+                           max_steps=800).parse_args(argv)
     return run_graph_model("gcn", "mean", args)
 
 

@@ -54,11 +54,19 @@ def test_layerwise_dataflow(tiny_data):
     g = tiny_data.engine
     flow = LayerwiseDataFlow(g, [6, 8], feature_ids=["feature"])
     batch = flow(g.sample_node(4, 0))
-    assert batch["adjs"][0].shape == (4, 6)
-    assert batch["adjs"][1].shape == (6, 8)
-    # rows with any neighbors are normalized to sum 1
+    # LADIES-style pools: each level unions the previous level's nodes
+    # (connectivity guarantee) → level sizes 4, 4+6, 4+6+8
+    assert batch["adjs"][0].shape == (4, 10)
+    assert batch["adjs"][1].shape == (10, 18)
+    # rows are normalized; with self-loops every row sums to 1
     sums = batch["adjs"][0].sum(axis=1)
-    assert np.all((sums < 1.0 + 1e-4))
+    np.testing.assert_allclose(sums, 1.0, rtol=1e-4)
+    # full (eval) mode: exact 1-hop closures instead of sampled pools
+    full = LayerwiseDataFlow(g, [6, 8], sample=False,
+                             feature_ids=["feature"])
+    fb = full(g.sample_node(4, 0))
+    assert fb["adjs"][0].shape[0] == 4
+    np.testing.assert_allclose(fb["adjs"][0].sum(axis=1), 1.0, rtol=1e-4)
 
 
 def test_relation_dataflow(tiny_data):

@@ -658,3 +658,138 @@ def test_out_e_bad_weight_op_rejected(ring_graph):
     with pytest.raises(EngineError):
         q.run("v(r).outE(*).has(weight in 1:5).as(e)",
               {"r": np.array([1], dtype=np.uint64)})
+
+
+# ---------------------------------------------------------------------------
+# UDF registration + composite hash-range index + index persistence
+# (reference udf.h:33-68, hash_range_sample_index.h, index_manager.h:34,54)
+# ---------------------------------------------------------------------------
+def test_udf_parameterized_builtins(ring_graph):
+    q = Query.local(ring_graph)
+    out = q.run("v(roots).udf(scale:2, f_dense).as(s)",
+                {"roots": np.array([1], dtype=np.uint64)})
+    np.testing.assert_allclose(out["s:1"], [0, 2, 4, 6])  # 2x [0,1,2,3]
+    out = q.run("v(roots).udf(clip:1:2, f_dense).as(c)",
+                {"roots": np.array([1], dtype=np.uint64)})
+    np.testing.assert_allclose(out["c:1"], [1, 1, 2, 2])
+
+
+def test_udf_unknown_rejected(ring_graph):
+    from euler_tpu.core.lib import EngineError
+
+    q = Query.local(ring_graph)
+    with pytest.raises(EngineError, match="no registered udf"):
+        q.run("v(roots).udf(nosuch, f_dense).as(x)",
+              {"roots": np.array([1], dtype=np.uint64)})
+
+
+def test_udf_custom_python_registration(ring_graph):
+    """Custom UDFs register from Python via ctypes (the TPU build's
+    version of the reference's compiled-in UDF subclasses)."""
+    from euler_tpu.gql import register_udf
+
+    def l2norm(params, offsets, values):
+        n = len(offsets) - 1
+        out = np.zeros(n, dtype=np.float32)
+        for i in range(n):
+            row = values[offsets[i]:offsets[i + 1]]
+            out[i] = np.sqrt((row.astype(np.float64) ** 2).sum())
+        return np.arange(n + 1, dtype=np.uint64), out
+
+    register_udf("l2norm", l2norm)
+    q = Query.local(ring_graph)
+    out = q.run("v(roots).udf(l2norm, f_dense).as(n)",
+                {"roots": np.array([1, 2], dtype=np.uint64)})
+    np.testing.assert_allclose(
+        out["n:1"],
+        [np.sqrt(0 + 1 + 4 + 9), np.sqrt(16 + 25 + 36 + 49)], rtol=1e-6)
+
+
+def test_udf_remote_applies_on_shards(ring_graph, two_shard_cluster):
+    """udf() in distribute mode ships with the plan and runs on the shard
+    servers (in-process here, so built-ins are present)."""
+    q, _ = two_shard_cluster
+    out = q.run("v(roots).udf(mean, f_dense).as(m)",
+                {"roots": np.array([1, 5], dtype=np.uint64)})
+    np.testing.assert_allclose(out["m:1"], [1.5, 17.5])
+
+
+@pytest.fixture
+def two_attr_graph():
+    """Nodes with a hash attribute (category) and a range attribute
+    (price) for composite-index tests: category of node i = i % 2,
+    price = i."""
+    from euler_tpu.graph import GraphBuilder, seed
+
+    seed(5)
+    b = GraphBuilder()
+    b.set_num_types(1, 1)
+    b.set_feature(0, 0, 1, "price")
+    b.set_feature(1, 0, 1, "category")
+    ids = np.arange(1, 21, dtype=np.uint64)
+    b.add_nodes(ids, weights=np.ones(20, dtype=np.float32))
+    b.add_edges(ids[:-1], ids[1:])
+    b.set_node_dense(ids, 0, ids.astype(np.float32).reshape(20, 1))
+    b.set_node_dense(ids, 1, (ids % 2).astype(np.float32).reshape(20, 1))
+    return b.finalize()
+
+
+def test_hash_range_composite_index(two_attr_graph):
+    q = Query.local(two_attr_graph,
+                    index_spec="category+price:hash_range_index", seed=3)
+    out = q.run("sampleN(-1, 64).has(category eq 1, price gt 10).as(n)")
+    ids = set(int(i) for i in out["n:0"])
+    # odd ids > 10: {11, 13, 15, 17, 19}
+    assert ids <= {11, 13, 15, 17, 19}
+    assert len(ids) >= 3
+
+
+def test_hash_range_matches_separate_indexes(two_attr_graph):
+    """The composite lookup must select the same rows as intersecting
+    separate hash+range indexes."""
+    comp = Query.local(two_attr_graph,
+                       index_spec="category+price:hash_range_index", seed=7)
+    sep = Query.local(
+        two_attr_graph,
+        index_spec="category:hash_index,price:range_index", seed=7)
+    got_c = comp.run("v(roots).has(category eq 0, price le 8).as(k)",
+                     {"roots": np.arange(1, 21, dtype=np.uint64)})
+    got_s = sep.run("v(roots).has(category eq 0, price le 8).as(k)",
+                    {"roots": np.arange(1, 21, dtype=np.uint64)})
+    assert list(got_c["k:0"]) == list(got_s["k:0"]) == [2, 4, 6, 8]
+
+
+def test_index_dump_load_roundtrip(two_attr_graph, tmp_path):
+    """Built indexes survive dump/load (reference index_manager.h:34,54
+    loads a serialized Index/ dir instead of rebuilding)."""
+    idir = str(tmp_path / "Index")
+    q = Query.local(two_attr_graph,
+                    index_spec="category+price:hash_range_index,"
+                               "price:range_index", seed=1)
+    q.dump_index(idir)
+    q2 = Query.local(two_attr_graph, index_spec=f"load:{idir}", seed=1)
+    out = q2.run("v(roots).has(category eq 1, price gt 10).as(n)",
+                 {"roots": np.arange(1, 21, dtype=np.uint64)})
+    assert list(out["n:0"]) == [11, 13, 15, 17, 19]
+    out = q2.run("v(roots).has(price le 3).as(m)",
+                 {"roots": np.arange(1, 21, dtype=np.uint64)})
+    assert list(out["m:0"]) == [1, 2, 3]
+
+
+def test_index_load_in_service(two_attr_graph, tmp_path):
+    """Servers can start from a dumped index ("load:<dir>" spec)."""
+    idir = str(tmp_path / "Index")
+    Query.local(two_attr_graph,
+                index_spec="price:range_index").dump_index(idir)
+    data_dir = str(tmp_path / "g")
+    two_attr_graph.dump(data_dir, num_partitions=1)
+    s = start_service(data_dir, shard_idx=0, shard_num=1, port=0,
+                      index_spec=f"load:{idir}")
+    q = Query.remote(f"hosts:127.0.0.1:{s.port}")
+    try:
+        out = q.run("v(roots).has(price ge 18).as(n)",
+                    {"roots": np.arange(1, 21, dtype=np.uint64)})
+        assert list(out["n:0"]) == [18, 19, 20]
+    finally:
+        q.close()
+        s.stop()

@@ -213,3 +213,31 @@ def test_synthetic_cora_calibrated_difficulty():
     # non-degenerate: neither single-modality baseline reaches the GNN bar
     assert 0.45 < feat_acc < 0.80, feat_acc
     assert 0.45 < struct_acc < 0.75, struct_acc
+
+
+def test_mutag_like_calibrated_difficulty():
+    """The mutag stand-in must be non-degenerate (VERDICT r1: GIN once
+    aced 1.00): a feature-only linear readout on the mean atom histogram
+    must be ≈ chance — the aromatic-ring label is a feature×structure
+    co-occurrence only message passing can read — while an oracle that
+    counts adjacent-aromatic edges separates up to the 7% label noise."""
+    from euler_tpu.dataset import mutag_like
+
+    d = mutag_like()
+    X = np.stack([g["x"].mean(0) for g in d.graphs])
+    y = d.labels
+    tr, ev = d.train_indices, d.eval_indices
+    w = np.linalg.lstsq(np.c_[X[tr], np.ones(len(tr))], y[tr] * 2.0 - 1.0,
+                        rcond=None)[0]
+    pred = (np.c_[X[ev], np.ones(len(ev))] @ w) > 0
+    feat_acc = float((pred == y[ev].astype(bool)).mean())
+    assert feat_acc < 0.65, feat_acc
+
+    aa = []
+    for g in d.graphs:
+        x, ei = g["x"], g["edge_index"]
+        arom = x[:, :2].sum(1) > 0
+        aa.append((arom[ei[0]] & arom[ei[1]]).sum() / 2)
+    aa = np.asarray(aa)
+    oracle = float(((aa > 0).astype(int) == y).mean())
+    assert oracle > 0.88, oracle

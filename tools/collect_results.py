@@ -1,10 +1,12 @@
-"""Run every example model at full training length and record final
-metrics into RESULTS.md — the repo's analog of the reference's per-example
-README F1 tables (examples/gcn/README.md:29-33 etc.), which are its
-model-quality regression record.
+"""Run every example model at full training length, record final metrics
+into results.json, and render RESULTS.md — the repo's analog of the
+reference's per-example README F1 tables (examples/gcn/README.md:29-33
+etc.), which are its model-quality regression record.
 
 Usage: python tools/collect_results.py [--only PAT] [--jobs results.json]
-Resumable: completed entries in the json are skipped on re-run.
+Resumable: completed entries in the json are skipped on re-run; the
+markdown table is rewritten at the end of every run (or alone with
+--markdown-only).
 """
 
 from __future__ import annotations
@@ -64,13 +66,77 @@ def parse_result(stdout: str):
     return None
 
 
+# Reference baselines (SURVEY.md §6 — the per-example README tables).
+REF = {
+    "gcn": (0.822, 0.871, 0.752), "gat": (0.823, 0.876, 0.755),
+    "graphsage": (0.774, 0.884, 0.731), "fastgcn": (0.803, 0.860, 0.740),
+    "appnp": (0.813, 0.870, 0.723), "adaptivegcn": (0.821, 0.859, 0.751),
+    "agnn": (0.813, 0.894, 0.719), "arma": (0.822, 0.880, 0.755),
+    "dna": (0.811, 0.867, 0.710), "geniepath": (0.742, 0.872, 0.735),
+    "lgcn": (0.641, 0.848, 0.675), "sgcn": (0.825, 0.866, 0.716),
+    "tagcn": (0.817, 0.867, 0.727), "deepwalk": (0.905, 0.983, 0.976),
+    "line": (0.900, 0.987, 0.956),
+    "gin": 0.923, "gated_graph": 0.920, "set2set": 0.901,
+    "graphgcn": 0.891,
+}
+DATASETS = ("cora", "pubmed", "citeseer")
+
+
+def write_markdown(results: dict, path):
+    """RESULTS.md: measured metric vs the reference's published number
+    (real datasets; ours are calibrated synthetic stand-ins — see
+    euler_tpu/dataset/__init__.py for the calibration evidence)."""
+    lines = [
+        "# RESULTS — model quality on the calibrated synthetic datasets",
+        "",
+        "Produced by `python tools/collect_results.py` (defaults of each",
+        "`examples/*/run_*.py`). Reference numbers are the published",
+        "tables on the REAL datasets (SURVEY.md §6); ours run on the",
+        "calibrated synthetic stand-ins (no network egress), tuned so a",
+        "2-layer GCN lands near the published cora/pubmed/citeseer F1",
+        "and a ring-detection GIN near the published mutag accuracy —",
+        "see the difficulty guards in tests/test_tools_datasets.py.",
+        "",
+        "| model | dataset | metric | ours | reference |",
+        "|---|---|---|---|---|",
+    ]
+    for key in sorted(results):
+        model, _, ds = key.partition("/")
+        res = results[key]
+        if "error" in res:
+            ours = "ERROR"
+        else:
+            ours = f"{res.get('eval_metric', float('nan')):.3f}"
+        ref = REF.get(model)
+        if isinstance(ref, tuple) and ds in DATASETS:
+            ref_s = f"{ref[DATASETS.index(ds)]:.3f}"
+        elif isinstance(ref, float):
+            ref_s = f"{ref:.3f}"
+        else:
+            ref_s = "—"
+        metric = "acc" if ds == "mutag" else (
+            "mrr" if model in ("deepwalk", "line", "transe", "transh",
+                               "transr", "transd", "distmult", "rgcn",
+                               "gae", "dgi") else "micro-F1")
+        lines.append(f"| {model} | {ds} | {metric} | {ours} | {ref_s} |")
+    lines.append("")
+    Path(path).write_text("\n".join(lines))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", default="")
     ap.add_argument("--jobs", default=str(REPO / "results.json"))
     ap.add_argument("--platform", default="cpu")
     ap.add_argument("--timeout", type=int, default=3600)
+    ap.add_argument("--markdown-only", action="store_true")
     args = ap.parse_args()
+
+    if args.markdown_only:
+        write_markdown(json.loads(Path(args.jobs).read_text()),
+                       REPO / "RESULTS.md")
+        print(f"wrote {REPO / 'RESULTS.md'}")
+        return
 
     out_path = Path(args.jobs)
     results = {}
@@ -100,7 +166,9 @@ def main():
         got = results[name].get("eval_metric", results[name].get("error", "?"))
         print(f"[{name}] -> {got}", flush=True)
 
-    print(f"done: {len(results)} rows in {out_path}", flush=True)
+    write_markdown(results, REPO / "RESULTS.md")
+    print(f"done: {len(results)} rows in {out_path} + RESULTS.md",
+          flush=True)
 
 
 if __name__ == "__main__":
