@@ -7,3 +7,4 @@ from euler_tpu.graph.api import (  # noqa: F401
     GraphEngine,
     seed,
 )
+from euler_tpu.graph.remote import RemoteGraphEngine  # noqa: F401

@@ -1,0 +1,147 @@
+"""Remote graph client: the GraphEngine batch API served by a shard
+cluster over GQL.
+
+Parity: the reference's TF custom kernels build a GQL string per op and
+run it through QueryProxy against remote shards (SURVEY.md §3.3,
+tf_euler/kernels/sample_fanout_op.cc:36-48 — the chained
+".sampleNB().as(nb_i)" one-round-trip fanout). Here the same idea backs
+the GraphEngine surface the dataflows/estimators consume, so a trainer
+switches from embedded to cluster mode by swapping the graph object:
+
+    remote = RemoteGraphEngine("hosts:127.0.0.1:9190,127.0.0.1:9191")
+    flow = FanoutDataFlow(remote, [10, 10], feature_ids=["feature"])
+    est = NodeEstimator(model, params, remote, flow, ...)
+
+Every sample_fanout call is ONE query (compile-cached server-side plan,
+split/REMOTE/merge per shard) — the host-side feeding pattern the
+reference's whole design exists to amortize.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from euler_tpu.gql import Query
+
+
+class RemoteGraphEngine:
+    """GraphEngine-compatible batch sampling/feature API over a remote
+    Query proxy (distribute or graph_partition mode)."""
+
+    def __init__(self, endpoints: str, seed: int = 0,
+                 mode: str = "distribute"):
+        self.query = Query.remote(endpoints, seed=seed, mode=mode)
+
+    # -- root sampling -----------------------------------------------------
+    def sample_node(self, count: int, node_type: int = -1) -> np.ndarray:
+        out = self.query.run(f"sampleN({node_type}, {count}).as(n)")
+        return out["n:0"].astype(np.uint64).ravel()
+
+    def sample_edge(self, count: int, edge_type: int = -1):
+        out = self.query.run(f"sampleE({edge_type}, {count}).as(e)")
+        return (out["e:0"].astype(np.uint64), out["e:1"].astype(np.uint64),
+                out["e:2"].astype(np.int32))
+
+    # -- traversal ---------------------------------------------------------
+    @staticmethod
+    def _et(edge_types) -> str:
+        if edge_types is None:
+            return "*"
+        return ":".join(str(int(t)) for t in edge_types) or "*"
+
+    def sample_fanout(self, roots, counts: Sequence[int], edge_types=None,
+                      default_id: int = 0):
+        """Multi-hop expansion in ONE round trip (reference
+        sample_fanout_op.cc:36-48). Returns (ids_per_hop, w_per_hop,
+        t_per_hop) with hop i arrays of shape [n·prod(counts[:i+1])]."""
+        roots = np.ascontiguousarray(roots, dtype=np.uint64).ravel()
+        if edge_types is not None and len(edge_types) > 0 and isinstance(
+                edge_types[0], (list, tuple, np.ndarray)):
+            if len(edge_types) != len(counts):
+                raise ValueError(
+                    f"per-hop edge_types has {len(edge_types)} entries, "
+                    f"expected {len(counts)} (one per hop)")
+            per_hop = [self._et(h) for h in edge_types]
+        else:
+            per_hop = [self._et(edge_types)] * len(counts)
+        q = "v(r)"
+        for i, k in enumerate(counts):
+            q += f".sampleNB({per_hop[i]}, {int(k)}, {default_id}).as(h{i})"
+        out = self.query.run(q, {"r": roots})
+        ids = [out[f"h{i}:1"].astype(np.uint64) for i in range(len(counts))]
+        w = [out[f"h{i}:2"].astype(np.float32) for i in range(len(counts))]
+        t = [out[f"h{i}:3"].astype(np.int32) for i in range(len(counts))]
+        return ids, w, t
+
+    def sample_neighbor(self, ids, count: int, edge_types=None,
+                        default_id: int = 0):
+        ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
+        out = self.query.run(
+            f"v(r).sampleNB({self._et(edge_types)}, {count}, "
+            f"{default_id}).as(nb)", {"r": ids})
+        n = ids.size
+        return (out["nb:1"].reshape(n, count).astype(np.uint64),
+                out["nb:2"].reshape(n, count).astype(np.float32),
+                out["nb:3"].reshape(n, count).astype(np.int32))
+
+    def get_full_neighbor(self, ids, edge_types=None,
+                          sorted_by_id: bool = False,
+                          in_edges: bool = False):
+        ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
+        verb = "getRNB" if in_edges else (
+            "getSortedNB" if sorted_by_id else "getNB")
+        out = self.query.run(
+            f"v(r).{verb}({self._et(edge_types)}).as(nb)", {"r": ids})
+        idx = out["nb:0"].reshape(-1, 2)
+        offsets = np.concatenate([[0], idx[:, 1]]).astype(np.uint64)
+        return (offsets, out["nb:1"].astype(np.uint64),
+                out["nb:2"].astype(np.float32), out["nb:3"].astype(np.int32))
+
+    def get_neighbor_edges(self, ids, edge_types=None):
+        ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
+        out = self.query.run(
+            f"v(r).outE({self._et(edge_types)}).as(e)", {"r": ids})
+        idx = out["e:0"].reshape(-1, 2)
+        offsets = np.concatenate([[0], idx[:, 1]]).astype(np.uint64)
+        return (offsets, out["e:1"].astype(np.uint64),
+                out["e:2"].astype(np.uint64), out["e:3"].astype(np.int32),
+                out["e:4"].astype(np.float32))
+
+    # -- features ----------------------------------------------------------
+    def get_dense_feature(self, ids, fids, dims=None):
+        """[n, dim] float32 per fid; mirrors GraphEngine.get_dense_feature
+        (single name → single array, list → list)."""
+        ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
+        single = not isinstance(fids, (list, tuple, np.ndarray))
+        names = [fids] if single else list(fids)
+        q = "v(r).values(" + ", ".join(str(n) for n in names) + ").as(f)"
+        out = self.query.run(q, {"r": ids})
+        outs = []
+        dim_list = ([dims] if single else list(dims)) if dims is not None \
+            else [None] * len(names)
+        for i, want in enumerate(dim_list):
+            idx = out[f"f:{2 * i}"].reshape(-1, 2).astype(np.int64)
+            vals = out[f"f:{2 * i + 1}"].astype(np.float32)
+            # rows can be ragged (graph_partition mode returns EMPTY rows
+            # for ids a shard doesn't own) — scatter by the idx offsets
+            # instead of a flat reshape, zero-filling misses like the
+            # embedded engine does
+            lens = idx[:, 1] - idx[:, 0]
+            dim = int(want) if want is not None else int(lens.max(initial=0))
+            arr = np.zeros((ids.size, dim), dtype=np.float32)
+            for r in range(min(ids.size, idx.shape[0])):
+                m = min(int(lens[r]), dim)
+                arr[r, :m] = vals[idx[r, 0]:idx[r, 0] + m]
+            outs.append(arr)
+        return outs[0] if single else outs
+
+    def get_node_type(self, ids) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
+        out = self.query.run("v(r).label().as(t)", {"r": ids})
+        return out["t:0"].astype(np.int32)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        self.query.close()

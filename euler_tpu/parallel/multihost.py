@@ -1,0 +1,113 @@
+"""Multi-host training bootstrap.
+
+Parity: the reference's PS/worker launch scripts
+(tf_euler/scripts/dist_tf_euler.sh:28-43 — per-host TF_CONFIG wiring +
+worker exit barrier, hooks.py:25 SyncExitHook). TPU-native redesign:
+no parameter servers — every host joins one jax.distributed job
+(coordination service), the global device mesh spans all hosts, and XLA
+GSPMD moves gradients/embeddings over ICI/DCN collectives. The graph
+service remains a separate host-side cluster each trainer host connects
+to (RemoteGraphEngine over the registry), exactly like the reference's
+worker ↔ euler-shard split (SURVEY.md §3.4).
+
+Typical per-host entry (see tools/launch_multihost.py):
+
+    cfg = MultihostConfig(coordinator="10.0.0.1:9999",
+                          num_processes=4, process_id=host_idx)
+    initialize_multihost(cfg)
+    mesh = make_mesh(model_parallel=2)        # global devices
+    remote = RemoteGraphEngine(f"dir:{registry}")  # graph cluster
+    ... train ...
+    finalize_multihost(barrier_dir, cfg)      # exit rendezvous
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class MultihostConfig:
+    coordinator: str          # "host:port" of process 0
+    num_processes: int
+    process_id: int
+
+    @classmethod
+    def from_env(cls) -> "MultihostConfig":
+        """EULER_TPU_COORDINATOR / _NUM_HOSTS / _HOST_IDX (the launcher
+        sets these; on cloud TPU pods jax.distributed auto-detects and
+        this config is unnecessary)."""
+        return cls(
+            coordinator=os.environ["EULER_TPU_COORDINATOR"],
+            num_processes=int(os.environ["EULER_TPU_NUM_HOSTS"]),
+            process_id=int(os.environ["EULER_TPU_HOST_IDX"]),
+        )
+
+
+def initialize_multihost(cfg: Optional[MultihostConfig] = None) -> int:
+    """Joins the jax.distributed job and returns this process's id.
+
+    Must run before the first jax device query. With cfg=None, tries the
+    environment (launcher-set vars), then jax's own auto-detection
+    (TPU pods); single-process if neither applies.
+    """
+    import jax
+
+    if cfg is None:
+        try:
+            cfg = MultihostConfig.from_env()
+        except KeyError:
+            cfg = None
+    if cfg is None:
+        # no launcher vars — let jax auto-detect the cluster (TPU pods,
+        # SLURM, GKE); argless initialize raises where no cluster env
+        # exists, which is the single-process case
+        try:
+            jax.distributed.initialize()
+            return jax.process_index()
+        except Exception:
+            return 0
+    if cfg.num_processes <= 1:
+        return 0
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    assert jax.process_count() == cfg.num_processes
+    return cfg.process_id
+
+
+def finalize_multihost(barrier_dir: Optional[str] = None,
+                       cfg: Optional[MultihostConfig] = None,
+                       run_id: str = "exit") -> None:
+    """Worker exit rendezvous (reference SyncExitHook, hooks.py:25): a
+    host that finishes early keeps serving collectives until everyone
+    arrives, then all shut down together."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    if barrier_dir:
+        from euler_tpu.utils.hooks import FileBarrier
+
+        n = cfg.num_processes if cfg else jax.process_count()
+        pid = cfg.process_id if cfg else jax.process_index()
+        FileBarrier(barrier_dir, n, run_id=run_id).wait(pid)
+    jax.distributed.shutdown()
+
+
+def process_batch_slice(global_batch: int) -> slice:
+    """This host's rows of a globally-sharded batch: host i feeds
+    devices [i·L, (i+1)·L) of the 'data' axis, so it samples only its
+    slice of each global batch (per-host graph clients, no broadcast)."""
+    import jax
+
+    n, i = jax.process_count(), jax.process_index()
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"{n} hosts")
+    per = global_batch // n
+    return slice(i * per, (i + 1) * per)

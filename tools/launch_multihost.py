@@ -1,0 +1,163 @@
+"""Multi-host launcher (parity: tf_euler/scripts/dist_tf_euler.sh:28-43,
+which looped over hosts exporting TF_CONFIG and starting PS/worker
+processes).
+
+Two modes:
+
+  * --local N : spawn N worker processes on THIS machine (CPU backend,
+    one device each) that join one jax.distributed job — the smoke path
+    used by tests/test_multihost.py.
+  * print mode (default): emit the per-host command lines + env to run
+    on each machine of a real pod/cluster.
+
+The worker entry (--worker) is what each host runs: it joins the job,
+optionally serves its graph shard, builds a global mesh, runs a tiny
+all-reduce proof, queries the shared graph cluster, and exits through
+the FileBarrier — the full multi-host wiring in one script.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def worker_main(args) -> None:
+    # CPU backend, 1 device per process — set before jax import
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from euler_tpu.parallel.multihost import (
+        finalize_multihost, initialize_multihost, process_batch_slice,
+    )
+
+    pid = initialize_multihost()
+    out = {"process_id": pid, "process_count": jax.process_count(),
+           "devices": len(jax.devices())}
+
+    # each host serves one graph shard and queries the whole cluster
+    # through the file registry (ZK-parity discovery)
+    import numpy as np
+
+    from euler_tpu.gql import start_service
+    from euler_tpu.graph import RemoteGraphEngine
+
+    server = start_service(args.data_dir, shard_idx=pid,
+                           shard_num=jax.process_count(), port=0,
+                           registry_dir=args.registry_dir)
+    # wait until EVERY host's shard has registered before building the
+    # client (discovery is eventually consistent, like the reference's
+    # ZK watch — a client built early would see a partial cluster)
+    import time
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        shards = {f.split("__")[0] for f in os.listdir(args.registry_dir)
+                  if f.startswith("shard_")}
+        if len(shards) >= jax.process_count():
+            break
+        time.sleep(0.1)
+    else:
+        raise RuntimeError("graph shards did not all register in 60s")
+    remote = RemoteGraphEngine(f"dir:{args.registry_dir}")
+    out["graph_nodes_seen"] = sorted(
+        int(i) for i in remote.sample_node(64, -1))[:3]
+
+    # global-mesh all-reduce proof: psum(process_id+1) over all hosts
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("data",))
+    x = np.array([float(pid + 1)], dtype=np.float32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), x)
+    total = jax.jit(
+        lambda a: jax.numpy.sum(a),
+        out_shardings=NamedSharding(mesh, P()))(arr)
+    out["psum"] = float(total)
+    out["batch_slice"] = [process_batch_slice(8 * jax.process_count()).start,
+                          process_batch_slice(8 * jax.process_count()).stop]
+
+    print("WORKER_RESULT " + json.dumps(out), flush=True)
+    remote.close()
+    finalize_multihost(args.barrier_dir)
+    server.stop()
+
+
+def launch_local(n: int, data_dir: str) -> int:
+    import socket
+
+    registry = tempfile.mkdtemp(prefix="et_mh_reg_")
+    barrier = tempfile.mkdtemp(prefix="et_mh_bar_")
+    # reserve a genuinely free coordinator port (a guessed constant can
+    # collide with concurrent runs and hang both jobs)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for i in range(n):
+        env = dict(os.environ)
+        env.update({
+            "EULER_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "EULER_TPU_NUM_HOSTS": str(n),
+            "EULER_TPU_HOST_IDX": str(i),
+            "JAX_PLATFORMS": "cpu",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, __file__, "--worker", "--data_dir", data_dir,
+             "--registry_dir", registry, "--barrier_dir", barrier],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    rc = 0
+    for i, p in enumerate(procs):
+        out, _ = p.communicate(timeout=300)
+        print(f"--- host {i} (rc={p.returncode}) ---")
+        print(out)
+        rc |= p.returncode
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--local", type=int, default=0,
+                    help="spawn N local worker processes (smoke mode)")
+    ap.add_argument("--num_hosts", type=int, default=2)
+    ap.add_argument("--coordinator", default="HOST0:9999")
+    ap.add_argument("--data_dir", default="")
+    ap.add_argument("--registry_dir", default="/shared/registry")
+    ap.add_argument("--barrier_dir", default="/shared/barrier")
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        worker_main(args)
+        return 0
+    if args.local:
+        if not args.data_dir:
+            raise SystemExit("--local needs --data_dir (partitioned dump)")
+        return launch_local(args.local, args.data_dir)
+
+    # print-mode: the per-host commands for a real cluster
+    for i in range(args.num_hosts):
+        print(f"# host {i}:")
+        print(f"EULER_TPU_COORDINATOR={args.coordinator} "
+              f"EULER_TPU_NUM_HOSTS={args.num_hosts} "
+              f"EULER_TPU_HOST_IDX={i} "
+              f"python {__file__} --worker --data_dir {args.data_dir} "
+              f"--registry_dir {args.registry_dir} "
+              f"--barrier_dir {args.barrier_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
