@@ -614,4 +614,14 @@ int etg_get_edge_binary_feature(int64_t h, const uint64_t* src,
   return 0;
 }
 
+// 64-bit string hash for Python data-prep id mapping (parity:
+// euler/util/python_api.cc py_hash64 — tools hash string node ids into
+// u64). FNV-1a: stable across platforms/runs, unlike Python's hash().
+uint64_t etg_hash64(const char* data, uint64_t size) {
+  uint64_t h = 1469598103934665603ULL;
+  for (uint64_t i = 0; i < size; ++i)
+    h = (h ^ static_cast<unsigned char>(data[i])) * 1099511628211ULL;
+  return h;
+}
+
 }  // extern "C"

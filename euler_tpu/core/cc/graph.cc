@@ -37,15 +37,10 @@ void GraphBuilder::AddEdge(NodeId src, NodeId dst, int32_t type,
     ET_LOG(WARNING) << "AddEdge: negative edge type " << type << " ignored";
     return;
   }
-  uint32_t srow = EnsureNode(src, 0, 1.0f, /*overwrite=*/false);
+  EnsureNode(src, 0, 1.0f, /*overwrite=*/false);
   if (type >= meta_.num_edge_types) meta_.num_edge_types = type + 1;
-  auto key = std::make_tuple(srow, dst, type);
-  auto it = edge_row_.find(key);
-  if (it != edge_row_.end()) {
-    edges_[it->second].weight = weight;
-    return;
-  }
-  edge_row_.emplace(key, edges_.size());
+  // duplicates are allowed here and deduped at Finalize (last added
+  // wins) — per-edge map maintenance would dominate bulk ingestion
   edges_.push_back({src, dst, type, weight});
 }
 
@@ -138,6 +133,16 @@ void GraphBuilder::SetNodeBinary(NodeId id, int fid, const char* v,
 
 int64_t GraphBuilder::FindEdgeRow(NodeId src, NodeId dst,
                                   int32_t type) const {
+  // extend the lazy index over edges added since the last lookup; later
+  // rows overwrite earlier ones, matching Finalize's last-added-wins
+  // dedup
+  for (; edge_indexed_upto_ < edges_.size(); ++edge_indexed_upto_) {
+    size_t e = edge_indexed_upto_;
+    auto nit = node_row_.find(edges_[e].src);
+    if (nit == node_row_.end()) continue;
+    edge_row_[std::make_tuple(nit->second, edges_[e].dst,
+                              edges_[e].type)] = e;
+  }
   auto nit = node_row_.find(src);
   if (nit == node_row_.end()) return -1;
   auto it = edge_row_.find(std::make_tuple(nit->second, dst, type));
@@ -275,36 +280,68 @@ std::unique_ptr<Graph> GraphBuilder::Finalize(bool build_in_adjacency) {
   }
 
   // ---- out-adjacency CSR, grouped by (src row, edge type) ----
-  std::vector<uint64_t> group_count(N * ET + 1, 0);
+  // Order edges within a group by dst id → deterministic layout, free
+  // sorted-full-neighbor, AND O(log d) EdgeSlot binary search (no edge
+  // map). Duplicate (src,dst,type) rows dedupe here, last added wins
+  // (ties break by builder row DESC so the survivor sorts first).
   std::vector<uint32_t> esrc_row(E);
+  for (size_t e = 0; e < E; ++e) esrc_row[e] = node_row_.at(edges_[e].src);
+  // Sort packed (group, dst, ~row) keys instead of indices: an indirect
+  // comparator dereferences edges_[] at random, and on 100M+ edges the
+  // cache misses made the sort dominate finalize.
+  struct SortKey {
+    uint64_t group;
+    NodeId dst;
+    uint64_t row;
+  };
+  std::vector<SortKey> keys(E);
   for (size_t e = 0; e < E; ++e) {
-    uint32_t srow = node_row_.at(edges_[e].src);
-    esrc_row[e] = srow;
-    group_count[static_cast<size_t>(srow) * ET + edges_[e].type + 1]++;
+    keys[e] = {static_cast<uint64_t>(esrc_row[e]) * ET + edges_[e].type,
+               edges_[e].dst, e};
   }
+  std::sort(keys.begin(), keys.end(), [](const SortKey& a, const SortKey& b) {
+    if (a.group != b.group) return a.group < b.group;
+    if (a.dst != b.dst) return a.dst < b.dst;
+    return a.row > b.row;  // latest added first among duplicates
+  });
+  std::vector<uint64_t> order(E);
+  for (size_t s = 0; s < E; ++s) order[s] = keys[s].row;
+  keys.clear();
+  keys.shrink_to_fit();
+  std::vector<uint64_t> kept;  // slot → builder edge row
+  kept.reserve(E);
+  std::vector<uint64_t> row2slot(E);  // builder edge row → adjacency slot
+  std::vector<uint64_t> group_count(N * ET + 1, 0);
+  {
+    size_t prev_g = static_cast<size_t>(-1);
+    NodeId prev_dst = 0;
+    for (uint64_t idx : order) {
+      size_t gi = static_cast<size_t>(esrc_row[idx]) * ET + edges_[idx].type;
+      NodeId dd = edges_[idx].dst;
+      if (!kept.empty() && gi == prev_g && dd == prev_dst) {
+        row2slot[idx] = kept.size() - 1;  // duplicate → survivor's slot
+        continue;
+      }
+      row2slot[idx] = kept.size();
+      kept.push_back(idx);
+      group_count[gi + 1]++;
+      prev_g = gi;
+      prev_dst = dd;
+    }
+  }
+  const size_t E2 = kept.size();
+  g->meta_.edge_count = E2;
   g->adj_offsets_.assign(N * ET + 1, 0);
   for (size_t i = 1; i <= N * ET; ++i) {
     g->adj_offsets_[i] = g->adj_offsets_[i - 1] + group_count[i];
   }
-  // Order edges within a group by dst id → deterministic layout and free
-  // sorted-full-neighbor. Sort edge row indices by (group, dst).
-  std::vector<uint64_t> order(E);
-  std::iota(order.begin(), order.end(), 0);
-  std::sort(order.begin(), order.end(), [&](uint64_t a, uint64_t b) {
-    size_t ga = static_cast<size_t>(esrc_row[a]) * ET + edges_[a].type;
-    size_t gb = static_cast<size_t>(esrc_row[b]) * ET + edges_[b].type;
-    if (ga != gb) return ga < gb;
-    return edges_[a].dst < edges_[b].dst;
-  });
-  g->adj_nbr_.resize(E);
-  g->adj_w_.resize(E);
-  g->adj_cumw_.resize(E);
-  std::vector<uint64_t> row2slot(E);  // builder edge row → adjacency slot
-  for (size_t s = 0; s < E; ++s) {
-    const EdgeRow& er = edges_[order[s]];
+  g->adj_nbr_.resize(E2);
+  g->adj_w_.resize(E2);
+  g->adj_cumw_.resize(E2);
+  for (size_t s = 0; s < E2; ++s) {
+    const EdgeRow& er = edges_[kept[s]];
     g->adj_nbr_[s] = er.dst;
     g->adj_w_[s] = er.weight;
-    row2slot[order[s]] = s;
   }
   for (size_t gi = 0; gi < N * ET; ++gi) {
     float run = 0.f;
@@ -313,14 +350,11 @@ std::unique_ptr<Graph> GraphBuilder::Finalize(bool build_in_adjacency) {
       g->adj_cumw_[s] = run;
     }
   }
-  for (const auto& kv : edge_row_) {
-    g->edge_slot_.emplace(kv.first, row2slot[kv.second]);
-  }
 
-  // ---- in-adjacency (only edges whose dst is a local node) ----
+  // ---- in-adjacency (only deduped edges whose dst is a local node) ----
   if (build_in_adjacency) {
     std::vector<uint64_t> in_count(N * ET + 1, 0);
-    for (size_t e = 0; e < E; ++e) {
+    for (uint64_t e : kept) {
       auto it = node_row_.find(edges_[e].dst);
       if (it == node_row_.end()) continue;
       in_count[static_cast<size_t>(it->second) * ET + edges_[e].type + 1]++;
@@ -336,8 +370,8 @@ std::unique_ptr<Graph> GraphBuilder::Finalize(bool build_in_adjacency) {
     std::vector<uint64_t> cursor(g->in_adj_offsets_.begin(),
                                  g->in_adj_offsets_.end() - 1);
     // Respect the same by-src-id order inside each group for determinism.
-    for (size_t s = 0; s < E; ++s) {
-      const EdgeRow& er = edges_[order[s]];
+    for (size_t s = 0; s < E2; ++s) {
+      const EdgeRow& er = edges_[kept[s]];
       auto it = node_row_.find(er.dst);
       if (it == node_row_.end()) continue;
       size_t gi = static_cast<size_t>(it->second) * ET + er.type;
@@ -401,7 +435,7 @@ std::unique_ptr<Graph> GraphBuilder::Finalize(bool build_in_adjacency) {
     auto& infos = is_node ? g->meta_.node_features : g->meta_.edge_features;
     auto& dense = is_node ? g->node_dense_ : g->edge_dense_;
     auto& var = is_node ? g->node_var_ : g->edge_var_;
-    size_t rows = is_node ? N : E;
+    size_t rows = is_node ? N : E2;
     dense.resize(infos.size());
     var.resize(infos.size());
     for (size_t fid = 0; fid < cells_by_fid.size(); ++fid) {
@@ -889,8 +923,16 @@ void Graph::GetBinaryFeature(const NodeId* ids, size_t count, int fid,
 uint64_t Graph::EdgeSlot(NodeId src, NodeId dst, int32_t type) const {
   uint32_t idx = NodeIndex(src);
   if (idx == kInvalidIndex) return kNoSlot;
-  auto it = edge_slot_.find(std::make_tuple(idx, dst, type));
-  return it == edge_slot_.end() ? kNoSlot : it->second;
+  int32_t et = meta_.num_edge_types;
+  if (type < 0 || type >= et) return kNoSlot;
+  // each (src row, type) group is sorted by dst — binary search beats a
+  // 100M+-entry edge map on both memory and build time
+  size_t gi = static_cast<size_t>(idx) * et + type;
+  uint64_t b = adj_offsets_[gi], e = adj_offsets_[gi + 1];
+  auto first = adj_nbr_.begin() + b, last = adj_nbr_.begin() + e;
+  auto it = std::lower_bound(first, last, dst);
+  if (it == last || *it != dst) return kNoSlot;
+  return b + static_cast<uint64_t>(it - first);
 }
 
 float Graph::GetEdgeWeight(NodeId src, NodeId dst, int32_t type) const {

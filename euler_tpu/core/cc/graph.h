@@ -216,7 +216,10 @@ class Graph {
   std::vector<NodeId> in_adj_nbr_;
   std::vector<float> in_adj_w_;
   std::vector<float> in_adj_cumw_;
-  // edge lookup: (src<<?) — use map keyed by (src_idx, dst_id, type)
+  // Edge slot lookup needs no map: each (src row, type) group's slots
+  // are sorted by dst, so EdgeSlot binary-searches the group — O(log d)
+  // with zero build/memory cost (a 100M+-entry hash map here once
+  // dominated finalize time and RSS).
   struct EdgeKeyHash {
     size_t operator()(const std::tuple<uint32_t, NodeId, int32_t>& k) const {
       uint64_t h = std::get<0>(k) * 0x9e3779b97f4a7c15ULL;
@@ -225,9 +228,6 @@ class Graph {
       return static_cast<size_t>(h);
     }
   };
-  std::unordered_map<std::tuple<uint32_t, NodeId, int32_t>, uint64_t,
-                     EdgeKeyHash>
-      edge_slot_;
   // global samplers
   // whole-graph labels
   std::vector<uint64_t> graph_labels_;  // per node row; empty → unlabeled
@@ -324,9 +324,17 @@ class GraphBuilder {
   std::vector<NodeRow> nodes_;
   std::unordered_map<NodeId, uint32_t> node_row_;
   std::vector<EdgeRow> edges_;
-  std::unordered_map<std::tuple<uint32_t, NodeId, int32_t>, uint64_t,
-                     Graph::EdgeKeyHash>
+  // Lazy (src_row, dst, type) → builder row index, extended
+  // incrementally on feature-setter lookups (edge_indexed_upto_ marks
+  // how far edges_ has been indexed). Plain ingest never touches it:
+  // maintaining a 100M+-entry map per AddEdge made bulk loads minutes
+  // slower for graphs that set no edge features at all, while the
+  // incremental cursor keeps interleaved AddEdge/SetEdge* loading
+  // (io.cc per-record pattern) linear.
+  mutable std::unordered_map<std::tuple<uint32_t, NodeId, int32_t>,
+                             uint64_t, Graph::EdgeKeyHash>
       edge_row_;
+  mutable size_t edge_indexed_upto_ = 0;
   // feature cells per fid, sorted at finalize
   std::vector<std::vector<FeatCell>> node_feat_cells_;
   std::vector<std::vector<FeatCell>> edge_feat_cells_;

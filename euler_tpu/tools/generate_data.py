@@ -102,18 +102,27 @@ def convert(json_path: str, out_dir: str, num_partitions: int = 1) -> dict:
     node_types: Dict[str, int] = {}
     edge_types: Dict[str, int] = {}
 
+    def node_id(val) -> int:
+        # string ids hash to u64 (reference parity: the json tools map
+        # string node ids through py_hash64, euler/util/python_api.cc)
+        if isinstance(val, str) and not val.lstrip("-").isdigit():
+            from euler_tpu.utils import hash64
+
+            return hash64(val)
+        return int(val)
+
     part_nodes = defaultdict(list)
     part_edges = defaultdict(list)
     for nd in nodes:
-        nid = int(nd["id"])
+        nid = node_id(nd["id"])
         p = nid % num_partitions
         rec = struct.pack("<Qif", nid, type_id(nd.get("type", 0), node_types),
                           float(nd.get("weight", 1.0)))
         rec += _pack_feats(nd.get("features", []), node_reg)
         part_nodes[p].append(rec)
     for ed in edges:
-        src = int(ed.get("src", ed.get("src_id", 0)))
-        dst = int(ed.get("dst", ed.get("dst_id", 0)))
+        src = node_id(ed.get("src", ed.get("src_id", 0)))
+        dst = node_id(ed.get("dst", ed.get("dst_id", 0)))
         p = src % num_partitions
         rec = struct.pack("<QQif", src, dst,
                           type_id(ed.get("type", 0), edge_types),

@@ -1,1 +1,10 @@
 from euler_tpu.utils import aggregators, encoders, layers, metrics, optimizers  # noqa: F401
+
+
+def hash64(s) -> int:
+    """Stable 64-bit string hash for id mapping in data prep (parity:
+    euler/util/python_api.cc py_hash64 exported to the json tools)."""
+    from euler_tpu.core import lib as _libmod
+
+    data = s.encode() if isinstance(s, str) else bytes(s)
+    return int(_libmod.load().etg_hash64(data, len(data)))

@@ -292,3 +292,15 @@ def test_native_engine_selftest():
                           capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "ALL OK" in proc.stdout
+
+
+def test_hash64_stable():
+    """64-bit string hash export (parity: euler/util/python_api.cc
+    py_hash64 — data-prep tools map string ids to u64)."""
+    from euler_tpu.utils import hash64
+
+    a = hash64("node_123")
+    assert a == hash64("node_123")            # stable
+    assert a != hash64("node_124")
+    assert hash64(b"node_123") == a           # bytes accepted
+    assert 0 <= a < 2 ** 64

@@ -241,3 +241,29 @@ def test_mutag_like_calibrated_difficulty():
     aa = np.asarray(aa)
     oracle = float(((aa > 0).astype(int) == y).mean())
     assert oracle > 0.88, oracle
+
+
+def test_generate_data_string_ids(tmp_path):
+    """JSON graphs with string node ids hash through hash64 (reference:
+    json tools map string ids via py_hash64)."""
+    import json as _json
+
+    from euler_tpu.graph import GraphEngine
+    from euler_tpu.tools.generate_data import convert
+    from euler_tpu.utils import hash64
+
+    graph = {
+        "nodes": [{"id": "user_a", "type": 0, "weight": 1.0},
+                  {"id": "user_b", "type": 0, "weight": 1.0}],
+        "edges": [{"src": "user_a", "dst": "user_b", "type": 0,
+                   "weight": 2.0}],
+    }
+    src_json = tmp_path / "g.json"
+    src_json.write_text(_json.dumps(graph))
+    out = str(tmp_path / "out")
+    convert(str(src_json), out, num_partitions=1)
+    g = GraphEngine.load(out)
+    a, b = hash64("user_a"), hash64("user_b")
+    off, nb, w, _ = g.get_full_neighbor(np.array([a], dtype=np.uint64))
+    assert list(nb) == [b]
+    np.testing.assert_allclose(w, [2.0])

@@ -1,0 +1,189 @@
+"""Host graph-engine microbenchmarks.
+
+Two concerns from the round-1 review, measured in one tool:
+
+  * --mode fanout — sampler throughput (edges sampled/s/core) through
+    each layer of the feeding stack: engine-direct C++ batch call, the
+    compiled GQL local path, and the 2-shard TCP remote path. The host
+    sampler must outrun the TPU (the reference's one-RPC fanout design,
+    sample_fanout_op.cc:36-48).
+  * --mode scale — ogbn-products-sized store probe (default 2.4M nodes /
+    ~120M edges): build time, finalize time, RSS, dump/load time, and a
+    sampling probe on the giant graph (super-linear blowups show here).
+
+Each section prints one JSON line and is also merged into perf.json at
+the repo root, which tools/collect_results.py renders into RESULTS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import resource
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+PERF_JSON = Path(__file__).resolve().parents[1] / "perf.json"
+
+
+def record(entry: dict) -> None:
+    print(json.dumps(entry), flush=True)
+    perf = {}
+    if PERF_JSON.exists():
+        perf = json.loads(PERF_JSON.read_text())
+    perf[entry["bench"]] = entry
+    PERF_JSON.write_text(json.dumps(perf, indent=1, sort_keys=True))
+
+
+def rss_gb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+
+
+def build_graph(n_nodes: int, avg_degree: int, feat_dim: int = 0,
+                chunk: int = 5_000_000):
+    """Power-law-ish random graph, built in chunks (columnar ingestion)."""
+    from euler_tpu.graph import GraphBuilder, seed
+
+    seed(1)
+    b = GraphBuilder()
+    if feat_dim:
+        b.set_num_types(1, 1)
+        b.set_feature(0, 0, feat_dim, "feature")
+    ids = np.arange(1, n_nodes + 1, dtype=np.uint64)
+    t0 = time.time()
+    b.add_nodes(ids)
+    n_edges = n_nodes * avg_degree
+    rng = np.random.default_rng(0)
+    for start in range(0, n_edges, chunk):
+        m = min(chunk, n_edges - start)
+        src = rng.integers(1, n_nodes + 1, m).astype(np.uint64)
+        # mild skew: square the uniform to concentrate on low ids
+        dst = (rng.random(m) ** 2 * n_nodes).astype(np.uint64) + 1
+        b.add_edges(src, dst, weights=rng.random(m).astype(np.float32))
+    ingest_s = time.time() - t0
+    t0 = time.time()
+    if feat_dim:
+        for start in range(0, n_nodes, chunk // max(feat_dim, 1)):
+            part = ids[start:start + chunk // max(feat_dim, 1)]
+            b.set_node_dense(part, 0,
+                             rng.random((part.size, feat_dim),
+                                        dtype=np.float32))
+    g = b.finalize()
+    finalize_s = time.time() - t0
+    return g, ingest_s, finalize_s, n_edges
+
+
+def bench_fanout(args):
+    from euler_tpu.gql import Query, start_service
+    from euler_tpu.graph import RemoteGraphEngine
+
+    import os
+
+    g, *_ = build_graph(args.nodes, args.degree, feat_dim=0)
+    fanouts = [int(x) for x in args.fanouts.split(",")]
+    # edges/step accounting matches bench.py: sum over hops of
+    # batch * prod(fanouts[:h+1])
+    edges_per_batch, m = 0, args.batch
+    for k in fanouts:
+        m *= k
+        edges_per_batch += m
+    n_cores = os.cpu_count() or 1
+
+    def run(tag, fn):
+        fn()  # warm
+        t0 = time.time()
+        reps = 0
+        while time.time() - t0 < args.seconds:
+            fn()
+            reps += 1
+        dt = time.time() - t0
+        eps = reps * edges_per_batch / dt
+        # the GQL/remote paths use the engine thread pool, so this is
+        # whole-host throughput; cores recorded for per-core math
+        record({"bench": f"host_fanout_{tag}", "edges_per_sec": round(eps),
+                "host_cores": n_cores, "batch": args.batch,
+                "fanouts": fanouts, "reps": reps})
+        return eps
+
+    roots = g.sample_node(args.batch, -1)
+    run("engine", lambda: g.sample_fanout(roots, fanouts))
+
+    q = Query.local(g, seed=1)
+    gql = "v(r)" + "".join(f".sampleNB(*, {k}, 0).as(h{i})"
+                           for i, k in enumerate(fanouts))
+    run("gql_local", lambda: q.run(gql, {"r": roots}))
+
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="et_bench_")
+    g.dump(d, num_partitions=2)
+    servers = [start_service(d, shard_idx=i, shard_num=2, port=0)
+               for i in range(2)]
+    eps = ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    remote = RemoteGraphEngine(f"hosts:{eps}", seed=1)
+    run("remote_2shard", lambda: remote.sample_fanout(roots, fanouts))
+    remote.close()
+    for s in servers:
+        s.stop()
+
+
+def bench_scale(args):
+    t_all = time.time()
+    g, ingest_s, finalize_s, n_edges = build_graph(
+        args.nodes, args.degree, feat_dim=args.feat_dim)
+    out = {
+        "bench": "store_scale_probe",
+        "nodes": args.nodes,
+        "edges": n_edges,
+        "feat_dim": args.feat_dim,
+        "ingest_s": round(ingest_s, 1),
+        "finalize_s": round(finalize_s, 1),
+        "rss_gb": round(rss_gb(), 2),
+    }
+    # sampling probe on the giant store
+    roots = g.sample_node(512, -1)
+    t0 = time.time()
+    reps = 5
+    for _ in range(reps):
+        g.sample_fanout(roots, [10, 10])
+    out["fanout_edges_per_sec"] = round(reps * (512 * 10 + 512 * 100) /
+                                        (time.time() - t0))
+    if args.dump_dir:
+        t0 = time.time()
+        g.dump(args.dump_dir, num_partitions=4)
+        out["dump_s"] = round(time.time() - t0, 1)
+        from euler_tpu.graph import GraphEngine
+
+        t0 = time.time()
+        g2 = GraphEngine.load(args.dump_dir)
+        out["load_s"] = round(time.time() - t0, 1)
+        out["loaded_edges"] = g2.edge_count
+    out["total_s"] = round(time.time() - t_all, 1)
+    record(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["fanout", "scale"], default="fanout")
+    ap.add_argument("--nodes", type=int, default=100_000)
+    ap.add_argument("--degree", type=int, default=15)
+    ap.add_argument("--feat_dim", type=int, default=0)
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--fanouts", default="10,10")
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--dump_dir", default="")
+    args = ap.parse_args(argv)
+    if args.mode == "fanout":
+        bench_fanout(args)
+    else:
+        bench_scale(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -114,11 +114,27 @@ def write_markdown(results: dict, path):
             ref_s = f"{ref:.3f}"
         else:
             ref_s = "—"
-        metric = "acc" if ds == "mutag" else (
-            "mrr" if model in ("deepwalk", "line", "transe", "transh",
-                               "transr", "transd", "distmult", "rgcn",
-                               "gae", "dgi") else "micro-F1")
+        if ds == "mutag":
+            metric = "acc"
+        elif model == "dgi":
+            metric = "probe-acc"  # linear probe on frozen embeddings
+        elif model in ("deepwalk", "line", "transe", "transh", "transr",
+                       "transd", "distmult", "rgcn", "gae"):
+            metric = "mrr"
+        else:
+            metric = "micro-F1"
         lines.append(f"| {model} | {ds} | {metric} | {ours} | {ref_s} |")
+    perf_path = REPO / "perf.json"
+    if perf_path.exists():
+        perf = json.loads(perf_path.read_text())
+        lines += ["", "## Host engine performance",
+                  "(`python tools/bench_host.py`; whole-host throughput, "
+                  "core count recorded per entry)", ""]
+        for key in sorted(perf):
+            e = dict(perf[key])
+            e.pop("bench", None)
+            lines.append(f"- **{key}**: " + ", ".join(
+                f"{k}={v}" for k, v in e.items()))
     lines.append("")
     Path(path).write_text("\n".join(lines))
 
