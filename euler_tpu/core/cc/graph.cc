@@ -260,7 +260,23 @@ std::unique_ptr<Graph> GraphBuilder::Finalize(bool build_in_adjacency) {
     g->node_types_[i] = nodes_[i].type;
     g->node_weights_[i] = nodes_[i].weight;
   }
-  g->id2idx_ = node_row_;
+  if (N > 0) {
+    NodeId lo = g->node_ids_[0], hi = g->node_ids_[0];
+    for (NodeId id : g->node_ids_) {
+      lo = std::min(lo, id);
+      hi = std::max(hi, id);
+    }
+    uint64_t span = hi - lo + 1;  // wraps to 0 for the full u64 range
+    if (span != 0 && span <= 4 * static_cast<uint64_t>(N)) {
+      g->dense_base_ = lo;
+      g->dense_idx_.assign(span, kInvalidIndex);
+      for (size_t i = 0; i < N; ++i)
+        g->dense_idx_[g->node_ids_[i] - lo] = static_cast<uint32_t>(i);
+    }
+  }
+  // the hash map is only the NodeIndex fallback — keeping both on a
+  // 100M-edge store would waste ~100MB RSS for nothing
+  if (g->dense_idx_.empty()) g->id2idx_ = node_row_;
 
   // ---- whole-graph labels ----
   if (!graph_label_of_.empty()) {

@@ -66,6 +66,13 @@ class Graph {
  public:
   // ---- topology ----
   uint32_t NodeIndex(NodeId id) const {
+    // dense-id fast path: id→row is one bounds check + array load when
+    // the id space is compact (ogbn-style 0..N ids) — the hash lookup
+    // otherwise dominates the per-edge sampling cost
+    if (!dense_idx_.empty()) {
+      uint64_t off = id - dense_base_;
+      return off < dense_idx_.size() ? dense_idx_[off] : kInvalidIndex;
+    }
     auto it = id2idx_.find(id);
     return it == id2idx_.end() ? kInvalidIndex : it->second;
   }
@@ -206,6 +213,10 @@ class Graph {
   std::vector<int32_t> node_types_;
   std::vector<float> node_weights_;
   std::unordered_map<NodeId, uint32_t> id2idx_;
+  // direct id→row table when the id range is ≤ 4× node count (built at
+  // Finalize); empty → fall back to the hash map
+  std::vector<uint32_t> dense_idx_;
+  NodeId dense_base_ = 0;
   // out-adjacency: group g = idx*num_edge_types + et
   std::vector<uint64_t> adj_offsets_;  // size N*ET + 1
   std::vector<NodeId> adj_nbr_;

@@ -71,7 +71,7 @@ def main(argv=None):
 
     print({**{f"train_{k}": v for k, v in res.items()},
            **{f"eval_{k}": v for k, v in ev.items()},
-           "eval_metric": probe, "probe_f1": probe})
+           "probe_acc": probe})
     return ev
 
 

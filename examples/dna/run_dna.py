@@ -21,7 +21,7 @@ def main(argv=None):
     ap.add_argument("--heads", type=int, default=1)
     ap.add_argument("--batch_size", type=int, default=128)
     ap.add_argument("--learning_rate", type=float, default=0.01)
-    ap.add_argument("--max_steps", type=int, default=200)
+    ap.add_argument("--max_steps", type=int, default=400)
     ap.add_argument("--eval_steps", type=int, default=20)
     ap.add_argument("--dropout", type=float, default=0.5)
     ap.add_argument("--weight_decay", type=float, default=0.005)
@@ -42,18 +42,24 @@ def main(argv=None):
         def embed(self, batch):
             x = batch["x"]
             n = x.shape[0]
-            h = nn.relu(nn.Dense(args.hidden_dim, name="proj")(x))
+            det = not self.has_rng("dropout")
+            drop = nn.Dropout(args.dropout)
+            h = nn.relu(nn.Dense(args.hidden_dim, name="proj")(
+                drop(x, deterministic=det)))
             hist = h[:, None, :]
             for i in range(args.num_layers):
+                # between-layer dropout (the reference DNA uses heavy
+                # inter-layer dropout on citation sets)
+                hist_in = drop(hist, deterministic=det)
                 h = DNAConv(out_dim=args.hidden_dim, heads=args.heads,
-                            name=f"dna_{i}")(hist, batch["edge_index"], n)
+                            name=f"dna_{i}")(hist_in, batch["edge_index"], n)
                 hist = jnp.concatenate([hist, h[:, None, :]], axis=1)
             root = batch.get("root_index")
             return h if root is None else jnp.take(h, root, axis=0)
 
     flow = FullBatchDataFlow(data.engine, feature_ids=["feature"])
     est = NodeEstimator(
-        DNAModel(num_classes=data.num_classes, multilabel=data.multilabel, dropout=args.dropout),
+        DNAModel(num_classes=data.num_classes, multilabel=data.multilabel),
         dict(batch_size=args.batch_size, learning_rate=args.learning_rate,
              weight_decay=args.weight_decay,
              label_dim=data.num_classes),

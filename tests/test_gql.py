@@ -793,3 +793,16 @@ def test_index_load_in_service(two_attr_graph, tmp_path):
     finally:
         q.close()
         s.stop()
+
+
+def test_gp_out_e_matches_local(labeled_graph, gp_cluster):
+    """outE in graph_partition mode: broadcast + ownership filter +
+    GP_RAGGED_MERGE over 5 outputs must reproduce local results."""
+    q, _ = gp_cluster
+    lq = Query.local(labeled_graph)
+    roots = np.arange(1, 13, dtype=np.uint64)
+    lo = lq.run("v(r).outE(*).as(e)", {"r": roots})
+    ro = q.run("v(r).outE(*).as(e)", {"r": roots})
+    for k in ("e:0", "e:1", "e:2", "e:3"):
+        assert list(np.ravel(ro[k])) == list(np.ravel(lo[k])), k
+    np.testing.assert_allclose(ro["e:4"], lo["e:4"])
