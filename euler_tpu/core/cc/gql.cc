@@ -933,29 +933,38 @@ Status OptimizeDag(const CompileOptions& opts, DAGDef* dag) {
     }
 
     if (n.op == "API_SAMPLE_L") {
-      // broadcast roots to every shard, merge per-layer pools
-      size_t n_layers =
-          1 + std::count(n.attrs[1].begin(), n.attrs[1].end(), ':');
+      // Per-LAYER split/remote/merge: layer l's pool is sampled by the
+      // shards OWNING the layer-(l-1) nodes (edges are partitioned by
+      // src, so only the owner sees a node's out-neighbors). A one-shot
+      // broadcast once produced all-pad layer-2 pools: a shard's local
+      // layer-1 nodes mostly live on other shards.
       std::vector<std::string> sizes;
       {
         std::stringstream ss(n.attrs[1]);
         std::string it;
         while (std::getline(ss, it, ':')) sizes.push_back(it);
       }
-      std::vector<std::string> remotes;
-      for (int s = 0; s < S; ++s) {
-        NodeDef inner = n;
-        inner.name = orig + "_sh" + std::to_string(s);
-        remotes.push_back(rw.AddRemote(s, std::move(inner), {n.inputs[0]},
-                                       static_cast<int>(n_layers)));
-      }
+      std::string pool = n.inputs[0];
       std::vector<std::string> collect_ins;
-      for (size_t l = 0; l < n_layers; ++l) {
+      for (size_t l = 0; l < sizes.size(); ++l) {
+        std::string split =
+            rw.Add(rw.Fresh("ID_SPLIT"), "ID_SPLIT", {pool}, {pn, sn});
         std::vector<std::string> ins;
-        for (auto& r : remotes) ins.push_back(r + ":" + std::to_string(l));
+        for (int s = 0; s < S; ++s) {
+          NodeDef inner = n;
+          inner.name =
+              orig + "_l" + std::to_string(l) + "_sh" + std::to_string(s);
+          inner.inputs = {split + ":" + std::to_string(2 * s)};
+          inner.attrs[1] = sizes[l];  // single-layer sample on the shard
+          ins.push_back(
+              rw.AddRemote(s, std::move(inner),
+                           {split + ":" + std::to_string(2 * s)}, 1) +
+              ":0");
+        }
         std::string m =
             rw.Add(rw.Fresh("POOL_MERGE"), "POOL_MERGE", ins, {sizes[l]});
         collect_ins.push_back(m + ":0");
+        pool = m + ":0";
       }
       rw.Add(orig, "COLLECT", collect_ins, {});
       continue;

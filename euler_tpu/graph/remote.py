@@ -33,6 +33,9 @@ class RemoteGraphEngine:
     def __init__(self, endpoints: str, seed: int = 0,
                  mode: str = "distribute"):
         self.query = Query.remote(endpoints, seed=seed, mode=mode)
+        # host-side rng for the client-computed node2vec bias; seed=0 →
+        # fresh entropy (matching the engine's seed convention)
+        self._rng = np.random.default_rng(seed if seed else None)
 
     # -- root sampling -----------------------------------------------------
     def sample_node(self, count: int, node_type: int = -1) -> np.ndarray:
@@ -108,6 +111,71 @@ class RemoteGraphEngine:
         return (offsets, out["e:1"].astype(np.uint64),
                 out["e:2"].astype(np.uint64), out["e:3"].astype(np.int32),
                 out["e:4"].astype(np.float32))
+
+    def sample_layerwise(self, roots, layer_sizes: Sequence[int],
+                         edge_types=None, default_id: int = 0):
+        """LADIES pools from the cluster via one sampleLNB query
+        (reference SampleNeighborLayerwiseWithAdj → API_SAMPLE_L)."""
+        roots = np.ascontiguousarray(roots, dtype=np.uint64).ravel()
+        sizes = ":".join(str(int(s)) for s in layer_sizes)
+        out = self.query.run(
+            f"v(r).sampleLNB({self._et(edge_types)}, {sizes}, "
+            f"{default_id}).as(l)", {"r": roots})
+        return [out[f"l:{i}"].astype(np.uint64)
+                for i in range(len(layer_sizes))]
+
+    def random_walk(self, roots, walk_len: int, p: float = 1.0,
+                    q: float = 1.0, edge_types=None,
+                    default_id: int = 0) -> np.ndarray:
+        """[n, walk_len+1] walks against the cluster. The unbiased case
+        is ONE chained-sampleNB round trip; node2vec bias (p/q) falls
+        back to per-step neighbor queries with client-side reweighting —
+        the reference's random_walk_op.cc:70-110 approach."""
+        roots = np.ascontiguousarray(roots, dtype=np.uint64).ravel()
+        n = roots.size
+        et = self._et(edge_types)
+        out = np.zeros((n, walk_len + 1), dtype=np.uint64)
+        out[:, 0] = roots
+        if p == 1.0 and q == 1.0:
+            gql = "v(r)" + "".join(
+                f".sampleNB({et}, 1, {default_id}).as(s{i})"
+                for i in range(walk_len))
+            res = self.query.run(gql, {"r": roots})
+            for i in range(walk_len):
+                out[:, i + 1] = res[f"s{i}:1"].astype(np.uint64)
+            return out
+        rng = self._rng
+        prev = np.zeros(n, dtype=np.uint64)
+        cur = roots.copy()
+        # neighbor lists of `prev` are the previous step's `cur` lists —
+        # cache them instead of refetching (halves the per-step RPCs)
+        poff = np.zeros(n + 1, dtype=np.int64)
+        pnbr = np.zeros(0, dtype=np.uint64)
+        for step in range(walk_len):
+            off, nbr, w, _ = self.get_full_neighbor(cur,
+                                                    edge_types=edge_types)
+            off = off.astype(np.int64)
+            nxt = np.full(n, default_id, dtype=np.uint64)
+            for i in range(n):
+                b, e = off[i], off[i + 1]
+                if e <= b:
+                    continue
+                cand = nbr[b:e]
+                wt = w[b:e].astype(np.float64).copy()
+                prev_nb = set(pnbr[poff[i]:poff[i + 1]].tolist())
+                for j, x in enumerate(cand):
+                    if x == prev[i]:
+                        wt[j] /= p        # return edge
+                    elif int(x) not in prev_nb:
+                        wt[j] /= q        # outward edge
+                s = wt.sum()
+                if s <= 0:
+                    continue
+                nxt[i] = cand[rng.choice(e - b, p=wt / s)]
+            prev, cur = cur, nxt
+            poff, pnbr = off, nbr
+            out[:, step + 1] = cur
+        return out
 
     # -- features ----------------------------------------------------------
     def get_dense_feature(self, ids, fids, dims=None):

@@ -25,7 +25,10 @@ def initialize_graph(config) -> GraphEngine:
     data_type} mirroring the reference's "k=v;..." config string.
     """
     global _GRAPH
-    if isinstance(config, GraphEngine):
+    if isinstance(config, GraphEngine) or hasattr(config, "sample_fanout"):
+        # embedded engine OR a RemoteGraphEngine / compatible client —
+        # the reference's initialize_graph covers both modes too
+        # (tf_euler/python/euler_ops/base.py:37 local vs remote config)
         _GRAPH = config
     elif isinstance(config, str):
         _GRAPH = GraphEngine.load(config)

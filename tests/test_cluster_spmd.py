@@ -124,3 +124,46 @@ def test_remote_dense_feature_missing_id_zero_filled(featured_cluster):
     want = g.get_dense_feature(ids, "feature")
     np.testing.assert_allclose(got, want)
     assert not got[0].any() and got[1].any()
+
+
+def test_remote_layerwise_and_walks(featured_cluster):
+    """Layerwise pools + random walks against the cluster (reference:
+    API_SAMPLE_L and the client-side node2vec walk both work remote)."""
+    g, remote = featured_cluster
+    roots = np.array([1, 2, 3, 4], dtype=np.uint64)
+    pools = remote.sample_layerwise(roots, [6, 8])
+    assert [len(x) for x in pools] == [6, 8]
+    assert all(set(x) <= set(range(1, 41)) for x in pools)
+    # unbiased walk: one chained query; ring graph (type 0 edge i→i+1,
+    # type-1 i→i+3 mod 40), so every step lands on a valid node
+    walks = remote.random_walk(roots, 4)
+    assert walks.shape == (4, 5)
+    assert (walks[:, 0] == roots).all()
+    assert set(walks.ravel()) <= set(range(1, 41))
+    # biased (p,q) walk matches the embedded engine's reachable set
+    bwalks = remote.random_walk(roots, 3, p=0.5, q=2.0)
+    assert bwalks.shape == (4, 4)
+    assert set(bwalks.ravel()) <= set(range(0, 41))
+
+
+def test_ops_facade_remote_mode(featured_cluster):
+    """euler_tpu.ops works against a cluster: initialize_graph adopts a
+    RemoteGraphEngine and the functional ops (fanout, walks, features)
+    route through GQL — the reference's initialize_graph remote mode."""
+    import euler_tpu.ops as ops
+
+    g, remote = featured_cluster
+    ops.initialize_graph(remote)
+    try:
+        ids, w, t = ops.sample_fanout(np.array([1, 2], dtype=np.uint64),
+                                      [3, 2])
+        assert ids[0].shape == (2,) and ids[1].shape == (6,)
+        walks = ops.random_walk(np.array([5], dtype=np.uint64), 3)
+        assert walks.shape == (1, 4)
+        pairs = ops.gen_pair(walks, 1, 1)
+        assert pairs.shape[-1] == 2
+        feats = ops.get_dense_feature(np.array([7], dtype=np.uint64),
+                                      "feature")
+        assert feats.shape == (1, 8)
+    finally:
+        ops.initialize_graph(g)  # restore embedded for other tests

@@ -806,3 +806,28 @@ def test_gp_out_e_matches_local(labeled_graph, gp_cluster):
     for k in ("e:0", "e:1", "e:2", "e:3"):
         assert list(np.ravel(ro[k])) == list(np.ravel(lo[k])), k
     np.testing.assert_allclose(ro["e:4"], lo["e:4"])
+
+
+def test_remote_layerwise_pools_valid(two_shard_cluster):
+    """Distributed sampleLNB must produce real node pools at EVERY layer
+    (per-layer split/remote/merge; the one-shot broadcast rewrite once
+    emitted all-pad layer-2 pools because a shard's layer-1 nodes mostly
+    live on other shards)."""
+    q, _ = two_shard_cluster
+    out = q.run("v(r).sampleLNB(*, 4:6, 0).as(l)",
+                {"r": np.array([1, 2], dtype=np.uint64)})
+    assert out["l:0"].shape == (4,)
+    assert out["l:1"].shape == (6,)
+    for k in ("l:0", "l:1"):
+        vals = set(int(v) for v in out[k])
+        assert vals <= set(range(1, 11)) and vals, (k, vals)
+    # frontier check: layer l must be sampled from layer l-1's
+    # OUT-NEIGHBORS (a rewrite that re-sampled from the roots would
+    # still emit valid ids) — ring edges are i→i+1 and i→i+2 (mod 10)
+    def succs(pool):
+        return {i % 10 + 1 for i in pool} | {(i + 1) % 10 + 1 for i in pool}
+
+    l0 = [int(v) for v in out["l:0"]]
+    l1 = set(int(v) for v in out["l:1"])
+    assert set(l0) <= succs([1, 2])
+    assert l1 <= succs(l0), (l0, l1)
