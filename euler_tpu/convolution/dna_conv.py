@@ -36,7 +36,12 @@ class DNAConv(nn.Module):
         q_w = nn.Dense(self.out_dim, use_bias=False, name="q")
         k_w = nn.Dense(self.out_dim, use_bias=False, name="k")
         v_w = nn.Dense(self.out_dim, use_bias=False, name="v")
-        src, dst = edge_index[0], edge_index[1]
+        # attention runs over N(i) ∪ {i}: append virtual self-loop edges
+        # (the paper's formulation; without them a node's own history only
+        # enters through the query and the update loses its skip path)
+        loop = jnp.arange(n, dtype=edge_index.dtype)
+        src = jnp.concatenate([edge_index[0], loop])
+        dst = jnp.concatenate([edge_index[1], loop])
         # per-edge: query = dst's latest layer; key/value = src's history
         q = q_w(x[:, -1, :]).reshape(N, H, dh)[dst]          # [E, H, dh]
         k = k_w(x).reshape(N, T, H, dh)[src]                 # [E, T, H, dh]

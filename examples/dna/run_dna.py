@@ -6,6 +6,9 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from common import fit_citation  # noqa: E402
 
 from euler_tpu.platform import add_platform_flag, init_platform  # noqa: E402
 
@@ -65,8 +68,7 @@ def main(argv=None):
              label_dim=data.num_classes),
         data.engine, flow, label_fid="label", label_dim=data.num_classes,
         model_dir=args.model_dir or None)
-    res = est.train_and_evaluate(est.train_input_fn, est.eval_input_fn,
-                                 args.max_steps, args.eval_steps)
+    res = fit_citation(est, args.max_steps, args.eval_steps)
     print(res)
     return res
 

@@ -13,6 +13,9 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from common import fit_citation  # noqa: E402
 
 from euler_tpu.platform import add_platform_flag, init_platform  # noqa: E402
 
@@ -75,8 +78,7 @@ def main(argv=None):
     elif args.run_mode == "infer":
         print(est.infer(est.infer_input_fn))
     else:
-        res = est.train_and_evaluate(est.train_input_fn, est.eval_input_fn,
-                                     args.max_steps, args.eval_steps)
+        res = fit_citation(est, args.max_steps, args.eval_steps)
         print(res)
         return res
     return None
