@@ -44,8 +44,11 @@ static std::string ProducerOf(const std::string& tensor_name) {
 
 bool TopologicSort(const DAGDef& dag, std::vector<int>* order) {
   std::unordered_map<std::string, int> by_name;
-  for (size_t i = 0; i < dag.nodes.size(); ++i)
+  for (size_t i = 0; i < dag.nodes.size(); ++i) {
     by_name[dag.nodes[i].name] = static_cast<int>(i);
+    for (const auto& extra : dag.nodes[i].also_produces)
+      by_name[extra] = static_cast<int>(i);
+  }
   std::vector<int> indeg(dag.nodes.size(), 0);
   std::vector<std::vector<int>> succ(dag.nodes.size());
   for (size_t i = 0; i < dag.nodes.size(); ++i) {
@@ -79,8 +82,11 @@ Executor::Executor(const DAGDef* dag, const QueryEnv& env,
     : dag_(dag), env_(env), ctx_(ctx), remaining_nodes_(0), failed_(false) {
   if (env_.pool == nullptr) env_.pool = GlobalThreadPool();
   std::unordered_map<std::string, int> by_name;
-  for (size_t i = 0; i < dag->nodes.size(); ++i)
+  for (size_t i = 0; i < dag->nodes.size(); ++i) {
     by_name[dag->nodes[i].name] = static_cast<int>(i);
+    for (const auto& extra : dag->nodes[i].also_produces)
+      by_name[extra] = static_cast<int>(i);
+  }
   nodes_.resize(dag->nodes.size());
   for (size_t i = 0; i < dag->nodes.size(); ++i) {
     nodes_[i].def = &dag->nodes[i];

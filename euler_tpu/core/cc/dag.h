@@ -50,6 +50,13 @@ struct NodeDef {
   // REMOTE only: target shard and the sub-plan to run there.
   int shard_idx = -1;
   std::vector<NodeDef> inner;
+  // FUSED only: names of the subsumed inner nodes. The fused kernel puts
+  // their tensors under the original "<name>:idx" names, and dependency
+  // resolution treats this node as the producer of those names — so
+  // consumers outside the fusion group need no rewriting. (Reference
+  // analog: the subgraph-iso fusion pass, optimizer.h:96; here fusion is
+  // a direct linear-chain collapse.)
+  std::vector<std::string> also_produces;
 
   std::string OutName(int i) const { return name + ":" + std::to_string(i); }
 };

@@ -2,6 +2,7 @@
 
 #include <algorithm>
 #include <cctype>
+#include <cstdlib>
 #include <sstream>
 #include <unordered_set>
 
@@ -811,8 +812,52 @@ Status GpRewrite(const CompileOptions& opts, DAGDef* dag) {
 
 }  // namespace
 
+// Local fusion (reference analog: the optimizer's subgraph-iso fusion,
+// optimizer.h:96 — here a direct collapse, no pattern matching needed):
+// wrap the whole plan in one FUSED node whose kernel runs the original
+// nodes inline in topological order. All local kernels are synchronous,
+// so this removes the per-op executor scheduling (atomic dep counters +
+// thread-pool handoff per node) from the hot sampling path; tensors keep
+// their original names via also_produces, and seeded RNG streams hash the
+// original node names, so fused and unfused plans sample identically.
+void FuseLocalPass(DAGDef* dag) {
+  if (dag->nodes.size() < 2) return;
+  for (const auto& n : dag->nodes)
+    if (n.op == "REMOTE" || LookupKernel(n.op) == nullptr) return;
+  std::vector<int> order;
+  if (!TopologicSort(*dag, &order)) return;  // cycle → let the executor report
+  NodeDef fused;
+  fused.name = dag->UniqueName("FUSED");
+  fused.op = "FUSED";
+  std::unordered_set<std::string> inner_names;
+  for (const auto& n : dag->nodes) inner_names.insert(n.name);
+  std::unordered_set<std::string> seen_inputs;
+  for (int idx : order) {
+    const NodeDef& n = dag->nodes[idx];
+    fused.also_produces.push_back(n.name);
+    for (const auto& in : n.inputs) {
+      auto pos = in.rfind(':');
+      std::string producer =
+          pos == std::string::npos ? in : in.substr(0, pos);
+      if (inner_names.count(producer) == 0 && seen_inputs.insert(in).second)
+        fused.inputs.push_back(in);  // external query input → dep edge
+    }
+  }
+  std::vector<NodeDef> inner;
+  inner.reserve(order.size());
+  for (int idx : order) inner.push_back(std::move(dag->nodes[idx]));
+  fused.inner = std::move(inner);
+  dag->nodes.clear();
+  dag->nodes.push_back(std::move(fused));
+}
+
 Status OptimizeDag(const CompileOptions& opts, DAGDef* dag) {
   CsePass(dag);
+  if (opts.mode == "local" && opts.fuse_local &&
+      std::getenv("EULER_TPU_NO_FUSE") == nullptr) {
+    FuseLocalPass(dag);
+    return Status::OK();
+  }
   if (opts.mode == "graph_partition") return GpRewrite(opts, dag);
   // shard_num == 1 still needs the rewrite in distribute mode: the client
   // has no local graph, so graph ops must ship to the (single) remote

@@ -7,10 +7,11 @@
 // sampleNWithTypes, outV, inV, sampleNB, sampleLNB, values, label, udf,
 // has, hasKey, hasLabel, limit, orderBy, as, and/or, gt/ge/lt/le/eq/ne);
 // Translator::Translate → translation to a DAGDef of API_* nodes with DNF
-// conditions; Optimizer::Optimize → CSE plus the distribute rewrite
-// (split → per-shard REMOTE → merge, with unique/gather dedup — reference
-// optimizer.h:51-121); Compiler::Compile → cached compilation keyed by
-// query text (reference compiler.h:112).
+// conditions; Optimizer::Optimize → CSE, local fusion (FuseLocalPass —
+// the reference's subgraph-iso fusion, optimizer.h:96, as a direct
+// whole-plan collapse), and the distribute rewrite (split → per-shard
+// REMOTE → merge, with unique/gather dedup — reference optimizer.h:51-121);
+// Compiler::Compile → cached compilation keyed by query text (compiler.h:112).
 //
 // Query chains reference externally supplied input tensors by name:
 //   v(roots).sampleNB(0, 10, -1).as(nb)         — roots: u64 ids input
@@ -53,6 +54,10 @@ struct CompileOptions {
   int shard_num = 1;      // >1 + mode=distribute → shard rewrite
   int partition_num = 1;  // graph partition count (placement modulus)
   std::string mode = "local";  // "local" | "distribute"
+  // Local-mode fusion: collapse the whole (sync-op) plan into one FUSED
+  // node executed inline — removes per-op executor scheduling from the
+  // hot sampling path. Env override: EULER_TPU_NO_FUSE=1 disables.
+  bool fuse_local = true;
 };
 
 // Node shard placement. Data prep assigns partition p = id % P and shard k

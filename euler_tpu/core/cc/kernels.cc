@@ -16,6 +16,8 @@
 // shape-agnostic.
 #include <algorithm>
 #include <cstdlib>
+#include <memory>
+#include <mutex>
 #include <numeric>
 #include <sstream>
 #include <unordered_map>
@@ -769,6 +771,61 @@ class AsOp : public OpKernel {
   }
 };
 ET_REGISTER_KERNEL("AS", AsOp);
+
+// ---------------------------------------------------------------------------
+// FUSED — a collapsed local plan (gql.cc FuseLocalPass): runs `inner`
+// nodes inline in the already-topological order, sharing this query's
+// context, so an entire sampling chain costs one executor dispatch.
+// Inner kernels put tensors under their ORIGINAL names; consumers outside
+// the fusion group resolve through NodeDef::also_produces.
+// ---------------------------------------------------------------------------
+class FusedOp : public OpKernel {
+ public:
+  void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
+               std::function<void(Status)> done) override {
+    for (const auto& sub : node.inner) {
+      OpKernel* k = LookupKernel(sub.op);
+      if (k == nullptr) {
+        done(Status::NotFound("FUSED: no kernel for op " + sub.op));
+        return;
+      }
+      // Contract: fusion groups hold synchronous kernels only (FuseLocal-
+      // Pass excludes REMOTE, the sole async op). Waiting here for a
+      // stray async kernel would deadlock the shared pool (the inner
+      // completion needs a pool thread this one is blocking), so fail
+      // loudly instead. State lives in a shared_ptr so a late completion
+      // writes into live memory instead of a dead stack frame.
+      struct CallState {
+        std::mutex mu;
+        bool fired = false;
+        Status st;
+      };
+      auto cs = std::make_shared<CallState>();
+      k->Compute(sub, env, ctx, [cs](Status s) {
+        std::lock_guard<std::mutex> lk(cs->mu);
+        cs->st = std::move(s);
+        cs->fired = true;
+      });
+      Status st;
+      {
+        std::lock_guard<std::mutex> lk(cs->mu);
+        if (!cs->fired) {
+          done(Status::Internal(
+              "FUSED: op " + sub.op +
+              " completed asynchronously; fusion requires sync kernels"));
+          return;
+        }
+        st = cs->st;
+      }
+      if (!st.ok()) {
+        done(st);
+        return;
+      }
+    }
+    done(Status::OK());
+  }
+};
+ET_REGISTER_KERNEL("FUSED", FusedOp);
 
 // ---------------------------------------------------------------------------
 // POST_PROCESS — order_by/limit over a ragged quad (reference

@@ -3,7 +3,10 @@
 namespace et {
 
 namespace {
-constexpr uint32_t kExecMagic = 0x58455445;  // 'ETEX'
+// Wire-format tag. v2 ('ETEY') added NodeDef::also_produces mid-record;
+// a mixed-version client/server pair fails fast on the magic check
+// instead of misreading the record.
+constexpr uint32_t kExecMagic = 0x59455445;  // 'ETEY'
 
 void PutStrList(const std::vector<std::string>& v, ByteWriter* w) {
   w->Put<uint32_t>(static_cast<uint32_t>(v.size()));
@@ -63,6 +66,7 @@ void EncodeNodeDef(const NodeDef& n, ByteWriter* w) {
   w->Put<uint32_t>(static_cast<uint32_t>(n.dnf.size()));
   for (const auto& conj : n.dnf) PutStrList(conj, w);
   w->Put<int32_t>(n.shard_idx);
+  PutStrList(n.also_produces, w);
   w->Put<uint32_t>(static_cast<uint32_t>(n.inner.size()));
   for (const auto& in : n.inner) EncodeNodeDef(in, w);
 }
@@ -79,8 +83,9 @@ Status DecodeNodeDef(ByteReader* r, NodeDef* out) {
   for (uint32_t i = 0; i < n_dnf; ++i)
     ET_RETURN_IF_ERROR(GetStrList(r, &out->dnf[i]));
   uint32_t n_inner;
-  if (!r->Get(&out->shard_idx) || !r->Get(&n_inner))
-    return Status::IOError("truncated node tail");
+  if (!r->Get(&out->shard_idx)) return Status::IOError("truncated node tail");
+  ET_RETURN_IF_ERROR(GetStrList(r, &out->also_produces));
+  if (!r->Get(&n_inner)) return Status::IOError("truncated node tail");
   out->inner.resize(n_inner);
   for (uint32_t i = 0; i < n_inner; ++i)
     ET_RETURN_IF_ERROR(DecodeNodeDef(r, &out->inner[i]));

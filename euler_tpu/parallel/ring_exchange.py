@@ -66,10 +66,12 @@ def ring_lookup(table: Array, ids: Array, mesh: Mesh,
 
         acc0 = jnp.zeros((ids_shard.shape[0], table_shard.shape[1]),
                          table_shard.dtype)
-        if hasattr(jax.lax, "pvary"):
-            # the new shard_map tracks per-axis varyingness: the carry
-            # must enter the scan already device-varying because ppermute
-            # makes it so on the way out
+        # the new shard_map tracks per-axis varyingness: the carry must
+        # enter the scan already device-varying because ppermute makes it
+        # so on the way out (pcast on jax >= 0.9, pvary before)
+        if hasattr(jax.lax, "pcast"):
+            acc0 = jax.lax.pcast(acc0, axis, to="varying")
+        elif hasattr(jax.lax, "pvary"):
             acc0 = jax.lax.pvary(acc0, axis)
         (_, acc), _ = jax.lax.scan(step, (ids_shard, acc0), None, length=k)
         # after k hops every id shard (and its answers) is home again

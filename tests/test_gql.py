@@ -83,6 +83,36 @@ def test_compile_cse_dedups_feature_reads():
     assert text.count("= API_GET_P(") == 1
 
 
+def test_compile_local_fuses_whole_plan():
+    """Local plans collapse into one FUSED node (gql.cc FuseLocalPass);
+    the original ops survive as its inner nodes."""
+    text = compile_debug(
+        "v(roots).sampleNB(0, 5, 0).as(nb_0).sampleNB(0, 3, 0).as(nb_1)")
+    lines = [l for l in text.splitlines() if l and not l.startswith(" ")]
+    assert len(lines) == 1 and "= FUSED(" in lines[0]
+    assert text.count("= API_SAMPLE_NB(") == 2
+    # distribute mode must NOT fuse (REMOTE fan-out needs the executor)
+    text = compile_debug("v(roots).sampleNB(0, 5, 0).as(nb)", shard_num=2,
+                         partition_num=2, mode="distribute")
+    assert "FUSED" not in text
+
+
+def test_fused_execution_matches_unfused(ring_graph, monkeypatch):
+    """Seeded fused and unfused plans draw identical samples: the fused
+    kernel re-runs the original NodeDefs (same names → same RNG streams)."""
+    query = ("v(roots).sampleNB(0, 4, 0).as(h0)"
+             ".sampleNB(0, 3, 0).as(h1)")
+    roots = {"roots": np.array([1, 3, 5], dtype=np.uint64)}
+    monkeypatch.delenv("EULER_TPU_NO_FUSE", raising=False)
+    assert "= FUSED(" in compile_debug(query)  # fusion actually active
+    fused = Query.local(ring_graph, seed=42).run(query, roots)
+    monkeypatch.setenv("EULER_TPU_NO_FUSE", "1")
+    plain = Query.local(ring_graph, seed=42).run(query, roots)
+    assert set(fused) == set(plain)
+    for k in plain:
+        np.testing.assert_array_equal(fused[k], plain[k])
+
+
 # ---------------------------------------------------------------------------
 # local execution
 # ---------------------------------------------------------------------------

@@ -119,6 +119,16 @@ def bench_fanout(args):
                            for i, k in enumerate(fanouts))
     run("gql_local", lambda: q.run(gql, {"r": roots}))
 
+    # same query with the FuseLocalPass disabled (per-op executor
+    # dispatch), recorded so the fused/unfused delta is a committed
+    # artifact rather than a claim
+    os.environ["EULER_TPU_NO_FUSE"] = "1"
+    try:
+        q_nf = Query.local(g, seed=1)
+        run("gql_local_nofuse", lambda: q_nf.run(gql, {"r": roots}))
+    finally:
+        del os.environ["EULER_TPU_NO_FUSE"]
+
     import tempfile
 
     d = tempfile.mkdtemp(prefix="et_bench_")
