@@ -36,9 +36,13 @@ _CITATION_SHAPES = {
     "citeseer": dict(n=3327, d=3703, num_classes=6, signal=1.12,
                      confuse_frac=0.21, informative_dims=48,
                      intra_degree=3.0, inter_degree=1.4),
+    # pubmed: homophily ≈ 0.80 (the real graph's level — Zhu et al. 2020
+    # measure 0.80); difficulty carried by confuse_frac, picked so the
+    # model spread straddles the published table (GCN 0.89 vs ref 0.871,
+    # sampled-fanout models ≈0.84 vs ref 0.884) with minimum total error
     "pubmed": dict(n=19717, d=500, num_classes=3, signal=1.1,
-                   confuse_frac=0.2, informative_dims=32,
-                   intra_degree=3.0, inter_degree=1.5),
+                   confuse_frac=0.25, informative_dims=32,
+                   intra_degree=3.6, inter_degree=0.9),
     "ppi": dict(n=14755, d=50, num_classes=121, signal=1.0,
                 confuse_frac=0.2, informative_dims=24),
     "reddit": dict(n=232965, d=602, num_classes=41, signal=1.2,

@@ -240,12 +240,13 @@ class BaseEstimator:
         it = input_fn() if callable(input_fn) else input_fn
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
-        losses, metrics = [], []
+        losses, metrics, weights = [], [], []
         for _ in range(steps):
             try:
-                batch = _to_device_tree(next(it), self.max_id)
+                raw = next(it)
             except StopIteration:
                 break
+            batch = _to_device_tree(raw, self.max_id)
             if self.state is None:
                 self._init_state(_merged(batch, self.static_batch))
                 self.restore_checkpoint()
@@ -254,8 +255,17 @@ class BaseEstimator:
                 self.state, _merged(batch, self.static_batch))
             losses.append(float(loss))
             metrics.append(float(metric))
-        return {"loss": float(np.mean(losses)) if losses else float("nan"),
-                "metric": float(np.mean(metrics)) if metrics else float("nan")}
+            # masked batches (graph packing) report per-batch means over
+            # n_real entries; weight them so a short final sweep batch
+            # doesn't count like a full one
+            mask = raw.get("graph_mask") if isinstance(raw, dict) else None
+            weights.append(float(np.sum(mask)) if mask is not None else 1.0)
+        if not losses:
+            return {"loss": float("nan"), "metric": float("nan")}
+        w = np.asarray(weights)
+        w = w / w.sum()
+        return {"loss": float(np.dot(losses, w)),
+                "metric": float(np.dot(metrics, w))}
 
     def infer(self, input_fn, steps: int = 100,
               id_key: str = "infer_ids") -> Dict[str, str]:

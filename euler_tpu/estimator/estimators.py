@@ -155,7 +155,10 @@ class GraphEstimator(BaseEstimator):
             g["edge_index"].shape[1] for g in graphs) * self.num_graphs
         self.rng = np.random.default_rng(int(params.get("seed", 0)))
 
-    def _pack(self, idxs) -> Dict:
+    def _pack(self, idxs, n_real: Optional[int] = None) -> Dict:
+        """Pack `num_graphs` graphs into one static-shape batch; entries
+        past n_real are shape padding, masked out of loss and metric."""
+        n_real = len(idxs) if n_real is None else n_real
         xs, eis, gi, labels = [], [], [], []
         offset = 0
         for slot, gidx in enumerate(idxs):
@@ -169,7 +172,8 @@ class GraphEstimator(BaseEstimator):
         x = np.concatenate(xs).astype(np.float32)
         ei = np.concatenate(eis, axis=1).astype(np.int32)
         gi = np.concatenate(gi)
-        mask = np.ones(len(idxs), np.float32)
+        mask = np.zeros(len(idxs), np.float32)
+        mask[:n_real] = 1.0
         # pad to static shapes: dummy nodes attach to an extra sink row
         n_pad = self.max_nodes - x.shape[0]
         e_pad = self.max_edges - ei.shape[1]
@@ -195,10 +199,27 @@ class GraphEstimator(BaseEstimator):
         return self._batches(pool)
 
     def eval_input_fn(self):
+        """Deterministic sweep: every eval graph exactly once per pass
+        (random-with-replacement batches made the eval metric noisy
+        enough to defeat best-checkpoint selection on small pools).
+        Callers must pass evaluate() steps >= ceil(pool / num_graphs) or
+        the tail of the pool is never seen — run_graph_model sizes
+        eval_steps from the pool for exactly this reason."""
         split = self.params_cfg.get("eval_indices")
         pool = np.asarray(split) if split is not None else np.arange(
             len(self.graphs))
-        return self._batches(pool)
+
+        def gen():
+            for i in range(0, len(pool), self.num_graphs):
+                chunk = pool[i:i + self.num_graphs]
+                n_real = len(chunk)
+                if n_real < self.num_graphs:
+                    chunk = np.concatenate(
+                        [chunk,
+                         np.repeat(chunk[-1], self.num_graphs - n_real)])
+                yield self._pack(chunk, n_real)
+
+        return gen()
 
 
 class GaeEstimator(BaseEstimator):

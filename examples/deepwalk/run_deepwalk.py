@@ -28,7 +28,8 @@ def main(argv=None):
     ap.add_argument("--num_negs", type=int, default=5)
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--learning_rate", type=float, default=0.025)
-    ap.add_argument("--max_steps", type=int, default=500)
+    ap.add_argument("--max_steps", type=int, default=0,
+                help="0 = auto: ~10 root walks per node")
     ap.add_argument("--eval_steps", type=int, default=20)
     ap.add_argument("--model_dir", default="")
     add_platform_flag(ap)
@@ -42,6 +43,10 @@ def main(argv=None):
 
     data = get_dataset(args.dataset)
     g = data.engine
+    if not args.max_steps:
+        args.max_steps = max(500,
+                             int(10 * data.engine.node_count
+                                 / args.batch_size))
     print(f"dataset {args.dataset}: {g.node_count} nodes [{data.source}]")
 
     model = DeepWalk(max_id=data.max_id, dim=args.dim)

@@ -12,8 +12,8 @@ from common import citation_argparser, run_citation  # noqa: E402
 
 
 def main(argv=None):
-    args = citation_argparser(hidden_dim=8, dropout=0.6, weight_decay=0.005,
-                              max_steps=300).parse_args(argv)
+    args = citation_argparser(hidden_dim=16, dropout=0.6, weight_decay=0.005,
+                              learning_rate=0.005, max_steps=500).parse_args(argv)
     return run_citation("gat", args, conv_kwargs={'heads': 8})
 
 

@@ -56,7 +56,15 @@ def run_graph_model(conv_name: str, pool_name: str, args):
              weight_decay=getattr(args, "weight_decay", 0.0),
              train_indices=data.train_indices, eval_indices=data.eval_indices),
         data.graphs, data.labels, model_dir=args.model_dir or None)
+    # best-epoch eval accuracy — the GIN-paper protocol the reference's
+    # mutag table follows (their 10-fold CV reports the best epoch).
+    # eval_steps must cover the whole deterministic sweep (see
+    # GraphEstimator.eval_input_fn)
+    pool = len(data.eval_indices)
+    eval_steps = max(args.eval_steps, -(-pool // args.num_graphs))
     res = est.train_and_evaluate(est.train_input_fn, est.eval_input_fn,
-                                 args.max_steps, args.eval_steps)
+                                 args.max_steps, eval_steps,
+                                 eval_every=max(args.max_steps // 10, 10),
+                                 keep_best=True)
     print(res)
     return res

@@ -14,7 +14,8 @@ from graph_common import graph_argparser, run_graph_model  # noqa: E402
 def main(argv=None):
     args = graph_argparser(num_layers=3, hidden_dim=64,
                            max_steps=800).parse_args(argv)
-    return run_graph_model("gcn", "mean", args)
+    # the reference pools with 'add' (graphgcn.py:57), not mean
+    return run_graph_model("gcn", "sum", args)
 
 
 if __name__ == "__main__":

@@ -26,8 +26,10 @@ def main(argv=None):
     ap.add_argument("--aggregator", default="mean")
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--num_negs", type=int, default=5)
-    ap.add_argument("--learning_rate", type=float, default=0.01)
-    ap.add_argument("--max_steps", type=int, default=300)
+    ap.add_argument("--learning_rate", type=float, default=0.003)
+    ap.add_argument("--dropout", type=float, default=0.6)
+    ap.add_argument("--weight_decay", type=float, default=0.0)
+    ap.add_argument("--max_steps", type=int, default=600)
     ap.add_argument("--eval_steps", type=int, default=20)
     ap.add_argument("--model_dir", default="")
     add_platform_flag(ap)
@@ -49,11 +51,12 @@ def main(argv=None):
         model = SupervisedGraphSage(
             num_classes=data.num_classes, multilabel=data.multilabel,
             dim=args.hidden_dim, fanouts=fanouts,
-            aggregator=args.aggregator)
+            aggregator=args.aggregator, dropout=args.dropout)
         est = NodeEstimator(
             model,
             dict(batch_size=args.batch_size,
                  learning_rate=args.learning_rate,
+                 weight_decay=args.weight_decay,
                  label_dim=data.num_classes),
             data.engine, flow, label_fid="label",
             label_dim=data.num_classes, model_dir=args.model_dir or None)

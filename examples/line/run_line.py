@@ -21,7 +21,8 @@ def main(argv=None):
     ap.add_argument("--num_negs", type=int, default=5)
     ap.add_argument("--batch_size", type=int, default=128)
     ap.add_argument("--learning_rate", type=float, default=0.025)
-    ap.add_argument("--max_steps", type=int, default=500)
+    ap.add_argument("--max_steps", type=int, default=0,
+                help="0 = auto: ~8 epochs over the edge set")
     ap.add_argument("--eval_steps", type=int, default=20)
     ap.add_argument("--model_dir", default="")
     add_platform_flag(ap)
@@ -34,6 +35,9 @@ def main(argv=None):
 
     data = get_dataset(args.dataset)
     g = data.engine
+    if not args.max_steps:
+        args.max_steps = max(500,
+                             int(8 * g.edge_count / args.batch_size))
     model = LINE(max_id=data.max_id, dim=args.dim, order=args.order)
     est = BaseEstimator(model,
                         dict(learning_rate=args.learning_rate,
