@@ -94,11 +94,12 @@ def run_bench(args):
                                label_dim=num_classes,
                                dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
     flow = FanoutDataFlow(graph, fanouts, with_features=False)
+    spl = args.steps_per_loop or (1 if (args.smoke or cpu_fallback) else 8)
     est = NodeEstimator(
         model,
         dict(batch_size=batch, learning_rate=0.01, optimizer="adam",
              label_dim=num_classes, log_steps=1 << 30, checkpoint_steps=0,
-             train_node_type=-1),
+             train_node_type=-1, steps_per_loop=spl),
         graph, flow, label_fid="label", label_dim=num_classes,
         feature_store=store)
 
@@ -114,9 +115,12 @@ def run_bench(args):
     # warmup (compile) then timed steps. The headline value is the
     # AGGREGATE rate over all measured steps; per-window rates (and the
     # peak) ride in detail because the shared-tunnel TPU host shows
-    # ±30% drift between runs.
+    # ±30% drift between runs. With steps_per_loop > 1 the warmup must
+    # compile BOTH dispatch paths: one full scanned window + a tail.
+    if spl > 1:
+        warmup = spl + 2
     est.train(iter([next(it) for _ in range(warmup)]), max_steps=warmup)
-    per_window = max(steps // 3, 1)
+    per_window = max(steps // 3, spl, 1)
     window_rates = []
     done_before = warmup
     total_dt = 0.0
@@ -168,6 +172,9 @@ def main(argv=None):
     ap.add_argument("--steps", type=int, default=0)
     ap.add_argument("--feat_dim", type=int, default=0)
     ap.add_argument("--bf16", action="store_true", default=False)
+    ap.add_argument("--steps_per_loop", type=int, default=0,
+                    help="0 = auto (8 on TPU, 1 in smoke/CPU mode): "
+                         "lax.scan window per device dispatch")
     ap.add_argument("--fp32", action="store_true", default=False,
                     help="keep float32 features in the full bench")
     ap.add_argument("--platform", default="",

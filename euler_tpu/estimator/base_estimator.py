@@ -76,11 +76,17 @@ class BaseEstimator:
             weight_decay=float(self.params_cfg.get("weight_decay", 0.0)),
         )
         self.max_id = int(self.params_cfg.get("max_id", 0))
+        # >1 → lax.scan over that many host batches per device dispatch
+        # (the TPUEstimator iterations_per_loop idea): amortizes dispatch
+        # and host↔device round-trip latency, which dominates when the
+        # chip sits behind a network tunnel
+        self.steps_per_loop = int(self.params_cfg.get("steps_per_loop", 1))
         self.log_steps = int(self.params_cfg.get("log_steps", 20))
         self.ckpt_steps = int(self.params_cfg.get("checkpoint_steps", 1000))
         self.profiling = bool(self.params_cfg.get("profiling", False))
         self.state: Optional[TrainState] = None
         self._train_step = None
+        self._train_loop = None
         self._eval_step = None
         self._ckpt_mgr = None
         # device-resident arrays merged into every batch (e.g. a
@@ -99,12 +105,14 @@ class BaseEstimator:
             extra_vars=dict(variables),
         )
 
-    def _build_train_step(self):
+    def _make_one_step(self):
+        """The single SGD step shared by the per-step jit and the scanned
+        loop — one definition so the two dispatch paths cannot drift."""
         mutable_keys = [k for k in (self.state.extra_vars or {})]
         dropout_key = jax.random.key(
             int(self.params_cfg.get("seed", 0)) + 1)
 
-        def train_step(state: TrainState, batch):
+        def one_step(state: TrainState, batch):
             # per-step dropout rng; eval applies without rngs → dropout
             # layers run deterministic there
             rngs = {"dropout": jax.random.fold_in(dropout_key, state.step)}
@@ -126,6 +134,11 @@ class BaseEstimator:
                 state = state.replace(extra_vars=dict(new_vars))
             return state, loss, out.metric
 
+        return one_step
+
+    def _build_train_step(self):
+        train_step = self._make_one_step()
+
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -139,6 +152,22 @@ class BaseEstimator:
         else:
             train_step = jax.jit(train_step, donate_argnums=(0,))
         return train_step
+
+    def _build_train_loop(self):
+        """K steps per dispatch: scan the single-step body over a batch
+        pytree stacked on axis 0. static_batch rides as an explicit arg
+        so the feature table isn't baked into the jaxpr as a constant."""
+        one_step = self._make_one_step()
+
+        def train_loop(state: TrainState, batches, static_batch):
+            def body(s, b):
+                s, loss, metric = one_step(s, _merged(b, static_batch))
+                return s, (loss, metric)
+
+            state, (losses, metrics) = jax.lax.scan(body, state, batches)
+            return state, losses, metrics
+
+        return jax.jit(train_loop, donate_argnums=(0,))
 
     def _build_eval_step(self):
         def eval_step(state: TrainState, batch):
@@ -189,8 +218,8 @@ class BaseEstimator:
     def train(self, input_fn: Callable[[], Iterator[Dict]],
               max_steps: int = 1000) -> Dict[str, float]:
         it = input_fn() if callable(input_fn) else input_fn
-        first = _merged(_to_device_tree(next(it), self.max_id),
-                        self.static_batch)
+        raw_first = _to_device_tree(next(it), self.max_id)
+        first = _merged(raw_first, self.static_batch)
         if self.state is None:
             self._init_state(first)
             self.restore_checkpoint()
@@ -198,6 +227,10 @@ class BaseEstimator:
             self._train_step = self._build_train_step()
         if self.profiling and self.model_dir:
             jax.profiler.start_trace(os.path.join(self.model_dir, "prof"))
+        if self.steps_per_loop > 1:
+            # pass the UNMERGED batch: the looped path stacks raw batches
+            # and merges static_batch inside the scanned body
+            return self._run_looped(it, raw_first, max_steps)
         step = int(self.state.step)
         start_step = step
         losses, metrics = [], []
@@ -232,6 +265,88 @@ class BaseEstimator:
         return {
             "loss": float(losses[-1]) if losses else float("nan"),
             "metric": float(jnp.mean(jnp.stack(metrics))) if metrics else 0.0,
+            "steps_per_sec": (step - start_step) / max(time.time() - t0, 1e-9),
+            "global_step": step,
+        }
+
+    def _run_looped(self, it, first: Dict, max_steps: int) -> Dict[str, float]:
+        """steps_per_loop > 1 train path: full K-step windows dispatch as
+        one scanned device call; a tail shorter than K falls back to the
+        single-step function (no partial-scan recompile)."""
+        K = self.steps_per_loop
+        step = int(self.state.step)
+        start_step = step
+        loop_losses, loop_metrics = [], []
+        last_loss = float("nan")
+        t0 = time.time()
+        last_log = t0
+        logged_at = step
+        buf = [first]
+        exhausted = False
+
+        def stack(*xs):
+            if isinstance(xs[0], np.ndarray):
+                return np.stack(xs)
+            return jnp.stack(xs)
+
+        while step < max_steps:
+            want = min(K, max_steps - step)
+            while len(buf) < want and not exhausted:
+                try:
+                    buf.append(_to_device_tree(next(it), self.max_id))
+                except StopIteration:
+                    exhausted = True
+            if not buf:
+                break
+            if len(buf) == K:
+                if self._train_loop is None:
+                    self._train_loop = self._build_train_loop()
+                stacked = jax.tree_util.tree_map(stack, *buf)
+                self.state, l_arr, m_arr = self._train_loop(
+                    self.state, stacked, self.static_batch)
+                loop_losses.append((jnp.mean(l_arr), K))
+                loop_metrics.append((jnp.mean(m_arr), K))
+                last_loss = l_arr[-1]
+                done = K
+            else:
+                # tail shorter than K: single-step dispatches (the jit
+                # was built in train() before this path was entered)
+                for b in buf:
+                    self.state, last_loss, m = self._train_step(
+                        self.state, _merged(b, self.static_batch))
+                    loop_losses.append((last_loss, 1))
+                    loop_metrics.append((m, 1))
+                done = len(buf)
+            prev = step
+            step += done
+            buf = []
+            if step - logged_at >= self.log_steps:
+                now = time.time()
+                rate = (step - logged_at) / max(now - last_log, 1e-9)
+                print(f"step {step}: loss={float(loop_losses[-1][0]):.4f} "
+                      f"metric={float(loop_metrics[-1][0]):.4f} "
+                      f"({rate:.1f} steps/s)", flush=True)
+                last_log, logged_at = now, step
+            if self.ckpt_steps and \
+                    step // self.ckpt_steps > prev // self.ckpt_steps:
+                self.save_checkpoint(step)
+            if exhausted:
+                break
+        if self.ckpt_steps:
+            self.save_checkpoint(step)
+        if self.profiling and self.model_dir:
+            jax.profiler.stop_trace()
+        # step-weighted mean so the reported train metric matches what
+        # the same run would report with steps_per_loop=1
+        if loop_metrics:
+            w = np.asarray([c for _, c in loop_metrics], np.float64)
+            vals = np.asarray([float(v) for v, _ in loop_metrics])
+            metric = float(np.dot(vals, w / w.sum()))
+        else:
+            metric = 0.0
+        return {
+            "loss": float(last_loss),
+            "metric": metric,
             "steps_per_sec": (step - start_step) / max(time.time() - t0, 1e-9),
             "global_step": step,
         }
