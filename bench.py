@@ -76,20 +76,28 @@ def run_bench(args):
     from euler_tpu.estimator import NodeEstimator
     from euler_tpu.estimator.base_estimator import _to_device_tree
     from euler_tpu.estimator.prefetch import Prefetcher
-    from euler_tpu.models import SupervisedGraphSage
-    from euler_tpu.parallel import DeviceFeatureStore
+    from euler_tpu.models import DeviceSampledGraphSage, SupervisedGraphSage
+    from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
 
     num_classes = 16
     data = build_products_like(n_nodes, 10, feat_dim, num_classes)
     graph = data.engine
 
-    model = SupervisedGraphSage(
-        num_classes=num_classes, multilabel=False, dim=128,
-        fanouts=tuple(fanouts))
-    # TPU-first input path: features live in HBM (DeviceFeatureStore);
-    # the host ships only int32 rows per step (~100× fewer bytes than
-    # shipping the gathered feature arrays)
+    # TPU-first input path: features live in HBM (DeviceFeatureStore) and
+    # — unless --host_sampler — the fanout is sampled ON DEVICE
+    # (DeviceNeighborTable): the host ships only root rows per step, so
+    # the feeder leaves the critical path (measured: the jitted step
+    # sustains 11-24 steps/s while a 2-core host samples ~3 batches/s)
     import jax.numpy as jnp
+    sampler = None if args.host_sampler else DeviceNeighborTable(graph, cap=32)
+    if sampler is None:
+        model = SupervisedGraphSage(
+            num_classes=num_classes, multilabel=False, dim=128,
+            fanouts=tuple(fanouts))
+    else:
+        model = DeviceSampledGraphSage(
+            num_classes=num_classes, multilabel=False, dim=128,
+            fanouts=tuple(fanouts))
     store = DeviceFeatureStore(graph, ["feature"], label_fid="label",
                                label_dim=num_classes,
                                dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
@@ -101,7 +109,7 @@ def run_bench(args):
              label_dim=num_classes, log_steps=1 << 30, checkpoint_steps=0,
              train_node_type=-1, steps_per_loop=spl),
         graph, flow, label_fid="label", label_dim=num_classes,
-        feature_store=store)
+        feature_store=store, device_sampler=sampler)
 
     def to_dev(b):
         # the estimator already trims store-mode batches to rows (+
@@ -158,6 +166,7 @@ def run_bench(args):
             "window_steps_per_sec": [round(r, 2) for r in window_rates],
             "peak_edges_per_sec": round(edges_per_step * max(window_rates)),
             "final_loss": res["loss"],
+            "sampler": "host" if sampler is None else "device",
             "cpu_fallback": cpu_fallback,
         },
     }
@@ -172,6 +181,9 @@ def main(argv=None):
     ap.add_argument("--steps", type=int, default=0)
     ap.add_argument("--feat_dim", type=int, default=0)
     ap.add_argument("--bf16", action="store_true", default=False)
+    ap.add_argument("--host_sampler", action="store_true", default=False,
+                    help="sample fanouts on the host engine (the "
+                         "reference topology) instead of on device")
     ap.add_argument("--steps_per_loop", type=int, default=0,
                     help="0 = auto (8 on TPU, 1 in smoke/CPU mode): "
                          "lax.scan window per device dispatch")

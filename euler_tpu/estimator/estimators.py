@@ -22,12 +22,16 @@ class NodeEstimator(BaseEstimator):
     def __init__(self, model, params: Dict, graph: GraphEngine, dataflow,
                  label_fid="label", label_dim: Optional[int] = None,
                  model_dir=None, mesh=None, feature_store=None,
-                 eval_dataflow=None):
+                 eval_dataflow=None, device_sampler=None):
         """feature_store: optional DeviceFeatureStore — batches then carry
         int32 'rows' into the device-resident table instead of shipping
         feature arrays, and the table rides self.static_batch.
         eval_dataflow: optional flow for evaluate/infer (e.g. FastGCN
-        trains on sampled pools but evaluates full-adjacency)."""
+        trains on sampled pools but evaluates full-adjacency).
+        device_sampler: optional DeviceNeighborTable (requires
+        feature_store) — neighbor sampling moves into the jitted step;
+        batches carry only root rows + a sample seed, and the model must
+        read nbr_table/cum_table (e.g. DeviceSampledGraphSage)."""
         super().__init__(model, params, model_dir, mesh)
         self.graph = graph
         self.dataflow = dataflow
@@ -39,16 +43,27 @@ class NodeEstimator(BaseEstimator):
         self.eval_node_type = int(params.get("eval_node_type", 1))
         self.infer_node_type = int(params.get("infer_node_type", -1))
         self.feature_store = feature_store
+        self.device_sampler = device_sampler
+        if device_sampler is not None and feature_store is None:
+            raise ValueError("device_sampler requires a feature_store")
+        self._seed_counter = 0
         if feature_store is not None:
             self.static_batch["feature_table"] = feature_store.features
             if feature_store.labels is not None:
                 self.static_batch["label_table"] = feature_store.labels
+        if device_sampler is not None:
+            self.static_batch.update(device_sampler.tables)
 
     def _batches(self, node_type: int, flow=None) -> Iterator[Dict]:
         store = self.feature_store
         flow = flow or self.dataflow
         while True:
             roots = self.graph.sample_node(self.batch_size, node_type)
+            if self.device_sampler is not None:
+                # on-device sampling: the host's whole contribution is
+                # root rows + a seed (the model draws the fanout in-jit)
+                yield self._sampler_batch(roots)
+                continue
             batch = flow(roots)
             if store is not None:
                 # rows replace ids/weights/types AND (with a label table)
@@ -66,6 +81,20 @@ class NodeEstimator(BaseEstimator):
                     self.label_dim if self.label_dim else None)
                 batch["infer_ids"] = roots
             yield batch
+
+    def _sampler_batch(self, roots) -> Dict:
+        """Device-sampler batch: root rows + a per-batch seed; labels via
+        the device table when present, host fetch otherwise (mirrors the
+        store path's fallback)."""
+        self._seed_counter += 1
+        batch = {"rows": [self.feature_store.lookup(roots)],
+                 "sample_seed": np.uint32(self._seed_counter),
+                 "infer_ids": roots}
+        if self.feature_store.labels is None:
+            batch["labels"] = self.graph.get_dense_feature(
+                roots, self.label_fid,
+                self.label_dim if self.label_dim else None)
+        return batch
 
     def train_input_fn(self):
         return self._batches(self.train_node_type)
@@ -88,6 +117,9 @@ class NodeEstimator(BaseEstimator):
                         chunk,
                         np.full(self.batch_size - len(chunk), chunk[-1],
                                 np.uint64)])
+                if self.device_sampler is not None:
+                    yield self._sampler_batch(chunk)
+                    continue
                 batch = self.eval_dataflow(chunk)
                 if store is not None:
                     batch = {"rows": [store.lookup(j) for j in batch["ids"]],

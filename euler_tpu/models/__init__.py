@@ -2,6 +2,7 @@ from euler_tpu.models.dgi import DGI  # noqa: F401
 from euler_tpu.models.embedding_models import LINE, DeepWalk, Node2Vec  # noqa: F401
 from euler_tpu.models.graphsage import (  # noqa: F401
     ScalableGraphSage,
+    DeviceSampledGraphSage,
     ShardedSupervisedGraphSage,
     SupervisedGraphSage,
     UnsupervisedGraphSage,

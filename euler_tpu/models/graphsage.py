@@ -56,6 +56,30 @@ class UnsupervisedGraphSage(UnsuperviseModel):
                            concat=False, name="encoder")(_fanout_layers(batch))
 
 
+class DeviceSampledGraphSage(SuperviseModel):
+    """GraphSAGE whose fanout is sampled ON DEVICE (DeviceNeighborTable):
+    the batch carries only root rows + a sample seed; neighbor sampling,
+    feature gather, and label lookup all read HBM-resident tables inside
+    the jitted step. The TPU-first configuration bench.py measures —
+    the host feeder drops out of the critical path entirely."""
+
+    dim: int = 32
+    fanouts: Sequence[int] = (10, 10)
+    aggregator: str = "mean"
+
+    def embed(self, batch: Dict[str, Any]) -> Array:
+        from euler_tpu.parallel.device_sampler import sample_fanout_rows
+
+        roots = batch["rows"][0]
+        key = jax.random.fold_in(jax.random.key(17), batch["sample_seed"])
+        rows = sample_fanout_rows(batch["nbr_table"], batch["cum_table"],
+                                  roots, tuple(self.fanouts), key)
+        table = batch["feature_table"]
+        layers = [jax.numpy.take(table, r, axis=0) for r in rows]
+        return SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
+                           name="encoder")(layers)
+
+
 class ShardedSupervisedGraphSage(SuperviseModel):
     """GraphSAGE with an id-embedding input sharded across the mesh's
     'model' axis — the multi-chip flagship: feature = concat(sharded id

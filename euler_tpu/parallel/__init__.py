@@ -10,6 +10,11 @@ from euler_tpu.parallel.sharded_embedding import (  # noqa: F401
     apply_param_shardings,
     param_shardings,
 )
+from euler_tpu.parallel.device_sampler import (  # noqa: F401
+    DeviceNeighborTable,
+    sample_fanout_rows,
+    sample_hop,
+)
 from euler_tpu.parallel.feature_store import DeviceFeatureStore  # noqa: F401
 from euler_tpu.parallel.ring_exchange import ring_lookup  # noqa: F401
 from euler_tpu.parallel.train import make_spmd_train_step, spmd_init  # noqa: F401

@@ -1,0 +1,145 @@
+"""Device-resident neighbor sampling: the TPU-first answer to the host
+sampling bottleneck.
+
+The reference's whole input design exists to amortize CPU-side neighbor
+sampling (one-RPC chained fanout, tf_euler/kernels/sample_fanout_op.cc:
+36-48). On TPU that leaves the chip idle: measured on v5e-1, the jitted
+GraphSAGE train step sustains 11-24 steps/s while a 2-core host produces
+at most ~3 fanout batches/s — the accelerator waits on the feeder 4-10×
+over. When the graph fits in HBM the right design is to move sampling
+itself onto the device:
+
+  - neighbor rows [N, C] (int32, capped at C per node) and inclusive
+    cumulative weights [N, C] (float32) live in HBM — the
+    CompactWeightedCollection layout (reference
+    euler/common/compact_weighted_collection.h:55) transposed into two
+    dense tables an XLA gather can hit;
+  - per hop, sampling is: uniform draw → per-row inverse-CDF over C
+    cumulative weights (C compares on the VPU) → gather neighbor rows.
+    Pure XLA inside the jitted train step; composes with lax.scan
+    (steps_per_loop) and pjit;
+  - the host ships ONLY root rows (~131KB for batch 32768) — everything
+    else (sampling, feature gather, labels) reads HBM-resident tables.
+
+Memory: 8 bytes × N × C (e.g. 200k nodes × C=32 → 51MB) next to the
+DeviceFeatureStore feature table.
+
+Fidelity: nodes with degree ≤ C sample exactly the host engine's
+weighted-with-replacement distribution. Nodes with degree > C sample
+from a C-subset drawn once at build time (weighted, without
+replacement) — the standard neighbor-cap approximation (GraphSAGE §3.1
+uses fixed-size uniform subsets the same way). Pass cap >= max degree
+for exact parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DeviceNeighborTable:
+    """Builds the HBM neighbor/cum-weight tables from a graph engine.
+
+    Row order matches `graph.all_node_ids()` (the DeviceFeatureStore
+    convention) so the same int32 rows index features, labels, and
+    adjacency. Row N (= pad_row) is an all-pad row: sampling from it
+    yields pad_row again, mirroring the host sampler's default_id pads.
+    """
+
+    def __init__(self, graph, cap: int = 32, edge_types=None,
+                 seed: int = 0,
+                 mesh: Optional[jax.sharding.Mesh] = None):
+        ids = graph.all_node_ids()
+        n = len(ids)
+        self.cap = int(cap)
+        self.pad_row = n
+        offs, nbrs, ws, _ = graph.get_full_neighbor(ids, edge_types)
+        offs = offs.astype(np.int64)
+        deg = np.diff(offs)
+        nbr_rows = graph.node_rows(nbrs, missing=n).astype(np.int32)
+        ws = ws.astype(np.float32)
+
+        C = self.cap
+        nbr_tab = np.full((n + 1, C), n, dtype=np.int32)
+        w_tab = np.zeros((n + 1, C), dtype=np.float32)
+
+        # common case: degree <= C — one vectorized ragged scatter
+        small = deg <= C
+        if small.any():
+            edge_node = np.repeat(np.arange(n), deg)
+            edge_col = np.arange(len(nbr_rows)) - np.repeat(offs[:-1], deg)
+            keep = small[edge_node]
+            nbr_tab[edge_node[keep], edge_col[keep]] = nbr_rows[keep]
+            w_tab[edge_node[keep], edge_col[keep]] = ws[keep]
+        # hubs: weighted C-subset without replacement, drawn once
+        rng = np.random.default_rng(seed)
+        for i in np.where(~small)[0]:
+            lo, hi = offs[i], offs[i + 1]
+            w = ws[lo:hi]
+            tot = w.sum()
+            nnz = int((w > 0).sum())
+            if tot <= 0:
+                pick = rng.choice(hi - lo, size=C, replace=False)
+            elif nnz >= C:
+                pick = rng.choice(hi - lo, size=C, replace=False, p=w / tot)
+            else:
+                # fewer positive-weight edges than slots: keep them all,
+                # pad with zero-weight edges (never drawn by the CDF)
+                pos = np.where(w > 0)[0]
+                zero = np.where(w <= 0)[0]
+                pick = np.concatenate(
+                    [pos, rng.choice(zero, C - nnz, replace=False)])
+            nbr_tab[i, :] = nbr_rows[lo + pick]
+            w_tab[i, :] = ws[lo + pick]
+
+        cum = np.cumsum(w_tab, axis=1, dtype=np.float32)
+        from euler_tpu.parallel.placement import put_replicated
+
+        self.neighbors = put_replicated(nbr_tab, mesh)
+        self.cum_weights = put_replicated(cum, mesh)
+
+    @property
+    def tables(self):
+        """Arrays to merge into the estimator's static_batch."""
+        return {"nbr_table": self.neighbors, "cum_table": self.cum_weights}
+
+
+def sample_hop(nbr_table: jax.Array, cum_table: jax.Array,
+               rows: jax.Array, count: int, key) -> jax.Array:
+    """One weighted neighbor draw per (row, slot): [n] → [n * count].
+
+    Inverse-CDF over each row's C inclusive cumulative weights — the
+    device transpose of CompactWeightedCollection's binary search (C is
+    small and fixed, so C vectorized compares beat a gather-heavy
+    log-search). Zero-degree rows (total weight 0) resolve to the pad
+    slot, whose neighbor entry is pad_row.
+    """
+    C = nbr_table.shape[1]
+    n = rows.shape[0]
+    cum = jnp.take(cum_table, rows, axis=0)            # [n, C]
+    total = cum[:, -1]
+    u = jax.random.uniform(key, (n, count)) * total[:, None]   # [n, k]
+    col = (cum[:, None, :] <= u[:, :, None]).sum(-1)   # [n, k]
+    col = jnp.clip(col, 0, C - 1).astype(jnp.int32)
+    flat = rows[:, None] * C + col                     # [n, k]
+    out = jnp.take(nbr_table.reshape(-1), flat.reshape(-1))
+    return out
+
+
+def sample_fanout_rows(nbr_table: jax.Array, cum_table: jax.Array,
+                       roots: jax.Array, fanouts: Sequence[int], key):
+    """Multi-hop on-device fanout: returns [roots, hop1, hop2, ...] row
+    arrays (layer h has roots.shape[0] * prod(fanouts[:h]) entries) —
+    the shape contract of FanoutDataFlow, produced without touching the
+    host."""
+    layers = [roots]
+    cur = roots
+    for k in fanouts:
+        key, sub = jax.random.split(key)
+        cur = sample_hop(nbr_table, cum_table, cur, int(k), sub)
+        layers.append(cur)
+    return layers

@@ -55,23 +55,16 @@ class DeviceFeatureStore:
         feats = np.concatenate(
             [feats, np.zeros((1, feats.shape[1]), feats.dtype)])
         feats = feats.astype(np.dtype(dtype), copy=False)
-        self._sharding = None
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
+        from euler_tpu.parallel.placement import put_replicated
 
-            self._sharding = NamedSharding(mesh, PartitionSpec())
-        self.features = self._put(feats)
+        self.features = put_replicated(feats, mesh)
         self.labels = None
         if label_fid is not None:
             labels = graph.get_dense_feature(ids, label_fid, label_dim)
             labels = np.concatenate(
                 [labels, np.zeros((1, labels.shape[1]), labels.dtype)])
-            self.labels = self._put(labels.astype(np.float32, copy=False))
-
-    def _put(self, x: np.ndarray) -> jax.Array:
-        if self._sharding is not None:
-            return jax.device_put(x, self._sharding)
-        return jax.device_put(x)
+            self.labels = put_replicated(
+                labels.astype(np.float32, copy=False), mesh)
 
     @property
     def dim(self) -> int:

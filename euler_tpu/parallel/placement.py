@@ -1,0 +1,20 @@
+"""Shared device placement for HBM-resident input tables
+(DeviceFeatureStore, DeviceNeighborTable): replicated across the mesh so
+per-step gathers stay local — no collective per step. One helper so the
+two table classes cannot diverge in placement policy."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+def put_replicated(x: np.ndarray,
+                   mesh: Optional[jax.sharding.Mesh] = None) -> jax.Array:
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
+    return jax.device_put(x)
