@@ -89,7 +89,8 @@ def run_bench(args):
     # the feeder leaves the critical path (measured: the jitted step
     # sustains 11-24 steps/s while a 2-core host samples ~3 batches/s)
     import jax.numpy as jnp
-    sampler = None if args.host_sampler else DeviceNeighborTable(graph, cap=32)
+    sampler = None if args.host_sampler else DeviceNeighborTable(
+        graph, cap=args.cap)
     if sampler is None:
         model = SupervisedGraphSage(
             num_classes=num_classes, multilabel=False, dim=128,
@@ -102,7 +103,7 @@ def run_bench(args):
                                label_dim=num_classes,
                                dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
     flow = FanoutDataFlow(graph, fanouts, with_features=False)
-    spl = args.steps_per_loop or (1 if (args.smoke or cpu_fallback) else 8)
+    spl = args.steps_per_loop or (1 if (args.smoke or cpu_fallback) else 16)
     est = NodeEstimator(
         model,
         dict(batch_size=batch, learning_rate=0.01, optimizer="adam",
@@ -167,6 +168,8 @@ def run_bench(args):
             "peak_edges_per_sec": round(edges_per_step * max(window_rates)),
             "final_loss": res["loss"],
             "sampler": "host" if sampler is None else "device",
+            "sampler_cap": None if sampler is None else sampler.cap,
+            "steps_per_loop": spl,
             "cpu_fallback": cpu_fallback,
         },
     }
@@ -181,11 +184,13 @@ def main(argv=None):
     ap.add_argument("--steps", type=int, default=0)
     ap.add_argument("--feat_dim", type=int, default=0)
     ap.add_argument("--bf16", action="store_true", default=False)
+    ap.add_argument("--cap", type=int, default=32,
+                    help="device-sampler neighbor cap C (HBM table width)")
     ap.add_argument("--host_sampler", action="store_true", default=False,
                     help="sample fanouts on the host engine (the "
                          "reference topology) instead of on device")
     ap.add_argument("--steps_per_loop", type=int, default=0,
-                    help="0 = auto (8 on TPU, 1 in smoke/CPU mode): "
+                    help="0 = auto (16 on TPU, 1 in smoke/CPU mode): "
                          "lax.scan window per device dispatch")
     ap.add_argument("--fp32", action="store_true", default=False,
                     help="keep float32 features in the full bench")

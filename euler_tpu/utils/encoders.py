@@ -273,7 +273,9 @@ class GenieEncoder(nn.Module):
         # project all layers to dim
         proj = nn.Dense(self.dim, name="proj")
         hidden = [proj(h) for h in layers]
-        b = hidden[0].shape[0]
+        # adaptive depth: collect the root representation after every
+        # breadth layer (reference encoders.py:265-277 depth_fc per layer)
+        h_t = [nn.Dense(self.dim, name="depth_fc_0")(hidden[0])]
         # breadth: attention-pool each hop's neighborhood into the target
         for depth in range(n_hops):
             att = AttLayer(self.dim, name=f"att_{depth}")
@@ -285,11 +287,15 @@ class GenieEncoder(nn.Module):
                 next_hidden.append(nn.tanh(
                     nn.Dense(self.dim, name=f"w_{depth}_{hop}")(pooled)))
             hidden = next_hidden
-        # depth: LSTM over the single remaining representation treated as a
-        # length-1 sequence per reference simplification
-        h = hidden[0][:, None, :]
-        h = LSTMLayer(self.dim, name="depth_lstm")(h)
-        return h[:, 0, :]
+            h_t.append(
+                nn.Dense(self.dim, name=f"depth_fc_{depth + 1}")(hidden[0]))
+        # depth gating: LSTM over the depth sequence [B, L+1, dim]. The
+        # paper reads the final state; the reference's code reads
+        # timestep 0 (encoders.py:287), which discards the gating — we
+        # follow the paper.
+        seq = jnp.stack(h_t, axis=1)
+        out = LSTMLayer(self.dim, name="depth_lstm")(seq)
+        return out[:, -1, :]
 
 
 class LGCEncoder(nn.Module):

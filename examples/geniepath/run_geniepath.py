@@ -16,12 +16,12 @@ from euler_tpu.platform import add_platform_flag, init_platform  # noqa: E402
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--dataset", default="cora")
-    ap.add_argument("--hidden_dim", type=int, default=32)
-    ap.add_argument("--fanouts", default="10,10")
+    ap.add_argument("--hidden_dim", type=int, default=64)
+    ap.add_argument("--fanouts", default="15,10")
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--learning_rate", type=float, default=0.0,
                 help="0 = auto per dataset (cora is stable at 0.01; the larger sets need 0.003)")
-    ap.add_argument("--max_steps", type=int, default=400)
+    ap.add_argument("--max_steps", type=int, default=600)
     ap.add_argument("--eval_steps", type=int, default=20)
     ap.add_argument("--dropout", type=float, default=0.5)
     ap.add_argument("--weight_decay", type=float, default=0.005)
