@@ -16,10 +16,11 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--dataset", default="cora")
     ap.add_argument("--hidden_dim", type=int, default=32)
-    ap.add_argument("--layer_sizes", default="128,128")
+    ap.add_argument("--layer_sizes", default="",
+                help="default: 256,256 on pubmed-sized sets, 128,128 otherwise")
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--learning_rate", type=float, default=0.01)
-    ap.add_argument("--max_steps", type=int, default=800)
+    ap.add_argument("--max_steps", type=int, default=0)
     ap.add_argument("--eval_steps", type=int, default=20)
     ap.add_argument("--dropout", type=float, default=0.5)
     ap.add_argument("--weight_decay", type=float, default=0.005)
@@ -27,6 +28,11 @@ def main(argv=None):
     add_platform_flag(ap)
     args = ap.parse_args(argv)
     init_platform(args.platform)
+    if not args.layer_sizes:
+        args.layer_sizes = ('256,256' if args.dataset == 'pubmed'
+                            else '128,128')
+    if not args.max_steps:
+        args.max_steps = 1200 if args.dataset == 'pubmed' else 800
 
     from euler_tpu.dataflow import LayerwiseDataFlow
     from euler_tpu.dataset import get_dataset

@@ -12,7 +12,12 @@ from common import citation_argparser, run_citation  # noqa: E402
 
 
 def main(argv=None):
-    args = citation_argparser().parse_args(argv)
+    args = citation_argparser(learning_rate=0.0, max_steps=0).parse_args(argv)
+    # per-dataset measured best (citeseer prefers the shared defaults)
+    if not args.learning_rate:
+        args.learning_rate = 0.01 if args.dataset == "citeseer" else 0.005
+    if not args.max_steps:
+        args.max_steps = 200 if args.dataset == "citeseer" else 500
     return run_citation("tag", args, conv_kwargs={'k_hop': 3})
 
 
