@@ -20,8 +20,9 @@ def main(argv=None):
     ap.add_argument("--dim", type=int, default=64)
     ap.add_argument("--logits", default="dot", choices=["dot", "cosine"])
     ap.add_argument("--batch_size", type=int, default=64)
-    ap.add_argument("--learning_rate", type=float, default=0.01)
-    ap.add_argument("--max_steps", type=int, default=200)
+    ap.add_argument("--learning_rate", type=float, default=0.003)
+    ap.add_argument("--weight_decay", type=float, default=0.001)
+    ap.add_argument("--max_steps", type=int, default=400)
     ap.add_argument("--eval_steps", type=int, default=20)
     ap.add_argument("--model_dir", default="")
     add_platform_flag(ap)
@@ -45,8 +46,21 @@ def main(argv=None):
             batch_size=args.batch_size, logits=args.logits)
     est = BaseEstimator(sol.model,
                         dict(learning_rate=args.learning_rate,
+                             weight_decay=args.weight_decay,
                              max_id=data.max_id),
                         model_dir=args.model_dir or None)
+    if args.mode == "supervise":
+        # citation protocol: early-stop on val (type 1), report test
+        # (type 2) — solutions sample train nodes by default
+        res = est.train_and_evaluate(
+            sol.input_fn, lambda: sol.input_fn(1),
+            args.max_steps, args.eval_steps,
+            eval_every=max(args.max_steps // 10, 10), keep_best=True)
+        test = est.evaluate(lambda: sol.input_fn(2), args.eval_steps)
+        res["test_metric"] = test["metric"]
+        res["test_loss"] = test["loss"]
+        print(res)
+        return test
     res = est.train(sol.input_fn, args.max_steps)
     ev = est.evaluate(sol.input_fn, args.eval_steps)
     print({**{f"train_{k}": v for k, v in res.items()},
