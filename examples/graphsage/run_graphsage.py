@@ -24,6 +24,11 @@ def main(argv=None):
     ap.add_argument("--fanouts", default="10,10")
     ap.add_argument("--hidden_dim", type=int, default=64)
     ap.add_argument("--aggregator", default="mean")
+    ap.add_argument("--device_sampler", action="store_true",
+                    help="sample fanouts on the accelerator "
+                         "(DeviceNeighborTable; features+labels "
+                         "move to HBM tables)")
+    ap.add_argument("--sampler_cap", type=int, default=32)
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--num_negs", type=int, default=5)
     ap.add_argument("--learning_rate", type=float, default=0.003)
@@ -48,10 +53,26 @@ def main(argv=None):
     flow = FanoutDataFlow(data.engine, list(fanouts),
                           feature_ids=["feature"])
     if args.mode == "supervised":
-        model = SupervisedGraphSage(
-            num_classes=data.num_classes, multilabel=data.multilabel,
-            dim=args.hidden_dim, fanouts=fanouts,
-            aggregator=args.aggregator, dropout=args.dropout)
+        store = sampler = None
+        if args.device_sampler:
+            from euler_tpu.models import DeviceSampledGraphSage
+            from euler_tpu.parallel import (
+                DeviceFeatureStore, DeviceNeighborTable,
+            )
+
+            store = DeviceFeatureStore(data.engine, ["feature"],
+                                       label_fid="label",
+                                       label_dim=data.num_classes)
+            sampler = DeviceNeighborTable(data.engine, cap=args.sampler_cap)
+            model = DeviceSampledGraphSage(
+                num_classes=data.num_classes, multilabel=data.multilabel,
+                dim=args.hidden_dim, fanouts=fanouts,
+                aggregator=args.aggregator, dropout=args.dropout)
+        else:
+            model = SupervisedGraphSage(
+                num_classes=data.num_classes, multilabel=data.multilabel,
+                dim=args.hidden_dim, fanouts=fanouts,
+                aggregator=args.aggregator, dropout=args.dropout)
         est = NodeEstimator(
             model,
             dict(batch_size=args.batch_size,
@@ -59,7 +80,8 @@ def main(argv=None):
                  weight_decay=args.weight_decay,
                  label_dim=data.num_classes),
             data.engine, flow, label_fid="label",
-            label_dim=data.num_classes, model_dir=args.model_dir or None)
+            label_dim=data.num_classes, model_dir=args.model_dir or None,
+            feature_store=store, device_sampler=sampler)
         res = fit_citation(est, args.max_steps, args.eval_steps)
     else:
         model = UnsupervisedGraphSage(
