@@ -218,6 +218,27 @@ void TestExecutorRunsDag() {
   CHECK_TRUE(out.NumElements() == 2);
   CHECK_TRUE(out.Flat<uint64_t>()[0] == 4);
   CHECK_TRUE(out.Flat<uint64_t>()[1] == 10);
+
+  // local mode fuses the whole plan into one FUSED node (FuseLocalPass);
+  // assert that so the sanitizer runs exercise FusedOp intentionally
+  CHECK_TRUE(plan->dag.nodes.size() == 1);
+  CHECK_TRUE(plan->dag.nodes[0].op == "FUSED");
+  CHECK_TRUE(plan->dag.nodes[0].inner.size() >= 2);
+
+  // a multi-hop sampling chain through the fused path
+  std::shared_ptr<const TranslateResult> plan2;
+  CHECK_OK(compiler.Compile(
+      "v(roots).sampleNB(*, 3, 0).as(h0).sampleNB(*, 2, 0).as(h1)", &plan2));
+  OpKernelContext ctx2;
+  Tensor roots2(DType::kU64, {2});
+  roots2.Flat<uint64_t>()[0] = 1;
+  roots2.Flat<uint64_t>()[1] = 5;
+  ctx2.Put("roots", std::move(roots2));
+  Executor exec2(&plan2->dag, env, &ctx2);
+  CHECK_OK(exec2.RunSync());
+  Tensor h1;
+  CHECK_TRUE(ctx2.Get("h1:1", &h1));
+  CHECK_TRUE(h1.NumElements() == 2 * 3 * 2);
 }
 
 // ---- index ----

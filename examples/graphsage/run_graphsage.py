@@ -84,6 +84,10 @@ def main(argv=None):
             feature_store=store, device_sampler=sampler)
         res = fit_citation(est, args.max_steps, args.eval_steps)
     else:
+        if args.device_sampler:
+            ap.error("--device_sampler supports --mode supervised only "
+                     "(the unsupervised edge/negative pipeline samples "
+                     "on the host)")
         model = UnsupervisedGraphSage(
             dim=args.hidden_dim, max_id=data.max_id, fanouts=fanouts,
             aggregator=args.aggregator, num_negs=args.num_negs)
