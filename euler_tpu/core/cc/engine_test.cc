@@ -11,6 +11,7 @@
 #include <atomic>
 #include <cmath>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <string>
@@ -197,6 +198,8 @@ void TestTensorSerde() {
 
 // ---- executor ----
 void TestExecutorRunsDag() {
+  // the fusion assertions below require FuseLocalPass active
+  unsetenv("EULER_TPU_NO_FUSE");
   // AS chain through the executor against a real graph
   auto g = RingGraph();
   CompileOptions opts;

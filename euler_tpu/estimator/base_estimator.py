@@ -197,6 +197,10 @@ class BaseEstimator:
                    "opt_state": self.state.opt_state,
                    "extra_vars": self.state.extra_vars or {}}
         mgr.save(step, args=ocp.args.StandardSave(payload))
+        # orbax saves asynchronously; block until committed so a process
+        # exiting right after train() never leaves a half-written
+        # checkpoint (observed as futures-after-shutdown errors at exit)
+        mgr.wait_until_finished()
 
     def restore_checkpoint(self) -> Optional[int]:
         mgr = self._checkpoint_manager()

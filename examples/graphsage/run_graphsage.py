@@ -39,6 +39,10 @@ def main(argv=None):
     ap.add_argument("--model_dir", default="")
     add_platform_flag(ap)
     args = ap.parse_args(argv)
+    if args.device_sampler and args.mode != "supervised":
+        ap.error("--device_sampler supports --mode supervised only "
+                 "(the unsupervised edge/negative pipeline samples "
+                 "on the host)")
     init_platform(args.platform)
 
     from euler_tpu.dataflow import FanoutDataFlow
@@ -84,10 +88,6 @@ def main(argv=None):
             feature_store=store, device_sampler=sampler)
         res = fit_citation(est, args.max_steps, args.eval_steps)
     else:
-        if args.device_sampler:
-            ap.error("--device_sampler supports --mode supervised only "
-                     "(the unsupervised edge/negative pipeline samples "
-                     "on the host)")
         model = UnsupervisedGraphSage(
             dim=args.hidden_dim, max_id=data.max_id, fanouts=fanouts,
             aggregator=args.aggregator, num_negs=args.num_negs)

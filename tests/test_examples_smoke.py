@@ -31,15 +31,23 @@ EXTRA = {
 }
 
 
-@pytest.mark.parametrize(
-    "script", SCRIPTS, ids=[s.name[len("run_"):-len(".py")] for s in SCRIPTS])
-def test_example_smoke(script, tmp_path):
+# Non-default mode variants that a plain run never enters (the
+# unsupervised graphsage path once rotted unnoticed for exactly this
+# reason).
+VARIANTS = [
+    ("graphsage/run_graphsage.py",
+     ["--mode", "unsupervised", "--batch_size", "16"]),
+    ("graphsage/run_graphsage.py", ["--device_sampler"]),
+    ("solution/run_solution.py", ["--mode", "unsupervise"]),
+]
+
+
+def _smoke(script, tmp_path, extra):
     cmd = [
         sys.executable, str(script),
         "--max_steps", "3", "--eval_steps", "2",
         "--model_dir", str(tmp_path / "model"),
-    ]
-    cmd += EXTRA.get(script.name, [])
+    ] + extra
     proc = subprocess.run(
         cmd, cwd=str(REPO), capture_output=True, text=True, timeout=600,
         env={"PATH": "/usr/bin:/bin:/usr/local/bin",
@@ -50,3 +58,16 @@ def test_example_smoke(script, tmp_path):
     assert proc.returncode == 0, (
         f"{script} rc={proc.returncode}\nstdout:\n{proc.stdout[-3000:]}\n"
         f"stderr:\n{proc.stderr[-3000:]}")
+
+
+@pytest.mark.parametrize(
+    "script", SCRIPTS, ids=[s.name[len("run_"):-len(".py")] for s in SCRIPTS])
+def test_example_smoke(script, tmp_path):
+    _smoke(script, tmp_path, EXTRA.get(script.name, []))
+
+
+@pytest.mark.parametrize(
+    "rel,extra", VARIANTS, ids=[f"{r.split('/')[0]}:{' '.join(e)}"
+                                for r, e in VARIANTS])
+def test_example_mode_variants(rel, extra, tmp_path):
+    _smoke(REPO / "examples" / rel, tmp_path, extra)
