@@ -198,8 +198,16 @@ void TestTensorSerde() {
 
 // ---- executor ----
 void TestExecutorRunsDag() {
-  // the fusion assertions below require FuseLocalPass active
+  // the fusion assertions below require FuseLocalPass active; restore
+  // the caller's knob afterwards so a NO_FUSE suite run stays NO_FUSE
+  const char* saved_no_fuse = getenv("EULER_TPU_NO_FUSE");
   unsetenv("EULER_TPU_NO_FUSE");
+  struct RestoreEnv {
+    const char* saved;
+    ~RestoreEnv() {
+      if (saved != nullptr) setenv("EULER_TPU_NO_FUSE", saved, 1);
+    }
+  } restore{saved_no_fuse};
   // AS chain through the executor against a real graph
   auto g = RingGraph();
   CompileOptions opts;

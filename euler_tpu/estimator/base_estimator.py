@@ -197,10 +197,14 @@ class BaseEstimator:
                    "opt_state": self.state.opt_state,
                    "extra_vars": self.state.extra_vars or {}}
         mgr.save(step, args=ocp.args.StandardSave(payload))
-        # orbax saves asynchronously; block until committed so a process
-        # exiting right after train() never leaves a half-written
-        # checkpoint (observed as futures-after-shutdown errors at exit)
-        mgr.wait_until_finished()
+
+    def finalize_checkpoints(self) -> None:
+        """Block until async orbax saves commit — called at the end of
+        every train path so a process exiting right after train() never
+        leaves a half-written checkpoint (observed as futures-after-
+        shutdown errors at exit). Mid-training saves stay async."""
+        if self._ckpt_mgr is not None:
+            self._ckpt_mgr.wait_until_finished()
 
     def restore_checkpoint(self) -> Optional[int]:
         mgr = self._checkpoint_manager()
@@ -264,6 +268,7 @@ class BaseEstimator:
                     break
         if self.ckpt_steps:
             self.save_checkpoint(step)
+        self.finalize_checkpoints()
         if self.profiling and self.model_dir:
             jax.profiler.stop_trace()
         return {
@@ -338,6 +343,7 @@ class BaseEstimator:
                 break
         if self.ckpt_steps:
             self.save_checkpoint(step)
+        self.finalize_checkpoints()
         if self.profiling and self.model_dir:
             jax.profiler.stop_trace()
         # step-weighted mean so the reported train metric matches what
@@ -477,6 +483,7 @@ class BaseEstimator:
                     jnp.asarray, best_snap["extra_vars"]) or {})
         if self.ckpt_steps and self.state is not None:
             self.save_checkpoint(step)  # disk matches the reported weights
+            self.finalize_checkpoints()
         eval_res = self.evaluate(eval_input_fn, eval_steps)
         out = {**{f"train_{k}": v for k, v in train_res.items()},
                **{f"eval_{k}": v for k, v in eval_res.items()}}

@@ -85,7 +85,7 @@ class UnsuperviseModel(nn.Module):
     pairs + num_negs sampled negatives, sigmoid ranking loss, MRR metric.
 
     Parity: mp_utils/base.py:49-90. Subclasses define embed(batch) and
-    may override context_embed(ids, pos, negs) -> (pos_emb, negs_emb)
+    may override context_embed(pos, negs) -> (pos_emb, negs_emb)
     (the default embeds both from ONE shared id-context table — a single
     submodule, created once).
     batch: src_emb inputs + 'pos' ids + 'negs' ids handled by the caller's
@@ -100,19 +100,18 @@ class UnsuperviseModel(nn.Module):
     def embed(self, batch: Dict[str, Any]) -> Array:
         raise NotImplementedError
 
-    def context_embed(self, ids: Array, pos: Array, negs: Array):
+    def context_embed(self, pos: Array, negs: Array):
         """Context (pos, negs) embeddings from ONE shared table — a
         single submodule construction, since flax forbids creating two
-        modules under the same explicit name in one call."""
+        modules under the same explicit name in one call. Overrides
+        needing more of the batch can read it in embed()/__call__."""
         ctx = Embedding(self.max_id + 1, self.dim, name="ctx_emb")
-        del ids  # the default context table is id-indexed only
         return ctx(pos), ctx(negs)
 
     @nn.compact
     def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
         emb = self.embed(batch)                       # [B, D]
-        pos, negs = self.context_embed(
-            batch.get("src"), batch["pos"], batch["negs"])
+        pos, negs = self.context_embed(batch["pos"], batch["negs"])
         if pos.ndim == 2:
             pos = pos[:, None, :]                     # [B, 1, D]
         pos_logit = jnp.einsum("bd,bkd->bk", emb, pos)    # [B, 1]

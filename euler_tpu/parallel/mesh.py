@@ -46,18 +46,42 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch: Dict, mesh: Mesh) -> Dict:
+# batch keys that carry HBM-resident lookup tables rather than per-step
+# data — replicated by default in shard_batch
+REPLICATED_TABLE_KEYS = ("feature_table", "label_table",
+                         "nbr_table", "cum_table")
+
+
+def shard_batch(batch: Dict, mesh: Mesh,
+                replicated_keys=REPLICATED_TABLE_KEYS) -> Dict:
     """device_put every array in the batch with its leading axis split over
     'data' (arrays whose leading dim doesn't divide fall back to
-    replication — e.g. scalar counts)."""
+    replication — e.g. scalar counts). Top-level keys in replicated_keys
+    are always replicated — HBM-resident lookup tables (feature/label/
+    neighbor) must not be row-sharded over 'data', or every in-step
+    gather turns into a cross-device collective."""
     dsh = data_sharding(mesh)
     rsh = replicated(mesh)
     n_data = mesh.shape["data"]
 
     def put(v):
-        a = np.asarray(v)
-        if a.ndim >= 1 and a.shape[0] % n_data == 0 and a.shape[0] > 0:
-            return jax.device_put(a, dsh)
-        return jax.device_put(a, rsh)
+        # no np.asarray on jax arrays: that would gather device-resident
+        # tables back to host; device_put is a no-op when already placed
+        shape = getattr(v, "shape", None)
+        if shape is None:
+            v = np.asarray(v)
+            shape = v.shape
+        if len(shape) >= 1 and shape[0] % n_data == 0 and shape[0] > 0:
+            return jax.device_put(v, dsh)
+        return jax.device_put(v, rsh)
 
-    return jax.tree_util.tree_map(put, batch)
+    if not isinstance(batch, dict):
+        return jax.tree_util.tree_map(put, batch)
+    out = {}
+    for k, v in batch.items():
+        if k in replicated_keys:
+            out[k] = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, rsh), v)
+        else:
+            out[k] = jax.tree_util.tree_map(put, v)
+    return out
