@@ -200,14 +200,18 @@ void TestTensorSerde() {
 void TestExecutorRunsDag() {
   // the fusion assertions below require FuseLocalPass active; restore
   // the caller's knob afterwards so a NO_FUSE suite run stays NO_FUSE
-  const char* saved_no_fuse = getenv("EULER_TPU_NO_FUSE");
+  const char* saved_ptr = getenv("EULER_TPU_NO_FUSE");
+  // copy before unsetenv: POSIX allows unsetenv to invalidate the pointer
+  std::string saved_no_fuse = saved_ptr != nullptr ? saved_ptr : "";
+  bool had_no_fuse = saved_ptr != nullptr;
   unsetenv("EULER_TPU_NO_FUSE");
   struct RestoreEnv {
-    const char* saved;
+    std::string saved;
+    bool had;
     ~RestoreEnv() {
-      if (saved != nullptr) setenv("EULER_TPU_NO_FUSE", saved, 1);
+      if (had) setenv("EULER_TPU_NO_FUSE", saved.c_str(), 1);
     }
-  } restore{saved_no_fuse};
+  } restore{saved_no_fuse, had_no_fuse};
   // AS chain through the executor against a real graph
   auto g = RingGraph();
   CompileOptions opts;

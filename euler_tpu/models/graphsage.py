@@ -57,18 +57,22 @@ class UnsupervisedGraphSage(UnsuperviseModel):
 
 
 class DeviceSampledGraphSage(SuperviseModel):
-    """GraphSAGE whose fanout is sampled ON DEVICE (DeviceNeighborTable):
+    """A fanout model whose sampling runs ON DEVICE (DeviceNeighborTable):
     the batch carries only root rows + a sample seed; neighbor sampling,
     feature gather, and label lookup all read HBM-resident tables inside
     the jitted step. The TPU-first configuration bench.py measures —
-    the host feeder drops out of the critical path entirely."""
+    the host feeder drops out of the critical path entirely. encoder
+    picks any fanout-layer encoder ('sage' or 'gcn' — both consume the
+    per-hop feature list the on-device sampler produces)."""
 
     dim: int = 32
     fanouts: Sequence[int] = (10, 10)
     aggregator: str = "mean"
+    encoder: str = "sage"
 
     def embed(self, batch: Dict[str, Any]) -> Array:
         from euler_tpu.parallel.device_sampler import sample_fanout_rows
+        from euler_tpu.utils.encoders import GCNEncoder
 
         roots = batch["rows"][0]
         key = jax.random.fold_in(jax.random.key(17), batch["sample_seed"])
@@ -76,6 +80,13 @@ class DeviceSampledGraphSage(SuperviseModel):
                                   roots, tuple(self.fanouts), key)
         table = batch["feature_table"]
         layers = [jax.numpy.take(table, r, axis=0) for r in rows]
+        if self.encoder == "gcn":
+            return GCNEncoder(self.dim, tuple(self.fanouts),
+                              name="encoder")(layers)
+        if self.encoder != "sage":
+            raise ValueError(
+                f"DeviceSampledGraphSage.encoder must be 'sage' or 'gcn', "
+                f"got {self.encoder!r}")
         return SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
                            name="encoder")(layers)
 

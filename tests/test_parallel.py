@@ -290,3 +290,32 @@ def test_device_sampled_spmd_train_step():
             losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_device_sampled_gcn_encoder():
+    """The on-device sampling path composes with the GCN fanout encoder
+    too (encoder='gcn') — sampling is encoder-agnostic."""
+    import jax
+
+    from euler_tpu.dataset.base_dataset import synthetic_citation
+    from euler_tpu.models import DeviceSampledGraphSage
+    from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+
+    data = synthetic_citation("t", n=120, d=8, num_classes=3,
+                              train_per_class=10, val=15, test=20, seed=9)
+    g = data.engine
+    store = DeviceFeatureStore(g, ["feature"], label_fid="label",
+                               label_dim=3)
+    sampler = DeviceNeighborTable(g, cap=8)
+    model = DeviceSampledGraphSage(num_classes=3, multilabel=False, dim=8,
+                                   fanouts=(3, 3), encoder="gcn")
+    roots = store.lookup(g.sample_node(8, -1)).astype(np.int32)
+    batch = {"rows": [roots], "sample_seed": np.uint32(1),
+             "feature_table": store.features, "label_table": store.labels,
+             **sampler.tables}
+    params = model.init(jax.random.key(0), batch)
+    loss, emb = jax.jit(
+        lambda p, b: (model.apply(p, b).loss, model.apply(p, b).embedding)
+    )(params, batch)
+    assert np.isfinite(float(loss))
+    assert emb.shape[0] == 8
