@@ -34,10 +34,14 @@ class DGI(nn.Module):
                          name="encoder")
         sub = dict(batch)
         sub.pop("root_index", None)
-        h_real = nn.sigmoid(net(sub))
+        # paper: h = PReLU(GCN(x)); only the SUMMARY goes through a
+        # sigmoid — squashing the embeddings themselves destroys the
+        # linear separability the downstream probe relies on
+        act = nn.PReLU()
+        h_real = act(net(sub))
         sub_c = dict(sub)
         sub_c["x"] = batch["x_corrupt"]
-        h_fake = nn.sigmoid(net(sub_c))
+        h_fake = act(net(sub_c))
         summary = nn.sigmoid(h_real.mean(axis=0))
         w = self.param("disc", nn.initializers.glorot_uniform(),
                        (self.dim, self.dim))

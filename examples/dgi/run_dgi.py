@@ -14,10 +14,10 @@ import numpy as np  # noqa: E402
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--dataset", default="cora")
-    ap.add_argument("--dim", type=int, default=64)
-    ap.add_argument("--batch_size", type=int, default=64)
-    ap.add_argument("--learning_rate", type=float, default=0.005)
-    ap.add_argument("--max_steps", type=int, default=600)
+    ap.add_argument("--dim", type=int, default=512)
+    ap.add_argument("--num_layers", type=int, default=1)
+    ap.add_argument("--learning_rate", type=float, default=0.001)
+    ap.add_argument("--max_steps", type=int, default=1000)
     ap.add_argument("--eval_steps", type=int, default=20)
     ap.add_argument("--model_dir", default="")
     add_platform_flag(ap)
@@ -32,19 +32,22 @@ def main(argv=None):
     data = get_dataset(args.dataset)
     g = data.engine
     flow = FullBatchDataFlow(g, feature_ids=["feature"])
-    model = DGI(dim=args.dim)
+    model = DGI(dim=args.dim, num_layers=args.num_layers)
     est = BaseEstimator(model, dict(learning_rate=args.learning_rate),
                         model_dir=args.model_dir or None)
     rng = np.random.default_rng(0)
 
+    # the paper trains on the WHOLE graph each step (one corruption per
+    # step). The constant graph arrays ride static_batch so only the
+    # per-step corruption permutation crosses to the device.
+    ids = g.all_node_ids()
+    full = flow(ids)
+    est.static_batch.update(full)
+
     def input_fn():
         while True:
-            roots = g.sample_node(args.batch_size, -1)
-            batch = flow(roots)
-            perm = rng.permutation(batch["x"].shape[0])
-            batch["x_corrupt"] = batch["x"][perm]
-            batch["infer_ids"] = roots
-            yield batch
+            perm = rng.permutation(full["x"].shape[0])
+            yield {"x_corrupt": full["x"][perm]}
 
     res = est.train(input_fn, args.max_steps)
     ev = est.evaluate(input_fn, args.eval_steps)
@@ -54,8 +57,7 @@ def main(argv=None):
     # evaluation — a linear probe on the frozen embeddings.
     import jax
 
-    ids = g.all_node_ids()
-    batch = flow(ids)
+    batch = full
     variables = {"params": est.state.params, **(est.state.extra_vars or {})}
     emb = np.asarray(jax.device_get(
         est.model.apply(variables, {**batch, "x_corrupt": batch["x"]}
