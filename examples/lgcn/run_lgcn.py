@@ -17,11 +17,11 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--dataset", default="cora")
     ap.add_argument("--hidden_dim", type=int, default=32)
-    ap.add_argument("--fanout", type=int, default=10)
-    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--fanout", type=int, default=30)
+    ap.add_argument("--k", type=int, default=8)
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--learning_rate", type=float, default=0.01)
-    ap.add_argument("--max_steps", type=int, default=200)
+    ap.add_argument("--max_steps", type=int, default=400)
     ap.add_argument("--eval_steps", type=int, default=20)
     ap.add_argument("--dropout", type=float, default=0.5)
     ap.add_argument("--weight_decay", type=float, default=0.005)
