@@ -58,9 +58,12 @@ class ShallowEncoder(nn.Module):
 class SageEncoder(nn.Module):
     """GraphSAGE encoder over a sampled fanout (reference encoders.py SageEncoder).
 
-    layers[h]: feature tensor of hop h, shape [B·Πk_{<h}, D]. counts[h] is
-    the fanout at hop h. Aggregates deepest-first with fresh aggregator
-    params per hop.
+    layers[h]: feature tensor of hop h, shape [B·Πk_{<h}, D]. Aggregates
+    deepest-first with fresh aggregator params per hop. Per-hop widths k
+    are derived from the layer shapes (static under jit), so parameters
+    are fanout-independent — evaluation may use wider fanouts than
+    training (pass a bigger-fanout eval_dataflow to NodeEstimator);
+    `fanouts` only fixes the hop count.
     """
 
     dim: int
@@ -83,7 +86,7 @@ class SageEncoder(nn.Module):
             for hop in range(n_hops - depth):
                 x = hidden[hop]
                 nbr = hidden[hop + 1].reshape(
-                    x.shape[0], self.fanouts[hop], -1)
+                    x.shape[0], hidden[hop + 1].shape[0] // x.shape[0], -1)
                 next_hidden.append(agg(x, nbr))
             hidden = next_hidden
         return hidden[0]
@@ -106,7 +109,8 @@ class GCNEncoder(nn.Module):
             next_hidden = []
             for hop in range(n_hops - depth):
                 x = hidden[hop]
-                nbr = hidden[hop + 1].reshape(x.shape[0], self.fanouts[hop], -1)
+                nbr = hidden[hop + 1].reshape(
+                    x.shape[0], hidden[hop + 1].shape[0] // x.shape[0], -1)
                 both = jnp.concatenate([x[:, None, :], nbr], axis=1)
                 h = w(both.mean(axis=1))
                 next_hidden.append(h if last else nn.relu(h))
@@ -282,7 +286,8 @@ class GenieEncoder(nn.Module):
             next_hidden = []
             for hop in range(n_hops - depth):
                 x = hidden[hop]
-                nbr = hidden[hop + 1].reshape(x.shape[0], self.fanouts[hop], -1)
+                nbr = hidden[hop + 1].reshape(
+                    x.shape[0], hidden[hop + 1].shape[0] // x.shape[0], -1)
                 pooled = att(jnp.concatenate([x[:, None, :], nbr], axis=1))
                 next_hidden.append(nn.tanh(
                     nn.Dense(self.dim, name=f"w_{depth}_{hop}")(pooled)))
