@@ -55,6 +55,17 @@ class ShallowEncoder(nn.Module):
         return jnp.concatenate(parts, axis=-1)
 
 
+def _hop_neighbors(child: Array, parent: Array) -> Array:
+    """Reshape hop h+1's flat layer to [n_h, k, D], deriving k from the
+    (jit-static) shapes. Shared by all fanout encoders so the divisibility
+    invariant lives in one place."""
+    n = parent.shape[0]
+    assert child.shape[0] % n == 0, (
+        f"layer of {child.shape[0]} rows is not a whole fanout of the "
+        f"{n}-row parent layer")
+    return child.reshape(n, child.shape[0] // n, -1)
+
+
 class SageEncoder(nn.Module):
     """GraphSAGE encoder over a sampled fanout (reference encoders.py SageEncoder).
 
@@ -85,8 +96,7 @@ class SageEncoder(nn.Module):
             next_hidden = []
             for hop in range(n_hops - depth):
                 x = hidden[hop]
-                nbr = hidden[hop + 1].reshape(
-                    x.shape[0], hidden[hop + 1].shape[0] // x.shape[0], -1)
+                nbr = _hop_neighbors(hidden[hop + 1], x)
                 next_hidden.append(agg(x, nbr))
             hidden = next_hidden
         return hidden[0]
@@ -102,6 +112,8 @@ class GCNEncoder(nn.Module):
     @nn.compact
     def __call__(self, layers: Sequence[Array]) -> Array:
         n_hops = len(self.fanouts)
+        assert len(layers) == n_hops + 1, (
+            f"need {n_hops + 1} feature layers for {n_hops} fanouts")
         hidden = list(layers)
         for depth in range(n_hops):
             w = nn.Dense(self.dim, use_bias=False, name=f"w_{depth}")
@@ -109,8 +121,7 @@ class GCNEncoder(nn.Module):
             next_hidden = []
             for hop in range(n_hops - depth):
                 x = hidden[hop]
-                nbr = hidden[hop + 1].reshape(
-                    x.shape[0], hidden[hop + 1].shape[0] // x.shape[0], -1)
+                nbr = _hop_neighbors(hidden[hop + 1], x)
                 both = jnp.concatenate([x[:, None, :], nbr], axis=1)
                 h = w(both.mean(axis=1))
                 next_hidden.append(h if last else nn.relu(h))
@@ -274,6 +285,8 @@ class GenieEncoder(nn.Module):
     @nn.compact
     def __call__(self, layers: Sequence[Array]) -> Array:
         n_hops = len(self.fanouts)
+        assert len(layers) == n_hops + 1, (
+            f"need {n_hops + 1} feature layers for {n_hops} fanouts")
         # project all layers to dim
         proj = nn.Dense(self.dim, name="proj")
         hidden = [proj(h) for h in layers]
@@ -286,8 +299,7 @@ class GenieEncoder(nn.Module):
             next_hidden = []
             for hop in range(n_hops - depth):
                 x = hidden[hop]
-                nbr = hidden[hop + 1].reshape(
-                    x.shape[0], hidden[hop + 1].shape[0] // x.shape[0], -1)
+                nbr = _hop_neighbors(hidden[hop + 1], x)
                 pooled = att(jnp.concatenate([x[:, None, :], nbr], axis=1))
                 next_hidden.append(nn.tanh(
                     nn.Dense(self.dim, name=f"w_{depth}_{hop}")(pooled)))
