@@ -21,11 +21,52 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 import traceback
 
 import numpy as np
+
+# Last verified on-TPU result, refreshed after every successful TPU run.
+# When the tunnel is down and the bench falls back to CPU, the fallback
+# JSON carries this (clearly labeled, with source + age) so a transient
+# outage at capture time doesn't erase the measured TPU number. Tracked
+# in git ON PURPOSE: a fresh clone benched during an outage should still
+# surface the last measurement and its provenance.
+TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_TPU.json")
+
+
+def _record_tpu_result(result: dict) -> None:
+    """Best-effort: a cache-write failure must never clobber the
+    successful measurement being reported."""
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(TPU_CACHE)).stdout.strip()
+    except OSError:
+        commit = ""
+    payload = dict(result)
+    payload["recorded_at_commit"] = commit
+    payload["recorded_unix"] = int(time.time())
+    payload["source"] = "auto (bench.py _record_tpu_result)"
+    try:
+        with open(TPU_CACHE, "w") as f:
+            json.dump(payload, f, indent=1)
+    except OSError as e:
+        print(f"bench: could not refresh {TPU_CACHE}: {e}",
+              file=sys.stderr)
+
+
+def _cached_tpu_result():
+    try:
+        with open(TPU_CACHE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def build_products_like(n_nodes: int, avg_degree: int, feat_dim: int,
@@ -221,6 +262,24 @@ def main(argv=None):
             raise RuntimeError(backend_err)
         result = run_bench(args)
         rc = 0
+        if result.get("detail", {}).get("backend") == "tpu" \
+                and not args.smoke:
+            _record_tpu_result(result)
+        elif result.get("detail", {}).get("cpu_fallback"):
+            cached = _cached_tpu_result()
+            if cached is not None:
+                # transient tunnel outage: surface the last verified
+                # on-TPU measurement alongside the CPU fallback number
+                result["detail"]["last_verified_tpu"] = {
+                    "value": cached.get("value"),
+                    "unit": cached.get("unit"),
+                    "vs_baseline": cached.get("vs_baseline"),
+                    "recorded_at_commit": cached.get("recorded_at_commit"),
+                    "recorded_unix": cached.get("recorded_unix"),
+                    "source": cached.get("source"),
+                    "steps_per_sec": cached.get("detail", {}).get(
+                        "steps_per_sec"),
+                }
     except Exception as e:
         result = {
             "metric": "graphsage_train_edges_per_sec_per_chip",
