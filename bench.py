@@ -45,17 +45,21 @@ def _record_tpu_result(result: dict) -> None:
     try:
         commit = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"],
-            capture_output=True, text=True,
+            capture_output=True, text=True, timeout=5,
             cwd=os.path.dirname(TPU_CACHE)).stdout.strip()
-    except OSError:
+    except (OSError, subprocess.TimeoutExpired):
         commit = ""
     payload = dict(result)
     payload["recorded_at_commit"] = commit
     payload["recorded_unix"] = int(time.time())
     payload["source"] = "auto (bench.py _record_tpu_result)"
     try:
-        with open(TPU_CACHE, "w") as f:
+        # atomic: a crash mid-write must not destroy the previous
+        # verified measurement this file exists to preserve
+        tmp = TPU_CACHE + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(payload, f, indent=1)
+        os.replace(tmp, TPU_CACHE)
     except OSError as e:
         print(f"bench: could not refresh {TPU_CACHE}: {e}",
               file=sys.stderr)
@@ -262,8 +266,14 @@ def main(argv=None):
             raise RuntimeError(backend_err)
         result = run_bench(args)
         rc = 0
+        default_shapes = (not args.smoke and not args.nodes
+                          and not args.batch_size and not args.fanouts
+                          and not args.steps and not args.feat_dim
+                          and args.cap == 32 and not args.steps_per_loop)
         if result.get("detail", {}).get("backend") == "tpu" \
-                and not args.smoke:
+                and default_shapes:
+            # only canonical default-config runs refresh the cache — a
+            # tiny custom-flag run must not replace the headline number
             _record_tpu_result(result)
         elif result.get("detail", {}).get("cpu_fallback"):
             cached = _cached_tpu_result()

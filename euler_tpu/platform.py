@@ -21,9 +21,11 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
+import types
 
 _PROBE_SRC = (
     "import json, jax\n"
@@ -50,14 +52,36 @@ def probe_backend(timeout: float = 90.0):
     or an error string on failure. Never touches this process's backend.
     """
     env = dict(os.environ)
+    # NOT subprocess.run: its TimeoutExpired cleanup calls an unbounded
+    # wait() on the child, and a probe stuck in uninterruptible sleep
+    # against a dead TPU tunnel never reaps — observed hanging the
+    # caller forever past the stated timeout. Popen + bounded
+    # communicate lets us abandon an unkillable child instead.
     try:
-        proc = subprocess.run(
+        proc = subprocess.Popen(
             [sys.executable, "-c", _PROBE_SRC],
-            capture_output=True, text=True, timeout=timeout, env=env)
-    except subprocess.TimeoutExpired:
-        return False, f"backend probe timed out after {timeout:.0f}s"
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, start_new_session=True)
     except OSError as e:  # no child processes allowed, etc.
         return False, f"backend probe could not run: {e}"
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # the probe got its own session; kill the whole group so plugin
+        # helper processes holding the pipes die too
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            proc.kill()
+        try:
+            proc.communicate(timeout=5)
+        except subprocess.TimeoutExpired:
+            return False, (f"backend probe hung unkillably after "
+                           f"{timeout:.0f}s (abandoned pid {proc.pid})")
+        return False, f"backend probe timed out after {timeout:.0f}s"
+
+    proc = types.SimpleNamespace(returncode=proc.returncode,
+                                 stdout=out, stderr=err)
     if proc.returncode != 0:
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()
         return False, tail[-1] if tail else f"probe rc={proc.returncode}"
