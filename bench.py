@@ -254,9 +254,10 @@ def main(argv=None):
         from euler_tpu.platform import init_platform
 
         # Bound the worst case (hung plugin burns the full timeout every
-        # attempt): 2 × 210s + 10s ≈ 7.2 min before CPU fallback, leaving
-        # room for the fallback run inside a ~10-min driver patience.
-        init_platform(platform, probe_timeout=210.0, retries=2,
+        # attempt): 2 × 150s + 10s ≈ 5.2 min before CPU fallback, leaving
+        # ample room for the fallback run inside a ~10-min driver
+        # patience (a healthy backend probes in well under 30s).
+        init_platform(platform, probe_timeout=150.0, retries=2,
                       retry_delay=10.0, verbose=True)
     except Exception as e:
         backend_err = f"platform init: {e}"
