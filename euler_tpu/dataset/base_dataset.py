@@ -131,12 +131,16 @@ def synthetic_citation(name: str, n: int, d: int, num_classes: int,
     # sparse SBM edges via sampled pairs
     n_intra = int(n * intra_degree / 2)
     n_inter = int(n * inter_degree / 2)
-    # intra: pick random nodes, partner within same class
+    # intra: pick random nodes, partner within same class (vectorized —
+    # a per-edge Python loop here would dominate products-scale builds)
     by_class = [np.where(labels == c)[0] for c in range(num_classes)]
+    class_sizes = np.array([len(b) for b in by_class], np.int64)
+    class_offs = np.concatenate([[0], np.cumsum(class_sizes)])
+    nodes_by_class = np.concatenate(by_class) if n else np.array([], np.int64)
     intra_src = rng.integers(0, n, n_intra)
-    intra_dst = np.array([
-        by_class[labels[s]][rng.integers(0, len(by_class[labels[s]]))]
-        for s in intra_src])
+    src_cls = labels[intra_src]
+    within = rng.integers(0, class_sizes[src_cls])
+    intra_dst = nodes_by_class[class_offs[src_cls] + within]
     inter_src = rng.integers(0, n, n_inter)
     inter_dst = rng.integers(0, n, n_inter)
     edges = np.stack([

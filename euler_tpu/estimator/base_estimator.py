@@ -380,10 +380,14 @@ class BaseEstimator:
                 self.state, _merged(batch, self.static_batch))
             losses.append(float(loss))
             metrics.append(float(metric))
-            # masked batches (graph packing) report per-batch means over
-            # n_real entries; weight them so a short final sweep batch
-            # doesn't count like a full one
-            mask = raw.get("graph_mask") if isinstance(raw, dict) else None
+            # masked batches (graph packing / node eval sweeps) report
+            # per-batch means over n_real entries; weight them so a short
+            # final sweep batch doesn't count like a full one
+            mask = None
+            if isinstance(raw, dict):
+                mask = raw.get("graph_mask")
+                if mask is None:
+                    mask = raw.get("metric_mask")
             weights.append(float(np.sum(mask)) if mask is not None else 1.0)
         if not losses:
             return {"loss": float("nan"), "metric": float("nan")}

@@ -46,7 +46,11 @@ class NodeEstimator(BaseEstimator):
         self.device_sampler = device_sampler
         if device_sampler is not None and feature_store is None:
             raise ValueError("device_sampler requires a feature_store")
-        self._seed_counter = 0
+        # independent per-phase device-sampler RNG streams (advisor r2:
+        # one shared counter made training draws depend on how many
+        # interleaved evals had run — eval cadence broke step-for-step
+        # reproducibility)
+        self._seed_counters = {0: 0, 1: 0}
         if feature_store is not None:
             self.static_batch["feature_table"] = feature_store.features
             if feature_store.labels is not None:
@@ -54,41 +58,49 @@ class NodeEstimator(BaseEstimator):
         if device_sampler is not None:
             self.static_batch.update(device_sampler.tables)
 
-    def _batches(self, node_type: int, flow=None) -> Iterator[Dict]:
+    def _node_batch(self, roots, flow, stream: int = 0) -> Dict:
+        """One batch for the given roots through whichever input path is
+        configured (device sampler / feature store / host arrays)."""
         store = self.feature_store
-        flow = flow or self.dataflow
-        while True:
-            roots = self.graph.sample_node(self.batch_size, node_type)
-            if self.device_sampler is not None:
-                # on-device sampling: the host's whole contribution is
-                # root rows + a seed (the model draws the fanout in-jit)
-                yield self._sampler_batch(roots)
-                continue
-            batch = flow(roots)
-            if store is not None:
-                # rows replace ids/weights/types AND (with a label table)
-                # the host label fetch — the device step sees only int32
-                # rows, everything else gathers from HBM-resident tables
-                rows = [store.lookup(i) for i in batch["ids"]]
-                batch = {"rows": rows, "infer_ids": roots}
-                if store.labels is None:
-                    batch["labels"] = self.graph.get_dense_feature(
-                        roots, self.label_fid,
-                        self.label_dim if self.label_dim else None)
-            else:
+        if self.device_sampler is not None:
+            # on-device sampling: the host's whole contribution is
+            # root rows + a seed (the model draws the fanout in-jit)
+            return self._sampler_batch(roots, stream)
+        batch = flow(roots)
+        if store is not None:
+            # rows replace ids/weights/types AND (with a label table)
+            # the host label fetch — the device step sees only int32
+            # rows, everything else gathers from HBM-resident tables
+            rows = [store.lookup(i) for i in batch["ids"]]
+            batch = {"rows": rows, "infer_ids": roots}
+            if store.labels is None:
                 batch["labels"] = self.graph.get_dense_feature(
                     roots, self.label_fid,
                     self.label_dim if self.label_dim else None)
-                batch["infer_ids"] = roots
-            yield batch
+        else:
+            batch["labels"] = self.graph.get_dense_feature(
+                roots, self.label_fid,
+                self.label_dim if self.label_dim else None)
+            batch["infer_ids"] = roots
+        return batch
 
-    def _sampler_batch(self, roots) -> Dict:
+    def _batches(self, node_type: int, flow=None,
+                 stream: int = 0) -> Iterator[Dict]:
+        flow = flow or self.dataflow
+        while True:
+            roots = self.graph.sample_node(self.batch_size, node_type)
+            yield self._node_batch(roots, flow, stream)
+
+    def _sampler_batch(self, roots, stream: int = 0) -> Dict:
         """Device-sampler batch: root rows + a per-batch seed; labels via
         the device table when present, host fetch otherwise (mirrors the
-        store path's fallback)."""
-        self._seed_counter += 1
+        store path's fallback). stream 0 = train, 1 = eval/infer — the
+        high seed bit separates them so eval cadence never shifts the
+        training sample sequence."""
+        self._seed_counters[stream] += 1
+        seed = np.uint32((stream << 31) | self._seed_counters[stream])
         batch = {"rows": [self.feature_store.lookup(roots)],
-                 "sample_seed": np.uint32(self._seed_counter),
+                 "sample_seed": seed,
                  "infer_ids": roots}
         if self.feature_store.labels is None:
             batch["labels"] = self.graph.get_dense_feature(
@@ -100,7 +112,52 @@ class NodeEstimator(BaseEstimator):
         return self._batches(self.train_node_type)
 
     def eval_input_fn(self):
-        return self._batches(self.eval_node_type, flow=self.eval_dataflow)
+        return self._batches(self.eval_node_type, flow=self.eval_dataflow,
+                             stream=1)
+
+    def split_ids(self, node_type: int) -> np.ndarray:
+        """All node ids of a split (node type), engine order."""
+        ids = self.graph.all_node_ids()
+        if node_type < 0:
+            return ids
+        return ids[self.graph.get_node_type(ids) == node_type]
+
+    def eval_sweep_steps(self, node_type: Optional[int] = None) -> int:
+        n = len(self.split_ids(
+            self.eval_node_type if node_type is None else node_type))
+        return max((n + self.batch_size - 1) // self.batch_size, 1)
+
+    def eval_sweep_input_fn(self, node_type: Optional[int] = None,
+                            flow=None) -> Iterator[Dict]:
+        """Deterministic pass over a split: every node EXACTLY once. For
+        accuracy-decomposable metrics (single-label micro-F1 ==
+        accuracy) the n_real-weighted batch mean IS the exact full-split
+        value; for true multilabel micro-F1 it is the standard per-batch
+        average (micro-F1 doesn't decompose over batches), still free of
+        sampling noise. The final chunk pads to the static batch shape
+        with a metric_mask zeroing the padded rows out of loss and
+        metric (SuperviseModel honors it; advisor r2: unmasked
+        repeat-pads double-count)."""
+        ids = self.split_ids(
+            self.eval_node_type if node_type is None else node_type)
+        flow = flow or self.eval_dataflow
+
+        def gen():
+            for i in range(0, len(ids), self.batch_size):
+                chunk = ids[i:i + self.batch_size]
+                n_real = len(chunk)
+                if n_real < self.batch_size:
+                    chunk = np.concatenate([
+                        chunk,
+                        np.full(self.batch_size - n_real, chunk[-1],
+                                np.uint64)])
+                batch = self._node_batch(chunk, flow, stream=1)
+                mask = np.zeros(self.batch_size, np.float32)
+                mask[:n_real] = 1.0
+                batch["metric_mask"] = mask
+                yield batch
+
+        return gen()
 
     def infer_input_fn(self):
         """Deterministic sweep over all nodes (padded final batch)."""
@@ -109,7 +166,6 @@ class NodeEstimator(BaseEstimator):
             ids = ids[self.graph.get_node_type(ids) == self.infer_node_type]
 
         def gen():
-            store = self.feature_store
             for i in range(0, len(ids), self.batch_size):
                 chunk = ids[i:i + self.batch_size]
                 if len(chunk) < self.batch_size:
@@ -117,21 +173,10 @@ class NodeEstimator(BaseEstimator):
                         chunk,
                         np.full(self.batch_size - len(chunk), chunk[-1],
                                 np.uint64)])
-                if self.device_sampler is not None:
-                    yield self._sampler_batch(chunk)
-                    continue
-                batch = self.eval_dataflow(chunk)
-                if store is not None:
-                    batch = {"rows": [store.lookup(j) for j in batch["ids"]],
-                             "infer_ids": chunk}
-                    if store.labels is not None:
-                        yield batch
-                        continue
-                batch["labels"] = self.graph.get_dense_feature(
-                    chunk, self.label_fid,
-                    self.label_dim if self.label_dim else None)
-                batch["infer_ids"] = chunk
-                yield batch
+                # stream 1: inference must not advance the train seed
+                # counter (mid-training infer would shift all subsequent
+                # training draws)
+                yield self._node_batch(chunk, self.eval_dataflow, stream=1)
 
         return gen()
 

@@ -71,15 +71,24 @@ class DeviceSampledGraphSage(SuperviseModel):
     encoder: str = "sage"
 
     def embed(self, batch: Dict[str, Any]) -> Array:
-        from euler_tpu.parallel.device_sampler import sample_fanout_rows
+        from euler_tpu.parallel.device_sampler import (
+            make_table_gather, sample_fanout_rows,
+        )
         from euler_tpu.utils.encoders import GCNEncoder
 
         roots = batch["rows"][0]
         key = jax.random.fold_in(jax.random.key(17), batch["sample_seed"])
+        # table_mesh set → tables are row-sharded over 'model' and every
+        # read goes through the masked-take + psum gather; None → the
+        # replicated local-take fast path
+        gather = make_table_gather(self.table_mesh)
+        sharded = self.table_mesh is not None and dict(
+            self.table_mesh.shape).get("model", 1) > 1
         rows = sample_fanout_rows(batch["nbr_table"], batch["cum_table"],
-                                  roots, tuple(self.fanouts), key)
+                                  roots, tuple(self.fanouts), key,
+                                  gather=gather if sharded else None)
         table = batch["feature_table"]
-        layers = [jax.numpy.take(table, r, axis=0) for r in rows]
+        layers = [gather(table, r) for r in rows]
         if self.encoder == "gcn":
             return GCNEncoder(self.dim, tuple(self.fanouts),
                               name="encoder")(layers)

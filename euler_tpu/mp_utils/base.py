@@ -42,9 +42,17 @@ class SuperviseModel(nn.Module):
     # sets, e.g. examples/gat/gat.py): active only when the estimator
     # provides a "dropout" rng, i.e. during training steps
     dropout: float = 0.0
+    # mesh whose 'model' axis row-shards the HBM tables (feature/label/
+    # neighbor) — None means replicated tables, plain local gathers
+    table_mesh: Any = None
 
     def embed(self, batch: Dict[str, Any]) -> Array:
         raise NotImplementedError
+
+    def table_gather(self):
+        from euler_tpu.parallel.device_sampler import make_table_gather
+
+        return make_table_gather(self.table_mesh)
 
     @nn.compact
     def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
@@ -56,26 +64,34 @@ class SuperviseModel(nn.Module):
         if labels is None:
             # device-resident label table (DeviceFeatureStore): gather the
             # root rows in-jit instead of shipping labels from the host
-            labels = jnp.take(batch["label_table"], batch["rows"][0],
-                              axis=0)
+            labels = self.table_gather()(batch["label_table"],
+                                         batch["rows"][0])
         logits = nn.Dense(self.num_classes, name="out")(emb)
+        # optional [B] 0/1 metric_mask: padded rows (deterministic eval
+        # sweeps pad the final chunk to the static batch shape) drop out
+        # of both the loss mean and the metric counts
+        mask = batch.get("metric_mask")
+
+        def wmean(per_row):
+            return M.masked_mean(per_row, mask)
+
         if self.multilabel:
-            loss = optax.sigmoid_binary_cross_entropy(
-                logits, labels.astype(jnp.float32)).sum(-1).mean()
-            metric = M.micro_f1(jax.nn.sigmoid(logits), labels)
+            loss = wmean(optax.sigmoid_binary_cross_entropy(
+                logits, labels.astype(jnp.float32)).sum(-1))
+            metric = M.micro_f1(jax.nn.sigmoid(logits), labels, mask=mask)
             name = "f1"
         else:
             # labels arrive either as integer classes [B] or one-hot [B, C]
             # (dense label features are stored one-hot)
             if labels.ndim == logits.ndim:
-                loss = optax.softmax_cross_entropy(
-                    logits, labels.astype(jnp.float32)).mean()
+                loss = wmean(optax.softmax_cross_entropy(
+                    logits, labels.astype(jnp.float32)))
                 int_labels = jnp.argmax(labels, axis=-1)
             else:
                 int_labels = labels.astype(jnp.int32)
-                loss = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, int_labels).mean()
-            metric = M.micro_f1(logits, int_labels)
+                loss = wmean(optax.softmax_cross_entropy_with_integer_labels(
+                    logits, int_labels))
+            metric = M.micro_f1(logits, int_labels, mask=mask)
             name = "f1"
         return ModelOutput(emb, loss, name, metric)
 

@@ -12,8 +12,13 @@ from euler_tpu.parallel.sharded_embedding import (  # noqa: F401
 )
 from euler_tpu.parallel.device_sampler import (  # noqa: F401
     DeviceNeighborTable,
+    make_table_gather,
     sample_fanout_rows,
     sample_hop,
+)
+from euler_tpu.parallel.placement import (  # noqa: F401
+    put_replicated,
+    put_row_sharded,
 )
 from euler_tpu.parallel.feature_store import DeviceFeatureStore  # noqa: F401
 from euler_tpu.parallel.ring_exchange import ring_lookup  # noqa: F401

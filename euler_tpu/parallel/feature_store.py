@@ -39,7 +39,9 @@ class DeviceFeatureStore:
     def __init__(self, graph, feature_ids: Sequence, label_fid=None,
                  label_dim: Optional[int] = None,
                  dtype=jnp.float32,
-                 mesh: Optional[jax.sharding.Mesh] = None):
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 keep_host: bool = False, shard_rows: bool = False):
+        self.shard_rows = bool(shard_rows)
         # table rows follow ENGINE row order so lookup() is the engine's
         # O(1) hash translation (etg_node_rows), not a binary search
         ids = graph.all_node_ids()
@@ -55,16 +57,56 @@ class DeviceFeatureStore:
         feats = np.concatenate(
             [feats, np.zeros((1, feats.shape[1]), feats.dtype)])
         feats = feats.astype(np.dtype(dtype), copy=False)
-        from euler_tpu.parallel.placement import put_replicated
+        from euler_tpu.parallel.placement import (
+            put_replicated, put_row_sharded,
+        )
 
-        self.features = put_replicated(feats, mesh)
+        put = (lambda x: put_row_sharded(x, mesh)) if shard_rows else \
+            (lambda x: put_replicated(x, mesh))
+        self.features = put(feats)
         self.labels = None
+        labels = None
         if label_fid is not None:
             labels = graph.get_dense_feature(ids, label_fid, label_dim)
             labels = np.concatenate(
                 [labels, np.zeros((1, labels.shape[1]), labels.dtype)])
-            self.labels = put_replicated(
-                labels.astype(np.float32, copy=False), mesh)
+            labels = labels.astype(np.float32, copy=False)
+            self.labels = put(labels)
+        # host copies are opt-in (cache writers like bench): pinning them
+        # by default would double host RAM for every training caller
+        self.host_arrays = (feats, labels) if keep_host else None
+
+    @classmethod
+    def from_arrays(cls, features: np.ndarray,
+                    labels: Optional[np.ndarray] = None,
+                    ids: Optional[np.ndarray] = None,
+                    mesh: Optional[jax.sharding.Mesh] = None,
+                    shard_rows: bool = False):
+        """Rehydrate from prebuilt arrays (a cache) without a graph
+        engine. `features`/`labels` must already carry the trailing pad
+        row; `ids` (sorted u64, len N) backs lookup() via searchsorted —
+        when omitted, node ids are taken to BE table rows (dense-id
+        graphs, e.g. the bench cache)."""
+        self = cls.__new__(cls)
+        self._graph = None
+        self.host_arrays = None
+        self.pad_row = int(features.shape[0]) - 1
+        self.ids = ids if ids is not None else np.arange(
+            self.pad_row, dtype=np.uint64)
+        self._sorted_ids = ids is not None
+        self.shard_rows = bool(shard_rows)
+        from euler_tpu.parallel.placement import (
+            put_replicated, put_row_sharded,
+        )
+
+        put = (lambda x: put_row_sharded(x, mesh)) if shard_rows else \
+            (lambda x: put_replicated(x, mesh))
+        self.features = put(np.ascontiguousarray(features))
+        self.labels = None
+        if labels is not None:
+            self.labels = put(
+                np.ascontiguousarray(labels.astype(np.float32, copy=False)))
+        return self
 
     @property
     def dim(self) -> int:
@@ -73,4 +115,14 @@ class DeviceFeatureStore:
     def lookup(self, ids) -> np.ndarray:
         """u64 node ids → int32 rows into the device tables. Unknown ids
         (including default_id=0 sampling pads) map to the zero pad row."""
-        return self._graph.node_rows(ids, missing=self.pad_row)
+        if self._graph is not None:
+            return self._graph.node_rows(ids, missing=self.pad_row)
+        ids = np.asarray(ids, np.uint64).ravel()
+        if not self._sorted_ids:
+            rows = ids.astype(np.int64)
+            return np.where(rows < self.pad_row, rows,
+                            self.pad_row).astype(np.int32)
+        pos = np.searchsorted(self.ids, ids)
+        pos = np.minimum(pos, len(self.ids) - 1)
+        hit = self.ids[pos] == ids
+        return np.where(hit, pos, self.pad_row).astype(np.int32)

@@ -1,7 +1,18 @@
 """Shared device placement for HBM-resident input tables
-(DeviceFeatureStore, DeviceNeighborTable): replicated across the mesh so
-per-step gathers stay local — no collective per step. One helper so the
-two table classes cannot diverge in placement policy."""
+(DeviceFeatureStore, DeviceNeighborTable).
+
+Two policies, one helper module so the table classes cannot diverge:
+
+- put_replicated: every chip holds the full table; per-step gathers stay
+  local, no collective per step. Right when the graph fits one chip's
+  HBM — the single-chip bench configuration.
+- put_row_sharded: rows split over the mesh's 'model' axis (the
+  reference's PS-sharded embedding capability, tf_euler/python/utils/
+  layers.py:119-171): per-chip memory shrinks ~linearly with the model
+  axis, and gathers become a masked local take + psum over 'model'
+  (device_sampler.make_table_gather). Right when the graph outgrows one
+  chip.
+"""
 
 from __future__ import annotations
 
@@ -18,3 +29,22 @@ def put_replicated(x: np.ndarray,
 
         return jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
     return jax.device_put(x)
+
+
+def put_row_sharded(x: np.ndarray, mesh: Optional[jax.sharding.Mesh],
+                    axis: str = "model") -> jax.Array:
+    """Rows over `axis`; falls back to replication when the mesh has no
+    (or a trivial) model axis. Rows are zero-padded up to a multiple of
+    the axis size — the pad rows sit PAST the table's own trailing
+    pad_row, so no live index ever reaches them."""
+    if mesh is None or dict(mesh.shape).get(axis, 1) <= 1:
+        return put_replicated(x, mesh)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mp = dict(mesh.shape)[axis]
+    pad = (-x.shape[0]) % mp
+    if pad:
+        x = np.concatenate(
+            [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+    spec = PartitionSpec(axis, *([None] * (x.ndim - 1)))
+    return jax.device_put(x, NamedSharding(mesh, spec))

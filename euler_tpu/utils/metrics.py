@@ -13,17 +13,28 @@ import jax.numpy as jnp
 Array = jax.Array
 
 __all__ = ["accuracy", "auc", "f1_score", "micro_f1", "mrr", "mr", "hit_at_k",
-           "get_metric"]
+           "masked_mean", "get_metric"]
 
 
-def accuracy(logits: Array, labels: Array) -> Array:
-    """Multiclass (argmax over last dim) or binary (threshold 0.5)."""
+def masked_mean(x: Array, mask) -> Array:
+    """Mean of x over rows where mask (0/1, any shape raveling to [B]) is
+    set; plain mean when mask is None."""
+    if mask is None:
+        return jnp.mean(x)
+    m = mask.ravel().astype(jnp.float32)
+    return jnp.sum(x * m) / jnp.maximum(m.sum(), 1.0)
+
+
+def accuracy(logits: Array, labels: Array, mask=None) -> Array:
+    """Multiclass (argmax over last dim) or binary (threshold 0.5).
+    mask [B] (0/1) excludes padded rows from the mean."""
     if logits.ndim > 1 and logits.shape[-1] > 1:
         pred = jnp.argmax(logits, axis=-1)
         lab = labels if labels.ndim == logits.ndim - 1 else jnp.argmax(labels, -1)
-        return jnp.mean((pred == lab).astype(jnp.float32))
+        return masked_mean((pred == lab).astype(jnp.float32), mask)
     pred = (logits.ravel() > 0.5).astype(jnp.int32)
-    return jnp.mean((pred == labels.ravel().astype(jnp.int32)).astype(jnp.float32))
+    return masked_mean((pred == labels.ravel().astype(jnp.int32)).astype(
+        jnp.float32), mask)
 
 
 def auc(scores: Array, labels: Array) -> Array:
@@ -41,14 +52,21 @@ def auc(scores: Array, labels: Array) -> Array:
         n_pos * n_neg, 1.0)
 
 
-def micro_f1(logits: Array, labels: Array, threshold: float = 0.5) -> Array:
-    """Micro-averaged F1 for multilabel (sigmoid) or one-hot multiclass."""
+def micro_f1(logits: Array, labels: Array, threshold: float = 0.5,
+             mask=None) -> Array:
+    """Micro-averaged F1 for multilabel (sigmoid) or one-hot multiclass.
+    mask [B] (0/1) drops padded rows from every tp/fp/fn count."""
     if logits.ndim > 1 and labels.ndim == 1:
         pred = jax.nn.one_hot(jnp.argmax(logits, -1), logits.shape[-1])
         lab = jax.nn.one_hot(labels, logits.shape[-1])
     else:
         pred = (logits > threshold).astype(jnp.float32)
         lab = labels.astype(jnp.float32)
+    if mask is not None:
+        m = mask.astype(jnp.float32).reshape(
+            mask.shape + (1,) * (pred.ndim - mask.ndim))
+        pred = pred * m
+        lab = lab * m
     tp = (pred * lab).sum()
     fp = (pred * (1 - lab)).sum()
     fn = ((1 - pred) * lab).sum()

@@ -186,3 +186,56 @@ def test_prefetcher():
     assert next(it2) == 1
     with pytest.raises(RuntimeError):
         next(it2)
+
+
+def test_eval_sweep_exact_and_masked(tiny_data):
+    """eval_sweep_input_fn: every split node exactly once; the padded
+    tail is masked out of the metric, so the sweep metric equals a
+    hand-computed full-split micro-F1."""
+    import jax
+
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.mp_utils import BaseGNNNet, SuperviseModel
+    from euler_tpu.utils import metrics as M
+
+    g = tiny_data.engine
+
+    class ConvModel(SuperviseModel):
+        dim: int = 8
+
+        def embed(self, batch):
+            return BaseGNNNet("gcn", self.dim, 2, name="gnn")(batch)
+
+    model = ConvModel(num_classes=3, multilabel=False)
+    flow = FullBatchDataFlow(g, feature_ids=["feature"])
+    # batch 16 does NOT divide the 20-node val split → forces a padded
+    # final chunk (the advisor-r2 double-count scenario)
+    est = NodeEstimator(
+        model, dict(batch_size=16, learning_rate=0.05, label_dim=3,
+                    log_steps=1 << 30, checkpoint_steps=0),
+        g, flow, label_fid="label", label_dim=3)
+    est.train(est.train_input_fn(), max_steps=3)
+
+    val_ids = est.split_ids(1)
+    assert len(val_ids) == 20
+    assert est.eval_sweep_steps() == 2  # ceil(20 / 16)
+    # batches carry each id exactly once (pads excluded by the mask)
+    seen = []
+    masks = []
+    for b in est.eval_sweep_input_fn():
+        seen.append(np.asarray(b["infer_ids"])[b["metric_mask"] > 0])
+        masks.append(b["metric_mask"].sum())
+    assert masks == [16.0, 4.0]
+    np.testing.assert_array_equal(np.sort(np.concatenate(seen)),
+                                  np.sort(val_ids))
+
+    res = est.evaluate(est.eval_sweep_input_fn, est.eval_sweep_steps())
+    # hand-computed exact F1 over the val split at the same params
+    batch = flow(val_ids)
+    batch["labels"] = g.get_dense_feature(val_ids, "label", 3)
+    variables = {"params": est.state.params, **(est.state.extra_vars or {})}
+    out = est.model.apply(variables, {
+        k: v for k, v in batch.items()})
+    # recompute logits directly: embed + out layer is inside the model,
+    # so compare via a full-split single batch with no padding instead
+    np.testing.assert_allclose(res["metric"], float(out.metric), atol=1e-5)

@@ -1,0 +1,45 @@
+"""One-off probe: time each stage of a products-scale bench setup on this
+host (1 core).  Not a test; used to size bench.py defaults."""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2_400_000
+DEG = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+
+t0 = time.time()
+from euler_tpu.dataset.base_dataset import synthetic_citation  # noqa: E402
+
+data = synthetic_citation(
+    "probe", n=N, d=100, num_classes=16,
+    intra_degree=DEG * 0.75, inter_degree=DEG * 0.25,
+    signal=1.0, seed=0, train_per_class=max(20, N // 160),
+    val=N // 20, test=N // 10)
+t1 = time.time()
+print(f"synthetic+engine build: {t1-t0:.1f}s", flush=True)
+g = data.engine
+print(f"nodes={g.node_count} edges={g.edge_count}", flush=True)
+
+from euler_tpu.parallel import DeviceNeighborTable  # noqa: E402
+
+t2 = time.time()
+tab = DeviceNeighborTable(g, cap=32)
+t3 = time.time()
+print(f"DeviceNeighborTable: {t3-t2:.1f}s hub_frac={tab.hub_frac:.3f} "
+      f"edge_keep_frac={tab.edge_keep_frac:.3f} max_deg={tab.max_degree}",
+      flush=True)
+
+from euler_tpu.parallel import DeviceFeatureStore  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+t4 = time.time()
+store = DeviceFeatureStore(g, ["feature"], label_fid="label", label_dim=16,
+                           dtype=jnp.bfloat16)
+t5 = time.time()
+print(f"DeviceFeatureStore: {t5-t4:.1f}s", flush=True)
+print(f"TOTAL: {t5-t0:.1f}s", flush=True)
