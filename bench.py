@@ -90,8 +90,11 @@ def build_products_like(n_nodes: int, avg_degree: int, feat_dim: int,
 
 class _CachedGraph:
     """Minimal engine facade over the bench table cache: dense ids
-    (row == id), uniform unit node weights — exactly the bench graph's
-    statistics, so sample_node matches the real engine's draw."""
+    (row == id), uniform unit node weights — so sample_node(-1) matches
+    the real engine's draw. The cache does not carry per-node types, so
+    a typed draw (node_type >= 0) would silently change the measured
+    workload between cache states — refuse it instead (the bench always
+    trains with train_node_type=-1)."""
 
     def __init__(self, n_nodes: int, edge_count: int, seed: int = 17):
         self.node_count = int(n_nodes)
@@ -99,6 +102,10 @@ class _CachedGraph:
         self._rng = np.random.default_rng(seed)
 
     def sample_node(self, count: int, node_type: int = -1) -> np.ndarray:
+        if node_type >= 0:
+            raise ValueError(
+                "_CachedGraph has no node types; run with "
+                "train_node_type=-1 or --no_cache")
         return self._rng.integers(
             0, self.node_count, count).astype(np.uint64)
 
@@ -159,6 +166,100 @@ def setup_tables(args, n_nodes, avg_degree, feat_dim, num_classes,
     return graph, store, sampler, "miss"
 
 
+def run_walk_bench(args, graph, sampler, cache_state, setup_secs,
+                   n_nodes, batch, steps, spl, cpu_fallback):
+    """--walk mode: DeepWalk skip-gram throughput, device-sampled
+    (walks + pairs + negatives in-jit, DeviceSampledSkipGram) vs
+    --host_sampler (engine random_walk + host gen_pair + host negatives
+    — the reference random_walk_op.cc topology)."""
+    import jax
+
+    from euler_tpu.estimator import BaseEstimator
+    from euler_tpu.estimator.base_estimator import _to_device_tree
+    from euler_tpu.estimator.prefetch import Prefetcher
+    from euler_tpu.models import DeepWalk, DeviceSampledSkipGram
+
+    walk_len, lwin, rwin, num_negs = 5, 1, 1, 5
+    if sampler is not None:
+        model = DeviceSampledSkipGram(
+            num_rows=sampler.pad_row, dim=128, walk_len=walk_len,
+            left_win=lwin, right_win=rwin, num_negs=num_negs)
+        est = BaseEstimator(model, dict(
+            learning_rate=0.01, log_steps=1 << 30, checkpoint_steps=0,
+            steps_per_loop=spl))
+        # bench graph node weights are uniform 1.0 → the device negative
+        # sampler is a dense pool with a unit-weight cumsum
+        import jax.numpy as jnp
+        est.static_batch.update({
+            **sampler.tables,
+            "neg_rows": jax.device_put(
+                np.arange(n_nodes, dtype=np.int32)),
+            "neg_cum": jax.device_put(
+                np.arange(1, n_nodes + 1, dtype=np.float32)),
+        })
+        seed_box = [0]
+
+        def gen():
+            while True:
+                roots = graph.sample_node(batch, -1).astype(np.int64)
+                seed_box[0] += 1
+                yield {"rows": [roots.astype(np.int32)],
+                       "sample_seed": np.uint32(seed_box[0])}
+    else:
+        from euler_tpu.ops.walk_ops import gen_pair
+
+        model = DeepWalk(max_id=n_nodes - 1, dim=128)
+        est = BaseEstimator(model, dict(
+            learning_rate=0.01, log_steps=1 << 30, checkpoint_steps=0,
+            max_id=n_nodes - 1, steps_per_loop=spl))
+
+        def gen():
+            while True:
+                roots = graph.sample_node(batch, -1)
+                walks = graph.random_walk(roots, walk_len)
+                pairs = gen_pair(walks, lwin, rwin)
+                flat = pairs.reshape(-1, 2)
+                negs = graph.sample_node(
+                    flat.shape[0] * num_negs, -1).reshape(-1, num_negs)
+                yield {"src": flat[:, 0], "pos": flat[:, 1], "negs": negs}
+
+    def to_dev(b):
+        return jax.device_put(_to_device_tree(b, est.max_id))
+
+    it = Prefetcher(gen(), depth=3, transform=to_dev)
+    warmup = spl + 2 if spl > 1 else 3
+    est.train(iter([next(it) for _ in range(warmup)]), max_steps=warmup)
+    t0 = time.time()
+    res = est.train(it, max_steps=warmup + steps)
+    dt = time.time() - t0
+    done = res["global_step"] - warmup
+    n_pairs = len([1 for i in range(walk_len + 1)
+                   for off in (-1, 1) if 0 <= i + off <= walk_len])
+    pairs_per_sec = done * batch * n_pairs / dt
+    value = pairs_per_sec / max(jax.device_count(), 1)
+    return {
+        "metric": "deepwalk_train_pairs_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "pairs/s/chip",
+        "vs_baseline": round(value / 1_000_000, 4),
+        "detail": {
+            "backend": jax.default_backend(),
+            "nodes": n_nodes,
+            "graph_edges": int(graph.edge_count),
+            "batch_size": batch,
+            "walk_len": walk_len,
+            "num_negs": num_negs,
+            "steps": done,
+            "steps_per_sec": round(done / dt, 2),
+            "sampler": "host" if sampler is None else "device",
+            "steps_per_loop": spl,
+            "graph_cache": cache_state,
+            "setup_secs": round(setup_secs, 1),
+            "cpu_fallback": cpu_fallback,
+        },
+    }
+
+
 def run_bench(args):
     import jax
 
@@ -210,6 +311,12 @@ def run_bench(args):
         use_cache=not (args.no_cache or args.smoke or cpu_fallback
                        or args.host_sampler))
     setup_secs = time.time() - setup_t0
+    spl_walk = args.steps_per_loop or (1 if (args.smoke or cpu_fallback)
+                                       else 8)
+    if args.walk:
+        return run_walk_bench(args, graph, sampler, cache_state,
+                              setup_secs, n_nodes, batch, steps, spl_walk,
+                              cpu_fallback)
     if sampler is None:
         model = SupervisedGraphSage(
             num_classes=num_classes, multilabel=False, dim=128,
@@ -327,6 +434,10 @@ def main(argv=None):
                          "lax.scan window per device dispatch")
     ap.add_argument("--fp32", action="store_true", default=False,
                     help="keep float32 features in the full bench")
+    ap.add_argument("--walk", action="store_true", default=False,
+                    help="DeepWalk skip-gram throughput instead of "
+                         "GraphSAGE (pairs/s; combine with "
+                         "--host_sampler for the host-walk topology)")
     ap.add_argument("--platform", default="",
                     choices=["", "auto", "tpu", "cpu"],
                     help="default: cpu for --smoke, auto otherwise")
@@ -362,7 +473,7 @@ def main(argv=None):
                           and not args.batch_size and not args.fanouts
                           and not args.steps and not args.feat_dim
                           and args.cap == 32 and not args.steps_per_loop
-                          and not args.avg_degree
+                          and not args.avg_degree and not args.walk
                           and not args.host_sampler and not args.fp32)
         if result.get("detail", {}).get("backend") == "tpu" \
                 and default_shapes:

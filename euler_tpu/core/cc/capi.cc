@@ -312,6 +312,17 @@ int etg_node_rows(int64_t h, const uint64_t* ids, int64_t n, int32_t missing,
   return 0;
 }
 
+int etg_all_node_weights(int64_t h, float* out) {
+  // engine-row order (matches etg_all_node_ids) — backs device-resident
+  // weighted global sampling (DeviceNodeSampler)
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  for (size_t i = 0; i < g->node_count(); ++i) {
+    out[i] = g->node_weight(static_cast<uint32_t>(i));
+  }
+  return 0;
+}
+
 int etg_node_weight_sums(int64_t h, float* out) {
   auto g = GetGraph(h);
   if (!g) return Fail("bad graph handle");

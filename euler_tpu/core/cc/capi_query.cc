@@ -6,6 +6,7 @@
 // euler/service/python_api.cc (StartService) — restructured as
 // handle-based objects so one process can host several proxies/servers
 // (e.g. fork-free multi-shard tests).
+#include <cstring>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -33,6 +34,7 @@ struct QueryRegistry {
   std::unordered_map<int64_t, std::shared_ptr<et::GraphServer>> servers;
   // servers keep their graph alive
   std::unordered_map<int64_t, std::shared_ptr<const et::Graph>> server_graphs;
+  std::unordered_map<int64_t, std::shared_ptr<et::RegistryServer>> registries;
 };
 
 QueryRegistry& QReg() {
@@ -308,6 +310,69 @@ int ets_stop(int64_t h) {
   }
   if (server) server->Stop();
   return 0;
+}
+
+// ---- registry server (ZK-role discovery without a shared FS) ----
+int64_t etr_start(int port) {
+  auto reg = std::make_shared<et::RegistryServer>();
+  et::Status s = reg->Start(port);
+  if (!s.ok()) {
+    FailWith(s.message());
+    return 0;
+  }
+  auto& r = QReg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  int64_t h = r.next++;
+  r.registries[h] = reg;
+  return h;
+}
+
+int etr_port(int64_t h) {
+  auto& r = QReg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.registries.find(h);
+  return it == r.registries.end() ? -1 : it->second->port();
+}
+
+int etr_stop(int64_t h) {
+  std::shared_ptr<et::RegistryServer> reg;
+  {
+    auto& r = QReg();
+    std::lock_guard<std::mutex> lk(r.mu);
+    auto it = r.registries.find(h);
+    if (it != r.registries.end()) {
+      reg = it->second;
+      r.registries.erase(it);
+    }
+  }
+  if (reg) reg->Stop();
+  return 0;
+}
+
+// List a registry's shard entries as "idx,host,port,age_ms\n" lines
+// (spec = dir path, "dir:...", or "tcp:host:port"). Returns the needed
+// byte length (truncates to buf_len), or -1 on scan failure — lets
+// launchers poll until every expected shard has registered.
+int64_t etr_scan(const char* spec, char* buf, int64_t buf_len) {
+  std::map<int, std::pair<std::string, int>> found;
+  std::map<int, int64_t> ages;
+  et::Status s = et::ScanRegistrySpec(spec ? spec : "", &found, &ages);
+  if (!s.ok()) {
+    FailWith(s.message());
+    return -1;
+  }
+  std::string out;
+  for (const auto& kv : found) {
+    out += std::to_string(kv.first) + "," + kv.second.first + "," +
+           std::to_string(kv.second.second) + "," +
+           std::to_string(ages[kv.first]) + "\n";
+  }
+  if (buf != nullptr && buf_len > 0) {
+    int64_t n = std::min<int64_t>(buf_len - 1, out.size());
+    std::memcpy(buf, out.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int64_t>(out.size());
 }
 
 // ---- compiler debug (golden structure tests) ----

@@ -23,6 +23,7 @@
 #include "graph.h"
 #include "index.h"
 #include "io.h"
+#include "kernels_common.h"
 #include "sampling.h"
 #include "serde.h"
 #include "tensor.h"
@@ -287,6 +288,23 @@ void TestDumpLoadRoundtrip() {
   CHECK_TRUE(back->edge_count() == 10);
 }
 
+// Ragged offsets travel as i32 [n,2]; every merge producer range-checks
+// its final cursor (advisor r1: >2^31-element merges would silently
+// wrap). Exercise the guard on both sides of the boundary — allocating
+// a real >2GB payload in a unit test is not viable, and every producer
+// funnels through this one check.
+void TestI32OffsetGuard() {
+  NodeDef node;
+  node.name = "GP_RAGGED_MERGE_test";
+  CHECK_OK(CheckI32Offsets(node, 0));
+  CHECK_OK(CheckI32Offsets(node, (1LL << 31) - 1));
+  Status s = CheckI32Offsets(node, 1LL << 31);
+  CHECK_TRUE(!s.ok());
+  CHECK_TRUE(s.message().find("int32 offset range") != std::string::npos);
+  CHECK_TRUE(s.message().find(node.name) != std::string::npos);
+  CHECK_TRUE(!CheckI32Offsets(node, (1LL << 40)).ok());
+}
+
 }  // namespace
 }  // namespace et
 
@@ -296,6 +314,7 @@ int main() {
   et::TestAliasSamplerStatistics();
   et::TestParallelForCoversAll();
   et::TestThreadPoolStress();
+  et::TestI32OffsetGuard();
   et::TestGraphStore();
   et::TestConcurrentSampling();
   et::TestTensorSerde();

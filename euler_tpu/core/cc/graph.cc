@@ -1,10 +1,33 @@
 #include "graph.h"
 
+#include <sys/mman.h>
+
 #include <algorithm>
 #include <cstring>
 #include <numeric>
 
 namespace et {
+
+namespace {
+// Giant-store arrays (adjacency, cumw, dense features) are hit with
+// pure random access on the sampling path; 4KB pages make every miss a
+// TLB miss too. Advise transparent hugepages for multi-MB arrays (the
+// kernel honors it under THP=madvise, a no-op elsewhere).
+void AdviseHuge(const void* p, size_t bytes) {
+  constexpr uintptr_t kHuge = 2u << 20;
+  if (bytes < 2 * kHuge) return;
+  uintptr_t a = reinterpret_cast<uintptr_t>(p);
+  uintptr_t lo = (a + kHuge - 1) & ~(kHuge - 1);
+  uintptr_t hi = (a + bytes) & ~(kHuge - 1);
+  if (hi > lo)
+    ::madvise(reinterpret_cast<void*>(lo), hi - lo, MADV_HUGEPAGE);
+}
+
+template <typename V>
+void AdviseHugeVec(const V& v) {
+  AdviseHuge(v.data(), v.size() * sizeof(typename V::value_type));
+}
+}  // namespace
 
 // ---------------------------------------------------------------------------
 // GraphBuilder
@@ -504,6 +527,16 @@ std::unique_ptr<Graph> GraphBuilder::Finalize(bool build_in_adjacency) {
   pack_node(0, true);
   pack_node(0, false);
 
+  // TLB relief for the random-access sampling path on giant stores
+  AdviseHugeVec(g->adj_nbr_);
+  AdviseHugeVec(g->adj_w_);
+  AdviseHugeVec(g->adj_cumw_);
+  AdviseHugeVec(g->adj_offsets_);
+  AdviseHugeVec(g->dense_idx_);
+  AdviseHugeVec(g->in_adj_nbr_);
+  AdviseHugeVec(g->in_adj_cumw_);
+  for (auto& d : g->node_dense_) AdviseHugeVec(d);
+
   return g;
 }
 
@@ -692,6 +725,121 @@ void Graph::SampleNeighbor(NodeId id, const int32_t* edge_types,
     out_ids[i] = adj_nbr_[slot];
     if (out_w) out_w[i] = adj_w_[slot];
     if (out_t) out_t[i] = s.types[gsel];
+  }
+}
+
+void Graph::SampleNeighborBatch(const NodeId* ids, size_t n,
+                                const int32_t* edge_types, size_t n_types,
+                                size_t count, NodeId default_id, Pcg32* rng,
+                                NodeId* out_ids, float* out_w,
+                                int32_t* out_t) const {
+  const int ET = meta_.num_edge_types;
+  constexpr size_t D = 16;  // prefetch distance: ~enough in-flight
+                            // misses to cover DRAM latency
+  // candidate edge types for every node (same for all — hoisted)
+  thread_local std::vector<int32_t> all_et;
+  const int32_t* ets = edge_types;
+  size_t n_et = n_types;
+  if (ets == nullptr || n_et == 0) {
+    all_et.resize(ET);
+    for (int t = 0; t < ET; ++t) all_et[t] = t;
+    ets = all_et.data();
+    n_et = static_cast<size_t>(ET);
+  }
+  // staged scratch, reused across calls on this thread
+  struct Scratch {
+    std::vector<uint32_t> idx;
+    std::vector<size_t> gb, ge;     // [n * n_et] group ranges
+    std::vector<float> gtot;        // [n * n_et] group totals
+  };
+  thread_local Scratch s;
+  s.idx.resize(n);
+  s.gb.assign(n * n_et, 0);
+  s.ge.assign(n * n_et, 0);
+  s.gtot.assign(n * n_et, 0.f);
+
+  // pass 1: id → row index (prefetch the dense-id table ahead)
+  for (size_t i = 0; i < n; ++i) {
+    if (i + D < n && !dense_idx_.empty()) {
+      uint64_t off = ids[i + D] - dense_base_;
+      if (off < dense_idx_.size()) __builtin_prefetch(&dense_idx_[off]);
+    }
+    s.idx[i] = NodeIndex(ids[i]);
+  }
+  // pass 2: group ranges (prefetch adj_offsets_ rows ahead)
+  for (size_t i = 0; i < n; ++i) {
+    if (i + D < n && s.idx[i + D] != kInvalidIndex) {
+      __builtin_prefetch(
+          &adj_offsets_[static_cast<size_t>(s.idx[i + D]) * ET]);
+    }
+    if (s.idx[i] == kInvalidIndex) continue;
+    for (size_t t = 0; t < n_et; ++t) {
+      int et = ets[t];
+      if (et < 0 || et >= ET) continue;
+      GroupRange(s.idx[i], et, &s.gb[i * n_et + t], &s.ge[i * n_et + t]);
+    }
+  }
+  // pass 3: group totals (prefetch each group's last cumw ahead)
+  for (size_t i = 0; i < n; ++i) {
+    if (i + D < n) {
+      for (size_t t = 0; t < n_et; ++t) {
+        size_t e = s.ge[(i + D) * n_et + t];
+        if (e > s.gb[(i + D) * n_et + t])
+          __builtin_prefetch(&adj_cumw_[e - 1]);
+      }
+    }
+    for (size_t t = 0; t < n_et; ++t) {
+      size_t b = s.gb[i * n_et + t], e = s.ge[i * n_et + t];
+      if (e > b) s.gtot[i * n_et + t] = adj_cumw_[e - 1];
+    }
+  }
+  // pass 4: draws (prefetch the next nodes' cumw/nbr segments)
+  for (size_t i = 0; i < n; ++i) {
+    if (i + D < n) {
+      for (size_t t = 0; t < n_et; ++t) {
+        size_t b = s.gb[(i + D) * n_et + t], e = s.ge[(i + D) * n_et + t];
+        if (e > b) {
+          __builtin_prefetch(&adj_cumw_[b]);
+          __builtin_prefetch(&adj_cumw_[(b + e) / 2]);
+          __builtin_prefetch(&adj_nbr_[b]);
+          __builtin_prefetch(&adj_nbr_[(b + e) / 2]);
+        }
+      }
+    }
+    float grand = 0.f;
+    for (size_t t = 0; t < n_et; ++t) {
+      float tt = s.gtot[i * n_et + t];
+      if (tt > 0.f) grand += tt;
+    }
+    NodeId* oi = out_ids + i * count;
+    float* ow = out_w ? out_w + i * count : nullptr;
+    int32_t* ot = out_t ? out_t + i * count : nullptr;
+    if (grand <= 0.f) {
+      for (size_t c = 0; c < count; ++c) {
+        oi[c] = default_id;
+        if (ow) ow[c] = 0.f;
+        if (ot) ot[c] = -1;
+      }
+      continue;
+    }
+    for (size_t c = 0; c < count; ++c) {
+      size_t gsel = 0;
+      float run = 0.f;
+      float r = rng->NextFloat() * grand;
+      for (size_t t = 0; t < n_et; ++t) {
+        float tt = s.gtot[i * n_et + t];
+        if (tt <= 0.f) continue;
+        run += tt;
+        gsel = t;
+        if (r < run) break;
+      }
+      size_t slot = SampleFromCumulative(adj_cumw_.data(),
+                                        s.gb[i * n_et + gsel],
+                                        s.ge[i * n_et + gsel], rng);
+      oi[c] = adj_nbr_[slot];
+      if (ow) ow[c] = adj_w_[slot];
+      if (ot) ot[c] = static_cast<int32_t>(ets[gsel]);
+    }
   }
 }
 

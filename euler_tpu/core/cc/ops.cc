@@ -40,19 +40,15 @@ void SampleFanout(const Graph& g, const NodeId* roots, size_t n_roots,
       ParallelFor(GlobalThreadPool(), static_cast<int64_t>(cur_n), 2048,
                   [&](int64_t b, int64_t e, int c) {
                     Pcg32 local(hop_seed, static_cast<uint64_t>(c) * 2 + 1);
-                    for (int64_t i = b; i < e; ++i) {
-                      g.SampleNeighbor(cur[i], et, n_et, k, default_id,
-                                       &local, ids + i * k,
-                                       ws ? ws + i * k : nullptr,
-                                       ts ? ts + i * k : nullptr);
-                    }
+                    g.SampleNeighborBatch(cur + b, static_cast<size_t>(e - b),
+                                          et, n_et, k, default_id, &local,
+                                          ids + b * k,
+                                          ws ? ws + b * k : nullptr,
+                                          ts ? ts + b * k : nullptr);
                   });
     } else {
-      for (size_t i = 0; i < cur_n; ++i) {
-        g.SampleNeighbor(cur[i], et, n_et, k, default_id, rng, ids + i * k,
-                         ws ? ws + i * k : nullptr,
-                         ts ? ts + i * k : nullptr);
-      }
+      g.SampleNeighborBatch(cur, cur_n, et, n_et, k, default_id, rng, ids,
+                            ws, ts);
     }
     cur = ids;
     cur_n = cur_n * k;

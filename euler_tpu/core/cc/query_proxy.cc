@@ -31,15 +31,21 @@ Status QueryProxy::NewRemote(const std::string& endpoints, uint64_t seed,
     return Status::InvalidArgument("remote mode must be distribute or "
                                    "graph_partition, got " + mode);
   ShardEndpoints eps;
-  std::string watch_dir;
+  std::string watch_spec;
   if (endpoints.rfind("hosts:", 0) == 0) {
     ET_RETURN_IF_ERROR(DiscoverFromSpec(endpoints.substr(6), &eps));
   } else if (endpoints.rfind("dir:", 0) == 0) {
-    watch_dir = endpoints.substr(4);
-    ET_RETURN_IF_ERROR(DiscoverFromRegistryAuto(watch_dir, &eps));
+    watch_spec = endpoints.substr(4);
+    ET_RETURN_IF_ERROR(DiscoverFromRegistryAuto(watch_spec, &eps));
+  } else if (endpoints.rfind("tcp:", 0) == 0) {
+    // TCP registry server — cross-machine discovery without a shared
+    // filesystem (the reference's ZK role)
+    watch_spec = endpoints;
+    ET_RETURN_IF_ERROR(DiscoverFromRegistryAuto(watch_spec, &eps));
   } else {
     return Status::InvalidArgument(
-        "endpoints must be 'hosts:h:p,...' or 'dir:/path'");
+        "endpoints must be 'hosts:h:p,...', 'dir:/path', or "
+        "'tcp:host:port' (registry server)");
   }
   auto qp = std::unique_ptr<QueryProxy>(new QueryProxy());
   qp->seed_ = seed;
@@ -47,7 +53,7 @@ Status QueryProxy::NewRemote(const std::string& endpoints, uint64_t seed,
   ET_RETURN_IF_ERROR(qp->client_->Init(eps));
   // registry mode gets live membership: restarted shards are picked up
   // without re-initializing the proxy (ZK watch parity)
-  if (!watch_dir.empty()) qp->client_->WatchRegistry(watch_dir);
+  if (!watch_spec.empty()) qp->client_->WatchRegistry(watch_spec);
   CompileOptions opts;
   opts.mode = mode;
   opts.shard_num = qp->client_->shard_num();

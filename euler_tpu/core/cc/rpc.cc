@@ -1,6 +1,8 @@
 #include "rpc.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
+#include <poll.h>
 #include <dirent.h>
 #include <netdb.h>
 #include <netinet/in.h>
@@ -23,7 +25,14 @@ namespace et {
 namespace {
 constexpr uint32_t kFrameMagic = 0x52465445;  // 'ETFR'
 
-enum MsgType : uint32_t { kExecute = 0, kMeta = 1, kPing = 2 };
+enum MsgType : uint32_t {
+  kExecute = 0,
+  kMeta = 1,
+  kPing = 2,
+  kRegPut = 3,     // body: entry name → registry stores/refreshes it
+  kRegList = 4,    // body: empty → u32 count | per entry: str name, i64 age
+  kRegRemove = 5,  // body: entry name → dropped (clean shutdown)
+};
 
 bool WriteAll(int fd, const char* p, size_t n) {
   while (n > 0) {
@@ -222,7 +231,9 @@ void GraphServer::Stop() {
   }
   hb_cv_.notify_all();
   if (heartbeat_.joinable()) heartbeat_.join();
-  if (!registered_path_.empty()) std::remove(registered_path_.c_str());
+  // clean shutdown unregisters (file unlink or tcp kRegRemove); a crash
+  // skips this and the entry goes stale instead
+  if (!reg_spec_.empty()) RegistryRemoveEntry(reg_spec_, reg_name_);
 }
 
 void GraphServer::ReapFinishedLocked() {
@@ -238,21 +249,21 @@ void GraphServer::ReapFinishedLocked() {
   conns_.resize(kept);
 }
 
-Status GraphServer::Register(const std::string& registry_dir,
+Status GraphServer::Register(const std::string& registry,
                              const std::string& host, int heartbeat_ms) {
   std::ostringstream os;
-  os << registry_dir << "/shard_" << shard_idx_ << "__" << host << "_"
-     << port_;
-  registered_path_ = os.str();
-  ET_RETURN_IF_ERROR(WriteStringToFile(registered_path_, "", 0));
+  os << "shard_" << shard_idx_ << "__" << host << "_" << port_;
+  reg_spec_ = registry;
+  reg_name_ = os.str();
+  ET_RETURN_IF_ERROR(RegistryPutEntry(reg_spec_, reg_name_));
   if (heartbeat_ms > 0 && !heartbeat_.joinable()) {
     heartbeat_ = std::thread([this, heartbeat_ms] {
       std::unique_lock<std::mutex> lk(hb_mu_);
       while (!hb_cv_.wait_for(lk, std::chrono::milliseconds(heartbeat_ms),
                               [this] { return stopping_.load(); })) {
-        // re-touch: monitors treat a fresh mtime as "alive" (ephemeral
-        // ZK-node semantics on plain files)
-        WriteStringToFile(registered_path_, "", 0);
+        // re-put: monitors treat a fresh entry as "alive" (ephemeral
+        // ZK-node semantics — file mtime or registry-server timestamp)
+        RegistryPutEntry(reg_spec_, reg_name_);
       }
     });
   }
@@ -375,7 +386,33 @@ int RpcChannel::Connect() {
   for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
     fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
     if (fd < 0) continue;
-    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    if (timeout_ms_ > 0) {
+      // bounded connect: a black-holed host would otherwise block the
+      // kernel SYN-retry timeout (~2 min) — registry heartbeat/shutdown
+      // paths cap this (see set_timeout_ms callers)
+      int flags = ::fcntl(fd, F_GETFL, 0);
+      ::fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+      int rc = ::connect(fd, ai->ai_addr, ai->ai_addrlen);
+      if (rc != 0 && errno == EINPROGRESS) {
+        pollfd pf{fd, POLLOUT, 0};
+        rc = ::poll(&pf, 1, timeout_ms_) == 1 ? 0 : -1;
+        if (rc == 0) {
+          int err = 0;
+          socklen_t len = sizeof(err);
+          ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+          if (err != 0) rc = -1;
+        }
+      }
+      ::fcntl(fd, F_SETFL, flags);
+      if (rc == 0) {
+        timeval tv{timeout_ms_ / 1000, (timeout_ms_ % 1000) * 1000};
+        ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+        ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+        break;
+      }
+    } else if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+      break;
+    }
     ::close(fd);
     fd = -1;
   }
@@ -405,8 +442,9 @@ void RpcChannel::Release(int fd) {
 }
 
 Status RpcChannel::Call(uint32_t msg_type, const std::vector<char>& body,
-                        std::vector<char>* reply_body) {
-  for (int attempt = 0; attempt < kRetryCount; ++attempt) {
+                        std::vector<char>* reply_body, int max_retries) {
+  if (max_retries <= 0) max_retries = kRetryCount;
+  for (int attempt = 0; attempt < max_retries; ++attempt) {
     int fd = Acquire();
     if (fd < 0) {
       ::usleep(1000 * (1 << std::min(attempt, 6)));
@@ -425,54 +463,261 @@ Status RpcChannel::Call(uint32_t msg_type, const std::vector<char>& body,
 }
 
 // ---------------------------------------------------------------------------
-// Discovery
+// Registry server (TCP) + spec-aware registry access
+// ---------------------------------------------------------------------------
+RegistryServer::~RegistryServer() { Stop(); }
+
+Status RegistryServer::Start(int port) {
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return Status::IOError("socket() failed");
+  int one = 1;
+  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+      0)
+    return Status::IOError("registry bind() failed on port " +
+                           std::to_string(port));
+  if (::listen(listen_fd_, 64) != 0)
+    return Status::IOError("registry listen() failed");
+  socklen_t len = sizeof(addr);
+  ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+  port_ = ntohs(addr.sin_port);
+  acceptor_ = std::thread([this] { AcceptLoop(); });
+  ET_LOG(INFO) << "registry server on port " << port_;
+  return Status::OK();
+}
+
+void RegistryServer::Stop() {
+  if (stopping_.exchange(true)) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+  }
+  if (acceptor_.joinable()) acceptor_.join();
+  std::vector<std::thread> to_join;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (size_t i = 0; i < conn_fds_.size(); ++i) {
+      // finished conns already closed their fd — the number may have
+      // been recycled by an unrelated descriptor
+      if (!done_[i]->load()) ::shutdown(conn_fds_[i], SHUT_RDWR);
+    }
+    to_join = std::move(conns_);
+    conns_.clear();
+    done_.clear();
+  }
+  for (auto& t : to_join)
+    if (t.joinable()) t.join();
+  std::lock_guard<std::mutex> lk(mu_);
+  conn_fds_.clear();
+}
+
+void RegistryServer::AcceptLoop() {
+  for (;;) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (stopping_.load()) return;
+      // EMFILE/ECONNABORTED etc: back off instead of pinning a core
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+      continue;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::lock_guard<std::mutex> lk(mu_);
+    // reap exited connections — heartbeats/polls open one short-lived
+    // connection each, so without this the thread/fd lists grow without
+    // bound and Stop() would shutdown() long-recycled fd numbers
+    for (size_t i = 0; i < conns_.size();) {
+      if (done_[i]->load()) {
+        conns_[i].join();
+        conns_.erase(conns_.begin() + i);
+        done_.erase(done_.begin() + i);
+        conn_fds_.erase(conn_fds_.begin() + i);
+      } else {
+        ++i;
+      }
+    }
+    conn_fds_.push_back(fd);
+    done_.push_back(std::make_shared<std::atomic<bool>>(false));
+    auto flag = done_.back();
+    conns_.emplace_back([this, fd, flag] {
+      HandleConnection(fd);
+      flag->store(true);  // before close: Stop() skips done fds, so a
+      ::close(fd);        // recycled fd number can't be shutdown() here
+    });
+  }
+}
+
+void RegistryServer::HandleConnection(int fd) {
+  auto now_ms = [] {
+    return std::chrono::duration_cast<std::chrono::milliseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  };
+  std::vector<char> body;
+  uint32_t msg_type;
+  while (!stopping_.load() && ReadFrame(fd, &msg_type, &body)) {
+    ByteWriter w;
+    if (msg_type == kRegPut) {
+      std::string name(body.data(), body.size());
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        entries_[name] = now_ms();
+      }
+      w.Put<int32_t>(0);
+    } else if (msg_type == kRegRemove) {
+      std::string name(body.data(), body.size());
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        entries_.erase(name);
+      }
+      w.Put<int32_t>(0);
+    } else if (msg_type == kRegList) {
+      std::lock_guard<std::mutex> lk(mu_);
+      w.Put<uint32_t>(static_cast<uint32_t>(entries_.size()));
+      int64_t now = now_ms();
+      for (const auto& kv : entries_) {
+        w.PutStr(kv.first);
+        w.Put<int64_t>(now - kv.second);
+      }
+    } else {
+      w.Put<int32_t>(-1);
+    }
+    if (!WriteFrame(fd, msg_type, w.buffer().data(), w.buffer().size()))
+      break;
+  }
+  ::close(fd);
+}
+
+// ---------------------------------------------------------------------------
+// Discovery (spec-aware: directory registries and tcp: registry servers)
 // ---------------------------------------------------------------------------
 namespace {
-// One directory scan → (idx, host, port) triples. Duplicate indices (e.g.
-// a stale file left by a crashed server plus its replacement) keep the
-// highest port entry last-wins deterministically by name order.
-Status ScanRegistry(const std::string& registry_dir,
-                    std::map<int, std::pair<std::string, int>>* found) {
-  DIR* d = ::opendir(registry_dir.c_str());
+bool SplitTcpSpec(const std::string& spec, std::string* host, int* port) {
+  if (spec.rfind("tcp:", 0) != 0) return false;
+  auto rest = spec.substr(4);
+  auto pos = rest.rfind(':');
+  if (pos == std::string::npos) return false;
+  *host = rest.substr(0, pos);
+  *port = std::atoi(rest.substr(pos + 1).c_str());
+  return true;
+}
+
+// "shard_<i>__<host>_<port>" -> parts; false for foreign entries.
+bool ParseShardEntry(const std::string& name, int* idx, std::string* host,
+                     int* port) {
+  if (name.rfind("shard_", 0) != 0) return false;
+  auto sep = name.find("__");
+  if (sep == std::string::npos) return false;
+  *idx = std::atoi(name.substr(6, sep - 6).c_str());
+  auto last = name.rfind('_');
+  if (last == std::string::npos || last <= sep + 1) return false;
+  *host = name.substr(sep + 2, last - sep - 2);
+  *port = std::atoi(name.substr(last + 1).c_str());
+  return *idx >= 0;
+}
+
+std::string DirOfSpec(const std::string& spec) {
+  return spec.rfind("dir:", 0) == 0 ? spec.substr(4) : spec;
+}
+}  // namespace
+
+Status RegistryPutEntry(const std::string& spec, const std::string& name) {
+  std::string host;
+  int port;
+  if (SplitTcpSpec(spec, &host, &port)) {
+    RpcChannel ch(host, port);
+    ch.set_timeout_ms(3000);
+    std::vector<char> body(name.begin(), name.end()), reply;
+    // 2 bounded attempts: heartbeats repeat anyway; a long retry ladder
+    // here would stall the heartbeat thread (and Stop(), which joins
+    // it) behind an unreachable registry host
+    return ch.Call(kRegPut, body, &reply, /*max_retries=*/2);
+  }
+  return WriteStringToFile(DirOfSpec(spec) + "/" + name, "", 0);
+}
+
+Status RegistryRemoveEntry(const std::string& spec,
+                           const std::string& name) {
+  std::string host;
+  int port;
+  if (SplitTcpSpec(spec, &host, &port)) {
+    RpcChannel ch(host, port);
+    ch.set_timeout_ms(3000);
+    std::vector<char> body(name.begin(), name.end()), reply;
+    // best-effort single bounded attempt: shutdown must never block on
+    // a partitioned registry (the entry just goes stale instead)
+    return ch.Call(kRegRemove, body, &reply, /*max_retries=*/1);
+  }
+  std::remove((DirOfSpec(spec) + "/" + name).c_str());
+  return Status::OK();
+}
+
+Status ScanRegistrySpec(const std::string& spec,
+                        std::map<int, std::pair<std::string, int>>* found,
+                        std::map<int, int64_t>* ages_ms) {
+  std::string rhost;
+  int rport;
+  if (SplitTcpSpec(spec, &rhost, &rport)) {
+    RpcChannel ch(rhost, rport);
+    ch.set_timeout_ms(3000);
+    std::vector<char> reply;
+    ET_RETURN_IF_ERROR(ch.Call(kRegList, {}, &reply, /*max_retries=*/2));
+    ByteReader r(reply.data(), reply.size());
+    uint32_t n;
+    if (!r.Get(&n)) return Status::IOError("truncated registry listing");
+    std::map<int, int64_t> best_age;
+    for (uint32_t i = 0; i < n; ++i) {
+      std::string name;
+      int64_t age;
+      if (!r.GetStr(&name) || !r.Get(&age))
+        return Status::IOError("truncated registry entry");
+      int idx, port;
+      std::string host;
+      if (!ParseShardEntry(name, &idx, &host, &port)) continue;
+      // duplicate indices (a crashed server's entry + its replacement):
+      // the YOUNGEST heartbeat wins — a stale ghost must not shadow the
+      // live registration
+      auto it = best_age.find(idx);
+      if (it != best_age.end() && it->second <= age) continue;
+      best_age[idx] = age;
+      (*found)[idx] = {host, port};
+      if (ages_ms != nullptr) (*ages_ms)[idx] = age;
+    }
+    return Status::OK();
+  }
+  // File mode: one directory scan; duplicate indices keep the last entry
+  // in name order (a stale file left by a crashed server plus its
+  // replacement resolves deterministically). Age = wall now - mtime.
+  std::string dir = DirOfSpec(spec);
+  DIR* d = ::opendir(dir.c_str());
   if (d == nullptr)
-    return Status::IOError("cannot open registry dir " + registry_dir);
+    return Status::IOError("cannot open registry dir " + dir);
   dirent* e;
+  int64_t now = static_cast<int64_t>(::time(nullptr)) * 1000;
+  std::map<int, int64_t> best_age;
   while ((e = ::readdir(d)) != nullptr) {
-    std::string name = e->d_name;
-    if (name.rfind("shard_", 0) != 0) continue;
-    // shard_<i>__<host>_<port>
-    auto sep = name.find("__");
-    if (sep == std::string::npos) continue;
-    int idx = std::atoi(name.substr(6, sep - 6).c_str());
-    auto last = name.rfind('_');
-    if (last == std::string::npos || last <= sep + 1) continue;
-    std::string host = name.substr(sep + 2, last - sep - 2);
-    int port = std::atoi(name.substr(last + 1).c_str());
-    if (idx >= 0) (*found)[idx] = {host, port};
+    int idx, port;
+    std::string host;
+    if (!ParseShardEntry(e->d_name, &idx, &host, &port)) continue;
+    struct stat st;
+    std::string path = dir + "/" + e->d_name;
+    int64_t age = ::stat(path.c_str(), &st) == 0
+                      ? now - static_cast<int64_t>(st.st_mtime) * 1000
+                      : (1LL << 60);
+    // duplicate indices: youngest mtime wins (see tcp path)
+    auto it = best_age.find(idx);
+    if (it != best_age.end() && it->second <= age) continue;
+    best_age[idx] = age;
+    (*found)[idx] = {host, port};
+    if (ages_ms != nullptr) (*ages_ms)[idx] = age;
   }
   ::closedir(d);
   return Status::OK();
 }
-// Like ScanRegistry but also reports each entry's mtime in ms-since-epoch
-// (for staleness checks against heartbeats).
-Status ScanRegistryWithTimes(
-    const std::string& registry_dir,
-    std::map<int, std::pair<std::string, int>>* found,
-    std::map<int, int64_t>* mtimes) {
-  ET_RETURN_IF_ERROR(ScanRegistry(registry_dir, found));
-  for (const auto& kv : *found) {
-    std::ostringstream os;
-    os << registry_dir << "/shard_" << kv.first << "__" << kv.second.first
-       << "_" << kv.second.second;
-    struct stat st;
-    (*mtimes)[kv.first] =
-        ::stat(os.str().c_str(), &st) == 0
-            ? static_cast<int64_t>(st.st_mtime) * 1000
-            : 0;
-  }
-  return Status::OK();
-}
-}  // namespace
 
 // ---------------------------------------------------------------------------
 // ServerMonitor
@@ -509,15 +754,11 @@ void ServerMonitor::Loop() {
         return;
     }
     std::map<int, std::pair<std::string, int>> found;
-    std::map<int, int64_t> mtimes;
-    if (!ScanRegistryWithTimes(dir_, &found, &mtimes).ok()) continue;
-    int64_t now = std::chrono::duration_cast<std::chrono::milliseconds>(
-                      std::chrono::system_clock::now().time_since_epoch())
-                      .count();
+    std::map<int, int64_t> ages;
+    if (!ScanRegistrySpec(dir_, &found, &ages).ok()) continue;
     // stale registrations count as down (heartbeat stopped)
     for (auto it = found.begin(); it != found.end();) {
-      int64_t age = now - mtimes[it->first];
-      if (stale_ms_ > 0 && age > stale_ms_)
+      if (stale_ms_ > 0 && ages[it->first] > stale_ms_)
         it = found.erase(it);
       else
         ++it;
@@ -539,7 +780,7 @@ void ServerMonitor::Loop() {
 Status DiscoverFromRegistry(const std::string& registry_dir, int shard_num,
                             ShardEndpoints* out) {
   std::map<int, std::pair<std::string, int>> found;
-  ET_RETURN_IF_ERROR(ScanRegistry(registry_dir, &found));
+  ET_RETURN_IF_ERROR(ScanRegistrySpec(registry_dir, &found, nullptr));
   out->endpoints.assign(shard_num, {"", 0});
   int unique = 0;
   for (const auto& kv : found) {
@@ -557,7 +798,7 @@ Status DiscoverFromRegistry(const std::string& registry_dir, int shard_num,
 Status DiscoverFromRegistryAuto(const std::string& registry_dir,
                                 ShardEndpoints* out) {
   std::map<int, std::pair<std::string, int>> found;
-  ET_RETURN_IF_ERROR(ScanRegistry(registry_dir, &found));
+  ET_RETURN_IF_ERROR(ScanRegistrySpec(registry_dir, &found, nullptr));
   if (found.empty())
     return Status::NotFound("no shard files in registry " + registry_dir);
   int shard_num = found.rbegin()->first + 1;

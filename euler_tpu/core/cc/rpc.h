@@ -74,12 +74,13 @@ class GraphServer {
   void Stop();
   int port() const { return port_; }
 
-  // Register under registry_dir as shard_<i>__<host>_<port> and start a
-  // heartbeat thread that re-touches the file every heartbeat_ms — the
-  // ephemeral-node semantics of the reference's ZK registration
+  // Register under the registry (a shared directory OR a
+  // "tcp:<host>:<port>" RegistryServer) as shard_<i>__<host>_<port> and
+  // start a heartbeat thread that re-puts the entry every heartbeat_ms —
+  // the ephemeral-node semantics of the reference's ZK registration
   // (zk_server_register.cc): a crashed server's entry goes stale and
   // monitors mark the shard down. heartbeat_ms <= 0 disables (tests).
-  Status Register(const std::string& registry_dir, const std::string& host,
+  Status Register(const std::string& registry, const std::string& host,
                   int heartbeat_ms = 2000);
 
  private:
@@ -103,7 +104,7 @@ class GraphServer {
   std::mutex conn_mu_;
   std::vector<Conn> conns_;
   std::vector<int> conn_fds_;  // open connection sockets (for Stop)
-  std::string registered_path_;
+  std::string reg_spec_, reg_name_;  // registry spec + entry name
   std::thread heartbeat_;
   std::mutex hb_mu_;
   std::condition_variable hb_cv_;
@@ -122,8 +123,16 @@ class RpcChannel {
   explicit RpcChannel(std::string host, int port);
   ~RpcChannel();
 
+  // max_retries <= 0 → kRetryCount. Registry traffic passes 1-2 so
+  // heartbeat/shutdown paths can't stall behind an unreachable host.
   Status Call(uint32_t msg_type, const std::vector<char>& body,
-              std::vector<char>* reply_body);
+              std::vector<char>* reply_body, int max_retries = 0);
+
+  // > 0: bound connect() AND each recv/send to this budget (poll-based
+  // connect + SO_RCVTIMEO/SO_SNDTIMEO). 0 (default) = blocking sockets
+  // — the graph-query path keeps them (long merges may stream for a
+  // while); registry channels set ~3s.
+  void set_timeout_ms(int ms) { timeout_ms_ = ms; }
 
   const std::string& host() const { return host_; }
   int port() const { return port_; }
@@ -135,22 +144,68 @@ class RpcChannel {
 
   std::string host_;
   int port_;
+  int timeout_ms_ = 0;
   std::mutex mu_;
   std::vector<int> free_fds_;
 };
 
-// Discovery: resolve shard → endpoints. Two sources, like the reference's
+// ---------------------------------------------------------------------------
+// TCP registry server — the ZooKeeper role WITHOUT a shared filesystem
+// (reference euler/common/zk_server_monitor.h). Servers heartbeat named
+// entries over the framed protocol (kRegPut); clients list entries with
+// server-computed ages (kRegList) — ephemeral-node semantics from the
+// server's own clock, so machines need no NFS and no clock agreement.
+// All registry access below accepts either a directory path (optionally
+// "dir:"-prefixed) or "tcp:<host>:<port>" pointing at one of these.
+// ---------------------------------------------------------------------------
+class RegistryServer {
+ public:
+  ~RegistryServer();
+  Status Start(int port);  // 0 → ephemeral
+  void Stop();
+  int port() const { return port_; }
+
+ private:
+  void AcceptLoop();
+  void HandleConnection(int fd);
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> stopping_{false};
+  std::thread acceptor_;
+  std::mutex mu_;  // guards entries_ and conns_
+  // name → last-put steady time (ms)
+  std::map<std::string, int64_t> entries_;
+  // parallel vectors: connection thread, its fd, and a finished flag
+  // (reaped opportunistically in AcceptLoop; finished conns' fds are
+  // already closed and must not be shutdown() again)
+  std::vector<std::thread> conns_;
+  std::vector<int> conn_fds_;
+  std::vector<std::shared_ptr<std::atomic<bool>>> done_;
+};
+
+// Write/refresh one named entry in a registry (file touch or tcp put).
+Status RegistryPutEntry(const std::string& spec, const std::string& name);
+// Drop one named entry (file unlink or tcp remove) — clean shutdown.
+Status RegistryRemoveEntry(const std::string& spec, const std::string& name);
+// List a registry's shard entries: shard idx → (host, port) + entry age
+// in ms (time since last heartbeat).
+Status ScanRegistrySpec(const std::string& spec,
+                        std::map<int, std::pair<std::string, int>>* found,
+                        std::map<int, int64_t>* ages_ms);
+
+// Discovery: resolve shard → endpoints. Sources, like the reference's
 // ZK monitor + static config:
-//   - registry dir: files "shard_<i>__<host>_<port>"
+//   - registry: dir path or tcp: spec with "shard_<i>__<host>_<port>" entries
 //   - static spec: "host:port,host:port,..." (index in list = shard)
 struct ShardEndpoints {
   std::vector<std::pair<std::string, int>> endpoints;  // per shard
 };
-Status DiscoverFromRegistry(const std::string& registry_dir, int shard_num,
+Status DiscoverFromRegistry(const std::string& registry, int shard_num,
                             ShardEndpoints* out);
 // Single scan; shard count derived from the max index found (all indices
 // 0..max must be present).
-Status DiscoverFromRegistryAuto(const std::string& registry_dir,
+Status DiscoverFromRegistryAuto(const std::string& registry,
                                 ShardEndpoints* out);
 Status DiscoverFromSpec(const std::string& spec, ShardEndpoints* out);
 

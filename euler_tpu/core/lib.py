@@ -112,6 +112,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etg_graph_label_count": (i64, [i64]),
         "etg_sample_graph_label": (i32, [i64, i64, c_u64p]),
         "etg_get_graph_by_label": (i32, [i64, c_u64p, i64, c_voidp]),
+        "etg_all_node_weights": (i32, [i64, c_f32p]),
         "etg_node_weight_sums": (i32, [i64, c_f32p]),
         "etg_edge_weight_sums": (i32, [i64, c_f32p]),
         "etg_sample_node": (i32, [i64, i32, i64, c_u64p]),
@@ -164,6 +165,10 @@ def _declare(lib: ctypes.CDLL) -> None:
         "ets_start": (i64, [ctypes.c_char_p, i32, i32, i32, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]),
         "ets_port": (i32, [i64]),
         "ets_stop": (i32, [i64]),
+        "etr_start": (i64, [i32]),
+        "etr_port": (i32, [i64]),
+        "etr_stop": (i32, [i64]),
+        "etr_scan": (i64, [ctypes.c_char_p, ctypes.c_char_p, i64]),
         "etq_compile_debug": (i64, [ctypes.c_char_p, i32, i32, ctypes.c_char_p, ctypes.c_char_p, i64]),
     }
     for name, (restype, argtypes) in sigs.items():

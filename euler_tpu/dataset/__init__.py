@@ -60,6 +60,14 @@ from euler_tpu.dataset.ml_1m import ml_1m  # noqa: E402,F401
 
 _REGISTRY["ml_1m"] = ml_1m
 
+# REAL datasets available without egress (see real_sets.py): every node/
+# edge/label in karate is observed 1977 data; digits_knn has real
+# features+labels with derived kNN edges.
+from euler_tpu.dataset.real_sets import digits_knn, karate  # noqa: E402,F401
+
+_REGISTRY["karate"] = karate
+_REGISTRY["digits_knn"] = digits_knn
+
 
 def get_dataset(name: str, **overrides):
     name = name.lower()

@@ -182,7 +182,12 @@ def start_service(data_dir: str, shard_idx: int = 0, shard_num: int = 1,
                   port: int = 0, registry_dir: str = "",
                   host: str = "127.0.0.1",
                   index_spec: str = "") -> GraphService:
-    """Load shard `shard_idx`/`shard_num` from data_dir and serve it."""
+    """Load shard `shard_idx`/`shard_num` from data_dir and serve it.
+
+    registry_dir: where the shard registers for discovery — a shared
+    directory path (or "dir:/path"), or "tcp:<host>:<port>" pointing at
+    a registry server (start_registry) for clusters with no shared
+    filesystem (the reference's ZooKeeper role)."""
     lib = _libmod.load()
     h = lib.ets_start(data_dir.encode(), shard_idx, shard_num, port,
                       registry_dir.encode(), host.encode(),
@@ -190,6 +195,60 @@ def start_service(data_dir: str, shard_idx: int = 0, shard_num: int = 1,
     if h == 0:
         raise EngineError(lib.etg_last_error().decode())
     return GraphService(lib, h)
+
+
+class RegistryService:
+    """A TCP registry server (ZK-role discovery without a shared FS):
+    shards heartbeat named entries; clients and monitors list them with
+    ages. Use "tcp:<host>:<port>" as registry_dir / endpoints."""
+
+    def __init__(self, lib, handle: int):
+        self._lib = lib
+        self._h = handle
+
+    @property
+    def port(self) -> int:
+        return self._lib.etr_port(self._h)
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.etr_stop(self._h)
+            self._h = 0
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+def start_registry(port: int = 0) -> RegistryService:
+    """Start a registry server (port 0 → ephemeral)."""
+    lib = _libmod.load()
+    h = lib.etr_start(port)
+    if h == 0:
+        raise EngineError(lib.etg_last_error().decode())
+    return RegistryService(lib, h)
+
+
+def scan_registry(spec: str):
+    """List a registry's shard entries: {shard: (host, port, age_ms)}.
+    spec = directory path, "dir:/path", or "tcp:host:port"."""
+    lib = _libmod.load()
+    buf = ctypes.create_string_buffer(1 << 16)
+    n = lib.etr_scan(spec.encode(), buf, len(buf))
+    if n < 0:
+        raise EngineError(lib.etg_last_error().decode())
+    if n >= len(buf):  # truncated: re-scan with the reported size
+        buf = ctypes.create_string_buffer(n + 1)
+        n = lib.etr_scan(spec.encode(), buf, len(buf))
+        if n < 0:
+            raise EngineError(lib.etg_last_error().decode())
+    out = {}
+    for line in buf.value.decode().splitlines():
+        idx, host, port, age = line.split(",")
+        out[int(idx)] = (host, int(port), int(age))
+    return out
 
 
 # ctypes callbacks must outlive the engine; keyed by name so

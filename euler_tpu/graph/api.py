@@ -327,6 +327,14 @@ class GraphEngine:
         _libmod.check(self._lib, self._lib.etg_all_node_ids(self.h, _ptr(out, c_u64p)))
         return out
 
+    def all_node_weights(self) -> np.ndarray:
+        """Per-node weights in engine-row order (all_node_ids order) —
+        backs device-resident weighted global sampling."""
+        out = np.zeros(self.node_count, dtype=np.float32)
+        _libmod.check(self._lib, self._lib.etg_all_node_weights(
+            self.h, _ptr(out, c_f32p)))
+        return out
+
     def node_weight_sums(self) -> np.ndarray:
         out = np.zeros(self.num_node_types, dtype=np.float32)
         _libmod.check(self._lib, self._lib.etg_node_weight_sums(self.h, _ptr(out, c_f32p)))

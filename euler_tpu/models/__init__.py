@@ -1,8 +1,14 @@
 from euler_tpu.models.dgi import DGI  # noqa: F401
-from euler_tpu.models.embedding_models import LINE, DeepWalk, Node2Vec  # noqa: F401
+from euler_tpu.models.embedding_models import (  # noqa: F401
+    LINE,
+    DeepWalk,
+    DeviceSampledSkipGram,
+    Node2Vec,
+)
 from euler_tpu.models.graphsage import (  # noqa: F401
     ScalableGraphSage,
     DeviceSampledGraphSage,
+    DeviceSampledUnsupervisedSage,
     ShardedSupervisedGraphSage,
     SupervisedGraphSage,
     UnsupervisedGraphSage,

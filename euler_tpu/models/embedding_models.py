@@ -50,6 +50,73 @@ class DeepWalk(nn.Module):
 Node2Vec = DeepWalk  # same model; the walk's p/q bias differs (walk_ops)
 
 
+class DeviceSampledSkipGram(nn.Module):
+    """DeepWalk / node2vec / LINE with the ENTIRE input pipeline on
+    device: walks (device_walk.walk_rows over the HBM neighbor table),
+    skip-gram pair generation, and weighted negative sampling all run
+    inside the jitted step — the host ships only root rows + a seed.
+
+    Covers the reference walk family (random_walk_op.cc + gen_pair_op.cc
+    + the global negative sampler): walk_len/window give DeepWalk; p,q
+    give node2vec's second-order bias; walk_len=1 with window (0,1) and
+    share_context=True is LINE first-order (order-2 = separate ctx
+    table, the default). Pairs touching pad_row (dead-end walks) are
+    masked out of loss and metric — strictly cleaner than the host
+    path's default-id pairs.
+
+    batch: rows=[roots [B]], sample_seed, nbr_table, cum_table,
+    neg_rows, neg_cum (DeviceNodeSampler.tables).
+    """
+
+    num_rows: int = 0           # feature-table rows N (pad_row == N)
+    dim: int = 128
+    walk_len: int = 5
+    left_win: int = 1
+    right_win: int = 1
+    num_negs: int = 5
+    p: float = 1.0
+    q: float = 1.0
+    share_context: bool = False
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
+        from euler_tpu.parallel.device_walk import (
+            gen_pair_rows, sample_global_rows, walk_rows,
+        )
+
+        roots = batch["rows"][0]
+        pad = self.num_rows
+        key = jax.random.fold_in(jax.random.key(23), batch["sample_seed"])
+        kw, kn = jax.random.split(key)
+        walks = walk_rows(batch["nbr_table"], batch["cum_table"], roots,
+                          self.walk_len, kw, p=self.p, q=self.q)
+        pairs = gen_pair_rows(walks, self.left_win, self.right_win)
+        flat = pairs.reshape(-1, 2)                    # [B*P, 2]
+        src_r, pos_r = flat[:, 0], flat[:, 1]
+        negs_r = sample_global_rows(batch["neg_rows"], batch["neg_cum"],
+                                    kn, (flat.shape[0], self.num_negs))
+        emb = Embedding(self.num_rows + 1, self.dim, name="emb")
+        ctx = emb if self.share_context else Embedding(
+            self.num_rows + 1, self.dim, name="ctx")
+        src = emb(src_r)
+        pos = ctx(pos_r)
+        negs = ctx(negs_r)
+        pos_logit = (src * pos).sum(-1, keepdims=True)
+        neg_logit = jnp.einsum("bd,bnd->bn", src, negs)
+        valid = ((src_r != pad) & (pos_r != pad)).astype(jnp.float32)
+        loss = (
+            M.masked_mean(optax.sigmoid_binary_cross_entropy(
+                pos_logit, jnp.ones_like(pos_logit)).mean(-1), valid)
+            + M.masked_mean(optax.sigmoid_binary_cross_entropy(
+                neg_logit, jnp.zeros_like(neg_logit)).mean(-1), valid)
+        )
+        scores = jnp.concatenate([pos_logit, neg_logit], axis=1)
+        ranks = 1.0 + (scores[:, 1:] >= scores[:, :1]).sum(
+            axis=1).astype(jnp.float32)
+        mrr = M.masked_mean(1.0 / ranks, valid)
+        return ModelOutput(emb(roots), loss, "mrr", mrr)
+
+
 class LINE(nn.Module):
     """LINE (1st/2nd order). batch: src [B], pos [B], negs [B, N].
     order=1 shares one table; order=2 uses a context table."""

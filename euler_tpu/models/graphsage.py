@@ -100,6 +100,67 @@ class DeviceSampledGraphSage(SuperviseModel):
                            name="encoder")(layers)
 
 
+class DeviceSampledUnsupervisedSage(nn.Module):
+    """Unsupervised GraphSAGE fully on device: the fanout embedding AND
+    the positive/negative context pipeline run in-jit. Positives are one
+    weighted neighbor draw per root (the reference's SamplePosWithTypes
+    role, solution/samplers.py); negatives draw from the HBM node-weight
+    sampler (DeviceNodeSampler). The host ships only root rows + a seed.
+    Pairs whose positive lands on pad_row (isolated roots) are masked
+    out of loss and metric."""
+
+    num_rows: int = 0
+    dim: int = 32
+    fanouts: Sequence[int] = (10, 10)
+    aggregator: str = "mean"
+    num_negs: int = 5
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, Any]):
+        import jax.numpy as jnp
+        import optax
+
+        from euler_tpu.mp_utils.base import ModelOutput
+        from euler_tpu.parallel.device_sampler import (
+            sample_fanout_rows, sample_hop,
+        )
+        from euler_tpu.parallel.device_walk import sample_global_rows
+        from euler_tpu.utils import metrics as M
+        from euler_tpu.utils.layers import Embedding
+
+        roots = batch["rows"][0]
+        pad = self.num_rows
+        key = jax.random.fold_in(jax.random.key(29), batch["sample_seed"])
+        kf, kp, kn = jax.random.split(key, 3)
+        rows = sample_fanout_rows(batch["nbr_table"], batch["cum_table"],
+                                  roots, tuple(self.fanouts), kf)
+        table = batch["feature_table"]
+        layers = [jnp.take(table, r, axis=0) for r in rows]
+        emb = SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
+                          concat=False, name="encoder")(layers)   # [B, D]
+        pos_r = sample_hop(batch["nbr_table"], batch["cum_table"], roots,
+                           1, kp)                                 # [B]
+        negs_r = sample_global_rows(batch["neg_rows"], batch["neg_cum"],
+                                    kn, (roots.shape[0], self.num_negs))
+        ctx = Embedding(self.num_rows + 1, self.dim, name="ctx_emb")
+        pos = ctx(pos_r)                                          # [B, D]
+        negs = ctx(negs_r)                                        # [B, N, D]
+        pos_logit = (emb * pos).sum(-1, keepdims=True)
+        neg_logit = jnp.einsum("bd,bnd->bn", emb, negs)
+        valid = (pos_r != pad).astype(jnp.float32)
+        loss = (
+            M.masked_mean(optax.sigmoid_binary_cross_entropy(
+                pos_logit, jnp.ones_like(pos_logit)).mean(-1), valid)
+            + M.masked_mean(optax.sigmoid_binary_cross_entropy(
+                neg_logit, jnp.zeros_like(neg_logit)).mean(-1), valid)
+        )
+        scores = jnp.concatenate([pos_logit, neg_logit], axis=1)
+        ranks = 1.0 + (scores[:, 1:] >= scores[:, :1]).sum(
+            axis=1).astype(jnp.float32)
+        mrr = M.masked_mean(1.0 / ranks, valid)
+        return ModelOutput(emb, loss, "mrr", mrr)
+
+
 class ShardedSupervisedGraphSage(SuperviseModel):
     """GraphSAGE with an id-embedding input sharded across the mesh's
     'model' axis — the multi-chip flagship: feature = concat(sharded id

@@ -20,6 +20,12 @@ from euler_tpu.parallel.placement import (  # noqa: F401
     put_replicated,
     put_row_sharded,
 )
+from euler_tpu.parallel.device_walk import (  # noqa: F401
+    DeviceNodeSampler,
+    gen_pair_rows,
+    sample_global_rows,
+    walk_rows,
+)
 from euler_tpu.parallel.feature_store import DeviceFeatureStore  # noqa: F401
 from euler_tpu.parallel.ring_exchange import ring_lookup  # noqa: F401
 from euler_tpu.parallel.train import make_spmd_train_step, spmd_init  # noqa: F401

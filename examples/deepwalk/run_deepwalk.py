@@ -31,6 +31,15 @@ def main(argv=None):
     ap.add_argument("--max_steps", type=int, default=0,
                 help="0 = auto: ~10 root walks per node")
     ap.add_argument("--eval_steps", type=int, default=20)
+    ap.add_argument("--device_sampler", action="store_true",
+                    help="run walks + pair generation + negative "
+                         "sampling ON DEVICE (DeviceNeighborTable + "
+                         "DeviceNodeSampler): the host ships only root "
+                         "rows per step")
+    ap.add_argument("--sampler_cap", type=int, default=32)
+    ap.add_argument("--steps_per_loop", type=int, default=1,
+                    help=">1 scans K steps per device dispatch "
+                         "(device_sampler mode)")
     ap.add_argument("--model_dir", default="")
     add_platform_flag(ap)
     args = ap.parse_args(argv)
@@ -38,7 +47,7 @@ def main(argv=None):
 
     from euler_tpu.dataset import get_dataset
     from euler_tpu.estimator import BaseEstimator
-    from euler_tpu.models import DeepWalk
+    from euler_tpu.models import DeepWalk, DeviceSampledSkipGram
     from euler_tpu.ops.walk_ops import gen_pair
 
     data = get_dataset(args.dataset)
@@ -49,22 +58,49 @@ def main(argv=None):
                                  / args.batch_size))
     print(f"dataset {args.dataset}: {g.node_count} nodes [{data.source}]")
 
-    model = DeepWalk(max_id=data.max_id, dim=args.dim)
-    est = BaseEstimator(
-        model,
-        dict(learning_rate=args.learning_rate, max_id=data.max_id),
-        model_dir=args.model_dir or None)
+    if args.device_sampler:
+        from euler_tpu.parallel import DeviceNeighborTable, DeviceNodeSampler
 
-    def input_fn():
-        while True:
-            roots = g.sample_node(args.batch_size, -1)
-            walks = g.random_walk(roots, args.walk_len, p=args.p, q=args.q)
-            pairs = gen_pair(walks, args.left_win, args.right_win)
-            flat = pairs.reshape(-1, 2)
-            negs = g.sample_node(flat.shape[0] * args.num_negs, -1).reshape(
-                flat.shape[0], args.num_negs)
-            yield {"src": flat[:, 0], "pos": flat[:, 1], "negs": negs,
-                   "infer_ids": flat[:, 0]}
+        tab = DeviceNeighborTable(g, cap=args.sampler_cap)
+        neg = DeviceNodeSampler(g, node_type=-1)
+        model = DeviceSampledSkipGram(
+            num_rows=tab.pad_row, dim=args.dim, walk_len=args.walk_len,
+            left_win=args.left_win, right_win=args.right_win,
+            num_negs=args.num_negs, p=args.p, q=args.q)
+        est = BaseEstimator(
+            model,
+            dict(learning_rate=args.learning_rate,
+                 steps_per_loop=args.steps_per_loop),
+            model_dir=args.model_dir or None)
+        est.static_batch.update({**tab.tables, **neg.tables})
+        seed_box = [0]
+
+        def input_fn():
+            while True:
+                roots = g.node_rows(g.sample_node(args.batch_size, -1),
+                                    missing=tab.pad_row)
+                seed_box[0] += 1
+                yield {"rows": [roots], "infer_ids": roots,
+                       "sample_seed": np.uint32(seed_box[0])}
+    else:
+        model = DeepWalk(max_id=data.max_id, dim=args.dim)
+        est = BaseEstimator(
+            model,
+            dict(learning_rate=args.learning_rate, max_id=data.max_id),
+            model_dir=args.model_dir or None)
+
+        def input_fn():
+            while True:
+                roots = g.sample_node(args.batch_size, -1)
+                walks = g.random_walk(roots, args.walk_len, p=args.p,
+                                      q=args.q)
+                pairs = gen_pair(walks, args.left_win, args.right_win)
+                flat = pairs.reshape(-1, 2)
+                negs = g.sample_node(
+                    flat.shape[0] * args.num_negs, -1).reshape(
+                        flat.shape[0], args.num_negs)
+                yield {"src": flat[:, 0], "pos": flat[:, 1], "negs": negs,
+                       "infer_ids": flat[:, 0]}
 
     res = est.train(input_fn, args.max_steps)
     ev = est.evaluate(input_fn, args.eval_steps)

@@ -39,10 +39,6 @@ def main(argv=None):
     ap.add_argument("--model_dir", default="")
     add_platform_flag(ap)
     args = ap.parse_args(argv)
-    if args.device_sampler and args.mode != "supervised":
-        ap.error("--device_sampler supports --mode supervised only "
-                 "(the unsupervised edge/negative pipeline samples "
-                 "on the host)")
     init_platform(args.platform)
 
     from euler_tpu.dataflow import FanoutDataFlow
@@ -87,6 +83,42 @@ def main(argv=None):
             label_dim=data.num_classes, model_dir=args.model_dir or None,
             feature_store=store, device_sampler=sampler)
         res = fit_citation(est, args.max_steps, args.eval_steps)
+    elif args.device_sampler:
+        # fully on-device unsupervised path: fanout embedding, positive
+        # 1-hop draw, and weighted negatives all inside the jitted step
+        import numpy as np
+
+        from euler_tpu.estimator import BaseEstimator
+        from euler_tpu.models import DeviceSampledUnsupervisedSage
+        from euler_tpu.parallel import (
+            DeviceFeatureStore, DeviceNeighborTable, DeviceNodeSampler,
+        )
+
+        g = data.engine
+        store = DeviceFeatureStore(g, ["feature"])
+        tab = DeviceNeighborTable(g, cap=args.sampler_cap)
+        neg = DeviceNodeSampler(g, node_type=-1)
+        model = DeviceSampledUnsupervisedSage(
+            num_rows=tab.pad_row, dim=args.hidden_dim, fanouts=fanouts,
+            aggregator=args.aggregator, num_negs=args.num_negs)
+        est = BaseEstimator(
+            model, dict(learning_rate=args.learning_rate),
+            model_dir=args.model_dir or None)
+        est.static_batch.update({"feature_table": store.features,
+                                 **tab.tables, **neg.tables})
+        seed_box = [0]
+
+        def input_fn():
+            while True:
+                roots = store.lookup(g.sample_node(args.batch_size, -1))
+                seed_box[0] += 1
+                yield {"rows": [roots], "infer_ids": roots,
+                       "sample_seed": np.uint32(seed_box[0])}
+
+        res = est.train(input_fn, args.max_steps)
+        ev = est.evaluate(input_fn, args.eval_steps)
+        res = {**{f"train_{k}": v for k, v in res.items()},
+               **{f"eval_{k}": v for k, v in ev.items()}}
     else:
         model = UnsupervisedGraphSage(
             dim=args.hidden_dim, max_id=data.max_id, fanouts=fanouts,

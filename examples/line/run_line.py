@@ -24,32 +24,66 @@ def main(argv=None):
     ap.add_argument("--max_steps", type=int, default=0,
                 help="0 = auto: ~8 epochs over the edge set")
     ap.add_argument("--eval_steps", type=int, default=20)
+    ap.add_argument("--device_sampler", action="store_true",
+                    help="positives (1-hop weighted draw) + negatives "
+                         "sampled on device from HBM tables")
+    ap.add_argument("--sampler_cap", type=int, default=32)
     ap.add_argument("--model_dir", default="")
     add_platform_flag(ap)
     args = ap.parse_args(argv)
     init_platform(args.platform)
 
+    import numpy as np
+
     from euler_tpu.dataset import get_dataset
     from euler_tpu.estimator import BaseEstimator
-    from euler_tpu.models import LINE
+    from euler_tpu.models import LINE, DeviceSampledSkipGram
 
     data = get_dataset(args.dataset)
     g = data.engine
     if not args.max_steps:
         args.max_steps = max(500,
                              int(8 * g.edge_count / args.batch_size))
-    model = LINE(max_id=data.max_id, dim=args.dim, order=args.order)
-    est = BaseEstimator(model,
-                        dict(learning_rate=args.learning_rate,
-                             max_id=data.max_id),
-                        model_dir=args.model_dir or None)
+    if args.device_sampler:
+        # LINE as a walk_len-1 skip-gram: (src, 1-hop weighted neighbor)
+        # pairs ≡ weighted edge sampling given roots ~ node weights;
+        # order=1 shares the context table
+        from euler_tpu.parallel import DeviceNeighborTable, DeviceNodeSampler
 
-    def input_fn():
-        while True:
-            src, dst, _ = g.sample_edge(args.batch_size, -1)
-            negs = g.sample_node(args.batch_size * args.num_negs, -1).reshape(
-                args.batch_size, args.num_negs)
-            yield {"src": src, "pos": dst, "negs": negs, "infer_ids": src}
+        tab = DeviceNeighborTable(g, cap=args.sampler_cap)
+        neg = DeviceNodeSampler(g, node_type=-1)
+        model = DeviceSampledSkipGram(
+            num_rows=tab.pad_row, dim=args.dim, walk_len=1, left_win=0,
+            right_win=1, num_negs=args.num_negs,
+            share_context=args.order == 1)
+        est = BaseEstimator(model,
+                            dict(learning_rate=args.learning_rate),
+                            model_dir=args.model_dir or None)
+        est.static_batch.update({**tab.tables, **neg.tables})
+        seed_box = [0]
+
+        def input_fn():
+            while True:
+                roots = g.node_rows(g.sample_node(args.batch_size, -1),
+                                    missing=tab.pad_row)
+                seed_box[0] += 1
+                yield {"rows": [roots], "infer_ids": roots,
+                       "sample_seed": np.uint32(seed_box[0])}
+    else:
+        model = LINE(max_id=data.max_id, dim=args.dim, order=args.order)
+        est = BaseEstimator(model,
+                            dict(learning_rate=args.learning_rate,
+                                 max_id=data.max_id),
+                            model_dir=args.model_dir or None)
+
+        def input_fn():
+            while True:
+                src, dst, _ = g.sample_edge(args.batch_size, -1)
+                negs = g.sample_node(
+                    args.batch_size * args.num_negs, -1).reshape(
+                        args.batch_size, args.num_negs)
+                yield {"src": src, "pos": dst, "negs": negs,
+                       "infer_ids": src}
 
     res = est.train(input_fn, args.max_steps)
     ev = est.evaluate(input_fn, args.eval_steps)

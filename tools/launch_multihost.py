@@ -57,19 +57,25 @@ def worker_main(args) -> None:
                            registry_dir=args.registry_dir)
     # wait until EVERY host's shard has registered before building the
     # client (discovery is eventually consistent, like the reference's
-    # ZK watch — a client built early would see a partial cluster)
+    # ZK watch — a client built early would see a partial cluster).
+    # scan_registry handles both dir and tcp: registries.
     import time
 
+    from euler_tpu.gql import scan_registry
+
+    spec = args.registry_dir
+    client_spec = spec if spec.startswith(("dir:", "tcp:")) else f"dir:{spec}"
     deadline = time.time() + 60
     while time.time() < deadline:
-        shards = {f.split("__")[0] for f in os.listdir(args.registry_dir)
-                  if f.startswith("shard_")}
-        if len(shards) >= jax.process_count():
-            break
+        try:
+            if len(scan_registry(spec)) >= jax.process_count():
+                break
+        except Exception:
+            pass
         time.sleep(0.1)
     else:
         raise RuntimeError("graph shards did not all register in 60s")
-    remote = RemoteGraphEngine(f"dir:{args.registry_dir}")
+    remote = RemoteGraphEngine(client_spec)
     out["graph_nodes_seen"] = sorted(
         int(i) for i in remote.sample_node(64, -1))[:3]
 
@@ -94,10 +100,19 @@ def worker_main(args) -> None:
     server.stop()
 
 
-def launch_local(n: int, data_dir: str) -> int:
+def launch_local(n: int, data_dir: str, tcp_registry: bool = False) -> int:
     import socket
 
-    registry = tempfile.mkdtemp(prefix="et_mh_reg_")
+    reg_server = None
+    if tcp_registry:
+        # no-shared-FS mode: the launcher hosts the registry server and
+        # every worker discovers through tcp (the reference's ZK role)
+        from euler_tpu.gql import start_registry
+
+        reg_server = start_registry(port=0)
+        registry = f"tcp:127.0.0.1:{reg_server.port}"
+    else:
+        registry = tempfile.mkdtemp(prefix="et_mh_reg_")
     barrier = tempfile.mkdtemp(prefix="et_mh_bar_")
     # reserve a genuinely free coordinator port (a guessed constant can
     # collide with concurrent runs and hang both jobs)
@@ -124,6 +139,8 @@ def launch_local(n: int, data_dir: str) -> int:
         print(f"--- host {i} (rc={p.returncode}) ---")
         print(out)
         rc |= p.returncode
+    if reg_server is not None:
+        reg_server.stop()
     return rc
 
 
@@ -132,6 +149,10 @@ def main(argv=None):
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--local", type=int, default=0,
                     help="spawn N local worker processes (smoke mode)")
+    ap.add_argument("--tcp_registry", action="store_true",
+                    help="local mode: discover via a TCP registry server "
+                         "instead of a shared directory (no-shared-FS "
+                         "clusters)")
     ap.add_argument("--num_hosts", type=int, default=2)
     ap.add_argument("--coordinator", default="HOST0:9999")
     ap.add_argument("--data_dir", default="")
@@ -145,7 +166,8 @@ def main(argv=None):
     if args.local:
         if not args.data_dir:
             raise SystemExit("--local needs --data_dir (partitioned dump)")
-        return launch_local(args.local, args.data_dir)
+        return launch_local(args.local, args.data_dir,
+                            tcp_registry=args.tcp_registry)
 
     # print-mode: the per-host commands for a real cluster
     for i in range(args.num_hosts):

@@ -1,0 +1,152 @@
+"""TPU step micro-ablations at products scale (perf attribution).
+
+Loads the bench table cache (no engine build) and times jitted pieces:
+
+  train_full   — the bench train step (fwd+bwd+adam), reference point
+  fwd_full     — model forward only
+  sample_only  — in-jit fanout sampling alone (no feature gather)
+  gather_only  — feature gather of fixed rows alone (no sampling)
+  gather_cumw  — the sampler's cum-row gathers alone
+
+Usage: python tools/probe_tpu_step.py [--steps 30] [--batch 32768]
+Prints one JSON line per variant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=32768)
+    ap.add_argument("--fanouts", default="15,10")
+    ap.add_argument("--cache", default="")
+    ap.add_argument("--platform", default="auto")
+    args = ap.parse_args(argv)
+
+    from euler_tpu.platform import init_platform
+
+    init_platform(args.platform)
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    cache = args.cache or os.path.join(
+        Path(__file__).resolve().parents[1], ".bench_cache",
+        "g_n2450000_d50_f100_c16_cap32_bf16_v1.npz")
+    z = np.load(cache)
+    from euler_tpu.models import DeviceSampledGraphSage
+    from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+    from euler_tpu.parallel.device_sampler import sample_fanout_rows
+
+    tab = DeviceNeighborTable.from_arrays(z["nbr"], z["cum"])
+    store = DeviceFeatureStore.from_arrays(
+        z["feat"].astype(jnp.bfloat16), z["label"])
+    n = store.pad_row
+    fanouts = tuple(int(x) for x in args.fanouts.split(","))
+    batch = args.batch
+    rng = np.random.default_rng(0)
+    roots = jnp.asarray(rng.integers(0, n, batch).astype(np.int32))
+    sizes = [batch]
+    for k in fanouts:
+        sizes.append(sizes[-1] * k)
+    edges_per_step = sum(sizes[1:])
+    fixed_rows = [jnp.asarray(rng.integers(0, n, s).astype(np.int32))
+                  for s in sizes]
+
+    model = DeviceSampledGraphSage(num_classes=16, multilabel=False,
+                                   dim=128, fanouts=fanouts)
+    tx = optax.adam(0.01)
+    base_batch = {"rows": [roots], "sample_seed": np.uint32(1),
+                  "feature_table": store.features,
+                  "label_table": store.labels, **tab.tables}
+    variables = model.init(jax.random.key(0), base_batch)
+    opt_state = tx.init(variables)
+
+    @jax.jit
+    def train_full(p, o, seed):
+        def loss_fn(pp):
+            return model.apply(
+                pp, {**base_batch, "sample_seed": seed}).loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    @jax.jit
+    def fwd_full(p, seed):
+        return model.apply(p, {**base_batch, "sample_seed": seed}).loss
+
+    @jax.jit
+    def sample_only(seed):
+        key = jax.random.fold_in(jax.random.key(17), seed)
+        rows = sample_fanout_rows(tab.neighbors, tab.cum_weights, roots,
+                                  fanouts, key)
+        return sum(jnp.sum(r.astype(jnp.int64)) for r in rows)
+
+    @jax.jit
+    def gather_only(seed):
+        tot = jnp.zeros((), jnp.float32)
+        for r in fixed_rows:
+            # fold the seed in so the gather isn't constant-folded
+            x = jnp.take(store.features, r + (seed % 2).astype(jnp.int32),
+                         axis=0)
+            tot = tot + jnp.sum(x.astype(jnp.float32))
+        return tot
+
+    @jax.jit
+    def gather_cumw(seed):
+        tot = jnp.zeros((), jnp.float32)
+        for r in fixed_rows[:-1]:
+            x = jnp.take(tab.cum_weights,
+                         r + (seed % 2).astype(jnp.int32), axis=0)
+            tot = tot + jnp.sum(x)
+        return tot
+
+    def time_it(name, fn, *fixed_args, stateful=False):
+        nonlocal variables, opt_state
+        try:
+            if stateful:
+                variables, opt_state, out = fn(variables, opt_state,
+                                               np.uint32(0))
+            else:
+                out = fn(*fixed_args, np.uint32(0))
+            jax.block_until_ready(out)
+            t0 = time.time()
+            for i in range(args.steps):
+                if stateful:
+                    variables, opt_state, out = fn(variables, opt_state,
+                                                   np.uint32(i + 1))
+                else:
+                    out = fn(*fixed_args, np.uint32(i + 1))
+            jax.block_until_ready(out)
+            sps = args.steps / (time.time() - t0)
+            print(json.dumps({
+                "variant": name, "steps_per_sec": round(sps, 2),
+                "edges_per_sec_equiv": round(sps * edges_per_step),
+            }), flush=True)
+        except Exception as e:
+            print(json.dumps({"variant": name,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+    time_it("train_full", train_full, stateful=True)
+    time_it("fwd_full", fwd_full, variables)
+    time_it("sample_only", sample_only)
+    time_it("gather_only", gather_only)
+    time_it("gather_cumw", gather_cumw)
+
+
+if __name__ == "__main__":
+    main()
