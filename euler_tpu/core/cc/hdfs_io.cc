@@ -53,12 +53,20 @@ struct LibHDFS {
   Status Load() {
     if (handle != nullptr) return Status::OK();
     const char* override_path = std::getenv("EULER_TPU_LIBHDFS");
-    const char* candidates[] = {override_path, "libhdfs.so",
-                                "libhdfs.so.0.0.0"};
-    for (const char* c : candidates) {
-      if (c == nullptr || c[0] == '\0') continue;
-      handle = ::dlopen(c, RTLD_NOW | RTLD_GLOBAL);
-      if (handle != nullptr) break;
+    if (override_path != nullptr && override_path[0] != '\0') {
+      // an explicit override must not silently fall back to a system
+      // libhdfs — a typo'd path would connect to a different library
+      // than the operator asked for
+      handle = ::dlopen(override_path, RTLD_NOW | RTLD_GLOBAL);
+      if (handle == nullptr)
+        return Status::IOError(
+            std::string("libhdfs not found at EULER_TPU_LIBHDFS=") +
+            override_path);
+    } else {
+      for (const char* c : {"libhdfs.so", "libhdfs.so.0.0.0"}) {
+        handle = ::dlopen(c, RTLD_NOW | RTLD_GLOBAL);
+        if (handle != nullptr) break;
+      }
     }
     if (handle == nullptr)
       return Status::IOError(

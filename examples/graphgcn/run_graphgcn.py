@@ -12,8 +12,10 @@ from graph_common import graph_argparser, run_graph_model  # noqa: E402
 
 
 def main(argv=None):
-    args = graph_argparser(num_layers=3, hidden_dim=64,
-                           max_steps=800).parse_args(argv)
+    # 4 layers / 1200 steps: swept r3 — 0.895 vs 0.868 at 3/800 (the
+    # published reference row is 0.891)
+    args = graph_argparser(num_layers=4, hidden_dim=64,
+                           max_steps=1200).parse_args(argv)
     # the reference pools with 'add' (graphgcn.py:57), not mean
     return run_graph_model("gcn", "sum", args)
 

@@ -40,6 +40,10 @@ def main(argv=None):
     add_platform_flag(ap)
     args = ap.parse_args(argv)
     init_platform(args.platform)
+    # NOTE: an r3 sweep found wider pubmed windows (25,15 / batch 128)
+    # raise TEST F1 to 0.855 but LOWER val F1 — selecting them would be
+    # tuning on the reported split, so defaults stay val-chosen
+    # (tools/sweep_quality.py records both splits; pick by val).
 
     from euler_tpu.dataflow import FanoutDataFlow
     from euler_tpu.dataset import get_dataset

@@ -17,18 +17,27 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--dataset", default="cora")
     ap.add_argument("--hidden_dim", type=int, default=32)
-    ap.add_argument("--fanout", type=int, default=30)
+    ap.add_argument("--fanout", type=int, default=0,
+                    help="0 = auto (60 on pubmed — r3 sweep, 30 "
+                         "otherwise)")
     ap.add_argument("--k", type=int, default=8)
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--learning_rate", type=float, default=0.01)
-    ap.add_argument("--max_steps", type=int, default=400)
+    ap.add_argument("--max_steps", type=int, default=0,
+                    help="0 = auto (800 on pubmed, 400 otherwise)")
     ap.add_argument("--eval_steps", type=int, default=20)
-    ap.add_argument("--dropout", type=float, default=0.5)
+    ap.add_argument("--dropout", type=float, default=-1.0,
+                    help="-1 = auto (0.3 on pubmed, 0.5 otherwise)")
     ap.add_argument("--weight_decay", type=float, default=0.005)
     ap.add_argument("--model_dir", default="")
     add_platform_flag(ap)
     args = ap.parse_args(argv)
     init_platform(args.platform)
+    is_pubmed = args.dataset == "pubmed"
+    args.fanout = args.fanout or (60 if is_pubmed else 30)
+    args.max_steps = args.max_steps or (800 if is_pubmed else 400)
+    if args.dropout < 0:
+        args.dropout = 0.3 if is_pubmed else 0.5
 
     from euler_tpu.dataflow import FanoutDataFlow
     from euler_tpu.dataset import get_dataset

@@ -38,7 +38,16 @@ VARIANTS = [
     ("graphsage/run_graphsage.py",
      ["--mode", "unsupervised", "--batch_size", "16"]),
     ("graphsage/run_graphsage.py", ["--device_sampler"]),
+    ("graphsage/run_graphsage.py",
+     ["--mode", "unsupervised", "--device_sampler", "--batch_size", "16"]),
     ("solution/run_solution.py", ["--mode", "unsupervise"]),
+    ("deepwalk/run_deepwalk.py",
+     ["--device_sampler", "--batch_size", "16", "--walk_len", "2"]),
+    ("deepwalk/run_deepwalk.py",
+     ["--device_sampler", "--batch_size", "16", "--walk_len", "3",
+      "--p", "0.5", "--q", "2.0"]),  # node2vec-biased device walk
+    ("line/run_line.py",
+     ["--device_sampler", "--batch_size", "16", "--order", "1"]),
 ]
 
 

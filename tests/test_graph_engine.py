@@ -209,15 +209,22 @@ def test_deterministic_seeding(ring_graph):
     np.testing.assert_array_equal(a, b)
 
 
-def test_hdfs_io_with_fake_libhdfs(tmp_path, monkeypatch):
-    """hdfs:// paths route through a dlopen'd libhdfs; exercised against a
-    local-file-backed stub implementing the minimal hdfs C ABI."""
+DEFAULT_HDFS_READ = r"""
+        int hdfsRead(void*, void* f, void* buf, int len) {
+          return (int)fread(buf, 1, len, (FILE*)f);
+        }
+"""
+
+
+def build_hdfs_stub(tmp_path, read_body: str = DEFAULT_HDFS_READ):
+    """Compile a local-file-backed libhdfs stub (paths live under
+    $FAKE_HDFS_ROOT) with a parameterizable hdfsRead — one copy of the
+    minimal hdfs C ABI shared by every hdfs test."""
     import subprocess
     import textwrap
 
     stub_src = tmp_path / "fake_hdfs.cc"
     stub_src.write_text(textwrap.dedent(r"""
-        // local-file-backed libhdfs stub: paths live under $FAKE_HDFS_ROOT
         #include <cstdio>
         #include <cstdlib>
         #include <cstring>
@@ -242,9 +249,8 @@ def test_hdfs_io_with_fake_libhdfs(tmp_path, monkeypatch):
           return fopen(full(path).c_str(), flags == 1 ? "wb" : "rb");
         }
         int hdfsCloseFile(void*, void* f) { return fclose((FILE*)f); }
-        int hdfsRead(void*, void* f, void* buf, int len) {
-          return (int)fread(buf, 1, len, (FILE*)f);
-        }
+"""
+        ) + textwrap.dedent(read_body) + textwrap.dedent(r"""
         int hdfsWrite(void*, void* f, const void* buf, int len) {
           return (int)fwrite(buf, 1, len, (FILE*)f);
         }
@@ -261,6 +267,13 @@ def test_hdfs_io_with_fake_libhdfs(tmp_path, monkeypatch):
     stub_so = tmp_path / "libfakehdfs.so"
     subprocess.run(["g++", "-shared", "-fPIC", "-o", str(stub_so),
                     str(stub_src)], check=True)
+    return stub_so
+
+
+def test_hdfs_io_with_fake_libhdfs(tmp_path, monkeypatch):
+    """hdfs:// paths route through a dlopen'd libhdfs; exercised against a
+    local-file-backed stub implementing the minimal hdfs C ABI."""
+    stub_so = build_hdfs_stub(tmp_path)
     root = tmp_path / "hdfs_root"
     root.mkdir()
     monkeypatch.setenv("EULER_TPU_LIBHDFS", str(stub_so))
@@ -304,3 +317,77 @@ def test_hash64_stable():
     assert a != hash64("node_124")
     assert hash64(b"node_123") == a           # bytes accepted
     assert 0 <= a < 2 ** 64
+
+
+def test_hdfs_dlopen_failure_is_clean_error(tmp_path):
+    """A missing/bad libhdfs must surface as a clear EngineError, not a
+    crash or link failure (r2 weak #5: no dlopen error-path coverage).
+    Runs in a subprocess because the loaded handle is cached per
+    process."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import os; os.environ['EULER_TPU_LIBHDFS'] = %r\n"
+        "from euler_tpu.graph import GraphEngine, EngineError\n"
+        "try:\n"
+        "    GraphEngine.load('hdfs://nn:9000/nope')\n"
+        "    print('NOERROR')\n"
+        "except EngineError as e:\n"
+        "    print('ERR:', e)\n"
+    ) % (str(repo), str(tmp_path / "no_such_libhdfs.so"))
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120,
+                          env={"PATH": "/usr/bin:/bin", "HOME": "/tmp",
+                               "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "ERR:" in proc.stdout
+    assert "libhdfs not found" in proc.stdout
+
+
+def test_hdfs_mid_read_failure_is_clean_error(tmp_path):
+    """libhdfs failing MID-read (network drop after some bytes) must
+    yield a short-read IOError, not a partial/corrupt load. Uses a stub
+    whose hdfsRead serves one chunk then errors."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    stub_so = build_hdfs_stub(tmp_path, read_body=r"""
+        int hdfsRead(void*, void* f, void* buf, int len) {
+          // serve at most 8 bytes once, then fail - a dropped DataNode
+          static int calls = 0;
+          if (++calls > 1) return -1;
+          return (int)fread(buf, 1, len < 8 ? len : 8, (FILE*)f);
+        }
+""")
+    root = tmp_path / "hdfs_root"
+    (root / "g").mkdir(parents=True)
+    # a meta.bin the stub will fail mid-read: GraphEngine.load's first
+    # HdfsReadFile must surface the short read, not parse garbage
+    (root / "g" / "meta.bin").write_bytes(b"x" * 64)
+
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from euler_tpu.graph import GraphEngine, EngineError\n"
+        "try:\n"
+        "    GraphEngine.load('hdfs://nn:9000/g')\n"
+        "    print('NOERROR')\n"
+        "except EngineError as e:\n"
+        "    print('ERR:', e)\n"
+    ) % (str(repo),)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120,
+        env={"PATH": "/usr/bin:/bin", "HOME": "/tmp",
+             "JAX_PLATFORMS": "cpu",
+             "EULER_TPU_LIBHDFS": str(stub_so),
+             "FAKE_HDFS_ROOT": str(root)})
+    out = proc.stdout
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "ERR:" in out, out
+    assert "short hdfs read" in out, out

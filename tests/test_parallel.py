@@ -667,3 +667,23 @@ def test_device_skipgram_and_unsup_sage_train():
         assert np.isfinite(res["loss"])
         ev = est.evaluate(input_fn, 4)
         assert 0.0 < ev["metric"] <= 1.0
+
+
+def test_graft_entry_selftest_subprocess():
+    """__graft_entry__.py's self-test mode (entry() compile +
+    dryrun_multichip(8) with the config-route backend switch) must run
+    clean in a fresh process WITHOUT the conftest env — the driver
+    invokes it under its own environment (r2 weak #8: the backend
+    juggling's error paths were untested)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        [sys.executable, str(repo / "__graft_entry__.py")],
+        capture_output=True, text=True, timeout=480, cwd=str(repo),
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/tmp"})
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "device-sampled step" in proc.stdout
+    assert "row-sharded over model" in proc.stdout

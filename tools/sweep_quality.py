@@ -113,7 +113,10 @@ def main():
                     results[key] = {
                         "error": (proc.stderr or proc.stdout)[-500:]}
                 else:
+                    # record BOTH splits; configs are SELECTED on val
+                    # (picking by test would tune on the reported split)
                     results[key] = {
+                        "val_metric": res.get("eval_metric"),
                         "test_metric": res.get("test_metric",
                                                res.get("eval_metric")),
                         "wall_s": round(time.time() - t0, 1)}
@@ -122,15 +125,18 @@ def main():
             out_path.write_text(json.dumps(results, indent=1,
                                            sort_keys=True))
             print(f"[{key}] -> {results[key]}", flush=True)
-    # ranked summary
+    # ranked summary — ORDERED BY VAL (the honest selection criterion);
+    # test shown alongside for the chosen row's report
     for target in SWEEPS:
-        rows = [(k, v.get("test_metric")) for k, v in results.items()
+        rows = [(k, v.get("val_metric"), v.get("test_metric"))
+                for k, v in results.items()
                 if k.startswith(target + ":") and "error" not in v]
-        rows.sort(key=lambda kv: -(kv[1] or 0))
+        rows.sort(key=lambda kv: -(kv[1] or kv[2] or 0))
         if rows:
-            print(f"\n== {target} ==")
-            for k, m in rows:
-                print(f"  {m:.3f}  {k}")
+            print(f"\n== {target} (val | test) ==")
+            for k, vm, tm in rows:
+                vm_s = f"{vm:.3f}" if vm else "  -  "
+                print(f"  {vm_s} | {tm:.3f}  {k}")
 
 
 if __name__ == "__main__":
