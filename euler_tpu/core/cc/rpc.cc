@@ -30,9 +30,14 @@ enum MsgType : uint32_t {
   kMeta = 1,
   kPing = 2,
   kRegPut = 3,     // body: entry name → registry stores/refreshes it
-  kRegList = 4,    // body: empty → u32 count | per entry: str name, i64 age
+  kRegList = 4,    // body: empty → u32 version | u32 count | per entry:
+                   // str name, i64 age_ms, u64 put-sequence
   kRegRemove = 5,  // body: entry name → dropped (clean shutdown)
 };
+
+// kRegList reply schema version: mixed-binary registry pairs must fail
+// loudly, not misparse (the reply has no other self-description).
+constexpr uint32_t kRegListVersion = 2;
 
 bool WriteAll(int fd, const char* p, size_t n) {
   while (n > 0) {
@@ -565,7 +570,7 @@ void RegistryServer::HandleConnection(int fd) {
       std::string name(body.data(), body.size());
       {
         std::lock_guard<std::mutex> lk(mu_);
-        entries_[name] = now_ms();
+        entries_[name] = {now_ms(), ++put_seq_};
       }
       w.Put<int32_t>(0);
     } else if (msg_type == kRegRemove) {
@@ -577,11 +582,13 @@ void RegistryServer::HandleConnection(int fd) {
       w.Put<int32_t>(0);
     } else if (msg_type == kRegList) {
       std::lock_guard<std::mutex> lk(mu_);
+      w.Put<uint32_t>(kRegListVersion);
       w.Put<uint32_t>(static_cast<uint32_t>(entries_.size()));
       int64_t now = now_ms();
       for (const auto& kv : entries_) {
         w.PutStr(kv.first);
-        w.Put<int64_t>(now - kv.second);
+        w.Put<int64_t>(now - kv.second.first);
+        w.Put<uint64_t>(kv.second.second);
       }
     } else {
       w.Put<int32_t>(-1);
@@ -667,23 +674,31 @@ Status ScanRegistrySpec(const std::string& spec,
     std::vector<char> reply;
     ET_RETURN_IF_ERROR(ch.Call(kRegList, {}, &reply, /*max_retries=*/2));
     ByteReader r(reply.data(), reply.size());
-    uint32_t n;
+    uint32_t ver, n;
+    if (!r.Get(&ver)) return Status::IOError("truncated registry listing");
+    if (ver != kRegListVersion)
+      return Status::IOError(
+          "registry protocol version mismatch: server speaks v" +
+          std::to_string(ver) + ", this client v" +
+          std::to_string(kRegListVersion) +
+          " — upgrade the older binary");
     if (!r.Get(&n)) return Status::IOError("truncated registry listing");
-    std::map<int, int64_t> best_age;
+    std::map<int, uint64_t> best_seq;
     for (uint32_t i = 0; i < n; ++i) {
       std::string name;
       int64_t age;
-      if (!r.GetStr(&name) || !r.Get(&age))
+      uint64_t seq;
+      if (!r.GetStr(&name) || !r.Get(&age) || !r.Get(&seq))
         return Status::IOError("truncated registry entry");
       int idx, port;
       std::string host;
       if (!ParseShardEntry(name, &idx, &host, &port)) continue;
       // duplicate indices (a crashed server's entry + its replacement):
-      // the YOUNGEST heartbeat wins — a stale ghost must not shadow the
-      // live registration
-      auto it = best_age.find(idx);
-      if (it != best_age.end() && it->second <= age) continue;
-      best_age[idx] = age;
+      // the LATEST registration wins — the server's put sequence is
+      // exact insertion recency (ms ages tie within a clock tick)
+      auto it = best_seq.find(idx);
+      if (it != best_seq.end() && it->second >= seq) continue;
+      best_seq[idx] = seq;
       (*found)[idx] = {host, port};
       if (ages_ms != nullptr) (*ages_ms)[idx] = age;
     }

@@ -174,8 +174,11 @@ class RegistryServer {
   std::atomic<bool> stopping_{false};
   std::thread acceptor_;
   std::mutex mu_;  // guards entries_ and conns_
-  // name → last-put steady time (ms)
-  std::map<std::string, int64_t> entries_;
+  // name → (last-put steady time ms, put sequence). The sequence breaks
+  // same-millisecond ties: clients pick the entry with the HIGHEST seq
+  // per shard (exact insertion recency), while age drives staleness.
+  std::map<std::string, std::pair<int64_t, uint64_t>> entries_;
+  uint64_t put_seq_ = 0;
   // parallel vectors: connection thread, its fd, and a finished flag
   // (reaped opportunistically in AcceptLoop; finished conns' fds are
   // already closed and must not be shutdown() again)

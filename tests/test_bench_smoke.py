@@ -1,0 +1,44 @@
+"""bench.py contract tests: the driver depends on exactly one JSON line
+per invocation, in every mode — including the walk modes added in r3."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _run(extra):
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--smoke"] + extra,
+        capture_output=True, text=True, timeout=420, cwd=str(REPO),
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/tmp",
+             "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, proc.stdout[-1500:]
+    return json.loads(lines[0])
+
+
+def test_bench_smoke_graphsage_device_and_host():
+    dev = _run([])
+    assert dev["metric"] == "graphsage_train_edges_per_sec_per_chip"
+    assert dev["value"] > 0
+    assert dev["detail"]["sampler"] == "device"
+    assert 0.0 <= dev["detail"]["edge_keep_frac"] <= 1.0
+    host = _run(["--host_sampler"])
+    assert host["detail"]["sampler"] == "host"
+    assert host["value"] > 0
+
+
+def test_bench_smoke_walk_modes():
+    dev = _run(["--walk"])
+    assert dev["metric"] == "deepwalk_train_pairs_per_sec_per_chip"
+    assert dev["detail"]["sampler"] == "device"
+    assert dev["value"] > 0
+    host = _run(["--walk", "--host_sampler"])
+    assert host["detail"]["sampler"] == "host"
+    assert host["value"] > 0

@@ -96,7 +96,10 @@ def main():
         for cfg in grid:
             key = f"{target}:" + ",".join(
                 f"{k}={v}" for k, v in sorted(cfg.items())) or f"{target}:default"
-            if key in results and "error" not in results[key]:
+            if key in results and "error" not in results[key] \
+                    and "val_metric" in results[key]:
+                # rows recorded before val_metric existed re-run, or the
+                # val-ordered summary would silently rank them by test
                 continue
             cmd = [sys.executable, str(REPO / script), "--platform", "cpu"]
             if "--dataset" not in cfg and target != "graphgcn":
@@ -136,7 +139,8 @@ def main():
             print(f"\n== {target} (val | test) ==")
             for k, vm, tm in rows:
                 vm_s = f"{vm:.3f}" if vm else "  -  "
-                print(f"  {vm_s} | {tm:.3f}  {k}")
+                tm_s = f"{tm:.3f}" if tm else "  -  "
+                print(f"  {vm_s} | {tm_s}  {k}")
 
 
 if __name__ == "__main__":
