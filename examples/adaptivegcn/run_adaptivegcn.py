@@ -12,8 +12,13 @@ from common import citation_argparser, run_citation  # noqa: E402
 
 
 def main(argv=None):
-    args = citation_argparser(dropout=0.5, weight_decay=0.005,
-                              max_steps=300).parse_args(argv)
+    ap = citation_argparser(dropout=-1.0, weight_decay=0.005,
+                            max_steps=300)
+    args = ap.parse_args(argv)
+    if args.dropout < 0:
+        # cora: 0.6 beats 0.5 on VAL (r3 probe, 0.804 vs 0.788 — test
+        # 0.817); the other sets keep 0.5
+        args.dropout = 0.6 if args.dataset == "cora" else 0.5
     return run_citation("graph", args, conv_kwargs=None)
 
 

@@ -16,13 +16,16 @@ from euler_tpu.platform import add_platform_flag, init_platform  # noqa: E402
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--dataset", default="cora")
-    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--dim", type=int, default=0,
+                    help="0 = auto (256 on pubmed — r3 probe lifts MRR "
+                         "0.966→0.990, 128 otherwise)")
     ap.add_argument("--order", type=int, default=2, choices=[1, 2])
     ap.add_argument("--num_negs", type=int, default=5)
     ap.add_argument("--batch_size", type=int, default=128)
-    ap.add_argument("--learning_rate", type=float, default=0.025)
+    ap.add_argument("--learning_rate", type=float, default=0.0,
+                    help="0 = auto (0.05 on pubmed, 0.025 otherwise)")
     ap.add_argument("--max_steps", type=int, default=0,
-                help="0 = auto: ~8 epochs over the edge set")
+                help="0 = auto: 8000 on pubmed, ~8 epochs otherwise")
     ap.add_argument("--eval_steps", type=int, default=20)
     ap.add_argument("--device_sampler", action="store_true",
                     help="positives (1-hop weighted draw) + negatives "
@@ -41,9 +44,13 @@ def main(argv=None):
 
     data = get_dataset(args.dataset)
     g = data.engine
+    is_pubmed = args.dataset == "pubmed"
+    args.dim = args.dim or (256 if is_pubmed else 128)
+    args.learning_rate = args.learning_rate or (0.05 if is_pubmed
+                                                else 0.025)
     if not args.max_steps:
-        args.max_steps = max(500,
-                             int(8 * g.edge_count / args.batch_size))
+        args.max_steps = 8000 if is_pubmed else max(
+            500, int(8 * g.edge_count / args.batch_size))
     if args.device_sampler:
         # LINE as a walk_len-1 skip-gram: (src, 1-hop weighted neighbor)
         # pairs ≡ weighted edge sampling given roots ~ node weights;

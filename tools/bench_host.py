@@ -184,9 +184,44 @@ def bench_scale(args):
     record(out)
 
 
+def bench_walk(args):
+    """Host walk-feeder rate (the reference's random_walk_op topology):
+    engine random_walk + host gen_pair + global negative draws, per
+    training batch — the number the device walk path competes with."""
+    from euler_tpu.ops.walk_ops import gen_pair
+
+    g, ingest_s, finalize_s, n_edges = build_graph(
+        args.nodes, args.degree, feat_dim=0)
+    walk_len, lwin, rwin, negs = 5, 1, 1, 5
+    roots = g.sample_node(args.batch, -1)
+
+    def one_batch():
+        walks = g.random_walk(roots, walk_len)
+        pairs = gen_pair(walks, lwin, rwin)
+        flat = pairs.reshape(-1, 2)
+        g.sample_node(flat.shape[0] * negs, -1)
+
+    one_batch()  # warm
+    t0 = time.time()
+    reps = 0
+    while time.time() - t0 < args.seconds:
+        one_batch()
+        reps += 1
+    dt = time.time() - t0
+    record({
+        "bench": "host_walk_feeder",
+        "nodes": args.nodes, "edges": n_edges, "batch": args.batch,
+        "walk_len": walk_len, "num_negs": negs,
+        "batches_per_sec": round(reps / dt, 3),
+        "walk_edges_per_sec": round(reps * args.batch * walk_len / dt),
+        "reps": reps,
+    })
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=["fanout", "scale"], default="fanout")
+    ap.add_argument("--mode", choices=["fanout", "scale", "walk"],
+                    default="fanout")
     ap.add_argument("--nodes", type=int, default=100_000)
     ap.add_argument("--degree", type=int, default=15)
     ap.add_argument("--feat_dim", type=int, default=0)
@@ -197,6 +232,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.mode == "fanout":
         bench_fanout(args)
+    elif args.mode == "walk":
+        bench_walk(args)
     else:
         bench_scale(args)
 
