@@ -120,6 +120,12 @@ def setup_tables(args, n_nodes, avg_degree, feat_dim, num_classes,
     from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
 
     dt = jnp.bfloat16 if args.bf16 else jnp.float32
+    # walk models (DeviceSampledSkipGram → walk_rows) read the split
+    # nbr/cum tables; the fused layout only serves the fanout path
+    fused = args.fused_sampler and not args.walk
+    if args.fused_sampler and args.walk:
+        print("bench: --fused_sampler ignored in --walk mode "
+              "(walk_rows reads the split tables)", file=sys.stderr)
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".bench_cache")
     # precision rides the key: a bf16-written cache holds bf16-quantized
@@ -132,7 +138,8 @@ def setup_tables(args, n_nodes, avg_degree, feat_dim, num_classes,
         stats = {k: z[k].item() for k in
                  ("hub_frac", "edge_keep_frac", "max_degree")}
         sampler = None if args.host_sampler else \
-            DeviceNeighborTable.from_arrays(z["nbr"], z["cum"], stats=stats)
+            DeviceNeighborTable.from_arrays(z["nbr"], z["cum"], stats=stats,
+                                            fused=fused)
         store = DeviceFeatureStore.from_arrays(
             z["feat"].astype(np.dtype(dt), copy=False), z["label"])
         graph = _CachedGraph(n_nodes, int(z["edge_count"]))
@@ -140,7 +147,7 @@ def setup_tables(args, n_nodes, avg_degree, feat_dim, num_classes,
     data = build_products_like(n_nodes, avg_degree, feat_dim, num_classes)
     graph = data.engine
     sampler = None if args.host_sampler else DeviceNeighborTable(
-        graph, cap=args.cap, keep_host=use_cache)
+        graph, cap=args.cap, keep_host=use_cache, fused=fused)
     store = DeviceFeatureStore(graph, ["feature"], label_fid="label",
                                label_dim=num_classes, dtype=dt,
                                keep_host=use_cache)
@@ -251,7 +258,9 @@ def run_walk_bench(args, graph, sampler, cache_state, setup_secs,
             "num_negs": num_negs,
             "steps": done,
             "steps_per_sec": round(done / dt, 2),
-            "sampler": "host" if sampler is None else "device",
+            "sampler": "host" if sampler is None else (
+                "device_fused" if getattr(sampler, "fused", False)
+                else "device"),
             "steps_per_loop": spl,
             "graph_cache": cache_state,
             "setup_secs": round(setup_secs, 1),
@@ -392,7 +401,9 @@ def run_bench(args):
             "window_steps_per_sec": [round(r, 2) for r in window_rates],
             "peak_edges_per_sec": round(edges_per_step * max(window_rates)),
             "final_loss": res["loss"],
-            "sampler": "host" if sampler is None else "device",
+            "sampler": "host" if sampler is None else (
+                "device_fused" if getattr(sampler, "fused", False)
+                else "device"),
             "sampler_cap": None if sampler is None else sampler.cap,
             # cap-truncation telemetry (VERDICT r2 weak #2): what share
             # of nodes exceed the cap and what share of edges the HBM
@@ -429,6 +440,10 @@ def main(argv=None):
     ap.add_argument("--host_sampler", action="store_true", default=False,
                     help="sample fanouts on the host engine (the "
                          "reference topology) instead of on device")
+    ap.add_argument("--fused_sampler", action="store_true", default=False,
+                    help="fused [N+1, 2C] sampling table: one row gather "
+                         "per hop (candidate headline config — excluded "
+                         "from the BENCH_TPU cache until proven)")
     ap.add_argument("--steps_per_loop", type=int, default=0,
                     help="0 = auto (16 on TPU, 1 in smoke/CPU mode): "
                          "lax.scan window per device dispatch")
@@ -474,7 +489,8 @@ def main(argv=None):
                           and not args.steps and not args.feat_dim
                           and args.cap == 32 and not args.steps_per_loop
                           and not args.avg_degree and not args.walk
-                          and not args.host_sampler and not args.fp32)
+                          and not args.host_sampler and not args.fp32
+                          and not args.fused_sampler)
         if result.get("detail", {}).get("backend") == "tpu" \
                 and default_shapes:
             # only canonical default-config runs refresh the cache — a

@@ -72,7 +72,7 @@ class DeviceSampledGraphSage(SuperviseModel):
 
     def embed(self, batch: Dict[str, Any]) -> Array:
         from euler_tpu.parallel.device_sampler import (
-            make_table_gather, sample_fanout_rows,
+            make_table_gather, sample_fanout_rows, sample_fanout_rows_fused,
         )
         from euler_tpu.utils.encoders import GCNEncoder
 
@@ -84,9 +84,21 @@ class DeviceSampledGraphSage(SuperviseModel):
         gather = make_table_gather(self.table_mesh)
         sharded = self.table_mesh is not None and dict(
             self.table_mesh.shape).get("model", 1) > 1
-        rows = sample_fanout_rows(batch["nbr_table"], batch["cum_table"],
-                                  roots, tuple(self.fanouts), key,
-                                  gather=gather if sharded else None)
+        if batch.get("nbrcum_table") is not None:
+            if sharded:
+                raise ValueError(
+                    "fused sampling table is replicated-only — build "
+                    "DeviceNeighborTable with shard_rows=True (split "
+                    "tables) when row-sharding over the model axis")
+            # fused [N+1, 2C] layout (DeviceNeighborTable(fused=True)):
+            # one row gather per hop instead of cum + neighbor gathers
+            rows = sample_fanout_rows_fused(batch["nbrcum_table"], roots,
+                                            tuple(self.fanouts), key)
+        else:
+            rows = sample_fanout_rows(
+                batch["nbr_table"], batch["cum_table"],
+                roots, tuple(self.fanouts), key,
+                gather=gather if sharded else None)
         table = batch["feature_table"]
         layers = [gather(table, r) for r in rows]
         if self.encoder == "gcn":

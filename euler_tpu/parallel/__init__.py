@@ -12,9 +12,12 @@ from euler_tpu.parallel.sharded_embedding import (  # noqa: F401
 )
 from euler_tpu.parallel.device_sampler import (  # noqa: F401
     DeviceNeighborTable,
+    fuse_tables,
     make_table_gather,
     sample_fanout_rows,
+    sample_fanout_rows_fused,
     sample_hop,
+    sample_hop_fused,
 )
 from euler_tpu.parallel.placement import (  # noqa: F401
     put_replicated,

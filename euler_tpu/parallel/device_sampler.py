@@ -53,8 +53,15 @@ class DeviceNeighborTable:
     def __init__(self, graph, cap: int = 32, edge_types=None,
                  seed: int = 0,
                  mesh: Optional[jax.sharding.Mesh] = None,
-                 keep_host: bool = False, shard_rows: bool = False):
+                 keep_host: bool = False, shard_rows: bool = False,
+                 fused: bool = False):
+        if fused and shard_rows:
+            raise ValueError(
+                "fused=True is replicated-only: the fused [N+1, 2C] "
+                "layout has no masked-take+psum gather path; use the "
+                "split tables with shard_rows=True")
         self.shard_rows = bool(shard_rows)
+        self.fused = bool(fused)
         ids = graph.all_node_ids()
         n = len(ids)
         self.cap = int(cap)
@@ -75,11 +82,17 @@ class DeviceNeighborTable:
     def from_arrays(cls, nbr_tab: np.ndarray, cum_tab: np.ndarray,
                     stats: Optional[dict] = None,
                     mesh: Optional[jax.sharding.Mesh] = None,
-                    shard_rows: bool = False):
+                    shard_rows: bool = False, fused: bool = False):
         """Rehydrate from prebuilt [N+1, C] tables (e.g. a bench/dataset
         cache) without a live graph engine."""
+        if fused and shard_rows:
+            raise ValueError(
+                "fused=True is replicated-only: the fused [N+1, 2C] "
+                "layout has no masked-take+psum gather path; use the "
+                "split tables with shard_rows=True")
         self = cls.__new__(cls)
         self.shard_rows = bool(shard_rows)
+        self.fused = bool(fused)
         self.cap = int(nbr_tab.shape[1])
         self.pad_row = int(nbr_tab.shape[0]) - 1
         for k in ("hub_frac", "edge_keep_frac", "max_degree"):
@@ -166,7 +179,17 @@ class DeviceNeighborTable:
             put_replicated, put_row_sharded,
         )
 
-        if self.shard_rows:
+        if getattr(self, "fused", False):
+            # one [N+1, 2C] i32 table (ids + bitcast cum): one row gather
+            # per hop in sample_hop_fused. Split views are not uploaded —
+            # fused mode exists to cut HBM gathers, not to double memory.
+            host_fused = np.concatenate(
+                [nbr_tab.astype(np.int32, copy=False),
+                 cum.astype(np.float32, copy=False).view(np.int32)], axis=1)
+            self.fused_table = put_replicated(host_fused, mesh)
+            self.neighbors = None
+            self.cum_weights = None
+        elif self.shard_rows:
             self.neighbors = put_row_sharded(nbr_tab, mesh)
             self.cum_weights = put_row_sharded(cum, mesh)
         else:
@@ -176,7 +199,55 @@ class DeviceNeighborTable:
     @property
     def tables(self):
         """Arrays to merge into the estimator's static_batch."""
+        if getattr(self, "fused", False):
+            return {"nbrcum_table": self.fused_table}
         return {"nbr_table": self.neighbors, "cum_table": self.cum_weights}
+
+
+def fuse_tables(nbr_tab, cum_tab):
+    """Interleave neighbor ids and cumulative weights into one
+    [N+1, 2C] int32 table (cum bitcast to i32): sample_hop then reads a
+    node's full sampling state with ONE 2C-wide row gather instead of a
+    cum-row gather plus a separate flattened neighbor-id gather. At
+    products scale the per-hop gathers are the step's dominant cost, so
+    halving the gather count on the sampling side is a direct win; the
+    f32 bits ride an i32 lane and are bitcast back in-jit (exact)."""
+    import jax.numpy as jnp
+
+    nbr = jnp.asarray(nbr_tab)
+    cum_bits = jax.lax.bitcast_convert_type(
+        jnp.asarray(cum_tab, jnp.float32), jnp.int32)
+    return jnp.concatenate([nbr.astype(jnp.int32), cum_bits], axis=1)
+
+
+def sample_hop_fused(fused_table: jax.Array, rows: jax.Array,
+                     count: int, key) -> jax.Array:
+    """sample_hop over a fuse_tables() layout: one row gather yields
+    both the C neighbor ids and the C cumulative weights; the chosen
+    column is then picked locally with take_along_axis (operand already
+    in registers/VMEM — no second HBM gather)."""
+    C = fused_table.shape[1] // 2
+    n = rows.shape[0]
+    row = jnp.take(fused_table, rows, axis=0)              # [n, 2C]
+    nbr = row[:, :C]
+    cum = jax.lax.bitcast_convert_type(row[:, C:], jnp.float32)
+    total = cum[:, -1]
+    u = jax.random.uniform(key, (n, count)) * total[:, None]
+    col = (cum[:, None, :] <= u[:, :, None]).sum(-1)
+    col = jnp.clip(col, 0, C - 1).astype(jnp.int32)
+    return jnp.take_along_axis(nbr, col, axis=1).reshape(-1)
+
+
+def sample_fanout_rows_fused(fused_table: jax.Array, roots: jax.Array,
+                             fanouts: Sequence[int], key):
+    """sample_fanout_rows over a fuse_tables() layout."""
+    layers = [roots]
+    cur = roots
+    for k in fanouts:
+        key, sub = jax.random.split(key)
+        cur = sample_hop_fused(fused_table, cur, int(k), sub)
+        layers.append(cur)
+    return layers
 
 
 def make_table_gather(mesh: Optional[jax.sharding.Mesh] = None,

@@ -49,7 +49,7 @@ def replicated(mesh: Mesh) -> NamedSharding:
 # batch keys that carry HBM-resident lookup tables rather than per-step
 # data — replicated by default in shard_batch
 REPLICATED_TABLE_KEYS = ("feature_table", "label_table",
-                         "nbr_table", "cum_table")
+                         "nbr_table", "cum_table", "nbrcum_table")
 
 
 def shard_batch(batch: Dict, mesh: Mesh,

@@ -687,3 +687,57 @@ def test_graft_entry_selftest_subprocess():
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "device-sampled step" in proc.stdout
     assert "row-sharded over model" in proc.stdout
+
+
+def test_fused_sampling_matches_split_tables():
+    """fuse_tables + sample_hop_fused must reproduce the split-table
+    sampler draw-for-draw under the same key (the fused layout is a
+    gather-count optimization, not a different sampler)."""
+    import jax
+    import jax.numpy as jnp
+
+    from euler_tpu.parallel import (
+        DeviceNeighborTable, fuse_tables, sample_fanout_rows,
+        sample_fanout_rows_fused, sample_hop, sample_hop_fused,
+    )
+
+    g, ids = _weighted_ring()
+    t = DeviceNeighborTable(g, cap=4)
+    fused = fuse_tables(t.neighbors, t.cum_weights)
+    assert fused.shape == (t.neighbors.shape[0], 8)
+    assert fused.dtype == jnp.int32
+
+    rows = jnp.asarray(g.node_rows(ids), jnp.int32)
+    key = jax.random.key(3)
+    a = sample_hop(t.neighbors, t.cum_weights, rows, 6, key)
+    b = sample_hop_fused(fused, rows, 6, key)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    la = sample_fanout_rows(t.neighbors, t.cum_weights, rows, (3, 2),
+                            jax.random.key(9))
+    lb = sample_fanout_rows_fused(fused, rows, (3, 2), jax.random.key(9))
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_fused_sampling_pad_row_resolves_to_pad():
+    """Zero-degree rows keep the pad convention through the fused path."""
+    import jax
+    import jax.numpy as jnp
+
+    from euler_tpu.graph import GraphBuilder
+    from euler_tpu.parallel import (
+        DeviceNeighborTable, fuse_tables, sample_hop_fused,
+    )
+
+    b = GraphBuilder()
+    b.add_nodes(np.array([1, 2], dtype=np.uint64))
+    b.add_edges(np.array([1], dtype=np.uint64),
+                np.array([2], dtype=np.uint64))
+    g = b.finalize()
+    t = DeviceNeighborTable(g, cap=2)
+    fused = fuse_tables(t.neighbors, t.cum_weights)
+    iso = jnp.asarray(g.node_rows(np.array([2], dtype=np.uint64)),
+                      jnp.int32)
+    out = sample_hop_fused(fused, iso, 3, jax.random.key(0))
+    assert set(np.asarray(out).tolist()) == {t.pad_row}

@@ -1,0 +1,361 @@
+"""Decompose the products-scale device-sampled train step on real TPU.
+
+VERDICT r2 next-step #10: the bench headline (27.4M edges/s/chip at
+products scale) sits well below the 128M scan ceiling measured on the
+small graph; PERF.md fingers the hop-2 feature gather. This script
+measures each component of the step in isolation on the same cached
+bench tables so the attack lands on the real bottleneck:
+
+  python tools/profile_device_step.py            # all probes
+  python tools/profile_device_step.py --probe gather
+
+Measurement notes (both matter on the axon remote-TPU tunnel):
+  - tables ride as jit ARGUMENTS — closing over device arrays bakes
+    them into the HLO as literals and the remote-compile endpoint
+    rejects the ~600MB request body (HTTP 413);
+  - every probe is a lax.scan of SCAN_LEN iterations whose inputs vary
+    per iteration (fold_in / index-perturbation), timed as one
+    dispatch — repeated dispatch of an IDENTICAL (executable, args)
+    pair returns in ~0.2ms regardless of the real device time (a
+    result cache somewhere in the tunnel), so naive per-call timing
+    reads 1000x fast.
+
+Writes a JSON summary to stdout (one object per probe).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+SCAN_LEN = 16
+
+
+def _timeit(fn, *args, reps=3):
+    """fn(*args, seed) must run SCAN_LEN internally-varied iterations;
+    returns per-iteration seconds, min over reps (each rep gets a fresh
+    seed so no two dispatches are identical)."""
+    import jax
+
+    jax.block_until_ready(fn(*args, 0))   # compile
+    best = float("inf")
+    for r in range(1, reps + 1):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args, r))
+        best = min(best, (time.perf_counter() - t0) / SCAN_LEN)
+    return best
+
+
+def load_tables(cache_dir, nodes, deg, feat, classes, cap):
+    key = f"g_n{nodes}_d{deg}_f{feat}_c{classes}_cap{cap}_bf16_v1.npz"
+    path = os.path.join(cache_dir, key)
+    if not os.path.exists(path):
+        raise SystemExit(f"bench cache missing: {path} — run bench.py first")
+    z = np.load(path)
+    return z["nbr"], z["cum"], z["feat"], z["label"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe", default="all",
+                    help="all|step|sample|gather|encoder")
+    ap.add_argument("--nodes", type=int, default=2_450_000)
+    ap.add_argument("--avg_degree", type=int, default=50)
+    ap.add_argument("--feat_dim", type=int, default=100)
+    ap.add_argument("--classes", type=int, default=16)
+    ap.add_argument("--cap", type=int, default=32)
+    ap.add_argument("--batch", type=int, default=32768)
+    ap.add_argument("--fanouts", default="15,10")
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".bench_cache")
+    nbr_h, cum_h, feat_h, label_h = load_tables(
+        cache, args.nodes, args.avg_degree, args.feat_dim, args.classes,
+        args.cap)
+    fanouts = tuple(int(x) for x in args.fanouts.split(","))
+    B = args.batch
+    N = nbr_h.shape[0] - 1
+    nbr = jax.device_put(nbr_h)
+    cum = jax.device_put(cum_h)
+    feat = jax.device_put(feat_h.astype(np.float32)).astype(jnp.bfloat16)
+    label = jax.device_put(label_h.astype(np.float32))
+    del nbr_h, cum_h, feat_h
+    print(f"# backend={jax.default_backend()} N={N} cap={args.cap} "
+          f"feat_dim={feat.shape[1]} B={B} fanouts={fanouts} "
+          f"scan_len={SCAN_LEN}", file=sys.stderr)
+
+    from euler_tpu.parallel.device_sampler import (
+        sample_fanout_rows, sample_hop,
+    )
+
+    key = jax.random.key(7)
+    roots = jax.random.randint(key, (B,), 0, N, dtype=jnp.int32)
+    results = {}
+    probes = args.probe.split(",")
+
+    def want(p):
+        return "all" in probes or p in probes
+
+    def scanned(body):
+        """body(carry_sum, i, seed) -> value; returns jitted fn running
+        SCAN_LEN iterations with a carried dependency."""
+
+        @jax.jit
+        def run(*args_and_seed):
+            *xs, seed = args_and_seed
+
+            def step(c, i):
+                v = body(c, i, seed, *xs)
+                return c + v.astype(jnp.float32), None
+
+            out, _ = jax.lax.scan(step, jnp.float32(0),
+                                  jnp.arange(SCAN_LEN))
+            return out
+
+        return run
+
+    # a cheap per-iteration perturbation keeping rows in [0, N]
+    def perturb(rr, i, seed):
+        return (rr + (i + 1) * (seed * 131071 % 1000003)) % (N + 1)
+
+    @jax.jit
+    def sample_rows(nbr, cum, roots, seed):
+        k = jax.random.fold_in(jax.random.key(17), seed)
+        return sample_fanout_rows(nbr, cum, roots, fanouts, k)
+
+    rows_all = jax.block_until_ready(sample_rows(nbr, cum, roots, 0))
+
+    # ---- sampling only -------------------------------------------------
+    if want("sample"):
+        def samp(c, i, seed, nbr, cum, roots):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            rows = sample_fanout_rows(nbr, cum, roots, fanouts, k)
+            return sum(r.sum() for r in rows)
+
+        results["sample_only_ms"] = 1e3 * _timeit(
+            scanned(samp), nbr, cum, roots, reps=args.reps)
+
+        def hop2(c, i, seed, nbr, cum, r1):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            return sample_hop(nbr, cum, perturb(r1, i, seed),
+                              fanouts[1], k).sum()
+
+        results["sample_hop2_ms"] = 1e3 * _timeit(
+            scanned(hop2), nbr, cum, rows_all[1], reps=args.reps)
+
+        # fused layout: one [N+1, 2C] i32 table, one gather per hop
+        from euler_tpu.parallel.device_sampler import (
+            fuse_tables, sample_fanout_rows_fused, sample_hop_fused,
+        )
+
+        fused = jax.block_until_ready(
+            jax.jit(fuse_tables)(nbr, cum))
+
+        def sampf(c, i, seed, fused, roots):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            rows = sample_fanout_rows_fused(fused, roots, fanouts, k)
+            return sum(r.sum() for r in rows)
+
+        results["sample_only_fused_ms"] = 1e3 * _timeit(
+            scanned(sampf), fused, roots, reps=args.reps)
+
+        def hop2f(c, i, seed, fused, r1):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            return sample_hop_fused(fused, perturb(r1, i, seed),
+                                    fanouts[1], k).sum()
+
+        results["sample_hop2_fused_ms"] = 1e3 * _timeit(
+            scanned(hop2f), fused, rows_all[1], reps=args.reps)
+        del fused
+
+    # ---- feature gathers ----------------------------------------------
+    if want("gather"):
+        def mk_gather(post=None):
+            def g(c, i, seed, tab, rr):
+                r = perturb(rr, i, seed)
+                if post is not None:
+                    r = post(r)
+                return jnp.take(tab, r, axis=0).sum()
+            return g
+
+        for h, r in enumerate(rows_all):
+            results[f"feat_gather_h{h}_ms"] = 1e3 * _timeit(
+                scanned(mk_gather()), feat, r, reps=args.reps)
+            results[f"feat_gather_h{h}_rows"] = int(r.shape[0])
+        r2 = rows_all[-1]
+        results["feat_gather_h2_sortin_ms"] = 1e3 * _timeit(
+            scanned(mk_gather(jnp.sort)), feat, r2, reps=args.reps)
+
+        # fused gather+mean (what the encoder actually consumes)
+        k2 = fanouts[-1]
+
+        def gmean(c, i, seed, tab, rr):
+            x = jnp.take(tab, perturb(rr, i, seed), axis=0)
+            return x.reshape(-1, k2, tab.shape[1]).mean(axis=1).sum()
+
+        results["feat_gathermean_h2_ms"] = 1e3 * _timeit(
+            scanned(gmean), feat, r2, reps=args.reps)
+        # cum-table row gather at hop-1 scale (sampling's own gather)
+        results["cum_gather_h1rows_ms"] = 1e3 * _timeit(
+            scanned(mk_gather()), cum, rows_all[1], reps=args.reps)
+
+        # scalar gather (sample_hop's neighbor lookup at hop 2)
+        cols = jax.random.randint(key, (rows_all[1].shape[0] * k2,), 0,
+                                  args.cap, dtype=jnp.int32)
+
+        def scal(c, i, seed, nbr, rr, cols):
+            fl = jnp.repeat(perturb(rr, i, seed), k2) * args.cap + cols
+            return jnp.take(nbr.reshape(-1), fl).sum()
+
+        results["scalar_gather_h2_ms"] = 1e3 * _timeit(
+            scanned(scal), nbr, rows_all[1], cols, reps=args.reps)
+
+        # lane-padded feature table: 100 → 128 dims so each gathered row
+        # is one aligned 256B tile
+        featp = jax.block_until_ready(jax.jit(
+            lambda f: jnp.pad(f, ((0, 0), (0, 128 - f.shape[1]))))(feat))
+        results["feat_gather_h2_pad128_ms"] = 1e3 * _timeit(
+            scanned(mk_gather()), featp, r2, reps=args.reps)
+
+        def gmean_pad(c, i, seed, tab, rr):
+            x = jnp.take(tab, perturb(rr, i, seed), axis=0)
+            return x.reshape(-1, k2, tab.shape[1]).mean(axis=1).sum()
+
+        results["feat_gathermean_h2_pad128_ms"] = 1e3 * _timeit(
+            scanned(gmean_pad), featp, r2, reps=args.reps)
+        del featp
+
+        # promise_in_bounds: skip the clamp/oob handling in the gather
+        def g_pib(c, i, seed, tab, rr):
+            return jnp.take(tab, perturb(rr, i, seed), axis=0,
+                            mode="promise_in_bounds").sum()
+
+        results["feat_gather_h2_pib_ms"] = 1e3 * _timeit(
+            scanned(g_pib), feat, r2, reps=args.reps)
+
+        # fused pallas gather+mean kernel (ops/pallas_ops.py)
+        from euler_tpu.ops.pallas_ops import _pallas_gather_mean
+
+        def gm_pallas(c, i, seed, tab, rr):
+            r = perturb(rr, i, seed).reshape(-1, k2)
+            return _pallas_gather_mean(tab, r).sum()
+
+        try:
+            results["feat_gathermean_h2_pallas_ms"] = 1e3 * _timeit(
+                scanned(gm_pallas), feat, r2, reps=args.reps)
+        except Exception as e:  # noqa: BLE001 — probe is best-effort
+            results["feat_gathermean_h2_pallas_error"] = repr(e)[:200]
+
+    # ---- encoder fwd+bwd on fixed layers --------------------------------
+    if want("encoder"):
+        from euler_tpu.utils.encoders import SageEncoder
+
+        gj = jax.jit(lambda tab, rr: jnp.take(tab, rr, axis=0))
+        layers = [jax.block_until_ready(gj(feat, r)) for r in rows_all]
+        enc = SageEncoder(128, fanouts, "mean")
+        p0 = enc.init(jax.random.key(0), layers)
+
+        def loss_fn(p, layers):
+            return (enc.apply(p, layers).astype(jnp.float32) ** 2).mean()
+
+        def encfb(c, i, seed, p0, *layers):
+            # perturb layer 0 so each iteration's grads differ
+            l0 = layers[0] + (i * seed).astype(jnp.bfloat16)
+            l, g = jax.value_and_grad(loss_fn)(
+                p0, [l0, *layers[1:]])
+            return l + sum(jnp.sum(x).astype(jnp.float32)
+                           for x in jax.tree.leaves(g))
+
+        results["encoder_fb_ms"] = 1e3 * _timeit(
+            scanned(encfb), p0, *layers, reps=args.reps)
+
+    # ---- full step ------------------------------------------------------
+    if want("step"):
+        import optax
+
+        from euler_tpu.models import DeviceSampledGraphSage
+
+        model = DeviceSampledGraphSage(
+            num_classes=args.classes, multilabel=False, dim=128,
+            fanouts=fanouts)
+        batch0 = {"rows": [roots], "sample_seed": jnp.int32(0),
+                  "nbr_table": nbr, "cum_table": cum,
+                  "feature_table": feat,
+                  "labels": jax.jit(
+                      lambda l, r: jnp.take(l, r, axis=0))(label, roots)}
+        params = model.init(jax.random.key(0), batch0)
+        tx = optax.adam(1e-2)
+        opt0 = tx.init(params)
+
+        def loss_fn(p, batch):
+            return model.apply(p, batch).loss
+
+        @jax.jit
+        def run_steps(params, opt, nbr, cum, feat, label, roots, seed):
+            def step(carry, i):
+                p, o = carry
+                r = perturb(roots, i, seed)
+                batch = {"rows": [r], "sample_seed": seed * 1000 + i,
+                         "nbr_table": nbr, "cum_table": cum,
+                         "feature_table": feat,
+                         "labels": jnp.take(label, r, axis=0)}
+                l, g = jax.value_and_grad(loss_fn)(p, batch)
+                up, o = tx.update(g, o, p)
+                return (optax.apply_updates(p, up), o), l
+
+            (p, o), ls = jax.lax.scan(step, (params, opt),
+                                      jnp.arange(SCAN_LEN))
+            return ls.sum()
+
+        results["full_step_ms"] = 1e3 * _timeit(
+            run_steps, params, opt0, nbr, cum, feat, label, roots,
+            reps=args.reps)
+        epe = B * (fanouts[0] + fanouts[0] * fanouts[1])
+        results["full_step_edges_per_sec"] = round(
+            epe / (results["full_step_ms"] / 1e3))
+
+        # same step over the fused sampling table
+        from euler_tpu.parallel.device_sampler import fuse_tables
+
+        fused = jax.block_until_ready(jax.jit(fuse_tables)(nbr, cum))
+
+        @jax.jit
+        def run_steps_fused(params, opt, fused, feat, label, roots, seed):
+            def step(carry, i):
+                p, o = carry
+                r = perturb(roots, i, seed)
+                batch = {"rows": [r], "sample_seed": seed * 1000 + i,
+                         "nbrcum_table": fused,
+                         "feature_table": feat,
+                         "labels": jnp.take(label, r, axis=0)}
+                l, g = jax.value_and_grad(loss_fn)(p, batch)
+                up, o = tx.update(g, o, p)
+                return (optax.apply_updates(p, up), o), l
+
+            (p, o), ls = jax.lax.scan(step, (params, opt),
+                                      jnp.arange(SCAN_LEN))
+            return ls.sum()
+
+        results["full_step_fused_ms"] = 1e3 * _timeit(
+            run_steps_fused, params, opt0, fused, feat, label, roots,
+            reps=args.reps)
+        results["full_step_fused_edges_per_sec"] = round(
+            epe / (results["full_step_fused_ms"] / 1e3))
+
+    print(json.dumps(results, indent=1))
+
+
+if __name__ == "__main__":
+    main()
