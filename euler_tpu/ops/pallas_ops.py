@@ -8,12 +8,14 @@ XLA expresses this as gather → reshape → mean, materializing the
 traffic). The fused kernel streams each neighbor row HBM→VMEM once and
 accumulates in VMEM, cutting HBM traffic to n·k·D·4 + n·D·4.
 
-gather_mean() defaults to the XLA formulation: on the current v5e
-bench (200k x 128 table, 16384 x 15 rows) the fused kernel is within 2x
-of XLA's gather in either direction depending on dispatch pipelining,
-with no reproducible win — XLA's TPU gather is already tight. The kernel
-stays as the opt-in (use_pallas=True) path and the template for
-neighbor-indexed fusions that XLA can't express (validated in interpret
+gather_mean() defaults to the XLA formulation: on the small v5e bench
+(200k x 128 table, 16384 x 15 rows) the fused kernel was within 2x of
+XLA's gather in either direction with no reproducible win — XLA's TPU
+gather is already tight there. At products scale (2.45M-row table) the
+balance may differ: tile_n is now a parameter so the profiler
+(tools/profile_device_step.py) can sweep DMA-batch sizes. The kernel
+remains the opt-in (use_pallas=True) path and the template for
+neighbor-indexed fusions XLA can't express (validated in interpret
 mode on CPU, numerics match to float tolerance).
 """
 
@@ -26,7 +28,7 @@ import jax.numpy as jnp
 
 Array = jax.Array
 
-# output rows processed per grid step: amortizes control overhead while
+# default output rows per grid step: amortizes control overhead while
 # keeping k·D scratch well under VMEM
 _TILE_N = 8
 
@@ -38,9 +40,9 @@ def _xla_gather_mean(table: Array, rows: Array) -> Array:
 
 
 def _kernel(rows_ref, table_ref, out_ref, scratch, sems):
-    """One grid step: gather k rows for each of _TILE_N outputs, reduce.
-    rows_ref is this step's (_TILE_N, k) index block in SMEM. All
-    _TILE_N·k row fetches are in flight at once (start all, then wait) —
+    """One grid step: gather k rows for each of tile_n outputs, reduce.
+    rows_ref is this step's (tile_n, k) index block in SMEM. All
+    tile_n·k row fetches are in flight at once (start all, then wait) —
     serializing them makes the kernel DMA-latency-bound."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -69,30 +71,30 @@ def _kernel(rows_ref, table_ref, out_ref, scratch, sems):
     out_ref[:, :] = jnp.mean(scratch[:, :].reshape(tile_n, k, d), axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _pallas_gather_mean(table: Array, rows: Array,
+@functools.partial(jax.jit, static_argnames=("tile_n", "interpret"))
+def _pallas_gather_mean(table: Array, rows: Array, tile_n: int = _TILE_N,
                         interpret: bool = False) -> Array:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n, k = rows.shape
     d = table.shape[-1]
-    assert n % _TILE_N == 0
+    assert n % tile_n == 0
     return pl.pallas_call(
         _kernel,
-        grid=(n // _TILE_N,),
+        grid=(n // tile_n,),
         in_specs=[
             # this step's index block rides SMEM (DMA addresses are
             # scalar reads); the table stays wherever it lives (HBM)
-            pl.BlockSpec((_TILE_N, k), lambda i: (i, 0),
+            pl.BlockSpec((tile_n, k), lambda i: (i, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((_TILE_N, d), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((tile_n, d), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((_TILE_N * k, d), table.dtype),
-            pltpu.SemaphoreType.DMA((_TILE_N * k,)),
+            pltpu.VMEM((tile_n * k, d), table.dtype),
+            pltpu.SemaphoreType.DMA((tile_n * k,)),
         ],
         out_shape=jax.ShapeDtypeStruct((n, d), table.dtype),
         interpret=interpret,
@@ -100,7 +102,7 @@ def _pallas_gather_mean(table: Array, rows: Array,
 
 
 def gather_mean(table: Array, rows: Array,
-                use_pallas: bool = False) -> Array:
+                use_pallas: bool = False, tile_n: int = _TILE_N) -> Array:
     """out[i] = mean over k of table[rows[i]]; rows [n, k] int32.
 
     use_pallas=True runs the fused Pallas kernel on TPU when shapes allow
@@ -109,6 +111,6 @@ def gather_mean(table: Array, rows: Array,
     """
     n, k = rows.shape
     on_tpu = jax.default_backend() == "tpu"
-    if not use_pallas or not on_tpu or n % _TILE_N != 0:
+    if not use_pallas or not on_tpu or n % tile_n != 0:
         return _xla_gather_mean(table, rows)
-    return _pallas_gather_mean(table, rows)
+    return _pallas_gather_mean(table, rows, tile_n=tile_n)

@@ -183,10 +183,8 @@ class DeviceNeighborTable:
             # one [N+1, 2C] i32 table (ids + bitcast cum): one row gather
             # per hop in sample_hop_fused. Split views are not uploaded —
             # fused mode exists to cut HBM gathers, not to double memory.
-            host_fused = np.concatenate(
-                [nbr_tab.astype(np.int32, copy=False),
-                 cum.astype(np.float32, copy=False).view(np.int32)], axis=1)
-            self.fused_table = put_replicated(host_fused, mesh)
+            self.fused_table = put_replicated(
+                fuse_tables_host(nbr_tab, cum), mesh)
             self.neighbors = None
             self.cum_weights = None
         elif self.shard_rows:
@@ -204,6 +202,16 @@ class DeviceNeighborTable:
         return {"nbr_table": self.neighbors, "cum_table": self.cum_weights}
 
 
+def fuse_tables_host(nbr_tab: np.ndarray, cum_tab: np.ndarray) -> np.ndarray:
+    """Host-side fuse_tables (numpy view bitcast, no device transfer) —
+    the layout contract is defined ONCE here; fuse_tables mirrors it on
+    device and a unit test pins the two equal bit-for-bit."""
+    return np.concatenate(
+        [np.asarray(nbr_tab).astype(np.int32, copy=False),
+         np.asarray(cum_tab).astype(np.float32, copy=False)
+            .view(np.int32)], axis=1)
+
+
 def fuse_tables(nbr_tab, cum_tab):
     """Interleave neighbor ids and cumulative weights into one
     [N+1, 2C] int32 table (cum bitcast to i32): sample_hop then reads a
@@ -211,7 +219,8 @@ def fuse_tables(nbr_tab, cum_tab):
     cum-row gather plus a separate flattened neighbor-id gather. At
     products scale the per-hop gathers are the step's dominant cost, so
     halving the gather count on the sampling side is a direct win; the
-    f32 bits ride an i32 lane and are bitcast back in-jit (exact)."""
+    f32 bits ride an i32 lane and are bitcast back in-jit (exact).
+    Layout contract shared with fuse_tables_host."""
     import jax.numpy as jnp
 
     nbr = jnp.asarray(nbr_tab)

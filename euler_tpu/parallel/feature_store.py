@@ -81,12 +81,16 @@ class DeviceFeatureStore:
                     labels: Optional[np.ndarray] = None,
                     ids: Optional[np.ndarray] = None,
                     mesh: Optional[jax.sharding.Mesh] = None,
-                    shard_rows: bool = False):
+                    shard_rows: bool = False,
+                    pad_dim_to: Optional[int] = None):
         """Rehydrate from prebuilt arrays (a cache) without a graph
         engine. `features`/`labels` must already carry the trailing pad
         row; `ids` (sorted u64, len N) backs lookup() via searchsorted —
         when omitted, node ids are taken to BE table rows (dense-id
-        graphs, e.g. the bench cache)."""
+        graphs, e.g. the bench cache). pad_dim_to zero-pads the feature
+        dim up to a lane multiple (e.g. 128) so each gathered row is an
+        aligned tile — a throughput knob; downstream Dense layers see
+        the wider (zero-extended) features."""
         self = cls.__new__(cls)
         self._graph = None
         self.host_arrays = None
@@ -101,6 +105,12 @@ class DeviceFeatureStore:
 
         put = (lambda x: put_row_sharded(x, mesh)) if shard_rows else \
             (lambda x: put_replicated(x, mesh))
+        if pad_dim_to is not None and features.shape[1] < pad_dim_to:
+            features = np.concatenate(
+                [features,
+                 np.zeros((features.shape[0],
+                           pad_dim_to - features.shape[1]),
+                          features.dtype)], axis=1)
         self.features = put(np.ascontiguousarray(features))
         self.labels = None
         if labels is not None:

@@ -144,6 +144,10 @@ def test_pallas_gather_mean_interpret():
     ref = _xla_gather_mean(table, rows)
     got = _pallas_gather_mean(table, rows, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
+    # tile_n sweeps the DMA-batch size; numerics must be invariant
+    got16 = _pallas_gather_mean(table, rows, tile_n=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(got16), np.asarray(ref),
+                               atol=1e-6)
     # public entry falls back to XLA off-TPU
     np.testing.assert_allclose(np.asarray(gather_mean(table, rows)),
                                np.asarray(ref), atol=1e-6)

@@ -719,6 +719,20 @@ def test_fused_sampling_matches_split_tables():
     for x, y in zip(la, lb):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
+    # the class's fused path (numpy-side fuse_tables_host in _place) must
+    # carry the SAME bit layout as the device-side fuse_tables, and its
+    # uploaded table must sample identically
+    from euler_tpu.parallel.device_sampler import fuse_tables_host
+
+    np.testing.assert_array_equal(
+        np.asarray(fused),
+        fuse_tables_host(np.asarray(t.neighbors), np.asarray(t.cum_weights)))
+    t_f = DeviceNeighborTable(g, cap=4, fused=True)
+    tab = t_f.tables["nbrcum_table"]
+    np.testing.assert_array_equal(np.asarray(tab), np.asarray(fused))
+    c = sample_hop_fused(tab, rows, 6, key)
+    np.testing.assert_array_equal(np.asarray(b), np.asarray(c))
+
 
 def test_fused_sampling_pad_row_resolves_to_pad():
     """Zero-degree rows keep the pad convention through the fused path."""
@@ -741,3 +755,69 @@ def test_fused_sampling_pad_row_resolves_to_pad():
                       jnp.int32)
     out = sample_hop_fused(fused, iso, 3, jax.random.key(0))
     assert set(np.asarray(out).tolist()) == {t.pad_row}
+
+
+def test_dryrun_backend_switch_error_paths():
+    """dryrun_multichip's platform-switch fallbacks (VERDICT r2 weak #8):
+    (a) backend already initialized with too few devices → the
+    clear_backends route recovers; (b) when every route fails, the
+    RuntimeError reports each route's error rather than a bare count."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    env = {"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/tmp"}
+
+    # (a) init the backend FIRST with 1 CPU device, then ask for 4
+    ok = subprocess.run(
+        [sys.executable, "-c", (
+            "import sys; sys.path.insert(0, %r)\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "assert len(jax.devices()) == 1\n"   # backend now live
+            "from __graft_entry__ import dryrun_multichip\n"
+            "dryrun_multichip(4)\n" % str(repo))],
+        capture_output=True, text=True, timeout=480, cwd=str(repo), env=env)
+    assert ok.returncode == 0, ok.stdout[-2000:] + ok.stderr[-2000:]
+    assert "device-sampled step" in ok.stdout
+
+    # (b) break both routes: clear_backends raising must surface its
+    # error in the final RuntimeError message
+    bad = subprocess.run(
+        [sys.executable, "-c", (
+            "import sys; sys.path.insert(0, %r)\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "assert len(jax.devices()) == 1\n"
+            "from jax.extend import backend as jex\n"
+            "def boom(): raise OSError('simulated plugin wedge')\n"
+            "jex.clear_backends = boom\n"
+            "import __graft_entry__ as ge\n"
+            "try:\n"
+            "    ge.dryrun_multichip(4)\n"
+            "except RuntimeError as e:\n"
+            "    assert 'simulated plugin wedge' in str(e), str(e)\n"
+            "    assert 'only 1 devices visible' in str(e), str(e)\n"
+            "    print('ERROR_PATH_OK')\n" % str(repo))],
+        capture_output=True, text=True, timeout=480, cwd=str(repo), env=env)
+    assert bad.returncode == 0, bad.stdout[-2000:] + bad.stderr[-2000:]
+    assert "ERROR_PATH_OK" in bad.stdout
+
+
+def test_feature_store_pad_dim_to():
+    """from_arrays(pad_dim_to=...) zero-extends the feature dim (aligned
+    gather rows); lookups and row semantics are unchanged."""
+    import jax.numpy as jnp
+
+    from euler_tpu.parallel import DeviceFeatureStore
+
+    feats = np.arange(12, dtype=np.float32).reshape(4, 3)  # 3 rows + pad
+    store = DeviceFeatureStore.from_arrays(feats, pad_dim_to=8)
+    assert store.dim == 8
+    got = np.asarray(jnp.take(store.features, jnp.arange(4), axis=0))
+    np.testing.assert_array_equal(got[:, :3], feats)
+    np.testing.assert_array_equal(got[:, 3:], 0)
+    # wider than requested pad → left untouched
+    store2 = DeviceFeatureStore.from_arrays(feats, pad_dim_to=2)
+    assert store2.dim == 3

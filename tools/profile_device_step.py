@@ -229,12 +229,9 @@ def main():
         results["feat_gather_h2_pad128_ms"] = 1e3 * _timeit(
             scanned(mk_gather()), featp, r2, reps=args.reps)
 
-        def gmean_pad(c, i, seed, tab, rr):
-            x = jnp.take(tab, perturb(rr, i, seed), axis=0)
-            return x.reshape(-1, k2, tab.shape[1]).mean(axis=1).sum()
-
+        # gmean reads k2/tab.shape[1] inside the body — reuse it
         results["feat_gathermean_h2_pad128_ms"] = 1e3 * _timeit(
-            scanned(gmean_pad), featp, r2, reps=args.reps)
+            scanned(gmean), featp, r2, reps=args.reps)
         del featp
 
         # promise_in_bounds: skip the clamp/oob handling in the gather
@@ -245,18 +242,23 @@ def main():
         results["feat_gather_h2_pib_ms"] = 1e3 * _timeit(
             scanned(g_pib), feat, r2, reps=args.reps)
 
-        # fused pallas gather+mean kernel (ops/pallas_ops.py)
+        # fused pallas gather+mean kernel (ops/pallas_ops.py), sweeping
+        # the DMA-batch size (tile_n output rows per grid step)
         from euler_tpu.ops.pallas_ops import _pallas_gather_mean
 
-        def gm_pallas(c, i, seed, tab, rr):
-            r = perturb(rr, i, seed).reshape(-1, k2)
-            return _pallas_gather_mean(tab, r).sum()
+        for tile in (8, 32, 128):
+            def gm_pallas(c, i, seed, tab, rr, _tile=tile):
+                r = perturb(rr, i, seed).reshape(-1, k2)
+                return _pallas_gather_mean(tab, r, tile_n=_tile).sum()
 
-        try:
-            results["feat_gathermean_h2_pallas_ms"] = 1e3 * _timeit(
-                scanned(gm_pallas), feat, r2, reps=args.reps)
-        except Exception as e:  # noqa: BLE001 — probe is best-effort
-            results["feat_gathermean_h2_pallas_error"] = repr(e)[:200]
+            try:
+                results[f"feat_gathermean_h2_pallas_t{tile}_ms"] = \
+                    1e3 * _timeit(scanned(gm_pallas), feat, r2,
+                                  reps=args.reps)
+            except Exception as e:  # noqa: BLE001 — probe is best-effort
+                results[f"feat_gathermean_h2_pallas_t{tile}_error"] = \
+                    repr(e)[:200]
+                break
 
     # ---- encoder fwd+bwd on fixed layers --------------------------------
     if want("encoder"):
