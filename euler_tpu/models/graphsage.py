@@ -134,7 +134,8 @@ class DeviceSampledUnsupervisedSage(nn.Module):
 
         from euler_tpu.mp_utils.base import ModelOutput
         from euler_tpu.parallel.device_sampler import (
-            sample_fanout_rows, sample_hop,
+            sample_fanout_rows, sample_fanout_rows_fused, sample_hop,
+            sample_hop_fused,
         )
         from euler_tpu.parallel.device_walk import sample_global_rows
         from euler_tpu.utils import metrics as M
@@ -144,14 +145,23 @@ class DeviceSampledUnsupervisedSage(nn.Module):
         pad = self.num_rows
         key = jax.random.fold_in(jax.random.key(29), batch["sample_seed"])
         kf, kp, kn = jax.random.split(key, 3)
-        rows = sample_fanout_rows(batch["nbr_table"], batch["cum_table"],
-                                  roots, tuple(self.fanouts), kf)
+        fused_tab = batch.get("nbrcum_table")
+        if fused_tab is not None:
+            rows = sample_fanout_rows_fused(fused_tab, roots,
+                                            tuple(self.fanouts), kf)
+        else:
+            rows = sample_fanout_rows(batch["nbr_table"],
+                                      batch["cum_table"],
+                                      roots, tuple(self.fanouts), kf)
         table = batch["feature_table"]
         layers = [jnp.take(table, r, axis=0) for r in rows]
         emb = SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
                           concat=False, name="encoder")(layers)   # [B, D]
-        pos_r = sample_hop(batch["nbr_table"], batch["cum_table"], roots,
-                           1, kp)                                 # [B]
+        if fused_tab is not None:
+            pos_r = sample_hop_fused(fused_tab, roots, 1, kp)     # [B]
+        else:
+            pos_r = sample_hop(batch["nbr_table"], batch["cum_table"],
+                               roots, 1, kp)                      # [B]
         negs_r = sample_global_rows(batch["neg_rows"], batch["neg_cum"],
                                     kn, (roots.shape[0], self.num_negs))
         ctx = Embedding(self.num_rows + 1, self.dim, name="ctx_emb")

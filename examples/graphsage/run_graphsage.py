@@ -29,6 +29,9 @@ def main(argv=None):
                          "(DeviceNeighborTable; features+labels "
                          "move to HBM tables)")
     ap.add_argument("--sampler_cap", type=int, default=32)
+    ap.add_argument("--fused_sampler", action="store_true",
+                    help="with --device_sampler (supervised): one fused "
+                         "[N+1, 2C] HBM table, one row gather per hop")
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--num_negs", type=int, default=5)
     ap.add_argument("--learning_rate", type=float, default=0.003)
@@ -67,7 +70,8 @@ def main(argv=None):
             store = DeviceFeatureStore(data.engine, ["feature"],
                                        label_fid="label",
                                        label_dim=data.num_classes)
-            sampler = DeviceNeighborTable(data.engine, cap=args.sampler_cap)
+            sampler = DeviceNeighborTable(data.engine, cap=args.sampler_cap,
+                                          fused=args.fused_sampler)
             model = DeviceSampledGraphSage(
                 num_classes=data.num_classes, multilabel=data.multilabel,
                 dim=args.hidden_dim, fanouts=fanouts,
@@ -100,7 +104,8 @@ def main(argv=None):
 
         g = data.engine
         store = DeviceFeatureStore(g, ["feature"])
-        tab = DeviceNeighborTable(g, cap=args.sampler_cap)
+        tab = DeviceNeighborTable(g, cap=args.sampler_cap,
+                                  fused=args.fused_sampler)
         neg = DeviceNodeSampler(g, node_type=-1)
         model = DeviceSampledUnsupervisedSage(
             num_rows=tab.pad_row, dim=args.hidden_dim, fanouts=fanouts,

@@ -821,3 +821,32 @@ def test_feature_store_pad_dim_to():
     # wider than requested pad → left untouched
     store2 = DeviceFeatureStore.from_arrays(feats, pad_dim_to=2)
     assert store2.dim == 3
+
+
+def test_unsupervised_fused_matches_split(ring_graph):
+    """DeviceSampledUnsupervisedSage under a fused table reproduces the
+    split-table loss exactly (same seeds → same draws)."""
+    import jax
+
+    from euler_tpu.models import DeviceSampledUnsupervisedSage
+    from euler_tpu.parallel import (
+        DeviceFeatureStore, DeviceNeighborTable, DeviceNodeSampler,
+    )
+
+    g = ring_graph
+    ids = np.arange(1, 11, dtype=np.uint64)
+    store = DeviceFeatureStore(g, ["f_dense"])
+    neg = DeviceNodeSampler(g, node_type=-1)
+    roots = store.lookup(ids[:8])
+    model = DeviceSampledUnsupervisedSage(
+        num_rows=store.pad_row, dim=8, fanouts=(3, 2), num_negs=3)
+
+    losses = {}
+    for mode in ("split", "fused"):
+        tab = DeviceNeighborTable(g, cap=4, fused=(mode == "fused"))
+        batch = {"rows": [roots], "sample_seed": np.uint32(7),
+                 "feature_table": store.features, **tab.tables,
+                 **neg.tables}
+        params = model.init(jax.random.key(0), batch)
+        losses[mode] = float(model.apply(params, batch).loss)
+    assert losses["split"] == losses["fused"], losses
