@@ -9,16 +9,21 @@ bench tables so the attack lands on the real bottleneck:
   python tools/profile_device_step.py            # all probes
   python tools/profile_device_step.py --probe gather
 
-Measurement notes (both matter on the axon remote-TPU tunnel):
+Measurement notes (all three matter on the axon remote-TPU tunnel):
   - tables ride as jit ARGUMENTS — closing over device arrays bakes
     them into the HLO as literals and the remote-compile endpoint
     rejects the ~600MB request body (HTTP 413);
   - every probe is a lax.scan of SCAN_LEN iterations whose inputs vary
     per iteration (fold_in / index-perturbation), timed as one
-    dispatch — repeated dispatch of an IDENTICAL (executable, args)
-    pair returns in ~0.2ms regardless of the real device time (a
-    result cache somewhere in the tunnel), so naive per-call timing
-    reads 1000x fast.
+    dispatch, and each rep varies the seed argument so no two
+    dispatches are identical;
+  - the timed sync is a host VALUE fetch (np.asarray of the scalar),
+    NOT jax.block_until_ready — on this tunnel block_until_ready
+    returns without waiting for device execution, so a block-based
+    timer reads ~30µs for any program whatsoever. The `rtt_ms` result
+    is the dispatch+fetch floor for a trivial program; real probe
+    costs are (probe_ms·SCAN_LEN − rtt) / SCAN_LEN ≈ probe_ms for
+    anything slower than ~0.5ms/iter.
 
 Writes a JSON summary to stdout (one object per probe).
 """
@@ -39,16 +44,19 @@ SCAN_LEN = 16
 
 
 def _timeit(fn, *args, reps=3):
-    """fn(*args, seed) must run SCAN_LEN internally-varied iterations;
-    returns per-iteration seconds, min over reps (each rep gets a fresh
-    seed so no two dispatches are identical)."""
-    import jax
+    """fn(*args, seed) must run SCAN_LEN internally-varied iterations
+    and return a SCALAR; returns per-iteration seconds, min over reps
+    (each rep gets a fresh seed so no two dispatches are identical).
 
-    jax.block_until_ready(fn(*args, 0))   # compile
+    Timing is dispatch→host VALUE fetch, not block_until_ready: on the
+    axon tunnel block_until_ready returns without waiting for device
+    execution (measured: a 16×1GB-gather scan "completed" in 30µs),
+    so only reading the result bytes bounds the real device time."""
+    np.asarray(fn(*args, 0))   # compile + run to completion
     best = float("inf")
     for r in range(1, reps + 1):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args, r))
+        np.asarray(fn(*args, r))
         best = min(best, (time.perf_counter() - t0) / SCAN_LEN)
     return best
 
@@ -74,7 +82,16 @@ def main():
     ap.add_argument("--batch", type=int, default=32768)
     ap.add_argument("--fanouts", default="15,10")
     ap.add_argument("--reps", type=int, default=3)
+    from euler_tpu.platform import add_platform_flag, init_platform
+
+    add_platform_flag(ap)
     args = ap.parse_args()
+    # guarded backend init: with the TPU tunnel down, a bare `import
+    # jax; jax.devices()` hangs indefinitely even under
+    # JAX_PLATFORMS=cpu (the injected plugin blocks at registration) —
+    # the subprocess probe + config fallback in euler_tpu.platform is
+    # the only reliable path to a CPU run on this host
+    init_platform(args.platform, verbose=True)
 
     import jax
     import jax.numpy as jnp
@@ -105,8 +122,27 @@ def main():
     results = {}
     probes = args.probe.split(",")
 
+    def measure(name, fn, *margs, scale=1.0, **kw):
+        """Record one probe; a failing probe logs and never loses the
+        session's other measurements (each result prints as it lands —
+        TPU windows are too scarce to forfeit a partial run). scale
+        multiplies the per-iteration time (for probes that are not a
+        SCAN_LEN scan, e.g. the single-dispatch rtt probe)."""
+        try:
+            results[name] = 1e3 * _timeit(fn, *margs, **kw) * scale
+        except Exception as e:  # noqa: BLE001 — probes are best-effort
+            results[name + "_error"] = repr(e)[:200]
+        print(f"# {name} = {results.get(name, results.get(name + '_error'))}",
+              file=sys.stderr, flush=True)
+
     def want(p):
         return "all" in probes or p in probes
+
+    # dispatch+value-fetch floor: a trivial scalar program through the
+    # same timing path, so readers can judge how much of a small probe
+    # is tunnel round-trip rather than device work
+    measure("rtt_ms", jax.jit(lambda x, seed: x * 1.0 + seed),
+            jnp.float32(1), scale=SCAN_LEN, reps=args.reps)
 
     def scanned(body):
         """body(carry_sum, i, seed) -> value; returns jitted fn running
@@ -144,16 +180,16 @@ def main():
             rows = sample_fanout_rows(nbr, cum, roots, fanouts, k)
             return sum(r.sum() for r in rows)
 
-        results["sample_only_ms"] = 1e3 * _timeit(
-            scanned(samp), nbr, cum, roots, reps=args.reps)
+        measure("sample_only_ms", scanned(samp), nbr, cum, roots,
+                reps=args.reps)
 
         def hop2(c, i, seed, nbr, cum, r1):
             k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
             return sample_hop(nbr, cum, perturb(r1, i, seed),
                               fanouts[1], k).sum()
 
-        results["sample_hop2_ms"] = 1e3 * _timeit(
-            scanned(hop2), nbr, cum, rows_all[1], reps=args.reps)
+        measure("sample_hop2_ms", scanned(hop2), nbr, cum, rows_all[1],
+                reps=args.reps)
 
         # fused layout: one [N+1, 2C] i32 table, one gather per hop
         from euler_tpu.parallel.device_sampler import (
@@ -168,16 +204,16 @@ def main():
             rows = sample_fanout_rows_fused(fused, roots, fanouts, k)
             return sum(r.sum() for r in rows)
 
-        results["sample_only_fused_ms"] = 1e3 * _timeit(
-            scanned(sampf), fused, roots, reps=args.reps)
+        measure("sample_only_fused_ms", scanned(sampf), fused, roots,
+                reps=args.reps)
 
         def hop2f(c, i, seed, fused, r1):
             k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
             return sample_hop_fused(fused, perturb(r1, i, seed),
                                     fanouts[1], k).sum()
 
-        results["sample_hop2_fused_ms"] = 1e3 * _timeit(
-            scanned(hop2f), fused, rows_all[1], reps=args.reps)
+        measure("sample_hop2_fused_ms", scanned(hop2f), fused, rows_all[1],
+                reps=args.reps)
         del fused
 
     # ---- feature gathers ----------------------------------------------
@@ -191,12 +227,12 @@ def main():
             return g
 
         for h, r in enumerate(rows_all):
-            results[f"feat_gather_h{h}_ms"] = 1e3 * _timeit(
-                scanned(mk_gather()), feat, r, reps=args.reps)
+            measure(f"feat_gather_h{h}_ms",
+                    scanned(mk_gather()), feat, r, reps=args.reps)
             results[f"feat_gather_h{h}_rows"] = int(r.shape[0])
         r2 = rows_all[-1]
-        results["feat_gather_h2_sortin_ms"] = 1e3 * _timeit(
-            scanned(mk_gather(jnp.sort)), feat, r2, reps=args.reps)
+        measure("feat_gather_h2_sortin_ms", scanned(mk_gather(jnp.sort)),
+                feat, r2, reps=args.reps)
 
         # fused gather+mean (what the encoder actually consumes)
         k2 = fanouts[-1]
@@ -205,11 +241,11 @@ def main():
             x = jnp.take(tab, perturb(rr, i, seed), axis=0)
             return x.reshape(-1, k2, tab.shape[1]).mean(axis=1).sum()
 
-        results["feat_gathermean_h2_ms"] = 1e3 * _timeit(
-            scanned(gmean), feat, r2, reps=args.reps)
+        measure("feat_gathermean_h2_ms", scanned(gmean), feat, r2,
+                reps=args.reps)
         # cum-table row gather at hop-1 scale (sampling's own gather)
-        results["cum_gather_h1rows_ms"] = 1e3 * _timeit(
-            scanned(mk_gather()), cum, rows_all[1], reps=args.reps)
+        measure("cum_gather_h1rows_ms", scanned(mk_gather()), cum,
+                rows_all[1], reps=args.reps)
 
         # scalar gather (sample_hop's neighbor lookup at hop 2)
         cols = jax.random.randint(key, (rows_all[1].shape[0] * k2,), 0,
@@ -219,28 +255,29 @@ def main():
             fl = jnp.repeat(perturb(rr, i, seed), k2) * args.cap + cols
             return jnp.take(nbr.reshape(-1), fl).sum()
 
-        results["scalar_gather_h2_ms"] = 1e3 * _timeit(
-            scanned(scal), nbr, rows_all[1], cols, reps=args.reps)
+        measure("scalar_gather_h2_ms", scanned(scal), nbr, rows_all[1],
+                cols, reps=args.reps)
 
         # lane-padded feature table: 100 → 128 dims so each gathered row
         # is one aligned 256B tile
         featp = jax.block_until_ready(jax.jit(
             lambda f: jnp.pad(f, ((0, 0), (0, 128 - f.shape[1]))))(feat))
-        results["feat_gather_h2_pad128_ms"] = 1e3 * _timeit(
-            scanned(mk_gather()), featp, r2, reps=args.reps)
+        measure("feat_gather_h2_pad128_ms", scanned(mk_gather()), featp,
+                r2, reps=args.reps)
 
         # gmean reads k2/tab.shape[1] inside the body — reuse it
-        results["feat_gathermean_h2_pad128_ms"] = 1e3 * _timeit(
-            scanned(gmean), featp, r2, reps=args.reps)
+        measure("feat_gathermean_h2_pad128_ms", scanned(gmean), featp, r2,
+                reps=args.reps)
         del featp
 
         # promise_in_bounds: skip the clamp/oob handling in the gather
+        # (jnp.take has no such mode; it lives on the .at[] indexing API)
         def g_pib(c, i, seed, tab, rr):
-            return jnp.take(tab, perturb(rr, i, seed), axis=0,
-                            mode="promise_in_bounds").sum()
+            return tab.at[perturb(rr, i, seed)].get(
+                mode="promise_in_bounds").sum()
 
-        results["feat_gather_h2_pib_ms"] = 1e3 * _timeit(
-            scanned(g_pib), feat, r2, reps=args.reps)
+        measure("feat_gather_h2_pib_ms", scanned(g_pib), feat, r2,
+                reps=args.reps)
 
         # fused pallas gather+mean kernel (ops/pallas_ops.py), sweeping
         # the DMA-batch size (tile_n output rows per grid step)
@@ -251,13 +288,9 @@ def main():
                 r = perturb(rr, i, seed).reshape(-1, k2)
                 return _pallas_gather_mean(tab, r, tile_n=_tile).sum()
 
-            try:
-                results[f"feat_gathermean_h2_pallas_t{tile}_ms"] = \
-                    1e3 * _timeit(scanned(gm_pallas), feat, r2,
-                                  reps=args.reps)
-            except Exception as e:  # noqa: BLE001 — probe is best-effort
-                results[f"feat_gathermean_h2_pallas_t{tile}_error"] = \
-                    repr(e)[:200]
+            measure(f"feat_gathermean_h2_pallas_t{tile}_ms",
+                    scanned(gm_pallas), feat, r2, reps=args.reps)
+            if f"feat_gathermean_h2_pallas_t{tile}_ms" not in results:
                 break
 
     # ---- encoder fwd+bwd on fixed layers --------------------------------
@@ -280,8 +313,8 @@ def main():
             return l + sum(jnp.sum(x).astype(jnp.float32)
                            for x in jax.tree.leaves(g))
 
-        results["encoder_fb_ms"] = 1e3 * _timeit(
-            scanned(encfb), p0, *layers, reps=args.reps)
+        measure("encoder_fb_ms", scanned(encfb), p0, *layers,
+                reps=args.reps)
 
     # ---- full step ------------------------------------------------------
     if want("step"):
@@ -321,12 +354,12 @@ def main():
                                       jnp.arange(SCAN_LEN))
             return ls.sum()
 
-        results["full_step_ms"] = 1e3 * _timeit(
-            run_steps, params, opt0, nbr, cum, feat, label, roots,
-            reps=args.reps)
+        measure("full_step_ms", run_steps, params, opt0, nbr, cum,
+                feat, label, roots, reps=args.reps)
         epe = B * (fanouts[0] + fanouts[0] * fanouts[1])
-        results["full_step_edges_per_sec"] = round(
-            epe / (results["full_step_ms"] / 1e3))
+        if "full_step_ms" in results:
+            results["full_step_edges_per_sec"] = round(
+                epe / (results["full_step_ms"] / 1e3))
 
         # same step over the fused sampling table
         from euler_tpu.parallel.device_sampler import fuse_tables
@@ -350,11 +383,11 @@ def main():
                                       jnp.arange(SCAN_LEN))
             return ls.sum()
 
-        results["full_step_fused_ms"] = 1e3 * _timeit(
-            run_steps_fused, params, opt0, fused, feat, label, roots,
-            reps=args.reps)
-        results["full_step_fused_edges_per_sec"] = round(
-            epe / (results["full_step_fused_ms"] / 1e3))
+        measure("full_step_fused_ms", run_steps_fused, params, opt0,
+                fused, feat, label, roots, reps=args.reps)
+        if "full_step_fused_ms" in results:
+            results["full_step_fused_edges_per_sec"] = round(
+                epe / (results["full_step_fused_ms"] / 1e3))
 
         # split-chain variant: the batch processed as two independent
         # half-chains (sample→gather→encode), losses averaged — the
@@ -394,11 +427,11 @@ def main():
                                       jnp.arange(SCAN_LEN))
             return ls.sum()
 
-        results["full_step_split2_ms"] = 1e3 * _timeit(
-            run_steps_split, params, opt0, nbr, cum, feat, label, roots,
-            reps=args.reps)
-        results["full_step_split2_edges_per_sec"] = round(
-            epe / (results["full_step_split2_ms"] / 1e3))
+        measure("full_step_split2_ms", run_steps_split, params, opt0,
+                nbr, cum, feat, label, roots, reps=args.reps)
+        if "full_step_split2_ms" in results:
+            results["full_step_split2_edges_per_sec"] = round(
+                epe / (results["full_step_split2_ms"] / 1e3))
 
     print(json.dumps(results, indent=1))
 
