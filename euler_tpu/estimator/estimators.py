@@ -53,6 +53,10 @@ class NodeEstimator(BaseEstimator):
         self._seed_counters = {0: 0, 1: 0}
         if feature_store is not None:
             self.static_batch["feature_table"] = feature_store.features
+            if getattr(feature_store, "feature_scale", None) is not None:
+                # int8-quantized table: models dequantize after gather
+                self.static_batch["feature_scale"] = \
+                    feature_store.feature_scale
             if feature_store.labels is not None:
                 self.static_batch["label_table"] = feature_store.labels
         if device_sampler is not None:

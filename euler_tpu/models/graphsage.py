@@ -18,6 +18,22 @@ from euler_tpu.utils.encoders import SageEncoder, ScalableSageEncoder, ShallowEn
 Array = jax.Array
 
 
+def gather_feature_rows(batch: Dict[str, Any], rows, gather=None):
+    """table[rows] for each hop's rows, honoring an int8-quantized
+    table: when the batch carries 'feature_scale'
+    (DeviceFeatureStore(quantize='int8')), the gathered int8 rows are
+    dequantized by the per-column scale — the multiply fuses into the
+    consumer, and the gather itself moves half the HBM bytes."""
+    from euler_tpu.parallel.feature_store import dequantize_rows
+
+    table = batch["feature_table"]
+    take = gather or (lambda t, r: jax.numpy.take(t, r, axis=0))
+    scale = batch.get("feature_scale")
+    if scale is None:
+        return [take(table, r) for r in rows]
+    return [dequantize_rows(take(table, r), scale) for r in rows]
+
+
 def _fanout_layers(batch: Dict[str, Any]):
     """Per-hop feature arrays from either batch geometry:
       'layers'               — features shipped from the host (engine path)
@@ -28,8 +44,7 @@ def _fanout_layers(batch: Dict[str, Any]):
     layers = batch.get("layers")
     if layers is not None:
         return layers
-    table = batch["feature_table"]
-    return [jax.numpy.take(table, r, axis=0) for r in batch["rows"]]
+    return gather_feature_rows(batch, batch["rows"])
 
 
 class SupervisedGraphSage(SuperviseModel):
@@ -99,8 +114,7 @@ class DeviceSampledGraphSage(SuperviseModel):
                 batch["nbr_table"], batch["cum_table"],
                 roots, tuple(self.fanouts), key,
                 gather=gather if sharded else None)
-        table = batch["feature_table"]
-        layers = [gather(table, r) for r in rows]
+        layers = gather_feature_rows(batch, rows, gather=gather)
         if self.encoder == "gcn":
             return GCNEncoder(self.dim, tuple(self.fanouts),
                               name="encoder")(layers)
@@ -153,8 +167,7 @@ class DeviceSampledUnsupervisedSage(nn.Module):
             rows = sample_fanout_rows(batch["nbr_table"],
                                       batch["cum_table"],
                                       roots, tuple(self.fanouts), kf)
-        table = batch["feature_table"]
-        layers = [jnp.take(table, r, axis=0) for r in rows]
+        layers = gather_feature_rows(batch, rows)
         emb = SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
                           concat=False, name="encoder")(layers)   # [B, D]
         if fused_tab is not None:

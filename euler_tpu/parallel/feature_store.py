@@ -25,6 +25,26 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def quantize_int8(feats: np.ndarray):
+    """Per-column symmetric int8 quantization: q = round(x/scale),
+    scale = colmax|x|/127. Returns (q int8, scale f32[D]). Halves the
+    bytes every feature-row gather moves out of HBM vs bf16 (the hop-2
+    gather dominates step HBM traffic at products scale) and halves the
+    table's HBM footprint; dequant (q·scale) runs after the gather,
+    fused into the consumer by XLA. All-zero columns get scale 1."""
+    scale = np.abs(feats).max(axis=0).astype(np.float32) / 127.0
+    scale[scale == 0] = 1.0
+    q = np.clip(np.rint(feats.astype(np.float32, copy=False) / scale),
+                -127, 127)
+    return q.astype(np.int8), scale
+
+
+def dequantize_rows(x, scale):
+    """Inverse of quantize_int8 for gathered rows; output dtype follows
+    scale (store the scale in the dtype you want features to train in)."""
+    return x.astype(scale.dtype) * scale
+
+
 class DeviceFeatureStore:
     """Uploads dense node features (and optionally labels) to device HBM
     once; translates u64 node ids → int32 table rows on the host.
@@ -40,7 +60,11 @@ class DeviceFeatureStore:
                  label_dim: Optional[int] = None,
                  dtype=jnp.float32,
                  mesh: Optional[jax.sharding.Mesh] = None,
-                 keep_host: bool = False, shard_rows: bool = False):
+                 keep_host: bool = False, shard_rows: bool = False,
+                 quantize: Optional[str] = None):
+        """quantize='int8' stores the feature table int8 with a
+        per-column scale (quantize_int8); the store exposes
+        feature_scale and models dequantize after the gather."""
         self.shard_rows = bool(shard_rows)
         # table rows follow ENGINE row order so lookup() is the engine's
         # O(1) hash translation (etg_node_rows), not a binary search
@@ -63,7 +87,16 @@ class DeviceFeatureStore:
 
         put = (lambda x: put_row_sharded(x, mesh)) if shard_rows else \
             (lambda x: put_replicated(x, mesh))
-        self.features = put(feats)
+        self.feature_scale = None
+        if quantize == "int8":
+            q, scale = quantize_int8(np.asarray(feats, np.float32))
+            self.features = put(q)
+            self.feature_scale = put_replicated(
+                scale.astype(np.dtype(dtype), copy=False), mesh)
+        elif quantize is not None:
+            raise ValueError(f"unknown quantize mode {quantize!r}")
+        else:
+            self.features = put(feats)
         self.labels = None
         labels = None
         if label_fid is not None:
@@ -82,7 +115,9 @@ class DeviceFeatureStore:
                     ids: Optional[np.ndarray] = None,
                     mesh: Optional[jax.sharding.Mesh] = None,
                     shard_rows: bool = False,
-                    pad_dim_to: Optional[int] = None):
+                    pad_dim_to: Optional[int] = None,
+                    quantize: Optional[str] = None,
+                    scale_dtype=jnp.float32):
         """Rehydrate from prebuilt arrays (a cache) without a graph
         engine. `features`/`labels` must already carry the trailing pad
         row; `ids` (sorted u64, len N) backs lookup() via searchsorted —
@@ -111,7 +146,16 @@ class DeviceFeatureStore:
                  np.zeros((features.shape[0],
                            pad_dim_to - features.shape[1]),
                           features.dtype)], axis=1)
-        self.features = put(np.ascontiguousarray(features))
+        self.feature_scale = None
+        if quantize == "int8":
+            q, scale = quantize_int8(np.asarray(features, np.float32))
+            self.features = put(np.ascontiguousarray(q))
+            self.feature_scale = put_replicated(
+                scale.astype(np.dtype(scale_dtype), copy=False), mesh)
+        elif quantize is not None:
+            raise ValueError(f"unknown quantize mode {quantize!r}")
+        else:
+            self.features = put(np.ascontiguousarray(features))
         self.labels = None
         if labels is not None:
             self.labels = put(

@@ -48,7 +48,7 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 # batch keys that carry HBM-resident lookup tables rather than per-step
 # data — replicated by default in shard_batch
-REPLICATED_TABLE_KEYS = ("feature_table", "label_table",
+REPLICATED_TABLE_KEYS = ("feature_table", "feature_scale", "label_table",
                          "nbr_table", "cum_table", "nbrcum_table")
 
 

@@ -120,6 +120,7 @@ def main():
     key = jax.random.key(7)
     roots = jax.random.randint(key, (B,), 0, N, dtype=jnp.int32)
     results = {}
+    results_arrays = {}   # device arrays shared across probe families
     probes = args.probe.split(",")
 
     def measure(name, fn, *margs, scale=1.0, **kw):
@@ -279,6 +280,33 @@ def main():
         measure("feat_gather_h2_pib_ms", scanned(g_pib), feat, r2,
                 reps=args.reps)
 
+        # int8-quantized table (DeviceFeatureStore(quantize='int8')):
+        # half the gather bytes, dequant fused into the consumer
+        from euler_tpu.parallel.feature_store import quantize_int8
+
+        q_h, scale_h = quantize_int8(np.asarray(
+            feat.astype(jnp.float32)))
+        featq = results_arrays["featq_cached"] = jax.device_put(q_h)
+        fscale = results_arrays["fscale_cached"] = jax.device_put(
+            scale_h.astype(np.float32))
+        del q_h
+
+        def g_q(c, i, seed, tab, sc, rr):
+            x = jnp.take(tab, perturb(rr, i, seed), axis=0)
+            return (x.astype(jnp.bfloat16) * sc.astype(jnp.bfloat16)).sum()
+
+        measure("feat_gather_h2_int8_ms", scanned(g_q), featq, fscale,
+                r2, reps=args.reps)
+
+        def gmean_q(c, i, seed, tab, sc, rr):
+            x = jnp.take(tab, perturb(rr, i, seed), axis=0)
+            x = x.astype(jnp.bfloat16) * sc.astype(jnp.bfloat16)
+            return x.reshape(-1, k2, tab.shape[1]).mean(axis=1).sum()
+
+        measure("feat_gathermean_h2_int8_ms", scanned(gmean_q), featq,
+                fscale, r2, reps=args.reps)
+        del featq
+
         # fused pallas gather+mean kernel (ops/pallas_ops.py), sweeping
         # the DMA-batch size (tile_n output rows per grid step)
         from euler_tpu.ops.pallas_ops import _pallas_gather_mean
@@ -292,6 +320,19 @@ def main():
                     scanned(gm_pallas), feat, r2, reps=args.reps)
             if f"feat_gathermean_h2_pallas_t{tile}_ms" not in results:
                 break
+
+        # pallas over a 128-lane-aligned table: the d=100 bf16 row DMA
+        # is tile-unaligned and the most likely mosaic-crash culprit
+        featp2 = jax.block_until_ready(jax.jit(
+            lambda f: jnp.pad(f, ((0, 0), (0, 128 - f.shape[1]))))(feat))
+
+        def gm_pallas_p(c, i, seed, tab, rr):
+            r = perturb(rr, i, seed).reshape(-1, k2)
+            return _pallas_gather_mean(tab, r, tile_n=32).sum()
+
+        measure("feat_gathermean_h2_pallas_pad128_ms",
+                scanned(gm_pallas_p), featp2, r2, reps=args.reps)
+        del featp2
 
     # ---- encoder fwd+bwd on fixed layers --------------------------------
     if want("encoder"):
@@ -388,6 +429,46 @@ def main():
         if "full_step_fused_ms" in results:
             results["full_step_fused_edges_per_sec"] = round(
                 epe / (results["full_step_fused_ms"] / 1e3))
+
+        # fused sampling table + int8 feature table together — the
+        # combination bench.py --fused_sampler --int8_features runs.
+        # reuse the gather probe's quantization when it already ran
+        # (the fp32 round-trip of the full table costs real minutes of
+        # a scarce TPU window)
+        if "featq_cached" not in results_arrays:
+            from euler_tpu.parallel.feature_store import quantize_int8
+
+            q_h, scale_h = quantize_int8(
+                np.asarray(feat.astype(jnp.float32)))
+            results_arrays["featq_cached"] = jax.device_put(q_h)
+            results_arrays["fscale_cached"] = jax.device_put(scale_h)
+            del q_h
+        featq = results_arrays["featq_cached"]
+        fscale = results_arrays["fscale_cached"].astype(jnp.bfloat16)
+
+        @jax.jit
+        def run_steps_fused_q(params, opt, fused, featq, fscale, label,
+                              roots, seed):
+            def step(carry, i):
+                p, o = carry
+                r = perturb(roots, i, seed)
+                batch = {"rows": [r], "sample_seed": seed * 1000 + i,
+                         "nbrcum_table": fused,
+                         "feature_table": featq, "feature_scale": fscale,
+                         "labels": jnp.take(label, r, axis=0)}
+                l, g = jax.value_and_grad(loss_fn)(p, batch)
+                up, o = tx.update(g, o, p)
+                return (optax.apply_updates(p, up), o), l
+
+            (p, o), ls = jax.lax.scan(step, (params, opt),
+                                      jnp.arange(SCAN_LEN))
+            return ls.sum()
+
+        measure("full_step_fused_int8_ms", run_steps_fused_q, params,
+                opt0, fused, featq, fscale, label, roots, reps=args.reps)
+        if "full_step_fused_int8_ms" in results:
+            results["full_step_fused_int8_edges_per_sec"] = round(
+                epe / (results["full_step_fused_int8_ms"] / 1e3))
 
         # split-chain variant: the batch processed as two independent
         # half-chains (sample→gather→encode), losses averaged — the
