@@ -8,6 +8,7 @@ from euler_tpu.models.embedding_models import (  # noqa: F401
 from euler_tpu.models.graphsage import (  # noqa: F401
     ScalableGraphSage,
     DeviceSampledGraphSage,
+    DeviceSampledLayerwiseGCN,
     DeviceSampledUnsupervisedSage,
     ShardedSupervisedGraphSage,
     SupervisedGraphSage,

@@ -126,6 +126,46 @@ class DeviceSampledGraphSage(SuperviseModel):
                            name="encoder")(layers)
 
 
+class DeviceSampledLayerwiseGCN(SuperviseModel):
+    """FastGCN/LADIES with sampling ON DEVICE: per-layer importance
+    pools, dense inter-pool adjacency, and feature gathers all run
+    in-jit over the HBM tables (parallel/device_layerwise.py); the host
+    ships root rows + a seed. Reference topology: API_SAMPLE_L
+    (sample_layer_op.cc:74) + LayerwiseDataFlow on the host."""
+
+    dim: int = 32
+    layer_sizes: Sequence[int] = (128, 128)
+    # per-layer input dropout inside LayerEncoder (the standard FastGCN
+    # setup) — distinct from SuperviseModel.dropout, which the base
+    # class applies once to the final embedding
+    layer_dropout: float = 0.0
+
+    def embed(self, batch: Dict[str, Any]) -> Array:
+        from euler_tpu.parallel.device_layerwise import sample_layerwise_rows
+        from euler_tpu.utils.encoders import LayerEncoder
+
+        if batch.get("nbrcum_table") is not None:
+            raise ValueError(
+                "DeviceSampledLayerwiseGCN needs the split nbr/cum "
+                "tables (pool weights come from the cum rows) — build "
+                "DeviceNeighborTable with fused=False")
+        if self.table_mesh is not None and dict(
+                self.table_mesh.shape).get("model", 1) > 1:
+            raise NotImplementedError(
+                "row-sharded tables are not supported for device "
+                "layerwise sampling (top-k pooling needs the full "
+                "candidate slot set) — use replicated tables "
+                "(shard_rows=False)")
+        roots = batch["rows"][0]
+        key = jax.random.fold_in(jax.random.key(31), batch["sample_seed"])
+        levels, adjs = sample_layerwise_rows(
+            batch["nbr_table"], batch["cum_table"], roots,
+            tuple(self.layer_sizes), key)
+        layers = gather_feature_rows(batch, levels)
+        return LayerEncoder(self.dim, dropout=self.layer_dropout,
+                            name="encoder")(layers, adjs)
+
+
 class DeviceSampledUnsupervisedSage(nn.Module):
     """Unsupervised GraphSAGE fully on device: the fanout embedding AND
     the positive/negative context pipeline run in-jit. Positives are one

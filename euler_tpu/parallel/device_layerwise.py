@@ -1,0 +1,82 @@
+"""Device-resident layerwise (LADIES/FastGCN) sampling.
+
+Completes the on-device input family: fanout (device_sampler.py) and
+walks (device_walk.py) already run in-jit; this moves the third
+sampling strategy — per-layer importance-sampled pools + dense
+inter-pool adjacency (reference API_SAMPLE_L / sample_layer_op.cc:74 and
+LayerwiseDataFlow, tf_euler/python/dataflow/layerwise_dataflow.py) —
+into the jitted step as well. The host ships only root rows + a seed.
+
+Per layer, over the capped HBM tables (DeviceNeighborTable layout):
+  - candidates are the current level's neighbor slots [n_l, C] with
+    their edge weights (diff of the inclusive cum rows);
+  - the pool is a weighted draw of m_l slots via the Gumbel-max trick
+    (keys log(w) + Gumbel noise, lax.top_k) — slots of the same node
+    may repeat, which under row-normalization splits that node's mass
+    across duplicate columns instead of changing it (the static-shape
+    substitute for the host sampler's distinct-node pools);
+  - the next level is concat(current, pool) — the LADIES connectivity
+    guarantee (each level contains the previous one, so self-loops
+    always find a column), mirroring LayerwiseDataFlow.__call__;
+  - the dense adjacency [n_l, n_{l+1}] is rebuilt on the VPU by
+    comparing neighbor slots against the level columns, + self-loops,
+    row-normalized — the same Â = A + I math as
+    LayerwiseDataFlow._dense_adj.
+
+Shapes are fully static: n_0 = B, n_{l+1} = n_l + m_l.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _slot_weights(cum_row):
+    """Inclusive cum rows [n, C] → per-slot weights [n, C]."""
+    return jnp.diff(cum_row, axis=1, prepend=jnp.zeros_like(cum_row[:, :1]))
+
+
+def sample_layerwise_rows(nbr_table: jax.Array, cum_table: jax.Array,
+                          roots: jax.Array, layer_sizes: Sequence[int],
+                          key):
+    """roots [B] int32 → (levels, adjs): levels[l] is an int32 row array
+    (level 0 = roots, level l+1 = level l ++ pool of layer_sizes[l]);
+    adjs[l] is the row-normalized dense [n_l, n_{l+1}] adjacency of
+    Â = A + I restricted to the pools — exactly the batch geometry
+    LayerwiseDataFlow produces and LayerEncoder consumes."""
+    C = int(nbr_table.shape[1])
+    n = int(roots.shape[0])
+    for li, m in enumerate(layer_sizes):
+        if int(m) > n * C:
+            raise ValueError(
+                f"layer_sizes[{li}]={m} exceeds the {n}*{C}={n * C} "
+                f"candidate neighbor slots of level {li} — lower the "
+                f"layer size or raise batch_size/sampler cap")
+        n += int(m)
+    levels = [roots]
+    adjs = []
+    cur = roots
+    for m in layer_sizes:
+        key, kg = jax.random.split(key)
+        nbr = jnp.take(nbr_table, cur, axis=0)          # [n, C] rows
+        w = _slot_weights(jnp.take(cum_table, cur, axis=0))
+        # Gumbel-max over slots: P(slot) ∝ w; zero-weight slots (pads,
+        # zero-weight edges) get -inf keys and lose to any real slot
+        g = jax.random.gumbel(kg, w.shape, dtype=jnp.float32)
+        keys = jnp.where(w > 0, jnp.log(jnp.maximum(w, 1e-30)) + g,
+                         -jnp.inf)
+        _, idx = jax.lax.top_k(keys.reshape(-1), int(m))
+        pool = jnp.take(nbr.reshape(-1), idx)           # [m]
+        nxt = jnp.concatenate([cur, pool])              # [n + m]
+        # dense Â = A + I between cur and nxt, row-normalized
+        hit = (nbr[:, :, None] == nxt[None, None, :])   # [n, C, n+m]
+        adj = (w[:, :, None] * hit).sum(axis=1)
+        adj = adj + (cur[:, None] == nxt[None, :]).astype(adj.dtype)
+        adj = adj / jnp.maximum(adj.sum(axis=1, keepdims=True), 1e-12)
+        adjs.append(adj)
+        levels.append(nxt)
+        cur = nxt
+    return levels, adjs

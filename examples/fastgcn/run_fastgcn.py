@@ -25,6 +25,13 @@ def main(argv=None):
     ap.add_argument("--dropout", type=float, default=0.5)
     ap.add_argument("--weight_decay", type=float, default=0.005)
     ap.add_argument("--model_dir", default="")
+    ap.add_argument("--device_sampler", action="store_true",
+                    help="sample the layer pools on the accelerator "
+                         "(device_layerwise.sample_layerwise_rows; "
+                         "features+labels move to HBM tables; eval uses "
+                         "the same sampled pools rather than the exact-"
+                         "closure host flow)")
+    ap.add_argument("--sampler_cap", type=int, default=32)
     add_platform_flag(ap)
     args = ap.parse_args(argv)
     init_platform(args.platform)
@@ -48,19 +55,40 @@ def main(argv=None):
             return LayerEncoder(dim=args.hidden_dim, dropout=args.dropout,
                                 name="enc")(batch["layers"], batch["adjs"])
 
-    flow = LayerwiseDataFlow(data.engine, sizes, feature_ids=["feature"])
-    # standard FastGCN protocol: importance-sampled pools for training,
-    # exact 1-hop closures (full propagation matrix) for evaluation
-    eval_flow = LayerwiseDataFlow(data.engine, sizes, sample=False,
-                                  feature_ids=["feature"])
+    store = sampler = None
+    if args.device_sampler:
+        from euler_tpu.models import DeviceSampledLayerwiseGCN
+        from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+
+        store = DeviceFeatureStore(data.engine, ["feature"],
+                                   label_fid="label",
+                                   label_dim=data.num_classes)
+        sampler = DeviceNeighborTable(data.engine, cap=args.sampler_cap)
+        model = DeviceSampledLayerwiseGCN(
+            num_classes=data.num_classes, multilabel=data.multilabel,
+            dim=args.hidden_dim, layer_sizes=tuple(sizes),
+            layer_dropout=args.dropout)
+        # device mode: the estimator short-circuits to root-rows-only
+        # batches, so no host dataflow runs — train AND eval both use
+        # the in-jit sampled pools (no exact-closure eval protocol)
+        flow = eval_flow = None
+    else:
+        model = FastGCNModel(num_classes=data.num_classes,
+                             multilabel=data.multilabel)
+        flow = LayerwiseDataFlow(data.engine, sizes, feature_ids=["feature"])
+        # standard FastGCN protocol: importance-sampled pools for
+        # training, exact 1-hop closures (full propagation matrix) for
+        # evaluation
+        eval_flow = LayerwiseDataFlow(data.engine, sizes, sample=False,
+                                      feature_ids=["feature"])
     est = NodeEstimator(
-        FastGCNModel(num_classes=data.num_classes,
-                     multilabel=data.multilabel),
+        model,
         dict(batch_size=args.batch_size, learning_rate=args.learning_rate,
              weight_decay=args.weight_decay,
              label_dim=data.num_classes),
         data.engine, flow, label_fid="label", label_dim=data.num_classes,
-        model_dir=args.model_dir or None, eval_dataflow=eval_flow)
+        model_dir=args.model_dir or None, eval_dataflow=eval_flow,
+        feature_store=store, device_sampler=sampler)
     res = fit_citation(est, args.max_steps, args.eval_steps)
     print(res)
     return res

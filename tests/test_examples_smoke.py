@@ -48,6 +48,9 @@ VARIANTS = [
       "--p", "0.5", "--q", "2.0"]),  # node2vec-biased device walk
     ("line/run_line.py",
      ["--device_sampler", "--batch_size", "16", "--order", "1"]),
+    ("fastgcn/run_fastgcn.py",
+     ["--device_sampler", "--batch_size", "16",
+      "--layer_sizes", "8,8"]),  # device-resident layerwise pools
 ]
 
 
