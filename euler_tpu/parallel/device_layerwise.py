@@ -10,11 +10,11 @@ into the jitted step as well. The host ships only root rows + a seed.
 Per layer, over the capped HBM tables (DeviceNeighborTable layout):
   - candidates are the current level's neighbor slots [n_l, C] with
     their edge weights (diff of the inclusive cum rows);
-  - the pool is a weighted draw of m_l slots via the Gumbel-max trick
-    (keys log(w) + Gumbel noise, lax.top_k) — slots of the same node
-    may repeat, which under row-normalization splits that node's mass
-    across duplicate columns instead of changing it (the static-shape
-    substitute for the host sampler's distinct-node pools);
+  - the pool is m_l WITH-REPLACEMENT draws ∝ slot weight (inverse-CDF
+    over the flattened slot weights) — the same sampling semantics as
+    the host engine's layerwise sampler, so duplicate pool columns
+    arise exactly as they do on the host path (each duplicate carries
+    the full edge weight into the adjacency; _dense_adj does the same);
   - the next level is concat(current, pool) — the LADIES connectivity
     guarantee (each level contains the previous one, so self-loops
     always find a column), mirroring LayerwiseDataFlow.__call__;
@@ -34,9 +34,7 @@ import jax
 import jax.numpy as jnp
 
 
-def _slot_weights(cum_row):
-    """Inclusive cum rows [n, C] → per-slot weights [n, C]."""
-    return jnp.diff(cum_row, axis=1, prepend=jnp.zeros_like(cum_row[:, :1]))
+from euler_tpu.parallel.device_sampler import slot_weights  # noqa: E402
 
 
 def sample_layerwise_rows(nbr_table: jax.Array, cum_table: jax.Array,
@@ -47,28 +45,23 @@ def sample_layerwise_rows(nbr_table: jax.Array, cum_table: jax.Array,
     adjs[l] is the row-normalized dense [n_l, n_{l+1}] adjacency of
     Â = A + I restricted to the pools — exactly the batch geometry
     LayerwiseDataFlow produces and LayerEncoder consumes."""
-    C = int(nbr_table.shape[1])
-    n = int(roots.shape[0])
-    for li, m in enumerate(layer_sizes):
-        if int(m) > n * C:
-            raise ValueError(
-                f"layer_sizes[{li}]={m} exceeds the {n}*{C}={n * C} "
-                f"candidate neighbor slots of level {li} — lower the "
-                f"layer size or raise batch_size/sampler cap")
-        n += int(m)
     levels = [roots]
     adjs = []
     cur = roots
     for m in layer_sizes:
         key, kg = jax.random.split(key)
         nbr = jnp.take(nbr_table, cur, axis=0)          # [n, C] rows
-        w = _slot_weights(jnp.take(cum_table, cur, axis=0))
-        # Gumbel-max over slots: P(slot) ∝ w; zero-weight slots (pads,
-        # zero-weight edges) get -inf keys and lose to any real slot
-        g = jax.random.gumbel(kg, w.shape, dtype=jnp.float32)
-        keys = jnp.where(w > 0, jnp.log(jnp.maximum(w, 1e-30)) + g,
-                         -jnp.inf)
-        _, idx = jax.lax.top_k(keys.reshape(-1), int(m))
+        w = slot_weights(jnp.take(cum_table, cur, axis=0))
+        # with-replacement inverse-CDF over the flat slot weights:
+        # P(slot) ∝ w, zero-weight slots (pads, zero-weight edges) are
+        # never hit while any real slot exists — the host layerwise
+        # sampler's semantics, without top-k's shortfall when fewer
+        # than m positive slots exist
+        flat_cum = jnp.cumsum(w.reshape(-1))
+        total = flat_cum[-1]
+        u = jax.random.uniform(kg, (int(m),)) * total
+        idx = jnp.searchsorted(flat_cum, u, side="right")
+        idx = jnp.minimum(idx, flat_cum.shape[0] - 1).astype(jnp.int32)
         pool = jnp.take(nbr.reshape(-1), idx)           # [m]
         nxt = jnp.concatenate([cur, pool])              # [n + m]
         # dense Â = A + I between cur and nxt, row-normalized

@@ -306,6 +306,16 @@ def make_table_gather(mesh: Optional[jax.sharding.Mesh] = None,
     return gather
 
 
+def slot_weights(cum_rows: jax.Array) -> jax.Array:
+    """Inclusive cumulative-weight rows [n, C] → per-slot edge weights
+    [n, C]. The inverse of the cumsum in DeviceNeighborTable's layout —
+    defined HERE, next to the layout contract, and shared by every
+    consumer that needs raw slot weights (device_walk's node2vec bias,
+    device_layerwise's pool draws)."""
+    return jnp.diff(cum_rows, axis=1,
+                    prepend=jnp.zeros_like(cum_rows[:, :1]))
+
+
 def sample_hop(nbr_table: jax.Array, cum_table: jax.Array,
                rows: jax.Array, count: int, key,
                gather=None) -> jax.Array:

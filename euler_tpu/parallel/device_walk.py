@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from euler_tpu.parallel.device_sampler import sample_hop
+from euler_tpu.parallel.device_sampler import slot_weights, sample_hop
 
 
 class DeviceNodeSampler:
@@ -106,8 +106,7 @@ def walk_rows(nbr_table: jax.Array, cum_table: jax.Array,
             nxt = sample_hop(nbr_table, cum_table, cur, 1, sub, gather)
         else:
             cand = take(nbr_table, cur)                     # [B, C]
-            cum = take(cum_table, cur)                      # [B, C]
-            w = jnp.diff(cum, axis=1, prepend=0.0)          # [B, C]
+            w = slot_weights(take(cum_table, cur))          # [B, C]
             prev_nbr = take(nbr_table, prev)                # [B, C]
             is_prev = cand == prev[:, None]
             in_prev_nbr = (cand[:, :, None]
