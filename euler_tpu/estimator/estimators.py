@@ -22,7 +22,8 @@ class NodeEstimator(BaseEstimator):
     def __init__(self, model, params: Dict, graph: GraphEngine, dataflow,
                  label_fid="label", label_dim: Optional[int] = None,
                  model_dir=None, mesh=None, feature_store=None,
-                 eval_dataflow=None, device_sampler=None):
+                 eval_dataflow=None, device_sampler=None,
+                 eval_via_flow: bool = False):
         """feature_store: optional DeviceFeatureStore — batches then carry
         int32 'rows' into the device-resident table instead of shipping
         feature arrays, and the table rides self.static_batch.
@@ -31,7 +32,12 @@ class NodeEstimator(BaseEstimator):
         device_sampler: optional DeviceNeighborTable (requires
         feature_store) — neighbor sampling moves into the jitted step;
         batches carry only root rows + a sample seed, and the model must
-        read nbr_table/cum_table (e.g. DeviceSampledGraphSage)."""
+        read nbr_table/cum_table (e.g. DeviceSampledGraphSage).
+        eval_via_flow: with device_sampler, route eval/infer batches
+        through the HOST eval_dataflow instead of the in-jit sampler —
+        for protocols whose eval geometry differs from training (e.g.
+        FastGCN trains on sampled pools but evaluates exact 1-hop
+        closures); the model must then also accept the host batch."""
         super().__init__(model, params, model_dir, mesh)
         self.graph = graph
         self.dataflow = dataflow
@@ -44,6 +50,14 @@ class NodeEstimator(BaseEstimator):
         self.infer_node_type = int(params.get("infer_node_type", -1))
         self.feature_store = feature_store
         self.device_sampler = device_sampler
+        self.eval_via_flow = bool(eval_via_flow)
+        if self.eval_via_flow and device_sampler is None:
+            raise ValueError("eval_via_flow only applies with a "
+                             "device_sampler (host mode already "
+                             "evaluates through the flow)")
+        if self.eval_via_flow and self.eval_dataflow is None:
+            raise ValueError("eval_via_flow needs an eval_dataflow (or "
+                             "dataflow) to build the host eval batches")
         if device_sampler is not None and feature_store is None:
             raise ValueError("device_sampler requires a feature_store")
         # independent per-phase device-sampler RNG streams (advisor r2:
@@ -67,6 +81,17 @@ class NodeEstimator(BaseEstimator):
         configured (device sampler / feature store / host arrays)."""
         store = self.feature_store
         if self.device_sampler is not None:
+            if self.eval_via_flow and stream == 1:
+                # eval keeps the HOST protocol: the flow's full batch
+                # geometry (layers/adjs/...) rides to the device as-is,
+                # labels fetched host-side (the label table is keyed by
+                # rows the host batch doesn't carry)
+                batch = flow(roots)
+                batch["labels"] = self.graph.get_dense_feature(
+                    roots, self.label_fid,
+                    self.label_dim if self.label_dim else None)
+                batch["infer_ids"] = roots
+                return batch
             # on-device sampling: the host's whole contribution is
             # root rows + a seed (the model draws the fanout in-jit)
             return self._sampler_batch(roots, stream)

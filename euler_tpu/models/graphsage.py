@@ -144,6 +144,13 @@ class DeviceSampledLayerwiseGCN(SuperviseModel):
         from euler_tpu.parallel.device_layerwise import sample_layerwise_rows
         from euler_tpu.utils.encoders import LayerEncoder
 
+        if batch.get("adjs") is not None:
+            # host-built layerwise batch (NodeEstimator eval_via_flow):
+            # the FastGCN protocol evaluates on exact 1-hop closures, so
+            # eval geometry arrives from the host flow pre-assembled
+            return LayerEncoder(self.dim, dropout=self.layer_dropout,
+                                name="encoder")(batch["layers"],
+                                                batch["adjs"])
         if batch.get("nbrcum_table") is not None:
             raise ValueError(
                 "DeviceSampledLayerwiseGCN needs the split nbr/cum "

@@ -28,9 +28,9 @@ def main(argv=None):
     ap.add_argument("--device_sampler", action="store_true",
                     help="sample the layer pools on the accelerator "
                          "(device_layerwise.sample_layerwise_rows; "
-                         "features+labels move to HBM tables; eval uses "
-                         "the same sampled pools rather than the exact-"
-                         "closure host flow)")
+                         "features+labels move to HBM tables; eval "
+                         "keeps the standard exact-closure host "
+                         "protocol via eval_via_flow)")
     ap.add_argument("--sampler_cap", type=int, default=32)
     add_platform_flag(ap)
     args = ap.parse_args(argv)
@@ -68,10 +68,13 @@ def main(argv=None):
             num_classes=data.num_classes, multilabel=data.multilabel,
             dim=args.hidden_dim, layer_sizes=tuple(sizes),
             layer_dropout=args.dropout)
-        # device mode: the estimator short-circuits to root-rows-only
-        # batches, so no host dataflow runs — train AND eval both use
-        # the in-jit sampled pools (no exact-closure eval protocol)
-        flow = eval_flow = None
+        # device mode: training short-circuits to root-rows-only batches
+        # (in-jit sampled pools); eval keeps the standard FastGCN
+        # protocol — exact 1-hop closures from the host flow
+        # (eval_via_flow below)
+        flow = None
+        eval_flow = LayerwiseDataFlow(data.engine, sizes, sample=False,
+                                      feature_ids=["feature"])
     else:
         model = FastGCNModel(num_classes=data.num_classes,
                              multilabel=data.multilabel)
@@ -88,7 +91,8 @@ def main(argv=None):
              label_dim=data.num_classes),
         data.engine, flow, label_fid="label", label_dim=data.num_classes,
         model_dir=args.model_dir or None, eval_dataflow=eval_flow,
-        feature_store=store, device_sampler=sampler)
+        feature_store=store, device_sampler=sampler,
+        eval_via_flow=args.device_sampler)
     res = fit_citation(est, args.max_steps, args.eval_steps)
     print(res)
     return res

@@ -32,6 +32,10 @@ def main(argv=None):
     ap.add_argument("--fused_sampler", action="store_true",
                     help="with --device_sampler (supervised): one fused "
                          "[N+1, 2C] HBM table, one row gather per hop")
+    ap.add_argument("--int8_features", action="store_true",
+                    help="with --device_sampler (supervised): int8-"
+                         "quantized HBM feature table (per-column "
+                         "scale, dequant after the in-jit gather)")
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--num_negs", type=int, default=5)
     ap.add_argument("--learning_rate", type=float, default=0.003)
@@ -67,9 +71,10 @@ def main(argv=None):
                 DeviceFeatureStore, DeviceNeighborTable,
             )
 
-            store = DeviceFeatureStore(data.engine, ["feature"],
-                                       label_fid="label",
-                                       label_dim=data.num_classes)
+            store = DeviceFeatureStore(
+                data.engine, ["feature"], label_fid="label",
+                label_dim=data.num_classes,
+                quantize="int8" if args.int8_features else None)
             sampler = DeviceNeighborTable(data.engine, cap=args.sampler_cap,
                                           fused=args.fused_sampler)
             model = DeviceSampledGraphSage(

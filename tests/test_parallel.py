@@ -991,3 +991,51 @@ def test_device_layerwise_gcn_trains():
     assert res["global_step"] == 80
     ev = est.evaluate(est.eval_input_fn, 10)
     assert ev["metric"] > 0.55, ev
+
+
+def test_device_layerwise_eval_via_host_flow():
+    """eval_via_flow: training runs in-jit sampled pools, eval rides the
+    host exact-closure flow (the standard FastGCN protocol) — the model
+    must consume both batch geometries; misconfiguration errors."""
+    import pytest
+
+    from euler_tpu.dataflow import LayerwiseDataFlow
+    from euler_tpu.dataset.base_dataset import synthetic_citation
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.models import DeviceSampledLayerwiseGCN
+    from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+
+    data = synthetic_citation("tevf", n=300, d=16, num_classes=3,
+                              train_per_class=30, val=40, test=60, seed=6)
+    g = data.engine
+    store = DeviceFeatureStore(g, ["feature"], label_fid="label",
+                               label_dim=data.num_classes)
+    sampler = DeviceNeighborTable(g, cap=16)
+    eval_flow = LayerwiseDataFlow(g, [24, 24], sample=False,
+                                  feature_ids=["feature"])
+    est = NodeEstimator(
+        DeviceSampledLayerwiseGCN(num_classes=data.num_classes,
+                                  multilabel=False, dim=16,
+                                  layer_sizes=(24, 24)),
+        dict(batch_size=32, learning_rate=0.01,
+             label_dim=data.num_classes, log_steps=1000,
+             checkpoint_steps=0),
+        g, None, label_fid="label", label_dim=data.num_classes,
+        feature_store=store, device_sampler=sampler,
+        eval_dataflow=eval_flow, eval_via_flow=True)
+    # eval batches carry the host geometry (exact closures), train
+    # batches the device geometry (rows + seed)
+    ev_batch = next(est.eval_input_fn())
+    assert "adjs" in ev_batch and "labels" in ev_batch
+    tr_batch = next(est.train_input_fn())
+    assert "adjs" not in tr_batch and "sample_seed" in tr_batch
+    est.train(est.train_input_fn, max_steps=60)
+    ev = est.evaluate(est.eval_input_fn, 10)
+    assert ev["metric"] > 0.6, ev
+
+    with pytest.raises(ValueError, match="eval_via_flow"):
+        NodeEstimator(
+            DeviceSampledLayerwiseGCN(num_classes=3, multilabel=False),
+            dict(batch_size=8, label_dim=3), g,
+            LayerwiseDataFlow(g, [8, 8], feature_ids=["feature"]),
+            label_fid="label", label_dim=3, eval_via_flow=True)

@@ -56,6 +56,22 @@ def job_list():
     jobs.append(("gae/cora", "examples/gae/run_gae.py", []))
     jobs.append(("scalable_sage/cora", "examples/scalable_sage/run_scalable_sage.py", []))
     jobs.append(("solution/cora", "examples/solution/run_solution.py", []))
+    # device-sampler quality rows: the in-jit input paths (fanout /
+    # layerwise pools / walks, cap-truncated tables, optional int8
+    # features) must hold the host-fed rows' quality — these back the
+    # PERF.md truncation-quality claim with machine-checked numbers
+    for ds in ("cora", "pubmed"):
+        jobs.append((f"graphsage-dev/{ds}",
+                     "examples/graphsage/run_graphsage.py",
+                     ["--dataset", ds, "--device_sampler"]))
+        jobs.append((f"fastgcn-dev/{ds}", "examples/fastgcn/run_fastgcn.py",
+                     ["--dataset", ds, "--device_sampler"]))
+    jobs.append(("graphsage-dev-int8/cora",
+                 "examples/graphsage/run_graphsage.py",
+                 ["--dataset", "cora", "--device_sampler",
+                  "--int8_features"]))
+    jobs.append(("deepwalk-dev/cora", "examples/deepwalk/run_deepwalk.py",
+                 ["--dataset", "cora", "--device_sampler"]))
     return jobs
 
 
@@ -122,7 +138,8 @@ def write_markdown(results: dict, path):
             # metric otherwise
             m = res.get("test_metric", res.get("eval_metric", float("nan")))
             ours = f"{m:.3f}"
-        ref = REF.get(model)
+        base = model.split("-")[0]   # graphsage-dev → graphsage row
+        ref = REF.get(base)
         if isinstance(ref, tuple) and ds in DATASETS:
             ref_s = f"{ref[DATASETS.index(ds)]:.3f}"
         elif isinstance(ref, float):
@@ -131,10 +148,10 @@ def write_markdown(results: dict, path):
             ref_s = "—"
         if ds == "mutag":
             metric = "acc"
-        elif model == "dgi":
+        elif base == "dgi":
             metric = "probe-acc"  # linear probe on frozen embeddings
-        elif model in ("deepwalk", "line", "transe", "transh", "transr",
-                       "transd", "distmult", "rgcn", "gae"):
+        elif base in ("deepwalk", "line", "transe", "transh", "transr",
+                      "transd", "distmult", "rgcn", "gae"):
             metric = "mrr"
         else:
             metric = "micro-F1"
