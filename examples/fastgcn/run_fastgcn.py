@@ -69,21 +69,18 @@ def main(argv=None):
             dim=args.hidden_dim, layer_sizes=tuple(sizes),
             layer_dropout=args.dropout)
         # device mode: training short-circuits to root-rows-only batches
-        # (in-jit sampled pools); eval keeps the standard FastGCN
-        # protocol — exact 1-hop closures from the host flow
-        # (eval_via_flow below)
+        # (in-jit sampled pools); eval_via_flow below keeps eval on the
+        # host exact-closure protocol
         flow = None
-        eval_flow = LayerwiseDataFlow(data.engine, sizes, sample=False,
-                                      feature_ids=["feature"])
     else:
         model = FastGCNModel(num_classes=data.num_classes,
                              multilabel=data.multilabel)
         flow = LayerwiseDataFlow(data.engine, sizes, feature_ids=["feature"])
-        # standard FastGCN protocol: importance-sampled pools for
-        # training, exact 1-hop closures (full propagation matrix) for
-        # evaluation
-        eval_flow = LayerwiseDataFlow(data.engine, sizes, sample=False,
-                                      feature_ids=["feature"])
+    # standard FastGCN protocol in BOTH modes: importance-sampled pools
+    # for training, exact 1-hop closures (full propagation matrix) for
+    # evaluation
+    eval_flow = LayerwiseDataFlow(data.engine, sizes, sample=False,
+                                  feature_ids=["feature"])
     est = NodeEstimator(
         model,
         dict(batch_size=args.batch_size, learning_rate=args.learning_rate,
