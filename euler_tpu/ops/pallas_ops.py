@@ -39,11 +39,18 @@ def _xla_gather_mean(table: Array, rows: Array) -> Array:
         .reshape(n, k, table.shape[-1]).mean(axis=1)
 
 
-def _kernel(rows_ref, table_ref, out_ref, scratch, sems):
+def _kernel(rows_ref, table_ref, out_ref, scratch, sems, *,
+            one_sem: bool):
     """One grid step: gather k rows for each of tile_n outputs, reduce.
     rows_ref is this step's (tile_n, k) index block in SMEM. All
     tile_n·k row fetches are in flight at once (start all, then wait) —
-    serializing them makes the kernel DMA-latency-bound."""
+    serializing them makes the kernel DMA-latency-bound.
+
+    one_sem selects the semaphore layout: a per-copy semaphore array
+    (sems.at[idx]) vs ONE shared DMA semaphore every copy signals and
+    each wait consumes once — the dynamically-indexed array is a
+    suspect for the remote Mosaic compiler crash seen on TPU, so the
+    profiler A/Bs both layouts over the same body."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -54,7 +61,7 @@ def _kernel(rows_ref, table_ref, out_ref, scratch, sems):
         return pltpu.make_async_copy(
             table_ref.at[pl.ds(row, 1), :],
             scratch.at[pl.ds(idx, 1), :],
-            sems.at[idx],
+            sems if one_sem else sems.at[idx],
         )
 
     def start(idx, _):
@@ -71,9 +78,11 @@ def _kernel(rows_ref, table_ref, out_ref, scratch, sems):
     out_ref[:, :] = jnp.mean(scratch[:, :].reshape(tile_n, k, d), axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("tile_n", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("tile_n", "interpret", "one_sem"))
 def _pallas_gather_mean(table: Array, rows: Array, tile_n: int = _TILE_N,
-                        interpret: bool = False) -> Array:
+                        interpret: bool = False,
+                        one_sem: bool = False) -> Array:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -81,7 +90,7 @@ def _pallas_gather_mean(table: Array, rows: Array, tile_n: int = _TILE_N,
     d = table.shape[-1]
     assert n % tile_n == 0
     return pl.pallas_call(
-        _kernel,
+        functools.partial(_kernel, one_sem=one_sem),
         grid=(n // tile_n,),
         in_specs=[
             # this step's index block rides SMEM (DMA addresses are
@@ -94,7 +103,8 @@ def _pallas_gather_mean(table: Array, rows: Array, tile_n: int = _TILE_N,
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
             pltpu.VMEM((tile_n * k, d), table.dtype),
-            pltpu.SemaphoreType.DMA((tile_n * k,)),
+            pltpu.SemaphoreType.DMA if one_sem
+            else pltpu.SemaphoreType.DMA((tile_n * k,)),
         ],
         out_shape=jax.ShapeDtypeStruct((n, d), table.dtype),
         interpret=interpret,

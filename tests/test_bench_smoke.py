@@ -42,3 +42,14 @@ def test_bench_smoke_walk_modes():
     host = _run(["--walk", "--host_sampler"])
     assert host["detail"]["sampler"] == "host"
     assert host["value"] > 0
+
+
+def test_bench_smoke_perf_lever_flags():
+    """The perf-lever flags (fused sampling table, int8 features) keep
+    the one-JSON-line contract and record their provenance in detail."""
+    fused = _run(["--fused_sampler"])
+    assert fused["detail"]["sampler"] == "device_fused"
+    assert fused["value"] > 0
+    q = _run(["--int8_features"])
+    assert q["detail"]["feat_table_dtype"] == "int8"
+    assert q["value"] > 0

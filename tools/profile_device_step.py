@@ -322,7 +322,7 @@ def main():
                 break
 
         # pallas over a 128-lane-aligned table: the d=100 bf16 row DMA
-        # is tile-unaligned and the most likely mosaic-crash culprit
+        # is tile-unaligned and one mosaic-crash suspect
         featp2 = jax.block_until_ready(jax.jit(
             lambda f: jnp.pad(f, ((0, 0), (0, 128 - f.shape[1]))))(feat))
 
@@ -332,6 +332,18 @@ def main():
 
         measure("feat_gathermean_h2_pallas_pad128_ms",
                 scanned(gm_pallas_p), featp2, r2, reps=args.reps)
+
+        # single-DMA-semaphore layout (the other crash suspect: the
+        # dynamically-indexed semaphore array), d=100 and d=128
+        def gm_pallas_1s(c, i, seed, tab, rr):
+            r = perturb(rr, i, seed).reshape(-1, k2)
+            return _pallas_gather_mean(tab, r, tile_n=32,
+                                       one_sem=True).sum()
+
+        measure("feat_gathermean_h2_pallas_onesem_ms",
+                scanned(gm_pallas_1s), feat, r2, reps=args.reps)
+        measure("feat_gathermean_h2_pallas_onesem_pad128_ms",
+                scanned(gm_pallas_1s), featp2, r2, reps=args.reps)
         del featp2
 
     # ---- encoder fwd+bwd on fixed layers --------------------------------
