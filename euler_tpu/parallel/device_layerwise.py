@@ -24,6 +24,12 @@ Per layer, over the capped HBM tables (DeviceNeighborTable layout):
     LayerwiseDataFlow._dense_adj.
 
 Shapes are fully static: n_0 = B, n_{l+1} = n_l + m_l.
+
+Envelope: the adjacency build materializes an [n_l, C, n_{l+1}] bool
+hit tensor on the VPU — fine for the FastGCN/LADIES training regime
+(batches 64-512, pools 128-512: ≤ ~50M elements), not for the
+fanout-style batch-32k regime; giant batches belong to the fanout
+sampler (device_sampler.py), whose cost is linear in drawn edges.
 """
 
 from __future__ import annotations
