@@ -122,10 +122,14 @@ def setup_tables(args, n_nodes, avg_degree, feat_dim, num_classes,
     dt = jnp.bfloat16 if args.bf16 else jnp.float32
     # walk models (DeviceSampledSkipGram → walk_rows) read the split
     # nbr/cum tables; the fused layout only serves the fanout path
-    fused = args.fused_sampler and not args.walk
+    fused = args.fused_sampler and not args.walk and not args.layerwise
     if args.fused_sampler and args.walk:
         print("bench: --fused_sampler ignored in --walk mode "
               "(walk_rows reads the split tables)", file=sys.stderr)
+    if args.fused_sampler and args.layerwise:
+        print("bench: --fused_sampler ignored in --layerwise mode "
+              "(pool weights come from the split cum table)",
+              file=sys.stderr)
     pad_features = args.pad_features and not args.walk
     if args.pad_features and args.walk:
         print("bench: --pad_features ignored in --walk mode (the skip-"
@@ -282,6 +286,83 @@ def run_walk_bench(args, graph, sampler, cache_state, setup_secs,
     }
 
 
+def run_layerwise_bench(args, graph, store, sampler, cache_state,
+                        setup_secs, n_nodes, steps, spl, cpu_fallback):
+    """--layerwise mode: device-resident LADIES/FastGCN training rate
+    (in-jit pools + dense adjacency, DeviceSampledLayerwiseGCN). The
+    host feeder ceiling to compare against is tools/bench_host.py
+    --mode layerwise (engine pools + python adjacency assembly)."""
+    import jax
+
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.estimator.prefetch import Prefetcher
+    from euler_tpu.models import DeviceSampledLayerwiseGCN
+
+    if sampler is None:
+        raise ValueError(
+            "--layerwise has no --host_sampler mode in bench.py; the "
+            "host layerwise feeder ceiling is measured by "
+            "tools/bench_host.py --mode layerwise")
+    batch = args.batch_size or (64 if (args.smoke or cpu_fallback)
+                                else 512)
+    sizes = ((8, 8) if (args.smoke or cpu_fallback) else (512, 512))
+    model = DeviceSampledLayerwiseGCN(
+        num_classes=16, multilabel=False, dim=128, layer_sizes=sizes)
+    est = NodeEstimator(
+        model,
+        dict(batch_size=batch, learning_rate=0.01, label_dim=16,
+             log_steps=1 << 30, checkpoint_steps=0, train_node_type=-1,
+             steps_per_loop=spl),
+        graph, None, label_fid="label", label_dim=16,
+        feature_store=store, device_sampler=sampler)
+
+    it = Prefetcher(est.train_input_fn(), depth=3,
+                    transform=_make_to_dev(est))
+    warmup = spl + 2 if spl > 1 else 3
+    est.train(iter([next(it) for _ in range(warmup)]), max_steps=warmup)
+    t0 = time.time()
+    res = est.train(it, max_steps=warmup + steps)
+    dt = time.time() - t0
+    done = res["global_step"] - warmup
+    nodes_per_sec = done * (batch + sum(sizes)) / dt
+    value = nodes_per_sec / max(jax.device_count(), 1)
+    return {
+        "metric": "layerwise_train_pool_nodes_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "pool-nodes/s/chip",
+        "vs_baseline": round(value / 1_000_000, 4),
+        "detail": {
+            "backend": jax.default_backend(),
+            "nodes": n_nodes,
+            "graph_edges": int(graph.edge_count),
+            "batch_size": batch,
+            "layer_sizes": list(sizes),
+            "steps": done,
+            "steps_per_sec": round(done / dt, 2),
+            "final_loss": res["loss"],
+            "sampler": "device",
+            "steps_per_loop": spl,
+            "graph_cache": cache_state,
+            "setup_secs": round(setup_secs, 1),
+            "cpu_fallback": cpu_fallback,
+        },
+    }
+
+
+def _make_to_dev(est):
+    """Prefetch-thread transform: strip host-only keys, device_put —
+    ONE definition so every bench mode measures the same input path."""
+    import jax
+
+    from euler_tpu.estimator.base_estimator import _to_device_tree
+
+    def to_dev(b):
+        return jax.device_put(_to_device_tree(
+            {k: v for k, v in b.items() if k != "infer_ids"}, est.max_id))
+
+    return to_dev
+
+
 def run_bench(args):
     import jax
 
@@ -339,6 +420,10 @@ def run_bench(args):
         return run_walk_bench(args, graph, sampler, cache_state,
                               setup_secs, n_nodes, batch, steps, spl_walk,
                               cpu_fallback)
+    if args.layerwise:
+        return run_layerwise_bench(args, graph, store, sampler,
+                                   cache_state, setup_secs, n_nodes,
+                                   steps, spl_walk, cpu_fallback)
     if sampler is None:
         model = SupervisedGraphSage(
             num_classes=num_classes, multilabel=False, dim=128,
@@ -358,14 +443,11 @@ def run_bench(args):
         graph, flow, label_fid="label", label_dim=num_classes,
         feature_store=store, device_sampler=sampler)
 
-    def to_dev(b):
-        # the estimator already trims store-mode batches to rows (+
-        # infer_ids, host-only); transfer in the prefetch thread so the
-        # main loop never waits on the link
-        return jax.device_put(_to_device_tree(
-            {k: v for k, v in b.items() if k != "infer_ids"}, est.max_id))
-
-    it = Prefetcher(est.train_input_fn(), depth=3, transform=to_dev)
+    # the estimator already trims store-mode batches to rows (+
+    # infer_ids, host-only); transfer in the prefetch thread so the
+    # main loop never waits on the link
+    it = Prefetcher(est.train_input_fn(), depth=3,
+                    transform=_make_to_dev(est))
 
     # warmup (compile) then timed steps. The headline value is the
     # AGGREGATE rate over all measured steps; per-window rates (and the
@@ -473,6 +555,9 @@ def main(argv=None):
                          "lax.scan window per device dispatch")
     ap.add_argument("--fp32", action="store_true", default=False,
                     help="keep float32 features in the full bench")
+    ap.add_argument("--layerwise", action="store_true", default=False,
+                    help="measure device-resident layerwise (LADIES) "
+                         "training instead of fanout GraphSAGE")
     ap.add_argument("--walk", action="store_true", default=False,
                     help="DeepWalk skip-gram throughput instead of "
                          "GraphSAGE (pairs/s; combine with "
@@ -513,6 +598,7 @@ def main(argv=None):
                           and not args.steps and not args.feat_dim
                           and args.cap == 32 and not args.steps_per_loop
                           and not args.avg_degree and not args.walk
+                          and not args.layerwise
                           and not args.host_sampler and not args.fp32
                           and not args.fused_sampler
                           and not args.pad_features

@@ -53,3 +53,10 @@ def test_bench_smoke_perf_lever_flags():
     q = _run(["--int8_features"])
     assert q["detail"]["feat_table_dtype"] == "int8"
     assert q["value"] > 0
+
+
+def test_bench_smoke_layerwise_mode():
+    out = _run(["--layerwise"])
+    assert out["metric"] == "layerwise_train_pool_nodes_per_sec_per_chip"
+    assert out["detail"]["sampler"] == "device"
+    assert out["value"] > 0

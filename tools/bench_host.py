@@ -218,10 +218,41 @@ def bench_walk(args):
     })
 
 
+def bench_layerwise(args):
+    """Host layerwise-feeder rate (the reference's API_SAMPLE_L +
+    LayerwiseDataFlow topology): engine pool sampling + python dense
+    adjacency assembly per training batch — the number the device
+    layerwise path (parallel/device_layerwise.py) competes with."""
+    from euler_tpu.dataflow import LayerwiseDataFlow
+
+    g, ingest_s, finalize_s, n_edges = build_graph(
+        args.nodes, args.degree, feat_dim=0)
+    sizes = [int(x) for x in args.layer_sizes.split(",")]
+    flow = LayerwiseDataFlow(g, sizes)
+    roots = g.sample_node(args.batch, -1)
+    flow(roots)  # warm
+    t0 = time.time()
+    reps = 0
+    while time.time() - t0 < args.seconds:
+        flow(roots)
+        reps += 1
+    dt = time.time() - t0
+    record({
+        "bench": "host_layerwise_feeder",
+        "nodes": args.nodes, "edges": n_edges, "batch": args.batch,
+        "layer_sizes": sizes,
+        "batches_per_sec": round(reps / dt, 3),
+        "pool_nodes_per_sec": round(reps * (args.batch + sum(sizes)) / dt),
+        "reps": reps,
+    })
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=["fanout", "scale", "walk"],
+    ap.add_argument("--mode", choices=["fanout", "scale", "walk",
+                                       "layerwise"],
                     default="fanout")
+    ap.add_argument("--layer_sizes", default="512,512")
     ap.add_argument("--nodes", type=int, default=100_000)
     ap.add_argument("--degree", type=int, default=15)
     ap.add_argument("--feat_dim", type=int, default=0)
@@ -234,6 +265,8 @@ def main(argv=None):
         bench_fanout(args)
     elif args.mode == "walk":
         bench_walk(args)
+    elif args.mode == "layerwise":
+        bench_layerwise(args)
     else:
         bench_scale(args)
 
