@@ -77,8 +77,8 @@ class DeviceSampledGraphSage(SuperviseModel):
     feature gather, and label lookup all read HBM-resident tables inside
     the jitted step. The TPU-first configuration bench.py measures —
     the host feeder drops out of the critical path entirely. encoder
-    picks any fanout-layer encoder ('sage' or 'gcn' — both consume the
-    per-hop feature list the on-device sampler produces)."""
+    picks any fanout-layer encoder ('sage', 'gcn' or 'genie' — all
+    consume the per-hop feature list the on-device sampler produces)."""
 
     dim: int = 32
     fanouts: Sequence[int] = (10, 10)
@@ -89,7 +89,7 @@ class DeviceSampledGraphSage(SuperviseModel):
         from euler_tpu.parallel.device_sampler import (
             make_table_gather, sample_fanout_rows, sample_fanout_rows_fused,
         )
-        from euler_tpu.utils.encoders import GCNEncoder
+        from euler_tpu.utils.encoders import GCNEncoder, GenieEncoder
 
         roots = batch["rows"][0]
         key = jax.random.fold_in(jax.random.key(17), batch["sample_seed"])
@@ -118,10 +118,13 @@ class DeviceSampledGraphSage(SuperviseModel):
         if self.encoder == "gcn":
             return GCNEncoder(self.dim, tuple(self.fanouts),
                               name="encoder")(layers)
+        if self.encoder == "genie":
+            return GenieEncoder(self.dim, tuple(self.fanouts),
+                                name="encoder")(layers)
         if self.encoder != "sage":
             raise ValueError(
-                f"DeviceSampledGraphSage.encoder must be 'sage' or 'gcn', "
-                f"got {self.encoder!r}")
+                f"DeviceSampledGraphSage.encoder must be 'sage', 'gcn' "
+                f"or 'genie', got {self.encoder!r}")
         return SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
                            name="encoder")(layers)
 

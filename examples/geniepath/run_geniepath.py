@@ -26,6 +26,11 @@ def main(argv=None):
     ap.add_argument("--dropout", type=float, default=0.5)
     ap.add_argument("--weight_decay", type=float, default=0.005)
     ap.add_argument("--model_dir", default="")
+    ap.add_argument("--device_sampler", action="store_true",
+                    help="sample fanouts on the accelerator "
+                         "(DeviceSampledGraphSage(encoder='genie'); "
+                         "features+labels move to HBM tables)")
+    ap.add_argument("--sampler_cap", type=int, default=32)
     add_platform_flag(ap)
     args = ap.parse_args(argv)
     init_platform(args.platform)
@@ -46,16 +51,34 @@ def main(argv=None):
             return GenieEncoder(dim=args.hidden_dim, fanouts=fanouts,
                                 name="enc")(batch["layers"])
 
-    flow = FanoutDataFlow(data.engine, list(fanouts),
-                          feature_ids=["feature"])
+    store = sampler = None
+    if args.device_sampler:
+        from euler_tpu.models import DeviceSampledGraphSage
+        from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+
+        store = DeviceFeatureStore(data.engine, ["feature"],
+                                   label_fid="label",
+                                   label_dim=data.num_classes)
+        sampler = DeviceNeighborTable(data.engine, cap=args.sampler_cap)
+        model = DeviceSampledGraphSage(
+            num_classes=data.num_classes, multilabel=data.multilabel,
+            dim=args.hidden_dim, fanouts=fanouts, encoder="genie",
+            dropout=args.dropout)
+        flow = None
+    else:
+        model = GeniePathModel(num_classes=data.num_classes,
+                               multilabel=data.multilabel,
+                               dropout=args.dropout)
+        flow = FanoutDataFlow(data.engine, list(fanouts),
+                              feature_ids=["feature"])
     est = NodeEstimator(
-        GeniePathModel(num_classes=data.num_classes,
-                       multilabel=data.multilabel, dropout=args.dropout),
+        model,
         dict(batch_size=args.batch_size, learning_rate=args.learning_rate,
              weight_decay=args.weight_decay,
              label_dim=data.num_classes),
         data.engine, flow, label_fid="label", label_dim=data.num_classes,
-        model_dir=args.model_dir or None)
+        model_dir=args.model_dir or None,
+        feature_store=store, device_sampler=sampler)
     res = fit_citation(est, args.max_steps, args.eval_steps)
     print(res)
     return res

@@ -51,6 +51,9 @@ VARIANTS = [
     ("fastgcn/run_fastgcn.py",
      ["--device_sampler", "--batch_size", "16",
       "--layer_sizes", "8,8"]),  # device-resident layerwise pools
+    ("geniepath/run_geniepath.py",
+     ["--device_sampler", "--batch_size", "16",
+      "--fanouts", "4,3"]),  # genie encoder over device fanouts
 ]
 
 
