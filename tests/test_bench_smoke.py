@@ -6,6 +6,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import numpy as np
+
 import pytest
 
 REPO = Path(__file__).resolve().parents[1]
@@ -60,3 +62,44 @@ def test_bench_smoke_layerwise_mode():
     assert out["metric"] == "layerwise_train_pool_nodes_per_sec_per_chip"
     assert out["detail"]["sampler"] == "device"
     assert out["value"] > 0
+
+
+def test_degree_sort_tables_is_isomorphic():
+    """_degree_sort_tables is a pure relabeling: each node keeps its
+    neighbor multiset (through the row permutation), weights, features,
+    and labels; hubs land in the lowest rows; pad row survives."""
+    sys.path.insert(0, str(REPO))
+    from bench import _degree_sort_tables
+
+    rng = np.random.default_rng(0)
+    n, C = 50, 4
+    nbr = rng.integers(0, n, (n + 1, C)).astype(np.int32)
+    # variable degrees: pad out slots with the pad row id n
+    deg = rng.integers(0, C + 1, n)
+    for i in range(n):
+        nbr[i, deg[i]:] = n
+    nbr[-1] = n
+    w = rng.random((n + 1, C), dtype=np.float32)
+    w[nbr == n] = 0.0
+    cum = np.cumsum(w, axis=1, dtype=np.float32)
+    feat = rng.random((n + 1, 3), dtype=np.float32)
+    label = rng.random((n + 1, 2), dtype=np.float32)
+    nbr2, cum2, feat2, label2 = _degree_sort_tables(nbr, cum, feat, label)
+
+    # recover the permutation from the feature rows (unique with p=1)
+    order = []
+    for r in range(n):
+        hits = np.where((feat == feat2[r]).all(axis=1))[0]
+        assert len(hits) == 1
+        order.append(int(hits[0]))
+    inv = {old: new for new, old in enumerate(order)}
+    inv[n] = n
+    # hub-first: degrees non-increasing over new rows
+    deg2 = (nbr2[:n] != n).sum(axis=1)
+    assert (np.diff(deg2) <= 0).all()
+    for r in range(n):
+        old = order[r]
+        assert sorted(inv[x] for x in nbr[old]) == sorted(nbr2[r].tolist())
+        np.testing.assert_allclose(cum2[r], cum[old])
+        np.testing.assert_allclose(label2[r], label[old])
+    assert (nbr2[-1] == n).all()
