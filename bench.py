@@ -104,16 +104,19 @@ def _degree_sort_tables(nbr, cum, feat, label):
     inv[order] = np.arange(n, dtype=np.int32)
     inv[n] = n                                # pad maps to pad
 
-    def permute(x, remap=None):
-        # one copy per table: preallocate and write rows in place (the
-        # concatenate form would transiently hold two extra copies of
-        # each multi-GB table at products scale)
+    def permute(x, remap=False):
+        # true one-copy-per-table: np.take with out= avoids the
+        # fancy-indexing temporary, and the nbr remap rewrites the
+        # permuted buffer in place — multi-GB tables at products scale
+        # must not hold extra transient copies during setup
         out = np.empty_like(x)
-        out[:n] = x[order]
+        np.take(x, order, axis=0, out=out[:n])
         out[n] = x[n]                         # pad row kept verbatim
-        return remap(out) if remap else out
+        if remap:
+            np.take(inv, out, out=out)
+        return out
 
-    return (permute(nbr, remap=lambda t: inv[t]), permute(cum),
+    return (permute(nbr, remap=True), permute(cum),
             permute(feat), permute(label))
 
 
@@ -180,12 +183,11 @@ def setup_tables(args, n_nodes, avg_degree, feat_dim, num_classes,
                  ("hub_frac", "edge_keep_frac", "max_degree")}
         nbr_h, cum_h = z["nbr"], z["cum"]
         feat_h, label_h = z["feat"], z["label"]
-        if args.degree_sorted and not args.host_sampler:
+        if args.degree_sorted:
+            # host_sampler runs never reach this branch (they always
+            # rebuild: use_cache=False in run_bench)
             nbr_h, cum_h, feat_h, label_h = _degree_sort_tables(
                 nbr_h, cum_h, feat_h, label_h)
-        elif args.degree_sorted:
-            print("bench: --degree_sorted ignored with --host_sampler "
-                  "(permutes the device tables only)", file=sys.stderr)
         sampler = None if args.host_sampler else \
             DeviceNeighborTable.from_arrays(nbr_h, cum_h, stats=stats,
                                             fused=fused)
@@ -319,6 +321,8 @@ def run_walk_bench(args, graph, sampler, cache_state, setup_secs,
             "sampler": "host" if sampler is None else (
                 "device_fused" if getattr(sampler, "fused", False)
                 else "device"),
+            "degree_sorted": bool(args.degree_sorted
+                                  and cache_state == "hit"),
             "steps_per_loop": spl,
             "graph_cache": cache_state,
             "setup_secs": round(setup_secs, 1),
@@ -382,6 +386,8 @@ def run_layerwise_bench(args, graph, store, sampler, cache_state,
             "steps_per_sec": round(done / dt, 2),
             "final_loss": res["loss"],
             "sampler": "device",
+            "degree_sorted": bool(args.degree_sorted
+                                  and cache_state == "hit"),
             "steps_per_loop": spl,
             "graph_cache": cache_state,
             "setup_secs": round(setup_secs, 1),

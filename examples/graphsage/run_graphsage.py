@@ -33,9 +33,9 @@ def main(argv=None):
                     help="with --device_sampler (supervised): one fused "
                          "[N+1, 2C] HBM table, one row gather per hop")
     ap.add_argument("--int8_features", action="store_true",
-                    help="with --device_sampler (supervised): int8-"
-                         "quantized HBM feature table (per-column "
-                         "scale, dequant after the in-jit gather)")
+                    help="with --device_sampler: int8-quantized HBM "
+                         "feature table (per-column scale, dequant "
+                         "after the in-jit gather)")
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--num_negs", type=int, default=5)
     ap.add_argument("--learning_rate", type=float, default=0.003)
@@ -108,7 +108,9 @@ def main(argv=None):
         )
 
         g = data.engine
-        store = DeviceFeatureStore(g, ["feature"])
+        store = DeviceFeatureStore(
+            g, ["feature"],
+            quantize="int8" if args.int8_features else None)
         tab = DeviceNeighborTable(g, cap=args.sampler_cap,
                                   fused=args.fused_sampler)
         neg = DeviceNodeSampler(g, node_type=-1)
@@ -120,6 +122,8 @@ def main(argv=None):
             model_dir=args.model_dir or None)
         est.static_batch.update({"feature_table": store.features,
                                  **tab.tables, **neg.tables})
+        if store.feature_scale is not None:
+            est.static_batch["feature_scale"] = store.feature_scale
         seed_box = [0]
 
         def input_fn():

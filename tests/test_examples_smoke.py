@@ -40,6 +40,9 @@ VARIANTS = [
     ("graphsage/run_graphsage.py", ["--device_sampler"]),
     ("graphsage/run_graphsage.py",
      ["--mode", "unsupervised", "--device_sampler", "--batch_size", "16"]),
+    ("graphsage/run_graphsage.py",
+     ["--mode", "unsupervised", "--device_sampler", "--int8_features",
+      "--batch_size", "16"]),
     ("solution/run_solution.py", ["--mode", "unsupervise"]),
     ("deepwalk/run_deepwalk.py",
      ["--device_sampler", "--batch_size", "16", "--walk_len", "2"]),
