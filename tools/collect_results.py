@@ -126,6 +126,11 @@ def write_markdown(results: dict, path):
         "val split, test-split micro-F1 reported at the best-val weights",
         "(examples/common.py fit_citation).",
         "",
+        "`*-dev` rows run the device-resident in-jit input paths",
+        "(fanout / layerwise pools / walks over capped HBM tables,",
+        "`-int8` with the quantized feature table) — they pin the",
+        "quality of the TPU-first samplers against the host rows.",
+        "",
         "| model | dataset | metric | ours | reference |",
         "|---|---|---|---|---|",
     ]
