@@ -60,7 +60,7 @@ def job_list():
     # layerwise pools / walks, cap-truncated tables, optional int8
     # features) must hold the host-fed rows' quality — these back the
     # PERF.md truncation-quality claim with machine-checked numbers
-    for ds in ("cora", "pubmed"):
+    for ds in ("cora", "pubmed", "citeseer"):
         jobs.append((f"graphsage-dev/{ds}",
                      "examples/graphsage/run_graphsage.py",
                      ["--dataset", ds, "--device_sampler"]))
