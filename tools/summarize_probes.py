@@ -1,0 +1,73 @@
+"""Summarize the TPU-window decision data the tunnel watcher collects:
+
+  python tools/summarize_probes.py
+
+Reads .bench_cache/{profile_tpu.json, bench_*.json} (the watcher's
+outputs) and prints a compact lever comparison: per-probe times from
+the step profiler plus each bench variant's edges/s vs the canonical
+BENCH_TPU.json headline — the inputs to the flip-defaults decision
+(PERF.md "Prepared candidates").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+CACHE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".bench_cache")
+
+
+def load(name):
+    path = os.path.join(CACHE, name)
+    try:
+        with open(path) as f:
+            txt = f.read()
+        start = txt.index("{")
+        return json.loads(txt[start:])
+    except (OSError, ValueError) as e:
+        print(f"  {name}: unavailable ({e})", file=sys.stderr)
+        return None
+
+
+def main():
+    prof = load("profile_tpu.json")
+    if prof:
+        print("# step profiler (ms/iter; rtt is the dispatch floor)")
+        for k in sorted(prof, key=lambda k: (k.endswith("_ms"), prof[k]
+                        if isinstance(prof[k], (int, float)) else 0)):
+            v = prof[k]
+            print(f"  {k:48s} {v:.3f}" if isinstance(v, float)
+                  else f"  {k:48s} {v}")
+    base = None
+    repo = os.path.dirname(CACHE)
+    try:
+        with open(os.path.join(repo, "BENCH_TPU.json")) as f:
+            cand = json.load(f)
+        if isinstance(cand.get("value"), (int, float)) and cand.get("unit"):
+            base = cand
+            print(f"\n# canonical: {base['value']:.0f} {base['unit']} "
+                  f"@ {base.get('recorded_at_commit')}")
+    except (OSError, ValueError):
+        pass
+    print("\n# lever sweep vs canonical")
+    for name in ("bench_fused.json", "bench_int8.json",
+                 "bench_fused_int8.json", "bench_pad.json",
+                 "bench_degsort.json", "bench_layerwise.json",
+                 "bench_walk.json"):
+        d = load(name)
+        if not d:
+            continue
+        v = d.get("value", 0)
+        rel = ""
+        if base and d.get("unit") == base.get("unit"):
+            delta = (v - base["value"]) / base["value"]
+            rel = f" ({delta:+.1%} vs canonical)"
+        det = d.get("detail", {})
+        print(f"  {name:28s} {v:>14,.0f} {d.get('unit', ''):18s}{rel}"
+              f"  backend={det.get('backend')}")
+
+
+if __name__ == "__main__":
+    main()
