@@ -60,6 +60,11 @@ def main():
         if not d:
             continue
         v = d.get("value", 0)
+        if d.get("error"):
+            # bench's robustness contract emits value 0 + an error key
+            # on failed runs — render the failure, not a fake regression
+            print(f"  {name:28s} ERROR: {d['error'][:80]}")
+            continue
         rel = ""
         if base and d.get("unit") == base.get("unit"):
             delta = (v - base["value"]) / base["value"]
