@@ -1039,3 +1039,33 @@ def test_device_layerwise_eval_via_host_flow():
             dict(batch_size=8, label_dim=3), g,
             LayerwiseDataFlow(g, [8, 8], feature_ids=["feature"]),
             label_fid="label", label_dim=3, eval_via_flow=True)
+
+
+def test_sharded_int8_feature_gather_dequantizes():
+    """Row-sharded int8 feature table + masked-take/psum gather +
+    post-gather dequant: the full multi-chip int8 path a
+    DeviceSampledGraphSage(table_mesh=...) step uses. Int8 psum cannot
+    overflow (exactly one chip contributes non-zero per row) and the
+    dequantized rows must match the replicated-table reference."""
+    from euler_tpu.models.graphsage import gather_feature_rows
+    from euler_tpu.parallel import make_mesh, make_table_gather
+    from euler_tpu.parallel.feature_store import (
+        dequantize_rows, quantize_int8,
+    )
+    from euler_tpu.parallel.placement import put_row_sharded
+
+    mesh = make_mesh(model_parallel=2)
+    rng = np.random.default_rng(5)
+    feats = rng.normal(0, 3, (30, 6)).astype(np.float32)
+    q, scale = quantize_int8(feats)
+    q_s = put_row_sharded(q, mesh)
+    rows = rng.integers(0, 30, 16).astype(np.int32)
+    gather = make_table_gather(mesh)
+    batch = {"feature_table": q_s,
+             "feature_scale": jnp.asarray(scale)}
+    with mesh:
+        [got] = gather_feature_rows(batch, [jnp.asarray(rows)],
+                                    gather=gather)
+    expect = np.asarray(dequantize_rows(jnp.asarray(q[rows]),
+                                        jnp.asarray(scale)))
+    np.testing.assert_allclose(np.asarray(got), expect, atol=1e-6)
