@@ -209,19 +209,46 @@ class LayerwiseDataFlow(DataFlow):
         """Row-normalized dense adjacency [len(rows), len(cols)] of
         Â = A + I restricted to the sampled pool (FastGCN/LADIES use the
         self-loop-augmented GCN propagation matrix — without the diagonal
-        a root whose neighbors missed the pool gets a zero embedding)."""
-        col_pos: Dict[int, List[int]] = {}
-        for j, c in enumerate(cols):
-            col_pos.setdefault(int(c), []).append(j)
-        adj = np.zeros((len(rows), len(cols)), dtype=np.float32)
+        a root whose neighbors missed the pool gets a zero embedding).
+
+        Vectorized: each (edge, matching-col) pair is expanded via
+        searchsorted ranges over the sorted col array — duplicate pool
+        columns each receive the edge weight, and edge writes land in
+        edge order (later parallel edges overwrite earlier, matching
+        the original per-edge loop)."""
+        rows = np.asarray(rows, np.uint64)
+        cols_arr = np.asarray(cols, np.uint64)
+        order = np.argsort(cols_arr, kind="stable")
+        sc = cols_arr[order]
+        adj = np.zeros((len(rows), len(cols_arr)), dtype=np.float32)
         off, nbr, w, _ = self.graph.get_full_neighbor(
             rows, edge_types=self.edge_types)
-        for i in range(len(rows)):
-            for e in range(int(off[i]), int(off[i + 1])):
-                for j in col_pos.get(int(nbr[e]), ()):
-                    adj[i, j] = w[e]
-            for j in col_pos.get(int(rows[i]), ()):  # self-loop
-                adj[i, j] += 1.0
+
+        def expand(ids, per_id_row):
+            """(row, col, run-length) triples for every position of
+            each id in the sorted col array."""
+            lo = np.searchsorted(sc, ids)
+            hi = np.searchsorted(sc, ids, side="right")
+            cnt = (hi - lo).astype(np.int64)
+            total = int(cnt.sum())
+            if total == 0:
+                return (np.empty(0, np.int64), np.empty(0, np.int64),
+                        np.empty(0, np.int64))
+            rep = np.repeat(np.arange(len(ids)), cnt)
+            pos_in_run = np.arange(total) - np.repeat(
+                np.cumsum(cnt) - cnt, cnt)
+            cpos = order[np.repeat(lo, cnt) + pos_in_run]
+            return per_id_row[rep], cpos, rep
+
+        edge_row = np.repeat(np.arange(len(rows)),
+                             np.diff(off).astype(np.int64))
+        er, ec, eidx = expand(nbr, edge_row)
+        adj[er, ec] = w[eidx]
+        sr, scol, _ = expand(rows, np.arange(len(rows)))
+        # (row, col) pairs cannot repeat (distinct positions per sorted
+        # run, one run per row), so plain fancy += is exact — and faster
+        # than an unbuffered np.add.at scatter
+        adj[sr, scol] += 1.0
         norm = adj.sum(axis=1, keepdims=True)
         return adj / np.maximum(norm, 1e-12)
 
