@@ -1,0 +1,24 @@
+#!/bin/bash
+# Poll the TPU tunnel; whenever it is up, run tools/tpu_window_payload.sh
+# (stage-stamped, resumable). Keeps polling after a successful sweep so
+# that clearing a stamp (e.g. after flipping a bench default) re-runs
+# that stage in the next window. Log: .bench_cache/watch.log
+cd /root/repo || exit 1
+log() { echo "$(date -u +%H:%M:%S) $1" >> .bench_cache/watch.log; }
+for i in $(seq 1 400); do
+  ok=$(python - <<'PY'
+from euler_tpu.platform import probe_backend
+ok, info = probe_backend(timeout=75)
+print("yes" if ok and isinstance(info, dict) and info.get("backend") != "cpu" else "no")
+PY
+)
+  if [ "$ok" = "yes" ]; then
+    log "tunnel UP (probe $i) - running payload"
+    bash tools/tpu_window_payload.sh
+    log "payload exited rc=$? - continuing to poll"
+    sleep 120
+  else
+    log "tunnel down (probe $i)"
+    sleep 240
+  fi
+done
