@@ -87,7 +87,8 @@ class DeviceSampledGraphSage(SuperviseModel):
 
     def embed(self, batch: Dict[str, Any]) -> Array:
         from euler_tpu.parallel.device_sampler import (
-            make_table_gather, sample_fanout_rows, sample_fanout_rows_fused,
+            is_model_sharded, make_table_gather, sample_fanout_rows,
+            sample_fanout_rows_fused,
         )
         from euler_tpu.utils.encoders import GCNEncoder, GenieEncoder
 
@@ -97,18 +98,16 @@ class DeviceSampledGraphSage(SuperviseModel):
         # read goes through the masked-take + psum gather; None → the
         # replicated local-take fast path
         gather = make_table_gather(self.table_mesh)
-        sharded = self.table_mesh is not None and dict(
-            self.table_mesh.shape).get("model", 1) > 1
+        sharded = is_model_sharded(self.table_mesh)
         if batch.get("nbrcum_table") is not None:
-            if sharded:
-                raise ValueError(
-                    "fused sampling table is replicated-only — build "
-                    "DeviceNeighborTable with shard_rows=True (split "
-                    "tables) when row-sharding over the model axis")
             # fused [N+1, 2C] layout (DeviceNeighborTable(fused=True)):
-            # one row gather per hop instead of cum + neighbor gathers
+            # one row gather per hop instead of cum + neighbor gathers.
+            # Composes with row-sharded tables: the gather becomes one
+            # masked-take+psum per hop (half the split-sharded path's)
             rows = sample_fanout_rows_fused(batch["nbrcum_table"], roots,
-                                            tuple(self.fanouts), key)
+                                            tuple(self.fanouts), key,
+                                            gather=gather if sharded
+                                            else None)
         else:
             rows = sample_fanout_rows(
                 batch["nbr_table"], batch["cum_table"],
@@ -159,8 +158,9 @@ class DeviceSampledLayerwiseGCN(SuperviseModel):
                 "DeviceSampledLayerwiseGCN needs the split nbr/cum "
                 "tables (pool weights come from the cum rows) — build "
                 "DeviceNeighborTable with fused=False")
-        if self.table_mesh is not None and dict(
-                self.table_mesh.shape).get("model", 1) > 1:
+        from euler_tpu.parallel.device_sampler import is_model_sharded
+
+        if is_model_sharded(self.table_mesh):
             raise NotImplementedError(
                 "row-sharded tables are not supported for device "
                 "layerwise sampling (top-k pooling needs the full "
@@ -190,6 +190,12 @@ class DeviceSampledUnsupervisedSage(nn.Module):
     fanouts: Sequence[int] = (10, 10)
     aggregator: str = "mean"
     num_negs: int = 5
+    # set to the mesh when the nbr/cum (or fused) + feature tables are
+    # row-sharded over 'model' (shard_rows=True): every table read then
+    # goes through the masked-take+psum gather. The negative-sampler
+    # tables (neg_rows/neg_cum) stay replicated — they are O(N) scalars,
+    # not O(N·C)/O(N·D) rows.
+    table_mesh: Any = None
 
     @nn.compact
     def __call__(self, batch: Dict[str, Any]):
@@ -198,8 +204,8 @@ class DeviceSampledUnsupervisedSage(nn.Module):
 
         from euler_tpu.mp_utils.base import ModelOutput
         from euler_tpu.parallel.device_sampler import (
-            sample_fanout_rows, sample_fanout_rows_fused, sample_hop,
-            sample_hop_fused,
+            is_model_sharded, make_table_gather, sample_fanout_rows,
+            sample_fanout_rows_fused, sample_hop, sample_hop_fused,
         )
         from euler_tpu.parallel.device_walk import sample_global_rows
         from euler_tpu.utils import metrics as M
@@ -209,22 +215,26 @@ class DeviceSampledUnsupervisedSage(nn.Module):
         pad = self.num_rows
         key = jax.random.fold_in(jax.random.key(29), batch["sample_seed"])
         kf, kp, kn = jax.random.split(key, 3)
+        gather = make_table_gather(self.table_mesh)
+        tg = gather if is_model_sharded(self.table_mesh) else None
         fused_tab = batch.get("nbrcum_table")
         if fused_tab is not None:
             rows = sample_fanout_rows_fused(fused_tab, roots,
-                                            tuple(self.fanouts), kf)
+                                            tuple(self.fanouts), kf,
+                                            gather=tg)
         else:
             rows = sample_fanout_rows(batch["nbr_table"],
                                       batch["cum_table"],
-                                      roots, tuple(self.fanouts), kf)
-        layers = gather_feature_rows(batch, rows)
+                                      roots, tuple(self.fanouts), kf,
+                                      gather=tg)
+        layers = gather_feature_rows(batch, rows, gather=gather)
         emb = SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
                           concat=False, name="encoder")(layers)   # [B, D]
         if fused_tab is not None:
-            pos_r = sample_hop_fused(fused_tab, roots, 1, kp)     # [B]
+            pos_r = sample_hop_fused(fused_tab, roots, 1, kp, tg)  # [B]
         else:
             pos_r = sample_hop(batch["nbr_table"], batch["cum_table"],
-                               roots, 1, kp)                      # [B]
+                               roots, 1, kp, gather=tg)           # [B]
         negs_r = sample_global_rows(batch["neg_rows"], batch["neg_cum"],
                                     kn, (roots.shape[0], self.num_negs))
         ctx = Embedding(self.num_rows + 1, self.dim, name="ctx_emb")

@@ -55,11 +55,6 @@ class DeviceNeighborTable:
                  mesh: Optional[jax.sharding.Mesh] = None,
                  keep_host: bool = False, shard_rows: bool = False,
                  fused: bool = False):
-        if fused and shard_rows:
-            raise ValueError(
-                "fused=True is replicated-only: the fused [N+1, 2C] "
-                "layout has no masked-take+psum gather path; use the "
-                "split tables with shard_rows=True")
         self.shard_rows = bool(shard_rows)
         self.fused = bool(fused)
         ids = graph.all_node_ids()
@@ -85,11 +80,6 @@ class DeviceNeighborTable:
                     shard_rows: bool = False, fused: bool = False):
         """Rehydrate from prebuilt [N+1, C] tables (e.g. a bench/dataset
         cache) without a live graph engine."""
-        if fused and shard_rows:
-            raise ValueError(
-                "fused=True is replicated-only: the fused [N+1, 2C] "
-                "layout has no masked-take+psum gather path; use the "
-                "split tables with shard_rows=True")
         self = cls.__new__(cls)
         self.shard_rows = bool(shard_rows)
         self.fused = bool(fused)
@@ -183,8 +173,17 @@ class DeviceNeighborTable:
             # one [N+1, 2C] i32 table (ids + bitcast cum): one row gather
             # per hop in sample_hop_fused. Split views are not uploaded —
             # fused mode exists to cut HBM gathers, not to double memory.
-            self.fused_table = put_replicated(
-                fuse_tables_host(nbr_tab, cum), mesh)
+            # Composes with shard_rows: the fused rows split over 'model'
+            # exactly like the split tables (the masked-take+psum gather
+            # is dtype-exact for the bitcast f32 lanes — the one owning
+            # shard contributes the bits, all others contribute i32
+            # zeros), so the HBM-capacity lever and the gather-count
+            # lever stack.
+            fused_tab = fuse_tables_host(nbr_tab, cum)
+            if self.shard_rows:
+                self.fused_table = put_row_sharded(fused_tab, mesh)
+            else:
+                self.fused_table = put_replicated(fused_tab, mesh)
             self.neighbors = None
             self.cum_weights = None
         elif self.shard_rows:
@@ -230,14 +229,21 @@ def fuse_tables(nbr_tab, cum_tab):
 
 
 def sample_hop_fused(fused_table: jax.Array, rows: jax.Array,
-                     count: int, key) -> jax.Array:
+                     count: int, key, gather=None) -> jax.Array:
     """sample_hop over a fuse_tables() layout: one row gather yields
     both the C neighbor ids and the C cumulative weights; the chosen
     column is then picked locally with take_along_axis (operand already
-    in registers/VMEM — no second HBM gather)."""
+    in registers/VMEM — no second HBM gather).
+
+    gather (make_table_gather) routes the row read for row-sharded fused
+    tables: one masked local take + psum per hop — still half the
+    collectives of the split-sharded path."""
     C = fused_table.shape[1] // 2
     n = rows.shape[0]
-    row = jnp.take(fused_table, rows, axis=0)              # [n, 2C]
+    if gather is None:
+        row = jnp.take(fused_table, rows, axis=0)          # [n, 2C]
+    else:
+        row = gather(fused_table, rows)                    # [n, 2C]
     nbr = row[:, :C]
     cum = jax.lax.bitcast_convert_type(row[:, C:], jnp.float32)
     total = cum[:, -1]
@@ -248,15 +254,24 @@ def sample_hop_fused(fused_table: jax.Array, rows: jax.Array,
 
 
 def sample_fanout_rows_fused(fused_table: jax.Array, roots: jax.Array,
-                             fanouts: Sequence[int], key):
+                             fanouts: Sequence[int], key, gather=None):
     """sample_fanout_rows over a fuse_tables() layout."""
     layers = [roots]
     cur = roots
     for k in fanouts:
         key, sub = jax.random.split(key)
-        cur = sample_hop_fused(fused_table, cur, int(k), sub)
+        cur = sample_hop_fused(fused_table, cur, int(k), sub, gather)
         layers.append(cur)
     return layers
+
+
+def is_model_sharded(mesh: Optional[jax.sharding.Mesh],
+                     axis: str = "model") -> bool:
+    """True when `mesh` has a non-trivial model axis — i.e. HBM tables
+    built against it are actually row-sharded and reads must go through
+    make_table_gather's masked-take+psum path. The single definition of
+    the triviality rule (models and make_table_gather both use it)."""
+    return mesh is not None and dict(mesh.shape).get(axis, 1) > 1
 
 
 def make_table_gather(mesh: Optional[jax.sharding.Mesh] = None,
@@ -270,7 +285,7 @@ def make_table_gather(mesh: Optional[jax.sharding.Mesh] = None,
     'model' axis reassembles full rows. One collective per gather, rides
     ICI; per-chip table memory stays 1/mp. rows must be shardable over
     the 'data' axis (batch and hop widths are multiples of it)."""
-    if mesh is None or dict(mesh.shape).get(axis, 1) <= 1:
+    if not is_model_sharded(mesh, axis):
         return lambda tab, rows: jnp.take(tab, rows, axis=0)
     from functools import partial
 
@@ -283,6 +298,14 @@ def make_table_gather(mesh: Optional[jax.sharding.Mesh] = None,
     mp = dict(mesh.shape)[axis]
 
     def gather(tab, rows):
+        if tab.shape[0] % mp:
+            raise ValueError(
+                f"make_table_gather: table has {tab.shape[0]} rows, not "
+                f"divisible by the '{axis}' axis size {mp}. Row-sharded "
+                "tables must be placed with placement.put_row_sharded "
+                "(which pads rows to a multiple of the axis); a "
+                "replicated table should use the local-take path "
+                "(model table_mesh=None / shard_rows=False throughout)")
         per = tab.shape[0] // mp
         shape = rows.shape
         rows_flat = rows.reshape(-1)

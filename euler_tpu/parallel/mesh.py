@@ -47,7 +47,8 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 # batch keys that carry HBM-resident lookup tables rather than per-step
-# data — replicated by default in shard_batch
+# data — replicated by default in shard_batch unless the caller already
+# placed them (e.g. row-sharded over 'model' via put_row_sharded)
 REPLICATED_TABLE_KEYS = ("feature_table", "feature_scale", "label_table",
                          "nbr_table", "cum_table", "nbrcum_table")
 
@@ -57,9 +58,12 @@ def shard_batch(batch: Dict, mesh: Mesh,
     """device_put every array in the batch with its leading axis split over
     'data' (arrays whose leading dim doesn't divide fall back to
     replication — e.g. scalar counts). Top-level keys in replicated_keys
-    are always replicated — HBM-resident lookup tables (feature/label/
-    neighbor) must not be row-sharded over 'data', or every in-step
-    gather turns into a cross-device collective."""
+    are replicated unless the caller already placed them on THIS mesh
+    (NamedSharding — e.g. row-sharded over 'model' via put_row_sharded),
+    in which case their placement is kept. They are never sharded over
+    'data': HBM-resident lookup tables (feature/label/neighbor) split by
+    batch would turn every in-step gather into a cross-device
+    collective."""
     dsh = data_sharding(mesh)
     rsh = replicated(mesh)
     n_data = mesh.shape["data"]
@@ -75,13 +79,26 @@ def shard_batch(batch: Dict, mesh: Mesh,
             return jax.device_put(v, dsh)
         return jax.device_put(v, rsh)
 
+    def put_table(x):
+        # tables the caller already placed on THIS mesh keep their
+        # placement: force-replicating a row-sharded table
+        # (placement.put_row_sharded over 'model') would all-gather the
+        # full table onto every chip, defeating the HBM-capacity lever
+        # in exactly the regime it exists for. Tables placed on a
+        # DIFFERENT mesh are re-placed replicated as before — keeping a
+        # stale device assignment would fail inside jit.
+        if isinstance(x, jax.Array) and isinstance(
+                getattr(x, "sharding", None), NamedSharding) \
+                and x.sharding.mesh == mesh:
+            return x
+        return jax.device_put(x, rsh)
+
     if not isinstance(batch, dict):
         return jax.tree_util.tree_map(put, batch)
     out = {}
     for k, v in batch.items():
         if k in replicated_keys:
-            out[k] = jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, rsh), v)
+            out[k] = jax.tree_util.tree_map(put_table, v)
         else:
             out[k] = jax.tree_util.tree_map(put, v)
     return out
