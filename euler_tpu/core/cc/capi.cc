@@ -184,6 +184,15 @@ int etg_builder_set_edge_sparse(int64_t b, uint64_t src, uint64_t dst,
   return 0;
 }
 
+int etg_builder_set_edge_binary(int64_t b, uint64_t src, uint64_t dst,
+                                int32_t type, int fid, const char* data,
+                                int64_t len) {
+  auto builder = GetBuilder(b);
+  if (!builder) return Fail("bad builder handle");
+  builder->SetEdgeBinary(src, dst, type, fid, data, len);
+  return 0;
+}
+
 int64_t etg_builder_finalize(int64_t b, int build_in_adjacency) {
   auto& r = Reg();
   std::shared_ptr<et::GraphBuilder> builder;

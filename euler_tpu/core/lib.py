@@ -95,6 +95,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etg_builder_set_node_binary": (i32, [i64, u64, i32, ctypes.c_char_p, i64]),
         "etg_builder_set_edge_dense": (i32, [i64, c_u64p, c_u64p, c_i32p, i64, i32, i64, c_f32p]),
         "etg_builder_set_edge_sparse": (i32, [i64, u64, u64, i32, i32, c_u64p, i64]),
+        "etg_builder_set_edge_binary": (i32, [i64, u64, u64, i32, i32, ctypes.c_char_p, i64]),
         "etg_builder_finalize": (i64, [i64, i32]),
         "etg_load": (i64, [ctypes.c_char_p, i32, i32, i32, i32]),
         "etg_dump": (i32, [i64, ctypes.c_char_p, i32, i32]),

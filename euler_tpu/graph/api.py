@@ -175,6 +175,18 @@ class GraphBuilder:
         )
         return self
 
+    def set_edge_binary(self, src: int, dst: int, etype: int, fid: int,
+                        data: bytes):
+        """Attach raw bytes to one edge (reference GetEdgeBinaryFeature
+        storage side, tf_euler/kernels/get_edge_binary_feature_op.cc —
+        there populated from the JSON 'binary_feature' edge block)."""
+        _libmod.check(
+            self._lib,
+            self._lib.etg_builder_set_edge_binary(
+                self.h, src, dst, etype, fid, data, len(data)),
+        )
+        return self
+
     def set_edge_dense(self, src, dst, types, fid: int, values):
         src = _u64(src).ravel()
         dst = _u64(dst).ravel()
@@ -634,6 +646,22 @@ class GraphEngine:
                     src.size, fid, res.h),
             )
             return res.offsets(), res.u64()
+
+    def get_edge_binary_feature(self, src, dst, types, fid) -> tuple:
+        """Returns (offsets[n+1], bytes): per-edge raw byte strings, CSR
+        (reference GetEdgeBinaryFeature, euler/core/api/api.h:44-95)."""
+        src = _u64(src).ravel()
+        dst = _u64(dst).ravel()
+        types = _i32(types).ravel()
+        fid = self.feature_id(fid, edge=True)
+        with _Result(self._lib) as res:
+            _libmod.check(
+                self._lib,
+                self._lib.etg_get_edge_binary_feature(
+                    self.h, _ptr(src, c_u64p), _ptr(dst, c_u64p), _ptr(types, c_i32p),
+                    src.size, fid, res.h),
+            )
+            return res.offsets(), res.bytes_()
 
 
 def seed(value: int) -> None:

@@ -8,6 +8,7 @@ from euler_tpu.ops.base import (  # noqa: F401
 from euler_tpu.ops.feature_ops import (  # noqa: F401
     get_binary_feature,
     get_dense_feature,
+    get_edge_binary_feature,
     get_edge_dense_feature,
     get_edge_sparse_feature,
     get_node_type,

@@ -29,5 +29,11 @@ def get_edge_sparse_feature(src, dst, types, feature_id):
     return get_graph().get_edge_sparse_feature(src, dst, types, feature_id)
 
 
+def get_edge_binary_feature(src, dst, types, feature_id):
+    """(offsets, bytes) CSR of per-edge raw byte strings (parity:
+    tf_euler GetEdgeBinaryFeature, kernels/get_edge_binary_feature_op.cc)."""
+    return get_graph().get_edge_binary_feature(src, dst, types, feature_id)
+
+
 def get_node_type(nodes):
     return get_graph().get_node_type(nodes)

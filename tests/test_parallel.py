@@ -802,6 +802,14 @@ def test_shard_batch_preserves_row_sharded_tables():
     # numpy tables still get replicated
     out2 = shard_batch({"nbr_table": np.zeros((18, 4), np.int32)}, mesh)
     assert out2["nbr_table"].sharding.spec == ()
+    # a table mistakenly sharded over 'data' is corrected to replicated
+    # (the docstring's 'never split by batch' invariant)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    bad = jax.device_put(np.zeros((8, 4), np.float32),
+                         NamedSharding(mesh, P("data")))
+    out3 = shard_batch({"feature_table": bad}, mesh)
+    assert out3["feature_table"].sharding.spec == ()
 
 
 def test_table_gather_rejects_unpadded_table():
