@@ -3,10 +3,16 @@
 #include <sys/mman.h>
 
 #include <algorithm>
+#include <atomic>
 #include <cstring>
 #include <numeric>
 
 namespace et {
+
+Graph::Graph() {
+  static std::atomic<uint64_t> next{1};
+  uid_ = next.fetch_add(1, std::memory_order_relaxed);
+}
 
 namespace {
 // Giant-store arrays (adjacency, cumw, dense features) are hit with

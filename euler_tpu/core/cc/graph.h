@@ -211,15 +211,21 @@ class Graph {
   // ---- serialization ----
   Status Dump(const std::string& path) const;  // single-partition binary dump
 
+  // Process-unique id, assigned at construction. Finalized graphs are
+  // immutable, so (uid, query) fully identifies a result — the UDF
+  // result cache keys on it (udf.h UdfResultCache).
+  uint64_t uid() const { return uid_; }
+
  private:
   friend class GraphBuilder;
-  Graph() = default;
+  Graph();
 
   // Weighted choice among the (begin,end) cumw groups selected by edge_types;
   // returns adjacency slot or kNoSlot when all groups are empty/zero.
   uint64_t SampleAdjSlot(uint32_t idx, const int32_t* edge_types,
                          size_t n_types, Pcg32* rng) const;
 
+  uint64_t uid_ = 0;
   GraphMeta meta_;
   // nodes
   std::vector<NodeId> node_ids_;

@@ -613,15 +613,44 @@ class GetFeatureOp : public OpKernel {
       ET_K_RETURN_IF_ERROR(
           ResolveFeature(*env.graph, node.attrs[a], false, &kind, &fid, &dim));
       if (kind == FeatureKind::kDense) {
-        std::vector<float> vals(n * dim);
-        env.graph->GetDenseFeature(ids, n, fid, dim, vals.data());
-        std::vector<uint64_t> offs(n + 1);
-        for (int64_t i = 0; i <= n; ++i) offs[i] = i * dim;
-        if (udf)
-          ET_K_RETURN_IF_ERROR(udf(udf_params, &offs, &vals));
-        ctx->Put(node.OutName(out_i), MakeIdx(offs));
-        ctx->Put(node.OutName(out_i + 1),
-                 Tensor::FromVector(vals));
+        // UDF result cache (reference UdfCache, udf.h:33-68): the
+        // transformed column is keyed on (immutable graph uid, registry
+        // generation, full udf spec, fid, ids) — repeated queries skip
+        // both the feature read and the transform. The hash only
+        // buckets; the stored full key decides a true hit.
+        uint64_t ck = 0, gen = 0;
+        std::shared_ptr<const CachedColumn> hit;
+        if (udf) {
+          gen = UdfRegistry::Instance().Generation();
+          ck = UdfCacheKey(env.graph->uid(), gen, node.attrs[0], fid, ids,
+                           static_cast<size_t>(n));
+          hit = UdfResultCache::Instance().Get(
+              ck, env.graph->uid(), gen, node.attrs[0], fid, ids,
+              static_cast<size_t>(n));
+        }
+        if (hit) {
+          ctx->Put(node.OutName(out_i), MakeIdx(hit->offs));
+          ctx->Put(node.OutName(out_i + 1), Tensor::FromVector(hit->vals));
+        } else {
+          std::vector<float> vals(n * dim);
+          env.graph->GetDenseFeature(ids, n, fid, dim, vals.data());
+          std::vector<uint64_t> offs(n + 1);
+          for (int64_t i = 0; i <= n; ++i) offs[i] = i * dim;
+          if (udf) {
+            ET_K_RETURN_IF_ERROR(udf(udf_params, &offs, &vals));
+            auto col = std::make_shared<CachedColumn>();
+            col->graph_uid = env.graph->uid();
+            col->generation = gen;
+            col->spec = node.attrs[0];
+            col->fid = fid;
+            col->ids.assign(ids, ids + n);
+            col->offs = offs;
+            col->vals = vals;
+            UdfResultCache::Instance().Put(ck, std::move(col));
+          }
+          ctx->Put(node.OutName(out_i), MakeIdx(offs));
+          ctx->Put(node.OutName(out_i + 1), Tensor::FromVector(vals));
+        }
       } else if (kind == FeatureKind::kSparse) {
         std::vector<uint64_t> offs, vals;
         env.graph->GetSparseFeature(ids, n, fid, &offs, &vals);

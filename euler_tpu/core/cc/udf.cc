@@ -18,7 +18,13 @@ UdfRegistry& UdfRegistry::Instance() {
 
 void UdfRegistry::Register(const std::string& name, ValueUdf fn) {
   std::lock_guard<std::mutex> lk(mu_);
+  ++generation_;
   fns_[name] = std::move(fn);
+}
+
+uint64_t UdfRegistry::Generation() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return generation_;
 }
 
 ValueUdf UdfRegistry::Find(const std::string& name) const {
@@ -33,6 +39,97 @@ std::vector<std::string> UdfRegistry::Names() const {
   for (auto& kv : fns_) out.push_back(kv.first);
   std::sort(out.begin(), out.end());
   return out;
+}
+
+UdfResultCache& UdfResultCache::Instance() {
+  static UdfResultCache* c = new UdfResultCache();
+  return *c;
+}
+
+std::shared_ptr<const CachedColumn> UdfResultCache::Get(
+    uint64_t key, uint64_t graph_uid, uint64_t generation,
+    const std::string& spec, int fid, const uint64_t* ids, size_t n) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = map_.find(key);
+  if (it == map_.end() ||
+      !it->second.col->KeyEquals(graph_uid, generation, spec, fid, ids, n)) {
+    // a 64-bit hash collision verifies as a miss, never as wrong data
+    ++misses_;
+    return nullptr;
+  }
+  ++hits_;
+  lru_.splice(lru_.begin(), lru_, it->second.lru_it);  // touch
+  return it->second.col;  // pointer copy only; no payload copy in-lock
+}
+
+void UdfResultCache::Put(uint64_t key, std::shared_ptr<const CachedColumn> col) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (cap_bytes_ == 0) return;  // caching disabled
+  auto it = map_.find(key);
+  if (it != map_.end()) return;  // immutable inputs → same value; keep
+  Entry e;
+  e.col = std::move(col);
+  size_t sz = EntryBytes(e);
+  if (sz > cap_bytes_) return;  // larger than the whole cache
+  while (bytes_ + sz > cap_bytes_ && !lru_.empty()) {
+    uint64_t victim = lru_.back();
+    lru_.pop_back();
+    auto vit = map_.find(victim);
+    bytes_ -= EntryBytes(vit->second);
+    map_.erase(vit);
+  }
+  lru_.push_front(key);
+  e.lru_it = lru_.begin();
+  bytes_ += sz;
+  map_.emplace(key, std::move(e));
+}
+
+void UdfResultCache::Clear() {
+  std::lock_guard<std::mutex> lk(mu_);
+  map_.clear();
+  lru_.clear();
+  bytes_ = 0;
+}
+
+void UdfResultCache::Stats(uint64_t* hits, uint64_t* misses,
+                           uint64_t* entries, uint64_t* bytes) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  *hits = hits_;
+  *misses = misses_;
+  *entries = map_.size();
+  *bytes = bytes_;
+}
+
+void UdfResultCache::SetCapacityBytes(size_t cap) {
+  std::lock_guard<std::mutex> lk(mu_);
+  cap_bytes_ = cap;
+  while (bytes_ > cap_bytes_ && !lru_.empty()) {
+    uint64_t victim = lru_.back();
+    lru_.pop_back();
+    auto vit = map_.find(victim);
+    bytes_ -= EntryBytes(vit->second);
+    map_.erase(vit);
+  }
+}
+
+uint64_t UdfCacheKey(uint64_t graph_uid, uint64_t generation,
+                     const std::string& spec, int fid, const uint64_t* ids,
+                     size_t n) {
+  uint64_t h = 1469598103934665603ULL;
+  auto mix = [&h](const void* p, size_t len) {
+    const unsigned char* b = static_cast<const unsigned char*>(p);
+    for (size_t i = 0; i < len; ++i) h = (h ^ b[i]) * 1099511628211ULL;
+  };
+  auto mix_sized = [&](const void* p, uint64_t len) {
+    mix(&len, sizeof(len));  // length prefix: concatenations can't alias
+    mix(p, static_cast<size_t>(len));
+  };
+  mix(&graph_uid, sizeof(graph_uid));
+  mix(&generation, sizeof(generation));
+  mix_sized(spec.data(), spec.size());
+  mix(&fid, sizeof(fid));
+  mix_sized(ids, n * sizeof(uint64_t));
+  return h;
 }
 
 Status ParseUdfSpec(const std::string& spec, std::string* name,
@@ -139,6 +236,20 @@ void et_udf_emit(void* out, const uint64_t* offs, int64_t n_offs,
   auto* o = static_cast<EtUdfOut*>(out);
   o->offs->assign(offs, offs + n_offs);
   o->vals->assign(vals, vals + n_vals);
+}
+
+// UDF result-cache introspection/control (hit-count tests, memory
+// pressure, disabling via capacity 0).
+void etg_udf_cache_stats(uint64_t* hits, uint64_t* misses,
+                         uint64_t* entries, uint64_t* bytes) {
+  et::UdfResultCache::Instance().Stats(hits, misses, entries, bytes);
+}
+
+void etg_udf_cache_clear() { et::UdfResultCache::Instance().Clear(); }
+
+void etg_udf_cache_set_capacity(uint64_t bytes) {
+  et::UdfResultCache::Instance().SetCapacityBytes(
+      static_cast<size_t>(bytes));
 }
 
 void etg_register_udf(const char* name, et_udf_cb cb) {

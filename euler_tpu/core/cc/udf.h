@@ -11,8 +11,11 @@
 #ifndef EULER_TPU_UDF_H_
 #define EULER_TPU_UDF_H_
 
+#include <algorithm>
 #include <cstdint>
 #include <functional>
+#include <list>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <unordered_map>
@@ -39,15 +42,99 @@ class UdfRegistry {
   // with concurrent re-registration); empty function when unknown.
   ValueUdf Find(const std::string& name) const;
   std::vector<std::string> Names() const;
+  // Bumped on every Register(). Part of the result-cache key, so
+  // re-registering a UDF (new behavior under an old name) implicitly
+  // invalidates every cached result.
+  uint64_t Generation() const;
 
  private:
   mutable std::mutex mu_;
+  uint64_t generation_ = 0;
   std::unordered_map<std::string, ValueUdf> fns_;
 };
 
 // Parse "udf:name:p1:p2" (after the "udf:" prefix) → (name, params).
 Status ParseUdfSpec(const std::string& spec, std::string* name,
                     std::vector<double>* params);
+
+// One cached UDF-transformed column, with its FULL key stored alongside
+// the result so a 64-bit hash collision verifies as a miss instead of
+// serving another query's data. Immutable once published (shared_ptr
+// handed out under the lock; readers never copy the vectors).
+struct CachedColumn {
+  uint64_t graph_uid = 0;
+  uint64_t generation = 0;      // UdfRegistry generation at compute time
+  std::string spec;             // full "udf:name:p1:p2" attr
+  int fid = 0;
+  std::vector<uint64_t> ids;    // the queried ids (key verification)
+  std::vector<uint64_t> offs;   // the transformed ragged column
+  std::vector<float> vals;
+
+  bool KeyEquals(uint64_t uid, uint64_t gen, const std::string& s, int f,
+                 const uint64_t* q_ids, size_t n) const {
+    return graph_uid == uid && generation == gen && fid == f && spec == s &&
+           ids.size() == n &&
+           std::equal(ids.begin(), ids.end(), q_ids);
+  }
+};
+
+// Result cache for UDF-transformed feature columns (reference UdfCache,
+// euler/core/framework/udf.h:33-68 — there it caches Udf instances to
+// skip re-construction; here the expensive repeated work is the
+// transform itself, so the cache holds the transformed ragged columns).
+//
+// Invalidation story: finalized graphs are IMMUTABLE, and the key
+// includes the graph's process-unique uid (Graph::uid), the UdfRegistry
+// generation (bumped by every Register(), so re-registering a UDF
+// orphans old entries), the full udf spec (name + params), the feature
+// id, and the queried ids — so an entry can never go stale; it can only
+// be evicted. Eviction is size-bounded LRU (default 64MB,
+// SetCapacityBytes to change; capacity 0 disables caching). Clear()
+// drops everything (tests / memory pressure).
+//
+// Purity contract: cached UDFs must be pure functions of
+// (params, offsets, values) — see register_udf's documentation; a
+// deliberately stateful UDF should disable the cache (capacity 0).
+class UdfResultCache {
+ public:
+  static UdfResultCache& Instance();
+
+  // Hit → the cached column (full-key verified); miss/collision →
+  // nullptr. Counts hits/misses. The returned column is immutable and
+  // safe to read without the lock.
+  std::shared_ptr<const CachedColumn> Get(uint64_t key, uint64_t graph_uid,
+                                          uint64_t generation,
+                                          const std::string& spec, int fid,
+                                          const uint64_t* ids, size_t n);
+  void Put(uint64_t key, std::shared_ptr<const CachedColumn> col);
+  void Clear();
+  void Stats(uint64_t* hits, uint64_t* misses, uint64_t* entries,
+             uint64_t* bytes) const;
+  void SetCapacityBytes(size_t cap);
+
+ private:
+  struct Entry {
+    std::shared_ptr<const CachedColumn> col;
+    std::list<uint64_t>::iterator lru_it;
+  };
+  static size_t EntryBytes(const Entry& e) {
+    return (e.col->offs.size() + e.col->ids.size()) * sizeof(uint64_t) +
+           e.col->vals.size() * sizeof(float) + e.col->spec.size();
+  }
+  mutable std::mutex mu_;
+  std::list<uint64_t> lru_;  // front = most recent
+  std::unordered_map<uint64_t, Entry> map_;
+  size_t bytes_ = 0;
+  size_t cap_bytes_ = 64u << 20;
+  uint64_t hits_ = 0, misses_ = 0;
+};
+
+// FNV-1a over (graph uid, registry generation, udf spec, fid, ids),
+// each component length-prefixed so concatenations cannot alias. The
+// hash only buckets — CachedColumn::KeyEquals decides a true hit.
+uint64_t UdfCacheKey(uint64_t graph_uid, uint64_t generation,
+                     const std::string& spec, int fid, const uint64_t* ids,
+                     size_t n);
 
 }  // namespace et
 

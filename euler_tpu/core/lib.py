@@ -152,6 +152,9 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etq_stats": (i32, [i64, c_u64p]),
         "etq_index_dump": (i32, [i64, ctypes.c_char_p]),
         "etg_register_udf": (None, [ctypes.c_char_p, c_voidp]),
+        "etg_udf_cache_stats": (None, [ctypes.POINTER(u64), ctypes.POINTER(u64), ctypes.POINTER(u64), ctypes.POINTER(u64)]),
+        "etg_udf_cache_clear": (None, []),
+        "etg_udf_cache_set_capacity": (None, [u64]),
         "etg_hash64": (u64, [ctypes.c_char_p, u64]),
         "et_udf_emit": (None, [c_voidp, c_u64p, i64, c_f32p, i64]),
         "etq_exec_new": (i64, [i64]),

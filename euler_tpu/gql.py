@@ -33,7 +33,8 @@ from euler_tpu.core import lib as _libmod
 from euler_tpu.core.lib import EngineError, check
 
 __all__ = ["Query", "GraphService", "start_service", "compile_debug",
-           "register_udf"]
+           "register_udf", "udf_cache_stats", "udf_cache_clear",
+           "udf_cache_set_capacity"]
 
 _DTYPES = {
     0: np.uint64,
@@ -276,6 +277,12 @@ def register_udf(name: str, fn) -> None:
 
     Note: in distribute mode the UDF executes on the shard SERVERS —
     register it in each server process as well.
+
+    PURITY: dense-feature UDF results are cached (UdfResultCache; see
+    udf_cache_stats) keyed on the graph + spec + ids, so fn MUST be a
+    pure function of (params, offsets, values). Re-registering a name
+    invalidates all cached results; a deliberately stateful or random
+    UDF should disable the cache with udf_cache_set_capacity(0).
     """
     lib = _libmod.load()
 
@@ -305,6 +312,33 @@ def register_udf(name: str, fn) -> None:
 
     _UDF_CALLBACKS[name] = cb
     lib.etg_register_udf(name.encode(), ctypes.cast(cb, ctypes.c_void_p))
+
+
+def udf_cache_stats() -> dict:
+    """UDF result-cache counters (reference UdfCache, udf.h:33-68):
+    {'hits', 'misses', 'entries', 'bytes'}. Cached results are keyed on
+    the immutable graph's uid + registry generation + spec + fid + ids,
+    so entries never go stale — re-registering any UDF orphans old
+    entries, and eviction is size-bounded LRU."""
+    lib = _libmod.load()
+    h = ctypes.c_uint64()
+    m = ctypes.c_uint64()
+    e = ctypes.c_uint64()
+    b = ctypes.c_uint64()
+    lib.etg_udf_cache_stats(ctypes.byref(h), ctypes.byref(m),
+                            ctypes.byref(e), ctypes.byref(b))
+    return {"hits": h.value, "misses": m.value, "entries": e.value,
+            "bytes": b.value}
+
+
+def udf_cache_clear() -> None:
+    """Drop every cached UDF result (testing / memory pressure)."""
+    _libmod.load().etg_udf_cache_clear()
+
+
+def udf_cache_set_capacity(num_bytes: int) -> None:
+    """Resize the UDF result cache (default 64MB; 0 disables caching)."""
+    _libmod.load().etg_udf_cache_set_capacity(num_bytes)
 
 
 def compile_debug(gremlin: str, shard_num: int = 1, partition_num: int = 1,

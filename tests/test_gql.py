@@ -735,6 +735,74 @@ def test_udf_custom_python_registration(ring_graph):
         [np.sqrt(0 + 1 + 4 + 9), np.sqrt(16 + 25 + 36 + 49)], rtol=1e-6)
 
 
+def test_udf_result_cache(ring_graph):
+    """UdfResultCache (reference UdfCache, udf.h:33-68): a repeated
+    dense-feature UDF query is served from the cache (hit count rises,
+    same result); different ids miss; re-registering any UDF orphans old
+    entries via the registry generation; capacity 0 disables caching."""
+    from euler_tpu.gql import (
+        register_udf, udf_cache_clear, udf_cache_set_capacity,
+        udf_cache_stats,
+    )
+
+    udf_cache_set_capacity(64 << 20)
+    udf_cache_clear()
+    try:
+        _udf_cache_scenario(ring_graph, register_udf, udf_cache_stats,
+                            udf_cache_set_capacity)
+    finally:
+        # the capacity/entries are process-global: restore even on
+        # assertion failure so later tests see a working cache
+        udf_cache_set_capacity(64 << 20)
+        udf_cache_clear()
+
+
+def _udf_cache_scenario(ring_graph, register_udf, udf_cache_stats,
+                        udf_cache_set_capacity):
+    q = Query.local(ring_graph)
+    feed = {"roots": np.array([1, 2], dtype=np.uint64)}
+
+    s0 = udf_cache_stats()
+    out1 = q.run("v(roots).udf(scale:2, f_dense).as(s)", feed)
+    s1 = udf_cache_stats()
+    assert s1["misses"] == s0["misses"] + 1 and s1["hits"] == s0["hits"]
+    assert s1["entries"] >= 1 and s1["bytes"] > 0
+
+    out2 = q.run("v(roots).udf(scale:2, f_dense).as(s)", feed)
+    s2 = udf_cache_stats()
+    assert s2["hits"] == s1["hits"] + 1 and s2["misses"] == s1["misses"]
+    np.testing.assert_allclose(out2["s:1"], out1["s:1"])
+    np.testing.assert_array_equal(out2["s:0"], out1["s:0"])
+
+    # different ids → different key → miss
+    q.run("v(roots).udf(scale:2, f_dense).as(s)",
+          {"roots": np.array([3], dtype=np.uint64)})
+    s3 = udf_cache_stats()
+    assert s3["misses"] == s2["misses"] + 1
+
+    # different params → different spec → miss
+    q.run("v(roots).udf(scale:3, f_dense).as(s)", feed)
+    s4 = udf_cache_stats()
+    assert s4["misses"] == s3["misses"] + 1
+
+    # registering ANY udf bumps the generation: the old entries are
+    # orphaned, so the same query misses and recomputes (correctness
+    # when a udf name is re-registered with new behavior)
+    register_udf("cache_gen_probe", lambda p, o, v: (o, v))
+    q.run("v(roots).udf(scale:2, f_dense).as(s)", feed)
+    s5 = udf_cache_stats()
+    assert s5["misses"] == s4["misses"] + 1
+
+    # capacity 0 disables: stats still count misses, nothing is stored
+    udf_cache_set_capacity(0)
+    assert udf_cache_stats()["entries"] == 0  # resize evicted everything
+    q.run("v(roots).udf(scale:2, f_dense).as(s)", feed)
+    q.run("v(roots).udf(scale:2, f_dense).as(s)", feed)
+    s6 = udf_cache_stats()
+    assert s6["entries"] == 0
+    assert s6["misses"] >= s5["misses"] + 2
+
+
 def test_udf_remote_applies_on_shards(ring_graph, two_shard_cluster):
     """udf() in distribute mode ships with the plan and runs on the shard
     servers (in-process here, so built-ins are present)."""
