@@ -332,7 +332,8 @@ def run_walk_bench(args, graph, sampler, cache_state, setup_secs,
 
 
 def run_layerwise_bench(args, graph, store, sampler, cache_state,
-                        setup_secs, n_nodes, steps, spl, cpu_fallback):
+                        setup_secs, n_nodes, steps, spl, cpu_fallback,
+                        num_classes):
     """--layerwise mode: device-resident LADIES/FastGCN training rate
     (in-jit pools + dense adjacency, DeviceSampledLayerwiseGCN). The
     host feeder ceiling to compare against is tools/bench_host.py
@@ -351,14 +352,18 @@ def run_layerwise_bench(args, graph, store, sampler, cache_state,
     batch = args.batch_size or (64 if (args.smoke or cpu_fallback)
                                 else 512)
     sizes = ((8, 8) if (args.smoke or cpu_fallback) else (512, 512))
+    # num_classes comes from run_bench (the label-table dimension the
+    # tables were built with) — a hardcoded copy here would break
+    # silently if the canonical value changed (advisor r3)
     model = DeviceSampledLayerwiseGCN(
-        num_classes=16, multilabel=False, dim=128, layer_sizes=sizes)
+        num_classes=num_classes, multilabel=False, dim=128,
+        layer_sizes=sizes)
     est = NodeEstimator(
         model,
-        dict(batch_size=batch, learning_rate=0.01, label_dim=16,
+        dict(batch_size=batch, learning_rate=0.01, label_dim=num_classes,
              log_steps=1 << 30, checkpoint_steps=0, train_node_type=-1,
              steps_per_loop=spl),
-        graph, None, label_fid="label", label_dim=16,
+        graph, None, label_fid="label", label_dim=num_classes,
         feature_store=store, device_sampler=sampler)
 
     it = Prefetcher(est.train_input_fn(), depth=3,
@@ -470,7 +475,8 @@ def run_bench(args):
     if args.layerwise:
         return run_layerwise_bench(args, graph, store, sampler,
                                    cache_state, setup_secs, n_nodes,
-                                   steps, spl_walk, cpu_fallback)
+                                   steps, spl_walk, cpu_fallback,
+                                   num_classes)
     if sampler is None:
         model = SupervisedGraphSage(
             num_classes=num_classes, multilabel=False, dim=128,

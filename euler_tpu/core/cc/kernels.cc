@@ -593,12 +593,15 @@ class GetFeatureOp : public OpKernel {
     int64_t n = ids_t.NumElements();
     ValueUdf udf;
     std::vector<double> udf_params;
+    uint64_t udf_gen = 0;  // captured atomically with the lookup: a
+    // later Generation() read could cache an old function's result
+    // under a newer generation if Register() raced in between
     size_t a0 = 0;
     if (!node.attrs.empty() && node.attrs[0].rfind("udf:", 0) == 0) {
       std::string uname;
       ET_K_RETURN_IF_ERROR(
           ParseUdfSpec(node.attrs[0].substr(4), &uname, &udf_params));
-      udf = UdfRegistry::Instance().Find(uname);
+      udf = UdfRegistry::Instance().Find(uname, &udf_gen);
       if (!udf) {
         done(Status::NotFound("no registered udf named " + uname));
         return;
@@ -618,14 +621,13 @@ class GetFeatureOp : public OpKernel {
         // generation, full udf spec, fid, ids) — repeated queries skip
         // both the feature read and the transform. The hash only
         // buckets; the stored full key decides a true hit.
-        uint64_t ck = 0, gen = 0;
+        uint64_t ck = 0;
         std::shared_ptr<const CachedColumn> hit;
         if (udf) {
-          gen = UdfRegistry::Instance().Generation();
-          ck = UdfCacheKey(env.graph->uid(), gen, node.attrs[0], fid, ids,
-                           static_cast<size_t>(n));
+          ck = UdfCacheKey(env.graph->uid(), udf_gen, node.attrs[0], fid,
+                           ids, static_cast<size_t>(n));
           hit = UdfResultCache::Instance().Get(
-              ck, env.graph->uid(), gen, node.attrs[0], fid, ids,
+              ck, env.graph->uid(), udf_gen, node.attrs[0], fid, ids,
               static_cast<size_t>(n));
         }
         if (hit) {
@@ -640,7 +642,7 @@ class GetFeatureOp : public OpKernel {
             ET_K_RETURN_IF_ERROR(udf(udf_params, &offs, &vals));
             auto col = std::make_shared<CachedColumn>();
             col->graph_uid = env.graph->uid();
-            col->generation = gen;
+            col->generation = udf_gen;
             col->spec = node.attrs[0];
             col->fid = fid;
             col->ids.assign(ids, ids + n);

@@ -27,8 +27,10 @@ uint64_t UdfRegistry::Generation() const {
   return generation_;
 }
 
-ValueUdf UdfRegistry::Find(const std::string& name) const {
+ValueUdf UdfRegistry::Find(const std::string& name,
+                           uint64_t* generation) const {
   std::lock_guard<std::mutex> lk(mu_);
+  if (generation) *generation = generation_;
   auto it = fns_.find(name);
   return it == fns_.end() ? ValueUdf() : it->second;
 }

@@ -40,7 +40,12 @@ class UdfRegistry {
   void Register(const std::string& name, ValueUdf fn);
   // Returns a COPY under the lock (a pointer into the map would race
   // with concurrent re-registration); empty function when unknown.
-  ValueUdf Find(const std::string& name) const;
+  // When generation is non-null it receives the registry generation
+  // ATOMICALLY with the lookup — cache keys must use this value, not a
+  // later Generation() read, or a concurrent re-registration could
+  // cache the OLD function's result under the NEW generation.
+  ValueUdf Find(const std::string& name,
+                uint64_t* generation = nullptr) const;
   std::vector<std::string> Names() const;
   // Bumped on every Register(). Part of the result-cache key, so
   // re-registering a UDF (new behavior under an old name) implicitly

@@ -37,7 +37,13 @@ class NodeEstimator(BaseEstimator):
         through the HOST eval_dataflow instead of the in-jit sampler —
         for protocols whose eval geometry differs from training (e.g.
         FastGCN trains on sampled pools but evaluates exact 1-hop
-        closures); the model must then also accept the host batch."""
+        closures); the model must then also accept the host batch.
+        Compile-cost caveat: host layerwise batches have data-dependent
+        level sizes (np.unique closures), so each distinct eval batch
+        geometry jit-compiles a fresh eval step — fine for the FastGCN
+        protocol's few fixed eval sets, but unbounded compile churn on
+        large/varied eval sets (bucket or pad closure sizes if eval
+        throughput ever matters)."""
         super().__init__(model, params, model_dir, mesh)
         self.graph = graph
         self.dataflow = dataflow

@@ -8,13 +8,21 @@ LayerwiseDataFlow, tf_euler/python/dataflow/layerwise_dataflow.py) —
 into the jitted step as well. The host ships only root rows + a seed.
 
 Per layer, over the capped HBM tables (DeviceNeighborTable layout):
-  - candidates are the current level's neighbor slots [n_l, C] with
-    their edge weights (diff of the inclusive cum rows);
+  - pool candidates are the FRONTIER's neighbor slots — the previous
+    layer's pool (the roots at layer 0) — with their edge weights
+    (diff of the inclusive cum rows); drawing from the frontier only
+    matches the host engine's layerwise sampler (SampleLayerwise,
+    core/cc/ops.cc), which expands each layer from the nodes drawn in
+    the previous one, not from the whole accumulated level (advisor
+    r3: the concatenated-level draw skewed candidate mass toward
+    earlier/duplicated nodes);
   - the pool is m_l WITH-REPLACEMENT draws ∝ slot weight (inverse-CDF
-    over the flattened slot weights) — the same sampling semantics as
-    the host engine's layerwise sampler, so duplicate pool columns
-    arise exactly as they do on the host path (each duplicate carries
-    the full edge weight into the adjacency; _dense_adj does the same);
+    over the flattened slot weights): P(neighbor) ∝ its total incident
+    edge weight from the frontier — distributionally the engine's
+    per-unique-neighbor accumulated-weight draw, with duplicates
+    arising exactly as they do on the host path (each duplicate
+    carries the full edge weight into the adjacency; _dense_adj does
+    the same);
   - the next level is concat(current, pool) — the LADIES connectivity
     guarantee (each level contains the previous one, so self-loops
     always find a column), mirroring LayerwiseDataFlow.__call__;
@@ -54,22 +62,27 @@ def sample_layerwise_rows(nbr_table: jax.Array, cum_table: jax.Array,
     levels = [roots]
     adjs = []
     cur = roots
+    n_frontier = roots.shape[0]  # frontier = last pool (roots at l=0)
     for m in layer_sizes:
         key, kg = jax.random.split(key)
         nbr = jnp.take(nbr_table, cur, axis=0)          # [n, C] rows
         w = slot_weights(jnp.take(cum_table, cur, axis=0))
-        # with-replacement inverse-CDF over the flat slot weights:
+        # pool draw expands the FRONTIER (a suffix of cur) only — the
+        # host engine's layer-by-layer semantics; the full cur rows are
+        # still needed below for the inter-level adjacency.
+        # With-replacement inverse-CDF over the flat slot weights:
         # P(slot) ∝ w, zero-weight slots (pads, zero-weight edges) are
-        # never hit while any real slot exists — the host layerwise
-        # sampler's semantics, without top-k's shortfall when fewer
-        # than m positive slots exist
-        flat_cum = jnp.cumsum(w.reshape(-1))
+        # never hit while any real slot exists — without top-k's
+        # shortfall when fewer than m positive slots exist
+        nbr_f = nbr[-n_frontier:]
+        flat_cum = jnp.cumsum(w[-n_frontier:].reshape(-1))
         total = flat_cum[-1]
         u = jax.random.uniform(kg, (int(m),)) * total
         idx = jnp.searchsorted(flat_cum, u, side="right")
         idx = jnp.minimum(idx, flat_cum.shape[0] - 1).astype(jnp.int32)
-        pool = jnp.take(nbr.reshape(-1), idx)           # [m]
+        pool = jnp.take(nbr_f.reshape(-1), idx)         # [m]
         nxt = jnp.concatenate([cur, pool])              # [n + m]
+        n_frontier = int(m)
         # dense Â = A + I between cur and nxt, row-normalized
         hit = (nbr[:, :, None] == nxt[None, None, :])   # [n, C, n+m]
         adj = (w[:, :, None] * hit).sum(axis=1)

@@ -22,12 +22,24 @@ import jax
 import numpy as np
 
 
+def _global_put(x: np.ndarray, sharding) -> jax.Array:
+    """device_put that also works when `sharding` spans OTHER hosts'
+    devices (multi-process mesh): every process calls this with the
+    same full array and contributes its addressable shards."""
+    if all(d.process_index == jax.process_index()
+           for d in sharding.device_set):
+        return jax.device_put(x, sharding)
+    x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sharding,
+                                        lambda idx: x[idx])
+
+
 def put_replicated(x: np.ndarray,
                    mesh: Optional[jax.sharding.Mesh] = None) -> jax.Array:
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
 
-        return jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
+        return _global_put(x, NamedSharding(mesh, PartitionSpec()))
     return jax.device_put(x)
 
 
@@ -47,4 +59,4 @@ def put_row_sharded(x: np.ndarray, mesh: Optional[jax.sharding.Mesh],
         x = np.concatenate(
             [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
     spec = PartitionSpec(axis, *([None] * (x.ndim - 1)))
-    return jax.device_put(x, NamedSharding(mesh, spec))
+    return _global_put(x, NamedSharding(mesh, spec))
