@@ -31,19 +31,48 @@ class RemoteGraphEngine:
     Query proxy (distribute or graph_partition mode)."""
 
     def __init__(self, endpoints: str, seed: int = 0,
-                 mode: str = "distribute"):
+                 mode: str = "distribute",
+                 retry_deadline_s: float = 30.0):
+        """retry_deadline_s: failover budget. A query that fails (shard
+        died mid-call, RpcChannel exhausted its in-channel retries) is
+        retried until this deadline — the registry monitor swaps the
+        replacement shard's endpoint in live, so a restarted shard
+        becomes visible within its heartbeat interval and the retry
+        succeeds without rebuilding the engine. 0 disables (one
+        attempt). Reference semantics: rpc_client.h:46 retry counter +
+        ZK watch re-resolution."""
         self.query = Query.remote(endpoints, seed=seed, mode=mode)
+        self.retry_deadline_s = float(retry_deadline_s)
         # host-side rng for the client-computed node2vec bias; seed=0 →
         # fresh entropy (matching the engine's seed convention)
         self._rng = np.random.default_rng(seed if seed else None)
 
+    def _run(self, gql: str, feed=None):
+        """query.run with shard-failover retry (see retry_deadline_s)."""
+        import time
+
+        from euler_tpu.core.lib import EngineError
+
+        deadline = time.monotonic() + self.retry_deadline_s
+        while True:
+            try:
+                return self.query.run(gql, feed)
+            except EngineError as e:
+                # only transport failures are retryable (a dead/restarting
+                # shard surfaces as "rpc to H:P failed after retries");
+                # semantic errors (unknown feature, parse) raise at once
+                if "failed after retries" not in str(e) \
+                        or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+
     # -- root sampling -----------------------------------------------------
     def sample_node(self, count: int, node_type: int = -1) -> np.ndarray:
-        out = self.query.run(f"sampleN({node_type}, {count}).as(n)")
+        out = self._run(f"sampleN({node_type}, {count}).as(n)")
         return out["n:0"].astype(np.uint64).ravel()
 
     def sample_edge(self, count: int, edge_type: int = -1):
-        out = self.query.run(f"sampleE({edge_type}, {count}).as(e)")
+        out = self._run(f"sampleE({edge_type}, {count}).as(e)")
         return (out["e:0"].astype(np.uint64), out["e:1"].astype(np.uint64),
                 out["e:2"].astype(np.int32))
 
@@ -72,7 +101,7 @@ class RemoteGraphEngine:
         q = "v(r)"
         for i, k in enumerate(counts):
             q += f".sampleNB({per_hop[i]}, {int(k)}, {default_id}).as(h{i})"
-        out = self.query.run(q, {"r": roots})
+        out = self._run(q, {"r": roots})
         ids = [out[f"h{i}:1"].astype(np.uint64) for i in range(len(counts))]
         w = [out[f"h{i}:2"].astype(np.float32) for i in range(len(counts))]
         t = [out[f"h{i}:3"].astype(np.int32) for i in range(len(counts))]
@@ -81,7 +110,7 @@ class RemoteGraphEngine:
     def sample_neighbor(self, ids, count: int, edge_types=None,
                         default_id: int = 0):
         ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
-        out = self.query.run(
+        out = self._run(
             f"v(r).sampleNB({self._et(edge_types)}, {count}, "
             f"{default_id}).as(nb)", {"r": ids})
         n = ids.size
@@ -95,7 +124,7 @@ class RemoteGraphEngine:
         ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
         verb = "getRNB" if in_edges else (
             "getSortedNB" if sorted_by_id else "getNB")
-        out = self.query.run(
+        out = self._run(
             f"v(r).{verb}({self._et(edge_types)}).as(nb)", {"r": ids})
         idx = out["nb:0"].reshape(-1, 2)
         offsets = np.concatenate([[0], idx[:, 1]]).astype(np.uint64)
@@ -104,7 +133,7 @@ class RemoteGraphEngine:
 
     def get_neighbor_edges(self, ids, edge_types=None):
         ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
-        out = self.query.run(
+        out = self._run(
             f"v(r).outE({self._et(edge_types)}).as(e)", {"r": ids})
         idx = out["e:0"].reshape(-1, 2)
         offsets = np.concatenate([[0], idx[:, 1]]).astype(np.uint64)
@@ -118,7 +147,7 @@ class RemoteGraphEngine:
         (reference SampleNeighborLayerwiseWithAdj → API_SAMPLE_L)."""
         roots = np.ascontiguousarray(roots, dtype=np.uint64).ravel()
         sizes = ":".join(str(int(s)) for s in layer_sizes)
-        out = self.query.run(
+        out = self._run(
             f"v(r).sampleLNB({self._et(edge_types)}, {sizes}, "
             f"{default_id}).as(l)", {"r": roots})
         return [out[f"l:{i}"].astype(np.uint64)
@@ -140,7 +169,7 @@ class RemoteGraphEngine:
             gql = "v(r)" + "".join(
                 f".sampleNB({et}, 1, {default_id}).as(s{i})"
                 for i in range(walk_len))
-            res = self.query.run(gql, {"r": roots})
+            res = self._run(gql, {"r": roots})
             for i in range(walk_len):
                 out[:, i + 1] = res[f"s{i}:1"].astype(np.uint64)
             return out
@@ -185,7 +214,7 @@ class RemoteGraphEngine:
         single = not isinstance(fids, (list, tuple, np.ndarray))
         names = [fids] if single else list(fids)
         q = "v(r).values(" + ", ".join(str(n) for n in names) + ").as(f)"
-        out = self.query.run(q, {"r": ids})
+        out = self._run(q, {"r": ids})
         outs = []
         dim_list = ([dims] if single else list(dims)) if dims is not None \
             else [None] * len(names)
@@ -207,7 +236,7 @@ class RemoteGraphEngine:
 
     def get_node_type(self, ids) -> np.ndarray:
         ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
-        out = self.query.run("v(r).label().as(t)", {"r": ids})
+        out = self._run("v(r).label().as(t)", {"r": ids})
         return out["t:0"].astype(np.int32)
 
     # -- lifecycle ---------------------------------------------------------

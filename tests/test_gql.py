@@ -812,6 +812,96 @@ def test_udf_remote_applies_on_shards(ring_graph, two_shard_cluster):
     np.testing.assert_allclose(out["m:1"], [1.5, 17.5])
 
 
+def test_shard_failure_during_training(tmp_path):
+    """Mid-training shard failure (VERDICT r3 #7): one of 2 graph shards
+    is killed DURING a cluster-fed training run and restarted on a new
+    port ~1.5s later. The feeder rides out the outage — RemoteGraphEngine
+    retries transport failures until retry_deadline_s while the registry
+    monitor swaps in the replacement endpoint (recency) — and training
+    completes every step. Reference semantics: rpc_client.h:46 retry +
+    ZK watch re-resolution."""
+    import threading
+    import time
+
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.graph import (
+        GraphBuilder, RemoteGraphEngine, seed as gseed,
+    )
+    from euler_tpu.models import SupervisedGraphSage
+
+    gseed(11)
+    rng = np.random.default_rng(11)
+    n, d, c = 30, 4, 3
+    b = GraphBuilder()
+    b.set_num_types(1, 1)
+    b.set_feature(0, 0, d, "feature")
+    b.set_feature(1, 0, c, "label")
+    ids = np.arange(1, n + 1, dtype=np.uint64)
+    b.add_nodes(ids)
+    b.add_edges(np.concatenate([ids, ids]),
+                np.concatenate([np.roll(ids, -1), np.roll(ids, -3)]))
+    b.set_node_dense(ids, 0, rng.normal(0, 1, (n, d)).astype(np.float32))
+    b.set_node_dense(ids, 1, np.eye(c, dtype=np.float32)[
+        (ids % c).astype(np.int64)])
+    data_dir = str(tmp_path / "g")
+    b.finalize().dump(data_dir, num_partitions=2)
+
+    reg = str(tmp_path / "reg")
+    import os
+
+    os.makedirs(reg)
+    servers = [start_service(data_dir, shard_idx=i, shard_num=2, port=0,
+                             registry_dir=reg) for i in range(2)]
+    remote = RemoteGraphEngine(f"dir:{reg}", seed=5, retry_deadline_s=60)
+    timeline = {}
+    try:
+        flow = FanoutDataFlow(remote, [3, 2], feature_ids=["feature"])
+        est = NodeEstimator(
+            SupervisedGraphSage(num_classes=c, multilabel=False, dim=8,
+                                fanouts=(3, 2)),
+            dict(batch_size=8, learning_rate=0.05, label_dim=c,
+                 log_steps=1000, checkpoint_steps=0),
+            remote, flow, label_fid="label", label_dim=c)
+        it = est.train_input_fn()
+        res = est.train(it, max_steps=3)
+        assert res["global_step"] == 3
+
+        # kill shard 0 NOW; a replacement comes up on a NEW port 1.5s
+        # later (while the feeder is already retrying)
+        servers[0].stop()
+        timeline["down_at"] = time.monotonic()
+
+        def revive():
+            time.sleep(1.5)
+            servers[0] = start_service(data_dir, shard_idx=0, shard_num=2,
+                                       port=0, registry_dir=reg)
+            timeline["up_at"] = time.monotonic()
+
+        t = threading.Thread(target=revive)
+        t.start()
+        try:
+            # every fanout query fans over BOTH shards (split/REMOTE/
+            # merge), so these steps cannot complete while shard 0 is
+            # down — the feeder must survive the outage
+            res = est.train(it, max_steps=8)
+            done_at = time.monotonic()  # BEFORE t.join(): the join would
+            # make a later reading >= up_at vacuously
+        finally:
+            t.join()
+        assert res["global_step"] == 8
+        assert np.isfinite(res["loss"])
+        # the run genuinely crossed the outage: training could only
+        # have finished after the replacement shard came up
+        assert done_at >= timeline["up_at"] > timeline["down_at"]
+        # and the cluster is healthy again for a direct query
+        assert remote.sample_node(4, -1).shape == (4,)
+    finally:
+        remote.close()
+        for s in servers:
+            s.stop()
+
+
 @pytest.fixture
 def two_attr_graph():
     """Nodes with a hash attribute (category) and a range attribute

@@ -93,9 +93,10 @@ def test_production_topology_loss_parity(tmp_path):
     devices, one global {model: 2, data: 4} mesh whose MODEL axis spans
     the hosts, feature + FUSED sampling tables row-sharded over it, and
     every step's labels fetched live from the 2-shard TCP graph cluster.
-    Training losses must match (a) across the two hosts and (b) a
-    single-process run of the same global program bit-for-bit
-    (the same 8-device mesh in one process)."""
+    Training losses must match (a) IDENTICALLY across the two hosts and
+    (b) a single-process run of the same global program to float32
+    round-off (cross-process collectives may reduce in a different
+    order than the single-process build — measured delta is 1 ULP)."""
     data_dir = _production_graph(tmp_path)
 
     ref = _run_topology(data_dir, 1)
@@ -110,13 +111,19 @@ def test_production_topology_loss_parity(tmp_path):
     assert len(results) == 2
     by_pid = {r["process_id"]: r for r in results}
     assert set(by_pid) == {0, 1}
+    # the two hosts run ONE global program: their losses must be
+    # IDENTICAL, not merely close
+    assert by_pid[0]["losses"] == by_pid[1]["losses"]
     for pid, r in by_pid.items():
         assert r["process_count"] == 2
         assert r["devices"] == 8           # global view spans both hosts
         assert r["mesh"] == {"model": 2, "data": 4}
         assert r["table_spans_hosts"]
-        # loss parity with the single-process reference run
-        np.testing.assert_allclose(r["losses"], ref_losses, rtol=1e-5)
+        # loss parity with the single-process reference run: the global
+        # program is the same but cross-process collectives may reduce
+        # in a different order, so parity holds to float32 round-off
+        # (measured: 1 ULP), not bit-for-bit
+        np.testing.assert_allclose(r["losses"], ref_losses, rtol=1e-6)
 
 
 def test_two_process_multihost_tcp_registry(tmp_path):

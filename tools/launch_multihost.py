@@ -142,17 +142,22 @@ def worker_train_topology(args) -> None:
     mesh = Mesh(np.array(devs).reshape(2, 4), ("model", "data"))
 
     nproc = jax.process_count()
-    server, remote = _serve_and_connect(args, pid, nproc, seed=3)
+    server = remote = None
     try:
+        # inside the try: a registration timeout in _serve_and_connect
+        # must still reach finalize_multihost, or the peer process
+        # strands at the exit barrier until the launcher's timeout
+        server, remote = _serve_and_connect(args, pid, nproc, seed=3)
         _train_topology_body(args, pid, nproc, mesh, remote)
     finally:
-        # a failure here must not strand the peer at the exit barrier:
         # release everything, THEN rendezvous
-        remote.close()
+        if remote is not None:
+            remote.close()
         try:
             finalize_multihost(args.barrier_dir)
         finally:
-            server.stop()
+            if server is not None:
+                server.stop()
 
 
 def _train_topology_body(args, pid, nproc, mesh, remote) -> None:
