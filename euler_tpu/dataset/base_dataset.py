@@ -3,12 +3,16 @@
 Parity: tf_euler/python/dataset/ (base_dataset.py:37-60 download→json→
 binary pipeline + 13 named datasets with get_dataset registry). This
 environment has no network egress, so each named dataset resolves in
-order:
+order (DATA.md documents every layout with download pointers):
   1. a prepared binary graph under $EULER_TPU_DATA_DIR/<name>/ (meta.bin)
-  2. a raw .npz under $EULER_TPU_DATA_DIR/<name>.npz
-     (keys: features [N,D] float32, labels [N] or [N,C], edges [2,E],
-     train_mask/val_mask/test_mask [N] bool)
-  3. a deterministic synthetic stand-in with the same statistical shape
+  2. a raw .npz under $EULER_TPU_DATA_DIR/<name>.npz — either native
+     keys (features [N,D], labels [N]/[N,C], edges [2,E], optional
+     train/val/test masks) or the public gnn-benchmark CSR layout
+     (adj_*/attr_*/labels); absent masks get the planetoid protocol
+     split
+  3. an OGB-style directory $EULER_TPU_DATA_DIR/<name>/ with
+     edge_index/node_feat/node_label/{train,valid,test}_idx .npy files
+  4. a deterministic synthetic stand-in with the same statistical shape
      (class-informative features over an SBM graph) so the full pipeline
      — engine build, sampling, training, eval — exercises identically.
 
@@ -147,32 +151,127 @@ def synthetic_citation(name: str, n: int, d: int, num_classes: int,
         np.concatenate([intra_src, inter_src]),
         np.concatenate([intra_dst, inter_dst]),
     ])
-    # splits: train_per_class per class, then val/test
-    train_mask = np.zeros(n, bool)
-    for c in range(num_classes):
-        take = by_class[c][:train_per_class]
-        train_mask[take] = True
-    remaining = np.where(~train_mask)[0]
-    val_mask = np.zeros(n, bool)
-    val_mask[remaining[:val]] = True
-    test_mask = np.zeros(n, bool)
-    test_mask[remaining[val:val + test]] = True
+    # splits: the planetoid protocol (shared with the real-data loaders)
+    train_mask, val_mask, test_mask = _planetoid_split(
+        labels, train_per_class=train_per_class, val=val, test=test)
     engine = build_engine(features, labels, edges, train_mask, val_mask,
                           test_mask)
     return GraphData(engine, num_classes, d, n - 1, name=name,
                      source="synthetic")
 
 
+def _planetoid_split(labels_1d: np.ndarray, train_per_class: int = 20,
+                     val: int = 500, test: int = 1000):
+    """The planetoid protocol split (20 labeled nodes per class, 500
+    val, 1000 test — Yang et al. 2016, the split the reference's
+    published cora/pubmed/citeseer numbers use) over nodes in id order.
+    Used when a dump carries no masks (e.g. gnn-benchmark CSR files)."""
+    n = labels_1d.shape[0]
+    train_mask = np.zeros(n, bool)
+    for c in np.unique(labels_1d):
+        train_mask[np.where(labels_1d == c)[0][:train_per_class]] = True
+    rest = np.where(~train_mask)[0]
+    val_mask = np.zeros(n, bool)
+    val_mask[rest[:val]] = True
+    test_mask = np.zeros(n, bool)
+    test_mask[rest[val:val + test]] = True
+    return train_mask, val_mask, test_mask
+
+
+def _csr_to_dense(z, prefix: str) -> np.ndarray:
+    """Rebuild a dense [N, D] float32 matrix from the CSR triplet keys
+    `<prefix>_data/_indices/_indptr/_shape` (the gnn-benchmark layout)
+    without scipy."""
+    data = z[f"{prefix}_data"]
+    indices = z[f"{prefix}_indices"].astype(np.int64)
+    indptr = z[f"{prefix}_indptr"].astype(np.int64)
+    shape = tuple(int(s) for s in z[f"{prefix}_shape"])
+    out = np.zeros(shape, np.float32)
+    rows = np.repeat(np.arange(shape[0]), np.diff(indptr))
+    out[rows, indices] = data
+    return out
+
+
+def _csr_to_edges(z, prefix: str = "adj") -> np.ndarray:
+    indices = z[f"{prefix}_indices"].astype(np.int64)
+    indptr = z[f"{prefix}_indptr"].astype(np.int64)
+    n = int(z[f"{prefix}_shape"][0])
+    src = np.repeat(np.arange(n), np.diff(indptr))
+    return np.stack([src, indices])
+
+
 def _load_npz(path: str, name: str) -> GraphData:
+    """A `.npz` drop-in under $EULER_TPU_DATA_DIR/<name>.npz. Two
+    accepted layouts (DATA.md documents both with download pointers):
+
+    1. native: features [N,D], labels [N] or [N,C], edges [2,E],
+       train_mask/val_mask/test_mask [N] bool.
+    2. gnn-benchmark CSR (the public cora/citeseer/pubmed dumps from
+       github.com/shchur/gnn-benchmark, also used by many planetoid
+       mirrors): adj_data/adj_indices/adj_indptr/adj_shape +
+       attr_data/attr_indices/attr_indptr/attr_shape + labels. Masks are
+       optional there; absent masks get the planetoid protocol split
+       (20 per class / 500 val / 1000 test, in node order).
+
+    Parity: the reference downloads the same public sources in
+    tf_euler/python/dataset/base_dataset.py:37-60 (e.g. dataset/cora.py)."""
     z = np.load(path, allow_pickle=False)
-    engine = build_engine(
-        z["features"], z["labels"], z["edges"],
-        z["train_mask"], z["val_mask"], z["test_mask"])
-    labels = z["labels"]
+    keys = set(z.files)
+
+    def masks_for(labels):
+        # all three masks or none: a partial set is a malformed file
+        # (an actionable error, not a KeyError from the archive)
+        have = {"train_mask", "val_mask", "test_mask"} & keys
+        if len(have) == 3:
+            return z["train_mask"], z["val_mask"], z["test_mask"]
+        if have:
+            raise ValueError(
+                f"{path}: carries {sorted(have)} but not all of "
+                "train_mask/val_mask/test_mask — provide all three or "
+                "none (absent masks get the planetoid split; see DATA.md)")
+        labels_1d = labels.argmax(axis=1) if labels.ndim > 1 else labels
+        return _planetoid_split(labels_1d)
+
+    if {"features", "labels", "edges"} <= keys:
+        features, labels, edges = z["features"], z["labels"], z["edges"]
+        masks = masks_for(labels)
+    elif {"adj_data", "adj_indices", "adj_indptr", "adj_shape",
+          "labels"} <= keys:
+        features = _csr_to_dense(z, "attr")
+        labels = z["labels"]
+        edges = _csr_to_edges(z, "adj")
+        masks = masks_for(labels)
+    else:
+        raise ValueError(
+            f"{path}: unrecognized npz layout (keys: {sorted(keys)}); "
+            "expected native keys (features/labels/edges/*_mask) or the "
+            "gnn-benchmark CSR keys (adj_*/attr_*/labels) — see DATA.md")
+    engine = build_engine(features, labels, edges, *masks)
     num_classes = int(labels.max()) + 1 if labels.ndim == 1 else labels.shape[1]
-    return GraphData(engine, num_classes, z["features"].shape[1],
-                     int(z["features"].shape[0]) - 1, name=name,
+    return GraphData(engine, num_classes, features.shape[1],
+                     int(features.shape[0]) - 1, name=name,
                      multilabel=labels.ndim > 1, source=path)
+
+
+def _load_ogb_dir(path: str, name: str) -> GraphData:
+    """An OGB-style directory drop-in: $EULER_TPU_DATA_DIR/<name>/ with
+    edge_index.npy [2,E], node_feat.npy [N,D], node_label.npy [N] or
+    [N,1], and train_idx.npy/valid_idx.npy/test_idx.npy (the arrays
+    `ogb.nodeproppred.NodePropPredDataset` exposes — np.save each once
+    on any machine with egress; see DATA.md)."""
+    ld = {k: np.load(os.path.join(path, f"{k}.npy"))
+          for k in ("edge_index", "node_feat", "node_label",
+                    "train_idx", "valid_idx", "test_idx")}
+    labels = ld["node_label"].reshape(-1).astype(np.int64)
+    n = ld["node_feat"].shape[0]
+    masks = []
+    for k in ("train_idx", "valid_idx", "test_idx"):
+        m = np.zeros(n, bool)
+        m[ld[k].reshape(-1).astype(np.int64)] = True
+        masks.append(m)
+    engine = build_engine(ld["node_feat"], labels, ld["edge_index"], *masks)
+    return GraphData(engine, int(labels.max()) + 1, ld["node_feat"].shape[1],
+                     n - 1, name=name, source=path)
 
 
 def load_named(name: str, synthetic_cfg: Dict) -> GraphData:
@@ -188,4 +287,6 @@ def load_named(name: str, synthetic_cfg: Dict) -> GraphData:
         npz = os.path.join(data_dir, f"{name}.npz")
         if os.path.exists(npz):
             return _load_npz(npz, name)
+        if os.path.exists(os.path.join(bin_dir, "edge_index.npy")):
+            return _load_ogb_dir(bin_dir, name)
     return synthetic_citation(name, **synthetic_cfg)

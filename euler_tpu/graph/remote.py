@@ -19,10 +19,12 @@ reference's whole design exists to amortize.
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Sequence
 
 import numpy as np
 
+from euler_tpu.core.lib import EngineError
 from euler_tpu.gql import Query
 
 
@@ -49,10 +51,6 @@ class RemoteGraphEngine:
 
     def _run(self, gql: str, feed=None):
         """query.run with shard-failover retry (see retry_deadline_s)."""
-        import time
-
-        from euler_tpu.core.lib import EngineError
-
         deadline = time.monotonic() + self.retry_deadline_s
         while True:
             try:

@@ -65,6 +65,90 @@ def test_karate_via_data_dir_npz(tmp_path, monkeypatch):
     assert res["test_metric"] >= 0.75, res
 
 
+def test_gnn_benchmark_csr_npz_layout(tmp_path, monkeypatch):
+    """The public gnn-benchmark CSR dumps (shchur/gnn-benchmark
+    data/npz/{cora,citeseer,pubmed}.npz) load unmodified: CSR adjacency
+    + CSR attributes + labels, planetoid-protocol split applied when the
+    file carries no masks (DATA.md layout 2)."""
+    from euler_tpu.dataset import get_dataset
+
+    rng = np.random.default_rng(3)
+    n, d, c = 60, 12, 3
+    labels = rng.integers(0, c, n)
+    # random sparse features as CSR
+    dense = (rng.random((n, d)) < 0.25) * rng.random((n, d))
+    indptr = np.zeros(n + 1, np.int64)
+    indices, data = [], []
+    for i in range(n):
+        cols = np.where(dense[i] != 0)[0]
+        indices.extend(cols)
+        data.extend(dense[i, cols])
+        indptr[i + 1] = len(indices)
+    # ring adjacency as CSR
+    adj_indices = ((np.arange(n) + 1) % n).astype(np.int64)
+    adj_indptr = np.arange(n + 1, dtype=np.int64)
+    np.savez(tmp_path / "pubmed.npz",
+             adj_data=np.ones(n, np.float32), adj_indices=adj_indices,
+             adj_indptr=adj_indptr, adj_shape=np.array([n, n]),
+             attr_data=np.array(data, np.float32),
+             attr_indices=np.array(indices, np.int64),
+             attr_indptr=indptr, attr_shape=np.array([n, d]),
+             labels=labels)
+    monkeypatch.setenv("EULER_TPU_DATA_DIR", str(tmp_path))
+    ds = get_dataset("pubmed")
+    assert ds.source.endswith("pubmed.npz")
+    assert ds.engine.node_count == n and ds.num_classes == c
+    # features round-trip the CSR densification exactly
+    ids = np.arange(n, dtype=np.uint64)
+    feats = ds.engine.get_dense_feature(ids, "feature")
+    np.testing.assert_allclose(feats, dense.astype(np.float32), atol=1e-6)
+    # planetoid-protocol split: 20/class train (capped by class size),
+    # remainder to val (here < 500, so no test nodes)
+    types = ds.engine.get_node_type(ids)
+    per_class_train = [
+        int(((types == 0) & (labels == k)).sum()) for k in range(c)]
+    assert all(t == min(20, int((labels == k).sum()))
+               for k, t in zip(range(c), per_class_train))
+
+
+def test_ogb_style_npy_dir_layout(tmp_path, monkeypatch):
+    """OGB-style directory drop-in (DATA.md layout 3): edge_index /
+    node_feat / node_label / {train,valid,test}_idx .npy files."""
+    from euler_tpu.dataset import get_dataset
+
+    rng = np.random.default_rng(4)
+    n, d, c = 40, 6, 4
+    sub = tmp_path / "cora"
+    sub.mkdir()
+    np.save(sub / "edge_index.npy",
+            np.stack([np.arange(n), (np.arange(n) + 1) % n]))
+    np.save(sub / "node_feat.npy",
+            rng.normal(0, 1, (n, d)).astype(np.float32))
+    np.save(sub / "node_label.npy",
+            rng.integers(0, c, (n, 1)))          # OGB's [N, 1] shape
+    idx = rng.permutation(n)
+    np.save(sub / "train_idx.npy", idx[:20])
+    np.save(sub / "valid_idx.npy", idx[20:30])
+    np.save(sub / "test_idx.npy", idx[30:])
+    monkeypatch.setenv("EULER_TPU_DATA_DIR", str(tmp_path))
+    ds = get_dataset("cora")
+    assert ds.source == str(sub)
+    assert ds.engine.node_count == n and ds.num_classes == c
+    types = ds.engine.get_node_type(np.arange(n, dtype=np.uint64))
+    assert (types == 0).sum() == 20
+    assert (types == 1).sum() == 10
+    assert (types == 2).sum() == 10
+
+
+def test_unrecognized_npz_layout_is_actionable(tmp_path, monkeypatch):
+    from euler_tpu.dataset import get_dataset
+
+    np.savez(tmp_path / "citeseer.npz", stuff=np.zeros(3))
+    monkeypatch.setenv("EULER_TPU_DATA_DIR", str(tmp_path))
+    with pytest.raises(ValueError, match="DATA.md"):
+        get_dataset("citeseer")
+
+
 def test_karate_named_dataset():
     from euler_tpu.dataset import get_dataset
 
