@@ -229,8 +229,12 @@ def _load_npz(path: str, name: str) -> GraphData:
                 f"{path}: carries {sorted(have)} but not all of "
                 "train_mask/val_mask/test_mask — provide all three or "
                 "none (absent masks get the planetoid split; see DATA.md)")
-        labels_1d = labels.argmax(axis=1) if labels.ndim > 1 else labels
-        return _planetoid_split(labels_1d)
+        if labels.ndim > 1:
+            raise ValueError(
+                f"{path}: multilabel [N, C] labels need explicit "
+                "train/val/test masks — the planetoid per-class split "
+                "protocol is single-label only (see DATA.md)")
+        return _planetoid_split(labels)
 
     if {"features", "labels", "edges"} <= keys:
         features, labels, edges = z["features"], z["labels"], z["edges"]

@@ -205,6 +205,27 @@ class RemoteGraphEngine:
         return out
 
     # -- features ----------------------------------------------------------
+    def _dense_from_values(self, out, n: int, names, dims, single: bool):
+        """Decode a values() query's (idx, vals) pairs into dense [n, d]
+        arrays. Rows can be ragged (graph_partition mode returns EMPTY
+        rows for ids a shard doesn't own) — scatter by the idx offsets
+        instead of a flat reshape, zero-filling misses like the embedded
+        engine does. Shared by the node and edge dense getters."""
+        outs = []
+        dim_list = ([dims] if single else list(dims)) if dims is not None \
+            else [None] * len(names)
+        for i, want in enumerate(dim_list):
+            idx = out[f"f:{2 * i}"].reshape(-1, 2).astype(np.int64)
+            vals = out[f"f:{2 * i + 1}"].astype(np.float32)
+            lens = idx[:, 1] - idx[:, 0]
+            dim = int(want) if want is not None else int(lens.max(initial=0))
+            arr = np.zeros((n, dim), dtype=np.float32)
+            for r in range(min(n, idx.shape[0])):
+                m = min(int(lens[r]), dim)
+                arr[r, :m] = vals[idx[r, 0]:idx[r, 0] + m]
+            outs.append(arr)
+        return outs[0] if single else outs
+
     def get_dense_feature(self, ids, fids, dims=None):
         """[n, dim] float32 per fid; mirrors GraphEngine.get_dense_feature
         (single name → single array, list → list)."""
@@ -213,24 +234,59 @@ class RemoteGraphEngine:
         names = [fids] if single else list(fids)
         q = "v(r).values(" + ", ".join(str(n) for n in names) + ").as(f)"
         out = self._run(q, {"r": ids})
-        outs = []
-        dim_list = ([dims] if single else list(dims)) if dims is not None \
-            else [None] * len(names)
-        for i, want in enumerate(dim_list):
-            idx = out[f"f:{2 * i}"].reshape(-1, 2).astype(np.int64)
-            vals = out[f"f:{2 * i + 1}"].astype(np.float32)
-            # rows can be ragged (graph_partition mode returns EMPTY rows
-            # for ids a shard doesn't own) — scatter by the idx offsets
-            # instead of a flat reshape, zero-filling misses like the
-            # embedded engine does
-            lens = idx[:, 1] - idx[:, 0]
-            dim = int(want) if want is not None else int(lens.max(initial=0))
-            arr = np.zeros((ids.size, dim), dtype=np.float32)
-            for r in range(min(ids.size, idx.shape[0])):
-                m = min(int(lens[r]), dim)
-                arr[r, :m] = vals[idx[r, 0]:idx[r, 0] + m]
-            outs.append(arr)
-        return outs[0] if single else outs
+        return self._dense_from_values(out, ids.size, names, dims, single)
+
+    @staticmethod
+    def _csr_result(out, tag: str, dtype):
+        """(offsets[n+1], values) from a values() query's (idx, vals)
+        pair — the CSR convention the embedded engine's sparse/binary
+        getters return."""
+        idx = out[f"{tag}:0"].reshape(-1, 2).astype(np.int64)
+        offsets = np.concatenate([[0], idx[:, 1]]).astype(np.uint64)
+        return offsets, out[f"{tag}:1"].astype(dtype)
+
+    def get_sparse_feature(self, ids, fid) -> tuple:
+        """(offsets[n+1], u64 values) CSR; mirrors
+        GraphEngine.get_sparse_feature over the cluster."""
+        ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
+        out = self._run(f"v(r).values({fid}).as(p)", {"r": ids})
+        return self._csr_result(out, "p", np.uint64)
+
+    def get_binary_feature(self, ids, fid) -> tuple:
+        """(offsets[n+1], bytes) CSR of raw per-node byte strings."""
+        ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
+        out = self._run(f"v(r).values({fid}).as(p)", {"r": ids})
+        offs, vals = self._csr_result(out, "p", np.uint8)
+        return offs, vals.tobytes()
+
+    def get_edge_dense_feature(self, src, dst, types, fids, dims=None):
+        """[n, dim] float32 per fid for (src, dst, type) edge triples."""
+        feed = {"batch:0": np.ascontiguousarray(src, np.uint64).ravel(),
+                "batch:1": np.ascontiguousarray(dst, np.uint64).ravel(),
+                "batch:2": np.ascontiguousarray(types, np.int32).ravel()}
+        single = not isinstance(fids, (list, tuple, np.ndarray))
+        names = [fids] if single else list(fids)
+        q = "e(batch).values(" + ", ".join(str(n) for n in names) + ").as(f)"
+        out = self._run(q, feed)
+        return self._dense_from_values(out, feed["batch:0"].size, names,
+                                       dims, single)
+
+    def get_edge_sparse_feature(self, src, dst, types, fid) -> tuple:
+        feed = {"batch:0": np.ascontiguousarray(src, np.uint64).ravel(),
+                "batch:1": np.ascontiguousarray(dst, np.uint64).ravel(),
+                "batch:2": np.ascontiguousarray(types, np.int32).ravel()}
+        out = self._run(f"e(batch).values({fid}).as(p)", feed)
+        return self._csr_result(out, "p", np.uint64)
+
+    def get_edge_binary_feature(self, src, dst, types, fid) -> tuple:
+        """(offsets[n+1], bytes): per-edge raw byte strings over the
+        cluster (reference GetEdgeBinaryFeature)."""
+        feed = {"batch:0": np.ascontiguousarray(src, np.uint64).ravel(),
+                "batch:1": np.ascontiguousarray(dst, np.uint64).ravel(),
+                "batch:2": np.ascontiguousarray(types, np.int32).ravel()}
+        out = self._run(f"e(batch).values({fid}).as(p)", feed)
+        offs, vals = self._csr_result(out, "p", np.uint8)
+        return offs, vals.tobytes()
 
     def get_node_type(self, ids) -> np.ndarray:
         ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()

@@ -23,6 +23,9 @@ def featured_cluster(tmp_path):
     b.set_num_types(2, 1)
     b.set_feature(0, 0, 8, "feature")
     b.set_feature(1, 0, 4, "label")
+    b.set_feature(2, 1, 0, "f_sp")          # sparse u64
+    b.set_feature(3, 2, 0, "f_bin")         # binary
+    b.set_feature(0, 2, 0, "e_blob", edge=True)  # edge binary
     ids = np.arange(1, 41, dtype=np.uint64)
     b.add_nodes(ids, types=(ids % 2).astype(np.int32),
                 weights=np.ones(40, dtype=np.float32))
@@ -36,6 +39,12 @@ def featured_cluster(tmp_path):
     feats[np.arange(40), cls] += 2.0  # learnable signal
     b.set_node_dense(ids, 0, feats)
     b.set_node_dense(ids, 1, np.eye(4, dtype=np.float32)[cls])
+    b.set_node_sparse(ids, 2, np.arange(41, dtype=np.uint64) * 2,
+                      np.arange(80, dtype=np.uint64))
+    for i in ids:
+        b.set_node_binary(int(i), 3, f"node-{i}".encode())
+        b.set_edge_binary(int(i), int(i % 40 + 1), 0, 0,
+                          f"edge-{i}".encode())
     g = b.finalize()
 
     data_dir = str(tmp_path / "g")
@@ -62,6 +71,24 @@ def test_remote_engine_matches_embedded(featured_cluster):
     assert list(r_off) == list(l_off)
     assert list(r_nb) == list(l_nb)
     assert list(remote.get_node_type(ids)) == list(g.get_node_type(ids))
+    # sparse / binary node features match the embedded engine
+    r_off, r_vals = remote.get_sparse_feature(ids, "f_sp")
+    l_off, l_vals = g.get_sparse_feature(ids, "f_sp")
+    np.testing.assert_array_equal(r_off, l_off)
+    np.testing.assert_array_equal(r_vals, l_vals)
+    rb_off, rb = remote.get_binary_feature(ids, "f_bin")
+    lb_off, lb = g.get_binary_feature(ids, "f_bin")
+    np.testing.assert_array_equal(rb_off, lb_off)
+    assert bytes(rb) == bytes(lb)
+    # edge features (dense absent here; sparse/binary) over the cluster
+    es = ids[:3]
+    ed = (es % 40 + 1).astype(np.uint64)
+    et = np.zeros(3, np.int32)
+    re_off, re_b = remote.get_edge_binary_feature(es, ed, et, "e_blob")
+    le_off, le_b = g.get_edge_binary_feature(es, ed, et, "e_blob")
+    np.testing.assert_array_equal(re_off, le_off)
+    assert bytes(re_b) == bytes(le_b)
+    assert bytes(re_b[re_off[0]:re_off[1]]) == b"edge-1"
     # fanout: remote sampling draws valid neighbors with exact shapes
     f_ids, f_w, f_t = remote.sample_fanout(ids, [3, 2])
     assert f_ids[0].shape == (12,) and f_ids[1].shape == (24,)

@@ -66,5 +66,17 @@ fi
 
 bench_stage walk      1800 --walk      || exit 1
 bench_stage layerwise 1200 --layerwise || exit 1
+
+if [ ! -f .bench_cache/stamps/infer_knn ]; then
+  log "stage infer_knn start"
+  timeout 1800 python tools/infer_knn_products.py --platform tpu --record \
+    > .bench_cache/out_infer_knn.json 2> .bench_cache/out_infer_knn.log
+  rc=$?
+  if [ $rc -eq 0 ]; then
+    touch .bench_cache/stamps/infer_knn; log "stage infer_knn OK"
+  else
+    log "stage infer_knn FAIL rc=$rc"; exit 1
+  fi
+fi
 log "ALL STAGES DONE"
 exit 0
