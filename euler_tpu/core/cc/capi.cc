@@ -469,15 +469,19 @@ int etg_random_walk(int64_t h, const uint64_t* roots, int64_t n, int64_t len,
 int etg_sample_layerwise(int64_t h, const uint64_t* roots, int64_t n_roots,
                          const int32_t* layer_sizes, int64_t n_layers,
                          const int32_t* edge_types, int64_t n_et,
-                         uint64_t default_id, uint64_t** out_layers) {
+                         uint64_t default_id, int weight_func,
+                         uint64_t** out_layers) {
   auto g = GetGraph(h);
   if (!g) return Fail("bad graph handle");
+  if (weight_func < 0 || weight_func > 1)
+    return Fail("weight_func must be 0 (identity) or 1 (sqrt)");
   std::vector<et::NodeId*> layers(n_layers);
   for (int64_t i = 0; i < n_layers; ++i) layers[i] = out_layers[i];
   et::SampleLayerwise(*g, roots, static_cast<size_t>(n_roots), layer_sizes,
                       static_cast<size_t>(n_layers), edge_types,
                       static_cast<size_t>(n_et), default_id,
-                      &et::ThreadLocalRng(), layers);
+                      &et::ThreadLocalRng(), layers,
+                      static_cast<et::LayerWeightFunc>(weight_func));
   return 0;
 }
 

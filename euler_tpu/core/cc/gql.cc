@@ -275,14 +275,21 @@ Status TranslateGql(const std::vector<GqlCall>& calls, TranslateResult* out) {
       st.cur_ids = st.last_outputs[1];
       st.cur_edge.clear();
     } else if (c.name == "sampleLNB") {
-      // sampleLNB(edge_types, layer_sizes m0:m1:..., default_id)
+      // sampleLNB(edge_types, layer_sizes m0:m1:..., default_id
+      //           [, weight_func]) — weight_func "sqrt" dampens the
+      // accumulated candidate mass (reference GeneralSampleLayer,
+      // local_sample_layer_op.cc:94); default identity.
       if (st.cur_ids.empty())
         return Status::InvalidArgument("sampleLNB without a node set");
       std::string sizes = argw(1, "1");
       int n_layers = 1 + static_cast<int>(std::count(sizes.begin(),
                                                      sizes.end(), ':'));
+      std::string wf = argw(3, "");
+      if (!wf.empty() && wf != "sqrt")
+        return Status::InvalidArgument(
+            "sampleLNB weight_func must be 'sqrt' (or omitted), got " + wf);
       st.Emit("API_SAMPLE_L", {st.cur_ids},
-              {argw(0, "*"), sizes, argw(2, "0")}, n_layers);
+              {argw(0, "*"), sizes, argw(2, "0"), wf}, n_layers);
       st.cur_ids = st.last_outputs.back();
       st.last_quad.clear();
       st.cur_edge.clear();
@@ -1001,13 +1008,20 @@ Status OptimizeDag(const CompileOptions& opts, DAGDef* dag) {
               orig + "_l" + std::to_string(l) + "_sh" + std::to_string(s);
           inner.inputs = {split + ":" + std::to_string(2 * s)};
           inner.attrs[1] = sizes[l];  // single-layer sample on the shard
-          ins.push_back(
-              rw.AddRemote(s, std::move(inner),
-                           {split + ":" + std::to_string(2 * s)}, 1) +
-              ":0");
+          // each shard also reports its candidate weight mass so
+          // POOL_MERGE can weigh shards (a mass-blind merge skewed the
+          // pool toward low-weight shards and their pad entries)
+          inner.attrs.resize(4);  // [ets, m, default, weight_func]
+          inner.attrs.push_back("emit_wsum");
+          std::string r = rw.AddRemote(
+              s, std::move(inner),
+              {split + ":" + std::to_string(2 * s)}, 2);
+          ins.push_back(r + ":0");   // pool ids
+          ins.push_back(r + ":1");   // candidate mass
         }
         std::string m =
-            rw.Add(rw.Fresh("POOL_MERGE"), "POOL_MERGE", ins, {sizes[l]});
+            rw.Add(rw.Fresh("POOL_MERGE"), "POOL_MERGE", ins,
+                   {sizes[l], n.attrs.size() > 2 ? n.attrs[2] : "0"});
         collect_ins.push_back(m + ":0");
         pool = m + ":0";
       }

@@ -744,8 +744,11 @@ class GetNodeTypeOp : public OpKernel {
 ET_REGISTER_KERNEL("API_GET_NODE_T", GetNodeTypeOp);
 
 // API_SAMPLE_L — layerwise sampling (reference sample_layer_op.cc:74).
-// input 0: root ids; attrs [edge_types, layer_sizes "m0:m1", default_id].
-// out :l = pool ids for layer l.
+// input 0: root ids; attrs [edge_types, layer_sizes "m0:m1", default_id,
+// optional weight_func "sqrt", optional "emit_wsum"]. out :l = pool ids
+// for layer l; with emit_wsum (set by the distribute rewrite on the
+// per-shard single-layer clones) out :n_layers+l = that layer's total
+// candidate mass, which POOL_MERGE uses to weigh shards.
 class SampleLayerOp : public OpKernel {
  public:
   void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
@@ -763,6 +766,16 @@ class SampleLayerOp : public OpKernel {
       sizes.push_back(m);
     }
     uint64_t def = node.attrs.size() > 2 ? std::strtoull(node.attrs[2].c_str(), nullptr, 10) : 0;
+    LayerWeightFunc wf = LayerWeightFunc::kIdentity;
+    if (node.attrs.size() > 3 && !node.attrs[3].empty()) {
+      if (node.attrs[3] != "sqrt") {
+        done(Status::InvalidArgument(
+            "sampleLNB weight_func must be 'sqrt', got " + node.attrs[3]));
+        return;
+      }
+      wf = LayerWeightFunc::kSqrt;
+    }
+    bool emit_wsum = node.attrs.size() > 4 && node.attrs[4] == "emit_wsum";
     Pcg32 rng = NodeRng(node, env);
     std::vector<Tensor> layers;
     std::vector<NodeId*> ptrs;
@@ -770,12 +783,26 @@ class SampleLayerOp : public OpKernel {
       layers.emplace_back(DType::kU64, std::vector<int64_t>{m});
       ptrs.push_back(layers.back().Flat<uint64_t>());
     }
+    std::vector<float> wsums;
     SampleLayerwise(*env.graph, ids_t.Flat<uint64_t>(), ids_t.NumElements(),
                     sizes.data(), sizes.size(),
                     ets.empty() ? nullptr : ets.data(), ets.size(), def, &rng,
-                    ptrs);
-    for (size_t l = 0; l < layers.size(); ++l)
+                    ptrs, wf, emit_wsum ? &wsums : nullptr);
+    size_t n_layers_out = layers.size();
+    for (size_t l = 0; l < n_layers_out; ++l)
       ctx->Put(node.OutName(l), std::move(layers[l]));
+    if (emit_wsum) {
+      // SampleLayerwise records one wsum per layer unconditionally
+      ET_K_RETURN_IF_ERROR(
+          wsums.size() == n_layers_out
+              ? Status::OK()
+              : Status::Internal("layer wsum count mismatch"));
+      for (size_t l = 0; l < n_layers_out; ++l) {
+        Tensor w(DType::kF32, {1});
+        w.Flat<float>()[0] = wsums[l];
+        ctx->Put(node.OutName(n_layers_out + l), std::move(w));
+      }
+    }
     done(Status::OK());
   }
 };

@@ -382,35 +382,58 @@ class RaggedGatherOp : public OpKernel {
 };
 ET_REGISTER_KERNEL("RAGGED_GATHER", RaggedGatherOp);
 
-// POOL_MERGE — attrs [m]; concat per-shard candidate pools, dedupe,
-// downsample to m (pad by cycling when short).
+// POOL_MERGE — attrs [m, default_id]; inputs are per-shard
+// (pool ids [m_s], candidate mass [1]) pairs from single-layer
+// API_SAMPLE_L(emit_wsum) clones. Each output slot draws a shard
+// ∝ its candidate mass, then a uniform entry from that shard's pool —
+// shard pools are already weighted-with-replacement draws over the
+// shard-local candidates, so for the identity weight_func the merge
+// reproduces the GLOBAL weighted-with-replacement distribution exactly
+// (the embedded engine's semantics). With weight_func=sqrt the
+// transform is applied to each shard's PARTIAL accumulation, so a
+// candidate whose frontier predecessors span shards gets
+// sqrt(w0)+sqrt(w1) rather than sqrt(w0+w1) — the same semantics as
+// the reference's distributed lowering (local_sample_layer_op.cc runs
+// per shard over shard-local edges), documented rather than hidden.
+// Zero-mass shards (no local candidates — their pools are all
+// default_id pads) are never drawn unless every shard is empty.
 class PoolMergeOp : public OpKernel {
  public:
   void Compute(const NodeDef& node, const QueryEnv& env, OpKernelContext* ctx,
                std::function<void(Status)> done) override {
     int64_t m = std::atoll(node.attrs[0].c_str());
-    std::vector<uint64_t> all;
-    std::unordered_set<uint64_t> seen;
-    for (size_t i = 0; i < node.inputs.size(); ++i) {
-      Tensor t;
-      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, i, &t));
-      const uint64_t* p = t.Flat<uint64_t>();
-      for (int64_t j = 0; j < t.NumElements(); ++j)
-        if (seen.insert(p[j]).second) all.push_back(p[j]);
+    uint64_t default_id =
+        node.attrs.size() > 1
+            ? std::strtoull(node.attrs[1].c_str(), nullptr, 10)
+            : 0;
+    size_t ns = node.inputs.size() / 2;
+    std::vector<Tensor> pools(ns);
+    std::vector<float> mass(ns);
+    std::vector<float> cum(ns);
+    float total = 0.f;
+    for (size_t s = 0; s < ns; ++s) {
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 2 * s, &pools[s]));
+      Tensor w;
+      ET_K_RETURN_IF_ERROR(GetInput(ctx, node, 2 * s + 1, &w));
+      mass[s] = w.NumElements() ? w.Flat<float>()[0] : 0.f;
+      if (mass[s] < 0 || pools[s].NumElements() == 0) mass[s] = 0.f;
+      total += mass[s];
+      cum[s] = total;
     }
     Pcg32 rng = NodeRng(node, env);
     Tensor out(DType::kU64, {m});
     uint64_t* o = out.Flat<uint64_t>();
-    if (all.empty()) {
-      std::memset(o, 0, out.ByteSize());
-    } else if (static_cast<int64_t>(all.size()) <= m) {
-      for (int64_t i = 0; i < m; ++i) o[i] = all[i % all.size()];
+    if (total <= 0.f) {
+      for (int64_t i = 0; i < m; ++i) o[i] = default_id;
     } else {
-      // partial Fisher–Yates for m distinct picks
       for (int64_t i = 0; i < m; ++i) {
-        size_t j = i + rng.NextUInt(all.size() - i);
-        std::swap(all[i], all[j]);
-        o[i] = all[i];
+        float r = rng.NextFloat() * total;
+        // upper_bound (first cum > r): r == 0 with leading zero-mass
+        // shards must still land on the first POSITIVE-mass shard
+        size_t s = std::upper_bound(cum.begin(), cum.end(), r) - cum.begin();
+        if (s >= ns) s = ns - 1;
+        const uint64_t* p = pools[s].Flat<uint64_t>();
+        o[i] = p[rng.NextUInt(pools[s].NumElements())];
       }
     }
     ctx->Put(node.OutName(0), std::move(out));

@@ -1,6 +1,7 @@
 #include "ops.h"
 
 #include <algorithm>
+#include <cmath>
 #include <unordered_map>
 
 #include "threadpool.h"
@@ -150,7 +151,9 @@ void SampleLayerwise(const Graph& g, const NodeId* roots, size_t n_roots,
                      const int32_t* layer_sizes, size_t n_layers,
                      const int32_t* edge_types, size_t n_types,
                      NodeId default_id, Pcg32* rng,
-                     const std::vector<NodeId*>& out_layers) {
+                     const std::vector<NodeId*>& out_layers,
+                     LayerWeightFunc weight_func,
+                     std::vector<float>* layer_wsums) {
   // Frontier = current set of nodes; each layer samples `m` nodes from the
   // union of the frontier's neighborhoods, ∝ accumulated edge weight.
   std::vector<NodeId> frontier(roots, roots + n_roots);
@@ -173,10 +176,16 @@ void SampleLayerwise(const Graph& g, const NodeId* roots, size_t n_roots,
     }
     cand_ids.clear();
     cand_w.clear();
+    float wsum = 0.f;
     for (const auto& kv : acc) {
       cand_ids.push_back(kv.first);
-      cand_w.push_back(kv.second);
+      float w = weight_func == LayerWeightFunc::kSqrt
+                    ? std::sqrt(kv.second)
+                    : kv.second;
+      cand_w.push_back(w);
+      wsum += w;
     }
+    if (layer_wsums) layer_wsums->push_back(wsum);
     NodeId* out = out_layers[layer];
     if (cand_ids.empty()) {
       for (size_t j = 0; j < m; ++j) out[j] = default_id;

@@ -43,11 +43,22 @@ void RandomWalk(const Graph& g, const NodeId* roots, size_t n_roots,
 // neighborhood). Parity: reference API_SAMPLE_L / sampleLNB
 // (euler/core/kernels/sample_layer_op.cc:74). Returns the pool (size m,
 // padded with default_id) for each layer.
+// weight_func transforms the accumulated per-unique-neighbor weight
+// before the draw: kIdentity (default) or kSqrt (the reference's
+// weight_func="sqrt", local_sample_layer_op.cc:94 — dampens hub mass).
+enum class LayerWeightFunc { kIdentity = 0, kSqrt = 1 };
+
+// layer_wsums (optional): receives each layer's total candidate mass
+// (sum of per-unique accumulated weights AFTER weight_func) — the
+// distributed POOL_MERGE weighs shards by it so the merged pool keeps
+// the global weighted-with-replacement distribution.
 void SampleLayerwise(const Graph& g, const NodeId* roots, size_t n_roots,
                      const int32_t* layer_sizes, size_t n_layers,
                      const int32_t* edge_types, size_t n_types,
                      NodeId default_id, Pcg32* rng,
-                     const std::vector<NodeId*>& out_layers);
+                     const std::vector<NodeId*>& out_layers,
+                     LayerWeightFunc weight_func = LayerWeightFunc::kIdentity,
+                     std::vector<float>* layer_wsums = nullptr);
 
 }  // namespace et
 

@@ -125,7 +125,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etg_get_top_k_neighbor": (i32, [i64, c_u64p, i64, c_i32p, i64, i64, u64, c_u64p, c_f32p, c_i32p]),
         "etg_sample_fanout": (i32, [i64, c_u64p, i64, c_i32p, i64, c_i32p, c_i64p, u64, ctypes.POINTER(c_u64p), ctypes.POINTER(c_f32p), ctypes.POINTER(c_i32p)]),
         "etg_random_walk": (i32, [i64, c_u64p, i64, i64, f32, f32, u64, c_i32p, i64, c_u64p]),
-        "etg_sample_layerwise": (i32, [i64, c_u64p, i64, c_i32p, i64, c_i32p, i64, u64, ctypes.POINTER(c_u64p)]),
+        "etg_sample_layerwise": (i32, [i64, c_u64p, i64, c_i32p, i64, c_i32p, i64, u64, i32, ctypes.POINTER(c_u64p)]),
         "etg_get_dense_feature": (i32, [i64, c_u64p, i64, i32, i64, c_f32p]),
         "etg_get_edge_dense_feature": (i32, [i64, c_u64p, c_u64p, c_i32p, i64, i32, i64, c_f32p]),
         "etres_new": (c_voidp, []),

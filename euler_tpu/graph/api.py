@@ -551,19 +551,26 @@ class GraphEngine:
         return out
 
     def sample_layerwise(self, roots, layer_sizes: Sequence[int], edge_types=None,
-                         default_id: int = 0):
+                         default_id: int = 0, weight_func: str = ""):
+        """weight_func '' (identity) or 'sqrt' — the reference's
+        optional transform of the accumulated candidate weight before
+        the draw (local_sample_layer_op.cc:94)."""
         roots = _u64(roots).ravel()
         sizes = _i32(layer_sizes).ravel()
         n_layers = sizes.size
         et, n_et = _opt_types(edge_types)
         etp = _ptr(et, c_i32p) if et is not None else None
+        wf = {"": 0, "sqrt": 1}.get(weight_func)
+        if wf is None:
+            raise ValueError(
+                f"weight_func must be '' or 'sqrt', got {weight_func!r}")
         bufs = [np.zeros(int(s), dtype=np.uint64) for s in sizes]
         ptrs = (c_u64p * n_layers)(*[_ptr(b, c_u64p) for b in bufs])
         _libmod.check(
             self._lib,
             self._lib.etg_sample_layerwise(
                 self.h, _ptr(roots, c_u64p), roots.size, _ptr(sizes, c_i32p),
-                n_layers, etp, n_et, default_id, ptrs),
+                n_layers, etp, n_et, default_id, wf, ptrs),
         )
         return bufs
 

@@ -140,14 +140,21 @@ class RemoteGraphEngine:
                 out["e:4"].astype(np.float32))
 
     def sample_layerwise(self, roots, layer_sizes: Sequence[int],
-                         edge_types=None, default_id: int = 0):
+                         edge_types=None, default_id: int = 0,
+                         weight_func: str = ""):
         """LADIES pools from the cluster via one sampleLNB query
-        (reference SampleNeighborLayerwiseWithAdj → API_SAMPLE_L)."""
+        (reference SampleNeighborLayerwiseWithAdj → API_SAMPLE_L).
+        weight_func '' or 'sqrt' (hub-dampening, reference
+        local_sample_layer_op.cc:94). Note: in distribute mode sqrt is
+        applied to each shard's partial accumulation (the reference's
+        distributed semantics too) — see POOL_MERGE in
+        kernels_dist.cc."""
         roots = np.ascontiguousarray(roots, dtype=np.uint64).ravel()
         sizes = ":".join(str(int(s)) for s in layer_sizes)
+        wf = f", {weight_func}" if weight_func else ""
         out = self._run(
             f"v(r).sampleLNB({self._et(edge_types)}, {sizes}, "
-            f"{default_id}).as(l)", {"r": roots})
+            f"{default_id}{wf}).as(l)", {"r": roots})
         return [out[f"l:{i}"].astype(np.uint64)
                 for i in range(len(layer_sizes))]
 

@@ -54,11 +54,15 @@ def get_top_k_neighbor(nodes, k: int, edge_types=None, default_node: int = 0):
 
 
 def sample_neighbor_layerwise(nodes, layer_sizes, edge_types=None,
-                              default_node: int = 0):
+                              default_node: int = 0,
+                              weight_func: str = ""):
     """LADIES-style layerwise sampling (reference sampleLNB /
-    SampleNeighborLayerwiseWithAdj)."""
+    SampleNeighborLayerwiseWithAdj). weight_func '' or 'sqrt' (the
+    reference's hub-dampening transform of the accumulated candidate
+    weight, local_sample_layer_op.cc:94)."""
     return get_graph().sample_layerwise(
-        nodes, layer_sizes, edge_types=edge_types, default_id=default_node
+        nodes, layer_sizes, edge_types=edge_types, default_id=default_node,
+        weight_func=weight_func
     )
 
 
