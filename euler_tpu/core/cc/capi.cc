@@ -11,6 +11,9 @@
 //
 // Convention: functions return 0 on success, nonzero on error;
 // etg_last_error() returns a thread-local message.
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <memory>
 #include <mutex>
@@ -118,6 +121,55 @@ int etg_builder_set_num_types(int64_t b, int num_node_types,
   if (!builder) return Fail("bad builder handle");
   builder->mutable_meta()->num_node_types = num_node_types;
   builder->mutable_meta()->num_edge_types = num_edge_types;
+  return 0;
+}
+
+// Named types (reference type_ops get_node_type_id/get_edge_type_id:
+// data-prep declares type NAMES, training code refers to them by name).
+int etg_builder_set_type_name(int64_t b, int edge, int type_id,
+                              const char* name) {
+  auto builder = GetBuilder(b);
+  if (!builder) return Fail("bad builder handle");
+  if (type_id < 0) return Fail("type_id must be >= 0");
+  auto* names = edge ? &builder->mutable_meta()->edge_type_names
+                     : &builder->mutable_meta()->node_type_names;
+  if (static_cast<size_t>(type_id) >= names->size())
+    names->resize(type_id + 1);
+  (*names)[type_id] = name;
+  return 0;
+}
+
+// name → type id; -1 when unknown (numeric strings resolve to their
+// value like the reference's int passthrough).
+int etg_type_id(int64_t h, int edge, const char* name) {
+  auto g = GetGraph(h);
+  if (!g) {
+    Fail("bad graph handle");
+    return -1;
+  }
+  const auto& names =
+      edge ? g->meta().edge_type_names : g->meta().node_type_names;
+  std::string want = name;
+  for (size_t i = 0; i < names.size(); ++i)
+    if (names[i] == want) return static_cast<int>(i);
+  char* end = nullptr;
+  long v = std::strtol(name, &end, 10);
+  // bounds-checked numeric passthrough: an out-of-int-range string must
+  // surface as unknown (-1 → Python KeyError), not wrap to a valid id
+  if (end != name && *end == '\0' && v >= 0 && v <= INT32_MAX)
+    return static_cast<int>(v);
+  return -1;
+}
+
+int etg_type_name(int64_t h, int edge, int type_id, char* buf, int64_t cap) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  const auto& names =
+      edge ? g->meta().edge_type_names : g->meta().node_type_names;
+  std::string out = type_id >= 0 && static_cast<size_t>(type_id) < names.size()
+                        ? names[type_id]
+                        : std::to_string(type_id);
+  std::snprintf(buf, static_cast<size_t>(cap), "%s", out.c_str());
   return 0;
 }
 

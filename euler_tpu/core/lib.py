@@ -88,6 +88,9 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etg_builder_new": (i64, []),
         "etg_builder_set_feature": (i32, [i64, i32, i32, i32, i64, ctypes.c_char_p]),
         "etg_builder_set_num_types": (i32, [i64, i32, i32]),
+        "etg_builder_set_type_name": (i32, [i64, i32, i32, ctypes.c_char_p]),
+        "etg_type_id": (i32, [i64, i32, ctypes.c_char_p]),
+        "etg_type_name": (i32, [i64, i32, i32, ctypes.c_char_p, i64]),
         "etg_builder_add_nodes": (i32, [i64, i64, c_u64p, c_i32p, c_f32p]),
         "etg_builder_add_edges": (i32, [i64, i64, c_u64p, c_u64p, c_i32p, c_f32p]),
         "etg_builder_set_node_dense": (i32, [i64, c_u64p, i64, i32, i64, c_f32p]),

@@ -108,6 +108,18 @@ class GraphBuilder:
         )
         return self
 
+    def set_type_name(self, type_id: int, name: str, edge: bool = False):
+        """Name a node/edge type so training code can refer to it by
+        name (reference type_ops get_node_type_id / get_edge_type_id;
+        the json data-prep declares type names the same way). Unnamed
+        types keep their numeric-string default."""
+        _libmod.check(
+            self._lib,
+            self._lib.etg_builder_set_type_name(
+                self.h, 1 if edge else 0, type_id, name.encode()),
+        )
+        return self
+
     def set_feature(self, fid: int, kind: int, dim: int, name: str = "", edge: bool = False):
         _libmod.check(
             self._lib,
@@ -309,6 +321,27 @@ class GraphEngine:
         if isinstance(name, (int, np.integer)):
             return int(name)
         return self._feature_names["edge" if edge else "node"][name]
+
+    def type_id(self, name_or_id, edge: bool = False) -> int:
+        """Type name (or numeric string / int) → type id (reference
+        type_ops). Raises KeyError for unknown names."""
+        if isinstance(name_or_id, (int, np.integer)):
+            return int(name_or_id)
+        t = self._lib.etg_type_id(self.h, 1 if edge else 0,
+                                  str(name_or_id).encode())
+        if t < 0:
+            kind = "edge" if edge else "node"
+            raise KeyError(f"unknown {kind} type name: {name_or_id!r}")
+        return int(t)
+
+    def type_name(self, type_id: int, edge: bool = False) -> str:
+        buf = ctypes.create_string_buffer(256)
+        _libmod.check(
+            self._lib,
+            self._lib.etg_type_name(self.h, 1 if edge else 0, type_id,
+                                    buf, 256),
+        )
+        return buf.value.decode()
 
     def feature_dim(self, fid_or_name, edge: bool = False) -> int:
         fid = self.feature_id(fid_or_name, edge)

@@ -74,6 +74,13 @@ class RemoteGraphEngine:
         return (out["e:0"].astype(np.uint64), out["e:1"].astype(np.uint64),
                 out["e:2"].astype(np.int32))
 
+    def sample_node_with_types(self, types) -> np.ndarray:
+        """One weighted node draw per requested type (reference
+        SampleNWithTypes) via the sampleNWithTypes verb."""
+        types = np.ascontiguousarray(types, dtype=np.int32).ravel()
+        out = self._run("sampleNWithTypes(t).as(n)", {"t": types})
+        return out["n:0"].astype(np.uint64).ravel()
+
     # -- traversal ---------------------------------------------------------
     @staticmethod
     def _et(edge_types) -> str:
@@ -299,6 +306,22 @@ class RemoteGraphEngine:
         ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
         out = self._run("v(r).label().as(t)", {"r": ids})
         return out["t:0"].astype(np.int32)
+
+    def type_id(self, name_or_id, edge: bool = False) -> int:
+        """Cluster clients resolve numeric ids/strings only — type NAME
+        metadata lives in the shards' local meta and is not served over
+        the wire; resolve names against a local GraphEngine (or extend
+        the meta RPC) if needed."""
+        if isinstance(name_or_id, (int, np.integer)):
+            return int(name_or_id)
+        s = str(name_or_id)
+        try:
+            return int(s)
+        except ValueError:
+            raise KeyError(
+                f"RemoteGraphEngine cannot resolve type NAME {s!r}; "
+                "pass the integer type id (names resolve on embedded "
+                "engines via GraphEngine.type_id)")
 
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:

@@ -16,10 +16,14 @@ from euler_tpu.ops.feature_ops import (  # noqa: F401
 )
 from euler_tpu.ops.neighbor_ops import (  # noqa: F401
     get_full_neighbor,
+    get_multi_hop_neighbor,
     get_neighbor_edges,
     get_sorted_full_neighbor,
     get_top_k_neighbor,
     sample_fanout,
+    sample_fanout_layerwise,
+    sample_fanout_layerwise_each_node,
+    sample_fanout_with_feature,
     sample_neighbor,
     sample_neighbor_layerwise,
     sparse_get_adj,
@@ -27,6 +31,12 @@ from euler_tpu.ops.neighbor_ops import (  # noqa: F401
 from euler_tpu.ops.sample_ops import (  # noqa: F401
     sample_edge,
     sample_node,
+    sample_node_with_src,
     sample_node_with_types,
+)
+from euler_tpu.ops.type_ops import (  # noqa: F401
+    ALL_NODE_TYPE,
+    get_edge_type_id,
+    get_node_type_id,
 )
 from euler_tpu.ops.walk_ops import gen_pair, random_walk  # noqa: F401

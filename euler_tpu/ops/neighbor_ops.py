@@ -66,6 +66,110 @@ def sample_neighbor_layerwise(nodes, layer_sizes, edge_types=None,
     )
 
 
+def get_multi_hop_neighbor(nodes, edge_types_per_hop):
+    """Full multi-hop expansion with inter-hop adjacency (reference
+    neighbor_ops.py:209 get_multi_hop_neighbor).
+
+    edge_types_per_hop: one edge-type filter per hop (None = all).
+    Returns (nodes_list, adj_list): nodes_list[h] is the UNIQUE node ids
+    of hop h (h=0 is the roots); adj_list[h] is the
+    (edge_index [2, E] int32, weights [E]) sparse adjacency from
+    nodes_list[h] rows to nodes_list[h+1] rows (the sparse_get_adj
+    convention)."""
+    import numpy as np
+
+    g = get_graph()
+    cur = np.ascontiguousarray(nodes, dtype=np.uint64).ravel()
+    nodes_list = [cur]
+    adj_list = []
+    for ets in edge_types_per_hop:
+        off, ids, w, _ = g.get_full_neighbor(cur, edge_types=ets)
+        nxt = np.unique(ids) if ids.size else np.zeros(0, np.uint64)
+        src_rows = np.repeat(np.arange(cur.size),
+                             np.diff(off.astype(np.int64)))
+        # nxt is sorted-unique → vectorized position lookup (a dict per
+        # hop would put O(E) Python work on the host feeder path)
+        dst_rows = np.searchsorted(nxt, ids).astype(np.int32)
+        adj_list.append((
+            np.stack([src_rows.astype(np.int32), dst_rows]),
+            np.asarray(w, np.float32)))
+        nodes_list.append(nxt)
+        cur = nxt
+    return nodes_list, adj_list
+
+
+def sample_fanout_layerwise_each_node(nodes, layer_counts, edge_types=None,
+                                      default_node: int = 0):
+    """Hop 1 = per-node sample_neighbor; later hops = one shared
+    layerwise pool per hop (reference neighbor_ops.py:161). Returns the
+    per-hop node arrays [roots, hop1, pool2, ...]."""
+    import numpy as np
+
+    g = get_graph()
+    cur = np.ascontiguousarray(nodes, dtype=np.uint64).ravel()
+    out = [cur]
+    for h, m in enumerate(layer_counts):
+        if h == 0:
+            nb, _, _ = g.sample_neighbor(cur, int(m),
+                                         edge_types=edge_types,
+                                         default_id=default_node)
+            cur = nb.reshape(-1)
+        else:
+            cur = g.sample_layerwise(cur, [int(m)], edge_types=edge_types,
+                                     default_id=default_node)[0]
+        out.append(cur)
+    return out
+
+
+def sample_fanout_layerwise(nodes, layer_counts, edge_types=None,
+                            default_node: int = 0, weight_func: str = ""):
+    """Every hop a shared layerwise pool (reference neighbor_ops.py:189).
+    Returns [roots, pool1, pool2, ...]."""
+    import numpy as np
+
+    g = get_graph()
+    cur = np.ascontiguousarray(nodes, dtype=np.uint64).ravel()
+    out = [cur]
+    for m in layer_counts:
+        cur = g.sample_layerwise(cur, [int(m)], edge_types=edge_types,
+                                 default_id=default_node,
+                                 weight_func=weight_func)[0]
+        out.append(cur)
+    return out
+
+
+def sample_fanout_with_feature(nodes, counts, edge_types=None,
+                               default_node: int = 0,
+                               dense_feature_names=(), dense_dimensions=(),
+                               sparse_feature_names=()):
+    """Fanout + per-hop feature fetch in one call (reference
+    neighbor_ops.py:49 SampleFanoutWithFeature). Returns
+    (neighbors, weights, types, dense_features, sparse_features):
+    neighbors has len(counts)+1 per-hop id arrays (roots first);
+    dense_features is hop-major then feature-major ([hop][feat] →
+    [n_hop, dim]); sparse_features likewise with (offsets, values)
+    CSR pairs."""
+    import numpy as np
+
+    g = get_graph()
+    roots = np.ascontiguousarray(nodes, dtype=np.uint64).ravel()
+    ids, w, t = g.sample_fanout(roots, list(counts),
+                                edge_types=edge_types,
+                                default_id=default_node)
+    neighbors = [roots] + list(ids)
+    dense, sparse = [], []
+    for hop in neighbors:
+        if dense_feature_names:
+            dims = list(dense_dimensions) if dense_dimensions else None
+            dense.append(g.get_dense_feature(hop,
+                                             list(dense_feature_names),
+                                             dims))
+        if sparse_feature_names:
+            sparse.append([g.get_sparse_feature(hop, f)
+                           for f in sparse_feature_names])
+    return neighbors, w, t, dense, sparse
+
+
 def sparse_get_adj(roots, nbr_ids, edge_types=None):
     """Adjacency between a root batch and a candidate neighbor set.
 

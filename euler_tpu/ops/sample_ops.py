@@ -22,3 +22,14 @@ def sample_edge(count: int, edge_type: int = -1):
 
 def sample_node_with_types(types) -> np.ndarray:
     return get_graph().sample_node_with_types(types)
+
+
+def sample_node_with_src(src_nodes, count: int) -> np.ndarray:
+    """For each src node, sample `count` nodes of the SAME type —
+    type-matched negatives (reference sample_ops.py:75
+    sample_node_with_src = get_node_type + sample_n_with_types).
+    Returns [len(src), count] uint64."""
+    g = get_graph()
+    src = np.ascontiguousarray(src_nodes, dtype=np.uint64).ravel()
+    types = np.repeat(g.get_node_type(src), count)
+    return g.sample_node_with_types(types).reshape(src.size, count)

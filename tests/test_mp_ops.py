@@ -158,6 +158,102 @@ def test_pallas_gather_mean_interpret():
                                atol=1e-6)
 
 
+def test_type_names_and_type_ops(ring_graph):
+    """Named types end-to-end (reference type_ops): builder
+    set_type_name → dump/load → engine type_id/type_name → ops facade
+    get_node_type_id/get_edge_type_id."""
+    import tempfile
+
+    from euler_tpu.graph import GraphBuilder, GraphEngine
+    from euler_tpu.ops import (
+        get_edge_type_id, get_node_type_id, initialize_shared_graph,
+    )
+
+    b = GraphBuilder()
+    b.set_num_types(2, 2)
+    b.set_type_name(0, "user")
+    b.set_type_name(1, "item")
+    b.set_type_name(0, "click", edge=True)
+    b.set_type_name(1, "buy", edge=True)
+    ids = np.arange(1, 7, dtype=np.uint64)
+    b.add_nodes(ids, types=(ids % 2).astype(np.int32))
+    b.add_edges(ids[:-1], ids[1:],
+                types=(ids[:-1] % 2).astype(np.int32))
+    g = b.finalize()
+    assert g.type_id("user") == 0 and g.type_id("item") == 1
+    assert g.type_id("buy", edge=True) == 1
+    assert g.type_id(1) == 1 and g.type_id("7") == 7  # passthroughs
+    assert g.type_name(0) == "user" and g.type_name(1, edge=True) == "buy"
+    with pytest.raises(KeyError):
+        g.type_id("nosuch")
+    # names survive dump/load (meta serde)
+    with tempfile.TemporaryDirectory() as d:
+        g.dump(d)
+        g2 = GraphEngine.load(d)
+        assert g2.type_id("item") == 1
+        assert g2.type_name(0, edge=True) == "click"
+    # facade (reference get_node_type_id / get_edge_type_id)
+    initialize_shared_graph(g)
+    assert get_node_type_id("item") == 1
+    np.testing.assert_array_equal(get_edge_type_id(["click", "buy", 0]),
+                                  [0, 1, 0])
+
+
+def test_composite_sampling_facades(ring_graph):
+    """The reference's composite euler_ops: sample_node_with_src,
+    get_multi_hop_neighbor, sample_fanout_layerwise(_each_node),
+    sample_fanout_with_feature."""
+    from euler_tpu.ops import (
+        get_multi_hop_neighbor, initialize_shared_graph,
+        sample_fanout_layerwise, sample_fanout_layerwise_each_node,
+        sample_fanout_with_feature, sample_node_with_src,
+    )
+
+    initialize_shared_graph(ring_graph)
+    src = np.array([1, 2, 3, 4], dtype=np.uint64)
+
+    # type-matched negatives: every sample shares its src row's type
+    negs = sample_node_with_src(src, 6)
+    assert negs.shape == (4, 6)
+    src_t = ring_graph.get_node_type(src)
+    for i in range(4):
+        got_t = ring_graph.get_node_type(negs[i])
+        assert set(got_t.tolist()) == {int(src_t[i])}
+
+    # multi-hop with inter-hop adjacency
+    nodes_list, adj_list = get_multi_hop_neighbor(src, [None, None])
+    assert len(nodes_list) == 3 and len(adj_list) == 2
+    for h, (ei, w) in enumerate(adj_list):
+        assert ei.shape[0] == 2 and ei.shape[1] == w.shape[0]
+        # every edge endpoint indexes into its hop's node list
+        assert ei[0].max(initial=0) < len(nodes_list[h])
+        assert ei[1].max(initial=0) < len(nodes_list[h + 1])
+        # adjacency rows are real edges
+        for s_row, d_row in zip(ei[0][:8], ei[1][:8]):
+            u = nodes_list[h][s_row]
+            v = nodes_list[h + 1][d_row]
+            off, nb, _, _ = ring_graph.get_full_neighbor([u])
+            assert v in set(nb.tolist())
+
+    # layerwise fanout variants: shape contract [roots, m1, m2]
+    out = sample_fanout_layerwise(src, [5, 7])
+    assert [len(x) for x in out] == [4, 5, 7]
+    out = sample_fanout_layerwise(src, [5, 7], weight_func="sqrt")
+    assert [len(x) for x in out] == [4, 5, 7]
+    out = sample_fanout_layerwise_each_node(src, [3, 7])
+    assert [len(x) for x in out] == [4, 12, 7]
+
+    # fanout + features in one call
+    nb, w, t, dense, sparse = sample_fanout_with_feature(
+        src, [3, 2], dense_feature_names=["f_dense"],
+        sparse_feature_names=["f_sparse"])
+    assert [len(x) for x in nb] == [4, 12, 24]
+    assert len(dense) == 3 and dense[0][0].shape == (4, 4)
+    assert len(sparse) == 3
+    offs, vals = sparse[1][0]
+    assert offs.shape == (13,)
+
+
 def test_sparse_get_adj(ring_graph):
     from euler_tpu.ops import initialize_shared_graph, sparse_get_adj
 
