@@ -34,7 +34,16 @@ from euler_tpu.core.lib import EngineError, check
 
 __all__ = ["Query", "GraphService", "start_service", "compile_debug",
            "register_udf", "udf_cache_stats", "udf_cache_clear",
-           "udf_cache_set_capacity"]
+           "udf_cache_set_capacity", "edge_types_str"]
+
+
+def edge_types_str(edge_types) -> str:
+    """GQL edge-type argument convention: None/empty → "*" (all types),
+    else colon-joined ids — the single definition shared by the remote
+    client and the conditioned ops facade."""
+    if edge_types is None:
+        return "*"
+    return ":".join(str(int(t)) for t in edge_types) or "*"
 
 _DTYPES = {
     0: np.uint64,

@@ -335,13 +335,19 @@ class GraphEngine:
         return int(t)
 
     def type_name(self, type_id: int, edge: bool = False) -> str:
-        buf = ctypes.create_string_buffer(256)
-        _libmod.check(
-            self._lib,
-            self._lib.etg_type_name(self.h, 1 if edge else 0, type_id,
-                                    buf, 256),
-        )
-        return buf.value.decode()
+        cap = 256
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            _libmod.check(
+                self._lib,
+                self._lib.etg_type_name(self.h, 1 if edge else 0, type_id,
+                                        buf, cap),
+            )
+            # snprintf truncates silently; a full buffer means retry
+            # bigger so long names round-trip through type_id()
+            if len(buf.value) < cap - 1:
+                return buf.value.decode()
+            cap *= 2
 
     def feature_dim(self, fid_or_name, edge: bool = False) -> int:
         fid = self.feature_id(fid_or_name, edge)

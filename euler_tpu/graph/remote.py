@@ -84,9 +84,9 @@ class RemoteGraphEngine:
     # -- traversal ---------------------------------------------------------
     @staticmethod
     def _et(edge_types) -> str:
-        if edge_types is None:
-            return "*"
-        return ":".join(str(int(t)) for t in edge_types) or "*"
+        from euler_tpu.gql import edge_types_str
+
+        return edge_types_str(edge_types)
 
     def sample_fanout(self, roots, counts: Sequence[int], edge_types=None,
                       default_id: int = 0):

@@ -58,3 +58,42 @@ def get_graph() -> GraphEngine:
             "graph not initialized; call euler_tpu.ops.initialize_graph first"
         )
     return _GRAPH
+
+
+_QUERY_CACHE: dict = {}
+_INDEX_SPEC: str = ""
+
+
+def set_index_spec(spec: str) -> None:
+    """Declare the attribute indexes conditioned ops may use, e.g.
+    "price:range_index;category:hash_index" (the reference builds these
+    at data-prep time; conditions require a matching index there too).
+    Rebuilds the cached query on next use."""
+    global _INDEX_SPEC
+    _INDEX_SPEC = spec
+    _QUERY_CACHE.clear()
+
+
+def get_query():
+    """A Query bound to the global graph — backs the ops' `condition`
+    parameters (the reference kernels append `.has(condition)` to their
+    gremlin the same way, e.g. sample_neighbor_op.cc:40). Embedded
+    engines get a cached Query.local built with set_index_spec's
+    indexes (compile cache persists across calls); cluster engines
+    reuse their own proxy (their shards' index spec is fixed at
+    start_service time)."""
+    g = get_graph()
+    q = getattr(g, "query", None)
+    if q is not None:  # RemoteGraphEngine carries its proxy
+        return q
+    key = (id(g), _INDEX_SPEC)
+    cached = _QUERY_CACHE.get(key)
+    if cached is None or cached[0]() is None:
+        import weakref
+
+        from euler_tpu.gql import Query
+
+        cached = (weakref.ref(g), Query.local(g, index_spec=_INDEX_SPEC))
+        _QUERY_CACHE.clear()  # one live entry: the current global graph
+        _QUERY_CACHE[key] = cached
+    return cached[1]

@@ -9,13 +9,35 @@ from __future__ import annotations
 
 import numpy as np
 
-from euler_tpu.ops.base import get_graph
+from euler_tpu.gql import edge_types_str as _et_str
+from euler_tpu.ops.base import get_graph, get_query
 
 
-def sample_neighbor(nodes, count: int, edge_types=None, default_node: int = 0):
-    return get_graph().sample_neighbor(
-        nodes, count, edge_types=edge_types, default_id=default_node
-    )
+def sample_neighbor(nodes, count: int, edge_types=None,
+                    default_node: int = 0, condition: str = ""):
+    """condition (index DNF, e.g. "price gt 3") filters the sampled
+    neighbors — the reference appends `.has(condition)` to the sampleNB
+    gremlin the same way (sample_neighbor_op.cc:40)."""
+    if not condition:
+        return get_graph().sample_neighbor(
+            nodes, count, edge_types=edge_types, default_id=default_node
+        )
+    roots = np.ascontiguousarray(nodes, dtype=np.uint64).ravel()
+    out = get_query().run(
+        f"v(r).sampleNB({_et_str(edge_types)}, {int(count)}, "
+        f"{int(default_node)}).has({condition}).as(nb)", {"r": roots})
+    idx = out["nb:0"].reshape(-1, 2).astype(np.int64)
+    n = roots.size
+    ids = np.full((n, count), np.uint64(default_node), np.uint64)
+    w = np.zeros((n, count), np.float32)
+    t = np.zeros((n, count), np.int32)
+    for i in range(min(n, idx.shape[0])):
+        b, e = int(idx[i, 0]), int(idx[i, 1])
+        m = min(e - b, count)
+        ids[i, :m] = out["nb:1"][b:b + m]
+        w[i, :m] = out["nb:2"][b:b + m]
+        t[i, :m] = out["nb:3"][b:b + m]
+    return ids, w, t
 
 
 def sample_fanout(nodes, counts, edge_types=None, default_node: int = 0):
@@ -30,11 +52,28 @@ def sample_fanout(nodes, counts, edge_types=None, default_node: int = 0):
     return [roots] + ids, w, t
 
 
-def get_full_neighbor(nodes, edge_types=None):
+def _conditioned_full_neighbor(nodes, edge_types, condition, verb):
+    roots = np.ascontiguousarray(nodes, dtype=np.uint64).ravel()
+    out = get_query().run(
+        f"v(r).{verb}({_et_str(edge_types)}).has({condition}).as(nb)",
+        {"r": roots})
+    idx = out["nb:0"].reshape(-1, 2)
+    offsets = np.concatenate([[0], idx[:, 1]]).astype(np.uint64)
+    return (offsets, out["nb:1"].astype(np.uint64),
+            out["nb:2"].astype(np.float32), out["nb:3"].astype(np.int32))
+
+
+def get_full_neighbor(nodes, edge_types=None, condition: str = ""):
+    if condition:
+        return _conditioned_full_neighbor(nodes, edge_types, condition,
+                                          "getNB")
     return get_graph().get_full_neighbor(nodes, edge_types=edge_types)
 
 
-def get_sorted_full_neighbor(nodes, edge_types=None):
+def get_sorted_full_neighbor(nodes, edge_types=None, condition: str = ""):
+    if condition:
+        return _conditioned_full_neighbor(nodes, edge_types, condition,
+                                          "getSortedNB")
     return get_graph().get_full_neighbor(
         nodes, edge_types=edge_types, sorted_by_id=True
     )
@@ -47,10 +86,34 @@ def get_neighbor_edges(nodes, edge_types=None):
     return get_graph().get_neighbor_edges(nodes, edge_types=edge_types)
 
 
-def get_top_k_neighbor(nodes, k: int, edge_types=None, default_node: int = 0):
-    return get_graph().get_top_k_neighbor(
-        nodes, k, edge_types=edge_types, default_id=default_node
-    )
+def get_top_k_neighbor(nodes, k: int, edge_types=None,
+                       default_node: int = 0, condition: str = ""):
+    """condition filters candidate neighbors before the weight-ordered
+    top-k (reference get_top_k_neighbor_op.cc:34: outE.has(cond)
+    .order_by(weight, desc).limit(k))."""
+    if not condition:
+        return get_graph().get_top_k_neighbor(
+            nodes, k, edge_types=edge_types, default_id=default_node
+        )
+    # node-attribute conditions filter the neighbor set (getNB.has,
+    # index-backed), then weight-ordered top-k per row
+    roots = np.ascontiguousarray(nodes, dtype=np.uint64).ravel()
+    off, nbr, w_all, t_all = _conditioned_full_neighbor(
+        roots, edge_types, condition, "getNB")
+    n = roots.size
+    ids = np.full((n, k), np.uint64(default_node), np.uint64)
+    w = np.zeros((n, k), np.float32)
+    t = np.zeros((n, k), np.int32)
+    for i in range(n):
+        b, e = int(off[i]), int(off[i + 1])
+        if e <= b:
+            continue
+        order = np.argsort(-w_all[b:e], kind="stable")[:k]
+        m = order.size
+        ids[i, :m] = nbr[b:e][order]
+        w[i, :m] = w_all[b:e][order]
+        t[i, :m] = t_all[b:e][order]
+    return ids, w, t
 
 
 def sample_neighbor_layerwise(nodes, layer_sizes, edge_types=None,
@@ -76,8 +139,6 @@ def get_multi_hop_neighbor(nodes, edge_types_per_hop):
     (edge_index [2, E] int32, weights [E]) sparse adjacency from
     nodes_list[h] rows to nodes_list[h+1] rows (the sparse_get_adj
     convention)."""
-    import numpy as np
-
     g = get_graph()
     cur = np.ascontiguousarray(nodes, dtype=np.uint64).ravel()
     nodes_list = [cur]
@@ -103,8 +164,6 @@ def sample_fanout_layerwise_each_node(nodes, layer_counts, edge_types=None,
     """Hop 1 = per-node sample_neighbor; later hops = one shared
     layerwise pool per hop (reference neighbor_ops.py:161). Returns the
     per-hop node arrays [roots, hop1, pool2, ...]."""
-    import numpy as np
-
     g = get_graph()
     cur = np.ascontiguousarray(nodes, dtype=np.uint64).ravel()
     out = [cur]
@@ -125,8 +184,6 @@ def sample_fanout_layerwise(nodes, layer_counts, edge_types=None,
                             default_node: int = 0, weight_func: str = ""):
     """Every hop a shared layerwise pool (reference neighbor_ops.py:189).
     Returns [roots, pool1, pool2, ...]."""
-    import numpy as np
-
     g = get_graph()
     cur = np.ascontiguousarray(nodes, dtype=np.uint64).ravel()
     out = [cur]
@@ -149,24 +206,41 @@ def sample_fanout_with_feature(nodes, counts, edge_types=None,
     dense_features is hop-major then feature-major ([hop][feat] →
     [n_hop, dim]); sparse_features likewise with (offsets, values)
     CSR pairs."""
-    import numpy as np
-
     g = get_graph()
     roots = np.ascontiguousarray(nodes, dtype=np.uint64).ravel()
     ids, w, t = g.sample_fanout(roots, list(counts),
                                 edge_types=edge_types,
                                 default_id=default_node)
     neighbors = [roots] + list(ids)
+    # one native call PER FEATURE over the concatenated hops, split back
+    # by hop sizes — not hops x features round trips (host feeder path)
+    flat = np.concatenate(neighbors)
+    splits = np.cumsum([len(h) for h in neighbors])[:-1]
     dense, sparse = [], []
-    for hop in neighbors:
-        if dense_feature_names:
-            dims = list(dense_dimensions) if dense_dimensions else None
-            dense.append(g.get_dense_feature(hop,
-                                             list(dense_feature_names),
-                                             dims))
-        if sparse_feature_names:
-            sparse.append([g.get_sparse_feature(hop, f)
-                           for f in sparse_feature_names])
+    if dense_feature_names:
+        dims = list(dense_dimensions) if dense_dimensions else None
+        per_feat = g.get_dense_feature(flat, list(dense_feature_names),
+                                       dims)
+        by_hop = [np.split(f, splits) for f in per_feat]   # [feat][hop]
+        dense = [[by_hop[f][h] for f in range(len(per_feat))]
+                 for h in range(len(neighbors))]           # [hop][feat]
+    if sparse_feature_names:
+        per_feat_sp = []
+        for fname in sparse_feature_names:
+            offs, vals = g.get_sparse_feature(flat, fname)
+            offs = offs.astype(np.int64)
+            hop_feats = []
+            lo = 0
+            for h in neighbors:
+                hi = lo + len(h)
+                o = offs[lo:hi + 1] - offs[lo]
+                hop_feats.append((o.astype(np.uint64),
+                                  vals[offs[lo]:offs[hi]]))
+                lo = hi
+            per_feat_sp.append(hop_feats)                  # [feat][hop]
+        sparse = [[per_feat_sp[f][h]
+                   for f in range(len(sparse_feature_names))]
+                  for h in range(len(neighbors))]          # [hop][feat]
     return neighbors, w, t, dense, sparse
 
 

@@ -9,11 +9,19 @@ from __future__ import annotations
 
 import numpy as np
 
-from euler_tpu.ops.base import get_graph
+from euler_tpu.ops.base import get_graph, get_query
 
 
-def sample_node(count: int, node_type: int = -1) -> np.ndarray:
-    return get_graph().sample_node(count, node_type)
+def sample_node(count: int, node_type: int = -1,
+                condition: str = "") -> np.ndarray:
+    """condition (index DNF, e.g. "price gt 3") restricts sampling to
+    matching nodes — the reference's sample_node(condition) via
+    `sampleN(...).has(...)` (sample_node_op.cc:61)."""
+    if not condition:
+        return get_graph().sample_node(count, node_type)
+    out = get_query().run(
+        f"sampleN({int(node_type)}, {int(count)}).has({condition}).as(n)")
+    return out["n:0"].astype(np.uint64).ravel()
 
 
 def sample_edge(count: int, edge_type: int = -1):

@@ -254,6 +254,61 @@ def test_composite_sampling_facades(ring_graph):
     assert offs.shape == (13,)
 
 
+def test_ops_condition_parameters():
+    """The reference kernels' `condition` attr (index-DNF filters
+    appended as `.has(...)` to the gremlin — sample_node_op.cc:61,
+    sample_neighbor_op.cc:40, get_top_k_neighbor_op.cc:34) on the ops
+    facade."""
+    from euler_tpu.graph import GraphBuilder, seed as gseed
+    from euler_tpu.ops import (
+        get_full_neighbor, get_top_k_neighbor, initialize_shared_graph,
+        sample_neighbor, sample_node,
+    )
+
+    gseed(17)
+    b = GraphBuilder()
+    b.set_num_types(1, 1)
+    b.set_feature(0, 0, 1, "price")
+    ids = np.arange(1, 21, dtype=np.uint64)
+    b.add_nodes(ids)
+    src = np.repeat(ids[:4], 5)
+    dst = np.tile(ids[4:9], 4)
+    b.add_edges(src, dst, weights=np.tile(
+        np.arange(1, 6, dtype=np.float32), 4))
+    b.set_node_dense(ids, 0, ids.astype(np.float32).reshape(20, 1))
+    g = b.finalize()
+    initialize_shared_graph(g)
+    from euler_tpu.ops.base import set_index_spec
+
+    set_index_spec("price:range_index")
+
+    # sample_node: every draw satisfies the condition
+    got = sample_node(64, -1, condition="price gt 15")
+    assert got.shape == (64,)
+    assert set(got.tolist()) <= set(range(16, 21))
+
+    # sample_neighbor: only price>6 neighbors survive (7, 8 of 5..9)
+    roots = ids[:2]
+    nb, w, t = sample_neighbor(roots, 4, condition="price gt 6")
+    assert nb.shape == (2, 4)
+    real = nb[nb != 0]
+    assert set(real.tolist()) <= {7, 8, 9}
+
+    # get_full_neighbor: filtered CSR
+    off, nbr, w, t = get_full_neighbor(roots, condition="price le 5")
+    assert set(nbr.tolist()) <= {4, 5}
+    assert off[-1] == nbr.size
+
+    # top-k with condition: highest-weight surviving edges first
+    ids_k, w_k, t_k = get_top_k_neighbor(roots, 2, condition="price le 8")
+    assert ids_k.shape == (2, 2)
+    # weight = dst-4 by construction; best allowed dst is 8 (w=5)... the
+    # per-row top weights must be non-increasing and all dsts <= 8
+    real = ids_k[ids_k != 0]
+    assert set(real.tolist()) <= {4, 5, 6, 7, 8}
+    assert (w_k[:, 0] >= w_k[:, 1]).all()
+
+
 def test_sparse_get_adj(ring_graph):
     from euler_tpu.ops import initialize_shared_graph, sparse_get_adj
 
