@@ -52,10 +52,20 @@ def main():
     except (OSError, ValueError):
         pass
     print("\n# lever sweep vs canonical")
-    for name in ("bench_fused.json", "bench_int8.json",
-                 "bench_fused_int8.json", "bench_pad.json",
-                 "bench_degsort.json", "bench_layerwise.json",
-                 "bench_walk.json"):
+    # both naming schemes: the round-3 watcher wrote bench_*.json, the
+    # round-4 stage-stamped payload writes out_*.json (incl. the fresh
+    # out_canonical.json recorded at HEAD)
+    for name in ("out_canonical.json",
+                 "bench_fused.json", "out_fused.json",
+                 "bench_int8.json", "out_int8.json",
+                 "bench_fused_int8.json", "out_fused_int8.json",
+                 "bench_pad.json", "out_pad.json",
+                 "bench_degsort.json", "out_degsort.json",
+                 "bench_layerwise.json", "out_layerwise.json",
+                 "bench_walk.json", "out_walk.json",
+                 "out_infer_knn.json"):
+        if not os.path.exists(os.path.join(CACHE, name)):
+            continue
         d = load(name)
         if not d:
             continue
