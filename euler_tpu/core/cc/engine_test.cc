@@ -29,6 +29,7 @@
 #include "serde.h"
 #include "tensor.h"
 #include "threadpool.h"
+#include "udf.h"
 
 namespace et {
 namespace {
@@ -175,6 +176,71 @@ void TestConcurrentSampling() {
   std::unique_lock<std::mutex> lk(mu);
   cv.wait(lk, [&] { return remaining.load() == 0; });
   CHECK_TRUE(ok.load());
+}
+
+void TestUdfResultCacheConcurrent() {
+  // the UDF result cache is hit from the executor's thread pool: hammer
+  // Get/Put/Clear/SetCapacity from many threads under TSAN; then check
+  // the single-threaded contract (hit returns the stored column,
+  // collision-by-construction verifies as a miss).
+  auto& c = UdfResultCache::Instance();
+  c.SetCapacityBytes(1u << 20);
+  c.Clear();
+  ThreadPool pool(8);
+  std::atomic<int> remaining{64};
+  std::mutex mu;
+  std::condition_variable cv;
+  for (int t0 = 0; t0 < 64; ++t0) {
+    pool.Schedule([&, t0] {
+      std::vector<uint64_t> ids = {static_cast<uint64_t>(t0 % 8)};
+      uint64_t key = UdfCacheKey(1, 0, "udf:mean", 0, ids.data(), 1);
+      auto hit = c.Get(key, 1, 0, "udf:mean", 0, ids.data(), 1);
+      if (!hit) {
+        auto col = std::make_shared<CachedColumn>();
+        col->graph_uid = 1;
+        col->generation = 0;
+        col->spec = "udf:mean";
+        col->fid = 0;
+        col->ids = ids;
+        col->offs = {0, 1};
+        col->vals = {static_cast<float>(t0 % 8)};
+        c.Put(key, std::move(col));
+      }
+      if (t0 % 16 == 3) c.Clear();
+      if (t0 % 16 == 7) c.SetCapacityBytes(1u << 19);
+      if (remaining.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lk(mu);
+        cv.notify_one();
+      }
+    });
+  }
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return remaining.load() == 0; });
+  }
+  // single-threaded contract
+  c.SetCapacityBytes(1u << 20);
+  c.Clear();
+  std::vector<uint64_t> ids = {42};
+  uint64_t key = UdfCacheKey(9, 3, "udf:scale:2", 1, ids.data(), 1);
+  CHECK_TRUE(c.Get(key, 9, 3, "udf:scale:2", 1, ids.data(), 1) == nullptr);
+  auto col = std::make_shared<CachedColumn>();
+  col->graph_uid = 9;
+  col->generation = 3;
+  col->spec = "udf:scale:2";
+  col->fid = 1;
+  col->ids = ids;
+  col->offs = {0, 2};
+  col->vals = {1.f, 2.f};
+  c.Put(key, col);
+  auto hit = c.Get(key, 9, 3, "udf:scale:2", 1, ids.data(), 1);
+  CHECK_TRUE(hit != nullptr && hit->vals.size() == 2);
+  // same bucket, different full key (simulated collision) → miss
+  CHECK_TRUE(c.Get(key, 9, 4, "udf:scale:2", 1, ids.data(), 1) == nullptr);
+  uint64_t h, m, e, b;
+  c.Stats(&h, &m, &e, &b);
+  CHECK_TRUE(e >= 1 && b > 0);
+  c.Clear();
 }
 
 // ---- serde ----
@@ -371,6 +437,7 @@ int main() {
   et::TestI32OffsetGuard();
   et::TestGraphStore();
   et::TestConcurrentSampling();
+  et::TestUdfResultCacheConcurrent();
   et::TestTensorSerde();
   et::TestExecutorRunsDag();
   et::TestIndexDnf();
