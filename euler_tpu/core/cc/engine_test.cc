@@ -104,10 +104,11 @@ void TestThreadPoolStress() {
   for (int i = 0; i < 10000; ++i) {
     pool.Schedule([&, i] {
       sum.fetch_add(i);
-      if (remaining.fetch_sub(1) == 1) {
-        std::lock_guard<std::mutex> lk(mu);
-        cv.notify_one();
-      }
+      // decrement under mu: if the decrement were outside, the main
+      // thread could observe 0 and destroy mu/cv while this worker is
+      // about to lock them (UB caught by review r4)
+      std::lock_guard<std::mutex> lk(mu);
+      if (remaining.fetch_sub(1) == 1) cv.notify_one();
     });
   }
   std::unique_lock<std::mutex> lk(mu);
@@ -167,10 +168,8 @@ void TestConcurrentSampling() {
       g->SampleNode(-1, 8, &rng, out);
       for (NodeId id : out)
         if (id < 1 || id > 10) ok.store(false);
-      if (remaining.fetch_sub(1) == 1) {
-        std::lock_guard<std::mutex> lk(mu);
-        cv.notify_one();
-      }
+      std::lock_guard<std::mutex> lk(mu);  // see TestThreadPoolStress
+      if (remaining.fetch_sub(1) == 1) cv.notify_one();
     });
   }
   std::unique_lock<std::mutex> lk(mu);
@@ -208,10 +207,8 @@ void TestUdfResultCacheConcurrent() {
       }
       if (t0 % 16 == 3) c.Clear();
       if (t0 % 16 == 7) c.SetCapacityBytes(1u << 19);
-      if (remaining.fetch_sub(1) == 1) {
-        std::lock_guard<std::mutex> lk(mu);
-        cv.notify_one();
-      }
+      std::lock_guard<std::mutex> lk(mu);  // see TestThreadPoolStress
+      if (remaining.fetch_sub(1) == 1) cv.notify_one();
     });
   }
   {
@@ -240,6 +237,9 @@ void TestUdfResultCacheConcurrent() {
   uint64_t h, m, e, b;
   c.Stats(&h, &m, &e, &b);
   CHECK_TRUE(e >= 1 && b > 0);
+  // restore the production default: the cache is a process singleton
+  // and later tests must not inherit this test's tiny capacity
+  c.SetCapacityBytes(64u << 20);
   c.Clear();
 }
 

@@ -77,9 +77,18 @@ class DeviceSampledSkipGram(nn.Module):
     p: float = 1.0
     q: float = 1.0
     share_context: bool = False
+    # set to the mesh when nbr/cum are row-sharded over 'model'
+    # (shard_rows=True): walk-table reads then route through the
+    # masked-take+psum gather instead of a local take (which GSPMD would
+    # otherwise turn into a full-table all-gather per hop). The
+    # negative-sampler tables stay replicated (O(N) scalars).
+    table_mesh: Any = None
 
     @nn.compact
     def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
+        from euler_tpu.parallel.device_sampler import (
+            is_model_sharded, make_table_gather,
+        )
         from euler_tpu.parallel.device_walk import (
             gen_pair_rows, sample_global_rows, walk_rows,
         )
@@ -88,8 +97,11 @@ class DeviceSampledSkipGram(nn.Module):
         pad = self.num_rows
         key = jax.random.fold_in(jax.random.key(23), batch["sample_seed"])
         kw, kn = jax.random.split(key)
+        tg = make_table_gather(self.table_mesh) \
+            if is_model_sharded(self.table_mesh) else None
         walks = walk_rows(batch["nbr_table"], batch["cum_table"], roots,
-                          self.walk_len, kw, p=self.p, q=self.q)
+                          self.walk_len, kw, p=self.p, q=self.q,
+                          gather=tg)
         pairs = gen_pair_rows(walks, self.left_win, self.right_win)
         flat = pairs.reshape(-1, 2)                    # [B*P, 2]
         src_r, pos_r = flat[:, 0], flat[:, 1]

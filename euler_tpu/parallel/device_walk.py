@@ -89,7 +89,6 @@ def walk_rows(nbr_table: jax.Array, cum_table: jax.Array,
     the capped rows with C x C equality compares, no host round-trip.
     """
     C = nbr_table.shape[1]
-    pad_row = nbr_table.shape[0] - 1
 
     def take(tab, r):
         return gather(tab, r) if gather is not None else \
@@ -121,8 +120,13 @@ def walk_rows(nbr_table: jax.Array, cum_table: jax.Array,
             col = (bcum <= u[:, None]).sum(-1)
             col = jnp.clip(col, 0, C - 1).astype(jnp.int32)
             nxt = jnp.take_along_axis(cand, col[:, None], axis=1)[:, 0]
-            # zero-total rows (dead end / pad) stick at pad_row
-            nxt = jnp.where(total > 0, nxt, pad_row)
+            # zero-total rows (dead end / pad): every candidate slot of
+            # such a row already holds the table's DATA pad value (the
+            # builder fills dead rows with pad), so cand[:, 0] is the
+            # correct sentinel. Deriving it from nbr_table.shape[0]-1
+            # would be wrong for row-sharded tables, whose row count is
+            # padded up to the model-axis multiple (code-review r4).
+            nxt = jnp.where(total > 0, nxt, cand[:, 0])
         cols.append(nxt)
         prev, cur = cur, nxt
     return jnp.stack(cols, axis=1)

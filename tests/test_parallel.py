@@ -865,6 +865,83 @@ def test_unsupervised_device_sampled_sharded_matches_replicated():
     np.testing.assert_allclose(losses["fs"], losses["rep"], rtol=1e-5)
 
 
+def test_walk_model_sharded_matches_replicated():
+    """DeviceSampledSkipGram(table_mesh=...) over row-sharded walk
+    tables must produce the same loss as the replicated run under the
+    same key (walk_rows threads the masked-take+psum gather)."""
+    from euler_tpu.models import DeviceSampledSkipGram
+    from euler_tpu.parallel import DeviceNeighborTable, make_mesh
+    from euler_tpu.parallel.device_walk import DeviceNodeSampler
+
+    g, ids = _weighted_ring(16)
+    mesh = make_mesh(model_parallel=2)
+    negs = DeviceNodeSampler(g, mesh=mesh)
+    roots = jnp.arange(8, dtype=jnp.int32)
+    losses = {}
+    for name, kw, tm in (
+            ("rep", {}, None),
+            ("sh", {"mesh": mesh, "shard_rows": True}, mesh)):
+        t = DeviceNeighborTable(g, cap=4, **kw)
+        model = DeviceSampledSkipGram(num_rows=t.pad_row, dim=8,
+                                      walk_len=3, left_win=1, right_win=1,
+                                      num_negs=2, table_mesh=tm)
+        batch = {"rows": [roots], "sample_seed": np.uint32(4),
+                 "nbr_table": t.neighbors, "cum_table": t.cum_weights,
+                 **negs.tables}
+        with mesh:
+            params = model.init(jax.random.key(0), batch)
+            losses[name] = float(jax.jit(
+                lambda p, b: model.apply(p, b).loss)(params, batch))
+    assert np.isfinite(losses["rep"])
+    np.testing.assert_allclose(losses["sh"], losses["rep"], rtol=1e-5)
+
+    # the node2vec-biased path (p/q != 1) reads tables through the same
+    # gather hook: sharded walks must equal replicated draw-for-draw
+    from euler_tpu.parallel import make_table_gather
+    from euler_tpu.parallel.device_walk import walk_rows
+
+    t_rep = DeviceNeighborTable(g, cap=4)
+    t_sh = DeviceNeighborTable(g, cap=4, mesh=mesh, shard_rows=True)
+    kb = jax.random.key(6)
+    w_rep = walk_rows(t_rep.neighbors, t_rep.cum_weights, roots, 3, kb,
+                      p=0.5, q=2.0)
+    gather = make_table_gather(mesh)
+    with mesh:
+        w_sh = jax.jit(
+            lambda nt, ct, r: walk_rows(nt, ct, r, 3, kb, p=0.5, q=2.0,
+                                        gather=gather)
+        )(t_sh.neighbors, t_sh.cum_weights, roots)
+    np.testing.assert_array_equal(np.asarray(w_rep), np.asarray(w_sh))
+
+    # dead-end sentinel under row-padding (code-review r4): a graph
+    # with sinks and (N+1) % mp != 0 — the sharded table gains zero-pad
+    # rows, and biased walks hitting the dead end must still emit the
+    # DATA pad value (N), identical to the replicated run
+    from euler_tpu.graph import GraphBuilder
+
+    b2 = GraphBuilder()
+    ids2 = np.arange(1, 13, dtype=np.uint64)       # 12 nodes → 13 table
+    b2.add_nodes(ids2)                             # rows, padded to 14
+    b2.add_edges(ids2[:6], ids2[1:7])              # nodes 8.. are sinks
+    g2 = b2.finalize()
+    t2_rep = DeviceNeighborTable(g2, cap=3)
+    t2_sh = DeviceNeighborTable(g2, cap=3, mesh=mesh, shard_rows=True)
+    assert t2_rep.neighbors.shape[0] == 13         # unpadded
+    assert t2_sh.neighbors.shape[0] == 14          # row-padded
+    roots2 = jnp.asarray(np.arange(12, dtype=np.int32))
+    kb2 = jax.random.key(8)
+    w2_rep = walk_rows(t2_rep.neighbors, t2_rep.cum_weights, roots2, 3,
+                       kb2, p=0.5, q=2.0)
+    with mesh:
+        w2_sh = jax.jit(
+            lambda nt, ct, r: walk_rows(nt, ct, r, 3, kb2, p=0.5, q=2.0,
+                                        gather=gather)
+        )(t2_sh.neighbors, t2_sh.cum_weights, roots2)
+    np.testing.assert_array_equal(np.asarray(w2_rep), np.asarray(w2_sh))
+    # dead-end roots stick at the DATA pad (13), never a padded row index
+    assert np.asarray(w2_sh).max() <= t2_rep.pad_row
+
+
 def test_device_sampled_model_with_fused_sharded_tables():
     """End-to-end: DeviceSampledGraphSage trains a jit step with the
     FUSED sampling table row-sharded over 'model' (composition of the
