@@ -28,6 +28,8 @@ def _run(extra):
 def test_bench_smoke_graphsage_device_and_host():
     dev = _run([])
     assert dev["metric"] == "graphsage_train_edges_per_sec_per_chip"
+    # int8 feature table is the default config since the round-4 A/B
+    assert dev["detail"]["feat_table_dtype"] == "int8"
     assert dev["value"] > 0
     assert dev["detail"]["sampler"] == "device"
     assert 0.0 <= dev["detail"]["edge_keep_frac"] <= 1.0
@@ -52,9 +54,12 @@ def test_bench_smoke_perf_lever_flags():
     fused = _run(["--fused_sampler"])
     assert fused["detail"]["sampler"] == "device_fused"
     assert fused["value"] > 0
-    q = _run(["--int8_features"])
-    assert q["detail"]["feat_table_dtype"] == "int8"
-    assert q["value"] > 0
+    # int8 is the DEFAULT since the round-4 on-TPU A/B (the default-on
+    # leg is asserted on the dev run in the first test); the off-switch
+    # must restore the bf16 table for A/B re-runs
+    off = _run(["--no-int8_features"])
+    assert off["detail"]["feat_table_dtype"] != "int8"
+    assert off["value"] > 0
 
 
 def test_bench_smoke_layerwise_mode():

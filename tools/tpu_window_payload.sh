@@ -45,9 +45,13 @@ bench_stage() {  # bench_stage <name> <timeout_s> <bench args...>
   return 1  # abort the window; the watcher retries at the next UP probe
 }
 
-bench_stage canonical 1200             || exit 1
+# int8 features are DEFAULT since the round-4 A/B: canonical now runs
+# int8-on; `bf16` is the baseline leg (old canonical). The fused legs
+# keep their historical stamps: under the new default --fused_sampler
+# equals the old fused_int8 config, both already measured (regressions).
+bench_stage canonical 1500             || exit 1
+bench_stage bf16      1200 --no-int8_features || exit 1
 bench_stage fused     1200 --fused_sampler || exit 1
-bench_stage int8      1200 --int8_features || exit 1
 bench_stage fused_int8 1200 --fused_sampler --int8_features || exit 1
 bench_stage degsort   1200 --degree_sorted || exit 1
 bench_stage pad       1200 --pad_features  || exit 1
