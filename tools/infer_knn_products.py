@@ -58,7 +58,8 @@ def main(argv=None):
         smoke=False, nodes=args.nodes, batch_size=args.batch_size,
         fanouts="", steps=0, feat_dim=args.feat_dim, avg_degree=0,
         no_cache=False, bf16=True, cap=32, host_sampler=False,
-        fused_sampler=False, degree_sorted=False, int8_features=False,
+        # int8 matches bench.py's tuned default since the round-4 A/B
+        fused_sampler=False, degree_sorted=False, int8_features=True,
         pad_features=False, steps_per_loop=0, fp32=False,
         layerwise=False, walk=False, platform=args.platform)
     t0 = time.time()

@@ -4,8 +4,10 @@
 # later window resumes where the last one died.
 #
 # Priority order (most valuable first):
-#   1. canonical  — default-config bench at HEAD; refreshes BENCH_TPU.json
-#   2. lever A/Bs — fused / int8 / fused+int8 / degsort / pad
+#   1. canonical  — default-config bench at HEAD (int8 feature table
+#                   since round 4); refreshes BENCH_TPU.json
+#   2. lever A/Bs — bf16 / fused / fused_bf16 / degsort / pad /
+#                   degsort_pad (all relative to the int8-on default)
 #   3. profiler   — per-component step probes (tools/profile_device_step.py)
 #   4. walk / layerwise family benches
 #
@@ -52,9 +54,12 @@ bench_stage() {  # bench_stage <name> <timeout_s> <bench args...>
 bench_stage canonical 1500             || exit 1
 bench_stage bf16      1200 --no-int8_features || exit 1
 bench_stage fused     1200 --fused_sampler || exit 1
-bench_stage fused_int8 1200 --fused_sampler --int8_features || exit 1
+bench_stage fused_bf16 1200 --fused_sampler --no-int8_features || exit 1
 bench_stage degsort   1200 --degree_sorted || exit 1
 bench_stage pad       1200 --pad_features  || exit 1
+# stacking leg: if either single lever wins, the combo is the next
+# question — measure it in the same window rather than waiting a round
+bench_stage degsort_pad 1200 --degree_sorted --pad_features || exit 1
 
 if [ ! -f .bench_cache/stamps/profiler ]; then
   log "stage profiler start"
