@@ -571,7 +571,10 @@ def run_bench(args):
     }
 
 
-def main(argv=None):
+def build_argparser():
+    """The bench flag set; tools that re-use setup_tables derive their
+    config from this parser's defaults (one source of truth for
+    default-flip decisions like the round-4 int8 win)."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="small CPU run")
     ap.add_argument("--nodes", type=int, default=0)
@@ -627,7 +630,11 @@ def main(argv=None):
     ap.add_argument("--platform", default="",
                     choices=["", "auto", "tpu", "cpu"],
                     help="default: cpu for --smoke, auto otherwise")
-    args = ap.parse_args(argv)
+    return ap
+
+
+def main(argv=None):
+    args = build_argparser().parse_args(argv)
 
     # Eager, bounded backend init BEFORE any heavy work: probe the
     # accelerator in a subprocess with retries, fall back to CPU rather

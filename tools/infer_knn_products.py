@@ -54,14 +54,14 @@ def main(argv=None):
     # bench-cache tables (setup identical to bench.py's measured config)
     import bench as bench_mod
 
-    bench_args = argparse.Namespace(
-        smoke=False, nodes=args.nodes, batch_size=args.batch_size,
-        fanouts="", steps=0, feat_dim=args.feat_dim, avg_degree=0,
-        no_cache=False, bf16=True, cap=32, host_sampler=False,
-        # int8 matches bench.py's tuned default since the round-4 A/B
-        fused_sampler=False, degree_sorted=False, int8_features=True,
-        pad_features=False, steps_per_loop=0, fp32=False,
-        layerwise=False, walk=False, platform=args.platform)
+    # derive from bench.py's own parser so tuned default flips (e.g.
+    # the round-4 int8 win) carry over without a hand-maintained copy
+    bench_args = bench_mod.build_argparser().parse_args([])
+    bench_args.nodes = args.nodes
+    bench_args.batch_size = args.batch_size
+    bench_args.feat_dim = args.feat_dim
+    bench_args.bf16 = True
+    bench_args.platform = args.platform
     t0 = time.time()
     graph, store, sampler, cache_state = bench_mod.setup_tables(
         bench_args, args.nodes, args.avg_degree, args.feat_dim, 16,

@@ -259,10 +259,16 @@ def main():
         measure("scalar_gather_h2_ms", scanned(scal), nbr, rows_all[1],
                 cols, reps=args.reps)
 
+        # pad-to-128-lanes helper shared by the pad/int8+pad/pallas-pad
+        # probes below (feat_dim ≤ 128 is a probe precondition)
+        def pad128(tab):
+            return jax.block_until_ready(jax.jit(
+                lambda f: jnp.pad(f, ((0, 0),
+                                      (0, 128 - f.shape[1]))))(tab))
+
         # lane-padded feature table: 100 → 128 dims so each gathered row
         # is one aligned 256B tile
-        featp = jax.block_until_ready(jax.jit(
-            lambda f: jnp.pad(f, ((0, 0), (0, 128 - f.shape[1]))))(feat))
+        featp = pad128(feat)
         measure("feat_gather_h2_pad128_ms", scanned(mk_gather()), featp,
                 r2, reps=args.reps)
 
@@ -305,6 +311,19 @@ def main():
 
         measure("feat_gathermean_h2_int8_ms", scanned(gmean_q), featq,
                 fscale, r2, reps=args.reps)
+
+        # int8 + 128-lane pad: one 128-byte-aligned row per gather — the
+        # alignment question that matters under the round-4 int8-on
+        # default (pad alone was probed on the bf16 table above)
+        featqp = pad128(featq)
+        fscalep = jax.device_put(np.pad(
+            scale_h.astype(np.float32), (0, 128 - scale_h.shape[0]),
+            constant_values=1.0))
+        measure("feat_gather_h2_int8_pad128_ms", scanned(g_q), featqp,
+                fscalep, r2, reps=args.reps)
+        measure("feat_gathermean_h2_int8_pad128_ms", scanned(gmean_q),
+                featqp, fscalep, r2, reps=args.reps)
+        del featqp
         del featq
 
         # fused pallas gather+mean kernel (ops/pallas_ops.py), sweeping
@@ -323,8 +342,7 @@ def main():
 
         # pallas over a 128-lane-aligned table: the d=100 bf16 row DMA
         # is tile-unaligned and one mosaic-crash suspect
-        featp2 = jax.block_until_ready(jax.jit(
-            lambda f: jnp.pad(f, ((0, 0), (0, 128 - f.shape[1]))))(feat))
+        featp2 = pad128(feat)
 
         def gm_pallas_p(c, i, seed, tab, rr):
             r = perturb(rr, i, seed).reshape(-1, k2)
