@@ -62,6 +62,18 @@ def test_bench_smoke_perf_lever_flags():
     assert off["value"] > 0
 
 
+def test_bench_argparser_defaults_contract():
+    """Tools (infer_knn_products) derive their config from
+    build_argparser(); the tuned round-4 defaults must live there."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    d = bench.build_argparser().parse_args([])
+    assert d.int8_features is True      # round-4 on-TPU A/B winner
+    assert d.fused_sampler is False     # measured regression — not flipped
+    assert d.cap == 32 and d.steps_per_loop == 0
+
+
 def test_bench_smoke_layerwise_mode():
     out = _run(["--layerwise"])
     assert out["metric"] == "layerwise_train_pool_nodes_per_sec_per_chip"
