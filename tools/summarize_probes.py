@@ -11,6 +11,7 @@ BENCH_TPU.json headline — the inputs to the flip-defaults decision
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import sys
@@ -52,20 +53,22 @@ def main():
     except (OSError, ValueError):
         pass
     print("\n# lever sweep vs canonical")
-    # both naming schemes: the round-3 watcher wrote bench_*.json, the
-    # round-4 stage-stamped payload writes out_*.json (incl. the fresh
-    # out_canonical.json recorded at HEAD)
-    for name in ("out_canonical.json",
-                 "bench_fused.json", "out_fused.json",
-                 "bench_int8.json", "out_int8.json",
-                 "bench_fused_int8.json", "out_fused_int8.json",
-                 "bench_pad.json", "out_pad.json",
-                 "bench_degsort.json", "out_degsort.json",
-                 "bench_layerwise.json", "out_layerwise.json",
-                 "bench_walk.json", "out_walk.json",
-                 "out_infer_knn.json"):
-        if not os.path.exists(os.path.join(CACHE, name)):
-            continue
+    # discovery is glob-driven so a new payload stage can never be
+    # silently dropped (the drift class this replaced: three measured
+    # legs sat invisible behind a hardcoded list); _PRIORITY only
+    # orders the display. Both naming schemes ride the glob: the
+    # round-3 watcher wrote bench_*.json, the round-4 stage-stamped
+    # payload writes out_*.json.
+    _PRIORITY = ("out_canonical.json", "out_bf16.json", "out_fused.json",
+                 "out_fused_bf16.json", "out_int8.json",
+                 "out_degsort.json", "out_pad.json",
+                 "out_degsort_pad.json")
+    found = sorted(
+        os.path.basename(p) for pat in ("out_*.json", "bench_*.json")
+        for p in glob.glob(os.path.join(CACHE, pat)))
+    names = [n for n in _PRIORITY if n in found] + \
+            [n for n in found if n not in _PRIORITY]
+    for name in names:
         d = load(name)
         if not d:
             continue
