@@ -72,6 +72,8 @@ def job_list():
                   "--int8_features"]))
     jobs.append(("deepwalk-dev/cora", "examples/deepwalk/run_deepwalk.py",
                  ["--dataset", "cora", "--device_sampler"]))
+    jobs.append(("line-dev/cora", "examples/line/run_line.py",
+                 ["--dataset", "cora", "--device_sampler"]))
     jobs.append(("geniepath-dev/cora", "examples/geniepath/run_geniepath.py",
                  ["--dataset", "cora", "--device_sampler"]))
     return jobs
