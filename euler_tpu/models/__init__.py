@@ -9,6 +9,7 @@ from euler_tpu.models.graphsage import (  # noqa: F401
     ScalableGraphSage,
     DeviceSampledGraphSage,
     DeviceSampledLayerwiseGCN,
+    DeviceSampledScalableSage,
     DeviceSampledUnsupervisedSage,
     ShardedSupervisedGraphSage,
     SupervisedGraphSage,

@@ -128,6 +128,59 @@ class DeviceSampledGraphSage(SuperviseModel):
                            name="encoder")(layers)
 
 
+class DeviceSampledScalableSage(SuperviseModel):
+    """Historical-activation GraphSAGE with sampling AND the activation
+    cache ON DEVICE — the in-jit re-application of the reference's
+    ScalableGCN/ScalableSage insight (tf_euler/python/utils/encoders.py
+    :294,629, there a host-side TF variable store).
+
+    Structural fix for the products-scale bottleneck (PERF.md): the
+    canonical 2-hop fanout gathers ~B·k1·k2 random feature rows per
+    step (~5M at batch 32768, fanouts [15,10]) — the dominant HBM cost.
+    This model samples ONE hop, gathers raw features for roots + hop-1
+    neighbors only (B + B·k rows), and reads deeper-layer neighbor
+    activations from an HBM cache [N+1, dim] carried in the train
+    state's 'cache' collection (donated each step → XLA updates it in
+    place). Per-step gather bytes drop ~10× at the canonical shape;
+    staleness is the documented ScalableGCN tradeoff, pinned by the
+    graphsage-dev-cache quality row in RESULTS.md.
+
+    Eval applies with the cache frozen (read-only), same protocol as
+    the reference's store-based eval."""
+
+    dim: int = 32
+    fanout: int = 10          # neighbors sampled per node (single hop)
+    num_layers: int = 2       # model depth; layers >0 read the cache
+    max_id: int = 0           # cache rows - 1 == feature-table rows - 1
+    cache_dtype: Any = None   # None → float32; jnp.bfloat16 at scale
+
+    def embed(self, batch: Dict[str, Any]) -> Array:
+        import jax.numpy as jnp
+
+        from euler_tpu.parallel.device_sampler import (
+            is_model_sharded, make_table_gather, sample_hop,
+            sample_hop_fused,
+        )
+
+        roots = batch["rows"][0]
+        b = roots.shape[0]
+        key = jax.random.fold_in(jax.random.key(17), batch["sample_seed"])
+        gather = make_table_gather(self.table_mesh)
+        tg = gather if is_model_sharded(self.table_mesh) else None
+        if batch.get("nbrcum_table") is not None:
+            nbr = sample_hop_fused(batch["nbrcum_table"], roots,
+                                   int(self.fanout), key, tg)
+        else:
+            nbr = sample_hop(batch["nbr_table"], batch["cum_table"],
+                             roots, int(self.fanout), key, tg)
+        x, nbr_x = gather_feature_rows(batch, [roots, nbr], gather=gather)
+        enc = ScalableSageEncoder(
+            self.dim, int(self.num_layers), int(self.max_id),
+            cache_dtype=self.cache_dtype or jnp.float32, name="encoder")
+        return enc(roots, x, nbr.reshape(b, int(self.fanout)),
+                   nbr_x.reshape(b, int(self.fanout), x.shape[-1]))
+
+
 class DeviceSampledLayerwiseGCN(SuperviseModel):
     """FastGCN/LADIES with sampling ON DEVICE: per-layer importance
     pools, dense inter-pool adjacency, and feature gathers all run

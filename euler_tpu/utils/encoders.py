@@ -16,7 +16,7 @@ the reference's TF variable assign machinery (encoders.py:294,629).
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -131,25 +131,33 @@ class GCNEncoder(nn.Module):
 
 class _ScalableCache(nn.Module):
     """Per-node activation cache: [max_id+1, dim] rows in the 'cache'
-    collection, read for neighbor ids, written for the batch's own ids."""
+    collection, read for neighbor ids, written for the batch's own ids.
+
+    dtype picks the stored row precision: bfloat16 halves the HBM
+    footprint AND the per-step read bytes at products scale (the whole
+    point of the cache is replacing a bigger gather); reads are upcast
+    to float32 before use."""
 
     max_id: int
     dim: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, read_ids: Array, write_ids: Optional[Array] = None,
                  write_vals: Optional[Array] = None) -> Array:
         cache = self.variable(
-            "cache", "h", lambda: jnp.zeros((self.max_id + 1, self.dim)))
+            "cache", "h",
+            lambda: jnp.zeros((self.max_id + 1, self.dim), self.dtype))
         out = jnp.take(cache.value, bucketize_ids(read_ids, self.max_id + 1),
-                       axis=0)
+                       axis=0).astype(jnp.float32)
         if (write_ids is not None and write_vals is not None
                 and self.is_mutable_collection("cache")):
             # eval/infer apply the module with the cache frozen; historical
             # activations are read-only there (reference ScalableGCNEncoder
             # only updates stores inside the training op).
             rows = bucketize_ids(write_ids, self.max_id + 1)
-            cache.value = cache.value.at[rows].set(write_vals)
+            cache.value = cache.value.at[rows].set(
+                write_vals.astype(self.dtype))
         return out
 
 
@@ -166,6 +174,7 @@ class ScalableGCNEncoder(nn.Module):
     num_layers: int
     max_id: int
     store_decay: float = 0.9
+    cache_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, ids: Array, x: Array, nbr_ids: Array,
@@ -173,6 +182,7 @@ class ScalableGCNEncoder(nn.Module):
         b, k = nbr_ids.shape
         # one cache module per non-input layer, created once
         caches = {layer: _ScalableCache(self.max_id, self.dim,
+                                        dtype=self.cache_dtype,
                                         name=f"cache_{layer}")
                   for layer in range(1, self.num_layers)}
         h_self = x
@@ -202,12 +212,14 @@ class ScalableSageEncoder(nn.Module):
     num_layers: int
     max_id: int
     store_decay: float = 0.9
+    cache_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, ids: Array, x: Array, nbr_ids: Array,
                  nbr_x: Array) -> Array:
         b, k = nbr_ids.shape
         caches = {layer: _ScalableCache(self.max_id, self.dim,
+                                        dtype=self.cache_dtype,
                                         name=f"cache_{layer}")
                   for layer in range(1, self.num_layers)}
         h_self = x

@@ -36,6 +36,12 @@ def main(argv=None):
                     help="with --device_sampler: int8-quantized HBM "
                          "feature table (per-column scale, dequant "
                          "after the in-jit gather)")
+    ap.add_argument("--act_cache", action="store_true",
+                    help="with --device_sampler (supervised): "
+                         "DeviceSampledScalableSage — 1-hop sampling + "
+                         "in-jit historical-activation cache (the "
+                         "structural fix for the products-scale hop-2 "
+                         "gather, PERF.md; this flag pins its quality)")
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--num_negs", type=int, default=5)
     ap.add_argument("--learning_rate", type=float, default=0.003)
@@ -77,10 +83,19 @@ def main(argv=None):
                 quantize="int8" if args.int8_features else None)
             sampler = DeviceNeighborTable(data.engine, cap=args.sampler_cap,
                                           fused=args.fused_sampler)
-            model = DeviceSampledGraphSage(
-                num_classes=data.num_classes, multilabel=data.multilabel,
-                dim=args.hidden_dim, fanouts=fanouts,
-                aggregator=args.aggregator, dropout=args.dropout)
+            if args.act_cache:
+                from euler_tpu.models import DeviceSampledScalableSage
+                model = DeviceSampledScalableSage(
+                    num_classes=data.num_classes,
+                    multilabel=data.multilabel, dim=args.hidden_dim,
+                    fanout=fanouts[0], num_layers=len(fanouts),
+                    max_id=int(sampler.pad_row), dropout=args.dropout)
+            else:
+                model = DeviceSampledGraphSage(
+                    num_classes=data.num_classes,
+                    multilabel=data.multilabel,
+                    dim=args.hidden_dim, fanouts=fanouts,
+                    aggregator=args.aggregator, dropout=args.dropout)
         else:
             model = SupervisedGraphSage(
                 num_classes=data.num_classes, multilabel=data.multilabel,

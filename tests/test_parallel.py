@@ -1360,3 +1360,78 @@ def test_sharded_int8_feature_gather_dequantizes():
     expect = np.asarray(dequantize_rows(jnp.asarray(q[rows]),
                                         jnp.asarray(scale)))
     np.testing.assert_allclose(np.asarray(got), expect, atol=1e-6)
+
+
+def test_device_scalable_sage_trains_and_caches():
+    """DeviceSampledScalableSage end to end: 1-hop sampling + in-jit
+    historical-activation cache. Training must (a) learn, (b) actually
+    WRITE the cache (rows visited by training become non-zero), and
+    (c) evaluate with the cache frozen (same extra_vars, no mutation)."""
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.dataset.base_dataset import synthetic_citation
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.models import DeviceSampledScalableSage
+    from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+
+    data = synthetic_citation("tsc", n=300, d=16, num_classes=3,
+                              train_per_class=30, val=40, test=60, seed=3)
+    g = data.engine
+    store = DeviceFeatureStore(g, ["feature"], label_fid="label",
+                               label_dim=data.num_classes)
+    sampler = DeviceNeighborTable(g, cap=16)
+    n_rows = int(store.features.shape[0])
+    est = NodeEstimator(
+        DeviceSampledScalableSage(num_classes=data.num_classes,
+                                  multilabel=False, dim=16, fanout=4,
+                                  num_layers=2, max_id=n_rows - 1),
+        dict(batch_size=32, learning_rate=0.01, steps_per_loop=3,
+             label_dim=data.num_classes, log_steps=1000,
+             checkpoint_steps=0),
+        g, FanoutDataFlow(g, [4, 4]), label_fid="label",
+        label_dim=data.num_classes, feature_store=store,
+        device_sampler=sampler)
+    res = est.train(est.train_input_fn, max_steps=60)
+    assert res["global_step"] == 60
+    cache = est.state.extra_vars["cache"]
+    leaves = jax.tree_util.tree_leaves(cache)
+    assert leaves and leaves[0].shape == (n_rows, 16)
+    touched = np.asarray(jnp.any(leaves[0] != 0, axis=-1)).sum()
+    assert touched > 0, "training never wrote the activation cache"
+    before = np.asarray(leaves[0]).copy()
+    ev = est.evaluate(est.eval_input_fn, 10)
+    assert ev["metric"] > 0.5, ev
+    after = np.asarray(jax.tree_util.tree_leaves(
+        est.state.extra_vars["cache"])[0])
+    np.testing.assert_array_equal(before, after)  # eval must not write
+
+
+def test_device_scalable_sage_fused_table():
+    """--act_cache composes with the fused [N+1, 2C] sampling layout:
+    sample_hop_fused feeds the same encoder; training learns."""
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.dataset.base_dataset import synthetic_citation
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.models import DeviceSampledScalableSage
+    from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+
+    data = synthetic_citation("tscf", n=300, d=16, num_classes=3,
+                              train_per_class=30, val=40, test=60, seed=5)
+    g = data.engine
+    store = DeviceFeatureStore(g, ["feature"], label_fid="label",
+                               label_dim=data.num_classes)
+    sampler = DeviceNeighborTable(g, cap=16, fused=True)
+    n_rows = int(store.features.shape[0])
+    est = NodeEstimator(
+        DeviceSampledScalableSage(num_classes=data.num_classes,
+                                  multilabel=False, dim=16, fanout=4,
+                                  num_layers=2, max_id=n_rows - 1),
+        dict(batch_size=32, learning_rate=0.01, steps_per_loop=1,
+             label_dim=data.num_classes, log_steps=1000,
+             checkpoint_steps=0),
+        g, FanoutDataFlow(g, [4, 4]), label_fid="label",
+        label_dim=data.num_classes, feature_store=store,
+        device_sampler=sampler)
+    res = est.train(est.train_input_fn, max_steps=60)
+    assert res["global_step"] == 60
+    ev = est.evaluate(est.eval_input_fn, 10)
+    assert ev["metric"] > 0.5, ev

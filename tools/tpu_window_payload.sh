@@ -82,31 +82,33 @@ bench_stage() {  # bench_stage <name> <timeout_s> <bench args...>
 # int8-on; `bf16` is the baseline leg (old canonical); `fused` is
 # fused+int8, `fused_bf16` fused without int8 (out_*.json artifacts are
 # self-describing via detail.int8_features etc. since round 5).
-had_canonical=0
-[ -f .bench_cache/stamps/canonical ] && had_canonical=1
-stamp_ok .bench_cache/stamps/canonical || had_canonical=0
 bench_stage canonical 1500             || exit 1
-if [ "$had_canonical" = 0 ]; then
-  # land the refreshed at-HEAD record immediately as a data-only commit,
-  # so the round artifact exists even if the session is mid-task when
-  # the window closes. Dirty device path → the record is NOT at any
-  # commit; skip the commit and say so (bench stamps recorded_dirty).
+# Land any uncommitted BENCH_TPU.json refresh as a data-only commit, so
+# the round artifact exists even if the session is mid-task when the
+# window closes. Keyed on the file's uncommitted state (NOT on whether
+# THIS window re-ran the stage) so a failed attempt retries next
+# window. Dirty device path → the record is not at any commit; skip
+# and say so (bench stamps recorded_dirty inside the JSON).
+if [ -n "$(git status --porcelain -- BENCH_TPU.json 2>/dev/null)" ]; then
   if [ -n "$DIRTY" ]; then
     log "BENCH_TPU.json refreshed on a DIRTY device path - not auto-committing"
   else
     committed=""
     for i in 1 2 3; do
-      if git commit -q \
+      err=$(git commit -q \
            -m "Record canonical on-TPU headline at $HEADC" \
            -m "No-Verification-Needed: data-only refresh of BENCH_TPU.json by the window payload" \
-           -- BENCH_TPU.json 2>/dev/null; then
-        committed=1; log "BENCH_TPU.json committed"; break
-      fi
+           -- BENCH_TPU.json 2>&1) \
+        && { committed=1; log "BENCH_TPU.json committed"; break; }
       sleep 5
     done
-    [ -n "$committed" ] || log "WARNING: BENCH_TPU.json refresh NOT committed (index busy or unchanged)"
+    [ -n "$committed" ] || log "WARNING: BENCH_TPU.json refresh NOT committed: ${err:0:160}"
   fi
 fi
+# the round-5 structural lever first — it's the biggest open question
+# (hop-2 gather removal via the in-jit historical-activation cache);
+# edges/s counts actually-aggregated edges, compare by nodes_per_sec
+bench_stage cache     1200 --act_cache || exit 1
 bench_stage bf16      1200 --no-int8_features || exit 1
 bench_stage fused     1200 --fused_sampler || exit 1
 bench_stage fused_bf16 1200 --fused_sampler --no-int8_features || exit 1
