@@ -20,10 +20,14 @@ _PATHS = ("euler_tpu", "bench.py")
 def device_path_fp(repo: str | None = None) -> str:
     repo = repo or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     try:
-        out = subprocess.run(
+        proc = subprocess.run(
             ["git", "ls-files", "-co", "--exclude-standard", "--", *_PATHS],
-            capture_output=True, text=True, timeout=20, cwd=repo).stdout
-        files = sorted(set(out.splitlines()))
+            capture_output=True, text=True, timeout=20, cwd=repo)
+        if proc.returncode != 0 or not proc.stdout.strip():
+            # a failing git must NOT hash to a constant "valid" value
+            # (sha1 of nothing) — that would defeat stale detection
+            return "unknown"
+        files = sorted(set(proc.stdout.splitlines()))
     except (OSError, subprocess.TimeoutExpired):
         return "unknown"
     h = hashlib.sha1()
@@ -45,10 +49,12 @@ def device_path_dirty(repo: str | None = None) -> bool:
     """True when the measured path has uncommitted changes."""
     repo = repo or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     try:
-        out = subprocess.run(
+        proc = subprocess.run(
             ["git", "status", "--porcelain", "--", *_PATHS],
-            capture_output=True, text=True, timeout=20, cwd=repo).stdout
-        return bool(out.strip())
+            capture_output=True, text=True, timeout=20, cwd=repo)
+        if proc.returncode != 0:
+            return True  # can't tell → conservative
+        return bool(proc.stdout.strip())
     except (OSError, subprocess.TimeoutExpired):
         return True
 

@@ -152,54 +152,57 @@ def main(argv=None):
             "knn_build_secs": round(build_secs, 1),
             "knn_search_secs_64q": round(search_secs, 3),
             "self_hit_at_k": self_hit,
+            # index/search params so the RESULTS.md renderer can label
+            # the measurement honestly under non-default flags
+            "knn_nlist": 256, "knn_nprobe": 8, "knn_k": args.k,
+            "knn_queries": args.queries, "avg_degree": args.avg_degree,
             "artifacts": paths,
         },
     }
     print(json.dumps(result), flush=True)
     if args.record:
-        _record(result)
+        _record(result)  # raises on failure → nonzero exit → the
+        # watcher payload stage FAILS instead of stamping success with
+        # nothing recorded (advisor r4 medium)
     return 0
 
 
-def _record(result):
-    """Update the 'Products-scale infer' section's bullet lines in
-    RESULTS.md in place (appending table rows after a bullet list broke
-    the markdown)."""
-    d = result["detail"]
-    path = os.path.join(REPO, "RESULTS.md")
-    text = open(path).read()
-    marker = "## Products-scale infer"
-    if marker not in text:
-        print("RESULTS.md section missing; not recording", file=sys.stderr)
-        return
-    head, sect = text.split(marker, 1)
-    # replace the measured bullet block, keep the section prose
-    lines = [
-        f"- **infer sweep (every node once)**: {d['infer_secs']}s on "
-        f"{d['backend']} — {d['infer_nodes_per_sec']:,} nodes/s, "
-        f"embedding artifacts `{d['embedding_shape']}` f32 to\n"
-        f"  `embedding_0.npy` / `ids_0.npy`",
-        f"- **kNN index build** (numpy IVFFlat, 256 lists, 4 k-means "
-        f"iters,\n  cosine): {d['knn_build_secs']}s over all "
-        f"{d['nodes']:,} embeddings",
-        f"- **64-query search** (nprobe 8, k=10): "
-        f"{d['knn_search_secs_64q']}s; self-hit@10 = "
-        f"{d['self_hit_at_k']:.2f}",
-        "- Re-runs on TPU automatically via the tunnel-watcher payload\n"
-        "  (stage `infer_knn`), which refreshes this section's numbers.",
-    ]
-    prose_end = sect.find("\n- ")
-    if prose_end < 0:
-        print("RESULTS.md section malformed; not recording",
-              file=sys.stderr)
-        return
-    # replace ONLY this section's bullet block: keep anything after the
-    # next heading (sections appended in later rounds must survive)
-    next_heading = sect.find("\n## ", prose_end)
-    tail = sect[next_heading:] if next_heading >= 0 else "\n"
-    new_sect = sect[:prose_end] + "\n" + "\n".join(lines) + tail
-    open(path, "w").write(head + marker + new_sect)
-    print(f"recorded to {path}", file=sys.stderr)
+def _record(result, repo=None):
+    """Record the measurement into results.json under the reserved
+    '_infer_products' key and regenerate RESULTS.md through
+    collect_results.write_markdown — the single renderer, so the
+    section can never be dropped by a later regeneration (VERDICT r4
+    weak #5: the old in-place markdown edit was lost exactly that way).
+    Raises on any failure."""
+    import subprocess
+
+    repo = repo or REPO
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import collect_results
+
+    path = os.path.join(repo, "results.json")
+    results = {}
+    if os.path.exists(path):
+        results = json.loads(open(path).read())
+    entry = dict(result)
+    entry["recorded_unix"] = int(time.time())
+    try:
+        entry["recorded_at_commit"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=5, cwd=repo).stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        entry["recorded_at_commit"] = ""
+    results["_infer_products"] = entry
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(results, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    md = os.path.join(repo, "RESULTS.md")
+    collect_results.write_markdown(results, md)
+    if "## Products-scale infer" not in open(md).read():
+        raise RuntimeError(
+            "write_markdown did not render the infer section")
+    print(f"recorded to {path} + {md}", file=sys.stderr)
 
 
 if __name__ == "__main__":
