@@ -1,0 +1,126 @@
+"""memory_plan formulas vs the REAL builders, byte for byte.
+
+Builds small DeviceNeighborTable / DeviceFeatureStore instances
+(replicated and row-sharded over a 2-way model axis) and asserts
+plan_tables predicts exactly the bytes each chip holds — then checks
+the products-scale v5e-16 claim as pinned arithmetic (VERDICT r4 #8).
+"""
+
+import numpy as np
+import pytest
+
+from euler_tpu.parallel.memory_plan import plan_tables
+
+
+def _per_shard_nbytes(arr, mp):
+    """bytes held by ONE chip of the 'model' axis for a jax array."""
+    shards = arr.addressable_shards
+    # replicated arrays have one shard per device, all full-size;
+    # row-sharded arrays have rows/mp each — either way shard 0's data
+    # is what a single chip holds
+    return shards[0].data.nbytes
+
+
+@pytest.fixture(scope="module")
+def small_graph():
+    from euler_tpu.dataset.base_dataset import synthetic_citation
+
+    return synthetic_citation("mem", n=101, d=8, num_classes=3,
+                              train_per_class=10, val=10, test=10, seed=7)
+
+
+def test_plan_matches_replicated_builders(small_graph):
+    from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+
+    g = small_graph.engine
+    n = len(g.all_node_ids())
+    tab = DeviceNeighborTable(g, cap=16)
+    store = DeviceFeatureStore(g, ["feature"], label_fid="label",
+                               label_dim=3)
+    p = plan_tables(n, cap=16, feat_dim=8, label_dim=3, mp=1,
+                    quantize=None, feat_dtype_bytes=4)
+    t = p["per_chip_table_bytes"]
+    assert t["nbr_table"] == tab.tables["nbr_table"].nbytes
+    assert t["cum_table"] == tab.tables["cum_table"].nbytes
+    assert t["feature_table"] == store.features.nbytes
+    assert t["label_table"] == store.labels.nbytes
+
+
+def test_plan_matches_fused_and_int8(small_graph):
+    from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+
+    g = small_graph.engine
+    n = len(g.all_node_ids())
+    tab = DeviceNeighborTable(g, cap=16, fused=True)
+    store = DeviceFeatureStore(g, ["feature"], quantize="int8")
+    p = plan_tables(n, cap=16, feat_dim=8, label_dim=0, mp=1,
+                    fused=True, quantize="int8")
+    t = p["per_chip_table_bytes"]
+    assert t["nbrcum_table"] == tab.tables["nbrcum_table"].nbytes
+    assert t["feature_table"] == store.features.nbytes
+    assert t["feature_scale"] == store.feature_scale.nbytes
+
+
+def test_plan_matches_row_sharded_builders(small_graph):
+    """mp=2 row-sharding: per-chip bytes = ceil(rows/mp) rows. n=101 →
+    102 rows (odd with the pad row... 102 even, use cap to vary) — the
+    put_row_sharded pad-to-multiple path is exercised by the 101+1=102
+    vs mp=4 case."""
+    from euler_tpu.parallel import (
+        DeviceFeatureStore, DeviceNeighborTable, make_mesh,
+    )
+
+    g = small_graph.engine
+    n = len(g.all_node_ids())
+    for mp in (2, 4):
+        mesh = make_mesh(model_parallel=mp)
+        tab = DeviceNeighborTable(g, cap=16, mesh=mesh, shard_rows=True)
+        store = DeviceFeatureStore(g, ["feature"], label_fid="label",
+                                   label_dim=3, mesh=mesh,
+                                   shard_rows=True, quantize="int8")
+        p = plan_tables(n, cap=16, feat_dim=8, label_dim=3, mp=mp,
+                        quantize="int8")
+        t = p["per_chip_table_bytes"]
+        assert t["nbr_table"] == _per_shard_nbytes(
+            tab.tables["nbr_table"], mp)
+        assert t["cum_table"] == _per_shard_nbytes(
+            tab.tables["cum_table"], mp)
+        assert t["feature_table"] == _per_shard_nbytes(store.features, mp)
+        assert t["label_table"] == _per_shard_nbytes(store.labels, mp)
+
+
+def test_products_scale_fits_v5e_budget():
+    """The v5e-16 claim as arithmetic: per-chip bytes for {fused, split}
+    x {mp 1,2,4,8} at the canonical products shape (2.45M nodes, cap 32,
+    int8 features, 16 label dims, + the 128-dim bf16 activation cache)
+    all fit a 16GB chip with >= 75% headroom left for params,
+    activations and XLA scratch."""
+    budget = 16 * (1 << 30)
+    for fused in (False, True):
+        for mp in (1, 2, 4, 8):
+            p = plan_tables(2_450_000, cap=32, feat_dim=100,
+                            label_dim=16, mp=mp, fused=fused,
+                            act_cache_dim=128)
+            total = p["per_chip_total_bytes"]
+            assert total < budget // 4, (fused, mp, total)
+    # and the replicated (mp=1) totals are the bench-measured ~1.3GB
+    # working set: pin the order of magnitude so a layout regression
+    # (e.g. f32 features sneaking back) trips loudly
+    p1 = plan_tables(2_450_000, cap=32, feat_dim=100, label_dim=16)
+    assert 0.4 * (1 << 30) < p1["per_chip_total_bytes"] < 2 * (1 << 30)
+
+
+def test_memory_math_tool_runs():
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        [sys.executable, str(repo / "tools" / "memory_math.py")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    rows = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    assert len(rows) == 16
+    assert all(r["fits_budget"] for r in rows)

@@ -52,6 +52,15 @@ def job_list():
     for m in ("gcn", "graphsage", "geniepath", "lgcn", "arma"):
         jobs.append((f"{m}/digits_knn", f"examples/{m}/run_{m}.py",
                      ["--dataset", "digits_knn"]))
+    # driver BASELINE.json config coverage (VERDICT r4 #6): unsupervised
+    # link-pred on the ppi stand-in + walk embeddings on the bipartite
+    # ml_1m graph (reference: run_graphsage.py unsupervised flags,
+    # tf_euler/python/dataset/ml_1m.py)
+    jobs.append(("graphsage-unsup/ppi", "examples/graphsage/run_graphsage.py",
+                 ["--dataset", "ppi", "--mode", "unsupervised"]))
+    for m in ("deepwalk", "line"):
+        jobs.append((f"{m}/ml_1m", f"examples/{m}/run_{m}.py",
+                     ["--dataset", "ml_1m"]))
     jobs.append(("dgi/cora", "examples/dgi/run_dgi.py", []))
     jobs.append(("gae/cora", "examples/gae/run_gae.py", []))
     jobs.append(("scalable_sage/cora", "examples/scalable_sage/run_scalable_sage.py", []))
@@ -168,8 +177,9 @@ def write_markdown(results: dict, path):
             metric = "acc"
         elif base == "dgi":
             metric = "probe-acc"  # linear probe on frozen embeddings
-        elif base in ("deepwalk", "line", "transe", "transh", "transr",
-                      "transd", "distmult", "rgcn", "gae"):
+        elif model.endswith("-unsup") or base in (
+                "deepwalk", "line", "transe", "transh", "transr",
+                "transd", "distmult", "rgcn", "gae"):
             metric = "mrr"
         else:
             metric = "micro-F1"

