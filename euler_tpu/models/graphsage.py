@@ -153,6 +153,7 @@ class DeviceSampledScalableSage(SuperviseModel):
     num_layers: int = 2       # model depth; layers >0 read the cache
     max_id: int = 0           # cache rows - 1 == feature-table rows - 1
     cache_dtype: Any = None   # None → float32; jnp.bfloat16 at scale
+    store_decay: float = 0.9  # EMA weight on the old cached activation
 
     def embed(self, batch: Dict[str, Any]) -> Array:
         import jax.numpy as jnp
@@ -176,6 +177,7 @@ class DeviceSampledScalableSage(SuperviseModel):
         x, nbr_x = gather_feature_rows(batch, [roots, nbr], gather=gather)
         enc = ScalableSageEncoder(
             self.dim, int(self.num_layers), int(self.max_id),
+            store_decay=self.store_decay,
             cache_dtype=self.cache_dtype or jnp.float32, name="encoder")
         return enc(roots, x, nbr.reshape(b, int(self.fanout)),
                    nbr_x.reshape(b, int(self.fanout), x.shape[-1]))

@@ -42,6 +42,9 @@ def main(argv=None):
                          "in-jit historical-activation cache (the "
                          "structural fix for the products-scale hop-2 "
                          "gather, PERF.md; this flag pins its quality)")
+    ap.add_argument("--store_decay", type=float, default=0.9,
+                    help="with --act_cache: EMA weight on the old "
+                         "cached activation")
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--num_negs", type=int, default=5)
     ap.add_argument("--learning_rate", type=float, default=0.003)
@@ -89,7 +92,8 @@ def main(argv=None):
                     num_classes=data.num_classes,
                     multilabel=data.multilabel, dim=args.hidden_dim,
                     fanout=fanouts[0], num_layers=len(fanouts),
-                    max_id=int(sampler.pad_row), dropout=args.dropout)
+                    max_id=int(sampler.pad_row), dropout=args.dropout,
+                    store_decay=args.store_decay)
             else:
                 model = DeviceSampledGraphSage(
                     num_classes=data.num_classes,

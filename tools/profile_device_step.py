@@ -431,6 +431,8 @@ def main():
         if "full_step_ms" in results:
             results["full_step_edges_per_sec"] = round(
                 epe / (results["full_step_ms"] / 1e3))
+            results["full_step_nodes_per_sec"] = round(
+                B / (results["full_step_ms"] / 1e3))
 
         # same step over the fused sampling table
         from euler_tpu.parallel.device_sampler import fuse_tables
@@ -543,6 +545,60 @@ def main():
         if "full_step_split2_ms" in results:
             results["full_step_split2_edges_per_sec"] = round(
                 epe / (results["full_step_split2_ms"] / 1e3))
+
+        # historical-activation config (bench --act_cache, int8
+        # features): the round-5 structural candidate — per-step gather
+        # rows drop from B·(1+k1+k1·k2) to B·(1+2·k1). Compare by
+        # nodes/s (it aggregates fewer edges by design); the
+        # full_step_* nodes/s equivalents are B/step_ms.
+        from euler_tpu.models import DeviceSampledScalableSage
+
+        # featq/fscale are in scope from the fused_int8 probe above
+        sc_model = DeviceSampledScalableSage(
+            num_classes=args.classes, multilabel=False, dim=128,
+            fanout=fanouts[0], num_layers=len(fanouts),
+            max_id=N, cache_dtype=jnp.bfloat16)
+        batch0c = {"rows": [roots], "sample_seed": jnp.int32(0),
+                   "nbr_table": nbr, "cum_table": cum,
+                   "feature_table": featq, "feature_scale": fscale,
+                   "labels": jax.jit(
+                       lambda l, r: jnp.take(l, r, axis=0))(label, roots)}
+        vars_c = sc_model.init(jax.random.key(0), batch0c)
+        params_c, cache0 = vars_c["params"], vars_c["cache"]
+        opt0c = tx.init(params_c)
+
+        @jax.jit
+        def run_steps_cache(params, opt, cache, nbr, cum, featq, fscale,
+                            label, roots, seed):
+            def step(carry, i):
+                p, o, ch = carry
+                r = perturb(roots, i, seed)
+                batch = {"rows": [r], "sample_seed": seed * 1000 + i,
+                         "nbr_table": nbr, "cum_table": cum,
+                         "feature_table": featq, "feature_scale": fscale,
+                         "labels": jnp.take(label, r, axis=0)}
+
+                def loss_c(pp):
+                    out, new = sc_model.apply(
+                        {"params": pp, "cache": ch}, batch,
+                        mutable=["cache"])
+                    return out.loss, new["cache"]
+
+                (l, ch), g = jax.value_and_grad(
+                    loss_c, has_aux=True)(p)
+                up, o = tx.update(g, o, p)
+                return (optax.apply_updates(p, up), o, ch), l
+
+            (p, o, ch), ls = jax.lax.scan(step, (params, opt, cache),
+                                          jnp.arange(SCAN_LEN))
+            return ls.sum()
+
+        measure("full_step_cache_int8_ms", run_steps_cache, params_c,
+                opt0c, cache0, nbr, cum, featq, fscale, label, roots,
+                reps=args.reps)
+        if "full_step_cache_int8_ms" in results:
+            results["full_step_cache_int8_nodes_per_sec"] = round(
+                B / (results["full_step_cache_int8_ms"] / 1e3))
 
     print(json.dumps(results, indent=1))
 

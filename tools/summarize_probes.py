@@ -59,8 +59,8 @@ def main():
     # orders the display. Both naming schemes ride the glob: the
     # round-3 watcher wrote bench_*.json, the round-4 stage-stamped
     # payload writes out_*.json.
-    _PRIORITY = ("out_canonical.json", "out_bf16.json", "out_fused.json",
-                 "out_fused_bf16.json", "out_int8.json",
+    _PRIORITY = ("out_canonical.json", "out_cache.json", "out_bf16.json",
+                 "out_fused.json", "out_fused_bf16.json", "out_int8.json",
                  "out_degsort.json", "out_pad.json",
                  "out_degsort_pad.json")
     found = sorted(
@@ -78,11 +78,29 @@ def main():
             # on failed runs — render the failure, not a fake regression
             print(f"  {name:28s} ERROR: {d['error'][:80]}")
             continue
+        det = d.get("detail", {})
         rel = ""
         if base and d.get("unit") == base.get("unit"):
-            delta = (v - base["value"]) / base["value"]
-            rel = f" ({delta:+.1%} vs canonical)"
-        det = d.get("detail", {})
+            if det.get("act_cache"):
+                # --act_cache aggregates ~5x fewer edges per step by
+                # design: edges/s deltas are meaningless — compare the
+                # config-independent training rate instead. Older
+                # canonical records predate detail.nodes_per_sec;
+                # derive it (batch * steps/s) rather than fall back to
+                # the meaningless edges/s delta
+                bdet = base.get("detail", {})
+                bnps = bdet.get("nodes_per_sec") or (
+                    bdet.get("batch_size", 0) * bdet.get(
+                        "steps_per_sec", 0))
+                nps = det.get("nodes_per_sec") or (
+                    det.get("batch_size", 0) * det.get(
+                        "steps_per_sec", 0))
+                if bnps:
+                    delta = (nps - bnps) / bnps
+                    rel = f" ({delta:+.1%} nodes/s vs canonical)"
+            else:
+                delta = (v - base["value"]) / base["value"]
+                rel = f" ({delta:+.1%} vs canonical)"
         print(f"  {name:28s} {v:>14,.0f} {d.get('unit', ''):18s}{rel}"
               f"  backend={det.get('backend')}")
 
