@@ -93,6 +93,10 @@ class BaseEstimator:
         # DeviceFeatureStore table): same jax.Array object each step, so
         # jit sees a cached on-device arg — no per-step transfer
         self.static_batch: Dict[str, Any] = {}
+        # called with this estimator right before every interleaved and
+        # final evaluation in train_and_evaluate (e.g. a full-coverage
+        # activation-cache refresh, models/graphsage.refresh_act_cache)
+        self.pre_eval_hook = None
 
     # -- setup -------------------------------------------------------------
     def _init_state(self, batch: Dict, rng=None) -> None:
@@ -448,6 +452,8 @@ class BaseEstimator:
         """
         if eval_every <= 0:
             train_res = self.train(train_input_fn, max_steps)
+            if self.pre_eval_hook:
+                self.pre_eval_hook(self)
             eval_res = self.evaluate(eval_input_fn, eval_steps)
             return {**{f"train_{k}": v for k, v in train_res.items()},
                     **{f"eval_{k}": v for k, v in eval_res.items()}}
@@ -468,6 +474,8 @@ class BaseEstimator:
                     break  # train iterator exhausted at a segment edge
                 train_res = seg
                 step = seg["global_step"]
+                if self.pre_eval_hook:
+                    self.pre_eval_hook(self)
                 ev = self.evaluate(eval_input_fn, eval_steps)
                 m = ev["metric"]
                 if keep_best and (best_snap is None or m > best_metric):
@@ -488,6 +496,11 @@ class BaseEstimator:
         if self.ckpt_steps and self.state is not None:
             self.save_checkpoint(step)  # disk matches the reported weights
             self.finalize_checkpoints()
+        if self.pre_eval_hook:
+            # the restored-best snapshot's cache was refreshed before
+            # its eval, but keep_best=False (or a first-segment
+            # StopIteration) reaches here without any refresh at all
+            self.pre_eval_hook(self)
         eval_res = self.evaluate(eval_input_fn, eval_steps)
         out = {**{f"train_{k}": v for k, v in train_res.items()},
                **{f"eval_{k}": v for k, v in eval_res.items()}}

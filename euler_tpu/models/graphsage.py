@@ -183,6 +183,64 @@ class DeviceSampledScalableSage(SuperviseModel):
                    nbr_x.reshape(b, int(self.fanout), x.shape[-1]))
 
 
+def refresh_act_cache(est, n_rows=None, chunk: int = 8192, seed: int = 1):
+    """Full-coverage refresh of a DeviceSampledScalableSage estimator's
+    activation cache: run the model forward over EVERY table row in
+    chunks with the cache mutable, so nodes outside the train split get
+    populated entries too (first writes land at full scale —
+    encoders._ema_update). This is the structural fix for the config's
+    quality gap on small-train-split data: plain training only ever
+    writes cache rows for train roots, so eval-time neighbor reads hit
+    zeros. Install as `est.pre_eval_hook = refresh_act_cache` (the
+    reference's analog is its periodic full-graph store refresh in the
+    ScalableGCN training loop, tf_euler/python/utils/encoders.py:294).
+
+    The trailing pad row is excluded and re-zeroed: padded neighbor
+    slots must keep aggregating zeros, not relu(bias)."""
+    import numpy as np
+
+    state = est.state
+    if not (state and state.extra_vars
+            and "cache" in (state.extra_vars or {})):
+        return
+    cache = state.extra_vars["cache"]
+    if n_rows is None:
+        n_rows = int(est.static_batch["feature_table"].shape[0])
+    live = n_rows - 1  # rows 0..live-1 are real nodes; row live is pad
+    chunk = max(1, min(chunk, live))
+
+    upd = getattr(est, "_act_cache_upd", None)
+    if upd is None:
+        # memoized on the estimator: a fresh jax.jit wrapper per call
+        # would recompile at every pre-eval refresh. Capture ONLY
+        # apply_fn (a constant holding no arrays) — closing over the
+        # whole TrainState would pin the first call's params+opt_state
+        # copy in device memory for the estimator's lifetime
+        apply_fn = state.apply_fn
+
+        @jax.jit
+        def upd(params, cache, batch):
+            _, new = apply_fn({"params": params, "cache": cache},
+                              batch, mutable=["cache"])
+            return new["cache"]
+
+        est._act_cache_upd = upd
+
+    import jax.numpy as jnp
+
+    base = dict(est.static_batch)
+    for i, lo in enumerate(range(0, live, chunk)):
+        rows = np.arange(lo, lo + chunk, dtype=np.int32)
+        rows = np.minimum(rows, live - 1)  # tail clamps to a real row
+        batch = {**base, "rows": [jnp.asarray(rows)],
+                 "sample_seed": np.uint32(seed * 1_000_003 + i)}
+        cache = upd(state.params, cache, batch)
+    cache = jax.tree_util.tree_map(
+        lambda a: a.at[live].set(jnp.zeros((), a.dtype)), cache)
+    est.state = state.replace(
+        extra_vars={**state.extra_vars, "cache": cache})
+
+
 class DeviceSampledLayerwiseGCN(SuperviseModel):
     """FastGCN/LADIES with sampling ON DEVICE: per-layer importance
     pools, dense inter-pool adjacency, and feature gathers all run

@@ -129,6 +129,18 @@ class GCNEncoder(nn.Module):
         return hidden[0]
 
 
+def _ema_update(old: Array, fresh: Array, decay: float) -> Array:
+    """Bias-corrected cache write: rows never written before (all-zero —
+    the init value) take the fresh activation at FULL scale; visited
+    rows blend decay·old + (1-decay)·fresh. Without this, a node's
+    first write lands at (1-decay)·h ≈ 0.1·h and rarely-visited nodes'
+    cached activations stay massively under-scaled — the zero-init bias
+    of a plain EMA. (A live activation that is exactly all-zero would be
+    re-written at full scale too, which is the same value — harmless.)"""
+    seen = jnp.any(old != 0, axis=-1, keepdims=True)
+    return jnp.where(seen, decay * old + (1 - decay) * fresh, fresh)
+
+
 class _ScalableCache(nn.Module):
     """Per-node activation cache: [max_id+1, dim] rows in the 'cache'
     collection, read for neighbor ids, written for the batch's own ids.
@@ -199,7 +211,7 @@ class ScalableGCNEncoder(nn.Module):
                 # store this batch's layer-(l+1) input activations
                 store = caches[layer + 1]
                 old = store(ids)
-                new = self.store_decay * old + (1 - self.store_decay) * h_self
+                new = _ema_update(old, h_self, self.store_decay)
                 store(ids, write_ids=ids, write_vals=new)
         return h_self
 
@@ -234,7 +246,7 @@ class ScalableSageEncoder(nn.Module):
                 h_new = nn.relu(h_new)
                 store = caches[layer + 1]
                 old = store(ids)
-                upd = self.store_decay * old + (1 - self.store_decay) * h_new
+                upd = _ema_update(old, h_new, self.store_decay)
                 store(ids, write_ids=ids, write_vals=upd)
             h_self = h_new
         return h_self

@@ -45,6 +45,14 @@ def main(argv=None):
     ap.add_argument("--store_decay", type=float, default=0.9,
                     help="with --act_cache: EMA weight on the old "
                          "cached activation")
+    ap.add_argument("--cache_refresh", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="with --act_cache: refresh the cache over ALL "
+                         "nodes before each evaluation (plain training "
+                         "only writes train-root rows, so eval-time "
+                         "neighbor reads on small train splits hit "
+                         "zeros); --no-cache_refresh reverts to the "
+                         "train-visited-only protocol")
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--num_negs", type=int, default=5)
     ap.add_argument("--learning_rate", type=float, default=0.003)
@@ -66,6 +74,10 @@ def main(argv=None):
     from euler_tpu.estimator import EdgeEstimator, NodeEstimator
     from euler_tpu.models import SupervisedGraphSage, UnsupervisedGraphSage
 
+    if args.act_cache and not args.device_sampler:
+        print("run_graphsage: --act_cache needs --device_sampler "
+              "(the cache config is the device path)", file=sys.stderr)
+        raise SystemExit(2)
     fanouts = tuple(int(x) for x in args.fanouts.split(","))
     data = get_dataset(args.dataset)
     print(f"dataset {args.dataset}: {data.engine.node_count} nodes "
@@ -114,6 +126,9 @@ def main(argv=None):
             data.engine, flow, label_fid="label",
             label_dim=data.num_classes, model_dir=args.model_dir or None,
             feature_store=store, device_sampler=sampler)
+        if args.act_cache and args.device_sampler and args.cache_refresh:
+            from euler_tpu.models.graphsage import refresh_act_cache
+            est.pre_eval_hook = refresh_act_cache
         res = fit_citation(est, args.max_steps, args.eval_steps)
     elif args.device_sampler:
         # fully on-device unsupervised path: fanout embedding, positive

@@ -1435,3 +1435,56 @@ def test_device_scalable_sage_fused_table():
     assert res["global_step"] == 60
     ev = est.evaluate(est.eval_input_fn, 10)
     assert ev["metric"] > 0.5, ev
+
+
+def test_act_cache_refresh_covers_all_nodes():
+    """refresh_act_cache populates cache rows for EVERY live node (not
+    just train roots), keeps the pad row zero, and first writes land at
+    FULL scale (encoders._ema_update bias correction)."""
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.dataset.base_dataset import synthetic_citation
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.models import DeviceSampledScalableSage
+    from euler_tpu.models.graphsage import refresh_act_cache
+    from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+
+    data = synthetic_citation("tref", n=200, d=16, num_classes=3,
+                              train_per_class=10, val=20, test=40, seed=9)
+    g = data.engine
+    store = DeviceFeatureStore(g, ["feature"], label_fid="label",
+                               label_dim=data.num_classes)
+    sampler = DeviceNeighborTable(g, cap=16)
+    n_rows = int(store.features.shape[0])
+    est = NodeEstimator(
+        DeviceSampledScalableSage(num_classes=data.num_classes,
+                                  multilabel=False, dim=16, fanout=4,
+                                  num_layers=2, max_id=n_rows - 1),
+        dict(batch_size=16, learning_rate=0.01, steps_per_loop=1,
+             label_dim=data.num_classes, log_steps=1000,
+             checkpoint_steps=0),
+        g, FanoutDataFlow(g, [4, 4]), label_fid="label",
+        label_dim=data.num_classes, feature_store=store,
+        device_sampler=sampler)
+    est.train(est.train_input_fn, max_steps=10)
+    arr = np.asarray(jax.tree_util.tree_leaves(
+        est.state.extra_vars["cache"])[0])
+    before = int((np.abs(arr) > 0).any(axis=-1).sum())
+    assert before < n_rows - 1  # small train split: partial coverage
+    refresh_act_cache(est, chunk=64)
+    arr = np.asarray(jax.tree_util.tree_leaves(
+        est.state.extra_vars["cache"])[0])
+    covered = (np.abs(arr) > 0).any(axis=-1)
+    assert covered[: n_rows - 1].mean() > 0.95  # all live nodes (relu
+    # can zero the odd row) ...
+    assert not covered[n_rows - 1]  # ... but never the pad row
+
+
+def test_ema_update_first_write_full_scale():
+    from euler_tpu.utils.encoders import _ema_update
+
+    old = jnp.zeros((3, 4))
+    fresh = jnp.ones((3, 4)) * 2.0
+    out = _ema_update(old, fresh, 0.9)
+    np.testing.assert_allclose(np.asarray(out), 2.0)  # NOT 0.1*2
+    out2 = _ema_update(out, jnp.zeros((3, 4)), 0.9)
+    np.testing.assert_allclose(np.asarray(out2), 1.8)  # visited: EMA
