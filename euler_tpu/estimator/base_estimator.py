@@ -55,6 +55,27 @@ def _merged(batch: Dict, static_batch: Dict) -> Dict:
     return {**batch, **static_batch} if static_batch else batch
 
 
+def _match_placement(new_tree, like_tree):
+    """Re-place each restored leaf with the CURRENT leaf's sharding:
+    snapshot/checkpoint restores go through host numpy, which would
+    silently replicate a deliberately sharded leaf (e.g. a
+    shard_act_cache'd activation cache) — re-inflating per-chip memory
+    by mp with no error."""
+    def place(new, like):
+        sh = getattr(like, "sharding", None)
+        if sh is not None:
+            try:
+                return jax.device_put(new, sh)
+            except Exception:  # shape changed / mesh gone: plain array
+                pass
+        return jnp.asarray(new)
+
+    try:
+        return jax.tree_util.tree_map(place, new_tree, like_tree)
+    except ValueError:  # tree structures differ (e.g. fresh collection)
+        return jax.tree_util.tree_map(jnp.asarray, new_tree)
+
+
 class BaseEstimator:
     """Drives a flax model with the ModelOutput contract.
 
@@ -223,7 +244,8 @@ class BaseEstimator:
         restored = mgr.restore(step, args=ocp.args.StandardRestore(payload))
         self.state = self.state.replace(
             params=restored["params"], opt_state=restored["opt_state"],
-            extra_vars=restored.get("extra_vars") or {})
+            extra_vars=_match_placement(restored.get("extra_vars") or {},
+                                        self.state.extra_vars or {}))
         return step
 
     # -- drivers -----------------------------------------------------------
@@ -491,8 +513,9 @@ class BaseEstimator:
             self.state = self.state.replace(
                 params=jax.tree_util.tree_map(jnp.asarray,
                                               best_snap["params"]),
-                extra_vars=jax.tree_util.tree_map(
-                    jnp.asarray, best_snap["extra_vars"]) or {})
+                extra_vars=_match_placement(
+                    best_snap["extra_vars"],
+                    self.state.extra_vars or {}) or {})
         if self.ckpt_steps and self.state is not None:
             self.save_checkpoint(step)  # disk matches the reported weights
             self.finalize_checkpoints()

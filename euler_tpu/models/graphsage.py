@@ -183,6 +183,30 @@ class DeviceSampledScalableSage(SuperviseModel):
                    nbr_x.reshape(b, int(self.fanout), x.shape[-1]))
 
 
+def shard_act_cache(est, mesh, axis: str = "model"):
+    """Re-place the estimator's activation cache row-sharded over the
+    mesh's model axis (per-chip cache bytes 1/mp — the same capacity
+    lever row-sharded graph tables get from placement.put_row_sharded).
+    GSPMD keeps the sharding through the jitted train step (the cache
+    update is a row scatter, so each chip only writes its slice;
+    pinned by tests/test_parallel.py::test_act_cache_row_sharded).
+    Call once after the first train step (or any state init)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from euler_tpu.parallel.device_sampler import is_model_sharded
+
+    if not is_model_sharded(mesh, axis):
+        return
+    state = est.state
+    if not (state and "cache" in (state.extra_vars or {})):
+        return
+    sh = NamedSharding(mesh, P(axis, None))
+    cache = jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, sh), state.extra_vars["cache"])
+    est.state = state.replace(
+        extra_vars={**state.extra_vars, "cache": cache})
+
+
 def refresh_act_cache(est, n_rows=None, chunk: int = 8192, seed: int = 1):
     """Full-coverage refresh of a DeviceSampledScalableSage estimator's
     activation cache: run the model forward over EVERY table row in

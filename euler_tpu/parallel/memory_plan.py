@@ -32,15 +32,19 @@ def plan_tables(n_nodes: int, cap: int = 32, feat_dim: int = 100,
                 pad_dim_to: Optional[int] = None,
                 shard_rows: bool = True,
                 act_cache_dim: int = 0,
-                act_cache_dtype_bytes: int = 2) -> Dict:
+                act_cache_dtype_bytes: int = 2,
+                act_cache_sharded: bool = False) -> Dict:
     """Per-chip bytes for one replica group's HBM-resident tables.
 
     mp — size of the 'model' mesh axis; with shard_rows the row-sharded
     tables hold ceil(rows/mp) rows per chip (put_row_sharded pads rows
     to a multiple of mp). shard_rows=False models the replicated
     placement (every chip holds full tables). The activation cache
-    (DeviceSampledScalableSage) is carried replicated in the train
-    state today, so it never divides by mp.
+    (DeviceSampledScalableSage) is replicated by default;
+    act_cache_sharded models models/graphsage.shard_act_cache — the
+    cache row-sharded over 'model' (GSPMD keeps it sharded through the
+    train step; test_act_cache_row_sharded), dividing its bytes by mp
+    like the tables.
     """
     rows = n_nodes + 1  # + the trailing pad row (builders' convention)
 
@@ -66,7 +70,11 @@ def plan_tables(n_nodes: int, cap: int = 32, feat_dim: int = 100,
     if label_dim:
         entries["label_table"] = per_chip(rows) * label_dim * 4
     if act_cache_dim:
-        entries["act_cache"] = rows * act_cache_dim * act_cache_dtype_bytes
+        # independent of shard_rows: shard_act_cache only needs a
+        # non-trivial model axis, not sharded graph tables
+        c_rows = _ceil_div(rows, mp) if (act_cache_sharded and mp > 1) \
+            else rows
+        entries["act_cache"] = c_rows * act_cache_dim * act_cache_dtype_bytes
     return {
         "per_chip_table_bytes": entries,
         "per_chip_total_bytes": sum(entries.values()),

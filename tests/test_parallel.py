@@ -1488,3 +1488,71 @@ def test_ema_update_first_write_full_scale():
     np.testing.assert_allclose(np.asarray(out), 2.0)  # NOT 0.1*2
     out2 = _ema_update(out, jnp.zeros((3, 4)), 0.9)
     np.testing.assert_allclose(np.asarray(out2), 1.8)  # visited: EMA
+
+
+def test_act_cache_row_sharded():
+    """The activation cache composes with model-axis sharding: re-placed
+    row-sharded (shard_act_cache), the estimator's jitted train step
+    keeps it sharded (per-chip bytes 1/mp) and writes still land."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.dataset.base_dataset import synthetic_citation
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.models import DeviceSampledScalableSage
+    from euler_tpu.models.graphsage import shard_act_cache
+    from euler_tpu.parallel import (
+        DeviceFeatureStore, DeviceNeighborTable, make_mesh,
+    )
+
+    mesh = make_mesh(model_parallel=2)
+    data = synthetic_citation("tshc", n=200, d=16, num_classes=3,
+                              train_per_class=10, val=20, test=40, seed=11)
+    g = data.engine
+    store = DeviceFeatureStore(g, ["feature"], label_fid="label",
+                               label_dim=data.num_classes, mesh=mesh,
+                               shard_rows=True)
+    sampler = DeviceNeighborTable(g, cap=16, mesh=mesh, shard_rows=True)
+    n_rows = int(store.features.shape[0])
+    est = NodeEstimator(
+        DeviceSampledScalableSage(num_classes=data.num_classes,
+                                  multilabel=False, dim=16, fanout=4,
+                                  num_layers=2, max_id=n_rows - 1,
+                                  table_mesh=mesh),
+        dict(batch_size=32, learning_rate=0.01, steps_per_loop=1,
+             label_dim=data.num_classes, log_steps=1000,
+             checkpoint_steps=0),
+        g, FanoutDataFlow(g, [4, 4]), label_fid="label",
+        label_dim=data.num_classes, feature_store=store,
+        device_sampler=sampler)
+    with mesh:
+        est.train(est.train_input_fn, max_steps=2)
+        shard_act_cache(est, mesh)
+        est.train(est.train_input_fn, max_steps=8)
+    leaf = jax.tree_util.tree_leaves(est.state.extra_vars["cache"])[0]
+    spec = leaf.sharding.spec
+    assert tuple(spec)[:1] == ("model",), spec  # still row-sharded
+    per_chip = leaf.addressable_shards[0].data.shape[0]
+    assert per_chip * 2 == leaf.shape[0] + (leaf.shape[0] % 2), \
+        (per_chip, leaf.shape)
+    touched = int(np.asarray(
+        jnp.any(leaf != 0, axis=-1)).sum())
+    assert touched > 0
+
+    # the sharded-cache arithmetic in memory_plan matches the real
+    # per-shard bytes (pinning contract of tests/test_memory_math.py)
+    from euler_tpu.parallel.memory_plan import plan_tables
+    p = plan_tables(n_rows - 1, cap=16, feat_dim=16, label_dim=0, mp=2,
+                    quantize=None, feat_dtype_bytes=4, act_cache_dim=16,
+                    act_cache_dtype_bytes=4, act_cache_sharded=True)
+    assert p["per_chip_table_bytes"]["act_cache"] == \
+        leaf.addressable_shards[0].data.nbytes
+
+    # snapshot/restore (keep_best) must not silently replicate the
+    # sharded cache (base_estimator._match_placement)
+    with mesh:
+        est.train_and_evaluate(est.train_input_fn, est.eval_input_fn,
+                               max_steps=12, eval_steps=2, eval_every=4,
+                               keep_best=True)
+    leaf2 = jax.tree_util.tree_leaves(est.state.extra_vars["cache"])[0]
+    assert tuple(leaf2.sharding.spec)[:1] == ("model",), leaf2.sharding
