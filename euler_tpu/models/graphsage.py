@@ -154,6 +154,8 @@ class DeviceSampledScalableSage(SuperviseModel):
     max_id: int = 0           # cache rows - 1 == feature-table rows - 1
     cache_dtype: Any = None   # None → float32; jnp.bfloat16 at scale
     store_decay: float = 0.9  # EMA weight on the old cached activation
+    encoder: str = "sage"     # 'sage' (concat) or 'gcn' (mean-combine),
+    # the reference's two scalable variants (encoders.py:294,629)
 
     def embed(self, batch: Dict[str, Any]) -> Array:
         import jax.numpy as jnp
@@ -175,7 +177,16 @@ class DeviceSampledScalableSage(SuperviseModel):
             nbr = sample_hop(batch["nbr_table"], batch["cum_table"],
                              roots, int(self.fanout), key, tg)
         x, nbr_x = gather_feature_rows(batch, [roots, nbr], gather=gather)
-        enc = ScalableSageEncoder(
+        if self.encoder == "gcn":
+            from euler_tpu.utils.encoders import ScalableGCNEncoder
+            enc_cls = ScalableGCNEncoder
+        elif self.encoder == "sage":
+            enc_cls = ScalableSageEncoder
+        else:
+            raise ValueError(
+                f"DeviceSampledScalableSage.encoder must be 'sage' or "
+                f"'gcn', got {self.encoder!r}")
+        enc = enc_cls(
             self.dim, int(self.num_layers), int(self.max_id),
             store_decay=self.store_decay,
             cache_dtype=self.cache_dtype or jnp.float32, name="encoder")
@@ -199,7 +210,13 @@ def shard_act_cache(est, mesh, axis: str = "model"):
         return
     state = est.state
     if not (state and "cache" in (state.extra_vars or {})):
-        return
+        # a trivial-mesh no-op is composability; calling before the
+        # state exists is a caller bug that would silently forfeit the
+        # 1/mp memory lever at scale
+        raise ValueError(
+            "shard_act_cache: estimator has no 'cache' collection yet — "
+            "run at least one train step (which initializes the state) "
+            "before sharding the cache")
     sh = NamedSharding(mesh, P(axis, None))
     cache = jax.tree_util.tree_map(
         lambda a: jax.device_put(a, sh), state.extra_vars["cache"])

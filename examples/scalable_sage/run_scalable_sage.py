@@ -24,6 +24,15 @@ def main(argv=None):
     ap.add_argument("--max_steps", type=int, default=200)
     ap.add_argument("--eval_steps", type=int, default=20)
     ap.add_argument("--model_dir", default="")
+    ap.add_argument("--encoder", default="sage", choices=["sage", "gcn"],
+                    help="scalable variant: sage (concat) or gcn "
+                         "(mean-combine) — the reference's two "
+                         "store-backed encoders")
+    ap.add_argument("--device_sampler", action="store_true",
+                    help="run the TPU-first config: sampling AND the "
+                         "activation cache on device "
+                         "(DeviceSampledScalableSage + full-coverage "
+                         "pre-eval cache refresh — bench --act_cache)")
     add_platform_flag(ap)
     args = ap.parse_args(argv)
     init_platform(args.platform)
@@ -34,17 +43,40 @@ def main(argv=None):
     from euler_tpu.models import ScalableGraphSage
 
     data = get_dataset(args.dataset)
-    model = ScalableGraphSage(
-        num_classes=data.num_classes, multilabel=data.multilabel,
-        dim=args.hidden_dim, num_layers=args.num_layers, max_id=data.max_id)
     flow = FanoutDataFlow(data.engine, [args.fanout],
                           feature_ids=["feature"])
+    store = sampler = None
+    if args.device_sampler:
+        from euler_tpu.models import DeviceSampledScalableSage
+        from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+
+        store = DeviceFeatureStore(data.engine, ["feature"],
+                                   label_fid="label",
+                                   label_dim=data.num_classes)
+        sampler = DeviceNeighborTable(data.engine, cap=32)
+        model = DeviceSampledScalableSage(
+            num_classes=data.num_classes, multilabel=data.multilabel,
+            dim=args.hidden_dim, fanout=args.fanout,
+            num_layers=args.num_layers, max_id=int(sampler.pad_row),
+            encoder=args.encoder)
+    elif args.encoder != "sage":
+        raise SystemExit("--encoder gcn requires --device_sampler "
+                         "(the host example is the sage variant)")
+    else:
+        model = ScalableGraphSage(
+            num_classes=data.num_classes, multilabel=data.multilabel,
+            dim=args.hidden_dim, num_layers=args.num_layers,
+            max_id=data.max_id)
     est = NodeEstimator(
         model,
         dict(batch_size=args.batch_size, learning_rate=args.learning_rate,
              max_id=data.max_id, label_dim=data.num_classes),
         data.engine, flow, label_fid="label", label_dim=data.num_classes,
-        model_dir=args.model_dir or None)
+        model_dir=args.model_dir or None,
+        feature_store=store, device_sampler=sampler)
+    if args.device_sampler:
+        from euler_tpu.models.graphsage import refresh_act_cache
+        est.pre_eval_hook = refresh_act_cache
     res = fit_citation(est, args.max_steps, args.eval_steps)
     print(res)
     return res

@@ -1556,3 +1556,35 @@ def test_act_cache_row_sharded():
                                keep_best=True)
     leaf2 = jax.tree_util.tree_leaves(est.state.extra_vars["cache"])[0]
     assert tuple(leaf2.sharding.spec)[:1] == ("model",), leaf2.sharding
+
+
+def test_device_scalable_gcn_variant():
+    """encoder='gcn' (reference ScalableGCNEncoder) rides the same
+    device path: trains and learns."""
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.dataset.base_dataset import synthetic_citation
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.models import DeviceSampledScalableSage
+    from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+
+    data = synthetic_citation("tscg", n=300, d=16, num_classes=3,
+                              train_per_class=30, val=40, test=60, seed=8)
+    g = data.engine
+    store = DeviceFeatureStore(g, ["feature"], label_fid="label",
+                               label_dim=data.num_classes)
+    sampler = DeviceNeighborTable(g, cap=16)
+    est = NodeEstimator(
+        DeviceSampledScalableSage(num_classes=data.num_classes,
+                                  multilabel=False, dim=16, fanout=4,
+                                  num_layers=2, encoder="gcn",
+                                  max_id=int(store.features.shape[0]) - 1),
+        dict(batch_size=32, learning_rate=0.01, steps_per_loop=3,
+             label_dim=data.num_classes, log_steps=1000,
+             checkpoint_steps=0),
+        g, FanoutDataFlow(g, [4, 4]), label_fid="label",
+        label_dim=data.num_classes, feature_store=store,
+        device_sampler=sampler)
+    res = est.train(est.train_input_fn, max_steps=60)
+    assert res["global_step"] == 60
+    ev = est.evaluate(est.eval_input_fn, 10)
+    assert ev["metric"] > 0.5, ev
