@@ -209,14 +209,14 @@ def shard_act_cache(est, mesh, axis: str = "model"):
     if not is_model_sharded(mesh, axis):
         return
     state = est.state
-    if not (state and "cache" in (state.extra_vars or {})):
-        # a trivial-mesh no-op is composability; calling before the
-        # state exists is a caller bug that would silently forfeit the
-        # 1/mp memory lever at scale
+    if state is None:
+        # calling before the state exists is a caller bug that would
+        # silently forfeit the 1/mp memory lever at scale
         raise ValueError(
-            "shard_act_cache: estimator has no 'cache' collection yet — "
-            "run at least one train step (which initializes the state) "
-            "before sharding the cache")
+            "shard_act_cache: estimator state not initialized — run at "
+            "least one train step before sharding the cache")
+    if "cache" not in (state.extra_vars or {}):
+        return  # model carries no activation cache: legitimate no-op
     sh = NamedSharding(mesh, P(axis, None))
     cache = jax.tree_util.tree_map(
         lambda a: jax.device_put(a, sh), state.extra_vars["cache"])

@@ -33,6 +33,13 @@ def main(argv=None):
                          "activation cache on device "
                          "(DeviceSampledScalableSage + full-coverage "
                          "pre-eval cache refresh — bench --act_cache)")
+    ap.add_argument("--sampler_cap", type=int, default=32)
+    ap.add_argument("--store_decay", type=float, default=0.9)
+    ap.add_argument("--cache_refresh", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="with --device_sampler: full-coverage cache "
+                         "refresh before each evaluation (same flag as "
+                         "run_graphsage --act_cache)")
     add_platform_flag(ap)
     args = ap.parse_args(argv)
     init_platform(args.platform)
@@ -53,12 +60,12 @@ def main(argv=None):
         store = DeviceFeatureStore(data.engine, ["feature"],
                                    label_fid="label",
                                    label_dim=data.num_classes)
-        sampler = DeviceNeighborTable(data.engine, cap=32)
+        sampler = DeviceNeighborTable(data.engine, cap=args.sampler_cap)
         model = DeviceSampledScalableSage(
             num_classes=data.num_classes, multilabel=data.multilabel,
             dim=args.hidden_dim, fanout=args.fanout,
             num_layers=args.num_layers, max_id=int(sampler.pad_row),
-            encoder=args.encoder)
+            store_decay=args.store_decay, encoder=args.encoder)
     elif args.encoder != "sage":
         raise SystemExit("--encoder gcn requires --device_sampler "
                          "(the host example is the sage variant)")
@@ -74,7 +81,7 @@ def main(argv=None):
         data.engine, flow, label_fid="label", label_dim=data.num_classes,
         model_dir=args.model_dir or None,
         feature_store=store, device_sampler=sampler)
-    if args.device_sampler:
+    if args.device_sampler and args.cache_refresh:
         from euler_tpu.models.graphsage import refresh_act_cache
         est.pre_eval_hook = refresh_act_cache
     res = fit_citation(est, args.max_steps, args.eval_steps)

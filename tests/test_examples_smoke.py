@@ -57,6 +57,13 @@ VARIANTS = [
     ("geniepath/run_geniepath.py",
      ["--device_sampler", "--batch_size", "16",
       "--fanouts", "4,3"]),  # genie encoder over device fanouts
+    ("graphsage/run_graphsage.py",
+     ["--device_sampler", "--act_cache", "--batch_size", "16",
+      "--fanouts", "4,3"]),  # in-jit historical-activation cache
+    ("scalable_sage/run_scalable_sage.py",
+     ["--device_sampler", "--batch_size", "16"]),
+    ("scalable_sage/run_scalable_sage.py",
+     ["--device_sampler", "--encoder", "gcn", "--batch_size", "16"]),
 ]
 
 

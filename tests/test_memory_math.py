@@ -122,5 +122,10 @@ def test_memory_math_tool_runs():
         capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr[-500:]
     rows = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
-    assert len(rows) == 16
+    # {fused,split} x (mp=1: 2 cache modes; mp in {2,4,8}: 3 incl.
+    # the row-sharded cache)
+    assert len(rows) == 2 * (2 + 3 * 3)
     assert all(r["fits_budget"] for r in rows)
+    sharded = [r for r in rows if r["config"].endswith("cache128s")]
+    assert sharded and all(
+        r["tables_mb"]["act_cache"] < 598 / r["mp"] + 1 for r in sharded)

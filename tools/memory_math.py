@@ -36,17 +36,24 @@ def main(argv=None):
     ok_all = True
     for fused in (False, True):
         for mp in (1, 2, 4, 8):
-            for cache_dim in (0, 128):
+            # cache modes: none / replicated 128-dim / row-sharded
+            # 128-dim (models.graphsage.shard_act_cache; mp>1 only)
+            modes = [(0, False), (128, False)]
+            if mp > 1:
+                modes.append((128, True))
+            for cache_dim, cache_sharded in modes:
                 p = plan_tables(args.nodes, cap=args.cap,
                                 feat_dim=args.feat_dim,
                                 label_dim=args.label_dim, mp=mp,
-                                fused=fused, act_cache_dim=cache_dim)
+                                fused=fused, act_cache_dim=cache_dim,
+                                act_cache_sharded=cache_sharded)
                 total = p["per_chip_total_bytes"]
                 fits = total < budget
                 ok_all &= fits
                 print(json.dumps({
                     "config": ("fused" if fused else "split")
-                              + (f"+cache{cache_dim}" if cache_dim else ""),
+                              + (f"+cache{cache_dim}" if cache_dim else "")
+                              + ("s" if cache_sharded else ""),
                     "mp": mp,
                     "per_chip_mb": round(total / (1 << 20), 1),
                     "fits_budget": fits,
