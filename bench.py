@@ -491,6 +491,13 @@ def run_bench(args):
                                    cache_state, setup_secs, n_nodes,
                                    steps, spl_walk, cpu_fallback,
                                    num_classes)
+    if args.remat and (args.act_cache or sampler is None):
+        # a silently-ignored flag would stamp remat=true on an artifact
+        # whose model never ran remat — fail loudly like --act_cache
+        print("bench: --remat applies to the device fanout model only "
+              "(incompatible with --act_cache / --host_sampler)",
+              file=sys.stderr)
+        sys.exit(2)
     if sampler is None:
         if args.act_cache:
             print("bench: --act_cache needs the device sampler "
@@ -511,7 +518,7 @@ def run_bench(args):
     else:
         model = DeviceSampledGraphSage(
             num_classes=num_classes, multilabel=False, dim=128,
-            fanouts=tuple(fanouts))
+            fanouts=tuple(fanouts), remat=args.remat)
     flow = None if isinstance(graph, _CachedGraph) else FanoutDataFlow(
         graph, fanouts, with_features=False)
     spl = args.steps_per_loop or (1 if (args.smoke or cpu_fallback) else 16)
@@ -598,6 +605,7 @@ def run_bench(args):
             "fused_sampler": bool(args.fused_sampler),
             "pad_features": bool(args.pad_features),
             "act_cache": bool(args.act_cache),
+            "remat": bool(args.remat),
             # config-independent training rate (root nodes consumed/s):
             # the honest cross-config axis when edge accounting differs
             # (--act_cache aggregates ~5x fewer edges per step by design)
@@ -662,6 +670,14 @@ def build_argparser():
                          "each gathered row is one aligned tile "
                          "(candidate config, excluded from the cache "
                          "gate; cache-served runs only)")
+    ap.add_argument("--remat", action="store_true", default=False,
+                    help="recompute gather+encode in the backward pass "
+                         "(jax.checkpoint): the hop-2 feature layer "
+                         "never lives across the backward, unlocking "
+                         "bigger batches (batch 65536 OOMs without it; "
+                         "pair with --batch_size 65536 for the A/B — "
+                         "candidate config, excluded from the cache "
+                         "gate)")
     ap.add_argument("--act_cache", action="store_true", default=False,
                     help="historical-activation config "
                          "(DeviceSampledScalableSage): sample ONE hop and "
@@ -750,6 +766,7 @@ def main(argv=None):
                           and not args.fused_sampler
                           and not args.pad_features
                           and not args.act_cache
+                          and not args.remat
                           and args.int8_features
                           and not args.degree_sorted)
         if result.get("detail", {}).get("backend") == "tpu" \

@@ -71,6 +71,40 @@ class UnsupervisedGraphSage(UnsuperviseModel):
                            concat=False, name="encoder")(_fanout_layers(batch))
 
 
+class _GatherEncode(nn.Module):
+    """gather + encode as ONE module — the single encoder dispatch for
+    DeviceSampledGraphSage (every config shares this param tree, so the
+    remat toggle never invalidates a checkpoint). Wrapped in nn.remat
+    when remat=True: that puts gather+encode under one jax.checkpoint
+    boundary, so the backward pass RE-GATHERS the per-hop feature
+    layers instead of keeping them alive — at the canonical products
+    shape the hop-2 layer alone is ~1GB bf16, the allocation that makes
+    batch 65536 OOM. Residuals kept are only the HBM tables (already
+    resident) and the int32 rows."""
+
+    dim: int
+    fanouts: tuple
+    aggregator: str
+    encoder: str
+    gather: Any = None  # make_table_gather closure for sharded tables
+
+    @nn.compact
+    def __call__(self, table, scale, rows):
+        from euler_tpu.utils.encoders import GCNEncoder, GenieEncoder
+
+        batch = {"feature_table": table}
+        if scale is not None:
+            batch["feature_scale"] = scale
+        layers = gather_feature_rows(batch, rows, gather=self.gather)
+        if self.encoder == "gcn":
+            return GCNEncoder(self.dim, self.fanouts, name="enc")(layers)
+        if self.encoder == "genie":
+            return GenieEncoder(self.dim, self.fanouts,
+                                name="enc")(layers)
+        return SageEncoder(self.dim, self.fanouts, self.aggregator,
+                           name="enc")(layers)
+
+
 class DeviceSampledGraphSage(SuperviseModel):
     """A fanout model whose sampling runs ON DEVICE (DeviceNeighborTable):
     the batch carries only root rows + a sample seed; neighbor sampling,
@@ -84,13 +118,16 @@ class DeviceSampledGraphSage(SuperviseModel):
     fanouts: Sequence[int] = (10, 10)
     aggregator: str = "mean"
     encoder: str = "sage"
+    # remat: recompute gather+encode in the backward pass
+    # (_RematGatherEncode) — unlocks batches whose per-hop feature
+    # layers don't fit HBM twice. Replicated tables only.
+    remat: bool = False
 
     def embed(self, batch: Dict[str, Any]) -> Array:
         from euler_tpu.parallel.device_sampler import (
             is_model_sharded, make_table_gather, sample_fanout_rows,
             sample_fanout_rows_fused,
         )
-        from euler_tpu.utils.encoders import GCNEncoder, GenieEncoder
 
         roots = batch["rows"][0]
         key = jax.random.fold_in(jax.random.key(17), batch["sample_seed"])
@@ -113,19 +150,21 @@ class DeviceSampledGraphSage(SuperviseModel):
                 batch["nbr_table"], batch["cum_table"],
                 roots, tuple(self.fanouts), key,
                 gather=gather if sharded else None)
-        layers = gather_feature_rows(batch, rows, gather=gather)
-        if self.encoder == "gcn":
-            return GCNEncoder(self.dim, tuple(self.fanouts),
-                              name="encoder")(layers)
-        if self.encoder == "genie":
-            return GenieEncoder(self.dim, tuple(self.fanouts),
-                                name="encoder")(layers)
-        if self.encoder != "sage":
+        if self.encoder not in ("sage", "gcn", "genie"):
             raise ValueError(
                 f"DeviceSampledGraphSage.encoder must be 'sage', 'gcn' "
                 f"or 'genie', got {self.encoder!r}")
-        return SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
-                           name="encoder")(layers)
+        if self.remat and sharded:
+            raise ValueError(
+                "DeviceSampledGraphSage(remat=True) supports "
+                "replicated tables only (the re-gather would nest "
+                "shard_map inside jax.checkpoint)")
+        mod_cls = nn.remat(_GatherEncode) if self.remat else _GatherEncode
+        mod = mod_cls(self.dim, tuple(self.fanouts), self.aggregator,
+                      self.encoder, gather=gather if sharded else None,
+                      name="encoder")
+        return mod(batch["feature_table"], batch.get("feature_scale"),
+                   rows)
 
 
 class DeviceSampledScalableSage(SuperviseModel):

@@ -1588,3 +1588,49 @@ def test_device_scalable_gcn_variant():
     assert res["global_step"] == 60
     ev = est.evaluate(est.eval_input_fn, 10)
     assert ev["metric"] > 0.5, ev
+
+
+def test_device_sampled_remat_trains():
+    """remat=True (gather+encode re-run in backward) trains and learns —
+    numerics are the same ops recomputed, so quality must hold."""
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.dataset.base_dataset import synthetic_citation
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.models import DeviceSampledGraphSage
+    from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+
+    data = synthetic_citation("trem", n=300, d=16, num_classes=3,
+                              train_per_class=30, val=40, test=60, seed=12)
+    g = data.engine
+    store = DeviceFeatureStore(g, ["feature"], label_fid="label",
+                               label_dim=data.num_classes, quantize="int8")
+    sampler = DeviceNeighborTable(g, cap=16)
+    est = NodeEstimator(
+        DeviceSampledGraphSage(num_classes=data.num_classes,
+                               multilabel=False, dim=16, fanouts=(4, 4),
+                               remat=True),
+        dict(batch_size=32, learning_rate=0.01, steps_per_loop=3,
+             label_dim=data.num_classes, log_steps=1000,
+             checkpoint_steps=0),
+        g, FanoutDataFlow(g, [4, 4]), label_fid="label",
+        label_dim=data.num_classes, feature_store=store,
+        device_sampler=sampler)
+    res = est.train(est.train_input_fn, max_steps=60)
+    assert res["global_step"] == 60
+    ev = est.evaluate(est.eval_input_fn, 10)
+    assert ev["metric"] > 0.55, ev
+
+    import pytest
+
+    from euler_tpu.parallel import make_mesh
+    with pytest.raises(ValueError, match="replicated tables only"):
+        m = DeviceSampledGraphSage(num_classes=3, multilabel=False,
+                                   dim=8, fanouts=(2,), remat=True,
+                                   table_mesh=make_mesh(model_parallel=2))
+        batch = {"rows": [jnp.zeros(4, jnp.int32)],
+                 "sample_seed": np.uint32(0),
+                 "nbr_table": jnp.zeros((8, 4), jnp.int32),
+                 "cum_table": jnp.ones((8, 4)),
+                 "feature_table": jnp.ones((8, 6)),
+                 "label_table": jnp.zeros((8, 3))}
+        m.init(jax.random.key(0), batch)

@@ -117,6 +117,9 @@ bench_stage pad       1200 --pad_features  || exit 1
 # stacking leg: if either single lever wins, the combo is the next
 # question — measure it in the same window rather than waiting a round
 bench_stage degsort_pad 1200 --degree_sorted --pad_features || exit 1
+# remat unlocks the batch the chip couldn't fit (65536 OOMed bare):
+# bigger batch amortizes dispatch + deepens the gather pipeline
+bench_stage remat64k  1500 --remat --batch_size 65536 || exit 1
 
 if ! stamp_ok .bench_cache/stamps/profiler; then
   log "stage profiler start"
