@@ -228,7 +228,7 @@ def _train_topology_body(args, pid, nproc, mesh, remote) -> None:
             return optax.apply_updates(params, updates), opt_state2, loss
 
         losses = []
-        for step in range(4):
+        for step in range(6):
             batch = global_batch(step)
             params, opt_state, loss = train_step(params, opt_state, batch)
             losses.append(float(loss))
