@@ -1557,6 +1557,15 @@ def test_act_cache_row_sharded():
     leaf2 = jax.tree_util.tree_leaves(est.state.extra_vars["cache"])[0]
     assert tuple(leaf2.sharding.spec)[:1] == ("model",), leaf2.sharding
 
+    # the full-coverage refresh must not silently replicate it either
+    from euler_tpu.models.graphsage import refresh_act_cache
+    with mesh:
+        refresh_act_cache(est, chunk=64)
+    leaf3 = jax.tree_util.tree_leaves(est.state.extra_vars["cache"])[0]
+    assert tuple(leaf3.sharding.spec)[:1] == ("model",), leaf3.sharding
+    covered = np.asarray(jnp.any(leaf3 != 0, axis=-1))
+    assert covered[: n_rows - 1].mean() > 0.9
+
 
 def test_device_scalable_gcn_variant():
     """encoder='gcn' (reference ScalableGCNEncoder) rides the same
