@@ -120,6 +120,9 @@ bench_stage degsort_pad 1200 --degree_sorted --pad_features || exit 1
 # remat unlocks the batch the chip couldn't fit (65536 OOMed bare):
 # bigger batch amortizes dispatch + deepens the gather pipeline
 bench_stage remat64k  1500 --remat --batch_size 65536 || exit 1
+# dispatch-amortization knob last re-tuned round 2 (16): the int8
+# default changed step time, so re-check the next stop
+bench_stage spl32     1200 --steps_per_loop 32 || exit 1
 
 if ! stamp_ok .bench_cache/stamps/profiler; then
   log "stage profiler start"
