@@ -84,9 +84,10 @@ def job_list():
     # host scalable_sage row (its true protocol family). Flags are
     # per-dataset VAL-chosen (sweep.json act_cache:* — pubmed's val
     # prefers the wider window, cora's prefers the defaults)
-    jobs.append(("graphsage-dev-cache/cora",
-                 "examples/graphsage/run_graphsage.py",
-                 ["--dataset", "cora", "--device_sampler", "--act_cache"]))
+    for ds in ("cora", "citeseer"):
+        jobs.append((f"graphsage-dev-cache/{ds}",
+                     "examples/graphsage/run_graphsage.py",
+                     ["--dataset", ds, "--device_sampler", "--act_cache"]))
     jobs.append(("graphsage-dev-cache/pubmed",
                  "examples/graphsage/run_graphsage.py",
                  ["--dataset", "pubmed", "--device_sampler", "--act_cache",
