@@ -38,6 +38,11 @@ import numpy as np
 TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "BENCH_TPU.json")
 
+# Resolved steps_per_loop when --steps_per_loop is unset on TPU. 32 since
+# the round-5 on-chip A/B (28.81M vs 28.27M edges/s at spl=16 under the
+# int8 default; stacking degsort+pad on top added only +0.2% — PERF.md).
+TPU_STEPS_PER_LOOP = 32
+
 
 def _record_tpu_result(result: dict) -> None:
     """Best-effort: a cache-write failure must never clobber the
@@ -521,7 +526,8 @@ def run_bench(args):
             fanouts=tuple(fanouts), remat=args.remat)
     flow = None if isinstance(graph, _CachedGraph) else FanoutDataFlow(
         graph, fanouts, with_features=False)
-    spl = args.steps_per_loop or (1 if (args.smoke or cpu_fallback) else 16)
+    spl = args.steps_per_loop or (1 if (args.smoke or cpu_fallback)
+                                  else TPU_STEPS_PER_LOOP)
     est = NodeEstimator(
         model,
         dict(batch_size=batch, learning_rate=0.01, optimizer="adam",
@@ -689,8 +695,9 @@ def build_argparser():
                          "configs by detail.nodes_per_sec (candidate "
                          "config, excluded from the cache gate)")
     ap.add_argument("--steps_per_loop", type=int, default=0,
-                    help="0 = auto (16 on TPU, 1 in smoke/CPU mode): "
-                         "lax.scan window per device dispatch")
+                    help="0 = auto (32 on TPU since the round-5 on-chip "
+                         "A/B, 1 in smoke/CPU mode): lax.scan window per "
+                         "device dispatch")
     ap.add_argument("--fp32", action="store_true", default=False,
                     help="keep float32 features in the full bench")
     ap.add_argument("--layerwise", action="store_true", default=False,

@@ -8,15 +8,28 @@ XLA expresses this as gather → reshape → mean, materializing the
 traffic). The fused kernel streams each neighbor row HBM→VMEM once and
 accumulates in VMEM, cutting HBM traffic to n·k·D·4 + n·D·4.
 
-gather_mean() defaults to the XLA formulation: on the small v5e bench
-(200k x 128 table, 16384 x 15 rows) the fused kernel was within 2x of
-XLA's gather in either direction with no reproducible win — XLA's TPU
-gather is already tight there. At products scale (2.45M-row table) the
-balance may differ: tile_n is now a parameter so the profiler
-(tools/profile_device_step.py) can sweep DMA-batch sizes. The kernel
-remains the opt-in (use_pallas=True) path and the template for
-neighbor-indexed fusions XLA can't express (validated in interpret
-mode on CPU, numerics match to float tolerance).
+CLOSED NEGATIVE RESULT (round 5 — PERF.md "Pallas gather: closed").
+gather_mean() defaults to the XLA formulation and that is the final
+verdict, not an interim one:
+- Small-scale (200k x 128 table): the fused kernel was within 2x of
+  XLA's gather in either direction, no reproducible win.
+- Per-row DMA cost analysis (round 4): at d=100 bf16 a row is ~200B,
+  so each async copy moves less than one 512B HBM burst and the
+  issue/semaphore overhead dominates — the per-row design loses
+  regardless of tile_n.
+- The last credible configuration — 128B-aligned int8 rows
+  (int8 + pad128, one aligned burst per row) — could not even be
+  compiled: all four products-scale probes (t8 / pad128 / onesem /
+  onesem+pad128) crash this environment's remote Mosaic compiler with
+  HTTP 500 (round-5 window, .bench_cache/profile_tpu.json), and the
+  meaningful XLA-side A/Bs (pad128 59.6ms vs plain 59.8ms vs
+  promise_in_bounds 58.6ms on the 4.9M-row hop-2 gather) show the
+  gather is HBM-random-access-bound, not layout-bound.
+The hop-2 gather was instead removed structurally (the in-jit
+historical-activation cache, parallel/encoders — 4.2x step-time win).
+The kernel below stays as the validated template for neighbor-indexed
+fusions XLA can't express (interpret-mode tests pin numerics), not as
+a performance path.
 """
 
 from __future__ import annotations

@@ -72,6 +72,10 @@ def test_bench_argparser_defaults_contract():
     assert d.int8_features is True      # round-4 on-TPU A/B winner
     assert d.fused_sampler is False     # measured regression — not flipped
     assert d.cap == 32 and d.steps_per_loop == 0
+    # resolved TPU default: 32 since the round-5 on-chip A/B (28.81M vs
+    # 28.27M at 16); the flag default stays 0 so the canonical-refresh
+    # gate (not args.steps_per_loop) still recognizes default runs
+    assert bench.TPU_STEPS_PER_LOOP == 32
 
 
 def test_bench_smoke_layerwise_mode():

@@ -59,9 +59,12 @@ def main():
     # orders the display. Both naming schemes ride the glob: the
     # round-3 watcher wrote bench_*.json, the round-4 stage-stamped
     # payload writes out_*.json.
-    _PRIORITY = ("out_canonical.json", "out_cache.json", "out_bf16.json",
-                 "out_fused.json", "out_fused_bf16.json", "out_int8.json",
-                 "out_degsort.json", "out_pad.json",
+    # round-5 live stage set (tpu_window_payload.sh); retired legs
+    # (fused_bf16 / degsort / pad / remat64k / spl32 — closed in
+    # PERF.md) still render via the glob tail if their artifacts exist
+    _PRIORITY = ("out_canonical.json", "out_cache.json",
+                 "out_cache_tuned.json", "out_bf16.json",
+                 "out_fused.json", "out_spl16.json",
                  "out_degsort_pad.json")
     found = sorted(
         os.path.basename(p) for pat in ("out_*.json", "bench_*.json")

@@ -12,16 +12,20 @@
 #
 # Priority order (most valuable first):
 #   1. canonical  — default-config bench at HEAD (int8 feature table
-#                   since round 4); refreshes BENCH_TPU.json and commits
-#                   the refreshed record (clean tree only)
-#   2. lever A/Bs — bf16 / fused / fused_bf16 / degsort / pad /
-#                   degsort_pad (all relative to the int8-on default)
+#                   since round 4, steps_per_loop 32 since round 5);
+#                   refreshes BENCH_TPU.json and commits the refreshed
+#                   record (clean tree only)
+#   2. lever A/Bs — cache / cache_tuned / bf16 / fused / spl16 /
+#                   degsort_pad (all relative to the tuned default)
 #   3. profiler   — per-component step probes (tools/profile_device_step.py)
 #   4. walk / layerwise family benches, products-scale infer→kNN
 #
 # To force a re-run of a stage: rm .bench_cache/stamps/<stage>
 cd /root/repo || exit 1
 mkdir -p .bench_cache/stamps
+# single-instance guard: two payloads on one chip corrupt every measurement
+exec 9>.bench_cache/payload.lock
+flock -n 9 || { echo "payload already running; exiting" >&2; exit 0; }
 log() { echo "$(date -u +%H:%M:%S) payload: $1" >> .bench_cache/watch.log; }
 
 FP=$(python tools/devpath_fp.py 2>/dev/null)
@@ -78,10 +82,10 @@ bench_stage() {  # bench_stage <name> <timeout_s> <bench args...>
   return 1  # abort the window; the watcher retries at the next UP probe
 }
 
-# int8 features are DEFAULT since the round-4 A/B: canonical runs
-# int8-on; `bf16` is the baseline leg (old canonical); `fused` is
-# fused+int8, `fused_bf16` fused without int8 (out_*.json artifacts are
-# self-describing via detail.int8_features etc. since round 5).
+# Canonical = the tuned defaults (int8 features since round 4,
+# steps_per_loop 32 since round 5). Each A/B leg below flips ONE knob
+# off that baseline; out_*.json artifacts are self-describing via
+# detail.int8_features / steps_per_loop / act_cache etc.
 bench_stage canonical 1500             || exit 1
 # Land any uncommitted BENCH_TPU.json refresh as a data-only commit, so
 # the round artifact exists even if the session is mid-task when the
@@ -105,24 +109,22 @@ if [ -n "$(git status --porcelain -- BENCH_TPU.json 2>/dev/null)" ]; then
     [ -n "$committed" ] || log "WARNING: BENCH_TPU.json refresh NOT committed: ${err:0:160}"
   fi
 fi
-# the round-5 structural lever first — it's the biggest open question
-# (hop-2 gather removal via the in-jit historical-activation cache);
-# edges/s counts actually-aggregated edges, compare by nodes_per_sec
-bench_stage cache     1200 --act_cache || exit 1
-bench_stage bf16      1200 --no-int8_features || exit 1
-bench_stage fused     1200 --fused_sampler || exit 1
-bench_stage fused_bf16 1200 --fused_sampler --no-int8_features || exit 1
-bench_stage degsort   1200 --degree_sorted || exit 1
-bench_stage pad       1200 --pad_features  || exit 1
-# stacking leg: if either single lever wins, the combo is the next
-# question — measure it in the same window rather than waiting a round
+# the round-5 structural lever: apples-to-apples (default shapes) plus
+# its tuned config (batch 131072, the measured sweet spot of the
+# round-5 batch sweep — the cache family has no hop-2 layer, so batch
+# scales where the fanout model OOMed at 65536); edges/s counts
+# actually-aggregated edges, compare configs by detail.nodes_per_sec
+bench_stage cache       1200 --act_cache || exit 1
+bench_stage cache_tuned 1500 --act_cache --batch_size 131072 || exit 1
+# live A/B legs, one per open knob: int8-off baseline, fused sampler,
+# previous dispatch window (spl default flipped 16->32 in round 5),
+# degsort+pad layout stack. Legs settled by the round-5 window
+# (fused_bf16, separate degsort/pad, remat64k) are closed out in
+# PERF.md and no longer burn window time.
+bench_stage bf16        1200 --no-int8_features || exit 1
+bench_stage fused       1200 --fused_sampler || exit 1
+bench_stage spl16       1200 --steps_per_loop 16 || exit 1
 bench_stage degsort_pad 1200 --degree_sorted --pad_features || exit 1
-# remat unlocks the batch the chip couldn't fit (65536 OOMed bare):
-# bigger batch amortizes dispatch + deepens the gather pipeline
-bench_stage remat64k  1500 --remat --batch_size 65536 || exit 1
-# dispatch-amortization knob last re-tuned round 2 (16): the int8
-# default changed step time, so re-check the next stop
-bench_stage spl32     1200 --steps_per_loop 32 || exit 1
 
 if ! stamp_ok .bench_cache/stamps/profiler; then
   log "stage profiler start"
