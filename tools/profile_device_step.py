@@ -192,6 +192,18 @@ def main():
         measure("sample_hop2_ms", scanned(hop2), nbr, cum, rows_all[1],
                 reps=args.reps)
 
+        # sorted-locality variant: sort the hop-1 frontier before the
+        # cum-row gather so the 491k random rows arrive in ascending
+        # order (sort cost included in the probe — the lever only wins
+        # if sort + local gathers beat the random gathers)
+        def hop2s(c, i, seed, nbr, cum, r1):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            r = jnp.sort(perturb(r1, i, seed))
+            return sample_hop(nbr, cum, r, fanouts[1], k).sum()
+
+        measure("sample_hop2_sorted_ms", scanned(hop2s), nbr, cum,
+                rows_all[1], reps=args.reps)
+
         # fused layout: one [N+1, 2C] i32 table, one gather per hop
         from euler_tpu.parallel.device_sampler import (
             fuse_tables, sample_fanout_rows_fused, sample_hop_fused,
@@ -244,6 +256,28 @@ def main():
 
         measure("feat_gathermean_h2_ms", scanned(gmean), feat, r2,
                 reps=args.reps)
+
+        # sorted gather + segment-mean: the END-TO-END sorted-locality
+        # candidate. The feature rows are gathered in ascending-id order
+        # (HBM locality) and the permutation is absorbed by the segment
+        # ids of the aggregation — no un-permute gather of the gathered
+        # rows. Wins only if argsort(4.9M) + local gathers + scatter-add
+        # beat random gathers + reshape-mean; compare with
+        # feat_gathermean_h2_ms.
+        def gmean_sorted(c, i, seed, tab, rr):
+            r = perturb(rr, i, seed)
+            # one key-value sort yields sorted rows AND the permutation
+            # (argsort + take(r, order) would pay a second 4.9M gather)
+            r_sorted, orig_pos = jax.lax.sort_key_val(
+                r, jnp.arange(r.shape[0], dtype=jnp.int32))
+            x = jnp.take(tab, r_sorted, axis=0)
+            seg = orig_pos // k2
+            s = jax.ops.segment_sum(x, seg,
+                                    num_segments=r.shape[0] // k2)
+            return (s * (1.0 / k2)).sum()
+
+        measure("feat_gathermean_h2_sorted_ms", scanned(gmean_sorted),
+                feat, r2, reps=args.reps)
         # cum-table row gather at hop-1 scale (sampling's own gather)
         measure("cum_gather_h1rows_ms", scanned(mk_gather()), cum,
                 rows_all[1], reps=args.reps)
