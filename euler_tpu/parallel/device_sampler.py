@@ -350,9 +350,18 @@ def sample_hop(nbr_table: jax.Array, cum_table: jax.Array,
     log-search). Zero-degree rows (total weight 0) resolve to the pad
     slot, whose neighbor entry is pad_row.
 
-    gather (make_table_gather) routes table reads; the default local
-    take also uses a flattened single-gather fast path that a row-
-    sharded table can't."""
+    The neighbor pick is count-aware (round-5 on-chip probes,
+    PERF.md): TPU gather cost here is element-count-bound, not
+    byte-bound — at products scale a flat pick of n·count single int32
+    elements ran 77.9ms where a row gather of the same n nodes ran
+    21.7ms — so for count >= 4 the whole [n, C] neighbor row is
+    gathered once per node and the count columns are picked locally
+    with take_along_axis (draw-for-draw identical output). For small
+    count (the walk family's count=1 chains) the flat pick moves C×
+    fewer bytes at the same element count and stays the right shape.
+
+    gather (make_table_gather) routes table reads for row-sharded
+    tables; that path always has the full rows and picks locally."""
     C = nbr_table.shape[1]
     n = rows.shape[0]
     if gather is None:
@@ -364,9 +373,12 @@ def sample_hop(nbr_table: jax.Array, cum_table: jax.Array,
     col = (cum[:, None, :] <= u[:, :, None]).sum(-1)   # [n, k]
     col = jnp.clip(col, 0, C - 1).astype(jnp.int32)
     if gather is None:
-        flat = rows[:, None] * C + col                 # [n, k]
-        return jnp.take(nbr_table.reshape(-1), flat.reshape(-1))
-    nbr = gather(nbr_table, rows)                      # [n, C]
+        if count < 4:
+            flat = rows[:, None] * C + col             # [n, k]
+            return jnp.take(nbr_table.reshape(-1), flat.reshape(-1))
+        nbr = jnp.take(nbr_table, rows, axis=0)        # [n, C]
+    else:
+        nbr = gather(nbr_table, rows)                  # [n, C]
     return jnp.take_along_axis(nbr, col, axis=1).reshape(-1)
 
 

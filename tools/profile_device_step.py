@@ -204,6 +204,33 @@ def main():
         measure("sample_hop2_sorted_ms", scanned(hop2s), nbr, cum,
                 rows_all[1], reps=args.reps)
 
+        # flat-pick baseline: the RETIRED neighbor-pick algorithm (one
+        # n·count single-element gather), pinned inline so the A/B
+        # against the live count-aware row pick stays measurable after
+        # the round-5 flip. sample_hop2_ms above times the LIVE path
+        # (count=10 >= 4 → row gather + take_along_axis, measured
+        # 90.0ms); this baseline measured 95.9ms in the same window —
+        # gather cost on this chip is element-count-bound, not
+        # byte-bound (scalar_gather_h2_ms 77.9 vs cum_gather_h1rows_ms
+        # 21.7 for the same node count). Distinct from the fused
+        # [N+1,2C] layout, whose single 256B-row gather is SLOWER
+        # (sample_hop2_fused_ms 110.3).
+        def hop2fp(c, i, seed, nbr, cum, r1):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            r = perturb(r1, i, seed)
+            C = nbr.shape[1]
+            cumr = jnp.take(cum, r, axis=0)
+            total = cumr[:, -1]
+            u = jax.random.uniform(k, (r.shape[0], fanouts[1])) \
+                * total[:, None]
+            col = (cumr[:, None, :] <= u[:, :, None]).sum(-1)
+            col = jnp.clip(col, 0, C - 1).astype(jnp.int32)
+            flat = r[:, None] * C + col
+            return jnp.take(nbr.reshape(-1), flat.reshape(-1)).sum()
+
+        measure("sample_hop2_flatpick_ms", scanned(hop2fp), nbr, cum,
+                rows_all[1], reps=args.reps)
+
         # fused layout: one [N+1, 2C] i32 table, one gather per hop
         from euler_tpu.parallel.device_sampler import (
             fuse_tables, sample_fanout_rows_fused, sample_hop_fused,
