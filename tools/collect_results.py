@@ -84,15 +84,17 @@ def job_list():
     # host scalable_sage row (its true protocol family). Flags are
     # per-dataset VAL-chosen (sweep.json act_cache:* — pubmed's val
     # prefers the wider window, cora's prefers the defaults)
-    for ds in ("cora", "citeseer"):
+    jobs.append(("graphsage-dev-cache/cora",
+                 "examples/graphsage/run_graphsage.py",
+                 ["--dataset", "cora", "--device_sampler", "--act_cache"]))
+    # pubmed AND citeseer val-select the same wider window (sweep.json
+    # act_cache:* / citeseer_act_cache:*) — cora's val keeps defaults
+    for ds in ("pubmed", "citeseer"):
         jobs.append((f"graphsage-dev-cache/{ds}",
                      "examples/graphsage/run_graphsage.py",
-                     ["--dataset", ds, "--device_sampler", "--act_cache"]))
-    jobs.append(("graphsage-dev-cache/pubmed",
-                 "examples/graphsage/run_graphsage.py",
-                 ["--dataset", "pubmed", "--device_sampler", "--act_cache",
-                  "--fanouts", "25,10", "--hidden_dim", "128",
-                  "--store_decay", "0.8"]))
+                     ["--dataset", ds, "--device_sampler", "--act_cache",
+                      "--fanouts", "25,10", "--hidden_dim", "128",
+                      "--store_decay", "0.8"]))
     jobs.append(("deepwalk-dev/cora", "examples/deepwalk/run_deepwalk.py",
                  ["--dataset", "cora", "--device_sampler"]))
     jobs.append(("line-dev/cora", "examples/line/run_line.py",
