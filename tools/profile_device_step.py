@@ -256,6 +256,81 @@ def main():
                 reps=args.reps)
         del fused
 
+        # ---- round-5 third-window candidates: RNG cost + uniform path.
+        # The bench graph (and cora/pubmed/products) is UNWEIGHTED, so
+        # per-row uniform weights make the cum-row gather removable: the
+        # pad convention (pad slots hold pad_row) means degree is
+        # derivable from the neighbor row itself, (row != pad).sum(-1) —
+        # C compares on data the gather already brought into VMEM. One
+        # row gather per hop instead of two, and the inverse-CDF compare
+        # collapses to floor(u·deg).
+        n2, k2_ = rows_all[1].shape[0], fanouts[1]
+
+        def rngu(c, i, seed):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            return jax.random.uniform(k, (n2, k2_)).sum()
+
+        measure("rng_uniform_h2_ms", scanned(rngu), reps=args.reps)
+
+        def rngu_rbg(c, i, seed):
+            k = jax.random.fold_in(
+                jax.random.key(17, impl="rbg"), seed * 1000 + i)
+            return jax.random.uniform(k, (n2, k2_)).sum()
+
+        measure("rng_uniform_h2_rbg_ms", scanned(rngu_rbg), reps=args.reps)
+
+        def _hop_unif(nbr, r, k, count):
+            row = jnp.take(nbr, r, axis=0)                     # [n, C]
+            pad = nbr.shape[0] - 1
+            deg = (row != pad).sum(-1).astype(jnp.float32)     # [n]
+            u = jax.random.uniform(k, (r.shape[0], count))
+            col = jnp.minimum((u * deg[:, None]).astype(jnp.int32),
+                              jnp.maximum(deg[:, None].astype(jnp.int32)
+                                          - 1, 0))
+            return jnp.take_along_axis(row, col, axis=1)
+
+        def hop2u(c, i, seed, nbr, r1):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            return _hop_unif(nbr, perturb(r1, i, seed), k, k2_).sum()
+
+        measure("sample_hop2_unif_ms", scanned(hop2u), nbr, rows_all[1],
+                reps=args.reps)
+
+        def hop2u_rbg(c, i, seed, nbr, r1):
+            k = jax.random.fold_in(
+                jax.random.key(17, impl="rbg"), seed * 1000 + i)
+            return _hop_unif(nbr, perturb(r1, i, seed), k, k2_).sum()
+
+        measure("sample_hop2_unif_rbg_ms", scanned(hop2u_rbg), nbr,
+                rows_all[1], reps=args.reps)
+
+        # live weighted path with an rbg key: isolates how much of the
+        # live hop-2 cost is threefry itself
+        def hop2_rbg(c, i, seed, nbr, cum, r1):
+            k = jax.random.fold_in(
+                jax.random.key(17, impl="rbg"), seed * 1000 + i)
+            return sample_hop(nbr, cum, perturb(r1, i, seed),
+                              fanouts[1], k).sum()
+
+        measure("sample_hop2_rbg_ms", scanned(hop2_rbg), nbr, cum,
+                rows_all[1], reps=args.reps)
+
+        # full 2-hop fanout, uniform path + rbg: the end-to-end sampling
+        # candidate (compare with sample_only_ms)
+        def sampu(c, i, seed, nbr, roots):
+            k = jax.random.fold_in(
+                jax.random.key(17, impl="rbg"), seed * 1000 + i)
+            cur = roots
+            tot = jnp.float32(0)
+            for kk in fanouts:
+                k, sub = jax.random.split(k)
+                cur = _hop_unif(nbr, cur, sub, kk).reshape(-1)
+                tot = tot + cur.sum().astype(jnp.float32)
+            return tot
+
+        measure("sample_only_unif_rbg_ms", scanned(sampu), nbr, roots,
+                reps=args.reps)
+
     # ---- feature gathers ----------------------------------------------
     if want("gather"):
         def mk_gather(post=None):
