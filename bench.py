@@ -140,6 +140,24 @@ def _degree_sort_tables(nbr, cum, feat, label):
             permute(feat), permute(label))
 
 
+def _uniform_effective(args, sampler) -> bool:
+    """Resolve the --uniform_path tri-state against the table: default
+    (None) auto-enables on unit-weight tables (the one-gather sampling
+    path, round-5 on-chip win); forcing it ON over a weighted table is
+    refused — it would silently change the sampling distribution."""
+    if sampler is None or getattr(sampler, "fused", False):
+        return False
+    detected = bool(getattr(sampler, "uniform_rows", False))
+    if args.uniform_path is None:
+        return detected
+    if args.uniform_path and not detected:
+        print("bench: --uniform_path forced on a weighted table "
+              "(uniform_rows=False) — refusing; the uniform draw would "
+              "not match the table's weights", file=sys.stderr)
+        sys.exit(2)
+    return bool(args.uniform_path)
+
+
 class _CachedGraph:
     """Minimal engine facade over the bench table cache: dense ids
     (row == id), uniform unit node weights — so sample_node(-1) matches
@@ -200,6 +218,9 @@ def setup_tables(args, n_nodes, avg_degree, feat_dim, num_classes,
         z = np.load(path)
         stats = {k: z[k].item() for k in
                  ("hub_frac", "edge_keep_frac", "max_degree")}
+        if "uniform_rows" in z.files:  # absent in pre-round-5 caches →
+            # from_arrays recomputes from the tables
+            stats["uniform_rows"] = bool(z["uniform_rows"].item())
         nbr_h, cum_h = z["nbr"], z["cum"]
         feat_h, label_h = z["feat"], z["label"]
         if args.degree_sorted:
@@ -241,7 +262,8 @@ def setup_tables(args, n_nodes, avg_degree, feat_dim, num_classes,
                      edge_count=np.int64(graph.edge_count),
                      hub_frac=sampler.hub_frac,
                      edge_keep_frac=sampler.edge_keep_frac,
-                     max_degree=sampler.max_degree)
+                     max_degree=sampler.max_degree,
+                     uniform_rows=sampler.uniform_rows)
             os.replace(tmp, path)
         except OSError as e:
             print(f"bench: cache write failed (ignored): {e}",
@@ -269,7 +291,8 @@ def run_walk_bench(args, graph, sampler, cache_state, setup_secs,
     if sampler is not None:
         model = DeviceSampledSkipGram(
             num_rows=sampler.pad_row, dim=128, walk_len=walk_len,
-            left_win=lwin, right_win=rwin, num_negs=num_negs)
+            left_win=lwin, right_win=rwin, num_negs=num_negs,
+            uniform_sampling=_uniform_effective(args, sampler))
         est = BaseEstimator(model, dict(
             learning_rate=0.01, log_steps=1 << 30, checkpoint_steps=0,
             steps_per_loop=spl))
@@ -342,6 +365,7 @@ def run_walk_bench(args, graph, sampler, cache_state, setup_secs,
                 else "device"),
             "degree_sorted": bool(args.degree_sorted
                                   and cache_state == "hit"),
+            "uniform_path": _uniform_effective(args, sampler),
             "steps_per_loop": spl,
             "graph_cache": cache_state,
             "setup_secs": round(setup_secs, 1),
@@ -519,11 +543,13 @@ def run_bench(args):
             num_classes=num_classes, multilabel=False, dim=128,
             fanout=fanouts[0], num_layers=len(fanouts),
             max_id=int(store.features.shape[0]) - 1,
-            cache_dtype=jnp.bfloat16 if args.bf16 else None)
+            cache_dtype=jnp.bfloat16 if args.bf16 else None,
+            uniform_sampling=_uniform_effective(args, sampler))
     else:
         model = DeviceSampledGraphSage(
             num_classes=num_classes, multilabel=False, dim=128,
-            fanouts=tuple(fanouts), remat=args.remat)
+            fanouts=tuple(fanouts), remat=args.remat,
+            uniform_sampling=_uniform_effective(args, sampler))
     flow = None if isinstance(graph, _CachedGraph) else FanoutDataFlow(
         graph, fanouts, with_features=False)
     spl = args.steps_per_loop or (1 if (args.smoke or cpu_fallback)
@@ -612,6 +638,7 @@ def run_bench(args):
             "pad_features": bool(args.pad_features),
             "act_cache": bool(args.act_cache),
             "remat": bool(args.remat),
+            "uniform_path": _uniform_effective(args, sampler),
             # config-independent training rate (root nodes consumed/s):
             # the honest cross-config axis when edge accounting differs
             # (--act_cache aggregates ~5x fewer edges per step by design)
@@ -662,6 +689,14 @@ def build_argparser():
     ap.add_argument("--degree_sorted", action="store_true", default=False,
                     help="permute table rows hub-first (gather-locality "
                          "A/B; cache-served runs only)")
+    ap.add_argument("--uniform_path", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="one-gather uniform sampling on unit-weight "
+                         "tables (skips the cum-row gather per hop; "
+                         "round-5 on-chip win). Default: auto — on when "
+                         "the table reports uniform_rows; --no-uniform_"
+                         "path A/Bs the weighted inverse-CDF draw on "
+                         "the same table")
     ap.add_argument("--int8_features", action=argparse.BooleanOptionalAction,
                     default=True,
                     help="store the HBM feature table int8-quantized "

@@ -83,6 +83,10 @@ class DeviceSampledSkipGram(nn.Module):
     # otherwise turn into a full-table all-gather per hop). The
     # negative-sampler tables stay replicated (O(N) scalars).
     table_mesh: Any = None
+    # unit-weight tables (DeviceNeighborTable.uniform_rows): p=q=1 walk
+    # draws become one neighbor-row gather each, no cum-row read
+    # (replicated tables only; the node2vec biased path keeps cum)
+    uniform_sampling: bool = False
 
     @nn.compact
     def __call__(self, batch: Dict[str, Any]) -> ModelOutput:
@@ -101,7 +105,8 @@ class DeviceSampledSkipGram(nn.Module):
             if is_model_sharded(self.table_mesh) else None
         walks = walk_rows(batch["nbr_table"], batch["cum_table"], roots,
                           self.walk_len, kw, p=self.p, q=self.q,
-                          gather=tg)
+                          gather=tg,
+                          uniform=self.uniform_sampling and tg is None)
         pairs = gen_pair_rows(walks, self.left_win, self.right_win)
         flat = pairs.reshape(-1, 2)                    # [B*P, 2]
         src_r, pos_r = flat[:, 0], flat[:, 1]

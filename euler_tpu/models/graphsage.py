@@ -122,6 +122,12 @@ class DeviceSampledGraphSage(SuperviseModel):
     # (_RematGatherEncode) — unlocks batches whose per-hop feature
     # layers don't fit HBM twice. Replicated tables only.
     remat: bool = False
+    # uniform_sampling: the table's rows are unit-weight
+    # (DeviceNeighborTable.uniform_rows — unweighted graphs) → each hop
+    # is ONE neighbor-row gather, no cum-row read. Applies on the
+    # replicated split-table path only (fused/row-sharded layouts keep
+    # the weighted draw); distribution-identical on such tables.
+    uniform_sampling: bool = False
 
     def embed(self, batch: Dict[str, Any]) -> Array:
         from euler_tpu.parallel.device_sampler import (
@@ -149,7 +155,8 @@ class DeviceSampledGraphSage(SuperviseModel):
             rows = sample_fanout_rows(
                 batch["nbr_table"], batch["cum_table"],
                 roots, tuple(self.fanouts), key,
-                gather=gather if sharded else None)
+                gather=gather if sharded else None,
+                uniform=self.uniform_sampling and not sharded)
         if self.encoder not in ("sage", "gcn", "genie"):
             raise ValueError(
                 f"DeviceSampledGraphSage.encoder must be 'sage', 'gcn' "
@@ -195,6 +202,7 @@ class DeviceSampledScalableSage(SuperviseModel):
     store_decay: float = 0.9  # EMA weight on the old cached activation
     encoder: str = "sage"     # 'sage' (concat) or 'gcn' (mean-combine),
     # the reference's two scalable variants (encoders.py:294,629)
+    uniform_sampling: bool = False  # as DeviceSampledGraphSage
 
     def embed(self, batch: Dict[str, Any]) -> Array:
         import jax.numpy as jnp
@@ -214,7 +222,9 @@ class DeviceSampledScalableSage(SuperviseModel):
                                    int(self.fanout), key, tg)
         else:
             nbr = sample_hop(batch["nbr_table"], batch["cum_table"],
-                             roots, int(self.fanout), key, tg)
+                             roots, int(self.fanout), key, tg,
+                             uniform=self.uniform_sampling
+                             and tg is None)
         x, nbr_x = gather_feature_rows(batch, [roots, nbr], gather=gather)
         if self.encoder == "gcn":
             from euler_tpu.utils.encoders import ScalableGCNEncoder
@@ -389,6 +399,7 @@ class DeviceSampledUnsupervisedSage(nn.Module):
     # tables (neg_rows/neg_cum) stay replicated — they are O(N) scalars,
     # not O(N·C)/O(N·D) rows.
     table_mesh: Any = None
+    uniform_sampling: bool = False  # as DeviceSampledGraphSage
 
     @nn.compact
     def __call__(self, batch: Dict[str, Any]):
@@ -416,10 +427,11 @@ class DeviceSampledUnsupervisedSage(nn.Module):
                                             tuple(self.fanouts), kf,
                                             gather=tg)
         else:
+            unif = self.uniform_sampling and tg is None
             rows = sample_fanout_rows(batch["nbr_table"],
                                       batch["cum_table"],
                                       roots, tuple(self.fanouts), kf,
-                                      gather=tg)
+                                      gather=tg, uniform=unif)
         layers = gather_feature_rows(batch, rows, gather=gather)
         emb = SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
                           concat=False, name="encoder")(layers)   # [B, D]
@@ -427,7 +439,9 @@ class DeviceSampledUnsupervisedSage(nn.Module):
             pos_r = sample_hop_fused(fused_tab, roots, 1, kp, tg)  # [B]
         else:
             pos_r = sample_hop(batch["nbr_table"], batch["cum_table"],
-                               roots, 1, kp, gather=tg)           # [B]
+                               roots, 1, kp, gather=tg,
+                               uniform=self.uniform_sampling
+                               and tg is None)                    # [B]
         negs_r = sample_global_rows(batch["neg_rows"], batch["neg_cum"],
                                     kn, (roots.shape[0], self.num_negs))
         ctx = Embedding(self.num_rows + 1, self.dim, name="ctx_emb")

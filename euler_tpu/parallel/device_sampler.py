@@ -87,6 +87,16 @@ class DeviceNeighborTable:
         self.pad_row = int(nbr_tab.shape[0]) - 1
         for k in ("hub_frac", "edge_keep_frac", "max_degree"):
             setattr(self, k, (stats or {}).get(k))
+        # caches written before the round-5 uniform lever carry no
+        # uniform_rows stat — recompute from the tables (the slot
+        # weights are exactly recoverable from the inclusive cumsum)
+        u = (stats or {}).get("uniform_rows")
+        if u is None:
+            w = np.diff(cum_tab.astype(np.float32), axis=1,
+                        prepend=np.zeros((cum_tab.shape[0], 1),
+                                         np.float32))
+            u = _detect_uniform_rows(np.asarray(nbr_tab), w)
+        self.uniform_rows = bool(u)
         self.host_tables = None
         self._place(np.ascontiguousarray(nbr_tab),
                     np.ascontiguousarray(cum_tab), mesh)
@@ -160,6 +170,7 @@ class DeviceNeighborTable:
         kept = np.minimum(deg, C).sum()
         self.edge_keep_frac = float(kept / max(len(nbr_rows), 1))
         self.max_degree = int(deg.max()) if n else 0
+        self.uniform_rows = _detect_uniform_rows(nbr_tab, w_tab)
 
         cum = np.cumsum(w_tab, axis=1, dtype=np.float32)
         return nbr_tab, cum
@@ -199,6 +210,42 @@ class DeviceNeighborTable:
         if getattr(self, "fused", False):
             return {"nbrcum_table": self.fused_table}
         return {"nbr_table": self.neighbors, "cum_table": self.cum_weights}
+
+
+def _detect_uniform_rows(nbr_tab: np.ndarray, w_tab: np.ndarray) -> bool:
+    """True iff every row's positive-weight slots carry ONE equal weight
+    and the positive slots are exactly the non-pad slots — the unweighted
+    -graph case (cora/pubmed/ogbn-products and the bench graph all build
+    with default edge weight 1.0). Under this condition the inverse-CDF
+    draw is distribution-identical to a uniform draw over the row's
+    degree, and sample_hop(uniform=True) may skip the cum-row gather
+    entirely. Any weighted row (or an edge whose endpoint was missing
+    and mapped to pad while keeping weight) clears the flag — a false
+    positive would silently change the sampling distribution."""
+    pad = nbr_tab.shape[0] - 1
+    pos = w_tab > 0
+    if not (pos == (nbr_tab != pad)).all():
+        return False
+    rmax = w_tab.max(axis=1, keepdims=True)
+    return bool(((w_tab == 0) | (w_tab == rmax)).all())
+
+
+def _pick_cols(row: jax.Array, col: jax.Array, exact_f32: bool):
+    """row [n, C], col [n, k] → row[i, col[i, j]] [n, k].
+
+    take_along_axis lowers to an n·k single-element gather on TPU —
+    element-count-bound exactly like the retired flat pick (round-5
+    probes: 4.9M picks ≈ 40ms inside the 90ms hop-2 sample while the
+    row gather itself is 22ms). When ids fit f32 exactly (table rows
+    <= 2^24) the pick is instead a masked lane-sum over the C columns
+    already staged by the row gather — fused VPU work, no gather."""
+    if not exact_f32:
+        return jnp.take_along_axis(row, col, axis=1)
+    C = row.shape[1]
+    iota = jnp.arange(C, dtype=jnp.int32)
+    ind = iota[None, None, :] == col[:, :, None]          # [n, k, C]
+    return (row[:, None, :].astype(jnp.float32) * ind).sum(-1) \
+        .astype(row.dtype)
 
 
 def fuse_tables_host(nbr_tab: np.ndarray, cum_tab: np.ndarray) -> np.ndarray:
@@ -341,7 +388,7 @@ def slot_weights(cum_rows: jax.Array) -> jax.Array:
 
 def sample_hop(nbr_table: jax.Array, cum_table: jax.Array,
                rows: jax.Array, count: int, key,
-               gather=None) -> jax.Array:
+               gather=None, uniform: bool = False) -> jax.Array:
     """One weighted neighbor draw per (row, slot): [n] → [n * count].
 
     Inverse-CDF over each row's C inclusive cumulative weights — the
@@ -356,14 +403,42 @@ def sample_hop(nbr_table: jax.Array, cum_table: jax.Array,
     elements ran 77.9ms where a row gather of the same n nodes ran
     21.7ms — so for count >= 4 the whole [n, C] neighbor row is
     gathered once per node and the count columns are picked locally
-    with take_along_axis (draw-for-draw identical output). For small
-    count (the walk family's count=1 chains) the flat pick moves C×
-    fewer bytes at the same element count and stays the right shape.
+    (draw-for-draw identical output; _pick_cols uses a masked lane-sum
+    instead of take_along_axis when ids fit f32, which on TPU also
+    lowers to an element-count-bound gather). For small count (the walk
+    family's count=1 chains) the flat pick moves C× fewer bytes at the
+    same element count and stays the right shape.
+
+    uniform=True (tables whose rows are unit-weight —
+    DeviceNeighborTable.uniform_rows) skips the cum-row gather
+    entirely: ONE neighbor-row gather per hop, degree derived from the
+    row's pad count, column = floor(u·deg). Distribution-identical to
+    the inverse-CDF draw on such tables (not draw-for-draw — different
+    u consumption). Replicated tables only: the row-sharded layout pads
+    the row count up to the model-axis multiple, so pad cannot be
+    derived from shape there (walk_rows has the same constraint).
 
     gather (make_table_gather) routes table reads for row-sharded
     tables; that path always has the full rows and picks locally."""
     C = nbr_table.shape[1]
     n = rows.shape[0]
+    exact = nbr_table.shape[0] <= (1 << 24)  # ids ride f32 exactly
+    if uniform:
+        if gather is not None:
+            raise ValueError(
+                "sample_hop(uniform=True) supports replicated tables "
+                "only: a row-sharded table's row count is padded to the "
+                "model-axis multiple, so the pad id cannot be derived "
+                "from its shape. Use the weighted path (uniform=False) "
+                "with row-sharded tables.")
+        nbr = jnp.take(nbr_table, rows, axis=0)        # [n, C]
+        pad = nbr_table.shape[0] - 1
+        deg = (nbr != pad).sum(-1).astype(jnp.float32)             # [n]
+        u = jax.random.uniform(key, (n, count))
+        col = jnp.minimum((u * deg[:, None]).astype(jnp.int32),
+                          jnp.maximum(
+                              deg[:, None].astype(jnp.int32) - 1, 0))
+        return _pick_cols(nbr, col, exact).reshape(-1)
     if gather is None:
         cum = jnp.take(cum_table, rows, axis=0)        # [n, C]
     else:
@@ -379,20 +454,22 @@ def sample_hop(nbr_table: jax.Array, cum_table: jax.Array,
         nbr = jnp.take(nbr_table, rows, axis=0)        # [n, C]
     else:
         nbr = gather(nbr_table, rows)                  # [n, C]
-    return jnp.take_along_axis(nbr, col, axis=1).reshape(-1)
+    return _pick_cols(nbr, col, exact).reshape(-1)
 
 
 def sample_fanout_rows(nbr_table: jax.Array, cum_table: jax.Array,
                        roots: jax.Array, fanouts: Sequence[int], key,
-                       gather=None):
+                       gather=None, uniform: bool = False):
     """Multi-hop on-device fanout: returns [roots, hop1, hop2, ...] row
     arrays (layer h has roots.shape[0] * prod(fanouts[:h]) entries) —
     the shape contract of FanoutDataFlow, produced without touching the
-    host."""
+    host. uniform=True → the one-gather unit-weight path per hop (see
+    sample_hop)."""
     layers = [roots]
     cur = roots
     for k in fanouts:
         key, sub = jax.random.split(key)
-        cur = sample_hop(nbr_table, cum_table, cur, int(k), sub, gather)
+        cur = sample_hop(nbr_table, cum_table, cur, int(k), sub, gather,
+                         uniform=uniform)
         layers.append(cur)
     return layers

@@ -79,16 +79,21 @@ def sample_global_rows(pool_rows: jax.Array, pool_cum: jax.Array,
 def walk_rows(nbr_table: jax.Array, cum_table: jax.Array,
               roots: jax.Array, walk_len: int, key,
               p: float = 1.0, q: float = 1.0,
-              gather=None) -> jax.Array:
+              gather=None, uniform: bool = False) -> jax.Array:
     """[B] roots → [B, walk_len+1] row walks, column 0 = roots.
 
-    p == q == 1: each step is one weighted neighbor draw (sample_hop).
+    p == q == 1: each step is one weighted neighbor draw (sample_hop);
+    uniform=True routes those draws through the one-gather unit-weight
+    path (DeviceNeighborTable.uniform_rows tables, replicated only).
     Otherwise node2vec second-order bias: candidate weights are scaled
     1/p when returning to the previous node, 1 when the candidate is a
     kept neighbor of the previous node, 1/q otherwise — computed over
-    the capped rows with C x C equality compares, no host round-trip.
+    the capped rows with C x C equality compares, no host round-trip
+    (the biased path always reads the cum table: the bias math needs
+    raw slot weights, so uniform is ignored there).
     """
     C = nbr_table.shape[1]
+    unif = uniform and gather is None
 
     def take(tab, r):
         return gather(tab, r) if gather is not None else \
@@ -96,13 +101,15 @@ def walk_rows(nbr_table: jax.Array, cum_table: jax.Array,
 
     cols = [roots]
     key, sub = jax.random.split(key)
-    cur = sample_hop(nbr_table, cum_table, roots, 1, sub, gather)
+    cur = sample_hop(nbr_table, cum_table, roots, 1, sub, gather,
+                     uniform=unif)
     cols.append(cur)
     prev = roots
     for _ in range(walk_len - 1):
         key, sub = jax.random.split(key)
         if p == 1.0 and q == 1.0:
-            nxt = sample_hop(nbr_table, cum_table, cur, 1, sub, gather)
+            nxt = sample_hop(nbr_table, cum_table, cur, 1, sub, gather,
+                             uniform=unif)
         else:
             cand = take(nbr_table, cur)                     # [B, C]
             w = slot_weights(take(cum_table, cur))          # [B, C]

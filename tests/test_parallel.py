@@ -215,6 +215,160 @@ def test_device_sampler_zero_degree_pads():
     assert set(np.asarray(out).tolist()) == {t.pad_row}
 
 
+def _unweighted_ring(n=10):
+    from euler_tpu.graph import GraphBuilder
+
+    b = GraphBuilder()
+    ids = np.arange(n, dtype=np.uint64)
+    b.add_nodes(ids)
+    src = np.concatenate([ids, ids, ids])
+    dst = np.concatenate([(ids + 1) % n, (ids + 2) % n, (ids + 3) % n])
+    b.add_edges(src, dst)
+    return b.finalize(), ids
+
+
+def test_uniform_rows_detection():
+    """Unweighted graphs (default edge weight 1.0) set uniform_rows; any
+    per-row weight spread clears it — the flag gates the one-gather
+    uniform sampling path, so a false positive would silently change a
+    weighted graph's sampling distribution."""
+    from euler_tpu.parallel import DeviceNeighborTable
+
+    g, _ = _unweighted_ring()
+    assert DeviceNeighborTable(g, cap=4).uniform_rows is True
+    gw, _ = _weighted_ring()
+    assert DeviceNeighborTable(gw, cap=4).uniform_rows is False
+
+
+def test_uniform_sample_hop_matches_weighted_distribution():
+    """uniform=True draws true neighbors ~uniformly — same distribution
+    as the inverse-CDF path on a unit-weight table (not draw-for-draw:
+    the uniform path skips the cum-row gather entirely)."""
+    import jax
+    import jax.numpy as jnp
+
+    from euler_tpu.parallel import DeviceNeighborTable, sample_hop
+
+    g, ids = _unweighted_ring()
+    t = DeviceNeighborTable(g, cap=4)
+    assert t.uniform_rows
+    rows = g.node_rows(ids)
+    roots = jnp.asarray(np.repeat(rows[:1], 9000), jnp.int32)
+    out = sample_hop(t.neighbors, t.cum_weights, roots, 1,
+                     jax.random.key(2), uniform=True)
+    sampled = np.asarray(out)
+    nbr_rows = set(rows[[1, 2, 3]].tolist())
+    counts = {r: int((sampled == r).sum()) for r in nbr_rows}
+    assert sum(counts.values()) == 9000          # only true neighbors
+    for c in counts.values():
+        assert 2600 < c < 3400                   # ~3000 each
+
+
+def test_uniform_sample_hop_zero_degree_pads():
+    import jax
+    import jax.numpy as jnp
+
+    from euler_tpu.graph import GraphBuilder
+    from euler_tpu.parallel import DeviceNeighborTable, sample_hop
+
+    b = GraphBuilder()
+    b.add_nodes(np.arange(3, dtype=np.uint64))
+    b.add_edges(np.array([0], np.uint64), np.array([1], np.uint64))
+    g = b.finalize()
+    t = DeviceNeighborTable(g, cap=2)
+    assert t.uniform_rows
+    iso = g.node_rows(np.array([2], np.uint64))
+    out = sample_hop(t.neighbors, t.cum_weights,
+                     jnp.asarray(iso, jnp.int32), 4, jax.random.key(0),
+                     uniform=True)
+    assert set(np.asarray(out).tolist()) == {t.pad_row}
+    # sampling from the pad row itself also stays at pad
+    out2 = sample_hop(t.neighbors, t.cum_weights,
+                      jnp.full(4, t.pad_row, jnp.int32), 3,
+                      jax.random.key(1), uniform=True)
+    assert set(np.asarray(out2).tolist()) == {t.pad_row}
+
+
+def test_uniform_hub_draws_from_capped_subset():
+    """A node with degree > cap keeps a C-subset; uniform draws must
+    stay inside that subset (deg counts non-pad slots, which is C)."""
+    import jax
+    import jax.numpy as jnp
+
+    from euler_tpu.graph import GraphBuilder
+    from euler_tpu.parallel import DeviceNeighborTable, sample_hop
+
+    b = GraphBuilder()
+    ids = np.arange(12, dtype=np.uint64)
+    b.add_nodes(ids)
+    src = np.zeros(11, np.uint64)
+    dst = np.arange(1, 12, dtype=np.uint64)
+    b.add_edges(src, dst)
+    g = b.finalize()
+    t = DeviceNeighborTable(g, cap=4)
+    assert t.uniform_rows and t.max_degree == 11
+    row0 = g.node_rows(np.array([0], np.uint64))
+    kept = set(int(x) for x in np.asarray(t.neighbors)[int(row0[0])]
+               if x != t.pad_row)
+    assert len(kept) == 4
+    out = sample_hop(t.neighbors, t.cum_weights,
+                     jnp.asarray(np.repeat(row0, 400), jnp.int32), 2,
+                     jax.random.key(3), uniform=True)
+    assert set(np.asarray(out).tolist()) <= kept
+
+
+def test_from_arrays_uniform_rows_stat_and_recompute():
+    """uniform_rows rides the stats dict; when absent (old bench
+    caches) from_arrays recomputes it from the tables."""
+    from euler_tpu.parallel import DeviceNeighborTable
+
+    g, _ = _unweighted_ring()
+    t = DeviceNeighborTable(g, cap=4, keep_host=True)
+    nbr, cum = t.host_tables
+    t2 = DeviceNeighborTable.from_arrays(
+        nbr, cum, stats={"uniform_rows": t.uniform_rows})
+    assert t2.uniform_rows is True
+    t3 = DeviceNeighborTable.from_arrays(nbr, cum)   # stat missing
+    assert t3.uniform_rows is True
+    gw, _ = _weighted_ring()
+    tw = DeviceNeighborTable(gw, cap=4, keep_host=True)
+    nw, cw = tw.host_tables
+    assert DeviceNeighborTable.from_arrays(nw, cw).uniform_rows is False
+
+
+def test_device_sampled_graphsage_uniform_trains():
+    """Model-level wiring: uniform_sampling=True (the one-gather path on
+    an unweighted citation set) trains to the same quality bar as the
+    weighted-path estimator test above it."""
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.dataset.base_dataset import synthetic_citation
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.models import DeviceSampledGraphSage
+    from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+
+    data = synthetic_citation("t", n=300, d=16, num_classes=3,
+                              train_per_class=30, val=40, test=60, seed=2)
+    g = data.engine
+    store = DeviceFeatureStore(g, ["feature"], label_fid="label",
+                               label_dim=data.num_classes)
+    sampler = DeviceNeighborTable(g, cap=16)
+    assert sampler.uniform_rows
+    est = NodeEstimator(
+        DeviceSampledGraphSage(num_classes=data.num_classes,
+                               multilabel=False, dim=16, fanouts=(4, 4),
+                               uniform_sampling=True),
+        dict(batch_size=32, learning_rate=0.01, steps_per_loop=3,
+             label_dim=data.num_classes, log_steps=1000,
+             checkpoint_steps=0),
+        g, FanoutDataFlow(g, [4, 4]), label_fid="label",
+        label_dim=data.num_classes, feature_store=store,
+        device_sampler=sampler)
+    res = est.train(est.train_input_fn, max_steps=60)
+    assert res["global_step"] == 60
+    ev = est.evaluate(est.eval_input_fn, 10)
+    assert ev["metric"] > 0.55, ev
+
+
 def test_device_sampled_graphsage_trains():
     """Root-rows-only batches through NodeEstimator(device_sampler=...)
     + DeviceSampledGraphSage learn on a small citation set, including

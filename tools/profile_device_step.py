@@ -331,6 +331,68 @@ def main():
         measure("sample_only_unif_rbg_ms", scanned(sampu), nbr, roots,
                 reps=args.reps)
 
+        # ---- the pick itself: on-chip, take_along_axis over [n, C]
+        # rows lowers to an n·count-element gather — element-count-bound
+        # like the retired flat pick. Candidate replacement: a masked
+        # sum over the C lanes, (row · (iota == col)).sum(-1) — pure
+        # fused VPU work on data the row gather already staged, no
+        # gather at all. Ids ride f32 exactly (N < 2^24).
+        def _pick_onehot(row, col):
+            C = row.shape[1]
+            iota = jnp.arange(C, dtype=jnp.int32)
+            ind = iota[None, None, :] == col[:, :, None]   # [n, k, C]
+            return (row[:, None, :].astype(jnp.float32)
+                    * ind).sum(-1).astype(jnp.int32)       # [n, k]
+
+        def _hop_unif_oh(nbr, r, k, count):
+            row = jnp.take(nbr, r, axis=0)
+            pad = nbr.shape[0] - 1
+            deg = (row != pad).sum(-1).astype(jnp.float32)
+            u = jax.random.uniform(k, (r.shape[0], count))
+            col = jnp.minimum((u * deg[:, None]).astype(jnp.int32),
+                              jnp.maximum(deg[:, None].astype(jnp.int32)
+                                          - 1, 0))
+            return _pick_onehot(row, col)
+
+        def hop2u_oh(c, i, seed, nbr, r1):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            return _hop_unif_oh(nbr, perturb(r1, i, seed), k, k2_).sum()
+
+        measure("sample_hop2_unif_onehot_ms", scanned(hop2u_oh), nbr,
+                rows_all[1], reps=args.reps)
+
+        # weighted path, same pick swap: cum+nbr gathers stay, only
+        # take_along_axis is replaced (compare with sample_hop2_ms)
+        def hop2_oh(c, i, seed, nbr, cum, r1):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            r = perturb(r1, i, seed)
+            C = nbr.shape[1]
+            cumr = jnp.take(cum, r, axis=0)
+            total = cumr[:, -1]
+            u = jax.random.uniform(k, (r.shape[0], k2_)) * total[:, None]
+            col = (cumr[:, None, :] <= u[:, :, None]).sum(-1)
+            col = jnp.clip(col, 0, C - 1).astype(jnp.int32)
+            row = jnp.take(nbr, r, axis=0)
+            return _pick_onehot(row, col).sum()
+
+        measure("sample_hop2_onehot_ms", scanned(hop2_oh), nbr, cum,
+                rows_all[1], reps=args.reps)
+
+        # end-to-end 2-hop fanout, uniform + onehot pick (the full
+        # candidate sampling path; compare with sample_only_ms)
+        def sampu_oh(c, i, seed, nbr, roots):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            cur = roots
+            tot = jnp.float32(0)
+            for kk in fanouts:
+                k, sub = jax.random.split(k)
+                cur = _hop_unif_oh(nbr, cur, sub, kk).reshape(-1)
+                tot = tot + cur.sum().astype(jnp.float32)
+            return tot
+
+        measure("sample_only_unif_onehot_ms", scanned(sampu_oh), nbr,
+                roots, reps=args.reps)
+
     # ---- feature gathers ----------------------------------------------
     if want("gather"):
         def mk_gather(post=None):

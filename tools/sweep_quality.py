@@ -131,7 +131,12 @@ def main():
     if out_path.exists():
         results = json.loads(out_path.read_text())
     for target, (script, ds, grid) in SWEEPS.items():
-        if args.only and args.only not in target:
+        # exact match first: substring-only made `--only act_cache`
+        # silently widen to citeseer_act_cache when that target landed
+        # (code-review r5); substring stays as a fallback for patterns
+        # that match no target exactly
+        if args.only and args.only != target \
+                and (args.only in SWEEPS or args.only not in target):
             continue
         for cfg in grid:
             key = f"{target}:" + (",".join(
