@@ -144,8 +144,24 @@ def _uniform_effective(args, sampler) -> bool:
     """Resolve the --uniform_path tri-state against the table: default
     (None) auto-enables on unit-weight tables (the one-gather sampling
     path, round-5 on-chip win); forcing it ON over a weighted table is
-    refused — it would silently change the sampling distribution."""
-    if sampler is None or getattr(sampler, "fused", False):
+    refused — it would silently change the sampling distribution.
+    Forcing it ON when the path can't apply at all (--fused_sampler /
+    --host_sampler / --alias_sampler) is refused the same way: a
+    silently-recorded uniform_path=False would mislabel the A/B leg
+    (advisor r5)."""
+    if sampler is None or getattr(sampler, "fused", False) \
+            or getattr(sampler, "alias", False):
+        if args.uniform_path:
+            # explicit force on an inapplicable config: refuse rather
+            # than silently record uniform_path=False on the artifact
+            reason = "--host_sampler leaves no device table" \
+                if sampler is None else (
+                    "--fused_sampler keeps the weighted fused draw"
+                    if getattr(sampler, "fused", False)
+                    else "--alias_sampler selects the alias draw")
+            print(f"bench: --uniform_path forced but inapplicable "
+                  f"({reason}) — drop one of the flags", file=sys.stderr)
+            sys.exit(2)
         return False
     detected = bool(getattr(sampler, "uniform_rows", False))
     if args.uniform_path is None:
@@ -156,6 +172,24 @@ def _uniform_effective(args, sampler) -> bool:
               "not match the table's weights", file=sys.stderr)
         sys.exit(2)
     return bool(args.uniform_path)
+
+
+def _sampler_variant(args, sampler, has_uniform_path: bool = True) -> str:
+    """The draw algorithm the measured run actually used — recorded in
+    detail JSON so A/B leg artifacts are self-describing (the 'sampler'
+    key only says host/device/device_fused). has_uniform_path=False for
+    modes whose draw never consults the uniform lever (layerwise's pool
+    draw) — recording 'uniform' there would mislabel the artifact."""
+    if sampler is None:
+        return "host"
+    if getattr(sampler, "fused", False):
+        return "fused"
+    if getattr(sampler, "alias", False):
+        return "alias"
+    if not has_uniform_path:
+        return "inverse_cdf"
+    return "uniform" if _uniform_effective(args, sampler) \
+        else "inverse_cdf"
 
 
 class _CachedGraph:
@@ -193,6 +227,9 @@ def setup_tables(args, n_nodes, avg_degree, feat_dim, num_classes,
     # walk models (DeviceSampledSkipGram → walk_rows) read the split
     # nbr/cum tables; the fused layout only serves the fanout path
     fused = args.fused_sampler and not args.walk and not args.layerwise
+    # the alias draw serves all three families (fanout/walk/layerwise);
+    # conflicts vs fused/host are refused up front in run_bench
+    alias = bool(args.alias_sampler)
     if args.fused_sampler and args.walk:
         print("bench: --fused_sampler ignored in --walk mode "
               "(walk_rows reads the split tables)", file=sys.stderr)
@@ -230,7 +267,7 @@ def setup_tables(args, n_nodes, avg_degree, feat_dim, num_classes,
                 nbr_h, cum_h, feat_h, label_h)
         sampler = None if args.host_sampler else \
             DeviceNeighborTable.from_arrays(nbr_h, cum_h, stats=stats,
-                                            fused=fused)
+                                            fused=fused, alias=alias)
         store = DeviceFeatureStore.from_arrays(
             feat_h.astype(np.dtype(dt), copy=False), label_h,
             pad_dim_to=128 if pad_features else None,
@@ -244,7 +281,8 @@ def setup_tables(args, n_nodes, avg_degree, feat_dim, num_classes,
     data = build_products_like(n_nodes, avg_degree, feat_dim, num_classes)
     graph = data.engine
     sampler = None if args.host_sampler else DeviceNeighborTable(
-        graph, cap=args.cap, keep_host=use_cache, fused=fused)
+        graph, cap=args.cap, keep_host=use_cache, fused=fused,
+        alias=alias)
     if pad_features:
         print("bench: --pad_features applies only to cache-served runs; "
               "rebuild path stores the raw dim", file=sys.stderr)
@@ -363,6 +401,8 @@ def run_walk_bench(args, graph, sampler, cache_state, setup_secs,
             "sampler": "host" if sampler is None else (
                 "device_fused" if getattr(sampler, "fused", False)
                 else "device"),
+            "sampler_variant": _sampler_variant(args, sampler),
+            "alias_sampler": bool(args.alias_sampler),
             "degree_sorted": bool(args.degree_sorted
                                   and cache_state == "hit"),
             "uniform_path": _uniform_effective(args, sampler),
@@ -434,6 +474,9 @@ def run_layerwise_bench(args, graph, store, sampler, cache_state,
             "steps_per_sec": round(done / dt, 2),
             "final_loss": res["loss"],
             "sampler": "device",
+            "sampler_variant": _sampler_variant(args, sampler,
+                                                has_uniform_path=False),
+            "alias_sampler": bool(args.alias_sampler),
             "degree_sorted": bool(args.degree_sorted
                                   and cache_state == "hit"),
             "steps_per_loop": spl,
@@ -460,6 +503,36 @@ def _make_to_dev(est):
 
 def run_bench(args):
     import jax
+
+    # --alias_sampler conflicts fail BEFORE any table build: a leg that
+    # silently dropped the flag would be mislabeled in the sweep
+    if args.alias_sampler:
+        if args.host_sampler:
+            print("bench: --alias_sampler needs the device sampler "
+                  "(incompatible with --host_sampler)", file=sys.stderr)
+            sys.exit(2)
+        if args.fused_sampler:
+            print("bench: --alias_sampler needs the split nbr/cum "
+                  "layout (incompatible with --fused_sampler — the "
+                  "fused [N+1, 2C] table has no alias words)",
+                  file=sys.stderr)
+            sys.exit(2)
+        if args.uniform_path:
+            print("bench: --alias_sampler and --uniform_path select "
+                  "different draw algorithms — run them as separate "
+                  "A/B legs", file=sys.stderr)
+            sys.exit(2)
+    # a forced --uniform_path on a config with no uniform path must die
+    # HERE, not at detail-record time after the measured run completed
+    # (the in-_uniform_effective refusal is the backstop for tools that
+    # bypass run_bench)
+    if args.uniform_path and (args.host_sampler or args.fused_sampler
+                              or args.layerwise):
+        which = "--host_sampler" if args.host_sampler else (
+            "--fused_sampler" if args.fused_sampler else "--layerwise")
+        print(f"bench: --uniform_path forced but inapplicable with "
+              f"{which} — drop one of the flags", file=sys.stderr)
+        sys.exit(2)
 
     # If the accelerator fell through to CPU, run smoke-sized shapes —
     # a full-size CPU run would outlast the driver's patience and lose
@@ -625,6 +698,7 @@ def run_bench(args):
             "sampler": "host" if sampler is None else (
                 "device_fused" if getattr(sampler, "fused", False)
                 else "device"),
+            "sampler_variant": _sampler_variant(args, sampler),
             "feat_dim_stored": store.dim,
             "feat_table_dtype": str(store.features.dtype),
             "degree_sorted": bool(args.degree_sorted
@@ -635,6 +709,7 @@ def run_bench(args):
             # historical measurement (advisor r4)
             "int8_features": bool(args.int8_features),
             "fused_sampler": bool(args.fused_sampler),
+            "alias_sampler": bool(args.alias_sampler),
             "pad_features": bool(args.pad_features),
             "act_cache": bool(args.act_cache),
             "remat": bool(args.remat),
@@ -686,6 +761,17 @@ def build_argparser():
                     help="fused [N+1, 2C] sampling table: one row gather "
                          "per hop (candidate headline config — excluded "
                          "from the BENCH_TPU cache until proven)")
+    ap.add_argument("--alias_sampler", action="store_true", default=False,
+                    help="O(1) Vose alias-method neighbor draws over a "
+                         "packed [N+1, C] int32 alias table (one extra "
+                         "row gather per hop replaces the cum-row "
+                         "gather, no C-wide inverse-CDF scan per draw — "
+                         "the reference's alias_method.h moved on "
+                         "device). Applies to fanout, --walk and "
+                         "--layerwise; incompatible with "
+                         "--fused_sampler / --host_sampler / a forced "
+                         "--uniform_path (candidate config, excluded "
+                         "from the cache gate)")
     ap.add_argument("--degree_sorted", action="store_true", default=False,
                     help="permute table rows hub-first (gather-locality "
                          "A/B; cache-served runs only)")
@@ -806,6 +892,7 @@ def main(argv=None):
                           and not args.layerwise
                           and not args.host_sampler and not args.fp32
                           and not args.fused_sampler
+                          and not args.alias_sampler
                           and not args.pad_features
                           and not args.act_cache
                           and not args.remat

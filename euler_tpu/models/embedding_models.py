@@ -103,10 +103,13 @@ class DeviceSampledSkipGram(nn.Module):
         kw, kn = jax.random.split(key)
         tg = make_table_gather(self.table_mesh) \
             if is_model_sharded(self.table_mesh) else None
+        atab = batch.get("alias_table") if tg is None else None
         walks = walk_rows(batch["nbr_table"], batch["cum_table"], roots,
                           self.walk_len, kw, p=self.p, q=self.q,
                           gather=tg,
-                          uniform=self.uniform_sampling and tg is None)
+                          uniform=self.uniform_sampling and tg is None
+                          and atab is None,
+                          alias_table=atab)
         pairs = gen_pair_rows(walks, self.left_win, self.right_win)
         flat = pairs.reshape(-1, 2)                    # [B*P, 2]
         src_r, pos_r = flat[:, 0], flat[:, 1]

@@ -152,11 +152,17 @@ class DeviceSampledGraphSage(SuperviseModel):
                                             gather=gather if sharded
                                             else None)
         else:
+            # alias_table in the batch (DeviceNeighborTable(alias=True))
+            # selects the O(1) alias draw; it subsumes the uniform
+            # shortcut, so presence wins over uniform_sampling
+            atab = batch.get("alias_table") if not sharded else None
             rows = sample_fanout_rows(
                 batch["nbr_table"], batch["cum_table"],
                 roots, tuple(self.fanouts), key,
                 gather=gather if sharded else None,
-                uniform=self.uniform_sampling and not sharded)
+                uniform=(self.uniform_sampling and not sharded
+                         and atab is None),
+                alias_table=atab)
         if self.encoder not in ("sage", "gcn", "genie"):
             raise ValueError(
                 f"DeviceSampledGraphSage.encoder must be 'sage', 'gcn' "
@@ -221,10 +227,12 @@ class DeviceSampledScalableSage(SuperviseModel):
             nbr = sample_hop_fused(batch["nbrcum_table"], roots,
                                    int(self.fanout), key, tg)
         else:
+            atab = batch.get("alias_table") if tg is None else None
             nbr = sample_hop(batch["nbr_table"], batch["cum_table"],
                              roots, int(self.fanout), key, tg,
                              uniform=self.uniform_sampling
-                             and tg is None)
+                             and tg is None and atab is None,
+                             alias_table=atab)
         x, nbr_x = gather_feature_rows(batch, [roots, nbr], gather=gather)
         if self.encoder == "gcn":
             from euler_tpu.utils.encoders import ScalableGCNEncoder
@@ -373,7 +381,8 @@ class DeviceSampledLayerwiseGCN(SuperviseModel):
         key = jax.random.fold_in(jax.random.key(31), batch["sample_seed"])
         levels, adjs = sample_layerwise_rows(
             batch["nbr_table"], batch["cum_table"], roots,
-            tuple(self.layer_sizes), key)
+            tuple(self.layer_sizes), key,
+            alias_table=batch.get("alias_table"))
         layers = gather_feature_rows(batch, levels)
         return LayerEncoder(self.dim, dropout=self.layer_dropout,
                             name="encoder")(layers, adjs)
@@ -427,11 +436,13 @@ class DeviceSampledUnsupervisedSage(nn.Module):
                                             tuple(self.fanouts), kf,
                                             gather=tg)
         else:
-            unif = self.uniform_sampling and tg is None
+            atab = batch.get("alias_table") if tg is None else None
+            unif = self.uniform_sampling and tg is None and atab is None
             rows = sample_fanout_rows(batch["nbr_table"],
                                       batch["cum_table"],
                                       roots, tuple(self.fanouts), kf,
-                                      gather=tg, uniform=unif)
+                                      gather=tg, uniform=unif,
+                                      alias_table=atab)
         layers = gather_feature_rows(batch, rows, gather=gather)
         emb = SageEncoder(self.dim, tuple(self.fanouts), self.aggregator,
                           concat=False, name="encoder")(layers)   # [B, D]
@@ -441,7 +452,8 @@ class DeviceSampledUnsupervisedSage(nn.Module):
             pos_r = sample_hop(batch["nbr_table"], batch["cum_table"],
                                roots, 1, kp, gather=tg,
                                uniform=self.uniform_sampling
-                               and tg is None)                    # [B]
+                               and tg is None and atab is None,
+                               alias_table=atab)                  # [B]
         negs_r = sample_global_rows(batch["neg_rows"], batch["neg_cum"],
                                     kn, (roots.shape[0], self.num_negs))
         ctx = Embedding(self.num_rows + 1, self.dim, name="ctx_emb")

@@ -12,6 +12,7 @@ from euler_tpu.parallel.sharded_embedding import (  # noqa: F401
 )
 from euler_tpu.parallel.device_sampler import (  # noqa: F401
     DeviceNeighborTable,
+    build_alias_tables,
     fuse_tables,
     make_table_gather,
     sample_fanout_rows,

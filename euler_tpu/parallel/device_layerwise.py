@@ -48,17 +48,28 @@ import jax
 import jax.numpy as jnp
 
 
-from euler_tpu.parallel.device_sampler import slot_weights  # noqa: E402
+from euler_tpu.parallel.device_sampler import (  # noqa: E402
+    _alias_pick, slot_weights,
+)
 
 
 def sample_layerwise_rows(nbr_table: jax.Array, cum_table: jax.Array,
                           roots: jax.Array, layer_sizes: Sequence[int],
-                          key):
+                          key, alias_table=None):
     """roots [B] int32 → (levels, adjs): levels[l] is an int32 row array
     (level 0 = roots, level l+1 = level l ++ pool of layer_sizes[l]);
     adjs[l] is the row-normalized dense [n_l, n_{l+1}] adjacency of
     Â = A + I restricted to the pools — exactly the batch geometry
-    LayerwiseDataFlow produces and LayerEncoder consumes."""
+    LayerwiseDataFlow produces and LayerEncoder consumes.
+
+    alias_table (DeviceNeighborTable(alias=True)): the pool draw
+    becomes two-stage — frontier node ∝ its total incident weight (an
+    inverse-CDF over n_frontier row totals instead of n_frontier·C
+    slots), then the O(1) alias draw inside the chosen row. P(node) ·
+    P(slot|node) = (W_i/ΣW)·(w_ij/W_i) = w_ij/ΣW: distribution-
+    identical to the flat slot draw, with the cumsum/searchsorted
+    shrunk C×. The adjacency build is unchanged (it needs the raw slot
+    weights either way)."""
     levels = [roots]
     adjs = []
     cur = roots
@@ -75,12 +86,31 @@ def sample_layerwise_rows(nbr_table: jax.Array, cum_table: jax.Array,
         # never hit while any real slot exists — without top-k's
         # shortfall when fewer than m positive slots exist
         nbr_f = nbr[-n_frontier:]
-        flat_cum = jnp.cumsum(w[-n_frontier:].reshape(-1))
-        total = flat_cum[-1]
-        u = jax.random.uniform(kg, (int(m),)) * total
-        idx = jnp.searchsorted(flat_cum, u, side="right")
-        idx = jnp.minimum(idx, flat_cum.shape[0] - 1).astype(jnp.int32)
-        pool = jnp.take(nbr_f.reshape(-1), idx)         # [m]
+        if alias_table is not None:
+            cur_f = cur[-n_frontier:]
+            tot_cum = jnp.cumsum(w[-n_frontier:].sum(-1))   # [nf]
+            u = jax.random.uniform(kg, (int(m),)) * tot_cum[-1]
+            idx = jnp.searchsorted(tot_cum, u, side="right")
+            idx = jnp.minimum(idx,
+                              tot_cum.shape[0] - 1).astype(jnp.int32)
+            arow = jnp.take(alias_table, jnp.take(cur_f, idx),
+                            axis=0)                         # [m, C]
+            key, ka = jax.random.split(key)
+            ua = jax.random.uniform(ka, (2, int(m), 1))
+            col, deg = _alias_pick(arow, ua[0], ua[1])      # [m, 1]
+            pool = jnp.take_along_axis(jnp.take(nbr_f, idx, axis=0),
+                                       col, axis=1)[:, 0]   # [m]
+            # zero-total frontier rows carry no draw mass; if the WHOLE
+            # frontier is dead every draw resolves to pad explicitly
+            pool = jnp.where(deg > 0, pool, nbr_table.shape[0] - 1)
+        else:
+            flat_cum = jnp.cumsum(w[-n_frontier:].reshape(-1))
+            total = flat_cum[-1]
+            u = jax.random.uniform(kg, (int(m),)) * total
+            idx = jnp.searchsorted(flat_cum, u, side="right")
+            idx = jnp.minimum(idx,
+                              flat_cum.shape[0] - 1).astype(jnp.int32)
+            pool = jnp.take(nbr_f.reshape(-1), idx)         # [m]
         nxt = jnp.concatenate([cur, pool])              # [n + m]
         n_frontier = int(m)
         # dense Â = A + I between cur and nxt, row-normalized

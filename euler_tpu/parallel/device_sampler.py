@@ -48,15 +48,26 @@ class DeviceNeighborTable:
     convention) so the same int32 rows index features, labels, and
     adjacency. Row N (= pad_row) is an all-pad row: sampling from it
     yields pad_row again, mirroring the host sampler's default_id pads.
+
+    alias=True additionally builds the per-row Vose alias table
+    (build_alias_tables): one packed int32 word per slot, enabling the
+    O(1) alias draw in sample_hop(alias_table=...) — the device
+    transpose of the reference's euler/common/alias_method.h. Replicated
+    split tables only (raises with fused/shard_rows): the alias draw
+    derives per-row degree from the words themselves and pad from the
+    table shape, neither of which survives the fused bitcast layout or
+    the row-sharded shape padding.
     """
 
     def __init__(self, graph, cap: int = 32, edge_types=None,
                  seed: int = 0,
                  mesh: Optional[jax.sharding.Mesh] = None,
                  keep_host: bool = False, shard_rows: bool = False,
-                 fused: bool = False):
+                 fused: bool = False, alias: bool = False):
         self.shard_rows = bool(shard_rows)
         self.fused = bool(fused)
+        self.alias = bool(alias)
+        _check_alias_layout(self.alias, self.fused, self.shard_rows)
         ids = graph.all_node_ids()
         n = len(ids)
         self.cap = int(cap)
@@ -67,39 +78,59 @@ class DeviceNeighborTable:
         nbr_rows = graph.node_rows(nbrs, missing=n).astype(np.int32)
         del nbrs
         ws = ws.astype(np.float32)
-        nbr_tab, cum = self._build_tables(n, deg, nbr_rows, ws, seed)
+        nbr_tab, cum, alias_tab = self._build_tables(
+            n, deg, nbr_rows, ws, seed)
         # host copies are opt-in (cache writers like bench): pinning them
         # by default would double host RAM for every training caller
         self.host_tables = (nbr_tab, cum) if keep_host else None
-        self._place(nbr_tab, cum, mesh)
+        self._place(nbr_tab, cum, mesh, alias_tab)
 
     @classmethod
     def from_arrays(cls, nbr_tab: np.ndarray, cum_tab: np.ndarray,
                     stats: Optional[dict] = None,
                     mesh: Optional[jax.sharding.Mesh] = None,
-                    shard_rows: bool = False, fused: bool = False):
+                    shard_rows: bool = False, fused: bool = False,
+                    alias: bool = False):
         """Rehydrate from prebuilt [N+1, C] tables (e.g. a bench/dataset
-        cache) without a live graph engine."""
+        cache) without a live graph engine. alias=True rebuilds the
+        alias table from the cum rows (chunked — caches carry only
+        nbr/cum)."""
         self = cls.__new__(cls)
         self.shard_rows = bool(shard_rows)
         self.fused = bool(fused)
+        self.alias = bool(alias)
+        _check_alias_layout(self.alias, self.fused, self.shard_rows)
         self.cap = int(nbr_tab.shape[1])
         self.pad_row = int(nbr_tab.shape[0]) - 1
         for k in ("hub_frac", "edge_keep_frac", "max_degree"):
             setattr(self, k, (stats or {}).get(k))
         # caches written before the round-5 uniform lever carry no
         # uniform_rows stat — recompute from the tables (the slot
-        # weights are exactly recoverable from the inclusive cumsum)
+        # weights are exactly recoverable from the inclusive cumsum).
+        # Chunked: a full-table astype + diff would hold two ~3.5GB
+        # transients at products scale (advisor r5)
         u = (stats or {}).get("uniform_rows")
         if u is None:
-            w = np.diff(cum_tab.astype(np.float32), axis=1,
-                        prepend=np.zeros((cum_tab.shape[0], 1),
-                                         np.float32))
-            u = _detect_uniform_rows(np.asarray(nbr_tab), w)
+            u = True
+            pad = self.pad_row
+            for lo in range(0, cum_tab.shape[0], _CHUNK_ROWS):
+                cc = np.asarray(cum_tab[lo:lo + _CHUNK_ROWS]) \
+                    .astype(np.float32, copy=False)
+                w = np.diff(cc, axis=1,
+                            prepend=np.zeros((cc.shape[0], 1),
+                                             np.float32))
+                if not _detect_uniform_rows(
+                        np.asarray(nbr_tab[lo:lo + _CHUNK_ROWS]), w,
+                        pad=pad):
+                    u = False
+                    break
         self.uniform_rows = bool(u)
         self.host_tables = None
+        alias_tab = build_alias_tables(
+            np.asarray(nbr_tab), cum_tab=np.asarray(cum_tab)) \
+            if self.alias else None
         self._place(np.ascontiguousarray(nbr_tab),
-                    np.ascontiguousarray(cum_tab), mesh)
+                    np.ascontiguousarray(cum_tab), mesh, alias_tab)
         return self
 
     def _build_tables(self, n, deg, nbr_rows, ws, seed):
@@ -172,10 +203,14 @@ class DeviceNeighborTable:
         self.max_degree = int(deg.max()) if n else 0
         self.uniform_rows = _detect_uniform_rows(nbr_tab, w_tab)
 
+        # alias table built from the exact slot weights BEFORE they are
+        # folded into the cumsum (no f32 diff round-trip on this path)
+        alias_tab = build_alias_tables(nbr_tab, w_tab=w_tab) \
+            if getattr(self, "alias", False) else None
         cum = np.cumsum(w_tab, axis=1, dtype=np.float32)
-        return nbr_tab, cum
+        return nbr_tab, cum, alias_tab
 
-    def _place(self, nbr_tab, cum, mesh):
+    def _place(self, nbr_tab, cum, mesh, alias_tab=None):
         from euler_tpu.parallel.placement import (
             put_replicated, put_row_sharded,
         )
@@ -203,16 +238,22 @@ class DeviceNeighborTable:
         else:
             self.neighbors = put_replicated(nbr_tab, mesh)
             self.cum_weights = put_replicated(cum, mesh)
+        self.alias_table = put_replicated(alias_tab, mesh) \
+            if alias_tab is not None else None
 
     @property
     def tables(self):
         """Arrays to merge into the estimator's static_batch."""
         if getattr(self, "fused", False):
             return {"nbrcum_table": self.fused_table}
-        return {"nbr_table": self.neighbors, "cum_table": self.cum_weights}
+        out = {"nbr_table": self.neighbors, "cum_table": self.cum_weights}
+        if getattr(self, "alias_table", None) is not None:
+            out["alias_table"] = self.alias_table
+        return out
 
 
-def _detect_uniform_rows(nbr_tab: np.ndarray, w_tab: np.ndarray) -> bool:
+def _detect_uniform_rows(nbr_tab: np.ndarray, w_tab: np.ndarray,
+                         pad: Optional[int] = None) -> bool:
     """True iff every row's positive-weight slots carry ONE equal weight
     and the positive slots are exactly the non-pad slots — the unweighted
     -graph case (cora/pubmed/ogbn-products and the bench graph all build
@@ -221,13 +262,192 @@ def _detect_uniform_rows(nbr_tab: np.ndarray, w_tab: np.ndarray) -> bool:
     degree, and sample_hop(uniform=True) may skip the cum-row gather
     entirely. Any weighted row (or an edge whose endpoint was missing
     and mapped to pad while keeping weight) clears the flag — a false
-    positive would silently change the sampling distribution."""
-    pad = nbr_tab.shape[0] - 1
+    positive would silently change the sampling distribution.
+
+    The non-pad slots must additionally be FRONT-PACKED (contiguous in
+    columns [0, deg)): the uniform draw's col = floor(u·deg) only ever
+    reads that prefix, so an externally built from_arrays table with an
+    interior pad slot would otherwise pass detection and silently sample
+    pad rows while skipping real neighbors (advisor r5). Every in-repo
+    builder front-packs; this guards the public rehydrate API.
+
+    pad: the pad row id — pass it when nbr_tab is a ROW CHUNK of a
+    larger table (from_arrays' chunked recompute), where shape[0] - 1
+    is not the pad id. Chunk-wise conjunction is exact: every condition
+    here is row-local."""
+    if pad is None:
+        pad = nbr_tab.shape[0] - 1
+    C = nbr_tab.shape[1]
+    nonpad = nbr_tab != pad
     pos = w_tab > 0
-    if not (pos == (nbr_tab != pad)).all():
+    if not (pos == nonpad).all():
+        return False
+    deg = nonpad.sum(axis=1)
+    if not (nonpad == (np.arange(C) < deg[:, None])).all():
         return False
     rmax = w_tab.max(axis=1, keepdims=True)
     return bool(((w_tab == 0) | (w_tab == rmax)).all())
+
+
+# Row-chunk size for table-scale host passes: bounds transients to
+# chunk-sized arrays instead of full-table copies (~3.5GB at products
+# scale, advisor r5). The uniform recompute holds ~2 f32 arrays per
+# chunk; the Vose build holds ~8 f64/i64 working arrays per chunk, so
+# it chunks finer to stay under one full-table f32 copy at any scale
+# (the products-scale memory smoke pins this).
+_CHUNK_ROWS = 262_144
+_ALIAS_CHUNK_ROWS = 32_768
+
+# Packed alias word layout (one int32 per slot; the layout contract for
+# build_alias_tables and _alias_pick):
+#   bits 16..30: alias column index (C <= 255 → 8 bits used)
+#   bits  0..15: acceptance probability, quantized to uint16
+#                (P(keep) = prob / 65535 — exact at 0 and 1)
+# Pad/inactive slots and dead rows (total weight <= 0) hold -1: the
+# sign bit doubles as the sentinel, so the device derives per-row
+# active-column count as (word >= 0).sum(-1). Max packed value is
+# 254<<16 | 65535 = 2^24 - 65537 < 2^24, so words always ride an f32
+# lane exactly and _pick_cols' masked lane-sum applies unconditionally.
+ALIAS_SENTINEL = np.int32(-1)
+_ALIAS_PROB_MAX = 65535
+
+
+def _check_alias_layout(alias: bool, fused: bool, shard_rows: bool):
+    if alias and fused:
+        raise ValueError(
+            "DeviceNeighborTable(alias=True) needs the split nbr/cum "
+            "layout — the fused [N+1, 2C] table has no slot for the "
+            "alias words. Build with fused=False.")
+    if alias and shard_rows:
+        raise ValueError(
+            "DeviceNeighborTable(alias=True) supports replicated tables "
+            "only: the alias draw derives pad from the table shape, "
+            "which row-sharding pads to the model-axis multiple. Use "
+            "the weighted inverse-CDF path with row-sharded tables.")
+
+
+def _vose_rows(w: np.ndarray, active: np.ndarray) -> np.ndarray:
+    """Vectorized per-row Vose alias construction.
+
+    w [R, C] float slot weights, active [R, C] bool (the columns the
+    draw can land on: col0 = floor(u·K) with K = active.sum(row)) →
+    packed int32 words [R, C] (layout above). Rows whose active weight
+    totals <= 0 come back all-sentinel — the draw side resolves them to
+    pad (the zero-degree convention).
+
+    Two-pointer robin hood over per-row sorted scaled probabilities:
+    each iteration finalizes exactly one column per live row (a small
+    against the current large, a depleted large against the next one,
+    or the terminal column), so the loop runs at most C+1 times with
+    O(R) work per step — O(R·C) overall, no per-row Python loop."""
+    R, C = w.shape
+    out = np.full((R, C), ALIAS_SENTINEL, dtype=np.int32)
+    if R == 0:
+        return out
+    w = np.where(active, w, 0.0).astype(np.float64)
+    K = active.sum(axis=1).astype(np.int64)                 # [R]
+    W = w.sum(axis=1)                                       # [R]
+    live = (K > 0) & (W > 0)
+    if not live.any():
+        return out
+    with np.errstate(invalid="ignore", divide="ignore"):
+        p = w * (K[:, None] / W[:, None])                   # target 1.0
+    # inactive columns sort to the far right and are never entered
+    # (l starts at K-1); dead rows are skipped entirely
+    p = np.where(active & live[:, None], p, np.inf)
+    order = np.argsort(p, axis=1, kind="stable")            # ascending
+    p_ord = np.take_along_axis(p, order, axis=1)            # [R, C]
+    prob = np.ones((R, C))          # final prob, by sorted position
+    alias = order.copy()            # final alias TARGET COLUMN, ditto
+    s = np.zeros(R, dtype=np.int64)                 # next small (left)
+    l = np.maximum(K - 1, 0)                        # current large
+    rem = np.take_along_axis(p_ord, l[:, None], axis=1)[:, 0]
+    done = ~live
+    for _ in range(C + 1):
+        a = np.flatnonzero(~done)
+        if a.size == 0:
+            break
+        fin = s[a] >= l[a]
+        f = a[fin]
+        if f.size:
+            # terminal column: mass conservation leaves rem ≈ 1 here
+            prob[f, l[f]] = np.clip(rem[f], 0.0, 1.0)
+            done[f] = True
+        r = a[~fin]
+        if r.size:
+            sm = rem[r] >= 1.0
+            rs = r[sm]          # finalize the next small against l
+            if rs.size:
+                ps = p_ord[rs, s[rs]]
+                prob[rs, s[rs]] = np.clip(ps, 0.0, 1.0)
+                alias[rs, s[rs]] = order[rs, l[rs]]
+                rem[rs] += ps - 1.0
+                s[rs] += 1
+            rd = r[~sm]         # current large depleted: it becomes a
+            if rd.size:         # small, finalized against the next one
+                prob[rd, l[rd]] = np.clip(rem[rd], 0.0, 1.0)
+                alias[rd, l[rd]] = order[rd, l[rd] - 1]
+                l[rd] -= 1
+                rem[rd] = p_ord[rd, l[rd]] + rem[rd] - 1.0
+    q = np.rint(prob * _ALIAS_PROB_MAX).astype(np.int64)
+    words = (alias.astype(np.int64) << 16) | q
+    # scatter back from sorted position to actual column, live active
+    # slots only — everything else keeps the sentinel
+    keep = live[:, None] & (np.arange(C)[None, :] < K[:, None])
+    ri, pi = np.nonzero(keep)
+    out[ri, order[ri, pi]] = words[ri, pi].astype(np.int32)
+    return out
+
+
+def build_alias_tables(nbr_tab: np.ndarray,
+                       cum_tab: Optional[np.ndarray] = None,
+                       w_tab: Optional[np.ndarray] = None,
+                       chunk_rows: int = _ALIAS_CHUNK_ROWS) -> np.ndarray:
+    """[N+1, C] neighbor table (+ slot weights, given directly or as the
+    inclusive cumsum) → [N+1, C] packed int32 alias table (word layout
+    at ALIAS_SENTINEL above) — the device transpose of the reference's
+    euler/common/alias_method.h, built once per table like the
+    CompactWeightedCollection cum rows.
+
+    Per row the active draw columns are the front-packed non-pad prefix
+    [0, deg) when the row IS front-packed, else all C columns (pad slots
+    then carry prob 0 and alias into a real slot) — either way the
+    device-side count of non-sentinel words equals the builder's K, so
+    col = floor(u·K) is always in range and never skips a real slot,
+    even for externally built from_arrays tables with interior pads.
+
+    Chunked over rows: peak transient is O(chunk_rows · C) floats, never
+    a full-table f32 copy (the products-scale memory contract, pinned by
+    the slow alias-build smoke)."""
+    if (cum_tab is None) == (w_tab is None):
+        raise ValueError(
+            "build_alias_tables needs exactly one of cum_tab / w_tab")
+    n_rows, C = nbr_tab.shape
+    if C > 255:
+        raise ValueError(
+            f"alias words pack the column index into 8 bits — cap C "
+            f"must be <= 255, got {C}")
+    pad = n_rows - 1
+    out = np.empty((n_rows, C), dtype=np.int32)
+    cols = np.arange(C)
+    for lo in range(0, n_rows, max(int(chunk_rows), 1)):
+        hi = min(lo + max(int(chunk_rows), 1), n_rows)
+        nb = np.asarray(nbr_tab[lo:hi])
+        if w_tab is not None:
+            w = np.asarray(w_tab[lo:hi]).astype(np.float32, copy=False)
+        else:
+            cc = np.asarray(cum_tab[lo:hi]).astype(np.float32,
+                                                   copy=False)
+            w = np.diff(cc, axis=1,
+                        prepend=np.zeros((cc.shape[0], 1), np.float32))
+        nonpad = nb != pad
+        deg = nonpad.sum(axis=1)
+        front = (nonpad == (cols < deg[:, None])).all(axis=1)
+        # front-packed rows draw over their [0, deg) prefix; any other
+        # layout falls back to all-C columns with zero-weight pads
+        active = np.where(front[:, None], cols < deg[:, None], True)
+        out[lo:hi] = _vose_rows(w, active)
+    return out
 
 
 def _pick_cols(row: jax.Array, col: jax.Array, exact_f32: bool):
@@ -386,9 +606,40 @@ def slot_weights(cum_rows: jax.Array) -> jax.Array:
                     prepend=jnp.zeros_like(cum_rows[:, :1]))
 
 
+def _alias_pick(alias_rows: jax.Array, u1: jax.Array, u2: jax.Array):
+    """alias_rows [n, C] packed words, u1/u2 [n, k] uniforms →
+    (col [n, k] int32, deg [n] int32): the O(1) alias draw.
+
+    col0 = floor(u1·deg) over the row's active columns (deg = count of
+    non-sentinel words — C compares on data the row gather already
+    staged, the same trick the uniform path uses for pad counting),
+    then ONE word read decides: keep col0 with P = prob/65535, else
+    jump to the packed alias column. No [n, k, C] f32 broadcast-compare
+    and no per-draw dependence on C — the inverse-CDF's cum-row scan is
+    what the round-5 profile fingered inside the 90ms hop-2 draw. The
+    word read uses _pick_cols' masked lane-sum (packed words always fit
+    f32 exactly — see the layout note at ALIAS_SENTINEL).
+
+    Dead rows (all-sentinel: pad row, zero-degree, zero-total-weight)
+    come back with deg = 0 and col = 0 — callers resolve them to the
+    pad row."""
+    C = alias_rows.shape[1]
+    deg = (alias_rows >= 0).sum(-1).astype(jnp.int32)          # [n]
+    col0 = jnp.minimum(
+        (u1 * deg[:, None].astype(jnp.float32)).astype(jnp.int32),
+        jnp.maximum(deg[:, None] - 1, 0))                      # [n, k]
+    word = _pick_cols(alias_rows, col0, True)                  # [n, k]
+    prob = jnp.bitwise_and(word, _ALIAS_PROB_MAX)
+    ali = jnp.right_shift(word, 16)                # arithmetic: -1 → -1
+    keep = u2 * float(_ALIAS_PROB_MAX) < prob.astype(jnp.float32)
+    col = jnp.where(keep, col0, ali)
+    return jnp.clip(col, 0, C - 1).astype(jnp.int32), deg
+
+
 def sample_hop(nbr_table: jax.Array, cum_table: jax.Array,
                rows: jax.Array, count: int, key,
-               gather=None, uniform: bool = False) -> jax.Array:
+               gather=None, uniform: bool = False,
+               alias_table=None) -> jax.Array:
     """One weighted neighbor draw per (row, slot): [n] → [n * count].
 
     Inverse-CDF over each row's C inclusive cumulative weights — the
@@ -418,11 +669,49 @@ def sample_hop(nbr_table: jax.Array, cum_table: jax.Array,
     the row count up to the model-axis multiple, so pad cannot be
     derived from shape there (walk_rows has the same constraint).
 
+    alias_table (DeviceNeighborTable(alias=True) /
+    build_alias_tables): the Vose alias draw — O(1) per draw via one
+    packed-word read instead of the C-wide inverse-CDF scan, at the
+    same gather element count (the alias row gather replaces the
+    cum-row gather). Distribution-identical to the inverse-CDF draw up
+    to the uint16 prob quantization (< 1e-5 per slot; chi-squared
+    pinned in tests), NOT draw-for-draw (different u consumption).
+    Composes with the count-aware pick on both sides (row pick for
+    count >= 4, flat pick for the walk family's count = 1 chains).
+    Replicated split tables only, and exclusive with uniform=True —
+    callers resolve precedence explicitly.
+
     gather (make_table_gather) routes table reads for row-sharded
     tables; that path always has the full rows and picks locally."""
     C = nbr_table.shape[1]
     n = rows.shape[0]
     exact = nbr_table.shape[0] <= (1 << 24)  # ids ride f32 exactly
+    if alias_table is not None:
+        if gather is not None:
+            raise ValueError(
+                "sample_hop(alias_table=...) supports replicated tables "
+                "only: the alias draw resolves dead rows to the pad id "
+                "derived from the table shape, which row-sharding pads "
+                "to the model-axis multiple. Use the weighted path "
+                "(alias_table=None) with row-sharded tables.")
+        if uniform:
+            raise ValueError(
+                "sample_hop: uniform=True and alias_table are exclusive "
+                "— resolve the precedence at the call site (the alias "
+                "draw already covers unit-weight tables)")
+        arow = jnp.take(alias_table, rows, axis=0)     # [n, C]
+        u = jax.random.uniform(key, (2, n, count))
+        col, deg = _alias_pick(arow, u[0], u[1])
+        pad = nbr_table.shape[0] - 1
+        if count < 4:
+            flat = rows[:, None] * C + col             # [n, k]
+            out = jnp.take(nbr_table.reshape(-1),
+                           flat.reshape(-1)).reshape(n, count)
+        else:
+            nbr = jnp.take(nbr_table, rows, axis=0)    # [n, C]
+            out = _pick_cols(nbr, col, exact)
+        # dead rows (zero degree / zero total weight) resolve to pad
+        return jnp.where(deg[:, None] > 0, out, pad).reshape(-1)
     if uniform:
         if gather is not None:
             raise ValueError(
@@ -459,17 +748,18 @@ def sample_hop(nbr_table: jax.Array, cum_table: jax.Array,
 
 def sample_fanout_rows(nbr_table: jax.Array, cum_table: jax.Array,
                        roots: jax.Array, fanouts: Sequence[int], key,
-                       gather=None, uniform: bool = False):
+                       gather=None, uniform: bool = False,
+                       alias_table=None):
     """Multi-hop on-device fanout: returns [roots, hop1, hop2, ...] row
     arrays (layer h has roots.shape[0] * prod(fanouts[:h]) entries) —
     the shape contract of FanoutDataFlow, produced without touching the
-    host. uniform=True → the one-gather unit-weight path per hop (see
-    sample_hop)."""
+    host. uniform=True → the one-gather unit-weight path per hop;
+    alias_table → the O(1) alias draw per hop (see sample_hop)."""
     layers = [roots]
     cur = roots
     for k in fanouts:
         key, sub = jax.random.split(key)
         cur = sample_hop(nbr_table, cum_table, cur, int(k), sub, gather,
-                         uniform=uniform)
+                         uniform=uniform, alias_table=alias_table)
         layers.append(cur)
     return layers

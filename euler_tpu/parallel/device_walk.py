@@ -79,21 +79,26 @@ def sample_global_rows(pool_rows: jax.Array, pool_cum: jax.Array,
 def walk_rows(nbr_table: jax.Array, cum_table: jax.Array,
               roots: jax.Array, walk_len: int, key,
               p: float = 1.0, q: float = 1.0,
-              gather=None, uniform: bool = False) -> jax.Array:
+              gather=None, uniform: bool = False,
+              alias_table=None) -> jax.Array:
     """[B] roots → [B, walk_len+1] row walks, column 0 = roots.
 
     p == q == 1: each step is one weighted neighbor draw (sample_hop);
     uniform=True routes those draws through the one-gather unit-weight
-    path (DeviceNeighborTable.uniform_rows tables, replicated only).
-    Otherwise node2vec second-order bias: candidate weights are scaled
-    1/p when returning to the previous node, 1 when the candidate is a
-    kept neighbor of the previous node, 1/q otherwise — computed over
-    the capped rows with C x C equality compares, no host round-trip
-    (the biased path always reads the cum table: the bias math needs
-    raw slot weights, so uniform is ignored there).
+    path (DeviceNeighborTable.uniform_rows tables, replicated only);
+    alias_table routes them through the O(1) alias draw — the walk
+    family's chained count=1 draws are where the per-draw constant
+    matters most, and the flat neighbor pick stays. Otherwise node2vec
+    second-order bias: candidate weights are scaled 1/p when returning
+    to the previous node, 1 when the candidate is a kept neighbor of
+    the previous node, 1/q otherwise — computed over the capped rows
+    with C x C equality compares, no host round-trip (the biased path
+    always reads the cum table: the bias math needs raw slot weights,
+    so uniform/alias are ignored there).
     """
     C = nbr_table.shape[1]
-    unif = uniform and gather is None
+    unif = uniform and gather is None and alias_table is None
+    atab = alias_table if gather is None else None
 
     def take(tab, r):
         return gather(tab, r) if gather is not None else \
@@ -102,14 +107,14 @@ def walk_rows(nbr_table: jax.Array, cum_table: jax.Array,
     cols = [roots]
     key, sub = jax.random.split(key)
     cur = sample_hop(nbr_table, cum_table, roots, 1, sub, gather,
-                     uniform=unif)
+                     uniform=unif, alias_table=atab)
     cols.append(cur)
     prev = roots
     for _ in range(walk_len - 1):
         key, sub = jax.random.split(key)
         if p == 1.0 and q == 1.0:
             nxt = sample_hop(nbr_table, cum_table, cur, 1, sub, gather,
-                             uniform=unif)
+                             uniform=unif, alias_table=atab)
         else:
             cand = take(nbr_table, cur)                     # [B, C]
             w = slot_weights(take(cum_table, cur))          # [B, C]

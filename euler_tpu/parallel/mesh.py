@@ -50,7 +50,8 @@ def replicated(mesh: Mesh) -> NamedSharding:
 # data — replicated by default in shard_batch unless the caller already
 # placed them (e.g. row-sharded over 'model' via put_row_sharded)
 REPLICATED_TABLE_KEYS = ("feature_table", "feature_scale", "label_table",
-                         "nbr_table", "cum_table", "nbrcum_table")
+                         "nbr_table", "cum_table", "nbrcum_table",
+                         "alias_table")
 
 
 def shard_batch(batch: Dict, mesh: Mesh,

@@ -6,10 +6,20 @@ jax.config route switches the platform reliably (backend selection happens
 at first device query, which hasn't run yet at conftest import).
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.4.34 has no jax_num_cpu_devices): the XLA flag
+    # route still works because the backend initializes at the first
+    # device query, which hasn't run at conftest import time
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8")
 
 import numpy as np
 import pytest

@@ -32,9 +32,13 @@ def test_bench_smoke_graphsage_device_and_host():
     assert dev["detail"]["feat_table_dtype"] == "int8"
     assert dev["value"] > 0
     assert dev["detail"]["sampler"] == "device"
+    # the smoke graph is unweighted → the uniform path auto-enables and
+    # the artifact says which draw actually ran
+    assert dev["detail"]["sampler_variant"] == "uniform"
     assert 0.0 <= dev["detail"]["edge_keep_frac"] <= 1.0
     host = _run(["--host_sampler"])
     assert host["detail"]["sampler"] == "host"
+    assert host["detail"]["sampler_variant"] == "host"
     assert host["value"] > 0
 
 
@@ -62,6 +66,31 @@ def test_bench_smoke_perf_lever_flags():
     assert off["value"] > 0
 
 
+def test_bench_smoke_alias_sampler():
+    """--alias_sampler: the round-6 O(1) alias-draw leg keeps the
+    one-JSON-line contract, records its variant in detail, and refuses
+    contradictory lever combinations (a silently-dropped flag would
+    mislabel the window's A/B artifacts)."""
+    out = _run(["--alias_sampler"])
+    assert out["detail"]["sampler"] == "device"
+    assert out["detail"]["sampler_variant"] == "alias"
+    assert out["detail"]["alias_sampler"] is True
+    assert out["detail"]["uniform_path"] is False
+    assert out["value"] > 0
+    for flags in (["--alias_sampler", "--fused_sampler"],
+                  ["--alias_sampler", "--host_sampler"],
+                  ["--alias_sampler", "--uniform_path"],
+                  ["--uniform_path", "--fused_sampler"],
+                  ["--uniform_path", "--host_sampler"],
+                  ["--uniform_path", "--layerwise"]):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "bench.py"), "--smoke"] + flags,
+            capture_output=True, text=True, timeout=420, cwd=str(REPO),
+            env={"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/tmp",
+                 "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 2, (flags, proc.stderr[-800:])
+
+
 def test_bench_argparser_defaults_contract():
     """Tools (infer_knn_products) derive their config from
     build_argparser(); the tuned round-4 defaults must live there."""
@@ -71,6 +100,7 @@ def test_bench_argparser_defaults_contract():
     d = bench.build_argparser().parse_args([])
     assert d.int8_features is True      # round-4 on-TPU A/B winner
     assert d.fused_sampler is False     # measured regression — not flipped
+    assert d.alias_sampler is False     # round-6 candidate — A/B leg only
     assert d.cap == 32 and d.steps_per_loop == 0
     # resolved TPU default: 32 since the round-5 on-chip A/B (28.81M vs
     # 28.27M at 16); the flag default stays 0 so the canonical-refresh
@@ -82,6 +112,9 @@ def test_bench_smoke_layerwise_mode():
     out = _run(["--layerwise"])
     assert out["metric"] == "layerwise_train_pool_nodes_per_sec_per_chip"
     assert out["detail"]["sampler"] == "device"
+    # layerwise's pool draw has no uniform lever: the artifact must say
+    # the inverse-CDF draw ran, even on a unit-weight table
+    assert out["detail"]["sampler_variant"] == "inverse_cdf"
     assert out["value"] > 0
 
 

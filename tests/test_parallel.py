@@ -1826,3 +1826,284 @@ def test_sample_hop_count_aware_pick_bit_parity():
                        (rows[:, None] * C + col).reshape(-1))
         assert (out == ref).all()
         assert out.shape == (300 * count,)
+
+
+# ---------------------------------------------------------------------------
+# Alias-method sampling (round-6 tentpole): O(1) weighted draws over the
+# packed [N+1, C] int32 alias table — distribution-identical to the
+# inverse-CDF draw, with pad/dead rows resolving to pad_row.
+# ---------------------------------------------------------------------------
+def _chi2(counts, expected_probs, total):
+    obs = np.asarray(counts, np.float64)
+    exp = np.asarray(expected_probs, np.float64) * total
+    return float(((obs - exp) ** 2 / exp).sum())
+
+
+def test_alias_table_layout_and_sentinels():
+    """Packed-word contract: pad row and pad slots hold the -1
+    sentinel; active slots hold alias-in-range words; the device-side
+    active count (word >= 0) equals the row degree."""
+    from euler_tpu.parallel import DeviceNeighborTable
+
+    g, ids = _weighted_ring()
+    t = DeviceNeighborTable(g, cap=4, alias=True)
+    tab = np.asarray(t.alias_table)
+    assert tab.shape == (t.pad_row + 1, 4) and tab.dtype == np.int32
+    assert (tab[-1] == -1).all()                   # pad row all-sentinel
+    nbr = np.asarray(t.neighbors)
+    deg = (nbr != t.pad_row).sum(axis=1)
+    np.testing.assert_array_equal((tab >= 0).sum(axis=1), deg)
+    act = tab[tab >= 0]
+    ali, prob = act >> 16, act & 0xFFFF
+    assert (0 <= ali).all() and (ali < 4).all()
+    assert (0 <= prob).all() and (prob <= 65535).all()
+
+
+def test_alias_matches_inverse_cdf_marginals():
+    """Chi-squared: the alias draw reproduces the inverse-CDF draw's
+    marginal distribution on weighted tables, on BOTH sides of the
+    count-aware pick split (count=1 flat pick, count>=4 row pick)."""
+    from euler_tpu.parallel import DeviceNeighborTable, sample_hop
+
+    # 2-neighbor rows, weights 1 vs 3 → expected [0.25, 0.75]
+    g, ids = _weighted_ring()
+    t = DeviceNeighborTable(g, cap=4, alias=True)
+    rows = g.node_rows(ids)
+    roots = jnp.asarray(np.repeat(rows[:1], 8000), jnp.int32)
+    out = np.asarray(sample_hop(t.neighbors, t.cum_weights, roots, 1,
+                                jax.random.key(0),
+                                alias_table=t.alias_table))
+    r1, r2 = int(rows[1]), int(rows[2])
+    n1, n2 = (out == r1).sum(), (out == r2).sum()
+    assert n1 + n2 == 8000                        # only true neighbors
+    assert _chi2([n1, n2], [0.25, 0.75], 8000) < 10.83   # df=1, p=.001
+
+    # 5-way weighted star, count=4 → the row-gather pick side
+    w = np.array([1, 2, 3, 4, 6], np.float32)
+    gs = _star_graph(5, w)
+    ts = DeviceNeighborTable(gs, cap=6, alias=True)
+    sat = gs.node_rows(np.arange(1, 6, dtype=np.uint64))
+    out4 = np.asarray(sample_hop(
+        ts.neighbors, ts.cum_weights, jnp.zeros(4000, jnp.int32), 4,
+        jax.random.key(1), alias_table=ts.alias_table))
+    counts = [(out4 == int(r)).sum() for r in sat]
+    assert sum(counts) == 16000
+    assert _chi2(counts, w / w.sum(), 16000) < 18.47     # df=4, p=.001
+
+    # and the inverse-CDF draw on the same table agrees cell-for-cell
+    ref = np.asarray(sample_hop(
+        ts.neighbors, ts.cum_weights, jnp.zeros(4000, jnp.int32), 4,
+        jax.random.key(2)))
+    ref_counts = [(ref == int(r)).sum() for r in sat]
+    for a, b in zip(counts, ref_counts):
+        assert abs(a - b) < 6 * np.sqrt(max(b, 1)) + 30
+
+
+def test_alias_zero_degree_and_dead_rows_pad():
+    """Pad/zero-degree rows resolve to pad on the alias path, including
+    a zero-TOTAL-weight row that still carries neighbor ids (the corner
+    the all-sentinel convention pins down)."""
+    from euler_tpu.graph import GraphBuilder
+    from euler_tpu.parallel import DeviceNeighborTable, sample_hop
+
+    b = GraphBuilder()
+    b.add_nodes(np.arange(5, dtype=np.uint64))
+    # node 0 → {1, 2} with zero weights (dead-with-neighbors);
+    # node 1 → 2 (normal); nodes 2..4 isolated
+    b.add_edges(np.array([0, 0, 1], np.uint64),
+                np.array([1, 2, 2], np.uint64),
+                weights=np.array([0, 0, 1], np.float32))
+    g = b.finalize()
+    t = DeviceNeighborTable(g, cap=3, alias=True)
+    iso = g.node_rows(np.array([3], np.uint64))
+    dead = g.node_rows(np.array([0], np.uint64))
+    for r, count in ((int(iso[0]), 4), (int(dead[0]), 4),
+                     (t.pad_row, 2)):
+        out = sample_hop(t.neighbors, t.cum_weights,
+                         jnp.full(16, r, jnp.int32), count,
+                         jax.random.key(0), alias_table=t.alias_table)
+        assert set(np.asarray(out).tolist()) == {t.pad_row}, r
+
+
+def test_alias_hub_draws_from_capped_subset():
+    """degree > cap: alias draws stay inside the kept C-subset, like
+    every other draw path."""
+    from euler_tpu.parallel import DeviceNeighborTable, sample_hop
+
+    g = _star_graph(64, np.ones(64, np.float32))
+    t = DeviceNeighborTable(g, cap=8, alias=True)
+    kept = set(int(x) for x in np.asarray(t.neighbors)[0]
+               if x != t.pad_row)
+    assert len(kept) == 8
+    out = sample_hop(t.neighbors, t.cum_weights,
+                     jnp.zeros(500, jnp.int32), 2, jax.random.key(3),
+                     alias_table=t.alias_table)
+    assert set(np.asarray(out).tolist()) <= kept
+
+
+def test_alias_layout_rejections():
+    """alias needs the replicated split layout; uniform and alias are
+    exclusive at the sample_hop level."""
+    from euler_tpu.parallel import (
+        DeviceNeighborTable, make_mesh, make_table_gather, sample_hop,
+    )
+
+    g, _ = _weighted_ring()
+    with pytest.raises(ValueError, match="split"):
+        DeviceNeighborTable(g, cap=4, alias=True, fused=True)
+    mesh = make_mesh(model_parallel=2)
+    with pytest.raises(ValueError, match="replicated"):
+        DeviceNeighborTable(g, cap=4, alias=True, mesh=mesh,
+                            shard_rows=True)
+    t = DeviceNeighborTable(g, cap=4, alias=True)
+    rows = jnp.zeros(4, jnp.int32)
+    with pytest.raises(ValueError, match="replicated"):
+        sample_hop(t.neighbors, t.cum_weights, rows, 2,
+                   jax.random.key(0), gather=make_table_gather(mesh),
+                   alias_table=t.alias_table)
+    with pytest.raises(ValueError, match="exclusive"):
+        sample_hop(t.neighbors, t.cum_weights, rows, 2,
+                   jax.random.key(0), uniform=True,
+                   alias_table=t.alias_table)
+
+
+def test_from_arrays_interior_pad_rejected_for_uniform():
+    """Advisor r5: an externally built table whose non-pad slots are
+    NOT front-packed must fail uniform detection — col = floor(u·deg)
+    would sample the interior pad and skip the real neighbor beyond
+    it."""
+    from euler_tpu.parallel import DeviceNeighborTable
+
+    N, C = 6, 4
+    nbr = np.full((N + 1, C), N, np.int32)
+    w = np.zeros((N + 1, C), np.float32)
+    nbr[0, 0], nbr[0, 2] = 1, 2          # interior pad at slot 1
+    w[0, 0], w[0, 2] = 1.0, 1.0          # unit weights otherwise
+    nbr[1, :2] = [2, 3]
+    w[1, :2] = 1.0
+    cum = np.cumsum(w, axis=1, dtype=np.float32)
+    assert DeviceNeighborTable.from_arrays(nbr, cum).uniform_rows \
+        is False
+    # the same table front-packed still detects uniform
+    nbr2 = nbr.copy()
+    nbr2[0, :2], nbr2[0, 2] = [1, 2], N
+    cum2 = np.cumsum(np.where(nbr2 != N, 1.0, 0.0),
+                     axis=1, dtype=np.float32)
+    assert DeviceNeighborTable.from_arrays(nbr2, cum2).uniform_rows \
+        is True
+
+
+def test_from_arrays_alias_and_chunked_recompute(monkeypatch):
+    """from_arrays(alias=True) rebuilds the alias table from the cum
+    rows (the bench-cache path), and the chunked uniform recompute is
+    chunk-size invariant (advisor r5: products scale must not hold
+    full-table transients)."""
+    from euler_tpu.parallel import DeviceNeighborTable, sample_hop
+    from euler_tpu.parallel import device_sampler
+
+    g, ids = _weighted_ring()
+    t = DeviceNeighborTable(g, cap=4, keep_host=True)
+    nbr, cum = t.host_tables
+    monkeypatch.setattr(device_sampler, "_CHUNK_ROWS", 3)
+    t2 = DeviceNeighborTable.from_arrays(nbr, cum, alias=True)
+    assert t2.uniform_rows is False       # multi-chunk recompute path
+    assert "alias_table" in t2.tables
+    rows = g.node_rows(ids)
+    roots = jnp.asarray(np.repeat(rows[:1], 6000), jnp.int32)
+    out = np.asarray(sample_hop(t2.neighbors, t2.cum_weights, roots, 1,
+                                jax.random.key(1),
+                                alias_table=t2.alias_table))
+    r1, r2 = int(rows[1]), int(rows[2])
+    n1, n2 = (out == r1).sum(), (out == r2).sum()
+    assert n1 + n2 == 6000
+    assert 2.5 < n2 / max(n1, 1) < 3.6    # weights 1 vs 3
+    gu, _ = _unweighted_ring()
+    tu = DeviceNeighborTable(gu, cap=4, keep_host=True)
+    nu, cu = tu.host_tables
+    assert DeviceNeighborTable.from_arrays(nu, cu).uniform_rows is True
+
+
+def test_walk_rows_alias_stays_on_graph_and_dead_ends():
+    """walk_rows(alias_table=...): every step lands on a true
+    out-neighbor; dead ends stick at pad — the chained count=1 flat
+    pick composes with the alias draw."""
+    from euler_tpu.parallel import DeviceNeighborTable, walk_rows
+
+    g, ids = _weighted_ring(12)
+    t = DeviceNeighborTable(g, cap=4, alias=True)
+    rows = g.node_rows(ids)
+    walks = np.asarray(walk_rows(t.neighbors, t.cum_weights,
+                                 jnp.asarray(rows, jnp.int32), 4,
+                                 jax.random.key(0),
+                                 alias_table=t.alias_table))
+    assert walks.shape == (12, 5)
+    id_of_row = {int(r): i for i, r in enumerate(rows)}
+    for b in range(12):
+        for s in range(4):
+            cur = id_of_row[int(walks[b, s])]
+            nxt = id_of_row[int(walks[b, s + 1])]
+            assert nxt in {(cur + 1) % 12, (cur + 2) % 12}
+
+    gs = _star_graph(3, np.ones(3, np.float32))
+    ts = DeviceNeighborTable(gs, cap=2, alias=True)
+    w2 = np.asarray(walk_rows(ts.neighbors, ts.cum_weights,
+                              jnp.zeros(4, jnp.int32), 3,
+                              jax.random.key(1),
+                              alias_table=ts.alias_table))
+    assert (w2[:, 2] == ts.pad_row).all()
+    assert (w2[:, 3] == ts.pad_row).all()
+
+
+def test_layerwise_alias_matches_flat_pool_distribution():
+    """The two-stage alias pool draw (node ∝ row total, then slot via
+    alias) reproduces the flat slot-weight draw's distribution:
+    P(slot) = w/ΣW either way."""
+    from euler_tpu.parallel import DeviceNeighborTable
+    from euler_tpu.parallel.device_layerwise import sample_layerwise_rows
+
+    g, ids = _weighted_ring()
+    t = DeviceNeighborTable(g, cap=4, alias=True)
+    rows = g.node_rows(ids)
+    roots = jnp.asarray(rows[:1], jnp.int32)
+    levels, adjs = sample_layerwise_rows(
+        t.neighbors, t.cum_weights, roots, (600,), jax.random.key(0),
+        alias_table=t.alias_table)
+    pool = np.asarray(levels[1][1:])          # level1 = roots ++ pool
+    r1, r2 = int(rows[1]), int(rows[2])
+    n1, n2 = (pool == r1).sum(), (pool == r2).sum()
+    assert n1 + n2 == 600                     # true neighbors only
+    assert _chi2([n1, n2], [0.25, 0.75], 600) < 10.83
+    assert adjs[0].shape == (1, 601)
+
+
+def test_device_sampled_graphsage_alias_trains():
+    """Model-level wiring: a DeviceNeighborTable(alias=True) sampler
+    routes DeviceSampledGraphSage through the alias draw (batch carries
+    alias_table via sampler.tables) and trains to the same quality bar
+    as the weighted/uniform estimator tests."""
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.dataset.base_dataset import synthetic_citation
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.models import DeviceSampledGraphSage
+    from euler_tpu.parallel import DeviceFeatureStore, DeviceNeighborTable
+
+    data = synthetic_citation("t", n=300, d=16, num_classes=3,
+                              train_per_class=30, val=40, test=60, seed=2)
+    g = data.engine
+    store = DeviceFeatureStore(g, ["feature"], label_fid="label",
+                               label_dim=data.num_classes)
+    sampler = DeviceNeighborTable(g, cap=16, alias=True)
+    assert "alias_table" in sampler.tables
+    est = NodeEstimator(
+        DeviceSampledGraphSage(num_classes=data.num_classes,
+                               multilabel=False, dim=16, fanouts=(4, 4)),
+        dict(batch_size=32, learning_rate=0.01, steps_per_loop=3,
+             label_dim=data.num_classes, log_steps=1000,
+             checkpoint_steps=0),
+        g, FanoutDataFlow(g, [4, 4]), label_fid="label",
+        label_dim=data.num_classes, feature_store=store,
+        device_sampler=sampler)
+    res = est.train(est.train_input_fn, max_steps=60)
+    assert res["global_step"] == 60
+    ev = est.evaluate(est.eval_input_fn, 10)
+    assert ev["metric"] > 0.55, ev

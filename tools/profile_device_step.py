@@ -256,6 +256,68 @@ def main():
                 reps=args.reps)
         del fused
 
+        # ---- round-6 tentpole: O(1) alias-method draws. The alias row
+        # gather matches the cum-row gather's element count (gathers are
+        # element-count-bound on this chip), but the per-draw work drops
+        # from a C-wide inverse-CDF scan to one packed-word read —
+        # compare sample_hop2_alias_ms against the pinned
+        # sample_hop2_flatpick_ms baseline and the live sample_hop2_ms.
+        from euler_tpu.parallel.device_sampler import build_alias_tables
+
+        alias_tab = jax.device_put(build_alias_tables(
+            np.asarray(nbr), cum_tab=np.asarray(cum)))
+
+        def hop2a(c, i, seed, nbr, cum, alias_tab, r1):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            return sample_hop(nbr, cum, perturb(r1, i, seed),
+                              fanouts[1], k, alias_table=alias_tab).sum()
+
+        measure("sample_hop2_alias_ms", scanned(hop2a), nbr, cum,
+                alias_tab, rows_all[1], reps=args.reps)
+
+        def sampa(c, i, seed, nbr, cum, alias_tab, roots):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            rows = sample_fanout_rows(nbr, cum, roots, fanouts, k,
+                                      alias_table=alias_tab)
+            return sum(r.sum() for r in rows)
+
+        measure("sample_only_alias_ms", scanned(sampa), nbr, cum,
+                alias_tab, roots, reps=args.reps)
+
+        # walk-chain A/B: the walk family's chained count=1 draws are
+        # where the O(1) constant compounds (walk_len sequential draws
+        # per step, each on the flat-pick side of the count-aware
+        # split). Same chain through the live weighted path vs alias.
+        WALK_CHAIN = 5
+
+        def wchain(c, i, seed, nbr, cum, roots):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            cur = perturb(roots, i, seed)
+            tot = jnp.float32(0)
+            for _ in range(WALK_CHAIN):
+                k, sub = jax.random.split(k)
+                cur = sample_hop(nbr, cum, cur, 1, sub)
+                tot = tot + cur.sum().astype(jnp.float32)
+            return tot
+
+        measure("walk_chain_ms", scanned(wchain), nbr, cum, roots,
+                reps=args.reps)
+
+        def wchain_a(c, i, seed, nbr, cum, alias_tab, roots):
+            k = jax.random.fold_in(jax.random.key(17), seed * 1000 + i)
+            cur = perturb(roots, i, seed)
+            tot = jnp.float32(0)
+            for _ in range(WALK_CHAIN):
+                k, sub = jax.random.split(k)
+                cur = sample_hop(nbr, cum, cur, 1, sub,
+                                 alias_table=alias_tab)
+                tot = tot + cur.sum().astype(jnp.float32)
+            return tot
+
+        measure("walk_chain_alias_ms", scanned(wchain_a), nbr, cum,
+                alias_tab, roots, reps=args.reps)
+        del alias_tab
+
         # ---- round-5 third-window candidates: RNG cost + uniform path.
         # The bench graph (and cora/pubmed/products) is UNWEIGHTED, so
         # per-row uniform weights make the cum-row gather removable: the

@@ -118,12 +118,18 @@ bench_stage cache       1200 --act_cache || exit 1
 bench_stage cache_tuned 1500 --act_cache --batch_size 131072 || exit 1
 # live A/B legs, one per open knob: uniform-path-off baseline (the
 # round-5 one-gather sampling lever, default auto-on for the
-# unit-weight bench table), int8-off baseline, fused sampler,
-# previous dispatch window (spl default flipped 16->32 in round 5),
-# degsort+pad layout stack. Legs settled by the round-5 window
-# (fused_bf16, separate degsort/pad, remat64k) are closed out in
-# PERF.md and no longer burn window time.
+# unit-weight bench table), the round-6 alias-method draw (O(1) per
+# draw over the packed alias table — A/B against the canonical
+# uniform-path leg AND the unif_off inverse-CDF leg; the profiler
+# stage below carries the matching sample_hop2_alias_ms /
+# walk_chain_alias_ms probes vs the pinned sample_hop2_flatpick_ms
+# baseline), int8-off baseline, fused sampler, previous dispatch
+# window (spl default flipped 16->32 in round 5), degsort+pad layout
+# stack. Legs settled by the round-5 window (fused_bf16, separate
+# degsort/pad, remat64k) are closed out in PERF.md and no longer burn
+# window time.
 bench_stage unif_off    1200 --no-uniform_path || exit 1
+bench_stage alias       1200 --alias_sampler || exit 1
 bench_stage bf16        1200 --no-int8_features || exit 1
 bench_stage fused       1200 --fused_sampler || exit 1
 bench_stage spl16       1200 --steps_per_loop 16 || exit 1
