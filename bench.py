@@ -410,6 +410,7 @@ def run_walk_bench(args, graph, sampler, cache_state, setup_secs,
             "graph_cache": cache_state,
             "setup_secs": round(setup_secs, 1),
             "cpu_fallback": cpu_fallback,
+            "health": _bench_health(graph, res),
         },
     }
 
@@ -483,8 +484,23 @@ def run_layerwise_bench(args, graph, store, sampler, cache_state,
             "graph_cache": cache_state,
             "setup_secs": round(setup_secs, 1),
             "cpu_fallback": cpu_fallback,
+            "health": _bench_health(graph, res),
         },
     }
+
+
+def _bench_health(graph, res=None):
+    """detail.health: the graph client's retry/degraded counters (None
+    for engines without a health() surface — embedded / _CachedGraph)
+    plus the train loop's nonfinite-skip count, so a perf artifact shows
+    whether the measured run degraded (a padded-batch or skipped-step
+    run is not comparable to a clean one)."""
+    h = getattr(graph, "health", None)
+    out = {"graph": h() if callable(h) else None}
+    if res is not None:
+        out["skipped_steps"] = int(res.get("skipped_steps", 0))
+        out["skipped_batches"] = int(res.get("skipped_batches", 0))
+    return out
 
 
 def _make_to_dev(est):
@@ -730,6 +746,7 @@ def run_bench(args):
             "graph_cache": cache_state,
             "setup_secs": round(setup_secs, 1),
             "cpu_fallback": cpu_fallback,
+            "health": _bench_health(graph, res),
         },
     }
 

@@ -31,9 +31,12 @@ from euler_tpu.utils import optimizers as opt_lib
 
 
 class TrainState(train_state.TrainState):
-    """TrainState + mutable variable collections (scalable-encoder caches)."""
+    """TrainState + mutable variable collections (scalable-encoder caches)
+    + the nonfinite-guard skip counter (device scalar so the guarded step
+    stays a single jitted dispatch)."""
 
     extra_vars: Dict[str, Any] = None
+    skipped_steps: Any = None
 
 
 def _to_device_tree(batch: Dict, max_id: int = 0) -> Dict:
@@ -53,6 +56,17 @@ def _to_device_tree(batch: Dict, max_id: int = 0) -> Dict:
 
 def _merged(batch: Dict, static_batch: Dict) -> Dict:
     return {**batch, **static_batch} if static_batch else batch
+
+
+def _last_finite(vals) -> float:
+    """Most recent finite scalar in `vals` (NaN when none): run
+    summaries report the last REAL loss, not a guard-skipped step's
+    NaN."""
+    for v in reversed(vals):
+        f = float(v)
+        if np.isfinite(f):
+            return f
+    return float("nan")
 
 
 def _match_placement(new_tree, like_tree):
@@ -105,6 +119,28 @@ class BaseEstimator:
         self.log_steps = int(self.params_cfg.get("log_steps", 20))
         self.ckpt_steps = int(self.params_cfg.get("checkpoint_steps", 1000))
         self.profiling = bool(self.params_cfg.get("profiling", False))
+        # nonfinite guard: a batch whose loss is NaN/Inf must not poison
+        # the donated params/opt_state — the step skips the update and
+        # counts it (see _make_one_step). Default ON; set
+        # nonfinite_guard=False to trade the (tiny) lax.cond for raw
+        # speed on trusted data.
+        self.nonfinite_guard = bool(
+            self.params_cfg.get("nonfinite_guard", True))
+        # resilient input path: transient input-pipeline failures (a
+        # flaky graph service) are retried with backoff; past the
+        # retries, up to skip_batch_budget batches may be abandoned
+        # (counted) before the error is treated as unrecoverable — at
+        # which point an emergency checkpoint is written and the error
+        # re-raises.
+        self.input_retries = int(self.params_cfg.get("input_retries", 3))
+        self.input_backoff_s = float(
+            self.params_cfg.get("input_backoff_s", 0.1))
+        self._skip_budget = int(
+            self.params_cfg.get("skip_batch_budget", 0))
+        self._input_factory = None
+        self.input_health: Dict[str, Any] = {
+            "input_failures": 0, "input_retries": 0, "skipped_batches": 0,
+            "emergency_checkpoint_step": None, "last_input_error": None}
         self.state: Optional[TrainState] = None
         self._train_step = None
         self._train_loop = None
@@ -128,6 +164,7 @@ class BaseEstimator:
         self.state = TrainState.create(
             apply_fn=self.model.apply, params=params, tx=self.tx,
             extra_vars=dict(variables),
+            skipped_steps=jnp.zeros((), jnp.int32),
         )
 
     def _make_one_step(self):
@@ -154,9 +191,31 @@ class BaseEstimator:
 
             (loss, (out, new_vars)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params)
-            state = state.apply_gradients(grads=grads)
-            if new_vars:
-                state = state.replace(extra_vars=dict(new_vars))
+
+            def apply_update(_):
+                s2 = state.apply_gradients(grads=grads)
+                if new_vars:
+                    s2 = s2.replace(extra_vars=dict(new_vars))
+                return s2
+
+            def skip_update(_):
+                # bad batch: keep params/opt_state/extra_vars, advance
+                # the step (so dropout rng / schedules move on) and
+                # count the skip — the donated buffers survive intact
+                return state.replace(
+                    step=state.step + 1,
+                    skipped_steps=state.skipped_steps + 1)
+
+            if self.nonfinite_guard and state.skipped_steps is not None:
+                # guard the GRADS too: overflow in the backward pass can
+                # yield NaN grads under a finite loss, which would poison
+                # the donated params with skipped_steps still reading 0
+                ok = jnp.isfinite(loss)
+                for g in jax.tree_util.tree_leaves(grads):
+                    ok &= jnp.all(jnp.isfinite(g))
+                state = jax.lax.cond(ok, apply_update, skip_update, None)
+            else:
+                state = apply_update(None)
             return state, loss, out.metric
 
         return one_step
@@ -220,7 +279,11 @@ class BaseEstimator:
 
         payload = {"params": self.state.params,
                    "opt_state": self.state.opt_state,
-                   "extra_vars": self.state.extra_vars or {}}
+                   "extra_vars": self.state.extra_vars or {},
+                   # persisted explicitly (not only as the checkpoint
+                   # label) so a resumed run restarts at the right step
+                   # instead of 0 and re-overwriting earlier checkpoints
+                   "step": int(self.state.step)}
         mgr.save(step, args=ocp.args.StandardSave(payload))
 
     def finalize_checkpoints(self) -> None:
@@ -240,19 +303,126 @@ class BaseEstimator:
         step = mgr.latest_step()
         payload = {"params": self.state.params,
                    "opt_state": self.state.opt_state,
-                   "extra_vars": self.state.extra_vars or {}}
-        restored = mgr.restore(step, args=ocp.args.StandardRestore(payload))
+                   "extra_vars": self.state.extra_vars or {},
+                   "step": int(self.state.step)}
+        try:
+            restored = mgr.restore(step,
+                                   args=ocp.args.StandardRestore(payload))
+        except Exception as first_err:
+            # pre-step-persisting checkpoint layout: retry without the
+            # step entry and fall back to the checkpoint label. If the
+            # legacy-layout retry ALSO fails, the checkpoint is broken
+            # for some other reason — re-raise the ORIGINAL error so the
+            # real diagnosis isn't masked by a missing-key complaint.
+            payload.pop("step")
+            try:
+                restored = mgr.restore(
+                    step, args=ocp.args.StandardRestore(payload))
+            except Exception:
+                raise first_err
+        resume_step = int(restored.get("step", step))
         self.state = self.state.replace(
             params=restored["params"], opt_state=restored["opt_state"],
+            step=jnp.asarray(resume_step, dtype=jnp.int32),
             extra_vars=_match_placement(restored.get("extra_vars") or {},
                                         self.state.extra_vars or {}))
-        return step
+        return resume_step
+
+    # -- resilient input path ----------------------------------------------
+    def _skipped_steps(self) -> int:
+        """Nonfinite-guard skip count from device state (0 pre-init)."""
+        if self.state is None or self.state.skipped_steps is None:
+            return 0
+        return int(jax.device_get(self.state.skipped_steps))
+
+    def health(self) -> Dict[str, Any]:
+        """Input-path + train-step degradation counters, merged with the
+        graph client's health() when the estimator's graph exposes one —
+        a single surface for 'did this run degrade?'."""
+        out = dict(self.input_health)
+        out["skipped_steps"] = self._skipped_steps()
+        graph_health = getattr(getattr(self, "graph", None), "health", None)
+        if callable(graph_health):
+            out["graph"] = graph_health()
+        return out
+
+    def _emergency_checkpoint(self, err: BaseException) -> None:
+        """Best-effort checkpoint before an unrecoverable input error
+        re-raises — the run dies, the progress doesn't. Never masks the
+        original error."""
+        if self.state is None:
+            return
+        step = int(self.state.step)
+        try:
+            self.save_checkpoint(step)
+            self.finalize_checkpoints()
+            if self.model_dir:
+                self.input_health["emergency_checkpoint_step"] = step
+                print(f"emergency checkpoint at step {step} before "
+                      f"re-raising input error: {err}", flush=True)
+        except Exception as ce:  # pragma: no cover - disk-full etc.
+            print(f"emergency checkpoint failed ({ce}); "
+                  f"re-raising original input error", flush=True)
+
+    def _next_input(self, it):
+        """next(it) with transient-failure retry (exponential backoff)
+        and the skip-batch budget. Returns (raw_batch, it) — the
+        iterator may have been recreated from the train input_fn after a
+        failure (a generator that raised is dead). StopIteration passes
+        through; an unrecoverable error checkpoints then re-raises.
+
+        Contract: retry/skip assumes input_fn() returns a STATELESS
+        (infinite random-sampler) stream — the estimator convention; all
+        built-in input_fns qualify — because recreation restarts the
+        stream. A finite deterministic stream would replay its head on
+        every recreation, so pass those as plain iterators instead: with
+        no factory every input failure is treated as unrecoverable
+        (emergency checkpoint + re-raise), never silently replayed."""
+        attempts = 0
+        while True:
+            try:
+                return next(it), it
+            except StopIteration:
+                raise
+            except Exception as e:
+                from euler_tpu.graph.remote import retryable_error
+
+                # retry needs a recreatable source: a generator that
+                # raised is dead (next() would yield StopIteration and
+                # silently END training) — without the input_fn factory
+                # every failure is unrecoverable
+                transient = (self._input_factory is not None
+                             and (retryable_error(e)
+                                  or isinstance(e, OSError)))
+                self.input_health["input_failures"] += 1
+                self.input_health["last_input_error"] = str(e)
+                if not transient:
+                    self._emergency_checkpoint(e)
+                    raise
+                if attempts < self.input_retries:
+                    attempts += 1
+                    self.input_health["input_retries"] += 1
+                    time.sleep(min(
+                        self.input_backoff_s * (2 ** (attempts - 1)), 2.0))
+                elif self._skip_budget > 0:
+                    # retries exhausted for this batch: abandon it and
+                    # move on (countable degraded event, not a job kill)
+                    self._skip_budget -= 1
+                    self.input_health["skipped_batches"] += 1
+                    attempts = 0
+                else:
+                    self._emergency_checkpoint(e)
+                    raise
+                if self._input_factory is not None:
+                    it = self._input_factory()  # the raised iter is dead
 
     # -- drivers -----------------------------------------------------------
     def train(self, input_fn: Callable[[], Iterator[Dict]],
               max_steps: int = 1000) -> Dict[str, float]:
         it = input_fn() if callable(input_fn) else input_fn
-        raw_first = _to_device_tree(next(it), self.max_id)
+        self._input_factory = input_fn if callable(input_fn) else None
+        raw0, it = self._next_input(it)
+        raw_first = _to_device_tree(raw0, self.max_id)
         first = _merged(raw_first, self.static_batch)
         if self.state is None:
             self._init_state(first)
@@ -278,8 +448,10 @@ class BaseEstimator:
             losses.append(loss)
             metrics.append(metric)
             if step % self.log_steps == 0:
-                lv = float(jnp.mean(jnp.stack(losses[-self.log_steps:])))
-                mv = float(jnp.mean(jnp.stack(metrics[-self.log_steps:])))
+                # nanmean: a guard-skipped step's NaN loss/metric must
+                # not turn the whole window's log line into nan
+                lv = float(jnp.nanmean(jnp.stack(losses[-self.log_steps:])))
+                mv = float(jnp.nanmean(jnp.stack(metrics[-self.log_steps:])))
                 now = time.time()
                 rate = self.log_steps / max(now - last_log, 1e-9)
                 last_log = now
@@ -289,7 +461,8 @@ class BaseEstimator:
                 self.save_checkpoint(step)
             if step < max_steps:
                 try:
-                    batch = _to_device_tree(next(it), self.max_id)
+                    raw, it = self._next_input(it)
+                    batch = _to_device_tree(raw, self.max_id)
                 except StopIteration:
                     break
         if self.ckpt_steps:
@@ -298,10 +471,16 @@ class BaseEstimator:
         if self.profiling and self.model_dir:
             jax.profiler.stop_trace()
         return {
-            "loss": float(losses[-1]) if losses else float("nan"),
-            "metric": float(jnp.mean(jnp.stack(metrics))) if metrics else 0.0,
+            # guard-skipped steps report NaN loss/metric; exclude them
+            # from the summary so one bad batch doesn't blank the run's
+            # headline numbers (the skip itself is in skipped_steps)
+            "loss": _last_finite(losses),
+            "metric": float(jnp.nanmean(jnp.stack(metrics)))
+            if metrics else 0.0,
             "steps_per_sec": (step - start_step) / max(time.time() - t0, 1e-9),
             "global_step": step,
+            "skipped_steps": self._skipped_steps(),
+            "skipped_batches": self.input_health["skipped_batches"],
         }
 
     def _run_looped(self, it, first: Dict, max_steps: int) -> Dict[str, float]:
@@ -328,7 +507,8 @@ class BaseEstimator:
             want = min(K, max_steps - step)
             while len(buf) < want and not exhausted:
                 try:
-                    buf.append(_to_device_tree(next(it), self.max_id))
+                    raw, it = self._next_input(it)
+                    buf.append(_to_device_tree(raw, self.max_id))
                 except StopIteration:
                     exhausted = True
             if not buf:
@@ -339,18 +519,26 @@ class BaseEstimator:
                 stacked = jax.tree_util.tree_map(stack, *buf)
                 self.state, l_arr, m_arr = self._train_loop(
                     self.state, stacked, self.static_batch)
-                loop_losses.append((jnp.mean(l_arr), K))
-                loop_metrics.append((jnp.mean(m_arr), K))
-                last_loss = l_arr[-1]
+                # nanmean / last-finite: guard-skipped steps inside the
+                # scanned window report NaN and must not poison the
+                # window aggregate or the reported final loss
+                loop_losses.append((jnp.nanmean(l_arr), K))
+                loop_metrics.append((jnp.nanmean(m_arr), K))
+                fin = np.asarray(l_arr)
+                fin = fin[np.isfinite(fin)]
+                if fin.size:
+                    last_loss = float(fin[-1])
                 done = K
             else:
                 # tail shorter than K: single-step dispatches (the jit
                 # was built in train() before this path was entered)
                 for b in buf:
-                    self.state, last_loss, m = self._train_step(
+                    self.state, l, m = self._train_step(
                         self.state, _merged(b, self.static_batch))
-                    loop_losses.append((last_loss, 1))
+                    loop_losses.append((l, 1))
                     loop_metrics.append((m, 1))
+                    if np.isfinite(float(l)):
+                        last_loss = float(l)
                 done = len(buf)
             prev = step
             step += done
@@ -373,11 +561,15 @@ class BaseEstimator:
         if self.profiling and self.model_dir:
             jax.profiler.stop_trace()
         # step-weighted mean so the reported train metric matches what
-        # the same run would report with steps_per_loop=1
+        # the same run would report with steps_per_loop=1; NaN entries
+        # (guard-skipped steps / all-skipped windows) drop out with
+        # their weight
         if loop_metrics:
             w = np.asarray([c for _, c in loop_metrics], np.float64)
             vals = np.asarray([float(v) for v, _ in loop_metrics])
-            metric = float(np.dot(vals, w / w.sum()))
+            keep = np.isfinite(vals)
+            metric = float(np.dot(vals[keep], w[keep] / w[keep].sum())) \
+                if keep.any() else float("nan")
         else:
             metric = 0.0
         return {
@@ -385,6 +577,8 @@ class BaseEstimator:
             "metric": metric,
             "steps_per_sec": (step - start_step) / max(time.time() - t0, 1e-9),
             "global_step": step,
+            "skipped_steps": self._skipped_steps(),
+            "skipped_batches": self.input_health["skipped_batches"],
         }
 
     def evaluate(self, input_fn, steps: int = 100) -> Dict[str, float]:

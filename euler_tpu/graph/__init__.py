@@ -7,4 +7,10 @@ from euler_tpu.graph.api import (  # noqa: F401
     GraphEngine,
     seed,
 )
-from euler_tpu.graph.remote import RemoteGraphEngine  # noqa: F401
+from euler_tpu.graph.chaos import ChaosGraphEngine, ChaosPlan  # noqa: F401
+from euler_tpu.graph.remote import (  # noqa: F401
+    RemoteGraphEngine,
+    RetryDeadlineExceeded,
+    RetryPolicy,
+    retryable_error,
+)

@@ -19,6 +19,9 @@ reference's whole design exists to amortize.
 
 from __future__ import annotations
 
+import dataclasses
+import random
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -27,6 +30,83 @@ import numpy as np
 from euler_tpu.core.lib import EngineError
 from euler_tpu.gql import Query
 
+# Error-text markers for failures worth retrying: transport-level faults
+# (a dead/restarting shard surfaces as "rpc to H:P failed after retries"
+# — the ONLY transport error string the C++ client emits from a query; a
+# chaos layer injects "chaos:"-prefixed transport errors; a thread-timed
+# attempt reports "timeout"). Deliberately NARROW: bare words like
+# "connect"/"send"/"recv" would misclassify semantic errors whose
+# message merely mentions them (e.g. a feature named "last_send_time"),
+# and with degrade=True a misclassified PERMANENT error would train on
+# padding forever. Semantic errors (parse failure, unknown feature)
+# never match — retrying those only re-fails.
+_TRANSPORT_MARKERS = (
+    "failed after retries",
+    "timeout",
+    "timed out",
+    "connection reset",
+    "reset by peer",
+    "connection refused",
+    "broken pipe",
+    "unavailable",
+    "chaos:",
+)
+
+
+def retryable_error(exc: BaseException) -> bool:
+    """True when the failure is transport-shaped (worth retrying against
+    the same or a re-resolved endpoint); False for semantic errors that
+    would fail identically on every attempt."""
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    if not isinstance(exc, EngineError):
+        return False
+    msg = str(exc).lower()
+    return any(m in msg for m in _TRANSPORT_MARKERS)
+
+
+class RetryDeadlineExceeded(EngineError):
+    """A retryable call ran out of its deadline/attempt budget. Carries
+    the last underlying error text; degrade-mode sampling queries catch
+    exactly this (semantic errors raise as plain EngineError at once)."""
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Backoff/deadline policy for remote graph calls.
+
+    deadline_s: total per-call budget across retries (0 → one attempt).
+    base_backoff_s / max_backoff_s: exponential backoff with FULL jitter —
+      sleep ~ U(0, min(max_backoff_s, base_backoff_s * 2^(attempt-1))),
+      the AWS-style decorrelation that avoids retry stampedes when every
+      trainer host sees the same shard die.
+    call_timeout_s: per-ATTEMPT bound. The graph-query RPC channels use
+      blocking sockets (long merges may stream for a while), so a black-
+      holed connection would otherwise hang forever; > 0 runs each
+      attempt on a worker thread and abandons it past the bound (the
+      abandoned attempt unblocks when its socket dies; close() reaps).
+      None/0 keeps the plain blocking call. Caveat: an abandoned attempt
+      still occupies an engine executor thread until its socket dies, so
+      during a SUSTAINED black-hole even non-timed calls may stall
+      behind a saturated executor — full recovery needs the dead
+      endpoint's connections to actually drop (they do when the shard
+      process restarts or the network heals with RST/FIN), after which
+      the parked attempts drain and the pool frees itself.
+    max_attempts: hard attempt cap inside the deadline (0 → unlimited).
+    """
+
+    deadline_s: float = 30.0
+    base_backoff_s: float = 0.05
+    max_backoff_s: float = 2.0
+    call_timeout_s: Optional[float] = None
+    max_attempts: int = 0
+
+    def backoff_s(self, attempt: int, rng: random.Random) -> float:
+        """Full-jitter backoff for retry `attempt` (1-based)."""
+        hi = min(self.max_backoff_s,
+                 self.base_backoff_s * (2 ** max(attempt - 1, 0)))
+        return rng.uniform(0.0, max(hi, 0.0))
+
 
 class RemoteGraphEngine:
     """GraphEngine-compatible batch sampling/feature API over a remote
@@ -34,35 +114,145 @@ class RemoteGraphEngine:
 
     def __init__(self, endpoints: str, seed: int = 0,
                  mode: str = "distribute",
-                 retry_deadline_s: float = 30.0):
+                 retry_deadline_s: float = 30.0,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 degrade: bool = False):
         """retry_deadline_s: failover budget. A query that fails (shard
         died mid-call, RpcChannel exhausted its in-channel retries) is
-        retried until this deadline — the registry monitor swaps the
+        retried under RetryPolicy (exponential backoff, full jitter)
+        until this deadline — the registry monitor swaps the
         replacement shard's endpoint in live, so a restarted shard
         becomes visible within its heartbeat interval and the retry
         succeeds without rebuilding the engine. 0 disables (one
         attempt). Reference semantics: rpc_client.h:46 retry counter +
-        ZK watch re-resolution."""
+        ZK watch re-resolution.
+
+        retry_policy: full control over backoff/deadline/per-attempt
+        timeout; overrides retry_deadline_s when given.
+
+        degrade: opt-in graceful degradation — a SAMPLING query that
+        exhausts its retry deadline returns default_id-padded,
+        correctly-shaped results and counts the event in health()
+        ["degraded"] instead of raising mid-epoch (the TF-GNN
+        "countable degraded batches" production posture). Feature
+        getters never degrade (silent zeros would corrupt training
+        data without a trace)."""
         self.query = Query.remote(endpoints, seed=seed, mode=mode)
-        self.retry_deadline_s = float(retry_deadline_s)
+        self.retry = retry_policy or RetryPolicy(
+            deadline_s=float(retry_deadline_s))
+        self.retry_deadline_s = self.retry.deadline_s  # back-compat alias
+        self.degrade = bool(degrade)
         # host-side rng for the client-computed node2vec bias; seed=0 →
         # fresh entropy (matching the engine's seed convention)
         self._rng = np.random.default_rng(seed if seed else None)
+        self._backoff_rng = random.Random(seed ^ 0x5EED if seed else None)
+        self._health_mu = threading.Lock()
+        self._health = {"calls": 0, "retries": 0, "failovers": 0,
+                        "degraded": 0, "deadline_exhausted": 0,
+                        "last_error": None}
+        self._strays: list = []  # abandoned timed-out attempt threads
+
+    # -- health / retry machinery ------------------------------------------
+    def health(self) -> dict:
+        """Counter surface for ops/bench artifacts: calls, retries (sleep
+        cycles), failovers (calls that failed then succeeded on retry),
+        degraded (padded results served), deadline_exhausted, last_error,
+        plus the proxy's own query/error totals."""
+        with self._health_mu:
+            out = dict(self._health)
+        try:
+            out.update({f"proxy_{k}": v
+                        for k, v in self.query.stats().items()
+                        if k in ("queries", "errors")})
+        except Exception:
+            pass  # closed / stats unavailable — counters still useful
+        return out
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._health_mu:
+            self._health[key] += n
+
+    # bound on live abandoned attempt threads: past this, timed attempts
+    # fail fast instead of spawning — a long black-holed outage with
+    # degrade=True must not accumulate threads/sockets without limit
+    _MAX_STRAYS = 32
+
+    def _attempt(self, gql: str, feed):
+        """One query attempt, bounded by retry.call_timeout_s when set
+        (the RPC sockets block, so a black-holed connection can only be
+        escaped by abandoning the attempt thread)."""
+        t = self.retry.call_timeout_s
+        if not t or t <= 0:
+            return self.query.run(gql, feed)
+        with self._health_mu:
+            # reap strays that have since unblocked; refuse to grow past
+            # the cap ("timeout" marker keeps this retryable/degradable)
+            self._strays = [th for th in self._strays if th.is_alive()]
+            if len(self._strays) >= self._MAX_STRAYS:
+                raise EngineError(
+                    f"graph rpc attempt timeout: {len(self._strays)} "
+                    "abandoned in-flight attempts already parked "
+                    "(endpoint black-holed?); refusing to spawn more")
+        box = {}
+
+        def work():
+            try:
+                box["out"] = self.query.run(gql, feed)
+            except BaseException as e:  # surfaced on join below
+                box["err"] = e
+
+        th = threading.Thread(target=work, daemon=True)
+        th.start()
+        th.join(t)
+        if th.is_alive():
+            with self._health_mu:
+                self._strays.append(th)
+            raise EngineError(
+                f"graph rpc attempt timeout after {t:.3f}s "
+                "(in-flight attempt abandoned)")
+        if "err" in box:
+            raise box["err"]
+        return box["out"]
 
     def _run(self, gql: str, feed=None):
-        """query.run with shard-failover retry (see retry_deadline_s)."""
-        deadline = time.monotonic() + self.retry_deadline_s
+        """query.run under RetryPolicy: retryable (transport) failures
+        back off with full jitter until the deadline; semantic errors
+        raise at once; an exhausted budget raises
+        RetryDeadlineExceeded."""
+        pol = self.retry
+        self._bump("calls")
+        deadline = time.monotonic() + max(pol.deadline_s, 0.0)
+        attempt = 0
         while True:
             try:
-                return self.query.run(gql, feed)
+                out = self._attempt(gql, feed)
+                if attempt:
+                    # the call came back after ≥1 transport failure: the
+                    # shard (or its replacement channel) recovered
+                    self._bump("failovers")
+                return out
             except EngineError as e:
-                # only transport failures are retryable (a dead/restarting
-                # shard surfaces as "rpc to H:P failed after retries");
-                # semantic errors (unknown feature, parse) raise at once
-                if "failed after retries" not in str(e) \
-                        or time.monotonic() >= deadline:
+                if not retryable_error(e):
                     raise
-                time.sleep(0.2)
+                attempt += 1
+                with self._health_mu:
+                    self._health["last_error"] = str(e)
+                now = time.monotonic()
+                exhausted = (now >= deadline
+                             or (pol.max_attempts
+                                 and attempt >= pol.max_attempts))
+                if exhausted:
+                    self._bump("deadline_exhausted")
+                    raise RetryDeadlineExceeded(
+                        f"graph rpc gave up after {attempt} attempt(s) "
+                        f"({pol.deadline_s:.1f}s deadline): {e}") from e
+                self._bump("retries")
+                sleep = min(pol.backoff_s(attempt, self._backoff_rng),
+                            max(deadline - now, 0.0))
+                time.sleep(sleep)
+
+    def _note_degraded(self) -> None:
+        self._bump("degraded")
 
     # -- root sampling -----------------------------------------------------
     def sample_node(self, count: int, node_type: int = -1) -> np.ndarray:
@@ -106,7 +296,20 @@ class RemoteGraphEngine:
         q = "v(r)"
         for i, k in enumerate(counts):
             q += f".sampleNB({per_hop[i]}, {int(k)}, {default_id}).as(h{i})"
-        out = self._run(q, {"r": roots})
+        try:
+            out = self._run(q, {"r": roots})
+        except RetryDeadlineExceeded:
+            if not self.degrade:
+                raise
+            self._note_degraded()
+            ids, w, t = [], [], []
+            m = roots.size
+            for k in counts:
+                m *= int(k)
+                ids.append(np.full(m, default_id, np.uint64))
+                w.append(np.zeros(m, np.float32))
+                t.append(np.full(m, -1, np.int32))
+            return ids, w, t
         ids = [out[f"h{i}:1"].astype(np.uint64) for i in range(len(counts))]
         w = [out[f"h{i}:2"].astype(np.float32) for i in range(len(counts))]
         t = [out[f"h{i}:3"].astype(np.int32) for i in range(len(counts))]
@@ -115,10 +318,18 @@ class RemoteGraphEngine:
     def sample_neighbor(self, ids, count: int, edge_types=None,
                         default_id: int = 0):
         ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
-        out = self._run(
-            f"v(r).sampleNB({self._et(edge_types)}, {count}, "
-            f"{default_id}).as(nb)", {"r": ids})
         n = ids.size
+        try:
+            out = self._run(
+                f"v(r).sampleNB({self._et(edge_types)}, {count}, "
+                f"{default_id}).as(nb)", {"r": ids})
+        except RetryDeadlineExceeded:
+            if not self.degrade:
+                raise
+            self._note_degraded()
+            return (np.full((n, count), default_id, np.uint64),
+                    np.zeros((n, count), np.float32),
+                    np.full((n, count), -1, np.int32))
         return (out["nb:1"].reshape(n, count).astype(np.uint64),
                 out["nb:2"].reshape(n, count).astype(np.float32),
                 out["nb:3"].reshape(n, count).astype(np.int32))
@@ -159,9 +370,16 @@ class RemoteGraphEngine:
         roots = np.ascontiguousarray(roots, dtype=np.uint64).ravel()
         sizes = ":".join(str(int(s)) for s in layer_sizes)
         wf = f", {weight_func}" if weight_func else ""
-        out = self._run(
-            f"v(r).sampleLNB({self._et(edge_types)}, {sizes}, "
-            f"{default_id}{wf}).as(l)", {"r": roots})
+        try:
+            out = self._run(
+                f"v(r).sampleLNB({self._et(edge_types)}, {sizes}, "
+                f"{default_id}{wf}).as(l)", {"r": roots})
+        except RetryDeadlineExceeded:
+            if not self.degrade:
+                raise
+            self._note_degraded()
+            return [np.full(int(s), default_id, np.uint64)
+                    for s in layer_sizes]
         return [out[f"l:{i}"].astype(np.uint64)
                 for i in range(len(layer_sizes))]
 
@@ -181,7 +399,14 @@ class RemoteGraphEngine:
             gql = "v(r)" + "".join(
                 f".sampleNB({et}, 1, {default_id}).as(s{i})"
                 for i in range(walk_len))
-            res = self._run(gql, {"r": roots})
+            try:
+                res = self._run(gql, {"r": roots})
+            except RetryDeadlineExceeded:
+                if not self.degrade:
+                    raise
+                self._note_degraded()
+                out[:, 1:] = default_id  # roots stay real; steps padded
+                return out
             for i in range(walk_len):
                 out[:, i + 1] = res[f"s{i}:1"].astype(np.uint64)
             return out
@@ -193,8 +418,15 @@ class RemoteGraphEngine:
         poff = np.zeros(n + 1, dtype=np.int64)
         pnbr = np.zeros(0, dtype=np.uint64)
         for step in range(walk_len):
-            off, nbr, w, _ = self.get_full_neighbor(cur,
-                                                    edge_types=edge_types)
+            try:
+                off, nbr, w, _ = self.get_full_neighbor(
+                    cur, edge_types=edge_types)
+            except RetryDeadlineExceeded:
+                if not self.degrade:
+                    raise
+                self._note_degraded()
+                out[:, step + 1:] = default_id  # remaining steps padded
+                return out
             off = off.astype(np.int64)
             nxt = np.full(n, default_id, dtype=np.uint64)
             for i in range(n):
@@ -333,4 +565,16 @@ class RemoteGraphEngine:
 
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
+        # abandoned timed-out attempts still hold exec handles into the
+        # query proxy; give them a moment to unblock (their sockets die
+        # when the far end/proxy shuts down) and LEAK the proxy rather
+        # than free it under a live thread
+        with self._health_mu:
+            strays, self._strays = self._strays, []
+        deadline = time.monotonic() + 5.0
+        for th in strays:
+            th.join(max(deadline - time.monotonic(), 0.0))
+        if any(th.is_alive() for th in strays):
+            self.query._h = 0  # leak: a stray thread still uses the handle
+            return
         self.query.close()

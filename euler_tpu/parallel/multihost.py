@@ -23,6 +23,7 @@ Typical per-host entry (see tools/launch_multihost.py):
 
 from __future__ import annotations
 
+import logging
 import os
 from dataclasses import dataclass
 from typing import Optional
@@ -62,12 +63,19 @@ def initialize_multihost(cfg: Optional[MultihostConfig] = None) -> int:
             cfg = None
     if cfg is None:
         # no launcher vars — let jax auto-detect the cluster (TPU pods,
-        # SLURM, GKE); argless initialize raises where no cluster env
-        # exists, which is the single-process case
+        # SLURM, GKE); argless initialize raises RuntimeError/ValueError
+        # where no cluster env exists, which is the single-process case.
+        # Only THOSE are swallowed (with a warning carrying the error):
+        # a genuinely misconfigured cluster failing some other way must
+        # not silently train single-process.
         try:
             jax.distributed.initialize()
             return jax.process_index()
-        except Exception:
+        except (RuntimeError, ValueError) as e:
+            logging.getLogger(__name__).warning(
+                "jax.distributed.initialize() auto-detect failed; "
+                "continuing single-process (set EULER_TPU_COORDINATOR/"
+                "_NUM_HOSTS/_HOST_IDX to force a cluster): %s", e)
             return 0
     if cfg.num_processes <= 1:
         return 0

@@ -33,16 +33,26 @@ def spmd_init(model: nn.Module, tx: optax.GradientTransformation,
     params = variables.pop("params")
     opt_state = tx.init(params)
     return {"params": params, "opt_state": opt_state,
-            "extra_vars": variables, "step": jnp.zeros((), jnp.int32)}
+            "extra_vars": variables, "step": jnp.zeros((), jnp.int32),
+            "skipped_steps": jnp.zeros((), jnp.int32)}
 
 
 def make_spmd_train_step(model: nn.Module,
                          tx: optax.GradientTransformation,
-                         mutable_keys: Tuple[str, ...] = ()) -> Callable:
+                         mutable_keys: Tuple[str, ...] = (),
+                         nonfinite_guard: bool = True) -> Callable:
     """Jitted (state, batch) → (state, loss, metric). State buffers are
-    donated so HBM is reused across steps."""
+    donated so HBM is reused across steps — which is exactly why the
+    nonfinite guard defaults on: one NaN loss applied to donated buffers
+    destroys the only copy of the params. A guarded bad step keeps the
+    old params/opt_state and bumps state['skipped_steps']."""
 
     def train_step(state, batch):
+        # states built before spmd_init grew the counter (hand-rolled
+        # dicts) can't be guarded — structure of both cond branches must
+        # match the input pytree
+        has_ctr = "skipped_steps" in state
+
         def loss_fn(p):
             variables = {"params": p, **state["extra_vars"]}
             if mutable_keys:
@@ -55,14 +65,33 @@ def make_spmd_train_step(model: nn.Module,
 
         (loss, (metric, new_vars)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state["params"])
-        updates, opt_state = tx.update(grads, state["opt_state"],
-                                       state["params"])
-        params = optax.apply_updates(state["params"], updates)
-        return (
-            {"params": params, "opt_state": opt_state,
-             "extra_vars": new_vars, "step": state["step"] + 1},
-            loss,
-            metric,
-        )
+
+        def apply_update(_):
+            updates, opt_state = tx.update(grads, state["opt_state"],
+                                           state["params"])
+            params = optax.apply_updates(state["params"], updates)
+            new = {"params": params, "opt_state": opt_state,
+                   "extra_vars": new_vars, "step": state["step"] + 1}
+            if has_ctr:
+                new["skipped_steps"] = state["skipped_steps"]
+            return new
+
+        def skip_update(_):
+            new = dict(state)
+            new["step"] = state["step"] + 1
+            if has_ctr:
+                new["skipped_steps"] = state["skipped_steps"] + 1
+            return new
+
+        if nonfinite_guard and has_ctr:
+            # loss AND grads: backward-pass overflow can produce NaN
+            # grads under a finite loss
+            ok = jnp.isfinite(loss)
+            for g in jax.tree_util.tree_leaves(grads):
+                ok &= jnp.all(jnp.isfinite(g))
+            new_state = jax.lax.cond(ok, apply_update, skip_update, None)
+        else:
+            new_state = apply_update(None)
+        return new_state, loss, metric
 
     return jax.jit(train_step, donate_argnums=(0,))

@@ -62,12 +62,14 @@ class FileBarrier:
         mine = os.path.join(self.dir, f"{tag}{worker_id}")
         with open(mine, "w"):
             pass
-        deadline = time.time() + self.timeout_s
+        # monotonic, not wall-clock: an NTP step during the wait must not
+        # spuriously expire (or indefinitely extend) an exit barrier
+        deadline = time.monotonic() + self.timeout_s
         while True:
             n = sum(1 for f in os.listdir(self.dir) if f.startswith(tag))
             if n >= self.num_workers:
                 break
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"barrier timed out: {n}/{self.num_workers} arrived")
             time.sleep(self.poll_ms / 1000.0)

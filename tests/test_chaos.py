@@ -1,0 +1,468 @@
+"""Chaos harness + graceful degradation (ISSUE 2 tentpole).
+
+The production claim under test: training survives a flaky sharded
+graph service. Three fault layers are exercised against REAL components:
+
+  * ChaosGraphEngine — deterministic API-level fault schedules driving
+    the estimator's resilient input path (retry / skip-budget /
+    emergency checkpoint);
+  * tools/chaos_proxy.py — kernel-level faults (RST, black-holes)
+    against the live framed-TCP RPC stack, driving RemoteGraphEngine's
+    RetryPolicy + degrade mode;
+  * a real shard kill + same-port restart mid-train() — the acceptance
+    scenario: the run completes, health()["failovers"] >= 1, zero
+    degraded batches.
+
+All smokes here stay in tier-1 (chaos marker, each well under ~10s).
+"""
+
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from euler_tpu.core.lib import EngineError
+from euler_tpu.graph import (
+    ChaosGraphEngine,
+    ChaosPlan,
+    RemoteGraphEngine,
+    RetryDeadlineExceeded,
+    RetryPolicy,
+    retryable_error,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------------------
+# retry classification + backoff
+# ---------------------------------------------------------------------------
+
+def test_retryable_classification():
+    assert retryable_error(
+        EngineError("rpc to 127.0.0.1:9190 failed after retries"))
+    assert retryable_error(
+        EngineError("graph rpc attempt timeout after 0.300s"))
+    assert retryable_error(
+        EngineError("chaos: rpc to shard failed after retries"))
+    assert retryable_error(ConnectionResetError("peer"))
+    assert retryable_error(TimeoutError("slow"))
+    # semantic failures retry identically forever — never retryable
+    assert not retryable_error(EngineError("parse error at token 'vv'"))
+    assert not retryable_error(EngineError("unknown feature f_nope"))
+    assert not retryable_error(ValueError("bad arg"))
+
+
+def test_retry_policy_full_jitter_bounded_and_deterministic():
+    pol = RetryPolicy(base_backoff_s=0.05, max_backoff_s=0.4)
+    rng = random.Random(7)
+    seq = [pol.backoff_s(a, rng) for a in range(1, 12)]
+    for a, s in zip(range(1, 12), seq):
+        assert 0.0 <= s <= min(0.4, 0.05 * 2 ** (a - 1))
+    # capped: late attempts never exceed max_backoff_s
+    assert all(s <= 0.4 for s in seq)
+    # same seed → same schedule (reproducible chaos runs)
+    rng2 = random.Random(7)
+    assert seq == [pol.backoff_s(a, rng2) for a in range(1, 12)]
+
+
+# ---------------------------------------------------------------------------
+# ChaosGraphEngine: deterministic API-level fault schedules
+# ---------------------------------------------------------------------------
+
+def test_chaos_explicit_fail_calls(ring_graph):
+    chaos = ChaosGraphEngine(ring_graph, ChaosPlan(fail_calls=(1,)))
+    assert chaos.sample_node(4).shape == (4,)          # call 0 ok
+    with pytest.raises(EngineError) as ei:             # call 1 injected
+        chaos.sample_node(4)
+    assert retryable_error(ei.value)  # classified like a real dead shard
+    assert chaos.sample_node(4).shape == (4,)          # call 2 ok
+    assert chaos.stats() == {"calls": 3, "errors": 1, "delayed": 0,
+                             "truncated": 0}
+
+
+def test_chaos_seeded_schedule_is_reproducible(ring_graph):
+    def run(seed):
+        chaos = ChaosGraphEngine(
+            ring_graph, ChaosPlan(seed=seed, error_rate=0.4))
+        pattern = []
+        for _ in range(30):
+            try:
+                chaos.sample_node(2)
+                pattern.append(0)
+            except EngineError:
+                pattern.append(1)
+        return pattern
+
+    a, b = run(11), run(11)
+    assert a == b                      # pure function of (seed, call idx)
+    assert 1 in a and 0 in a           # actually mixes faults and successes
+    assert run(12) != a                # seed matters
+
+
+def test_chaos_flap_window(ring_graph):
+    chaos = ChaosGraphEngine(
+        ring_graph, ChaosPlan(flap_period=4, flap_down=2))
+    got = []
+    for _ in range(8):
+        try:
+            chaos.sample_node(1)
+            got.append("ok")
+        except EngineError:
+            got.append("down")
+    assert got == ["down", "down", "ok", "ok"] * 2
+
+
+def test_chaos_latency_and_truncation(ring_graph):
+    import time
+
+    chaos = ChaosGraphEngine(
+        ring_graph, ChaosPlan(latency_ms=40, truncate_rate=1.0))
+    t0 = time.monotonic()
+    nb, w, t = chaos.sample_neighbor(
+        np.array([1, 2, 3, 4], np.uint64), 3)
+    assert time.monotonic() - t0 >= 0.035
+    # truncated: leading axis halved on every array of the tuple
+    assert nb.shape == (2, 3) and w.shape == (2, 3) and t.shape == (2, 3)
+    s = chaos.stats()
+    assert s["delayed"] == 1 and s["truncated"] == 1
+
+
+# ---------------------------------------------------------------------------
+# estimator resilience: retry / skip budget / emergency checkpoint /
+# nonfinite guard
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def citation():
+    from euler_tpu.dataset.base_dataset import synthetic_citation
+
+    return synthetic_citation("tiny", n=90, d=8, num_classes=3,
+                              train_per_class=10, val=15, test=15, seed=2)
+
+
+def _make_estimator(graph, flow_engine, model_dir=None, **extra):
+    """NodeEstimator whose FLOW samples from flow_engine while the
+    estimator itself (root sampling + labels) talks to `graph` — with a
+    chaos wrapper as `graph`, each batch costs EXACTLY two intercepted
+    calls (sample_node + get_dense_feature), so fault schedules are
+    deterministic in batch index."""
+    from euler_tpu.dataflow import FullBatchDataFlow
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.mp_utils import BaseGNNNet, SuperviseModel
+
+    class TinyGCN(SuperviseModel):
+        def embed(self, batch):
+            return BaseGNNNet("gcn", 8, 2, name="gnn")(batch)
+
+    flow = FullBatchDataFlow(flow_engine, feature_ids=["feature"])
+    params = {"batch_size": 16, "learning_rate": 0.05,
+              "log_steps": 1 << 30, "checkpoint_steps": 0,
+              "label_dim": 3, **extra}
+    return NodeEstimator(TinyGCN(num_classes=3, multilabel=False),
+                         params, graph, flow, label_fid="label",
+                         label_dim=3, model_dir=model_dir)
+
+
+def test_input_retry_survives_transient_failure(citation):
+    g = citation.engine
+    chaos = ChaosGraphEngine(g, ChaosPlan(fail_calls=(4,)))
+    est = _make_estimator(chaos, g, input_backoff_s=0.01)
+    res = est.train(est.train_input_fn, max_steps=6)
+    assert res["global_step"] == 6
+    assert est.input_health["input_retries"] == 1
+    assert est.input_health["skipped_batches"] == 0
+    assert est.health()["input_failures"] == 1
+
+
+def test_skip_batch_budget_absorbs_burst(citation):
+    g = citation.engine
+    # 5 consecutive failing calls: with 1 retry per batch the burst can
+    # only be crossed by abandoning batches under the skip budget
+    chaos = ChaosGraphEngine(
+        g, ChaosPlan(fail_calls=tuple(range(6, 11))))
+    est = _make_estimator(chaos, g, input_retries=1,
+                          input_backoff_s=0.01, skip_batch_budget=3)
+    res = est.train(est.train_input_fn, max_steps=8)
+    assert res["global_step"] == 8
+    assert est.input_health["skipped_batches"] >= 1
+    assert res["skipped_batches"] == est.input_health["skipped_batches"]
+
+
+def test_emergency_checkpoint_then_resume(citation, tmp_path):
+    """An unrecoverable input error (shard never comes back, budget 0)
+    must checkpoint before re-raising — and a fresh estimator must
+    RESUME from that step, not restart at 0 (the restore_checkpoint
+    step-loss satellite)."""
+    g = citation.engine
+    chaos = ChaosGraphEngine(g, ChaosPlan(fail_from=4))
+    est = _make_estimator(chaos, g, model_dir=str(tmp_path),
+                          input_retries=1, input_backoff_s=0.01)
+    with pytest.raises(EngineError):
+        est.train(est.train_input_fn, max_steps=50)
+    saved = est.input_health["emergency_checkpoint_step"]
+    assert saved == 2  # batches 1-2 trained; batch 3 hit the dead shard
+
+    # resume on a healthy engine: 2 more steps, not 4 from scratch
+    est2 = _make_estimator(g, g, model_dir=str(tmp_path))
+    res = est2.train(est2.train_input_fn, max_steps=4)
+    assert res["global_step"] == 4
+    assert int(est2.state.step) == 4
+
+
+def test_checkpoint_resume_restores_step(citation, tmp_path):
+    """Plain (non-emergency) resume round-trip: global_step continues
+    and earlier checkpoints are not re-overwritten from step 0."""
+    g = citation.engine
+    est = _make_estimator(g, g, model_dir=str(tmp_path),
+                          checkpoint_steps=5)
+    est.train(est.train_input_fn, max_steps=10)
+
+    est2 = _make_estimator(g, g, model_dir=str(tmp_path),
+                           checkpoint_steps=5)
+    # exactly 3 batches available: only a resumed-at-10 run can reach 13
+    it = est2.train_input_fn()
+    res = est2.train(iter([next(it) for _ in range(3)]), max_steps=13)
+    assert res["global_step"] == 13
+
+
+def test_nonfinite_guard_skips_bad_batch(citation):
+    """A NaN-loss batch must not poison the donated train state: the
+    update is skipped, skipped_steps counts 1, params stay finite, and
+    later steps keep learning."""
+    import jax
+
+    g = citation.engine
+    est = _make_estimator(g, g)
+    it = est.train_input_fn()
+    batches = [next(it) for _ in range(10)]
+    first = est.train(iter(batches[:1]), max_steps=1)
+    assert np.isfinite(first["loss"])
+
+    poisoned = dict(batches[2])
+    poisoned["labels"] = np.full_like(poisoned["labels"], np.nan)
+    stream = [batches[1], poisoned] + batches[3:]
+    res = est.train(iter(stream), max_steps=10)
+    assert res["global_step"] == 10
+    assert res["skipped_steps"] == 1
+    for leaf in jax.tree_util.tree_leaves(est.state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # keeps learning past the bad batch
+    res2 = est.train(iter(batches), max_steps=20)
+    assert res2["skipped_steps"] == 1          # no new skips
+    assert np.isfinite(res2["loss"])
+    assert res2["loss"] < first["loss"]
+
+
+def test_spmd_step_nonfinite_guard(citation):
+    """The SPMD dict-state step has the same guard: a NaN batch keeps
+    params bit-identical and bumps skipped_steps."""
+    import jax
+    import optax
+
+    from euler_tpu.mp_utils import BaseGNNNet, SuperviseModel
+    from euler_tpu.parallel import make_mesh, make_spmd_train_step, spmd_init
+    from euler_tpu.dataflow import FullBatchDataFlow
+
+    class TinyGCN(SuperviseModel):
+        def embed(self, batch):
+            return BaseGNNNet("gcn", 8, 2, name="gnn")(batch)
+
+    g = citation.engine
+    flow = FullBatchDataFlow(g, feature_ids=["feature"])
+    roots = g.sample_node(16, 0)
+    batch = flow(roots)
+    batch["labels"] = g.get_dense_feature(roots, "label", 3)
+    mesh = make_mesh()
+    tx = optax.adam(1e-2)
+    with mesh:
+        state = spmd_init(TinyGCN(num_classes=3, multilabel=False), tx,
+                          batch, mesh)
+        step = make_spmd_train_step(TinyGCN(num_classes=3,
+                                            multilabel=False), tx)
+        before = jax.device_get(state["params"])
+        bad = dict(batch)
+        bad["labels"] = np.full_like(batch["labels"], np.nan)
+        state, loss, _ = step(state, bad)
+        assert not np.isfinite(float(loss))
+        assert int(state["skipped_steps"]) == 1
+        after = jax.device_get(state["params"])
+        for a, b in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(after)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # a clean batch still updates
+        state, loss, _ = step(state, dict(batch))
+        assert np.isfinite(float(loss))
+        assert int(state["skipped_steps"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# live-cluster chaos: shard kill/restart mid-train, TCP proxy faults
+# ---------------------------------------------------------------------------
+
+def _featured_graph(tmp_path, n=40):
+    from euler_tpu.graph import GraphBuilder, seed
+
+    seed(5)
+    rng = np.random.default_rng(0)
+    b = GraphBuilder()
+    b.set_num_types(2, 1)
+    b.set_feature(0, 0, 8, "feature")
+    b.set_feature(1, 0, 4, "label")
+    ids = np.arange(1, n + 1, dtype=np.uint64)
+    b.add_nodes(ids, types=(ids % 2).astype(np.int32),
+                weights=np.ones(n, np.float32))
+    src = np.concatenate([ids, ids])
+    dst = np.concatenate([np.roll(ids, -1), np.roll(ids, -3)])
+    b.add_edges(src, dst, types=np.zeros(2 * n, np.int32),
+                weights=np.ones(2 * n, np.float32))
+    cls = (ids % 4).astype(np.int64)
+    feats = rng.normal(0, 1, (n, 8)).astype(np.float32)
+    feats[np.arange(n), cls] += 2.0
+    b.set_node_dense(ids, 0, feats)
+    b.set_node_dense(ids, 1, np.eye(4, dtype=np.float32)[cls])
+    data_dir = str(tmp_path / "g")
+    b.finalize().dump(data_dir, num_partitions=2)
+    return data_dir
+
+
+def test_shard_kill_restart_mid_train_failover(tmp_path):
+    """THE acceptance scenario: one of two live shards dies mid-train()
+    and restarts on the same port; the run completes with at least one
+    recorded failover and ZERO degraded (padded) batches."""
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.gql import start_service
+    from euler_tpu.models import SupervisedGraphSage
+
+    data_dir = _featured_graph(tmp_path)
+    servers = [start_service(data_dir, shard_idx=i, shard_num=2, port=0)
+               for i in range(2)]
+    ports = [s.port for s in servers]
+    eps = ",".join(f"127.0.0.1:{p}" for p in ports)
+    remote = RemoteGraphEngine(
+        f"hosts:{eps}", seed=3,
+        retry_policy=RetryPolicy(deadline_s=20.0, base_backoff_s=0.05,
+                                 max_backoff_s=0.3))
+    flow = FanoutDataFlow(remote, [3, 2], feature_ids=["feature"])
+    est = NodeEstimator(
+        SupervisedGraphSage(num_classes=4, multilabel=False, dim=8,
+                            fanouts=(3, 2)),
+        dict(batch_size=8, learning_rate=0.05, log_steps=1 << 30,
+             checkpoint_steps=0, label_dim=4),
+        remote, flow, label_fid="label", label_dim=4)
+
+    def restart():
+        servers[1] = start_service(data_dir, shard_idx=1, shard_num=2,
+                                   port=ports[1])
+
+    def gen():
+        base = est.train_input_fn()
+        n = 0
+        while True:
+            n += 1
+            if n == 3:
+                # kill shard 1 NOW; it comes back 0.6s later while the
+                # next query is inside the retry loop
+                servers[1].stop()
+                threading.Timer(0.6, restart).start()
+            yield next(base)
+
+    try:
+        res = est.train(gen(), max_steps=5)
+        assert res["global_step"] == 5
+        h = remote.health()
+        assert h["failovers"] >= 1, h
+        assert h["retries"] >= 1, h
+        assert h["degraded"] == 0, h          # zero padded batches
+        assert res["skipped_steps"] == 0
+    finally:
+        remote.close()
+        for s in servers:
+            s.stop()
+
+
+@pytest.fixture
+def proxied_shard(tmp_path):
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    from chaos_proxy import ChaosProxy
+
+    from euler_tpu.gql import start_service
+
+    data_dir = _featured_graph(tmp_path, n=20)
+    server = start_service(data_dir, shard_idx=0, shard_num=1, port=0)
+    proxy = ChaosProxy("127.0.0.1", server.port).start()
+    engines = []
+    yield proxy, engines
+    proxy.stop()          # unblocks any attempt threads parked in recv
+    for e in engines:
+        e.close()
+    server.stop()
+
+
+def test_proxy_reset_storm_then_recovery(proxied_shard):
+    """Connection resets against the REAL framed-TCP stack: the client
+    rides them out (C++ in-channel retries exhaust, the Python
+    RetryPolicy backs off) and recovers once the network heals, counting
+    retries + a failover."""
+    proxy, engines = proxied_shard
+    remote = RemoteGraphEngine(
+        f"hosts:127.0.0.1:{proxy.port}", seed=1,
+        retry_policy=RetryPolicy(deadline_s=10.0, base_backoff_s=0.05,
+                                 max_backoff_s=0.2))
+    engines.append(remote)
+    assert remote.sample_node(4, -1).shape == (4,)   # healthy path
+
+    proxy.set_mode("reset")
+    threading.Timer(0.6, proxy.set_mode, args=("ok",)).start()
+    f = remote.get_dense_feature(np.array([1, 2], np.uint64), "feature")
+    assert f.shape == (2, 8)
+    h = remote.health()
+    assert h["retries"] >= 1 and h["failovers"] >= 1, h
+    assert proxy.counters["reset"] >= 1
+
+
+def test_proxy_blackhole_degrade_pads_and_counts(proxied_shard):
+    """A black-holed connection (accepts, never answers) would hang the
+    blocking RPC sockets forever; with a per-attempt timeout + degrade
+    mode the sampling query returns default_id-padded, correctly-shaped
+    results and the event is counted instead of raised."""
+    proxy, engines = proxied_shard
+    remote = RemoteGraphEngine(
+        f"hosts:127.0.0.1:{proxy.port}", seed=1, degrade=True,
+        retry_policy=RetryPolicy(deadline_s=1.2, base_backoff_s=0.05,
+                                 max_backoff_s=0.15, call_timeout_s=0.35))
+    engines.append(remote)
+    ids = np.array([1, 2, 3], np.uint64)
+    real_nb, _, _ = remote.sample_neighbor(ids, 4, default_id=0)
+    assert real_nb.shape == (3, 4) and real_nb.any()
+
+    proxy.set_mode("blackhole")
+    nb, w, t = remote.sample_neighbor(ids, 4, default_id=0)
+    assert nb.shape == (3, 4) and not nb.any()       # default_id padded
+    assert (t == -1).all() and not w.any()
+    h = remote.health()
+    assert h["degraded"] == 1, h
+    assert h["deadline_exhausted"] >= 1, h
+    # fanout degrades with per-hop shapes too
+    f_ids, f_w, f_t = remote.sample_fanout(ids, [3, 2], default_id=0)
+    assert [a.shape[0] for a in f_ids] == [9, 18]
+    assert not f_ids[0].any() and (f_t[1] == -1).all()
+    assert remote.health()["degraded"] == 2
+
+
+def test_proxy_blackhole_without_degrade_raises(proxied_shard):
+    proxy, engines = proxied_shard
+    remote = RemoteGraphEngine(
+        f"hosts:127.0.0.1:{proxy.port}", seed=1,
+        retry_policy=RetryPolicy(deadline_s=0.8, base_backoff_s=0.05,
+                                 max_backoff_s=0.1, call_timeout_s=0.3))
+    engines.append(remote)
+    proxy.set_mode("blackhole")
+    with pytest.raises(RetryDeadlineExceeded, match="gave up after"):
+        remote.sample_neighbor(np.array([1], np.uint64), 2)
+    assert remote.health()["deadline_exhausted"] == 1

@@ -1,0 +1,61 @@
+"""FileBarrier unit coverage (ISSUE 2 satellites): timeout diagnostics,
+two-rounds-back marker GC, stale-run_id isolation, and the actual
+N-party rendezvous."""
+
+import os
+import threading
+
+import pytest
+
+from euler_tpu.utils.hooks import FileBarrier
+
+
+def test_barrier_timeout_reports_arrived_count(tmp_path):
+    b = FileBarrier(str(tmp_path), num_workers=3, poll_ms=10,
+                    timeout_s=0.25)
+    with pytest.raises(TimeoutError, match=r"1/3 arrived"):
+        b.wait(0)
+
+
+def test_barrier_two_thread_rendezvous(tmp_path):
+    n = 3
+    barriers = [FileBarrier(str(tmp_path), n, run_id="r", poll_ms=10,
+                            timeout_s=10.0) for _ in range(n)]
+    done = []
+
+    def worker(i):
+        barriers[i].wait(i)
+        done.append(i)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert sorted(done) == list(range(n))
+
+
+def test_barrier_gc_reclaims_two_rounds_back(tmp_path):
+    """Entering round r proves every worker passed r-1, so markers from
+    r-2 must actually be deleted (not just intended to be)."""
+    b = FileBarrier(str(tmp_path), num_workers=1, run_id="j", poll_ms=10,
+                    timeout_s=5.0)
+    for _ in range(3):  # rounds 0, 1, 2
+        b.wait(0)
+    names = set(os.listdir(str(tmp_path)))
+    assert "barrier_j_0_0" not in names      # round 0 reclaimed
+    assert "barrier_j_1_0" in names          # rounds 1, 2 still present
+    assert "barrier_j_2_0" in names
+
+
+def test_barrier_stale_run_id_markers_ignored(tmp_path):
+    """Markers left by a crashed previous run (different run_id) must not
+    satisfy a fresh run's count."""
+    # a dead run's full set of markers for round 0
+    for w in range(2):
+        (tmp_path / f"barrier_dead_0_{w}").write_text("")
+    b = FileBarrier(str(tmp_path), num_workers=2, run_id="fresh",
+                    poll_ms=10, timeout_s=0.25)
+    with pytest.raises(TimeoutError, match=r"1/2 arrived"):
+        b.wait(0)

@@ -130,6 +130,38 @@ def test_production_topology_loss_parity(tmp_path):
         np.testing.assert_allclose(r["losses"], ref_losses, rtol=1e-6)
 
 
+def test_initialize_multihost_narrow_catch(monkeypatch, caplog):
+    """Auto-detect failures (RuntimeError/ValueError: no cluster env) fall
+    back to single-process WITH a warning carrying the swallowed error;
+    any other exception from a genuinely misconfigured cluster must
+    propagate instead of silently training single-process (ISSUE 2
+    satellite — the old code caught bare Exception silently)."""
+    import logging
+
+    import jax
+
+    from euler_tpu.parallel import multihost as mh
+
+    for var in ("EULER_TPU_COORDINATOR", "EULER_TPU_NUM_HOSTS",
+                "EULER_TPU_HOST_IDX"):
+        monkeypatch.delenv(var, raising=False)
+
+    def no_cluster():
+        raise RuntimeError("no cluster detected in environment")
+
+    monkeypatch.setattr(jax.distributed, "initialize", no_cluster)
+    with caplog.at_level(logging.WARNING):
+        assert mh.initialize_multihost() == 0
+    assert "no cluster detected in environment" in caplog.text
+
+    def misconfigured():
+        raise TypeError("coordinator_address must be a string")
+
+    monkeypatch.setattr(jax.distributed, "initialize", misconfigured)
+    with pytest.raises(TypeError):
+        mh.initialize_multihost()
+
+
 def test_two_process_multihost_tcp_registry(tmp_path):
     """Same 2-process job, but discovery runs through a TCP registry
     server — no shared filesystem between 'hosts' (VERDICT r2 missing

@@ -1,0 +1,271 @@
+"""TCP chaos proxy: network-level fault injection for the graph service.
+
+Sits between a RemoteGraphEngine client and one live shard of the
+framed-TCP RPC stack and injects the faults ChaosGraphEngine can't (it
+fakes at the Python API boundary; this breaks the actual wire):
+
+  * reset     — accept then RST the connection (SO_LINGER 0), the
+                kernel-level view of a crashed shard;
+  * stall     — hold the connection for stall_s before piping, a
+                GC-pausing / overloaded shard;
+  * blackhole — accept, swallow client bytes, never answer: the
+                worst failure mode (blocking sockets hang forever
+                without a per-attempt timeout — exactly what
+                RetryPolicy.call_timeout_s exists for);
+  * ok        — transparent bidirectional pipe.
+
+The mode applies per NEW connection; switching to reset/blackhole also
+kills live piped connections so in-flight requests see the fault (a
+pooled client socket would otherwise sail through). A seeded schedule
+(mode_weights) draws a mode per connection for probabilistic chaos;
+set_mode() forces one deterministically.
+
+Usage (tests):
+
+    proxy = ChaosProxy("127.0.0.1", shard.port)
+    proxy.start()
+    remote = RemoteGraphEngine(f"hosts:127.0.0.1:{proxy.port}", ...)
+    proxy.set_mode("reset")     # every new connection gets RST
+    ...
+    proxy.set_mode("ok")
+    proxy.stop()                # stop BEFORE remote.close(): unblocks
+                                # any attempt threads parked in recv
+
+CLI:
+
+    python tools/chaos_proxy.py --target 127.0.0.1:9190 \
+        --listen_port 9999 --mode reset
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import socket
+import struct
+import threading
+import time
+
+MODES = ("ok", "reset", "stall", "blackhole")
+
+
+class ChaosProxy:
+    def __init__(self, target_host: str, target_port: int,
+                 listen_port: int = 0, mode: str = "ok",
+                 stall_s: float = 0.5, seed: int = 0,
+                 mode_weights=None):
+        """mode_weights: optional {mode: weight} dict — each new
+        connection draws its mode from this distribution (seeded);
+        None uses the fixed `mode` (set_mode switches it live)."""
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        self.target = (target_host, int(target_port))
+        self.stall_s = float(stall_s)
+        self._mode = mode
+        self._weights = dict(mode_weights) if mode_weights else None
+        self._rng = random.Random(seed)
+        self._mu = threading.Lock()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", int(listen_port)))
+        self.port = self._listener.getsockname()[1]
+        self._stopping = False
+        self._threads: list = []
+        self._conns: list = []  # live sockets (client + upstream)
+        self.counters = {"accepted": 0, "ok": 0, "reset": 0, "stall": 0,
+                         "blackhole": 0, "bytes_up": 0, "bytes_down": 0}
+
+    # -- control -----------------------------------------------------------
+    def start(self) -> "ChaosProxy":
+        self._listener.listen(16)
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def set_mode(self, mode: str) -> None:
+        """Force a mode for all subsequent connections. Every switch also
+        kills live connections: switching INTO a faulty mode makes pooled
+        client sockets see the fault instead of sailing through, and
+        switching back to ok drops lingering black-holed conns — the real
+        'shard restarted' signal that lets clients whose abandoned
+        attempts are parked in recv unblock and recover."""
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        with self._mu:
+            self._mode = mode
+            self._weights = None
+            self._kill_conns_locked()
+
+    def stop(self) -> None:
+        self._stopping = True
+        try:  # shutdown wakes a blocked accept(); close alone does not
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._mu:
+            self._kill_conns_locked()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def _kill_conns_locked(self) -> None:
+        for s in self._conns:
+            try:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             struct.pack("ii", 1, 0))  # RST, not FIN
+            except OSError:
+                pass
+            try:  # unblock any thread parked in recv on this socket —
+                # close() alone leaves it blocked (the fd dies, the
+                # in-flight recv doesn't)
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._conns.clear()
+
+    # -- data path ---------------------------------------------------------
+    def _pick_mode(self) -> str:
+        with self._mu:
+            if not self._weights:
+                return self._mode
+            modes = sorted(self._weights)
+            total = sum(self._weights[m] for m in modes)
+            x = self._rng.uniform(0, total)
+            for m in modes:
+                x -= self._weights[m]
+                if x <= 0:
+                    return m
+            return modes[-1]
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            self.counters["accepted"] += 1
+            with self._mu:
+                # reap finished handler threads: a retry storm is a
+                # reconnect storm, and an unpruned list would grow (and
+                # stop() would join it) for the proxy's whole lifetime
+                self._threads = [t for t in self._threads if t.is_alive()]
+            t = threading.Thread(target=self._handle, args=(client,),
+                                 daemon=True)
+            t.start()
+            with self._mu:
+                self._threads.append(t)
+
+    def _handle(self, client: socket.socket) -> None:
+        mode = self._pick_mode()
+        self.counters[mode] += 1
+        if mode == "reset":
+            try:
+                client.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                  struct.pack("ii", 1, 0))
+            finally:
+                client.close()
+            return
+        if mode == "blackhole":
+            with self._mu:
+                self._conns.append(client)
+            try:
+                while client.recv(1 << 16):
+                    pass  # swallow; never answer
+            except OSError:
+                pass
+            finally:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                with self._mu:
+                    self._conns = [c for c in self._conns if c is not client]
+            return
+        if mode == "stall":
+            time.sleep(self.stall_s)
+        try:
+            upstream = socket.create_connection(self.target, timeout=5.0)
+            upstream.settimeout(None)
+        except OSError:
+            client.close()
+            return
+        with self._mu:
+            self._conns.extend((client, upstream))
+        a = threading.Thread(target=self._pipe,
+                             args=(client, upstream, "bytes_up"),
+                             daemon=True)
+        b = threading.Thread(target=self._pipe,
+                             args=(upstream, client, "bytes_down"),
+                             daemon=True)
+        a.start()
+        b.start()
+
+    def _pipe(self, src: socket.socket, dst: socket.socket,
+              counter: str) -> None:
+        try:
+            while True:
+                data = src.recv(1 << 16)
+                if not data:
+                    break
+                self.counters[counter] += len(data)
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            # close (not just shutdown) and prune from _conns: a long-
+            # lived proxy under a reconnect-heavy client must not leak
+            # two fds per connection until stop()
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            with self._mu:
+                self._conns = [c for c in self._conns
+                               if c is not src and c is not dst]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--target", required=True, help="host:port of the shard")
+    ap.add_argument("--listen_port", type=int, default=0)
+    ap.add_argument("--mode", choices=MODES, default="ok")
+    ap.add_argument("--stall_s", type=float, default=0.5)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--reset_rate", type=float, default=0.0,
+                    help="probabilistic mix: P(reset) per connection "
+                         "(remainder is the --mode)")
+    args = ap.parse_args()
+    host, port = args.target.rsplit(":", 1)
+    weights = None
+    if args.reset_rate > 0:
+        weights = {"reset": args.reset_rate,
+                   args.mode: max(1.0 - args.reset_rate, 0.0)}
+    proxy = ChaosProxy(host, int(port), listen_port=args.listen_port,
+                       mode=args.mode, stall_s=args.stall_s,
+                       seed=args.seed, mode_weights=weights)
+    proxy.start()
+    print(f"chaos proxy listening on 127.0.0.1:{proxy.port} -> "
+          f"{args.target} (mode={args.mode})", flush=True)
+    try:
+        while True:
+            time.sleep(5)
+            print(f"chaos proxy counters: {proxy.counters}", flush=True)
+    except KeyboardInterrupt:
+        proxy.stop()
+
+
+if __name__ == "__main__":
+    main()
