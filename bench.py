@@ -376,6 +376,7 @@ def run_walk_bench(args, graph, sampler, cache_state, setup_secs,
     it = Prefetcher(gen(), depth=3, transform=to_dev)
     warmup = spl + 2 if spl > 1 else 3
     est.train(iter([next(it) for _ in range(warmup)]), max_steps=warmup)
+    _obs_region_start()
     t0 = time.time()
     res = est.train(it, max_steps=warmup + steps)
     dt = time.time() - t0
@@ -454,6 +455,7 @@ def run_layerwise_bench(args, graph, store, sampler, cache_state,
                     transform=_make_to_dev(est))
     warmup = spl + 2 if spl > 1 else 3
     est.train(iter([next(it) for _ in range(warmup)]), max_steps=warmup)
+    _obs_region_start()
     t0 = time.time()
     res = est.train(it, max_steps=warmup + steps)
     dt = time.time() - t0
@@ -487,6 +489,24 @@ def run_layerwise_bench(args, graph, store, sampler, cache_state,
             "health": _bench_health(graph, res),
         },
     }
+
+
+# registry snapshot taken when the measured region starts (post-warmup):
+# detail.obs_measured diffs the final snapshot against this, so compile-
+# dominated warmup observations can't masquerade as measured step time
+_OBS_REGION_BASE = None
+
+
+def _obs_region_start():
+    """Mark the start of the measured region: drop setup/warmup spans
+    (--trace exports exactly the region) and snapshot the registry so
+    detail.obs_measured can report region-only metric deltas (obs
+    import is stdlib-only/cheap)."""
+    global _OBS_REGION_BASE
+    from euler_tpu import obs
+
+    obs.clear_trace()
+    _OBS_REGION_BASE = obs.snapshot()
 
 
 def _bench_health(graph, res=None):
@@ -665,6 +685,7 @@ def run_bench(args):
     if spl > 1:
         warmup = spl + 2
     est.train(iter([next(it) for _ in range(warmup)]), max_steps=warmup)
+    _obs_region_start()
     per_window = max(steps // 3, spl, 1)
     window_rates = []
     done_before = warmup
@@ -848,6 +869,12 @@ def build_argparser():
     ap.add_argument("--platform", default="",
                     choices=["", "auto", "tpu", "cpu"],
                     help="default: cpu for --smoke, auto otherwise")
+    ap.add_argument("--trace", default="",
+                    help="write a chrome://tracing JSON of the measured "
+                         "region (per-step input_wait/device_step/hook "
+                         "spans, graph rpc spans) to this path; view "
+                         "with chrome://tracing, ui.perfetto.dev, or "
+                         "tools/trace_dump.py")
     return ap
 
 
@@ -898,6 +925,19 @@ def main(argv=None):
             raise RuntimeError(backend_err)
         result = run_bench(args)
         rc = 0
+        # every mode's artifact carries the full registry snapshot
+        # (process lifetime: includes setup/warmup/compile) PLUS the
+        # measured-region delta — read the host/device split off
+        # obs_measured, not obs (ISSUE 3: a degraded or input-bound run
+        # is visible in the artifact itself)
+        from euler_tpu import obs
+
+        if isinstance(result.get("detail"), dict):
+            final = obs.snapshot()
+            result["detail"]["obs"] = final
+            if _OBS_REGION_BASE is not None:
+                result["detail"]["obs_measured"] = obs.snapshot_delta(
+                    _OBS_REGION_BASE, final)
         # canonical config only: non-default shapes OR non-headline
         # sampler/precision flags (--host_sampler / --fp32, advisor r2
         # medium) must not overwrite the cached headline number
@@ -945,6 +985,17 @@ def main(argv=None):
         }
         traceback.print_exc(file=sys.stderr)
         rc = 1
+    if args.trace:
+        try:
+            from euler_tpu import obs
+
+            obs.dump_trace(args.trace)
+            print(f"bench: chrome trace written to {args.trace} "
+                  "(load in chrome://tracing / ui.perfetto.dev)",
+                  file=sys.stderr)
+        except Exception as te:  # a trace failure must not cost the JSON
+            print(f"bench: trace dump failed (ignored): {te}",
+                  file=sys.stderr)
     print(json.dumps(result), flush=True)
     return rc
 

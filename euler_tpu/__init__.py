@@ -18,6 +18,9 @@ Layering (bottom → top), mirroring SURVEY.md §1:
   dataset/     dataset registry (synthetic + on-disk loaders)
   parallel/    Mesh/pjit sharding, sharded embedding tables
   tools/       data prep (json → binary partitions), knn export
+  obs/         metrics registry + tracing + /metrics exposition
+               (stdlib-only; wired through graph client, input
+               pipeline, train loop, and bench)
 """
 
 __version__ = "0.1.0"

@@ -17,6 +17,7 @@ input_fn is a host-side iterator of numpy batch dicts with STATIC shapes.
 
 from __future__ import annotations
 
+import itertools
 import os
 import time
 from typing import Any, Callable, Dict, Iterator, Optional
@@ -27,7 +28,12 @@ import numpy as np
 import optax
 from flax.training import train_state
 
+from euler_tpu import obs as _obs
 from euler_tpu.utils import optimizers as opt_lib
+
+# per-process estimator numbering: the label value distinguishing N
+# estimators' children on the shared estimator_* metrics
+_EST_IDS = itertools.count()
 
 
 class TrainState(train_state.TrainState):
@@ -138,9 +144,48 @@ class BaseEstimator:
         self._skip_budget = int(
             self.params_cfg.get("skip_batch_budget", 0))
         self._input_factory = None
-        self.input_health: Dict[str, Any] = {
-            "input_failures": 0, "input_retries": 0, "skipped_batches": 0,
+        # input-path counters live on the obs registry (children labeled
+        # by estimator instance); input_health / health() are VIEWS over
+        # them — the same numbers a /metrics scrape reports
+        self._obs_name = f"estimator{next(_EST_IDS)}"
+        reg = _obs.default_registry()
+        lab = {"estimator": self._obs_name}
+        self._ctr_input_failures = reg.counter(
+            "estimator_input_failures_total",
+            "input batches that raised", ("estimator",)).labels(**lab)
+        self._ctr_input_retries = reg.counter(
+            "estimator_input_retries_total",
+            "input-pipeline retry sleeps", ("estimator",)).labels(**lab)
+        self._ctr_skipped_batches = reg.counter(
+            "estimator_skipped_batches_total",
+            "input batches abandoned under skip_batch_budget",
+            ("estimator",)).labels(**lab)
+        self._hist_input_wait = reg.histogram(
+            "estimator_input_wait_ms",
+            "per-step host wait for the next batch (sampling + RPC + "
+            "host→device conversion)", ("estimator",)).labels(**lab)
+        self._hist_device_step = reg.histogram(
+            "estimator_device_step_ms",
+            "per-step train-step dispatch", ("estimator",)).labels(**lab)
+        self._hist_hook = reg.histogram(
+            "estimator_hook_ms",
+            "per-step logging/checkpoint hooks", ("estimator",)
+        ).labels(**lab)
+        self._g_steps_per_sec = reg.gauge(
+            "estimator_steps_per_sec", "train-loop throughput",
+            ("estimator",)).labels(**lab)
+        self._g_skipped_steps = reg.gauge(
+            "estimator_skipped_steps",
+            "nonfinite-guard skipped device steps",
+            ("estimator",)).labels(**lab)
+        self._g_global_step = reg.gauge(
+            "estimator_global_step", "last reported global step",
+            ("estimator",)).labels(**lab)
+        # non-counter health fields (strings / one-shot markers) stay
+        # instance-side; the input_health view merges them back in
+        self._input_meta: Dict[str, Any] = {
             "emergency_checkpoint_step": None, "last_input_error": None}
+        _obs.register_health(self._obs_name, self.health)
         self.state: Optional[TrainState] = None
         self._train_step = None
         self._train_loop = None
@@ -335,16 +380,43 @@ class BaseEstimator:
             return 0
         return int(jax.device_get(self.state.skipped_steps))
 
+    @property
+    def input_health(self) -> Dict[str, Any]:
+        """Input-path counters — a compatibility VIEW over this
+        estimator's obs registry children (plus the instance-side
+        last-error / emergency-checkpoint markers); mutate the counters
+        through the registry children, not this dict."""
+        return {
+            "input_failures": int(self._ctr_input_failures.value),
+            "input_retries": int(self._ctr_input_retries.value),
+            "skipped_batches": int(self._ctr_skipped_batches.value),
+            **self._input_meta,
+        }
+
     def health(self) -> Dict[str, Any]:
         """Input-path + train-step degradation counters, merged with the
         graph client's health() when the estimator's graph exposes one —
-        a single surface for 'did this run degrade?'."""
+        a single surface for 'did this run degrade?'.
+
+        skipped_steps comes from the obs GAUGE (refreshed by the train
+        thread at every log hook and at train()'s end), NOT from device
+        state: health() runs on the /healthz scrape thread, and a
+        device_get there could touch buffers the in-flight train step
+        has already donated. Mid-train the value is at most one log
+        window stale; after train() it is exact."""
         out = dict(self.input_health)
-        out["skipped_steps"] = self._skipped_steps()
+        out["skipped_steps"] = int(self._g_skipped_steps.value)
         graph_health = getattr(getattr(self, "graph", None), "health", None)
         if callable(graph_health):
             out["graph"] = graph_health()
         return out
+
+    def _phase(self, name: str, hist):
+        """Span + histogram for one train-loop phase (input_wait /
+        device_step / hook). obs.timed_span never swallows exceptions —
+        a StopIteration from the input iterator propagates to the
+        loops' break handlers unchanged."""
+        return _obs.timed_span(name, hist, estimator=self._obs_name)
 
     def _emergency_checkpoint(self, err: BaseException) -> None:
         """Best-effort checkpoint before an unrecoverable input error
@@ -357,7 +429,7 @@ class BaseEstimator:
             self.save_checkpoint(step)
             self.finalize_checkpoints()
             if self.model_dir:
-                self.input_health["emergency_checkpoint_step"] = step
+                self._input_meta["emergency_checkpoint_step"] = step
                 print(f"emergency checkpoint at step {step} before "
                       f"re-raising input error: {err}", flush=True)
         except Exception as ce:  # pragma: no cover - disk-full etc.
@@ -394,21 +466,25 @@ class BaseEstimator:
                 transient = (self._input_factory is not None
                              and (retryable_error(e)
                                   or isinstance(e, OSError)))
-                self.input_health["input_failures"] += 1
-                self.input_health["last_input_error"] = str(e)
+                self._ctr_input_failures.inc()
+                self._input_meta["last_input_error"] = str(e)
                 if not transient:
                     self._emergency_checkpoint(e)
                     raise
                 if attempts < self.input_retries:
                     attempts += 1
-                    self.input_health["input_retries"] += 1
-                    time.sleep(min(
-                        self.input_backoff_s * (2 ** (attempts - 1)), 2.0))
+                    self._ctr_input_retries.inc()
+                    with _obs.span("input_retry_backoff",
+                                   estimator=self._obs_name,
+                                   attempt=attempts):
+                        time.sleep(min(
+                            self.input_backoff_s * (2 ** (attempts - 1)),
+                            2.0))
                 elif self._skip_budget > 0:
                     # retries exhausted for this batch: abandon it and
                     # move on (countable degraded event, not a job kill)
                     self._skip_budget -= 1
-                    self.input_health["skipped_batches"] += 1
+                    self._ctr_skipped_batches.inc()
                     attempts = 0
                 else:
                     self._emergency_checkpoint(e)
@@ -421,8 +497,9 @@ class BaseEstimator:
               max_steps: int = 1000) -> Dict[str, float]:
         it = input_fn() if callable(input_fn) else input_fn
         self._input_factory = input_fn if callable(input_fn) else None
-        raw0, it = self._next_input(it)
-        raw_first = _to_device_tree(raw0, self.max_id)
+        with self._phase("input_wait", self._hist_input_wait):
+            raw0, it = self._next_input(it)
+            raw_first = _to_device_tree(raw0, self.max_id)
         first = _merged(raw_first, self.static_batch)
         if self.state is None:
             self._init_state(first)
@@ -438,38 +515,66 @@ class BaseEstimator:
         step = int(self.state.step)
         start_step = step
         losses, metrics = [], []
-        t0 = time.time()
+        # monotonic everywhere in the loop: an NTP step during a long
+        # run must not corrupt rates (same bug class PR 2 fixed in
+        # FileBarrier.wait)
+        t0 = time.monotonic()
         batch = first
         last_log = t0
         while step < max_steps:
-            self.state, loss, metric = self._train_step(
-                self.state, _merged(batch, self.static_batch))
-            step += 1
-            losses.append(loss)
-            metrics.append(metric)
-            if step % self.log_steps == 0:
-                # nanmean: a guard-skipped step's NaN loss/metric must
-                # not turn the whole window's log line into nan
-                lv = float(jnp.nanmean(jnp.stack(losses[-self.log_steps:])))
-                mv = float(jnp.nanmean(jnp.stack(metrics[-self.log_steps:])))
-                now = time.time()
-                rate = self.log_steps / max(now - last_log, 1e-9)
-                last_log = now
-                print(f"step {step}: loss={lv:.4f} metric={mv:.4f} "
-                      f"({rate:.1f} steps/s)", flush=True)
-            if self.ckpt_steps and step % self.ckpt_steps == 0:
-                self.save_checkpoint(step)
-            if step < max_steps:
-                try:
-                    raw, it = self._next_input(it)
-                    batch = _to_device_tree(raw, self.max_id)
-                except StopIteration:
-                    break
+            with _obs.span("train_step", estimator=self._obs_name,
+                           step=step):
+                with self._phase("device_step", self._hist_device_step):
+                    self.state, loss, metric = self._train_step(
+                        self.state, _merged(batch, self.static_batch))
+                step += 1
+                losses.append(loss)
+                metrics.append(metric)
+                do_log = step % self.log_steps == 0
+                do_ckpt = self.ckpt_steps and step % self.ckpt_steps == 0
+                if do_log or do_ckpt:
+                    with self._phase("hook", self._hist_hook):
+                        if do_log:
+                            # nanmean: a guard-skipped step's NaN
+                            # loss/metric must not turn the whole
+                            # window's log line into nan
+                            lv = float(jnp.nanmean(jnp.stack(
+                                losses[-self.log_steps:])))
+                            mv = float(jnp.nanmean(jnp.stack(
+                                metrics[-self.log_steps:])))
+                            now = time.monotonic()
+                            rate = self.log_steps / max(now - last_log,
+                                                        1e-9)
+                            last_log = now
+                            self._g_steps_per_sec.set(rate)
+                            # train thread owns the state buffers here
+                            # (between dispatches) — safe sync point to
+                            # refresh the gauge health() reads
+                            self._g_skipped_steps.set(
+                                self._skipped_steps())
+                            print(f"step {step}: loss={lv:.4f} "
+                                  f"metric={mv:.4f} "
+                                  f"({rate:.1f} steps/s)", flush=True)
+                        if do_ckpt:
+                            self.save_checkpoint(step)
+                if step < max_steps:
+                    try:
+                        with self._phase("input_wait",
+                                         self._hist_input_wait):
+                            raw, it = self._next_input(it)
+                            batch = _to_device_tree(raw, self.max_id)
+                    except StopIteration:
+                        break
         if self.ckpt_steps:
             self.save_checkpoint(step)
         self.finalize_checkpoints()
         if self.profiling and self.model_dir:
             jax.profiler.stop_trace()
+        rate = (step - start_step) / max(time.monotonic() - t0, 1e-9)
+        skipped = self._skipped_steps()
+        self._g_steps_per_sec.set(rate)
+        self._g_skipped_steps.set(skipped)
+        self._g_global_step.set(step)
         return {
             # guard-skipped steps report NaN loss/metric; exclude them
             # from the summary so one bad batch doesn't blank the run's
@@ -477,9 +582,9 @@ class BaseEstimator:
             "loss": _last_finite(losses),
             "metric": float(jnp.nanmean(jnp.stack(metrics)))
             if metrics else 0.0,
-            "steps_per_sec": (step - start_step) / max(time.time() - t0, 1e-9),
+            "steps_per_sec": rate,
             "global_step": step,
-            "skipped_steps": self._skipped_steps(),
+            "skipped_steps": skipped,
             "skipped_batches": self.input_health["skipped_batches"],
         }
 
@@ -492,7 +597,7 @@ class BaseEstimator:
         start_step = step
         loop_losses, loop_metrics = [], []
         last_loss = float("nan")
-        t0 = time.time()
+        t0 = time.monotonic()
         last_log = t0
         logged_at = step
         buf = [first]
@@ -505,20 +610,23 @@ class BaseEstimator:
 
         while step < max_steps:
             want = min(K, max_steps - step)
-            while len(buf) < want and not exhausted:
-                try:
-                    raw, it = self._next_input(it)
-                    buf.append(_to_device_tree(raw, self.max_id))
-                except StopIteration:
-                    exhausted = True
+            if len(buf) < want and not exhausted:
+                with self._phase("input_wait", self._hist_input_wait):
+                    while len(buf) < want and not exhausted:
+                        try:
+                            raw, it = self._next_input(it)
+                            buf.append(_to_device_tree(raw, self.max_id))
+                        except StopIteration:
+                            exhausted = True
             if not buf:
                 break
             if len(buf) == K:
                 if self._train_loop is None:
                     self._train_loop = self._build_train_loop()
                 stacked = jax.tree_util.tree_map(stack, *buf)
-                self.state, l_arr, m_arr = self._train_loop(
-                    self.state, stacked, self.static_batch)
+                with self._phase("device_step", self._hist_device_step):
+                    self.state, l_arr, m_arr = self._train_loop(
+                        self.state, stacked, self.static_batch)
                 # nanmean / last-finite: guard-skipped steps inside the
                 # scanned window report NaN and must not poison the
                 # window aggregate or the reported final loss
@@ -533,8 +641,10 @@ class BaseEstimator:
                 # tail shorter than K: single-step dispatches (the jit
                 # was built in train() before this path was entered)
                 for b in buf:
-                    self.state, l, m = self._train_step(
-                        self.state, _merged(b, self.static_batch))
+                    with self._phase("device_step",
+                                     self._hist_device_step):
+                        self.state, l, m = self._train_step(
+                            self.state, _merged(b, self.static_batch))
                     loop_losses.append((l, 1))
                     loop_metrics.append((m, 1))
                     if np.isfinite(float(l)):
@@ -543,16 +653,24 @@ class BaseEstimator:
             prev = step
             step += done
             buf = []
-            if step - logged_at >= self.log_steps:
-                now = time.time()
-                rate = (step - logged_at) / max(now - last_log, 1e-9)
-                print(f"step {step}: loss={float(loop_losses[-1][0]):.4f} "
-                      f"metric={float(loop_metrics[-1][0]):.4f} "
-                      f"({rate:.1f} steps/s)", flush=True)
-                last_log, logged_at = now, step
-            if self.ckpt_steps and \
-                    step // self.ckpt_steps > prev // self.ckpt_steps:
-                self.save_checkpoint(step)
+            do_log = step - logged_at >= self.log_steps
+            do_ckpt = self.ckpt_steps and \
+                step // self.ckpt_steps > prev // self.ckpt_steps
+            if do_log or do_ckpt:
+                with self._phase("hook", self._hist_hook):
+                    if do_log:
+                        now = time.monotonic()
+                        rate = (step - logged_at) / max(now - last_log,
+                                                        1e-9)
+                        self._g_steps_per_sec.set(rate)
+                        self._g_skipped_steps.set(self._skipped_steps())
+                        print(f"step {step}: "
+                              f"loss={float(loop_losses[-1][0]):.4f} "
+                              f"metric={float(loop_metrics[-1][0]):.4f} "
+                              f"({rate:.1f} steps/s)", flush=True)
+                        last_log, logged_at = now, step
+                    if do_ckpt:
+                        self.save_checkpoint(step)
             if exhausted:
                 break
         if self.ckpt_steps:
@@ -572,12 +690,17 @@ class BaseEstimator:
                 if keep.any() else float("nan")
         else:
             metric = 0.0
+        rate = (step - start_step) / max(time.monotonic() - t0, 1e-9)
+        skipped = self._skipped_steps()
+        self._g_steps_per_sec.set(rate)
+        self._g_skipped_steps.set(skipped)
+        self._g_global_step.set(step)
         return {
             "loss": float(last_loss),
             "metric": metric,
-            "steps_per_sec": (step - start_step) / max(time.time() - t0, 1e-9),
+            "steps_per_sec": rate,
             "global_step": step,
-            "skipped_steps": self._skipped_steps(),
+            "skipped_steps": skipped,
             "skipped_batches": self.input_health["skipped_batches"],
         }
 

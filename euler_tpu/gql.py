@@ -25,6 +25,8 @@ values, udf, label, has, hasLabel, hasKey, hasId, orderBy, limit, as.
 from __future__ import annotations
 
 import ctypes
+import threading
+import weakref
 from typing import Dict, Optional
 
 import numpy as np
@@ -67,6 +69,11 @@ class Query:
     def __init__(self, lib, handle: int):
         self._lib = lib
         self._h = handle
+        # guards _h for stats()/close(): a /metrics scrape thread polls
+        # stats() via the bind_obs collector while the owner may be
+        # close()ing — without the lock that is a use-after-free on the
+        # native handle. run() stays lock-free (owner-thread hot path).
+        self._mu = threading.Lock()
 
     @classmethod
     def local(cls, engine, index_spec: str = "", seed: int = 0) -> "Query":
@@ -148,15 +155,51 @@ class Query:
         import numpy as np
 
         out = np.zeros(4, dtype=np.uint64)
-        check(self._lib, self._lib.etq_stats(
-            self._h, out.ctypes.data_as(_libmod.c_u64p)))
+        with self._mu:
+            if not self._h:
+                raise EngineError("query proxy is closed")
+            check(self._lib, self._lib.etq_stats(
+                self._h, out.ctypes.data_as(_libmod.c_u64p)))
         return {"queries": int(out[0]), "errors": int(out[1]),
                 "total_us": int(out[2]), "last_us": int(out[3])}
 
+    def bind_obs(self, name: str) -> None:
+        """Bridge this proxy's ENGINE-SIDE stats() counters into
+        euler_tpu.obs gauges (gql_proxy_*{proxy=name}), refreshed at
+        every registry scrape/snapshot by a collector. The collector
+        holds only a weakref: a collected or close()d proxy drops off
+        the next scrape instead of pinning the native handle."""
+        from euler_tpu import obs
+
+        reg = obs.default_registry()
+        gauges = {
+            k: reg.gauge(f"gql_proxy_{k}",
+                         f"engine-side query proxy {k}",
+                         ("proxy",)).labels(proxy=name)
+            for k in ("queries", "errors", "total_us", "last_us")}
+        ref = weakref.ref(self)
+
+        def _collect():
+            q = ref()
+            if q is None or not q._h:
+                return False  # proxy gone: collector self-removes
+            try:
+                st = q.stats()
+            except EngineError:  # closed between the check and the call
+                return False
+            for k, v in st.items():
+                g = gauges.get(k)
+                if g is not None:
+                    g.set(v)
+
+        reg.add_collector(_collect)
+        _ensure_udf_cache_obs()
+
     def close(self) -> None:
-        if self._h:
-            self._lib.etq_free(self._h)
-            self._h = 0
+        with self._mu:
+            if self._h:
+                self._lib.etq_free(self._h)
+                self._h = 0
 
     def __del__(self):  # best-effort
         try:
@@ -294,6 +337,7 @@ def register_udf(name: str, fn) -> None:
     UDF should disable the cache with udf_cache_set_capacity(0).
     """
     lib = _libmod.load()
+    _ensure_udf_cache_obs()  # local-mode UDF users get the gauges too
 
     @_UDF_CBTYPE
     def cb(params, n_params, offs, n_rows, vals, n_vals, out):
@@ -338,6 +382,34 @@ def udf_cache_stats() -> dict:
                             ctypes.byref(e), ctypes.byref(b))
     return {"hits": h.value, "misses": m.value, "entries": e.value,
             "bytes": b.value}
+
+
+_udf_obs_once = threading.Lock()
+_udf_obs_done = False
+
+
+def _ensure_udf_cache_obs() -> None:
+    """Register (once per process) the collector mirroring the native
+    UDF result-cache counters into gql_udf_cache_* gauges. Called from
+    bind_obs — i.e. only after the native lib is known to be loaded, so
+    a /metrics scrape never triggers a first-time lib build."""
+    global _udf_obs_done
+    with _udf_obs_once:
+        if _udf_obs_done:
+            return
+        _udf_obs_done = True
+    from euler_tpu import obs
+
+    reg = obs.default_registry()
+    gauges = {k: reg.gauge(f"gql_udf_cache_{k}",
+                           f"UDF result-cache {k} (see udf_cache_stats)")
+              for k in ("hits", "misses", "entries", "bytes")}
+
+    def _collect():
+        for k, v in udf_cache_stats().items():
+            gauges[k].set(v)
+
+    reg.add_collector(_collect)
 
 
 def udf_cache_clear() -> None:

@@ -26,12 +26,16 @@ tools/chaos_proxy.py instead.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import random
 import threading
 import time
 from typing import Tuple
 
+from euler_tpu import obs as _obs
 from euler_tpu.core.lib import EngineError
+
+_CHAOS_IDS = itertools.count()
 
 
 @dataclasses.dataclass
@@ -74,6 +78,17 @@ class ChaosGraphEngine:
         self._mu = threading.Lock()
         self._calls = 0
         self._counters = {"errors": 0, "delayed": 0, "truncated": 0}
+        # mirror injected faults onto the obs registry so chaos tests
+        # can assert fault injection and observability agree on counts
+        # (chaos_injected_total{engine=...,kind=error|delay|truncate})
+        self._obs_name = f"chaos{next(_CHAOS_IDS)}"
+        injected = _obs.default_registry().counter(
+            "chaos_injected_total",
+            "faults injected by ChaosGraphEngine",
+            ("engine", "kind"))
+        self._obs_kind = {
+            k: injected.labels(engine=self._obs_name, kind=k)
+            for k in ("error", "delay", "truncate")}
 
     # -- schedule ----------------------------------------------------------
     def _decide(self, idx: int):
@@ -128,10 +143,12 @@ class ChaosGraphEngine:
             if delay > 0:
                 with self._mu:
                     self._counters["delayed"] += 1
+                self._obs_kind["delay"].inc()
                 time.sleep(delay)
             if fail:
                 with self._mu:
                     self._counters["errors"] += 1
+                self._obs_kind["error"].inc()
                 raise EngineError(
                     f"chaos: rpc to shard failed after retries "
                     f"(injected at call {idx}, op {name})")
@@ -139,6 +156,7 @@ class ChaosGraphEngine:
             if trunc:
                 with self._mu:
                     self._counters["truncated"] += 1
+                self._obs_kind["truncate"].inc()
                 out = self._truncate(out)
             return out
 

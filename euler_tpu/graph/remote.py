@@ -20,6 +20,7 @@ reference's whole design exists to amortize.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import random
 import threading
 import time
@@ -27,8 +28,26 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from euler_tpu import obs as _obs
 from euler_tpu.core.lib import EngineError
 from euler_tpu.gql import Query
+
+# process-wide engine numbering: the per-instance label value on the
+# shared graph_rpc_* metrics (euler_tpu.obs), so N engines in one
+# process report side by side and health() stays an exact per-engine
+# view
+_ENGINE_IDS = itertools.count()
+
+# health() counter keys ↔ registry counters (one definition: the compat
+# view iterates this, so view and bookkeeping cannot drift)
+_RPC_COUNTERS = {
+    "calls": "graph rpc calls issued (before any retry)",
+    "retries": "retry sleep cycles taken on transport failures",
+    "failovers": "calls that failed then succeeded on a retry",
+    "degraded": "default_id-padded results served (degrade=True)",
+    "deadline_exhausted": "calls that ran out of retry budget",
+    "health_merge_errors": "proxy stats() failures during health()",
+}
 
 # Error-text markers for failures worth retrying: transport-level faults
 # (a dead/restarting shard surfaces as "rpc to H:P failed after retries"
@@ -147,9 +166,24 @@ class RemoteGraphEngine:
         self._rng = np.random.default_rng(seed if seed else None)
         self._backoff_rng = random.Random(seed ^ 0x5EED if seed else None)
         self._health_mu = threading.Lock()
-        self._health = {"calls": 0, "retries": 0, "failovers": 0,
-                        "degraded": 0, "deadline_exhausted": 0,
-                        "last_error": None}
+        # counters live on the obs registry (labeled by engine instance);
+        # health() is a VIEW over them — no parallel bookkeeping
+        self._obs_name = f"remote{next(_ENGINE_IDS)}"
+        reg = _obs.default_registry()
+        lab = {"engine": self._obs_name}
+        self._ctr = {
+            k: reg.counter(f"graph_rpc_{k}_total", h,
+                           ("engine",)).labels(**lab)
+            for k, h in _RPC_COUNTERS.items()}
+        self._ctr_backoff_s = reg.counter(
+            "graph_rpc_backoff_seconds_total",
+            "seconds slept in retry backoff", ("engine",)).labels(**lab)
+        self._hist_call_ms = reg.histogram(
+            "graph_rpc_ms", "end-to-end graph rpc latency incl. retries",
+            ("engine",)).labels(**lab)
+        self._last_error: Optional[str] = None
+        _obs.register_health(self._obs_name, self.health)
+        self.query.bind_obs(self._obs_name)
         self._strays: list = []  # abandoned timed-out attempt threads
 
     # -- health / retry machinery ------------------------------------------
@@ -157,20 +191,29 @@ class RemoteGraphEngine:
         """Counter surface for ops/bench artifacts: calls, retries (sleep
         cycles), failovers (calls that failed then succeeded on retry),
         degraded (padded results served), deadline_exhausted, last_error,
-        plus the proxy's own query/error totals."""
+        plus the proxy's own query/error totals. A compatibility VIEW
+        over this engine's euler_tpu.obs registry children — the same
+        numbers a /metrics scrape reports, by construction."""
+        out = {k: int(self._ctr[k].value) for k in
+               ("calls", "retries", "failovers", "degraded",
+                "deadline_exhausted")}
         with self._health_mu:
-            out = dict(self._health)
+            out["last_error"] = self._last_error
         try:
             out.update({f"proxy_{k}": v
                         for k, v in self.query.stats().items()
                         if k in ("queries", "errors")})
-        except Exception:
-            pass  # closed / stats unavailable — counters still useful
+        except (EngineError, OSError):
+            # closed / stats unavailable — the merge failure is COUNTED
+            # (it was silently swallowed pre-obs), local counters still
+            # serve
+            self._ctr["health_merge_errors"].inc()
+        out["health_merge_errors"] = int(
+            self._ctr["health_merge_errors"].value)
         return out
 
     def _bump(self, key: str, n: int = 1) -> None:
-        with self._health_mu:
-            self._health[key] += n
+        self._ctr[key].inc(n)
 
     # bound on live abandoned attempt threads: past this, timed attempts
     # fail fast instead of spawning — a long black-holed outage with
@@ -218,38 +261,51 @@ class RemoteGraphEngine:
         """query.run under RetryPolicy: retryable (transport) failures
         back off with full jitter until the deadline; semantic errors
         raise at once; an exhausted budget raises
-        RetryDeadlineExceeded."""
+        RetryDeadlineExceeded. The whole call (retries + backoff
+        included) runs under a `graph_rpc` span and lands in the
+        graph_rpc_ms histogram, success or raise."""
         pol = self.retry
         self._bump("calls")
-        deadline = time.monotonic() + max(pol.deadline_s, 0.0)
-        attempt = 0
-        while True:
-            try:
-                out = self._attempt(gql, feed)
-                if attempt:
-                    # the call came back after ≥1 transport failure: the
-                    # shard (or its replacement channel) recovered
-                    self._bump("failovers")
-                return out
-            except EngineError as e:
-                if not retryable_error(e):
-                    raise
-                attempt += 1
-                with self._health_mu:
-                    self._health["last_error"] = str(e)
-                now = time.monotonic()
-                exhausted = (now >= deadline
-                             or (pol.max_attempts
-                                 and attempt >= pol.max_attempts))
-                if exhausted:
-                    self._bump("deadline_exhausted")
-                    raise RetryDeadlineExceeded(
-                        f"graph rpc gave up after {attempt} attempt(s) "
-                        f"({pol.deadline_s:.1f}s deadline): {e}") from e
-                self._bump("retries")
-                sleep = min(pol.backoff_s(attempt, self._backoff_rng),
-                            max(deadline - now, 0.0))
-                time.sleep(sleep)
+        with _obs.timed_span("graph_rpc", self._hist_call_ms,
+                             engine=self._obs_name, gql=gql[:80]) as sp:
+            deadline = time.monotonic() + max(pol.deadline_s, 0.0)
+            attempt = 0
+            while True:
+                try:
+                    out = self._attempt(gql, feed)
+                    if attempt:
+                        # the call came back after ≥1 transport failure:
+                        # the shard (or its replacement channel)
+                        # recovered
+                        self._bump("failovers")
+                    sp.set(attempts=attempt + 1)
+                    return out
+                except EngineError as e:
+                    if not retryable_error(e):
+                        raise
+                    attempt += 1
+                    with self._health_mu:
+                        self._last_error = str(e)
+                    now = time.monotonic()
+                    exhausted = (now >= deadline
+                                 or (pol.max_attempts
+                                     and attempt >= pol.max_attempts))
+                    if exhausted:
+                        self._bump("deadline_exhausted")
+                        sp.set(attempts=attempt, exhausted=True)
+                        raise RetryDeadlineExceeded(
+                            f"graph rpc gave up after {attempt} "
+                            f"attempt(s) ({pol.deadline_s:.1f}s "
+                            f"deadline): {e}") from e
+                    self._bump("retries")
+                    sleep = min(
+                        pol.backoff_s(attempt, self._backoff_rng),
+                        max(deadline - now, 0.0))
+                    with _obs.span("graph_rpc_backoff",
+                                   engine=self._obs_name,
+                                   attempt=attempt):
+                        time.sleep(sleep)
+                    self._ctr_backoff_s.inc(sleep)
 
     def _note_degraded(self) -> None:
         self._bump("degraded")
@@ -565,6 +621,7 @@ class RemoteGraphEngine:
 
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
+        _obs.unregister_health(self._obs_name)
         # abandoned timed-out attempts still hold exec handles into the
         # query proxy; give them a moment to unblock (their sockets die
         # when the far end/proxy shuts down) and LEAK the proxy rather
@@ -575,6 +632,10 @@ class RemoteGraphEngine:
         for th in strays:
             th.join(max(deadline - time.monotonic(), 0.0))
         if any(th.is_alive() for th in strays):
-            self.query._h = 0  # leak: a stray thread still uses the handle
+            # leak: a stray thread still uses the handle (under the
+            # query lock so a concurrent stats() scrape can't race the
+            # zeroing)
+            with self.query._mu:
+                self.query._h = 0
             return
         self.query.close()

@@ -9,6 +9,7 @@ multi-chip mode, and __graft_entry__.dryrun_multichip.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -17,6 +18,7 @@ import optax
 from flax import linen as nn
 from jax.sharding import Mesh
 
+from euler_tpu import obs as _obs
 from euler_tpu.parallel.mesh import shard_batch
 from euler_tpu.parallel.sharded_embedding import apply_param_shardings
 
@@ -94,4 +96,21 @@ def make_spmd_train_step(model: nn.Module,
             new_state = apply_update(None)
         return new_state, loss, metric
 
-    return jax.jit(train_step, donate_argnums=(0,))
+    jitted = jax.jit(train_step, donate_argnums=(0,))
+    reg = _obs.default_registry()
+    c_steps = reg.counter("spmd_steps_total",
+                          "SPMD train-step dispatches")
+    h_dispatch = reg.histogram(
+        "spmd_dispatch_ms",
+        "host-side SPMD train-step dispatch latency (async: excludes "
+        "device time the host did not wait for)")
+
+    def stepped(state, batch):
+        t0 = time.monotonic()
+        with _obs.span("spmd_train_step"):
+            out = jitted(state, batch)
+        c_steps.inc()
+        h_dispatch.observe((time.monotonic() - t0) * 1000.0)
+        return out
+
+    return stepped

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""View / validate chrome://tracing JSON dumps from euler_tpu.obs.
+
+Any run that called `obs.dump_trace(path)` (or `bench.py --trace path`)
+leaves a Trace Event Format file; this CLI summarizes it in the
+terminal — per-name span counts, total/mean/max durations, the
+slowest individual spans — so the host/device time split is readable
+without opening a browser. For the full flame view load the same file
+in chrome://tracing or https://ui.perfetto.dev.
+
+    python tools/trace_dump.py run.json
+    python tools/trace_dump.py run.json --top 20
+    python tools/trace_dump.py --self-test   # exercises span → export →
+                                             # reload end to end (CI)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_trace(path: str) -> dict:
+    with open(path) as f:
+        trace = json.load(f)
+    if not isinstance(trace, dict) or "traceEvents" not in trace:
+        raise ValueError(
+            f"{path}: not a chrome trace (no traceEvents key)")
+    return trace
+
+
+def summarize(trace: dict, top: int = 12) -> str:
+    events = [e for e in trace.get("traceEvents", [])
+              if e.get("ph") == "X"]
+    if not events:
+        return "trace holds no complete ('X') span events"
+    per = {}
+    for e in events:
+        n, d = e["name"], float(e.get("dur", 0.0))
+        tot, cnt, mx = per.get(n, (0.0, 0, 0.0))
+        per[n] = (tot + d, cnt + 1, max(mx, d))
+    t_lo = min(float(e["ts"]) for e in events)
+    t_hi = max(float(e["ts"]) + float(e.get("dur", 0.0)) for e in events)
+    lines = [
+        f"{len(events)} spans over {(t_hi - t_lo) / 1e3:.1f} ms "
+        f"({len(per)} distinct names, "
+        f"{len({e['tid'] for e in events})} thread(s))",
+        "",
+        f"{'name':<28} {'count':>7} {'total ms':>10} {'mean ms':>9} "
+        f"{'max ms':>9}",
+    ]
+    by_total = sorted(per.items(), key=lambda kv: -kv[1][0])
+    for name, (tot, cnt, mx) in by_total[:top]:
+        lines.append(f"{name[:28]:<28} {cnt:>7} {tot / 1e3:>10.2f} "
+                     f"{tot / cnt / 1e3:>9.3f} {mx / 1e3:>9.3f}")
+    if len(by_total) > top:
+        lines.append(f"... {len(by_total) - top} more names (--top N)")
+    lines += ["", "flame view: load this file in chrome://tracing or "
+                  "https://ui.perfetto.dev"]
+    return "\n".join(lines)
+
+
+def self_test() -> int:
+    """End-to-end: spans → ring → export → reload → field/nesting
+    checks. Zero imports beyond euler_tpu.obs; exits nonzero on any
+    violated invariant."""
+    from euler_tpu.obs import Tracer
+
+    tr = Tracer(capacity=64)
+    with tr.span("outer", kind="self_test"):
+        with tr.span("inner"):
+            time.sleep(0.002)
+        with tr.span("inner"):
+            pass
+    fd, path = tempfile.mkstemp(suffix=".json")
+    os.close(fd)
+    try:
+        tr.export(path)
+        trace = load_trace(path)
+        ev = trace["traceEvents"]
+        assert len(ev) == 3, f"expected 3 events, got {len(ev)}"
+        for e in ev:
+            assert e["ph"] == "X", e
+            assert isinstance(e["ts"], (int, float)), e
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0, e
+            assert "pid" in e and "tid" in e and "name" in e, e
+        outer = next(e for e in ev if e["name"] == "outer")
+        inners = [e for e in ev if e["name"] == "inner"]
+        assert len(inners) == 2
+        for i in inners:
+            assert i["args"]["parent_id"] == outer["args"]["span_id"]
+            assert i["ts"] >= outer["ts"]
+            assert i["ts"] + i["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+        assert outer["dur"] >= 2000, outer  # the 2ms sleep, in µs
+        print(summarize(trace))
+        print("\ntrace_dump self-test OK")
+        return 0
+    finally:
+        os.unlink(path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a euler_tpu.obs chrome trace")
+    ap.add_argument("path", nargs="?", help="trace JSON to summarize")
+    ap.add_argument("--top", type=int, default=12,
+                    help="show the N heaviest span names (default 12)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="exercise span → export → reload and exit")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    if not args.path:
+        ap.error("give a trace path or --self-test")
+    print(summarize(load_trace(args.path), top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
