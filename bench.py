@@ -181,7 +181,8 @@ def _sampler_variant(args, sampler, has_uniform_path: bool = True) -> str:
     modes whose draw never consults the uniform lever (layerwise's pool
     draw) — recording 'uniform' there would mislabel the artifact."""
     if sampler is None:
-        return "host"
+        return "host_pipelined" if int(
+            getattr(args, "host_pipeline", 0) or 0) > 1 else "host"
     if getattr(sampler, "fused", False):
         return "fused"
     if getattr(sampler, "alias", False):
@@ -360,26 +361,43 @@ def run_walk_bench(args, graph, sampler, cache_state, setup_secs,
             learning_rate=0.01, log_steps=1 << 30, checkpoint_steps=0,
             max_id=n_nodes - 1, steps_per_loop=spl))
 
+        def one_batch():
+            # one independent host-walk batch — thread-safe, so
+            # --host_pipeline N can build N of them concurrently
+            roots = graph.sample_node(batch, -1)
+            walks = graph.random_walk(roots, walk_len)
+            pairs = gen_pair(walks, lwin, rwin)
+            flat = pairs.reshape(-1, 2)
+            negs = graph.sample_node(
+                flat.shape[0] * num_negs, -1).reshape(-1, num_negs)
+            return {"src": flat[:, 0], "pos": flat[:, 1], "negs": negs}
+
         def gen():
             while True:
-                roots = graph.sample_node(batch, -1)
-                walks = graph.random_walk(roots, walk_len)
-                pairs = gen_pair(walks, lwin, rwin)
-                flat = pairs.reshape(-1, 2)
-                negs = graph.sample_node(
-                    flat.shape[0] * num_negs, -1).reshape(-1, num_negs)
-                yield {"src": flat[:, 0], "pos": flat[:, 1], "negs": negs}
+                yield one_batch()
 
     def to_dev(b):
         return jax.device_put(_to_device_tree(b, est.max_id))
 
-    it = Prefetcher(gen(), depth=3, transform=to_dev)
+    from euler_tpu.estimator.prefetch import make_feeder
+
+    w = int(getattr(args, "host_pipeline", 0) or 0)
+    if sampler is None and w > 1:
+        it = make_feeder(one_batch, workers=w, depth=max(3, w),
+                         transform=to_dev)
+    else:
+        if w > 1:
+            print("bench: --host_pipeline is a host-feeder lever; the "
+                  "device-sampled walk path keeps its ordered seed "
+                  "stream (serial feeder)", file=sys.stderr)
+        it = Prefetcher(gen(), depth=3, transform=to_dev)
     warmup = spl + 2 if spl > 1 else 3
     est.train(iter([next(it) for _ in range(warmup)]), max_steps=warmup)
     _obs_region_start()
     t0 = time.time()
     res = est.train(it, max_steps=warmup + steps)
     dt = time.time() - t0
+    _close_iter(it)
     done = res["global_step"] - warmup
     n_pairs = len([1 for i in range(walk_len + 1)
                    for off in (-1, 1) if 0 <= i + off <= walk_len])
@@ -411,6 +429,8 @@ def run_walk_bench(args, graph, sampler, cache_state, setup_secs,
             "graph_cache": cache_state,
             "setup_secs": round(setup_secs, 1),
             "cpu_fallback": cpu_fallback,
+            "host_pipeline": int(getattr(args, "host_pipeline", 0) or 0),
+            "cache": _cache_detail(graph),
             "health": _bench_health(graph, res),
         },
     }
@@ -451,14 +471,14 @@ def run_layerwise_bench(args, graph, store, sampler, cache_state,
         graph, None, label_fid="label", label_dim=num_classes,
         feature_store=store, device_sampler=sampler)
 
-    it = Prefetcher(est.train_input_fn(), depth=3,
-                    transform=_make_to_dev(est))
+    it = _make_bench_feeder(est, args, _make_to_dev(est))
     warmup = spl + 2 if spl > 1 else 3
     est.train(iter([next(it) for _ in range(warmup)]), max_steps=warmup)
     _obs_region_start()
     t0 = time.time()
     res = est.train(it, max_steps=warmup + steps)
     dt = time.time() - t0
+    _close_iter(it)
     done = res["global_step"] - warmup
     nodes_per_sec = done * (batch + sum(sizes)) / dt
     value = nodes_per_sec / max(jax.device_count(), 1)
@@ -486,6 +506,8 @@ def run_layerwise_bench(args, graph, store, sampler, cache_state,
             "graph_cache": cache_state,
             "setup_secs": round(setup_secs, 1),
             "cpu_fallback": cpu_fallback,
+            "host_pipeline": int(getattr(args, "host_pipeline", 0) or 0),
+            "cache": _cache_detail(graph),
             "health": _bench_health(graph, res),
         },
     }
@@ -537,6 +559,48 @@ def _make_to_dev(est):
     return to_dev
 
 
+def _make_bench_feeder(est, args, transform, depth=3):
+    """The bench input iterator: the single prefetch thread, or — with
+    --host_pipeline N — the multi-worker feeder over the estimator's
+    thread-safe batch factory. Modes without a factory (device-sampler
+    paths, whose per-batch seed stream is ordered) fall back to
+    serialized next() with a stderr note rather than silently changing
+    the measured semantics."""
+    from euler_tpu.estimator.prefetch import make_feeder
+
+    w = int(getattr(args, "host_pipeline", 0) or 0)
+    if w > 1:
+        src = est._train_batch_factory()
+        if src is None:
+            print("bench: --host_pipeline has no thread-safe batch "
+                  "factory in this mode — K workers share one "
+                  "serialized input stream (transform/prefetch still "
+                  "overlap)", file=sys.stderr)
+            src = est.train_input_fn()
+        return make_feeder(src, workers=w, depth=max(depth, w),
+                           transform=transform)
+    return make_feeder(est.train_input_fn(), workers=0, depth=depth,
+                       transform=transform)
+
+
+def _close_iter(it) -> None:
+    """Reclaim a bench feeder's worker thread(s) right after the timed
+    section: an abandoned feeder keeps issuing graph RPCs during the
+    post-run health/obs snapshot (and into any later leg in the same
+    process), and the prefetchers' contract is close-or-with."""
+    closer = getattr(it, "close", None)
+    if callable(closer):
+        closer()
+
+
+def _cache_detail(graph):
+    """detail.cache: client-cache counters when --client_cache wrapped
+    the engine (None otherwise) — the artifact must show whether the
+    measured run was cache-served and how warm it ran."""
+    stats = getattr(graph, "cache_stats", None)
+    return stats() if callable(stats) else None
+
+
 def run_bench(args):
     import jax
 
@@ -558,6 +622,19 @@ def run_bench(args):
                   "different draw algorithms — run them as separate "
                   "A/B legs", file=sys.stderr)
             sys.exit(2)
+    # --client_cache intercepts the deterministic host reads
+    # (get_full_neighbor / get_dense_feature) — only the host feeder
+    # path issues any; wrapping a device-sampler run would stamp a
+    # dead cache onto the artifact
+    if args.client_cache and not args.host_sampler:
+        print("bench: --client_cache needs the host feeder path "
+              "(--host_sampler); device-sampler modes fetch features "
+              "from HBM tables, not the graph service", file=sys.stderr)
+        sys.exit(2)
+    if args.client_cache and args.layerwise:
+        print("bench: --layerwise has no host feeder mode for "
+              "--client_cache to intercept", file=sys.stderr)
+        sys.exit(2)
     # a forced --uniform_path on a config with no uniform path must die
     # HERE, not at detail-record time after the measured run completed
     # (the in-_uniform_effective refusal is the backstop for tools that
@@ -618,6 +695,11 @@ def run_bench(args):
         use_cache=not (args.no_cache or args.smoke or cpu_fallback
                        or args.host_sampler))
     setup_secs = time.time() - setup_t0
+    if args.client_cache:
+        from euler_tpu.graph import CachedGraphEngine
+
+        graph = CachedGraphEngine(
+            graph, budget_bytes=int(args.client_cache) << 20)
     spl_walk = args.steps_per_loop or (1 if (args.smoke or cpu_fallback)
                                        else 8)
     if args.walk:
@@ -674,8 +756,7 @@ def run_bench(args):
     # the estimator already trims store-mode batches to rows (+
     # infer_ids, host-only); transfer in the prefetch thread so the
     # main loop never waits on the link
-    it = Prefetcher(est.train_input_fn(), depth=3,
-                    transform=_make_to_dev(est))
+    it = _make_bench_feeder(est, args, _make_to_dev(est))
 
     # warmup (compile) then timed steps. The headline value is the
     # AGGREGATE rate over all measured steps; per-window rates (and the
@@ -697,6 +778,7 @@ def run_bench(args):
         total_dt += dt
         window_rates.append((res["global_step"] - done_before) / dt)
         done_before = res["global_step"]
+    _close_iter(it)
 
     if args.act_cache:
         # each of the len(fanouts) layers aggregates the SAME sampled
@@ -767,6 +849,8 @@ def run_bench(args):
             "graph_cache": cache_state,
             "setup_secs": round(setup_secs, 1),
             "cpu_fallback": cpu_fallback,
+            "host_pipeline": int(getattr(args, "host_pipeline", 0) or 0),
+            "cache": _cache_detail(graph),
             "health": _bench_health(graph, res),
         },
     }
@@ -853,6 +937,23 @@ def build_argparser():
                          "counts actually-aggregated edges, so compare "
                          "configs by detail.nodes_per_sec (candidate "
                          "config, excluded from the cache gate)")
+    ap.add_argument("--host_pipeline", type=int, default=0,
+                    help="N > 1 runs the multi-worker host feeder (N "
+                         "sampler threads over a thread-safe batch "
+                         "factory, ordered delivery); 0/1 keeps the "
+                         "single prefetch thread. Recorded as "
+                         "detail.host_pipeline (host modes also flip "
+                         "detail.sampler_variant to host_pipelined)")
+    ap.add_argument("--client_cache", type=int, default=0,
+                    help="MB > 0 wraps the host graph engine in the "
+                         "immutable-graph client cache "
+                         "(CachedGraphEngine): deterministic neighbor/"
+                         "feature reads served client-side, only "
+                         "misses over the wire; stats recorded as "
+                         "detail.cache. Needs --host_sampler (the only "
+                         "path issuing host feature reads); the feeder "
+                         "A/B proper is tools/bench_host.py --mode "
+                         "feeder")
     ap.add_argument("--steps_per_loop", type=int, default=0,
                     help="0 = auto (32 on TPU since the round-5 on-chip "
                          "A/B, 1 in smoke/CPU mode): lax.scan window per "
@@ -954,7 +1055,9 @@ def main(argv=None):
                           and not args.act_cache
                           and not args.remat
                           and args.int8_features
-                          and not args.degree_sorted)
+                          and not args.degree_sorted
+                          and not args.host_pipeline
+                          and not args.client_cache)
         if result.get("detail", {}).get("backend") == "tpu" \
                 and default_shapes:
             # only canonical default-config runs refresh the cache — a

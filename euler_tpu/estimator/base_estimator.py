@@ -143,6 +143,18 @@ class BaseEstimator:
             self.params_cfg.get("input_backoff_s", 0.1))
         self._skip_budget = int(
             self.params_cfg.get("skip_batch_budget", 0))
+        # multi-worker host feeder (ISSUE 4): feeder_workers > 1 wraps
+        # train()'s input stream in a ParallelPrefetcher — K sampler
+        # threads feeding an ordered bounded queue. Estimators whose
+        # batches are independent (NodeEstimator host mode) expose a
+        # thread-safe per-batch factory so sampling itself runs in
+        # parallel; otherwise only transform/prefetch overlap. Batch
+        # ORDER stays deterministic per feeder, but which random roots
+        # land in which position is not bit-reproducible vs serial.
+        self.feeder_workers = int(self.params_cfg.get("feeder_workers", 0))
+        self.feeder_depth = int(
+            self.params_cfg.get("feeder_depth", 0)) or None
+        self._live_feeder = None
         self._input_factory = None
         # input-path counters live on the obs registry (children labeled
         # by estimator instance); input_health / health() are VIEWS over
@@ -436,6 +448,35 @@ class BaseEstimator:
             print(f"emergency checkpoint failed ({ce}); "
                   f"re-raising original input error", flush=True)
 
+    # -- multi-worker feeder -----------------------------------------------
+    def _train_batch_factory(self):
+        """Thread-safe zero-arg one-batch callable for the multi-worker
+        feeder, or None when the input stream must stay serialized
+        (subclass hook — see NodeEstimator)."""
+        return None
+
+    def _wrap_feeder(self, input_fn, use_factory: bool = True):
+        """ParallelPrefetcher over the train input: the subclass batch
+        factory when one exists AND the caller passed the estimator's
+        own train_input_fn (parallel sampling); a CUSTOM input_fn's
+        stream is never substituted — it wraps with serialized next()
+        so its schedule (e.g. a chaos kill script) is preserved."""
+        from euler_tpu.estimator.prefetch import ParallelPrefetcher
+
+        src = self._train_batch_factory() if use_factory else None
+        if src is None:
+            src = input_fn() if callable(input_fn) else input_fn
+        f = ParallelPrefetcher(src, workers=self.feeder_workers,
+                               depth=self.feeder_depth,
+                               name=f"{self._obs_name}_train")
+        self._live_feeder = f
+        return f
+
+    def _close_live_feeder(self) -> None:
+        f, self._live_feeder = self._live_feeder, None
+        if f is not None:
+            f.close()
+
     def _next_input(self, it):
         """next(it) with transient-failure retry (exponential backoff)
         and the skip-batch budget. Returns (raw_batch, it) — the
@@ -462,8 +503,11 @@ class BaseEstimator:
                 # retry needs a recreatable source: a generator that
                 # raised is dead (next() would yield StopIteration and
                 # silently END training) — without the input_fn factory
-                # every failure is unrecoverable
-                transient = (self._input_factory is not None
+                # every failure is unrecoverable. A RESILIENT feeder
+                # (ParallelPrefetcher) survives its own errors, so it
+                # is retryable even when passed as a bare iterator.
+                transient = ((self._input_factory is not None
+                              or getattr(it, "resilient", False))
                              and (retryable_error(e)
                                   or isinstance(e, OSError)))
                 self._ctr_input_failures.inc()
@@ -489,14 +533,42 @@ class BaseEstimator:
                 else:
                     self._emergency_checkpoint(e)
                     raise
-                if self._input_factory is not None:
-                    it = self._input_factory()  # the raised iter is dead
+                if self._input_factory is not None and not getattr(
+                        it, "resilient", False):
+                    # the raised iter is dead — close it first (a feeder
+                    # holds worker threads; a generator's close() is a
+                    # no-op) then recreate. A resilient feeder
+                    # (ParallelPrefetcher) delivers the error in-stream
+                    # and keeps producing: just call next() again.
+                    closer = getattr(it, "close", None)
+                    if callable(closer):
+                        try:
+                            closer()
+                        except Exception:
+                            pass
+                    it = self._input_factory()
 
     # -- drivers -----------------------------------------------------------
     def train(self, input_fn: Callable[[], Iterator[Dict]],
               max_steps: int = 1000) -> Dict[str, float]:
+        if self.feeder_workers > 1 and callable(input_fn):
+            # multi-worker feeder: K sampler threads over the input
+            # stream; it owns worker threads, so train() reclaims it on
+            # every exit path and recreation-on-failure rebuilds it
+            use_factory = input_fn == getattr(self, "train_input_fn",
+                                              None)
+            it = self._wrap_feeder(input_fn, use_factory)
+            self._input_factory = lambda: self._wrap_feeder(input_fn,
+                                                            use_factory)
+            try:
+                return self._train_impl(it, max_steps)
+            finally:
+                self._close_live_feeder()
         it = input_fn() if callable(input_fn) else input_fn
         self._input_factory = input_fn if callable(input_fn) else None
+        return self._train_impl(it, max_steps)
+
+    def _train_impl(self, it, max_steps: int) -> Dict[str, float]:
         with self._phase("input_wait", self._hist_input_wait):
             raw0, it = self._next_input(it)
             raw_first = _to_device_tree(raw0, self.max_id)
@@ -797,7 +869,16 @@ class BaseEstimator:
             return {**{f"train_{k}": v for k, v in train_res.items()},
                     **{f"eval_{k}": v for k, v in eval_res.items()}}
 
-        it = train_input_fn() if callable(train_input_fn) else train_input_fn
+        owned_feeder = self.feeder_workers > 1 and callable(train_input_fn)
+        if owned_feeder:
+            # one feeder spans every train segment (segments pass it as
+            # a bare iterator, so train() doesn't wrap or close it)
+            it = self._wrap_feeder(
+                train_input_fn,
+                train_input_fn == getattr(self, "train_input_fn", None))
+        else:
+            it = train_input_fn() if callable(train_input_fn) \
+                else train_input_fn
         best_metric, best_step, best_snap = -float("inf"), 0, None
         train_res: Dict[str, float] = {}
         step = 0
@@ -826,6 +907,8 @@ class BaseEstimator:
                     break  # train iterator exhausted mid-segment
         finally:
             self.ckpt_steps = saved_ckpt_steps
+            if owned_feeder:
+                self._close_live_feeder()
         if keep_best and best_snap is not None:
             self.state = self.state.replace(
                 params=jax.tree_util.tree_map(jnp.asarray,

@@ -146,6 +146,26 @@ class NodeEstimator(BaseEstimator):
     def train_input_fn(self):
         return self._batches(self.train_node_type)
 
+    def _train_batch_factory(self):
+        """Thread-safe per-call train batch builder for the multi-worker
+        feeder (params["feeder_workers"] > 1): every batch is an
+        independent root draw + flow expansion + label fetch, so K
+        workers can build K batches concurrently against the graph
+        service. Device-sampler mode returns None — its per-batch seed
+        stream is ordered, and parallel claims would decouple seed
+        order from batch order — so the feeder falls back to
+        serialized next()."""
+        if self.device_sampler is not None:
+            return None
+        flow = self.dataflow
+
+        def one_batch():
+            roots = self.graph.sample_node(self.batch_size,
+                                           self.train_node_type)
+            return self._node_batch(roots, flow)
+
+        return one_batch
+
     def eval_input_fn(self):
         return self._batches(self.eval_node_type, flow=self.eval_dataflow,
                              stream=1)

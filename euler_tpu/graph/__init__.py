@@ -8,6 +8,10 @@ from euler_tpu.graph.api import (  # noqa: F401
     seed,
 )
 from euler_tpu.graph.chaos import ChaosGraphEngine, ChaosPlan  # noqa: F401
+from euler_tpu.graph.pipeline import (  # noqa: F401
+    CachedGraphEngine,
+    PipelinedClient,
+)
 from euler_tpu.graph.remote import (  # noqa: F401
     RemoteGraphEngine,
     RetryDeadlineExceeded,

@@ -14,7 +14,10 @@ switches from embedded to cluster mode by swapping the graph object:
 
 Every sample_fanout call is ONE query (compile-cached server-side plan,
 split/REMOTE/merge per shard) — the host-side feeding pattern the
-reference's whole design exists to amortize.
+reference's whole design exists to amortize. With pool_size > 0 the
+engine additionally runs the pipelined client (graph/pipeline.py):
+submit() futures, and large id sets fanned out as concurrent chunks
+instead of one blocking query at a time.
 """
 
 from __future__ import annotations
@@ -135,7 +138,10 @@ class RemoteGraphEngine:
                  mode: str = "distribute",
                  retry_deadline_s: float = 30.0,
                  retry_policy: Optional[RetryPolicy] = None,
-                 degrade: bool = False):
+                 degrade: bool = False,
+                 pool_size: int = 0,
+                 pool_handles: Optional[int] = None,
+                 chunk_size: int = 4096):
         """retry_deadline_s: failover budget. A query that fails (shard
         died mid-call, RpcChannel exhausted its in-channel retries) is
         retried under RetryPolicy (exponential backoff, full jitter)
@@ -155,7 +161,20 @@ class RemoteGraphEngine:
         ["degraded"] instead of raising mid-epoch (the TF-GNN
         "countable degraded batches" production posture). Feature
         getters never degrade (silent zeros would corrupt training
-        data without a trace)."""
+        data without a trace).
+
+        pool_size: > 0 enables the pipelined RPC client — pool_size
+        worker threads over `pool_handles` (default pool_size) pooled
+        query handles, exposing submit(gql, feed) -> Future and
+        turning large-id-set batch calls (sample_fanout /
+        sample_neighbor / get_full_neighbor / get_dense_feature) into
+        concurrent per-chunk queries merged in order. Each pooled call
+        still runs the full RetryPolicy/degrade machinery and
+        `graph_rpc` span. 0 (default) keeps the serial one-query-at-a-
+        time client.
+
+        chunk_size: id-set size above which a pooled engine splits a
+        batch call into concurrent chunks (ignored without a pool)."""
         self.query = Query.remote(endpoints, seed=seed, mode=mode)
         self.retry = retry_policy or RetryPolicy(
             deadline_s=float(retry_deadline_s))
@@ -185,6 +204,16 @@ class RemoteGraphEngine:
         _obs.register_health(self._obs_name, self.health)
         self.query.bind_obs(self._obs_name)
         self._strays: list = []  # abandoned timed-out attempt threads
+        # pipelined client (ISSUE 4): per-engine worker pool + pooled
+        # query handles; None keeps the serial path byte-identical
+        self.chunk_size = int(chunk_size)
+        self.pipeline = None
+        if pool_size and pool_size > 0:
+            from euler_tpu.graph.pipeline import PipelinedClient
+
+            self.pipeline = PipelinedClient(
+                self, endpoints, seed, mode, workers=int(pool_size),
+                handles=pool_handles)
 
     # -- health / retry machinery ------------------------------------------
     def health(self) -> dict:
@@ -220,13 +249,15 @@ class RemoteGraphEngine:
     # degrade=True must not accumulate threads/sockets without limit
     _MAX_STRAYS = 32
 
-    def _attempt(self, gql: str, feed):
+    def _attempt(self, gql: str, feed, query=None):
         """One query attempt, bounded by retry.call_timeout_s when set
         (the RPC sockets block, so a black-holed connection can only be
-        escaped by abandoning the attempt thread)."""
+        escaped by abandoning the attempt thread). `query` selects a
+        pooled handle; None uses the engine's own."""
+        query = query if query is not None else self.query
         t = self.retry.call_timeout_s
         if not t or t <= 0:
-            return self.query.run(gql, feed)
+            return query.run(gql, feed)
         with self._health_mu:
             # reap strays that have since unblocked; refuse to grow past
             # the cap ("timeout" marker keeps this retryable/degradable)
@@ -240,7 +271,7 @@ class RemoteGraphEngine:
 
         def work():
             try:
-                box["out"] = self.query.run(gql, feed)
+                box["out"] = query.run(gql, feed)
             except BaseException as e:  # surfaced on join below
                 box["err"] = e
 
@@ -257,13 +288,15 @@ class RemoteGraphEngine:
             raise box["err"]
         return box["out"]
 
-    def _run(self, gql: str, feed=None):
+    def _run(self, gql: str, feed=None, query=None):
         """query.run under RetryPolicy: retryable (transport) failures
         back off with full jitter until the deadline; semantic errors
         raise at once; an exhausted budget raises
         RetryDeadlineExceeded. The whole call (retries + backoff
         included) runs under a `graph_rpc` span and lands in the
-        graph_rpc_ms histogram, success or raise."""
+        graph_rpc_ms histogram, success or raise. `query` runs the
+        attempts on a pooled handle (the pipelined client's workers);
+        default is the engine's own handle."""
         pol = self.retry
         self._bump("calls")
         with _obs.timed_span("graph_rpc", self._hist_call_ms,
@@ -272,7 +305,7 @@ class RemoteGraphEngine:
             attempt = 0
             while True:
                 try:
-                    out = self._attempt(gql, feed)
+                    out = self._attempt(gql, feed, query)
                     if attempt:
                         # the call came back after ≥1 transport failure:
                         # the shard (or its replacement channel)
@@ -309,6 +342,52 @@ class RemoteGraphEngine:
 
     def _note_degraded(self) -> None:
         self._bump("degraded")
+
+    # -- pipelined submission / chunked intra-batch fan-out ----------------
+    def submit(self, gql: str, feed=None):
+        """Future-returning query submission. With a pool (pool_size>0)
+        the call queues to the worker pool and runs on a pooled handle;
+        without one it executes synchronously and returns an already-
+        completed Future — one surface either way."""
+        if self.pipeline is not None:
+            return self.pipeline.submit(gql, feed)
+        from concurrent.futures import Future
+
+        fut = Future()
+        try:
+            fut.set_result(self._run(gql, feed))
+        except BaseException as e:
+            fut.set_exception(e)
+        return fut
+
+    def _id_chunks(self, n: int):
+        """[(lo, hi)] chunk bounds when the pipelined client should fan
+        an id set out concurrently; None → serial single call (no pool,
+        chunking disabled, or the set is small enough already)."""
+        c = self.chunk_size
+        if self.pipeline is None or c <= 0 or n <= c:
+            return None
+        return [(i, min(i + c, n)) for i in range(0, n, c)]
+
+    def _chunk_results(self, chunks, submit_chunk, can_degrade=True):
+        """Submit every chunk, then collect results IN CHUNK ORDER. With
+        degrade=True a degradable (sampling) chunk that exhausts its
+        retry deadline yields None (the caller pads exactly that id
+        range); otherwise the first failure raises after all futures
+        were issued — in-flight siblings finish on their workers and
+        are dropped. can_degrade=False for verbs that never degrade
+        (feature/neighbor getters), matching the serial path."""
+        futs = [submit_chunk(a, b) for a, b in chunks]
+        outs = []
+        for f in futs:
+            try:
+                outs.append(f.result())
+            except RetryDeadlineExceeded:
+                if not (can_degrade and self.degrade):
+                    raise
+                self._note_degraded()
+                outs.append(None)
+        return outs
 
     # -- root sampling -----------------------------------------------------
     def sample_node(self, count: int, node_type: int = -1) -> np.ndarray:
@@ -352,43 +431,86 @@ class RemoteGraphEngine:
         q = "v(r)"
         for i, k in enumerate(counts):
             q += f".sampleNB({per_hop[i]}, {int(k)}, {default_id}).as(h{i})"
-        try:
-            out = self._run(q, {"r": roots})
-        except RetryDeadlineExceeded:
-            if not self.degrade:
-                raise
-            self._note_degraded()
-            ids, w, t = [], [], []
-            m = roots.size
-            for k in counts:
-                m *= int(k)
-                ids.append(np.full(m, default_id, np.uint64))
-                w.append(np.zeros(m, np.float32))
-                t.append(np.full(m, -1, np.int32))
+        chunks = self._id_chunks(roots.size)
+        if chunks is None:
+            try:
+                out = self._run(q, {"r": roots})
+            except RetryDeadlineExceeded:
+                if not self.degrade:
+                    raise
+                self._note_degraded()
+                ids, w, t = [], [], []
+                m = roots.size
+                for k in counts:
+                    m *= int(k)
+                    ids.append(np.full(m, default_id, np.uint64))
+                    w.append(np.zeros(m, np.float32))
+                    t.append(np.full(m, -1, np.int32))
+                return ids, w, t
+            ids = [out[f"h{i}:1"].astype(np.uint64)
+                   for i in range(len(counts))]
+            w = [out[f"h{i}:2"].astype(np.float32)
+                 for i in range(len(counts))]
+            t = [out[f"h{i}:3"].astype(np.int32)
+                 for i in range(len(counts))]
             return ids, w, t
-        ids = [out[f"h{i}:1"].astype(np.uint64) for i in range(len(counts))]
-        w = [out[f"h{i}:2"].astype(np.float32) for i in range(len(counts))]
-        t = [out[f"h{i}:3"].astype(np.int32) for i in range(len(counts))]
+        # concurrent fan-out: hop arrays are root-major, so per-chunk
+        # hop arrays concatenate into exactly the unchunked layout
+        outs = self._chunk_results(
+            chunks, lambda a, b: self.submit(q, {"r": roots[a:b]}))
+        ids, w, t = [], [], []
+        mult = 1
+        for i, k in enumerate(counts):
+            mult *= int(k)
+            pi, pw, pt = [], [], []
+            for (a, b), out in zip(chunks, outs):
+                m = (b - a) * mult
+                if out is None:          # this chunk degraded: pad it
+                    pi.append(np.full(m, default_id, np.uint64))
+                    pw.append(np.zeros(m, np.float32))
+                    pt.append(np.full(m, -1, np.int32))
+                else:
+                    pi.append(out[f"h{i}:1"].astype(np.uint64))
+                    pw.append(out[f"h{i}:2"].astype(np.float32))
+                    pt.append(out[f"h{i}:3"].astype(np.int32))
+            ids.append(np.concatenate(pi))
+            w.append(np.concatenate(pw))
+            t.append(np.concatenate(pt))
         return ids, w, t
 
     def sample_neighbor(self, ids, count: int, edge_types=None,
                         default_id: int = 0):
         ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
         n = ids.size
-        try:
-            out = self._run(
-                f"v(r).sampleNB({self._et(edge_types)}, {count}, "
-                f"{default_id}).as(nb)", {"r": ids})
-        except RetryDeadlineExceeded:
-            if not self.degrade:
-                raise
-            self._note_degraded()
-            return (np.full((n, count), default_id, np.uint64),
-                    np.zeros((n, count), np.float32),
-                    np.full((n, count), -1, np.int32))
-        return (out["nb:1"].reshape(n, count).astype(np.uint64),
-                out["nb:2"].reshape(n, count).astype(np.float32),
-                out["nb:3"].reshape(n, count).astype(np.int32))
+        gql = (f"v(r).sampleNB({self._et(edge_types)}, {count}, "
+               f"{default_id}).as(nb)")
+        chunks = self._id_chunks(n)
+        if chunks is None:
+            try:
+                out = self._run(gql, {"r": ids})
+            except RetryDeadlineExceeded:
+                if not self.degrade:
+                    raise
+                self._note_degraded()
+                return (np.full((n, count), default_id, np.uint64),
+                        np.zeros((n, count), np.float32),
+                        np.full((n, count), -1, np.int32))
+            return (out["nb:1"].reshape(n, count).astype(np.uint64),
+                    out["nb:2"].reshape(n, count).astype(np.float32),
+                    out["nb:3"].reshape(n, count).astype(np.int32))
+        outs = self._chunk_results(
+            chunks, lambda a, b: self.submit(gql, {"r": ids[a:b]}))
+        nb = np.full((n, count), default_id, np.uint64)
+        w = np.zeros((n, count), np.float32)
+        t = np.full((n, count), -1, np.int32)
+        for (a, b), out in zip(chunks, outs):
+            if out is None:
+                continue                 # degraded chunk keeps padding
+            m = b - a
+            nb[a:b] = out["nb:1"].reshape(m, count).astype(np.uint64)
+            w[a:b] = out["nb:2"].reshape(m, count).astype(np.float32)
+            t[a:b] = out["nb:3"].reshape(m, count).astype(np.int32)
+        return nb, w, t
 
     def get_full_neighbor(self, ids, edge_types=None,
                           sorted_by_id: bool = False,
@@ -396,12 +518,31 @@ class RemoteGraphEngine:
         ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
         verb = "getRNB" if in_edges else (
             "getSortedNB" if sorted_by_id else "getNB")
-        out = self._run(
-            f"v(r).{verb}({self._et(edge_types)}).as(nb)", {"r": ids})
-        idx = out["nb:0"].reshape(-1, 2)
-        offsets = np.concatenate([[0], idx[:, 1]]).astype(np.uint64)
-        return (offsets, out["nb:1"].astype(np.uint64),
-                out["nb:2"].astype(np.float32), out["nb:3"].astype(np.int32))
+        gql = f"v(r).{verb}({self._et(edge_types)}).as(nb)"
+        chunks = self._id_chunks(ids.size)
+        if chunks is None:
+            out = self._run(gql, {"r": ids})
+            idx = out["nb:0"].reshape(-1, 2)
+            offsets = np.concatenate([[0], idx[:, 1]]).astype(np.uint64)
+            return (offsets, out["nb:1"].astype(np.uint64),
+                    out["nb:2"].astype(np.float32),
+                    out["nb:3"].astype(np.int32))
+        # neighbor getters never degrade, so a failed chunk raises
+        outs = self._chunk_results(
+            chunks, lambda a, b: self.submit(gql, {"r": ids[a:b]}),
+            can_degrade=False)
+        offs, nbrs, ws, ts = [np.zeros(1, np.int64)], [], [], []
+        base = 0
+        for out in outs:
+            idx = out["nb:0"].reshape(-1, 2).astype(np.int64)
+            offs.append(idx[:, 1] + base)
+            base += int(idx[-1, 1]) if idx.size else 0
+            nbrs.append(out["nb:1"].astype(np.uint64))
+            ws.append(out["nb:2"].astype(np.float32))
+            ts.append(out["nb:3"].astype(np.int32))
+        return (np.concatenate(offs).astype(np.uint64),
+                np.concatenate(nbrs), np.concatenate(ws),
+                np.concatenate(ts))
 
     def get_neighbor_edges(self, ids, edge_types=None):
         ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
@@ -484,27 +625,63 @@ class RemoteGraphEngine:
                 out[:, step + 1:] = default_id  # remaining steps padded
                 return out
             off = off.astype(np.int64)
-            nxt = np.full(n, default_id, dtype=np.uint64)
-            for i in range(n):
-                b, e = off[i], off[i + 1]
-                if e <= b:
-                    continue
-                cand = nbr[b:e]
-                wt = w[b:e].astype(np.float64).copy()
-                prev_nb = set(pnbr[poff[i]:poff[i + 1]].tolist())
-                for j, x in enumerate(cand):
-                    if x == prev[i]:
-                        wt[j] /= p        # return edge
-                    elif int(x) not in prev_nb:
-                        wt[j] /= q        # outward edge
-                s = wt.sum()
-                if s <= 0:
-                    continue
-                nxt[i] = cand[rng.choice(e - b, p=wt / s)]
+            nxt = self._biased_step(off, nbr, w, prev, poff, pnbr,
+                                    p, q, default_id, rng)
             prev, cur = cur, nxt
             poff, pnbr = off, nbr
             out[:, step + 1] = cur
         return out
+
+    @staticmethod
+    def _biased_step(off, nbr, w, prev, poff, pnbr, p, q, default_id,
+                     rng) -> np.ndarray:
+        """One node2vec-biased walk step, fully vectorized (the per-node
+        Python loop with a set() per row was the walk feeder's host
+        ceiling): candidate weights are reweighted with numpy segment
+        ops — the prev-neighbor membership test is a sorted-rank
+        searchsorted over (row, id) composite keys, the draw a
+        segment-sum + segmented inverse-CDF over one global cumsum.
+        Distribution-identical to the loop (pinned by the seeded
+        chi-squared test in tests/test_host_pipeline.py); rows with no
+        candidates or zero total weight stay at default_id."""
+        n = prev.size
+        counts = off[1:] - off[:-1]
+        nxt = np.full(n, default_id, dtype=np.uint64)
+        if nbr.size == 0:
+            return nxt
+        seg = np.repeat(np.arange(n, dtype=np.int64), counts)
+        wt = w.astype(np.float64)
+        # return edge: candidate == the walk's previous node
+        ret = nbr == prev[seg]
+        # outward edge: candidate NOT adjacent to the previous node.
+        # Sorted-membership: rank every id against the union of ids
+        # seen this step, pack (row, rank) into one int64 key, and
+        # binary-search the sorted prev-neighbor keys — no per-row set.
+        uniq = np.unique(np.concatenate([nbr, pnbr]))
+        stride = np.int64(uniq.size + 1)
+        cand_key = seg * stride + np.searchsorted(uniq, nbr)
+        pseg = np.repeat(np.arange(n, dtype=np.int64),
+                         poff[1:] - poff[:-1])
+        prev_key = np.sort(pseg * stride + np.searchsorted(uniq, pnbr))
+        if prev_key.size:
+            ins = np.minimum(np.searchsorted(prev_key, cand_key),
+                             prev_key.size - 1)
+            member = prev_key[ins] == cand_key
+        else:
+            member = np.zeros(nbr.size, dtype=bool)
+        wt[ret] /= p
+        wt[~ret & ~member] /= q
+        # segment totals + segmented inverse-CDF draw on the global
+        # cumulative sum: row i's draw lands in [off[i], off[i+1])
+        s = np.bincount(seg, weights=wt, minlength=n)
+        cum = np.cumsum(wt)
+        start = np.concatenate([[0.0], cum])[off[:-1]]
+        ok = s > 0
+        u = rng.random(n) * s
+        pos = np.searchsorted(cum, start + u, side="right")
+        pos = np.minimum(pos, np.maximum(off[1:] - 1, 0))
+        nxt[ok] = nbr[pos[ok]]
+        return nxt
 
     # -- features ----------------------------------------------------------
     def _dense_from_values(self, out, n: int, names, dims, single: bool):
@@ -529,10 +706,18 @@ class RemoteGraphEngine:
                     and (idx[:, 0] == np.arange(n) * dim).all()):
                 outs.append(vals.reshape(n, dim))
                 continue
+            # ragged slow path (graph_partition mode: shards return
+            # EMPTY rows for ids they don't own): one repeat/scatter
+            # pass instead of a per-row copy loop
             arr = np.zeros((n, dim), dtype=np.float32)
-            for r in range(min(n, idx.shape[0])):
-                m = min(int(lens[r]), dim)
-                arr[r, :m] = vals[idx[r, 0]:idx[r, 0] + m]
+            k = min(n, idx.shape[0])
+            cnt = np.minimum(lens[:k], dim).astype(np.int64)
+            tot = int(cnt.sum())
+            if tot:
+                rows = np.repeat(np.arange(k), cnt)
+                col = (np.arange(tot, dtype=np.int64)
+                       - np.repeat(np.cumsum(cnt) - cnt, cnt))
+                arr[rows, col] = vals[np.repeat(idx[:k, 0], cnt) + col]
             outs.append(arr)
         return outs[0] if single else outs
 
@@ -543,8 +728,31 @@ class RemoteGraphEngine:
         single = not isinstance(fids, (list, tuple, np.ndarray))
         names = [fids] if single else list(fids)
         q = "v(r).values(" + ", ".join(str(n) for n in names) + ").as(f)"
-        out = self._run(q, {"r": ids})
-        return self._dense_from_values(out, ids.size, names, dims, single)
+        chunks = self._id_chunks(ids.size)
+        if chunks is None:
+            out = self._run(q, {"r": ids})
+            return self._dense_from_values(out, ids.size, names, dims,
+                                           single)
+        outs = self._chunk_results(
+            chunks, lambda a, b: self.submit(q, {"r": ids[a:b]}),
+            can_degrade=False)
+        # decode each chunk as a list, then merge per fid; with
+        # dims=None a chunk's inferred width is its own rows' max, so
+        # right-pad to the cross-chunk max — rows are zero-filled past
+        # their length either way, byte-identical to the single query
+        dim_list = None if dims is None else ([dims] if single
+                                              else list(dims))
+        per_chunk = [self._dense_from_values(out, b - a, names, dim_list,
+                                             False)
+                     for (a, b), out in zip(chunks, outs)]
+        merged = []
+        for i in range(len(names)):
+            parts = [pc[i] for pc in per_chunk]
+            width = max(p.shape[1] for p in parts)
+            parts = [p if p.shape[1] == width else np.pad(
+                p, ((0, 0), (0, width - p.shape[1]))) for p in parts]
+            merged.append(np.concatenate(parts))
+        return merged[0] if single else merged
 
     @staticmethod
     def _csr_result(out, tag: str, dtype):
@@ -622,6 +830,11 @@ class RemoteGraphEngine:
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
         _obs.unregister_health(self._obs_name)
+        if self.pipeline is not None:
+            # drain the worker pool first: pooled calls re-enter _run
+            # and must not race the handle teardown below
+            self.pipeline.close()
+            self.pipeline = None
         # abandoned timed-out attempts still hold exec handles into the
         # query proxy; give them a moment to unblock (their sockets die
         # when the far end/proxy shuts down) and LEAK the proxy rather

@@ -1,0 +1,695 @@
+"""Parallel host input pipeline (ISSUE 4 tentpole).
+
+Covers the three client-side layers against REAL components:
+
+  * pipelined RPC client — submit() futures + chunked intra-batch
+    fan-out over a live 2-shard cluster, byte-identical to the serial
+    client on deterministic verbs;
+  * immutable-graph client cache — cold/warm byte-parity, LRU eviction
+    under the byte budget, the degraded-result poisoning guard, and
+    health()/registry reconciliation;
+  * multi-worker feeder — ordered delivery, error resilience, thread
+    reclamation (the Prefetcher leak satellite), and estimator wiring;
+
+plus the two vectorization satellites (node2vec bias step pinned by a
+seeded chi-squared test against the reference loop; ragged
+graph_partition dense decode) and THE chaos acceptance scenario with
+the pool enabled (shard kill + restart mid-train: failovers >= 1, zero
+degraded, zero cache-poisoned rows).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu import obs
+from euler_tpu.core.lib import EngineError
+from euler_tpu.graph import (
+    CachedGraphEngine,
+    GraphBuilder,
+    RemoteGraphEngine,
+    RetryPolicy,
+    seed,
+)
+
+pytestmark = pytest.mark.host_pipeline
+
+
+def _no_euler_threads():
+    return [t for t in threading.enumerate()
+            if t.is_alive() and t.name.startswith("euler-")]
+
+
+# ---------------------------------------------------------------------------
+# shared 2-shard cluster
+# ---------------------------------------------------------------------------
+
+def _featured_graph(tmp_path, n=48):
+    seed(7)
+    rng = np.random.default_rng(3)
+    b = GraphBuilder()
+    b.set_num_types(2, 1)
+    b.set_feature(0, 0, 8, "feature")
+    b.set_feature(1, 0, 4, "label")
+    ids = np.arange(1, n + 1, dtype=np.uint64)
+    b.add_nodes(ids, types=(ids % 2).astype(np.int32),
+                weights=np.ones(n, np.float32))
+    src = np.concatenate([ids, ids])
+    dst = np.concatenate([np.roll(ids, -1), np.roll(ids, -5)])
+    b.add_edges(src, dst, types=np.zeros(2 * n, np.int32),
+                weights=(rng.random(2 * n) + 0.25).astype(np.float32))
+    cls = (ids % 4).astype(np.int64)
+    feats = rng.normal(0, 1, (n, 8)).astype(np.float32)
+    feats[np.arange(n), cls] += 2.0
+    b.set_node_dense(ids, 0, feats)
+    b.set_node_dense(ids, 1, np.eye(4, dtype=np.float32)[cls])
+    data_dir = str(tmp_path / "g")
+    b.finalize().dump(data_dir, num_partitions=2)
+    return data_dir
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    from euler_tpu.gql import start_service
+
+    data_dir = _featured_graph(tmp_path_factory.mktemp("hp"))
+    servers = [start_service(data_dir, shard_idx=i, shard_num=2, port=0)
+               for i in range(2)]
+    eps = "hosts:" + ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    yield eps, servers, data_dir
+    for s in servers:
+        s.stop()
+
+
+@pytest.fixture
+def serial_engine(cluster):
+    eng = RemoteGraphEngine(cluster[0], seed=11)
+    yield eng
+    eng.close()
+
+
+@pytest.fixture
+def pooled_engine(cluster):
+    eng = RemoteGraphEngine(cluster[0], seed=11, pool_size=4,
+                            chunk_size=16)
+    yield eng
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# pipelined client: submit futures + chunked fan-out parity
+# ---------------------------------------------------------------------------
+
+def test_submit_futures_concurrent(pooled_engine):
+    futs = [pooled_engine.submit("sampleN(-1, 8).as(n)")
+            for _ in range(12)]
+    outs = [f.result(timeout=30) for f in futs]
+    assert all(o["n:0"].size == 8 for o in outs)
+    # serial engines return completed futures from the same surface
+    assert pooled_engine.pipeline is not None
+
+
+def test_submit_without_pool_is_synchronous(serial_engine):
+    f = serial_engine.submit("sampleN(-1, 4).as(n)")
+    assert f.done() and f.result()["n:0"].size == 4
+
+
+def test_chunked_deterministic_parity(serial_engine, pooled_engine):
+    """chunk_size=16 over 48 ids → 3 concurrent chunks per call; the
+    merged results must be byte-identical to the serial client."""
+    ids = np.arange(1, 49, dtype=np.uint64)
+    a = serial_engine.get_dense_feature(ids, ["feature", "label"], [8, 4])
+    b = pooled_engine.get_dense_feature(ids, ["feature", "label"], [8, 4])
+    for x, y in zip(a, b):
+        assert x.tobytes() == y.tobytes()
+    # dims=None (inferred widths) merges identically too
+    a1 = serial_engine.get_dense_feature(ids, "feature")
+    b1 = pooled_engine.get_dense_feature(ids, "feature")
+    assert a1.tobytes() == b1.tobytes()
+    na = serial_engine.get_full_neighbor(ids)
+    nb = pooled_engine.get_full_neighbor(ids)
+    for x, y in zip(na, nb):
+        assert x.dtype == y.dtype and x.tobytes() == y.tobytes()
+
+
+def test_chunked_sampling_shapes_and_membership(pooled_engine,
+                                                serial_engine):
+    ids = np.arange(1, 49, dtype=np.uint64)
+    f_ids, f_w, f_t = pooled_engine.sample_fanout(ids, [3, 2])
+    assert [a.shape[0] for a in f_ids] == [48 * 3, 48 * 6]
+    nb, w, t = pooled_engine.sample_neighbor(ids, 4)
+    assert nb.shape == (48, 4) and w.shape == (48, 4)
+    # every sampled hop-1 neighbor is a true neighbor of its root
+    off, nbr, _, _ = serial_engine.get_full_neighbor(ids)
+    off = off.astype(np.int64)
+    for i in (0, 20, 47):
+        true_nb = set(nbr[off[i]:off[i + 1]].tolist())
+        assert set(nb[i].tolist()) <= true_nb
+
+
+def test_pool_close_reclaims_workers(cluster):
+    eng = RemoteGraphEngine(cluster[0], seed=3, pool_size=3)
+    eng.sample_node(4, -1)
+    pipe = eng.pipeline
+    eng.close()
+    assert eng.pipeline is None
+    assert not any(t.is_alive()
+                   for t in getattr(pipe._exec, "_threads", []))
+
+
+# ---------------------------------------------------------------------------
+# immutable-graph client cache
+# ---------------------------------------------------------------------------
+
+def test_cache_byte_parity_cold_and_warm(cluster, serial_engine):
+    eng = RemoteGraphEngine(cluster[0], seed=11, pool_size=2,
+                            chunk_size=16)
+    cache = CachedGraphEngine(eng, budget_bytes=4 << 20)
+    try:
+        ids = np.array([1, 2, 3, 2, 40, 40, 7], np.uint64)
+        f_off = serial_engine.get_dense_feature(ids, "feature", 8)
+        f_cold = cache.get_dense_feature(ids, "feature", 8)
+        f_warm = cache.get_dense_feature(ids, "feature", 8)
+        assert f_off.tobytes() == f_cold.tobytes() == f_warm.tobytes()
+        n_off = serial_engine.get_full_neighbor(ids)
+        n_cold = cache.get_full_neighbor(ids)
+        n_warm = cache.get_full_neighbor(ids)
+        for a, x, y in zip(n_off, n_cold, n_warm):
+            assert a.dtype == x.dtype == y.dtype
+            assert a.tobytes() == x.tobytes() == y.tobytes()
+        # partially-warm mix: some hits, some misses, same bytes
+        mix = np.array([2, 9, 40, 10, 1], np.uint64)
+        assert (cache.get_dense_feature(mix, "feature", 8).tobytes()
+                == serial_engine.get_dense_feature(mix, "feature",
+                                                   8).tobytes())
+        st = cache.cache_stats()
+        assert st["hits"] > 0 and st["hit_rate"] > 0
+        # sampling verbs pass through untouched (never cached): draws
+        # remain valid neighbors with correct shapes
+        nb, _, _ = cache.sample_neighbor(ids, 3)
+        assert nb.shape == (7, 3)
+        off, nbr, _, _ = serial_engine.get_full_neighbor(ids)
+        off = off.astype(np.int64)
+        assert set(nb[0].tolist()) <= set(
+            nbr[off[0]:off[1]].tolist())
+    finally:
+        cache.close()
+
+
+def test_cache_health_reconciles_with_registry(ring_graph):
+    cache = CachedGraphEngine(ring_graph, budget_bytes=1 << 20)
+    ids = np.arange(1, 11, dtype=np.uint64)
+    cache.get_dense_feature(ids, "f_dense", 4)
+    cache.get_dense_feature(ids, "f_dense", 4)
+    st = cache.cache_stats()
+    snap = obs.snapshot()
+    lab = f"cache={cache._obs_name}"
+    for key, metric in (("hits", "client_cache_hits_total"),
+                        ("misses", "client_cache_misses_total"),
+                        ("inserts", "client_cache_inserts_total"),
+                        ("bytes", "client_cache_bytes")):
+        assert snap[metric]["values"][lab] == st[key], (key, st)
+    # health() embeds the same stats (and /healthz serves it: the
+    # cache registers a health provider under its obs name)
+    assert cache.health()["cache"]["hits"] == st["hits"]
+    assert obs.health_snapshot()[cache._obs_name]["hits"] == st["hits"]
+    cache.close()
+    assert cache._obs_name not in obs.health_snapshot()
+
+
+def test_cache_eviction_respects_budget(ring_graph):
+    # ~32 bytes/row dense (key + gen + 4 float32), more for ragged
+    # rows: a 600-byte budget forces eviction within a few inserts
+    cache = CachedGraphEngine(ring_graph, budget_bytes=600)
+    for k in range(1, 11):
+        ids = np.arange(1, 11, dtype=np.uint64)
+        cache.get_full_neighbor(ids[:k])
+        cache.get_dense_feature(ids[k - 1:], "f_dense", 4)
+    st = cache.cache_stats()
+    assert st["bytes"] <= 600
+    assert st["evicted_rows"] > 0
+    # correctness after eviction: still byte-identical
+    ids = np.arange(1, 11, dtype=np.uint64)
+    assert (cache.get_dense_feature(ids, "f_dense", 4).tobytes()
+            == ring_graph.get_dense_feature(ids, "f_dense", 4).tobytes())
+    cache.close()
+
+
+def test_cache_concurrent_misses_insert_once(ring_graph):
+    """Two workers missing the SAME ids concurrently must not insert
+    duplicates (the stores' insert requires absent keys; duplicates
+    would bloat bytes/entries and distort eviction)."""
+    cache = CachedGraphEngine(ring_graph, budget_bytes=1 << 20)
+    ids = np.arange(1, 11, dtype=np.uint64)
+    barrier = threading.Barrier(2)
+    errs = []
+
+    def worker():
+        try:
+            barrier.wait(timeout=5)
+            for _ in range(5):
+                cache.get_dense_feature(ids, "f_dense", 4)
+                cache.get_full_neighbor(ids)
+        except Exception as e:   # pragma: no cover - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    st = cache.cache_stats()
+    assert st["entries"] == 20            # 10 dense + 10 ragged, ONCE
+    assert st["inserts"] == 20
+    # keys stayed unique → lookups still byte-identical
+    assert (cache.get_dense_feature(ids, "f_dense", 4).tobytes()
+            == ring_graph.get_dense_feature(ids, "f_dense", 4).tobytes())
+    cache.close()
+
+
+class _DegradingEngine:
+    """Engine stub whose reads return padding AND bump a degraded
+    counter mid-call — the poisoning-guard scenario (a real engine
+    never degrades feature getters today; the guard pins that a future
+    regression could not silently poison the cache)."""
+
+    def __init__(self):
+        from euler_tpu.obs.metrics import Registry
+
+        self._reg = Registry()
+        self._ctr = {"degraded": self._reg.counter("d", "")}
+        self.degrade_next = False
+
+    def get_dense_feature(self, ids, fids, dims=None):
+        ids = np.asarray(ids)
+        if self.degrade_next:
+            self._ctr["degraded"].inc()
+            return np.zeros((ids.size, int(dims)), np.float32)
+        return (ids.astype(np.float32)[:, None]
+                * np.ones((1, int(dims)), np.float32))
+
+    def get_full_neighbor(self, ids, edge_types=None, sorted_by_id=False,
+                          in_edges=False):
+        ids = np.asarray(ids)
+        if self.degrade_next:
+            self._ctr["degraded"].inc()
+            return (np.zeros(ids.size + 1, np.uint64),
+                    np.zeros(0, np.uint64), np.zeros(0, np.float32),
+                    np.zeros(0, np.int32))
+        off = np.arange(ids.size + 1, dtype=np.uint64)
+        return (off, ids.astype(np.uint64), np.ones(ids.size, np.float32),
+                np.zeros(ids.size, np.int32))
+
+
+def test_cache_poisoning_guard_skips_degraded_results():
+    eng = _DegradingEngine()
+    cache = CachedGraphEngine(eng, budget_bytes=1 << 20)
+    ids = np.array([1, 2, 3], np.uint64)
+    eng.degrade_next = True
+    padded = cache.get_dense_feature(ids, "f", 4)
+    assert not padded.any()                    # served once, degraded
+    assert cache.cache_stats()["inserts"] == 0  # NEVER inserted
+    assert cache.cache_stats()["poison_skips"] == 1
+    eng.degrade_next = False
+    good = cache.get_dense_feature(ids, "f", 4)
+    assert good.any()                          # fresh fetch, not cache
+    assert cache.cache_stats()["inserts"] == 3
+    # warm now serves the GOOD rows
+    assert cache.get_dense_feature(ids, "f", 4).tobytes() \
+        == good.tobytes()
+    # same guard on the ragged store
+    eng.degrade_next = True
+    cache.get_full_neighbor(np.array([9], np.uint64))
+    assert cache.cache_stats()["poison_skips"] == 2
+    eng.degrade_next = False
+    off, nbr, _, _ = cache.get_full_neighbor(np.array([9], np.uint64))
+    assert nbr.size == 1 and nbr[0] == 9
+    cache.close()
+
+
+# ---------------------------------------------------------------------------
+# prefetcher lifecycle + multi-worker feeder
+# ---------------------------------------------------------------------------
+
+def test_prefetcher_abandonment_leak_fixed():
+    """The satellite: an abandoned consumer must not leak the producer
+    thread blocked on q.put forever — close() (and the context manager)
+    reclaim it."""
+    from euler_tpu.estimator.prefetch import Prefetcher
+
+    def gen():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    p = Prefetcher(gen(), depth=2)
+    assert next(p) == 0
+    th = p._thread
+    assert th.is_alive()          # producer parked on the full queue
+    p.close()
+    assert not th.is_alive()      # reclaimed, not leaked
+    with pytest.raises(StopIteration):
+        next(p)
+    p.close()                     # idempotent
+
+    with Prefetcher(gen(), depth=1) as p2:
+        next(p2)
+        th2 = p2._thread
+    assert not th2.is_alive()
+
+
+def test_prefetcher_error_and_end_semantics():
+    from euler_tpu.estimator.prefetch import Prefetcher
+
+    def bad():
+        yield 1
+        raise ValueError("boom")
+
+    p = Prefetcher(bad(), depth=2)
+    assert next(p) == 1
+    with pytest.raises(ValueError, match="boom"):
+        next(p)
+    p.close()
+
+    p = Prefetcher(iter([7]), depth=2)
+    assert next(p) == 7
+    with pytest.raises(StopIteration):
+        next(p)
+    p.close()
+
+
+def test_parallel_prefetcher_ordered_and_reclaimed():
+    from euler_tpu.estimator.prefetch import ParallelPrefetcher
+
+    pp = ParallelPrefetcher(iter(range(40)), workers=4, depth=6)
+    assert [next(pp) for _ in range(40)] == list(range(40))
+    with pytest.raises(StopIteration):
+        next(pp)
+    pp.close()
+    assert all(not t.is_alive() for t in pp._threads)
+
+
+def test_parallel_prefetcher_error_does_not_kill_stream():
+    from euler_tpu.estimator.prefetch import ParallelPrefetcher
+
+    lock = threading.Lock()
+    box = {"n": 0}
+
+    def factory():
+        with lock:
+            box["n"] += 1
+            k = box["n"]
+        if k == 4:
+            raise OSError("transient")
+        return k
+
+    with ParallelPrefetcher(factory, workers=3, depth=4) as pp:
+        assert pp.resilient
+        got, errs = [], 0
+        for _ in range(12):
+            try:
+                got.append(next(pp))
+            except OSError:
+                errs += 1
+        assert errs == 1 and len(got) == 11   # stream continued
+
+
+def test_estimator_feeder_workers_end_to_end(cluster):
+    """NodeEstimator with feeder_workers=2 over a POOLED remote engine:
+    trains to completion, batches well-formed, feeder threads
+    reclaimed after train()."""
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.models import SupervisedGraphSage
+
+    eng = RemoteGraphEngine(cluster[0], seed=2, pool_size=2,
+                            chunk_size=32)
+    flow = FanoutDataFlow(eng, [3, 2], feature_ids=["feature"])
+    est = NodeEstimator(
+        SupervisedGraphSage(num_classes=4, multilabel=False, dim=8,
+                            fanouts=(3, 2)),
+        dict(batch_size=8, learning_rate=0.05, log_steps=1 << 30,
+             checkpoint_steps=0, label_dim=4, feeder_workers=2),
+        eng, flow, label_fid="label", label_dim=4)
+    try:
+        res = est.train(est.train_input_fn, max_steps=4)
+        assert res["global_step"] == 4
+        assert est._live_feeder is None       # reclaimed on exit
+        snap = obs.snapshot()
+        lab = f"feeder={est._obs_name}_train"
+        assert snap["feeder_batches_total"]["values"][lab] >= 4
+    finally:
+        est.graph = None
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# vectorization satellites
+# ---------------------------------------------------------------------------
+
+def _biased_step_reference(off, nbr, w, prev, poff, pnbr, p, q,
+                           default_id, rng):
+    """The pre-vectorization per-node loop (remote.py history) — the
+    distribution oracle for the seeded statistical test."""
+    n = prev.size
+    nxt = np.full(n, default_id, dtype=np.uint64)
+    for i in range(n):
+        b, e = off[i], off[i + 1]
+        if e <= b:
+            continue
+        cand = nbr[b:e]
+        wt = w[b:e].astype(np.float64).copy()
+        prev_nb = set(pnbr[poff[i]:poff[i + 1]].tolist())
+        for j, x in enumerate(cand):
+            if x == prev[i]:
+                wt[j] /= p
+            elif int(x) not in prev_nb:
+                wt[j] /= q
+        s = wt.sum()
+        if s <= 0:
+            continue
+        nxt[i] = cand[rng.choice(e - b, p=wt / s)]
+    return nxt
+
+
+def test_node2vec_bias_step_distribution_matches_loop():
+    """Seeded chi-squared: the vectorized segment-op draw and the
+    reference per-node loop must sample from the SAME distribution
+    (p=0.25, q=4 makes return/outward weights differ 16x — a biasing
+    bug cannot hide)."""
+    rng_build = np.random.default_rng(5)
+    n, deg = 6, 5
+    counts = np.full(n, deg, np.int64)
+    off = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    nbr = rng_build.integers(1, 30, off[-1]).astype(np.uint64)
+    w = (rng_build.random(off[-1]) + 0.2).astype(np.float32)
+    prev = rng_build.integers(1, 30, n).astype(np.uint64)
+    # make some candidates exact return edges / prev-neighbors
+    nbr[0] = prev[0]
+    pcounts = np.full(n, 3, np.int64)
+    poff = np.concatenate([[0], np.cumsum(pcounts)]).astype(np.int64)
+    pnbr = rng_build.integers(1, 30, poff[-1]).astype(np.uint64)
+    pnbr[0:2] = nbr[1:3]          # row 0 candidates 1,2 are prev-nbrs
+    p_par, q_par = 0.25, 4.0
+
+    draws = 4000
+    rng_a = np.random.default_rng(11)
+    rng_b = np.random.default_rng(99)
+    counts_vec = {i: {} for i in range(n)}
+    counts_ref = {i: {} for i in range(n)}
+    for _ in range(draws):
+        va = RemoteGraphEngine._biased_step(
+            off, nbr, w, prev, poff, pnbr, p_par, q_par, 0, rng_a)
+        vb = _biased_step_reference(
+            off, nbr, w, prev, poff, pnbr, p_par, q_par, 0, rng_b)
+        for i in range(n):
+            counts_vec[i][int(va[i])] = counts_vec[i].get(int(va[i]), 0) + 1
+            counts_ref[i][int(vb[i])] = counts_ref[i].get(int(vb[i]), 0) + 1
+    # expected probabilities computed analytically per row
+    for i in range(n):
+        cand = nbr[off[i]:off[i + 1]]
+        wt = w[off[i]:off[i + 1]].astype(np.float64).copy()
+        prev_nb = set(pnbr[poff[i]:poff[i + 1]].tolist())
+        for j, x in enumerate(cand):
+            if x == prev[i]:
+                wt[j] /= p_par
+            elif int(x) not in prev_nb:
+                wt[j] /= q_par
+        probs = {}
+        for x, pw in zip(cand.tolist(), wt / wt.sum()):
+            probs[int(x)] = probs.get(int(x), 0.0) + pw
+        for observed in (counts_vec[i], counts_ref[i]):
+            chi2 = 0.0
+            for x, pr in probs.items():
+                exp = pr * draws
+                obs_n = observed.get(x, 0)
+                chi2 += (obs_n - exp) ** 2 / max(exp, 1e-9)
+            dof = max(len(probs) - 1, 1)
+            # ~p > 1e-4 bound: seeded draws sit far inside this
+            assert chi2 < dof + 5.0 * np.sqrt(2.0 * dof) + 10.0, (
+                i, chi2, dof, observed, probs)
+
+
+def test_node2vec_biased_walk_live(serial_engine):
+    """Smoke on the live cluster: biased walks stay on real edges."""
+    roots = np.arange(1, 9, dtype=np.uint64)
+    walks = serial_engine.random_walk(roots, 3, p=0.5, q=2.0)
+    assert walks.shape == (8, 4)
+    assert (walks[:, 0] == roots).all()
+    off, nbr, _, _ = serial_engine.get_full_neighbor(walks[:, 1])
+    off = off.astype(np.int64)
+    for i in range(8):
+        step2 = int(walks[i, 2])
+        if step2 == 0:
+            continue
+        assert step2 in set(nbr[off[i]:off[i + 1]].tolist())
+
+
+def test_dense_from_values_ragged_decode_vectorized():
+    """graph_partition-style ragged payload (empty rows, short rows,
+    overlong rows, non-contiguous offsets): the vectorized scatter must
+    reproduce the per-row reference exactly."""
+    rng = np.random.default_rng(4)
+    n, dim = 7, 5
+    lens = np.array([5, 0, 3, 5, 7, 0, 2], np.int64)   # 7 > dim: clipped
+    starts = np.concatenate([[0], np.cumsum(lens)])[:-1] + 11  # shifted
+    vals = rng.random(int(lens.sum()) + 20).astype(np.float32)
+    idx = np.stack([starts, starts + lens], axis=1)
+    out = {"f:0": idx.ravel(), "f:1": vals}
+
+    expect = np.zeros((n, dim), np.float32)
+    for r in range(n):
+        m = min(int(lens[r]), dim)
+        expect[r, :m] = vals[idx[r, 0]:idx[r, 0] + m]
+
+    got = RemoteGraphEngine._dense_from_values(
+        object(), out, n, ["f"], dim, True)
+    assert got.dtype == np.float32 and got.shape == (n, dim)
+    np.testing.assert_array_equal(got, expect)
+    # fewer idx rows than n (a shard answered partially) zero-fills
+    out2 = {"f:0": idx[:4].ravel(), "f:1": vals}
+    got2 = RemoteGraphEngine._dense_from_values(
+        object(), out2, n, ["f"], dim, True)
+    assert got2.shape == (n, dim) and not got2[4:].any()
+
+
+def test_graph_partition_ragged_dense_parity(tmp_path):
+    """Live graph_partition-mode decode: partition shards return ragged
+    rows (only owned ids); serial vs pooled+chunked must agree and the
+    values must match the builder's features."""
+    from euler_tpu.gql import start_service
+
+    seed(9)
+    b = GraphBuilder()
+    b.set_num_types(1, 1)
+    b.set_feature(0, 0, 3, "f")
+    ids = np.arange(1, 13, dtype=np.uint64)
+    b.add_nodes(ids)
+    src, dst = [], []
+    for g0 in range(0, 12, 3):
+        trio = ids[g0:g0 + 3]
+        src.extend(trio.tolist())
+        dst.extend(np.roll(trio, -1).tolist())
+    b.add_edges(np.array(src, np.uint64), np.array(dst, np.uint64))
+    b.set_graph_labels(ids, np.repeat([100, 201, 302, 403], 3))
+    feats = np.arange(36, dtype=np.float32).reshape(12, 3)
+    b.set_node_dense(ids, 0, feats)
+    g = b.finalize()
+    data_dir = str(tmp_path / "gp")
+    g.dump(data_dir, num_partitions=2, by_graph=True)
+    servers = [start_service(data_dir, shard_idx=i, shard_num=2, port=0)
+               for i in range(2)]
+    eps = "hosts:" + ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    ser = RemoteGraphEngine(eps, seed=1, mode="graph_partition")
+    pool = RemoteGraphEngine(eps, seed=1, mode="graph_partition",
+                             pool_size=2, chunk_size=4)
+    try:
+        a = ser.get_dense_feature(ids, "f", 3)
+        bb = pool.get_dense_feature(ids, "f", 3)
+        assert a.tobytes() == bb.tobytes()
+        np.testing.assert_array_equal(a, feats)
+    finally:
+        pool.close()
+        ser.close()
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance with the pool enabled
+# ---------------------------------------------------------------------------
+
+def test_shard_kill_restart_mid_train_with_pool(tmp_path):
+    """THE ISSUE-4 chaos acceptance: one of two shards dies and
+    restarts mid-train with the PIPELINED client + client cache +
+    multi-worker feeder all enabled. The run completes, failovers >= 1,
+    ZERO degraded batches, ZERO cache-poisoned rows, and the cache /
+    rpc counters reconcile between health() and the obs registry."""
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.estimator import NodeEstimator
+    from euler_tpu.gql import start_service
+    from euler_tpu.models import SupervisedGraphSage
+
+    data_dir = _featured_graph(tmp_path, n=40)
+    servers = [start_service(data_dir, shard_idx=i, shard_num=2, port=0)
+               for i in range(2)]
+    ports = [s.port for s in servers]
+    eps = ",".join(f"127.0.0.1:{p}" for p in ports)
+    remote = RemoteGraphEngine(
+        f"hosts:{eps}", seed=3,
+        retry_policy=RetryPolicy(deadline_s=20.0, base_backoff_s=0.05,
+                                 max_backoff_s=0.3),
+        pool_size=4, chunk_size=16)
+    cached = CachedGraphEngine(remote, budget_bytes=4 << 20)
+    flow = FanoutDataFlow(cached, [3, 2], feature_ids=["feature"])
+    est = NodeEstimator(
+        SupervisedGraphSage(num_classes=4, multilabel=False, dim=8,
+                            fanouts=(3, 2)),
+        dict(batch_size=8, learning_rate=0.05, log_steps=1 << 30,
+             checkpoint_steps=0, label_dim=4, feeder_workers=2,
+             input_retries=6, input_backoff_s=0.05),
+        cached, flow, label_fid="label", label_dim=4)
+
+    def restart():
+        servers[1] = start_service(data_dir, shard_idx=1, shard_num=2,
+                                   port=ports[1])
+
+    killed = threading.Event()
+
+    def batches():
+        base = est.train_input_fn()
+        k = 0
+        while True:
+            k += 1
+            if k == 3 and not killed.is_set():
+                killed.set()
+                servers[1].stop()
+                threading.Timer(0.6, restart).start()
+            yield next(base)
+
+    try:
+        res = est.train(batches, max_steps=6)
+        assert res["global_step"] == 6
+        h = remote.health()
+        assert h["failovers"] >= 1, h
+        assert h["retries"] >= 1, h
+        assert h["degraded"] == 0, h
+        assert res["skipped_steps"] == 0
+        st = cached.cache_stats()
+        assert st["poison_skips"] == 0           # zero poisoned rows
+        # health() view == obs registry, by construction and in fact
+        snap = obs.snapshot()
+        assert snap["graph_rpc_failovers_total"]["values"][
+            f"engine={remote._obs_name}"] == h["failovers"]
+        assert snap["client_cache_hits_total"]["values"][
+            f"cache={cached._obs_name}"] == st["hits"]
+        assert cached.health()["cache"] == st
+    finally:
+        est.graph = None
+        cached.close()            # closes the wrapped remote too
+        for s in servers:
+            s.stop()

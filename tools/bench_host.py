@@ -10,6 +10,10 @@ Two concerns from the round-1 review, measured in one tool:
   * --mode scale — ogbn-products-sized store probe (default 2.4M nodes /
     ~120M edges): build time, finalize time, RSS, dump/load time, and a
     sampling probe on the giant graph (super-linear blowups show here).
+  * --mode feeder — serial vs pooled(+cache) host-feeder A/B against a
+    live 2-shard cluster (ISSUE 4): batches/s through the pipelined RPC
+    client + multi-worker feeder + immutable-graph client cache, with a
+    byte-parity check on the deterministic reads.
 
 Each section prints one JSON line and is also merged into perf.json at
 the repo root, which tools/collect_results.py renders into RESULTS.md.
@@ -247,10 +251,125 @@ def bench_layerwise(args):
     })
 
 
+def bench_feeder(args):
+    """--mode feeder: serial vs pooled vs pooled+cache A/B of the HOST
+    feeder against a live 2-shard cluster (ISSUE 4 acceptance: pooled
+    >= 2x serial batches/s at pool >= 4; warm cache hit_rate > 0 with
+    byte-identical batch contents).
+
+    One "batch" is the NodeEstimator host topology: sample roots →
+    sample_fanout → per-level get_dense_feature — every call a blocking
+    RPC on the serial path. The pooled leg runs the same batch builder
+    under ParallelPrefetcher workers over a pool_size RemoteGraphEngine
+    (chunked intra-batch fan-out included); the cache leg additionally
+    wraps the engine in CachedGraphEngine.
+
+    --rpc_delay_ms > 0 wraps every leg's engine in the existing chaos
+    fixture (ChaosGraphEngine latency injection — the "slow shard"
+    model): on a small container the loopback cluster is CPU-bound
+    (client + both shards share the cores), which hides exactly the
+    per-call wait a real remote cluster spends on the network. The
+    delayed A/B is the latency-bound regime the pipeline exists for;
+    both rows belong in PERF.md."""
+    import tempfile
+
+    from euler_tpu.dataflow import FanoutDataFlow
+    from euler_tpu.estimator.prefetch import ParallelPrefetcher
+    from euler_tpu.gql import start_service
+    from euler_tpu.graph import (CachedGraphEngine, ChaosGraphEngine,
+                                 ChaosPlan, RemoteGraphEngine)
+
+    feat_dim = args.feat_dim or 16
+    g, *_ = build_graph(args.nodes, args.degree, feat_dim=feat_dim)
+    fanouts = [int(x) for x in args.fanouts.split(",")]
+    d = tempfile.mkdtemp(prefix="et_feeder_")
+    g.dump(d, num_partitions=2)
+    servers = [start_service(d, shard_idx=i, shard_num=2, port=0)
+               for i in range(2)]
+    eps = "hosts:" + ",".join(f"127.0.0.1:{s.port}" for s in servers)
+
+    def delayed(engine):
+        if args.rpc_delay_ms > 0:
+            return ChaosGraphEngine(
+                engine, ChaosPlan(latency_ms=args.rpc_delay_ms))
+        return engine
+
+    def measure(engine, workers):
+        flow = FanoutDataFlow(engine, fanouts, feature_ids=["feature"],
+                              feature_dims=[feat_dim])
+
+        def one_batch():
+            roots = engine.sample_node(args.batch, -1)
+            return flow(roots)
+
+        if workers <= 1:
+            one_batch()                          # warm
+            t0 = time.time()
+            reps = 0
+            while time.time() - t0 < args.seconds:
+                one_batch()
+                reps += 1
+            return reps / (time.time() - t0)
+        with ParallelPrefetcher(one_batch, workers=workers,
+                                depth=2 * workers) as pf:
+            next(pf)                             # warm
+            t0 = time.time()
+            reps = 0
+            while time.time() - t0 < args.seconds:
+                next(pf)
+                reps += 1
+            return reps / (time.time() - t0)
+
+    pool = max(int(args.pool), 2)
+    serial_eng = RemoteGraphEngine(eps, seed=1)
+    serial = measure(delayed(serial_eng), 1)
+    pooled_eng = RemoteGraphEngine(eps, seed=1, pool_size=pool)
+    pooled = measure(delayed(pooled_eng), pool)
+    # cache ABOVE the delay: a hit skips the slow call entirely, the
+    # production value of the client cache
+    cached_eng = CachedGraphEngine(
+        delayed(RemoteGraphEngine(eps, seed=1, pool_size=pool)),
+        budget_bytes=int(args.cache_mb) << 20)
+    cached = measure(cached_eng, pool)
+
+    # parity: the deterministic reads must be byte-identical cache-on
+    # (cold AND warm) vs cache-off — the cache must never change batch
+    # contents, only where they come from
+    probe = serial_eng.sample_node(min(args.batch, 256), -1)
+    f_off = serial_eng.get_dense_feature(probe, "feature", feat_dim)
+    f_cold = cached_eng.get_dense_feature(probe, "feature", feat_dim)
+    f_warm = cached_eng.get_dense_feature(probe, "feature", feat_dim)
+    nb_off = serial_eng.get_full_neighbor(probe)
+    nb_on = cached_eng.get_full_neighbor(probe)
+    parity = (f_off.tobytes() == f_cold.tobytes() == f_warm.tobytes()
+              and all(a.tobytes() == b.tobytes()
+                      for a, b in zip(nb_off, nb_on)))
+    stats = cached_eng.cache_stats()
+    record({
+        "bench": "host_feeder" if args.rpc_delay_ms <= 0
+        else "host_feeder_delayed",
+        "nodes": args.nodes, "degree": args.degree, "batch": args.batch,
+        "fanouts": fanouts, "feat_dim": feat_dim, "pool": pool,
+        "rpc_delay_ms": args.rpc_delay_ms,
+        "serial_batches_per_sec": round(serial, 2),
+        "pooled_batches_per_sec": round(pooled, 2),
+        "pooled_cache_batches_per_sec": round(cached, 2),
+        "speedup_pooled": round(pooled / max(serial, 1e-9), 2),
+        "speedup_pooled_cache": round(cached / max(serial, 1e-9), 2),
+        "cache": stats,
+        "parity_ok": bool(parity),
+    })
+    cached_eng.close()
+    pooled_eng.close()
+    serial_eng.close()
+    for s in servers:
+        s.stop()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=["fanout", "scale", "walk",
-                                       "layerwise"],
+                                       "layerwise", "feeder"],
                     default="fanout")
     ap.add_argument("--layer_sizes", default="512,512")
     ap.add_argument("--nodes", type=int, default=100_000)
@@ -260,6 +379,16 @@ def main(argv=None):
     ap.add_argument("--fanouts", default="10,10")
     ap.add_argument("--seconds", type=float, default=5.0)
     ap.add_argument("--dump_dir", default="")
+    ap.add_argument("--pool", type=int, default=4,
+                    help="feeder mode: RPC pool size AND feeder worker "
+                         "count for the pooled legs")
+    ap.add_argument("--cache_mb", type=int, default=64,
+                    help="feeder mode: client cache budget (MB) for the "
+                         "pooled+cache leg")
+    ap.add_argument("--rpc_delay_ms", type=float, default=0.0,
+                    help="feeder mode: per-call latency injected via "
+                         "ChaosGraphEngine — the latency-bound (remote "
+                         "cluster) regime; 0 measures raw loopback")
     args = ap.parse_args(argv)
     if args.mode == "fanout":
         bench_fanout(args)
@@ -267,6 +396,8 @@ def main(argv=None):
         bench_walk(args)
     elif args.mode == "layerwise":
         bench_layerwise(args)
+    elif args.mode == "feeder":
+        bench_feeder(args)
     else:
         bench_scale(args)
 
