@@ -970,6 +970,13 @@ def build_argparser():
     ap.add_argument("--platform", default="",
                     choices=["", "auto", "tpu", "cpu"],
                     help="default: cpu for --smoke, auto otherwise")
+    ap.add_argument("--serve", action="store_true", default=False,
+                    help="after the training bench, run the serving "
+                         "smoke (tools/bench_serve.serve_smoke): a "
+                         "batch1-vs-micro-batched p50/p99 pair over "
+                         "the real InferenceServer/ServingClient "
+                         "stack with injected per-flush latency; "
+                         "recorded as detail.serve")
     ap.add_argument("--trace", default="",
                     help="write a chrome://tracing JSON of the measured "
                          "region (per-step input_wait/device_step/hook "
@@ -1039,6 +1046,15 @@ def main(argv=None):
             if _OBS_REGION_BASE is not None:
                 result["detail"]["obs_measured"] = obs.snapshot_delta(
                     _OBS_REGION_BASE, final)
+            if args.serve:
+                # serving smoke AFTER the measured region: its servers/
+                # clients must not pollute the training artifact's
+                # obs_measured delta
+                sys.path.insert(0, os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), "tools"))
+                from bench_serve import serve_smoke
+
+                result["detail"]["serve"] = serve_smoke()
         # canonical config only: non-default shapes OR non-headline
         # sampler/precision flags (--host_sampler / --fp32, advisor r2
         # medium) must not overwrite the cached headline number
@@ -1057,7 +1073,8 @@ def main(argv=None):
                           and args.int8_features
                           and not args.degree_sorted
                           and not args.host_pipeline
-                          and not args.client_cache)
+                          and not args.client_cache
+                          and not args.serve)
         if result.get("detail", {}).get("backend") == "tpu" \
                 and default_shapes:
             # only canonical default-config runs refresh the cache — a

@@ -21,6 +21,11 @@ Layering (bottom → top), mirroring SURVEY.md §1:
   obs/         metrics registry + tracing + /metrics exposition
                (stdlib-only; wired through graph client, input
                pipeline, train loop, and bench)
+  serving/     online inference: export bundles (params + embedding
+               matrix + IVF index, checksummed manifest), a framed-TCP
+               embedding/KNN/score server with dynamic micro-batching
+               + load shedding, and a registry-discovered failover
+               client
 """
 
 __version__ = "0.1.0"

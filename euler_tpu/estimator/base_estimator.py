@@ -846,6 +846,48 @@ class BaseEstimator:
             np.save(id_path, np.concatenate(ids))
         return {"embedding": emb_path, "ids": id_path}
 
+    def export_bundle(self, out_dir: str, input_fn=None,
+                      steps: int = 1_000_000, nlist: int = 64,
+                      nprobe: int = 8, index: bool = True,
+                      extra_meta: Optional[Dict[str, Any]] = None):
+        """Export a versioned serving bundle (euler_tpu.serving): the
+        trained parameter pytree, the full node-embedding matrix from a
+        batched `embed_all` inference pass over `input_fn` (default:
+        this estimator's infer_input_fn sweep), and an IVFFlat index
+        over it — everything the InferenceServer needs, checksummed in
+        a manifest so corruption is detected at load. Returns the
+        ModelBundle (already written to out_dir)."""
+        import dataclasses
+
+        import jax.tree_util as jtu
+
+        from euler_tpu.serving.export import ModelBundle, embed_all
+        from euler_tpu.tools.knn import IVFFlatIndex
+
+        ids, emb = embed_all(self, input_fn, steps)
+        leaves = jtu.tree_flatten_with_path(self.state.params)[0]
+        params = {jtu.keystr(path): np.asarray(jax.device_get(leaf))
+                  for path, leaf in leaves}
+        spec: Dict[str, Any] = {"model_class": type(self.model).__name__}
+        if dataclasses.is_dataclass(self.model):
+            for f in dataclasses.fields(self.model):
+                if f.name in ("parent", "name"):
+                    continue
+                v = getattr(self.model, f.name, None)
+                if isinstance(v, (str, int, float, bool)) or v is None:
+                    spec[f.name] = v
+        index_state = None
+        if index and len(ids) >= 2:
+            idx = IVFFlatIndex(nlist=nlist, nprobe=nprobe)
+            idx.train_add(emb, ids)
+            index_state = idx.state_dict()
+        bundle = ModelBundle(
+            params, emb, ids, index_state, spec,
+            meta={"global_step": int(self.state.step),
+                  **(extra_meta or {})})
+        bundle.save(out_dir)
+        return bundle
+
     def train_and_evaluate(self, train_input_fn, eval_input_fn,
                            max_steps: int = 1000,
                            eval_steps: int = 50,

@@ -47,6 +47,23 @@ def edge_types_str(edge_types) -> str:
         return "*"
     return ":".join(str(int(t)) for t in edge_types) or "*"
 
+def _note_unexpected(site: str, exc: BaseException) -> None:
+    """Count an exception that a best-effort site (a __del__, the UDF
+    trampoline) must swallow but did NOT expect — on the obs registry
+    (gql_unexpected_errors_total{site=}), so it is visible on /metrics
+    instead of vanishing. Never raises: these sites run during GC and
+    interpreter teardown, where even the import can fail."""
+    try:
+        from euler_tpu import obs
+
+        obs.default_registry().counter(
+            "gql_unexpected_errors_total",
+            "unexpected exceptions swallowed at best-effort gql sites",
+            ("site",)).labels(site=site).inc()
+    except Exception:
+        pass  # interpreter teardown: nothing left to report into
+
+
 _DTYPES = {
     0: np.uint64,
     1: np.int64,
@@ -204,8 +221,12 @@ class Query:
     def __del__(self):  # best-effort
         try:
             self.close()
-        except Exception:
+        except (EngineError, OSError, AttributeError, TypeError):
+            # expected at interpreter teardown: the ctypes lib / module
+            # globals may already be torn down under this object
             pass
+        except Exception as e:
+            _note_unexpected("query_del", e)
 
 
 class GraphService:
@@ -227,8 +248,10 @@ class GraphService:
     def __del__(self):
         try:
             self.stop()
-        except Exception:
-            pass
+        except (EngineError, OSError, AttributeError, TypeError):
+            pass  # teardown-order races (see Query.__del__)
+        except Exception as e:
+            _note_unexpected("graph_service_del", e)
 
 
 def start_service(data_dir: str, shard_idx: int = 0, shard_num: int = 1,
@@ -271,8 +294,10 @@ class RegistryService:
     def __del__(self):
         try:
             self.stop()
-        except Exception:
-            pass
+        except (EngineError, OSError, AttributeError, TypeError):
+            pass  # teardown-order races (see Query.__del__)
+        except Exception as e:
+            _note_unexpected("registry_service_del", e)
 
 
 def start_registry(port: int = 0) -> RegistryService:
@@ -360,7 +385,15 @@ def register_udf(name: str, fn) -> None:
                             out_v.ctypes.data_as(_libmod.c_f32p),
                             out_v.size)
             return 0
-        except Exception:
+        except (ValueError, TypeError):
+            # malformed UDF output / non-convertible arrays: the
+            # expected failure mode — rc=1 surfaces it as a query error
+            return 1
+        except Exception as e:
+            # a genuinely unexpected bug in the user fn (or this
+            # trampoline) must not vanish behind the same rc=1: count
+            # it where /metrics can see it, then fail the query
+            _note_unexpected("udf_cb", e)
             return 1
 
     _UDF_CALLBACKS[name] = cb

@@ -1,0 +1,44 @@
+"""euler_tpu.serving: the train→serve seam — export bundles, an online
+embedding/KNN inference server, and a failover-capable client.
+
+The first subsystem downstream of training: `BaseEstimator.
+export_bundle()` materializes a versioned, checksummed **ModelBundle**
+(trained params + node-embedding matrix + IVFFlat index + manifest),
+an **InferenceServer** serves `embed` / `knn` / `score` over the
+framed-TCP conventions with dynamic micro-batching (bucketed padded
+shapes — the jitted apply never recompiles in steady state) and
+explicit load shedding, and a **ServingClient** retries/fails over
+across replicas discovered through the same registry the graph shards
+heartbeat into.
+
+    est.train(input_fn, max_steps=...)
+    est.export_bundle("bundle/")                    # versioned artifact
+
+    srv = InferenceServer("bundle/", registry="tcp:127.0.0.1:9191",
+                          service="recs", replica=0)
+    cli = ServingClient(registry="tcp:127.0.0.1:9191", service="recs")
+    nbr_ids, scores = cli.knn(user_ids, k=10)       # online retrieval
+"""
+
+from euler_tpu.serving.batcher import (  # noqa: F401
+    MicroBatcher,
+    ShedError,
+    bucket_ladder,
+    run_bucketed,
+)
+from euler_tpu.serving.client import (  # noqa: F401
+    ServerOverloaded,
+    ServingClient,
+)
+from euler_tpu.serving.export import (  # noqa: F401
+    BundleCorruptionError,
+    ModelBundle,
+    embed_all,
+)
+from euler_tpu.serving.server import InferenceServer  # noqa: F401
+
+__all__ = [
+    "MicroBatcher", "ShedError", "bucket_ladder", "run_bucketed",
+    "ServingClient", "ServerOverloaded", "BundleCorruptionError",
+    "ModelBundle", "embed_all", "InferenceServer",
+]

@@ -1,0 +1,343 @@
+"""ServingClient: retrying, failover-capable client for InferenceServer
+replicas.
+
+Reuses the graph client's resilience vocabulary wholesale: RetryPolicy
+(exponential backoff, full jitter, per-call deadline, per-attempt
+timeout) and the transport-vs-semantic error split of
+`retryable_error`. Replicas come from a static ``hosts:h:p,h:p`` list
+or are discovered live from the registry (the same registry the graph
+shards heartbeat into); a transport failure fails over to the next
+replica and, under a registry, re-resolves the replica set — so a
+killed-and-restarted replica rejoins traffic within its heartbeat
+interval, exactly like a graph shard does for trainers.
+
+An explicit SHED reply from an overloaded replica is retried on
+another replica under the same deadline (counted separately from
+transport retries); when the deadline runs out the LAST explicit
+status is raised — ServerOverloaded for sheds, RetryDeadlineExceeded
+for transport — so no request ever ends without a status.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import random
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from euler_tpu import obs as _obs
+from euler_tpu.core.lib import EngineError
+from euler_tpu.graph.remote import (
+    RetryDeadlineExceeded,
+    RetryPolicy,
+    retryable_error,
+)
+from euler_tpu.serving import wire
+
+__all__ = ["ServingClient", "ServerOverloaded"]
+
+_CLIENT_IDS = itertools.count()
+
+
+class ServerOverloaded(EngineError):
+    """Every attempted replica answered SHED for the whole deadline —
+    the overload was explicit end to end."""
+
+
+class ServingClient:
+    """Client for a serving service (see module docstring).
+
+    endpoints: "hosts:h:p,h:p" static replica list, OR None with
+      `registry` set — a registry spec ("tcp:host:port" / "dir:/path")
+      plus `service` to discover replicas from.
+    retry_policy: backoff/deadline/per-attempt-timeout; the default is
+      a 10s deadline with a 5s per-attempt socket timeout.
+    stale_ms: registry entries older than this are skipped (a crashed
+      replica that never deregistered).
+    """
+
+    def __init__(self, endpoints: Optional[str] = None,
+                 registry: Optional[str] = None, service: str = "default",
+                 retry_policy: Optional[RetryPolicy] = None,
+                 stale_ms: int = 10_000, seed: int = 0):
+        if not endpoints and not registry:
+            raise ValueError("pass endpoints='hosts:h:p,...' or a "
+                             "registry spec + service")
+        self.service = service
+        self.registry = registry
+        self.stale_ms = int(stale_ms)
+        self.retry = retry_policy or RetryPolicy(
+            deadline_s=10.0, call_timeout_s=5.0)
+        self._backoff_rng = random.Random(seed ^ 0x5E21 if seed else None)
+        self._static: Optional[List[Tuple[str, int]]] = None
+        if endpoints:
+            if not endpoints.startswith("hosts:"):
+                raise ValueError("endpoints must be 'hosts:h:p,h:p'")
+            self._static = []
+            for part in endpoints[len("hosts:"):].split(","):
+                host, _, port = part.strip().rpartition(":")
+                self._static.append((host, int(port)))
+        self._mu = threading.Lock()
+        self._replicas: List[Tuple[str, int]] = list(self._static or [])
+        self._rr = 0
+        self._local = threading.local()  # per-thread connection cache
+        self._obs_name = f"serving_client{next(_CLIENT_IDS)}"
+        reg = _obs.default_registry()
+        lab = {"client": self._obs_name}
+        self._ctr = {
+            k: reg.counter(f"serving_client_{k}_total", h,
+                           ("client",)).labels(**lab)
+            for k, h in (
+                ("calls", "serving calls issued"),
+                ("retries", "retry cycles (transport or shed)"),
+                ("failovers", "calls that succeeded after >=1 failure"),
+                ("sheds", "explicit SHED replies received"),
+                ("deadline_exhausted", "calls that ran out of budget"),
+                ("rediscoveries", "registry re-resolutions"),
+            )}
+        self._hist_call_ms = reg.histogram(
+            "serving_client_call_ms",
+            "end-to-end serving call latency incl. retries",
+            ("client",)).labels(**lab)
+        self._last_error: Optional[str] = None
+        _obs.register_health(self._obs_name, self.health)
+        if self._static is None:
+            self._rediscover(initial=True)
+
+    # -- discovery ---------------------------------------------------------
+    def _rediscover(self, initial: bool = False) -> None:
+        if self._static is not None:
+            return
+        try:
+            found = wire.discover_replicas(self.registry, self.service,
+                                           max_age_ms=self.stale_ms)
+        except (OSError, wire.WireError) as e:
+            if initial:
+                raise
+            with self._mu:
+                self._last_error = f"registry scan: {e}"
+            return
+        self._ctr["rediscoveries"].inc()
+        with self._mu:
+            self._replicas = [(h, p) for h, p, _ in found]
+
+    def replicas(self) -> List[Tuple[str, int]]:
+        with self._mu:
+            return list(self._replicas)
+
+    def _next_replica(self) -> Tuple[str, int]:
+        with self._mu:
+            if not self._replicas:
+                # WireError subclasses ConnectionError → the call loop
+                # treats an (often transient) empty replica set as
+                # retryable and keeps re-resolving until the deadline
+                raise wire.WireError(
+                    f"no live replicas for service {self.service!r} "
+                    "(registry empty or all entries stale)")
+            ep = self._replicas[self._rr % len(self._replicas)]
+            self._rr += 1
+            return ep
+
+    # -- connections (one cached socket per thread per endpoint) ----------
+    def _conn(self, ep: Tuple[str, int]) -> socket.socket:
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            conns = self._local.conns = {}
+        s = conns.get(ep)
+        if s is None:
+            timeout = self.retry.call_timeout_s or 5.0
+            s = socket.create_connection(ep, timeout=timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conns[ep] = s
+        return s
+
+    def _drop_conn(self, ep: Tuple[str, int]) -> None:
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            return
+        s = conns.pop(ep, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # -- core call loop ----------------------------------------------------
+    def _call(self, msg_type: int, make_body, decode):
+        """One logical call under RetryPolicy: transport failures and
+        SHED replies rotate replicas with backoff until the deadline;
+        semantic ERROR replies raise immediately."""
+        pol = self.retry
+        self._ctr["calls"].inc()
+        deadline = time.monotonic() + max(pol.deadline_s, 0.0)
+        attempt = 0
+        last_shed: Optional[str] = None
+        t_start = time.monotonic()
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                ep = None
+                try:
+                    ep = self._next_replica()
+                    s = self._conn(ep)
+                    body = make_body(max(remaining, 0.001))
+                    wire.write_frame(s, msg_type, body)
+                    reply_type, reply = wire.read_frame(s)
+                    if reply_type != msg_type:
+                        raise wire.WireError(
+                            f"reply type {reply_type} != {msg_type}")
+                    r = wire.Reader(reply)
+                    status = r.u32()
+                    if status == wire.STATUS_OK:
+                        if attempt:
+                            self._ctr["failovers"].inc()
+                        return decode(r)
+                    reason = r.str_()
+                    if status == wire.STATUS_SHED:
+                        self._ctr["sheds"].inc()
+                        last_shed = reason
+                        raise ServerOverloaded(f"{ep[0]}:{ep[1]} shed: "
+                                               f"{reason}")
+                    raise EngineError(
+                        f"serving error from {ep[0]}:{ep[1]}: {reason}")
+                except (ServerOverloaded, ConnectionError, OSError,
+                        socket.timeout, EngineError) as e:
+                    transient = isinstance(
+                        e, (ServerOverloaded, ConnectionError, OSError,
+                            socket.timeout)) or retryable_error(e)
+                    if ep is not None and not isinstance(e,
+                                                         ServerOverloaded):
+                        self._drop_conn(ep)
+                    if not transient:
+                        raise
+                    attempt += 1
+                    with self._mu:
+                        self._last_error = str(e)
+                    now = time.monotonic()
+                    exhausted = (now >= deadline
+                                 or (pol.max_attempts
+                                     and attempt >= pol.max_attempts))
+                    if exhausted:
+                        self._ctr["deadline_exhausted"].inc()
+                        if last_shed is not None and isinstance(
+                                e, ServerOverloaded):
+                            raise ServerOverloaded(
+                                f"serving gave up after {attempt} "
+                                f"attempt(s): shed ({last_shed})") from e
+                        raise RetryDeadlineExceeded(
+                            f"serving call gave up after {attempt} "
+                            f"attempt(s) ({pol.deadline_s:.1f}s "
+                            f"deadline): {e}") from e
+                    self._ctr["retries"].inc()
+                    self._rediscover()
+                    sleep = min(pol.backoff_s(attempt, self._backoff_rng),
+                                max(deadline - now, 0.0))
+                    time.sleep(sleep)
+        finally:
+            self._hist_call_ms.observe(
+                (time.monotonic() - t_start) * 1000.0)
+
+    @staticmethod
+    def _deadline_ms(remaining_s: float) -> int:
+        return int(min(max(remaining_s, 0.001) * 1000.0, 0xFFFFFFFF))
+
+    # -- verbs -------------------------------------------------------------
+    def embed(self, ids) -> np.ndarray:
+        """[n, D] float32 embedding rows (zeros for unknown ids)."""
+        ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
+
+        def body(remaining):
+            return struct.pack("<II", self._deadline_ms(remaining),
+                               ids.size) + ids.tobytes()
+
+        def decode(r: wire.Reader):
+            n = r.u32()
+            dim = r.u32()
+            return r.array(np.float32, n * dim).reshape(n, dim)
+
+        return self._call(wire.MSG_EMBED, body, decode)
+
+    def knn(self, ids, k: int = 10,
+            exact: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+        """Per-query top-k: (neighbor ids [n, k] uint64, inner-product
+        scores [n, k] float32). exact=True is byte-identical to offline
+        tools/knn.brute_force over the bundle; exact=False uses the
+        bundle's IVFFlat index (approximate, faster at corpus scale).
+        The returned k may be clipped to the corpus size."""
+        ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
+
+        def body(remaining):
+            return struct.pack(
+                "<IIBI", self._deadline_ms(remaining), int(k),
+                1 if exact else 0, ids.size) + ids.tobytes()
+
+        def decode(r: wire.Reader):
+            n = r.u32()
+            got_k = r.u32()
+            nbr = r.array(np.uint64, n * got_k).reshape(n, got_k)
+            sims = r.array(np.float32, n * got_k).reshape(n, got_k)
+            return nbr, sims
+
+        return self._call(wire.MSG_KNN, body, decode)
+
+    def score(self, src, dst) -> np.ndarray:
+        """Inner product per (src, dst) pair: [n] float32 (0.0 when
+        either end is unknown)."""
+        src = np.ascontiguousarray(src, dtype=np.uint64).ravel()
+        dst = np.ascontiguousarray(dst, dtype=np.uint64).ravel()
+        if src.size != dst.size:
+            raise ValueError(f"src has {src.size} ids, dst {dst.size}")
+
+        def body(remaining):
+            return struct.pack("<II", self._deadline_ms(remaining),
+                               src.size) + src.tobytes() + dst.tobytes()
+
+        def decode(r: wire.Reader):
+            n = r.u32()
+            return r.array(np.float32, n)
+
+        return self._call(wire.MSG_SCORE, body, decode)
+
+    def server_health(self) -> Dict:
+        """One replica's health() dict (round-robin pick)."""
+        return self._call(wire.MSG_HEALTH, lambda _r: b"",
+                          lambda r: json.loads(r.str_()))
+
+    def info(self) -> Dict:
+        """Service/bundle identity of one replica (dim, count, spec)."""
+        return self._call(wire.MSG_INFO, lambda _r: b"",
+                          lambda r: json.loads(r.str_()))
+
+    # -- introspection / lifecycle -----------------------------------------
+    def health(self) -> Dict:
+        """Client-side counter view (obs registry children): calls,
+        retries, failovers, sheds, deadline_exhausted, rediscoveries,
+        last_error, live replica count."""
+        out = {k: int(c.value) for k, c in self._ctr.items()}
+        with self._mu:
+            out["last_error"] = self._last_error
+            out["replicas"] = len(self._replicas)
+        return out
+
+    def close(self) -> None:
+        _obs.unregister_health(self._obs_name)
+        conns = getattr(self._local, "conns", None)
+        if conns:
+            for s in conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            conns.clear()
+
+    def __enter__(self) -> "ServingClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,259 @@
+"""Model export: versioned, checksummed serving bundles.
+
+The train→serve seam (the reference's real deployment loop: train node
+embeddings offline, serve embedding-lookup / kNN queries online — the
+same split TF-GNN makes the centerpiece of its production design). A
+**ModelBundle** is a directory holding everything the serving tier
+needs, with a manifest that makes corruption detectable at load:
+
+  manifest.json     schema_version, model spec, per-file sha256 + sizes
+  params.npz        flattened trained parameter pytree ("path" → array)
+  embeddings.npy    [N, D] float32 node-embedding matrix (embed_all)
+  ids.npy           [N] uint64 node ids, SORTED ascending (the serving
+                    lookup is a searchsorted over this order)
+  index.npz         IVFFlat coarse-quantizer state (tools/knn.py)
+
+Loads verify the schema version and every file's checksum; a missing,
+truncated, or bit-flipped file raises BundleCorruptionError instead of
+serving garbage. Writes go through a temp directory + atomic rename so
+a crashed export never leaves a half-written bundle at the target path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["SCHEMA_VERSION", "BundleCorruptionError", "ModelBundle",
+           "embed_all"]
+
+SCHEMA_VERSION = 1
+
+_PARAMS = "params.npz"
+_EMB = "embeddings.npy"
+_IDS = "ids.npy"
+_INDEX = "index.npz"
+_MANIFEST = "manifest.json"
+
+
+class BundleCorruptionError(RuntimeError):
+    """The bundle on disk does not match its manifest (missing file,
+    checksum mismatch, unsupported schema) — refuse to serve it."""
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _json_safe(v: Any) -> Any:
+    """Best-effort JSON projection of a model-spec value; non-trivial
+    objects collapse to their repr (the spec is documentation, not a
+    reconstruction format)."""
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (np.integer, np.floating)):
+        return v.item()
+    return repr(v)
+
+
+class ModelBundle:
+    """In-memory view of an export bundle (see module docstring)."""
+
+    def __init__(self, params: Dict[str, np.ndarray],
+                 embeddings: np.ndarray, ids: np.ndarray,
+                 index_state: Optional[Dict[str, np.ndarray]] = None,
+                 model_spec: Optional[Dict[str, Any]] = None,
+                 meta: Optional[Dict[str, Any]] = None):
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        embeddings = np.ascontiguousarray(embeddings, dtype=np.float32)
+        if embeddings.ndim != 2 or embeddings.shape[0] != ids.shape[0]:
+            raise ValueError(
+                f"embeddings {embeddings.shape} must be [N, D] aligned "
+                f"with ids {ids.shape}")
+        if ids.size and not (ids[:-1] < ids[1:]).all():
+            raise ValueError("ids must be sorted ascending and unique "
+                             "(the serving lookup is a searchsorted)")
+        self.params = dict(params or {})
+        self.embeddings = embeddings
+        self.ids = ids
+        self.index_state = dict(index_state) if index_state else None
+        self.model_spec = dict(model_spec or {})
+        self.meta = dict(meta or {})
+
+    @property
+    def dim(self) -> int:
+        return int(self.embeddings.shape[1]) if self.embeddings.size else 0
+
+    @property
+    def count(self) -> int:
+        return int(self.ids.shape[0])
+
+    def build_index(self):
+        """IVFFlatIndex over this bundle's embeddings — from the stored
+        state when present (exactly the exported clustering), trained
+        fresh otherwise."""
+        from euler_tpu.tools.knn import IVFFlatIndex
+
+        if self.index_state is not None:
+            return IVFFlatIndex.from_state(self.index_state,
+                                           self.embeddings, self.ids)
+        idx = IVFFlatIndex()
+        idx.train_add(self.embeddings, self.ids)
+        return idx
+
+    # -- persistence -------------------------------------------------------
+    def save(self, out_dir: str) -> str:
+        """Write the bundle under out_dir (atomic: temp dir + rename).
+        Returns out_dir."""
+        out_dir = os.path.abspath(out_dir)
+        tmp = out_dir + ".tmp"
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        np.save(os.path.join(tmp, _EMB), self.embeddings)
+        np.save(os.path.join(tmp, _IDS), self.ids)
+        np.savez(os.path.join(tmp, _PARAMS),
+                 **{k: np.asarray(v) for k, v in self.params.items()})
+        files = [_EMB, _IDS, _PARAMS]
+        if self.index_state is not None:
+            np.savez(os.path.join(tmp, _INDEX), **self.index_state)
+            files.append(_INDEX)
+        manifest = {
+            "schema_version": SCHEMA_VERSION,
+            "model_spec": _json_safe(self.model_spec),
+            "meta": _json_safe(self.meta),
+            "embedding_count": self.count,
+            "embedding_dim": self.dim,
+            "files": {
+                name: {"sha256": _sha256(os.path.join(tmp, name)),
+                       "bytes": os.path.getsize(os.path.join(tmp, name))}
+                for name in files
+            },
+        }
+        with open(os.path.join(tmp, _MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        if os.path.isdir(out_dir):
+            shutil.rmtree(out_dir)
+        os.replace(tmp, out_dir)
+        return out_dir
+
+    @classmethod
+    def load(cls, bundle_dir: str, verify: bool = True) -> "ModelBundle":
+        """Load + (by default) verify a bundle. Any mismatch between
+        disk and manifest raises BundleCorruptionError."""
+        mpath = os.path.join(bundle_dir, _MANIFEST)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            raise BundleCorruptionError(
+                f"unreadable manifest {mpath}: {e}") from e
+        ver = manifest.get("schema_version")
+        if ver != SCHEMA_VERSION:
+            raise BundleCorruptionError(
+                f"bundle schema_version {ver!r} unsupported "
+                f"(this build reads {SCHEMA_VERSION})")
+        files = manifest.get("files", {})
+        for name, info in files.items():
+            path = os.path.join(bundle_dir, name)
+            if not os.path.isfile(path):
+                raise BundleCorruptionError(f"bundle file missing: {name}")
+            if not verify:
+                continue
+            size = os.path.getsize(path)
+            if size != info.get("bytes"):
+                raise BundleCorruptionError(
+                    f"{name}: size {size} != manifest {info.get('bytes')}")
+            digest = _sha256(path)
+            if digest != info.get("sha256"):
+                raise BundleCorruptionError(
+                    f"{name}: sha256 mismatch (corrupt bundle)")
+        for required in (_EMB, _IDS, _PARAMS):
+            if required not in files:
+                raise BundleCorruptionError(
+                    f"manifest lists no {required}")
+        emb = np.load(os.path.join(bundle_dir, _EMB))
+        ids = np.load(os.path.join(bundle_dir, _IDS))
+        with np.load(os.path.join(bundle_dir, _PARAMS)) as z:
+            params = {k: z[k] for k in z.files}
+        index_state = None
+        if _INDEX in files:
+            with np.load(os.path.join(bundle_dir, _INDEX)) as z:
+                index_state = {k: z[k] for k in z.files}
+        bundle = cls(params, emb, ids, index_state,
+                     manifest.get("model_spec"), manifest.get("meta"))
+        if bundle.count != manifest.get("embedding_count") \
+                or bundle.dim != manifest.get("embedding_dim"):
+            raise BundleCorruptionError(
+                "embedding shape disagrees with manifest")
+        return bundle
+
+
+def embed_all(estimator, input_fn: Optional[Callable[[], Iterator]] = None,
+              steps: int = 1_000_000
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    """Batched inference pass materializing the node-embedding matrix:
+    (ids [N] uint64 sorted unique, embeddings [N, D] float32).
+
+    Runs the estimator's jitted eval step over input_fn (default: the
+    estimator's own infer_input_fn sweep) and keeps each id's FIRST
+    embedding — a padded final batch repeats its last id, and dedup
+    by first occurrence drops exactly the pad rows. Output is sorted
+    by id: the canonical serving order (lookup = searchsorted)."""
+    if input_fn is None:
+        input_fn = getattr(estimator, "infer_input_fn", None)
+        if input_fn is None:
+            raise ValueError("estimator has no infer_input_fn; pass an "
+                             "input_fn of batches carrying infer_ids")
+    from euler_tpu.estimator.base_estimator import _merged, _to_device_tree
+
+    it = input_fn() if callable(input_fn) else input_fn
+    if estimator._eval_step is None:
+        estimator._eval_step = estimator._build_eval_step()
+    embs, ids = [], []
+    for _ in range(steps):
+        try:
+            raw = next(it)
+        except StopIteration:
+            break
+        batch = _to_device_tree(raw, estimator.max_id)
+        if estimator.state is None:
+            estimator._init_state(_merged(batch, estimator.static_batch))
+            estimator.restore_checkpoint()
+            estimator._eval_step = estimator._build_eval_step()
+        _, _, emb = estimator._eval_step(
+            estimator.state, _merged(batch, estimator.static_batch))
+        emb = np.asarray(emb, dtype=np.float32)
+        key = "infer_ids" if "infer_ids" in raw else (
+            "ids" if "ids" in raw else None)
+        if key is None:
+            raise ValueError("export batches must carry infer_ids (or "
+                             "ids) aligned with the embedding output")
+        v = raw[key]
+        v = v[0] if isinstance(v, list) else v
+        v = np.asarray(v).ravel()[: emb.shape[0]]
+        if v.shape[0] != emb.shape[0]:
+            raise ValueError(
+                f"batch carries {v.shape[0]} ids for {emb.shape[0]} "
+                "embedding rows")
+        embs.append(emb)
+        ids.append(v.astype(np.uint64))
+    if not embs:
+        raise ValueError("export input_fn yielded no batches")
+    all_ids = np.concatenate(ids)
+    all_emb = np.concatenate(embs)
+    uniq, first = np.unique(all_ids, return_index=True)
+    return uniq, np.ascontiguousarray(all_emb[first])

@@ -1,0 +1,508 @@
+"""InferenceServer: the online query endpoint over an exported bundle.
+
+Serves three verbs over the framed-TCP conventions (wire.py):
+
+  embed(ids)        [n, D] float32 embedding rows
+  knn(ids, k)       per-query top-k neighbor ids + inner-product scores
+                    (exact brute-force by default — byte-identical to
+                    tools/knn.brute_force over the bundle — or the
+                    bundle's IVFFlat index with exact=False)
+  score(src, dst)   inner product per (src, dst) pair
+
+Every verb funnels through a per-verb dynamic MicroBatcher: concurrent
+requests coalesce into one apply (flush at max_batch rows or flush_ms,
+whichever first), padded to a fixed bucket ladder so the jitted device
+apply (embedding gather / pair scoring) never recompiles in steady
+state. Past max_queue queued rows, admission control replies an
+explicit SHED status instead of queueing — overload degrades loudly
+and boundedly, never as silent latency growth. A request whose
+deadline_ms expires while queued also gets SHED (the batch result is
+discarded), so no admitted request hangs past its deadline.
+
+Replicas register in the SAME registry the graph shards use
+(``serve_<service>_<replica>__<host>_<port>``, heartbeat-refreshed),
+so ServingClient discovers them exactly like trainers discover shards.
+health() registers on the obs registry → /healthz, and every counter/
+histogram is a labeled child on the shared default registry.
+
+Unknown ids (not in the bundle) embed as zero rows and score 0 —
+counted in serving_unknown_ids_total, never an error: a freshly-added
+node simply has no embedding until the next export.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from euler_tpu import obs as _obs
+from euler_tpu.serving import wire
+from euler_tpu.serving.batcher import (
+    MicroBatcher,
+    ShedError,
+    bucket_ladder,
+    run_bucketed,
+)
+from euler_tpu.serving.export import ModelBundle
+
+__all__ = ["InferenceServer"]
+
+_DEFAULT_DEADLINE_S = 30.0
+
+
+class InferenceServer:
+    """One serving replica over one ModelBundle (see module docstring).
+
+    bundle: a ModelBundle or a bundle directory path (loaded with
+      checksum verification — a corrupt bundle refuses to serve).
+    registry: optional registry spec ("tcp:host:port", "dir:/path", or
+      a plain directory) to register in for discovery.
+    service / replica: the discovery identity.
+    max_batch / flush_ms / max_queue: MicroBatcher knobs (rows).
+    inject_apply_latency_ms: adds a fixed sleep to every flushed apply —
+      the honest way to model per-dispatch cost on CPU-bound test
+      containers (chaos/bench use only).
+    """
+
+    def __init__(self, bundle, host: str = "127.0.0.1", port: int = 0,
+                 registry: Optional[str] = None, service: str = "default",
+                 replica: int = 0, max_batch: int = 256,
+                 flush_ms: float = 2.0, max_queue: int = 0,
+                 heartbeat_s: float = 1.0,
+                 inject_apply_latency_ms: float = 0.0):
+        if isinstance(bundle, str):
+            bundle = ModelBundle.load(bundle, verify=True)
+        self.bundle = bundle
+        self.service = service
+        self.replica = int(replica)
+        self._inject_s = float(inject_apply_latency_ms) / 1000.0
+        self._ids = bundle.ids                      # sorted uint64
+        self._emb = bundle.embeddings               # [N, D] float32 host
+        self._index = None                          # built lazily (IVF)
+        self._index_mu = threading.Lock()
+
+        import jax
+        import jax.numpy as jnp
+
+        table = jnp.asarray(self._emb) if self._emb.size else None
+        self._jit_gather = jax.jit(
+            (lambda rows: table[rows]) if table is not None
+            else (lambda rows: jnp.zeros((rows.shape[0], 0), jnp.float32)))
+        self._jit_score = jax.jit(
+            (lambda a, b: jnp.sum(table[a] * table[b], axis=-1))
+            if table is not None
+            else (lambda a, b: jnp.zeros((a.shape[0],), jnp.float32)))
+        self.ladder = bucket_ladder(max_batch)
+        # warm every ladder bucket BEFORE accepting traffic: first-
+        # request jit compiles would otherwise land inside a client's
+        # per-attempt timeout, and steady state must never compile
+        for b in self.ladder:
+            rows = jnp.asarray(np.zeros(b, np.int32))
+            self._jit_gather(rows)
+            self._jit_score(rows, rows)
+
+        # -- metrics / health ----------------------------------------------
+        reg = _obs.default_registry()
+        lab = {"service": service, "replica": str(self.replica)}
+        self._ctr_requests = reg.counter(
+            "serving_requests_total", "serving requests by verb",
+            ("service", "replica", "verb"))
+        self._hist_request_ms = reg.histogram(
+            "serving_request_ms", "end-to-end in-server request latency",
+            ("service", "replica", "verb"))
+        self._ctr_deadline = reg.counter(
+            "serving_deadline_shed_total",
+            "admitted requests whose deadline expired in queue (SHED "
+            "replied)", ("service", "replica")).labels(**lab)
+        self._ctr_unknown = reg.counter(
+            "serving_unknown_ids_total",
+            "queried ids absent from the bundle (served as zeros)",
+            ("service", "replica")).labels(**lab)
+        self._ctr_errors = reg.counter(
+            "serving_errors_total", "requests answered with ERROR status",
+            ("service", "replica")).labels(**lab)
+        self._g_connections = reg.gauge(
+            "serving_connections", "live client connections",
+            ("service", "replica")).labels(**lab)
+        self._lab = lab
+
+        name = f"{service}.{self.replica}"
+        self._batchers = {
+            "embed": MicroBatcher(self._run_embed, max_batch=max_batch,
+                                  flush_ms=flush_ms, max_queue=max_queue,
+                                  name=f"{name}.embed"),
+            "knn": MicroBatcher(self._run_knn, max_batch=max_batch,
+                                flush_ms=flush_ms, max_queue=max_queue,
+                                name=f"{name}.knn"),
+            "score": MicroBatcher(self._run_score, max_batch=max_batch,
+                                  flush_ms=flush_ms, max_queue=max_queue,
+                                  name=f"{name}.score"),
+        }
+
+        # -- listener ------------------------------------------------------
+        self._stopping = threading.Event()
+        self._conn_mu = threading.Lock()
+        self._conns: List[Tuple[threading.Thread, socket.socket]] = []
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # same-port restart (the chaos kill/restart cycle): a predecessor
+        # replica's connections may still be draining — retry the bind
+        # briefly instead of failing the restart
+        bind_deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                self._listener.bind((host, port))
+                break
+            except OSError:
+                if port == 0 or time.monotonic() >= bind_deadline:
+                    raise
+                time.sleep(0.1)
+        self._listener.listen(64)
+        self.host = host
+        self.port = self._listener.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"serve-{name}", daemon=True)
+        self._accept_thread.start()
+
+        # -- discovery -----------------------------------------------------
+        self.registry = registry
+        self._entry = wire.serve_entry_name(service, self.replica,
+                                            self.host, self.port)
+        self._hb_thread = None
+        if registry:
+            wire.registry_put(registry, self._entry)
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, args=(float(heartbeat_s),),
+                name=f"serve-hb-{name}", daemon=True)
+            self._hb_thread.start()
+        self._obs_name = f"serving_{service}_{self.replica}_{self.port}"
+        _obs.register_health(self._obs_name, self.health)
+
+    # -- applies (run on the batcher workers) ------------------------------
+    def _lookup_rows(self, qids: np.ndarray) -> Tuple[np.ndarray,
+                                                      np.ndarray]:
+        """(row indices int32, valid mask) for query ids against the
+        bundle's sorted id order; unknown ids map to row 0, masked."""
+        qids = np.ascontiguousarray(qids, dtype=np.uint64)
+        if self._ids.size == 0:
+            return (np.zeros(qids.size, np.int32),
+                    np.zeros(qids.size, bool))
+        rows = np.searchsorted(self._ids, qids).clip(0, self._ids.size - 1)
+        valid = self._ids[rows] == qids
+        n_unknown = int((~valid).sum())
+        if n_unknown:
+            self._ctr_unknown.inc(n_unknown)
+        return rows.astype(np.int32), valid
+
+    def _maybe_inject(self) -> None:
+        if self._inject_s > 0:
+            time.sleep(self._inject_s)
+
+    def _run_embed(self, payloads: List[np.ndarray]) -> List[np.ndarray]:
+        """One bucketed jitted gather over every request's ids."""
+        import jax.numpy as jnp
+
+        self._maybe_inject()
+        flat = np.concatenate(payloads) if payloads else \
+            np.zeros(0, np.uint64)
+        rows, valid = self._lookup_rows(flat)
+        if flat.size:
+            out = run_bucketed(
+                lambda r: np.asarray(self._jit_gather(jnp.asarray(r))),
+                [rows], self.ladder)
+            # copy=True: jax device buffers surface as read-only numpy
+            out = np.array(out, dtype=np.float32)
+            out[~valid] = 0.0
+        else:
+            out = np.zeros((0, self.bundle.dim), np.float32)
+        results, at = [], 0
+        for p in payloads:
+            results.append(out[at:at + p.size])
+            at += p.size
+        return results
+
+    def _run_knn(self, payloads: List[Tuple[np.ndarray, int, bool]]
+                 ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Batched top-k: ONE sims pass for the whole flush at the max
+        requested k, sliced per request. The exact path is literally
+        tools/knn.brute_force over the bundle arrays — byte-identical
+        to offline retrieval by construction; exact=False routes
+        through the bundle's IVFFlat index instead."""
+        from euler_tpu.tools.knn import brute_force
+
+        self._maybe_inject()
+        results = []
+        for exact in (True, False):
+            group = [(i, p) for i, p in enumerate(payloads)
+                     if bool(p[2]) == exact]
+            if not group:
+                continue
+            flat = np.concatenate([p[0] for _, p in group])
+            rows, valid = self._lookup_rows(flat)
+            queries = self._emb[rows].copy()
+            queries[~valid] = 0.0
+            max_k = max(int(p[1]) for _, p in group)
+            max_k = max(1, min(max_k, max(self._ids.size, 1)))
+            if exact or self._ids.size == 0:
+                nbr, sims = brute_force(self._emb, self._ids, queries,
+                                        max_k)
+            else:
+                nbr, sims = self._get_index().search(queries, max_k)
+            at = 0
+            for i, (q, k, _) in group:
+                k = max(1, min(int(k), max_k))
+                results.append(
+                    (i, (nbr[at:at + q.size, :k].astype(np.uint64),
+                         sims[at:at + q.size, :k].astype(np.float32))))
+                at += q.size
+        results.sort(key=lambda t: t[0])
+        return [r for _, r in results]
+
+    def _run_score(self, payloads: List[Tuple[np.ndarray, np.ndarray]]
+                   ) -> List[np.ndarray]:
+        import jax.numpy as jnp
+
+        self._maybe_inject()
+        src = np.concatenate([p[0] for p in payloads]) if payloads \
+            else np.zeros(0, np.uint64)
+        dst = np.concatenate([p[1] for p in payloads]) if payloads \
+            else np.zeros(0, np.uint64)
+        a_rows, a_ok = self._lookup_rows(src)
+        b_rows, b_ok = self._lookup_rows(dst)
+        if src.size:
+            out = run_bucketed(
+                lambda a, b: np.asarray(
+                    self._jit_score(jnp.asarray(a), jnp.asarray(b))),
+                [a_rows, b_rows], self.ladder)
+            # copy=True: jax device buffers surface as read-only numpy
+            out = np.array(out, dtype=np.float32)
+            out[~(a_ok & b_ok)] = 0.0
+        else:
+            out = np.zeros(0, np.float32)
+        results, at = [], 0
+        for p in payloads:
+            results.append(out[at:at + p[0].size])
+            at += p[0].size
+        return results
+
+    def _get_index(self):
+        with self._index_mu:
+            if self._index is None:
+                self._index = self.bundle.build_index()
+            return self._index
+
+    def jit_cache_sizes(self) -> Dict[str, int]:
+        """Compiled-variant counts of the jitted applies (steady-state
+        no-recompile assertions): stays <= len(ladder) per fn."""
+        return {"gather": int(self._jit_gather._cache_size()),
+                "score": int(self._jit_score._cache_size())}
+
+    # -- network -----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed by stop()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conn_mu:
+                if self._stopping.is_set():
+                    # raced stop(): it already swapped the conn list out,
+                    # so nothing would ever shut this connection down
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return
+                # reap finished connection threads (heartbeat-style
+                # short-lived health probes would otherwise accumulate)
+                self._conns = [(t, s) for t, s in self._conns
+                               if t.is_alive()]
+                t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                     daemon=True)
+                self._conns.append((t, conn))
+            self._g_connections.set(len(self._conns))
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stopping.is_set():
+                try:
+                    msg_type, body = wire.read_frame(conn)
+                except (wire.WireError, OSError):
+                    return  # client went away / stop() shut us down
+                try:
+                    reply = self._dispatch(msg_type, body)
+                except ShedError as e:
+                    reply = struct_status(wire.STATUS_SHED, str(e))
+                except Exception as e:  # semantic/internal: explicit ERROR
+                    self._ctr_errors.inc()
+                    reply = struct_status(
+                        wire.STATUS_ERROR, f"{type(e).__name__}: {e}")
+                try:
+                    wire.write_frame(conn, msg_type, reply)
+                except OSError:
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, msg_type: int, body: bytes) -> bytes:
+        verb = {wire.MSG_EMBED: "embed", wire.MSG_KNN: "knn",
+                wire.MSG_SCORE: "score", wire.MSG_HEALTH: "health",
+                wire.MSG_INFO: "info"}.get(msg_type)
+        if verb is None:
+            raise ValueError(f"unknown serving msg_type {msg_type}")
+        self._ctr_requests.labels(verb=verb, **self._lab).inc()
+        t0 = time.monotonic()
+        try:
+            if msg_type == wire.MSG_HEALTH:
+                return struct.pack("<I", wire.STATUS_OK) + \
+                    wire.pack_str(json.dumps(self.health()))
+            if msg_type == wire.MSG_INFO:
+                info = {"service": self.service, "replica": self.replica,
+                        "dim": self.bundle.dim, "count": self.bundle.count,
+                        "model_spec": self.bundle.model_spec}
+                return struct.pack("<I", wire.STATUS_OK) + \
+                    wire.pack_str(json.dumps(info))
+            r = wire.Reader(body)
+            deadline_ms = r.u32()
+            timeout = (deadline_ms / 1000.0) if deadline_ms \
+                else _DEFAULT_DEADLINE_S
+            if msg_type == wire.MSG_EMBED:
+                n = r.u32()
+                ids = r.array(np.uint64, n)
+                fut = self._batchers["embed"].submit(ids, rows=n)
+                emb = self._wait(fut, timeout)
+                return (struct.pack("<III", wire.STATUS_OK, n,
+                                    emb.shape[1] if emb.ndim == 2 else 0)
+                        + np.ascontiguousarray(emb, np.float32).tobytes())
+            if msg_type == wire.MSG_KNN:
+                k = r.u32()
+                exact = bool(r.u8())
+                n = r.u32()
+                ids = r.array(np.uint64, n)
+                fut = self._batchers["knn"].submit((ids, k, exact), rows=n)
+                nbr, sims = self._wait(fut, timeout)
+                return (struct.pack("<III", wire.STATUS_OK, n,
+                                    nbr.shape[1] if nbr.size else 0)
+                        + np.ascontiguousarray(nbr, np.uint64).tobytes()
+                        + np.ascontiguousarray(sims, np.float32).tobytes())
+            # MSG_SCORE
+            n = r.u32()
+            src = r.array(np.uint64, n)
+            dst = r.array(np.uint64, n)
+            fut = self._batchers["score"].submit((src, dst), rows=n)
+            scores = self._wait(fut, timeout)
+            return (struct.pack("<II", wire.STATUS_OK, n)
+                    + np.ascontiguousarray(scores, np.float32).tobytes())
+        finally:
+            self._hist_request_ms.labels(verb=verb, **self._lab).observe(
+                (time.monotonic() - t0) * 1000.0)
+
+    def _wait(self, fut, timeout: float):
+        from concurrent.futures import TimeoutError as FutTimeout
+
+        try:
+            return fut.result(timeout=max(timeout, 0.001))
+        except FutTimeout:
+            # the flush may still land later; its result is discarded.
+            # The client gets an EXPLICIT shed, never a hang.
+            self._ctr_deadline.inc()
+            raise ShedError("deadline expired while queued") from None
+
+    # -- discovery heartbeat ----------------------------------------------
+    def _heartbeat_loop(self, interval_s: float) -> None:
+        while not self._stopping.wait(interval_s):
+            try:
+                wire.registry_put(self.registry, self._entry)
+            except (OSError, wire.WireError):
+                pass  # registry outage: entry goes stale, not fatal
+
+    # -- introspection -----------------------------------------------------
+    def health(self) -> Dict:
+        """Counter surface (also served via obs /healthz): request /
+        shed / unknown-id / error totals, per-verb queue depths, bundle
+        identity."""
+        shed = 0
+        queues = {}
+        for verb, b in self._batchers.items():
+            queues[verb] = b.queue_depth
+            shed += int(b._ctr_shed.value)
+        reqs = {
+            verb: int(self._ctr_requests.labels(
+                verb=verb, **self._lab).value)
+            for verb in ("embed", "knn", "score", "health", "info")}
+        return {
+            "service": self.service, "replica": self.replica,
+            "port": self.port, "requests": reqs,
+            "shed": shed + int(self._ctr_deadline.value),
+            "deadline_shed": int(self._ctr_deadline.value),
+            "unknown_ids": int(self._ctr_unknown.value),
+            "errors": int(self._ctr_errors.value),
+            "queue_rows": queues,
+            "bundle": {"count": self.bundle.count, "dim": self.bundle.dim},
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def stop(self) -> None:
+        """Shut the replica down: deregister, close the listener and
+        every live connection (in-flight clients see a transport error
+        — an explicit failure they fail over on, never a hang), drain
+        the batchers."""
+        if self._stopping.is_set():
+            return
+        self._stopping.set()
+        if self.registry:
+            wire.registry_remove(self.registry, self._entry)
+        try:
+            # shutdown BEFORE close: close() alone does not unblock a
+            # thread parked in accept(), leaving the port in LISTEN
+            # (same order the C++ RegistryServer::Stop uses)
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conn_mu:
+            conns, self._conns = self._conns, []
+        for _, s in conns:
+            try:
+                # RST on close (SO_LINGER 0): clients see an immediate,
+                # explicit connection reset — and no FIN_WAIT socket
+                # blocks a same-port replica restart
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             struct.pack("ii", 1, 0))
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        self._accept_thread.join(timeout=5.0)
+        for t, _ in conns:
+            t.join(timeout=5.0)
+        for b in self._batchers.values():
+            b.close(drain=False)
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5.0)
+        _obs.unregister_health(self._obs_name)
+        self._g_connections.set(0)
+
+    def __enter__(self) -> "InferenceServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def struct_status(status: int, message: str) -> bytes:
+    """Non-OK reply body: u32 status + reason string."""
+    return struct.pack("<I", status) + wire.pack_str(message)

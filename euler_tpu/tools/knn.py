@@ -53,9 +53,48 @@ class IVFFlatIndex:
         self.data = data
         self.ids = ids
 
+    def state_dict(self) -> dict:
+        """Array-only serialization of the trained clustering (no data/
+        ids payload — the serving bundle stores those once): centroids,
+        the per-point list assignment, and nprobe. Rebuild against the
+        same data/ids with from_state."""
+        if self.centroids is None:
+            raise ValueError("index not trained (call train_add first)")
+        assign = np.empty(len(self.data), dtype=np.int64)
+        for c, members in enumerate(self.lists):
+            assign[members] = c
+        return {"centroids": np.asarray(self.centroids, np.float32),
+                "assign": assign,
+                "nprobe": np.asarray(self.nprobe, np.int64)}
+
+    @classmethod
+    def from_state(cls, state: dict, data: np.ndarray,
+                   ids: np.ndarray) -> "IVFFlatIndex":
+        """Reconstruct a trained index from state_dict() output plus the
+        original (data, ids) arrays — search results are identical to
+        the index that produced the state."""
+        centroids = np.asarray(state["centroids"], np.float32)
+        assign = np.asarray(state["assign"], np.int64)
+        if assign.shape[0] != data.shape[0]:
+            raise ValueError(
+                f"index state assigns {assign.shape[0]} points but data "
+                f"has {data.shape[0]} rows")
+        idx = cls(nlist=centroids.shape[0], nprobe=int(state["nprobe"]))
+        idx.centroids = centroids
+        idx.lists = [np.where(assign == c)[0]
+                     for c in range(centroids.shape[0])]
+        idx.data = np.asarray(data, np.float32)
+        idx.ids = np.asarray(ids)
+        return idx
+
     def search(self, queries: np.ndarray, k: int):
+        if self.centroids is None:
+            raise ValueError("index not trained (call train_add first)")
+        # nprobe may have been set past nlist (or nlist shrank in
+        # train_add): probing every list is the correct degenerate case
+        nprobe = min(self.nprobe, len(self.lists))
         sims_c = queries @ self.centroids.T               # [Q, nlist]
-        probe = np.argsort(-sims_c, axis=1)[:, :self.nprobe]
+        probe = np.argsort(-sims_c, axis=1)[:, :nprobe]
         out_ids = np.zeros((len(queries), k), dtype=self.ids.dtype)
         out_sims = np.full((len(queries), k), -np.inf, np.float32)
         for qi, q in enumerate(queries):

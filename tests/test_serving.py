@@ -1,0 +1,560 @@
+"""Online serving subsystem (ISSUE 5 tentpole): export bundles,
+micro-batched inference server, failover client.
+
+Covers the acceptance loop end to end against REAL components:
+
+  * ModelBundle roundtrip + corruption detection (checksummed manifest);
+  * IVFFlatIndex direct coverage (seeded recall@10 vs brute force,
+    empty-cluster / nprobe>nlist edge cases, state roundtrip) — the
+    index is now a served component;
+  * MicroBatcher flush timing (max_batch vs flush_ms triggers),
+    admission-control shedding, bucketed-shape no-recompile;
+  * registry coexistence: serve_ entries and shard_ entries share one
+    registry without seeing each other;
+  * train → export_bundle → InferenceServer (registry-discovered) →
+    ServingClient.knn byte-identical to offline embed_all + brute
+    force;
+  * chaos: replica kill + same-port restart mid-traffic (failovers>=1,
+    zero lost-without-status requests) and an overload run (sheds
+    counted and explicit, admitted latency bounded, nothing hangs past
+    its deadline).
+
+All smokes stay tier-1 (serving marker, each well under ~10s).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.serving import (
+    BundleCorruptionError,
+    InferenceServer,
+    MicroBatcher,
+    ModelBundle,
+    ServerOverloaded,
+    ServingClient,
+    ShedError,
+    bucket_ladder,
+    run_bucketed,
+)
+from euler_tpu.serving import wire
+from euler_tpu.tools.knn import IVFFlatIndex, brute_force
+
+pytestmark = pytest.mark.serving
+
+
+def _bundle_arrays(n=100, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    emb = rng.normal(size=(n, d)).astype(np.float32)
+    ids = (np.arange(n, dtype=np.uint64) * 3 + 1)  # non-contiguous ids
+    return emb, ids
+
+
+# ---------------------------------------------------------------------------
+# ModelBundle: roundtrip + corruption detection
+# ---------------------------------------------------------------------------
+
+def test_bundle_roundtrip(tmp_path):
+    emb, ids = _bundle_arrays()
+    params = {"('emb', 'embedding')": np.arange(6, dtype=np.float32)}
+    idx = IVFFlatIndex(nlist=8, nprobe=4)
+    idx.train_add(emb, ids)
+    b = ModelBundle(params, emb, ids, idx.state_dict(),
+                    model_spec={"model_class": "Toy", "dim": 8},
+                    meta={"global_step": 7})
+    out = b.save(str(tmp_path / "bundle"))
+    b2 = ModelBundle.load(out)
+    assert np.array_equal(b2.embeddings, emb)
+    assert np.array_equal(b2.ids, ids)
+    assert np.array_equal(b2.params["('emb', 'embedding')"],
+                          params["('emb', 'embedding')"])
+    assert b2.model_spec["model_class"] == "Toy"
+    assert b2.meta["global_step"] == 7
+    assert b2.dim == 8 and b2.count == 100
+    # the stored index reproduces the exporting index's searches exactly
+    q = emb[:5]
+    a_ids, a_sims = idx.search(q, 5)
+    b_ids, b_sims = b2.build_index().search(q, 5)
+    assert np.array_equal(a_ids, b_ids)
+    assert np.array_equal(a_sims, b_sims)
+
+
+def test_bundle_corruption_detected(tmp_path):
+    emb, ids = _bundle_arrays()
+    out = ModelBundle({}, emb, ids).save(str(tmp_path / "b"))
+    # bit-flip in the embedding payload: checksum must catch it
+    path = tmp_path / "b" / "embeddings.npy"
+    raw = bytearray(path.read_bytes())
+    raw[-1] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    with pytest.raises(BundleCorruptionError, match="sha256|size"):
+        ModelBundle.load(out)
+    # verify=False loads anyway (forensics escape hatch)
+    ModelBundle.load(out, verify=False)
+    # missing file
+    path.unlink()
+    with pytest.raises(BundleCorruptionError, match="missing"):
+        ModelBundle.load(out, verify=False)
+
+
+def test_bundle_schema_and_shape_validation(tmp_path):
+    emb, ids = _bundle_arrays()
+    out = ModelBundle({}, emb, ids).save(str(tmp_path / "b"))
+    manifest = tmp_path / "b" / "manifest.json"
+    import json
+
+    m = json.loads(manifest.read_text())
+    m["schema_version"] = 999
+    manifest.write_text(json.dumps(m))
+    with pytest.raises(BundleCorruptionError, match="schema_version"):
+        ModelBundle.load(out)
+    # constructor contract: ids must be sorted unique, shapes aligned
+    with pytest.raises(ValueError, match="sorted"):
+        ModelBundle({}, emb, ids[::-1].copy())
+    with pytest.raises(ValueError, match="aligned"):
+        ModelBundle({}, emb[:-1], ids)
+
+
+# ---------------------------------------------------------------------------
+# IVFFlatIndex: direct coverage (it is now a served component)
+# ---------------------------------------------------------------------------
+
+def test_ivfflat_recall_at_10_pinned():
+    """Seeded recall@10 vs brute force on UNSTRUCTURED data (the hard
+    case — clustered corpora recall ~1.0): nlist=32/nprobe=8 measured
+    0.866, nprobe=16 measured 0.984. Pin below with slack; recall must
+    also improve monotonically with nprobe."""
+    rng = np.random.default_rng(7)
+    data = rng.normal(size=(2000, 16)).astype(np.float32)
+    ids = np.arange(2000, dtype=np.uint64)
+    queries = data[rng.integers(0, 2000, 50)]
+    exact_ids, _ = brute_force(data, ids, queries, 10)
+
+    def recall(nprobe):
+        idx = IVFFlatIndex(nlist=32, nprobe=nprobe, seed=3)
+        idx.train_add(data, ids)
+        got, _ = idx.search(queries, 10)
+        return np.mean([len(set(a) & set(b)) / 10.0
+                        for a, b in zip(got, exact_ids)])
+
+    r8, r16 = recall(8), recall(16)
+    assert r8 >= 0.80, f"recall@10 nprobe=8 regressed: {r8:.3f}"
+    assert r16 >= 0.95, f"recall@10 nprobe=16 regressed: {r16:.3f}"
+    assert r16 >= r8
+
+
+def test_ivfflat_empty_cluster_and_nprobe_edges():
+    rng = np.random.default_rng(0)
+    # 2 tight clusters but 16 requested lists → most lists empty
+    base = rng.normal(size=(2, 8)).astype(np.float32) * 5
+    data = base[np.arange(200) % 2] + \
+        rng.normal(size=(200, 8)).astype(np.float32) * 0.01
+    ids = np.arange(200, dtype=np.uint64)
+    idx = IVFFlatIndex(nlist=16, nprobe=2, seed=1)
+    idx.train_add(data, ids)
+    assert any(len(l) == 0 for l in idx.lists), "setup: wanted empty lists"
+    got, sims = idx.search(data[:4], 5)
+    assert got.shape == (4, 5)
+    assert np.isfinite(sims).all()   # probed-empty fallback scans all
+    # nprobe > nlist clips to a full scan == brute force
+    idx2 = IVFFlatIndex(nlist=4, nprobe=99, seed=1)
+    idx2.train_add(data, ids)
+    assert idx2.nprobe <= idx2.nlist
+    g2, s2 = idx2.search(data[:4], 5)
+    e2, es2 = brute_force(data, ids, data[:4], 5)
+    # ids match exactly; scores only to fp tolerance (the two paths use
+    # different BLAS shapes: per-query gemv vs one gemm)
+    assert np.array_equal(g2, e2)
+    np.testing.assert_allclose(s2, es2, rtol=1e-5)
+    # untrained index refuses to search / serialize
+    with pytest.raises(ValueError, match="not trained"):
+        IVFFlatIndex().search(data[:1], 1)
+    with pytest.raises(ValueError, match="not trained"):
+        IVFFlatIndex().state_dict()
+
+
+def test_ivfflat_state_roundtrip():
+    emb, ids = _bundle_arrays(n=300, d=12, seed=5)
+    idx = IVFFlatIndex(nlist=8, nprobe=3, seed=2)
+    idx.train_add(emb, ids)
+    idx2 = IVFFlatIndex.from_state(idx.state_dict(), emb, ids)
+    q = emb[10:20]
+    a, sa = idx.search(q, 7)
+    b, sb = idx2.search(q, 7)
+    assert np.array_equal(a, b) and np.array_equal(sa, sb)
+    with pytest.raises(ValueError, match="assigns"):
+        IVFFlatIndex.from_state(idx.state_dict(), emb[:-1], ids[:-1])
+
+
+# ---------------------------------------------------------------------------
+# MicroBatcher: flush triggers, shedding, bucketed shapes
+# ---------------------------------------------------------------------------
+
+def test_batcher_flushes_full_batch_immediately():
+    """max_batch rows pending → flush fires at once, NOT after the
+    (deliberately huge) flush window."""
+    mb = MicroBatcher(lambda ps: list(ps),
+                      max_batch=8, flush_ms=5000.0, name="t_full")
+    t0 = time.monotonic()
+    futs = [mb.submit(np.full(2, i), rows=2) for i in range(4)]
+    outs = [f.result(timeout=5.0) for f in futs]
+    dt = time.monotonic() - t0
+    assert dt < 2.0, f"full batch waited on the timer: {dt:.3f}s"
+    for i, o in enumerate(outs):
+        assert np.array_equal(o, np.full(2, i))
+    mb.close()
+
+
+def test_batcher_flush_ms_bounds_lone_request_latency():
+    mb = MicroBatcher(lambda ps: list(ps), max_batch=64, flush_ms=50.0,
+                      name="t_timer")
+    t0 = time.monotonic()
+    out = mb.submit(np.arange(3), rows=3).result(timeout=5.0)
+    dt = time.monotonic() - t0
+    assert np.array_equal(out, np.arange(3))
+    # fired by the timer: no earlier than ~the window, and not stuck
+    # until some larger bound (2-CPU container: generous upper slack)
+    assert 0.04 <= dt < 2.0, f"lone request latency {dt:.3f}s"
+    mb.close()
+
+
+def test_batcher_sheds_when_queue_full_and_counts():
+    gate = threading.Event()
+
+    def slow(ps):
+        gate.wait(10.0)
+        return list(ps)
+
+    mb = MicroBatcher(slow, max_batch=2, flush_ms=1.0, max_queue=4,
+                      name="t_shed")
+    first = mb.submit(np.zeros(2), rows=2)        # flushes, blocks on gate
+    time.sleep(0.2)                               # worker now in slow()
+    queued = [mb.submit(np.zeros(1), rows=1) for _ in range(4)]
+    with pytest.raises(ShedError, match="overloaded"):
+        mb.submit(np.zeros(1), rows=1)
+    assert int(mb._ctr_shed.value) == 1           # counted, not silent
+    gate.set()
+    first.result(timeout=5.0)
+    for f in queued:
+        f.result(timeout=5.0)
+    mb.close()
+
+
+def test_bucketed_shapes_never_recompile_in_steady_state():
+    import jax
+    import jax.numpy as jnp
+
+    table = jnp.asarray(np.arange(40, dtype=np.float32).reshape(20, 2))
+    gather = jax.jit(lambda rows: table[rows])
+    ladder = bucket_ladder(16)
+    assert ladder == (8, 16)
+    # warmup: one pass per bucket
+    for b in ladder:
+        run_bucketed(lambda r: np.asarray(gather(jnp.asarray(r))),
+                     [np.zeros(b, np.int32)], ladder)
+    warm = gather._cache_size()
+    assert warm == len(ladder)
+    # steady state: every size from 1 to 3*max_batch, no new compiles
+    rng = np.random.default_rng(0)
+    for n in list(range(1, 20)) + [33, 48]:
+        rows = rng.integers(0, 20, n).astype(np.int32)
+        out = run_bucketed(lambda r: np.asarray(gather(jnp.asarray(r))),
+                           [rows], ladder)
+        assert out.shape == (n, 2)
+        assert np.array_equal(out, np.asarray(table)[rows])
+    assert gather._cache_size() == warm, "steady-state recompile!"
+
+
+# ---------------------------------------------------------------------------
+# Registry coexistence: serving entries alongside graph shards
+# ---------------------------------------------------------------------------
+
+def test_serve_entries_coexist_with_shard_entries(tmp_path):
+    spec = str(tmp_path / "reg")
+    wire.registry_put(spec, wire.serve_entry_name("recs", 0,
+                                                  "127.0.0.1", 1234))
+    wire.registry_put(spec, wire.serve_entry_name("recs", 1,
+                                                  "127.0.0.1", 1235))
+    wire.registry_put(spec, wire.serve_entry_name("other", 0,
+                                                  "127.0.0.1", 9))
+    wire.registry_put(spec, "shard_0__127.0.0.1_9190")
+    # serving discovery sees only its own service
+    reps = wire.discover_replicas(spec, "recs")
+    assert [(h, p) for h, p, _ in reps] == [("127.0.0.1", 1234),
+                                            ("127.0.0.1", 1235)]
+    # the graph-shard scanner (C API) sees only shard_ entries
+    from euler_tpu.gql import scan_registry
+
+    shards = scan_registry(spec)
+    assert shards == {0: ("127.0.0.1", 9190, shards[0][2])}
+    # remove drops the entry
+    wire.registry_remove(spec, wire.serve_entry_name("recs", 0,
+                                                     "127.0.0.1", 1234))
+    assert len(wire.discover_replicas(spec, "recs")) == 1
+    assert wire.parse_serve_entry("shard_0__127.0.0.1_9190") is None
+    assert wire.parse_serve_entry("serve_bogus") is None
+
+
+# ---------------------------------------------------------------------------
+# End-to-end acceptance: train → export → serve → query
+# ---------------------------------------------------------------------------
+
+def _train_and_export(tmp_path, n=64, dim=8):
+    """Tiny trained estimator + exported bundle; returns (est, bundle,
+    bundle_dir, ids)."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from euler_tpu.estimator.base_estimator import BaseEstimator
+    from euler_tpu.mp_utils.base import ModelOutput
+
+    class TinyEmb(nn.Module):
+        n: int
+        dim: int
+
+        @nn.compact
+        def __call__(self, batch):
+            emb = nn.Embed(self.n, self.dim, name="emb")
+            v = emb(batch["rows"])
+            loss = jnp.mean((v - batch["target"]) ** 2)
+            return ModelOutput(v, loss, "mse", loss)
+
+    ids = (np.arange(n, dtype=np.uint64) * 2 + 3)
+    rng = np.random.default_rng(1)
+    targets = rng.normal(size=(n, dim)).astype(np.float32)
+    B = 16
+
+    def train_fn():
+        while True:
+            rows = rng.integers(0, n, B)
+            yield {"rows": rows.astype(np.int32), "target": targets[rows]}
+
+    def sweep_fn():
+        for i in range(0, n, B):
+            rows = np.arange(i, min(i + B, n))
+            if len(rows) < B:  # pad to the static batch shape
+                rows = np.concatenate(
+                    [rows, np.full(B - len(rows), rows[-1])])
+            yield {"rows": rows.astype(np.int32),
+                   "target": targets[rows],
+                   "infer_ids": ids[rows]}
+
+    est = BaseEstimator(TinyEmb(n=n, dim=dim),
+                        {"log_steps": 1000, "checkpoint_steps": 0})
+    est.train(train_fn(), max_steps=3)
+    bundle_dir = str(tmp_path / "bundle")
+    bundle = est.export_bundle(bundle_dir, input_fn=sweep_fn,
+                               nlist=4, nprobe=4)
+    return est, bundle, bundle_dir, ids
+
+
+def test_export_serve_query_end_to_end(tmp_path):
+    """The PR acceptance loop: train a small model → export_bundle() →
+    InferenceServer discovered through the registry →
+    ServingClient.knn() byte-identical to offline embed_all + brute-
+    force scoring on the same ids; jitted applies never recompile in
+    steady state."""
+    from euler_tpu.gql import start_registry
+    from euler_tpu.serving.export import embed_all
+
+    est, bundle, bundle_dir, ids = _train_and_export(tmp_path)
+    # the bundle IS embed_all's output (sorted ids, first-occurrence
+    # dedup of the padded sweep)
+    assert np.array_equal(bundle.ids, ids)
+    assert bundle.embeddings.shape == (len(ids), 8)
+    assert set(bundle.params)  # trained params made it into the bundle
+
+    reg = start_registry()
+    spec = f"tcp:127.0.0.1:{reg.port}"
+    try:
+        with InferenceServer(bundle_dir, registry=spec, service="e2e",
+                             replica=0, max_batch=16) as srv, \
+                ServingClient(registry=spec, service="e2e") as cli:
+            assert cli.replicas() == [("127.0.0.1", srv.port)]
+            info = cli.info()
+            assert info["dim"] == 8 and info["count"] == len(ids)
+
+            qids = ids[[3, 17, 31, 40]]
+            # offline comparator: embed_all + brute force on the SAME ids
+            off_ids, off_emb = embed_all(
+                est, lambda: iter(_sweep_again(ids)))
+            assert np.array_equal(off_ids, bundle.ids)
+            assert np.array_equal(off_emb, bundle.embeddings)
+            rows = np.searchsorted(bundle.ids, qids)
+            want_n, want_s = brute_force(bundle.embeddings, bundle.ids,
+                                         bundle.embeddings[rows], 5)
+
+            got_n, got_s = cli.knn(qids, k=5)       # exact (default)
+            assert np.array_equal(got_n, want_n), "knn ids not identical"
+            assert np.array_equal(got_s, want_s), "knn scores not identical"
+
+            emb = cli.embed(qids)
+            assert np.array_equal(emb, bundle.embeddings[rows])
+            sc = cli.score(qids, qids)
+            np.testing.assert_allclose(
+                sc, (bundle.embeddings[rows] ** 2).sum(-1), rtol=1e-5)
+
+            # steady state never recompiles: warmup covered the ladder
+            warm = srv.jit_cache_sizes()
+            for n_q in (1, 3, 5, 9, 17, 33):
+                cli.embed(ids[:n_q])
+                cli.score(ids[:n_q], ids[:n_q])
+            assert srv.jit_cache_sizes() == warm, "serving recompiled"
+
+            h = srv.health()
+            assert h["shed"] == 0 and h["errors"] == 0
+            assert h["requests"]["embed"] >= 7
+    finally:
+        reg.stop()
+
+
+def _sweep_again(ids):
+    """Second deterministic sweep for the offline comparator (same
+    padded batching the export used)."""
+    n = len(ids)
+    B = 16
+    rng = np.random.default_rng(1)
+    targets = rng.normal(size=(n, 8)).astype(np.float32)
+    for i in range(0, n, B):
+        rows = np.arange(i, min(i + B, n))
+        if len(rows) < B:
+            rows = np.concatenate([rows, np.full(B - len(rows), rows[-1])])
+        yield {"rows": rows.astype(np.int32), "target": targets[rows],
+               "infer_ids": ids[rows]}
+
+
+# ---------------------------------------------------------------------------
+# Chaos: replica kill + restart mid-traffic; overload shedding
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_serving_replica_kill_restart_failover(tmp_path):
+    """Kill one of two registry-discovered replicas mid-traffic, then
+    restart it on the same port: the client fails over (failovers>=1),
+    every request ends in a result or an explicit error — zero
+    lost-without-status — and the restarted replica rejoins."""
+    from euler_tpu.graph.remote import RetryPolicy
+
+    emb, ids = _bundle_arrays()
+    bundle_dir = str(tmp_path / "b")
+    ModelBundle({}, emb, ids).save(bundle_dir)
+    spec = str(tmp_path / "reg")     # shared-directory registry
+    s0 = InferenceServer(bundle_dir, registry=spec, service="ha",
+                         replica=0, max_batch=16)
+    s1 = InferenceServer(bundle_dir, registry=spec, service="ha",
+                         replica=1, max_batch=16)
+    cli = ServingClient(registry=spec, service="ha",
+                        retry_policy=RetryPolicy(deadline_s=8.0,
+                                                 base_backoff_s=0.02,
+                                                 call_timeout_s=2.0))
+    counts = {"ok": 0, "explicit_error": 0}
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                out = cli.embed(ids[:4])
+                assert out.shape == (4, emb.shape[1])
+                with lock:
+                    counts["ok"] += 1
+            except Exception:
+                with lock:           # still a STATUS: counted, not lost
+                    counts["explicit_error"] += 1
+            time.sleep(0.005)
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    try:
+        time.sleep(0.3)
+        port0 = s0.port
+        s0.stop()                        # kill mid-traffic
+        time.sleep(0.8)
+        s0 = InferenceServer(bundle_dir, host="127.0.0.1", port=port0,
+                             registry=spec, service="ha", replica=0,
+                             max_batch=16)
+        time.sleep(0.4)
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+    h = cli.health()
+    issued = counts["ok"] + counts["explicit_error"]
+    assert counts["ok"] >= 20, counts
+    assert h["failovers"] + h["retries"] >= 1, h
+    # zero lost-without-status: calls issued == calls accounted
+    assert h["calls"] == issued, (h, counts)
+    # restarted replica actually serves again
+    assert len(wire.discover_replicas(spec, "ha")) == 2
+    cli.close()
+    s0.stop()
+    s1.stop()
+
+
+@pytest.mark.chaos
+def test_serving_overload_sheds_explicitly(tmp_path):
+    """Overload a deliberately slow replica (injected per-flush
+    latency, tiny queue): sheds are counted and EXPLICIT (every refused
+    request raises ServerOverloaded), admitted-request latency stays
+    bounded, and no request outlives its deadline budget."""
+    from euler_tpu.graph.remote import (
+        RetryDeadlineExceeded,
+        RetryPolicy,
+    )
+
+    emb, ids = _bundle_arrays()
+    bundle_dir = str(tmp_path / "b")
+    ModelBundle({}, emb, ids).save(bundle_dir)
+    srv = InferenceServer(bundle_dir, service="ovl", replica=0,
+                          max_batch=8, flush_ms=1.0, max_queue=16,
+                          inject_apply_latency_ms=20.0)
+    pol = RetryPolicy(deadline_s=1.5, base_backoff_s=0.01,
+                      call_timeout_s=1.0, max_attempts=2)
+    results = {"ok": 0, "shed": 0, "deadline": 0, "other": 0}
+    admitted_lat = []
+    call_bounds = []
+    mu = threading.Lock()
+
+    def worker():
+        c = ServingClient(endpoints=f"hosts:127.0.0.1:{srv.port}",
+                          retry_policy=pol)
+        for _ in range(25):
+            t0 = time.monotonic()
+            try:
+                c.embed(ids[:8])
+                with mu:
+                    results["ok"] += 1
+                    admitted_lat.append(time.monotonic() - t0)
+            except ServerOverloaded:
+                with mu:
+                    results["shed"] += 1
+            except RetryDeadlineExceeded:
+                with mu:
+                    results["deadline"] += 1
+            except Exception:
+                with mu:
+                    results["other"] += 1
+            with mu:
+                call_bounds.append(time.monotonic() - t0)
+        c.close()
+
+    threads = [threading.Thread(target=worker) for _ in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120.0)
+    srv_shed = srv.health()["shed"]
+    srv.stop()
+    assert results["other"] == 0, results
+    assert results["ok"] > 0, results
+    assert results["shed"] > 0, f"no explicit sheds under overload: " \
+                                f"{results}"
+    assert srv_shed > 0
+    # admitted requests stay bounded: well under the client deadline
+    # even on the 2-CPU container (p99 measured ~0.26s)
+    admitted_lat.sort()
+    p99 = admitted_lat[max(int(len(admitted_lat) * 0.99) - 1, 0)]
+    assert p99 < 1.4, f"admitted p99 {p99:.3f}s breached the bound"
+    # nothing hangs past its deadline budget (1.5s + attempt slack)
+    assert max(call_bounds) < 4.0, max(call_bounds)

@@ -1,0 +1,217 @@
+"""Serving latency benchmark: micro-batching window vs offered load.
+
+Closed-loop A/B over the real InferenceServer + ServingClient stack
+(framed TCP, per-thread clients): each leg starts a fresh replica with
+one batching config, drives it with T closed-loop client threads, and
+reports p50/p99 request latency + throughput + shed counts.
+
+Legs: a batch-size-1 baseline (max_batch=1 — every request is its own
+dispatch) against micro-batched configs across --flush windows, at each
+--threads load level.
+
+Per the 2-CPU container guidance, loopback serving is CPU-bound and
+cannot show a batching win on compute alone; --inject_ms adds a fixed
+per-FLUSH latency inside the server apply (the cost a real device
+dispatch / downstream RTT would charge), which batching amortizes
+across coalesced requests — the honest A/B. With --inject_ms 0 the
+numbers measure pure stack overhead instead.
+
+Each leg prints one JSON line; the summary merges into perf.json
+(tools/collect_results.py renders RESULTS.md). `serve_smoke()` is the
+`bench.py --serve` lever: one tiny baseline-vs-batched pair.
+
+  python tools/bench_serve.py                    # default sweep
+  python tools/bench_serve.py --inject_ms 10 --threads 1,8,32
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+PERF_JSON = Path(__file__).resolve().parents[1] / "perf.json"
+
+
+def record(entry: dict) -> None:
+    print(json.dumps(entry), flush=True)
+    perf = {}
+    if PERF_JSON.exists():
+        perf = json.loads(PERF_JSON.read_text())
+    perf[entry["bench"]] = entry
+    PERF_JSON.write_text(json.dumps(perf, indent=1, sort_keys=True))
+
+
+def make_bundle(out_dir: str, nodes: int, dim: int, seed: int = 0) -> str:
+    from euler_tpu.serving import ModelBundle
+
+    rng = np.random.default_rng(seed)
+    emb = rng.normal(size=(nodes, dim)).astype(np.float32)
+    ids = np.arange(nodes, dtype=np.uint64)
+    return ModelBundle({}, emb, ids).save(out_dir)
+
+
+_LEG_IDS = [0]
+
+
+def run_leg(bundle_dir: str, *, threads: int, reqs_per_thread: int,
+            ids_per_req: int, max_batch: int, flush_ms: float,
+            inject_ms: float, verb: str = "embed", k: int = 10) -> dict:
+    """One closed-loop leg against a fresh replica; returns latency/
+    throughput stats. Latencies are per client request, measured at the
+    client, retries included."""
+    from euler_tpu.graph.remote import RetryPolicy
+    from euler_tpu.serving import InferenceServer, ServingClient
+
+    _LEG_IDS[0] += 1
+    srv = InferenceServer(bundle_dir, service=f"bench{_LEG_IDS[0]}",
+                          replica=0, max_batch=max_batch,
+                          flush_ms=flush_ms,
+                          inject_apply_latency_ms=inject_ms)
+    pol = RetryPolicy(deadline_s=30.0, call_timeout_s=20.0)
+    n_ids = srv.bundle.count
+    lat_mu = threading.Lock()
+    lats: list = []
+    errors = [0]
+
+    def worker(widx: int):
+        cli = ServingClient(endpoints=f"hosts:127.0.0.1:{srv.port}",
+                            retry_policy=pol)
+        rng = np.random.default_rng(widx)
+        for _ in range(reqs_per_thread):
+            q = rng.integers(0, n_ids, ids_per_req).astype(np.uint64)
+            t0 = time.monotonic()
+            try:
+                if verb == "knn":
+                    cli.knn(q, k=k)
+                elif verb == "score":
+                    cli.score(q, q)
+                else:
+                    cli.embed(q)
+                dt = time.monotonic() - t0
+                with lat_mu:
+                    lats.append(dt)
+            except Exception:
+                with lat_mu:
+                    errors[0] += 1
+        cli.close()
+
+    ts = [threading.Thread(target=worker, args=(i,))
+          for i in range(threads)]
+    t_wall = time.monotonic()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.monotonic() - t_wall
+    health = srv.health()
+    srv.stop()
+    lats.sort()
+
+    def pct(p):
+        return round(lats[min(int(len(lats) * p), len(lats) - 1)] * 1000,
+                     3) if lats else None
+
+    return {
+        "mode": "batch1" if max_batch == 1 else f"flush{flush_ms:g}ms",
+        "verb": verb,
+        "threads": threads,
+        "requests": len(lats),
+        "errors": errors[0],
+        "p50_ms": pct(0.50),
+        "p99_ms": pct(0.99),
+        "reqs_per_s": round(len(lats) / max(wall, 1e-9), 1),
+        "max_batch": max_batch,
+        "flush_ms": flush_ms,
+        "inject_ms": inject_ms,
+        "shed": health["shed"],
+    }
+
+
+def serve_smoke(inject_ms: float = 5.0) -> dict:
+    """The bench.py --serve lever: one tiny batch1-vs-batched pair at a
+    single load level; returns {detail-ready dict}."""
+    with tempfile.TemporaryDirectory() as td:
+        bundle = make_bundle(str(Path(td) / "b"), nodes=2000, dim=32)
+        common = dict(threads=8, reqs_per_thread=15, ids_per_req=8,
+                      inject_ms=inject_ms)
+        base = run_leg(bundle, max_batch=1, flush_ms=0.0, **common)
+        batched = run_leg(bundle, max_batch=64, flush_ms=2.0, **common)
+    return {
+        "batch1": base,
+        "batched": batched,
+        "p99_speedup": round(base["p99_ms"] / batched["p99_ms"], 2)
+        if base["p99_ms"] and batched["p99_ms"] else None,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=20_000)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--threads", default="1,8",
+                    help="comma list of closed-loop load levels")
+    ap.add_argument("--flush", default="0,2,5",
+                    help="comma list of flush_ms windows to A/B "
+                         "(a max_batch=1 baseline leg always runs)")
+    ap.add_argument("--max_batch", type=int, default=64)
+    ap.add_argument("--reqs", type=int, default=50,
+                    help="requests per client thread per leg")
+    ap.add_argument("--q", type=int, default=8, help="ids per request")
+    ap.add_argument("--k", type=int, default=10, help="knn k")
+    ap.add_argument("--verb", default="embed",
+                    choices=["embed", "knn", "score"])
+    ap.add_argument("--inject_ms", type=float, default=5.0,
+                    help="fixed per-flush latency injected in the "
+                         "server apply (0 = raw loopback overhead)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    threads = [int(v) for v in args.threads.split(",") if v]
+    windows = [float(v) for v in args.flush.split(",") if v]
+    rows = []
+    with tempfile.TemporaryDirectory() as td:
+        bundle = make_bundle(str(Path(td) / "b"), args.nodes, args.dim,
+                             args.seed)
+        for t in threads:
+            legs = [dict(max_batch=1, flush_ms=0.0)] + [
+                dict(max_batch=args.max_batch, flush_ms=w)
+                for w in windows]
+            for leg in legs:
+                row = run_leg(bundle, threads=t,
+                              reqs_per_thread=args.reqs,
+                              ids_per_req=args.q, verb=args.verb,
+                              k=args.k, inject_ms=args.inject_ms, **leg)
+                print(json.dumps(row), flush=True)
+                rows.append(row)
+
+    # the headline: batched-vs-batch1 p99 at the highest load
+    top = max(threads)
+    base = next(r for r in rows
+                if r["threads"] == top and r["mode"] == "batch1")
+    best = min((r for r in rows
+                if r["threads"] == top and r["mode"] != "batch1"),
+               key=lambda r: r["p99_ms"] or float("inf"))
+    record({
+        "bench": "serve",
+        "metric": "serving_p99_speedup_vs_batch1",
+        "value": round((base["p99_ms"] or 0)
+                       / max(best["p99_ms"] or 1e-9, 1e-9), 2),
+        "unit": "x (p99, highest load)",
+        "detail": {"rows": rows, "nodes": args.nodes, "dim": args.dim,
+                   "verb": args.verb, "inject_ms": args.inject_ms,
+                   "best_mode": best["mode"]},
+    })
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
