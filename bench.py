@@ -215,6 +215,52 @@ class _CachedGraph:
             0, self.node_count, count).astype(np.uint64)
 
 
+def _partition_from_hosts(args, nbr_h, cum_h, feat_h, label_h, stats,
+                          dt, quant, fused, alias, lookup_graph=None):
+    """--partition K: mesh-partitioned feature store (hub-first row
+    relabeling, PartitionedFeatureStore) + the neighbor/label tables
+    remapped into the same row space. Neighbor tables stay REPLICATED
+    (their bytes are cap-bounded); the feature table is the capacity
+    lever, split 1/K over the 'model' axis with the top
+    --hub_cache_frac degree-ranked rows replicated in front.
+
+    Degree ranking here comes from the capped neighbor table (the
+    cache carries no raw degrees) — a ranking proxy: rows above the
+    cap tie, so WHICH saturated hubs fill the cache is arbitrary but
+    the cache height and routing are exact. The engine-true ranking
+    A/B lives in tools/bench_host.py --mode table."""
+    import jax
+    from jax.sharding import Mesh
+
+    from euler_tpu.parallel import (
+        DeviceNeighborTable, PartitionedFeatureStore,
+    )
+    from euler_tpu.parallel.placement import put_replicated
+
+    k = int(args.partition)
+    devs = np.asarray(jax.devices()[:k]).reshape(1, k)
+    mesh = Mesh(devs, ("data", "model"))
+    n = nbr_h.shape[0] - 1
+    deg = (np.asarray(nbr_h[:n]) != n).sum(axis=1).astype(np.int64)
+    store = PartitionedFeatureStore.from_arrays(
+        np.asarray(feat_h).astype(np.dtype(dt), copy=False), deg,
+        mesh=mesh, hub_cache_frac=float(args.hub_cache_frac),
+        quantize=quant, scale_dtype=dt)
+    if lookup_graph is not None:
+        # real engine: ids are NOT dense rows — lookup() must translate
+        # through the engine's row order before the hub-first perm
+        store._graph = lookup_graph
+    nbr_p = store.apply_permutation(np.asarray(nbr_h),
+                                    remap_values=True)
+    cum_p = store.apply_permutation(np.asarray(cum_h))
+    lab_p = store.apply_permutation(np.asarray(label_h))
+    store.labels = put_replicated(
+        lab_p.astype(np.float32, copy=False), mesh)
+    sampler = DeviceNeighborTable.from_arrays(
+        nbr_p, cum_p, stats=stats, mesh=mesh, fused=fused, alias=alias)
+    return store, sampler
+
+
 def setup_tables(args, n_nodes, avg_degree, feat_dim, num_classes,
                  use_cache: bool):
     """Build (or load from the local cache) the HBM-resident bench
@@ -266,6 +312,12 @@ def setup_tables(args, n_nodes, avg_degree, feat_dim, num_classes,
             # rebuild: use_cache=False in run_bench)
             nbr_h, cum_h, feat_h, label_h = _degree_sort_tables(
                 nbr_h, cum_h, feat_h, label_h)
+        if args.partition:
+            store, sampler = _partition_from_hosts(
+                args, nbr_h, cum_h, feat_h, label_h, stats, dt, quant,
+                fused, alias)
+            return (_CachedGraph(n_nodes, int(z["edge_count"])), store,
+                    sampler, "hit")
         sampler = None if args.host_sampler else \
             DeviceNeighborTable.from_arrays(nbr_h, cum_h, stats=stats,
                                             fused=fused, alias=alias)
@@ -281,6 +333,28 @@ def setup_tables(args, n_nodes, avg_degree, feat_dim, num_classes,
               file=sys.stderr)
     data = build_products_like(n_nodes, avg_degree, feat_dim, num_classes)
     graph = data.engine
+    if args.partition:
+        # rebuild path: host tables built once (keep_host), then
+        # relabeled hub-first and re-placed partitioned
+        sampler_h = DeviceNeighborTable(graph, cap=args.cap,
+                                        keep_host=True)
+        ids = graph.all_node_ids()
+        feats = graph.get_dense_feature(ids, ["feature"])
+        if isinstance(feats, list):
+            feats = np.concatenate(feats, axis=1)
+        feats = np.concatenate(
+            [feats, np.zeros((1, feats.shape[1]), feats.dtype)])
+        labels = graph.get_dense_feature(ids, "label", num_classes)
+        labels = np.concatenate(
+            [labels, np.zeros((1, labels.shape[1]), labels.dtype)])
+        nbr_h, cum_h = sampler_h.host_tables
+        stats = {k: getattr(sampler_h, k) for k in
+                 ("hub_frac", "edge_keep_frac", "max_degree",
+                  "uniform_rows")}
+        store, sampler = _partition_from_hosts(
+            args, nbr_h, cum_h, feats, labels, stats, dt, quant,
+            fused, alias, lookup_graph=graph)
+        return graph, store, sampler, "miss"
     sampler = None if args.host_sampler else DeviceNeighborTable(
         graph, cap=args.cap, keep_host=use_cache, fused=fused,
         alias=alias)
@@ -622,6 +696,43 @@ def run_bench(args):
                   "different draw algorithms — run them as separate "
                   "A/B legs", file=sys.stderr)
             sys.exit(2)
+    # --partition levers fail BEFORE any table build, like the alias
+    # conflicts above: a leg that silently dropped the flag would be
+    # mislabeled in the sweep
+    if args.hub_cache_frac and args.partition < 2:
+        print("bench: --hub_cache_frac needs --partition >= 2 (a "
+              "replicated table has no remote leg for the hub cache "
+              "to absorb)", file=sys.stderr)
+        sys.exit(2)
+    if args.partition:
+        if args.partition < 2:
+            print("bench: --partition must be >= 2 (1 is the replicated "
+                  "layout — just drop the flag)", file=sys.stderr)
+            sys.exit(2)
+        for flag, on in (("--host_sampler", args.host_sampler),
+                         ("--walk", args.walk),
+                         ("--layerwise", args.layerwise),
+                         ("--act_cache", args.act_cache),
+                         ("--remat", args.remat),
+                         # the partitioned store has no pad_dim_to path
+                         # yet — refusing beats stamping pad_features=
+                         # true on a leg that measured an unpadded table
+                         ("--pad_features", args.pad_features)):
+            if on:
+                print(f"bench: --partition applies to the device fanout "
+                      f"feature path only (incompatible with {flag})",
+                      file=sys.stderr)
+                sys.exit(2)
+        if not 0.0 <= args.hub_cache_frac < 1.0:
+            print("bench: --hub_cache_frac must be in [0, 1)",
+                  file=sys.stderr)
+            sys.exit(2)
+        if jax.device_count() < args.partition:
+            print(f"bench: --partition {args.partition} needs that many "
+                  f"devices; backend has {jax.device_count()} (CPU runs "
+                  "force the virtual device count in main — pass "
+                  "--platform cpu or --smoke)", file=sys.stderr)
+            sys.exit(2)
     # --client_cache intercepts the deterministic host reads
     # (get_full_neighbor / get_dense_feature) — only the host feeder
     # path issues any; wrapping a device-sampler run would stamp a
@@ -749,7 +860,11 @@ def run_bench(args):
         model,
         dict(batch_size=batch, learning_rate=0.01, optimizer="adam",
              label_dim=num_classes, log_steps=1 << 30, checkpoint_steps=0,
-             train_node_type=-1, steps_per_loop=spl),
+             train_node_type=-1, steps_per_loop=spl,
+             # the opt-in partitioned-tier knobs (validated at
+             # construction; the store itself is built in setup_tables)
+             table_partition=int(args.partition),
+             hub_cache_frac=float(args.hub_cache_frac)),
         graph, flow, label_fid="label", label_dim=num_classes,
         feature_store=store, device_sampler=sampler)
 
@@ -832,6 +947,21 @@ def run_bench(args):
             "pad_features": bool(args.pad_features),
             "act_cache": bool(args.act_cache),
             "remat": bool(args.remat),
+            # partitioned-table tier (--partition K --hub_cache_frac f):
+            # per-chip bytes + the local/cached/remote gather-row split
+            # the run actually incurred (store.cache_stats is the same
+            # registry view /healthz serves)
+            "partition": None if not args.partition else {
+                "k": int(args.partition),
+                "hub_cache_frac": float(args.hub_cache_frac),
+                "degree_ranking": "capped_nbr_table",
+                # device-sampler mode draws hop rows in-jit, so these
+                # counters cover the ROOT rows the host shipped; the
+                # full-fanout counted split is tools/bench_host.py
+                # --mode table
+                "counted_rows": "roots_only",
+                "store": store.cache_stats(),
+            },
             "uniform_path": _uniform_effective(args, sampler),
             # config-independent training rate (root nodes consumed/s):
             # the honest cross-config axis when edge accounting differs
@@ -954,6 +1084,23 @@ def build_argparser():
                          "path issuing host feature reads); the feeder "
                          "A/B proper is tools/bench_host.py --mode "
                          "feeder")
+    ap.add_argument("--partition", type=int, default=0,
+                    help="K >= 2 partitions the HBM feature table into "
+                         "1/K row shards over a K-wide 'model' mesh axis "
+                         "(PartitionedFeatureStore): per-chip table "
+                         "memory drops ~Kx, cold gathers cross ICI. "
+                         "Rows are relabeled hub-first (the degree-"
+                         "sorted layout) and the neighbor tables are "
+                         "remapped to match. Device fanout mode only; "
+                         "recorded as detail.partition (candidate "
+                         "config, excluded from the cache gate)")
+    ap.add_argument("--hub_cache_frac", type=float, default=0.0,
+                    help="with --partition: replicate this fraction of "
+                         "highest-degree rows on every chip and route "
+                         "gathers cache-first, so only the cold tail "
+                         "crosses ICI (the measured degree skew means a "
+                         "tiny cache absorbs most gathers); counted in "
+                         "detail.partition.store gather_rows")
     ap.add_argument("--steps_per_loop", type=int, default=0,
                     help="0 = auto (32 on TPU since the round-5 on-chip "
                          "A/B, 1 in smoke/CPU mode): lax.scan window per "
@@ -988,6 +1135,24 @@ def build_argparser():
 
 def main(argv=None):
     args = build_argparser().parse_args(argv)
+
+    if args.partition > 1 and (args.smoke or args.platform == "cpu"):
+        # CPU runs need a virtual multi-device backend for the K-wide
+        # 'model' axis; the config route must land BEFORE the first
+        # device query (same constraint conftest/dryrun_multichip hit)
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices",
+                              max(int(args.partition), 2))
+        except Exception as e:  # older jax: XLA flag route
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count="
+                  f"{max(int(args.partition), 2)}")
+            print(f"bench: jax_num_cpu_devices unavailable ({e}); "
+                  "set XLA_FLAGS instead", file=sys.stderr)
 
     # Eager, bounded backend init BEFORE any heavy work: probe the
     # accelerator in a subprocess with retries, fall back to CPU rather
@@ -1074,6 +1239,7 @@ def main(argv=None):
                           and not args.degree_sorted
                           and not args.host_pipeline
                           and not args.client_cache
+                          and not args.partition
                           and not args.serve)
         if result.get("detail", {}).get("backend") == "tpu" \
                 and default_shapes:

@@ -154,6 +154,28 @@ class BaseEstimator:
         self.feeder_workers = int(self.params_cfg.get("feeder_workers", 0))
         self.feeder_depth = int(
             self.params_cfg.get("feeder_depth", 0)) or None
+        # partitioned device-table tier (opt-in knobs, ISSUE 6): callers
+        # that build the feature store from estimator params read these —
+        # table_partition = K mesh shards for the feature table (0/1 =
+        # replicated), hub_cache_frac = fraction of highest-degree rows
+        # replicated on every chip in front of the partition
+        # (PartitionedFeatureStore). Validated here so a typo'd config
+        # fails at construction, not after a day of training.
+        self.table_partition = int(self.params_cfg.get("table_partition", 0))
+        if self.table_partition < 0:
+            raise ValueError(
+                f"table_partition must be >= 0, got {self.table_partition}")
+        self.hub_cache_frac = float(
+            self.params_cfg.get("hub_cache_frac", 0.0))
+        if not 0.0 <= self.hub_cache_frac < 1.0:
+            raise ValueError(
+                f"hub_cache_frac must be in [0, 1), got "
+                f"{self.hub_cache_frac}")
+        if self.hub_cache_frac > 0 and self.table_partition <= 1:
+            raise ValueError(
+                "hub_cache_frac needs a partitioned table "
+                "(table_partition >= 2): a replicated table has no "
+                "remote leg for the hub cache to absorb")
         self._live_feeder = None
         self._input_factory = None
         # input-path counters live on the obs registry (children labeled
@@ -421,6 +443,14 @@ class BaseEstimator:
         graph_health = getattr(getattr(self, "graph", None), "health", None)
         if callable(graph_health):
             out["graph"] = graph_health()
+        # partitioned feature-store tier (NodeEstimator feature_store=
+        # PartitionedFeatureStore): degree stats + the hub-cache
+        # hit/miss and gather-leg counters, same pattern as the client
+        # cache's cache_stats()
+        store_stats = getattr(getattr(self, "feature_store", None),
+                              "cache_stats", None)
+        if callable(store_stats):
+            out["feature_store"] = store_stats()
         return out
 
     def _phase(self, name: str, hist):

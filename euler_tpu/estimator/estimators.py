@@ -77,8 +77,13 @@ class NodeEstimator(BaseEstimator):
                 # int8-quantized table: models dequantize after gather
                 self.static_batch["feature_scale"] = \
                     feature_store.feature_scale
-            if feature_store.labels is not None:
+            if getattr(feature_store, "labels", None) is not None:
                 self.static_batch["label_table"] = feature_store.labels
+            if getattr(feature_store, "hub_size", 0) > 0:
+                # PartitionedFeatureStore: the replicated hot-row tier —
+                # gather_feature_rows routes feature reads cache-first
+                # whenever this key is present
+                self.static_batch["hub_cache"] = feature_store.hub_cache
         if device_sampler is not None:
             self.static_batch.update(device_sampler.tables)
 
@@ -108,7 +113,13 @@ class NodeEstimator(BaseEstimator):
             # rows, everything else gathers from HBM-resident tables
             rows = [store.lookup(i) for i in batch["ids"]]
             batch = {"rows": rows, "infer_ids": roots}
-            if store.labels is None:
+            if getattr(store, "observe_batch", None) is not None:
+                # partitioned store: count this batch's gather split
+                # (local/cached/remote) — host mode carries EVERY hop's
+                # rows, so the counters cover the full fanout
+                for r in rows:
+                    store.observe_batch(r)
+            if getattr(store, "labels", None) is None:
                 batch["labels"] = self.graph.get_dense_feature(
                     roots, self.label_fid,
                     self.label_dim if self.label_dim else None)
@@ -134,10 +145,16 @@ class NodeEstimator(BaseEstimator):
         training sample sequence."""
         self._seed_counters[stream] += 1
         seed = np.uint32((stream << 31) | self._seed_counters[stream])
-        batch = {"rows": [self.feature_store.lookup(roots)],
+        root_rows = self.feature_store.lookup(roots)
+        if getattr(self.feature_store, "observe_batch", None) is not None:
+            # device-sampler mode draws hop rows in-jit, so host-side
+            # accounting covers the roots; the full-fanout split is
+            # measured by tools/bench_host.py --mode table
+            self.feature_store.observe_batch(root_rows)
+        batch = {"rows": [root_rows],
                  "sample_seed": seed,
                  "infer_ids": roots}
-        if self.feature_store.labels is None:
+        if getattr(self.feature_store, "labels", None) is None:
             batch["labels"] = self.graph.get_dense_feature(
                 roots, self.label_fid,
                 self.label_dim if self.label_dim else None)

@@ -23,11 +23,24 @@ def gather_feature_rows(batch: Dict[str, Any], rows, gather=None):
     table: when the batch carries 'feature_scale'
     (DeviceFeatureStore(quantize='int8')), the gathered int8 rows are
     dequantized by the per-column scale — the multiply fuses into the
-    consumer, and the gather itself moves half the HBM bytes."""
+    consumer, and the gather itself moves half the HBM bytes.
+
+    A 'hub_cache' batch key (PartitionedFeatureStore: the replicated
+    top-degree rows of a mesh-partitioned table) routes every feature
+    gather CACHE-FIRST: rows below the cache height are served from
+    the local replica and only the cold tail reaches `gather` (the
+    cross-shard exchange), with hub positions masked to the trailing
+    zero row — dequant applies after the combine, so int8 routing is
+    byte-exact too."""
     from euler_tpu.parallel.feature_store import dequantize_rows
 
     table = batch["feature_table"]
     take = gather or (lambda t, r: jax.numpy.take(t, r, axis=0))
+    hub = batch.get("hub_cache")
+    if hub is not None:
+        from euler_tpu.parallel.partitioned_store import hub_routed_take
+
+        take = hub_routed_take(take, hub)
     scale = batch.get("feature_scale")
     if scale is None:
         return [take(table, r) for r in rows]

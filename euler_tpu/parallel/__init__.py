@@ -31,5 +31,13 @@ from euler_tpu.parallel.device_walk import (  # noqa: F401
     walk_rows,
 )
 from euler_tpu.parallel.feature_store import DeviceFeatureStore  # noqa: F401
-from euler_tpu.parallel.ring_exchange import ring_lookup  # noqa: F401
+from euler_tpu.parallel.partitioned_store import (  # noqa: F401
+    PartitionedFeatureStore,
+    hub_routed_take,
+)
+from euler_tpu.parallel.ring_exchange import (  # noqa: F401
+    allgather_lookup,
+    pick_lookup_strategy,
+    ring_lookup,
+)
 from euler_tpu.parallel.train import make_spmd_train_step, spmd_init  # noqa: F401

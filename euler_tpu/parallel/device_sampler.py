@@ -542,7 +542,8 @@ def is_model_sharded(mesh: Optional[jax.sharding.Mesh],
 
 
 def make_table_gather(mesh: Optional[jax.sharding.Mesh] = None,
-                      axis: str = "model", data_axis: str = "data"):
+                      axis: str = "model", data_axis: str = "data",
+                      hub_cache=None):
     """gather(table, rows) → table[rows] for HBM-resident tables.
 
     Replicated tables (mesh None / trivial model axis) → a plain local
@@ -551,9 +552,23 @@ def make_table_gather(mesh: Optional[jax.sharding.Mesh] = None,
     with out-of-range rows masked to zero, then one psum over the
     'model' axis reassembles full rows. One collective per gather, rides
     ICI; per-chip table memory stays 1/mp. rows must be shardable over
-    the 'data' axis (batch and hop widths are multiples of it)."""
+    the 'data' axis (batch and hop widths are multiples of it).
+
+    hub_cache (a replicated [H, ...] copy of the table's first H rows —
+    the PartitionedFeatureStore hub-first layout) wraps the gather in
+    cache-first routing: rows < H are served from the local replica and
+    never enter the psum leg (partitioned_store.hub_routed_take). Only
+    meaningful for tables sharing that layout; pass per-table, since
+    each table has its own cache."""
     if not is_model_sharded(mesh, axis):
-        return lambda tab, rows: jnp.take(tab, rows, axis=0)
+        base = lambda tab, rows: jnp.take(tab, rows, axis=0)  # noqa: E731
+        if hub_cache is not None:
+            from euler_tpu.parallel.partitioned_store import (
+                hub_routed_take,
+            )
+
+            return hub_routed_take(base, hub_cache)
+        return base
     from functools import partial
 
     try:
@@ -593,6 +608,20 @@ def make_table_gather(mesh: Optional[jax.sharding.Mesh] = None,
 
         return _g(tab, rows_flat).reshape(shape + tab.shape[1:])
 
+    if hub_cache is not None:
+        from euler_tpu.parallel.partitioned_store import hub_routed_take
+
+        # flatten before routing: hub_routed_take's [..., None] mask
+        # broadcast and the pad redirect both operate on flat rows,
+        # exactly like the sharded gather itself
+        routed = hub_routed_take(gather, hub_cache)
+
+        def gather_hub(tab, rows):
+            shape = rows.shape
+            out = routed(tab, rows.reshape(-1))
+            return out.reshape(shape + tab.shape[1:])
+
+        return gather_hub
     return gather
 
 

@@ -14,6 +14,16 @@ For multi-chip, pass a mesh: the table is replicated by default (row
 sharding composes with ShardedEmbedding when the table itself is
 trainable — here it's frozen input data, and replication keeps the
 gather local, no collective per step).
+
+Tier selection: when the table no longer fits one chip's HBM
+replicated, use euler_tpu.parallel.partitioned_store
+.PartitionedFeatureStore instead — contiguous 1/K row shards over the
+'model' axis, a replicated hub cache (top hub_cache_frac
+highest-degree rows, gathers routed cache-first), and host-RAM
+overflow behind CachedGraphEngine. Its int8 path reuses quantize_int8
+/ dequantize_rows below, with ONE scale computed over the full table
+so partitioned and replicated lookups stay byte-identical;
+memory_plan.plan_partitioned_table emits the per-chip fit verdict.
 """
 
 from __future__ import annotations

@@ -83,3 +83,81 @@ def plan_tables(n_nodes: int, cap: int = 32, feat_dim: int = 100,
         "fused": fused,
         "shard_rows": bool(shard_rows and mp > 1),
     }
+
+
+# Per-chip HBM on the generations the plans are quoted against. v4-8 is
+# the canonical quote target (ISSUE 6): 4 chips × 32 GiB.
+HBM_BYTES = {"v4": 32 << 30, "v5e": 16 << 30, "v5p": 95 << 30}
+
+
+def plan_partitioned_table(n_nodes: int, feat_dim: int = 100,
+                           k_shards: int = 4,
+                           hub_cache_frac: float = 0.01,
+                           quantize: Optional[str] = "int8",
+                           feat_dtype_bytes: int = 2,
+                           label_dim: int = 0,
+                           device_rows: Optional[int] = None,
+                           hbm_budget_bytes: Optional[int] = None,
+                           chip: str = "v4") -> Dict:
+    """Per-chip bytes for the PartitionedFeatureStore tier, by the same
+    layout rules the builder uses (pinned by tests/test_memory_math.py
+    against a real store):
+
+      shard      ceil((device_rows + 1 pad sentinel) padded-to-K / K)
+                 rows × D × elem bytes on each chip
+      hub cache  round(hub_cache_frac · N) rows × D × elem bytes,
+                 REPLICATED on every chip (the rows also stay in the
+                 partition — the cache is a routing copy, not a move)
+      scale      [D] f32 replicated when int8-quantized
+      labels     optional [rows, label_dim] f32, sharded like the table
+      host       rows past device_rows never upload (the
+                 CachedGraphEngine overflow tier) — reported, not
+                 counted against HBM
+
+    Emits a verdict ("fits on <chip>-<4K> HBM at N nodes, K shards,
+    f hub" or the factor it misses by) against hbm_budget_bytes
+    (default: the chip generation's HBM)."""
+    if k_shards < 1:
+        raise ValueError(f"k_shards must be >= 1, got {k_shards}")
+    if not 0.0 <= float(hub_cache_frac) < 1.0:
+        raise ValueError(
+            f"hub_cache_frac must be in [0, 1), got {hub_cache_frac}")
+    dev = n_nodes if device_rows is None else min(int(device_rows),
+                                                  n_nodes)
+    hub = int(round(float(hub_cache_frac) * n_nodes))
+    dev = max(dev, hub)          # the builder clamps the same way
+    rows = dev + 1               # + trailing pad sentinel
+    padded = _ceil_div(rows, k_shards) * k_shards
+    fb = 1 if quantize == "int8" else feat_dtype_bytes
+    entries: Dict[str, int] = {
+        "feature_shard": _ceil_div(rows, k_shards) * feat_dim * fb,
+        "hub_cache": hub * feat_dim * fb,
+    }
+    if quantize == "int8":
+        entries["feature_scale"] = feat_dim * 4
+    if label_dim:
+        entries["label_shard"] = _ceil_div(rows, k_shards) * label_dim * 4
+    total = sum(entries.values())
+    budget = hbm_budget_bytes if hbm_budget_bytes is not None \
+        else HBM_BYTES[chip]
+    fits = total <= budget
+    where = f"{chip}-{4 * k_shards}"
+    verdict = (
+        f"fits on {where} HBM at {n_nodes} nodes, {k_shards} shards, "
+        f"{hub_cache_frac:g} hub ({total / 2**30:.2f} of "
+        f"{budget / 2**30:.0f} GiB/chip)" if fits else
+        f"EXCEEDS {where} HBM at {n_nodes} nodes, {k_shards} shards, "
+        f"{hub_cache_frac:g} hub by {total / budget:.2f}x — raise K, "
+        f"lower device_rows (host overflow), or quantize")
+    return {
+        "per_chip_table_bytes": entries,
+        "per_chip_total_bytes": total,
+        "rows": rows,
+        "padded_rows": padded,
+        "k_shards": k_shards,
+        "hub_rows": hub,
+        "host_rows": n_nodes - dev,
+        "hbm_budget_bytes": budget,
+        "fits": fits,
+        "verdict": verdict,
+    }

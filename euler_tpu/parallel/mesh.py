@@ -48,10 +48,13 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 # batch keys that carry HBM-resident lookup tables rather than per-step
 # data — replicated by default in shard_batch unless the caller already
-# placed them (e.g. row-sharded over 'model' via put_row_sharded)
+# placed them (e.g. row-sharded over 'model' via put_row_sharded).
+# hub_cache (PartitionedFeatureStore's replicated hot-row tier) rides
+# here too: splitting it over 'data' would turn the cache-first fast
+# path into a collective.
 REPLICATED_TABLE_KEYS = ("feature_table", "feature_scale", "label_table",
                          "nbr_table", "cum_table", "nbrcum_table",
-                         "alias_table")
+                         "alias_table", "hub_cache")
 
 
 def shard_batch(batch: Dict, mesh: Mesh,

@@ -31,12 +31,16 @@ Array = jax.Array
 
 
 def _local_answer(table_shard: Array, ids: Array, shard_lo: Array) -> Array:
-    """Rows for ids that fall inside this shard's range, zeros elsewhere."""
+    """Rows for ids that fall inside this shard's range, zeros elsewhere.
+    The masked fill is a typed zero (not the float literal 0.0): the
+    partitioned store runs int8-quantized tables through this path, and
+    a weakly-typed float zero would silently promote the whole answer to
+    f32 — breaking the byte-identity gate."""
     local = ids - shard_lo
     in_range = (local >= 0) & (local < table_shard.shape[0])
     rows = jnp.take(table_shard, jnp.clip(local, 0, table_shard.shape[0] - 1),
                     axis=0)
-    return jnp.where(in_range[:, None], rows, 0.0)
+    return jnp.where(in_range[:, None], rows, jnp.zeros((), rows.dtype))
 
 
 def ring_lookup(table: Array, ids: Array, mesh: Mesh,
@@ -90,7 +94,79 @@ def ring_lookup(table: Array, ids: Array, mesh: Mesh,
     return fn(table, ids)
 
 
+def allgather_lookup(table: Array, ids: Array, mesh: Mesh,
+                     axis: str = "model") -> Array:
+    """The one-collective alternative to ring_lookup: all-gather the id
+    shards over `axis`, answer the ids that fall in this device's rows,
+    then reduce-scatter the summed answers back so each device keeps its
+    own B/K slice. Same calling convention and the same bytes-exact
+    output as ring_lookup (every id has exactly one owning shard, so the
+    sum has one nonzero contributor per row — exact for float AND int8).
+
+    Tradeoff vs the ring (the cost model in pick_lookup_strategy):
+    2 collective launches instead of 2K ppermutes — wins when the batch
+    is small/latency-bound — but it materializes the full [B, D] answer
+    buffer on every chip before the scatter, so peak per-chip memory and
+    ICI burst scale with B·D·K where the ring stays at B·D/K per step.
+    """
+    k = mesh.shape[axis]
+    rows_per = table.shape[0] // k
+
+    def body(table_shard, ids_shard):
+        me = jax.lax.axis_index(axis)
+        all_ids = jax.lax.all_gather(ids_shard, axis).reshape(-1)   # [B]
+        shard_lo = (me * rows_per).astype(all_ids.dtype)
+        ans = _local_answer(table_shard, all_ids, shard_lo)         # [B, D]
+        # one owner per id → the scatter-sum reassembles exact rows
+        return jax.lax.psum_scatter(ans, axis, scatter_dimension=0,
+                                    tiled=True)
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P(axis)),
+        out_specs=P(axis, None),
+    )
+    return fn(table, ids)
+
+
+# Per-chip byte budget below which the all-gather variant's full [B, D]
+# answer buffer (replicated K ways before the scatter) is considered
+# cheap: under it the 2-launch all-gather wins on dispatch latency, over
+# it the ring's 1/K peak footprint wins. Tuned for ~v4/v5e VMEM-adjacent
+# staging; override per call site when measured on-chip.
+ALLGATHER_MAX_BYTES = 64 << 20
+
+
+def pick_lookup_strategy(n_ids: int, k: int, dim: int,
+                         elem_bytes: int = 4,
+                         allgather_max_bytes: int = ALLGATHER_MAX_BYTES
+                         ) -> str:
+    """Per-step lookup-strategy pick on batch ids shipped × K.
+
+    n_ids is the id count that actually enters the exchange — the full
+    batch today (neither variant deduplicates; pass the deduplicated
+    count iff a dedup stage runs upstream). Both variants move the same
+    total row bytes over ICI; what differs is launch count (all-gather:
+    2 collectives; ring: 2K ppermutes) vs peak footprint (all-gather
+    stages the full n_ids·D·elem answer on EVERY chip — a K-way
+    replicated burst — where the ring holds 1/K of that per step). So:
+    small batches on big meshes are launch-bound → 'allgather'; once
+    n_ids·K·D·elem crosses the budget the burst dominates → 'ring'.
+    K <= 1 means the table isn't partitioned at all → 'local' (plain
+    take, no collective)."""
+    if k <= 1:
+        return "local"
+    if n_ids * k * dim * elem_bytes <= allgather_max_bytes:
+        return "allgather"
+    return "ring"
+
+
 def reference_lookup(table: Array, ids: Array) -> Array:
-    """Single-device equivalent: plain take (the numbers ring_lookup must
-    reproduce)."""
+    """Single-device equivalent: plain take (the numbers ring_lookup and
+    allgather_lookup must reproduce byte-for-byte)."""
     return jnp.take(table, ids, axis=0)

@@ -30,12 +30,32 @@ __all__ = ["ShardedEmbedding", "param_shardings", "apply_param_shardings"]
 
 
 class ShardedEmbedding(nn.Module):
-    """Embedding table partitioned row-wise over the 'model' mesh axis."""
+    """Embedding table partitioned row-wise over the 'model' mesh axis.
+
+    lookup picks how the partitioned rows are fetched:
+      'gspmd'      (default) a plain take on the metadata-sharded table;
+                   XLA GSPMD chooses the collective — the historical
+                   behavior.
+      'ring'       explicit K-step ppermute exchange
+                   (ring_exchange.ring_lookup) under shard_map: peak
+                   ICI/buffer footprint is 1/K of the all-gather, the
+                   large-batch regime. Requires `mesh`.
+      'allgather'  explicit all-gather + reduce-scatter
+                   (ring_exchange.allgather_lookup): two collective
+                   launches, the small-batch/latency regime. Requires
+                   `mesh`.
+    Both explicit modes are differentiable (ppermute/psum_scatter carry
+    transposes), produce the same numbers as 'gspmd', and exist as the
+    staged on-chip A/B against GSPMD's choice. num_embeddings must
+    divide the mesh's partition axis for the explicit modes (the shard
+    layout put_row_sharded would otherwise pad)."""
 
     num_embeddings: int
     dim: int
     init_scale: float = 0.05
     partition_axis: str = "model"
+    lookup: str = "gspmd"
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, ids: Array) -> Array:
@@ -48,7 +68,45 @@ class ShardedEmbedding(nn.Module):
             (self.num_embeddings, self.dim),
         )
         rows = bucketize_ids(ids, self.num_embeddings)
-        return jnp.take(jnp.asarray(table), rows, axis=0)
+        tab = jnp.asarray(table)
+        if self.lookup == "gspmd":
+            return jnp.take(tab, rows, axis=0)
+        if self.lookup not in ("ring", "allgather"):
+            raise ValueError(
+                f"ShardedEmbedding.lookup must be 'gspmd', 'ring' or "
+                f"'allgather', got {self.lookup!r}")
+        mesh = self.mesh
+        k = 1 if mesh is None else int(
+            dict(mesh.shape).get(self.partition_axis, 1))
+        if k <= 1:  # no real partition axis — explicit modes degenerate
+            return jnp.take(tab, rows, axis=0)
+        if self.num_embeddings % k:
+            raise ValueError(
+                f"ShardedEmbedding.lookup={self.lookup!r} needs "
+                f"num_embeddings ({self.num_embeddings}) divisible by "
+                f"the '{self.partition_axis}' axis size {k}")
+        from euler_tpu.parallel.ring_exchange import (
+            allgather_lookup, ring_lookup,
+        )
+
+        fn = ring_lookup if self.lookup == "ring" else allgather_lookup
+        flat = rows.reshape(-1)
+        pad = (-flat.shape[0]) % k
+        if pad:  # id shards must divide evenly; pads gather row 0
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad,), flat.dtype)])
+        # pin the id vector REPLICATED before it enters shard_map: on a
+        # mesh with a non-trivial data axis, GSPMD may shard this
+        # in-jit intermediate over 'data', and shard_map's implicit
+        # reshard to P(axis) then reads wrong values on jax without
+        # pvary/pcast (observed on 0.4.37: whole rows wrong while the
+        # eager path is fine). No-op when already replicated.
+        flat = jax.lax.with_sharding_constraint(
+            flat, NamedSharding(mesh, P()))
+        out = fn(tab, flat, mesh, self.partition_axis)
+        if pad:
+            out = out[:-pad]
+        return out.reshape(rows.shape + (self.dim,))
 
 
 def param_shardings(variables: Dict, mesh: Mesh) -> Dict:

@@ -14,6 +14,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import linen as nn
 from jax.sharding import Mesh
@@ -42,12 +43,24 @@ def spmd_init(model: nn.Module, tx: optax.GradientTransformation,
 def make_spmd_train_step(model: nn.Module,
                          tx: optax.GradientTransformation,
                          mutable_keys: Tuple[str, ...] = (),
-                         nonfinite_guard: bool = True) -> Callable:
+                         nonfinite_guard: bool = True,
+                         table_store=None,
+                         table_rows_key: str = "rows") -> Callable:
     """Jitted (state, batch) → (state, loss, metric). State buffers are
     donated so HBM is reused across steps — which is exactly why the
     nonfinite guard defaults on: one NaN loss applied to donated buffers
     destroys the only copy of the params. A guarded bad step keeps the
-    old params/opt_state and bumps state['skipped_steps']."""
+    old params/opt_state and bumps state['skipped_steps'].
+
+    table_store (a PartitionedFeatureStore) turns on per-step gather
+    accounting in the HOST wrapper: each dispatch's table rows
+    (batch[table_rows_key], a row array or list of per-hop row arrays)
+    are routed through store.observe_batch before the device call, so
+    the table_gather_{local,cached,remote}_rows counters track exactly
+    the dispatched steps. Pass HOST row arrays — a device-resident
+    array here costs a blocking device→host fetch per step. (The
+    estimator path does its own counting in NodeEstimator._node_batch/
+    _sampler_batch; this hook serves raw spmd-loop callers.)"""
 
     def train_step(state, batch):
         # states built before spmd_init grew the counter (hand-rolled
@@ -107,6 +120,10 @@ def make_spmd_train_step(model: nn.Module,
 
     def stepped(state, batch):
         t0 = time.monotonic()
+        if table_store is not None and table_rows_key in batch:
+            rows = batch[table_rows_key]
+            for r in (rows if isinstance(rows, (list, tuple)) else [rows]):
+                table_store.observe_batch(np.asarray(r))
         with _obs.span("spmd_train_step"):
             out = jitted(state, batch)
         c_steps.inc()

@@ -821,6 +821,10 @@ def test_device_node_sampler_weighted():
     assert 0.62 < frac3 < 0.78       # weight 7/10
 
 
+# slow (~36s): full train loops for both unsupervised device models;
+# the device walk + unsup paths keep tier-1 smokes via the examples
+# keep-set (deepwalk/graphsage --device_sampler)
+@pytest.mark.slow
 def test_device_skipgram_and_unsup_sage_train():
     """Both on-device unsupervised models run a jitted step and a short
     training loop with falling loss."""
@@ -868,6 +872,9 @@ def test_device_skipgram_and_unsup_sage_train():
         assert 0.0 < ev["metric"] <= 1.0
 
 
+# slow (~72s): fresh-process selftest (entry + dryrun_multichip(8));
+# the same SPMD step runs in-process in test_spmd_graphsage_step_runs
+@pytest.mark.slow
 def test_graft_entry_selftest_subprocess():
     """__graft_entry__.py's self-test mode (entry() compile +
     dryrun_multichip(8) with the config-route backend switch) must run
@@ -1644,6 +1651,9 @@ def test_ema_update_first_write_full_scale():
     np.testing.assert_allclose(np.asarray(out2), 1.8)  # visited: EMA
 
 
+# slow (~25s): sharded-act-cache estimator loop; the act-cache path
+# keeps a tier-1 smoke via the examples keep-set (--act_cache variant)
+@pytest.mark.slow
 def test_act_cache_row_sharded():
     """The activation cache composes with model-axis sharding: re-placed
     row-sharded (shard_act_cache), the estimator's jitted train step
