@@ -1130,6 +1130,14 @@ def build_argparser():
                          "spans, graph rpc spans) to this path; view "
                          "with chrome://tracing, ui.perfetto.dev, or "
                          "tools/trace_dump.py")
+    ap.add_argument("--rpc_mux", action="store_true", default=False,
+                    help="after the training bench, run the mux-"
+                         "transport smoke (tools/bench_host.py --mode "
+                         "rpc): counted pool-vs-mux-vs-mux+dedup+"
+                         "compression A/B under 10ms injected RTT over "
+                         "a live 2-shard cluster; recorded as "
+                         "detail.rpc (excluded from the TPU cache "
+                         "gate)")
     return ap
 
 
@@ -1220,6 +1228,15 @@ def main(argv=None):
                 from bench_serve import serve_smoke
 
                 result["detail"]["serve"] = serve_smoke()
+            if args.rpc_mux:
+                # mux-transport smoke AFTER the measured region, same
+                # rule as --serve: its cluster/engines must not pollute
+                # the training artifact's obs_measured delta
+                sys.path.insert(0, os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), "tools"))
+                from bench_host import rpc_smoke
+
+                result["detail"]["rpc"] = rpc_smoke()
         # canonical config only: non-default shapes OR non-headline
         # sampler/precision flags (--host_sampler / --fp32, advisor r2
         # medium) must not overwrite the cached headline number
@@ -1240,7 +1257,8 @@ def main(argv=None):
                           and not args.host_pipeline
                           and not args.client_cache
                           and not args.partition
-                          and not args.serve)
+                          and not args.serve
+                          and not args.rpc_mux)
         if result.get("detail", {}).get("backend") == "tpu" \
                 and default_shapes:
             # only canonical default-config runs refresh the cache — a

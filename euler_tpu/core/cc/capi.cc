@@ -25,6 +25,7 @@
 #include "graph.h"
 #include "io.h"
 #include "ops.h"
+#include "rpc.h"
 #include "threadpool.h"
 
 namespace {
@@ -688,6 +689,39 @@ int etg_get_edge_binary_feature(int64_t h, const uint64_t* src,
   g->GetEdgeBinaryFeature(src, dst, types, static_cast<size_t>(n), fid,
                           &res->offsets, &res->bytes);
   return 0;
+}
+
+// ---- RPC transport config / counters (protocol v2 mux) ----
+// Process-global transport knobs; applies to graph-service channels
+// created afterwards (engines built after the call). Negative values
+// leave the corresponding knob unchanged.
+void etg_rpc_config(int mux, int mux_connections, int64_t compress_threshold,
+                    int max_inflight) {
+  auto& c = et::GlobalRpcConfig();
+  if (mux >= 0) c.mux = mux != 0;
+  if (mux_connections > 0) c.mux_connections = mux_connections;
+  if (compress_threshold >= 0) c.compress_threshold = compress_threshold;
+  if (max_inflight > 0) c.max_inflight = max_inflight;
+}
+
+// out[12]: round_trips, bytes_sent, bytes_received, bytes_sent_raw,
+// bytes_received_raw, connections_opened, compressed_frames_sent,
+// compressed_frames_received, mux_calls, v1_calls, hello_fallbacks,
+// inflight (gauge). Client-edge accounting only (see RpcCounters).
+void etg_rpc_stats(uint64_t* out) {
+  auto& c = et::GlobalRpcCounters();
+  out[0] = c.round_trips.load();
+  out[1] = c.bytes_sent.load();
+  out[2] = c.bytes_received.load();
+  out[3] = c.bytes_sent_raw.load();
+  out[4] = c.bytes_received_raw.load();
+  out[5] = c.connections_opened.load();
+  out[6] = c.compressed_frames_sent.load();
+  out[7] = c.compressed_frames_received.load();
+  out[8] = c.mux_calls.load();
+  out[9] = c.v1_calls.load();
+  out[10] = c.hello_fallbacks.load();
+  out[11] = static_cast<uint64_t>(std::max<int64_t>(c.inflight.load(), 0));
 }
 
 // 64-bit string hash for Python data-prep id mapping (parity:

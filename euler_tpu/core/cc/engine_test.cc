@@ -8,6 +8,8 @@
 //
 // Mirrors the reference's gtest tiers (SURVEY.md §4): common (samplers,
 // threadpool, rng), graph store, serde, executor, index, compiler.
+#include <unistd.h>
+
 #include <atomic>
 #include <cmath>
 #include <cstdio>
@@ -423,6 +425,127 @@ void TestRegistryServer() {
   CHECK_TRUE(!ScanRegistrySpec(spec, &found, nullptr).ok());
 }
 
+// ---- rpc: protocol v2 mux transport ----
+void TestRpcMuxTransport() {
+  std::shared_ptr<const Graph> g(RingGraph());
+  // heap-held: a stack-placed server's mutexes land on addresses a
+  // prior test's destroyed locals used, which TSAN misreads
+  auto server = std::make_unique<GraphServer>(g, nullptr, 0, 1, 1);
+  CHECK_OK(server->Start(0));
+
+  RpcConfig saved = GlobalRpcConfig();
+  GlobalRpcConfig().mux = true;
+  GlobalRpcConfig().mux_connections = 1;
+  GlobalRpcConfig().compress_threshold = 64;
+  auto& ctr = GlobalRpcCounters();
+
+  // v1 reference bytes (classic channel, no mux)
+  RpcChannel v1ch("127.0.0.1", server->port());
+  std::vector<char> v1_meta;
+  CHECK_OK(v1ch.Call(1 /*kMeta*/, {}, &v1_meta));
+  CHECK_TRUE(!v1_meta.empty());
+
+  // many concurrent in-flight calls over ONE mux connection; replies
+  // come back out-of-order and must route to the right caller
+  uint64_t conns0 = ctr.connections_opened.load();
+  RpcChannel ch("127.0.0.1", server->port());
+  ch.set_mux(true);
+  {
+    ThreadPool pool(8);
+    std::atomic<int> remaining{32};
+    std::atomic<bool> all_ok{true};
+    std::mutex mu;
+    std::condition_variable cv;
+    for (int i = 0; i < 32; ++i) {
+      pool.Schedule([&, i] {
+        std::vector<char> reply;
+        uint32_t mt = (i % 2 == 0) ? 1u /*kMeta*/ : 2u /*kPing*/;
+        Status s = ch.Call(mt, {}, &reply);
+        if (!s.ok() || (mt == 1 && reply != v1_meta)) all_ok.store(false);
+        if (remaining.fetch_sub(1) == 1) {
+          std::lock_guard<std::mutex> lk(mu);
+          cv.notify_one();
+        }
+      });
+    }
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return remaining.load() == 0; });
+    CHECK_TRUE(all_ok.load());
+  }
+  CHECK_TRUE(ch.mux_active());
+  // 32 calls rode exactly one new connection
+  CHECK_TRUE(ctr.connections_opened.load() - conns0 == 1);
+
+  // async surface: reply delivered via callback on the client pool
+  {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool fired = false;
+    Status got = Status::IOError("not fired");
+    ch.CallAsync(2 /*kPing*/, {}, [&](Status s, std::vector<char>) {
+      std::lock_guard<std::mutex> lk(mu);
+      got = s;
+      fired = true;
+      cv.notify_one();
+    });
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return fired; });
+    CHECK_OK(got);
+  }
+
+  // kill the server while callers hammer the channel: every parked
+  // waiter must come back with a STATUS (the joins below are the
+  // no-hang assertion)
+  {
+    std::atomic<bool> saw_failure{false};
+    std::vector<std::thread> threads;
+    for (int t = 0; t < 4; ++t) {
+      threads.emplace_back([&] {
+        for (int i = 0; i < 200; ++i) {
+          std::vector<char> reply;
+          if (!ch.Call(2 /*kPing*/, {}, &reply, /*max_retries=*/2).ok()) {
+            saw_failure.store(true);
+            return;
+          }
+        }
+      });
+    }
+    ::usleep(5000);
+    server->Stop();
+    for (auto& th : threads) th.join();
+    CHECK_TRUE(saw_failure.load());
+  }
+  GlobalRpcConfig() = saved;
+}
+
+// ---- rpc: v2 client against a v1-only server falls back cleanly ----
+void TestRpcHelloFallback() {
+  std::shared_ptr<const Graph> g(RingGraph());
+  ::setenv("EULER_TPU_RPC_SERVER_V1", "1", 1);
+  auto server = std::make_unique<GraphServer>(g, nullptr, 0, 1, 1);
+  CHECK_OK(server->Start(0));
+  ::unsetenv("EULER_TPU_RPC_SERVER_V1");
+
+  RpcConfig saved = GlobalRpcConfig();
+  GlobalRpcConfig().mux = true;
+  auto& ctr = GlobalRpcCounters();
+  uint64_t fb0 = ctr.hello_fallbacks.load();
+
+  RpcChannel v1ch("127.0.0.1", server->port());
+  std::vector<char> v1_meta;
+  CHECK_OK(v1ch.Call(1 /*kMeta*/, {}, &v1_meta));
+
+  RpcChannel ch("127.0.0.1", server->port());
+  ch.set_mux(true);
+  std::vector<char> meta;
+  CHECK_OK(ch.Call(1 /*kMeta*/, {}, &meta));  // hello refused → v1 path
+  CHECK_TRUE(meta == v1_meta);
+  CHECK_TRUE(!ch.mux_active());
+  CHECK_TRUE(ctr.hello_fallbacks.load() == fb0 + 1);
+  server->Stop();
+  GlobalRpcConfig() = saved;
+}
+
 }  // namespace
 }  // namespace et
 
@@ -434,6 +557,8 @@ int main() {
   et::TestParallelForCoversAll();
   et::TestThreadPoolStress();
   et::TestRegistryServer();
+  et::TestRpcMuxTransport();
+  et::TestRpcHelloFallback();
   et::TestI32OffsetGuard();
   et::TestGraphStore();
   et::TestConcurrentSampling();

@@ -12,18 +12,36 @@
 
 #include <sys/stat.h>
 
+#include <zlib.h>
+
 #include <chrono>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <sstream>
+#include <unordered_map>
 
 #include "threadpool.h"
 
 namespace et {
 
+RpcConfig& GlobalRpcConfig() {
+  static RpcConfig* c = new RpcConfig();
+  return *c;
+}
+
+RpcCounters& GlobalRpcCounters() {
+  static RpcCounters* c = new RpcCounters();
+  return *c;
+}
+
 namespace {
-constexpr uint32_t kFrameMagic = 0x52465445;  // 'ETFR'
+constexpr uint32_t kFrameMagic = 0x52465445;    // 'ETFR'
+constexpr uint32_t kFrameMagicV2 = 0x32465445;  // 'ETF2'
+constexpr uint32_t kFrameFlagCompressed = 1u;   // body: u64 raw_len | zlib
+constexpr uint32_t kProtoV2 = 2;
+constexpr uint32_t kFeatAcceptCompressed = 1u;  // hello feature bit
 
 enum MsgType : uint32_t {
   kExecute = 0,
@@ -33,6 +51,7 @@ enum MsgType : uint32_t {
   kRegList = 4,    // body: empty → u32 version | u32 count | per entry:
                    // str name, i64 age_ms, u64 put-sequence
   kRegRemove = 5,  // body: entry name → dropped (clean shutdown)
+  kHello = 6,      // v2 only: version | feature bits | compress threshold
 };
 
 // kRegList reply schema version: mixed-binary registry pairs must fail
@@ -80,6 +99,100 @@ bool ReadFrame(int fd, uint32_t* msg_type, std::vector<char>* body) {
   if (len > (1ULL << 33)) return false;  // 8 GiB sanity cap
   body->resize(len);
   return len == 0 || ReadAll(fd, body->data(), len);
+}
+
+// --- protocol v2: correlated frames + adaptive zlib-1 bodies --------------
+
+// v2 header: magic | msg_type | flags | request_id | body_len (28 bytes).
+constexpr size_t kV2HdrLen = 28;
+
+bool WriteFrameV2(int fd, uint32_t msg_type, uint32_t flags,
+                  uint64_t request_id, const char* body, size_t len) {
+  char hdr[kV2HdrLen];
+  std::memcpy(hdr, &kFrameMagicV2, 4);
+  std::memcpy(hdr + 4, &msg_type, 4);
+  std::memcpy(hdr + 8, &flags, 4);
+  std::memcpy(hdr + 12, &request_id, 8);
+  uint64_t l = len;
+  std::memcpy(hdr + 20, &l, 8);
+  return WriteAll(fd, hdr, kV2HdrLen) && WriteAll(fd, body, len);
+}
+
+// Reads a frame of EITHER version (*ver = 1 or 2): the 16-byte v1 header
+// first, then — when the magic says v2 — the 12 remaining header bytes.
+// accept_v2=false emulates a pre-v2 binary exactly (unknown magic drops
+// the connection), which is how EULER_TPU_RPC_SERVER_V1 pins interop.
+bool ReadAnyFrame(int fd, int* ver, uint32_t* msg_type, uint32_t* flags,
+                  uint64_t* request_id, std::vector<char>* body,
+                  bool accept_v2 = true) {
+  char hdr[kV2HdrLen];
+  if (!ReadAll(fd, hdr, 16)) return false;
+  uint32_t magic;
+  std::memcpy(&magic, hdr, 4);
+  uint64_t len;
+  if (magic == kFrameMagic) {
+    *ver = 1;
+    *flags = 0;
+    *request_id = 0;
+    std::memcpy(msg_type, hdr + 4, 4);
+    std::memcpy(&len, hdr + 8, 8);
+  } else if (magic == kFrameMagicV2 && accept_v2) {
+    *ver = 2;
+    if (!ReadAll(fd, hdr + 16, kV2HdrLen - 16)) return false;
+    std::memcpy(msg_type, hdr + 4, 4);
+    std::memcpy(flags, hdr + 8, 4);
+    std::memcpy(request_id, hdr + 12, 8);
+    std::memcpy(&len, hdr + 20, 8);
+  } else {
+    return false;
+  }
+  if (len > (1ULL << 33)) return false;  // 8 GiB sanity cap
+  body->resize(len);
+  return len == 0 || ReadAll(fd, body->data(), len);
+}
+
+// Compressed body layout: u64 raw_len | zlib stream (level 1 — the
+// latency-friendly setting; feature replies are the target, and level 1
+// already captures most of the float-row redundancy). Returns false when
+// deflate would NOT shrink the frame — the caller then sends raw with no
+// flag bit, which is what makes the compression adaptive per frame.
+bool DeflateBody(const std::vector<char>& raw, std::vector<char>* out) {
+  uLong bound = compressBound(static_cast<uLong>(raw.size()));
+  out->resize(8 + bound);
+  uint64_t raw_len = raw.size();
+  std::memcpy(out->data(), &raw_len, 8);
+  uLongf dest_len = bound;
+  if (compress2(reinterpret_cast<Bytef*>(out->data() + 8), &dest_len,
+                reinterpret_cast<const Bytef*>(raw.data()),
+                static_cast<uLong>(raw.size()), /*level=*/1) != Z_OK)
+    return false;
+  if (8 + dest_len >= raw.size()) return false;
+  out->resize(8 + dest_len);
+  return true;
+}
+
+bool InflateBody(const std::vector<char>& comp, std::vector<char>* out) {
+  if (comp.size() < 8) return false;
+  uint64_t raw_len;
+  std::memcpy(&raw_len, comp.data(), 8);
+  if (raw_len > (1ULL << 33)) return false;
+  out->resize(raw_len);
+  uLongf dest_len = static_cast<uLongf>(raw_len);
+  if (raw_len > 0 &&
+      uncompress(reinterpret_cast<Bytef*>(out->data()), &dest_len,
+                 reinterpret_cast<const Bytef*>(comp.data() + 8),
+                 static_cast<uLong>(comp.size() - 8)) != Z_OK)
+    return false;
+  return dest_len == raw_len;
+}
+
+// Full-jitter retry sleep: U(0, 2^attempt ms), capped at 64ms. The old
+// fixed 2^attempt ladder fired synchronized retry stampedes — every
+// worker that saw a shard die woke on the same schedule (the Python
+// RetryPolicy already jitters; this matches it at the transport layer).
+void JitteredBackoffUs(int attempt) {
+  uint64_t hi = 1000ULL * (1ULL << std::min(attempt, 6));
+  ::usleep(static_cast<useconds_t>(ThreadLocalRng().NextUInt(hi + 1)));
 }
 }  // namespace
 
@@ -184,6 +297,11 @@ GraphServer::GraphServer(std::shared_ptr<const Graph> graph,
 GraphServer::~GraphServer() { Stop(); }
 
 Status GraphServer::Start(int port) {
+  // interop test hook: serve exactly like a pre-v2 binary (v2 hellos are
+  // an unknown magic → connection dropped, clients fall back to v1)
+  const char* v1_env = std::getenv("EULER_TPU_RPC_SERVER_V1");
+  v1_only_ = v1_env != nullptr && v1_env[0] != '\0' &&
+             std::strcmp(v1_env, "0") != 0;
   listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
   if (listen_fd_ < 0) return Status::IOError("socket() failed");
   int one = 1;
@@ -201,6 +319,20 @@ Status GraphServer::Start(int port) {
   ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
   port_ = ntohs(addr.sin_port);
   acceptor_ = std::thread([this] { AcceptLoop(); });
+  // periodic reap: finished handler threads used to be joined only at
+  // the NEXT accept, so an idle server parked joinable threads forever.
+  // Plain atomic poll (100ms ticks, reap every 5th): no condvar, so
+  // Stop() just flips stopping_ and joins — worst case +100ms.
+  reaper_ = std::thread([this] {
+    int tick = 0;
+    while (!stopping_.load()) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      if (++tick < 5) continue;
+      tick = 0;
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      ReapFinishedLocked();
+    }
+  });
   ET_LOG(INFO) << "graph shard " << shard_idx_ << "/" << shard_num_
                << " serving on port " << port_;
   return Status::OK();
@@ -213,6 +345,7 @@ void GraphServer::Stop() {
     ::close(listen_fd_);
   }
   if (acceptor_.joinable()) acceptor_.join();
+  if (reaper_.joinable()) reaper_.join();  // polls stopping_; ≤100ms
   // Shut down open sockets so reader threads unblock, then join outside the
   // lock (the threads deregister their fds under conn_mu_ on exit).
   std::vector<Conn> to_join;
@@ -298,31 +431,71 @@ void GraphServer::AcceptLoop() {
   }
 }
 
+// Per-connection v2 state: the reply write lock (out-of-order completions
+// serialize on it), the hello-negotiated compression caps, and the
+// in-flight dispatch bound. shared_ptr-held because executor completions
+// outlive the reader loop's stack frame.
+struct GraphServer::ConnState {
+  explicit ConnState(int fd_in) : fd(fd_in) {}
+  const int fd;
+  std::mutex wmu;              // serializes reply frames on this fd
+  bool write_broken = false;   // under wmu: stop writing after a failure
+  bool peer_compress = false;  // hello: client accepts deflated replies
+  uint64_t peer_threshold = 0;
+  std::mutex imu;
+  std::condition_variable icv;
+  int inflight = 0;  // dispatched requests whose reply is not yet written
+};
+
+void GraphServer::BuildMeta(ByteWriter* w) const {
+  ShardMeta m;
+  m.shard_idx = shard_idx_;
+  m.shard_num = shard_num_;
+  m.partition_num = partition_num_;
+  m.node_type_wsum = graph_->node_type_weight_sums();
+  m.graph_label_count = graph_->graph_label_count();
+  m.owned_graph_label_count =
+      graph_->OwnedGraphLabelCount(shard_idx_, shard_num_);
+  m.edge_type_wsum = graph_->edge_type_weight_sums();
+  m.graph_meta = graph_->meta();
+  EncodeShardMeta(m, w);
+}
+
 void GraphServer::HandleConnection(int fd) {
+  auto conn = std::make_shared<ConnState>(fd);
   std::vector<char> body;
-  uint32_t msg_type;
-  while (!stopping_.load() && ReadFrame(fd, &msg_type, &body)) {
+  uint32_t msg_type = 0, flags = 0;
+  uint64_t req_id = 0;
+  int ver = 0;
+  while (!stopping_.load() &&
+         ReadAnyFrame(fd, &ver, &msg_type, &flags, &req_id, &body,
+                      /*accept_v2=*/!v1_only_)) {
+    if (ver == 2) {
+      // pipelined path: dispatch and keep reading — replies return
+      // out-of-order, correlated by request_id
+      if (!HandleV2Frame(conn, msg_type, req_id, flags, std::move(body)))
+        break;
+      continue;
+    }
+    // v1: serial request/reply on the reader thread, byte-for-byte the
+    // pre-v2 behavior (old 'ETFR' clients see an unchanged server)
     ByteWriter w;
     if (msg_type == kExecute) {
       ByteReader r(body.data(), body.size());
       HandleExecute(&r, &w);
     } else if (msg_type == kMeta) {
-      ShardMeta m;
-      m.shard_idx = shard_idx_;
-      m.shard_num = shard_num_;
-      m.partition_num = partition_num_;
-      m.node_type_wsum = graph_->node_type_weight_sums();
-      m.graph_label_count = graph_->graph_label_count();
-      m.owned_graph_label_count =
-          graph_->OwnedGraphLabelCount(shard_idx_, shard_num_);
-      m.edge_type_wsum = graph_->edge_type_weight_sums();
-      m.graph_meta = graph_->meta();
-      EncodeShardMeta(m, &w);
+      BuildMeta(&w);
     } else {  // ping
       w.Put<uint32_t>(0);
     }
     if (!WriteFrame(fd, msg_type, w.buffer().data(), w.buffer().size()))
       break;
+  }
+  // v2 executions may still be completing on the pool; they write under
+  // conn->wmu and MUST finish before the fd closes under them
+  {
+    std::unique_lock<std::mutex> lk(conn->imu);
+    conn->icv.wait(lk, [&] { return conn->inflight == 0; });
   }
   ::close(fd);
   std::lock_guard<std::mutex> lk(conn_mu_);
@@ -333,6 +506,130 @@ void GraphServer::HandleConnection(int fd) {
       break;
     }
   }
+}
+
+bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
+                                uint32_t msg_type, uint64_t request_id,
+                                uint32_t flags, std::vector<char> body) {
+  // shared reply writer: adaptive compression (only if the hello offered
+  // it, the raw body clears the client's threshold, AND deflate actually
+  // shrinks it), then one frame under the per-connection write lock
+  auto write_reply = [conn](uint32_t mt, uint64_t rid,
+                            const std::vector<char>& payload) {
+    uint32_t out_flags = 0;
+    const std::vector<char>* out = &payload;
+    std::vector<char> comp;
+    if (conn->peer_compress && conn->peer_threshold > 0 &&
+        payload.size() >= conn->peer_threshold &&
+        DeflateBody(payload, &comp)) {
+      out = &comp;
+      out_flags |= kFrameFlagCompressed;
+    }
+    std::lock_guard<std::mutex> lk(conn->wmu);
+    if (conn->write_broken) return;
+    if (!WriteFrameV2(conn->fd, mt, out_flags, rid, out->data(),
+                      out->size()))
+      conn->write_broken = true;
+  };
+
+  if ((flags & kFrameFlagCompressed) != 0) {
+    std::vector<char> raw;
+    if (!InflateBody(body, &raw)) return false;  // protocol error
+    body = std::move(raw);
+  }
+  if (msg_type == kHello) {
+    ByteReader r(body.data(), body.size());
+    uint32_t pver = 0, feats = 0;
+    uint64_t thresh = 0;
+    if (r.Get(&pver) && r.Get(&feats)) r.Get(&thresh);
+    // reader-thread-only writes, and every dispatch happens after the
+    // hello on the same thread — no lock needed
+    conn->peer_compress = (feats & kFeatAcceptCompressed) != 0;
+    conn->peer_threshold = thresh;
+    ByteWriter w;
+    w.Put<uint32_t>(kProtoV2);
+    w.Put<uint32_t>(kFeatAcceptCompressed);
+    w.Put<uint64_t>(thresh);
+    write_reply(kHello, request_id, w.buffer());
+    return true;
+  }
+  if (msg_type != kExecute) {
+    ByteWriter w;
+    if (msg_type == kMeta) {
+      BuildMeta(&w);
+    } else {  // ping / unknown
+      w.Put<uint32_t>(0);
+    }
+    write_reply(msg_type, request_id, w.buffer());
+    return true;
+  }
+  // kExecute: bounded out-of-order dispatch — the point of v2. The DAG
+  // runs ASYNCHRONOUSLY on the shared executor pool (Executor::Run's
+  // completion fires on a pool thread), so one connection can have many
+  // requests executing while this reader keeps reading; no server thread
+  // is parked per in-flight request.
+  int cap = std::max(GlobalRpcConfig().max_inflight.load(), 1);
+  {
+    std::unique_lock<std::mutex> lk(conn->imu);
+    conn->icv.wait(lk, [&] {
+      return conn->inflight < cap || stopping_.load();
+    });
+    if (stopping_.load()) return false;
+    ++conn->inflight;
+  }
+  struct Pending {
+    OpKernelContext ctx;
+    DAGDef dag;
+    std::vector<std::string> outputs;
+    std::unique_ptr<Executor> exec;
+  };
+  auto p = std::make_shared<Pending>();
+  auto finish = [conn, write_reply, request_id](const ExecuteReply& rep) {
+    ByteWriter w;
+    EncodeExecuteReply(rep, &w);
+    write_reply(kExecute, request_id, w.buffer());
+    std::lock_guard<std::mutex> lk(conn->imu);
+    --conn->inflight;
+    conn->icv.notify_all();
+  };
+  ExecuteRequest req;
+  ByteReader r(body.data(), body.size());
+  Status ds = DecodeExecuteRequest(&r, &req);
+  if (!ds.ok()) {
+    ExecuteReply rep;
+    rep.status = ds;
+    finish(rep);
+    return true;
+  }
+  for (auto& kv : req.inputs) p->ctx.Put(kv.first, std::move(kv.second));
+  p->dag.nodes = std::move(req.nodes);
+  p->outputs = std::move(req.outputs);
+  QueryEnv env;
+  env.graph = graph_.get();
+  env.index = index_.get();
+  env.pool = GlobalThreadPool();
+  p->exec = std::make_unique<Executor>(&p->dag, env, &p->ctx);
+  // completion owns the last ref to p: the executor releases its stored
+  // callback before invoking (see Executor::OnNodeDone), so destroying
+  // the Executor from inside its own done is the sanctioned pattern
+  p->exec->Run([p, finish](Status rs) {
+    ExecuteReply rep;
+    rep.status = rs;
+    if (rs.ok()) {
+      for (const auto& name : p->outputs) {
+        Tensor t;
+        if (!p->ctx.Get(name, &t)) {
+          rep.status =
+              Status::NotFound("requested output not produced: " + name);
+          rep.outputs.clear();
+          break;
+        }
+        rep.outputs.emplace_back(name, std::move(t));
+      }
+    }
+    finish(rep);
+  });
+  return true;
 }
 
 void GraphServer::HandleExecute(ByteReader* r, ByteWriter* w) {
@@ -369,12 +666,239 @@ void GraphServer::HandleExecute(ByteReader* r, ByteWriter* w) {
 }
 
 // ---------------------------------------------------------------------------
+// RpcChannel::MuxConn — one multiplexed v2 connection. Callers stamp a
+// fresh request_id, write their frame under the write lock, and park on a
+// waiter slot; a single demux reader thread routes reply frames back by
+// id (out-of-order welcome). A dead socket fails EVERY parked waiter with
+// a status — an RST mid-stream can never leave a caller hanging.
+// ---------------------------------------------------------------------------
+class RpcChannel::MuxConn {
+ public:
+  MuxConn(int fd, bool peer_compress, int64_t compress_threshold,
+          int max_inflight)
+      : fd_(fd),
+        peer_compress_(peer_compress),
+        compress_threshold_(compress_threshold),
+        max_inflight_(std::max(max_inflight, 1)) {
+    reader_ = std::thread([this] { ReaderLoop(); });
+  }
+
+  ~MuxConn() {
+    Shutdown();
+    if (reader_.joinable()) reader_.join();
+    ::close(fd_);
+  }
+
+  // Force-break: the reader unblocks, fails all waiters, and exits.
+  void Shutdown() { ::shutdown(fd_, SHUT_RDWR); }
+
+  bool broken() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return broken_;
+  }
+
+  Status Call(uint32_t msg_type, const std::vector<char>& body,
+              std::vector<char>* reply_body) {
+    auto& ctr = GlobalRpcCounters();
+    Waiter w;
+    uint64_t id = next_id_.fetch_add(1);
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      // in-flight cap: block before writing request max_inflight+1 so a
+      // runaway feeder can't queue unbounded server work on one conn
+      cv_.wait(lk, [&] {
+        return broken_ ||
+               static_cast<int>(waiters_.size()) < max_inflight_;
+      });
+      if (broken_) return Status::IOError("mux connection is down");
+      waiters_[id] = &w;
+    }
+    ctr.inflight.fetch_add(1);
+    if (!WriteRequest(msg_type, id, body)) {
+      // socket dead: tear the whole conn down so every parked waiter
+      // (not just this call) gets a status promptly
+      Shutdown();
+    }
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [&] { return w.done || broken_; });
+    ctr.inflight.fetch_sub(1);
+    if (!w.done) {
+      waiters_.erase(id);
+      return Status::IOError("mux connection reset mid-call");
+    }
+    if (w.st.ok()) {
+      *reply_body = std::move(w.body);
+      ctr.round_trips.fetch_add(1);
+      ctr.mux_calls.fetch_add(1);
+    }
+    return w.st;
+  }
+
+  // Callback waiter: done fires on the client pool once the reply frame
+  // arrives (or with a status when the connection dies). No thread is
+  // parked while the request is on the wire.
+  void CallAsync(uint32_t msg_type, const std::vector<char>& body,
+                 std::function<void(Status, std::vector<char>)> done) {
+    auto* w = new Waiter();
+    w->cb = std::move(done);
+    uint64_t id = next_id_.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (broken_) {
+        FailAsyncWaiter(w, Status::IOError("mux connection is down"));
+        return;
+      }
+      // async callers skip the blocking cap (they are bounded by their
+      // own scheduling); the server still bounds dispatch per conn
+      waiters_[id] = w;
+    }
+    GlobalRpcCounters().inflight.fetch_add(1);
+    if (!WriteRequest(msg_type, id, body)) Shutdown();
+  }
+
+ private:
+  struct Waiter {
+    Status st = Status::OK();
+    std::vector<char> body;
+    bool done = false;
+    std::function<void(Status, std::vector<char>)> cb;  // async only
+  };
+
+  static void FailAsyncWaiter(Waiter* w, Status s) {
+    auto cb = std::move(w->cb);
+    delete w;
+    ClientThreadPool()->Schedule([cb = std::move(cb), s]() mutable {
+      cb(s, {});
+    });
+  }
+
+  bool WriteRequest(uint32_t msg_type, uint64_t id,
+                    const std::vector<char>& body) {
+    auto& ctr = GlobalRpcCounters();
+    // adaptive request compression (negotiated in the hello)
+    uint32_t flags = 0;
+    const std::vector<char>* out = &body;
+    std::vector<char> comp;
+    if (peer_compress_ && compress_threshold_ > 0 &&
+        static_cast<int64_t>(body.size()) >= compress_threshold_ &&
+        DeflateBody(body, &comp)) {
+      out = &comp;
+      flags |= kFrameFlagCompressed;
+      ctr.compressed_frames_sent.fetch_add(1);
+    }
+    bool wrote;
+    {
+      std::lock_guard<std::mutex> lk(wmu_);
+      wrote = WriteFrameV2(fd_, msg_type, flags, id, out->data(),
+                           out->size());
+    }
+    ctr.bytes_sent_raw.fetch_add(kV2HdrLen + body.size());
+    if (wrote) ctr.bytes_sent.fetch_add(kV2HdrLen + out->size());
+    return wrote;
+  }
+
+  void ReaderLoop() {
+    std::vector<char> body;
+    uint32_t msg_type = 0, flags = 0;
+    uint64_t id = 0;
+    int ver = 0;
+    auto& ctr = GlobalRpcCounters();
+    for (;;) {
+      if (!ReadAnyFrame(fd_, &ver, &msg_type, &flags, &id, &body) ||
+          ver != 2)
+        break;
+      uint64_t wire = kV2HdrLen + body.size();
+      if ((flags & kFrameFlagCompressed) != 0) {
+        std::vector<char> raw;
+        if (!InflateBody(body, &raw)) break;  // protocol error: drop conn
+        body = std::move(raw);
+        ctr.compressed_frames_received.fetch_add(1);
+      }
+      ctr.bytes_received.fetch_add(wire);
+      ctr.bytes_received_raw.fetch_add(kV2HdrLen + body.size());
+      Waiter* async_w = nullptr;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto it = waiters_.find(id);
+        if (it != waiters_.end()) {
+          Waiter* w = it->second;
+          waiters_.erase(it);
+          if (w->cb) {
+            w->body = std::move(body);
+            async_w = w;
+          } else {
+            w->body = std::move(body);
+            w->done = true;
+          }
+          // either branch shrank waiters_: wake completed sync callers
+          // AND any sync Call parked on the max_inflight cap (async
+          // completions must release cap slots too)
+          cv_.notify_all();
+        }
+        // unknown id: reply for an abandoned waiter — dropped
+      }
+      if (async_w != nullptr) {
+        ctr.inflight.fetch_sub(1);
+        ctr.round_trips.fetch_add(1);
+        ctr.mux_calls.fetch_add(1);
+        ClientThreadPool()->Schedule([async_w] {
+          auto cb = std::move(async_w->cb);
+          Status st = async_w->st;
+          std::vector<char> b = std::move(async_w->body);
+          delete async_w;
+          cb(st, std::move(b));
+        });
+      }
+      body.clear();  // moved-from: reset for the next frame
+    }
+    // teardown: fail every parked waiter with a status — no hangs
+    std::vector<Waiter*> async_fail;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      broken_ = true;
+      for (auto& kv : waiters_) {
+        if (kv.second->cb) {
+          async_fail.push_back(kv.second);
+        } else {
+          kv.second->st =
+              Status::IOError("mux connection reset with in-flight calls");
+          kv.second->done = true;
+        }
+      }
+      waiters_.clear();
+      cv_.notify_all();
+    }
+    for (Waiter* w : async_fail) {
+      ctr.inflight.fetch_sub(1);
+      FailAsyncWaiter(
+          w, Status::IOError("mux connection reset with in-flight calls"));
+    }
+  }
+
+  const int fd_;
+  const bool peer_compress_;
+  const int64_t compress_threshold_;
+  const int max_inflight_;
+  std::atomic<uint64_t> next_id_{1};
+  std::mutex wmu_;  // one writer at a time on the shared fd
+  std::mutex mu_;   // waiters_ + broken_
+  std::condition_variable cv_;
+  bool broken_ = false;
+  std::unordered_map<uint64_t, Waiter*> waiters_;
+  std::thread reader_;
+};
+
+// ---------------------------------------------------------------------------
 // RpcChannel
 // ---------------------------------------------------------------------------
 RpcChannel::RpcChannel(std::string host, int port)
     : host_(std::move(host)), port_(port) {}
 
 RpcChannel::~RpcChannel() {
+  {
+    std::lock_guard<std::mutex> lk(mux_mu_);
+    mux_conns_.clear();  // ~MuxConn: shutdown socket, join reader
+  }
   std::lock_guard<std::mutex> lk(mu_);
   for (int fd : free_fds_) ::close(fd);
 }
@@ -425,6 +949,7 @@ int RpcChannel::Connect() {
   if (fd >= 0) {
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    GlobalRpcCounters().connections_opened.fetch_add(1);
   }
   return fd;
 }
@@ -443,21 +968,172 @@ int RpcChannel::Acquire() {
 
 void RpcChannel::Release(int fd) {
   std::lock_guard<std::mutex> lk(mu_);
+  if (static_cast<int>(free_fds_.size()) >= kMaxPooledFds) {
+    // cap the idle pool: a concurrency burst used to grow it without
+    // bound and the sockets were kept forever
+    ::close(fd);
+    return;
+  }
   free_fds_.push_back(fd);
+}
+
+std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
+  // the whole dial runs under mux_mu_: a thundering herd of callers
+  // hitting an undialed slot must share ONE connection, not each open
+  // their own (the fd frugality is the point of the mux)
+  std::lock_guard<std::mutex> lk(mux_mu_);
+  if (slot < static_cast<int>(mux_conns_.size()) && mux_conns_[slot] &&
+      !mux_conns_[slot]->broken())
+    return mux_conns_[slot];
+  int fd = Connect();
+  if (fd < 0) return nullptr;
+  // The hello round trip below must be BOUNDED: it runs under mux_mu_,
+  // so a peer that accepts the TCP connection but never answers (wedged
+  // process, post-handshake black hole) would otherwise park every call
+  // on this channel forever — the MuxConn "dead socket fails every
+  // waiter" guarantee only starts after the handshake. timeout_ms_ wins
+  // when the caller set one; 10s otherwise.
+  {
+    int hello_ms = timeout_ms_ > 0 ? timeout_ms_ : 10000;
+    timeval tv{hello_ms / 1000, (hello_ms % 1000) * 1000};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
+  const RpcConfig cfg = GlobalRpcConfig();
+  ByteWriter hw;
+  hw.Put<uint32_t>(kProtoV2);
+  hw.Put<uint32_t>(kFeatAcceptCompressed);
+  const int64_t hello_thr = cfg.compress_threshold.load();
+  hw.Put<uint64_t>(static_cast<uint64_t>(hello_thr > 0 ? hello_thr : 0));
+  std::vector<char> hbody;
+  uint32_t msg_type = 0, flags = 0;
+  uint64_t rid = 0;
+  int ver = 0;
+  bool hello_ok = WriteFrameV2(fd, kHello, 0, 0, hw.buffer().data(),
+                               hw.buffer().size()) &&
+                  ReadAnyFrame(fd, &ver, &msg_type, &flags, &rid, &hbody) &&
+                  ver == 2 && msg_type == kHello;
+  bool peer_compress = false;
+  if (hello_ok) {
+    ByteReader r(hbody.data(), hbody.size());
+    uint32_t pver = 0, feats = 0;
+    if (!r.Get(&pver) || !r.Get(&feats) || pver < kProtoV2) hello_ok = false;
+    peer_compress = (feats & kFeatAcceptCompressed) != 0;
+  }
+  if (!hello_ok) {
+    ::close(fd);
+    // connect succeeded but the hello was refused: a pre-v2 server drops
+    // the unknown magic. Fall back to v1 for this channel's lifetime (a
+    // mid-handshake crash lands here too — still correct, just unmuxed
+    // until the endpoint's channel is rebuilt by the registry monitor).
+    v1_fallback_.store(true);
+    GlobalRpcCounters().hello_fallbacks.fetch_add(1);
+    ET_LOG_INFO << "rpc " << host_ << ":" << port_
+                << " refused the v2 hello; falling back to v1 framing";
+    return nullptr;
+  }
+  // Handshake bound must NOT leak onto the live mux fd: the demux reader
+  // legitimately idles in recv between replies and a long merge may
+  // stream past timeout_ms_ (header contract: on mux connections the
+  // timeout applies to connect + hello only).
+  {
+    timeval tv{0, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
+  auto conn = std::make_shared<MuxConn>(fd, peer_compress,
+                                        cfg.compress_threshold,
+                                        cfg.max_inflight);
+  if (slot >= static_cast<int>(mux_conns_.size()))
+    mux_conns_.resize(slot + 1);
+  mux_conns_[slot] = conn;
+  return conn;
+}
+
+Status RpcChannel::MuxCall(uint32_t msg_type, const std::vector<char>& body,
+                           std::vector<char>* reply_body, int max_retries) {
+  Status last = Status::IOError("rpc not attempted");
+  for (int attempt = 0; attempt < max_retries; ++attempt) {
+    if (v1_fallback_.load()) return last;  // caller switches to v1
+    int slots = std::max(GlobalRpcConfig().mux_connections.load(), 1);
+    int slot = static_cast<int>(mux_rr_.fetch_add(1) % slots);
+    auto conn = MuxGet(slot);
+    if (conn == nullptr) {
+      if (v1_fallback_.load()) return last;
+      JitteredBackoffUs(attempt);  // connect failed — dead endpoint
+      continue;
+    }
+    last = conn->Call(msg_type, body, reply_body);
+    if (last.ok()) return last;
+    // transport failure: the conn marked itself broken; the next attempt
+    // re-dials (a dead endpoint fails fast in connect and backs off there)
+  }
+  return Status::IOError("rpc to " + host_ + ":" + std::to_string(port_) +
+                         " failed after retries: " + last.message());
+}
+
+void RpcChannel::CallAsync(
+    uint32_t msg_type, std::vector<char> body,
+    std::function<void(Status, std::vector<char>)> done) {
+  if (mux_active()) {
+    int slots = std::max(GlobalRpcConfig().mux_connections.load(), 1);
+    auto conn = MuxGet(static_cast<int>(mux_rr_.fetch_add(1) % slots));
+    if (conn != nullptr) {
+      conn->CallAsync(msg_type, body, std::move(done));
+      return;
+    }
+  }
+  // no mux connection (v1 server / connect failure): blocking call off
+  // the caller's thread, full retry ladder included. The scheduled task
+  // must not outlive the channel: when it is shared-owned (ClientManager,
+  // which may drop its ref on a failover swap) hold a weak ref and fail
+  // the callback with a status if the channel died first; a channel never
+  // owned by a shared_ptr (stack-allocated in tests) keeps the old
+  // caller-guarantees-lifetime contract.
+  std::weak_ptr<RpcChannel> weak = weak_from_this();
+  const bool shared_owned = !weak.expired();
+  ClientThreadPool()->Schedule(
+      [this, weak = std::move(weak), shared_owned, msg_type,
+       body = std::move(body), done = std::move(done)] {
+        std::shared_ptr<RpcChannel> self;
+        if (shared_owned) {
+          self = weak.lock();
+          if (self == nullptr) {
+            done(Status::IOError("rpc channel destroyed with call pending"),
+                 {});
+            return;
+          }
+        }
+        std::vector<char> reply;
+        Status s = Call(msg_type, body, &reply);
+        done(s, std::move(reply));
+      });
 }
 
 Status RpcChannel::Call(uint32_t msg_type, const std::vector<char>& body,
                         std::vector<char>* reply_body, int max_retries) {
   if (max_retries <= 0) max_retries = kRetryCount;
+  if (mux_ && !v1_fallback_.load()) {
+    Status s = MuxCall(msg_type, body, reply_body, max_retries);
+    if (s.ok() || !v1_fallback_.load()) return s;
+    // the server refused the hello mid-call: finish this call on v1
+  }
+  auto& ctr = GlobalRpcCounters();
   for (int attempt = 0; attempt < max_retries; ++attempt) {
     int fd = Acquire();
     if (fd < 0) {
-      ::usleep(1000 * (1 << std::min(attempt, 6)));
+      JitteredBackoffUs(attempt);
       continue;
     }
     uint32_t reply_type;
     if (WriteFrame(fd, msg_type, body.data(), body.size()) &&
         ReadFrame(fd, &reply_type, reply_body) && reply_type == msg_type) {
+      ctr.round_trips.fetch_add(1);
+      ctr.v1_calls.fetch_add(1);
+      ctr.bytes_sent.fetch_add(16 + body.size());
+      ctr.bytes_sent_raw.fetch_add(16 + body.size());
+      ctr.bytes_received.fetch_add(16 + reply_body->size());
+      ctr.bytes_received_raw.fetch_add(16 + reply_body->size());
       Release(fd);
       return Status::OK();
     }
@@ -596,7 +1272,13 @@ void RegistryServer::HandleConnection(int fd) {
     if (!WriteFrame(fd, msg_type, w.buffer().data(), w.buffer().size()))
       break;
   }
-  ::close(fd);
+  // NO close here: the connection-thread wrapper in AcceptLoop owns the
+  // close (after setting the done flag, so Stop() never shutdown()s a
+  // recycled fd number). Closing here too double-closed every registry
+  // connection — and when another thread had already reused the fd
+  // number, the second close killed an UNRELATED live socket, which is
+  // exactly the concurrent-heartbeat flake (ECONNRESET/EBADF/EISCONN on
+  // fresh registry channels) the native registry test kept tripping.
 }
 
 // ---------------------------------------------------------------------------
@@ -873,6 +1555,10 @@ void ClientManager::WatchRegistry(const std::string& dir, int interval_ms,
           ET_LOG_INFO << "shard " << shard << " re-resolved to " << host
                       << ":" << port;
           channels_[shard] = std::make_shared<RpcChannel>(host, port);
+          // a replacement channel re-reads the transport config — this
+          // is also how a v1-fallback channel regains mux after the
+          // shard restarts on a v2 binary
+          if (GlobalRpcConfig().mux) channels_[shard]->set_mux(true);
           fresh = channels_[shard];
         }
       }
@@ -900,8 +1586,13 @@ void ClientManager::WatchRegistry(const std::string& dir, int interval_ms,
 
 Status ClientManager::Init(const ShardEndpoints& eps) {
   channels_.clear();
-  for (const auto& ep : eps.endpoints)
+  for (const auto& ep : eps.endpoints) {
     channels_.push_back(std::make_shared<RpcChannel>(ep.first, ep.second));
+    // graph-service channels opt into the multiplexed transport from the
+    // process-global config; registry channels (RegistryPutEntry & co.
+    // build their own short-lived RpcChannel) always speak v1
+    if (GlobalRpcConfig().mux) channels_.back()->set_mux(true);
+  }
   std::vector<ShardMeta> metas(channels_.size());
   for (size_t s = 0; s < channels_.size(); ++s) {
     std::vector<char> body, reply;

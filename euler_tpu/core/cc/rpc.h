@@ -14,8 +14,20 @@
 // directory) with a static "hosts=" fallback — ZooKeeper semantics on
 // plain files, fitting one-host tests and multi-host NFS deployments.
 //
-// Frame: u32 'ETFR' | u32 msg_type | u64 body_len | body
-// msg types: 0 = Execute, 1 = ShardMeta, 2 = Ping.
+// Frame v1: u32 'ETFR' | u32 msg_type | u64 body_len | body
+// Frame v2: u32 'ETF2' | u32 msg_type | u32 flags | u64 request_id
+//         | u64 body_len | body        (flags bit 0: body zlib-deflated,
+//           laid out as u64 raw_len | deflate stream)
+// msg types: 0 = Execute, 1 = ShardMeta, 2 = Ping, 6 = Hello (v2 only).
+//
+// v2 is negotiated per connection: a v2 client opens with a Hello frame
+// carrying (version, feature bits, compress threshold); a v2 server
+// answers Hello and from then on serves that connection PIPELINED —
+// requests dispatch to the executor and replies return out-of-order,
+// correlated by request_id, under a per-connection write lock. A v1
+// server closes on the unknown magic, which the client takes as "speak
+// v1" and falls back to the classic one-frame-per-connection-at-a-time
+// path; v1 clients ('ETFR' frames) are served byte-for-byte as before.
 #ifndef EULER_TPU_RPC_H_
 #define EULER_TPU_RPC_H_
 
@@ -36,6 +48,62 @@
 #include "serde.h"
 
 namespace et {
+
+// ---------------------------------------------------------------------------
+// Transport config + counters (protocol v2 mux / adaptive compression).
+// ---------------------------------------------------------------------------
+// Process-global transport knobs. Applies to GRAPH-SERVICE channels
+// created AFTER a change (ClientManager::Init / registry re-resolution);
+// registry channels always speak v1 (tiny frames, nothing to win).
+// Fields are atomic: etg_rpc_config may run while live server readers
+// (per-request dispatch cap) and channel builders read them.
+struct RpcConfig {
+  // Multiplex graph channels: one v2 connection carries many in-flight
+  // requests (CallAsync + demux reader) instead of one blocking fd per
+  // concurrent call.
+  std::atomic<bool> mux{false};
+  // Mux connections per channel endpoint (in-flight calls round-robin
+  // over them). Wire fd count per shard == this, regardless of depth.
+  std::atomic<int> mux_connections{1};
+  // > 0: zlib level-1 deflate frame bodies >= this many bytes (both
+  // directions, negotiated in the hello; a frame that doesn't shrink is
+  // sent raw — the flag bit says which). 0 disables.
+  std::atomic<int64_t> compress_threshold{0};
+  // Per-mux-connection in-flight cap: callers block before writing the
+  // next request past this depth (server mirrors it as a dispatch
+  // bound), so a runaway feeder cannot queue unbounded server work.
+  std::atomic<int> max_inflight{256};
+
+  RpcConfig() = default;
+  RpcConfig(const RpcConfig& o) { *this = o; }
+  RpcConfig& operator=(const RpcConfig& o) {
+    mux.store(o.mux.load());
+    mux_connections.store(o.mux_connections.load());
+    compress_threshold.store(o.compress_threshold.load());
+    max_inflight.store(o.max_inflight.load());
+    return *this;
+  }
+};
+RpcConfig& GlobalRpcConfig();
+
+// Client-side transport counters (process-global, monotonic; inflight is
+// a gauge). Counted at the CLIENT edge only — loopback tests run client
+// and server in one process and the A/B must read client traffic.
+struct RpcCounters {
+  std::atomic<uint64_t> round_trips{0};      // completed request/reply pairs
+  std::atomic<uint64_t> bytes_sent{0};       // wire bytes incl. headers
+  std::atomic<uint64_t> bytes_received{0};   // wire bytes incl. headers
+  std::atomic<uint64_t> bytes_sent_raw{0};   // pre-compression payload view
+  std::atomic<uint64_t> bytes_received_raw{0};
+  std::atomic<uint64_t> connections_opened{0};
+  std::atomic<uint64_t> compressed_frames_sent{0};
+  std::atomic<uint64_t> compressed_frames_received{0};
+  std::atomic<uint64_t> mux_calls{0};        // calls over v2 mux conns
+  std::atomic<uint64_t> v1_calls{0};         // calls over the classic path
+  std::atomic<uint64_t> hello_fallbacks{0};  // v2 hello refused → v1
+  std::atomic<int64_t> inflight{0};          // mux calls on the wire now
+};
+RpcCounters& GlobalRpcCounters();
 
 // ---------------------------------------------------------------------------
 // Shard metadata exchanged at client init (reference query_proxy.cc:62-105:
@@ -88,15 +156,23 @@ class GraphServer {
     std::thread thread;
     std::shared_ptr<std::atomic<bool>> finished;
   };
+  struct ConnState;  // per-connection v2 state (rpc.cc)
 
   void AcceptLoop();
   void ReapFinishedLocked();  // join + drop exited connection threads
   void HandleConnection(int fd);
   void HandleExecute(ByteReader* r, ByteWriter* w);
+  // v2 path: dispatch one decoded frame; false → close the connection.
+  bool HandleV2Frame(const std::shared_ptr<ConnState>& conn,
+                     uint32_t msg_type, uint64_t request_id,
+                     uint32_t flags, std::vector<char> body);
+  void BuildMeta(ByteWriter* w) const;
 
   std::shared_ptr<const Graph> graph_;
   std::shared_ptr<IndexManager> index_;
   int shard_idx_, shard_num_, partition_num_;
+  bool v1_only_ = false;  // EULER_TPU_RPC_SERVER_V1: emulate a pre-v2
+                          // binary exactly (interop tests)
   int listen_fd_ = -1;
   int port_ = 0;
   std::atomic<bool> stopping_{false};
@@ -108,6 +184,10 @@ class GraphServer {
   std::thread heartbeat_;
   std::mutex hb_mu_;
   std::condition_variable hb_cv_;
+  // periodic connection-thread reaper: without it an idle server only
+  // reaps finished handler threads at the NEXT accept, so a burst of
+  // short-lived clients leaves joinable threads parked until then
+  std::thread reaper_;
 };
 
 // ---------------------------------------------------------------------------
@@ -116,37 +196,66 @@ class GraphServer {
 // One logical endpoint ("host:port") with a pool of pooled blocking
 // sockets; Call() is thread-safe, retries up to kRetryCount with
 // reconnects (reference rpc_client.h:46).
-class RpcChannel {
+class RpcChannel : public std::enable_shared_from_this<RpcChannel> {
  public:
   static constexpr int kRetryCount = 10;
+  // Release() keeps at most this many idle pooled sockets; extras are
+  // closed on release so a concurrency burst cannot pin fds forever.
+  static constexpr int kMaxPooledFds = 8;
 
   explicit RpcChannel(std::string host, int port);
   ~RpcChannel();
 
   // max_retries <= 0 → kRetryCount. Registry traffic passes 1-2 so
   // heartbeat/shutdown paths can't stall behind an unreachable host.
+  // With set_mux(true) the call rides a shared v2 connection (many
+  // in-flight calls per fd, replies demuxed by request_id); against a
+  // v1 server the channel falls back to the classic path for life.
   Status Call(uint32_t msg_type, const std::vector<char>& body,
               std::vector<char>* reply_body, int max_retries = 0);
+
+  // Async mux submission: invokes done(status, reply) when the reply
+  // frame arrives (or the connection dies). Requires mux mode; without
+  // it the call is executed inline (blocking) before done fires.
+  void CallAsync(uint32_t msg_type, std::vector<char> body,
+                 std::function<void(Status, std::vector<char>)> done);
 
   // > 0: bound connect() AND each recv/send to this budget (poll-based
   // connect + SO_RCVTIMEO/SO_SNDTIMEO). 0 (default) = blocking sockets
   // — the graph-query path keeps them (long merges may stream for a
-  // while); registry channels set ~3s.
+  // while); registry channels set ~3s. Mux connections apply it to
+  // connect() only (the demux reader legitimately idles in recv).
   void set_timeout_ms(int ms) { timeout_ms_ = ms; }
+
+  // Enable multiplexed v2 transport (call before the first Call()).
+  void set_mux(bool on) { mux_ = on; }
+  bool mux_active() const { return mux_ && !v1_fallback_.load(); }
 
   const std::string& host() const { return host_; }
   int port() const { return port_; }
 
  private:
+  class MuxConn;
+
   int Acquire();           // pooled or fresh connected socket, -1 on fail
   void Release(int fd);
   int Connect();
+  Status MuxCall(uint32_t msg_type, const std::vector<char>& body,
+                 std::vector<char>* reply_body, int max_retries);
+  // Slot's live mux connection, dialing if absent/broken; nullptr on
+  // connect failure. Sets v1_fallback_ when the server refuses hello.
+  std::shared_ptr<MuxConn> MuxGet(int slot);
 
   std::string host_;
   int port_;
   int timeout_ms_ = 0;
   std::mutex mu_;
   std::vector<int> free_fds_;
+  bool mux_ = false;
+  std::atomic<bool> v1_fallback_{false};
+  std::atomic<uint64_t> mux_rr_{0};  // round-robin over mux slots
+  std::mutex mux_mu_;
+  std::vector<std::shared_ptr<MuxConn>> mux_conns_;
 };
 
 // ---------------------------------------------------------------------------

@@ -159,6 +159,11 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etg_udf_cache_clear": (None, []),
         "etg_udf_cache_set_capacity": (None, [u64]),
         "etg_hash64": (u64, [ctypes.c_char_p, u64]),
+        # RPC transport (protocol v2 mux / adaptive compression): global
+        # config + client-edge counters — see euler_tpu.graph.remote
+        # configure_rpc() / rpc_transport_stats() for the friendly wrapper
+        "etg_rpc_config": (None, [i32, i32, i64, i32]),
+        "etg_rpc_stats": (None, [c_u64p]),
         "et_udf_emit": (None, [c_voidp, c_u64p, i64, c_f32p, i64]),
         "etq_exec_new": (i64, [i64]),
         "etq_exec_add_input": (i32, [i64, ctypes.c_char_p, i32, i32, c_i64p, c_voidp]),

@@ -16,5 +16,7 @@ from euler_tpu.graph.remote import (  # noqa: F401
     RemoteGraphEngine,
     RetryDeadlineExceeded,
     RetryPolicy,
+    configure_rpc,
     retryable_error,
+    rpc_transport_stats,
 )

@@ -66,18 +66,33 @@ def _ranges(counts: np.ndarray) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 class HandlePool:
-    """M pooled native Query handles over the same endpoints, checked
-    out for exclusive use per call (free-list queue; acquire blocks
-    when all M are in flight). Concurrent run() on ONE handle is safe
-    (verified under an 8-thread stress test, and the serial engine's
-    timed-attempt strays already share its handle with retries) — the
-    pool exists for CHANNEL parallelism (each handle owns its own
-    connection set to the shards, so M handles keep M requests on the
-    wire) and for distinct per-handle sampling seeds (concurrent draws
-    must not replay one stream)."""
+    """M pooled native Query handles over the same endpoints.
 
-    def __init__(self, endpoints: str, seed: int, mode: str, size: int):
+    Exclusive mode (default): handles check out for exclusive use per
+    call (free-list queue; acquire blocks when all M are in flight).
+    Concurrent run() on ONE handle is safe (verified under an 8-thread
+    stress test, and the serial engine's timed-attempt strays already
+    share its handle with retries) — the pool exists for CHANNEL
+    parallelism (each handle owns its own connection set to the shards,
+    so M handles keep M requests on the wire) and for distinct
+    per-handle sampling seeds (concurrent draws must not replay one
+    stream).
+
+    Shared mode (``shared=True``, the mux-transport shape): acquire
+    never blocks — callers round-robin over the M handles and run
+    CONCURRENTLY on them, so N in-flight queries ride M handles (M is
+    typically 1) whose mux connections carry them all; the wire fd
+    count stops scaling with in-flight depth. Concurrent sampling draws
+    on one shared handle stay distinct: every execution takes a fresh
+    engine-side nonce, so streams never replay."""
+
+    def __init__(self, endpoints: str, seed: int, mode: str, size: int,
+                 shared: bool = False):
         self._q: queue.Queue = queue.Queue()
+        self._shared = bool(shared)
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._rr = 0
         self._handles = []
         for i in range(max(int(size), 1)):
             # distinct per-handle seeds: two concurrent sampling queries
@@ -89,9 +104,20 @@ class HandlePool:
         self.size = len(self._handles)
 
     def acquire(self) -> Query:
+        if self._shared:
+            with self._cv:
+                self._inflight += 1
+                h = self._handles[self._rr % self.size]
+                self._rr += 1
+                return h
         return self._q.get()
 
     def release(self, h: Query) -> None:
+        if self._shared:
+            with self._cv:
+                self._inflight -= 1
+                self._cv.notify_all()
+            return
         self._q.put(h)
 
     def close(self, timeout_s: float = 5.0) -> None:
@@ -100,6 +126,20 @@ class HandlePool:
         native memory intentionally not freed) rather than freed under
         a running thread — same policy as RemoteGraphEngine.close."""
         deadline = time.monotonic() + timeout_s
+        if self._shared:
+            with self._cv:
+                while (self._inflight > 0
+                       and time.monotonic() < deadline):
+                    self._cv.wait(
+                        max(min(deadline - time.monotonic(), 0.2), 0.01))
+                drained = self._inflight == 0
+            for h in self._handles:
+                if drained:
+                    h.close()
+                else:
+                    with h._mu:
+                        h._h = 0  # leak: live calls still use the handle
+            return
         reclaimed = []
         while len(reclaimed) < self.size:
             try:
@@ -122,11 +162,19 @@ class PipelinedClient:
     (retry/degrade/span machinery included) against a pooled handle."""
 
     def __init__(self, engine, endpoints: str, seed: int, mode: str,
-                 workers: int, handles: Optional[int] = None):
+                 workers: int, handles: Optional[int] = None,
+                 shared: bool = False):
+        """shared=True: the mux-transport shape — workers run
+        CONCURRENTLY on `handles` (default 1) shared query handles, so
+        in-flight depth comes from the workers while the wire fd count
+        comes from the transport's mux connections, not from handle
+        count. False (default): exclusive checkout, one handle per
+        in-flight call (the PR-4 pool shape)."""
         self._engine = engine
         workers = max(int(workers), 1)
         self._handles = HandlePool(endpoints, seed, mode,
-                                   handles or workers)
+                                   handles or (1 if shared else workers),
+                                   shared=shared)
         self._name = f"pipeline{next(_POOL_IDS)}"
         self._exec = ThreadPoolExecutor(
             max_workers=workers,
@@ -181,6 +229,108 @@ class PipelinedClient:
         for t in list(getattr(self._exec, "_threads", ())):
             t.join(max(deadline - time.monotonic(), 0.0))
         self._handles.close(max(deadline - time.monotonic(), 0.1))
+
+
+# ---------------------------------------------------------------------------
+# in-flight request dedup
+# ---------------------------------------------------------------------------
+
+def deterministic_gql(gql: str) -> bool:
+    """True when the query reads immutable graph state and two identical
+    executions return identical bytes — the coalescing precondition.
+    Every sampling verb starts with 'sample' (sampleN/sampleE/sampleNB/
+    sampleLNB/sampleNWithTypes/sampleGL), so one marker refuses them
+    all; udf() is excluded too (registered UDFs are REQUIRED pure for
+    the result cache, but a stateful one would silently corrupt
+    coalesced callers — refusing costs one wire call)."""
+    return "sample" not in gql and "udf(" not in gql
+
+
+class InflightDedup:
+    """Coalesce concurrent IDENTICAL deterministic queries onto one wire
+    call (e.g. overlapping feeder workers fetching the same feature
+    rows). The first caller (leader) issues the call; callers that
+    arrive with the same (gql, feed bytes) key while it is IN FLIGHT
+    wait on the leader's future and receive byte-identical COPIES of
+    its result (copies: callers may mutate returned arrays). The key
+    holds the full feed bytes — no hash-collision coalescing. Entries
+    leave the table the moment the leader finishes, so this never acts
+    as a result cache (CachedGraphEngine is that, above this layer).
+    Sampling verbs bypass entirely (see deterministic_gql): coalescing
+    two draws would correlate their randomness.
+
+    Counted on the obs registry: rpc_dedup_hits_total{engine=} (calls
+    served from a leader's flight) / rpc_dedup_issued_total (leader
+    flights that had at least the leader)."""
+
+    def __init__(self, name: str):
+        self._mu = threading.Lock()
+        self._inflight: Dict[tuple, list] = {}  # key -> [Future, followers]
+        reg = _obs.default_registry()
+        lab = {"engine": name}
+        self._ctr_hits = reg.counter(
+            "rpc_dedup_hits_total",
+            "calls coalesced onto an identical in-flight query",
+            ("engine",)).labels(**lab)
+        self._ctr_issued = reg.counter(
+            "rpc_dedup_issued_total",
+            "deduplicable queries that actually went to the wire",
+            ("engine",)).labels(**lab)
+
+    @staticmethod
+    def _key(gql: str, feed) -> tuple:
+        if not feed:
+            return (gql,)
+        items = []
+        for k in sorted(feed):
+            a = np.ascontiguousarray(feed[k])
+            items.append((k, a.dtype.str, a.shape, a.tobytes()))
+        return (gql, tuple(items))
+
+    @staticmethod
+    def _copy_result(out):
+        if isinstance(out, dict):
+            return {k: (np.array(v, copy=True)
+                        if isinstance(v, np.ndarray) else v)
+                    for k, v in out.items()}
+        return out
+
+    def run(self, gql: str, feed, fn):
+        """fn() under dedup: leader executes, followers wait + copy."""
+        if not deterministic_gql(gql):
+            return fn()
+        key = self._key(gql, feed)
+        with self._mu:
+            entry = self._inflight.get(key)
+            leader = entry is None
+            if leader:
+                entry = [Future(), 0]
+                self._inflight[key] = entry
+            else:
+                entry[1] += 1
+        fut = entry[0]
+        if not leader:
+            self._ctr_hits.inc()
+            return self._copy_result(fut.result())
+        self._ctr_issued.inc()
+        try:
+            out = fn()
+        except BaseException as e:
+            with self._mu:
+                self._inflight.pop(key, None)
+            fut.set_exception(e)
+            raise
+        # drop the entry BEFORE completing the future: a caller arriving
+        # after completion must issue its own call (in-flight dedup, not
+        # a cache) and a waiter that joined in time still gets the result
+        with self._mu:
+            self._inflight.pop(key, None)
+            followers = entry[1]
+        fut.set_result(out)
+        # followers copy from the future's pristine arrays AFTER this
+        # return — the leader's caller may mutate its result, so when
+        # anyone coalesced, hand the leader its own copy too
+        return self._copy_result(out) if followers else out
 
 
 # ---------------------------------------------------------------------------

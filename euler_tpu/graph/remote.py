@@ -87,6 +87,133 @@ def retryable_error(exc: BaseException) -> bool:
     return any(m in msg for m in _TRANSPORT_MARKERS)
 
 
+# ---------------------------------------------------------------------------
+# RPC transport config (protocol v2 mux + adaptive frame compression)
+# ---------------------------------------------------------------------------
+# Native client-edge counter layout (etg_rpc_stats) — order must match
+# capi.cc. *_raw is the pre-compression payload view of the same frames,
+# so bytes_received_raw / bytes_received is the reply compression ratio.
+_RPC_STAT_KEYS = (
+    "round_trips", "bytes_sent", "bytes_received", "bytes_sent_raw",
+    "bytes_received_raw", "connections_opened", "compressed_frames_sent",
+    "compressed_frames_received", "mux_calls", "v1_calls",
+    "hello_fallbacks", "inflight")
+
+# Last config applied through configure_rpc (the native side has no
+# getter). RemoteGraphEngine reads `mux` to default pool_shared.
+_RPC_CONFIG = {"mux": False, "connections": 1, "compress_threshold": 0,
+               "max_inflight": 256}
+_rpc_mu = threading.Lock()
+_rpc_env_applied = False
+_rpc_obs_done = False
+
+
+def configure_rpc(mux=None, connections=None, compress_threshold=None,
+                  max_inflight=None) -> dict:
+    """Set the PROCESS-GLOBAL graph-RPC transport knobs; returns the
+    resulting config. None leaves a knob unchanged. Applies to engines
+    (native channels) built AFTER the call.
+
+    mux: one v2 connection carries many in-flight requests (correlation-
+      id frames, demux reader) instead of one blocking fd per concurrent
+      call; v1 servers are detected at the hello and served classic
+      framing. connections: mux connections per shard endpoint.
+    compress_threshold: > 0 zlib-1-deflates frame bodies >= this many
+      bytes when the peer negotiated it (a frame that would not shrink
+      is sent raw — adaptive per frame). max_inflight: per-connection
+      in-flight cap (client blocks / server bounds dispatch past it)."""
+    from euler_tpu.core import lib as _lib
+
+    lib = _lib.load()
+    with _rpc_mu:
+        if mux is not None:
+            _RPC_CONFIG["mux"] = bool(mux)
+        if connections is not None:
+            _RPC_CONFIG["connections"] = max(int(connections), 1)
+        if compress_threshold is not None:
+            _RPC_CONFIG["compress_threshold"] = max(
+                int(compress_threshold), 0)
+        if max_inflight is not None:
+            _RPC_CONFIG["max_inflight"] = max(int(max_inflight), 1)
+        lib.etg_rpc_config(
+            -1 if mux is None else int(bool(mux)),
+            0 if connections is None else max(int(connections), 1),
+            -1 if compress_threshold is None else max(
+                int(compress_threshold), 0),
+            0 if max_inflight is None else max(int(max_inflight), 1))
+        return dict(_RPC_CONFIG)
+
+
+def configure_rpc_from_env() -> dict:
+    """Apply EULER_TPU_RPC_{MUX,CONNS,COMPRESS,MAX_INFLIGHT} once per
+    process (idempotent; explicit configure_rpc calls win afterwards).
+    Called by RemoteGraphEngine construction so `EULER_TPU_RPC_MUX=1
+    python train.py` flips a whole job without code changes."""
+    import os
+
+    global _rpc_env_applied
+    with _rpc_mu:
+        if _rpc_env_applied:
+            return dict(_RPC_CONFIG)
+    kw = {}
+    if os.environ.get("EULER_TPU_RPC_MUX"):
+        kw["mux"] = os.environ["EULER_TPU_RPC_MUX"] not in ("0", "")
+    if os.environ.get("EULER_TPU_RPC_CONNS"):
+        kw["connections"] = int(os.environ["EULER_TPU_RPC_CONNS"])
+    if os.environ.get("EULER_TPU_RPC_COMPRESS"):
+        kw["compress_threshold"] = int(os.environ["EULER_TPU_RPC_COMPRESS"])
+    if os.environ.get("EULER_TPU_RPC_MAX_INFLIGHT"):
+        kw["max_inflight"] = int(os.environ["EULER_TPU_RPC_MAX_INFLIGHT"])
+    # apply BEFORE publishing the applied flag: a concurrently
+    # constructing engine must never observe applied=True while the env
+    # config has not reached the native side yet (it would build its
+    # channels un-muxed for life). Racing duplicates of configure_rpc
+    # are idempotent, so two first-callers applying is harmless.
+    out = configure_rpc(**kw) if kw else dict(_RPC_CONFIG)
+    with _rpc_mu:
+        _rpc_env_applied = True
+    return out
+
+
+def rpc_transport_stats() -> dict:
+    """Client-edge transport counters (process-global, cumulative):
+    round_trips, wire bytes sent/received, the pre-compression *_raw
+    views, connections_opened, compressed frame counts, mux vs v1 call
+    split, hello fallbacks, and the in-flight gauge. Benches snapshot
+    before/after a leg and diff."""
+    from euler_tpu.core import lib as _lib
+
+    lib = _lib.load()
+    out = np.zeros(len(_RPC_STAT_KEYS), dtype=np.uint64)
+    lib.etg_rpc_stats(out.ctypes.data_as(_lib.c_u64p))
+    return {k: int(v) for k, v in zip(_RPC_STAT_KEYS, out)}
+
+
+def _ensure_rpc_obs() -> None:
+    """Mirror the native transport counters into obs gauges
+    (rpc_round_trips_total, rpc_bytes_{sent,received}[_raw]_total,
+    rpc_inflight, ...) via a registry collector — once per process, and
+    only after the native lib is known loaded (a /metrics scrape must
+    never trigger a first-time build)."""
+    global _rpc_obs_done
+    with _rpc_mu:
+        if _rpc_obs_done:
+            return
+        _rpc_obs_done = True
+    reg = _obs.default_registry()
+    gauges = {
+        k: reg.gauge(
+            f"rpc_{k}" if k == "inflight" else f"rpc_{k}_total",
+            f"graph rpc transport {k} (client edge, process-global)")
+        for k in _RPC_STAT_KEYS}
+
+    def _collect():
+        for k, v in rpc_transport_stats().items():
+            gauges[k].set(v)
+
+    reg.add_collector(_collect)
+
+
 class RetryDeadlineExceeded(EngineError):
     """A retryable call ran out of its deadline/attempt budget. Carries
     the last underlying error text; degrade-mode sampling queries catch
@@ -141,6 +268,8 @@ class RemoteGraphEngine:
                  degrade: bool = False,
                  pool_size: int = 0,
                  pool_handles: Optional[int] = None,
+                 pool_shared: Optional[bool] = None,
+                 dedup: bool = False,
                  chunk_size: int = 4096):
         """retry_deadline_s: failover budget. A query that fails (shard
         died mid-call, RpcChannel exhausted its in-channel retries) is
@@ -173,8 +302,24 @@ class RemoteGraphEngine:
         `graph_rpc` span. 0 (default) keeps the serial one-query-at-a-
         time client.
 
+        pool_shared: pooled query handles are SHARED by the workers
+        (concurrent run() on one handle, round-robin) instead of checked
+        out exclusively — the mux-transport shape: N logical in-flight
+        queries over pool_handles handles (default 1) and a small fixed
+        wire-connection count, instead of one fd per in-flight call.
+        None (default) auto-enables exactly when the process-global mux
+        transport is on (configure_rpc). Concurrent draws on a shared
+        handle stay distinct (each execution takes a fresh nonce).
+
+        dedup: in-flight request dedup — concurrent IDENTICAL
+        deterministic queries (same verb + ids; feature/neighbor reads)
+        coalesce onto ONE wire call, counted as rpc_dedup_hits_total.
+        Sampling verbs are never coalesced. Results are byte-identical
+        to independent calls (followers receive copies).
+
         chunk_size: id-set size above which a pooled engine splits a
         batch call into concurrent chunks (ignored without a pool)."""
+        configure_rpc_from_env()  # before the native channels are built
         self.query = Query.remote(endpoints, seed=seed, mode=mode)
         self.retry = retry_policy or RetryPolicy(
             deadline_s=float(retry_deadline_s))
@@ -204,6 +349,14 @@ class RemoteGraphEngine:
         _obs.register_health(self._obs_name, self.health)
         self.query.bind_obs(self._obs_name)
         self._strays: list = []  # abandoned timed-out attempt threads
+        _ensure_rpc_obs()
+        # in-flight dedup: deterministic sub-queries coalesce onto one
+        # wire call (graph/pipeline.py); None keeps every call 1:1
+        self._dedup = None
+        if dedup:
+            from euler_tpu.graph.pipeline import InflightDedup
+
+            self._dedup = InflightDedup(self._obs_name)
         # pipelined client (ISSUE 4): per-engine worker pool + pooled
         # query handles; None keeps the serial path byte-identical
         self.chunk_size = int(chunk_size)
@@ -211,9 +364,11 @@ class RemoteGraphEngine:
         if pool_size and pool_size > 0:
             from euler_tpu.graph.pipeline import PipelinedClient
 
+            shared = (_RPC_CONFIG["mux"] if pool_shared is None
+                      else bool(pool_shared))
             self.pipeline = PipelinedClient(
                 self, endpoints, seed, mode, workers=int(pool_size),
-                handles=pool_handles)
+                handles=pool_handles, shared=shared)
 
     # -- health / retry machinery ------------------------------------------
     def health(self) -> dict:
@@ -289,6 +444,16 @@ class RemoteGraphEngine:
         return box["out"]
 
     def _run(self, gql: str, feed=None, query=None):
+        """_run_wire with in-flight dedup in front when enabled:
+        concurrent identical DETERMINISTIC queries (never sampling
+        verbs) coalesce onto one wire call; followers get byte-
+        identical copies of the leader's result."""
+        if self._dedup is not None:
+            return self._dedup.run(
+                gql, feed, lambda: self._run_wire(gql, feed, query))
+        return self._run_wire(gql, feed, query)
+
+    def _run_wire(self, gql: str, feed=None, query=None):
         """query.run under RetryPolicy: retryable (transport) failures
         back off with full jitter until the deadline; semantic errors
         raise at once; an exhausted budget raises
