@@ -879,13 +879,18 @@ class BaseEstimator:
     def export_bundle(self, out_dir: str, input_fn=None,
                       steps: int = 1_000_000, nlist: int = 64,
                       nprobe: int = 8, index: bool = True,
+                      shards: int = 1, version: Optional[str] = None,
                       extra_meta: Optional[Dict[str, Any]] = None):
         """Export a versioned serving bundle (euler_tpu.serving): the
         trained parameter pytree, the full node-embedding matrix from a
         batched `embed_all` inference pass over `input_fn` (default:
         this estimator's infer_input_fn sweep), and an IVFFlat index
         over it — everything the InferenceServer needs, checksummed in
-        a manifest so corruption is detected at load. Returns the
+        a manifest so corruption is detected at load. `shards > 1`
+        writes the partitioned fleet layout instead (contiguous 1/N row
+        shards, per-shard IVFFlat, one manifest) for a sharded serving
+        fleet; `version` stamps the bundle_version the hot-swap
+        protocol reports (default: the training step). Returns the
         ModelBundle (already written to out_dir)."""
         import dataclasses
 
@@ -906,16 +911,22 @@ class BaseEstimator:
                 v = getattr(self.model, f.name, None)
                 if isinstance(v, (str, int, float, bool)) or v is None:
                     spec[f.name] = v
+        meta = {"global_step": int(self.state.step), **(extra_meta or {})}
+        if version is not None:
+            meta["bundle_version"] = str(version)
         index_state = None
-        if index and len(ids) >= 2:
+        if index and shards == 1 and len(ids) >= 2:
+            # the global index only serves the unsharded layout;
+            # save_sharded trains one per shard instead
             idx = IVFFlatIndex(nlist=nlist, nprobe=nprobe)
             idx.train_add(emb, ids)
             index_state = idx.state_dict()
-        bundle = ModelBundle(
-            params, emb, ids, index_state, spec,
-            meta={"global_step": int(self.state.step),
-                  **(extra_meta or {})})
-        bundle.save(out_dir)
+        bundle = ModelBundle(params, emb, ids, index_state, spec, meta)
+        if shards > 1:
+            bundle.save_sharded(out_dir, shards, nlist=nlist,
+                                nprobe=nprobe, index=index)
+        else:
+            bundle.save(out_dir)
         return bundle
 
     def train_and_evaluate(self, train_input_fn, eval_input_fn,

@@ -25,6 +25,7 @@ from euler_tpu.serving.batcher import (  # noqa: F401
     ShedError,
     bucket_ladder,
     run_bucketed,
+    warm_ladder,
 )
 from euler_tpu.serving.client import (  # noqa: F401
     ServerOverloaded,
@@ -33,12 +34,15 @@ from euler_tpu.serving.client import (  # noqa: F401
 from euler_tpu.serving.export import (  # noqa: F401
     BundleCorruptionError,
     ModelBundle,
+    bundle_shard_count,
     embed_all,
+    shard_bounds,
 )
 from euler_tpu.serving.server import InferenceServer  # noqa: F401
 
 __all__ = [
     "MicroBatcher", "ShedError", "bucket_ladder", "run_bucketed",
-    "ServingClient", "ServerOverloaded", "BundleCorruptionError",
-    "ModelBundle", "embed_all", "InferenceServer",
+    "warm_ladder", "ServingClient", "ServerOverloaded",
+    "BundleCorruptionError", "ModelBundle", "embed_all",
+    "shard_bounds", "bundle_shard_count", "InferenceServer",
 ]

@@ -39,7 +39,8 @@ import numpy as np
 
 from euler_tpu import obs as _obs
 
-__all__ = ["ShedError", "MicroBatcher", "bucket_ladder", "run_bucketed"]
+__all__ = ["ShedError", "MicroBatcher", "bucket_ladder", "run_bucketed",
+           "warm_ladder"]
 
 _BATCHER_IDS = itertools.count()
 
@@ -90,6 +91,20 @@ def run_bucketed(fn: Callable[..., np.ndarray],
         outs.append(np.asarray(fn(*chunk))[:take])
         at += take
     return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+
+def warm_ladder(ladder: Sequence[int], *fns: Callable[[np.ndarray],
+                                                      object]) -> None:
+    """Pre-compile a version-scoped pool of jitted applies at every
+    ladder bucket. Each fn takes one int32 rows array sized to the
+    bucket. Used at server startup AND before a hot-swap flips the
+    serving pointer: a freshly loaded bundle's applies are warmed
+    OFF-PATH, so neither a first request nor a just-promoted bundle
+    ever pays a jit compile inside a client's deadline."""
+    for b in ladder:
+        rows = np.zeros(int(b), np.int32)
+        for fn in fns:
+            fn(rows)
 
 
 class _Pending:

@@ -1,21 +1,53 @@
-"""ServingClient: retrying, failover-capable client for InferenceServer
-replicas.
+"""ServingClient: retrying, failover-capable, SHARD-AWARE client for a
+fleet of InferenceServer replicas.
 
 Reuses the graph client's resilience vocabulary wholesale: RetryPolicy
 (exponential backoff, full jitter, per-call deadline, per-attempt
 timeout) and the transport-vs-semantic error split of
 `retryable_error`. Replicas come from a static ``hosts:h:p,h:p`` list
-or are discovered live from the registry (the same registry the graph
-shards heartbeat into); a transport failure fails over to the next
-replica and, under a registry, re-resolves the replica set — so a
-killed-and-restarted replica rejoins traffic within its heartbeat
-interval, exactly like a graph shard does for trainers.
+(treated as one shard) or are discovered live from the registry as a
+FLEET — ``{shard -> [replicas]}`` parsed off the same namespace the
+graph shards heartbeat into. A transport failure rotates replicas
+WITHIN the failed shard and, under a registry, re-resolves the fleet —
+a killed-and-restarted replica rejoins traffic within its heartbeat
+interval, exactly like a graph shard does for trainers. Re-resolution
+also DROPS cached connections to endpoints that left the replica set,
+so a departed replica's socket never lingers until its next transport
+error.
+
+Scatter-gather (the multi-shard paths, thread-pool fan-out in the
+style of the pipelined graph client):
+
+  knn    two-phase: resolve each query id's embedding at its OWNING
+         shard (an exact gather — a shard must never mistake another
+         shard's id for an unknown), then broadcast the query VECTORS
+         to every shard concurrently and merge per-shard top-k into
+         the global top-k. Stable sorts end to end (each shard's
+         brute force, then the merge over candidates concatenated in
+         shard order) resolve ties in global row order, so the merged
+         exact result is byte-identical to a single-index
+         tools/knn.brute_force over the whole corpus — zero-vector
+         unknown-id queries included.
+  embed  scattered to owning shards by id range (binary search over
+         shard lower bounds fetched once per fleet generation from
+         info()), reassembled in request order. Byte-identical to the
+         monolith (it is the same gather).
+  score  same-shard pairs go to their shard's score verb; cross-shard
+         pairs are resolved as two embed gathers + a client-side dot
+         (float32 — summation order differs from the on-replica jitted
+         reduce, so cross-shard scores match to fp tolerance, not
+         bitwise).
 
 An explicit SHED reply from an overloaded replica is retried on
-another replica under the same deadline (counted separately from
-transport retries); when the deadline runs out the LAST explicit
-status is raised — ServerOverloaded for sheds, RetryDeadlineExceeded
-for transport — so no request ever ends without a status.
+another replica of the same shard under the same deadline; when the
+deadline runs out the LAST explicit status is raised —
+ServerOverloaded for sheds, RetryDeadlineExceeded for transport — so
+no request ever ends without a status, and a fan-out raises the
+failing shard's status rather than inventing a partial answer.
+
+`swap_fleet(bundle_dir)` performs the rolling zero-downtime promotion:
+every live replica, one at a time, loads vN+1 beside vN, warms, and
+flips — traffic keeps flowing on the replicas not currently warming.
 """
 
 from __future__ import annotations
@@ -27,6 +59,7 @@ import socket
 import struct
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -53,25 +86,34 @@ class ServerOverloaded(EngineError):
 class ServingClient:
     """Client for a serving service (see module docstring).
 
-    endpoints: "hosts:h:p,h:p" static replica list, OR None with
-      `registry` set — a registry spec ("tcp:host:port" / "dir:/path")
-      plus `service` to discover replicas from.
+    endpoints: "hosts:h:p,h:p" static replica list (single shard), OR
+      None with `registry` set — a registry spec ("tcp:host:port" /
+      "dir:/path") plus `service` to discover the fleet from.
     retry_policy: backoff/deadline/per-attempt-timeout; the default is
       a 10s deadline with a 5s per-attempt socket timeout.
     stale_ms: registry entries older than this are skipped (a crashed
       replica that never deregistered).
+    fanout: max concurrent shard calls per scatter-gather (0 = one
+      worker per shard).
+    swap_timeout_s: per-replica bound on a hot-swap admin call (the
+      replica loads + warms a bundle inside it, jit compiles included).
     """
 
     def __init__(self, endpoints: Optional[str] = None,
                  registry: Optional[str] = None, service: str = "default",
                  retry_policy: Optional[RetryPolicy] = None,
-                 stale_ms: int = 10_000, seed: int = 0):
+                 stale_ms: int = 10_000, seed: int = 0,
+                 fanout: int = 0, swap_timeout_s: float = 120.0,
+                 bounds_ttl_s: float = 30.0):
         if not endpoints and not registry:
             raise ValueError("pass endpoints='hosts:h:p,...' or a "
                              "registry spec + service")
         self.service = service
         self.registry = registry
         self.stale_ms = int(stale_ms)
+        self.fanout = int(fanout)
+        self.swap_timeout_s = float(swap_timeout_s)
+        self.bounds_ttl_s = float(bounds_ttl_s)
         self.retry = retry_policy or RetryPolicy(
             deadline_s=10.0, call_timeout_s=5.0)
         self._backoff_rng = random.Random(seed ^ 0x5E21 if seed else None)
@@ -84,8 +126,21 @@ class ServingClient:
                 host, _, port = part.strip().rpartition(":")
                 self._static.append((host, int(port)))
         self._mu = threading.Lock()
+        self._fleet: Dict[int, List[Tuple[str, int]]] = (
+            {0: list(self._static)} if self._static else {})
         self._replicas: List[Tuple[str, int]] = list(self._static or [])
-        self._rr = 0
+        self._rr: Dict[Optional[int], int] = {}
+        # (generation, live endpoint set): bumped whenever re-resolution
+        # changes the replica set; per-thread conn caches compare their
+        # generation against this and drop sockets to departed endpoints
+        self._live_state: Tuple[int, frozenset] = (
+            0, frozenset(self._replicas))
+        self._bounds: Optional[Tuple[List[int], np.ndarray]] = None
+        self._bounds_gen = -1
+        self._bounds_at = 0.0
+        self._num_shards: Optional[int] = None  # fleet width, pinned
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool_size = 0
         self._local = threading.local()  # per-thread connection cache
         self._obs_name = f"serving_client{next(_CLIENT_IDS)}"
         reg = _obs.default_registry()
@@ -100,23 +155,51 @@ class ServingClient:
                 ("sheds", "explicit SHED replies received"),
                 ("deadline_exhausted", "calls that ran out of budget"),
                 ("rediscoveries", "registry re-resolutions"),
+                ("stale_conns_dropped",
+                 "cached connections dropped because their endpoint "
+                 "left the replica set"),
+                ("swaps", "per-replica hot-swap admin calls issued"),
+            )}
+        self._ctr_fanout = {
+            k: reg.counter(f"serving_fanout_{k}_total", h,
+                           ("client",)).labels(**lab)
+            for k, h in (
+                ("queries", "logical queries scatter-gathered across "
+                            "shards"),
+                ("shard_calls", "per-shard sub-calls issued by "
+                                "scatter-gather"),
+                ("merges", "top-k merges performed"),
             )}
         self._hist_call_ms = reg.histogram(
             "serving_client_call_ms",
             "end-to-end serving call latency incl. retries",
             ("client",)).labels(**lab)
+        self._hist_shard_ms = reg.histogram(
+            "serving_client_shard_call_ms",
+            "per-shard sub-call latency incl. retries",
+            ("client", "shard"))
         self._last_error: Optional[str] = None
         _obs.register_health(self._obs_name, self.health)
         if self._static is None:
             self._rediscover(initial=True)
 
     # -- discovery ---------------------------------------------------------
+    def _set_fleet(self, fleet: Dict[int, List[Tuple[str, int]]]) -> None:
+        flat = [ep for s in sorted(fleet) for ep in fleet[s]]
+        with self._mu:
+            self._fleet = fleet
+            self._replicas = flat
+            gen, live = self._live_state
+            new_live = frozenset(flat)
+            if new_live != live:
+                self._live_state = (gen + 1, new_live)
+
     def _rediscover(self, initial: bool = False) -> None:
         if self._static is not None:
             return
         try:
-            found = wire.discover_replicas(self.registry, self.service,
-                                           max_age_ms=self.stale_ms)
+            found = wire.discover_fleet(self.registry, self.service,
+                                        max_age_ms=self.stale_ms)
         except (OSError, wire.WireError) as e:
             if initial:
                 raise
@@ -124,31 +207,94 @@ class ServingClient:
                 self._last_error = f"registry scan: {e}"
             return
         self._ctr["rediscoveries"].inc()
-        with self._mu:
-            self._replicas = [(h, p) for h, p, _ in found]
+        self._set_fleet(
+            {s: [(h, p) for h, p, _ in eps] for s, eps in found.items()})
 
     def replicas(self) -> List[Tuple[str, int]]:
         with self._mu:
             return list(self._replicas)
 
-    def _next_replica(self) -> Tuple[str, int]:
+    def shards(self) -> List[int]:
         with self._mu:
-            if not self._replicas:
-                # WireError subclasses ConnectionError → the call loop
-                # treats an (often transient) empty replica set as
-                # retryable and keeps re-resolving until the deadline
+            return sorted(self._fleet)
+
+    def _fleet_view(self) -> List[int]:
+        """Registered shard list, validated against the fleet's declared
+        width (num_shards from info(), fetched once per client — a swap
+        can never change it, the server enforces shard identity). A
+        shard whose every replica aged out of the registry must surface
+        as an EXPLICIT error: quietly fanning out to the survivors would
+        merge a partial top-k / zero-fill embeds of ids the fleet does
+        hold — confidently wrong results with STATUS_OK."""
+        shard_list = self.shards()
+        if not shard_list:
+            # never fall through to the single-shard path on an empty
+            # scan: once re-resolution repopulates the fleet mid-call,
+            # a shard=None retry would send the WHOLE query to one
+            # arbitrary shard's replica — wrong results, STATUS_OK
+            self._rediscover()
+            shard_list = self.shards()
+            if not shard_list:
                 raise wire.WireError(
                     f"no live replicas for service {self.service!r} "
                     "(registry empty or all entries stale)")
-            ep = self._replicas[self._rr % len(self._replicas)]
-            self._rr += 1
-            return ep
+        width = self._num_shards
+        if width is None and shard_list:
+            info = self._call(
+                wire.MSG_INFO, lambda _r: b"",
+                lambda r: json.loads(r.str_()),
+                shard=shard_list[0], count=False)
+            width = int(info.get("num_shards", 1))
+            with self._mu:
+                self._num_shards = width
+        if width is not None and len(shard_list) < width:
+            self._rediscover()
+            shard_list = self.shards()
+            if len(shard_list) < width:
+                raise wire.WireError(
+                    f"fleet incomplete: shards {shard_list} of "
+                    f"{width} registered for service "
+                    f"{self.service!r} — refusing a partial "
+                    "scatter-gather")
+        return shard_list
+
+    def _next_replica(self, shard: Optional[int] = None
+                      ) -> Tuple[str, int]:
+        with self._mu:
+            pool = self._replicas if shard is None \
+                else self._fleet.get(shard, [])
+            if not pool:
+                # WireError subclasses ConnectionError → the call loop
+                # treats an (often transient) empty replica set as
+                # retryable and keeps re-resolving until the deadline
+                where = f"shard {shard} of " if shard is not None else ""
+                raise wire.WireError(
+                    f"no live replicas for {where}service "
+                    f"{self.service!r} (registry empty or all entries "
+                    "stale)")
+            i = self._rr.get(shard, 0)
+            self._rr[shard] = i + 1
+            return pool[i % len(pool)]
 
     # -- connections (one cached socket per thread per endpoint) ----------
     def _conn(self, ep: Tuple[str, int]) -> socket.socket:
-        conns = getattr(self._local, "conns", None)
+        st = self._local
+        conns = getattr(st, "conns", None)
         if conns is None:
-            conns = self._local.conns = {}
+            conns = st.conns = {}
+        gen, live = self._live_state
+        if getattr(st, "gen", -1) != gen:
+            # the replica set changed since this thread last looked:
+            # drop sockets to departed endpoints NOW instead of keeping
+            # them around until their next transport error
+            for dead in [e for e in conns if e not in live]:
+                s = conns.pop(dead)
+                self._ctr["stale_conns_dropped"].inc()
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            st.gen = gen
         s = conns.get(ep)
         if s is None:
             timeout = self.retry.call_timeout_s or 5.0
@@ -169,12 +315,17 @@ class ServingClient:
                 pass
 
     # -- core call loop ----------------------------------------------------
-    def _call(self, msg_type: int, make_body, decode):
+    def _call(self, msg_type: int, make_body, decode,
+              shard: Optional[int] = None, count: bool = True):
         """One logical call under RetryPolicy: transport failures and
-        SHED replies rotate replicas with backoff until the deadline;
-        semantic ERROR replies raise immediately."""
+        SHED replies rotate replicas (within `shard` when given) with
+        backoff until the deadline; semantic ERROR replies raise
+        immediately. count=False keeps client-internal probes (the
+        one-time fleet-width info fetch) out of the calls counter, so
+        calls == user requests stays an exact accounting identity."""
         pol = self.retry
-        self._ctr["calls"].inc()
+        if count:
+            self._ctr["calls"].inc()
         deadline = time.monotonic() + max(pol.deadline_s, 0.0)
         attempt = 0
         last_shed: Optional[str] = None
@@ -184,7 +335,7 @@ class ServingClient:
                 remaining = deadline - time.monotonic()
                 ep = None
                 try:
-                    ep = self._next_replica()
+                    ep = self._next_replica(shard)
                     s = self._conn(ep)
                     body = make_body(max(remaining, 0.001))
                     wire.write_frame(s, msg_type, body)
@@ -240,18 +391,127 @@ class ServingClient:
                                 max(deadline - now, 0.0))
                     time.sleep(sleep)
         finally:
-            self._hist_call_ms.observe(
-                (time.monotonic() - t_start) * 1000.0)
+            dt_ms = (time.monotonic() - t_start) * 1000.0
+            self._hist_call_ms.observe(dt_ms)
+            if shard is not None:
+                self._hist_shard_ms.labels(
+                    client=self._obs_name, shard=str(shard)).observe(dt_ms)
 
     @staticmethod
     def _deadline_ms(remaining_s: float) -> int:
         return int(min(max(remaining_s, 0.001) * 1000.0, 0xFFFFFFFF))
 
+    # -- fan-out machinery -------------------------------------------------
+    def _submit_all(self, jobs: List) -> List:
+        """Grow-if-needed the fan-out pool and submit every job under
+        ONE lock hold: a concurrent grower replaces (and shuts down)
+        the pool, so fetch-then-submit as two steps could submit on a
+        just-shut-down executor and raise RuntimeError outside the
+        retry machinery. Submission is enqueue-only — cheap to hold
+        the lock across."""
+        with self._mu:
+            want = max(len(jobs), 2)
+            if self.fanout > 0:
+                want = min(want, self.fanout)
+            if self._pool is None or self._pool_size < want:
+                old = self._pool
+                self._pool = ThreadPoolExecutor(
+                    max_workers=want,
+                    thread_name_prefix=f"{self._obs_name}-fanout")
+                self._pool_size = want
+                if old is not None:
+                    old.shutdown(wait=False)
+            return [self._pool.submit(j) for j in jobs]
+
+    def _fanout(self, jobs: List) -> List:
+        """Run thunks concurrently on the fan-out pool; re-raise the
+        first failure (a shard that ran out its whole retry deadline
+        surfaces ITS explicit status — never a silent partial merge).
+        A fan-out issued FROM a fan-out worker runs inline instead:
+        parents parked on a pool slot waiting for children that need a
+        pool slot is a deadlock, not parallelism."""
+        self._ctr_fanout["shard_calls"].inc(len(jobs))
+        if len(jobs) == 1 or threading.current_thread().name.startswith(
+                f"{self._obs_name}-fanout"):
+            return [j() for j in jobs]
+        return [f.result() for f in self._submit_all(jobs)]
+
+    def _shard_bounds(self) -> Tuple[List[int], np.ndarray]:
+        """(shard ids, uint64 lower id bound per shard) for id-range
+        routing, fetched from each shard's info() and cached per fleet
+        generation with a bounds_ttl_s expiry. The TTL matters beyond
+        freshness: a hot-swap that shifts shard boundaries does NOT
+        change the endpoint set, so generation alone would leave every
+        client that didn't issue the swap routing on stale bounds
+        forever — the TTL bounds that window."""
+        gen = self._live_state[0]
+        with self._mu:
+            if (self._bounds is not None and self._bounds_gen == gen
+                    and (time.monotonic() - self._bounds_at)
+                    < self.bounds_ttl_s):
+                return self._bounds
+        shard_ids = self.shards()
+        infos = self._fanout([
+            (lambda s=s: (s, self._call(
+                wire.MSG_INFO, lambda _r: b"",
+                lambda r: json.loads(r.str_()), shard=s, count=False)))
+            for s in shard_ids])
+        los = []
+        for s, info in infos:
+            lo = info.get("id_lo")
+            # an empty shard owns no ids: push its bound past every
+            # possible id so routing never lands on it
+            los.append(int(lo) if lo is not None else (1 << 64) - 1)
+        bounds = (shard_ids, np.asarray(los, dtype=np.uint64))
+        with self._mu:
+            self._bounds = bounds
+            self._bounds_gen = gen
+            self._bounds_at = time.monotonic()
+        return bounds
+
+    def _owners(self, ids: np.ndarray) -> Tuple[List[int], np.ndarray]:
+        """(shard ids, owning-shard POSITION per query id). Ids below
+        the first bound clip to shard 0; ids in nobody's range route to
+        the range they fall in and come back as zeros — the same
+        unknown-id semantics the monolith has."""
+        shard_ids, los = self._shard_bounds()
+        pos = np.searchsorted(los, ids.astype(np.uint64),
+                              side="right").astype(np.int64) - 1
+        return shard_ids, np.clip(pos, 0, len(shard_ids) - 1)
+
     # -- verbs -------------------------------------------------------------
     def embed(self, ids) -> np.ndarray:
-        """[n, D] float32 embedding rows (zeros for unknown ids)."""
+        """[n, D] float32 embedding rows (zeros for unknown ids).
+        Multi-shard fleets scatter by owning id range and reassemble —
+        byte-identical to the monolith gather."""
         ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
+        shard_list = self._fleet_view()
+        if len(shard_list) > 1 and ids.size:
+            self._ctr_fanout["queries"].inc()
+        return self._embed_ids(ids, shard_list)
 
+    def _embed_ids(self, ids: np.ndarray,
+                   shard_list: List[int]) -> np.ndarray:
+        """embed() body without the logical-query counter: knn phase 1
+        and cross-shard score ride through here so ONE logical query
+        counts once, however many internal gathers it needs."""
+        if len(shard_list) <= 1 or ids.size == 0:
+            return self._embed_one(
+                ids, shard_list[0] if shard_list else None)
+        shard_ids, pos = self._owners(ids)
+        groups = [(shard_ids[p], np.nonzero(pos == p)[0])
+                  for p in np.unique(pos)]
+        parts = self._fanout([
+            (lambda s=s, idx=idx: (idx, self._embed_one(ids[idx], s)))
+            for s, idx in groups])
+        dim = parts[0][1].shape[1] if parts else 0
+        out = np.zeros((ids.size, dim), np.float32)
+        for idx, rows in parts:
+            out[idx] = rows
+        return out
+
+    def _embed_one(self, ids: np.ndarray,
+                   shard: Optional[int]) -> np.ndarray:
         def body(remaining):
             return struct.pack("<II", self._deadline_ms(remaining),
                                ids.size) + ids.tobytes()
@@ -261,39 +521,127 @@ class ServingClient:
             dim = r.u32()
             return r.array(np.float32, n * dim).reshape(n, dim)
 
-        return self._call(wire.MSG_EMBED, body, decode)
+        return self._call(wire.MSG_EMBED, body, decode, shard=shard)
 
     def knn(self, ids, k: int = 10,
             exact: bool = True) -> Tuple[np.ndarray, np.ndarray]:
         """Per-query top-k: (neighbor ids [n, k] uint64, inner-product
-        scores [n, k] float32). exact=True is byte-identical to offline
-        tools/knn.brute_force over the bundle; exact=False uses the
-        bundle's IVFFlat index (approximate, faster at corpus scale).
+        scores [n, k] float32). On a multi-shard fleet this is the
+        scatter-gather: query vectors resolved at their owning shard,
+        broadcast to every shard concurrently, per-shard top-k stable-
+        merged into the global top-k — with exact=True the result is
+        byte-identical to a single-index tools/knn.brute_force over the
+        whole corpus (see module docstring). exact=False routes through
+        each shard's IVFFlat index (approximate, faster at corpus
+        scale; the merge is the same but carries no bitwise guarantee).
         The returned k may be clipped to the corpus size."""
         ids = np.ascontiguousarray(ids, dtype=np.uint64).ravel()
+        shard_list = self._fleet_view()
+        if len(shard_list) <= 1:
+            return self._knn_ids(
+                ids, k, exact, shard_list[0] if shard_list else None)
+        # phase 1: exact query vectors from the owning shards
+        vecs = self._embed_ids(ids, shard_list)
+        # phase 2: broadcast vectors, gather per-shard top-k
+        self._ctr_fanout["queries"].inc()
+        parts = self._fanout([
+            (lambda s=s: self._knn_vec(vecs, k, exact, s))
+            for s in shard_list])
+        return self._merge_topk(parts, k)
 
+    def _knn_ids(self, ids: np.ndarray, k: int, exact: bool,
+                 shard: Optional[int]
+                 ) -> Tuple[np.ndarray, np.ndarray]:
         def body(remaining):
             return struct.pack(
                 "<IIBI", self._deadline_ms(remaining), int(k),
                 1 if exact else 0, ids.size) + ids.tobytes()
 
-        def decode(r: wire.Reader):
-            n = r.u32()
-            got_k = r.u32()
-            nbr = r.array(np.uint64, n * got_k).reshape(n, got_k)
-            sims = r.array(np.float32, n * got_k).reshape(n, got_k)
-            return nbr, sims
+        return self._call(wire.MSG_KNN, body, self._decode_topk,
+                          shard=shard)
 
-        return self._call(wire.MSG_KNN, body, decode)
+    def _knn_vec(self, vecs: np.ndarray, k: int, exact: bool,
+                 shard: int) -> Tuple[np.ndarray, np.ndarray]:
+        vecs = np.ascontiguousarray(vecs, dtype=np.float32)
+
+        def body(remaining):
+            return struct.pack(
+                "<IIBII", self._deadline_ms(remaining), int(k),
+                1 if exact else 0, vecs.shape[0], vecs.shape[1]) \
+                + vecs.tobytes()
+
+        return self._call(wire.MSG_KNN_VEC, body, self._decode_topk,
+                          shard=shard)
+
+    @staticmethod
+    def _decode_topk(r: wire.Reader):
+        n = r.u32()
+        got_k = r.u32()
+        nbr = r.array(np.uint64, n * got_k).reshape(n, got_k)
+        sims = r.array(np.float32, n * got_k).reshape(n, got_k)
+        return nbr, sims
+
+    def _merge_topk(self, parts, k: int
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Merge per-shard top-k into the global top-k. Candidates are
+        concatenated in SHARD ORDER (= ascending global row order for
+        contiguous shards) and selected with a STABLE sort on -sims, so
+        ties resolve toward the lower global row — exactly the total
+        order the stable single-index brute force uses. Byte-identical
+        by construction (per-shard sims are bitwise slices of the full
+        GEMM: the reduction runs over the same D either way)."""
+        self._ctr_fanout["merges"].inc()
+        nbr = np.concatenate([p[0] for p in parts], axis=1)
+        sims = np.concatenate([p[1] for p in parts], axis=1)
+        kk = min(int(k), nbr.shape[1])
+        order = np.argsort(-sims, axis=1, kind="stable")[:, :kk]
+        return (np.take_along_axis(nbr, order, axis=1),
+                np.take_along_axis(sims, order, axis=1))
 
     def score(self, src, dst) -> np.ndarray:
         """Inner product per (src, dst) pair: [n] float32 (0.0 when
-        either end is unknown)."""
+        either end is unknown). Same-shard pairs are scored on their
+        replica; cross-shard pairs resolve both embeddings and dot on
+        the client (fp tolerance vs the monolith, see module
+        docstring)."""
         src = np.ascontiguousarray(src, dtype=np.uint64).ravel()
         dst = np.ascontiguousarray(dst, dtype=np.uint64).ravel()
         if src.size != dst.size:
             raise ValueError(f"src has {src.size} ids, dst {dst.size}")
+        shard_list = self._fleet_view()
+        if len(shard_list) <= 1 or src.size == 0:
+            return self._score_one(
+                src, dst, shard_list[0] if shard_list else None)
+        shard_ids, spos = self._owners(src)
+        _, dpos = self._owners(dst)
+        same = spos == dpos
+        out = np.zeros(src.size, np.float32)
+        self._ctr_fanout["queries"].inc()
+        # cross-shard pairs first (embed() fans out internally); then
+        # the same-shard groups in one concurrent wave
+        cross = np.nonzero(~same)[0]
+        if cross.size:
+            # one deduplicated embed over BOTH ends: two sequential
+            # embed() calls would pay two full fan-out waves
+            uniq, inv = np.unique(
+                np.concatenate([src[cross], dst[cross]]),
+                return_inverse=True)
+            emb_u = self._embed_ids(uniq, shard_list)
+            out[cross] = np.einsum(
+                "ij,ij->i", emb_u[inv[:cross.size]],
+                emb_u[inv[cross.size:]]).astype(np.float32)
+        jobs = []
+        for p in np.unique(spos[same]):
+            idx = np.nonzero(same & (spos == p))[0]
+            jobs.append((lambda s=shard_ids[p], idx=idx:
+                         (idx, self._score_one(src[idx], dst[idx], s))))
+        if jobs:
+            for idx, vals in self._fanout(jobs):
+                out[idx] = vals
+        return out
 
+    def _score_one(self, src: np.ndarray, dst: np.ndarray,
+                   shard: Optional[int]) -> np.ndarray:
         def body(remaining):
             return struct.pack("<II", self._deadline_ms(remaining),
                                src.size) + src.tobytes() + dst.tobytes()
@@ -302,31 +650,92 @@ class ServingClient:
             n = r.u32()
             return r.array(np.float32, n)
 
-        return self._call(wire.MSG_SCORE, body, decode)
+        return self._call(wire.MSG_SCORE, body, decode, shard=shard)
 
-    def server_health(self) -> Dict:
-        """One replica's health() dict (round-robin pick)."""
+    def server_health(self, shard: Optional[int] = None) -> Dict:
+        """One replica's health() dict (round-robin pick, optionally
+        pinned to a shard)."""
         return self._call(wire.MSG_HEALTH, lambda _r: b"",
-                          lambda r: json.loads(r.str_()))
+                          lambda r: json.loads(r.str_()), shard=shard)
 
-    def info(self) -> Dict:
-        """Service/bundle identity of one replica (dim, count, spec)."""
+    def info(self, shard: Optional[int] = None) -> Dict:
+        """Service/bundle identity of one replica (dim, count, shard,
+        bundle_version, id range)."""
         return self._call(wire.MSG_INFO, lambda _r: b"",
-                          lambda r: json.loads(r.str_()))
+                          lambda r: json.loads(r.str_()), shard=shard)
+
+    def fleet_info(self) -> Dict[int, Dict]:
+        """{shard -> info()} across the fleet (concurrent)."""
+        shard_list = self.shards()
+        return dict(self._fanout([
+            (lambda s=s: (s, self.info(shard=s))) for s in shard_list]))
+
+    # -- zero-downtime promotion -------------------------------------------
+    def swap_fleet(self, bundle_dir: str) -> Dict[str, Dict]:
+        """Rolling zero-downtime promotion: tell EVERY live replica,
+        one at a time, to load `bundle_dir` beside its serving bundle,
+        warm it, and flip (wire MSG_SWAP). Sequential on purpose — the
+        fleet keeps serving on the replicas not currently warming.
+        Returns {"host:port": swap reply}. Raises on the first replica
+        that fails, leaving the fleet mixed-version; re-running
+        converges (an already-promoted replica just swaps to the same
+        version again)."""
+        with self._mu:
+            eps = list(self._replicas)
+        if not eps:
+            raise wire.WireError(
+                f"no live replicas for service {self.service!r}")
+        out: Dict[str, Dict] = {}
+        for ep in eps:
+            self._ctr["swaps"].inc()
+            out[f"{ep[0]}:{ep[1]}"] = self._swap_one(ep, bundle_dir)
+        # the promoted bundle may shard the id space differently (same
+        # shard count, shifted contiguous boundaries): drop the cached
+        # id-range routing table so the next routed call refetches it
+        with self._mu:
+            self._bounds = None
+        return out
+
+    def _swap_one(self, ep: Tuple[str, int], bundle_dir: str) -> Dict:
+        """One replica's swap on a DEDICATED socket (load+warm can take
+        far longer than the cached data-path sockets' timeout)."""
+        body = wire.pack_str(bundle_dir)
+        with socket.create_connection(
+                ep, timeout=self.swap_timeout_s) as s:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            wire.write_frame(s, wire.MSG_SWAP, body)
+            reply_type, reply = wire.read_frame(s)
+            if reply_type != wire.MSG_SWAP:
+                raise wire.WireError(
+                    f"reply type {reply_type} != {wire.MSG_SWAP}")
+            r = wire.Reader(reply)
+            status = r.u32()
+            if status != wire.STATUS_OK:
+                raise EngineError(
+                    f"swap failed on {ep[0]}:{ep[1]}: {r.str_()}")
+            return json.loads(r.str_())
 
     # -- introspection / lifecycle -----------------------------------------
     def health(self) -> Dict:
         """Client-side counter view (obs registry children): calls,
         retries, failovers, sheds, deadline_exhausted, rediscoveries,
-        last_error, live replica count."""
+        stale-conn drops, swap calls, fan-out counters, last_error,
+        live replica/shard counts."""
         out = {k: int(c.value) for k, c in self._ctr.items()}
+        out["fanout"] = {k: int(c.value)
+                        for k, c in self._ctr_fanout.items()}
         with self._mu:
             out["last_error"] = self._last_error
             out["replicas"] = len(self._replicas)
+            out["shards"] = len(self._fleet)
         return out
 
     def close(self) -> None:
         _obs.unregister_health(self._obs_name)
+        with self._mu:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
         conns = getattr(self._local, "conns", None)
         if conns:
             for s in conns.values():

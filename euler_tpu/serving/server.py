@@ -1,33 +1,53 @@
-"""InferenceServer: the online query endpoint over an exported bundle.
+"""InferenceServer: one serving-fleet replica over one bundle shard.
 
-Serves three verbs over the framed-TCP conventions (wire.py):
+Serves over the framed-TCP conventions (wire.py):
 
   embed(ids)        [n, D] float32 embedding rows
   knn(ids, k)       per-query top-k neighbor ids + inner-product scores
                     (exact brute-force by default — byte-identical to
-                    tools/knn.brute_force over the bundle — or the
-                    bundle's IVFFlat index with exact=False)
+                    tools/knn.brute_force over the served shard — or
+                    the shard's IVFFlat index with exact=False)
+  knn_vec(vecs, k)  same, but queries arrive as raw float32 vectors —
+                    the fleet fan-out verb: the client resolves each
+                    query id's embedding at its OWNING shard, then
+                    broadcasts the vectors to every shard, so a shard
+                    never mistakes another shard's id for an unknown
   score(src, dst)   inner product per (src, dst) pair
+  swap(bundle_dir)  admin: zero-downtime versioned hot-swap (below)
 
-Every verb funnels through a per-verb dynamic MicroBatcher: concurrent
-requests coalesce into one apply (flush at max_batch rows or flush_ms,
-whichever first), padded to a fixed bucket ladder so the jitted device
-apply (embedding gather / pair scoring) never recompiles in steady
-state. Past max_queue queued rows, admission control replies an
-explicit SHED status instead of queueing — overload degrades loudly
-and boundedly, never as silent latency growth. A request whose
-deadline_ms expires while queued also gets SHED (the batch result is
-discarded), so no admitted request hangs past its deadline.
+Every data verb funnels through a per-verb dynamic MicroBatcher:
+concurrent requests coalesce into one flush (flush at max_batch rows or
+flush_ms), padded to a fixed bucket ladder so the jitted device apply
+never recompiles in steady state. Past max_queue queued rows, admission
+control replies an explicit SHED status instead of queueing — overload
+degrades loudly and boundedly, never as silent latency growth. A
+request whose deadline_ms expires while queued also gets SHED.
 
-Replicas register in the SAME registry the graph shards use
-(``serve_<service>_<replica>__<host>_<port>``, heartbeat-refreshed),
-so ServingClient discovers them exactly like trainers discover shards.
-health() registers on the obs registry → /healthz, and every counter/
-histogram is a labeled child on the shared default registry.
+**Fleet**: a replica serves ONE contiguous shard of a partitioned
+bundle (export.save_sharded) and registers
+``serve_<service>_<shard>_<replica>__<host>_<port>`` in the same
+registry the graph shards heartbeat into — shards and replicas-per-
+shard are discoverable exactly like graph shards. kNN sims are
+computed PER REQUEST (not coalesced across a flush): per-request GEMM
+keeps each answer's bits independent of what else happened to share
+the flush, which is what lets the client's scatter-gather merge be
+byte-identical to a single-index brute-force reference. The flush
+still amortizes the per-dispatch cost — that cost is per flush, not
+per request.
 
-Unknown ids (not in the bundle) embed as zero rows and score 0 —
-counted in serving_unknown_ids_total, never an error: a freshly-added
-node simply has no embedding until the next export.
+**Zero-downtime hot-swap**: all bundle-scoped state (arrays, the
+jitted applies, the lazy IVF index) lives in a _BundleEngine. swap()
+loads bundle vN+1 BESIDE vN, warms the new engine's jitted applies
+over the whole bucket ladder and rebuilds its index off-path, then
+atomically flips the serving pointer (one reference assignment). A
+flush in progress keeps the engine it started with; queued requests
+pick up whichever engine their flush starts under — every in-flight
+request completes with a status either way, no request is dropped.
+``bundle_version`` is exposed in info()/health()/healthz and every
+completed swap increments serving_swap_total.
+
+Unknown ids (not in the served shard) embed as zero rows and score 0 —
+counted in serving_unknown_ids_total, never an error.
 """
 
 from __future__ import annotations
@@ -37,7 +57,7 @@ import socket
 import struct
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -48,91 +68,161 @@ from euler_tpu.serving.batcher import (
     ShedError,
     bucket_ladder,
     run_bucketed,
+    warm_ladder,
 )
-from euler_tpu.serving.export import ModelBundle
+from euler_tpu.serving.export import ModelBundle, bundle_shard_count
 
 __all__ = ["InferenceServer"]
 
 _DEFAULT_DEADLINE_S = 30.0
 
 
-class InferenceServer:
-    """One serving replica over one ModelBundle (see module docstring).
+class _BundleEngine:
+    """Version-scoped serving state: one loaded bundle (shard) plus its
+    jitted applies and lazy IVF index. Built (and warmed) OFF the
+    serving path; the server serves whichever engine its atomic
+    pointer names. Immutable after construction except the lazily
+    built index."""
 
-    bundle: a ModelBundle or a bundle directory path (loaded with
-      checksum verification — a corrupt bundle refuses to serve).
-    registry: optional registry spec ("tcp:host:port", "dir:/path", or
-      a plain directory) to register in for discovery.
-    service / replica: the discovery identity.
-    max_batch / flush_ms / max_queue: MicroBatcher knobs (rows).
-    inject_apply_latency_ms: adds a fixed sleep to every flushed apply —
-      the honest way to model per-dispatch cost on CPU-bound test
-      containers (chaos/bench use only).
-    """
-
-    def __init__(self, bundle, host: str = "127.0.0.1", port: int = 0,
-                 registry: Optional[str] = None, service: str = "default",
-                 replica: int = 0, max_batch: int = 256,
-                 flush_ms: float = 2.0, max_queue: int = 0,
-                 heartbeat_s: float = 1.0,
-                 inject_apply_latency_ms: float = 0.0):
-        if isinstance(bundle, str):
-            bundle = ModelBundle.load(bundle, verify=True)
-        self.bundle = bundle
-        self.service = service
-        self.replica = int(replica)
-        self._inject_s = float(inject_apply_latency_ms) / 1000.0
-        self._ids = bundle.ids                      # sorted uint64
-        self._emb = bundle.embeddings               # [N, D] float32 host
-        self._index = None                          # built lazily (IVF)
-        self._index_mu = threading.Lock()
-
+    def __init__(self, bundle: ModelBundle):
         import jax
         import jax.numpy as jnp
 
-        table = jnp.asarray(self._emb) if self._emb.size else None
-        self._jit_gather = jax.jit(
+        self.bundle = bundle
+        self.ids = bundle.ids                     # sorted uint64
+        self.emb = bundle.embeddings              # [N, D] float32 host
+        self.shard = bundle.shard
+        self.num_shards = bundle.num_shards
+        self.version = bundle.version
+        self._index = None
+        self._index_mu = threading.Lock()
+
+        table = jnp.asarray(self.emb) if self.emb.size else None
+        self.jit_gather = jax.jit(
             (lambda rows: table[rows]) if table is not None
             else (lambda rows: jnp.zeros((rows.shape[0], 0), jnp.float32)))
-        self._jit_score = jax.jit(
+        self.jit_score = jax.jit(
             (lambda a, b: jnp.sum(table[a] * table[b], axis=-1))
             if table is not None
             else (lambda a, b: jnp.zeros((a.shape[0],), jnp.float32)))
+
+    def warm(self, ladder: Tuple[int, ...]) -> None:
+        """Compile every ladder bucket of both applies BEFORE this
+        engine takes traffic (startup and pre-swap both come through
+        here), and rebuild the stored IVF clustering so the first
+        approximate query after a flip doesn't pay the build."""
+        import jax.numpy as jnp
+
+        warm_ladder(ladder,
+                    lambda rows: self.jit_gather(jnp.asarray(rows)),
+                    lambda rows: self.jit_score(jnp.asarray(rows),
+                                                jnp.asarray(rows)))
+        if self.bundle.index_state is not None:
+            self.get_index()
+
+    def lookup_rows(self, qids: np.ndarray
+                    ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """(row indices int32, valid mask, n_unknown) for query ids
+        against this shard's sorted id order; unknown ids map to row 0,
+        masked."""
+        qids = np.ascontiguousarray(qids, dtype=np.uint64)
+        if self.ids.size == 0:
+            return (np.zeros(qids.size, np.int32),
+                    np.zeros(qids.size, bool), int(qids.size))
+        rows = np.searchsorted(self.ids, qids).clip(0, self.ids.size - 1)
+        valid = self.ids[rows] == qids
+        return rows.astype(np.int32), valid, int((~valid).sum())
+
+    def get_index(self):
+        with self._index_mu:
+            if self._index is None:
+                self._index = self.bundle.build_index()
+            return self._index
+
+    def id_range(self) -> Tuple[Optional[int], Optional[int]]:
+        if self.ids.size == 0:
+            return None, None
+        return int(self.ids[0]), int(self.ids[-1])
+
+
+class InferenceServer:
+    """One serving replica over one bundle (shard) — see module
+    docstring.
+
+    bundle: a ModelBundle, a bundle directory, or a SHARDED bundle
+      directory (export.save_sharded) — pass `shard` to pick which
+      shard this replica serves; loads verify checksums.
+    registry: optional registry spec ("tcp:host:port", "dir:/path", or
+      a plain directory) to register in for discovery.
+    service / shard / replica: the discovery identity.
+    max_batch / flush_ms / max_queue: MicroBatcher knobs (rows).
+    inject_apply_latency_ms: fixed sleep per flushed apply — models the
+      per-dispatch cost on CPU-bound test containers (chaos/bench only).
+    inject_scan_ms_per_krow: sleep per flushed KNN apply scaled by the
+      served corpus size (ms per 1000 rows) — models the corpus-
+      proportional device scan a brute-force search costs, which is the
+      cost sharding divides (chaos/bench only).
+    """
+
+    def __init__(self, bundle: Union[ModelBundle, str],
+                 host: str = "127.0.0.1", port: int = 0,
+                 registry: Optional[str] = None, service: str = "default",
+                 shard: Optional[int] = None, replica: int = 0,
+                 max_batch: int = 256,
+                 flush_ms: float = 2.0, max_queue: int = 0,
+                 heartbeat_s: float = 1.0,
+                 inject_apply_latency_ms: float = 0.0,
+                 inject_scan_ms_per_krow: float = 0.0):
+        if isinstance(bundle, str):
+            bundle = self._load_bundle(bundle, shard)
+        elif shard is not None and int(shard) != bundle.shard:
+            raise ValueError(
+                f"shard={shard} but the bundle object is shard "
+                f"{bundle.shard}")
+        self.service = service
+        self.replica = int(replica)
+        self._inject_s = float(inject_apply_latency_ms) / 1000.0
+        self._scan_s_per_row = float(inject_scan_ms_per_krow) / 1e6
         self.ladder = bucket_ladder(max_batch)
+        self._swap_mu = threading.Lock()
+        engine = _BundleEngine(bundle)
         # warm every ladder bucket BEFORE accepting traffic: first-
         # request jit compiles would otherwise land inside a client's
         # per-attempt timeout, and steady state must never compile
-        for b in self.ladder:
-            rows = jnp.asarray(np.zeros(b, np.int32))
-            self._jit_gather(rows)
-            self._jit_score(rows, rows)
+        engine.warm(self.ladder)
+        self._engine = engine
 
         # -- metrics / health ----------------------------------------------
         reg = _obs.default_registry()
-        lab = {"service": service, "replica": str(self.replica)}
+        lab = {"service": service, "shard": str(engine.shard),
+               "replica": str(self.replica)}
         self._ctr_requests = reg.counter(
             "serving_requests_total", "serving requests by verb",
-            ("service", "replica", "verb"))
+            ("service", "shard", "replica", "verb"))
         self._hist_request_ms = reg.histogram(
             "serving_request_ms", "end-to-end in-server request latency",
-            ("service", "replica", "verb"))
+            ("service", "shard", "replica", "verb"))
         self._ctr_deadline = reg.counter(
             "serving_deadline_shed_total",
             "admitted requests whose deadline expired in queue (SHED "
-            "replied)", ("service", "replica")).labels(**lab)
+            "replied)", ("service", "shard", "replica")).labels(**lab)
         self._ctr_unknown = reg.counter(
             "serving_unknown_ids_total",
-            "queried ids absent from the bundle (served as zeros)",
-            ("service", "replica")).labels(**lab)
+            "queried ids absent from the served shard (served as zeros)",
+            ("service", "shard", "replica")).labels(**lab)
         self._ctr_errors = reg.counter(
             "serving_errors_total", "requests answered with ERROR status",
-            ("service", "replica")).labels(**lab)
+            ("service", "shard", "replica")).labels(**lab)
+        self._ctr_swap = reg.counter(
+            "serving_swap_total",
+            "completed zero-downtime bundle hot-swaps",
+            ("service", "shard", "replica")).labels(**lab)
         self._g_connections = reg.gauge(
             "serving_connections", "live client connections",
-            ("service", "replica")).labels(**lab)
+            ("service", "shard", "replica")).labels(**lab)
         self._lab = lab
 
-        name = f"{service}.{self.replica}"
+        name = f"{service}.{engine.shard}.{self.replica}"
         self._batchers = {
             "embed": MicroBatcher(self._run_embed, max_batch=max_batch,
                                   flush_ms=flush_ms, max_queue=max_queue,
@@ -172,8 +262,9 @@ class InferenceServer:
 
         # -- discovery -----------------------------------------------------
         self.registry = registry
-        self._entry = wire.serve_entry_name(service, self.replica,
-                                            self.host, self.port)
+        self._entry = wire.serve_entry_name(service, engine.shard,
+                                            self.replica, self.host,
+                                            self.port)
         self._hb_thread = None
         if registry:
             wire.registry_put(registry, self._entry)
@@ -181,46 +272,108 @@ class InferenceServer:
                 target=self._heartbeat_loop, args=(float(heartbeat_s),),
                 name=f"serve-hb-{name}", daemon=True)
             self._hb_thread.start()
-        self._obs_name = f"serving_{service}_{self.replica}_{self.port}"
+        self._obs_name = (f"serving_{service}_{engine.shard}_"
+                          f"{self.replica}_{self.port}")
         _obs.register_health(self._obs_name, self.health)
 
-    # -- applies (run on the batcher workers) ------------------------------
-    def _lookup_rows(self, qids: np.ndarray) -> Tuple[np.ndarray,
-                                                      np.ndarray]:
-        """(row indices int32, valid mask) for query ids against the
-        bundle's sorted id order; unknown ids map to row 0, masked."""
-        qids = np.ascontiguousarray(qids, dtype=np.uint64)
-        if self._ids.size == 0:
-            return (np.zeros(qids.size, np.int32),
-                    np.zeros(qids.size, bool))
-        rows = np.searchsorted(self._ids, qids).clip(0, self._ids.size - 1)
-        valid = self._ids[rows] == qids
-        n_unknown = int((~valid).sum())
-        if n_unknown:
-            self._ctr_unknown.inc(n_unknown)
-        return rows.astype(np.int32), valid
+    # -- bundle / engine ---------------------------------------------------
+    @staticmethod
+    def _load_bundle(path: str, shard: Optional[int]) -> ModelBundle:
+        n = bundle_shard_count(path)
+        if n > 1:
+            return ModelBundle.load_shard(path, int(shard or 0))
+        if shard not in (None, 0):
+            raise ValueError(
+                f"shard={shard} requested but {path} is unsharded")
+        return ModelBundle.load(path, verify=True)
 
-    def _maybe_inject(self) -> None:
-        if self._inject_s > 0:
-            time.sleep(self._inject_s)
+    @property
+    def bundle(self) -> ModelBundle:
+        return self._engine.bundle
+
+    @property
+    def shard(self) -> int:
+        return self._engine.shard
+
+    @property
+    def bundle_version(self) -> str:
+        return self._engine.version
+
+    def swap(self, bundle: Union[ModelBundle, str]) -> Dict:
+        """Zero-downtime versioned hot-swap: load the new bundle (same
+        shard identity as the one served — a replica never changes
+        shards mid-life), warm its jitted applies over the whole bucket
+        ladder and rebuild its index OFF the serving path, then
+        atomically flip the serving pointer. In-flight requests
+        complete against whichever engine their flush started under;
+        no request ends without a status. Returns the new identity."""
+        with self._swap_mu:
+            cur = self._engine
+            if isinstance(bundle, str):
+                n = bundle_shard_count(bundle)
+                if cur.num_shards > 1:
+                    if n != cur.num_shards:
+                        raise ValueError(
+                            f"swap bundle has {n} shard(s) but this "
+                            f"replica serves shard {cur.shard} of "
+                            f"{cur.num_shards}")
+                    bundle = ModelBundle.load_shard(bundle, cur.shard)
+                else:
+                    if n > 1:
+                        raise ValueError(
+                            f"swap bundle has {n} shards but this "
+                            "replica serves an unsharded bundle")
+                    bundle = ModelBundle.load(bundle, verify=True)
+            elif (bundle.shard, bundle.num_shards) != (cur.shard,
+                                                       cur.num_shards):
+                raise ValueError(
+                    f"swap bundle is shard {bundle.shard}/"
+                    f"{bundle.num_shards} but this replica serves "
+                    f"{cur.shard}/{cur.num_shards}")
+            if bundle.dim != cur.bundle.dim and cur.bundle.count \
+                    and bundle.count:
+                raise ValueError(
+                    f"swap bundle dim {bundle.dim} != served dim "
+                    f"{cur.bundle.dim}")
+            engine = _BundleEngine(bundle)
+            engine.warm(self.ladder)        # off-path: vN still serving
+            self._engine = engine           # the atomic flip
+            self._ctr_swap.inc()
+            return {"bundle_version": engine.version,
+                    "previous_version": cur.version,
+                    "shard": engine.shard, "count": bundle.count,
+                    "dim": bundle.dim}
+
+    # -- applies (run on the batcher workers) ------------------------------
+    def _maybe_inject(self, eng: _BundleEngine, scan: bool) -> None:
+        s = self._inject_s
+        if scan:
+            # corpus-proportional scan cost: the share a shard pays is
+            # its corpus share — the cost partitioning divides
+            s += self._scan_s_per_row * eng.ids.size
+        if s > 0:
+            time.sleep(s)
 
     def _run_embed(self, payloads: List[np.ndarray]) -> List[np.ndarray]:
         """One bucketed jitted gather over every request's ids."""
         import jax.numpy as jnp
 
-        self._maybe_inject()
+        eng = self._engine
+        self._maybe_inject(eng, scan=False)
         flat = np.concatenate(payloads) if payloads else \
             np.zeros(0, np.uint64)
-        rows, valid = self._lookup_rows(flat)
+        rows, valid, n_unknown = eng.lookup_rows(flat)
+        if n_unknown:
+            self._ctr_unknown.inc(n_unknown)
         if flat.size:
             out = run_bucketed(
-                lambda r: np.asarray(self._jit_gather(jnp.asarray(r))),
+                lambda r: np.asarray(eng.jit_gather(jnp.asarray(r))),
                 [rows], self.ladder)
             # copy=True: jax device buffers surface as read-only numpy
             out = np.array(out, dtype=np.float32)
             out[~valid] = 0.0
         else:
-            out = np.zeros((0, self.bundle.dim), np.float32)
+            out = np.zeros((0, eng.bundle.dim), np.float32)
         results, at = [], 0
         for p in payloads:
             results.append(out[at:at + p.size])
@@ -229,56 +382,67 @@ class InferenceServer:
 
     def _run_knn(self, payloads: List[Tuple[np.ndarray, int, bool]]
                  ) -> List[Tuple[np.ndarray, np.ndarray]]:
-        """Batched top-k: ONE sims pass for the whole flush at the max
-        requested k, sliced per request. The exact path is literally
-        tools/knn.brute_force over the bundle arrays — byte-identical
-        to offline retrieval by construction; exact=False routes
-        through the bundle's IVFFlat index instead."""
+        """Top-k per request. Queries are either uint64 ids (resolved
+        against this shard, unknown → zero vector) or a float32 [n, D]
+        vector matrix (the fleet fan-out verb). Sims are computed with
+        one GEMM PER REQUEST: a request's bits must not depend on what
+        else coalesced into the flush (BLAS picks different kernels by
+        batch shape), or the fleet merge could never be byte-identical
+        to the single-index reference. The flush still amortizes the
+        per-dispatch (injected) cost."""
         from euler_tpu.tools.knn import brute_force
 
-        self._maybe_inject()
+        eng = self._engine
+        self._maybe_inject(eng, scan=True)
         results = []
-        for exact in (True, False):
-            group = [(i, p) for i, p in enumerate(payloads)
-                     if bool(p[2]) == exact]
-            if not group:
-                continue
-            flat = np.concatenate([p[0] for _, p in group])
-            rows, valid = self._lookup_rows(flat)
-            queries = self._emb[rows].copy()
-            queries[~valid] = 0.0
-            max_k = max(int(p[1]) for _, p in group)
-            max_k = max(1, min(max_k, max(self._ids.size, 1)))
-            if exact or self._ids.size == 0:
-                nbr, sims = brute_force(self._emb, self._ids, queries,
-                                        max_k)
+        for q, k, exact in payloads:
+            if isinstance(q, np.ndarray) and q.dtype == np.float32:
+                # dim checked even for empty/zero-dim query matrices —
+                # a (n, 0) frame would otherwise raise inside the GEMM
+                if q.ndim != 2 or (eng.bundle.dim
+                                   and q.shape[1] != eng.bundle.dim):
+                    # a malformed request fails ALONE: raising here
+                    # would set the exception on every future coalesced
+                    # into this flush
+                    results.append(ValueError(
+                        f"knn_vec queries {q.shape} do not match "
+                        f"served dim {eng.bundle.dim}"))
+                    continue
+                queries = q
             else:
-                nbr, sims = self._get_index().search(queries, max_k)
-            at = 0
-            for i, (q, k, _) in group:
-                k = max(1, min(int(k), max_k))
-                results.append(
-                    (i, (nbr[at:at + q.size, :k].astype(np.uint64),
-                         sims[at:at + q.size, :k].astype(np.float32))))
-                at += q.size
-        results.sort(key=lambda t: t[0])
-        return [r for _, r in results]
+                rows, valid, n_unknown = eng.lookup_rows(q)
+                if n_unknown:
+                    self._ctr_unknown.inc(n_unknown)
+                queries = eng.emb[rows].copy() if eng.ids.size else \
+                    np.zeros((q.size, eng.bundle.dim), np.float32)
+                queries[~valid] = 0.0
+            k_eff = max(1, min(int(k), max(eng.ids.size, 1)))
+            if exact or eng.ids.size == 0:
+                nbr, sims = brute_force(eng.emb, eng.ids, queries, k_eff)
+            else:
+                nbr, sims = eng.get_index().search(queries, k_eff)
+            results.append((nbr.astype(np.uint64),
+                            sims.astype(np.float32)))
+        return results
 
     def _run_score(self, payloads: List[Tuple[np.ndarray, np.ndarray]]
                    ) -> List[np.ndarray]:
         import jax.numpy as jnp
 
-        self._maybe_inject()
+        eng = self._engine
+        self._maybe_inject(eng, scan=False)
         src = np.concatenate([p[0] for p in payloads]) if payloads \
             else np.zeros(0, np.uint64)
         dst = np.concatenate([p[1] for p in payloads]) if payloads \
             else np.zeros(0, np.uint64)
-        a_rows, a_ok = self._lookup_rows(src)
-        b_rows, b_ok = self._lookup_rows(dst)
+        a_rows, a_ok, a_unk = eng.lookup_rows(src)
+        b_rows, b_ok, b_unk = eng.lookup_rows(dst)
+        if a_unk or b_unk:
+            self._ctr_unknown.inc(a_unk + b_unk)
         if src.size:
             out = run_bucketed(
                 lambda a, b: np.asarray(
-                    self._jit_score(jnp.asarray(a), jnp.asarray(b))),
+                    eng.jit_score(jnp.asarray(a), jnp.asarray(b))),
                 [a_rows, b_rows], self.ladder)
             # copy=True: jax device buffers surface as read-only numpy
             out = np.array(out, dtype=np.float32)
@@ -291,17 +455,14 @@ class InferenceServer:
             at += p[0].size
         return results
 
-    def _get_index(self):
-        with self._index_mu:
-            if self._index is None:
-                self._index = self.bundle.build_index()
-            return self._index
-
     def jit_cache_sizes(self) -> Dict[str, int]:
-        """Compiled-variant counts of the jitted applies (steady-state
-        no-recompile assertions): stays <= len(ladder) per fn."""
-        return {"gather": int(self._jit_gather._cache_size()),
-                "score": int(self._jit_score._cache_size())}
+        """Compiled-variant counts of the SERVING engine's jitted
+        applies (steady-state no-recompile assertions): stays <=
+        len(ladder) per fn — including right after a hot-swap, whose
+        engine was warmed before the flip."""
+        eng = self._engine
+        return {"gather": int(eng.jit_gather._cache_size()),
+                "score": int(eng.jit_score._cache_size())}
 
     # -- network -----------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -357,8 +518,9 @@ class InferenceServer:
 
     def _dispatch(self, msg_type: int, body: bytes) -> bytes:
         verb = {wire.MSG_EMBED: "embed", wire.MSG_KNN: "knn",
-                wire.MSG_SCORE: "score", wire.MSG_HEALTH: "health",
-                wire.MSG_INFO: "info"}.get(msg_type)
+                wire.MSG_KNN_VEC: "knn_vec", wire.MSG_SCORE: "score",
+                wire.MSG_HEALTH: "health", wire.MSG_INFO: "info",
+                wire.MSG_SWAP: "swap"}.get(msg_type)
         if verb is None:
             raise ValueError(f"unknown serving msg_type {msg_type}")
         self._ctr_requests.labels(verb=verb, **self._lab).inc()
@@ -368,11 +530,22 @@ class InferenceServer:
                 return struct.pack("<I", wire.STATUS_OK) + \
                     wire.pack_str(json.dumps(self.health()))
             if msg_type == wire.MSG_INFO:
-                info = {"service": self.service, "replica": self.replica,
-                        "dim": self.bundle.dim, "count": self.bundle.count,
-                        "model_spec": self.bundle.model_spec}
+                eng = self._engine
+                lo, hi = eng.id_range()
+                info = {"service": self.service, "shard": eng.shard,
+                        "num_shards": eng.num_shards,
+                        "replica": self.replica,
+                        "bundle_version": eng.version,
+                        "id_lo": lo, "id_hi": hi,
+                        "dim": eng.bundle.dim, "count": eng.bundle.count,
+                        "model_spec": eng.bundle.model_spec}
                 return struct.pack("<I", wire.STATUS_OK) + \
                     wire.pack_str(json.dumps(info))
+            if msg_type == wire.MSG_SWAP:
+                r = wire.Reader(body)
+                out = self.swap(r.str_())
+                return struct.pack("<I", wire.STATUS_OK) + \
+                    wire.pack_str(json.dumps(out))
             r = wire.Reader(body)
             deadline_ms = r.u32()
             timeout = (deadline_ms / 1000.0) if deadline_ms \
@@ -385,13 +558,20 @@ class InferenceServer:
                 return (struct.pack("<III", wire.STATUS_OK, n,
                                     emb.shape[1] if emb.ndim == 2 else 0)
                         + np.ascontiguousarray(emb, np.float32).tobytes())
-            if msg_type == wire.MSG_KNN:
+            if msg_type in (wire.MSG_KNN, wire.MSG_KNN_VEC):
                 k = r.u32()
                 exact = bool(r.u8())
                 n = r.u32()
-                ids = r.array(np.uint64, n)
-                fut = self._batchers["knn"].submit((ids, k, exact), rows=n)
-                nbr, sims = self._wait(fut, timeout)
+                if msg_type == wire.MSG_KNN:
+                    q = r.array(np.uint64, n)
+                else:
+                    dim = r.u32()
+                    q = r.array(np.float32, n * dim).reshape(n, dim)
+                fut = self._batchers["knn"].submit((q, k, exact), rows=n)
+                res = self._wait(fut, timeout)
+                if isinstance(res, Exception):
+                    raise res  # per-request validation failure
+                nbr, sims = res
                 return (struct.pack("<III", wire.STATUS_OK, n,
                                     nbr.shape[1] if nbr.size else 0)
                         + np.ascontiguousarray(nbr, np.uint64).tobytes()
@@ -430,8 +610,9 @@ class InferenceServer:
     # -- introspection -----------------------------------------------------
     def health(self) -> Dict:
         """Counter surface (also served via obs /healthz): request /
-        shed / unknown-id / error totals, per-verb queue depths, bundle
-        identity."""
+        shed / unknown-id / error / swap totals, per-verb queue depths,
+        shard + bundle identity."""
+        eng = self._engine
         shed = 0
         queues = {}
         for verb, b in self._batchers.items():
@@ -440,16 +621,20 @@ class InferenceServer:
         reqs = {
             verb: int(self._ctr_requests.labels(
                 verb=verb, **self._lab).value)
-            for verb in ("embed", "knn", "score", "health", "info")}
+            for verb in ("embed", "knn", "knn_vec", "score", "health",
+                         "info", "swap")}
         return {
-            "service": self.service, "replica": self.replica,
-            "port": self.port, "requests": reqs,
+            "service": self.service, "shard": eng.shard,
+            "num_shards": eng.num_shards, "replica": self.replica,
+            "port": self.port, "bundle_version": eng.version,
+            "requests": reqs,
             "shed": shed + int(self._ctr_deadline.value),
             "deadline_shed": int(self._ctr_deadline.value),
             "unknown_ids": int(self._ctr_unknown.value),
             "errors": int(self._ctr_errors.value),
+            "swaps": int(self._ctr_swap.value),
             "queue_rows": queues,
-            "bundle": {"count": self.bundle.count, "dim": self.bundle.dim},
+            "bundle": {"count": eng.bundle.count, "dim": eng.bundle.dim},
         }
 
     # -- lifecycle ---------------------------------------------------------

@@ -17,10 +17,15 @@ The registry half speaks the RegistryServer protocol (kRegPut /
 kRegList / kRegRemove) and the shared-directory registry directly, so
 serving replicas register and clients discover through the SAME
 registry the graph shards use. Serving entries are named
-``serve_<service>_<replica>__<host>_<port>``; the C++ shard parser
-only accepts the ``shard_`` prefix, so serving entries are invisible
-to graph-shard discovery (and shard entries to serving discovery) by
-construction.
+``serve_<service>_<shard>_<replica>__<host>_<port>`` — index shards
+and replicas-per-shard are discoverable exactly like graph shards.
+The pre-fleet two-field form (``serve_<service>_<replica>__...``)
+still parses as shard 0, so a mixed-version fleet stays discoverable
+during a rollout (caveat: that back-compat form is ambiguous for
+service names ending in a numeric component; new entries always carry
+the explicit shard field). The C++ shard parser only accepts the
+``shard_`` prefix, so serving entries are invisible to graph-shard
+discovery (and shard entries to serving discovery) by construction.
 """
 
 from __future__ import annotations
@@ -35,10 +40,12 @@ import numpy as np
 
 __all__ = [
     "MAGIC", "HEADER", "MSG_EMBED", "MSG_KNN", "MSG_SCORE", "MSG_HEALTH",
-    "MSG_INFO", "STATUS_OK", "STATUS_SHED", "STATUS_ERROR", "WireError",
+    "MSG_INFO", "MSG_SWAP", "MSG_KNN_VEC", "STATUS_OK", "STATUS_SHED",
+    "STATUS_ERROR", "WireError",
     "read_frame", "write_frame", "pack_str", "Reader",
     "registry_put", "registry_remove", "registry_list",
     "serve_entry_name", "parse_serve_entry", "discover_replicas",
+    "discover_fleet",
 ]
 
 MAGIC = 0x52465445                     # b'ETFR' little-endian
@@ -50,6 +57,8 @@ MSG_KNN = 101
 MSG_SCORE = 102
 MSG_HEALTH = 103
 MSG_INFO = 104
+MSG_SWAP = 105                         # admin: hot-swap the served bundle
+MSG_KNN_VEC = 106                      # knn by query VECTORS (fleet fan-out)
 
 # registry verbs (rpc.cc MsgType)
 _REG_PUT = 3
@@ -244,41 +253,66 @@ def registry_list(spec: str) -> Dict[str, int]:
     return out
 
 
-def serve_entry_name(service: str, replica: int, host: str,
+def serve_entry_name(service: str, shard: int, replica: int, host: str,
                      port: int) -> str:
-    if "__" in service or "_" in str(replica):
+    if "__" in service:
         raise ValueError(f"service name must not contain '__': {service!r}")
-    return f"serve_{service}_{replica}__{host}_{port}"
+    return f"serve_{service}_{int(shard)}_{int(replica)}__{host}_{port}"
 
 
-def parse_serve_entry(name: str) -> Optional[Tuple[str, int, str, int]]:
-    """(service, replica, host, port), or None for foreign entries
-    (shard_ heartbeats share the namespace)."""
+def parse_serve_entry(name: str
+                      ) -> Optional[Tuple[str, int, int, str, int]]:
+    """(service, shard, replica, host, port), or None for foreign
+    entries (shard_ heartbeats share the namespace). The pre-fleet
+    two-field form parses as shard 0."""
     if not name.startswith("serve_"):
         return None
     left, sep, right = name.partition("__")
     if not sep:
         return None
-    svc_rep = left[len("serve_"):]
-    svc, _, rep = svc_rep.rpartition("_")
-    host, _, port = right.rpartition("_")
-    if not (svc and rep.isdigit() and host and port.lstrip("-").isdigit()):
+    parts = left[len("serve_"):].split("_")
+    if len(parts) >= 3 and parts[-1].isdigit() and parts[-2].isdigit():
+        svc = "_".join(parts[:-2])
+        shard, rep = int(parts[-2]), int(parts[-1])
+    elif len(parts) >= 2 and parts[-1].isdigit():
+        svc = "_".join(parts[:-1])
+        shard, rep = 0, int(parts[-1])
+    else:
         return None
-    return svc, int(rep), host, int(port)
+    host, _, port = right.rpartition("_")
+    if not (svc and host and port.lstrip("-").isdigit()):
+        return None
+    return svc, shard, rep, host, int(port)
 
 
-def discover_replicas(spec: str, service: str,
-                      max_age_ms: int = 0) -> List[Tuple[str, int, int]]:
-    """[(host, port, age_ms)] of the service's registered replicas,
-    sorted by replica index. max_age_ms > 0 drops stale entries
+def discover_fleet(spec: str, service: str, max_age_ms: int = 0
+                   ) -> Dict[int, List[Tuple[str, int, int]]]:
+    """{shard -> [(host, port, age_ms)] sorted by replica index} for
+    the service's registered fleet. max_age_ms > 0 drops stale entries
     (crashed replicas whose heartbeat stopped)."""
-    out = []
+    fleet: Dict[int, List[Tuple[int, str, int, int]]] = {}
     for name, age in registry_list(spec).items():
         parsed = parse_serve_entry(name)
         if parsed is None or parsed[0] != service:
             continue
         if max_age_ms > 0 and age > max_age_ms:
             continue
-        out.append((parsed[1], parsed[2], parsed[3], age))
-    out.sort()
-    return [(host, port, age) for _, host, port, age in out]
+        _, shard, rep, host, port = parsed
+        fleet.setdefault(shard, []).append((rep, host, port, age))
+    return {s: [(h, p, a) for _, h, p, a in sorted(v)]
+            for s, v in sorted(fleet.items())}
+
+
+def discover_replicas(spec: str, service: str, max_age_ms: int = 0,
+                      shard: Optional[int] = None
+                      ) -> List[Tuple[str, int, int]]:
+    """[(host, port, age_ms)] of the service's registered replicas,
+    sorted by (shard, replica) — or a single shard's replicas when
+    `shard` is given."""
+    fleet = discover_fleet(spec, service, max_age_ms=max_age_ms)
+    if shard is not None:
+        return fleet.get(shard, [])
+    out: List[Tuple[str, int, int]] = []
+    for s in sorted(fleet):
+        out.extend(fleet[s])
+    return out

@@ -273,11 +273,11 @@ def test_bucketed_shapes_never_recompile_in_steady_state():
 
 def test_serve_entries_coexist_with_shard_entries(tmp_path):
     spec = str(tmp_path / "reg")
-    wire.registry_put(spec, wire.serve_entry_name("recs", 0,
+    wire.registry_put(spec, wire.serve_entry_name("recs", 0, 0,
                                                   "127.0.0.1", 1234))
-    wire.registry_put(spec, wire.serve_entry_name("recs", 1,
+    wire.registry_put(spec, wire.serve_entry_name("recs", 0, 1,
                                                   "127.0.0.1", 1235))
-    wire.registry_put(spec, wire.serve_entry_name("other", 0,
+    wire.registry_put(spec, wire.serve_entry_name("other", 0, 0,
                                                   "127.0.0.1", 9))
     wire.registry_put(spec, "shard_0__127.0.0.1_9190")
     # serving discovery sees only its own service
@@ -290,7 +290,7 @@ def test_serve_entries_coexist_with_shard_entries(tmp_path):
     shards = scan_registry(spec)
     assert shards == {0: ("127.0.0.1", 9190, shards[0][2])}
     # remove drops the entry
-    wire.registry_remove(spec, wire.serve_entry_name("recs", 0,
+    wire.registry_remove(spec, wire.serve_entry_name("recs", 0, 0,
                                                      "127.0.0.1", 1234))
     assert len(wire.discover_replicas(spec, "recs")) == 1
     assert wire.parse_serve_entry("shard_0__127.0.0.1_9190") is None

@@ -1,0 +1,527 @@
+"""Sharded serving fleet (ISSUE 8 tentpole): partitioned kNN
+scatter-gather + zero-downtime versioned hot-swap.
+
+Covers, against REAL components (framed TCP, registry discovery):
+
+  * sharded bundle layout: save_sharded/load_shard/load roundtrip,
+    per-shard corruption isolation, contiguous bounds, versions;
+  * fleet registry entries (serve_<svc>_<shard>_<replica>__host_port)
+    incl. pre-fleet back-compat parsing;
+  * THE parity contract: fleet scatter-gather kNN byte-identical to a
+    single-index brute-force reference — unknown-id zero-vector tie
+    storms across shard boundaries included — plus embed id-range
+    routing (byte-identical, owner-only dispatch) and score
+    (same-shard exact, cross-shard fp-tolerance);
+  * zero-downtime hot-swap: vN+1 warmed beside vN mid-traffic, atomic
+    flip, every request ends with a status, no steady-state recompile
+    after the flip, serving_swap_total counted, shard identity
+    enforced;
+  * ServingClient conn-cache staleness: a departed replica's cached
+    socket is dropped at the next re-resolution, not kept until its
+    next transport error;
+  * estimator-level export_bundle(shards=N) — the sharded layout holds
+    exactly the unsharded export's rows;
+  * chaos (slow): rolling kill/restart of a 2x2 fleet onto the vN+1
+    bundle mid-traffic — failovers >= 1, zero lost-without-status,
+    served version converges.
+
+Everything but the rolling-restart chaos test stays tier-1
+(serving_fleet marker).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.serving import (
+    BundleCorruptionError,
+    InferenceServer,
+    ModelBundle,
+    ServingClient,
+    bundle_shard_count,
+    shard_bounds,
+)
+from euler_tpu.serving import wire
+from euler_tpu.tools.knn import brute_force
+
+pytestmark = [pytest.mark.serving, pytest.mark.serving_fleet]
+
+
+def _arrays(n=900, d=12, seed=0):
+    rng = np.random.default_rng(seed)
+    emb = rng.normal(size=(n, d)).astype(np.float32)
+    ids = (np.arange(n, dtype=np.uint64) * 3 + 5)  # non-contiguous ids
+    return emb, ids
+
+
+def _ref_knn(emb, ids, qids, k):
+    """The single-index comparator: resolve queries exactly like the
+    monolith server (unknown -> zero vector), brute force the full
+    corpus."""
+    rows = np.searchsorted(ids, qids).clip(0, len(ids) - 1)
+    valid = ids[rows] == qids
+    qv = emb[rows].copy()
+    qv[~valid] = 0.0
+    return brute_force(emb, ids, qv, k), (rows, valid)
+
+
+# ---------------------------------------------------------------------------
+# Sharded bundle layout
+# ---------------------------------------------------------------------------
+
+def test_sharded_bundle_roundtrip_and_shard_isolation(tmp_path):
+    emb, ids = _arrays()
+    b = ModelBundle({"w": np.arange(4, dtype=np.float32)},
+                    emb, ids, meta={"bundle_version": "v7"})
+    out = b.save_sharded(str(tmp_path / "b"), shards=4, nlist=4)
+    assert bundle_shard_count(out) == 4
+    # whole-bundle reassembly == the original (contiguous sorted shards)
+    full = ModelBundle.load(out)
+    assert np.array_equal(full.embeddings, emb)
+    assert np.array_equal(full.ids, ids)
+    assert full.version == "v7"
+    # per-shard loads carry identity + exactly their contiguous rows
+    bounds = shard_bounds(len(ids), 4)
+    assert bounds[0][0] == 0 and bounds[-1][1] == len(ids)
+    assert all(hi == nxt_lo for (_, hi), (nxt_lo, _)
+               in zip(bounds, bounds[1:]))
+    for s, (lo, hi) in enumerate(bounds):
+        part = ModelBundle.load_shard(out, s)
+        assert (part.shard, part.num_shards) == (s, 4)
+        assert np.array_equal(part.ids, ids[lo:hi])
+        assert np.array_equal(part.embeddings, emb[lo:hi])
+        assert part.index_state is not None  # per-shard IVF state
+        assert part.version == "v7"
+    # corruption in shard 2 blocks ONLY shard 2
+    path = tmp_path / "b" / "embeddings.2.npy"
+    raw = bytearray(path.read_bytes())
+    raw[-1] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    with pytest.raises(BundleCorruptionError, match="sha256|size"):
+        ModelBundle.load_shard(out, 2)
+    ModelBundle.load_shard(out, 1)          # unaffected shard serves
+    with pytest.raises(BundleCorruptionError):
+        ModelBundle.load(out)               # whole-bundle load refuses
+    # contract edges
+    with pytest.raises(ValueError, match="cannot cut"):
+        ModelBundle({}, emb[:3], ids[:3]).save_sharded(
+            str(tmp_path / "tiny"), shards=8)
+    with pytest.raises(BundleCorruptionError, match="not a sharded"):
+        ModelBundle.load_shard(
+            ModelBundle({}, emb, ids).save(str(tmp_path / "plain")), 0)
+
+
+def test_fleet_entry_name_roundtrip_and_backcompat(tmp_path):
+    name = wire.serve_entry_name("recs", 2, 1, "10.0.0.7", 9001)
+    assert name == "serve_recs_2_1__10.0.0.7_9001"
+    assert wire.parse_serve_entry(name) == ("recs", 2, 1, "10.0.0.7",
+                                            9001)
+    # pre-fleet two-field entries parse as shard 0
+    assert wire.parse_serve_entry("serve_recs_1__127.0.0.1_5") == \
+        ("recs", 0, 1, "127.0.0.1", 5)
+    # fleet discovery groups by shard, sorted by replica
+    spec = str(tmp_path / "reg")
+    for shard, rep, port in [(1, 0, 11), (0, 1, 12), (0, 0, 13),
+                             (1, 1, 14)]:
+        wire.registry_put(spec, wire.serve_entry_name(
+            "f", shard, rep, "127.0.0.1", port))
+    fleet = wire.discover_fleet(spec, "f")
+    assert sorted(fleet) == [0, 1]
+    assert [p for _, p, _ in fleet[0]] == [13, 12]
+    assert [p for _, p, _ in fleet[1]] == [11, 14]
+    # flat view orders by (shard, replica); shard pin filters
+    flat = wire.discover_replicas(spec, "f")
+    assert [p for _, p, _ in flat] == [13, 12, 11, 14]
+    assert [p for _, p, _ in wire.discover_replicas(spec, "f", shard=1)] \
+        == [11, 14]
+
+
+# ---------------------------------------------------------------------------
+# Scatter-gather parity (THE fleet acceptance contract)
+# ---------------------------------------------------------------------------
+
+def test_fleet_scatter_gather_parity_byte_identical(tmp_path):
+    """3-shard fleet vs single-index reference: kNN merged top-k is
+    byte-identical (ids AND sims) — including unknown ids, whose
+    zero-vector queries tie every row at 0.0 so the merge's tie-break
+    must reproduce the reference's row order across shard boundaries —
+    embed routes by id range and is byte-identical, score matches
+    same-shard exactly and cross-shard to fp tolerance."""
+    emb, ids = _arrays(n=300, d=8, seed=3)
+    out = ModelBundle({}, emb, ids).save_sharded(str(tmp_path / "b"),
+                                                 shards=3, nlist=4)
+    spec = str(tmp_path / "reg")
+    srvs = [InferenceServer(out, registry=spec, service="par", shard=s,
+                            replica=0, max_batch=16)
+            for s in range(3)]
+    try:
+        with ServingClient(registry=spec, service="par") as cli:
+            assert cli.shards() == [0, 1, 2]
+            # queries: interior ids of every shard, boundary rows, and
+            # unknown ids (one below all ranges, one between strides,
+            # one past the last id)
+            bounds = shard_bounds(len(ids), 3)
+            qrows = [0, 5, bounds[1][0] - 1, bounds[1][0],
+                     bounds[2][0], len(ids) - 1]
+            qids = np.concatenate([
+                ids[qrows],
+                np.array([1, ids[7] + 1, int(ids[-1]) + 999],
+                         np.uint64)])
+            (want_nbr, want_sims), (rows, valid) = _ref_knn(
+                emb, ids, qids, 7)
+            got_nbr, got_sims = cli.knn(qids, k=7)
+            assert np.array_equal(got_nbr, want_nbr)
+            assert np.array_equal(got_sims, want_sims)
+
+            # embed: byte-identical, and dispatched ONLY to owners
+            before = {s.shard: s.health()["requests"]["embed"]
+                      for s in srvs}
+            one_shard = ids[[bounds[1][0], bounds[1][0] + 2]]
+            got = cli.embed(one_shard)
+            assert np.array_equal(got,
+                                  emb[np.searchsorted(ids, one_shard)])
+            after = {s.shard: s.health()["requests"]["embed"]
+                     for s in srvs}
+            assert after[1] == before[1] + 1          # owner hit
+            assert after[0] == before[0]              # others not
+            assert after[2] == before[2]
+
+            we = emb[rows].copy()
+            we[~valid] = 0.0
+            assert np.array_equal(cli.embed(qids), we)
+
+            # score: same-shard pairs exact, cross-shard close
+            sc = cli.score(qids, qids[::-1].copy())
+            np.testing.assert_allclose(
+                sc, np.einsum("ij,ij->i", we, we[::-1]), rtol=1e-5)
+
+            # approximate path merges without error (no bitwise claim)
+            a_nbr, a_sims = cli.knn(qids[:4], k=5, exact=False)
+            assert a_nbr.shape == (4, 5) and np.isfinite(a_sims).all()
+
+            h = cli.health()
+            assert h["fanout"]["queries"] >= 3
+            assert h["fanout"]["merges"] >= 2
+            assert h["shards"] == 3
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Zero-downtime hot-swap
+# ---------------------------------------------------------------------------
+
+def test_hot_swap_zero_downtime_mid_traffic(tmp_path):
+    """Swap v1 -> v2 under live traffic: every request ends with a
+    status, the version flips atomically, the new engine was warmed
+    BEFORE the flip (no steady-state recompile afterwards), and
+    serving_swap_total counts it."""
+    emb, ids = _arrays(n=200, d=8, seed=1)
+    rng = np.random.default_rng(9)
+    emb2 = rng.normal(size=emb.shape).astype(np.float32)
+    d1 = ModelBundle({}, emb, ids,
+                     meta={"bundle_version": "v1"}).save(
+        str(tmp_path / "v1"))
+    d2 = ModelBundle({}, emb2, ids,
+                     meta={"bundle_version": "v2"}).save(
+        str(tmp_path / "v2"))
+    spec = str(tmp_path / "reg")
+    counts = {"ok": 0, "err": 0, "attempts": 0}
+    stop = threading.Event()
+    mu = threading.Lock()
+
+    with InferenceServer(d1, registry=spec, service="swp", shard=0,
+                         replica=0, max_batch=16) as srv, \
+            ServingClient(registry=spec, service="swp") as cli:
+        assert srv.bundle_version == "v1"
+        assert cli.info()["bundle_version"] == "v1"
+
+        def traffic():
+            while not stop.is_set():
+                with mu:
+                    counts["attempts"] += 1
+                try:
+                    cli.knn(ids[:4], k=3)
+                    with mu:
+                        counts["ok"] += 1
+                except Exception:
+                    with mu:        # still a status: counted, not lost
+                        counts["err"] += 1
+                time.sleep(0.002)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        reply = cli.swap_fleet(d2)
+        time.sleep(0.2)
+        stop.set()
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        # zero lost-without-status: every attempt got an outcome
+        assert counts["attempts"] == counts["ok"] + counts["err"]
+        assert counts["ok"] >= 10
+        [(ep, out)] = list(reply.items())
+        assert out["bundle_version"] == "v2"
+        assert out["previous_version"] == "v1"
+        assert srv.bundle_version == "v2"
+        assert srv.health()["swaps"] == 1
+        assert cli.info()["bundle_version"] == "v2"
+        # post-swap answers come from v2, steady state never recompiles
+        warm = srv.jit_cache_sizes()
+        (want_nbr, want_sims), _ = _ref_knn(emb2, ids, ids[:5], 4)
+        got_nbr, got_sims = cli.knn(ids[:5], k=4)
+        assert np.array_equal(got_nbr, want_nbr)
+        assert np.array_equal(got_sims, want_sims)
+        for n_q in (1, 3, 9):
+            cli.embed(ids[:n_q])
+            cli.score(ids[:n_q], ids[:n_q])
+        assert srv.jit_cache_sizes() == warm, "recompiled after swap"
+        # shard identity is enforced: a sharded bundle can't replace an
+        # unsharded one (explicit ERROR on the wire -> client raises)
+        sharded = ModelBundle({}, emb, ids).save_sharded(
+            str(tmp_path / "sh"), shards=2)
+        with pytest.raises(Exception, match="shard"):
+            cli.swap_fleet(sharded)
+
+
+# ---------------------------------------------------------------------------
+# Client conn-cache staleness (satellite fix)
+# ---------------------------------------------------------------------------
+
+def test_client_drops_stale_conns_on_rediscovery(tmp_path):
+    emb, ids = _arrays(n=60, d=4)
+    d = ModelBundle({}, emb, ids).save(str(tmp_path / "b"))
+    spec = str(tmp_path / "reg")
+    s0 = InferenceServer(d, registry=spec, service="st", shard=0,
+                         replica=0, max_batch=8)
+    s1 = InferenceServer(d, registry=spec, service="st", shard=0,
+                         replica=1, max_batch=8)
+    cli = ServingClient(registry=spec, service="st")
+    # round-robin both replicas -> both endpoints cached on this thread
+    cli.embed(ids[:2])
+    cli.embed(ids[:2])
+    eps = {("127.0.0.1", s0.port), ("127.0.0.1", s1.port)}
+    assert set(cli._local.conns) == eps
+    # replica 1 leaves (clean stop deregisters); re-resolution must
+    # drop its cached socket at the NEXT call, not on a later error
+    gone = ("127.0.0.1", s1.port)
+    s1.stop()
+    cli._rediscover()
+    assert cli.replicas() == [("127.0.0.1", s0.port)]
+    cli.embed(ids[:2])
+    assert gone not in cli._local.conns
+    assert cli.health()["stale_conns_dropped"] >= 1
+    cli.close()
+    s0.stop()
+
+
+def test_fleet_incomplete_refuses_partial_scatter_gather(tmp_path):
+    """When EVERY replica of a shard leaves the registry, fleet verbs
+    raise an explicit error instead of quietly fanning out to the
+    survivors: a partial merge would return a top-k missing that
+    shard's corpus slice (and zero-filled embeds for ids the fleet
+    does hold) with STATUS_OK — confidently wrong, not degraded."""
+    from euler_tpu.graph.remote import RetryPolicy
+
+    emb, ids = _arrays(n=200, d=8, seed=5)
+    out = ModelBundle({}, emb, ids).save_sharded(str(tmp_path / "b"),
+                                                 shards=2, nlist=4)
+    spec = str(tmp_path / "reg")
+    srvs = [InferenceServer(out, registry=spec, service="gap", shard=s,
+                            replica=0, max_batch=16) for s in range(2)]
+    try:
+        with ServingClient(
+                registry=spec, service="gap",
+                retry_policy=RetryPolicy(deadline_s=3.0,
+                                         call_timeout_s=1.0)) as cli:
+            cli.knn(ids[:4], k=3)       # pins the fleet width (2)
+            srvs[1].stop()              # shard 1 deregisters entirely
+            cli._rediscover()           # client now sees only shard 0
+            assert cli.shards() == [0]
+            with pytest.raises(wire.WireError,
+                               match="fleet incomplete"):
+                cli.knn(ids[:4], k=3)
+            with pytest.raises(wire.WireError,
+                               match="fleet incomplete"):
+                cli.embed(ids[:4])
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+def test_sharded_manifest_missing_params_is_corruption(tmp_path):
+    """A sharded manifest that lost its params entry (and file) must
+    refuse with BundleCorruptionError like every other corruption —
+    not escape as FileNotFoundError past refuse-to-serve handlers."""
+    import json as _json
+    import os
+
+    emb, ids = _arrays(n=60, d=4)
+    out = ModelBundle({"w": np.ones(2, np.float32)}, emb,
+                      ids).save_sharded(str(tmp_path / "b"), shards=2,
+                                        nlist=4)
+    man_path = tmp_path / "b" / "manifest.json"
+    man = _json.loads(man_path.read_text())
+    man["files"].pop("params.npz")
+    man_path.write_text(_json.dumps(man))
+    os.remove(tmp_path / "b" / "params.npz")
+    with pytest.raises(BundleCorruptionError, match="params"):
+        ModelBundle.load(out)
+    with pytest.raises(BundleCorruptionError, match="params"):
+        ModelBundle.load_shard(out, 0)
+
+
+# ---------------------------------------------------------------------------
+# Estimator-level sharded export
+# ---------------------------------------------------------------------------
+
+def test_export_bundle_sharded_from_estimator(tmp_path):
+    """export_bundle(shards=2, version=...) writes the fleet layout
+    holding exactly the rows the unsharded export holds, with the
+    version stamped for the swap protocol."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from euler_tpu.estimator.base_estimator import BaseEstimator
+    from euler_tpu.mp_utils.base import ModelOutput
+
+    class TinyEmb(nn.Module):
+        n: int
+        dim: int
+
+        @nn.compact
+        def __call__(self, batch):
+            v = nn.Embed(self.n, self.dim, name="emb")(batch["rows"])
+            loss = jnp.mean((v - batch["target"]) ** 2)
+            return ModelOutput(v, loss, "mse", loss)
+
+    n, dim, B = 48, 8, 16
+    ids = (np.arange(n, dtype=np.uint64) * 2 + 3)
+    rng = np.random.default_rng(1)
+    targets = rng.normal(size=(n, dim)).astype(np.float32)
+
+    def sweep():
+        for i in range(0, n, B):
+            rows = np.arange(i, min(i + B, n))
+            if len(rows) < B:
+                rows = np.concatenate(
+                    [rows, np.full(B - len(rows), rows[-1])])
+            yield {"rows": rows.astype(np.int32),
+                   "target": targets[rows], "infer_ids": ids[rows]}
+
+    est = BaseEstimator(TinyEmb(n=n, dim=dim),
+                        {"log_steps": 1000, "checkpoint_steps": 0})
+
+    def train():
+        while True:
+            rows = rng.integers(0, n, B)
+            yield {"rows": rows.astype(np.int32),
+                   "target": targets[rows]}
+
+    est.train(train(), max_steps=2)
+    plain = est.export_bundle(str(tmp_path / "plain"),
+                              input_fn=sweep, nlist=4)
+    sharded_dir = str(tmp_path / "sharded")
+    est.export_bundle(sharded_dir, input_fn=sweep, nlist=4,
+                      shards=2, version="r2")
+    assert bundle_shard_count(sharded_dir) == 2
+    full = ModelBundle.load(sharded_dir)
+    assert np.array_equal(full.ids, plain.ids)
+    assert np.array_equal(full.embeddings, plain.embeddings)
+    assert full.version == "r2"
+    assert set(full.params) == set(plain.params)
+    # a shard serves through the real server path
+    with InferenceServer(sharded_dir, service="est", shard=1,
+                         max_batch=16) as srv:
+        assert srv.bundle.count == full.count - len(
+            ModelBundle.load_shard(sharded_dir, 0).ids)
+        assert srv.bundle_version == "r2"
+
+
+# ---------------------------------------------------------------------------
+# Chaos: rolling restart of the fleet onto vN+1 (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_rolling_restart_convergence_chaos(tmp_path):
+    """Kill/restart the replicas of a 2-shard x 2-replica fleet one at
+    a time mid-traffic, each restart loading the vN+1 bundle (the
+    restart-based rollout): failovers >= 1, ZERO lost-without-status,
+    and the served version converges to vN+1."""
+    from euler_tpu.graph.remote import RetryPolicy
+
+    emb, ids = _arrays(n=240, d=8, seed=2)
+    rng = np.random.default_rng(5)
+    emb2 = rng.normal(size=emb.shape).astype(np.float32)
+    v1 = ModelBundle({}, emb, ids,
+                     meta={"bundle_version": "v1"}).save_sharded(
+        str(tmp_path / "v1"), shards=2, nlist=4)
+    v2 = ModelBundle({}, emb2, ids,
+                     meta={"bundle_version": "v2"}).save_sharded(
+        str(tmp_path / "v2"), shards=2, nlist=4)
+    spec = str(tmp_path / "reg")
+    fleet = {}
+    for s in range(2):
+        for r in range(2):
+            fleet[(s, r)] = InferenceServer(
+                v1, registry=spec, service="roll", shard=s, replica=r,
+                max_batch=16)
+    cli = ServingClient(registry=spec, service="roll",
+                        retry_policy=RetryPolicy(deadline_s=8.0,
+                                                 base_backoff_s=0.02,
+                                                 call_timeout_s=2.0))
+    counts = {"ok": 0, "err": 0, "attempts": 0}
+    stop = threading.Event()
+    mu = threading.Lock()
+
+    def traffic():
+        r = np.random.default_rng(11)
+        while not stop.is_set():
+            q = ids[r.integers(0, len(ids), 4)]
+            with mu:
+                counts["attempts"] += 1
+            try:
+                cli.knn(q, k=3)
+                with mu:
+                    counts["ok"] += 1
+            except Exception:
+                with mu:            # explicit status, not lost
+                    counts["err"] += 1
+            time.sleep(0.005)
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    try:
+        time.sleep(0.4)
+        for key in list(fleet):
+            s, r = key
+            port = fleet[key].port
+            fleet[key].stop()                    # kill mid-traffic
+            time.sleep(0.4)
+            fleet[key] = InferenceServer(        # restart on vN+1
+                v2, host="127.0.0.1", port=port, registry=spec,
+                service="roll", shard=s, replica=r, max_batch=16)
+            time.sleep(0.4)
+    finally:
+        stop.set()
+        t.join(timeout=15.0)
+    assert not t.is_alive()
+    h = cli.health()
+    # zero lost-without-status: every attempt resolved to an outcome
+    assert counts["attempts"] == counts["ok"] + counts["err"], counts
+    assert counts["ok"] >= 20, counts
+    assert h["failovers"] + h["retries"] >= 1, h
+    # the fleet converged to vN+1 and answers from it
+    versions = {i["bundle_version"] for i in cli.fleet_info().values()}
+    assert versions == {"v2"}
+    (want_nbr, want_sims), _ = _ref_knn(emb2, ids, ids[:4], 5)
+    got_nbr, got_sims = cli.knn(ids[:4], k=5)
+    assert np.array_equal(got_nbr, want_nbr)
+    assert np.array_equal(got_sims, want_sims)
+    cli.close()
+    for srv in fleet.values():
+        srv.stop()

@@ -1,4 +1,5 @@
-"""Serving latency benchmark: micro-batching window vs offered load.
+"""Serving latency benchmark: micro-batching window vs offered load,
+plus the sharded-fleet scatter-gather A/B and rolling hot-swap drill.
 
 Closed-loop A/B over the real InferenceServer + ServingClient stack
 (framed TCP, per-thread clients): each leg starts a fresh replica with
@@ -16,12 +17,29 @@ dispatch / downstream RTT would charge), which batching amortizes
 across coalesced requests — the honest A/B. With --inject_ms 0 the
 numbers measure pure stack overhead instead.
 
+**Fleet mode** (--shards K): the kNN scatter-gather A/B — one replica
+serving the whole corpus vs K shard replicas searched concurrently.
+The injected cost here is --scan_ms_per_krow, a per-flush latency
+PROPORTIONAL to the served corpus (a brute-force scan costs time
+linear in rows — the cost partitioning divides: each shard pays ~1/K).
+After the throughput legs, a rolling hot-swap drill promotes a v2
+bundle across the live fleet mid-traffic and asserts the zero-downtime
+contract: every request ends with a status, serving_swap_total ==
+replica count, served version converges.
+
+Every recorded entry carries an **SLO verdict block** — p99 latency /
+shed rate / lost-without-status counted against stated gates
+(--slo_p99_ms, --slo_shed_rate) with an explicit pass/fail — the
+diffable acceptance slice the closed-loop harness (ROADMAP item 5)
+gates on.
+
 Each leg prints one JSON line; the summary merges into perf.json
 (tools/collect_results.py renders RESULTS.md). `serve_smoke()` is the
 `bench.py --serve` lever: one tiny baseline-vs-batched pair.
 
   python tools/bench_serve.py                    # default sweep
   python tools/bench_serve.py --inject_ms 10 --threads 1,8,32
+  python tools/bench_serve.py --shards 4 --scan_ms_per_krow 1
 """
 
 from __future__ import annotations
@@ -50,13 +68,34 @@ def record(entry: dict) -> None:
     PERF_JSON.write_text(json.dumps(perf, indent=1, sort_keys=True))
 
 
-def make_bundle(out_dir: str, nodes: int, dim: int, seed: int = 0) -> str:
+def make_bundle(out_dir: str, nodes: int, dim: int, seed: int = 0,
+                shards: int = 1, version: str = "v1") -> str:
     from euler_tpu.serving import ModelBundle
 
     rng = np.random.default_rng(seed)
     emb = rng.normal(size=(nodes, dim)).astype(np.float32)
     ids = np.arange(nodes, dtype=np.uint64)
-    return ModelBundle({}, emb, ids).save(out_dir)
+    b = ModelBundle({}, emb, ids, meta={"bundle_version": version})
+    return b.save_sharded(out_dir, shards) if shards > 1 \
+        else b.save(out_dir)
+
+
+def slo_verdict(p99_ms, reqs: int, shed: int, lost: int,
+                p99_gate_ms: float, shed_rate_gate: float) -> dict:
+    """The diffable acceptance block: measured p99 / shed rate /
+    lost-without-status vs the stated gates, with an explicit verdict.
+    lost-without-status gates at ZERO always — a request with no status
+    is a contract violation, not a tunable."""
+    shed_rate = round(shed / max(reqs + shed, 1), 4)
+    checks = {
+        "p99_ms": {"value": p99_ms, "gate": p99_gate_ms,
+                   "ok": p99_ms is not None and p99_ms <= p99_gate_ms},
+        "shed_rate": {"value": shed_rate, "gate": shed_rate_gate,
+                      "ok": shed_rate <= shed_rate_gate},
+        "lost_without_status": {"value": lost, "gate": 0,
+                                "ok": lost == 0},
+    }
+    return {**checks, "pass": all(c["ok"] for c in checks.values())}
 
 
 _LEG_IDS = [0]
@@ -126,6 +165,9 @@ def run_leg(bundle_dir: str, *, threads: int, reqs_per_thread: int,
         "threads": threads,
         "requests": len(lats),
         "errors": errors[0],
+        # a request with no status would show up here — the contract
+        # is that this is always 0
+        "lost": threads * reqs_per_thread - len(lats) - errors[0],
         "p50_ms": pct(0.50),
         "p99_ms": pct(0.99),
         "reqs_per_s": round(len(lats) / max(wall, 1e-9), 1),
@@ -153,6 +195,162 @@ def serve_smoke(inject_ms: float = 5.0) -> dict:
     }
 
 
+def _drive_fleet(registry: str, service: str, *, threads: int,
+                 reqs_per_thread: int, ids_per_req: int, k: int,
+                 n_ids: int) -> dict:
+    """Closed-loop kNN load through registry-discovered fleet clients
+    (scatter-gather engages automatically on multi-shard services)."""
+    from euler_tpu.graph.remote import RetryPolicy
+    from euler_tpu.serving import ServerOverloaded, ServingClient
+
+    pol = RetryPolicy(deadline_s=30.0, call_timeout_s=20.0)
+    clients = [ServingClient(registry=registry, service=service,
+                             retry_policy=pol) for _ in range(threads)]
+    lat_mu = threading.Lock()
+    lats: list = []
+    errors = [0]
+    sheds = [0]
+
+    def worker(widx: int):
+        cli = clients[widx]
+        rng = np.random.default_rng(widx)
+        for _ in range(reqs_per_thread):
+            q = rng.integers(0, n_ids, ids_per_req).astype(np.uint64)
+            t0 = time.monotonic()
+            try:
+                cli.knn(q, k=k)
+                dt = time.monotonic() - t0
+                with lat_mu:
+                    lats.append(dt)
+            except ServerOverloaded:
+                with lat_mu:  # explicit shed status — gate separately
+                    sheds[0] += 1
+            except Exception:
+                with lat_mu:
+                    errors[0] += 1
+
+    ts = [threading.Thread(target=worker, args=(i,))
+          for i in range(threads)]
+    t_wall = time.monotonic()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.monotonic() - t_wall
+    for c in clients:
+        c.close()
+    lats.sort()
+
+    def pct(p):
+        return round(lats[min(int(len(lats) * p), len(lats) - 1)] * 1000,
+                     3) if lats else None
+
+    return {
+        "threads": threads, "requests": len(lats), "errors": errors[0],
+        "shed": sheds[0],
+        "lost": (threads * reqs_per_thread - len(lats) - errors[0]
+                 - sheds[0]),
+        "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+        "reqs_per_s": round(len(lats) / max(wall, 1e-9), 1),
+    }
+
+
+def run_fleet(args) -> dict:
+    """The sharded-fleet A/B + rolling hot-swap drill (see module
+    docstring): single replica over the whole corpus vs a K-shard
+    fleet, both under --scan_ms_per_krow corpus-proportional injected
+    scan cost; then a mid-traffic rolling swap_fleet to a v2 bundle."""
+    from euler_tpu.serving import InferenceServer, ServingClient
+
+    out: dict = {"shards": args.shards,
+                 "scan_ms_per_krow": args.scan_ms_per_krow}
+    with tempfile.TemporaryDirectory() as td:
+        td = Path(td)
+        full = make_bundle(str(td / "full"), args.nodes, args.dim,
+                           args.seed, shards=1, version="v1")
+        sharded = make_bundle(str(td / "v1"), args.nodes, args.dim,
+                              args.seed, shards=args.shards,
+                              version="v1")
+        reg = str(td / "reg")
+        common = dict(threads=max(int(v) for v in
+                                  args.threads.split(",") if v),
+                      reqs_per_thread=args.reqs,
+                      ids_per_req=args.q, k=args.k, n_ids=args.nodes)
+        windows = [float(v) for v in args.flush.split(",")
+                   if v and float(v) > 0]
+        srv_kw = dict(registry=reg, max_batch=args.max_batch,
+                      flush_ms=min(windows) if windows else 2.0,
+                      inject_scan_ms_per_krow=args.scan_ms_per_krow)
+
+        single = InferenceServer(full, service="bsingle", shard=0,
+                                 replica=0, **srv_kw)
+        out["single"] = _drive_fleet(reg, "bsingle", **common)
+        single.stop()
+
+        fleet = [InferenceServer(sharded, service="bfleet", shard=s,
+                                 replica=0, **srv_kw)
+                 for s in range(args.shards)]
+        out["fleet"] = _drive_fleet(reg, "bfleet", **common)
+        out["throughput_x"] = round(
+            out["fleet"]["reqs_per_s"]
+            / max(out["single"]["reqs_per_s"], 1e-9), 2)
+
+        # -- rolling hot-swap drill, mid-traffic ---------------------------
+        make_bundle(str(td / "v2"), args.nodes, args.dim,
+                    args.seed + 1, shards=args.shards, version="v2")
+        from euler_tpu.graph.remote import RetryPolicy
+
+        cli = ServingClient(registry=reg, service="bfleet",
+                            retry_policy=RetryPolicy(deadline_s=30.0,
+                                                     call_timeout_s=20.0))
+        counts = {"ok": 0, "err": 0}
+        stop = threading.Event()
+        mu = threading.Lock()
+
+        def traffic():
+            rng = np.random.default_rng(99)
+            while not stop.is_set():
+                q = rng.integers(0, args.nodes, args.q).astype(np.uint64)
+                try:
+                    cli.knn(q, k=args.k)
+                    with mu:
+                        counts["ok"] += 1
+                except Exception:
+                    with mu:           # still a status: counted, not lost
+                        counts["err"] += 1
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        swapped = cli.swap_fleet(str(td / "v2"))
+        time.sleep(0.3)
+        stop.set()
+        t.join(timeout=30.0)
+        versions = sorted({v["bundle_version"] for v in swapped.values()})
+        swap_total = sum(s.health()["swaps"] for s in fleet)
+        served = sorted({i["bundle_version"]
+                         for i in cli.fleet_info().values()})
+        cli.close()
+        for s in fleet:
+            s.stop()
+        out["swap"] = {
+            "replicas": len(fleet),
+            "serving_swap_total": swap_total,
+            "swap_replies": versions,
+            "served_versions_after": served,
+            "traffic_ok": counts["ok"], "traffic_err": counts["err"],
+            "lost_without_status": int(t.is_alive()),
+            "converged": served == ["v2"]
+            and swap_total == len(fleet),
+        }
+    out["slo"] = slo_verdict(
+        out["fleet"]["p99_ms"], out["fleet"]["requests"],
+        out["fleet"]["shed"],
+        out["fleet"]["lost"] + out["swap"]["lost_without_status"],
+        args.slo_p99_ms, args.slo_shed_rate)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=20_000)
@@ -172,8 +370,36 @@ def main(argv=None) -> int:
     ap.add_argument("--inject_ms", type=float, default=5.0,
                     help="fixed per-flush latency injected in the "
                          "server apply (0 = raw loopback overhead)")
+    ap.add_argument("--shards", type=int, default=0,
+                    help="> 1 runs the sharded-fleet scatter-gather A/B "
+                         "+ rolling hot-swap drill instead of the "
+                         "batching sweep")
+    ap.add_argument("--scan_ms_per_krow", type=float, default=10.0,
+                    help="fleet mode: injected per-flush KNN latency "
+                         "per 1000 served corpus rows (the corpus-"
+                         "proportional scan cost sharding divides; "
+                         "large enough by default to dominate the "
+                         "2-CPU container's loopback overhead, per "
+                         "the PERF.md convention)")
+    ap.add_argument("--slo_p99_ms", type=float, default=500.0,
+                    help="SLO gate: p99 request latency")
+    ap.add_argument("--slo_shed_rate", type=float, default=0.05,
+                    help="SLO gate: shed fraction of offered requests")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
+
+    if args.shards > 1:
+        fleet = run_fleet(args)
+        record({
+            "bench": "serve_fleet",
+            "metric": "serving_fleet_knn_throughput_x",
+            "value": fleet["throughput_x"],
+            "unit": f"x vs single replica ({args.shards} shards, "
+                    f"scan {args.scan_ms_per_krow:g}ms/krow)",
+            "detail": fleet,
+        })
+        return 0 if fleet["slo"]["pass"] and fleet["swap"]["converged"] \
+            else 1
 
     threads = [int(v) for v in args.threads.split(",") if v]
     windows = [float(v) for v in args.flush.split(",") if v]
@@ -208,7 +434,11 @@ def main(argv=None) -> int:
         "unit": "x (p99, highest load)",
         "detail": {"rows": rows, "nodes": args.nodes, "dim": args.dim,
                    "verb": args.verb, "inject_ms": args.inject_ms,
-                   "best_mode": best["mode"]},
+                   "best_mode": best["mode"],
+                   "slo": slo_verdict(
+                       best["p99_ms"], best["requests"], best["shed"],
+                       sum(r["lost"] for r in rows),
+                       args.slo_p99_ms, args.slo_shed_rate)},
     })
     return 0
 
