@@ -27,6 +27,7 @@
 #include "ops.h"
 #include "rpc.h"
 #include "threadpool.h"
+#include "udf.h"
 
 namespace {
 
@@ -41,7 +42,10 @@ struct Registry {
   std::mutex mu;
   int64_t next = 1;
   std::unordered_map<int64_t, std::shared_ptr<et::GraphBuilder>> builders;
-  std::unordered_map<int64_t, std::shared_ptr<et::Graph>> graphs;
+  // handle → swappable snapshot holder: etg_apply_delta swaps a new
+  // immutable Graph in behind the same handle (streaming deltas), and
+  // every proxy bound to the handle observes the swap
+  std::unordered_map<int64_t, std::shared_ptr<et::GraphRef>> graphs;
 };
 
 Registry& Reg() {
@@ -50,8 +54,9 @@ Registry& Reg() {
 }
 
 // shared_ptr copies keep the object alive for the duration of a call even
-// if another thread concurrently etg_free()s the handle (the Graph itself
-// is immutable, so concurrent readers are safe by design).
+// if another thread concurrently etg_free()s the handle (each Graph
+// SNAPSHOT is immutable, so concurrent readers are safe by design; a
+// delta apply publishes a new snapshot instead of mutating).
 std::shared_ptr<et::GraphBuilder> GetBuilder(int64_t h) {
   auto& r = Reg();
   std::lock_guard<std::mutex> lk(r.mu);
@@ -59,11 +64,27 @@ std::shared_ptr<et::GraphBuilder> GetBuilder(int64_t h) {
   return it == r.builders.end() ? nullptr : it->second;
 }
 
-std::shared_ptr<et::Graph> GetGraph(int64_t h) {
+std::shared_ptr<et::GraphRef> GetGraphRef(int64_t h) {
   auto& r = Reg();
   std::lock_guard<std::mutex> lk(r.mu);
   auto it = r.graphs.find(h);
   return it == r.graphs.end() ? nullptr : it->second;
+}
+
+std::shared_ptr<et::Graph> GetGraph(int64_t h) {
+  auto ref = GetGraphRef(h);
+  // const_cast is sound: every capi call on a finalized graph is const
+  // (the builder API is the only mutating surface, and it has its own
+  // handle space) — the cast just spares 60 call sites a type change
+  return ref ? std::const_pointer_cast<et::Graph>(ref->get()) : nullptr;
+}
+
+int64_t RegisterGraph(std::shared_ptr<const et::Graph> g) {
+  auto& r = Reg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  int64_t h = r.next++;
+  r.graphs[h] = std::make_shared<et::GraphRef>(std::move(g));
+  return h;
 }
 
 }  // namespace
@@ -72,20 +93,14 @@ namespace et {
 namespace capi {
 // Shared with capi_query.cc: resolve a Python-held graph handle.
 std::shared_ptr<Graph> GraphFromHandle(int64_t h) { return GetGraph(h); }
+std::shared_ptr<GraphRef> GraphRefFromHandle(int64_t h) {
+  return GetGraphRef(h);
+}
 int FailWith(const std::string& msg) { return Fail(msg); }
 }  // namespace capi
 }  // namespace et
 
 extern "C" {
-
-// Variable-size result carrier.
-struct EtResult {
-  std::vector<uint64_t> offsets;
-  std::vector<uint64_t> u64;
-  std::vector<float> f32;
-  std::vector<int32_t> i32;
-  std::vector<char> bytes;
-};
 
 const char* etg_last_error() { return g_last_error.c_str(); }
 
@@ -259,11 +274,9 @@ int64_t etg_builder_finalize(int64_t b, int build_in_adjacency) {
     builder = std::move(it->second);
     r.builders.erase(it);
   }
-  auto g = builder->Finalize(build_in_adjacency != 0);
-  std::lock_guard<std::mutex> lk(r.mu);
-  int64_t h = r.next++;
-  r.graphs[h] = std::move(g);
-  return h;
+  std::shared_ptr<const et::Graph> g = builder->Finalize(
+      build_in_adjacency != 0);
+  return RegisterGraph(std::move(g));
 }
 
 // ---- load/dump ----
@@ -276,11 +289,7 @@ int64_t etg_load(const char* dir, int shard_idx, int shard_num, int data_type,
     Fail(s.message());
     return -1;
   }
-  auto& r = Reg();
-  std::lock_guard<std::mutex> lk(r.mu);
-  int64_t h = r.next++;
-  r.graphs[h] = std::move(g);
-  return h;
+  return RegisterGraph(std::shared_ptr<const et::Graph>(std::move(g)));
 }
 
 int etg_dump(int64_t h, const char* dir, int num_partitions, int by_graph) {
@@ -722,6 +731,81 @@ void etg_rpc_stats(uint64_t* out) {
   out[9] = c.v1_calls.load();
   out[10] = c.hello_fallbacks.load();
   out[11] = static_cast<uint64_t>(std::max<int64_t>(c.inflight.load(), 0));
+}
+
+// ---- streaming deltas (graph epoch + O(delta) maintenance) ----
+// Current epoch of the handle's snapshot (0 = as-finalized; each
+// etg_apply_delta bumps it). -1 on a bad handle.
+int64_t etg_graph_epoch(int64_t h) {
+  auto ref = GetGraphRef(h);
+  if (!ref) {
+    Fail("bad graph handle");
+    return -1;
+  }
+  return static_cast<int64_t>(ref->epoch());
+}
+
+// Batched delta apply on an embedded graph handle: add/update nodes and
+// edges through the builder machinery, rebuild an immutable snapshot
+// off-path, swap it in behind the handle (queries bound to the handle
+// see it; in-flight executions finish on the old snapshot), record the
+// per-epoch dirty set, and orphan the old snapshot's UDF-cache entries.
+// out_epoch gets the new epoch.
+int etg_apply_delta(int64_t h, int64_t n_nodes, const uint64_t* node_ids,
+                    const int32_t* node_types, const float* node_weights,
+                    int64_t n_edges, const uint64_t* edge_src,
+                    const uint64_t* edge_dst, const int32_t* edge_types,
+                    const float* edge_weights, int64_t* out_epoch) {
+  auto ref = GetGraphRef(h);
+  if (!ref) return Fail("bad graph handle");
+  // per-ref apply serialization: queues concurrent applies on THIS
+  // graph (through any surface sharing the ref) without blocking
+  // applies on unrelated graph handles
+  std::lock_guard<std::mutex> lk(ref->apply_mutex());
+  auto base = ref->get();
+  std::unique_ptr<et::Graph> next;
+  std::vector<et::NodeId> dirty;
+  et::Status s = et::ApplyGraphDelta(
+      *base, node_ids, node_types, node_weights,
+      static_cast<size_t>(n_nodes), edge_src, edge_dst, edge_types,
+      edge_weights, static_cast<size_t>(n_edges), /*shard_idx=*/0,
+      /*shard_num=*/1, &next, &dirty);
+  if (!s.ok()) return Fail(s.message());
+  if (out_epoch != nullptr)
+    *out_epoch = static_cast<int64_t>(next->epoch());
+  if (!ref->SwapFrom(base, std::shared_ptr<const et::Graph>(std::move(next)),
+                     std::move(dirty)))
+    return Fail("concurrent delta apply on this graph; retry");
+  et::UdfResultCache::Instance().EvictGraph(base->uid());
+  return 0;
+}
+
+// Dirty-node union for epochs > from_epoch on an embedded handle.
+// res->u64 gets the sorted unique ids; *out_epoch the covered-up-to
+// epoch; *out_covered 0 when the bounded history no longer reaches
+// from_epoch (treat everything as dirty).
+int etg_delta_since(int64_t h, int64_t from_epoch, EtResult* res,
+                    int64_t* out_epoch, int32_t* out_covered) {
+  auto ref = GetGraphRef(h);
+  if (!ref) return Fail("bad graph handle");
+  std::vector<et::NodeId> ids;
+  uint64_t epoch = 0;
+  bool covered =
+      ref->DirtySince(static_cast<uint64_t>(from_epoch), &ids, &epoch);
+  res->u64.assign(ids.begin(), ids.end());
+  res->offsets.clear();
+  res->f32.clear();
+  res->i32.clear();
+  res->bytes.clear();
+  if (out_epoch != nullptr) *out_epoch = static_cast<int64_t>(epoch);
+  if (out_covered != nullptr) *out_covered = covered ? 1 : 0;
+  return 0;
+}
+
+// Cumulative UDF result-cache entries dropped by epoch bumps (the
+// udf_cache_epoch_evictions_total obs counter reads this).
+uint64_t etg_udf_cache_epoch_evictions() {
+  return et::UdfResultCache::Instance().EpochEvictions();
 }
 
 // 64-bit string hash for Python data-prep id mapping (parity:

@@ -7,13 +7,32 @@
 #include <cstdint>
 #include <memory>
 #include <string>
+#include <vector>
+
+// Variable-size result carrier handed across the ctypes boundary as an
+// opaque pointer (etres_* accessors in capi.cc). Shared here so both
+// extern-"C" translation units can fill one.
+struct EtResult {
+  std::vector<uint64_t> offsets;
+  std::vector<uint64_t> u64;
+  std::vector<float> f32;
+  std::vector<int32_t> i32;
+  std::vector<char> bytes;
+};
 
 namespace et {
 class Graph;
+class GraphRef;
 namespace capi {
 
-// Resolve a Python-held graph handle (nullptr if unknown).
+// Resolve a Python-held graph handle (nullptr if unknown). Returns the
+// handle's CURRENT snapshot — a delta apply swaps the snapshot behind
+// the same handle (the snapshot itself stays immutable).
 std::shared_ptr<Graph> GraphFromHandle(int64_t h);
+
+// The handle's swappable holder (streaming deltas): proxies bound to
+// it observe etg_apply_delta swaps.
+std::shared_ptr<GraphRef> GraphRefFromHandle(int64_t h);
 
 // Record msg as the thread-local last error; returns the nonzero C error
 // code callers propagate.

@@ -32,8 +32,8 @@ struct QueryRegistry {
   int64_t next = 1;
   std::unordered_map<int64_t, std::shared_ptr<et::QueryProxy>> proxies;
   std::unordered_map<int64_t, std::shared_ptr<et::GraphServer>> servers;
-  // servers keep their graph alive
-  std::unordered_map<int64_t, std::shared_ptr<const et::Graph>> server_graphs;
+  // servers keep their (swappable) graph holder alive
+  std::unordered_map<int64_t, std::shared_ptr<et::GraphRef>> server_graphs;
   std::unordered_map<int64_t, std::shared_ptr<et::RegistryServer>> registries;
 };
 
@@ -82,13 +82,15 @@ extern "C" {
 // ---- QueryProxy ----
 int64_t etq_new_local(int64_t graph_handle, const char* index_spec,
                       uint64_t seed) {
-  auto g = et::capi::GraphFromHandle(graph_handle);
-  if (!g) {
+  // bind to the handle's swappable GraphRef (not one snapshot): an
+  // etg_apply_delta on the graph handle is visible to this proxy
+  auto ref = et::capi::GraphRefFromHandle(graph_handle);
+  if (!ref) {
     FailWith("bad graph handle");
     return 0;
   }
   std::unique_ptr<et::QueryProxy> qp;
-  et::Status s = et::QueryProxy::NewLocal(g, index_spec ? index_spec : "",
+  et::Status s = et::QueryProxy::NewLocal(ref, index_spec ? index_spec : "",
                                           seed, &qp);
   if (!s.ok()) {
     FailWith(s.message());
@@ -153,6 +155,69 @@ int etq_free(int64_t h) {
   auto& r = QReg();
   std::lock_guard<std::mutex> lk(r.mu);
   r.proxies.erase(h);
+  return 0;
+}
+
+// ---- streaming deltas (proxy surface) ----
+static std::shared_ptr<et::QueryProxy> GetProxy(int64_t h) {
+  auto& r = QReg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.proxies.find(h);
+  return it == r.proxies.end() ? nullptr : it->second;
+}
+
+// Observed graph epoch: exact for local proxies; for distribute-mode
+// proxies the max epoch seen on any shard reply (v2 frames piggyback
+// it — poll etq_delta_since for an active refresh over v1).
+int64_t etq_epoch(int64_t h) {
+  auto qp = GetProxy(h);
+  if (!qp) {
+    FailWith("bad proxy handle");
+    return -1;
+  }
+  return static_cast<int64_t>(qp->ObservedEpoch());
+}
+
+// Batched delta through the proxy: local → swap this handle's graph;
+// distribute → broadcast kApplyDelta to every shard (each applies its
+// hash-owned rows). out_epoch gets the new (max) epoch.
+int etq_apply_delta(int64_t h, int64_t n_nodes, const uint64_t* node_ids,
+                    const int32_t* node_types, const float* node_weights,
+                    int64_t n_edges, const uint64_t* edge_src,
+                    const uint64_t* edge_dst, const int32_t* edge_types,
+                    const float* edge_weights, int64_t* out_epoch) {
+  auto qp = GetProxy(h);
+  if (!qp) return FailWith("bad proxy handle");
+  uint64_t epoch = 0;
+  et::Status s = qp->ApplyDelta(
+      node_ids, node_types, node_weights, static_cast<size_t>(n_nodes),
+      edge_src, edge_dst, edge_types, edge_weights,
+      static_cast<size_t>(n_edges), &epoch);
+  if (!s.ok()) return FailWith(s.message());
+  if (out_epoch != nullptr) *out_epoch = static_cast<int64_t>(epoch);
+  return 0;
+}
+
+// Dirty-node union for epochs > from_epoch (res->u64, sorted unique);
+// *out_covered 0 → some shard's bounded history no longer reaches
+// from_epoch (the caller must treat everything as dirty).
+int etq_delta_since(int64_t h, int64_t from_epoch, EtResult* res,
+                    int64_t* out_epoch, int32_t* out_covered) {
+  auto qp = GetProxy(h);
+  if (!qp) return FailWith("bad proxy handle");
+  uint64_t epoch = 0;
+  bool covered = false;
+  std::vector<et::NodeId> ids;
+  et::Status s = qp->DeltaSince(static_cast<uint64_t>(from_epoch), &epoch,
+                                &covered, &ids);
+  if (!s.ok()) return FailWith(s.message());
+  res->u64.assign(ids.begin(), ids.end());
+  res->offsets.clear();
+  res->f32.clear();
+  res->i32.clear();
+  res->bytes.clear();
+  if (out_epoch != nullptr) *out_epoch = static_cast<int64_t>(epoch);
+  if (out_covered != nullptr) *out_covered = covered ? 1 : 0;
   return 0;
 }
 
@@ -267,8 +332,13 @@ int64_t ets_start(const char* data_dir, int shard_idx, int shard_num,
       return 0;
     }
   }
+  int partition_num = graph->meta().partition_num;
+  auto graph_ref = std::make_shared<et::GraphRef>(std::move(graph));
   auto server = std::make_shared<et::GraphServer>(
-      graph, index, shard_idx, shard_num, graph->meta().partition_num);
+      graph_ref, index, shard_idx, shard_num, partition_num);
+  // spec retained so kApplyDelta can rebuild the index on the new
+  // snapshot (a server with an index but no spec refuses deltas)
+  server->set_index_spec(index_spec != nullptr ? index_spec : "");
   s = server->Start(port);
   if (!s.ok()) {
     FailWith(s.message());
@@ -285,7 +355,7 @@ int64_t ets_start(const char* data_dir, int shard_idx, int shard_num,
   std::lock_guard<std::mutex> lk(r.mu);
   int64_t h = r.next++;
   r.servers[h] = server;
-  r.server_graphs[h] = graph;
+  r.server_graphs[h] = graph_ref;
   return h;
 }
 

@@ -1229,5 +1229,150 @@ const std::vector<uint32_t>* Graph::GraphNodes(uint64_t label) const {
   return it == label_rows_.end() ? nullptr : &it->second;
 }
 
+// ---------------------------------------------------------------------------
+// Streaming deltas: builder reconstruction + delta apply.
+// ---------------------------------------------------------------------------
+
+std::unique_ptr<GraphBuilder> BuilderFromGraph(const Graph& g) {
+  auto b = std::make_unique<GraphBuilder>();
+  *b->mutable_meta() = g.meta_;  // types, names, feature infos, partitions
+  const size_t N = g.node_ids_.size();
+  const int ET = g.meta_.num_edge_types;
+  // Nodes in engine-row order: EnsureNode appends, so row i stays row i
+  // through Finalize — the append-only row-identity invariant every
+  // derived table (features, alias rows) relies on across deltas.
+  for (size_t i = 0; i < N; ++i) {
+    b->AddNode(g.node_ids_[i], g.node_types_[i], g.node_weights_[i]);
+  }
+  // Edges from the adjacency slots. Insertion order does not affect the
+  // finalized layout (Finalize sorts by (group, dst)); what matters is
+  // that the deduped edge SET and weights round-trip exactly.
+  std::vector<NodeId> esrc, edst;
+  std::vector<int32_t> etype;
+  const size_t E = g.adj_nbr_.size();
+  esrc.reserve(E);
+  edst.reserve(E);
+  etype.reserve(E);
+  for (size_t gi = 0; gi < N * static_cast<size_t>(ET); ++gi) {
+    NodeId src = g.node_ids_[gi / ET];
+    int32_t et = static_cast<int32_t>(gi % ET);
+    for (uint64_t s = g.adj_offsets_[gi]; s < g.adj_offsets_[gi + 1]; ++s) {
+      b->AddEdge(src, g.adj_nbr_[s], et, g.adj_w_[s]);
+      esrc.push_back(src);
+      edst.push_back(g.adj_nbr_[s]);
+      etype.push_back(et);
+    }
+  }
+  // Whole-graph labels (0 = unlabeled by convention — skip zeros).
+  for (size_t i = 0; i < g.graph_labels_.size(); ++i) {
+    if (g.graph_labels_[i] != 0) {
+      uint64_t gl = g.graph_labels_[i];
+      b->SetGraphLabels(&g.node_ids_[i], &gl, 1);
+    }
+  }
+  // Node features. Dense: one bulk call per fid (node_dense_ is exactly
+  // N*dim in row order). Sparse/binary: per non-empty row.
+  for (size_t fid = 0; fid < g.node_dense_.size(); ++fid) {
+    const auto& col = g.node_dense_[fid];
+    if (col.empty()) continue;
+    int64_t dim = std::max<int64_t>(g.meta_.node_features[fid].dim, 1);
+    b->SetNodeDenseBulk(g.node_ids_.data(), N, static_cast<int>(fid), dim,
+                        col.data());
+  }
+  for (size_t fid = 0; fid < g.node_var_.size(); ++fid) {
+    const auto& vf = g.node_var_[fid];
+    if (vf.offsets.empty()) continue;
+    bool sparse = g.meta_.node_features[fid].kind == FeatureKind::kSparse;
+    for (size_t r = 0; r < N; ++r) {
+      uint64_t lo = vf.offsets[r], hi = vf.offsets[r + 1];
+      if (hi <= lo) continue;
+      if (sparse) {
+        b->SetNodeSparse(g.node_ids_[r], static_cast<int>(fid),
+                         vf.values_u64.data() + lo,
+                         static_cast<int64_t>(hi - lo));
+      } else {
+        b->SetNodeBinary(g.node_ids_[r], static_cast<int>(fid),
+                         vf.values_bytes.data() + lo,
+                         static_cast<int64_t>(hi - lo));
+      }
+    }
+  }
+  // Edge features, keyed by the slot-order (src, dst, type) triples.
+  for (size_t fid = 0; fid < g.edge_dense_.size(); ++fid) {
+    const auto& col = g.edge_dense_[fid];
+    if (col.empty()) continue;
+    int64_t dim = std::max<int64_t>(g.meta_.edge_features[fid].dim, 1);
+    b->SetEdgeDenseBulk(esrc.data(), edst.data(), etype.data(), esrc.size(),
+                        static_cast<int>(fid), dim, col.data());
+  }
+  for (size_t fid = 0; fid < g.edge_var_.size(); ++fid) {
+    const auto& vf = g.edge_var_[fid];
+    if (vf.offsets.empty()) continue;
+    bool sparse = g.meta_.edge_features[fid].kind == FeatureKind::kSparse;
+    for (size_t s = 0; s < esrc.size(); ++s) {
+      uint64_t lo = vf.offsets[s], hi = vf.offsets[s + 1];
+      if (hi <= lo) continue;
+      if (sparse) {
+        b->SetEdgeSparse(esrc[s], edst[s], etype[s], static_cast<int>(fid),
+                         vf.values_u64.data() + lo,
+                         static_cast<int64_t>(hi - lo));
+      } else {
+        b->SetEdgeBinary(esrc[s], edst[s], etype[s], static_cast<int>(fid),
+                         vf.values_bytes.data() + lo,
+                         static_cast<int64_t>(hi - lo));
+      }
+    }
+  }
+  return b;
+}
+
+Status ApplyGraphDelta(const Graph& base, const NodeId* node_ids,
+                       const int32_t* node_types, const float* node_weights,
+                       size_t n_nodes, const NodeId* edge_src,
+                       const NodeId* edge_dst, const int32_t* edge_types,
+                       const float* edge_weights, size_t n_edges,
+                       int shard_idx, int shard_num,
+                       std::unique_ptr<Graph>* out,
+                       std::vector<NodeId>* dirty_out) {
+  if (shard_num < 1) shard_num = 1;
+  if (shard_idx < 0 || shard_idx >= shard_num)
+    return Status::InvalidArgument("bad shard index for delta apply");
+  const uint64_t P =
+      static_cast<uint64_t>(std::max(base.meta().partition_num, 1));
+  auto owns = [&](NodeId id) {
+    if (shard_num <= 1) return true;
+    return static_cast<int>((id % P) % shard_num) == shard_idx;
+  };
+  auto b = BuilderFromGraph(base);
+  for (size_t i = 0; i < n_nodes; ++i) {
+    if (!owns(node_ids[i])) continue;
+    b->AddNode(node_ids[i], node_types ? node_types[i] : 0,
+               node_weights ? node_weights[i] : 1.0f);
+  }
+  for (size_t i = 0; i < n_edges; ++i) {
+    // source-owned, matching DumpOnePartition — an edge lands on (and
+    // samples from) exactly one shard of a broadcast delta
+    if (!owns(edge_src[i])) continue;
+    b->AddEdge(edge_src[i], edge_dst[i], edge_types ? edge_types[i] : 0,
+               edge_weights ? edge_weights[i] : 1.0f);
+  }
+  auto g = b->Finalize(base.has_in_adjacency());
+  g->set_epoch(base.epoch() + 1);
+  if (dirty_out != nullptr) {
+    // FULL delta ids (unfiltered): clients invalidate by id, and a node
+    // another shard owns may still sit in their caches
+    dirty_out->clear();
+    dirty_out->reserve(n_nodes + 2 * n_edges);
+    dirty_out->insert(dirty_out->end(), node_ids, node_ids + n_nodes);
+    dirty_out->insert(dirty_out->end(), edge_src, edge_src + n_edges);
+    dirty_out->insert(dirty_out->end(), edge_dst, edge_dst + n_edges);
+    std::sort(dirty_out->begin(), dirty_out->end());
+    dirty_out->erase(std::unique(dirty_out->begin(), dirty_out->end()),
+                     dirty_out->end());
+  }
+  *out = std::move(g);
+  return Status::OK();
+}
+
 }  // namespace et
 

@@ -12,10 +12,15 @@
 //
 // Thread-safety: Graph is immutable after Finalize(); all Sample*/Get*
 // methods are const and take an explicit RNG → safe for concurrent readers.
+// Streaming mutations never break this: ApplyGraphDelta builds a NEW
+// snapshot off-path and GraphRef swaps it in (RCU) — readers holding the
+// old snapshot finish safely while new requests see the new epoch.
 #ifndef EULER_TPU_GRAPH_H_
 #define EULER_TPU_GRAPH_H_
 
+#include <algorithm>
 #include <cstdint>
+#include <deque>
 #include <limits>
 #include <memory>
 #include <mutex>
@@ -213,11 +218,26 @@ class Graph {
 
   // Process-unique id, assigned at construction. Finalized graphs are
   // immutable, so (uid, query) fully identifies a result — the UDF
-  // result cache keys on it (udf.h UdfResultCache).
+  // result cache keys on it (udf.h UdfResultCache). A delta-applied
+  // snapshot is a NEW Graph with a new uid, so cached results for the
+  // pre-delta snapshot can never be served after a swap.
   uint64_t uid() const { return uid_; }
+
+  // Graph epoch: monotonic version stamp. 0 for a freshly finalized
+  // graph; ApplyGraphDelta stamps base.epoch() + 1 on the snapshot it
+  // produces. Carried on v2 RPC reply frames and exposed through capi
+  // so clients invalidate derived state (caches, alias tables) on the
+  // bump instead of assuming immutability.
+  uint64_t epoch() const { return epoch_; }
+  void set_epoch(uint64_t e) { epoch_ = e; }
+
+  // Whether Finalize built the reverse adjacency (ApplyGraphDelta must
+  // rebuild with the same setting for byte parity).
+  bool has_in_adjacency() const { return !in_adj_offsets_.empty(); }
 
  private:
   friend class GraphBuilder;
+  friend std::unique_ptr<GraphBuilder> BuilderFromGraph(const Graph&);
   Graph();
 
   // Weighted choice among the (begin,end) cumw groups selected by edge_types;
@@ -226,6 +246,7 @@ class Graph {
                          size_t n_types, Pcg32* rng) const;
 
   uint64_t uid_ = 0;
+  uint64_t epoch_ = 0;
   GraphMeta meta_;
   // nodes
   std::vector<NodeId> node_ids_;
@@ -373,6 +394,142 @@ class GraphBuilder {
   std::vector<FeatCell>* NodeCells(int fid);
   std::vector<FeatCell>* EdgeCells(int fid);
 };
+
+// ---------------------------------------------------------------------------
+// Streaming deltas: swappable snapshot holder + O(delta) bookkeeping.
+// ---------------------------------------------------------------------------
+
+// Shared, swappable holder for an immutable Graph snapshot (RCU shape):
+// readers snapshot get() and keep sampling the old graph while a delta
+// finalizes off-path; Swap atomically publishes the new snapshot plus
+// the per-epoch dirty-node set that produced it. This is what turns
+// "the graph is immutable" from a load-bearing assumption into a
+// checked, versioned invariant — every Graph stays immutable, only the
+// ref moves.
+class GraphRef {
+ public:
+  explicit GraphRef(std::shared_ptr<const Graph> g) : g_(std::move(g)) {}
+
+  std::shared_ptr<const Graph> get() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return g_;
+  }
+  uint64_t epoch() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return g_->epoch();
+  }
+
+  // Publish `next` (epoch already stamped) with the dirty-node set of
+  // the delta that produced it. History is bounded (kMaxEpochs entries
+  // / kMaxDirtyIds total ids); once it overflows, DirtySince reports
+  // uncovered and clients fall back to a full flush — the documented
+  // escape hatch, never silent staleness.
+  void Swap(std::shared_ptr<const Graph> next, std::vector<NodeId> dirty) {
+    std::lock_guard<std::mutex> lk(mu_);
+    SwapLocked(std::move(next), std::move(dirty));
+  }
+
+  // Compare-and-swap publish: fails (false, no change) when the held
+  // snapshot is no longer `expected` — a concurrent apply through a
+  // DIFFERENT surface (capi handle vs a proxy bound to it) rebuilt
+  // from the same base first, and silently dropping either delta would
+  // lose writes. Callers surface "concurrent delta apply; retry".
+  bool SwapFrom(const std::shared_ptr<const Graph>& expected,
+                std::shared_ptr<const Graph> next,
+                std::vector<NodeId> dirty) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (g_ != expected) return false;
+    SwapLocked(std::move(next), std::move(dirty));
+    return true;
+  }
+
+  // Union of dirty sets for epochs in (from, epoch()], sorted unique.
+  // Returns false (and clears out) when the history no longer covers
+  // `from` — caller must treat everything as dirty. epoch_out (when
+  // non-null) receives the epoch the result covers UP TO, read under
+  // the same lock — a concurrent Swap can never make the caller think
+  // ids reach an epoch they don't.
+  bool DirtySince(uint64_t from, std::vector<NodeId>* out,
+                  uint64_t* epoch_out = nullptr) const {
+    std::lock_guard<std::mutex> lk(mu_);
+    out->clear();
+    uint64_t cur = g_->epoch();
+    if (epoch_out != nullptr) *epoch_out = cur;
+    // from > cur: the caller observed an epoch this graph never reached
+    // — an EPOCH REGRESSION (a restarted shard reloaded pre-delta data
+    // at epoch 0). History cannot prove anything about it; report
+    // uncovered so the caller full-flushes instead of silently serving
+    // rows from a future the graph lost.
+    if (from > cur) return false;
+    if (from == cur) return true;  // nothing newer — empty dirty set
+    // coverage: every epoch in (from, cur] must be present in history
+    uint64_t oldest = hist_.empty() ? cur + 1 : hist_.front().first;
+    if (from + 1 < oldest) return false;
+    for (const auto& kv : hist_) {
+      if (kv.first > from)
+        out->insert(out->end(), kv.second.begin(), kv.second.end());
+    }
+    std::sort(out->begin(), out->end());
+    out->erase(std::unique(out->begin(), out->end()), out->end());
+    return true;
+  }
+
+  static constexpr size_t kMaxEpochs = 64;
+  static constexpr size_t kMaxDirtyIds = 4u << 20;  // ~32MB of u64 ids
+
+  // Serializes delta APPLIES across every surface sharing this ref
+  // (capi handle, local proxies, a serving GraphServer): two racing
+  // rebuilds from one base would each win SwapFrom's CAS for different
+  // bases and one would error "retry" — queueing here turns that into
+  // plain back-to-back applies. Per-ref, so independent graphs in one
+  // process apply concurrently. The CAS stays the correctness backstop.
+  std::mutex& apply_mutex() const { return apply_mu_; }
+
+ private:
+  void SwapLocked(std::shared_ptr<const Graph> next,
+                  std::vector<NodeId> dirty) {
+    hist_ids_ += dirty.size();
+    hist_.emplace_back(next->epoch(), std::move(dirty));
+    while (hist_.size() > kMaxEpochs || hist_ids_ > kMaxDirtyIds) {
+      hist_ids_ -= hist_.front().second.size();
+      hist_.pop_front();
+    }
+    g_ = std::move(next);
+  }
+
+  mutable std::mutex mu_;
+  mutable std::mutex apply_mu_;
+  std::shared_ptr<const Graph> g_;
+  // (epoch, sorted-unique dirty node ids), oldest first
+  std::deque<std::pair<uint64_t, std::vector<NodeId>>> hist_;
+  size_t hist_ids_ = 0;
+};
+
+// Reconstruct a GraphBuilder whose Finalize() reproduces `g` byte-for-
+// byte: node rows in engine-row order (EnsureNode appends, so existing
+// rows keep their indices across deltas — the invariant device tables
+// patch against), edges from the adjacency slots, features bulk-copied.
+std::unique_ptr<GraphBuilder> BuilderFromGraph(const Graph& g);
+
+// base + delta → a NEW immutable snapshot with epoch = base.epoch()+1.
+// Delta semantics ride the existing builder machinery: AddNodes
+// (last-write-wins type/weight update), AddEdges (duplicate
+// (src,dst,type) dedupes last-added-wins, i.e. an existing edge's
+// weight updates in place). When shard_num > 1 the delta is filtered to
+// this shard's hash ownership ((id % partition_num) % shard_num ==
+// shard_idx, the LoadShard/DumpOnePartition convention; edges are
+// source-owned) so a broadcast delta lands each row on exactly one
+// shard. dirty_out gets the FULL delta's node ids (nodes ∪ edge
+// endpoints, unfiltered, sorted unique) — over-invalidation across
+// shards is safe, staleness is not.
+Status ApplyGraphDelta(const Graph& base, const NodeId* node_ids,
+                       const int32_t* node_types, const float* node_weights,
+                       size_t n_nodes, const NodeId* edge_src,
+                       const NodeId* edge_dst, const int32_t* edge_types,
+                       const float* edge_weights, size_t n_edges,
+                       int shard_idx, int shard_num,
+                       std::unique_ptr<Graph>* out,
+                       std::vector<NodeId>* dirty_out);
 
 }  // namespace et
 

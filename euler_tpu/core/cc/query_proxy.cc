@@ -1,6 +1,7 @@
 #include "query_proxy.h"
 
 #include "threadpool.h"
+#include "udf.h"
 
 #include <chrono>
 
@@ -9,12 +10,22 @@ namespace et {
 Status QueryProxy::NewLocal(std::shared_ptr<const Graph> graph,
                             const std::string& index_spec, uint64_t seed,
                             std::unique_ptr<QueryProxy>* out) {
+  return NewLocal(std::make_shared<GraphRef>(std::move(graph)), index_spec,
+                  seed, out);
+}
+
+Status QueryProxy::NewLocal(std::shared_ptr<GraphRef> graph_ref,
+                            const std::string& index_spec, uint64_t seed,
+                            std::unique_ptr<QueryProxy>* out) {
   auto qp = std::unique_ptr<QueryProxy>(new QueryProxy());
-  qp->graph_ = std::move(graph);
+  qp->graph_ref_ = std::move(graph_ref);
   qp->seed_ = seed;
+  qp->index_spec_ = index_spec;
   if (!index_spec.empty()) {
+    auto g = qp->graph_ref_->get();
     qp->index_ = std::make_shared<IndexManager>();
-    ET_RETURN_IF_ERROR(qp->index_->BuildFromSpec(*qp->graph_, index_spec));
+    ET_RETURN_IF_ERROR(qp->index_->BuildFromSpec(*g, index_spec));
+    qp->index_epoch_ = g->epoch();
   }
   CompileOptions opts;
   opts.mode = "local";
@@ -65,9 +76,64 @@ Status QueryProxy::NewRemote(const std::string& endpoints, uint64_t seed,
 
 const GraphMeta& QueryProxy::graph_meta() const {
   static GraphMeta empty;
-  if (graph_) return graph_->meta();
+  if (graph_ref_) {
+    // copy out of the pinned snapshot: returning a reference into the
+    // Graph itself would dangle if a delta swap dropped the snapshot
+    // between this return and the caller's read
+    thread_local GraphMeta snap;
+    snap = graph_ref_->get()->meta();
+    return snap;
+  }
   if (client_) return client_->graph_meta();
   return empty;
+}
+
+uint64_t QueryProxy::ObservedEpoch() const {
+  if (graph_ref_) return graph_ref_->epoch();
+  if (client_) return client_->ObservedEpoch();
+  return 0;
+}
+
+Status QueryProxy::ApplyDelta(const NodeId* node_ids,
+                              const int32_t* node_types,
+                              const float* node_weights, size_t n_nodes,
+                              const NodeId* edge_src, const NodeId* edge_dst,
+                              const int32_t* edge_types,
+                              const float* edge_weights, size_t n_edges,
+                              uint64_t* new_epoch) {
+  if (client_) {
+    return client_->ApplyDelta(node_ids, node_types, node_weights, n_nodes,
+                               edge_src, edge_dst, edge_types, edge_weights,
+                               n_edges, new_epoch);
+  }
+  if (!graph_ref_) return Status::Internal("proxy has no graph");
+  // per-ref apply lock: serialized with applies through ANY surface
+  // sharing this ref (the capi handle, other proxies, a server)
+  std::lock_guard<std::mutex> lk(graph_ref_->apply_mutex());
+  auto base = graph_ref_->get();
+  std::unique_ptr<Graph> next;
+  std::vector<NodeId> dirty;
+  ET_RETURN_IF_ERROR(ApplyGraphDelta(
+      *base, node_ids, node_types, node_weights, n_nodes, edge_src, edge_dst,
+      edge_types, edge_weights, n_edges, /*shard_idx=*/0, /*shard_num=*/1,
+      &next, &dirty));
+  uint64_t epoch = next->epoch();
+  if (!graph_ref_->SwapFrom(base,
+                            std::shared_ptr<const Graph>(std::move(next)),
+                            std::move(dirty)))
+    return Status::Internal("concurrent delta apply on this graph; retry");
+  UdfResultCache::Instance().EvictGraph(base->uid());
+  if (new_epoch != nullptr) *new_epoch = epoch;
+  return Status::OK();
+}
+
+Status QueryProxy::DeltaSince(uint64_t from, uint64_t* epoch, bool* covered,
+                              std::vector<NodeId>* ids) {
+  if (client_) return client_->DeltaSince(from, epoch, covered, ids);
+  if (!graph_ref_) return Status::Internal("proxy has no graph");
+  *covered = graph_ref_->DirtySince(from, ids, epoch);
+  if (!*covered) ids->clear();
+  return Status::OK();
 }
 
 Status QueryProxy::RunGremlin(const std::string& query,
@@ -90,11 +156,31 @@ Status QueryProxy::RunGremlinTimed(const std::string& query,
                                    std::map<std::string, Tensor>* outputs) {
   std::shared_ptr<const TranslateResult> plan;
   ET_RETURN_IF_ERROR(compiler_->Compile(query, &plan));
+  // pin this run's snapshot (local mode): a concurrent delta swap must
+  // not free the graph mid-execution, and has() filters must see an
+  // index coherent with the graph they run against
+  std::shared_ptr<const Graph> g;
+  std::shared_ptr<IndexManager> idx;
+  if (graph_ref_) {
+    g = graph_ref_->get();
+    if (index_ != nullptr || !index_spec_.empty()) {
+      std::lock_guard<std::mutex> lk(index_mu_);
+      if (g->epoch() != index_epoch_ && !index_spec_.empty()) {
+        // lazy rebuild on epoch bump — a delta applied through the
+        // shared GraphRef (capi etg_apply_delta) reaches this proxy here
+        auto fresh = std::make_shared<IndexManager>();
+        ET_RETURN_IF_ERROR(fresh->BuildFromSpec(*g, index_spec_));
+        index_ = std::move(fresh);
+        index_epoch_ = g->epoch();
+      }
+      idx = index_;
+    }
+  }
   OpKernelContext ctx;
   for (const auto& kv : inputs) ctx.Put(kv.first, kv.second);
   QueryEnv env;
-  env.graph = graph_.get();
-  env.index = index_.get();
+  env.graph = g.get();
+  env.index = idx.get();
   env.client = client_.get();
   env.pool = GlobalThreadPool();
   env.seed = seed_;

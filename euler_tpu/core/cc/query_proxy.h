@@ -11,7 +11,9 @@
 #include <atomic>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <string>
+#include <vector>
 
 #include "common.h"
 #include "gql.h"
@@ -26,6 +28,13 @@ class QueryProxy {
   // Local (embedded) mode over an existing in-memory graph.
   // index_spec: "" or "attr:hash_index,attr2:range_index".
   static Status NewLocal(std::shared_ptr<const Graph> graph,
+                         const std::string& index_spec, uint64_t seed,
+                         std::unique_ptr<QueryProxy>* out);
+  // Streaming form: queries run against whatever snapshot the shared
+  // ref currently holds, so an etg_apply_delta on the owning graph
+  // handle is visible to every proxy bound to it (each execution pins
+  // its snapshot; the index lazily rebuilds on an epoch bump).
+  static Status NewLocal(std::shared_ptr<GraphRef> graph_ref,
                          const std::string& index_spec, uint64_t seed,
                          std::unique_ptr<QueryProxy>* out);
 
@@ -70,6 +79,24 @@ class QueryProxy {
             last_us_.load()};
   }
 
+  // ---- streaming deltas ----
+  // Local mode: the ref's current epoch (exact). Distribute mode: the
+  // highest epoch observed on any shard reply (passive piggyback;
+  // DeltaSince refreshes it actively).
+  uint64_t ObservedEpoch() const;
+  // Apply a batched delta: local → rebuild + swap this ref (and orphan
+  // the old snapshot's UDF cache entries); distribute → broadcast
+  // kApplyDelta to every shard.
+  Status ApplyDelta(const NodeId* node_ids, const int32_t* node_types,
+                    const float* node_weights, size_t n_nodes,
+                    const NodeId* edge_src, const NodeId* edge_dst,
+                    const int32_t* edge_types, const float* edge_weights,
+                    size_t n_edges, uint64_t* new_epoch);
+  // Dirty-node union for epochs > from; *covered false → history gap,
+  // treat everything as dirty.
+  Status DeltaSince(uint64_t from, uint64_t* epoch, bool* covered,
+                    std::vector<NodeId>* ids);
+
  private:
   QueryProxy() = default;
 
@@ -77,8 +104,11 @@ class QueryProxy {
                          const std::map<std::string, Tensor>& inputs,
                          std::map<std::string, Tensor>* outputs);
 
-  std::shared_ptr<const Graph> graph_;          // local mode
+  std::shared_ptr<GraphRef> graph_ref_;         // local mode
   std::shared_ptr<IndexManager> index_;         // local mode
+  std::string index_spec_;                      // local mode (rebuilds)
+  uint64_t index_epoch_ = 0;   // epoch index_ was built against
+  std::mutex index_mu_;        // guards index_/index_epoch_ lazy rebuild
   std::unique_ptr<ClientManager> client_;       // distribute mode
   std::unique_ptr<GqlCompiler> compiler_;
   uint64_t seed_ = 0;

@@ -14,6 +14,7 @@
 
 #include <zlib.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
@@ -23,6 +24,7 @@
 #include <unordered_map>
 
 #include "threadpool.h"
+#include "udf.h"
 
 namespace et {
 
@@ -40,8 +42,14 @@ namespace {
 constexpr uint32_t kFrameMagic = 0x52465445;    // 'ETFR'
 constexpr uint32_t kFrameMagicV2 = 0x32465445;  // 'ETF2'
 constexpr uint32_t kFrameFlagCompressed = 1u;   // body: u64 raw_len | zlib
+// Reply body is prefixed with the serving graph's u64 epoch (before
+// compression). Hello-negotiated (kFeatEpoch): a server only sets it
+// for clients that advertised the feature, so pre-epoch v2 peers — and
+// every v1 peer — see unchanged bytes.
+constexpr uint32_t kFrameFlagEpoch = 2u;
 constexpr uint32_t kProtoV2 = 2;
 constexpr uint32_t kFeatAcceptCompressed = 1u;  // hello feature bit
+constexpr uint32_t kFeatEpoch = 2u;             // hello: send epoch prefixes
 
 enum MsgType : uint32_t {
   kExecute = 0,
@@ -52,7 +60,19 @@ enum MsgType : uint32_t {
                    // str name, i64 age_ms, u64 put-sequence
   kRegRemove = 5,  // body: entry name → dropped (clean shutdown)
   kHello = 6,      // v2 only: version | feature bits | compress threshold
+  // streaming deltas (graph service; both v1 and v2 framing):
+  kApplyDelta = 7,  // body: delta arrays → u32 code | u64 new_epoch / str
+  kGetDelta = 8,    // body: u64 from_epoch → u32 code | u64 epoch |
+                    // u8 covered | u64 n | n×u64 dirty node ids
 };
+
+// Max-update an atomic epoch (replies can arrive out of order).
+void MaxUpdateEpoch(std::atomic<uint64_t>* a, uint64_t v) {
+  if (a == nullptr) return;
+  uint64_t cur = a->load();
+  while (cur < v && !a->compare_exchange_weak(cur, v)) {
+  }
+}
 
 // kRegList reply schema version: mixed-binary registry pairs must fail
 // loudly, not misparse (the reply has no other self-description).
@@ -106,15 +126,19 @@ bool ReadFrame(int fd, uint32_t* msg_type, std::vector<char>* body) {
 // v2 header: magic | msg_type | flags | request_id | body_len (28 bytes).
 constexpr size_t kV2HdrLen = 28;
 
-bool WriteFrameV2(int fd, uint32_t msg_type, uint32_t flags,
-                  uint64_t request_id, const char* body, size_t len) {
-  char hdr[kV2HdrLen];
+void FillV2Hdr(char* hdr, uint32_t msg_type, uint32_t flags,
+               uint64_t request_id, uint64_t len) {
   std::memcpy(hdr, &kFrameMagicV2, 4);
   std::memcpy(hdr + 4, &msg_type, 4);
   std::memcpy(hdr + 8, &flags, 4);
   std::memcpy(hdr + 12, &request_id, 8);
-  uint64_t l = len;
-  std::memcpy(hdr + 20, &l, 8);
+  std::memcpy(hdr + 20, &len, 8);
+}
+
+bool WriteFrameV2(int fd, uint32_t msg_type, uint32_t flags,
+                  uint64_t request_id, const char* body, size_t len) {
+  char hdr[kV2HdrLen];
+  FillV2Hdr(hdr, msg_type, flags, request_id, len);
   return WriteAll(fd, hdr, kV2HdrLen) && WriteAll(fd, body, len);
 }
 
@@ -288,13 +312,28 @@ Status DecodeShardMeta(ByteReader* r, ShardMeta* m) {
 GraphServer::GraphServer(std::shared_ptr<const Graph> graph,
                          std::shared_ptr<IndexManager> index, int shard_idx,
                          int shard_num, int partition_num)
-    : graph_(std::move(graph)),
+    : GraphServer(std::make_shared<GraphRef>(std::move(graph)),
+                  std::move(index), shard_idx, shard_num, partition_num) {}
+
+GraphServer::GraphServer(std::shared_ptr<GraphRef> graph_ref,
+                         std::shared_ptr<IndexManager> index, int shard_idx,
+                         int shard_num, int partition_num)
+    : graph_ref_(std::move(graph_ref)),
       index_(std::move(index)),
       shard_idx_(shard_idx),
       shard_num_(shard_num),
       partition_num_(partition_num) {}
 
 GraphServer::~GraphServer() { Stop(); }
+
+void GraphServer::SnapshotState(std::shared_ptr<const Graph>* g,
+                                std::shared_ptr<IndexManager>* idx) const {
+  // one lock for both: a request must never pair a new graph with the
+  // old index (HandleApplyDelta swaps them together under state_mu_)
+  std::lock_guard<std::mutex> lk(state_mu_);
+  *g = graph_ref_->get();
+  if (idx != nullptr) *idx = index_;
+}
 
 Status GraphServer::Start(int port) {
   // interop test hook: serve exactly like a pre-v2 binary (v2 hellos are
@@ -441,6 +480,7 @@ struct GraphServer::ConnState {
   std::mutex wmu;              // serializes reply frames on this fd
   bool write_broken = false;   // under wmu: stop writing after a failure
   bool peer_compress = false;  // hello: client accepts deflated replies
+  bool peer_epoch = false;     // hello: client wants epoch reply prefixes
   uint64_t peer_threshold = 0;
   std::mutex imu;
   std::condition_variable icv;
@@ -448,17 +488,133 @@ struct GraphServer::ConnState {
 };
 
 void GraphServer::BuildMeta(ByteWriter* w) const {
+  std::shared_ptr<const Graph> g;
+  SnapshotState(&g, nullptr);
   ShardMeta m;
   m.shard_idx = shard_idx_;
   m.shard_num = shard_num_;
   m.partition_num = partition_num_;
-  m.node_type_wsum = graph_->node_type_weight_sums();
-  m.graph_label_count = graph_->graph_label_count();
-  m.owned_graph_label_count =
-      graph_->OwnedGraphLabelCount(shard_idx_, shard_num_);
-  m.edge_type_wsum = graph_->edge_type_weight_sums();
-  m.graph_meta = graph_->meta();
+  m.node_type_wsum = g->node_type_weight_sums();
+  m.graph_label_count = g->graph_label_count();
+  m.owned_graph_label_count = g->OwnedGraphLabelCount(shard_idx_, shard_num_);
+  m.edge_type_wsum = g->edge_type_weight_sums();
+  m.graph_meta = g->meta();
   EncodeShardMeta(m, w);
+}
+
+// kApplyDelta: decode the batched delta, rebuild a new snapshot through
+// the builder machinery (readers keep sampling the old one), swap it in
+// with its dirty set, rebuild the attribute index, and orphan the old
+// snapshot's UDF result-cache entries (counted). Serialized: concurrent
+// applies would each rebuild from the same base and lose one delta.
+void GraphServer::HandleApplyDelta(ByteReader* r, ByteWriter* w) {
+  // per-ref: also serialized with an embedded-handle apply when the
+  // server was constructed over a shared GraphRef
+  std::lock_guard<std::mutex> apply_lk(graph_ref_->apply_mutex());
+  uint64_t n_nodes = 0, n_edges = 0;
+  std::vector<NodeId> ids, src, dst;
+  std::vector<int32_t> ntypes, etypes;
+  std::vector<float> nw, ew;
+  auto fail = [&](const std::string& msg) {
+    w->Put<uint32_t>(1);
+    w->PutStr(msg);
+  };
+  // validate counts against the bytes actually present BEFORE any
+  // resize: a malformed frame declaring 2^33 rows must fail cheaply,
+  // not bad_alloc the shard out from under its other connections
+  bool ok = r->Get(&n_nodes) &&
+            n_nodes <= r->remaining() /
+                (sizeof(NodeId) + sizeof(int32_t) + sizeof(float));
+  if (ok && n_nodes > 0) {
+    ids.resize(n_nodes);
+    ntypes.resize(n_nodes);
+    nw.resize(n_nodes);
+    ok = r->GetRaw(ids.data(), n_nodes * sizeof(NodeId)) &&
+         r->GetRaw(ntypes.data(), n_nodes * sizeof(int32_t)) &&
+         r->GetRaw(nw.data(), n_nodes * sizeof(float));
+  }
+  ok = ok && r->Get(&n_edges) &&
+       n_edges <= r->remaining() /
+           (2 * sizeof(NodeId) + sizeof(int32_t) + sizeof(float));
+  if (ok && n_edges > 0) {
+    src.resize(n_edges);
+    dst.resize(n_edges);
+    etypes.resize(n_edges);
+    ew.resize(n_edges);
+    ok = r->GetRaw(src.data(), n_edges * sizeof(NodeId)) &&
+         r->GetRaw(dst.data(), n_edges * sizeof(NodeId)) &&
+         r->GetRaw(etypes.data(), n_edges * sizeof(int32_t)) &&
+         r->GetRaw(ew.data(), n_edges * sizeof(float));
+  }
+  if (!ok) {
+    fail("truncated delta body");
+    return;
+  }
+  {
+    // an index we cannot rebuild must refuse the delta — serving has()
+    // filters off a pre-delta index would be silent staleness
+    std::lock_guard<std::mutex> lk(state_mu_);
+    if (index_ != nullptr && index_spec_.empty()) {
+      fail("shard has an attribute index but no index_spec to rebuild "
+           "it after a delta; start the server with index_spec");
+      return;
+    }
+  }
+  std::shared_ptr<const Graph> base = graph_ref_->get();
+  std::unique_ptr<Graph> next;
+  std::vector<NodeId> dirty;
+  Status s = ApplyGraphDelta(
+      *base, ids.data(), ntypes.data(), nw.data(), n_nodes, src.data(),
+      dst.data(), etypes.data(), ew.data(), n_edges, shard_idx_, shard_num_,
+      &next, &dirty);
+  if (!s.ok()) {
+    fail(s.message());
+    return;
+  }
+  std::shared_ptr<const Graph> fresh(std::move(next));
+  std::shared_ptr<IndexManager> new_index;
+  if (!index_spec_.empty()) {
+    new_index = std::make_shared<IndexManager>();
+    s = new_index->BuildFromSpec(*fresh, index_spec_);
+    if (!s.ok()) {
+      fail("index rebuild after delta failed: " + s.message());
+      return;
+    }
+  }
+  uint64_t epoch = fresh->epoch();
+  uint64_t old_uid = base->uid();
+  {
+    std::lock_guard<std::mutex> lk(state_mu_);
+    // apply_mu_ serializes server applies; SwapFrom additionally guards
+    // against an embedded-handle apply racing a SHARED ref (tests)
+    if (!graph_ref_->SwapFrom(base, std::move(fresh), std::move(dirty))) {
+      fail("concurrent delta apply on this shard's graph; retry");
+      return;
+    }
+    index_ = new_index;  // null when the server has no index
+  }
+  UdfResultCache::Instance().EvictGraph(old_uid);
+  ET_LOG(INFO) << "shard " << shard_idx_ << " applied delta (" << n_nodes
+               << " nodes, " << n_edges << " edges) -> epoch " << epoch;
+  w->Put<uint32_t>(0);
+  w->Put<uint64_t>(epoch);
+}
+
+void GraphServer::HandleGetDelta(ByteReader* r, ByteWriter* w) {
+  uint64_t from = 0;
+  if (!r->Get(&from)) {
+    w->Put<uint32_t>(1);
+    w->PutStr("truncated get-delta body");
+    return;
+  }
+  std::vector<NodeId> ids;
+  uint64_t epoch = 0;
+  bool covered = graph_ref_->DirtySince(from, &ids, &epoch);
+  w->Put<uint32_t>(0);
+  w->Put<uint64_t>(epoch);
+  w->Put<uint8_t>(covered ? 1 : 0);
+  w->Put<uint64_t>(static_cast<uint64_t>(ids.size()));
+  if (!ids.empty()) w->PutRaw(ids.data(), ids.size() * sizeof(NodeId));
 }
 
 void GraphServer::HandleConnection(int fd) {
@@ -485,6 +641,12 @@ void GraphServer::HandleConnection(int fd) {
       HandleExecute(&r, &w);
     } else if (msg_type == kMeta) {
       BuildMeta(&w);
+    } else if (msg_type == kApplyDelta) {
+      ByteReader r(body.data(), body.size());
+      HandleApplyDelta(&r, &w);
+    } else if (msg_type == kGetDelta) {
+      ByteReader r(body.data(), body.size());
+      HandleGetDelta(&r, &w);
     } else {  // ping
       w.Put<uint32_t>(0);
     }
@@ -511,25 +673,58 @@ void GraphServer::HandleConnection(int fd) {
 bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
                                 uint32_t msg_type, uint64_t request_id,
                                 uint32_t flags, std::vector<char> body) {
-  // shared reply writer: adaptive compression (only if the hello offered
-  // it, the raw body clears the client's threshold, AND deflate actually
-  // shrinks it), then one frame under the per-connection write lock
-  auto write_reply = [conn](uint32_t mt, uint64_t rid,
-                            const std::vector<char>& payload) {
+  // shared reply writer: optional epoch prefix (hello-negotiated — the
+  // passive bump-observation channel for mux clients), then adaptive
+  // compression (only if the hello offered it, the body clears the
+  // client's threshold, AND deflate actually shrinks it), then one
+  // frame under the per-connection write lock
+  auto write_reply = [this, conn](uint32_t mt, uint64_t rid,
+                                  const std::vector<char>& payload) {
     uint32_t out_flags = 0;
-    const std::vector<char>* out = &payload;
+    uint64_t epoch = 0;
+    const bool stamp = conn->peer_epoch && mt != kHello;
+    if (stamp) {
+      epoch = graph_ref_->epoch();
+      out_flags |= kFrameFlagEpoch;
+    }
+    const size_t raw_len = payload.size() + (stamp ? 8 : 0);
     std::vector<char> comp;
+    bool compressed = false;
     if (conn->peer_compress && conn->peer_threshold > 0 &&
-        payload.size() >= conn->peer_threshold &&
-        DeflateBody(payload, &comp)) {
-      out = &comp;
-      out_flags |= kFrameFlagCompressed;
+        raw_len >= conn->peer_threshold) {
+      // the epoch prefix lives INSIDE the deflate stream; this branch
+      // already pays buffer copies, so stamping-by-copy is free here
+      std::vector<char> stamped;
+      const std::vector<char>* src = &payload;
+      if (stamp) {
+        stamped.reserve(raw_len);
+        stamped.resize(8);
+        std::memcpy(stamped.data(), &epoch, 8);
+        stamped.insert(stamped.end(), payload.begin(), payload.end());
+        src = &stamped;
+      }
+      compressed = DeflateBody(*src, &comp);
+      if (compressed) out_flags |= kFrameFlagCompressed;
     }
     std::lock_guard<std::mutex> lk(conn->wmu);
     if (conn->write_broken) return;
-    if (!WriteFrameV2(conn->fd, mt, out_flags, rid, out->data(),
-                      out->size()))
-      conn->write_broken = true;
+    bool ok;
+    if (compressed) {
+      ok = WriteFrameV2(conn->fd, mt, out_flags, rid, comp.data(),
+                        comp.size());
+    } else if (stamp) {
+      // scatter write (header | epoch | body): prepending 8 bytes must
+      // not cost an O(body) copy on every uncompressed reply
+      char hdr[kV2HdrLen];
+      FillV2Hdr(hdr, mt, out_flags, rid, raw_len);
+      ok = WriteAll(conn->fd, hdr, kV2HdrLen) &&
+           WriteAll(conn->fd, reinterpret_cast<const char*>(&epoch), 8) &&
+           WriteAll(conn->fd, payload.data(), payload.size());
+    } else {
+      ok = WriteFrameV2(conn->fd, mt, out_flags, rid, payload.data(),
+                        payload.size());
+    }
+    if (!ok) conn->write_broken = true;
   };
 
   if ((flags & kFrameFlagCompressed) != 0) {
@@ -545,12 +740,40 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     // reader-thread-only writes, and every dispatch happens after the
     // hello on the same thread — no lock needed
     conn->peer_compress = (feats & kFeatAcceptCompressed) != 0;
+    conn->peer_epoch = (feats & kFeatEpoch) != 0;
     conn->peer_threshold = thresh;
     ByteWriter w;
     w.Put<uint32_t>(kProtoV2);
-    w.Put<uint32_t>(kFeatAcceptCompressed);
+    w.Put<uint32_t>(kFeatAcceptCompressed | kFeatEpoch);
     w.Put<uint64_t>(thresh);
     write_reply(kHello, request_id, w.buffer());
+    return true;
+  }
+  if (msg_type == kApplyDelta || msg_type == kGetDelta) {
+    // Off the reader thread: an apply's O(graph) snapshot rebuild on
+    // this thread would stall every pipelined request multiplexed on
+    // the connection (kExecute dispatches async for the same reason).
+    // Counted in conn->inflight so close drains it; apply_mu_ already
+    // serializes concurrent applies.
+    {
+      std::lock_guard<std::mutex> lk(conn->imu);
+      ++conn->inflight;
+    }
+    GlobalThreadPool()->Schedule(
+        [this, conn, write_reply, msg_type, request_id,
+         body = std::move(body)] {
+          ByteWriter w;
+          ByteReader r(body.data(), body.size());
+          if (msg_type == kApplyDelta) {
+            HandleApplyDelta(&r, &w);
+          } else {
+            HandleGetDelta(&r, &w);
+          }
+          write_reply(msg_type, request_id, w.buffer());
+          std::lock_guard<std::mutex> lk(conn->imu);
+          --conn->inflight;
+          conn->icv.notify_all();
+        });
     return true;
   }
   if (msg_type != kExecute) {
@@ -582,6 +805,10 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     DAGDef dag;
     std::vector<std::string> outputs;
     std::unique_ptr<Executor> exec;
+    // pins the snapshot this request runs against: a concurrent delta
+    // apply swaps the ref, and the old graph must outlive the execution
+    std::shared_ptr<const Graph> graph;
+    std::shared_ptr<IndexManager> index;
   };
   auto p = std::make_shared<Pending>();
   auto finish = [conn, write_reply, request_id](const ExecuteReply& rep) {
@@ -604,9 +831,10 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
   for (auto& kv : req.inputs) p->ctx.Put(kv.first, std::move(kv.second));
   p->dag.nodes = std::move(req.nodes);
   p->outputs = std::move(req.outputs);
+  SnapshotState(&p->graph, &p->index);
   QueryEnv env;
-  env.graph = graph_.get();
-  env.index = index_.get();
+  env.graph = p->graph.get();
+  env.index = p->index.get();
   env.pool = GlobalThreadPool();
   p->exec = std::make_unique<Executor>(&p->dag, env, &p->ctx);
   // completion owns the last ref to p: the executor releases its stored
@@ -643,9 +871,12 @@ void GraphServer::HandleExecute(ByteReader* r, ByteWriter* w) {
     for (auto& kv : req.inputs) ctx.Put(kv.first, std::move(kv.second));
     DAGDef dag;
     dag.nodes = std::move(req.nodes);
+    std::shared_ptr<const Graph> g;
+    std::shared_ptr<IndexManager> idx;
+    SnapshotState(&g, &idx);
     QueryEnv env;
-    env.graph = graph_.get();
-    env.index = index_.get();
+    env.graph = g.get();
+    env.index = idx.get();
     env.pool = GlobalThreadPool();
     Executor exec(&dag, env, &ctx);
     s = exec.RunSync();
@@ -675,11 +906,12 @@ void GraphServer::HandleExecute(ByteReader* r, ByteWriter* w) {
 class RpcChannel::MuxConn {
  public:
   MuxConn(int fd, bool peer_compress, int64_t compress_threshold,
-          int max_inflight)
+          int max_inflight, std::atomic<uint64_t>* epoch_sink)
       : fd_(fd),
         peer_compress_(peer_compress),
         compress_threshold_(compress_threshold),
-        max_inflight_(std::max(max_inflight, 1)) {
+        max_inflight_(std::max(max_inflight, 1)),
+        epoch_sink_(epoch_sink) {
     reader_ = std::thread([this] { ReaderLoop(); });
   }
 
@@ -814,6 +1046,15 @@ class RpcChannel::MuxConn {
         body = std::move(raw);
         ctr.compressed_frames_received.fetch_add(1);
       }
+      if ((flags & kFrameFlagEpoch) != 0) {
+        // epoch prefix: the serving graph's version stamp rides every
+        // reply — strip it and max-update the owner's observed epoch
+        if (body.size() < 8) break;  // protocol error
+        uint64_t epoch;
+        std::memcpy(&epoch, body.data(), 8);
+        MaxUpdateEpoch(epoch_sink_, epoch);
+        body.erase(body.begin(), body.begin() + 8);
+      }
       ctr.bytes_received.fetch_add(wire);
       ctr.bytes_received_raw.fetch_add(kV2HdrLen + body.size());
       Waiter* async_w = nullptr;
@@ -879,6 +1120,7 @@ class RpcChannel::MuxConn {
   const bool peer_compress_;
   const int64_t compress_threshold_;
   const int max_inflight_;
+  std::atomic<uint64_t>* const epoch_sink_;
   std::atomic<uint64_t> next_id_{1};
   std::mutex wmu_;  // one writer at a time on the shared fd
   std::mutex mu_;   // waiters_ + broken_
@@ -1002,7 +1244,7 @@ std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
   const RpcConfig cfg = GlobalRpcConfig();
   ByteWriter hw;
   hw.Put<uint32_t>(kProtoV2);
-  hw.Put<uint32_t>(kFeatAcceptCompressed);
+  hw.Put<uint32_t>(kFeatAcceptCompressed | kFeatEpoch);
   const int64_t hello_thr = cfg.compress_threshold.load();
   hw.Put<uint64_t>(static_cast<uint64_t>(hello_thr > 0 ? hello_thr : 0));
   std::vector<char> hbody;
@@ -1043,7 +1285,7 @@ std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
   }
   auto conn = std::make_shared<MuxConn>(fd, peer_compress,
                                         cfg.compress_threshold,
-                                        cfg.max_inflight);
+                                        cfg.max_inflight, epoch_sink_);
   if (slot >= static_cast<int>(mux_conns_.size()))
     mux_conns_.resize(slot + 1);
   mux_conns_[slot] = conn;
@@ -1559,6 +1801,7 @@ void ClientManager::WatchRegistry(const std::string& dir, int interval_ms,
           // is also how a v1-fallback channel regains mux after the
           // shard restarts on a v2 binary
           if (GlobalRpcConfig().mux) channels_[shard]->set_mux(true);
+          channels_[shard]->set_epoch_sink(&observed_epoch_);
           fresh = channels_[shard];
         }
       }
@@ -1592,6 +1835,7 @@ Status ClientManager::Init(const ShardEndpoints& eps) {
     // process-global config; registry channels (RegistryPutEntry & co.
     // build their own short-lived RpcChannel) always speak v1
     if (GlobalRpcConfig().mux) channels_.back()->set_mux(true);
+    channels_.back()->set_epoch_sink(&observed_epoch_);
   }
   std::vector<ShardMeta> metas(channels_.size());
   for (size_t s = 0; s < channels_.size(); ++s) {
@@ -1664,6 +1908,103 @@ Status ClientManager::Execute(int shard, const ExecuteRequest& req,
   ByteReader r(reply.data(), reply.size());
   ET_RETURN_IF_ERROR(DecodeExecuteReply(&r, rep));
   return rep->status;
+}
+
+Status ClientManager::ApplyDelta(
+    const NodeId* node_ids, const int32_t* node_types,
+    const float* node_weights, size_t n_nodes, const NodeId* edge_src,
+    const NodeId* edge_dst, const int32_t* edge_types,
+    const float* edge_weights, size_t n_edges, uint64_t* new_epoch) {
+  // normalize optional columns once so every shard sees identical bytes
+  std::vector<int32_t> nt_buf, et_buf;
+  std::vector<float> nw_buf, ew_buf;
+  if (node_types == nullptr) nt_buf.assign(n_nodes, 0);
+  if (node_weights == nullptr) nw_buf.assign(n_nodes, 1.0f);
+  if (edge_types == nullptr) et_buf.assign(n_edges, 0);
+  if (edge_weights == nullptr) ew_buf.assign(n_edges, 1.0f);
+  ByteWriter w;
+  w.Put<uint64_t>(n_nodes);
+  if (n_nodes > 0) {
+    w.PutRaw(node_ids, n_nodes * sizeof(NodeId));
+    w.PutRaw(node_types ? node_types : nt_buf.data(),
+             n_nodes * sizeof(int32_t));
+    w.PutRaw(node_weights ? node_weights : nw_buf.data(),
+             n_nodes * sizeof(float));
+  }
+  w.Put<uint64_t>(n_edges);
+  if (n_edges > 0) {
+    w.PutRaw(edge_src, n_edges * sizeof(NodeId));
+    w.PutRaw(edge_dst, n_edges * sizeof(NodeId));
+    w.PutRaw(edge_types ? edge_types : et_buf.data(),
+             n_edges * sizeof(int32_t));
+    w.PutRaw(edge_weights ? edge_weights : ew_buf.data(),
+             n_edges * sizeof(float));
+  }
+  uint64_t max_epoch = 0;
+  // Serial on purpose for now: applies are rare, and first-failure-
+  // stops keeps the retry story trivial (re-issue is idempotent).
+  // Concurrent fan-out (ExecuteAsync-style) is the staged follow-up
+  // for wide fleets where N × rebuild wall and the mixed-epoch window
+  // start to matter.
+  for (int s = 0; s < shard_num(); ++s) {
+    std::vector<char> reply;
+    ET_RETURN_IF_ERROR(Channel(s)->Call(kApplyDelta, w.buffer(), &reply));
+    ByteReader r(reply.data(), reply.size());
+    uint32_t code = 1;
+    if (!r.Get(&code)) return Status::IOError("truncated delta reply");
+    if (code != 0) {
+      std::string msg;
+      r.GetStr(&msg);
+      return Status::Internal("shard " + std::to_string(s) +
+                              " refused delta: " + msg);
+    }
+    uint64_t epoch = 0;
+    if (!r.Get(&epoch)) return Status::IOError("truncated delta reply");
+    max_epoch = std::max(max_epoch, epoch);
+    // a shard's weight sums / counts changed — refresh its routing meta
+    // so proportional SAMPLE_SPLIT reflects the post-delta distribution
+    std::vector<char> mreply;
+    Status ms = Channel(s)->Call(kMeta, {}, &mreply);
+    RefreshMeta(s, ms, mreply);
+  }
+  MaxUpdateEpoch(&observed_epoch_, max_epoch);
+  if (new_epoch != nullptr) *new_epoch = max_epoch;
+  return Status::OK();
+}
+
+Status ClientManager::DeltaSince(uint64_t from, uint64_t* epoch,
+                                 bool* covered, std::vector<NodeId>* ids) {
+  ByteWriter w;
+  w.Put<uint64_t>(from);
+  uint64_t max_epoch = 0;
+  bool all_covered = true;
+  ids->clear();
+  for (int s = 0; s < shard_num(); ++s) {
+    std::vector<char> reply;
+    ET_RETURN_IF_ERROR(Channel(s)->Call(kGetDelta, w.buffer(), &reply));
+    ByteReader r(reply.data(), reply.size());
+    uint32_t code = 1;
+    uint64_t sh_epoch = 0, n = 0;
+    uint8_t cov = 0;
+    if (!r.Get(&code) || code != 0 || !r.Get(&sh_epoch) || !r.Get(&cov) ||
+        !r.Get(&n) || n > r.remaining() / sizeof(NodeId))
+      return Status::IOError("bad get-delta reply from shard " +
+                             std::to_string(s));
+    size_t base = ids->size();
+    ids->resize(base + n);
+    if (n > 0 && !r.GetRaw(ids->data() + base, n * sizeof(NodeId)))
+      return Status::IOError("truncated get-delta ids from shard " +
+                             std::to_string(s));
+    max_epoch = std::max(max_epoch, sh_epoch);
+    all_covered = all_covered && cov != 0;
+  }
+  std::sort(ids->begin(), ids->end());
+  ids->erase(std::unique(ids->begin(), ids->end()), ids->end());
+  MaxUpdateEpoch(&observed_epoch_, max_epoch);
+  *epoch = max_epoch;
+  *covered = all_covered;
+  if (!all_covered) ids->clear();
+  return Status::OK();
 }
 
 void ClientManager::ExecuteAsync(

@@ -17,8 +17,11 @@
 // Frame v1: u32 'ETFR' | u32 msg_type | u64 body_len | body
 // Frame v2: u32 'ETF2' | u32 msg_type | u32 flags | u64 request_id
 //         | u64 body_len | body        (flags bit 0: body zlib-deflated,
-//           laid out as u64 raw_len | deflate stream)
-// msg types: 0 = Execute, 1 = ShardMeta, 2 = Ping, 6 = Hello (v2 only).
+//           laid out as u64 raw_len | deflate stream; flags bit 1:
+//           reply body prefixed with the serving graph's u64 epoch —
+//           hello-negotiated, applied before compression)
+// msg types: 0 = Execute, 1 = ShardMeta, 2 = Ping, 6 = Hello (v2 only),
+//            7 = ApplyDelta, 8 = GetDelta (streaming graph deltas).
 //
 // v2 is negotiated per connection: a v2 client opens with a Hello frame
 // carrying (version, feature bits, compress threshold); a v2 server
@@ -136,7 +139,20 @@ class GraphServer {
   GraphServer(std::shared_ptr<const Graph> graph,
               std::shared_ptr<IndexManager> index, int shard_idx,
               int shard_num, int partition_num);
+  // Streaming form: the server serves whatever snapshot the ref holds —
+  // kApplyDelta swaps a new one in while in-flight requests finish on
+  // the old (each execution pins its snapshot shared_ptr).
+  GraphServer(std::shared_ptr<GraphRef> graph_ref,
+              std::shared_ptr<IndexManager> index, int shard_idx,
+              int shard_num, int partition_num);
   ~GraphServer();
+
+  // Index spec to rebuild attribute indexes from after a delta apply
+  // ("" = no index). Set before Start.
+  void set_index_spec(std::string spec) { index_spec_ = std::move(spec); }
+
+  // This shard's swappable graph holder (tests / embedded callers).
+  const std::shared_ptr<GraphRef>& graph_ref() const { return graph_ref_; }
 
   Status Start(int port);
   void Stop();
@@ -167,9 +183,18 @@ class GraphServer {
                      uint32_t msg_type, uint64_t request_id,
                      uint32_t flags, std::vector<char> body);
   void BuildMeta(ByteWriter* w) const;
+  // Streaming delta verbs (shared by the v1 and v2 frame paths).
+  void HandleApplyDelta(ByteReader* r, ByteWriter* w);
+  void HandleGetDelta(ByteReader* r, ByteWriter* w);
+  // Current-snapshot pair for one request (graph pinned, index coherent
+  // with it — index_ swaps under state_mu_ on delta apply).
+  void SnapshotState(std::shared_ptr<const Graph>* g,
+                     std::shared_ptr<IndexManager>* idx) const;
 
-  std::shared_ptr<const Graph> graph_;
+  std::shared_ptr<GraphRef> graph_ref_;
   std::shared_ptr<IndexManager> index_;
+  mutable std::mutex state_mu_;  // index_ swap vs request snapshots
+  std::string index_spec_;
   int shard_idx_, shard_num_, partition_num_;
   bool v1_only_ = false;  // EULER_TPU_RPC_SERVER_V1: emulate a pre-v2
                           // binary exactly (interop tests)
@@ -231,6 +256,12 @@ class RpcChannel : public std::enable_shared_from_this<RpcChannel> {
   void set_mux(bool on) { mux_ = on; }
   bool mux_active() const { return mux_ && !v1_fallback_.load(); }
 
+  // Epoch sink: v2 reply frames carry the serving graph's epoch (flag
+  // bit, hello-negotiated); the demux reader max-updates *sink with it
+  // so the owner (ClientManager) observes bumps passively on every
+  // reply. The sink must outlive the channel. nullptr disables.
+  void set_epoch_sink(std::atomic<uint64_t>* sink) { epoch_sink_ = sink; }
+
   const std::string& host() const { return host_; }
   int port() const { return port_; }
 
@@ -249,6 +280,7 @@ class RpcChannel : public std::enable_shared_from_this<RpcChannel> {
   std::string host_;
   int port_;
   int timeout_ms_ = 0;
+  std::atomic<uint64_t>* epoch_sink_ = nullptr;
   std::mutex mu_;
   std::vector<int> free_fds_;
   bool mux_ = false;
@@ -386,6 +418,25 @@ class ClientManager {
   void ExecuteAsync(int shard, ExecuteRequest req,
                     std::function<void(Status, ExecuteReply)> done);
 
+  // ---- streaming deltas ----
+  // Highest graph epoch observed on any reply from any shard (passive:
+  // v2 frames piggyback it; DeltaSince/ApplyDelta refresh it actively).
+  uint64_t ObservedEpoch() const { return observed_epoch_.load(); }
+  // Broadcast one batched delta to every shard (each applies its hash-
+  // owned rows and bumps its epoch). Idempotent per shard — a retry
+  // after a partial failure re-applies the same rows (last-write-wins)
+  // and only advances the epoch again. *new_epoch gets the max epoch.
+  Status ApplyDelta(const NodeId* node_ids, const int32_t* node_types,
+                    const float* node_weights, size_t n_nodes,
+                    const NodeId* edge_src, const NodeId* edge_dst,
+                    const int32_t* edge_types, const float* edge_weights,
+                    size_t n_edges, uint64_t* new_epoch);
+  // Union of the shards' dirty sets for epochs > from. *covered is
+  // false when ANY shard's history no longer reaches `from` (caller
+  // must treat everything as dirty). *epoch gets the max current epoch.
+  Status DeltaSince(uint64_t from, uint64_t* epoch, bool* covered,
+                    std::vector<NodeId>* ids);
+
  private:
   std::shared_ptr<RpcChannel> Channel(int shard) const;
   // Decode + install a shard's re-fetched ShardMeta after a failover
@@ -408,6 +459,8 @@ class ClientManager {
   GraphMeta graph_meta_;
   int partition_num_ = 1;
   std::unique_ptr<ServerMonitor> monitor_;
+  // max graph epoch seen on any shard reply (channels' epoch sink)
+  std::atomic<uint64_t> observed_epoch_{0};
 };
 
 }  // namespace et

@@ -93,6 +93,28 @@ void UdfResultCache::Clear() {
   bytes_ = 0;
 }
 
+size_t UdfResultCache::EvictGraph(uint64_t graph_uid) {
+  std::lock_guard<std::mutex> lk(mu_);
+  size_t dropped = 0;
+  for (auto it = map_.begin(); it != map_.end();) {
+    if (it->second.col->graph_uid == graph_uid) {
+      bytes_ -= EntryBytes(it->second);
+      lru_.erase(it->second.lru_it);
+      it = map_.erase(it);
+      ++dropped;
+    } else {
+      ++it;
+    }
+  }
+  epoch_evictions_ += dropped;
+  return dropped;
+}
+
+uint64_t UdfResultCache::EpochEvictions() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return epoch_evictions_;
+}
+
 void UdfResultCache::Stats(uint64_t* hits, uint64_t* misses,
                            uint64_t* entries, uint64_t* bytes) const {
   std::lock_guard<std::mutex> lk(mu_);

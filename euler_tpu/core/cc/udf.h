@@ -117,6 +117,16 @@ class UdfResultCache {
              uint64_t* bytes) const;
   void SetCapacityBytes(size_t cap);
 
+  // Epoch-bump invalidation (streaming deltas): a delta apply swaps in
+  // a NEW Graph snapshot (new uid), so entries keyed on the old uid can
+  // never be served again — drop exactly those (entries for other
+  // graphs are retained) and count them, instead of letting dead
+  // entries squat in the LRU until capacity pressure. Returns the
+  // number evicted; the cumulative count is EpochEvictions()
+  // (udf_cache_epoch_evictions_total on the Python obs registry).
+  size_t EvictGraph(uint64_t graph_uid);
+  uint64_t EpochEvictions() const;
+
  private:
   struct Entry {
     std::shared_ptr<const CachedColumn> col;
@@ -132,6 +142,7 @@ class UdfResultCache {
   size_t bytes_ = 0;
   size_t cap_bytes_ = 64u << 20;
   uint64_t hits_ = 0, misses_ = 0;
+  uint64_t epoch_evictions_ = 0;
 };
 
 // FNV-1a over (graph uid, registry generation, udf spec, fid, ids),

@@ -164,6 +164,17 @@ def _declare(lib: ctypes.CDLL) -> None:
         # configure_rpc() / rpc_transport_stats() for the friendly wrapper
         "etg_rpc_config": (None, [i32, i32, i64, i32]),
         "etg_rpc_stats": (None, [c_u64p]),
+        # streaming deltas: graph epoch + batched O(delta) apply +
+        # dirty-set retrieval, on embedded handles (etg_*) and query
+        # proxies (etq_* — local swaps the handle's graph, distribute
+        # broadcasts kApplyDelta to every shard)
+        "etg_graph_epoch": (i64, [i64]),
+        "etg_apply_delta": (i32, [i64, i64, c_u64p, c_i32p, c_f32p, i64, c_u64p, c_u64p, c_i32p, c_f32p, c_i64p]),
+        "etg_delta_since": (i32, [i64, i64, c_voidp, c_i64p, c_i32p]),
+        "etg_udf_cache_epoch_evictions": (u64, []),
+        "etq_epoch": (i64, [i64]),
+        "etq_apply_delta": (i32, [i64, i64, c_u64p, c_i32p, c_f32p, i64, c_u64p, c_u64p, c_i32p, c_f32p, c_i64p]),
+        "etq_delta_since": (i32, [i64, i64, c_voidp, c_i64p, c_i32p]),
         "et_udf_emit": (None, [c_voidp, c_u64p, i64, c_f32p, i64]),
         "etq_exec_new": (i64, [i64]),
         "etq_exec_add_input": (i32, [i64, ctypes.c_char_p, i32, i32, c_i64p, c_voidp]),

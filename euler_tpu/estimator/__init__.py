@@ -6,3 +6,4 @@ from euler_tpu.estimator.estimators import (  # noqa: F401
     NodeEstimator,
     SampleEstimator,
 )
+from euler_tpu.estimator.streaming import StreamingDriver  # noqa: F401

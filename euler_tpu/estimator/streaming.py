@@ -1,0 +1,165 @@
+"""Continuous-learning loop over a mutating graph (streaming deltas).
+
+The reference system was deployed on live e-commerce graphs: nodes and
+edges keep arriving while training and serving run (the TF-GNN
+production train→export→serve loop, arxiv 2207.03522). The engine-side
+pieces are a graph epoch + batched ``apply_delta`` (O(delta) dirty-set
+bookkeeping, RCU snapshot swap); this module composes the loop END TO
+END on top of them:
+
+    driver = StreamingDriver(estimator, engine,
+                             device_table=table,        # optional
+                             caches=[cached_engine],    # optional
+                             serving_client=client,     # optional
+                             export_dir="/bundles")
+    driver.apply_delta(node_ids=new_ids, edge_src=s, edge_dst=d)
+    driver.fine_tune(steps=50)
+    driver.export_and_swap()      # fresh bundle → rolling fleet swap
+
+After ``export_and_swap`` returns, a kNN query against the serving
+fleet reflects nodes that did not exist at train start — the ROADMAP
+item-3 acceptance. Every maintenance step is COUNTED, never assumed:
+cache invalidation via ``cache_epoch_{evicted,retained}_total``, alias
+patching via ``alias_rows_{patched,rebuilt}_total``, and the driver's
+own ``streaming_{deltas,exports,swaps}_total``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Iterable, Optional
+
+from euler_tpu import obs as _obs
+
+
+class StreamingDriver:
+    """Composes delta apply → derived-state maintenance → fine-tune →
+    export → fleet hot-swap, with one stats dict per step.
+
+    estimator: a BaseEstimator (used for fine_tune / export_bundle).
+    engine: the graph engine deltas go through. If it is itself a
+      CachedGraphEngine the cache invalidates inline; additional caches
+      (other clients' wrappers in-process) go in `caches`.
+    device_table: a DeviceNeighborTable to patch per dirty row
+      (replicated split layout; alias tables patch with it).
+    serving_client: a ServingClient whose fleet export_and_swap()
+      promotes fresh bundles into.
+    export_dir: where versioned bundles land (one subdir per version).
+    """
+
+    def __init__(self, estimator, engine, device_table=None,
+                 caches: Iterable = (), serving_client=None,
+                 export_dir: Optional[str] = None, shards: int = 1):
+        self.estimator = estimator
+        self.engine = engine
+        self.device_table = device_table
+        self.caches = list(caches)
+        self.serving_client = serving_client
+        self.export_dir = export_dir
+        self.shards = int(shards)
+        self._exports = 0
+        reg = _obs.default_registry()
+        self._ctr = {
+            k: reg.counter(f"streaming_{k}_total", h)
+            for k, h in (
+                ("deltas", "graph deltas applied through StreamingDriver"),
+                ("exports", "bundles exported by StreamingDriver"),
+                ("swaps", "serving-fleet hot-swaps by StreamingDriver"),
+            )}
+        self._g_epoch = reg.gauge(
+            "streaming_graph_epoch",
+            "graph epoch after the driver's last delta")
+
+    # -- the loop's steps --------------------------------------------------
+    def apply_delta(self, **delta) -> Dict[str, Any]:
+        """Apply one batched delta mid-train and maintain every piece of
+        derived state O(delta): the engine swaps in the new snapshot
+        (epoch bump), wrapped caches evict exactly the dirty ids, and
+        the device neighbor/alias tables patch only the dirty rows.
+        Returns {epoch, dirty, table, caches}."""
+        from euler_tpu.graph.api import delta_dirty_ids
+
+        epoch = self.engine.apply_delta(**delta)
+        dirty = delta_dirty_ids(**delta)
+        self._ctr["deltas"].inc()
+        self._g_epoch.set(epoch)
+        table_stats = None
+        if self.device_table is not None:
+            # patch against the post-delta engine (row identity is
+            # append-only, so only dirty rows re-derive)
+            table_stats = self.device_table.patch_rows(
+                self._graph_view(), dirty)
+        cache_stats = []
+        for cache in self.caches:
+            # out-of-band caches reconcile from the engine's dirty
+            # history (the engine wrapper, if any, already did inline)
+            maybe = getattr(cache, "maybe_invalidate", None)
+            if callable(maybe):
+                maybe()
+                stats = getattr(cache, "cache_stats", None)
+                cache_stats.append(stats() if callable(stats) else None)
+        return {"epoch": epoch, "dirty": int(dirty.size),
+                "table": table_stats, "caches": cache_stats}
+
+    def _graph_view(self):
+        """The object device-table patching queries (node_rows /
+        get_full_neighbor): the engine itself, unwrapped from chaos or
+        cache layers so a patch never trips fault injection."""
+        eng = self.engine
+        seen = set()
+        while id(eng) not in seen:
+            seen.add(id(eng))
+            inner = getattr(eng, "_engine", None)
+            if inner is None:
+                break
+            eng = inner
+        return eng
+
+    def fine_tune(self, steps: int, input_fn=None) -> Dict[str, float]:
+        """Continue training for `steps` MORE steps on the post-delta
+        graph (the estimator's own train loop — resilient input path,
+        chaos machinery and all). BaseEstimator.train's max_steps is an
+        ABSOLUTE global-step bound, so offset from the current step —
+        passing `steps` raw would silently no-op after any prior
+        training. Default input_fn: the estimator's train_input_fn."""
+        fn = input_fn if input_fn is not None else \
+            self.estimator.train_input_fn
+        state = self.estimator.state            # None before first train
+        target = (int(state.step) if state is not None else 0) + int(steps)
+        return self.estimator.train(fn, max_steps=target)
+
+    def export_and_swap(self, version: Optional[str] = None,
+                        **export_kw) -> Dict[str, Any]:
+        """Export a fresh versioned bundle of the CURRENT params +
+        embeddings (new nodes included — embed_all sweeps the post-delta
+        graph) and roll it through the serving fleet with the
+        zero-downtime hot-swap. Without a serving_client the export
+        still happens (pull-based deployments)."""
+        if self.export_dir is None:
+            raise ValueError("StreamingDriver needs export_dir to export")
+        self._exports += 1
+        version = version if version is not None else \
+            f"stream{self._exports}-{int(time.time())}"
+        out_dir = os.path.join(self.export_dir, str(version))
+        self.estimator.export_bundle(out_dir, shards=self.shards,
+                                     version=version, **export_kw)
+        self._ctr["exports"].inc()
+        swap = None
+        if self.serving_client is not None:
+            swap = self.serving_client.swap_fleet(out_dir)
+            self._ctr["swaps"].inc()
+        return {"version": version, "bundle_dir": out_dir, "swap": swap}
+
+    def round(self, delta: Dict[str, Any], steps: int,
+              train_input_fn=None, version: Optional[str] = None,
+              **export_kw) -> Dict[str, Any]:
+        """One full continuous-learning round: delta → fine-tune →
+        export → swap. Served kNN reflects the delta's new nodes within
+        this one export period. export_kw forwards to export_bundle
+        (input_fn= there selects the inference sweep — it must cover
+        the post-delta id set for new nodes to enter the index)."""
+        out = {"delta": self.apply_delta(**delta)}
+        out["train"] = self.fine_tune(steps, input_fn=train_input_fn)
+        out.update(self.export_and_swap(version=version, **export_kw))
+        return out

@@ -241,8 +241,55 @@ class GraphBuilder:
         return GraphEngine(gh, feature_names=self._feature_names)
 
 
+def _delta_arrays(node_ids, node_types, node_weights, edge_src, edge_dst,
+                  edge_types, edge_weights):
+    """Normalize a batched delta into contiguous arrays + validate the
+    parallel lengths — one definition shared by the embedded and remote
+    engines so both reject the same malformed deltas."""
+    nid = _u64(node_ids if node_ids is not None else []).ravel()
+    n = nid.size
+    nt = _i32(node_types).ravel() if node_types is not None \
+        else np.zeros(n, np.int32)
+    nw = _f32(node_weights).ravel() if node_weights is not None \
+        else np.ones(n, np.float32)
+    es = _u64(edge_src if edge_src is not None else []).ravel()
+    ed = _u64(edge_dst if edge_dst is not None else []).ravel()
+    e = es.size
+    et = _i32(edge_types).ravel() if edge_types is not None \
+        else np.zeros(e, np.int32)
+    ew = _f32(edge_weights).ravel() if edge_weights is not None \
+        else np.ones(e, np.float32)
+    if nt.size != n or nw.size != n:
+        raise ValueError(
+            f"delta node columns disagree: {n} ids, {nt.size} types, "
+            f"{nw.size} weights")
+    if ed.size != e or et.size != e or ew.size != e:
+        raise ValueError(
+            f"delta edge columns disagree: {e} src, {ed.size} dst, "
+            f"{et.size} types, {ew.size} weights")
+    if n == 0 and e == 0:
+        raise ValueError("empty delta: nothing to apply")
+    return nid, nt, nw, es, ed, et, ew
+
+
+def delta_dirty_ids(node_ids=None, edge_src=None, edge_dst=None,
+                    **_ignored) -> np.ndarray:
+    """Sorted unique node ids a delta touches (nodes ∪ edge endpoints) —
+    what the engine records as the epoch's dirty set. Callers that just
+    issued the delta can invalidate locally from this instead of asking
+    the engine (CachedGraphEngine.apply_delta does)."""
+    parts = [np.asarray(a, dtype=np.uint64).ravel()
+             for a in (node_ids, edge_src, edge_dst) if a is not None]
+    if not parts:
+        return np.zeros(0, dtype=np.uint64)
+    return np.unique(np.concatenate(parts))
+
+
 class GraphEngine:
-    """Immutable in-process graph; all query/sampling ops live here."""
+    """In-process graph engine. Each finalized graph SNAPSHOT is
+    immutable; apply_delta() builds and atomically swaps in a new
+    snapshot behind this handle (graph_epoch() bumps, queries bound to
+    the handle see it, in-flight readers finish on the old one)."""
 
     def __init__(self, handle: int, feature_names: Optional[dict] = None):
         self._lib = _libmod.load()
@@ -377,6 +424,54 @@ class GraphEngine:
         out = np.zeros(self.node_count, dtype=np.uint64)
         _libmod.check(self._lib, self._lib.etg_all_node_ids(self.h, _ptr(out, c_u64p)))
         return out
+
+    # -- streaming deltas --------------------------------------------------
+    def graph_epoch(self) -> int:
+        """Monotonic version stamp of the current snapshot (0 =
+        as-finalized; each apply_delta bumps it)."""
+        e = self._lib.etg_graph_epoch(self.h)
+        if e < 0:
+            raise EngineError(self._lib.etg_last_error().decode())
+        return int(e)
+
+    def apply_delta(self, node_ids=None, node_types=None,
+                    node_weights=None, edge_src=None, edge_dst=None,
+                    edge_types=None, edge_weights=None) -> int:
+        """Apply a batched delta (add/update nodes and edges) and swap
+        in the new immutable snapshot. Node rows are append-only (an
+        existing node keeps its engine row; its type/weight update in
+        place), an edge that already exists updates its weight, and new
+        edges/nodes append — so derived row-indexed state (device
+        feature/neighbor tables) stays valid for untouched rows and can
+        be patched per dirty row. Returns the new epoch."""
+        nid, nt, nw, es, ed, et, ew = _delta_arrays(
+            node_ids, node_types, node_weights, edge_src, edge_dst,
+            edge_types, edge_weights)
+        out_epoch = ctypes.c_int64()
+        _libmod.check(
+            self._lib,
+            self._lib.etg_apply_delta(
+                self.h, nid.size, _ptr(nid, c_u64p), _ptr(nt, c_i32p),
+                _ptr(nw, c_f32p), es.size, _ptr(es, c_u64p),
+                _ptr(ed, c_u64p), _ptr(et, c_i32p), _ptr(ew, c_f32p),
+                ctypes.byref(out_epoch)))
+        return int(out_epoch.value)
+
+    def delta_since(self, from_epoch: int):
+        """(epoch, covered, dirty_ids): the sorted unique node ids
+        touched by every delta after `from_epoch`. covered=False means
+        the bounded per-epoch history no longer reaches from_epoch —
+        the caller must treat EVERYTHING as dirty (full flush)."""
+        out_epoch = ctypes.c_int64()
+        covered = ctypes.c_int32()
+        with _Result(self._lib) as res:
+            _libmod.check(
+                self._lib,
+                self._lib.etg_delta_since(self.h, int(from_epoch), res.h,
+                                          ctypes.byref(out_epoch),
+                                          ctypes.byref(covered)))
+            ids = res.u64()
+        return int(out_epoch.value), bool(covered.value), ids
 
     def all_node_weights(self) -> np.ndarray:
         """Per-node weights in engine-row order (all_node_ids order) —

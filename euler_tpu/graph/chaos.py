@@ -162,6 +162,22 @@ class ChaosGraphEngine:
 
         return chaotic
 
+    # -- streaming deltas (explicit delegation) ----------------------------
+    # The epoch/delta verbs are defined EXPLICITLY rather than left to
+    # __getattr__: chaos interception must never apply to them (a fault-
+    # injected apply_delta would diverge the wrapper's view of the epoch
+    # from the engine's), and an engine lacking them must raise its own
+    # AttributeError naming the engine — wrapper drift is pinned by
+    # tests/test_streaming.py's delegation test.
+    def graph_epoch(self, *args, **kwargs) -> int:
+        return self._engine.graph_epoch(*args, **kwargs)
+
+    def apply_delta(self, **delta) -> int:
+        return self._engine.apply_delta(**delta)
+
+    def delta_since(self, from_epoch: int):
+        return self._engine.delta_since(from_epoch)
+
     # -- introspection -----------------------------------------------------
     def stats(self) -> dict:
         """Injected-fault counters: calls, errors, delayed, truncated."""

@@ -15,15 +15,19 @@ feeder, lives in estimator/prefetch.py):
     degrade machinery and ``graph_rpc`` spans as the serial path, just
     against a pooled handle instead of the engine's own.
 
-  * CachedGraphEngine — the training graph is FROZEN, so deterministic
-    reads (``get_full_neighbor`` rows, ``get_dense_feature`` rows) can
-    be served from a bounded client cache. The hit/miss partition is
-    one vectorized searchsorted/take pass over sorted key arrays —
-    never a per-id Python dict loop on the hot path — and only misses
-    go over the wire. Sampling verbs are NEVER cached (a cached random
-    draw would freeze the sampling distribution), and a result produced
+  * CachedGraphEngine — deterministic reads (``get_full_neighbor``
+    rows, ``get_dense_feature`` rows) of a graph SNAPSHOT are served
+    from a bounded client cache. The hit/miss partition is one
+    vectorized searchsorted/take pass over sorted key arrays — never a
+    per-id Python dict loop on the hot path — and only misses go over
+    the wire. Sampling verbs are NEVER cached (a cached random draw
+    would freeze the sampling distribution), and a result produced
     while the underlying engine degraded (default_id padding) is NEVER
-    inserted (the poisoning guard).
+    inserted (the poisoning guard). Streaming deltas (ISSUE 9) turned
+    "the graph is frozen" into a CHECKED epoch contract: on an observed
+    graph-epoch bump the cache evicts exactly the delta's dirty ids
+    (full flush only past ``epoch_dirty_bound`` or a history gap), so
+    warm state survives mutation instead of being flushed wholesale.
 
 Everything reports through euler_tpu.obs:
 ``client_cache_{hits,misses,inserts,evicted_rows}_total{cache=...}`` +
@@ -44,6 +48,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from euler_tpu import obs as _obs
+from euler_tpu.core.lib import EngineError
 from euler_tpu.gql import Query, edge_types_str
 
 _CACHE_IDS = itertools.count()
@@ -388,6 +393,22 @@ class _DenseStore:
         self.gen = self.gen[keep]
         return dropped
 
+    def drop_ids(self, ids_sorted: np.ndarray) -> int:
+        """Surgical epoch invalidation: evict exactly the rows whose key
+        is in the (sorted unique) dirty set; every other row is
+        retained warm. One searchsorted pass — O(n log d)."""
+        if self.keys.size == 0 or ids_sorted.size == 0:
+            return 0
+        pos = np.searchsorted(ids_sorted, self.keys)
+        pos = np.minimum(pos, ids_sorted.size - 1)
+        keep = ids_sorted[pos] != self.keys
+        dropped = int((~keep).sum())
+        if dropped:
+            self.keys = self.keys[keep]
+            self.vals = self.vals[keep]
+            self.gen = self.gen[keep]
+        return dropped
+
     @property
     def nbytes(self) -> int:
         return int(self.keys.nbytes + self.vals.nbytes + self.gen.nbytes)
@@ -457,6 +478,20 @@ class _RaggedStore:
         keep = self.gen > cut
         if keep.all():
             keep = np.zeros(self.keys.size, dtype=bool)
+        return self._drop_mask(keep)
+
+    def drop_ids(self, ids_sorted: np.ndarray) -> int:
+        """Surgical epoch invalidation (see _DenseStore.drop_ids)."""
+        if self.keys.size == 0 or ids_sorted.size == 0:
+            return 0
+        pos = np.searchsorted(ids_sorted, self.keys)
+        pos = np.minimum(pos, ids_sorted.size - 1)
+        keep = ids_sorted[pos] != self.keys
+        if keep.all():
+            return 0
+        return self._drop_mask(keep)
+
+    def _drop_mask(self, keep: np.ndarray) -> int:
         dropped = int((~keep).sum())
         rows = np.flatnonzero(keep)
         counts, *cols = self.gather(rows)
@@ -501,7 +536,13 @@ class CachedGraphEngine:
     """
 
     def __init__(self, engine, budget_bytes: int = 64 << 20,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 epoch_dirty_bound: int = 262_144):
+        """epoch_dirty_bound: max dirty-id set a graph-epoch bump is
+        invalidated SURGICALLY from (evict only keys in the delta's
+        dirty set); a bigger delta — or a history gap (covered=False) —
+        falls back to the documented full flush. Counted either way:
+        cache_epoch_{evicted,retained}_total / cache_epoch_flushes_total."""
         self._engine = engine
         self._budget = int(budget_bytes)
         self._mu = threading.RLock()
@@ -522,9 +563,38 @@ class CachedGraphEngine:
                 ("poison_skips",
                  "fetches not cached because the engine degraded"),
             )}
+        # streaming-delta invalidation accounting: evicted = rows whose
+        # id was dirty, retained = warm rows that SURVIVED a bump (the
+        # state a naive full flush would have destroyed), flushes =
+        # bumps that fell back to a full flush (overflow / history gap)
+        self._ctr_epoch = {
+            k: reg.counter(f"cache_epoch_{k}_total", h,
+                           ("cache",)).labels(**lab)
+            for k, h in (
+                ("evicted", "cache rows evicted by graph epoch bumps"),
+                ("retained", "warm cache rows retained across epoch bumps"),
+                ("flushes", "epoch bumps answered with a full flush"),
+            )}
         self._g_bytes = reg.gauge(
             "client_cache_bytes", "packed client-cache array bytes",
             ("cache",)).labels(**lab)
+        self._g_epoch = reg.gauge(
+            "graph_epoch", "last graph epoch this cache reconciled to",
+            ("cache",)).labels(**lab)
+        self._dirty_bound = int(epoch_dirty_bound)
+        # last epoch this cache reconciled to; None until the engine
+        # exposes one (plain engine-shaped test doubles never do)
+        self._observed_epoch: Optional[int] = None
+        epoch_fn = getattr(engine, "graph_epoch", None)
+        if callable(epoch_fn):
+            try:
+                self._observed_epoch = int(epoch_fn())
+                self._g_epoch.set(self._observed_epoch)
+            except (EngineError, OSError, AttributeError):
+                # AttributeError: a delegating wrapper (ChaosGraphEngine)
+                # always EXPOSES graph_epoch but raises when its inner
+                # engine lacks it — that composition must keep working
+                self._observed_epoch = None
         _obs.register_health(self._obs_name, self.cache_stats)
 
     # -- passthrough -------------------------------------------------------
@@ -544,6 +614,9 @@ class CachedGraphEngine:
             out["entries"] = sum(
                 s.entries for s in (*self._dense.values(),
                                     *self._ragged.values()))
+            out["graph_epoch"] = self._observed_epoch
+        for k, c in self._ctr_epoch.items():
+            out[f"epoch_{k}"] = int(c.value)
         total = out["hits"] + out["misses"]
         out["hit_rate"] = out["hits"] / total if total else 0.0
         return out
@@ -559,6 +632,101 @@ class CachedGraphEngine:
             self._dense.clear()
             self._ragged.clear()
             self._refresh_bytes()
+
+    # -- streaming-delta epoch coherence -----------------------------------
+    def graph_epoch(self, *args, **kwargs) -> int:
+        return self._engine.graph_epoch(*args, **kwargs)
+
+    def delta_since(self, from_epoch: int):
+        return self._engine.delta_since(from_epoch)
+
+    def apply_delta(self, **delta) -> int:
+        """Apply a delta through the wrapped engine, then invalidate
+        THIS cache surgically from the delta itself — the dirty set is
+        known locally (nodes ∪ edge endpoints), so the issuing client
+        pays zero extra RPCs to stay coherent. If the engine's epoch
+        jumped FURTHER than our own delta (another client applied
+        in between), the local dirty set does not cover the gap —
+        reconcile through the engine's history instead of silently
+        skipping the intermediate epochs' dirty ids."""
+        from euler_tpu.graph.api import delta_dirty_ids
+
+        epoch = self._engine.apply_delta(**delta)
+        dirty = delta_dirty_ids(**delta)
+        gap = (self._observed_epoch is None
+               or epoch != self._observed_epoch + 1)
+        if gap:
+            try:
+                from_e = self._observed_epoch or 0
+                e2, covered, hist = self._engine.delta_since(from_e)
+                dirty = hist if covered else None
+                epoch = max(epoch, e2)
+            except (EngineError, OSError):
+                dirty = None  # can't prove coverage → flush
+        with self._mu:
+            self._apply_dirty(dirty)
+            self._observed_epoch = epoch
+            self._g_epoch.set(epoch)
+        return epoch
+
+    def maybe_invalidate(self) -> None:
+        """Reconcile the cache with the engine's current epoch. Called
+        on every cached read (one native epoch poll, ~µs) and safe to
+        call explicitly after an out-of-band delta. On a bump: evict
+        only the dirty ids when the engine's history covers the gap and
+        the set is under epoch_dirty_bound; otherwise the documented
+        full-flush fallback. No epoch surface on the engine → no-op
+        (the PR-4 immutable contract)."""
+        if self._observed_epoch is None:
+            return
+        epoch_fn = getattr(self._engine, "graph_epoch", None)
+        if not callable(epoch_fn):
+            return
+        try:
+            cur = int(epoch_fn())
+        except (EngineError, OSError, AttributeError):
+            return
+        if cur < self._observed_epoch:
+            # epoch REGRESSION: the engine (a restarted shard) lost
+            # deltas we already reconciled to — nothing can prove which
+            # warm rows still match, so flush and re-anchor
+            with self._mu:
+                self._apply_dirty(None)
+                self._observed_epoch = cur
+                self._g_epoch.set(cur)
+            return
+        if cur == self._observed_epoch:
+            return
+        try:
+            epoch, covered, dirty = self._engine.delta_since(
+                self._observed_epoch)
+        except (EngineError, OSError, AttributeError):
+            return  # transient failure: retry at the next read
+        with self._mu:
+            if not covered:
+                dirty = None  # history gap → everything is dirty
+            self._apply_dirty(dirty)
+            self._observed_epoch = max(epoch, cur)
+            self._g_epoch.set(self._observed_epoch)
+
+    def _apply_dirty(self, dirty: Optional[np.ndarray]) -> None:
+        """Under self._mu: evict dirty ids (surgical) or flush. dirty
+        None → flush; oversized dirty set → flush (documented bound)."""
+        before = sum(s.entries for s in (*self._dense.values(),
+                                         *self._ragged.values()))
+        if dirty is not None and dirty.size <= self._dirty_bound:
+            ids = np.asarray(dirty, dtype=np.uint64).ravel()
+            evicted = 0
+            for store in (*self._dense.values(), *self._ragged.values()):
+                evicted += store.drop_ids(ids)
+            self._ctr_epoch["evicted"].inc(evicted)
+            self._ctr_epoch["retained"].inc(before - evicted)
+        else:
+            self._dense.clear()
+            self._ragged.clear()
+            self._ctr_epoch["evicted"].inc(before)
+            self._ctr_epoch["flushes"].inc()
+        self._refresh_bytes()
 
     # -- internals ---------------------------------------------------------
     def _degraded_count(self) -> int:
@@ -597,6 +765,7 @@ class CachedGraphEngine:
         n = ids.size
         if n == 0:
             return self._engine.get_dense_feature(ids, fids, dims)
+        self.maybe_invalidate()
         with self._mu:
             store = self._dense.setdefault(key, _DenseStore())
             hit, pos = store.lookup(ids)
@@ -608,6 +777,7 @@ class CachedGraphEngine:
                 hit_vals = store.vals[hit_rows]
             splits = store.splits
             width = store.width
+            epoch0 = self._observed_epoch
         self._ctr["hits"].inc(n_hit)
         self._ctr["misses"].inc(n - n_hit)
         if n_hit == n:
@@ -637,10 +807,15 @@ class CachedGraphEngine:
                 # re-check under the lock: a concurrent caller may have
                 # fetched+inserted the same misses while we were on the
                 # wire — the stores' insert requires ABSENT keys, and
-                # duplicates would bloat bytes/entries for nothing
+                # duplicates would bloat bytes/entries for nothing.
+                # Epoch guard: a delta observed while we were on the
+                # wire means these rows may be PRE-delta — serving them
+                # to this caller is fine (the bump was not yet observed
+                # at fetch time), caching them would be permanent
+                # staleness.
                 hit2, _ = store.lookup(uniq)
                 fresh = ~hit2
-                if fresh.any():
+                if fresh.any() and self._observed_epoch == epoch0:
                     store.splits = store.splits or f_splits
                     store.insert(uniq[fresh], packed[fresh], gen)
                     self._ctr["inserts"].inc(int(fresh.sum()))
@@ -668,6 +843,7 @@ class CachedGraphEngine:
         key = ("nbr", edge_types_str(edge_types), bool(sorted_by_id),
                bool(in_edges))
         n = ids.size
+        self.maybe_invalidate()
         with self._mu:
             store = self._ragged.setdefault(key, _RaggedStore())
             hit, pos = store.lookup(ids)
@@ -677,6 +853,7 @@ class CachedGraphEngine:
                 hit_rows = pos[hit]
                 store.touch(hit_rows, gen)
                 h_cnt, h_nbr, h_w, h_t = store.gather(hit_rows)
+            epoch0 = self._observed_epoch
         self._ctr["hits"].inc(n_hit)
         self._ctr["misses"].inc(n - n_hit)
         counts = np.zeros(n, dtype=np.int64)
@@ -694,9 +871,12 @@ class CachedGraphEngine:
             cnt_u = np.diff(off_u)
             if not poisoned:
                 with self._mu:
-                    # same still-absent re-check as the dense path
+                    # same still-absent re-check + epoch guard as the
+                    # dense path (a mid-fetch delta orphans this batch)
                     hit2, _ = store.lookup(uniq)
                     rows = np.flatnonzero(~hit2)
+                    if rows.size and self._observed_epoch != epoch0:
+                        rows = rows[:0]
                     if rows.size:
                         cnt_f = cnt_u[rows]
                         src = (np.repeat(off_u[:-1][rows], cnt_f)

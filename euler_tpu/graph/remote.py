@@ -976,6 +976,42 @@ class RemoteGraphEngine:
         out = self._run("v(r).label().as(t)", {"r": ids})
         return out["t:0"].astype(np.int32)
 
+    # -- streaming deltas --------------------------------------------------
+    def graph_epoch(self, refresh: bool = False) -> int:
+        """Observed graph epoch. Passive by default: the max epoch seen
+        on any shard reply (v2 mux frames carry it on every reply;
+        without mux the value only moves when delta verbs run).
+        refresh=True forces one kGetDelta round trip per shard so
+        non-mux clients observe bumps made by OTHER clients."""
+        if refresh:
+            epoch, _, _ = self.delta_since(self.query.epoch())
+            return epoch
+        return self.query.epoch()
+
+    def apply_delta(self, node_ids=None, node_types=None,
+                    node_weights=None, edge_src=None, edge_dst=None,
+                    edge_types=None, edge_weights=None) -> int:
+        """Broadcast a batched delta to the cluster: every shard applies
+        the rows it hash-owns through the builder machinery and swaps
+        in a new snapshot (in-flight queries finish on the old one),
+        then this client's routing meta refreshes so weight-
+        proportional sampling reflects the post-delta graph. Returns
+        the new (max) epoch. Not wrapped in RetryPolicy: the C++
+        channel already retries transport failures, and a delta is
+        idempotent per shard — re-issuing after a partial failure
+        re-applies the same last-write-wins rows."""
+        return self.query.apply_delta(
+            node_ids=node_ids, node_types=node_types,
+            node_weights=node_weights, edge_src=edge_src,
+            edge_dst=edge_dst, edge_types=edge_types,
+            edge_weights=edge_weights)
+
+    def delta_since(self, from_epoch: int):
+        """(epoch, covered, dirty_ids): union of the shards' dirty sets
+        for epochs after from_epoch; covered=False → some shard's
+        bounded history no longer reaches it (treat all ids dirty)."""
+        return self.query.delta_since(int(from_epoch))
+
     def type_id(self, name_or_id, edge: bool = False) -> int:
         """Cluster clients resolve numeric ids/strings only — type NAME
         metadata lives in the shards' local meta and is not served over
