@@ -733,6 +733,23 @@ void etg_rpc_stats(uint64_t* out) {
   out[11] = static_cast<uint64_t>(std::max<int64_t>(c.inflight.load(), 0));
 }
 
+// out[8]: wal appends, fsyncs, replayed_records, compactions,
+// catchup_deltas, refused, torn_records, degraded (gauge: the NUMBER
+// of degraded wal instances in this process). Process-global
+// durability counters (wal.h WalCounters) — the obs registry mirrors
+// them as wal_*_total gauges (euler_tpu.gql wal_stats()).
+void etg_wal_stats(uint64_t* out) {
+  auto& c = et::GlobalWalCounters();
+  out[0] = c.appends.load();
+  out[1] = c.fsyncs.load();
+  out[2] = c.replayed_records.load();
+  out[3] = c.compactions.load();
+  out[4] = c.catchup_deltas.load();
+  out[5] = c.refused.load();
+  out[6] = c.torn_records.load();
+  out[7] = static_cast<uint64_t>(std::max<int64_t>(c.degraded.load(), 0));
+}
+
 // ---- streaming deltas (graph epoch + O(delta) maintenance) ----
 // Current epoch of the handle's snapshot (0 = as-finalized; each
 // etg_apply_delta bumps it). -1 on a bad handle.

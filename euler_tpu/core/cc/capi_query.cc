@@ -311,16 +311,51 @@ int etq_exec_free(int64_t h) {
 // ---- graph service ----
 // Start serving a shard loaded from a data directory. Returns a server
 // handle; port 0 picks an ephemeral port (query with ets_port).
-int64_t ets_start(const char* data_dir, int shard_idx, int shard_num,
-                  int port, const char* registry_dir, const char* host,
-                  const char* index_spec) {
+// Durable form (ets_start2): wal_dir non-empty attaches a write-ahead
+// delta log — restart recovers snapshot+WAL to the pre-crash epoch,
+// then (catchup != 0 and a registry given) closes any remaining gap via
+// peer kGetDeltaLog anti-entropy BEFORE registering for traffic.
+int64_t ets_start2(const char* data_dir, int shard_idx, int shard_num,
+                   int port, const char* registry_dir, const char* host,
+                   const char* index_spec, const char* wal_dir,
+                   int fsync_policy, int64_t compact_bytes, int catchup) {
+  const bool durable = wal_dir != nullptr && wal_dir[0] != '\0';
   std::unique_ptr<et::Graph> g;
-  et::Status s = et::LoadShard(data_dir, shard_idx, shard_num,
-                               /*data_type=*/0,
-                               /*build_in_adjacency=*/true, &g);
-  if (!s.ok()) {
-    FailWith(s.message());
-    return 0;
+  std::unique_ptr<et::DeltaWal> wal;
+  std::vector<et::WalRecord> wal_records;
+  bool wal_degraded = false;
+  et::Status s;
+  bool wal_gap = false;
+  if (durable) {
+    uint64_t replayed = 0;
+    s = et::RecoverShard(wal_dir, data_dir, shard_idx, shard_num,
+                         /*build_in_adjacency=*/true, &g, &replayed,
+                         &wal_records, &wal_gap);
+    if (!s.ok()) {
+      FailWith(s.message());
+      return 0;
+    }
+    et::Status ws = et::DeltaWal::Open(
+        wal_dir,
+        fsync_policy != 0 ? et::FsyncPolicy::kAlways
+                          : et::FsyncPolicy::kNever,
+        compact_bytes, &wal);
+    if (!ws.ok()) {
+      // unusable log dir: serve reads, refuse deltas (counted) — the
+      // graceful-degradation contract, never silent divergence. The
+      // degraded-instance gauge is bumped by set_wal below.
+      wal_degraded = true;
+      ET_LOG_WARNING << "shard " << shard_idx << " wal degraded ("
+                     << ws.message() << "): deltas will be refused";
+    }
+  } else {
+    s = et::LoadShard(data_dir, shard_idx, shard_num,
+                      /*data_type=*/0,
+                      /*build_in_adjacency=*/true, &g);
+    if (!s.ok()) {
+      FailWith(s.message());
+      return 0;
+    }
   }
   std::shared_ptr<const et::Graph> graph(std::move(g));
   std::shared_ptr<et::IndexManager> index;
@@ -339,12 +374,33 @@ int64_t ets_start(const char* data_dir, int shard_idx, int shard_num,
   // spec retained so kApplyDelta can rebuild the index on the new
   // snapshot (a server with an index but no spec refuses deltas)
   server->set_index_spec(index_spec != nullptr ? index_spec : "");
+  if (durable) {
+    server->set_wal(std::shared_ptr<et::DeltaWal>(std::move(wal)),
+                    wal_degraded);
+    // seed the anti-entropy log from our own WAL (the records recovery
+    // already parsed — no second pass over the log) so a peer
+    // recovering after us can catch up THROUGH us
+    if (!wal_records.empty()) server->SeedDeltaLog(wal_records);
+    // a replay that stopped on a gap/failed record leaves the shard's
+    // epoch numbering untrusted: never claim anti-entropy coverage
+    if (wal_gap) server->MarkDeltaLogGap();
+  }
   s = server->Start(port);
   if (!s.ok()) {
     FailWith(s.message());
     return 0;
   }
   if (registry_dir != nullptr && registry_dir[0] != '\0') {
+    // rejoin at the fleet epoch BEFORE registering: discovery routes
+    // traffic only after Register, so clients of a recovered shard see
+    // no epoch regression on the happy path. A FAILED catch-up is
+    // non-fatal (the client epoch-regression flush is the fallback)
+    // but marks the delta log non-authoritative: this shard's future
+    // live epochs may alias fleet deltas it never saw, and serving
+    // them to a catching-up peer would silently diverge it.
+    if (durable && catchup != 0 &&
+        !server->CatchUpFromRegistry(registry_dir).ok())
+      server->MarkDeltaLogGap();
     s = server->Register(registry_dir, host && host[0] ? host : "127.0.0.1");
     if (!s.ok()) {
       FailWith(s.message());
@@ -357,6 +413,23 @@ int64_t ets_start(const char* data_dir, int shard_idx, int shard_num,
   r.servers[h] = server;
   r.server_graphs[h] = graph_ref;
   return h;
+}
+
+int64_t ets_start(const char* data_dir, int shard_idx, int shard_num,
+                  int port, const char* registry_dir, const char* host,
+                  const char* index_spec) {
+  return ets_start2(data_dir, shard_idx, shard_num, port, registry_dir,
+                    host, index_spec, /*wal_dir=*/"", /*fsync_policy=*/1,
+                    /*compact_bytes=*/0, /*catchup=*/0);
+}
+
+// Current graph epoch of a serving shard (post-recovery rejoin checks).
+int64_t ets_epoch(int64_t h) {
+  auto& r = QReg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.servers.find(h);
+  return it == r.servers.end() ? -1
+                               : static_cast<int64_t>(it->second->epoch());
 }
 
 int ets_port(int64_t h) {

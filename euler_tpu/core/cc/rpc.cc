@@ -64,6 +64,10 @@ enum MsgType : uint32_t {
   kApplyDelta = 7,  // body: delta arrays → u32 code | u64 new_epoch / str
   kGetDelta = 8,    // body: u64 from_epoch → u32 code | u64 epoch |
                     // u8 covered | u64 n | n×u64 dirty node ids
+  kGetDeltaLog = 9,  // body: u64 from_epoch → u32 code | u64 epoch |
+                     // u8 covered | u32 count | count×(u64 epoch,
+                     // u64 len, raw kApplyDelta body) — anti-entropy
+                     // catch-up for recovering shards
 };
 
 // Max-update an atomic epoch (replies can arrive out of order).
@@ -411,6 +415,23 @@ void GraphServer::Stop() {
   // clean shutdown unregisters (file unlink or tcp kRegRemove); a crash
   // skips this and the entry goes stale instead
   if (!reg_spec_.empty()) RegistryRemoveEntry(reg_spec_, reg_name_);
+  // drain off-path compaction BEFORE releasing the wal: a task that
+  // already lock()ed the weak_ptr keeps the DeltaWal alive through its
+  // dump, and returning from Stop mid-dump would let a successor open
+  // the same wal_dir and have its fresh generation unlinked under it
+  {
+    std::unique_lock<std::mutex> lk(compact_mu_);
+    compact_cv_.wait(lk, [this] { return compact_inflight_ == 0; });
+  }
+  // release this server's degraded-gauge contribution and drop the wal
+  // — every apply and compaction has drained above, and a NOT-yet-
+  // started task (weak_ptr capture) turns into a no-op once the wal
+  // dies, so a successor on the same wal_dir cannot race a stale dump
+  if (wal_degraded_) {
+    GlobalWalCounters().degraded.fetch_sub(1);
+    wal_degraded_ = false;
+  }
+  wal_.reset();
 }
 
 void GraphServer::ReapFinishedLocked() {
@@ -503,51 +524,36 @@ void GraphServer::BuildMeta(ByteWriter* w) const {
 }
 
 // kApplyDelta: decode the batched delta, rebuild a new snapshot through
-// the builder machinery (readers keep sampling the old one), swap it in
-// with its dirty set, rebuild the attribute index, and orphan the old
-// snapshot's UDF result-cache entries (counted). Serialized: concurrent
-// applies would each rebuild from the same base and lose one delta.
+// the builder machinery (readers keep sampling the old one), append the
+// raw body to the write-ahead log (durability — BEFORE the swap, so an
+// acked delta is always on disk), swap it in with its dirty set,
+// rebuild the attribute index, retain the body for peer anti-entropy,
+// and orphan the old snapshot's UDF result-cache entries (counted).
+// Serialized: concurrent applies would each rebuild from the same base
+// and lose one delta.
 void GraphServer::HandleApplyDelta(ByteReader* r, ByteWriter* w) {
+  // the reader sits at the body start: hand the RAW bytes to the shared
+  // apply path (WAL records and the retained delta log store them
+  // verbatim so replay/catch-up re-filter exactly like the live path)
+  ApplyDeltaBody(r->cursor(), r->remaining(), w);
+}
+
+void GraphServer::ApplyDeltaBody(const char* body, size_t len,
+                                 ByteWriter* w) {
   // per-ref: also serialized with an embedded-handle apply when the
   // server was constructed over a shared GraphRef
   std::lock_guard<std::mutex> apply_lk(graph_ref_->apply_mutex());
-  uint64_t n_nodes = 0, n_edges = 0;
-  std::vector<NodeId> ids, src, dst;
-  std::vector<int32_t> ntypes, etypes;
-  std::vector<float> nw, ew;
   auto fail = [&](const std::string& msg) {
     w->Put<uint32_t>(1);
     w->PutStr(msg);
   };
-  // validate counts against the bytes actually present BEFORE any
-  // resize: a malformed frame declaring 2^33 rows must fail cheaply,
-  // not bad_alloc the shard out from under its other connections
-  bool ok = r->Get(&n_nodes) &&
-            n_nodes <= r->remaining() /
-                (sizeof(NodeId) + sizeof(int32_t) + sizeof(float));
-  if (ok && n_nodes > 0) {
-    ids.resize(n_nodes);
-    ntypes.resize(n_nodes);
-    nw.resize(n_nodes);
-    ok = r->GetRaw(ids.data(), n_nodes * sizeof(NodeId)) &&
-         r->GetRaw(ntypes.data(), n_nodes * sizeof(int32_t)) &&
-         r->GetRaw(nw.data(), n_nodes * sizeof(float));
-  }
-  ok = ok && r->Get(&n_edges) &&
-       n_edges <= r->remaining() /
-           (2 * sizeof(NodeId) + sizeof(int32_t) + sizeof(float));
-  if (ok && n_edges > 0) {
-    src.resize(n_edges);
-    dst.resize(n_edges);
-    etypes.resize(n_edges);
-    ew.resize(n_edges);
-    ok = r->GetRaw(src.data(), n_edges * sizeof(NodeId)) &&
-         r->GetRaw(dst.data(), n_edges * sizeof(NodeId)) &&
-         r->GetRaw(etypes.data(), n_edges * sizeof(int32_t)) &&
-         r->GetRaw(ew.data(), n_edges * sizeof(float));
-  }
-  if (!ok) {
-    fail("truncated delta body");
+  std::vector<NodeId> ids, src, dst;
+  std::vector<int32_t> ntypes, etypes;
+  std::vector<float> nw, ew;
+  Status s = DecodeDeltaBody(body, len, &ids, &ntypes, &nw, &src, &dst,
+                             &etypes, &ew);
+  if (!s.ok()) {
+    fail(s.message());
     return;
   }
   {
@@ -560,13 +566,23 @@ void GraphServer::HandleApplyDelta(ByteReader* r, ByteWriter* w) {
       return;
     }
   }
+  if (wal_degraded_) {
+    // wal was requested but its directory is unusable: accepting the
+    // delta would diverge the in-memory graph from its (absent) log —
+    // refuse with an explicit, counted status instead (the degraded
+    // gauge already counts this instance, from set_wal)
+    GlobalWalCounters().refused.fetch_add(1);
+    fail("wal degraded: shard's write-ahead log is unusable; delta "
+         "refused (restart with a writable wal_dir)");
+    return;
+  }
   std::shared_ptr<const Graph> base = graph_ref_->get();
   std::unique_ptr<Graph> next;
   std::vector<NodeId> dirty;
-  Status s = ApplyGraphDelta(
-      *base, ids.data(), ntypes.data(), nw.data(), n_nodes, src.data(),
-      dst.data(), etypes.data(), ew.data(), n_edges, shard_idx_, shard_num_,
-      &next, &dirty);
+  s = ApplyGraphDelta(
+      *base, ids.data(), ntypes.data(), nw.data(), ids.size(), src.data(),
+      dst.data(), etypes.data(), ew.data(), src.size(), shard_idx_,
+      shard_num_, &next, &dirty);
   if (!s.ok()) {
     fail(s.message());
     return;
@@ -583,6 +599,19 @@ void GraphServer::HandleApplyDelta(ByteReader* r, ByteWriter* w) {
   }
   uint64_t epoch = fresh->epoch();
   uint64_t old_uid = base->uid();
+  if (wal_ != nullptr) {
+    // append BEFORE the swap: a refused append must leave the served
+    // graph exactly where the log says it is (disk-full degrades to
+    // "no new deltas", never to divergence). Counted + degraded gauge;
+    // a later successful append clears the gauge (space freed).
+    Status ws = wal_->Append(epoch, body, len);
+    if (!ws.ok()) {
+      GlobalWalCounters().refused.fetch_add(1);
+      fail("wal append failed; delta refused (shard keeps serving "
+           "reads, epoch unchanged): " + ws.message());
+      return;
+    }
+  }
   {
     std::lock_guard<std::mutex> lk(state_mu_);
     // apply_mu_ serializes server applies; SwapFrom additionally guards
@@ -594,8 +623,53 @@ void GraphServer::HandleApplyDelta(ByteReader* r, ByteWriter* w) {
     index_ = new_index;  // null when the server has no index
   }
   UdfResultCache::Instance().EvictGraph(old_uid);
-  ET_LOG(INFO) << "shard " << shard_idx_ << " applied delta (" << n_nodes
-               << " nodes, " << n_edges << " edges) -> epoch " << epoch;
+  {
+    // retained raw body: what kGetDeltaLog serves to a recovering peer
+    std::lock_guard<std::mutex> lk(dlog_mu_);
+    dlog_.emplace_back(epoch, std::vector<char>(body, body + len));
+    dlog_bytes_ += len;
+    while (dlog_.size() > kMaxDlogRecords || dlog_bytes_ > kMaxDlogBytes) {
+      dlog_bytes_ -= dlog_.front().second.size();
+      dlog_.pop_front();
+    }
+  }
+  if (wal_ != nullptr && wal_->wants_compaction()) {
+    // Compaction is an O(graph) dump — running it here would hold the
+    // delta ack (and apply_mutex) for the whole dump, long enough for
+    // the client to time out and re-issue (a spurious epoch bump).
+    // Schedule it off-path instead: the task re-takes apply_mutex (so
+    // it serializes with later applies exactly like an inline compact)
+    // and MaybeCompact re-checks the threshold (a superseding task
+    // no-ops). The weak_ptr capture no-ops a task that has not started
+    // when the server stops, and Stop() DRAINS started tasks via the
+    // inflight count before releasing the wal — either way a successor
+    // on the same wal_dir never races a stale dump. Failure is
+    // non-fatal: the log keeps growing and the next apply reschedules.
+    {
+      std::lock_guard<std::mutex> lk(compact_mu_);
+      ++compact_inflight_;
+    }
+    GlobalThreadPool()->Schedule(
+        [this, wwal = std::weak_ptr<DeltaWal>(wal_), ref = graph_ref_,
+         shard = shard_idx_] {
+          // `this` stays valid: Stop() (always run before destruction)
+          // waits for compact_inflight_ to reach zero
+          auto wal = wwal.lock();
+          if (wal != nullptr && !stopping_.load()) {
+            std::lock_guard<std::mutex> alk(ref->apply_mutex());
+            Status cs = wal->MaybeCompact(*ref->get());
+            if (!cs.ok())
+              ET_LOG(WARNING) << "shard " << shard
+                              << " wal compaction failed: "
+                              << cs.message();
+          }
+          std::lock_guard<std::mutex> lk(compact_mu_);
+          --compact_inflight_;
+          compact_cv_.notify_all();
+        });
+  }
+  ET_LOG(INFO) << "shard " << shard_idx_ << " applied delta (" << ids.size()
+               << " nodes, " << src.size() << " edges) -> epoch " << epoch;
   w->Put<uint32_t>(0);
   w->Put<uint64_t>(epoch);
 }
@@ -615,6 +689,173 @@ void GraphServer::HandleGetDelta(ByteReader* r, ByteWriter* w) {
   w->Put<uint8_t>(covered ? 1 : 0);
   w->Put<uint64_t>(static_cast<uint64_t>(ids.size()));
   if (!ids.empty()) w->PutRaw(ids.data(), ids.size() * sizeof(NodeId));
+}
+
+// kGetDeltaLog: the raw retained delta records with epoch > from —
+// what a recovering peer replays to close its gap. covered=0 when the
+// bounded retained log no longer reaches from+1 (the peer cannot catch
+// up from us; its clients fall back to the epoch-regression flush).
+void GraphServer::HandleGetDeltaLog(ByteReader* r, ByteWriter* w) {
+  uint64_t from = 0;
+  if (!r->Get(&from)) {
+    w->Put<uint32_t>(1);
+    w->PutStr("truncated get-delta-log body");
+    return;
+  }
+  std::lock_guard<std::mutex> lk(dlog_mu_);
+  uint64_t cur = graph_ref_->epoch();
+  // covered: nothing newer than `from`, or the retained log's oldest
+  // record is <= from+1 (epochs are consecutive, so that means every
+  // epoch in (from, cur] is present). A shard whose own recovery left
+  // an unclosed gap never claims coverage: its locally-stamped epochs
+  // may alias DIFFERENT fleet deltas, and serving them would diverge
+  // the peer at matching epoch numbers (no regression flush would
+  // ever fire).
+  bool covered = dlog_authoritative_.load() &&
+                 (from >= cur ||
+                  (!dlog_.empty() && dlog_.front().first <= from + 1));
+  w->Put<uint32_t>(0);
+  w->Put<uint64_t>(cur);
+  w->Put<uint8_t>(covered ? 1 : 0);
+  // never serve records beyond our own epoch: a WAL-seeded log can hold
+  // a record this server failed to (re)apply, and a peer must not be
+  // told the fleet reached an epoch this server's graph does not have
+  uint32_t count = 0;
+  if (covered) {
+    for (const auto& rec : dlog_)
+      if (rec.first > from && rec.first <= cur) ++count;
+  }
+  w->Put<uint32_t>(count);
+  if (count > 0) {
+    for (const auto& rec : dlog_) {
+      if (rec.first <= from || rec.first > cur) continue;
+      w->Put<uint64_t>(rec.first);
+      w->Put<uint64_t>(static_cast<uint64_t>(rec.second.size()));
+      w->PutRaw(rec.second.data(), rec.second.size());
+    }
+  }
+}
+
+void GraphServer::SeedDeltaLog(const std::vector<WalRecord>& recs) {
+  const uint64_t cur = graph_ref_->epoch();
+  std::lock_guard<std::mutex> lk(dlog_mu_);
+  for (const auto& rec : recs) {
+    // replay may have stopped BEFORE a valid record (failed apply /
+    // epoch gap); seeding past the recovered epoch would park a stale
+    // body that aliases a future live epoch — a catching-up peer would
+    // apply the stale body and skip the real one (silent divergence)
+    if (rec.epoch > cur) break;  // records are epoch-ordered
+    dlog_.emplace_back(rec.epoch, rec.body);
+    dlog_bytes_ += rec.body.size();
+  }
+  while (dlog_.size() > kMaxDlogRecords || dlog_bytes_ > kMaxDlogBytes) {
+    dlog_bytes_ -= dlog_.front().second.size();
+    dlog_.pop_front();
+  }
+}
+
+Status GraphServer::CatchUpFromPeer(const std::string& host, int port) {
+  auto chan = std::make_shared<RpcChannel>(host, port);
+  chan->set_timeout_ms(5000);
+  // bounded rounds: each round either reaches the peer's epoch or makes
+  // progress; a peer that keeps advancing faster than we apply would be
+  // pathological (applies are serialized fleet-wide in practice)
+  for (int round = 0; round < 64; ++round) {
+    uint64_t my = graph_ref_->epoch();
+    ByteWriter req;
+    req.Put<uint64_t>(my);
+    std::vector<char> reply;
+    ET_RETURN_IF_ERROR(chan->Call(kGetDeltaLog, req.buffer(), &reply, 2));
+    ByteReader r(reply.data(), reply.size());
+    uint32_t code = 1, count = 0;
+    uint64_t peer_epoch = 0;
+    uint8_t covered = 0;
+    if (!r.Get(&code) || code != 0 || !r.Get(&peer_epoch) ||
+        !r.Get(&covered) || !r.Get(&count))
+      return Status::IOError("bad get-delta-log reply from " + host + ":" +
+                             std::to_string(port));
+    if (!covered)
+      return Status::Internal(
+          "peer " + host + ":" + std::to_string(port) +
+          "'s retained delta log no longer reaches epoch " +
+          std::to_string(my) + " (peer at " + std::to_string(peer_epoch) +
+          ")");
+    if (count == 0) {
+      // count==0 with peer_epoch > my is the swap/retained-log race:
+      // the peer published an epoch whose record is not in its dlog_
+      // yet (appended after the snapshot swap). Returning "caught up"
+      // here would silently miss that delta forever — back off briefly
+      // and retry the round instead (the window is the tail of one
+      // apply; the bounded round count still terminates).
+      if (peer_epoch <= my) return Status::OK();  // caught up
+      ::usleep(50 * 1000);
+      continue;
+    }
+    for (uint32_t i = 0; i < count; ++i) {
+      uint64_t e = 0, blen = 0;
+      if (!r.Get(&e) || !r.Get(&blen) || blen > r.remaining())
+        return Status::IOError("truncated get-delta-log record");
+      const char* p = r.cursor();
+      r.Skip(blen);
+      if (e <= graph_ref_->epoch()) continue;
+      ByteWriter w;
+      ApplyDeltaBody(p, static_cast<size_t>(blen), &w);
+      ByteReader rr(w.buffer().data(), w.buffer().size());
+      uint32_t ac = 1;
+      rr.Get(&ac);
+      if (ac != 0) {
+        std::string msg;
+        rr.GetStr(&msg);
+        return Status::Internal("catch-up apply for epoch " +
+                                std::to_string(e) + " failed: " + msg);
+      }
+      GlobalWalCounters().catchup_deltas.fetch_add(1);
+    }
+    if (graph_ref_->epoch() >= peer_epoch) return Status::OK();
+    if (graph_ref_->epoch() == my)
+      return Status::Internal("catch-up made no progress at epoch " +
+                              std::to_string(my));
+  }
+  // rounds exhausted while still behind: report it — the caller's
+  // warning path tells the operator the truth instead of an INFO line
+  // claiming "catch-up complete" above the fleet's real state
+  return Status::Internal(
+      "anti-entropy catch-up did not converge (still at epoch " +
+      std::to_string(graph_ref_->epoch()) + ")");
+}
+
+Status GraphServer::CatchUpFromRegistry(const std::string& registry) {
+  std::map<int, std::pair<std::string, int>> found;
+  std::map<int, int64_t> ages;
+  Status s = ScanRegistrySpec(registry, &found, &ages);
+  if (!s.ok()) return Status::OK();  // unreadable registry: nothing to do
+  Status last = Status::OK();
+  bool tried = false;
+  for (const auto& kv : found) {
+    if (kv.first == shard_idx_) continue;  // our own (possibly stale) entry
+    tried = true;
+    last = CatchUpFromPeer(kv.second.first, kv.second.second);
+    if (last.ok()) {
+      ET_LOG(INFO) << "shard " << shard_idx_
+                   << " anti-entropy catch-up complete at epoch "
+                   << graph_ref_->epoch() << " (peer shard " << kv.first
+                   << ")";
+      return Status::OK();
+    }
+  }
+  if (tried) {
+    // non-fatal by design: serve at the reached epoch; clients detect
+    // the regression and full-flush (the documented fallback). The
+    // failure IS returned so the caller can mark this shard's delta
+    // log non-authoritative — its upcoming live epochs may alias
+    // fleet deltas it never saw.
+    ET_LOG(WARNING) << "shard " << shard_idx_
+                    << " anti-entropy catch-up failed ("
+                    << last.message() << ") — serving at epoch "
+                    << graph_ref_->epoch();
+    return last;
+  }
+  return Status::OK();
 }
 
 void GraphServer::HandleConnection(int fd) {
@@ -647,6 +888,9 @@ void GraphServer::HandleConnection(int fd) {
     } else if (msg_type == kGetDelta) {
       ByteReader r(body.data(), body.size());
       HandleGetDelta(&r, &w);
+    } else if (msg_type == kGetDeltaLog) {
+      ByteReader r(body.data(), body.size());
+      HandleGetDeltaLog(&r, &w);
     } else {  // ping
       w.Put<uint32_t>(0);
     }
@@ -749,7 +993,8 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     write_reply(kHello, request_id, w.buffer());
     return true;
   }
-  if (msg_type == kApplyDelta || msg_type == kGetDelta) {
+  if (msg_type == kApplyDelta || msg_type == kGetDelta ||
+      msg_type == kGetDeltaLog) {
     // Off the reader thread: an apply's O(graph) snapshot rebuild on
     // this thread would stall every pipelined request multiplexed on
     // the connection (kExecute dispatches async for the same reason).
@@ -766,8 +1011,10 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
           ByteReader r(body.data(), body.size());
           if (msg_type == kApplyDelta) {
             HandleApplyDelta(&r, &w);
-          } else {
+          } else if (msg_type == kGetDelta) {
             HandleGetDelta(&r, &w);
+          } else {
+            HandleGetDeltaLog(&r, &w);
           }
           write_reply(msg_type, request_id, w.buffer());
           std::lock_guard<std::mutex> lk(conn->imu);
@@ -1940,13 +2187,19 @@ Status ClientManager::ApplyDelta(
     w.PutRaw(edge_weights ? edge_weights : ew_buf.data(),
              n_edges * sizeof(float));
   }
+  // Concurrent per-shard fan-out (pipeline thread-pool pattern): every
+  // shard rebuilds its snapshot in parallel, so broadcast wall clock is
+  // the SLOWEST shard's rebuild instead of the sum — and the mixed-
+  // epoch window (some shards post-delta, some pre) shrinks with it.
+  // Per-shard retry semantics unchanged: each Channel::Call keeps its
+  // own in-channel retries, a re-issue after any failure is idempotent
+  // (last-write-wins rows), and EVERY shard is attempted so a single
+  // dead shard cannot leave later shards unapplied (the anti-entropy
+  // catch-up on its restart closes its own gap).
+  const int n = shard_num();
   uint64_t max_epoch = 0;
-  // Serial on purpose for now: applies are rare, and first-failure-
-  // stops keeps the retry story trivial (re-issue is idempotent).
-  // Concurrent fan-out (ExecuteAsync-style) is the staged follow-up
-  // for wide fleets where N × rebuild wall and the mixed-epoch window
-  // start to matter.
-  for (int s = 0; s < shard_num(); ++s) {
+  std::mutex mu;
+  auto apply_one = [&](int s) -> Status {
     std::vector<char> reply;
     ET_RETURN_IF_ERROR(Channel(s)->Call(kApplyDelta, w.buffer(), &reply));
     ByteReader r(reply.data(), reply.size());
@@ -1960,15 +2213,41 @@ Status ClientManager::ApplyDelta(
     }
     uint64_t epoch = 0;
     if (!r.Get(&epoch)) return Status::IOError("truncated delta reply");
-    max_epoch = std::max(max_epoch, epoch);
-    // a shard's weight sums / counts changed — refresh its routing meta
-    // so proportional SAMPLE_SPLIT reflects the post-delta distribution
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      max_epoch = std::max(max_epoch, epoch);
+    }
+    // the shard's weight sums / counts changed — refresh its routing
+    // meta so proportional SAMPLE_SPLIT reflects the post-delta graph
     std::vector<char> mreply;
     Status ms = Channel(s)->Call(kMeta, {}, &mreply);
     RefreshMeta(s, ms, mreply);
+    return Status::OK();
+  };
+  std::vector<Status> statuses(n);
+  if (n == 1) {
+    statuses[0] = apply_one(0);
+  } else {
+    // blocking calls ride the CLIENT pool (never the shared executor —
+    // see ClientThreadPool's comment); the launching thread parks on a
+    // plain latch until every shard answered or failed
+    std::condition_variable cv;
+    int pending = n;
+    for (int s = 0; s < n; ++s) {
+      ClientThreadPool()->Schedule([&, s] {
+        Status st = apply_one(s);
+        std::lock_guard<std::mutex> lk(mu);
+        statuses[s] = st;
+        if (--pending == 0) cv.notify_all();
+      });
+    }
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return pending == 0; });
   }
   MaxUpdateEpoch(&observed_epoch_, max_epoch);
   if (new_epoch != nullptr) *new_epoch = max_epoch;
+  for (int s = 0; s < n; ++s)
+    if (!statuses[s].ok()) return statuses[s];
   return Status::OK();
 }
 

@@ -21,7 +21,9 @@
 //           reply body prefixed with the serving graph's u64 epoch —
 //           hello-negotiated, applied before compression)
 // msg types: 0 = Execute, 1 = ShardMeta, 2 = Ping, 6 = Hello (v2 only),
-//            7 = ApplyDelta, 8 = GetDelta (streaming graph deltas).
+//            7 = ApplyDelta, 8 = GetDelta (streaming graph deltas),
+//            9 = GetDeltaLog (raw retained delta records — the
+//                anti-entropy catch-up source for recovering shards).
 //
 // v2 is negotiated per connection: a v2 client opens with a Hello frame
 // carrying (version, feature bits, compress threshold); a v2 server
@@ -36,6 +38,7 @@
 
 #include <atomic>
 #include <condition_variable>
+#include <deque>
 #include <functional>
 #include <map>
 #include <memory>
@@ -49,6 +52,7 @@
 #include "graph.h"
 #include "index.h"
 #include "serde.h"
+#include "wal.h"
 
 namespace et {
 
@@ -154,6 +158,47 @@ class GraphServer {
   // This shard's swappable graph holder (tests / embedded callers).
   const std::shared_ptr<GraphRef>& graph_ref() const { return graph_ref_; }
 
+  // Durable deltas (wal.h): every accepted kApplyDelta appends its raw
+  // broadcast body (stamped with the epoch it produces) to the log
+  // BEFORE the snapshot swap, and compaction re-dumps past the log
+  // threshold. A failed append refuses the delta with an explicit
+  // status (counted, wal_degraded gauge) so the in-memory graph never
+  // runs ahead of its log. degraded=true marks "wal requested but
+  // unopenable": reads serve normally, every delta is refused.
+  void set_wal(std::shared_ptr<DeltaWal> wal, bool degraded = false) {
+    wal_ = std::move(wal);
+    wal_degraded_ = degraded;
+    // an unopenable wal contributes to the degraded-instance gauge for
+    // this server's lifetime (Stop releases it)
+    if (degraded) GlobalWalCounters().degraded.fetch_add(1);
+  }
+
+  // Pre-populate the retained anti-entropy delta log (kGetDeltaLog)
+  // with records recovered from this shard's own WAL, so a freshly
+  // recovered shard can serve catch-up to peers recovering after it.
+  void SeedDeltaLog(const std::vector<WalRecord>& recs);
+
+  // Mark this shard's epoch numbering untrusted for anti-entropy:
+  // recovery left a known unclosed gap (replay stopped early, or the
+  // registry catch-up failed), so local epochs may alias different
+  // fleet deltas. kGetDeltaLog then always answers covered=0.
+  void MarkDeltaLogGap() { dlog_authoritative_.store(false); }
+
+  uint64_t epoch() const { return graph_ref_->epoch(); }
+
+  // Anti-entropy catch-up (restart rejoin): pull the raw delta records
+  // this shard missed (epoch > ours) from a peer's retained delta log
+  // (kGetDeltaLog) and apply them through the normal apply path — WAL
+  // append included, so caught-up epochs survive the NEXT crash too.
+  // Run between Start and Register: the shard rejoins at the fleet
+  // epoch before discovery routes traffic to it.
+  Status CatchUpFromPeer(const std::string& host, int port);
+  // Scan the registry for OTHER shards' endpoints and catch up from the
+  // first that answers covered. Non-fatal: an uncoverable gap logs a
+  // warning and serves at the reached epoch (clients fall back to the
+  // epoch-regression full flush). OK no-op when no peer is registered.
+  Status CatchUpFromRegistry(const std::string& registry);
+
   Status Start(int port);
   void Stop();
   int port() const { return port_; }
@@ -186,6 +231,11 @@ class GraphServer {
   // Streaming delta verbs (shared by the v1 and v2 frame paths).
   void HandleApplyDelta(ByteReader* r, ByteWriter* w);
   void HandleGetDelta(ByteReader* r, ByteWriter* w);
+  void HandleGetDeltaLog(ByteReader* r, ByteWriter* w);
+  // Shared apply path (wire kApplyDelta AND peer catch-up): decode →
+  // WAL append → rebuild → swap → retained log → compaction. Writes the
+  // wire reply (u32 code | u64 epoch, or u32 1 | str error) into w.
+  void ApplyDeltaBody(const char* body, size_t len, ByteWriter* w);
   // Current-snapshot pair for one request (graph pinned, index coherent
   // with it — index_ swaps under state_mu_ on delta apply).
   void SnapshotState(std::shared_ptr<const Graph>* g,
@@ -195,6 +245,27 @@ class GraphServer {
   std::shared_ptr<IndexManager> index_;
   mutable std::mutex state_mu_;  // index_ swap vs request snapshots
   std::string index_spec_;
+  std::shared_ptr<DeltaWal> wal_;
+  bool wal_degraded_ = false;  // wal requested but unopenable: refuse deltas
+  // off-path compaction accounting: Stop() drains in-flight tasks
+  // before releasing the wal, so a successor reopening the same
+  // wal_dir can never race a still-running dump
+  std::mutex compact_mu_;
+  std::condition_variable compact_cv_;
+  int compact_inflight_ = 0;
+  // false when this shard's own recovery left a known unclosed epoch
+  // gap: its locally-stamped epochs may alias different fleet deltas,
+  // so kGetDeltaLog must answer covered=0 (peers fall back to the
+  // client-driven convergence path) instead of serving aliased bodies
+  std::atomic<bool> dlog_authoritative_{true};
+  // bounded retained raw delta bodies (epoch, kApplyDelta wire body)
+  // served to recovering peers via kGetDeltaLog — the anti-entropy
+  // source. Consecutive epochs by construction (each apply bumps by 1).
+  mutable std::mutex dlog_mu_;
+  std::deque<std::pair<uint64_t, std::vector<char>>> dlog_;
+  size_t dlog_bytes_ = 0;
+  static constexpr size_t kMaxDlogRecords = 256;
+  static constexpr size_t kMaxDlogBytes = 64u << 20;
   int shard_idx_, shard_num_, partition_num_;
   bool v1_only_ = false;  // EULER_TPU_RPC_SERVER_V1: emulate a pre-v2
                           // binary exactly (interop tests)

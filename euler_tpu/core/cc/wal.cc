@@ -1,0 +1,488 @@
+#include "wal.h"
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <zlib.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstring>
+
+#include "io.h"
+
+namespace et {
+
+WalCounters& GlobalWalCounters() {
+  static WalCounters* c = new WalCounters();
+  return *c;
+}
+
+namespace {
+
+constexpr uint32_t kWalMagic = 0x52575445;  // 'ETWR'
+constexpr size_t kWalHdrLen = 4 + 8 + 8 + 4;  // magic|epoch|len|crc
+constexpr uint64_t kMaxRecordLen = 1ULL << 30;  // 1 GiB sanity cap
+
+uint32_t Crc32(const char* p, size_t n) {
+  return static_cast<uint32_t>(
+      crc32(0L, reinterpret_cast<const Bytef*>(p), static_cast<uInt>(n)));
+}
+
+std::string GenName(uint64_t start_epoch) {
+  return "wal_" + std::to_string(start_epoch) + ".log";
+}
+
+// wal_<epoch>.log → epoch; false for anything else.
+bool ParseGenName(const std::string& name, uint64_t* epoch) {
+  if (name.rfind("wal_", 0) != 0) return false;
+  if (name.size() < 9 || name.substr(name.size() - 4) != ".log") return false;
+  const std::string digits = name.substr(4, name.size() - 8);
+  if (digits.empty()) return false;
+  uint64_t e = 0;
+  for (char c : digits) {
+    if (c < '0' || c > '9') return false;
+    e = e * 10 + static_cast<uint64_t>(c - '0');
+  }
+  *epoch = e;
+  return true;
+}
+
+Status ListDir(const std::string& dir, std::vector<std::string>* names) {
+  names->clear();
+  DIR* d = ::opendir(dir.c_str());
+  if (d == nullptr)
+    return Status::IOError("cannot open wal dir " + dir + ": " +
+                           std::strerror(errno));
+  while (dirent* e = ::readdir(d)) {
+    std::string n = e->d_name;
+    if (n != "." && n != "..") names->push_back(std::move(n));
+  }
+  ::closedir(d);
+  std::sort(names->begin(), names->end());
+  return Status::OK();
+}
+
+// Generation start epochs present under dir, ascending.
+std::vector<uint64_t> ListGenerations(const std::string& dir) {
+  std::vector<std::string> names;
+  std::vector<uint64_t> gens;
+  if (!ListDir(dir, &names).ok()) return gens;
+  for (const auto& n : names) {
+    uint64_t e;
+    if (ParseGenName(n, &e)) gens.push_back(e);
+  }
+  std::sort(gens.begin(), gens.end());
+  return gens;
+}
+
+void FsyncDir(const std::string& dir) {
+  int fd = ::open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (fd >= 0) {
+    ::fsync(fd);
+    ::close(fd);
+  }
+}
+
+Status RemoveTreeBestEffort(const std::string& path) {
+  struct stat st;
+  if (::lstat(path.c_str(), &st) != 0) return Status::OK();  // gone
+  if (!S_ISDIR(st.st_mode)) {
+    ::unlink(path.c_str());
+    return Status::OK();
+  }
+  std::vector<std::string> names;
+  ET_RETURN_IF_ERROR(ListDir(path, &names));
+  for (const auto& n : names) RemoveTreeBestEffort(path + "/" + n);
+  ::rmdir(path.c_str());
+  return Status::OK();
+}
+
+// Parse one generation file's records; on a bad/torn record, truncate
+// the FILE to the valid prefix and stop. Returns the valid byte length.
+int64_t ParseGeneration(const std::string& path,
+                        std::vector<WalRecord>* out) {
+  std::string blob;
+  if (!ReadFileToString(path, &blob).ok()) return 0;
+  size_t off = 0;
+  auto& c = GlobalWalCounters();
+  while (off + kWalHdrLen <= blob.size()) {
+    uint32_t magic, crc;
+    uint64_t epoch, len;
+    std::memcpy(&magic, blob.data() + off, 4);
+    std::memcpy(&epoch, blob.data() + off + 4, 8);
+    std::memcpy(&len, blob.data() + off + 12, 8);
+    std::memcpy(&crc, blob.data() + off + 20, 4);
+    if (magic != kWalMagic || len > kMaxRecordLen ||
+        off + kWalHdrLen + len > blob.size() ||
+        Crc32(blob.data() + off + kWalHdrLen, len) != crc) {
+      break;  // torn tail / corruption: keep the valid prefix only
+    }
+    WalRecord rec;
+    rec.epoch = epoch;
+    rec.body.assign(blob.data() + off + kWalHdrLen,
+                    blob.data() + off + kWalHdrLen + len);
+    out->push_back(std::move(rec));
+    off += kWalHdrLen + len;
+  }
+  if (off < blob.size()) {
+    c.torn_records.fetch_add(1);
+    ET_LOG(WARNING) << "wal " << path << ": truncating "
+                    << (blob.size() - off)
+                    << " trailing bytes at a torn/corrupt record (replay "
+                    << "keeps the " << out->size() << "-record prefix)";
+    ::truncate(path.c_str(), static_cast<off_t>(off));
+  }
+  return static_cast<int64_t>(off);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// DeltaWal
+// ---------------------------------------------------------------------------
+
+DeltaWal::~DeltaWal() {
+  ClearDegraded();  // this instance's gauge contribution dies with it
+  if (fd_ >= 0) ::close(fd_);
+}
+
+void DeltaWal::MarkDegraded() {
+  if (!degraded_) {
+    degraded_ = true;
+    GlobalWalCounters().degraded.fetch_add(1);
+  }
+}
+
+void DeltaWal::ClearDegraded() {
+  if (degraded_) {
+    degraded_ = false;
+    GlobalWalCounters().degraded.fetch_sub(1);
+  }
+}
+
+Status DeltaWal::Open(const std::string& dir, FsyncPolicy fsync,
+                      int64_t compact_bytes,
+                      std::unique_ptr<DeltaWal>* out) {
+  out->reset();
+  if (::mkdir(dir.c_str(), 0755) != 0 && errno != EEXIST)
+    return Status::IOError("cannot create wal dir " + dir + ": " +
+                           std::strerror(errno));
+  struct stat st;
+  if (::stat(dir.c_str(), &st) != 0 || !S_ISDIR(st.st_mode))
+    return Status::IOError("wal dir " + dir + " is not a directory");
+  auto wal = std::unique_ptr<DeltaWal>(new DeltaWal());
+  wal->dir_ = dir;
+  wal->fsync_ = fsync;
+  wal->compact_bytes_ = compact_bytes;
+  ET_RETURN_IF_ERROR(wal->OpenActiveLog());
+  *out = std::move(wal);
+  return Status::OK();
+}
+
+Status DeltaWal::OpenActiveLog() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+  std::vector<uint64_t> gens = ListGenerations(dir_);
+  uint64_t gen = gens.empty() ? 0 : gens.back();
+  active_path_ = dir_ + "/" + GenName(gen);
+  fd_ = ::open(active_path_.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (fd_ < 0)
+    return Status::IOError("cannot open wal log " + active_path_ + ": " +
+                           std::strerror(errno));
+  struct stat st;
+  log_bytes_ = ::fstat(fd_, &st) == 0 ? static_cast<int64_t>(st.st_size) : 0;
+  if (gens.empty()) FsyncDir(dir_);  // first generation file creation
+  return Status::OK();
+}
+
+Status DeltaWal::Append(uint64_t epoch, const char* body, size_t len) {
+  auto& c = GlobalWalCounters();
+  if (len > kMaxRecordLen) {
+    // mirror the replay-side cap: appending a record replay would
+    // classify as corrupt (and truncate — destroying every later
+    // acked record in the generation) must refuse the DELTA instead.
+    // Per-delta, not an instance degrade.
+    return Status::InvalidArgument(
+        "delta body of " + std::to_string(len) +
+        " bytes exceeds the wal record cap (" +
+        std::to_string(kMaxRecordLen) +
+        "); split the delta into smaller batches");
+  }
+  if (fd_ < 0) {
+    // a previous failure closed the log; retry the open so a transient
+    // condition (disk freed, dir restored) recovers without a restart
+    Status s = OpenActiveLog();
+    if (!s.ok()) {
+      MarkDegraded();
+      return s;
+    }
+  }
+  std::vector<char> rec(kWalHdrLen + len);
+  uint32_t crc = Crc32(body, len);
+  uint64_t l = len;
+  std::memcpy(rec.data(), &kWalMagic, 4);
+  std::memcpy(rec.data() + 4, &epoch, 8);
+  std::memcpy(rec.data() + 12, &l, 8);
+  std::memcpy(rec.data() + 20, &crc, 4);
+  if (len > 0) std::memcpy(rec.data() + kWalHdrLen, body, len);
+  // one write(2) per record: on SIGKILL the page cache keeps whatever
+  // the syscall accepted; a partial write (disk full) leaves a torn
+  // tail that replay truncates
+  size_t done = 0;
+  while (done < rec.size()) {
+    ssize_t w = ::write(fd_, rec.data() + done, rec.size() - done);
+    if (w <= 0) {
+      MarkDegraded();
+      // roll the partial record back so a post-refusal append does not
+      // interleave after garbage; if even that fails, replay's checksum
+      // truncation still bounds the damage
+      ::ftruncate(fd_, static_cast<off_t>(log_bytes_));
+      return Status::IOError("wal append failed on " + active_path_ + ": " +
+                             std::strerror(errno));
+    }
+    done += static_cast<size_t>(w);
+  }
+  if (fsync_ == FsyncPolicy::kAlways) {
+    if (::fsync(fd_) != 0) {
+      MarkDegraded();
+      ::ftruncate(fd_, static_cast<off_t>(log_bytes_));
+      return Status::IOError("wal fsync failed on " + active_path_ + ": " +
+                             std::strerror(errno));
+    }
+    c.fsyncs.fetch_add(1);
+  }
+  log_bytes_ += static_cast<int64_t>(rec.size());
+  c.appends.fetch_add(1);
+  ClearDegraded();  // transient condition (e.g. disk-full) healed
+  return Status::OK();
+}
+
+Status DeltaWal::MaybeCompact(const Graph& g) {
+  if (compact_bytes_ <= 0 || log_bytes_ < compact_bytes_)
+    return Status::OK();
+  return Compact(g);
+}
+
+Status DeltaWal::Compact(const Graph& g) {
+  const uint64_t epoch = g.epoch();
+  const std::string snap_name = "snapshot_" + std::to_string(epoch);
+  const std::string snap_dir = dir_ + "/" + snap_name;
+  const std::string tmp_dir = snap_dir + ".tmp";
+  RemoveTreeBestEffort(tmp_dir);
+  RemoveTreeBestEffort(snap_dir);  // stale same-epoch leftover of a crash
+  if (::mkdir(tmp_dir.c_str(), 0755) != 0)
+    return Status::IOError("cannot create snapshot tmp dir " + tmp_dir +
+                           ": " + std::strerror(errno));
+  // keep the graph's ORIGINAL partition count: LoadShard's p % shard_num
+  // filter (and ApplyGraphDelta's hash-ownership filter, which divides
+  // by partition_num) must see the same layout after a recovery reload
+  ET_RETURN_IF_ERROR(
+      DumpGraphPartitioned(g, tmp_dir, g.meta().partition_num));
+  const std::string epoch_str = std::to_string(epoch);
+  ET_RETURN_IF_ERROR(WriteStringToFile(tmp_dir + "/EPOCH", epoch_str.data(),
+                                       epoch_str.size()));
+  if (::rename(tmp_dir.c_str(), snap_dir.c_str()) != 0)
+    return Status::IOError("cannot publish snapshot " + snap_dir + ": " +
+                           std::strerror(errno));
+  // CURRENT flip is itself temp+rename — a crash leaves either the old
+  // or the new pointer, never a torn file
+  const std::string cur_tmp = dir_ + "/CURRENT.tmp";
+  ET_RETURN_IF_ERROR(
+      WriteStringToFile(cur_tmp, snap_name.data(), snap_name.size()));
+  if (::rename(cur_tmp.c_str(), (dir_ + "/CURRENT").c_str()) != 0)
+    return Status::IOError("cannot flip CURRENT in " + dir_ + ": " +
+                           std::strerror(errno));
+  FsyncDir(dir_);
+  // new log generation; everything before it is covered by the snapshot
+  const std::string new_log = dir_ + "/" + GenName(epoch);
+  int fd = ::open(new_log.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (fd < 0)
+    return Status::IOError("cannot open post-compaction log " + new_log +
+                           ": " + std::strerror(errno));
+  if (fd_ >= 0) ::close(fd_);
+  fd_ = fd;
+  active_path_ = new_log;
+  log_bytes_ = 0;
+  // garbage-collect superseded generations and snapshots
+  std::vector<std::string> names;
+  if (ListDir(dir_, &names).ok()) {
+    for (const auto& n : names) {
+      uint64_t e;
+      if (ParseGenName(n, &e) && e < epoch)
+        ::unlink((dir_ + "/" + n).c_str());
+      else if (n.rfind("snapshot_", 0) == 0 && n != snap_name)
+        RemoveTreeBestEffort(dir_ + "/" + n);
+    }
+  }
+  FsyncDir(dir_);
+  GlobalWalCounters().compactions.fetch_add(1);
+  ET_LOG(INFO) << "wal " << dir_ << ": compacted to " << snap_name
+               << " (log truncated)";
+  return Status::OK();
+}
+
+Status DeltaWal::ReadAll(const std::string& dir,
+                         std::vector<WalRecord>* out) {
+  out->clear();
+  std::vector<uint64_t> gens = ListGenerations(dir);
+  for (size_t i = 0; i < gens.size(); ++i) {
+    const std::string path = dir + "/" + GenName(gens[i]);
+    struct stat st;
+    if (::stat(path.c_str(), &st) != 0) continue;
+    int64_t valid = ParseGeneration(path, out);
+    // a torn record invalidates everything after it (epoch order):
+    // ignore later generations too (they should not exist — the torn
+    // file is by construction the newest — but be defensive)
+    if (valid < st.st_size) {
+      if (i + 1 < gens.size())
+        ET_LOG(WARNING) << "wal " << dir << ": ignoring "
+                        << (gens.size() - i - 1)
+                        << " generation(s) after a torn record";
+      break;
+    }
+  }
+  return Status::OK();
+}
+
+Status DeltaWal::ReadCurrentSnapshot(const std::string& dir,
+                                     std::string* snap_dir,
+                                     uint64_t* epoch) {
+  snap_dir->clear();
+  *epoch = 0;
+  std::string name;
+  if (!ReadFileToString(dir + "/CURRENT", &name).ok())
+    return Status::OK();  // no snapshot yet
+  // trim whitespace defensively (hand-edited CURRENT files)
+  while (!name.empty() && (name.back() == '\n' || name.back() == ' '))
+    name.pop_back();
+  if (name.empty()) return Status::OK();
+  std::string epoch_blob;
+  const std::string full = dir + "/" + name;
+  if (!ReadFileToString(full + "/EPOCH", &epoch_blob).ok())
+    return Status::IOError("snapshot " + full + " has no EPOCH stamp");
+  uint64_t e = 0;
+  for (char c : epoch_blob) {
+    if (c < '0' || c > '9') break;
+    e = e * 10 + static_cast<uint64_t>(c - '0');
+  }
+  *snap_dir = name;
+  *epoch = e;
+  return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// Recovery
+// ---------------------------------------------------------------------------
+
+Status DecodeDeltaBody(const char* data, size_t size,
+                       std::vector<NodeId>* ids, std::vector<int32_t>* ntypes,
+                       std::vector<float>* nw, std::vector<NodeId>* src,
+                       std::vector<NodeId>* dst, std::vector<int32_t>* etypes,
+                       std::vector<float>* ew) {
+  ByteReader r(data, size);
+  uint64_t n_nodes = 0, n_edges = 0;
+  // validate counts against the bytes actually present BEFORE any
+  // resize (same rule as the wire path: a record declaring 2^62 rows
+  // fails cheaply instead of bad_alloc'ing)
+  bool ok = r.Get(&n_nodes) &&
+            n_nodes <= r.remaining() /
+                (sizeof(NodeId) + sizeof(int32_t) + sizeof(float));
+  if (ok && n_nodes > 0) {
+    ids->resize(n_nodes);
+    ntypes->resize(n_nodes);
+    nw->resize(n_nodes);
+    ok = r.GetRaw(ids->data(), n_nodes * sizeof(NodeId)) &&
+         r.GetRaw(ntypes->data(), n_nodes * sizeof(int32_t)) &&
+         r.GetRaw(nw->data(), n_nodes * sizeof(float));
+  }
+  ok = ok && r.Get(&n_edges) &&
+       n_edges <= r.remaining() /
+           (2 * sizeof(NodeId) + sizeof(int32_t) + sizeof(float));
+  if (ok && n_edges > 0) {
+    src->resize(n_edges);
+    dst->resize(n_edges);
+    etypes->resize(n_edges);
+    ew->resize(n_edges);
+    ok = r.GetRaw(src->data(), n_edges * sizeof(NodeId)) &&
+         r.GetRaw(dst->data(), n_edges * sizeof(NodeId)) &&
+         r.GetRaw(etypes->data(), n_edges * sizeof(int32_t)) &&
+         r.GetRaw(ew->data(), n_edges * sizeof(float));
+  }
+  if (!ok) return Status::IOError("truncated delta body");
+  return Status::OK();
+}
+
+Status RecoverShard(const std::string& wal_dir, const std::string& data_dir,
+                    int shard_idx, int shard_num, bool build_in_adjacency,
+                    std::unique_ptr<Graph>* out, uint64_t* replayed,
+                    std::vector<WalRecord>* records_out, bool* gap_out) {
+  if (replayed != nullptr) *replayed = 0;
+  if (gap_out != nullptr) *gap_out = false;
+  std::string snap_name;
+  uint64_t snap_epoch = 0;
+  ET_RETURN_IF_ERROR(
+      DeltaWal::ReadCurrentSnapshot(wal_dir, &snap_name, &snap_epoch));
+  std::unique_ptr<Graph> g;
+  if (!snap_name.empty()) {
+    ET_RETURN_IF_ERROR(LoadShard(wal_dir + "/" + snap_name, shard_idx,
+                                 shard_num, /*data_type=*/0,
+                                 build_in_adjacency, &g));
+    g->set_epoch(snap_epoch);
+    ET_LOG(INFO) << "wal recovery: shard " << shard_idx << " loaded "
+                 << snap_name << " (epoch " << snap_epoch << ")";
+  } else {
+    ET_RETURN_IF_ERROR(LoadShard(data_dir, shard_idx, shard_num,
+                                 /*data_type=*/0, build_in_adjacency, &g));
+  }
+  std::vector<WalRecord> recs;
+  ET_RETURN_IF_ERROR(DeltaWal::ReadAll(wal_dir, &recs));
+  uint64_t applied = 0;
+  for (const auto& rec : recs) {
+    uint64_t cur = g->epoch();
+    if (rec.epoch <= cur) continue;  // covered by the snapshot
+    if (rec.epoch != cur + 1) {
+      ET_LOG(WARNING) << "wal recovery: epoch gap (have " << cur
+                      << ", next record " << rec.epoch
+                      << ") — stopping replay; anti-entropy catch-up "
+                      << "or client epoch-regression flush covers the rest";
+      if (gap_out != nullptr) *gap_out = true;
+      break;
+    }
+    std::vector<NodeId> ids, src, dst;
+    std::vector<int32_t> ntypes, etypes;
+    std::vector<float> nw, ew;
+    Status s = DecodeDeltaBody(rec.body.data(), rec.body.size(), &ids,
+                               &ntypes, &nw, &src, &dst, &etypes, &ew);
+    std::unique_ptr<Graph> next;
+    std::vector<NodeId> dirty;
+    if (s.ok()) {
+      s = ApplyGraphDelta(*g, ids.data(), ntypes.data(), nw.data(),
+                          ids.size(), src.data(), dst.data(), etypes.data(),
+                          ew.data(), src.size(), shard_idx, shard_num, &next,
+                          &dirty);
+    }
+    if (!s.ok()) {
+      ET_LOG(WARNING) << "wal recovery: record for epoch " << rec.epoch
+                      << " failed to apply (" << s.message()
+                      << ") — serving at epoch " << cur;
+      if (gap_out != nullptr) *gap_out = true;
+      break;
+    }
+    g = std::move(next);
+    ++applied;
+  }
+  GlobalWalCounters().replayed_records.fetch_add(applied);
+  if (replayed != nullptr) *replayed = applied;
+  if (applied > 0)
+    ET_LOG(INFO) << "wal recovery: shard " << shard_idx << " replayed "
+                 << applied << " record(s) -> epoch " << g->epoch();
+  if (records_out != nullptr) *records_out = std::move(recs);
+  *out = std::move(g);
+  return Status::OK();
+}
+
+}  // namespace et

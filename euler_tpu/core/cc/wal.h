@@ -1,0 +1,183 @@
+// Durable streaming deltas: per-shard write-ahead delta log + snapshot
+// compaction + crash recovery.
+//
+// The reference engine survives restarts because graph state lives in
+// dumped partition blocks behind FileIO/HDFS; our streaming-delta layer
+// (graph.h ApplyGraphDelta / GraphRef) deliberately kept mutations
+// memory-only, so a crashed shard restarted at epoch 0 with its accepted
+// deltas gone. This module closes that hole with the classic database
+// shape, sized for the delta-apply cost model (an apply is already an
+// O(graph) snapshot rebuild, so the log can afford one record per apply):
+//
+//   * DeltaWal — an append-only log of the RAW broadcast delta bodies
+//     (the kApplyDelta wire payload, unfiltered: replay re-filters by
+//     hash ownership exactly like the live path). Records are
+//     length-prefixed, crc32-checksummed, and epoch-stamped; appends
+//     happen BEFORE the GraphRef swap so an acked delta is always on
+//     disk. Configurable fsync policy (kFsyncNever rides the page cache
+//     — survives SIGKILL, not power loss; kFsyncAlways survives both).
+//   * Snapshot compaction — past compact_bytes of log, the current
+//     snapshot is re-dumped through DumpGraphPartitioned into an atomic
+//     temp+rename directory (the ModelBundle convention), CURRENT flips
+//     to it, and older logs/snapshots are deleted. The dump keeps the
+//     graph's ORIGINAL partition_num so hash-ownership filtering is
+//     unchanged after a recovery reload.
+//   * Recovery — RecoverShard loads CURRENT's snapshot (or the original
+//     data_dir when none), restamps its epoch, then replays log records
+//     with epoch > current through ApplyGraphDelta. A torn tail (crash
+//     mid-append, disk-full partial write) truncates the log at the
+//     first bad checksum instead of refusing to start.
+//
+// Log file layout (little-endian), one file per generation
+// (wal_<start_epoch>.log; a compaction at epoch E starts wal_<E>.log):
+//   record: u32 'ETWR' | u64 epoch | u64 body_len | u32 crc32(body) | body
+//
+// Thread-safety: Append/MaybeCompact are called under the owning
+// GraphRef's apply_mutex (applies are serialized anyway), so DeltaWal
+// itself only guards its counters.
+#ifndef EULER_TPU_WAL_H_
+#define EULER_TPU_WAL_H_
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "graph.h"
+
+namespace et {
+
+// Process-global durability counters (the obs registry mirrors them via
+// etg_wal_stats, the same pattern as RpcCounters).
+struct WalCounters {
+  std::atomic<uint64_t> appends{0};          // records appended
+  std::atomic<uint64_t> fsyncs{0};           // fsync() calls issued
+  std::atomic<uint64_t> replayed_records{0};  // records applied at recovery
+  std::atomic<uint64_t> compactions{0};      // snapshot compactions
+  std::atomic<uint64_t> catchup_deltas{0};   // records applied via peer
+                                             // anti-entropy catch-up
+  std::atomic<uint64_t> refused{0};          // deltas refused (wal degraded)
+  std::atomic<uint64_t> torn_records{0};     // records dropped at replay
+                                             // (bad checksum / torn tail)
+  // gauge: NUMBER of degraded wal instances in this process (an
+  // unwritable wal refuses deltas). A count, not a boolean — one
+  // healthy shard's append must not mask another shard's degrade.
+  std::atomic<int64_t> degraded{0};
+};
+WalCounters& GlobalWalCounters();
+
+enum class FsyncPolicy : int {
+  kNever = 0,   // write(2) only: survives process death (SIGKILL), the
+                // page cache owns power-loss durability
+  kAlways = 1,  // fsync after every append: survives power loss too
+};
+
+// One decoded log record: the epoch the delta produced + the raw
+// broadcast body (kApplyDelta wire payload).
+struct WalRecord {
+  uint64_t epoch = 0;
+  std::vector<char> body;
+};
+
+class DeltaWal {
+ public:
+  ~DeltaWal();
+
+  // Opens (creating the directory and an initial generation if needed)
+  // the log under `dir`. compact_bytes <= 0 disables compaction.
+  // Failure leaves *out null — callers serve reads and refuse deltas
+  // (degraded), they do not crash.
+  static Status Open(const std::string& dir, FsyncPolicy fsync,
+                     int64_t compact_bytes, std::unique_ptr<DeltaWal>* out);
+
+  // Appends one record (raw broadcast delta body) stamped with the
+  // epoch the apply will produce. Called BEFORE the GraphRef swap: a
+  // failure here must refuse the delta (counted, degraded gauge set) so
+  // the in-memory graph never runs ahead of its log. A later success
+  // clears the degraded gauge (disk-full recovers when space frees).
+  Status Append(uint64_t epoch, const char* body, size_t len);
+
+  // Re-dump `g` (post-swap snapshot) as the new recovery base when the
+  // live log has outgrown compact_bytes: atomic temp+rename snapshot
+  // dir, CURRENT flip, fresh log generation, old generations deleted.
+  // no-op (OK) when under threshold or compaction is disabled.
+  Status MaybeCompact(const Graph& g);
+  // Unconditional compaction (tests / explicit admin).
+  Status Compact(const Graph& g);
+
+  int64_t log_bytes() const { return log_bytes_; }
+  const std::string& dir() const { return dir_; }
+
+  // Reads every generation's records in order, validating checksums.
+  // Stops at the first bad/torn record, physically truncating that file
+  // to its valid prefix (so future appends never land after garbage),
+  // and ignores any later generations. Static: recovery runs before a
+  // DeltaWal is open for writing.
+  static Status ReadAll(const std::string& dir,
+                        std::vector<WalRecord>* out);
+
+  // Snapshot bookkeeping (shared with RecoverShard): the CURRENT
+  // snapshot subdirectory name ("" when none) and its stamped epoch.
+  static Status ReadCurrentSnapshot(const std::string& dir,
+                                    std::string* snap_dir,
+                                    uint64_t* epoch);
+
+  // Whether the live log has crossed compact_bytes — the caller's cue
+  // to schedule a (possibly off-path) MaybeCompact.
+  bool wants_compaction() const {
+    return compact_bytes_ > 0 && log_bytes_ >= compact_bytes_;
+  }
+
+ private:
+  DeltaWal() = default;
+  Status OpenActiveLog();
+  // Degraded-gauge transitions (the gauge counts degraded INSTANCES):
+  // called under the owning apply_mutex, so no internal lock.
+  void MarkDegraded();
+  void ClearDegraded();
+
+  std::string dir_;
+  FsyncPolicy fsync_ = FsyncPolicy::kAlways;
+  int64_t compact_bytes_ = 0;
+  int fd_ = -1;             // active generation, O_APPEND
+  std::string active_path_;
+  int64_t log_bytes_ = 0;   // bytes in the active generation
+  bool degraded_ = false;   // this instance's contribution to the gauge
+};
+
+// Decode a kApplyDelta wire body (the WAL record payload) into its
+// columnar delta arrays, validating wire-supplied counts against the
+// bytes actually present. Shared by the RPC path and WAL replay so both
+// reject the same malformed bodies.
+Status DecodeDeltaBody(const char* data, size_t size,
+                       std::vector<NodeId>* ids, std::vector<int32_t>* ntypes,
+                       std::vector<float>* nw, std::vector<NodeId>* src,
+                       std::vector<NodeId>* dst, std::vector<int32_t>* etypes,
+                       std::vector<float>* ew);
+
+// Crash recovery: rebuild this shard's graph from snapshot + log.
+//   1. CURRENT snapshot under wal_dir if present (epoch restamped),
+//      else the original data_dir at epoch 0;
+//   2. replay log records with epoch == current+1 through
+//      ApplyGraphDelta (same hash-ownership filter as the live path).
+// `replayed` (optional) reports how many records applied; `records_out`
+// (optional) receives every VALID log record read — callers that also
+// need the raw records (GraphServer::SeedDeltaLog) reuse them instead
+// of parsing the whole log a second time. Torn tails truncate (the
+// shard is merely BEHIND, with a consistent epoch prefix); a record
+// that fails to apply or an epoch gap stops replay with a warning and
+// sets *gap_out — the shard's later epoch numbering may alias
+// different fleet deltas, so its anti-entropy log must not claim
+// coverage (GraphServer::MarkDeltaLogGap). Anti-entropy catch-up and
+// the client epoch-regression flush are the fallbacks either way.
+Status RecoverShard(const std::string& wal_dir, const std::string& data_dir,
+                    int shard_idx, int shard_num, bool build_in_adjacency,
+                    std::unique_ptr<Graph>* out, uint64_t* replayed,
+                    std::vector<WalRecord>* records_out = nullptr,
+                    bool* gap_out = nullptr);
+
+}  // namespace et
+
+#endif  // EULER_TPU_WAL_H_

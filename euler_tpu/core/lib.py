@@ -186,8 +186,15 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etq_exec_output_data": (c_voidp, [i64, i64]),
         "etq_exec_free": (i32, [i64]),
         "ets_start": (i64, [ctypes.c_char_p, i32, i32, i32, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]),
+        # durable form: + wal_dir, fsync_policy (0=never 1=always),
+        # compact_bytes, catchup (registry anti-entropy on restart)
+        "ets_start2": (i64, [ctypes.c_char_p, i32, i32, i32, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, i32, i64, i32]),
+        "ets_epoch": (i64, [i64]),
         "ets_port": (i32, [i64]),
         "ets_stop": (i32, [i64]),
+        # durability counters: appends, fsyncs, replayed_records,
+        # compactions, catchup_deltas, refused, torn_records, degraded
+        "etg_wal_stats": (None, [c_u64p]),
         "etr_start": (i64, [i32]),
         "etr_port": (i32, [i64]),
         "etr_stop": (i32, [i64]),

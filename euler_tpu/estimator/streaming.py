@@ -66,6 +66,8 @@ class StreamingDriver:
                 ("deltas", "graph deltas applied through StreamingDriver"),
                 ("exports", "bundles exported by StreamingDriver"),
                 ("swaps", "serving-fleet hot-swaps by StreamingDriver"),
+                ("deltas_refused", "graph deltas refused by a degraded "
+                                   "shard (write-ahead log unwritable)"),
             )}
         self._g_epoch = reg.gauge(
             "streaming_graph_epoch",
@@ -78,9 +80,18 @@ class StreamingDriver:
         (epoch bump), wrapped caches evict exactly the dirty ids, and
         the device neighbor/alias tables patch only the dirty rows.
         Returns {epoch, dirty, table, caches}."""
+        from euler_tpu.core.lib import EngineError
         from euler_tpu.graph.api import delta_dirty_ids
 
-        epoch = self.engine.apply_delta(**delta)
+        try:
+            epoch = self.engine.apply_delta(**delta)
+        except EngineError as e:
+            # a durable shard with an unwritable WAL refuses deltas
+            # rather than diverging from its log — count the explicit
+            # status so dashboards see the degrade, then surface it
+            if "wal" in str(e).lower():
+                self._ctr["deltas_refused"].inc()
+            raise
         dirty = delta_dirty_ids(**delta)
         self._ctr["deltas"].inc()
         self._g_epoch.set(epoch)

@@ -36,7 +36,7 @@ from euler_tpu.core.lib import EngineError, check
 
 __all__ = ["Query", "GraphService", "start_service", "compile_debug",
            "register_udf", "udf_cache_stats", "udf_cache_clear",
-           "udf_cache_set_capacity", "edge_types_str"]
+           "udf_cache_set_capacity", "edge_types_str", "wal_stats"]
 
 
 def edge_types_str(edge_types) -> str:
@@ -293,6 +293,11 @@ class GraphService:
     def port(self) -> int:
         return self._lib.ets_port(self._h)
 
+    @property
+    def epoch(self) -> int:
+        """The served graph's current epoch (recovery-rejoin checks)."""
+        return int(self._lib.ets_epoch(self._h))
+
     def stop(self) -> None:
         if self._h:
             self._lib.ets_stop(self._h)
@@ -307,20 +312,110 @@ class GraphService:
             _note_unexpected("graph_service_del", e)
 
 
+# native durability counter layout (etg_wal_stats) — order must match
+# capi.cc. `degraded` is a gauge counting the process's degraded wal
+# INSTANCES (shards currently refusing deltas because their log is
+# unwritable); everything else is a monotonic counter.
+_WAL_STAT_KEYS = (
+    "appends", "fsyncs", "replayed_records", "compactions",
+    "catchup_deltas", "refused", "torn_records", "degraded")
+
+_wal_obs_done = False
+_wal_obs_mu = threading.Lock()
+
+
+def wal_stats() -> dict:
+    """Process-global write-ahead-log durability counters: records
+    appended/fsynced, records replayed at recovery, snapshot
+    compactions, deltas applied via peer anti-entropy catch-up, deltas
+    refused while degraded, torn/corrupt records dropped at replay, and
+    the degraded gauge. Benches snapshot before/after a leg and diff."""
+    lib = _libmod.load()
+    out = np.zeros(len(_WAL_STAT_KEYS), dtype=np.uint64)
+    lib.etg_wal_stats(out.ctypes.data_as(_libmod.c_u64p))
+    return {k: int(v) for k, v in zip(_WAL_STAT_KEYS, out)}
+
+
+def _ensure_wal_obs() -> None:
+    """Mirror the native durability counters into obs gauges
+    (wal_appends_total, wal_fsyncs_total, wal_replayed_records_total,
+    wal_compactions_total, wal_recovery_catchup_deltas_total,
+    wal_refused_total, wal_torn_records_total, wal_degraded) and expose
+    them on /healthz via a "graph_wal" health provider — once per
+    process, first durable start_service."""
+    global _wal_obs_done
+    with _wal_obs_mu:
+        if _wal_obs_done:
+            return
+        from euler_tpu import obs as _obs
+
+        reg = _obs.default_registry()
+        names = {
+            "appends": "wal_appends_total",
+            "fsyncs": "wal_fsyncs_total",
+            "replayed_records": "wal_replayed_records_total",
+            "compactions": "wal_compactions_total",
+            "catchup_deltas": "wal_recovery_catchup_deltas_total",
+            "refused": "wal_refused_total",
+            "torn_records": "wal_torn_records_total",
+            "degraded": "wal_degraded",
+        }
+        gauges = {
+            k: reg.gauge(n, f"graph shard write-ahead log {k} "
+                            "(process-global, native counter mirror)")
+            for k, n in names.items()}
+
+        def _collect():
+            for k, v in wal_stats().items():
+                gauges[k].set(v)
+
+        reg.add_collector(_collect)
+        _obs.register_health("graph_wal", wal_stats)
+        # only after every registration succeeded: a raise above leaves
+        # the flag unset so the next durable start retries instead of
+        # permanently serving without wal observability
+        _wal_obs_done = True
+
+
 def start_service(data_dir: str, shard_idx: int = 0, shard_num: int = 1,
                   port: int = 0, registry_dir: str = "",
-                  host: str = "127.0.0.1",
-                  index_spec: str = "") -> GraphService:
+                  host: str = "127.0.0.1", index_spec: str = "",
+                  wal_dir: str = "", wal_fsync: str = "always",
+                  wal_compact_bytes: int = 64 << 20,
+                  catchup: bool = True) -> GraphService:
     """Load shard `shard_idx`/`shard_num` from data_dir and serve it.
 
     registry_dir: where the shard registers for discovery — a shared
     directory path (or "dir:/path"), or "tcp:<host>:<port>" pointing at
     a registry server (start_registry) for clusters with no shared
-    filesystem (the reference's ZooKeeper role)."""
+    filesystem (the reference's ZooKeeper role).
+
+    wal_dir: non-empty makes the shard DURABLE — every accepted delta
+    is appended to a checksummed write-ahead log before the snapshot
+    swap, and a restart with the same wal_dir recovers snapshot+WAL to
+    the pre-crash epoch, then (catchup=True, registry given) closes any
+    remaining gap from a peer's retained delta log before registering
+    for traffic. An unwritable wal_dir degrades gracefully: reads
+    serve, every delta is refused with an explicit status (counted,
+    `wal_degraded` on /healthz).
+    wal_fsync: "always" fsyncs each append (survives power loss);
+    "never" rides the page cache (survives process death/SIGKILL only).
+    wal_compact_bytes: once the log exceeds this, the snapshot is
+    re-dumped (atomic temp+rename) and the log truncated; <= 0 disables
+    compaction."""
     lib = _libmod.load()
-    h = lib.ets_start(data_dir.encode(), shard_idx, shard_num, port,
-                      registry_dir.encode(), host.encode(),
-                      index_spec.encode())
+    fsync_map = {"always": 1, "never": 0}
+    if wal_fsync not in fsync_map:
+        raise ValueError(
+            f"wal_fsync must be one of {sorted(fsync_map)}, got "
+            f"{wal_fsync!r}")
+    if wal_dir:
+        _ensure_wal_obs()
+    h = lib.ets_start2(data_dir.encode(), shard_idx, shard_num, port,
+                       registry_dir.encode(), host.encode(),
+                       index_spec.encode(), wal_dir.encode(),
+                       fsync_map[wal_fsync], int(wal_compact_bytes),
+                       1 if catchup else 0)
     if h == 0:
         raise EngineError(lib.etg_last_error().decode())
     return GraphService(lib, h)

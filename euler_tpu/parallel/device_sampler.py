@@ -224,16 +224,16 @@ class DeviceNeighborTable:
         engine's append-only row identity keeps neighbor row ids valid.
 
         Replicated split tables only (fused/shard_rows layouts raise —
-        same constraint family as alias=True). Uses the retained host
-        tables when built with keep_host=True, else pulls the device
-        copies back once. O(dirty) applies to the REBUILD work (graph
-        queries + Vose); the final device placement re-uploads the full
-        tables (host-side O(N) memcpy + transfer) — a device-side row
-        scatter is the staged follow-up for giant tables where the
-        upload, not the rebuild, would dominate. Counted on the obs
-        registry: alias_rows_patched_total (vs alias_rows_rebuilt_total
-        for full builds). Returns {rows_patched, rows_total, grown_rows,
-        rebuild_frac}."""
+        same constraint family as alias=True). O(dirty) end to end on
+        the common path: when the delta adds no nodes (no table growth)
+        and the tables are not mesh-placed, the device copies are
+        updated with an `.at[rows].set` row scatter — no O(N) host
+        round-trip, no full re-upload. Growth (shape change) or a mesh
+        placement falls back to the full re-place; stats["upload"] says
+        which path ran ("row_scatter" / "replace" / "none"). Counted on
+        the obs registry: alias_rows_patched_total (vs
+        alias_rows_rebuilt_total for full builds). Returns
+        {rows_patched, rows_total, grown_rows, rebuild_frac, upload}."""
         if self.fused or self.shard_rows:
             raise ValueError(
                 "patch_rows supports replicated split tables only — the "
@@ -248,16 +248,24 @@ class DeviceNeighborTable:
                 f"graph shrank ({n_new} nodes < table's {old_pad}) — "
                 "deltas are append-only; rebuild the table")
         C = self.cap
-        if self.host_tables is not None:
-            nbr = np.array(self.host_tables[0], copy=True)
-            cum = np.array(self.host_tables[1], copy=True)
-        else:
-            nbr = np.asarray(self.neighbors).copy()
-            cum = np.asarray(self.cum_weights).copy()
-        alias_tab = (np.asarray(self.alias_table).copy()
-                     if getattr(self, "alias_table", None) is not None
-                     else None)
         grown = n_new - old_pad
+        has_alias = getattr(self, "alias_table", None) is not None
+        # device-side row scatter: no growth (table shapes unchanged)
+        # and no mesh placement (a scatter on a mesh-sharded array would
+        # reshard under jit defaults) — the dirty rows go straight onto
+        # the device arrays with .at[rows].set, no O(N) host pull and no
+        # full re-upload. Growth or a mesh falls back to re-place.
+        scatter = grown == 0 and self._mesh is None
+        nbr = cum = alias_tab = None
+        if not scatter:
+            if self.host_tables is not None:
+                nbr = np.array(self.host_tables[0], copy=True)
+                cum = np.array(self.host_tables[1], copy=True)
+            else:
+                nbr = np.asarray(self.neighbors).copy()
+                cum = np.asarray(self.cum_weights).copy()
+            alias_tab = (np.asarray(self.alias_table).copy()
+                         if has_alias else None)
         if grown:
             g_nbr = np.full((n_new + 1, C), n_new, dtype=np.int32)
             g_cum = np.zeros((n_new + 1, C), dtype=np.float32)
@@ -287,7 +295,9 @@ class DeviceNeighborTable:
         rows = sorted_rows[keep_first]      # unique, ascending
         stats = {"rows_patched": int(rows.size), "rows_total": n_new,
                  "grown_rows": int(grown),
-                 "rebuild_frac": float(rows.size / max(n_new, 1))}
+                 "rebuild_frac": float(rows.size / max(n_new, 1)),
+                 "upload": ("none" if scatter and rows.size == 0
+                            else "row_scatter" if scatter else "replace")}
         if rows.size:
             # the dirty ids in ROW order (dedup'd) so the CSR block from
             # get_full_neighbor lines up 1:1 with `rows`
@@ -300,10 +310,25 @@ class DeviceNeighborTable:
             blk_nbr, blk_w = _fill_table_rows(
                 C, n_new, rows, deg, nbr_rows, ws.astype(np.float32),
                 self._seed)
-            nbr[rows] = blk_nbr
-            cum[rows] = np.cumsum(blk_w, axis=1, dtype=np.float32)
-            if alias_tab is not None:
-                alias_tab[rows] = _alias_rows_block(blk_nbr, blk_w, n_new)
+            blk_cum = np.cumsum(blk_w, axis=1, dtype=np.float32)
+            blk_alias = (_alias_rows_block(blk_nbr, blk_w, n_new)
+                         if has_alias else None)
+            if scatter:
+                # host copies (when kept) mutate in place — the device
+                # arrays own their own memory, so this cannot alias
+                if self.host_tables is not None:
+                    self.host_tables[0][rows] = blk_nbr
+                    self.host_tables[1][rows] = blk_cum
+                self.neighbors = self.neighbors.at[rows].set(blk_nbr)
+                self.cum_weights = self.cum_weights.at[rows].set(blk_cum)
+                if has_alias:
+                    self.alias_table = \
+                        self.alias_table.at[rows].set(blk_alias)
+            else:
+                nbr[rows] = blk_nbr
+                cum[rows] = blk_cum
+                if alias_tab is not None:
+                    alias_tab[rows] = blk_alias
             # stats stay correct conservatively: uniform_rows may only
             # turn False (correctness-neutral — False just keeps the
             # general inverse-CDF path); hub telemetry tracks the max
@@ -315,9 +340,10 @@ class DeviceNeighborTable:
                     int(getattr(self, "max_degree", 0) or 0),
                     int(deg.max()))
         self.pad_row = n_new
-        if self.host_tables is not None:
-            self.host_tables = (nbr, cum)
-        self._place(nbr, cum, self._mesh, alias_tab)
+        if not scatter:
+            if self.host_tables is not None:
+                self.host_tables = (nbr, cum)
+            self._place(nbr, cum, self._mesh, alias_tab)
         _alias_patch_counter("patched").inc(stats["rows_patched"])
         return stats
 

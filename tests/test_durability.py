@@ -1,0 +1,620 @@
+"""Durable streaming deltas (ISSUE 10 tentpole): per-shard write-ahead
+log, snapshot compaction, and crash-recovery rejoin.
+
+The contracts pinned here:
+
+  * an acked delta survives a restart: WAL replay rejoins the shard at
+    its pre-crash epoch, byte-for-byte with the live apply path;
+  * torn tails tolerate: a log cut mid-record (crash / disk-full /
+    severed wire) truncates at the first bad checksum and the shard
+    starts, serving the valid prefix — never refuses to start, never
+    applies garbage;
+  * compaction is atomic and parity-preserving: the re-dumped snapshot
+    (temp+rename + CURRENT flip) reloads to the same graph at the same
+    epoch with zero log replay;
+  * a restarted shard behind the fleet closes the gap via peer
+    anti-entropy (kGetDeltaLog) BEFORE registering for traffic, so the
+    client epoch-regression full-flush is the fallback, not the norm;
+  * an unwritable WAL degrades gracefully: reads serve, every delta is
+    refused with an explicit counted status;
+  * SIGKILL drill (slow): a shard killed mid-delta-stream restarts,
+    replays its WAL to the pre-crash epoch, catches the missed tail up
+    from a peer, and serves answers identical to an uninterrupted
+    replica — with zero client-cache epoch-regression flushes.
+"""
+
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from euler_tpu.core.lib import EngineError
+from euler_tpu.graph import GraphBuilder, GraphEngine, RemoteGraphEngine
+from euler_tpu.gql import start_service, wal_stats
+
+pytestmark = pytest.mark.durability
+
+_WAL_MAGIC = 0x52575445  # 'ETWR'
+_WAL_HDR = 24  # u32 magic | u64 epoch | u64 len | u32 crc
+
+
+def _build_graph(n=40):
+    rng = np.random.default_rng(7)
+    b = GraphBuilder()
+    b.set_num_types(2, 2)
+    b.set_feature(0, 0, 3, "feat")
+    ids = np.arange(1, n + 1, dtype=np.uint64)
+    b.add_nodes(ids, types=(ids % 2).astype(np.int32),
+                weights=np.linspace(1, 2, n).astype(np.float32))
+    m = n * 4
+    b.add_edges(rng.integers(1, n + 1, m).astype(np.uint64),
+                rng.integers(1, n + 1, m).astype(np.uint64),
+                types=rng.integers(0, 2, m).astype(np.int32),
+                weights=(rng.random(m) + 0.1).astype(np.float32))
+    b.set_node_dense(ids, 0, rng.random((n, 3), dtype=np.float32))
+    return b.finalize(), ids
+
+
+def _deltas(k=3):
+    """k broadcast deltas touching both hash shards (odd + even ids)."""
+    return [{"node_ids": np.array([100 + i], np.uint64),
+             "edge_src": np.array([100 + i, 1 + i], np.uint64),
+             "edge_dst": np.array([2 + i, 100 + i], np.uint64),
+             "edge_weights": np.array([1.0 + i, 2.0 + i], np.float32)}
+            for i in range(k)]
+
+
+def _dump(tmp_path, g, partitions=1):
+    data = str(tmp_path / "data")
+    g.dump(data, num_partitions=partitions)
+    return data
+
+
+def _wal_log_records(path: Path):
+    """[(offset, epoch, body_len)] of one generation file + total valid
+    length — the test-side view of the record framing."""
+    blob = path.read_bytes()
+    recs, off = [], 0
+    while off + _WAL_HDR <= len(blob):
+        magic, epoch, ln, _crc = struct.unpack_from("<IQQI", blob, off)
+        assert magic == _WAL_MAGIC
+        recs.append((off, epoch, ln))
+        off += _WAL_HDR + ln
+    return recs, off
+
+
+def _wal_delta(before, after):
+    return {k: after[k] - before[k] for k in after if k != "degraded"}
+
+
+def _assert_remote_matches_embedded(remote, g, ids):
+    """Id-keyed read parity: cluster answers == embedded post-delta
+    graph (sorted-neighbor lists, weights, features)."""
+    got = remote.get_full_neighbor(ids, sorted_by_id=True)
+    want = g.get_full_neighbor(ids, sorted_by_id=True)
+    for x, y in zip(got, want):
+        assert np.array_equal(x, y)
+    assert np.array_equal(remote.get_dense_feature(ids, "feat"),
+                          g.get_dense_feature(ids, "feat"))
+
+
+# ---------------------------------------------------------------------------
+# WAL roundtrip + restart rejoin
+# ---------------------------------------------------------------------------
+
+def test_wal_roundtrip_restart_rejoin(tmp_path):
+    """Acked deltas survive a restart: the shard rejoins at its
+    pre-crash epoch via WAL replay and serves the same answers as an
+    embedded engine that applied the same deltas live."""
+    g, ids = _build_graph()
+    data = _dump(tmp_path, g)
+    wal = str(tmp_path / "wal")
+    before = wal_stats()
+    s = start_service(data, 0, 1, wal_dir=wal, wal_fsync="always")
+    remote = RemoteGraphEngine(f"hosts:127.0.0.1:{s.port}", seed=1)
+    try:
+        for d in _deltas(3):
+            g.apply_delta(**d)          # embedded replica, in lockstep
+            remote.apply_delta(**d)
+        assert s.epoch == 3
+    finally:
+        remote.close()
+        s.stop()
+    d1 = _wal_delta(before, wal_stats())
+    assert d1["appends"] == 3 and d1["fsyncs"] == 3
+    # restart with the same wal_dir: replay rejoins at epoch 3
+    s2 = start_service(data, 0, 1, wal_dir=wal, wal_fsync="always")
+    remote2 = RemoteGraphEngine(f"hosts:127.0.0.1:{s2.port}", seed=1)
+    try:
+        assert s2.epoch == 3
+        d2 = _wal_delta(before, wal_stats())
+        assert d2["replayed_records"] == 3
+        probe = np.concatenate([ids, np.arange(100, 103, dtype=np.uint64)])
+        _assert_remote_matches_embedded(remote2, g, probe)
+        # the recovered shard accepts (and logs) NEW deltas
+        d = {"edge_src": np.array([5], np.uint64),
+             "edge_dst": np.array([6], np.uint64),
+             "edge_weights": np.array([9.5], np.float32)}
+        g.apply_delta(**d)
+        assert remote2.apply_delta(**d) == 4
+        assert s2.epoch == 4
+    finally:
+        remote2.close()
+        s2.stop()
+
+
+def test_wal_fsync_never_still_replays(tmp_path):
+    """fsync="never" (page-cache durability) still persists across a
+    clean process-level restart: write(2) data survives anything short
+    of a machine crash, and the fsync counter stays untouched."""
+    g, _ = _build_graph()
+    data = _dump(tmp_path, g)
+    wal = str(tmp_path / "wal")
+    before = wal_stats()
+    s = start_service(data, 0, 1, wal_dir=wal, wal_fsync="never")
+    remote = RemoteGraphEngine(f"hosts:127.0.0.1:{s.port}", seed=1)
+    try:
+        remote.apply_delta(**_deltas(1)[0])
+    finally:
+        remote.close()
+        s.stop()
+    assert _wal_delta(before, wal_stats())["fsyncs"] == 0
+    s2 = start_service(data, 0, 1, wal_dir=wal, wal_fsync="never")
+    try:
+        assert s2.epoch == 1
+    finally:
+        s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Torn tail / corruption tolerance
+# ---------------------------------------------------------------------------
+
+def test_wal_torn_tail_truncates_and_serves(tmp_path):
+    """A log cut mid-record (the disk-full / crash-mid-append shape)
+    replays the valid prefix: the shard starts at epoch 2 of 3, the
+    file is physically truncated, and re-issuing the lost delta
+    converges (idempotent last-write-wins)."""
+    g, _ = _build_graph()
+    data = _dump(tmp_path, g)
+    wal = str(tmp_path / "wal")
+    s = start_service(data, 0, 1, wal_dir=wal)
+    remote = RemoteGraphEngine(f"hosts:127.0.0.1:{s.port}", seed=1)
+    deltas = _deltas(3)
+    try:
+        for d in deltas:
+            remote.apply_delta(**d)
+    finally:
+        remote.close()
+        s.stop()
+    log = tmp_path / "wal" / "wal_0.log"
+    recs, valid = _wal_log_records(log)
+    assert len(recs) == 3 and valid == log.stat().st_size
+    # tear the TAIL: cut into the last record's body
+    log.write_bytes(log.read_bytes()[:recs[-1][0] + _WAL_HDR + 3])
+    before = wal_stats()
+    s2 = start_service(data, 0, 1, wal_dir=wal)
+    remote2 = RemoteGraphEngine(f"hosts:127.0.0.1:{s2.port}", seed=1)
+    try:
+        assert s2.epoch == 2               # valid prefix only
+        d = _wal_delta(before, wal_stats())
+        assert d["replayed_records"] == 2 and d["torn_records"] == 1
+        # the torn bytes are physically gone: appends land after the
+        # valid prefix, so a THIRD restart replays cleanly
+        r2, off2 = _wal_log_records(log)
+        assert r2 == recs[:2] and off2 == recs[2][0]
+        assert remote2.apply_delta(**deltas[2]) == 3  # re-issue converges
+    finally:
+        remote2.close()
+        s2.stop()
+    s3 = start_service(data, 0, 1, wal_dir=wal)
+    try:
+        assert s3.epoch == 3
+    finally:
+        s3.stop()
+
+
+def test_wal_checksum_corruption_stops_replay(tmp_path):
+    """A flipped byte mid-log (bit rot) fails that record's crc32:
+    replay keeps the records BEFORE it and drops the rest — serving a
+    stale-but-consistent graph, never a corrupt one."""
+    g, _ = _build_graph()
+    data = _dump(tmp_path, g)
+    wal = str(tmp_path / "wal")
+    s = start_service(data, 0, 1, wal_dir=wal)
+    remote = RemoteGraphEngine(f"hosts:127.0.0.1:{s.port}", seed=1)
+    try:
+        for d in _deltas(3):
+            remote.apply_delta(**d)
+    finally:
+        remote.close()
+        s.stop()
+    log = tmp_path / "wal" / "wal_0.log"
+    recs, _ = _wal_log_records(log)
+    blob = bytearray(log.read_bytes())
+    blob[recs[1][0] + _WAL_HDR + 1] ^= 0xFF  # corrupt record 2's body
+    log.write_bytes(bytes(blob))
+    s2 = start_service(data, 0, 1, wal_dir=wal)
+    try:
+        assert s2.epoch == 1  # records 2 and 3 dropped at the checksum
+    finally:
+        s2.stop()
+
+
+def test_torn_wire_frame_never_reaches_wal(tmp_path):
+    """chaos_proxy 'cut' mode severs the connection mid-kApplyDelta
+    frame: the shard reads a genuinely torn request off the wire — it
+    must neither apply nor log it, and keeps serving."""
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from tools.chaos_proxy import ChaosProxy
+
+    g, ids = _build_graph()
+    data = _dump(tmp_path, g)
+    wal = str(tmp_path / "wal")
+    s = start_service(data, 0, 1, wal_dir=wal)
+    # cut 20 bytes in: past the 16-byte v1 frame header, inside the body
+    proxy = ChaosProxy("127.0.0.1", s.port, mode="cut",
+                       cut_after_bytes=20).start()
+    remote = RemoteGraphEngine(f"hosts:127.0.0.1:{proxy.port}", seed=1)
+    before = wal_stats()
+    d = _deltas(1)[0]
+    try:
+        with pytest.raises(EngineError):
+            remote.apply_delta(**d)
+        assert proxy.counters["cuts_fired"] >= 1
+        assert s.epoch == 0                              # nothing applied
+        assert _wal_delta(before, wal_stats())["appends"] == 0
+        # shard unharmed: a direct (uncut) apply converges
+        direct = RemoteGraphEngine(f"hosts:127.0.0.1:{s.port}", seed=2)
+        try:
+            assert direct.apply_delta(**d) == 1
+        finally:
+            direct.close()
+    finally:
+        proxy.stop()
+        remote.close()
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Snapshot compaction
+# ---------------------------------------------------------------------------
+
+def test_compaction_snapshot_parity(tmp_path):
+    """compact_bytes=1 → every apply schedules a compaction: the
+    snapshot converges on the latest epoch OFF-PATH (the ack never
+    waits for the dump), restart loads it with ZERO log replay, rejoins
+    at the same epoch, serves the same id-keyed answers, and superseded
+    logs/snapshots are gone."""
+    g, ids = _build_graph()
+    data = _dump(tmp_path, g, partitions=2)  # P=2 preserved through dumps
+    wal = str(tmp_path / "wal")
+    before = wal_stats()
+    s = start_service(data, 0, 1, wal_dir=wal, wal_compact_bytes=1)
+    remote = RemoteGraphEngine(f"hosts:127.0.0.1:{s.port}", seed=1)
+    try:
+        for d in _deltas(3):
+            g.apply_delta(**d)
+            remote.apply_delta(**d)
+        # compaction is asynchronous (scheduled per apply, serialized
+        # with applies, coalescing): wait for the final on-disk state —
+        # snapshot at epoch 3, fresh log, old generations GC'd — BEFORE
+        # stopping (a stopped server's pending tasks no-op)
+        want = ["CURRENT", "snapshot_3", "wal_3.log"]
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if sorted(os.listdir(wal)) == want:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"compaction never converged: {sorted(os.listdir(wal))}")
+    finally:
+        remote.close()
+        s.stop()
+    d1 = _wal_delta(before, wal_stats())
+    assert d1["compactions"] >= 1  # tasks coalesce: >=1, snapshot at 3
+    assert (tmp_path / "wal" / "CURRENT").read_text() == "snapshot_3"
+    assert (tmp_path / "wal" / "snapshot_3" / "EPOCH").read_text() == "3"
+    mid = wal_stats()
+    s2 = start_service(data, 0, 1, wal_dir=wal, wal_compact_bytes=1)
+    remote2 = RemoteGraphEngine(f"hosts:127.0.0.1:{s2.port}", seed=1)
+    try:
+        assert s2.epoch == 3
+        assert _wal_delta(mid, wal_stats())["replayed_records"] == 0
+        probe = np.concatenate([ids, np.arange(100, 103, dtype=np.uint64)])
+        _assert_remote_matches_embedded(remote2, g, probe)
+    finally:
+        remote2.close()
+        s2.stop()
+
+
+def test_compaction_preserves_shard_ownership(tmp_path):
+    """A 2-shard fleet with compaction on: the snapshot keeps the
+    original partition_num, so hash-ownership filtering is identical
+    after recovery — a post-recovery broadcast delta lands each row on
+    exactly one shard (global sampling stays single-counted)."""
+    g, ids = _build_graph()
+    data = _dump(tmp_path, g, partitions=2)
+    wals = [str(tmp_path / f"wal{i}") for i in range(2)]
+    servers = [start_service(data, i, 2, wal_dir=wals[i],
+                             wal_compact_bytes=1) for i in range(2)]
+    eps = ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    remote = RemoteGraphEngine(f"hosts:{eps}", seed=1)
+    try:
+        for d in _deltas(2):
+            g.apply_delta(**d)
+            remote.apply_delta(**d)
+    finally:
+        remote.close()
+        for s in servers:
+            s.stop()
+    servers = [start_service(data, i, 2, wal_dir=wals[i],
+                             wal_compact_bytes=1) for i in range(2)]
+    eps = ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    remote = RemoteGraphEngine(f"hosts:{eps}", seed=1)
+    try:
+        d = {"node_ids": np.array([200], np.uint64),
+             "edge_src": np.array([200], np.uint64),
+             "edge_dst": np.array([1], np.uint64)}
+        g.apply_delta(**d)
+        remote.apply_delta(**d)
+        probe = np.concatenate([ids, np.array([200], np.uint64)])
+        _assert_remote_matches_embedded(remote, g, probe)
+        # single-placement: the new node is not double-weighted in the
+        # global sampler (weight 1 of ~70 total → far under 15%)
+        draws = remote.sample_node(2000, -1)
+        assert (draws == 200).mean() < 0.15
+    finally:
+        remote.close()
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Anti-entropy catch-up (restart rejoin behind the fleet)
+# ---------------------------------------------------------------------------
+
+def test_anti_entropy_catchup_rejoins_fleet_epoch(tmp_path):
+    """Shard B misses deltas while down (A keeps applying): B's restart
+    replays its WAL to the pre-crash epoch, then pulls the missed tail
+    from A's retained delta log BEFORE registering — the fleet
+    converges with zero epoch regression and id-keyed parity."""
+    g, ids = _build_graph()
+    data = _dump(tmp_path, g, partitions=2)
+    reg = str(tmp_path / "reg")
+    os.makedirs(reg)
+    wals = [str(tmp_path / f"wal{i}") for i in range(2)]
+    servers = [start_service(data, i, 2, registry_dir=reg,
+                             wal_dir=wals[i]) for i in range(2)]
+    remote = RemoteGraphEngine(f"dir:{reg}", seed=1)
+    deltas = _deltas(4)
+    try:
+        for d in deltas[:2]:                 # both shards reach epoch 2
+            g.apply_delta(**d)
+            remote.apply_delta(**d)
+        servers[1].stop()                    # B leaves; its WAL holds 1-2
+        for d in deltas[2:]:                 # A applies 3-4; broadcast errors
+            g.apply_delta(**d)
+            with pytest.raises(EngineError):
+                remote.apply_delta(**d)
+        assert servers[0].epoch == 4
+        before = wal_stats()
+        # B restarts: WAL replay → 2, then catch-up from A → 4
+        servers[1] = start_service(data, 1, 2, registry_dir=reg,
+                                   wal_dir=wals[1])
+        assert servers[1].epoch == 4
+        d = _wal_delta(before, wal_stats())
+        assert d["replayed_records"] == 2 and d["catchup_deltas"] == 2
+        # caught-up records were WAL-appended too: they survive B's NEXT
+        # restart without needing the peer again
+        assert d["appends"] == 2
+        probe = np.concatenate([ids, np.arange(100, 104, dtype=np.uint64)])
+        fresh = RemoteGraphEngine(f"dir:{reg}", seed=3)
+        try:
+            _assert_remote_matches_embedded(fresh, g, probe)
+        finally:
+            fresh.close()
+    finally:
+        remote.close()
+        for s in servers:
+            s.stop()
+
+
+def test_catchup_skipped_without_peers(tmp_path):
+    """A single-shard fleet restarts with catchup=True and an empty
+    registry: no peer, no error — WAL replay alone rejoins."""
+    g, _ = _build_graph()
+    data = _dump(tmp_path, g)
+    reg = str(tmp_path / "reg")
+    os.makedirs(reg)
+    wal = str(tmp_path / "wal")
+    s = start_service(data, 0, 1, registry_dir=reg, wal_dir=wal)
+    remote = RemoteGraphEngine(f"dir:{reg}", seed=1)
+    try:
+        remote.apply_delta(**_deltas(1)[0])
+    finally:
+        remote.close()
+        s.stop()
+    s2 = start_service(data, 0, 1, registry_dir=reg, wal_dir=wal)
+    try:
+        assert s2.epoch == 1
+    finally:
+        s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Degraded WAL: refuse, never diverge
+# ---------------------------------------------------------------------------
+
+def test_unwritable_wal_refuses_deltas(tmp_path):
+    """wal_dir that cannot be a directory → the shard starts DEGRADED:
+    reads serve normally, every delta is refused with an explicit
+    status naming the wal, and the refusals + gauge are counted (and
+    mirrored onto the obs registry / healthz)."""
+    from euler_tpu import obs as _obs
+
+    g, ids = _build_graph()
+    data = _dump(tmp_path, g)
+    bad = tmp_path / "notadir"
+    bad.write_text("occupied")
+    before = wal_stats()
+    s = start_service(data, 0, 1, wal_dir=str(bad))
+    remote = RemoteGraphEngine(f"hosts:127.0.0.1:{s.port}", seed=1)
+    try:
+        # reads serve
+        assert remote.sample_node(4, -1).size == 4
+        _assert_remote_matches_embedded(remote, g, ids)
+        # deltas refused with an explicit wal status
+        with pytest.raises(EngineError, match="wal"):
+            remote.apply_delta(**_deltas(1)[0])
+        st = wal_stats()
+        assert st["degraded"] == 1
+        assert st["refused"] - before["refused"] == 1
+        assert st["appends"] == before["appends"]  # nothing logged
+        assert s.epoch == 0                        # nothing applied
+        # obs surfaces: healthz provider + registry gauges
+        assert _obs.health_snapshot()["graph_wal"]["degraded"] == 1
+        snap = _obs.default_registry().snapshot()
+        assert snap["wal_degraded"]["values"][""] == 1
+    finally:
+        remote.close()
+        s.stop()
+
+
+def test_streaming_driver_counts_refused_deltas():
+    """StreamingDriver surfaces (and counts) the degrade status instead
+    of swallowing or mis-filing it."""
+    from euler_tpu import obs as _obs
+    from euler_tpu.estimator import StreamingDriver
+
+    del _obs  # driver registers its own counters
+
+    class Refusing:
+        def apply_delta(self, **kw):
+            raise EngineError("shard 0 refused delta: wal degraded: ...")
+
+    drv = StreamingDriver(estimator=None, engine=Refusing())
+    before = drv._ctr["deltas_refused"].value
+    with pytest.raises(EngineError, match="wal degraded"):
+        drv.apply_delta(node_ids=np.array([1], np.uint64))
+    assert drv._ctr["deltas_refused"].value == before + 1
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL drill (slow): crash mid-delta-stream, rejoin, zero flushes
+# ---------------------------------------------------------------------------
+
+_CHILD_SHARD = r"""
+import sys, time
+data, reg, wal = sys.argv[1], sys.argv[2], sys.argv[3]
+from euler_tpu.gql import start_service, wal_stats
+s = start_service(data, shard_idx=1, shard_num=2, port=0,
+                  registry_dir=reg, wal_dir=wal, wal_fsync="always")
+st = wal_stats()  # the child's own process-global counters
+print("READY", s.port, s.epoch, st["replayed_records"],
+      st["catchup_deltas"], flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def _spawn_shard1(data, reg, wal):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD_SHARD, data, reg, wal],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env)
+    line = proc.stdout.readline().strip()
+    assert line.startswith("READY"), f"child failed to start: {line!r}"
+    _, port, epoch, replayed, catchup = line.split()
+    return proc, int(port), int(epoch), int(replayed), int(catchup)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigkill_mid_stream_drill(tmp_path):
+    """The acceptance drill: SIGKILL shard 1 between ApplyDelta
+    broadcasts, keep mutating the survivor, restart the victim with the
+    same wal_dir — it rejoins at its pre-crash epoch via WAL replay,
+    closes the missed tail via peer catch-up, and the fleet serves
+    answers identical to an uninterrupted embedded replica. The client
+    cache takes ZERO epoch-regression full-flushes and no read observes
+    pre-delta data."""
+    from euler_tpu.graph.pipeline import CachedGraphEngine
+    from euler_tpu.graph.remote import RetryPolicy
+
+    g, ids = _build_graph()
+    data = _dump(tmp_path, g, partitions=2)
+    reg = str(tmp_path / "reg")
+    os.makedirs(reg)
+    walA, walB = str(tmp_path / "walA"), str(tmp_path / "walB")
+    s0 = start_service(data, 0, 2, registry_dir=reg, wal_dir=walA,
+                       wal_fsync="always")
+    child, _, child_epoch, _, _ = _spawn_shard1(data, reg, walB)
+    assert child_epoch == 0
+    remote = RemoteGraphEngine(
+        f"dir:{reg}", seed=1,
+        retry_policy=RetryPolicy(deadline_s=20.0, call_timeout_s=5.0))
+    cache = CachedGraphEngine(remote)
+    deltas = _deltas(6)
+    try:
+        _ = cache.get_full_neighbor(ids, sorted_by_id=True)  # warm
+        for d in deltas[:3]:                   # fleet reaches epoch 3
+            g.apply_delta(**d)
+            cache.apply_delta(**d)
+        # SIGKILL mid-stream: no clean shutdown, no unregister — the
+        # WAL (fsync=always) is the only thing that survives
+        child.kill()                           # SIGKILL
+        child.wait(timeout=10)
+        for d in deltas[3:5]:                  # survivor applies 4-5
+            g.apply_delta(**d)
+            with pytest.raises(EngineError):
+                cache.apply_delta(**d)
+        assert s0.epoch == 5
+        # victim restarts from its WAL + catch-up, re-registers
+        child, _, epoch1, replayed1, catchup1 = _spawn_shard1(
+            data, reg, walB)
+        # pre-crash epoch (3) recovered from WAL, then peer catch-up
+        # closed the 4-5 gap BEFORE registering (counters are the
+        # child's own — durability state is per process)
+        assert epoch1 == 5
+        assert replayed1 == 3 and catchup1 == 2
+        # the fleet converges for the registry client: its monitor swaps
+        # the victim's new endpoint in within the heartbeat window
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            try:
+                g.apply_delta(**deltas[5])
+                cache.apply_delta(**deltas[5])
+                break
+            except EngineError:
+                time.sleep(0.5)
+        else:
+            raise AssertionError("fleet never converged after restart")
+        # zero stale reads: cached answers == live cluster == embedded
+        # replica that never crashed, on old AND delta ids
+        probe = np.concatenate([ids, np.arange(100, 106, dtype=np.uint64)])
+        got = cache.get_full_neighbor(probe, sorted_by_id=True)
+        want_live = remote.get_full_neighbor(probe, sorted_by_id=True)
+        want_replica = g.get_full_neighbor(probe, sorted_by_id=True)
+        for x, y, z in zip(got, want_live, want_replica):
+            assert np.array_equal(x, y) and np.array_equal(x, z)
+        # the happy recovery path: zero epoch-REGRESSION full-flushes.
+        # (graph_epoch can exceed 6: each convergence-loop re-issue is
+        # idempotent in CONTENT but still bumps the survivor's epoch —
+        # the PR 9 re-issue semantics, observed as max over shards.)
+        st = cache.cache_stats()
+        assert st["epoch_flushes"] == 0
+        assert st["graph_epoch"] >= 6
+    finally:
+        cache.close()
+        s0.stop()
+        child.kill()
+        child.wait(timeout=10)

@@ -497,6 +497,7 @@ def test_patch_rows_byte_parity_with_hubs():
     assert 0 < stats["rows_patched"] <= delta_dirty_ids(**_DELTA).size
     assert stats["grown_rows"] == 2
     assert stats["rebuild_frac"] < 0.5
+    assert stats["upload"] == "replace"  # growth changes table shapes
     t2 = DeviceNeighborTable(_scratch_final(), cap=4, seed=7,
                              keep_host=True, alias=True)
     assert np.array_equal(t.host_tables[0], t2.host_tables[0])
@@ -522,6 +523,9 @@ def test_patch_rows_no_growth_edge_only():
     g.apply_delta(**delta)
     stats = t.patch_rows(g, delta_dirty_ids(**delta))
     assert stats["grown_rows"] == 0
+    # no growth → the DEVICE arrays take an O(dirty) .at[rows].set row
+    # scatter, no O(N) host pull / re-upload
+    assert stats["upload"] == "row_scatter"
     # untouched rows bit-copied
     row3 = int(g.node_rows(np.array([3], np.uint64))[0])
     row5 = int(g.node_rows(np.array([5], np.uint64))[0])
@@ -531,6 +535,12 @@ def test_patch_rows_no_growth_edge_only():
     t2 = DeviceNeighborTable(g, cap=4, seed=7, keep_host=True, alias=True)
     assert np.array_equal(t.host_tables[0], t2.host_tables[0])
     assert np.array_equal(t.host_tables[1], t2.host_tables[1])
+    # device copies match the scratch build byte-for-byte too — the
+    # scattered rows really landed on device, not just in host_tables
+    assert np.array_equal(np.asarray(t.neighbors), t2.host_tables[0])
+    assert np.array_equal(np.asarray(t.cum_weights), t2.host_tables[1])
+    assert np.array_equal(np.asarray(t.alias_table),
+                          np.asarray(t2.alias_table))
 
 
 def test_patch_rows_refuses_unsupported_layouts():

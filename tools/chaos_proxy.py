@@ -12,6 +12,13 @@ fakes at the Python API boundary; this breaks the actual wire):
                 worst failure mode (blocking sockets hang forever
                 without a per-attempt timeout — exactly what
                 RetryPolicy.call_timeout_s exists for);
+  * cut       — pipe normally, then RST BOTH sides the instant
+                cut_after_bytes client→server bytes have been
+                forwarded: a connection severed MID-FRAME. The server
+                reads a genuinely torn request off the wire (a partial
+                kApplyDelta body, not a cleanly truncated file) — the
+                durability tests drive this to pin that a torn wire
+                frame neither applies nor corrupts the shard's WAL;
   * ok        — transparent bidirectional pipe.
 
 The mode applies per NEW connection; switching to reset/blackhole also
@@ -46,21 +53,26 @@ import struct
 import threading
 import time
 
-MODES = ("ok", "reset", "stall", "blackhole")
+MODES = ("ok", "reset", "stall", "blackhole", "cut")
 
 
 class ChaosProxy:
     def __init__(self, target_host: str, target_port: int,
                  listen_port: int = 0, mode: str = "ok",
                  stall_s: float = 0.5, seed: int = 0,
-                 mode_weights=None):
+                 mode_weights=None, cut_after_bytes: int = 64):
         """mode_weights: optional {mode: weight} dict — each new
         connection draws its mode from this distribution (seeded);
-        None uses the fixed `mode` (set_mode switches it live)."""
+        None uses the fixed `mode` (set_mode switches it live).
+        cut_after_bytes: "cut" mode's per-connection client→server byte
+        budget before the RST — pick it to land INSIDE the frame under
+        test (e.g. past the 16-byte v1 header but before the body ends)
+        to produce a genuinely torn wire frame."""
         if mode not in MODES:
             raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
         self.target = (target_host, int(target_port))
         self.stall_s = float(stall_s)
+        self.cut_after_bytes = int(cut_after_bytes)
         self._mode = mode
         self._weights = dict(mode_weights) if mode_weights else None
         self._rng = random.Random(seed)
@@ -73,7 +85,8 @@ class ChaosProxy:
         self._threads: list = []
         self._conns: list = []  # live sockets (client + upstream)
         self.counters = {"accepted": 0, "ok": 0, "reset": 0, "stall": 0,
-                         "blackhole": 0, "bytes_up": 0, "bytes_down": 0}
+                         "blackhole": 0, "cut": 0, "cuts_fired": 0,
+                         "bytes_up": 0, "bytes_down": 0}
 
     # -- control -----------------------------------------------------------
     def start(self) -> "ChaosProxy":
@@ -199,8 +212,10 @@ class ChaosProxy:
             return
         with self._mu:
             self._conns.extend((client, upstream))
+        cut_budget = self.cut_after_bytes if mode == "cut" else None
         a = threading.Thread(target=self._pipe,
-                             args=(client, upstream, "bytes_up"),
+                             args=(client, upstream, "bytes_up",
+                                   cut_budget),
                              daemon=True)
         b = threading.Thread(target=self._pipe,
                              args=(upstream, client, "bytes_down"),
@@ -209,12 +224,33 @@ class ChaosProxy:
         b.start()
 
     def _pipe(self, src: socket.socket, dst: socket.socket,
-              counter: str) -> None:
+              counter: str, cut_budget=None) -> None:
         try:
             while True:
                 data = src.recv(1 << 16)
                 if not data:
                     break
+                if cut_budget is not None:
+                    # kill-after-N-bytes: forward only up to the budget,
+                    # then RST both directions — the far end has a
+                    # genuinely TORN frame in its read buffer (partial
+                    # body after a complete header), not a clean close
+                    take = min(len(data), cut_budget)
+                    cut_budget -= take
+                    if take:
+                        self.counters[counter] += take
+                        dst.sendall(data[:take])
+                    if cut_budget <= 0:
+                        self.counters["cuts_fired"] += 1
+                        for s in (dst, src):
+                            try:
+                                s.setsockopt(
+                                    socket.SOL_SOCKET, socket.SO_LINGER,
+                                    struct.pack("ii", 1, 0))
+                            except OSError:
+                                pass
+                        break
+                    continue
                 self.counters[counter] += len(data)
                 dst.sendall(data)
         except OSError:
@@ -243,6 +279,9 @@ def main() -> None:
     ap.add_argument("--listen_port", type=int, default=0)
     ap.add_argument("--mode", choices=MODES, default="ok")
     ap.add_argument("--stall_s", type=float, default=0.5)
+    ap.add_argument("--cut_after_bytes", type=int, default=64,
+                    help="cut mode: client→server bytes forwarded "
+                         "before the mid-frame RST")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--reset_rate", type=float, default=0.0,
                     help="probabilistic mix: P(reset) per connection "
@@ -255,7 +294,8 @@ def main() -> None:
                    args.mode: max(1.0 - args.reset_rate, 0.0)}
     proxy = ChaosProxy(host, int(port), listen_port=args.listen_port,
                        mode=args.mode, stall_s=args.stall_s,
-                       seed=args.seed, mode_weights=weights)
+                       seed=args.seed, mode_weights=weights,
+                       cut_after_bytes=args.cut_after_bytes)
     proxy.start()
     print(f"chaos proxy listening on 127.0.0.1:{proxy.port} -> "
           f"{args.target} (mode={args.mode})", flush=True)
