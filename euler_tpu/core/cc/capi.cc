@@ -705,18 +705,33 @@ int etg_get_edge_binary_feature(int64_t h, const uint64_t* src,
 // created afterwards (engines built after the call). Negative values
 // leave the corresponding knob unchanged.
 void etg_rpc_config(int mux, int mux_connections, int64_t compress_threshold,
-                    int max_inflight) {
+                    int max_inflight, int64_t hedge_delay_us, int p2c) {
   auto& c = et::GlobalRpcConfig();
   if (mux >= 0) c.mux = mux != 0;
   if (mux_connections > 0) c.mux_connections = mux_connections;
   if (compress_threshold >= 0) c.compress_threshold = compress_threshold;
   if (max_inflight > 0) c.max_inflight = max_inflight;
+  if (hedge_delay_us >= 0) c.hedge_delay_us = hedge_delay_us;
+  if (p2c >= 0) c.p2c = p2c != 0;
 }
 
-// out[12]: round_trips, bytes_sent, bytes_received, bytes_sent_raw,
+// Per-thread deadline handoff for the NEXT query run on this thread
+// (remaining budget in ms; <= 0 clears). Set just before etq_exec_run;
+// QueryProxy consumes it into the run's QueryEnv so REMOTE sub-calls
+// stamp the remaining budget into their v2 request frames.
+void etg_set_call_deadline_ms(double remaining_ms) {
+  et::SetCallDeadlineUs(
+      remaining_ms > 0
+          ? et::SteadyNowUs() + static_cast<int64_t>(remaining_ms * 1000.0)
+          : 0);
+}
+
+// out[17]: round_trips, bytes_sent, bytes_received, bytes_sent_raw,
 // bytes_received_raw, connections_opened, compressed_frames_sent,
 // compressed_frames_received, mux_calls, v1_calls, hello_fallbacks,
-// inflight (gauge). Client-edge accounting only (see RpcCounters).
+// inflight (gauge), deadline_propagated, deadline_shed (server edge),
+// hedge_fired, hedge_won, hedge_wasted. Client-edge accounting except
+// deadline_shed (see RpcCounters).
 void etg_rpc_stats(uint64_t* out) {
   auto& c = et::GlobalRpcCounters();
   out[0] = c.round_trips.load();
@@ -731,6 +746,11 @@ void etg_rpc_stats(uint64_t* out) {
   out[9] = c.v1_calls.load();
   out[10] = c.hello_fallbacks.load();
   out[11] = static_cast<uint64_t>(std::max<int64_t>(c.inflight.load(), 0));
+  out[12] = c.deadline_propagated.load();
+  out[13] = c.deadline_shed.load();
+  out[14] = c.hedge_fired.load();
+  out[15] = c.hedge_won.load();
+  out[16] = c.hedge_wasted.load();
 }
 
 // out[8]: wal appends, fsyncs, replayed_records, compactions,

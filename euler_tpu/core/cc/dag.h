@@ -94,6 +94,10 @@ struct QueryEnv {
   // still draws fresh samples on every run (only the sequence across runs
   // is reproducible, not each run identical).
   uint64_t nonce = 0;
+  // Absolute steady-clock deadline (µs) for this run; 0 = none. REMOTE
+  // sub-calls propagate the remaining budget inside their v2 request
+  // frames (rpc.h kFeatDeadline) so shards shed already-dead work.
+  int64_t deadline_us = 0;
 };
 
 // Stateless kernel; one singleton per op name serves all queries

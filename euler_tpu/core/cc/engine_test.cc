@@ -118,6 +118,46 @@ void TestThreadPoolStress() {
   CHECK_TRUE(sum.load() == 10000LL * 9999 / 2);
 }
 
+void TestThreadPoolPriorityLanes() {
+  // Both workers of a 2-thread pool get parked on long LOW tasks, six
+  // more LOW tasks queue behind them, then one HIGH task arrives. The
+  // high-preferring worker (idx 1) must take the HIGH task as soon as
+  // it frees — ahead of the whole queued LOW backlog — while worker 0
+  // keeps draining LOW (the anti-starvation guarantee).
+  ThreadPool pool(2);
+  std::mutex mu;
+  std::condition_variable cv;
+  int low_done = 0;
+  bool high_done = false;
+  int low_done_at_high = -1;
+  std::atomic<bool> gate{false};
+  for (int i = 0; i < 8; ++i) {
+    pool.Schedule(
+        [&] {
+          // first two occupy the workers until the HIGH task is queued
+          while (!gate.load()) ::usleep(500);
+          ::usleep(5000);
+          std::lock_guard<std::mutex> lk(mu);
+          ++low_done;
+          cv.notify_all();
+        },
+        ThreadPool::kLow);
+  }
+  pool.Schedule([&] {
+    std::lock_guard<std::mutex> lk(mu);
+    high_done = true;
+    low_done_at_high = low_done;
+    cv.notify_all();
+  });  // default lane: kHigh
+  gate.store(true);
+  std::unique_lock<std::mutex> lk(mu);
+  cv.wait(lk, [&] { return high_done; });
+  // the high task may wait for ONE in-flight low per worker, never for
+  // the queued backlog (6 lows were still queued when it arrived)
+  CHECK_TRUE(low_done_at_high <= 4);
+  cv.wait(lk, [&] { return low_done == 8; });  // lanes both drain
+}
+
 // ---- graph store ----
 std::unique_ptr<Graph> RingGraph() {
   GraphBuilder b;
@@ -556,6 +596,7 @@ int main() {
   et::TestAliasSamplerStatistics();
   et::TestParallelForCoversAll();
   et::TestThreadPoolStress();
+  et::TestThreadPoolPriorityLanes();
   et::TestRegistryServer();
   et::TestRpcMuxTransport();
   et::TestRpcHelloFallback();

@@ -591,7 +591,10 @@ class RemoteOp : public OpKernel {
                        std::move(rep.outputs[i].second));
           }
           done(s);
-        });
+        },
+        // propagate the run's remaining deadline inside the v2 frame so
+        // the shard can shed work that can no longer make it
+        env.deadline_us);
   }
 };
 ET_REGISTER_KERNEL("REMOTE", RemoteOp);

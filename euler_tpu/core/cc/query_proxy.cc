@@ -185,6 +185,11 @@ Status QueryProxy::RunGremlinTimed(const std::string& query,
   env.pool = GlobalThreadPool();
   env.seed = seed_;
   env.nonce = run_counter_.fetch_add(1);
+  // per-call deadline handoff (rpc.h): set by the capi on this thread
+  // just before the run; REMOTE sub-calls stamp the remaining budget
+  // into their v2 request frames. Consumed (read-and-cleared) so a
+  // later deadline-less run on this thread never inherits it.
+  env.deadline_us = TakeCallDeadlineUs();
   Executor exec(&plan->dag, env, &ctx);
   ET_RETURN_IF_ERROR(exec.RunSync());
   outputs->clear();

@@ -47,9 +47,15 @@ constexpr uint32_t kFrameFlagCompressed = 1u;   // body: u64 raw_len | zlib
 // for clients that advertised the feature, so pre-epoch v2 peers — and
 // every v1 peer — see unchanged bytes.
 constexpr uint32_t kFrameFlagEpoch = 2u;
+// REQUEST body is prefixed with the caller's remaining deadline budget
+// (u64 µs, before compression). Hello-negotiated (kFeatDeadline): a
+// client only stamps it for servers that advertised the feature, so
+// pre-deadline v2 peers — and every v1 peer — see unchanged bytes.
+constexpr uint32_t kFrameFlagDeadline = 4u;
 constexpr uint32_t kProtoV2 = 2;
 constexpr uint32_t kFeatAcceptCompressed = 1u;  // hello feature bit
 constexpr uint32_t kFeatEpoch = 2u;             // hello: send epoch prefixes
+constexpr uint32_t kFeatDeadline = 4u;          // hello: deadline prefixes ok
 
 enum MsgType : uint32_t {
   kExecute = 0,
@@ -222,7 +228,28 @@ void JitteredBackoffUs(int attempt) {
   uint64_t hi = 1000ULL * (1ULL << std::min(attempt, 6));
   ::usleep(static_cast<useconds_t>(ThreadLocalRng().NextUInt(hi + 1)));
 }
+
+// Per-thread deadline handoff (see rpc.h SetCallDeadlineUs): the capi
+// sets it on the query's calling thread; QueryProxy consumes it into
+// the run's QueryEnv on the same thread.
+thread_local int64_t tls_call_deadline_us = 0;
 }  // namespace
+
+int64_t SteadyNowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void SetCallDeadlineUs(int64_t abs_steady_us) {
+  tls_call_deadline_us = abs_steady_us;
+}
+
+int64_t TakeCallDeadlineUs() {
+  int64_t v = tls_call_deadline_us;
+  tls_call_deadline_us = 0;
+  return v;
+}
 
 // ---------------------------------------------------------------------------
 // ShardMeta serde
@@ -666,7 +693,9 @@ void GraphServer::ApplyDeltaBody(const char* body, size_t len,
           std::lock_guard<std::mutex> lk(compact_mu_);
           --compact_inflight_;
           compact_cv_.notify_all();
-        });
+        },
+        // maintenance lane: an O(graph) dump never queues ahead of reads
+        ThreadPool::kLow);
   }
   ET_LOG(INFO) << "shard " << shard_idx_ << " applied delta (" << ids.size()
                << " nodes, " << src.size() << " edges) -> epoch " << epoch;
@@ -971,10 +1000,22 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     if (!ok) conn->write_broken = true;
   };
 
+  const int64_t arrival_us = SteadyNowUs();
   if ((flags & kFrameFlagCompressed) != 0) {
     std::vector<char> raw;
     if (!InflateBody(body, &raw)) return false;  // protocol error
     body = std::move(raw);
+  }
+  // propagated deadline: remaining budget at client send time (µs).
+  // Measured against time spent HERE (arrival → dispatch pickup) only —
+  // wire flight time is invisible without clock agreement.
+  int64_t deadline_us = 0;
+  if ((flags & kFrameFlagDeadline) != 0) {
+    if (body.size() < 8) return false;  // protocol error
+    uint64_t rem = 0;
+    std::memcpy(&rem, body.data(), 8);
+    deadline_us = static_cast<int64_t>(std::min<uint64_t>(rem, 1ULL << 62));
+    body.erase(body.begin(), body.begin() + 8);
   }
   if (msg_type == kHello) {
     ByteReader r(body.data(), body.size());
@@ -988,7 +1029,7 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     conn->peer_threshold = thresh;
     ByteWriter w;
     w.Put<uint32_t>(kProtoV2);
-    w.Put<uint32_t>(kFeatAcceptCompressed | kFeatEpoch);
+    w.Put<uint32_t>(kFeatAcceptCompressed | kFeatEpoch | kFeatDeadline);
     w.Put<uint64_t>(thresh);
     write_reply(kHello, request_id, w.buffer());
     return true;
@@ -1020,7 +1061,10 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
           std::lock_guard<std::mutex> lk(conn->imu);
           --conn->inflight;
           conn->icv.notify_all();
-        });
+        },
+        // priority lanes: delta/catch-up maintenance traffic must never
+        // queue ahead of user reads on the dispatch pool
+        ThreadPool::kLow);
     return true;
   }
   if (msg_type != kExecute) {
@@ -1057,7 +1101,6 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     std::shared_ptr<const Graph> graph;
     std::shared_ptr<IndexManager> index;
   };
-  auto p = std::make_shared<Pending>();
   auto finish = [conn, write_reply, request_id](const ExecuteReply& rep) {
     ByteWriter w;
     EncodeExecuteReply(rep, &w);
@@ -1066,44 +1109,66 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     --conn->inflight;
     conn->icv.notify_all();
   };
-  ExecuteRequest req;
-  ByteReader r(body.data(), body.size());
-  Status ds = DecodeExecuteRequest(&r, &req);
-  if (!ds.ok()) {
-    ExecuteReply rep;
-    rep.status = ds;
-    finish(rep);
-    return true;
-  }
-  for (auto& kv : req.inputs) p->ctx.Put(kv.first, std::move(kv.second));
-  p->dag.nodes = std::move(req.nodes);
-  p->outputs = std::move(req.outputs);
-  SnapshotState(&p->graph, &p->index);
-  QueryEnv env;
-  env.graph = p->graph.get();
-  env.index = p->index.get();
-  env.pool = GlobalThreadPool();
-  p->exec = std::make_unique<Executor>(&p->dag, env, &p->ctx);
-  // completion owns the last ref to p: the executor releases its stored
-  // callback before invoking (see Executor::OnNodeDone), so destroying
-  // the Executor from inside its own done is the sanctioned pattern
-  p->exec->Run([p, finish](Status rs) {
-    ExecuteReply rep;
-    rep.status = rs;
-    if (rs.ok()) {
-      for (const auto& name : p->outputs) {
-        Tensor t;
-        if (!p->ctx.Get(name, &t)) {
-          rep.status =
-              Status::NotFound("requested output not produced: " + name);
-          rep.outputs.clear();
-          break;
+  // Decode + execute on the HIGH dispatch lane: the pool-queue wait in
+  // front of this task is exactly the delay the propagated deadline
+  // measures — a request whose budget already expired by pickup is
+  // SHED with an explicit status (counted), its DAG never run.
+  GlobalThreadPool()->Schedule(
+      [this, finish, deadline_us, arrival_us, body = std::move(body)] {
+        if (deadline_us > 0 && SteadyNowUs() - arrival_us > deadline_us) {
+          GlobalRpcCounters().deadline_shed.fetch_add(1);
+          ExecuteReply rep;
+          rep.status = Status::Internal(
+              "deadline shed: request waited " +
+              std::to_string(SteadyNowUs() - arrival_us) +
+              "us in dispatch, past its " + std::to_string(deadline_us) +
+              "us remaining budget");
+          finish(rep);
+          return;
         }
-        rep.outputs.emplace_back(name, std::move(t));
-      }
-    }
-    finish(rep);
-  });
+        auto p = std::make_shared<Pending>();
+        ExecuteRequest req;
+        ByteReader r(body.data(), body.size());
+        Status ds = DecodeExecuteRequest(&r, &req);
+        if (!ds.ok()) {
+          ExecuteReply rep;
+          rep.status = ds;
+          finish(rep);
+          return;
+        }
+        for (auto& kv : req.inputs)
+          p->ctx.Put(kv.first, std::move(kv.second));
+        p->dag.nodes = std::move(req.nodes);
+        p->outputs = std::move(req.outputs);
+        SnapshotState(&p->graph, &p->index);
+        QueryEnv env;
+        env.graph = p->graph.get();
+        env.index = p->index.get();
+        env.pool = GlobalThreadPool();
+        if (deadline_us > 0) env.deadline_us = arrival_us + deadline_us;
+        p->exec = std::make_unique<Executor>(&p->dag, env, &p->ctx);
+        // completion owns the last ref to p: the executor releases its
+        // stored callback before invoking (see Executor::OnNodeDone), so
+        // destroying the Executor from inside its own done is the
+        // sanctioned pattern
+        p->exec->Run([p, finish](Status rs) {
+          ExecuteReply rep;
+          rep.status = rs;
+          if (rs.ok()) {
+            for (const auto& name : p->outputs) {
+              Tensor t;
+              if (!p->ctx.Get(name, &t)) {
+                rep.status = Status::NotFound(
+                    "requested output not produced: " + name);
+                rep.outputs.clear();
+                break;
+              }
+              rep.outputs.emplace_back(name, std::move(t));
+            }
+          }
+          finish(rep);
+        });
+      });
   return true;
 }
 
@@ -1152,10 +1217,29 @@ void GraphServer::HandleExecute(ByteReader* r, ByteWriter* w) {
 // ---------------------------------------------------------------------------
 class RpcChannel::MuxConn {
  public:
+  // Shared completion state for one hedged call: two legs (primary +
+  // hedge) on DIFFERENT connections race; the first reply wins and the
+  // caller abandons the loser by request_id (CancelHedged — its late
+  // reply is discarded by the demux reader). Conn death fails a leg
+  // instead of hanging it; the call only fails when every submitted
+  // leg failed.
+  struct HedgeGroup {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;  // a winning reply was delivered
+    int winner = -1;    // leg index of the winner
+    std::vector<char> body;
+    int submitted = 0;  // legs put on a wire
+    int failed = 0;     // legs that died with a transport status
+    Status fail_st = Status::OK();
+  };
+
   MuxConn(int fd, bool peer_compress, int64_t compress_threshold,
-          int max_inflight, std::atomic<uint64_t>* epoch_sink)
+          int max_inflight, std::atomic<uint64_t>* epoch_sink,
+          bool peer_deadline)
       : fd_(fd),
         peer_compress_(peer_compress),
+        peer_deadline_(peer_deadline),
         compress_threshold_(compress_threshold),
         max_inflight_(std::max(max_inflight, 1)),
         epoch_sink_(epoch_sink) {
@@ -1176,10 +1260,20 @@ class RpcChannel::MuxConn {
     return broken_;
   }
 
+  // Connection-selection signals for power-of-two-choices (PickSlot):
+  // current in-flight depth + an EWMA of recent reply latency. A
+  // stalled connection shows up in both and stops attracting calls.
+  int inflight() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<int>(waiters_.size());
+  }
+  int64_t ewma_us() { return ewma_us_.load(); }
+
   Status Call(uint32_t msg_type, const std::vector<char>& body,
-              std::vector<char>* reply_body) {
+              std::vector<char>* reply_body, int64_t deadline_abs_us = 0) {
     auto& ctr = GlobalRpcCounters();
     Waiter w;
+    w.start_us = SteadyNowUs();
     uint64_t id = next_id_.fetch_add(1);
     {
       std::unique_lock<std::mutex> lk(mu_);
@@ -1193,7 +1287,7 @@ class RpcChannel::MuxConn {
       waiters_[id] = &w;
     }
     ctr.inflight.fetch_add(1);
-    if (!WriteRequest(msg_type, id, body)) {
+    if (!WriteRequest(msg_type, id, body, deadline_abs_us)) {
       // socket dead: tear the whole conn down so every parked waiter
       // (not just this call) gets a status promptly
       Shutdown();
@@ -1217,9 +1311,11 @@ class RpcChannel::MuxConn {
   // arrives (or with a status when the connection dies). No thread is
   // parked while the request is on the wire.
   void CallAsync(uint32_t msg_type, const std::vector<char>& body,
-                 std::function<void(Status, std::vector<char>)> done) {
+                 std::function<void(Status, std::vector<char>)> done,
+                 int64_t deadline_abs_us = 0) {
     auto* w = new Waiter();
     w->cb = std::move(done);
+    w->start_us = SteadyNowUs();
     uint64_t id = next_id_.fetch_add(1);
     {
       std::lock_guard<std::mutex> lk(mu_);
@@ -1232,7 +1328,68 @@ class RpcChannel::MuxConn {
       waiters_[id] = w;
     }
     GlobalRpcCounters().inflight.fetch_add(1);
-    if (!WriteRequest(msg_type, id, body)) Shutdown();
+    if (!WriteRequest(msg_type, id, body, deadline_abs_us)) Shutdown();
+  }
+
+  // One leg of a hedged call: heap waiter bound to the shared group.
+  // Returns the request_id (the cancellation handle), or 0 when this
+  // connection is already down (the leg is recorded failed on the
+  // group so the caller's wait predicate stays truthful).
+  uint64_t SubmitHedged(uint32_t msg_type, const std::vector<char>& body,
+                        const std::shared_ptr<HedgeGroup>& g, int leg,
+                        int64_t deadline_abs_us) {
+    auto* w = new Waiter();
+    w->hedge = g;
+    w->leg = leg;
+    w->start_us = SteadyNowUs();
+    uint64_t id = next_id_.fetch_add(1);
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      // same client-side backpressure as Call: hedging must not let a
+      // runaway feeder queue unbounded server work on one conn
+      cv_.wait(lk, [&] {
+        return broken_ ||
+               static_cast<int>(waiters_.size()) < max_inflight_;
+      });
+      if (broken_) {
+        delete w;
+        std::lock_guard<std::mutex> glk(g->mu);
+        ++g->submitted;
+        ++g->failed;
+        g->fail_st = Status::IOError("mux connection is down");
+        g->cv.notify_all();
+        return 0;
+      }
+      // count the leg as submitted BEFORE the waiter becomes routable:
+      // the reader could deliver its reply before we return
+      {
+        std::lock_guard<std::mutex> glk(g->mu);
+        ++g->submitted;
+      }
+      waiters_[id] = w;
+    }
+    GlobalRpcCounters().inflight.fetch_add(1);
+    if (!WriteRequest(msg_type, id, body, deadline_abs_us)) Shutdown();
+    return id;
+  }
+
+  // Cancel an abandoned hedge leg by request_id: deregister the waiter
+  // so the demux reader drops its late reply on the floor (the
+  // "unknown id: discarded" path). Returns false when the reply (or
+  // conn teardown) already consumed the waiter.
+  bool CancelHedged(uint64_t id) {
+    Waiter* w = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = waiters_.find(id);
+      if (it == waiters_.end()) return false;
+      w = it->second;
+      waiters_.erase(it);
+      cv_.notify_all();  // a cap slot freed
+    }
+    delete w;
+    GlobalRpcCounters().inflight.fetch_sub(1);
+    return true;
   }
 
  private:
@@ -1241,6 +1398,9 @@ class RpcChannel::MuxConn {
     std::vector<char> body;
     bool done = false;
     std::function<void(Status, std::vector<char>)> cb;  // async only
+    std::shared_ptr<HedgeGroup> hedge;  // hedged legs only
+    int leg = 0;
+    int64_t start_us = 0;  // submit time (EWMA latency signal)
   };
 
   static void FailAsyncWaiter(Waiter* w, Status s) {
@@ -1252,27 +1412,67 @@ class RpcChannel::MuxConn {
   }
 
   bool WriteRequest(uint32_t msg_type, uint64_t id,
-                    const std::vector<char>& body) {
+                    const std::vector<char>& body,
+                    int64_t deadline_abs_us) {
     auto& ctr = GlobalRpcCounters();
-    // adaptive request compression (negotiated in the hello)
     uint32_t flags = 0;
+    // deadline propagation: stamp the REMAINING budget at write time as
+    // a u64-µs body prefix (hello-negotiated; kExecute only — the verb
+    // the server sheds). An already-expired budget stamps 1µs so the
+    // server sheds it instead of the client inventing a local failure.
+    uint64_t remaining_us = 0;
+    const bool stamp = peer_deadline_ && deadline_abs_us > 0 &&
+                       msg_type == kExecute;
+    if (stamp) {
+      remaining_us = static_cast<uint64_t>(
+          std::max<int64_t>(deadline_abs_us - SteadyNowUs(), 1));
+      flags |= kFrameFlagDeadline;
+      ctr.deadline_propagated.fetch_add(1);
+    }
+    // adaptive request compression (negotiated in the hello); the
+    // deadline prefix rides INSIDE the deflate stream like the reply
+    // epoch prefix does
     const std::vector<char>* out = &body;
     std::vector<char> comp;
+    std::vector<char> stamped;
+    const size_t raw_len = body.size() + (stamp ? 8 : 0);
     if (peer_compress_ && compress_threshold_ > 0 &&
-        static_cast<int64_t>(body.size()) >= compress_threshold_ &&
-        DeflateBody(body, &comp)) {
-      out = &comp;
-      flags |= kFrameFlagCompressed;
-      ctr.compressed_frames_sent.fetch_add(1);
+        static_cast<int64_t>(raw_len) >= compress_threshold_) {
+      const std::vector<char>* src = &body;
+      if (stamp) {
+        stamped.resize(8);
+        std::memcpy(stamped.data(), &remaining_us, 8);
+        stamped.insert(stamped.end(), body.begin(), body.end());
+        src = &stamped;
+      }
+      if (DeflateBody(*src, &comp)) {
+        out = &comp;
+        flags |= kFrameFlagCompressed;
+        ctr.compressed_frames_sent.fetch_add(1);
+      }
     }
     bool wrote;
     {
       std::lock_guard<std::mutex> lk(wmu_);
-      wrote = WriteFrameV2(fd_, msg_type, flags, id, out->data(),
-                           out->size());
+      if (stamp && (flags & kFrameFlagCompressed) == 0) {
+        // scatter write (header | deadline | body): prefixing must not
+        // cost an O(body) copy on every uncompressed stamped request
+        char hdr[kV2HdrLen];
+        FillV2Hdr(hdr, msg_type, flags, id, raw_len);
+        wrote = WriteAll(fd_, hdr, kV2HdrLen) &&
+                WriteAll(fd_, reinterpret_cast<const char*>(&remaining_us),
+                         8) &&
+                WriteAll(fd_, body.data(), body.size());
+      } else {
+        wrote = WriteFrameV2(fd_, msg_type, flags, id, out->data(),
+                             out->size());
+      }
     }
-    ctr.bytes_sent_raw.fetch_add(kV2HdrLen + body.size());
-    if (wrote) ctr.bytes_sent.fetch_add(kV2HdrLen + out->size());
+    ctr.bytes_sent_raw.fetch_add(kV2HdrLen + raw_len);
+    if (wrote)
+      ctr.bytes_sent.fetch_add(
+          kV2HdrLen +
+          ((flags & kFrameFlagCompressed) != 0 ? out->size() : raw_len));
     return wrote;
   }
 
@@ -1305,25 +1505,64 @@ class RpcChannel::MuxConn {
       ctr.bytes_received.fetch_add(wire);
       ctr.bytes_received_raw.fetch_add(kV2HdrLen + body.size());
       Waiter* async_w = nullptr;
+      Waiter* hedged_w = nullptr;
       {
         std::lock_guard<std::mutex> lk(mu_);
         auto it = waiters_.find(id);
         if (it != waiters_.end()) {
           Waiter* w = it->second;
           waiters_.erase(it);
-          if (w->cb) {
+          // EWMA reply latency (p2c signal): new = (7*old + sample) / 8
+          if (w->start_us > 0) {
+            int64_t sample = SteadyNowUs() - w->start_us;
+            int64_t old = ewma_us_.load();
+            ewma_us_.store(old == 0 ? sample : (7 * old + sample) / 8);
+          }
+          if (w->hedge) {
+            w->body = std::move(body);
+            hedged_w = w;
+          } else if (w->cb) {
             w->body = std::move(body);
             async_w = w;
           } else {
             w->body = std::move(body);
             w->done = true;
           }
-          // either branch shrank waiters_: wake completed sync callers
+          // every branch shrank waiters_: wake completed sync callers
           // AND any sync Call parked on the max_inflight cap (async
           // completions must release cap slots too)
           cv_.notify_all();
         }
-        // unknown id: reply for an abandoned waiter — dropped
+        // unknown id: reply for an abandoned (cancelled) waiter — dropped
+      }
+      if (hedged_w != nullptr) {
+        ctr.inflight.fetch_sub(1);
+        auto g = hedged_w->hedge;
+        int leg = hedged_w->leg;
+        std::vector<char> b = std::move(hedged_w->body);
+        delete hedged_w;
+        bool won;
+        {
+          std::lock_guard<std::mutex> glk(g->mu);
+          won = !g->done;
+          if (won) {
+            g->done = true;
+            g->winner = leg;
+            g->body = std::move(b);
+          }
+          // else: the OTHER leg already won and this reply is
+          // discarded (a raced loser the caller did not cancel in
+          // time)
+          g->cv.notify_all();
+        }
+        // round_trips/mux_calls stay 1:1 with LOGICAL calls whether
+        // hedging is on or off: only the winning leg counts — a
+        // discarded loser already shows in hedge_wasted and in the
+        // bytes counters (the wire truth)
+        if (won) {
+          ctr.round_trips.fetch_add(1);
+          ctr.mux_calls.fetch_add(1);
+        }
       }
       if (async_w != nullptr) {
         ctr.inflight.fetch_sub(1);
@@ -1341,11 +1580,14 @@ class RpcChannel::MuxConn {
     }
     // teardown: fail every parked waiter with a status — no hangs
     std::vector<Waiter*> async_fail;
+    std::vector<Waiter*> hedge_fail;
     {
       std::lock_guard<std::mutex> lk(mu_);
       broken_ = true;
       for (auto& kv : waiters_) {
-        if (kv.second->cb) {
+        if (kv.second->hedge) {
+          hedge_fail.push_back(kv.second);
+        } else if (kv.second->cb) {
           async_fail.push_back(kv.second);
         } else {
           kv.second->st =
@@ -1356,6 +1598,16 @@ class RpcChannel::MuxConn {
       waiters_.clear();
       cv_.notify_all();
     }
+    for (Waiter* w : hedge_fail) {
+      ctr.inflight.fetch_sub(1);
+      auto g = w->hedge;
+      delete w;
+      std::lock_guard<std::mutex> glk(g->mu);
+      ++g->failed;
+      g->fail_st =
+          Status::IOError("mux connection reset with in-flight calls");
+      g->cv.notify_all();
+    }
     for (Waiter* w : async_fail) {
       ctr.inflight.fetch_sub(1);
       FailAsyncWaiter(
@@ -1365,9 +1617,11 @@ class RpcChannel::MuxConn {
 
   const int fd_;
   const bool peer_compress_;
+  const bool peer_deadline_;
   const int64_t compress_threshold_;
   const int max_inflight_;
   std::atomic<uint64_t>* const epoch_sink_;
+  std::atomic<int64_t> ewma_us_{0};  // recent reply latency (p2c signal)
   std::atomic<uint64_t> next_id_{1};
   std::mutex wmu_;  // one writer at a time on the shared fd
   std::mutex mu_;   // waiters_ + broken_
@@ -1491,7 +1745,7 @@ std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
   const RpcConfig cfg = GlobalRpcConfig();
   ByteWriter hw;
   hw.Put<uint32_t>(kProtoV2);
-  hw.Put<uint32_t>(kFeatAcceptCompressed | kFeatEpoch);
+  hw.Put<uint32_t>(kFeatAcceptCompressed | kFeatEpoch | kFeatDeadline);
   const int64_t hello_thr = cfg.compress_threshold.load();
   hw.Put<uint64_t>(static_cast<uint64_t>(hello_thr > 0 ? hello_thr : 0));
   std::vector<char> hbody;
@@ -1503,11 +1757,15 @@ std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
                   ReadAnyFrame(fd, &ver, &msg_type, &flags, &rid, &hbody) &&
                   ver == 2 && msg_type == kHello;
   bool peer_compress = false;
+  bool peer_deadline = false;
   if (hello_ok) {
     ByteReader r(hbody.data(), hbody.size());
     uint32_t pver = 0, feats = 0;
     if (!r.Get(&pver) || !r.Get(&feats) || pver < kProtoV2) hello_ok = false;
     peer_compress = (feats & kFeatAcceptCompressed) != 0;
+    // only stamp deadline prefixes for servers that will strip them —
+    // pre-deadline v2 servers keep seeing byte-identical requests
+    peer_deadline = (feats & kFeatDeadline) != 0;
   }
   if (!hello_ok) {
     ::close(fd);
@@ -1530,29 +1788,76 @@ std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
   }
-  auto conn = std::make_shared<MuxConn>(fd, peer_compress,
-                                        cfg.compress_threshold,
-                                        cfg.max_inflight, epoch_sink_);
+  auto conn = std::make_shared<MuxConn>(
+      fd, peer_compress, cfg.compress_threshold, cfg.max_inflight,
+      epoch_sink_, peer_deadline);
   if (slot >= static_cast<int>(mux_conns_.size()))
     mux_conns_.resize(slot + 1);
   mux_conns_[slot] = conn;
   return conn;
 }
 
+int RpcChannel::PickSlot(int slots, int avoid) {
+  if (slots <= 1) return 0;
+  if (avoid >= 0 && slots == 2) return 1 - avoid;
+  if (!GlobalRpcConfig().p2c.load()) {
+    // blind rotation (the pre-p2c default)
+    int slot = static_cast<int>(mux_rr_.fetch_add(1) % slots);
+    if (slot == avoid) slot = (slot + 1) % slots;
+    return slot;
+  }
+  // power-of-two-choices: two distinct random slots, take the one with
+  // the lower (inflight, EWMA latency) score. An undialed slot scores
+  // as idle — it gets explored instead of starved.
+  auto& rng = ThreadLocalRng();
+  int a = static_cast<int>(rng.NextUInt(slots));
+  int b = static_cast<int>(rng.NextUInt(slots - 1));
+  if (b >= a) ++b;
+  if (a == avoid) a = b;
+  if (b == avoid) b = a;
+  if (a == b) return a;
+  int64_t ia = 0, ea = 0, ib = 0, eb = 0;
+  {
+    std::lock_guard<std::mutex> lk(mux_mu_);
+    auto score = [this](int s, int64_t* infl, int64_t* ewma) {
+      if (s < static_cast<int>(mux_conns_.size()) && mux_conns_[s] &&
+          !mux_conns_[s]->broken()) {
+        *infl = mux_conns_[s]->inflight();
+        *ewma = mux_conns_[s]->ewma_us();
+      }
+    };
+    score(a, &ia, &ea);
+    score(b, &ib, &eb);
+  }
+  // load first (a stalled conn accumulates inflight), latency second
+  if (ia != ib) return ia < ib ? a : b;
+  return ea <= eb ? a : b;
+}
+
 Status RpcChannel::MuxCall(uint32_t msg_type, const std::vector<char>& body,
-                           std::vector<char>* reply_body, int max_retries) {
+                           std::vector<char>* reply_body, int max_retries,
+                           int64_t deadline_abs_us) {
   Status last = Status::IOError("rpc not attempted");
   for (int attempt = 0; attempt < max_retries; ++attempt) {
     if (v1_fallback_.load()) return last;  // caller switches to v1
     int slots = std::max(GlobalRpcConfig().mux_connections.load(), 1);
-    int slot = static_cast<int>(mux_rr_.fetch_add(1) % slots);
+    int slot = PickSlot(slots);
     auto conn = MuxGet(slot);
     if (conn == nullptr) {
       if (v1_fallback_.load()) return last;
       JitteredBackoffUs(attempt);  // connect failed — dead endpoint
       continue;
     }
-    last = conn->Call(msg_type, body, reply_body);
+    // adaptive hedging (kExecute only — the idempotent-from-the-
+    // client's-view read verb; a hedged mutation would double-apply):
+    // needs a SECOND wire path, so mux_connections >= 2
+    int64_t hedge_us = GlobalRpcConfig().hedge_delay_us.load();
+    if (hedge_us > 0 && slots >= 2 && msg_type == kExecute) {
+      last = HedgedMuxCall(conn, slot, slots, msg_type, body, reply_body,
+                           hedge_us, deadline_abs_us);
+    } else {
+      last = conn->Call(msg_type, body, reply_body, deadline_abs_us);
+    }
     if (last.ok()) return last;
     // transport failure: the conn marked itself broken; the next attempt
     // re-dials (a dead endpoint fails fast in connect and backs off there)
@@ -1561,12 +1866,79 @@ Status RpcChannel::MuxCall(uint32_t msg_type, const std::vector<char>& body,
                          " failed after retries: " + last.message());
 }
 
+// One hedged sync call (see RpcConfig::hedge_delay_us): primary leg on
+// `conn`; if no reply lands inside hedge_us, the same request fires on
+// a different mux connection and the FIRST reply wins. The loser is
+// abandoned by request_id — CancelHedged drops its waiter so the demux
+// reader discards the late reply — and counted hedge_wasted exactly
+// once per abandoned leg. A leg that dies with its connection counts
+// as failed, not wasted; the call only fails when every submitted leg
+// failed (the outer MuxCall retry ladder then re-dials).
+Status RpcChannel::HedgedMuxCall(const std::shared_ptr<MuxConn>& conn,
+                                 int slot, int slots, uint32_t msg_type,
+                                 const std::vector<char>& body,
+                                 std::vector<char>* reply_body,
+                                 int64_t hedge_us,
+                                 int64_t deadline_abs_us) {
+  auto& ctr = GlobalRpcCounters();
+  auto g = std::make_shared<MuxConn::HedgeGroup>();
+  uint64_t id0 = conn->SubmitHedged(msg_type, body, g, 0, deadline_abs_us);
+  std::shared_ptr<MuxConn> conn1;
+  uint64_t id1 = 0;
+  {
+    std::unique_lock<std::mutex> lk(g->mu);
+    if (id0 == 0)
+      return Status::IOError("mux connection is down");
+    g->cv.wait_for(lk, std::chrono::microseconds(hedge_us), [&] {
+      return g->done || g->failed >= g->submitted;
+    });
+    if (!g->done && g->failed == 0) {
+      // primary leg is straggling: fire the hedge on a different conn
+      lk.unlock();
+      conn1 = MuxGet(PickSlot(slots, /*avoid=*/slot));
+      if (conn1 != nullptr) {
+        ctr.hedge_fired.fetch_add(1);
+        id1 = conn1->SubmitHedged(msg_type, body, g, 1, deadline_abs_us);
+      }
+      lk.lock();
+    }
+    g->cv.wait(lk, [&] { return g->done || g->failed >= g->submitted; });
+    if (!g->done) return g->fail_st;
+    if (g->winner == 1) ctr.hedge_won.fetch_add(1);
+    *reply_body = std::move(g->body);
+  }
+  // abandon the losing leg OUTSIDE g->mu (CancelHedged takes the conn
+  // lock; the reader takes conn lock then g->mu — same order matters).
+  // Counted wasted whether the cancel landed (reply still in flight,
+  // now discarded by request_id) or the loser's reply raced in first
+  // and was discarded at the group — both are abandoned work.
+  bool loser_inflight;
+  uint64_t loser_id;
+  std::shared_ptr<MuxConn> loser_conn;
+  {
+    std::lock_guard<std::mutex> lk(g->mu);
+    if (g->winner == 0) {
+      loser_conn = conn1;
+      loser_id = id1;
+    } else {
+      loser_conn = conn;
+      loser_id = id0;
+    }
+    loser_inflight = g->submitted == 2 && g->failed == 0;
+  }
+  if (loser_inflight && loser_conn != nullptr && loser_id != 0) {
+    loser_conn->CancelHedged(loser_id);
+    ctr.hedge_wasted.fetch_add(1);
+  }
+  return Status::OK();
+}
+
 void RpcChannel::CallAsync(
     uint32_t msg_type, std::vector<char> body,
     std::function<void(Status, std::vector<char>)> done) {
   if (mux_active()) {
     int slots = std::max(GlobalRpcConfig().mux_connections.load(), 1);
-    auto conn = MuxGet(static_cast<int>(mux_rr_.fetch_add(1) % slots));
+    auto conn = MuxGet(PickSlot(slots));
     if (conn != nullptr) {
       conn->CallAsync(msg_type, body, std::move(done));
       return;
@@ -1600,10 +1972,12 @@ void RpcChannel::CallAsync(
 }
 
 Status RpcChannel::Call(uint32_t msg_type, const std::vector<char>& body,
-                        std::vector<char>* reply_body, int max_retries) {
+                        std::vector<char>* reply_body, int max_retries,
+                        int64_t deadline_abs_us) {
   if (max_retries <= 0) max_retries = kRetryCount;
   if (mux_ && !v1_fallback_.load()) {
-    Status s = MuxCall(msg_type, body, reply_body, max_retries);
+    Status s = MuxCall(msg_type, body, reply_body, max_retries,
+                       deadline_abs_us);
     if (s.ok() || !v1_fallback_.load()) return s;
     // the server refused the hello mid-call: finish this call on v1
   }
@@ -2144,14 +2518,16 @@ float ClientManager::EdgeWeight(int shard, int type) const {
 }
 
 Status ClientManager::Execute(int shard, const ExecuteRequest& req,
-                              ExecuteReply* rep) {
+                              ExecuteReply* rep, int64_t deadline_abs_us) {
   if (shard < 0 || shard >= shard_num())
     return Status::InvalidArgument("bad shard index");
   ByteWriter w;
   EncodeExecuteRequest(req, &w);
   std::vector<char> reply;
   // snapshot: the monitor may swap the channel concurrently
-  ET_RETURN_IF_ERROR(Channel(shard)->Call(kExecute, w.buffer(), &reply));
+  ET_RETURN_IF_ERROR(Channel(shard)->Call(kExecute, w.buffer(), &reply,
+                                          /*max_retries=*/0,
+                                          deadline_abs_us));
   ByteReader r(reply.data(), reply.size());
   ET_RETURN_IF_ERROR(DecodeExecuteReply(&r, rep));
   return rep->status;
@@ -2288,13 +2664,14 @@ Status ClientManager::DeltaSince(uint64_t from, uint64_t* epoch,
 
 void ClientManager::ExecuteAsync(
     int shard, ExecuteRequest req,
-    std::function<void(Status, ExecuteReply)> done) {
+    std::function<void(Status, ExecuteReply)> done, int64_t deadline_abs_us) {
   // the Call() below blocks until the shard replies — it must not occupy
   // an executor thread (see ClientThreadPool comment in threadpool.h)
   ClientThreadPool()->Schedule(
-      [this, shard, req = std::move(req), done = std::move(done)] {
+      [this, shard, req = std::move(req), done = std::move(done),
+       deadline_abs_us] {
         ExecuteReply rep;
-        Status s = Execute(shard, req, &rep);
+        Status s = Execute(shard, req, &rep, deadline_abs_us);
         done(s, std::move(rep));
       });
 }

@@ -19,7 +19,11 @@
 //         | u64 body_len | body        (flags bit 0: body zlib-deflated,
 //           laid out as u64 raw_len | deflate stream; flags bit 1:
 //           reply body prefixed with the serving graph's u64 epoch —
-//           hello-negotiated, applied before compression)
+//           hello-negotiated, applied before compression; flags bit 2:
+//           REQUEST body prefixed with the caller's remaining deadline
+//           as u64 µs — hello-negotiated (kFeatDeadline), applied
+//           before compression; the server sheds a kExecute whose
+//           deadline expired before dispatch pickup)
 // msg types: 0 = Execute, 1 = ShardMeta, 2 = Ping, 6 = Hello (v2 only),
 //            7 = ApplyDelta, 8 = GetDelta (streaming graph deltas),
 //            9 = GetDeltaLog (raw retained delta records — the
@@ -80,6 +84,20 @@ struct RpcConfig {
   // next request past this depth (server mirrors it as a dispatch
   // bound), so a runaway feeder cannot queue unbounded server work.
   std::atomic<int> max_inflight{256};
+  // > 0: a sync mux kExecute call whose reply has not arrived after
+  // this delay fires a HEDGE — the same request on a DIFFERENT mux
+  // connection of the channel; the first reply wins and the loser is
+  // abandoned by request_id (its late reply is discarded at the demux
+  // reader). Needs mux_connections >= 2 to have a second wire path.
+  // 0 (default) disables — the data path is byte-identical to pre-
+  // hedging builds. The adaptive delay is computed on the Python side
+  // from the obs latency histograms (remote.py) and pushed here.
+  std::atomic<int64_t> hedge_delay_us{0};
+  // Power-of-two-choices mux connection selection: pick two random
+  // slots and use the one with the lower (inflight, EWMA latency)
+  // score instead of blind round-robin — a stalled connection stops
+  // attracting new calls. Default off (rotation, the pre-p2c path).
+  std::atomic<bool> p2c{false};
 
   RpcConfig() = default;
   RpcConfig(const RpcConfig& o) { *this = o; }
@@ -88,6 +106,8 @@ struct RpcConfig {
     mux_connections.store(o.mux_connections.load());
     compress_threshold.store(o.compress_threshold.load());
     max_inflight.store(o.max_inflight.load());
+    hedge_delay_us.store(o.hedge_delay_us.load());
+    p2c.store(o.p2c.load());
     return *this;
   }
 };
@@ -109,8 +129,38 @@ struct RpcCounters {
   std::atomic<uint64_t> v1_calls{0};         // calls over the classic path
   std::atomic<uint64_t> hello_fallbacks{0};  // v2 hello refused → v1
   std::atomic<int64_t> inflight{0};          // mux calls on the wire now
+  // ---- tail-latency machinery (deadline propagation + hedging) ----
+  // requests stamped with a propagated deadline (client edge)
+  std::atomic<uint64_t> deadline_propagated{0};
+  // kExecute requests a SERVER dropped unexecuted because their
+  // propagated deadline had already expired at dispatch pickup —
+  // answered with an explicit "deadline shed" status, never silently.
+  // Server-edge (loopback tests see both edges in one process).
+  std::atomic<uint64_t> deadline_shed{0};
+  std::atomic<uint64_t> hedge_fired{0};   // hedge legs submitted
+  std::atomic<uint64_t> hedge_won{0};     // hedge leg answered first
+  // legs abandoned after the other leg won: cancelled by request_id at
+  // the demux reader, their replies discarded. Counted exactly once
+  // per abandoned leg, at abandonment.
+  std::atomic<uint64_t> hedge_wasted{0};
 };
 RpcCounters& GlobalRpcCounters();
+
+// ---------------------------------------------------------------------------
+// Per-call deadline propagation (protocol v2, hello feature kFeatDeadline).
+// ---------------------------------------------------------------------------
+// Monotonic (steady_clock) now, in microseconds.
+int64_t SteadyNowUs();
+// Set/clear the CALLING THREAD's deadline for the next query run
+// (absolute steady-clock µs; 0 clears). The capi sets it just before
+// etq_exec_run on the same thread; QueryProxy::RunGremlinTimed consumes
+// it into the run's QueryEnv, and every REMOTE sub-call stamps its v2
+// request frame with the remaining budget so a shard can shed work that
+// can no longer make it. v1 peers (and calls with no deadline set) are
+// byte-unchanged.
+void SetCallDeadlineUs(int64_t abs_steady_us);
+// Read-and-clear the calling thread's deadline (0 = none set).
+int64_t TakeCallDeadlineUs();
 
 // ---------------------------------------------------------------------------
 // Shard metadata exchanged at client init (reference query_proxy.cc:62-105:
@@ -307,8 +357,13 @@ class RpcChannel : public std::enable_shared_from_this<RpcChannel> {
   // With set_mux(true) the call rides a shared v2 connection (many
   // in-flight calls per fd, replies demuxed by request_id); against a
   // v1 server the channel falls back to the classic path for life.
+  // deadline_abs_us > 0 (steady-clock µs) stamps each v2 kExecute
+  // request frame with the REMAINING budget at write time (hello-
+  // negotiated; v1 peers byte-unchanged) so the server can shed
+  // already-dead work; it does not bound the call locally.
   Status Call(uint32_t msg_type, const std::vector<char>& body,
-              std::vector<char>* reply_body, int max_retries = 0);
+              std::vector<char>* reply_body, int max_retries = 0,
+              int64_t deadline_abs_us = 0);
 
   // Async mux submission: invokes done(status, reply) when the reply
   // frame arrives (or the connection dies). Requires mux mode; without
@@ -343,7 +398,20 @@ class RpcChannel : public std::enable_shared_from_this<RpcChannel> {
   void Release(int fd);
   int Connect();
   Status MuxCall(uint32_t msg_type, const std::vector<char>& body,
-                 std::vector<char>* reply_body, int max_retries);
+                 std::vector<char>* reply_body, int max_retries,
+                 int64_t deadline_abs_us);
+  // One hedged sync mux call: primary leg on `conn`; past hedge_us
+  // without a reply, the same request fires on a second connection and
+  // the first reply wins (the loser is abandoned by request_id).
+  Status HedgedMuxCall(const std::shared_ptr<MuxConn>& conn, int slot,
+                       int slots, uint32_t msg_type,
+                       const std::vector<char>& body,
+                       std::vector<char>* reply_body, int64_t hedge_us,
+                       int64_t deadline_abs_us);
+  // Mux slot for the next call: p2c over (inflight, EWMA latency) when
+  // configured, else round-robin. `avoid` >= 0 excludes that slot (the
+  // hedge leg must take a different wire path).
+  int PickSlot(int slots, int avoid = -1);
   // Slot's live mux connection, dialing if absent/broken; nullptr on
   // connect failure. Sets v1_fallback_ when the server refuses hello.
   std::shared_ptr<MuxConn> MuxGet(int slot);
@@ -483,11 +551,16 @@ class ClientManager {
   // owned=true → hash-ownership count (hash-distribute sampleGL split).
   float GraphLabelWeight(int shard, bool owned = false) const;
 
-  // Blocking execute on one shard.
-  Status Execute(int shard, const ExecuteRequest& req, ExecuteReply* rep);
+  // Blocking execute on one shard. deadline_abs_us > 0 propagates the
+  // caller's remaining budget inside the v2 request frame (see
+  // RpcChannel::Call) — the QueryEnv plumbs it from the query's entry
+  // point down to every REMOTE sub-call.
+  Status Execute(int shard, const ExecuteRequest& req, ExecuteReply* rep,
+                 int64_t deadline_abs_us = 0);
   // Async: schedules on the global pool, invokes done on completion.
   void ExecuteAsync(int shard, ExecuteRequest req,
-                    std::function<void(Status, ExecuteReply)> done);
+                    std::function<void(Status, ExecuteReply)> done,
+                    int64_t deadline_abs_us = 0);
 
   // ---- streaming deltas ----
   // Highest graph epoch observed on any reply from any shard (passive:

@@ -8,7 +8,7 @@ ThreadPool::ThreadPool(size_t num_threads) {
   num_threads = std::max<size_t>(1, num_threads);
   threads_.reserve(num_threads);
   for (size_t i = 0; i < num_threads; ++i) {
-    threads_.emplace_back([this] { WorkerLoop(); });
+    threads_.emplace_back([this, i] { WorkerLoop(i); });
   }
 }
 
@@ -21,23 +21,35 @@ ThreadPool::~ThreadPool() {
   for (auto& t : threads_) t.join();
 }
 
-void ThreadPool::Schedule(std::function<void()> fn) {
+void ThreadPool::Schedule(std::function<void()> fn, Lane lane) {
   {
     std::lock_guard<std::mutex> lk(mu_);
-    queue_.push_back(std::move(fn));
+    (lane == kLow ? low_queue_ : queue_).push_back(std::move(fn));
   }
   cv_.notify_one();
 }
 
-void ThreadPool::WorkerLoop() {
+void ThreadPool::WorkerLoop(size_t worker_idx) {
+  // Lane preference (see Lane in the header): worker 0 drains LOW
+  // first, everyone else drains HIGH first — weak priority with a
+  // progress guarantee for both lanes. A single-thread pool's lone
+  // worker is worker 0 and still serves both lanes.
+  std::deque<std::function<void()>>* pref =
+      worker_idx == 0 ? &low_queue_ : &queue_;
+  std::deque<std::function<void()>>* other =
+      worker_idx == 0 ? &queue_ : &low_queue_;
   for (;;) {
     std::function<void()> fn;
     {
       std::unique_lock<std::mutex> lk(mu_);
-      cv_.wait(lk, [this] { return shutdown_ || !queue_.empty(); });
-      if (queue_.empty()) return;  // shutdown and drained
-      fn = std::move(queue_.front());
-      queue_.pop_front();
+      cv_.wait(lk, [this] {
+        return shutdown_ || !queue_.empty() || !low_queue_.empty();
+      });
+      std::deque<std::function<void()>>* q =
+          !pref->empty() ? pref : (!other->empty() ? other : nullptr);
+      if (q == nullptr) return;  // shutdown and both lanes drained
+      fn = std::move(q->front());
+      q->pop_front();
     }
     fn();
   }

@@ -19,6 +19,17 @@ namespace et {
 
 class ThreadPool {
  public:
+  // Dispatch lanes. kHigh is the default and serves the user read path
+  // (query execution); kLow carries maintenance traffic — delta
+  // applies, anti-entropy catch-up, snapshot compaction — so a burst
+  // of background work can never queue ahead of a user read. Weak
+  // priority, not strict: worker 0 prefers the LOW lane while every
+  // other worker prefers HIGH, so neither lane can be starved forever
+  // by a saturating flood of the other (the executor's "tasks must not
+  // block on same-pool tasks" invariant needs every lane to make
+  // progress).
+  enum Lane { kHigh = 0, kLow = 1 };
+
   explicit ThreadPool(size_t num_threads);
   ~ThreadPool();
 
@@ -26,16 +37,17 @@ class ThreadPool {
   ThreadPool& operator=(const ThreadPool&) = delete;
 
   // Enqueue fn for execution on some pool thread. Never blocks.
-  void Schedule(std::function<void()> fn);
+  void Schedule(std::function<void()> fn, Lane lane = kHigh);
 
   size_t num_threads() const { return threads_.size(); }
 
  private:
-  void WorkerLoop();
+  void WorkerLoop(size_t worker_idx);
 
   std::mutex mu_;
   std::condition_variable cv_;
-  std::deque<std::function<void()>> queue_;
+  std::deque<std::function<void()>> queue_;      // kHigh lane
+  std::deque<std::function<void()>> low_queue_;  // kLow lane
   bool shutdown_ = false;
   std::vector<std::thread> threads_;
 };

@@ -162,8 +162,12 @@ def _declare(lib: ctypes.CDLL) -> None:
         # RPC transport (protocol v2 mux / adaptive compression): global
         # config + client-edge counters — see euler_tpu.graph.remote
         # configure_rpc() / rpc_transport_stats() for the friendly wrapper
-        "etg_rpc_config": (None, [i32, i32, i64, i32]),
+        "etg_rpc_config": (None, [i32, i32, i64, i32, i64, i32]),
         "etg_rpc_stats": (None, [c_u64p]),
+        # tail latency: per-thread deadline handoff for the next query
+        # run (remaining ms; <= 0 clears) — REMOTE sub-calls stamp the
+        # remaining budget into their v2 request frames
+        "etg_set_call_deadline_ms": (None, [ctypes.c_double]),
         # streaming deltas: graph epoch + batched O(delta) apply +
         # dirty-set retrieval, on embedded handles (etg_*) and query
         # proxies (etq_* — local swaps the handle's graph, distribute

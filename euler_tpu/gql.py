@@ -112,13 +112,25 @@ class Query:
         return cls(lib, h)
 
     def run(self, gremlin: str,
-            inputs: Optional[Dict[str, np.ndarray]] = None
+            inputs: Optional[Dict[str, np.ndarray]] = None,
+            deadline_ms: Optional[float] = None
             ) -> Dict[str, np.ndarray]:
-        """Execute a chain; returns alias outputs ("name:i") + terminals."""
+        """Execute a chain; returns alias outputs ("name:i") + terminals.
+
+        deadline_ms: remaining per-call budget to PROPAGATE to remote
+        shards (v2 frames carry it; a shard sheds a request whose
+        budget expired before dispatch — counted deadline_shed, never a
+        silent partial). Does not bound the call locally; local proxies
+        and v1 peers ignore it."""
         lib = self._lib
         eh = lib.etq_exec_new(self._h)
         if eh == 0:
             raise EngineError(lib.etg_last_error().decode())
+        if deadline_ms is not None and deadline_ms > 0:
+            # per-thread handoff, consumed by the native run below; the
+            # finally clears it so a failed run can't leak the budget
+            # into the next deadline-less call on this thread
+            lib.etg_set_call_deadline_ms(float(deadline_ms))
         try:
             for name, arr in (inputs or {}).items():
                 a = np.ascontiguousarray(arr)
@@ -156,6 +168,8 @@ class Query:
                                         if rank.value else ())
             return out
         finally:
+            if deadline_ms is not None and deadline_ms > 0:
+                lib.etg_set_call_deadline_ms(0.0)
             lib.etq_exec_free(eh)
 
     # -- streaming deltas --------------------------------------------------

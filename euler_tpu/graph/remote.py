@@ -72,6 +72,10 @@ _TRANSPORT_MARKERS = (
     "broken pipe",
     "unavailable",
     "chaos:",
+    # a shard dropped the request because its PROPAGATED deadline
+    # expired in the dispatch queue — transport-shaped (the caller's
+    # budget decides whether another attempt is worth it)
+    "deadline shed",
 )
 
 
@@ -97,22 +101,28 @@ _RPC_STAT_KEYS = (
     "round_trips", "bytes_sent", "bytes_received", "bytes_sent_raw",
     "bytes_received_raw", "connections_opened", "compressed_frames_sent",
     "compressed_frames_received", "mux_calls", "v1_calls",
-    "hello_fallbacks", "inflight")
+    "hello_fallbacks", "inflight",
+    # tail-latency machinery: deadline_shed is SERVER-edge (loopback
+    # tests see both edges in one process), the rest client-edge
+    "deadline_propagated", "deadline_shed", "hedge_fired", "hedge_won",
+    "hedge_wasted")
 
 # Last config applied through configure_rpc (the native side has no
 # getter). RemoteGraphEngine reads `mux` to default pool_shared.
 _RPC_CONFIG = {"mux": False, "connections": 1, "compress_threshold": 0,
-               "max_inflight": 256}
+               "max_inflight": 256, "hedge_delay_ms": 0.0, "p2c": False}
 _rpc_mu = threading.Lock()
 _rpc_env_applied = False
 _rpc_obs_done = False
 
 
 def configure_rpc(mux=None, connections=None, compress_threshold=None,
-                  max_inflight=None) -> dict:
+                  max_inflight=None, hedge_delay_ms=None,
+                  p2c=None) -> dict:
     """Set the PROCESS-GLOBAL graph-RPC transport knobs; returns the
     resulting config. None leaves a knob unchanged. Applies to engines
-    (native channels) built AFTER the call.
+    (native channels) built AFTER the call — except hedge_delay_ms and
+    p2c, which live channels read per call.
 
     mux: one v2 connection carries many in-flight requests (correlation-
       id frames, demux reader) instead of one blocking fd per concurrent
@@ -121,7 +131,15 @@ def configure_rpc(mux=None, connections=None, compress_threshold=None,
     compress_threshold: > 0 zlib-1-deflates frame bodies >= this many
       bytes when the peer negotiated it (a frame that would not shrink
       is sent raw — adaptive per frame). max_inflight: per-connection
-      in-flight cap (client blocks / server bounds dispatch past it)."""
+      in-flight cap (client blocks / server bounds dispatch past it).
+    hedge_delay_ms: > 0 fires a HEDGE for a mux kExecute whose reply is
+      this late — same request on a second mux connection, first reply
+      wins, loser cancelled by request_id (hedge_fired/won/wasted
+      counters). Needs connections >= 2. 0 disables (the byte-identical
+      pre-hedging path). RemoteGraphEngine(hedge=True) keeps this
+      ADAPTIVE off the observed latency histogram. p2c: power-of-two-
+      choices mux connection selection off (inflight, EWMA latency)
+      instead of blind rotation."""
     from euler_tpu.core import lib as _lib
 
     lib = _lib.load()
@@ -135,12 +153,19 @@ def configure_rpc(mux=None, connections=None, compress_threshold=None,
                 int(compress_threshold), 0)
         if max_inflight is not None:
             _RPC_CONFIG["max_inflight"] = max(int(max_inflight), 1)
+        if hedge_delay_ms is not None:
+            _RPC_CONFIG["hedge_delay_ms"] = max(float(hedge_delay_ms), 0.0)
+        if p2c is not None:
+            _RPC_CONFIG["p2c"] = bool(p2c)
         lib.etg_rpc_config(
             -1 if mux is None else int(bool(mux)),
             0 if connections is None else max(int(connections), 1),
             -1 if compress_threshold is None else max(
                 int(compress_threshold), 0),
-            0 if max_inflight is None else max(int(max_inflight), 1))
+            0 if max_inflight is None else max(int(max_inflight), 1),
+            -1 if hedge_delay_ms is None else max(
+                int(float(hedge_delay_ms) * 1000.0), 0),
+            -1 if p2c is None else int(bool(p2c)))
         return dict(_RPC_CONFIG)
 
 
@@ -164,6 +189,10 @@ def configure_rpc_from_env() -> dict:
         kw["compress_threshold"] = int(os.environ["EULER_TPU_RPC_COMPRESS"])
     if os.environ.get("EULER_TPU_RPC_MAX_INFLIGHT"):
         kw["max_inflight"] = int(os.environ["EULER_TPU_RPC_MAX_INFLIGHT"])
+    if os.environ.get("EULER_TPU_RPC_HEDGE_MS"):
+        kw["hedge_delay_ms"] = float(os.environ["EULER_TPU_RPC_HEDGE_MS"])
+    if os.environ.get("EULER_TPU_RPC_P2C"):
+        kw["p2c"] = os.environ["EULER_TPU_RPC_P2C"] not in ("0", "")
     # apply BEFORE publishing the applied flag: a concurrently
     # constructing engine must never observe applied=True while the env
     # config has not reached the native side yet (it would build its
@@ -270,7 +299,12 @@ class RemoteGraphEngine:
                  pool_handles: Optional[int] = None,
                  pool_shared: Optional[bool] = None,
                  dedup: bool = False,
-                 chunk_size: int = 4096):
+                 chunk_size: int = 4096,
+                 hedge: bool = False,
+                 hedge_quantile: float = 0.95,
+                 hedge_min_ms: float = 1.0,
+                 hedge_max_ms: float = 250.0,
+                 deadline_propagation: bool = False):
         """retry_deadline_s: failover budget. A query that fails (shard
         died mid-call, RpcChannel exhausted its in-channel retries) is
         retried under RetryPolicy (exponential backoff, full jitter)
@@ -318,11 +352,45 @@ class RemoteGraphEngine:
         to independent calls (followers receive copies).
 
         chunk_size: id-set size above which a pooled engine splits a
-        batch call into concurrent chunks (ignored without a pool)."""
+        batch call into concurrent chunks (ignored without a pool).
+
+        hedge: adaptive straggler hedging on the mux transport — a
+        kExecute whose reply exceeds the hedge delay fires the SAME
+        request on a second mux connection; first reply wins, the loser
+        is cancelled by request_id (its late reply discarded at the
+        demux reader). The delay ADAPTS: every 64 calls it is recomputed
+        as the hedge_quantile of this engine's observed per-attempt
+        latency histogram (graph_rpc_attempt_ms — no retries/backoff),
+        clamped to [hedge_min_ms, hedge_max_ms] (the max is
+        also the cold-start delay before any data). Process-global knob
+        (configure_rpc) — the LAST engine to refresh wins, which is the
+        right behavior for the normal one-engine-per-process case.
+        Requires mux with connections >= 2; hedging off is byte-
+        identical to the pre-hedging wire. Sampling semantics: both
+        legs carry identical bytes, so a hedged sampling query returns
+        one of two draws of the same distribution.
+
+        deadline_propagation: stamp each attempt's REMAINING retry
+        budget into the v2 request frames (hello-negotiated) so a shard
+        sheds queued work that can no longer make it — counted
+        deadline_shed server-side, never a silent partial. v1 peers are
+        byte-unchanged; off (default) stamps nothing."""
         configure_rpc_from_env()  # before the native channels are built
         self.query = Query.remote(endpoints, seed=seed, mode=mode)
         self.retry = retry_policy or RetryPolicy(
             deadline_s=float(retry_deadline_s))
+        # tail-latency knobs (ISSUE 12): adaptive hedging + deadline
+        # propagation — both opt-in, both no-ops on the wire when off
+        self.hedge = bool(hedge)
+        self.hedge_quantile = float(hedge_quantile)
+        self.hedge_min_ms = float(hedge_min_ms)
+        self.hedge_max_ms = float(hedge_max_ms)
+        self.deadline_propagation = bool(deadline_propagation)
+        self._hedge_calls = 0  # refresh cadence counter (under _health_mu)
+        if self.hedge:
+            # arm at the conservative cold-start delay; the histogram
+            # takes over from the first refresh
+            configure_rpc(hedge_delay_ms=self.hedge_max_ms)
         self.retry_deadline_s = self.retry.deadline_s  # back-compat alias
         self.degrade = bool(degrade)
         # host-side rng for the client-computed node2vec bias; seed=0 →
@@ -344,6 +412,14 @@ class RemoteGraphEngine:
             "seconds slept in retry backoff", ("engine",)).labels(**lab)
         self._hist_call_ms = reg.histogram(
             "graph_rpc_ms", "end-to-end graph rpc latency incl. retries",
+            ("engine",)).labels(**lab)
+        # per-ATTEMPT wire latency (no retries, no backoff sleeps):
+        # the adaptive hedge delay reads its quantiles here — the
+        # end-to-end histogram above would inflate the delay with
+        # backoff exactly when stragglers/failures abound
+        self._hist_attempt_ms = reg.histogram(
+            "graph_rpc_attempt_ms",
+            "single-attempt graph rpc wire latency (hedge-delay signal)",
             ("engine",)).labels(**lab)
         self._last_error: Optional[str] = None
         _obs.register_health(self._obs_name, self.health)
@@ -404,15 +480,20 @@ class RemoteGraphEngine:
     # degrade=True must not accumulate threads/sockets without limit
     _MAX_STRAYS = 32
 
-    def _attempt(self, gql: str, feed, query=None):
+    def _attempt(self, gql: str, feed, query=None, deadline_ms=None):
         """One query attempt, bounded by retry.call_timeout_s when set
         (the RPC sockets block, so a black-holed connection can only be
         escaped by abandoning the attempt thread). `query` selects a
-        pooled handle; None uses the engine's own."""
+        pooled handle; None uses the engine's own. deadline_ms rides to
+        the shards inside the v2 frames (Query.run)."""
         query = query if query is not None else self.query
         t = self.retry.call_timeout_s
+        t_att = time.monotonic()
         if not t or t <= 0:
-            return query.run(gql, feed)
+            out = query.run(gql, feed, deadline_ms=deadline_ms)
+            self._hist_attempt_ms.observe(
+                (time.monotonic() - t_att) * 1000.0)
+            return out
         with self._health_mu:
             # reap strays that have since unblocked; refuse to grow past
             # the cap ("timeout" marker keeps this retryable/degradable)
@@ -426,7 +507,7 @@ class RemoteGraphEngine:
 
         def work():
             try:
-                box["out"] = query.run(gql, feed)
+                box["out"] = query.run(gql, feed, deadline_ms=deadline_ms)
             except BaseException as e:  # surfaced on join below
                 box["err"] = e
 
@@ -441,6 +522,7 @@ class RemoteGraphEngine:
                 "(in-flight attempt abandoned)")
         if "err" in box:
             raise box["err"]
+        self._hist_attempt_ms.observe((time.monotonic() - t_att) * 1000.0)
         return box["out"]
 
     def _run(self, gql: str, feed=None, query=None):
@@ -470,13 +552,22 @@ class RemoteGraphEngine:
             attempt = 0
             while True:
                 try:
-                    out = self._attempt(gql, feed, query)
+                    dl_ms = None
+                    if self.deadline_propagation:
+                        # each attempt ships the budget REMAINING now —
+                        # a shard sheds it once it can no longer make it
+                        dl_ms = max(
+                            (deadline - time.monotonic()) * 1000.0, 1.0)
+                    out = self._attempt(gql, feed, query,
+                                        deadline_ms=dl_ms)
                     if attempt:
                         # the call came back after ≥1 transport failure:
                         # the shard (or its replacement channel)
                         # recovered
                         self._bump("failovers")
                     sp.set(attempts=attempt + 1)
+                    if self.hedge:
+                        self._maybe_refresh_hedge()
                     return out
                 except EngineError as e:
                     if not retryable_error(e):
@@ -507,6 +598,30 @@ class RemoteGraphEngine:
 
     def _note_degraded(self) -> None:
         self._bump("degraded")
+
+    # -- adaptive hedging --------------------------------------------------
+    _HEDGE_REFRESH_CALLS = 64
+
+    def _maybe_refresh_hedge(self) -> None:
+        """Every _HEDGE_REFRESH_CALLS successful calls, recompute the
+        process-global hedge delay as the hedge_quantile of THIS
+        engine's per-attempt latency histogram (bucket-interpolated),
+        clamped to [hedge_min_ms, hedge_max_ms] — the adaptive
+        percentile the straggler detector fires at."""
+        with self._health_mu:
+            self._hedge_calls += 1
+            if self._hedge_calls % self._HEDGE_REFRESH_CALLS:
+                return
+        self.update_hedge_delay()
+
+    def update_hedge_delay(self) -> float:
+        """Force one adaptive-hedge-delay refresh; returns the applied
+        delay in ms (also pushed into the process-global RpcConfig)."""
+        q = self._hist_attempt_ms.quantile(self.hedge_quantile)
+        delay = self.hedge_max_ms if q is None else min(
+            max(float(q), self.hedge_min_ms), self.hedge_max_ms)
+        configure_rpc(hedge_delay_ms=delay)
+        return delay
 
     # -- pipelined submission / chunked intra-batch fan-out ----------------
     def submit(self, gql: str, feed=None):

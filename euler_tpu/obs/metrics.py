@@ -157,6 +157,37 @@ class _HistogramChild:
         out.append(["+Inf", n])
         return {"count": n, "sum": s, "buckets": out}
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile estimate (the Prometheus
+        histogram_quantile convention): find the bucket holding the
+        q-th observation and interpolate linearly inside its [lower,
+        upper] bounds, assuming observations are uniform within a
+        bucket. The first bucket interpolates from 0; the +Inf bucket
+        clamps to the last finite bound (an estimate cannot exceed what
+        the buckets resolve). None when the histogram is empty.
+
+        Exact for values ON bucket edges, within one bucket's width
+        otherwise — good enough for adaptive hedge delays and p2c,
+        which only need the tail's order of magnitude."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._mu:
+            counts = list(self._counts)
+            n = self._n
+        if n == 0:
+            return None
+        target = q * n
+        cum = 0.0
+        lower = 0.0
+        for le, c in zip(self.bounds, counts):
+            if cum + c >= target and c > 0:
+                frac = (target - cum) / c
+                return lower + (le - lower) * min(max(frac, 0.0), 1.0)
+            cum += c
+            lower = le
+        # target lands in the +Inf bucket: clamp to the last finite edge
+        return float(self.bounds[-1])
+
 
 class _Metric:
     """Shared label-family machinery. Subclasses set `kind` and
@@ -279,6 +310,12 @@ class Histogram(_Metric):
 
     def observe(self, v: float) -> None:
         self._default().observe(v)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile of the label-less child (see
+        _HistogramChild.quantile); labeled histograms call
+        .labels(...).quantile(q)."""
+        return self._default().quantile(q)
 
 
 def snapshot_delta(before: Dict, after: Dict) -> Dict:

@@ -55,6 +55,7 @@ from __future__ import annotations
 import itertools
 import json
 import random
+import select
 import socket
 import struct
 import threading
@@ -104,7 +105,25 @@ class ServingClient:
                  retry_policy: Optional[RetryPolicy] = None,
                  stale_ms: int = 10_000, seed: int = 0,
                  fanout: int = 0, swap_timeout_s: float = 120.0,
-                 bounds_ttl_s: float = 30.0):
+                 bounds_ttl_s: float = 30.0, hedge: bool = False,
+                 hedge_quantile: float = 0.9, hedge_min_ms: float = 1.0,
+                 hedge_max_ms: float = 200.0, p2c: bool = False):
+        """Tail-latency knobs (both opt-in, both byte-identical on the
+        wire when off):
+
+        hedge: adaptive straggler hedging per scatter-gather leg — a
+          sub-call whose reply exceeds the hedge delay fires the SAME
+          request on a SECOND replica of the same shard; the first
+          reply wins and the loser is abandoned (its connection
+          dropped so the stale reply can never be read into a later
+          request). The delay adapts per shard: the hedge_quantile of
+          the observed per-attempt latency histogram, clamped to
+          [hedge_min_ms, hedge_max_ms] (max is also the cold-start
+          delay). Counted hedge_fired / hedge_won / hedge_wasted.
+        p2c: power-of-two-choices replica selection off the observed
+          per-endpoint latency EWMA instead of blind rotation — two
+          random replicas, take the historically faster one (unknown
+          endpoints score as idle, so fresh replicas get explored)."""
         if not endpoints and not registry:
             raise ValueError("pass endpoints='hosts:h:p,...' or a "
                              "registry spec + service")
@@ -116,7 +135,14 @@ class ServingClient:
         self.bounds_ttl_s = float(bounds_ttl_s)
         self.retry = retry_policy or RetryPolicy(
             deadline_s=10.0, call_timeout_s=5.0)
+        self.hedge = bool(hedge)
+        self.hedge_quantile = float(hedge_quantile)
+        self.hedge_min_ms = float(hedge_min_ms)
+        self.hedge_max_ms = float(hedge_max_ms)
+        self.p2c = bool(p2c)
+        self._ep_lat: Dict[Tuple[str, int], float] = {}  # EWMA ms, _mu
         self._backoff_rng = random.Random(seed ^ 0x5E21 if seed else None)
+        self._pick_rng = random.Random(seed ^ 0x9C2 if seed else None)
         self._static: Optional[List[Tuple[str, int]]] = None
         if endpoints:
             if not endpoints.startswith("hosts:"):
@@ -159,6 +185,13 @@ class ServingClient:
                  "cached connections dropped because their endpoint "
                  "left the replica set"),
                 ("swaps", "per-replica hot-swap admin calls issued"),
+                ("hedge_fired", "hedge legs fired at straggling "
+                                "sub-calls"),
+                ("hedge_won", "hedged sub-calls won by the hedge leg"),
+                ("hedge_wasted", "losing hedge legs abandoned after "
+                                 "the other leg won"),
+                ("p2c_picks", "replica selections decided by "
+                              "power-of-two-choices"),
             )}
         self._ctr_fanout = {
             k: reg.counter(f"serving_fanout_{k}_total", h,
@@ -177,6 +210,12 @@ class ServingClient:
         self._hist_shard_ms = reg.histogram(
             "serving_client_shard_call_ms",
             "per-shard sub-call latency incl. retries",
+            ("client", "shard"))
+        # per-ATTEMPT wire latency (no backoff, no retries): the source
+        # the adaptive hedge delay and p2c read their percentiles from
+        self._hist_attempt_ms = reg.histogram(
+            "serving_client_attempt_ms",
+            "single-attempt wire latency per shard (hedge/p2c signal)",
             ("client", "shard"))
         self._last_error: Optional[str] = None
         _obs.register_health(self._obs_name, self.health)
@@ -258,11 +297,25 @@ class ServingClient:
                     "scatter-gather")
         return shard_list
 
-    def _next_replica(self, shard: Optional[int] = None
+    def _next_replica(self, shard: Optional[int] = None,
+                      avoid: Optional[Tuple[str, int]] = None
                       ) -> Tuple[str, int]:
+        """Pick a replica (within `shard` when given): power-of-two-
+        choices off the per-endpoint latency EWMA when p2c is on, blind
+        rotation otherwise. `avoid` excludes one endpoint — the hedge
+        leg must land on a DIFFERENT replica than its primary."""
         with self._mu:
             pool = self._replicas if shard is None \
                 else self._fleet.get(shard, [])
+            if avoid is not None:
+                pool = [ep for ep in pool if ep != avoid]
+                if pool:
+                    # hedge-leg pick: the historically fastest OTHER
+                    # replica, WITHOUT advancing the rotation counter —
+                    # a hedge consuming rotation slots would lock the
+                    # primary rotation's parity onto one replica
+                    return min(pool,
+                               key=lambda e: self._ep_lat.get(e, 0.0))
             if not pool:
                 # WireError subclasses ConnectionError → the call loop
                 # treats an (often transient) empty replica set as
@@ -272,9 +325,140 @@ class ServingClient:
                     f"no live replicas for {where}service "
                     f"{self.service!r} (registry empty or all entries "
                     "stale)")
+            if self.p2c and len(pool) >= 2:
+                a, b = self._pick_rng.sample(range(len(pool)), 2)
+                # unknown endpoints score 0.0 (idle): a fresh replica
+                # gets explored instead of starved behind history
+                la = self._ep_lat.get(pool[a], 0.0)
+                lb = self._ep_lat.get(pool[b], 0.0)
+                self._ctr["p2c_picks"].inc()
+                return pool[a] if la <= lb else pool[b]
             i = self._rr.get(shard, 0)
             self._rr[shard] = i + 1
             return pool[i % len(pool)]
+
+    def _observe_attempt(self, ep: Tuple[str, int],
+                         shard: Optional[int], ms: float) -> None:
+        """Per-attempt latency bookkeeping: the per-shard histogram the
+        adaptive hedge delay reads, and the per-endpoint EWMA p2c
+        ranks replicas by."""
+        if shard is not None:
+            self._hist_attempt_ms.labels(
+                client=self._obs_name, shard=str(shard)).observe(ms)
+        with self._mu:
+            old = self._ep_lat.get(ep)
+            self._ep_lat[ep] = ms if old is None \
+                else 0.7 * old + 0.3 * ms
+
+    def _hedge_delay_s(self, shard: int) -> float:
+        """Adaptive hedge trigger: the hedge_quantile of this shard's
+        observed per-attempt latency, clamped to [hedge_min_ms,
+        hedge_max_ms]; the max is also the cold-start delay before any
+        observations exist."""
+        q = self._hist_attempt_ms.labels(
+            client=self._obs_name, shard=str(shard)).quantile(
+            self.hedge_quantile)
+        ms = self.hedge_max_ms if q is None else min(
+            max(float(q), self.hedge_min_ms), self.hedge_max_ms)
+        return ms / 1000.0
+
+    def _abandon(self, ep: Tuple[str, int], wasted: bool = True) -> None:
+        """Abandon a hedge leg: its connection carries an unread reply
+        that would poison the NEXT request on a cached socket, so the
+        conn is dropped (closed), the reply discarded unread — it never
+        reaches a decoder, so it cannot mutate anything. wasted=True
+        counts the leg (exactly the abandoned-after-a-winner legs)."""
+        self._drop_conn(ep)
+        if wasted:
+            self._ctr["hedge_wasted"].inc()
+
+    def _exchange_hedged(self, s: socket.socket, ep: Tuple[str, int],
+                         shard: int, msg_type: int, body: bytes,
+                         deadline: float):
+        """One request/reply exchange with adaptive hedging: write on
+        the primary; if no reply lands inside the hedge delay, fire the
+        SAME request at a second replica and take the first readable
+        reply — the loser is abandoned (connection dropped, reply
+        discarded unread). Returns (reply_type, reply, winner_ep).
+
+        Latency attribution is per LEG: the winner records its own
+        write→reply time, and an abandoned leg records its elapsed
+        time at abandonment — a truthful lower bound that keeps a
+        straggler ranked slow in the p2c EWMA and keeps the straggle
+        visible to the adaptive-delay histogram (observing winners
+        only would shrink the quantile toward hedge_min and over-fire
+        hedges)."""
+        t0 = time.monotonic()
+        wire.write_frame(s, msg_type, body)
+        remaining = deadline - t0
+        delay = min(self._hedge_delay_s(shard), max(remaining, 0.001))
+        readable, _, _ = select.select([s], [], [], max(delay, 0.0))
+        if readable:
+            rt, rb = wire.read_frame(s)
+            self._observe_attempt(ep, shard,
+                                  (time.monotonic() - t0) * 1000.0)
+            return rt, rb, ep
+        try:
+            ep2 = self._next_replica(shard, avoid=ep)
+        except wire.WireError:
+            ep2 = None  # single-replica shard: nothing to hedge to
+        s2 = None
+        if ep2 is not None:
+            try:
+                s2 = self._conn(ep2)
+                t1 = time.monotonic()
+                wire.write_frame(s2, msg_type, body)
+                self._ctr["hedge_fired"].inc()
+            except (OSError, wire.WireError):
+                # the hedge replica is unreachable: fall back to the
+                # primary leg alone (a failed hedge must not fail a
+                # call its primary could still win)
+                self._drop_conn(ep2)
+                s2 = None
+        if s2 is None:
+            rt, rb = wire.read_frame(s)
+            self._observe_attempt(ep, shard,
+                                  (time.monotonic() - t0) * 1000.0)
+            return rt, rb, ep
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                # no winner inside the budget: both legs are failures
+                # (not wasted hedges); both conns carry straggling
+                # replies and must go
+                self._abandon(ep, wasted=False)
+                self._abandon(ep2, wasted=False)
+                raise socket.timeout(
+                    "hedged call: no leg answered inside the deadline")
+            readable, _, _ = select.select([s, s2], [], [], remaining)
+            if not readable:
+                continue
+            winner_is_primary = readable[0] is s
+            try:
+                rt, rb = wire.read_frame(s if winner_is_primary else s2)
+            except (OSError, wire.WireError):
+                # the winning socket died mid-frame: abandon both legs
+                # (the other carries an unread reply) and let the retry
+                # machinery classify the failure
+                self._abandon(ep, wasted=False)
+                self._abandon(ep2, wasted=False)
+                raise
+            now = time.monotonic()
+            if winner_is_primary:
+                # the hedge leg lost a SHORT race — its elapsed says
+                # nothing about the replica's speed, so it records no
+                # sample (an optimistic tiny value would flatter it)
+                self._observe_attempt(ep, shard, (now - t0) * 1000.0)
+                self._abandon(ep2)
+                return rt, rb, ep
+            self._ctr["hedge_won"].inc()
+            self._observe_attempt(ep2, shard, (now - t1) * 1000.0)
+            # the abandoned primary was outrun by delay+race: its
+            # elapsed is a truthful LOWER BOUND — recorded so the
+            # straggle stays visible to the EWMA and the delay quantile
+            self._observe_attempt(ep, shard, (now - t0) * 1000.0)
+            self._abandon(ep)
+            return rt, rb, ep2
 
     # -- connections (one cached socket per thread per endpoint) ----------
     def _conn(self, ep: Tuple[str, int]) -> socket.socket:
@@ -338,8 +522,20 @@ class ServingClient:
                     ep = self._next_replica(shard)
                     s = self._conn(ep)
                     body = make_body(max(remaining, 0.001))
-                    wire.write_frame(s, msg_type, body)
-                    reply_type, reply = wire.read_frame(s)
+                    if self.hedge and shard is not None:
+                        # per-LEG latency attribution happens inside:
+                        # charging the whole exchange (primary straggle
+                        # + hedge delay) to the winner would rank the
+                        # rescuing replica as the slow one
+                        reply_type, reply, ep = self._exchange_hedged(
+                            s, ep, shard, msg_type, body, deadline)
+                    else:
+                        t_att = time.monotonic()
+                        wire.write_frame(s, msg_type, body)
+                        reply_type, reply = wire.read_frame(s)
+                        self._observe_attempt(
+                            ep, shard,
+                            (time.monotonic() - t_att) * 1000.0)
                     if reply_type != msg_type:
                         raise wire.WireError(
                             f"reply type {reply_type} != {msg_type}")

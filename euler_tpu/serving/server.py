@@ -162,6 +162,11 @@ class InferenceServer:
       served corpus size (ms per 1000 rows) — models the corpus-
       proportional device scan a brute-force search costs, which is the
       cost sharding divides (chaos/bench only).
+    inject_stall_ms / inject_stall_p / inject_seed: per-replica
+      STRAGGLER injection — each flushed apply independently stalls
+      inject_stall_ms with probability inject_stall_p (seeded) — the
+      GC-pause / noisy-neighbor tail the hedging A/B measures against
+      (chaos/bench only).
     """
 
     def __init__(self, bundle: Union[ModelBundle, str],
@@ -172,7 +177,10 @@ class InferenceServer:
                  flush_ms: float = 2.0, max_queue: int = 0,
                  heartbeat_s: float = 1.0,
                  inject_apply_latency_ms: float = 0.0,
-                 inject_scan_ms_per_krow: float = 0.0):
+                 inject_scan_ms_per_krow: float = 0.0,
+                 inject_stall_ms: float = 0.0,
+                 inject_stall_p: float = 0.1,
+                 inject_seed: int = 0):
         if isinstance(bundle, str):
             bundle = self._load_bundle(bundle, shard)
         elif shard is not None and int(shard) != bundle.shard:
@@ -183,6 +191,12 @@ class InferenceServer:
         self.replica = int(replica)
         self._inject_s = float(inject_apply_latency_ms) / 1000.0
         self._scan_s_per_row = float(inject_scan_ms_per_krow) / 1e6
+        self._stall_s = float(inject_stall_ms) / 1000.0
+        self._stall_p = float(inject_stall_p)
+        self._stall_mu = threading.Lock()  # batcher workers share the rng
+        import random as _random
+
+        self._stall_rng = _random.Random(inject_seed)
         self.ladder = bucket_ladder(max_batch)
         self._swap_mu = threading.Lock()
         engine = _BundleEngine(bundle)
@@ -351,6 +365,13 @@ class InferenceServer:
             # corpus-proportional scan cost: the share a shard pays is
             # its corpus share — the cost partitioning divides
             s += self._scan_s_per_row * eng.ids.size
+        if self._stall_s > 0:
+            # per-replica straggler: an occasional seeded stall on this
+            # flush — the tail the hedging A/B is gated against
+            with self._stall_mu:
+                stalled = self._stall_rng.random() < self._stall_p
+            if stalled:
+                s += self._stall_s
         if s > 0:
             time.sleep(s)
 

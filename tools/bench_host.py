@@ -995,11 +995,182 @@ def bench_durability(args, g):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_tail(args):
+    """--mode tail: counted p999 tail-latency A/B on the graph read
+    path (ISSUE 12), against a live shard behind a chaos-proxy JITTER
+    link — per-connection random added latency, so with 2 mux
+    connections one wire path is a straggler and its sibling is fast
+    (the seed is chosen so the draw pattern is exactly that split —
+    stated in the artifact, it is the drill's setup, not its result).
+
+    Legs at mux_connections=2:
+
+      baseline : hedging off — blind rotation alternates the fast and
+                 the jittered connection; every slow-path call eats the
+                 full jitter. Byte-identical to the pre-hedging wire.
+      hedge    : adaptive hedging on (RemoteGraphEngine(hedge=True)):
+                 a call straggling past the graph_rpc_ms-quantile delay
+                 fires on the other connection, first reply wins, loser
+                 cancelled by request_id.
+      p2c      : power-of-two-choices connection selection only — load
+                 steers AWAY from the straggler instead of racing it.
+
+    All latencies are COUNTED per request (sorted-sample p50/p99/p999 —
+    exact order statistics, not wall-clock throughput claims — the
+    2-CPU convention). Gate: baseline p999 / hedge p999 >= 2.
+
+    A deadline drill follows: deadline_propagation=True under a
+    saturating concurrent burst with a tiny per-call budget — the shard
+    sheds queued work whose propagated budget expired (counted
+    deadline_shed, every failed call ends in an explicit status)."""
+    import tempfile
+    import threading
+
+    from euler_tpu.gql import start_service
+    from euler_tpu.graph import (GraphBuilder, RemoteGraphEngine,
+                                 RetryPolicy, configure_rpc,
+                                 rpc_transport_stats, seed)
+    from euler_tpu.graph.remote import RetryDeadlineExceeded
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from bench_serve import lat_summary
+    from chaos_proxy import ChaosProxy, per_conn_jitter_ms
+
+    feat_dim = args.feat_dim or 32
+    n = min(args.nodes, 20_000)
+    seed(1)
+    rng = np.random.default_rng(0)
+    b = GraphBuilder()
+    b.set_num_types(1, 1)
+    b.set_feature(0, 0, feat_dim, "feature")
+    ids = np.arange(1, n + 1, dtype=np.uint64)
+    b.add_nodes(ids)
+    m = n * min(args.degree, 8)
+    src = rng.integers(1, n + 1, m).astype(np.uint64)
+    dst = (rng.random(m) ** 2 * n).astype(np.uint64) + 1
+    b.add_edges(src, dst, weights=rng.random(m).astype(np.float32))
+    b.set_node_dense(
+        ids, 0,
+        rng.integers(-127, 128, (n, feat_dim)).astype(np.float32) / 16.0)
+    g = b.finalize()
+    d = tempfile.mkdtemp(prefix="et_tail_")
+    g.dump(d, num_partitions=1)
+    srv = start_service(d, shard_idx=0, shard_num=1, port=0)
+
+    # seed whose first two per-connection draws are (fast, slow): the
+    # straggler-link setup the drill needs (accept order = dial order)
+    jit = float(args.jitter_ms)
+    tail_seed = next(
+        s for s in range(1000)
+        if per_conn_jitter_ms(jit, s, 2)[0] < 0.1 * jit
+        and per_conn_jitter_ms(jit, s, 2)[1] > 0.6 * jit)
+    draws = [round(v, 2) for v in per_conn_jitter_ms(jit, tail_seed, 2)]
+    probe = ids[:256]
+    reqs = int(args.tail_reqs)
+
+    def leg(name, hedge=False, p2c=False):
+        proxy = ChaosProxy("127.0.0.1", srv.port, mode="jitter",
+                           jitter_ms=jit, seed=tail_seed).start()
+        configure_rpc(mux=True, connections=2, hedge_delay_ms=0, p2c=p2c)
+        eng = RemoteGraphEngine(f"hosts:127.0.0.1:{proxy.port}", seed=11,
+                                hedge=hedge,
+                                hedge_max_ms=float(args.hedge_max_ms))
+        # warmup OUTSIDE the counted window: the first calls pay the
+        # mux dials' hello RTT through the jittered link — a one-time
+        # connection cost, not the steady-state tail this leg measures
+        for _ in range(8):
+            eng.get_dense_feature(probe, [0], [feat_dim])
+        s0 = rpc_transport_stats()
+        lats = []
+        for _ in range(reqs):
+            t0 = time.monotonic()
+            eng.get_dense_feature(probe, [0], [feat_dim])
+            lats.append(time.monotonic() - t0)
+        s1 = rpc_transport_stats()
+        eng.close()
+        proxy.stop()
+        lats.sort()
+        out = {"leg": name, "requests": len(lats), "warmup_requests": 8,
+               **lat_summary(lats)}
+        out.update({k: s1[k] - s0[k]
+                    for k in ("hedge_fired", "hedge_won", "hedge_wasted",
+                              "deadline_propagated", "deadline_shed")})
+        return out
+
+    baseline = leg("baseline")
+    hedged = leg("hedge", hedge=True)
+    p2c = leg("p2c", p2c=True)
+
+    # -- deadline drill: propagated budgets shed under saturation ------
+    configure_rpc(mux=True, connections=2, hedge_delay_ms=0, p2c=False)
+    eng = RemoteGraphEngine(
+        f"hosts:127.0.0.1:{srv.port}", seed=11,
+        deadline_propagation=True,
+        retry_policy=RetryPolicy(deadline_s=0.02, max_attempts=2))
+    s0 = rpc_transport_stats()
+    statuses = {"ok": 0, "deadline": 0, "other": 0}
+    smu = threading.Lock()
+
+    def burst_worker():
+        for _ in range(16):
+            try:
+                eng.get_dense_feature(ids[:4096], [0], [feat_dim])
+                k = "ok"
+            except RetryDeadlineExceeded:
+                k = "deadline"  # explicit status — never a silent partial
+            except Exception:
+                k = "other"
+            with smu:
+                statuses[k] += 1
+
+    ts = [threading.Thread(target=burst_worker) for _ in range(16)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    s1 = rpc_transport_stats()
+    eng.close()
+    srv.stop()
+    configure_rpc(mux=False, connections=1, compress_threshold=0,
+                  hedge_delay_ms=0, p2c=False)
+    shed = s1["deadline_shed"] - s0["deadline_shed"]
+    deadline_drill = {
+        "propagated": s1["deadline_propagated"] - s0["deadline_propagated"],
+        "deadline_shed": shed,
+        "statuses": statuses,
+        "lost_without_status": 16 * 16 - sum(statuses.values()),
+    }
+
+    x = round(baseline["p999_ms"] / max(hedged["p999_ms"], 1e-9), 2)
+    entry = {
+        "bench": "tail_latency_graph",
+        "metric": "graph_p999_hedging_speedup_x",
+        "value": x,
+        "unit": f"x counted p999, hedge off/on ({jit:g}ms conn jitter)",
+        "detail": {
+            "jitter_ms": jit, "jitter_seed": tail_seed,
+            "conn_jitter_draws_ms": draws,
+            "baseline": baseline, "hedge": hedged, "p2c": p2c,
+            "deadline_drill": deadline_drill,
+            "gate": {"p999_speedup_x": x, "gate": 2.0, "ok": x >= 2.0,
+                     "hedges_counted": hedged["hedge_fired"] > 0
+                     and hedged["hedge_wasted"] > 0,
+                     "deadline_shed_counted": shed > 0,
+                     "lost_without_status":
+                         deadline_drill["lost_without_status"]},
+        },
+    }
+    record(entry)
+    ok = (x >= 2.0 and hedged["hedge_fired"] > 0 and shed > 0
+          and deadline_drill["lost_without_status"] == 0)
+    return 0 if ok else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=["fanout", "scale", "walk",
                                        "layerwise", "feeder", "table",
-                                       "rpc", "mutate"],
+                                       "rpc", "mutate", "tail"],
                     default="fanout")
     ap.add_argument("--layer_sizes", default="512,512")
     ap.add_argument("--nodes", type=int, default=100_000)
@@ -1031,6 +1202,17 @@ def main(argv=None):
     ap.add_argument("--compress_threshold", type=int, default=1024,
                     help="rpc mode: zlib-1 frame bodies >= this many "
                          "bytes on the mux_full leg")
+    ap.add_argument("--jitter_ms", type=float, default=50.0,
+                    help="tail mode: chaos-proxy per-connection jitter "
+                         "bound (one mux connection draws slow, its "
+                         "sibling fast)")
+    ap.add_argument("--hedge_max_ms", type=float, default=15.0,
+                    help="tail mode: adaptive hedge delay clamp (also "
+                         "the cold-start delay)")
+    ap.add_argument("--tail_reqs", type=int, default=400,
+                    help="tail mode: counted requests per leg (p999 at "
+                         "this n is a near-max order statistic — "
+                         "reported as counted, not extrapolated)")
     args = ap.parse_args(argv)
     if args.mode == "table":
         # the K-wide virtual CPU mesh must exist before the first jax
@@ -1060,6 +1242,8 @@ def main(argv=None):
         bench_feeder(args)
     elif args.mode == "rpc":
         bench_rpc(args)
+    elif args.mode == "tail":
+        sys.exit(bench_tail(args))
     elif args.mode == "mutate":
         import jax
 

@@ -80,12 +80,27 @@ def make_bundle(out_dir: str, nodes: int, dim: int, seed: int = 0,
         else b.save(out_dir)
 
 
+def lat_summary(lats_s: list) -> dict:
+    """Counted latency order statistics off a SORTED seconds list:
+    p50/p99/p999 in ms, plus p9999 when the sample count can resolve it
+    (>= 5000 — below that the estimate is just the max re-labeled)."""
+    def pct(p):
+        return round(lats_s[min(int(len(lats_s) * p), len(lats_s) - 1)]
+                     * 1000, 3) if lats_s else None
+
+    return {"p50_ms": pct(0.50), "p99_ms": pct(0.99),
+            "p999_ms": pct(0.999),
+            "p9999_ms": pct(0.9999) if len(lats_s) >= 5000 else None}
+
+
 def slo_verdict(p99_ms, reqs: int, shed: int, lost: int,
-                p99_gate_ms: float, shed_rate_gate: float) -> dict:
-    """The diffable acceptance block: measured p99 / shed rate /
-    lost-without-status vs the stated gates, with an explicit verdict.
-    lost-without-status gates at ZERO always — a request with no status
-    is a contract violation, not a tunable."""
+                p99_gate_ms: float, shed_rate_gate: float,
+                p999_ms=None, p999_gate_ms: float = 0.0) -> dict:
+    """The diffable acceptance block: measured p99 (and p999 when a
+    gate is stated) / shed rate / lost-without-status vs the stated
+    gates, with an explicit verdict. lost-without-status gates at ZERO
+    always — a request with no status is a contract violation, not a
+    tunable."""
     shed_rate = round(shed / max(reqs + shed, 1), 4)
     checks = {
         "p99_ms": {"value": p99_ms, "gate": p99_gate_ms,
@@ -95,6 +110,10 @@ def slo_verdict(p99_ms, reqs: int, shed: int, lost: int,
         "lost_without_status": {"value": lost, "gate": 0,
                                 "ok": lost == 0},
     }
+    if p999_gate_ms > 0:
+        checks["p999_ms"] = {
+            "value": p999_ms, "gate": p999_gate_ms,
+            "ok": p999_ms is not None and p999_ms <= p999_gate_ms}
     return {**checks, "pass": all(c["ok"] for c in checks.values())}
 
 
@@ -154,11 +173,6 @@ def run_leg(bundle_dir: str, *, threads: int, reqs_per_thread: int,
     health = srv.health()
     srv.stop()
     lats.sort()
-
-    def pct(p):
-        return round(lats[min(int(len(lats) * p), len(lats) - 1)] * 1000,
-                     3) if lats else None
-
     return {
         "mode": "batch1" if max_batch == 1 else f"flush{flush_ms:g}ms",
         "verb": verb,
@@ -168,8 +182,7 @@ def run_leg(bundle_dir: str, *, threads: int, reqs_per_thread: int,
         # a request with no status would show up here — the contract
         # is that this is always 0
         "lost": threads * reqs_per_thread - len(lats) - errors[0],
-        "p50_ms": pct(0.50),
-        "p99_ms": pct(0.99),
+        **lat_summary(lats),
         "reqs_per_s": round(len(lats) / max(wall, 1e-9), 1),
         "max_batch": max_batch,
         "flush_ms": flush_ms,
@@ -240,17 +253,12 @@ def _drive_fleet(registry: str, service: str, *, threads: int,
     for c in clients:
         c.close()
     lats.sort()
-
-    def pct(p):
-        return round(lats[min(int(len(lats) * p), len(lats) - 1)] * 1000,
-                     3) if lats else None
-
     return {
         "threads": threads, "requests": len(lats), "errors": errors[0],
         "shed": sheds[0],
         "lost": (threads * reqs_per_thread - len(lats) - errors[0]
                  - sheds[0]),
-        "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+        **lat_summary(lats),
         "reqs_per_s": round(len(lats) / max(wall, 1e-9), 1),
     }
 
@@ -347,7 +355,117 @@ def run_fleet(args) -> dict:
         out["fleet"]["p99_ms"], out["fleet"]["requests"],
         out["fleet"]["shed"],
         out["fleet"]["lost"] + out["swap"]["lost_without_status"],
-        args.slo_p99_ms, args.slo_shed_rate)
+        args.slo_p99_ms, args.slo_shed_rate,
+        p999_ms=out["fleet"]["p999_ms"], p999_gate_ms=args.slo_p999_ms)
+    return out
+
+
+def run_tail(args) -> dict:
+    """--tail: the serving-side tail-latency A/B (ISSUE 12). One shard,
+    two replicas, one of them a STRAGGLER (seeded per-flush stall of
+    --tail_stall_ms with probability --tail_stall_p — per-replica
+    jitter at the apply, the serving analogue of a GC-pausing host).
+    Legs, each a fresh client against the same fleet:
+
+      baseline  : blind replica rotation — half the requests eat the
+                  straggler (byte-identical pre-hedging path);
+      hedge     : adaptive hedging — a leg straggling past the
+                  per-shard latency-histogram quantile fires on the
+                  OTHER replica, first reply wins, loser abandoned
+                  (hedge_fired/won/wasted counted);
+      p2c       : power-of-two-choices replica selection only.
+
+    Counted per-request latencies (sorted order statistics), gate:
+    baseline p999 / hedge p999 >= 2. A deadline drill follows: tight
+    client budgets against the straggling fleet — queued work whose
+    deadline expired is SHED explicitly (server deadline_shed counter),
+    the client fails over inside its budget, nothing is lost without a
+    status."""
+    from euler_tpu.graph.remote import RetryPolicy
+    from euler_tpu.serving import InferenceServer, ServingClient
+
+    out: dict = {"stall_ms": args.tail_stall_ms,
+                 "stall_p": args.tail_stall_p}
+    with tempfile.TemporaryDirectory() as td:
+        td = Path(td)
+        bundle = make_bundle(str(td / "b"), args.nodes, args.dim,
+                             args.seed)
+        reg = str(td / "reg")
+        fast = InferenceServer(bundle, registry=reg, service="btail",
+                               shard=0, replica=0, flush_ms=0.5)
+        slow = InferenceServer(bundle, registry=reg, service="btail",
+                               shard=0, replica=1, flush_ms=0.5,
+                               inject_stall_ms=args.tail_stall_ms,
+                               inject_stall_p=args.tail_stall_p,
+                               inject_seed=args.seed + 1)
+        pol = RetryPolicy(deadline_s=30.0, call_timeout_s=20.0)
+        rng = np.random.default_rng(args.seed)
+        qs = [rng.integers(0, args.nodes, args.q).astype(np.uint64)
+              for _ in range(args.reqs)]
+
+        def leg(name, **cli_kw):
+            cli = ServingClient(registry=reg, service="btail",
+                                retry_policy=pol, seed=args.seed,
+                                **cli_kw)
+            for q in qs[:8]:  # warmup: conns + hedge-delay histogram
+                cli.embed(q)
+            lats = []
+            for q in qs:
+                t0 = time.monotonic()
+                cli.embed(q)
+                lats.append(time.monotonic() - t0)
+            h = cli.health()
+            cli.close()
+            lats.sort()
+            return {"leg": name, "requests": len(lats),
+                    "warmup_requests": 8, **lat_summary(lats),
+                    **{k: h[k] for k in ("hedge_fired", "hedge_won",
+                                         "hedge_wasted", "p2c_picks")}}
+
+        out["baseline"] = leg("baseline")
+        out["hedge"] = leg("hedge", hedge=True,
+                           hedge_max_ms=args.tail_hedge_max_ms)
+        out["p2c"] = leg("p2c", p2c=True)
+
+        # -- deadline drill: tight budgets shed explicitly -------------
+        shed0 = slow.health()["deadline_shed"]
+        cli = ServingClient(
+            registry=reg, service="btail", seed=args.seed,
+            retry_policy=RetryPolicy(
+                deadline_s=max(args.tail_stall_ms * 0.6, 10.0) / 1000.0,
+                call_timeout_s=2.0))
+        drill = {"ok": 0, "overloaded": 0, "deadline": 0, "other": 0}
+        from euler_tpu.serving import ServerOverloaded
+        from euler_tpu.graph.remote import RetryDeadlineExceeded
+
+        for q in qs[:60]:
+            try:
+                cli.embed(q)
+                drill["ok"] += 1
+            except ServerOverloaded:
+                drill["overloaded"] += 1
+            except RetryDeadlineExceeded:
+                drill["deadline"] += 1
+            except Exception:
+                drill["other"] += 1
+        cli.close()
+        drill["server_deadline_shed"] = \
+            slow.health()["deadline_shed"] - shed0
+        drill["lost_without_status"] = 60 - sum(
+            drill[k] for k in ("ok", "overloaded", "deadline", "other"))
+        out["deadline_drill"] = drill
+        fast.stop()
+        slow.stop()
+
+    x = round(out["baseline"]["p999_ms"]
+              / max(out["hedge"]["p999_ms"], 1e-9), 2)
+    out["gate"] = {
+        "p999_speedup_x": x, "gate": 2.0, "ok": x >= 2.0,
+        "hedges_counted": out["hedge"]["hedge_fired"] > 0
+        and out["hedge"]["hedge_wasted"] > 0,
+        "deadline_shed_counted": drill["server_deadline_shed"] > 0,
+        "lost_without_status": drill["lost_without_status"],
+    }
     return out
 
 
@@ -383,10 +501,48 @@ def main(argv=None) -> int:
                          "the PERF.md convention)")
     ap.add_argument("--slo_p99_ms", type=float, default=500.0,
                     help="SLO gate: p99 request latency")
+    ap.add_argument("--slo_p999_ms", type=float, default=2000.0,
+                    help="SLO gate: p999 request latency (counted "
+                         "order statistic; at small sample counts this "
+                         "is a near-max)")
     ap.add_argument("--slo_shed_rate", type=float, default=0.05,
                     help="SLO gate: shed fraction of offered requests")
+    ap.add_argument("--tail", action="store_true",
+                    help="run the tail-latency hedging A/B (one shard, "
+                         "two replicas, one straggler) instead of the "
+                         "batching sweep — perf.json `tail_latency`")
+    ap.add_argument("--tail_stall_ms", type=float, default=50.0,
+                    help="tail mode: straggler replica's injected "
+                         "per-flush stall")
+    ap.add_argument("--tail_stall_p", type=float, default=0.2,
+                    help="tail mode: per-flush stall probability on "
+                         "the straggler replica (a TAIL, not a median "
+                         "shift — at 1.0 half of rotated traffic is "
+                         "slow and the adaptive hedge delay can only "
+                         "sit at its clamp)")
+    ap.add_argument("--tail_hedge_max_ms", type=float, default=25.0,
+                    help="tail mode: adaptive hedge delay clamp / "
+                         "cold-start delay")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
+
+    if args.tail:
+        if args.reqs <= 50:
+            args.reqs = 300  # enough samples for a meaningful p999
+        tail = run_tail(args)
+        record({
+            "bench": "tail_latency",
+            "metric": "serving_p999_hedging_speedup_x",
+            "value": tail["gate"]["p999_speedup_x"],
+            "unit": f"x counted p999, hedge off/on "
+                    f"({args.tail_stall_ms:g}ms replica stall, "
+                    f"p={args.tail_stall_p:g})",
+            "detail": tail,
+        })
+        g = tail["gate"]
+        return 0 if (g["ok"] and g["hedges_counted"]
+                     and g["deadline_shed_counted"]
+                     and g["lost_without_status"] == 0) else 1
 
     if args.shards > 1:
         fleet = run_fleet(args)
@@ -438,7 +594,9 @@ def main(argv=None) -> int:
                    "slo": slo_verdict(
                        best["p99_ms"], best["requests"], best["shed"],
                        sum(r["lost"] for r in rows),
-                       args.slo_p99_ms, args.slo_shed_rate)},
+                       args.slo_p99_ms, args.slo_shed_rate,
+                       p999_ms=best["p999_ms"],
+                       p999_gate_ms=args.slo_p999_ms)},
     })
     return 0
 

@@ -19,6 +19,15 @@ fakes at the Python API boundary; this breaks the actual wire):
                 kApplyDelta body, not a cleanly truncated file) — the
                 durability tests drive this to pin that a torn wire
                 frame neither applies nor corrupts the shard's WAL;
+  * jitter    — pipe, but each NEW connection draws a random added
+                latency j ~ U(0, jitter_ms) (seeded, in accept order)
+                applied to every server→client chunk: a per-connection
+                straggler link against the real framed-TCP stack — the
+                wire-level injection the mux hedging / p2c drills run
+                behind (one mux connection slow, its sibling fast).
+                Every applied delay bumps the jitter_injected counter;
+                per_conn_jitter_ms(seed, n) mirrors the draw sequence
+                so tests can pick seeds with a known fast/slow split;
   * ok        — transparent bidirectional pipe.
 
 The mode applies per NEW connection; switching to reset/blackhole also
@@ -53,26 +62,41 @@ import struct
 import threading
 import time
 
-MODES = ("ok", "reset", "stall", "blackhole", "cut")
+MODES = ("ok", "reset", "stall", "blackhole", "cut", "jitter")
+
+
+def per_conn_jitter_ms(jitter_ms: float, seed: int, n: int):
+    """The first n per-connection jitter draws a ChaosProxy(mode=
+    "jitter", jitter_ms=, seed=) will assign, in accept order — the
+    SAME rng sequence the proxy consumes, so tests/benches can choose a
+    seed whose draw pattern has a known fast/slow connection split."""
+    rng = random.Random(seed)
+    return [rng.uniform(0.0, float(jitter_ms)) for _ in range(n)]
 
 
 class ChaosProxy:
     def __init__(self, target_host: str, target_port: int,
                  listen_port: int = 0, mode: str = "ok",
                  stall_s: float = 0.5, seed: int = 0,
-                 mode_weights=None, cut_after_bytes: int = 64):
+                 mode_weights=None, cut_after_bytes: int = 64,
+                 jitter_ms: float = 0.0):
         """mode_weights: optional {mode: weight} dict — each new
         connection draws its mode from this distribution (seeded);
         None uses the fixed `mode` (set_mode switches it live).
         cut_after_bytes: "cut" mode's per-connection client→server byte
         budget before the RST — pick it to land INSIDE the frame under
         test (e.g. past the 16-byte v1 header but before the body ends)
-        to produce a genuinely torn wire frame."""
+        to produce a genuinely torn wire frame.
+        jitter_ms: "jitter" mode's per-connection latency bound — each
+        accepted connection draws U(0, jitter_ms) once (seeded, accept
+        order; see per_conn_jitter_ms) and every server→client chunk on
+        it is delayed by that draw."""
         if mode not in MODES:
             raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
         self.target = (target_host, int(target_port))
         self.stall_s = float(stall_s)
         self.cut_after_bytes = int(cut_after_bytes)
+        self.jitter_ms = float(jitter_ms)
         self._mode = mode
         self._weights = dict(mode_weights) if mode_weights else None
         self._rng = random.Random(seed)
@@ -86,6 +110,7 @@ class ChaosProxy:
         self._conns: list = []  # live sockets (client + upstream)
         self.counters = {"accepted": 0, "ok": 0, "reset": 0, "stall": 0,
                          "blackhole": 0, "cut": 0, "cuts_fired": 0,
+                         "jitter": 0, "jitter_injected": 0,
                          "bytes_up": 0, "bytes_down": 0}
 
     # -- control -----------------------------------------------------------
@@ -204,12 +229,29 @@ class ChaosProxy:
             return
         if mode == "stall":
             time.sleep(self.stall_s)
+        jitter_s = 0.0
+        if mode == "jitter":
+            # one draw per CONNECTION (accept order, seeded): this
+            # connection is a consistently slow — or fast — link for
+            # its whole life, which is what per-replica/per-conn
+            # straggler hedging must route around
+            with self._mu:
+                jitter_s = self._rng.uniform(0.0, self.jitter_ms) / 1000.0
         try:
             upstream = socket.create_connection(self.target, timeout=5.0)
             upstream.settimeout(None)
         except OSError:
             client.close()
             return
+        # NODELAY both hops: without it, Nagle + delayed-ACK adds ~40ms
+        # stalls on multi-write frames — noise that would drown the
+        # latency the jitter mode intends to inject (the endpoints
+        # behind/in front of the proxy already set it)
+        for s in (client, upstream):
+            try:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
         with self._mu:
             self._conns.extend((client, upstream))
         cut_budget = self.cut_after_bytes if mode == "cut" else None
@@ -218,18 +260,24 @@ class ChaosProxy:
                                    cut_budget),
                              daemon=True)
         b = threading.Thread(target=self._pipe,
-                             args=(upstream, client, "bytes_down"),
+                             args=(upstream, client, "bytes_down", None,
+                                   jitter_s),
                              daemon=True)
         a.start()
         b.start()
 
     def _pipe(self, src: socket.socket, dst: socket.socket,
-              counter: str, cut_budget=None) -> None:
+              counter: str, cut_budget=None, delay_s: float = 0.0) -> None:
         try:
             while True:
                 data = src.recv(1 << 16)
                 if not data:
                     break
+                if delay_s > 0:
+                    # jitter mode: this connection's fixed added latency
+                    # on every server→client chunk
+                    self.counters["jitter_injected"] += 1
+                    time.sleep(delay_s)
                 if cut_budget is not None:
                     # kill-after-N-bytes: forward only up to the budget,
                     # then RST both directions — the far end has a
@@ -282,6 +330,11 @@ def main() -> None:
     ap.add_argument("--cut_after_bytes", type=int, default=64,
                     help="cut mode: client→server bytes forwarded "
                          "before the mid-frame RST")
+    ap.add_argument("--jitter_ms", type=float, default=0.0,
+                    help="jitter mode: per-connection added latency "
+                         "bound — each accepted connection draws "
+                         "U(0, jitter_ms) once (seeded) and every "
+                         "server→client chunk is delayed by it")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--reset_rate", type=float, default=0.0,
                     help="probabilistic mix: P(reset) per connection "
@@ -292,10 +345,13 @@ def main() -> None:
     if args.reset_rate > 0:
         weights = {"reset": args.reset_rate,
                    args.mode: max(1.0 - args.reset_rate, 0.0)}
+    if args.jitter_ms > 0 and args.mode == "ok":
+        args.mode = "jitter"  # --jitter_ms alone means jitter mode
     proxy = ChaosProxy(host, int(port), listen_port=args.listen_port,
                        mode=args.mode, stall_s=args.stall_s,
                        seed=args.seed, mode_weights=weights,
-                       cut_after_bytes=args.cut_after_bytes)
+                       cut_after_bytes=args.cut_after_bytes,
+                       jitter_ms=args.jitter_ms)
     proxy.start()
     print(f"chaos proxy listening on 127.0.0.1:{proxy.port} -> "
           f"{args.target} (mode={args.mode})", flush=True)
