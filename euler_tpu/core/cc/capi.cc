@@ -705,7 +705,8 @@ int etg_get_edge_binary_feature(int64_t h, const uint64_t* src,
 // created afterwards (engines built after the call). Negative values
 // leave the corresponding knob unchanged.
 void etg_rpc_config(int mux, int mux_connections, int64_t compress_threshold,
-                    int max_inflight, int64_t hedge_delay_us, int p2c) {
+                    int max_inflight, int64_t hedge_delay_us, int p2c,
+                    int hedge_replicas) {
   auto& c = et::GlobalRpcConfig();
   if (mux >= 0) c.mux = mux != 0;
   if (mux_connections > 0) c.mux_connections = mux_connections;
@@ -713,6 +714,7 @@ void etg_rpc_config(int mux, int mux_connections, int64_t compress_threshold,
   if (max_inflight > 0) c.max_inflight = max_inflight;
   if (hedge_delay_us >= 0) c.hedge_delay_us = hedge_delay_us;
   if (p2c >= 0) c.p2c = p2c != 0;
+  if (hedge_replicas >= 0) c.hedge_replicas = hedge_replicas != 0;
 }
 
 // Per-thread deadline handoff for the NEXT query run on this thread
@@ -726,12 +728,13 @@ void etg_set_call_deadline_ms(double remaining_ms) {
           : 0);
 }
 
-// out[17]: round_trips, bytes_sent, bytes_received, bytes_sent_raw,
+// out[21]: round_trips, bytes_sent, bytes_received, bytes_sent_raw,
 // bytes_received_raw, connections_opened, compressed_frames_sent,
 // compressed_frames_received, mux_calls, v1_calls, hello_fallbacks,
 // inflight (gauge), deadline_propagated, deadline_shed (server edge),
-// hedge_fired, hedge_won, hedge_wasted. Client-edge accounting except
-// deadline_shed (see RpcCounters).
+// hedge_fired, hedge_won, hedge_wasted, stale_map_shed (server edge),
+// replica_hedge_fired, replica_hedge_won, replica_hedge_wasted.
+// Client-edge accounting except the *_shed pair (see RpcCounters).
 void etg_rpc_stats(uint64_t* out) {
   auto& c = et::GlobalRpcCounters();
   out[0] = c.round_trips.load();
@@ -751,6 +754,23 @@ void etg_rpc_stats(uint64_t* out) {
   out[14] = c.hedge_fired.load();
   out[15] = c.hedge_won.load();
   out[16] = c.hedge_wasted.load();
+  out[17] = c.stale_map_shed.load();
+  out[18] = c.replica_hedge_fired.load();
+  out[19] = c.replica_hedge_won.load();
+  out[20] = c.replica_hedge_wasted.load();
+}
+
+// Push an ownership-map spec to one graph server over the admin verb
+// (kSetOwnership) — the elastic driver's per-shard flip. Returns 0 and
+// writes the installed epoch to *out_epoch on success.
+int etg_push_ownership(const char* host, int port, const char* spec,
+                       int64_t* out_epoch) {
+  uint64_t e = 0;
+  et::Status s = et::PushOwnership(host ? host : "", port,
+                                   spec ? spec : "", &e);
+  if (!s.ok()) return Fail(s.message());
+  if (out_epoch != nullptr) *out_epoch = static_cast<int64_t>(e);
+  return 0;
 }
 
 // out[8]: wal appends, fsyncs, replayed_records, compactions,

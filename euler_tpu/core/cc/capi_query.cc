@@ -198,6 +198,58 @@ int etq_apply_delta(int64_t h, int64_t n_nodes, const uint64_t* node_ids,
   return 0;
 }
 
+// ---- elastic fleet (ownership maps; distribute-mode proxies) ----
+// Install the ownership map this client routes with (spec from the
+// registry). Fails on local proxies, on maps older than the installed
+// one, and on maps referencing shards beyond this client's channels
+// (rebuild the proxy against the grown fleet first).
+int etq_set_ownership(int64_t h, const char* spec) {
+  auto qp = GetProxy(h);
+  if (!qp) return FailWith("bad proxy handle");
+  et::Status s = qp->SetOwnership(spec ? spec : "");
+  if (!s.ok()) return FailWith(s.message());
+  return 0;
+}
+
+// Installed ownership-map epoch (0 = none / local proxy); -1 bad handle.
+int64_t etq_ownership_epoch(int64_t h) {
+  auto qp = GetProxy(h);
+  if (!qp) {
+    FailWith("bad proxy handle");
+    return -1;
+  }
+  return static_cast<int64_t>(qp->OwnershipEpoch());
+}
+
+// Shard count this proxy was built against (1 for local proxies);
+// -1 bad handle. The elastic layer compares it with the published
+// map's fleet width to decide when a proxy rebuild is due.
+int etq_shard_num(int64_t h) {
+  auto qp = GetProxy(h);
+  if (!qp) {
+    FailWith("bad proxy handle");
+    return -1;
+  }
+  return qp->shard_num();
+}
+
+// Per-shard traffic since proxy init (hot-shard detection): fills
+// out_reqs with kExecute request counts and out_rows with split-routed
+// id counts (min(cap, shard_num) entries each; either may be null).
+// Returns the count filled (0 for local proxies), -1 bad handle.
+int etq_shard_stats(int64_t h, uint64_t* out_reqs, uint64_t* out_rows,
+                    int cap) {
+  auto qp = GetProxy(h);
+  if (!qp) {
+    // FailWith returns the generic error code 1, which here would read
+    // as "1 shard filled" — the contract (and the Python caller's
+    // `got < 0` check) needs an explicit -1
+    FailWith("bad proxy handle");
+    return -1;
+  }
+  return qp->ShardStats(out_reqs, out_rows, cap);
+}
+
 // Dirty-node union for epochs > from_epoch (res->u64, sorted unique);
 // *out_covered 0 → some shard's bounded history no longer reaches
 // from_epoch (the caller must treat everything as dirty).
@@ -326,11 +378,12 @@ int64_t ets_start2(const char* data_dir, int shard_idx, int shard_num,
   bool wal_degraded = false;
   et::Status s;
   bool wal_gap = false;
+  et::OwnershipMap recovered_map;
   if (durable) {
     uint64_t replayed = 0;
     s = et::RecoverShard(wal_dir, data_dir, shard_idx, shard_num,
                          /*build_in_adjacency=*/true, &g, &replayed,
-                         &wal_records, &wal_gap);
+                         &wal_records, &wal_gap, &recovered_map);
     if (!s.ok()) {
       FailWith(s.message());
       return 0;
@@ -384,6 +437,17 @@ int64_t ets_start2(const char* data_dir, int shard_idx, int shard_num,
     // a replay that stopped on a gap/failed record leaves the shard's
     // epoch numbering untrusted: never claim anti-entropy coverage
     if (wal_gap) server->MarkDeltaLogGap();
+    // re-install the persisted ownership map so the recovered shard
+    // keeps refusing stale-map reads and filtering deltas under the
+    // map its WAL replay used
+    if (recovered_map.map_epoch != 0) {
+      et::Status os = server->SetOwnership(
+          std::make_shared<et::OwnershipMap>(recovered_map));
+      if (!os.ok())
+        ET_LOG_WARNING << "shard " << shard_idx
+                       << " could not re-install recovered ownership map: "
+                       << os.message();
+    }
   }
   s = server->Start(port);
   if (!s.ok()) {
@@ -421,6 +485,35 @@ int64_t ets_start(const char* data_dir, int shard_idx, int shard_num,
   return ets_start2(data_dir, shard_idx, shard_num, port, registry_dir,
                     host, index_spec, /*wal_dir=*/"", /*fsync_policy=*/1,
                     /*compact_bytes=*/0, /*catchup=*/0);
+}
+
+// Install an ownership map on an in-process serving shard (the elastic
+// driver's flip for servers it owns; remote servers take the
+// kSetOwnership wire verb via etg_push_ownership).
+int ets_set_ownership(int64_t h, const char* spec) {
+  std::shared_ptr<et::GraphServer> server;
+  {
+    auto& r = QReg();
+    std::lock_guard<std::mutex> lk(r.mu);
+    auto it = r.servers.find(h);
+    if (it == r.servers.end()) return FailWith("bad server handle");
+    server = it->second;
+  }
+  auto m = std::make_shared<et::OwnershipMap>();
+  et::Status s = et::OwnershipMap::Decode(spec ? spec : "", m.get());
+  if (s.ok()) s = server->SetOwnership(std::move(m));
+  if (!s.ok()) return FailWith(s.message());
+  return 0;
+}
+
+// Serving shard's installed ownership-map epoch (0 = none / bad handle).
+int64_t ets_map_epoch(int64_t h) {
+  auto& r = QReg();
+  std::lock_guard<std::mutex> lk(r.mu);
+  auto it = r.servers.find(h);
+  return it == r.servers.end()
+             ? 0
+             : static_cast<int64_t>(it->second->map_epoch());
 }
 
 // Current graph epoch of a serving shard (post-recovery rejoin checks).

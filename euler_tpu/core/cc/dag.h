@@ -98,6 +98,13 @@ struct QueryEnv {
   // sub-calls propagate the remaining budget inside their v2 request
   // frames (rpc.h kFeatDeadline) so shards shed already-dead work.
   int64_t deadline_us = 0;
+  // Ownership-map epoch captured at RUN START (0 = no map). REMOTE
+  // sub-calls stamp it into their v2 request frames (kFeatMapEpoch).
+  // Captured-then-stamped (not read live at write time) so a map flip
+  // mid-run can only make the stamp OLDER than the map the split used
+  // — a spurious, retried refusal — never newer (which would slip a
+  // stale-routed read past the server's one-sided check).
+  uint64_t map_epoch = 0;
 };
 
 // Stateless kernel; one singleton per op name serves all queries

@@ -1326,6 +1326,102 @@ std::unique_ptr<GraphBuilder> BuilderFromGraph(const Graph& g) {
   return b;
 }
 
+// ---------------------------------------------------------------------------
+// OwnershipMap
+// ---------------------------------------------------------------------------
+OwnershipMap OwnershipMap::Default(int partition_num, int shard_num,
+                                   uint64_t epoch) {
+  OwnershipMap m;
+  m.map_epoch = epoch;
+  m.partition_num = std::max(partition_num, 1);
+  if (m.partition_num < shard_num) m.partition_num = shard_num;
+  m.shard_num = std::max(shard_num, 1);
+  m.owners.resize(m.partition_num);
+  for (int p = 0; p < m.partition_num; ++p)
+    m.owners[p] = {p % m.shard_num};
+  return m;
+}
+
+std::string OwnershipMap::Encode() const {
+  std::string out = "e" + std::to_string(map_epoch) + "-P" +
+                    std::to_string(partition_num) + "-";
+  for (int p = 0; p < partition_num; ++p) {
+    if (p) out += '.';
+    const auto& os = owners[p];
+    for (size_t i = 0; i < os.size(); ++i) {
+      if (i) out += '+';
+      out += std::to_string(os[i]);
+    }
+  }
+  return out;
+}
+
+Status OwnershipMap::Decode(const std::string& spec, OwnershipMap* out) {
+  OwnershipMap m;
+  auto bad = [&](const char* why) {
+    return Status::InvalidArgument(std::string("bad ownership spec '") +
+                                   spec + "': " + why);
+  };
+  if (spec.size() < 6 || spec[0] != 'e') return bad("want e<E>-P<pn>-...");
+  size_t d1 = spec.find("-P", 1);
+  if (d1 == std::string::npos) return bad("missing -P");
+  size_t d2 = spec.find('-', d1 + 2);
+  if (d2 == std::string::npos) return bad("missing owner list");
+  m.map_epoch = std::strtoull(spec.substr(1, d1 - 1).c_str(), nullptr, 10);
+  m.partition_num =
+      std::atoi(spec.substr(d1 + 2, d2 - d1 - 2).c_str());
+  if (m.map_epoch == 0) return bad("map_epoch must be > 0");
+  if (m.partition_num < 1) return bad("partition_num must be >= 1");
+  std::string rest = spec.substr(d2 + 1);
+  size_t pos = 0;
+  while (pos <= rest.size()) {
+    size_t dot = rest.find('.', pos);
+    std::string part = rest.substr(
+        pos, dot == std::string::npos ? std::string::npos : dot - pos);
+    if (part.empty()) return bad("empty partition owner list");
+    std::vector<int> os;
+    size_t q = 0;
+    while (q <= part.size()) {
+      size_t plus = part.find('+', q);
+      std::string tok = part.substr(
+          q, plus == std::string::npos ? std::string::npos : plus - q);
+      if (tok.empty() ||
+          tok.find_first_not_of("0123456789") != std::string::npos)
+        return bad("non-numeric owner");
+      int s = std::atoi(tok.c_str());
+      // primary stays first; duplicates collapse
+      if (std::find(os.begin(), os.end(), s) == os.end()) os.push_back(s);
+      m.shard_num = std::max(m.shard_num, s + 1);
+      if (plus == std::string::npos) break;
+      q = plus + 1;
+    }
+    m.owners.push_back(std::move(os));
+    if (dot == std::string::npos) break;
+    pos = dot + 1;
+  }
+  if (static_cast<int>(m.owners.size()) != m.partition_num)
+    return bad("owner-list count != partition_num");
+  *out = std::move(m);
+  return Status::OK();
+}
+
+bool OwnershipMap::Covers(int sup, int shard) const {
+  if (sup == shard) return false;
+  bool any = false;
+  for (int p = 0; p < partition_num; ++p) {
+    bool mine = false, theirs = false;
+    for (int s : owners[p]) {
+      if (s == shard) mine = true;
+      if (s == sup) theirs = true;
+    }
+    if (mine) {
+      if (!theirs) return false;
+      any = true;
+    }
+  }
+  return any;
+}
+
 Status ApplyGraphDelta(const Graph& base, const NodeId* node_ids,
                        const int32_t* node_types, const float* node_weights,
                        size_t n_nodes, const NodeId* edge_src,
@@ -1333,13 +1429,18 @@ Status ApplyGraphDelta(const Graph& base, const NodeId* node_ids,
                        const float* edge_weights, size_t n_edges,
                        int shard_idx, int shard_num,
                        std::unique_ptr<Graph>* out,
-                       std::vector<NodeId>* dirty_out) {
+                       std::vector<NodeId>* dirty_out,
+                       const OwnershipMap* omap) {
   if (shard_num < 1) shard_num = 1;
-  if (shard_idx < 0 || shard_idx >= shard_num)
+  if (shard_idx < 0 || (omap == nullptr && shard_idx >= shard_num))
     return Status::InvalidArgument("bad shard index for delta apply");
   const uint64_t P =
       static_cast<uint64_t>(std::max(base.meta().partition_num, 1));
+  const bool mapped = omap != nullptr && omap->map_epoch != 0;
   auto owns = [&](NodeId id) {
+    // map routing first: ownership is the map's say (a replicated
+    // partition lands on every owner), hash only the no-map fallback
+    if (mapped) return omap->owns(shard_idx, id);
     if (shard_num <= 1) return true;
     return static_cast<int>((id % P) % shard_num) == shard_idx;
   };

@@ -37,6 +37,55 @@ namespace et {
 using NodeId = uint64_t;
 constexpr uint32_t kInvalidIndex = std::numeric_limits<uint32_t>::max();
 
+// ---------------------------------------------------------------------------
+// OwnershipMap — epoch-versioned partition → owner-shard routing (the
+// elastic-fleet replacement for the implicit (id % P) % shard_num hash
+// convention). Partition p lists one or more owner shards (primary
+// first; extra owners are replicas holding the same rows — hot-partition
+// rebalancing spreads reads over them). The map is registry-published
+// and client-cached; every change bumps map_epoch, and servers refuse
+// kExecute requests stamped with an OLDER epoch ("stale ownership map")
+// so a client routing on a superseded map can never silently read a
+// shard that stopped receiving that partition's deltas. map_epoch == 0
+// means "no map": every consumer falls back to the hash convention,
+// byte-identical to pre-elastic builds.
+// ---------------------------------------------------------------------------
+struct OwnershipMap {
+  uint64_t map_epoch = 0;
+  int partition_num = 1;
+  // owners[p] = owning shard indices, primary first, each sorted-unique
+  // after the primary. Never empty for a valid map.
+  std::vector<std::vector<int>> owners;
+  int shard_num = 0;  // 1 + max shard index listed (the fleet width)
+
+  // The hash convention as an explicit map: owners[p] = {p % shard_num}.
+  static OwnershipMap Default(int partition_num, int shard_num,
+                              uint64_t epoch = 1);
+
+  // Compact registry-entry-safe spec: "e<epoch>-P<pn>-<o0>.<o1>..."
+  // with multi-owner partitions joined by '+', e.g. "e3-P4-0.1.2.2+3".
+  std::string Encode() const;
+  static Status Decode(const std::string& spec, OwnershipMap* out);
+
+  int partition_of(NodeId id) const {
+    return static_cast<int>(id % static_cast<uint64_t>(
+                                     std::max(partition_num, 1)));
+  }
+  const std::vector<int>& owners_of(NodeId id) const {
+    return owners[partition_of(id)];
+  }
+  bool owns(int shard_idx, NodeId id) const {
+    for (int s : owners_of(id))
+      if (s == shard_idx) return true;
+    return false;
+  }
+  int primary(NodeId id) const { return owners_of(id)[0]; }
+  // True when shard `sup`'s owned partition set covers every partition
+  // `shard` owns — `sup` can then serve any request routed to `shard`
+  // (the replica-hedging eligibility test).
+  bool Covers(int sup, int shard) const;
+};
+
 enum class FeatureKind : int { kDense = 0, kSparse = 1, kBinary = 2 };
 
 struct FeatureInfo {
@@ -522,6 +571,12 @@ std::unique_ptr<GraphBuilder> BuilderFromGraph(const Graph& g);
 // shard. dirty_out gets the FULL delta's node ids (nodes ∪ edge
 // endpoints, unfiltered, sorted unique) — over-invalidation across
 // shards is safe, staleness is not.
+//
+// omap (optional): an installed OwnershipMap replaces the hash filter —
+// this shard applies exactly the rows whose partition lists shard_idx
+// as an owner (a replicated hot partition lands on EVERY owner), which
+// is what routes graph_partition-mode deltas too: ownership is the
+// map's say, not the modulus convention.
 Status ApplyGraphDelta(const Graph& base, const NodeId* node_ids,
                        const int32_t* node_types, const float* node_weights,
                        size_t n_nodes, const NodeId* edge_src,
@@ -529,7 +584,8 @@ Status ApplyGraphDelta(const Graph& base, const NodeId* node_ids,
                        const float* edge_weights, size_t n_edges,
                        int shard_idx, int shard_num,
                        std::unique_ptr<Graph>* out,
-                       std::vector<NodeId>* dirty_out);
+                       std::vector<NodeId>* dirty_out,
+                       const OwnershipMap* omap = nullptr);
 
 }  // namespace et
 

@@ -56,14 +56,26 @@ class IdSplitOp : public OpKernel {
     int sn = std::atoi(node.attrs[1].c_str());
     const uint64_t* ids = ids_t.Flat<uint64_t>();
     int64_t n = ids_t.NumElements();
+    // elastic fleet: an installed ownership map replaces the hash
+    // placement — one owner pick per partition for this batch (p2c over
+    // replicated partitions' owners). Empty picks → hash convention.
+    std::vector<int> picks;
+    if (env.client != nullptr && !env.client->PickOwners(&picks))
+      picks.clear();
+    const uint64_t mp = picks.size();
     std::vector<std::vector<uint64_t>> sids(sn);
     std::vector<std::vector<int32_t>> spos(sn);
     for (int64_t i = 0; i < n; ++i) {
-      int s = ShardOf(ids[i], pn, sn);
+      int s = mp ? picks[ids[i] % mp] : ShardOf(ids[i], pn, sn);
+      if (s < 0 || s >= sn) s = ShardOf(ids[i], pn, sn);  // defensive
       sids[s].push_back(ids[i]);
       spos[s].push_back(static_cast<int32_t>(i));
     }
     for (int s = 0; s < sn; ++s) {
+      // routed-row accounting: the hot-shard detection signal (every
+      // shard sees one REMOTE per query regardless; rows carry skew)
+      if (env.client != nullptr && !sids[s].empty())
+        env.client->CountRoutedRows(s, sids[s].size());
       ctx->Put(node.OutName(2 * s), Tensor::FromVector(sids[s]));
       ctx->Put(node.OutName(2 * s + 1), Tensor::FromVector(spos[s]));
     }
@@ -88,16 +100,25 @@ class TripleSplitOp : public OpKernel {
     const uint64_t* dst = dst_t.Flat<uint64_t>();
     const int32_t* typ = tt.Flat<int32_t>();
     int64_t n = src_t.NumElements();
+    // ownership-map routing by the edge's SOURCE owner (the placement
+    // convention); hash fallback without a map — see IdSplitOp
+    std::vector<int> picks;
+    if (env.client != nullptr && !env.client->PickOwners(&picks))
+      picks.clear();
+    const uint64_t mp = picks.size();
     std::vector<std::vector<uint64_t>> ss(sn), sd(sn);
     std::vector<std::vector<int32_t>> st(sn), sp(sn);
     for (int64_t i = 0; i < n; ++i) {
-      int s = ShardOf(src[i], pn, sn);
+      int s = mp ? picks[src[i] % mp] : ShardOf(src[i], pn, sn);
+      if (s < 0 || s >= sn) s = ShardOf(src[i], pn, sn);  // defensive
       ss[s].push_back(src[i]);
       sd[s].push_back(dst[i]);
       st[s].push_back(typ[i]);
       sp[s].push_back(static_cast<int32_t>(i));
     }
     for (int s = 0; s < sn; ++s) {
+      if (env.client != nullptr && !ss[s].empty())
+        env.client->CountRoutedRows(s, ss[s].size());
       ctx->Put(node.OutName(4 * s), Tensor::FromVector(ss[s]));
       ctx->Put(node.OutName(4 * s + 1), Tensor::FromVector(sd[s]));
       ctx->Put(node.OutName(4 * s + 2), Tensor::FromVector(st[s]));
@@ -592,9 +613,10 @@ class RemoteOp : public OpKernel {
           }
           done(s);
         },
-        // propagate the run's remaining deadline inside the v2 frame so
-        // the shard can shed work that can no longer make it
-        env.deadline_us);
+        // propagate the run's remaining deadline + the run-start map
+        // epoch inside the v2 frame: the shard sheds already-dead work
+        // and refuses reads routed on a superseded ownership map
+        env.deadline_us, env.map_epoch);
   }
 };
 ET_REGISTER_KERNEL("REMOTE", RemoteOp);

@@ -127,6 +127,15 @@ Status QueryProxy::ApplyDelta(const NodeId* node_ids,
   return Status::OK();
 }
 
+Status QueryProxy::SetOwnership(const std::string& spec) {
+  if (!client_)
+    return Status::InvalidArgument(
+        "ownership maps apply to distribute-mode proxies only");
+  auto m = std::make_shared<OwnershipMap>();
+  ET_RETURN_IF_ERROR(OwnershipMap::Decode(spec, m.get()));
+  return client_->SetOwnership(std::move(m));
+}
+
 Status QueryProxy::DeltaSince(uint64_t from, uint64_t* epoch, bool* covered,
                               std::vector<NodeId>* ids) {
   if (client_) return client_->DeltaSince(from, epoch, covered, ids);
@@ -190,6 +199,10 @@ Status QueryProxy::RunGremlinTimed(const std::string& query,
   // into their v2 request frames. Consumed (read-and-cleared) so a
   // later deadline-less run on this thread never inherits it.
   env.deadline_us = TakeCallDeadlineUs();
+  // ownership-map epoch captured ONCE per run (see QueryEnv.map_epoch:
+  // a live read at frame-write time could stamp a newer epoch than the
+  // map the split actually routed with)
+  env.map_epoch = client_ ? client_->map_epoch() : 0;
   Executor exec(&plan->dag, env, &ctx);
   ET_RETURN_IF_ERROR(exec.RunSync());
   outputs->clear();

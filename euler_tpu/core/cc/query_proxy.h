@@ -97,6 +97,24 @@ class QueryProxy {
   Status DeltaSince(uint64_t from, uint64_t* epoch, bool* covered,
                     std::vector<NodeId>* ids);
 
+  // ---- elastic fleet (distribute mode only) ----
+  // Install the epoch-versioned ownership map this client routes with
+  // (registry-published spec; see OwnershipMap::Decode). Splits then
+  // place ids by the map's owner lists (p2c over replicated
+  // partitions) and every kExecute frame is stamped with the map epoch
+  // so a server on a newer map refuses it ("stale ownership map").
+  Status SetOwnership(const std::string& spec);
+  uint64_t OwnershipEpoch() const {
+    return client_ ? client_->map_epoch() : 0;
+  }
+  // Per-shard traffic: request + split-routed row counts (hot-shard
+  // detection; rows carry the skew — every shard sees one REMOTE per
+  // query). Fills min(cap, shard_num) entries of each, returns the
+  // count filled (0 in local mode).
+  int ShardStats(uint64_t* reqs, uint64_t* rows, int cap) const {
+    return client_ ? client_->ShardTraffic(reqs, rows, cap) : 0;
+  }
+
  private:
   QueryProxy() = default;
 

@@ -52,10 +52,19 @@ constexpr uint32_t kFrameFlagEpoch = 2u;
 // client only stamps it for servers that advertised the feature, so
 // pre-deadline v2 peers — and every v1 peer — see unchanged bytes.
 constexpr uint32_t kFrameFlagDeadline = 4u;
+// REQUEST body is prefixed with the client's ownership-map epoch (u64,
+// after the deadline prefix, before compression). Hello-negotiated
+// (kFeatMapEpoch): the server refuses a kExecute stamped with an OLDER
+// epoch than its installed map ("stale ownership map", counted) — a
+// client routing on a superseded map can never silently read a shard
+// that stopped receiving that partition's deltas. Clients with no map
+// (epoch 0) stamp nothing; pre-map peers see unchanged bytes.
+constexpr uint32_t kFrameFlagMapEpoch = 8u;
 constexpr uint32_t kProtoV2 = 2;
 constexpr uint32_t kFeatAcceptCompressed = 1u;  // hello feature bit
 constexpr uint32_t kFeatEpoch = 2u;             // hello: send epoch prefixes
 constexpr uint32_t kFeatDeadline = 4u;          // hello: deadline prefixes ok
+constexpr uint32_t kFeatMapEpoch = 8u;          // hello: map-epoch prefixes ok
 
 enum MsgType : uint32_t {
   kExecute = 0,
@@ -74,7 +83,26 @@ enum MsgType : uint32_t {
                      // u8 covered | u32 count | count×(u64 epoch,
                      // u64 len, raw kApplyDelta body) — anti-entropy
                      // catch-up for recovering shards
+  kSetOwnership = 10,  // body: ownership spec string ("e<E>-P<pn>-...")
+                       // → u32 code | u64 map_epoch / u32 1 | str error.
+                       // Installs the epoch-versioned ownership map
+                       // (elastic fleet: live splits / rebalancing).
 };
+
+// Bench/chaos-only injected per-row work (env
+// EULER_TPU_EXEC_DELAY_US_PER_ROW, read once): models the row-
+// proportional scan cost a 2-CPU container cannot exhibit naturally —
+// the graph-tier analogue of InferenceServer's inject_scan_ms_per_krow.
+// Applied after decode, so the empty split batches the distribute
+// rewrite fires at non-owning shards cost nothing and routed ROWS are
+// what loads a shard (the signal elastic rebalancing spreads).
+int64_t ExecDelayUsPerRow() {
+  static const int64_t v = [] {
+    const char* e = std::getenv("EULER_TPU_EXEC_DELAY_US_PER_ROW");
+    return e != nullptr ? std::atoll(e) : 0;
+  }();
+  return v;
+}
 
 // Max-update an atomic epoch (replies can arrive out of order).
 void MaxUpdateEpoch(std::atomic<uint64_t>* a, uint64_t v) {
@@ -606,10 +634,15 @@ void GraphServer::ApplyDeltaBody(const char* body, size_t len,
   std::shared_ptr<const Graph> base = graph_ref_->get();
   std::unique_ptr<Graph> next;
   std::vector<NodeId> dirty;
+  // an installed ownership map replaces the hash filter: this shard
+  // applies the rows whose partition lists it as an owner — which is
+  // also what routes graph_partition-mode deltas (ownership is the
+  // map's say, not the modulus convention)
+  std::shared_ptr<const OwnershipMap> omap = ownership();
   s = ApplyGraphDelta(
       *base, ids.data(), ntypes.data(), nw.data(), ids.size(), src.data(),
       dst.data(), etypes.data(), ew.data(), src.size(), shard_idx_,
-      shard_num_, &next, &dirty);
+      shard_num_, &next, &dirty, omap.get());
   if (!s.ok()) {
     fail(s.message());
     return;
@@ -701,6 +734,52 @@ void GraphServer::ApplyDeltaBody(const char* body, size_t len,
                << " nodes, " << src.size() << " edges) -> epoch " << epoch;
   w->Put<uint32_t>(0);
   w->Put<uint64_t>(epoch);
+}
+
+Status GraphServer::SetOwnership(std::shared_ptr<const OwnershipMap> m) {
+  if (m == nullptr || m->map_epoch == 0)
+    return Status::InvalidArgument("ownership map must have epoch > 0");
+  // Serialize installs on the ref's apply mutex: a concurrent delta
+  // apply must never read a map that has not been PERSISTED yet — it
+  // would WAL-append a record whose live filter crash-recovery cannot
+  // reproduce (install-then-persist was exactly that hole). Order:
+  // check epoch → persist → install; the apply lock also keeps two
+  // concurrent installs from landing out of epoch order.
+  std::lock_guard<std::mutex> install_lk(graph_ref_->apply_mutex());
+  {
+    std::lock_guard<std::mutex> lk(omap_mu_);
+    if (omap_ != nullptr && m->map_epoch < omap_->map_epoch)
+      return Status::InvalidArgument(
+          "refusing ownership map epoch " + std::to_string(m->map_epoch) +
+          ": shard already at epoch " + std::to_string(omap_->map_epoch));
+  }
+  if (wal_ != nullptr) {
+    Status ps = PersistOwnership(wal_->dir(), m->Encode());
+    if (!ps.ok())
+      return Status::Internal("ownership persist failed: " + ps.message());
+  }
+  {
+    std::lock_guard<std::mutex> lk(omap_mu_);
+    omap_ = m;
+  }
+  map_epoch_.store(m->map_epoch);
+  ET_LOG(INFO) << "shard " << shard_idx_ << " installed ownership map "
+               << m->Encode();
+  return Status::OK();
+}
+
+void GraphServer::HandleSetOwnership(ByteReader* r, ByteWriter* w) {
+  std::string spec(r->cursor(), r->remaining());
+  auto m = std::make_shared<OwnershipMap>();
+  Status s = OwnershipMap::Decode(spec, m.get());
+  if (s.ok()) s = SetOwnership(std::move(m));
+  if (!s.ok()) {
+    w->Put<uint32_t>(1);
+    w->PutStr(s.message());
+    return;
+  }
+  w->Put<uint32_t>(0);
+  w->Put<uint64_t>(map_epoch_.load());
 }
 
 void GraphServer::HandleGetDelta(ByteReader* r, ByteWriter* w) {
@@ -920,6 +999,9 @@ void GraphServer::HandleConnection(int fd) {
     } else if (msg_type == kGetDeltaLog) {
       ByteReader r(body.data(), body.size());
       HandleGetDeltaLog(&r, &w);
+    } else if (msg_type == kSetOwnership) {
+      ByteReader r(body.data(), body.size());
+      HandleSetOwnership(&r, &w);
     } else {  // ping
       w.Put<uint32_t>(0);
     }
@@ -1017,6 +1099,14 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     deadline_us = static_cast<int64_t>(std::min<uint64_t>(rem, 1ULL << 62));
     body.erase(body.begin(), body.begin() + 8);
   }
+  // ownership-map epoch the client routed this request with (second
+  // prefix, after the deadline — same wire order WriteRequest stamps)
+  uint64_t req_map_epoch = 0;
+  if ((flags & kFrameFlagMapEpoch) != 0) {
+    if (body.size() < 8) return false;  // protocol error
+    std::memcpy(&req_map_epoch, body.data(), 8);
+    body.erase(body.begin(), body.begin() + 8);
+  }
   if (msg_type == kHello) {
     ByteReader r(body.data(), body.size());
     uint32_t pver = 0, feats = 0;
@@ -1029,7 +1119,8 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     conn->peer_threshold = thresh;
     ByteWriter w;
     w.Put<uint32_t>(kProtoV2);
-    w.Put<uint32_t>(kFeatAcceptCompressed | kFeatEpoch | kFeatDeadline);
+    w.Put<uint32_t>(kFeatAcceptCompressed | kFeatEpoch | kFeatDeadline |
+                    kFeatMapEpoch);
     w.Put<uint64_t>(thresh);
     write_reply(kHello, request_id, w.buffer());
     return true;
@@ -1071,6 +1162,9 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     ByteWriter w;
     if (msg_type == kMeta) {
       BuildMeta(&w);
+    } else if (msg_type == kSetOwnership) {
+      ByteReader r(body.data(), body.size());
+      HandleSetOwnership(&r, &w);
     } else {  // ping / unknown
       w.Put<uint32_t>(0);
     }
@@ -1114,7 +1208,28 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
   // measures — a request whose budget already expired by pickup is
   // SHED with an explicit status (counted), its DAG never run.
   GlobalThreadPool()->Schedule(
-      [this, finish, deadline_us, arrival_us, body = std::move(body)] {
+      [this, finish, deadline_us, arrival_us, req_map_epoch,
+       body = std::move(body)] {
+        // stale ownership map: the request was SPLIT with a routing map
+        // this shard has since superseded — partitions it stopped
+        // owning no longer receive deltas here, so serving the read
+        // would be a silent misroute. Refuse with an explicit status;
+        // the client refreshes the registry-published map and retries.
+        // One-sided (older only): a NEWER client epoch is safe — flips
+        // only shrink a surviving shard's owned set, and rows it still
+        // gets asked for are rows it still owns under the new map.
+        const uint64_t have_map = map_epoch_.load();
+        if (req_map_epoch != 0 && have_map != 0 &&
+            req_map_epoch < have_map) {
+          GlobalRpcCounters().stale_map_shed.fetch_add(1);
+          ExecuteReply rep;
+          rep.status = Status::Internal(
+              "stale ownership map: request routed on map epoch " +
+              std::to_string(req_map_epoch) + ", shard is at " +
+              std::to_string(have_map) + "; refresh the map and retry");
+          finish(rep);
+          return;
+        }
         if (deadline_us > 0 && SteadyNowUs() - arrival_us > deadline_us) {
           GlobalRpcCounters().deadline_shed.fetch_add(1);
           ExecuteReply rep;
@@ -1135,6 +1250,16 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
           rep.status = ds;
           finish(rep);
           return;
+        }
+        const int64_t per_row_us = ExecDelayUsPerRow();
+        if (per_row_us > 0) {
+          uint64_t rows = 0;
+          for (const auto& kv : req.inputs)
+            if (kv.second.dtype() == DType::kU64)
+              rows += static_cast<uint64_t>(kv.second.NumElements());
+          if (rows > 0)
+            ::usleep(static_cast<useconds_t>(
+                std::min<int64_t>(per_row_us * rows, 1000000)));
         }
         for (auto& kv : req.inputs)
           p->ctx.Put(kv.first, std::move(kv.second));
@@ -1236,10 +1361,11 @@ class RpcChannel::MuxConn {
 
   MuxConn(int fd, bool peer_compress, int64_t compress_threshold,
           int max_inflight, std::atomic<uint64_t>* epoch_sink,
-          bool peer_deadline)
+          bool peer_deadline, bool peer_map)
       : fd_(fd),
         peer_compress_(peer_compress),
         peer_deadline_(peer_deadline),
+        peer_map_(peer_map),
         compress_threshold_(compress_threshold),
         max_inflight_(std::max(max_inflight, 1)),
         epoch_sink_(epoch_sink) {
@@ -1270,7 +1396,8 @@ class RpcChannel::MuxConn {
   int64_t ewma_us() { return ewma_us_.load(); }
 
   Status Call(uint32_t msg_type, const std::vector<char>& body,
-              std::vector<char>* reply_body, int64_t deadline_abs_us = 0) {
+              std::vector<char>* reply_body, int64_t deadline_abs_us = 0,
+              uint64_t map_epoch = 0) {
     auto& ctr = GlobalRpcCounters();
     Waiter w;
     w.start_us = SteadyNowUs();
@@ -1287,7 +1414,7 @@ class RpcChannel::MuxConn {
       waiters_[id] = &w;
     }
     ctr.inflight.fetch_add(1);
-    if (!WriteRequest(msg_type, id, body, deadline_abs_us)) {
+    if (!WriteRequest(msg_type, id, body, deadline_abs_us, map_epoch)) {
       // socket dead: tear the whole conn down so every parked waiter
       // (not just this call) gets a status promptly
       Shutdown();
@@ -1328,7 +1455,7 @@ class RpcChannel::MuxConn {
       waiters_[id] = w;
     }
     GlobalRpcCounters().inflight.fetch_add(1);
-    if (!WriteRequest(msg_type, id, body, deadline_abs_us)) Shutdown();
+    if (!WriteRequest(msg_type, id, body, deadline_abs_us, 0)) Shutdown();
   }
 
   // One leg of a hedged call: heap waiter bound to the shared group.
@@ -1337,7 +1464,7 @@ class RpcChannel::MuxConn {
   // group so the caller's wait predicate stays truthful).
   uint64_t SubmitHedged(uint32_t msg_type, const std::vector<char>& body,
                         const std::shared_ptr<HedgeGroup>& g, int leg,
-                        int64_t deadline_abs_us) {
+                        int64_t deadline_abs_us, uint64_t map_epoch) {
     auto* w = new Waiter();
     w->hedge = g;
     w->leg = leg;
@@ -1369,7 +1496,8 @@ class RpcChannel::MuxConn {
       waiters_[id] = w;
     }
     GlobalRpcCounters().inflight.fetch_add(1);
-    if (!WriteRequest(msg_type, id, body, deadline_abs_us)) Shutdown();
+    if (!WriteRequest(msg_type, id, body, deadline_abs_us, map_epoch))
+      Shutdown();
     return id;
   }
 
@@ -1412,36 +1540,48 @@ class RpcChannel::MuxConn {
   }
 
   bool WriteRequest(uint32_t msg_type, uint64_t id,
-                    const std::vector<char>& body,
-                    int64_t deadline_abs_us) {
+                    const std::vector<char>& body, int64_t deadline_abs_us,
+                    uint64_t map_epoch) {
     auto& ctr = GlobalRpcCounters();
     uint32_t flags = 0;
-    // deadline propagation: stamp the REMAINING budget at write time as
-    // a u64-µs body prefix (hello-negotiated; kExecute only — the verb
-    // the server sheds). An already-expired budget stamps 1µs so the
-    // server sheds it instead of the client inventing a local failure.
-    uint64_t remaining_us = 0;
-    const bool stamp = peer_deadline_ && deadline_abs_us > 0 &&
-                       msg_type == kExecute;
-    if (stamp) {
-      remaining_us = static_cast<uint64_t>(
+    // request prefixes, in wire order: [deadline u64][map_epoch u64],
+    // each hello-negotiated and kExecute-only. Deadline stamps the
+    // REMAINING budget at write time (an already-expired budget stamps
+    // 1µs so the SERVER sheds it); map_epoch stamps the routing map
+    // this request was split with, so a server on a NEWER map refuses
+    // it instead of serving a partition whose deltas now land
+    // elsewhere.
+    char prefix[16];
+    size_t npfx = 0;
+    if (peer_deadline_ && deadline_abs_us > 0 && msg_type == kExecute) {
+      uint64_t remaining_us = static_cast<uint64_t>(
           std::max<int64_t>(deadline_abs_us - SteadyNowUs(), 1));
+      std::memcpy(prefix + npfx, &remaining_us, 8);
+      npfx += 8;
       flags |= kFrameFlagDeadline;
       ctr.deadline_propagated.fetch_add(1);
     }
+    if (peer_map_ && map_epoch != 0 && msg_type == kExecute) {
+      // the CALLER's run-start epoch, not a live read: stamping a map
+      // installed after the split could slip a stale-routed read past
+      // the server's one-sided check (see QueryEnv.map_epoch)
+      std::memcpy(prefix + npfx, &map_epoch, 8);
+      npfx += 8;
+      flags |= kFrameFlagMapEpoch;
+    }
     // adaptive request compression (negotiated in the hello); the
-    // deadline prefix rides INSIDE the deflate stream like the reply
-    // epoch prefix does
+    // prefixes ride INSIDE the deflate stream like the reply epoch
+    // prefix does
     const std::vector<char>* out = &body;
     std::vector<char> comp;
     std::vector<char> stamped;
-    const size_t raw_len = body.size() + (stamp ? 8 : 0);
+    const size_t raw_len = body.size() + npfx;
     if (peer_compress_ && compress_threshold_ > 0 &&
         static_cast<int64_t>(raw_len) >= compress_threshold_) {
       const std::vector<char>* src = &body;
-      if (stamp) {
-        stamped.resize(8);
-        std::memcpy(stamped.data(), &remaining_us, 8);
+      if (npfx > 0) {
+        stamped.resize(npfx);
+        std::memcpy(stamped.data(), prefix, npfx);
         stamped.insert(stamped.end(), body.begin(), body.end());
         src = &stamped;
       }
@@ -1454,14 +1594,13 @@ class RpcChannel::MuxConn {
     bool wrote;
     {
       std::lock_guard<std::mutex> lk(wmu_);
-      if (stamp && (flags & kFrameFlagCompressed) == 0) {
-        // scatter write (header | deadline | body): prefixing must not
+      if (npfx > 0 && (flags & kFrameFlagCompressed) == 0) {
+        // scatter write (header | prefixes | body): prefixing must not
         // cost an O(body) copy on every uncompressed stamped request
         char hdr[kV2HdrLen];
         FillV2Hdr(hdr, msg_type, flags, id, raw_len);
         wrote = WriteAll(fd_, hdr, kV2HdrLen) &&
-                WriteAll(fd_, reinterpret_cast<const char*>(&remaining_us),
-                         8) &&
+                WriteAll(fd_, prefix, npfx) &&
                 WriteAll(fd_, body.data(), body.size());
       } else {
         wrote = WriteFrameV2(fd_, msg_type, flags, id, out->data(),
@@ -1618,6 +1757,7 @@ class RpcChannel::MuxConn {
   const int fd_;
   const bool peer_compress_;
   const bool peer_deadline_;
+  const bool peer_map_;
   const int64_t compress_threshold_;
   const int max_inflight_;
   std::atomic<uint64_t>* const epoch_sink_;
@@ -1745,7 +1885,8 @@ std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
   const RpcConfig cfg = GlobalRpcConfig();
   ByteWriter hw;
   hw.Put<uint32_t>(kProtoV2);
-  hw.Put<uint32_t>(kFeatAcceptCompressed | kFeatEpoch | kFeatDeadline);
+  hw.Put<uint32_t>(kFeatAcceptCompressed | kFeatEpoch | kFeatDeadline |
+                   kFeatMapEpoch);
   const int64_t hello_thr = cfg.compress_threshold.load();
   hw.Put<uint64_t>(static_cast<uint64_t>(hello_thr > 0 ? hello_thr : 0));
   std::vector<char> hbody;
@@ -1758,14 +1899,16 @@ std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
                   ver == 2 && msg_type == kHello;
   bool peer_compress = false;
   bool peer_deadline = false;
+  bool peer_map = false;
   if (hello_ok) {
     ByteReader r(hbody.data(), hbody.size());
     uint32_t pver = 0, feats = 0;
     if (!r.Get(&pver) || !r.Get(&feats) || pver < kProtoV2) hello_ok = false;
     peer_compress = (feats & kFeatAcceptCompressed) != 0;
-    // only stamp deadline prefixes for servers that will strip them —
-    // pre-deadline v2 servers keep seeing byte-identical requests
+    // only stamp deadline/map-epoch prefixes for servers that will
+    // strip them — older v2 servers keep seeing byte-identical requests
     peer_deadline = (feats & kFeatDeadline) != 0;
+    peer_map = (feats & kFeatMapEpoch) != 0;
   }
   if (!hello_ok) {
     ::close(fd);
@@ -1790,7 +1933,7 @@ std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
   }
   auto conn = std::make_shared<MuxConn>(
       fd, peer_compress, cfg.compress_threshold, cfg.max_inflight,
-      epoch_sink_, peer_deadline);
+      epoch_sink_, peer_deadline, peer_map);
   if (slot >= static_cast<int>(mux_conns_.size()))
     mux_conns_.resize(slot + 1);
   mux_conns_[slot] = conn;
@@ -1836,7 +1979,7 @@ int RpcChannel::PickSlot(int slots, int avoid) {
 
 Status RpcChannel::MuxCall(uint32_t msg_type, const std::vector<char>& body,
                            std::vector<char>* reply_body, int max_retries,
-                           int64_t deadline_abs_us) {
+                           int64_t deadline_abs_us, uint64_t map_epoch) {
   Status last = Status::IOError("rpc not attempted");
   for (int attempt = 0; attempt < max_retries; ++attempt) {
     if (v1_fallback_.load()) return last;  // caller switches to v1
@@ -1854,9 +1997,10 @@ Status RpcChannel::MuxCall(uint32_t msg_type, const std::vector<char>& body,
     int64_t hedge_us = GlobalRpcConfig().hedge_delay_us.load();
     if (hedge_us > 0 && slots >= 2 && msg_type == kExecute) {
       last = HedgedMuxCall(conn, slot, slots, msg_type, body, reply_body,
-                           hedge_us, deadline_abs_us);
+                           hedge_us, deadline_abs_us, map_epoch);
     } else {
-      last = conn->Call(msg_type, body, reply_body, deadline_abs_us);
+      last = conn->Call(msg_type, body, reply_body, deadline_abs_us,
+                        map_epoch);
     }
     if (last.ok()) return last;
     // transport failure: the conn marked itself broken; the next attempt
@@ -1878,11 +2022,12 @@ Status RpcChannel::HedgedMuxCall(const std::shared_ptr<MuxConn>& conn,
                                  int slot, int slots, uint32_t msg_type,
                                  const std::vector<char>& body,
                                  std::vector<char>* reply_body,
-                                 int64_t hedge_us,
-                                 int64_t deadline_abs_us) {
+                                 int64_t hedge_us, int64_t deadline_abs_us,
+                                 uint64_t map_epoch) {
   auto& ctr = GlobalRpcCounters();
   auto g = std::make_shared<MuxConn::HedgeGroup>();
-  uint64_t id0 = conn->SubmitHedged(msg_type, body, g, 0, deadline_abs_us);
+  uint64_t id0 =
+      conn->SubmitHedged(msg_type, body, g, 0, deadline_abs_us, map_epoch);
   std::shared_ptr<MuxConn> conn1;
   uint64_t id1 = 0;
   {
@@ -1898,7 +2043,8 @@ Status RpcChannel::HedgedMuxCall(const std::shared_ptr<MuxConn>& conn,
       conn1 = MuxGet(PickSlot(slots, /*avoid=*/slot));
       if (conn1 != nullptr) {
         ctr.hedge_fired.fetch_add(1);
-        id1 = conn1->SubmitHedged(msg_type, body, g, 1, deadline_abs_us);
+        id1 = conn1->SubmitHedged(msg_type, body, g, 1, deadline_abs_us,
+                                  map_epoch);
       }
       lk.lock();
     }
@@ -1973,11 +2119,11 @@ void RpcChannel::CallAsync(
 
 Status RpcChannel::Call(uint32_t msg_type, const std::vector<char>& body,
                         std::vector<char>* reply_body, int max_retries,
-                        int64_t deadline_abs_us) {
+                        int64_t deadline_abs_us, uint64_t map_epoch) {
   if (max_retries <= 0) max_retries = kRetryCount;
   if (mux_ && !v1_fallback_.load()) {
     Status s = MuxCall(msg_type, body, reply_body, max_retries,
-                       deadline_abs_us);
+                       deadline_abs_us, map_epoch);
     if (s.ok() || !v1_fallback_.load()) return s;
     // the server refused the hello mid-call: finish this call on v1
   }
@@ -2004,6 +2150,29 @@ Status RpcChannel::Call(uint32_t msg_type, const std::vector<char>& body,
   }
   return Status::IOError("rpc to " + host_ + ":" + std::to_string(port_) +
                          " failed after retries");
+}
+
+Status PushOwnership(const std::string& host, int port,
+                     const std::string& spec, uint64_t* epoch_out) {
+  RpcChannel chan(host, port);
+  chan.set_timeout_ms(5000);
+  std::vector<char> body(spec.begin(), spec.end());
+  std::vector<char> reply;
+  ET_RETURN_IF_ERROR(chan.Call(kSetOwnership, body, &reply, 2));
+  ByteReader r(reply.data(), reply.size());
+  uint32_t code = 1;
+  if (!r.Get(&code))
+    return Status::IOError("truncated set-ownership reply");
+  if (code != 0) {
+    std::string msg;
+    r.GetStr(&msg);
+    return Status::Internal("shard " + host + ":" + std::to_string(port) +
+                            " refused ownership map: " + msg);
+  }
+  uint64_t e = 0;
+  r.Get(&e);
+  if (epoch_out != nullptr) *epoch_out = e;
+  return Status::OK();
 }
 
 // ---------------------------------------------------------------------------
@@ -2458,6 +2627,19 @@ Status ClientManager::Init(const ShardEndpoints& eps) {
     if (GlobalRpcConfig().mux) channels_.back()->set_mux(true);
     channels_.back()->set_epoch_sink(&observed_epoch_);
   }
+  // per-shard routing signals: request counters (hot-shard detection),
+  // inflight + reply-latency EWMA (PickOwners p2c / hedge steering)
+  stats_shards_ = static_cast<int>(channels_.size());
+  shard_reqs_ = std::make_unique<std::atomic<uint64_t>[]>(stats_shards_);
+  shard_rows_ = std::make_unique<std::atomic<uint64_t>[]>(stats_shards_);
+  shard_inflight_ = std::make_unique<std::atomic<int64_t>[]>(stats_shards_);
+  shard_ewma_us_ = std::make_unique<std::atomic<int64_t>[]>(stats_shards_);
+  for (int s = 0; s < stats_shards_; ++s) {
+    shard_reqs_[s].store(0);
+    shard_rows_[s].store(0);
+    shard_inflight_[s].store(0);
+    shard_ewma_us_[s].store(0);
+  }
   std::vector<ShardMeta> metas(channels_.size());
   for (size_t s = 0; s < channels_.size(); ++s) {
     std::vector<char> body, reply;
@@ -2517,17 +2699,226 @@ float ClientManager::EdgeWeight(int shard, int type) const {
   return s;
 }
 
+Status ClientManager::SetOwnership(std::shared_ptr<const OwnershipMap> m) {
+  if (m == nullptr || m->map_epoch == 0)
+    return Status::InvalidArgument("ownership map must have epoch > 0");
+  if (m->shard_num > shard_num())
+    return Status::InvalidArgument(
+        "ownership map references shard " + std::to_string(m->shard_num - 1) +
+        " but this client has " + std::to_string(shard_num()) +
+        " channel(s); rebuild the client against the grown fleet first");
+  std::lock_guard<std::mutex> lk(omap_mu_);
+  if (omap_ != nullptr && m->map_epoch < omap_->map_epoch)
+    return Status::InvalidArgument(
+        "refusing ownership map epoch " + std::to_string(m->map_epoch) +
+        ": client already at epoch " + std::to_string(omap_->map_epoch));
+  // precompute each shard's hedge alternative (a covering owner) once
+  // per map install — Execute reads it per call
+  hedge_alt_.assign(shard_num(), -1);
+  for (int s = 0; s < shard_num(); ++s)
+    for (int a = 0; a < m->shard_num && a < shard_num(); ++a)
+      if (m->Covers(a, s)) {
+        hedge_alt_[s] = a;
+        break;
+      }
+  omap_ = std::move(m);
+  // runs started after this stamp the new epoch (QueryEnv captures it)
+  map_epoch_.store(omap_->map_epoch);
+  return Status::OK();
+}
+
+bool ClientManager::PickOwners(std::vector<int>* out) const {
+  std::shared_ptr<const OwnershipMap> m;
+  {
+    std::lock_guard<std::mutex> lk(omap_mu_);
+    m = omap_;
+  }
+  if (m == nullptr || m->map_epoch == 0) return false;
+  out->resize(m->partition_num);
+  auto& rng = ThreadLocalRng();
+  for (int p = 0; p < m->partition_num; ++p) {
+    const auto& os = m->owners[p];
+    if (os.size() == 1) {
+      (*out)[p] = os[0];
+      continue;
+    }
+    // p2c over the owner list: two random distinct candidates, lower
+    // (inflight, EWMA latency) wins — load first (a hot owner
+    // accumulates inflight), latency as the tie-breaker
+    size_t ia = rng.NextUInt(os.size());
+    size_t ib = rng.NextUInt(os.size() - 1);
+    if (ib >= ia) ++ib;
+    int a = os[ia];
+    int b = os[ib];
+    auto load = [&](int s, int64_t* infl, int64_t* ewma) {
+      if (s >= 0 && s < stats_shards_) {
+        *infl = shard_inflight_[s].load();
+        *ewma = shard_ewma_us_[s].load();
+      } else {
+        *infl = 0;
+        *ewma = 0;
+      }
+    };
+    int64_t la = 0, ea = 0, lb = 0, eb = 0;
+    load(a, &la, &ea);
+    load(b, &lb, &eb);
+    (*out)[p] = la != lb ? (la < lb ? a : b) : (ea <= eb ? a : b);
+  }
+  return true;
+}
+
+int ClientManager::ShardTraffic(uint64_t* reqs, uint64_t* rows,
+                                int cap) const {
+  int n = std::min(cap, stats_shards_);
+  for (int s = 0; s < n; ++s) {
+    if (reqs != nullptr) reqs[s] = shard_reqs_[s].load();
+    if (rows != nullptr) rows[s] = shard_rows_[s].load();
+  }
+  return n;
+}
+
+int ClientManager::HedgeAltFor(int shard) const {
+  std::lock_guard<std::mutex> lk(omap_mu_);
+  if (shard < 0 || shard >= static_cast<int>(hedge_alt_.size())) return -1;
+  return hedge_alt_[shard];
+}
+
+// Live replica-hedge leg threads (process-global): the race legs are
+// dedicated detached threads, and a leg against a stalled shard with
+// no deadline can block until its connection dies — a closed-loop
+// retry storm must not accumulate threads without bound. At the cap,
+// Execute degrades to the plain (pre-hedging) blocking call.
+static std::atomic<int> g_replica_hedge_legs{0};
+constexpr int kMaxReplicaHedgeLegs = 128;
+
+Status ClientManager::ReplicaHedgedExecute(
+    int shard, int alt, std::shared_ptr<ByteWriter> body,
+    std::vector<char>* reply, int64_t hedge_us, int64_t deadline_abs_us,
+    uint64_t map_epoch) {
+  auto& ctr = GlobalRpcCounters();
+  // Two blocking legs race on their own detached threads; this thread
+  // coordinates on the shared state. Dedicated threads (not the client
+  // pool): a coordinator parked on a fixed-size pool while its legs
+  // queue behind other coordinators would deadlock it. The loser's
+  // blocking Call cannot be cancelled — it drains on its thread and
+  // its reply is discarded at the race (counted replica_hedge_wasted).
+  // The channel snapshot keeps the endpoint alive past a concurrent
+  // monitor swap; `race` keeps the state alive past this return.
+  struct Race {
+    std::mutex mu;
+    std::condition_variable cv;
+    int done = 0;
+    int winner = -1;
+    Status st[2] = {Status::OK(), Status::OK()};
+    std::vector<char> reply[2];
+  };
+  auto race = std::make_shared<Race>();
+  auto fire = [this, body, race, deadline_abs_us,
+               map_epoch](int leg_idx, int target) {
+    g_replica_hedge_legs.fetch_add(1);
+    auto chan = Channel(target);
+    std::thread([chan, body, race, deadline_abs_us, map_epoch, leg_idx] {
+      std::vector<char> rep;
+      Status s = chan->Call(kExecute, body->buffer(), &rep,
+                            /*max_retries=*/0, deadline_abs_us, map_epoch);
+      {
+        std::lock_guard<std::mutex> lk(race->mu);
+        race->st[leg_idx] = s;
+        race->reply[leg_idx] = std::move(rep);
+        ++race->done;
+        if (s.ok() && race->winner < 0) race->winner = leg_idx;
+        race->cv.notify_all();
+      }
+      g_replica_hedge_legs.fetch_sub(1);
+    }).detach();
+  };
+  fire(0, shard);
+  int fired = 1;
+  {
+    std::unique_lock<std::mutex> lk(race->mu);
+    race->cv.wait_for(lk, std::chrono::microseconds(hedge_us),
+                      [&] { return race->done >= 1; });
+    if (race->winner < 0 && race->done == 0) {
+      // primary is straggling: race the covering replica
+      lk.unlock();
+      ctr.replica_hedge_fired.fetch_add(1);
+      if (stats_shards_ > alt) shard_reqs_[alt].fetch_add(1);
+      fire(1, alt);
+      fired = 2;
+      lk.lock();
+    }
+    // first OK reply wins; only fail once EVERY fired leg failed
+    race->cv.wait(lk, [&] {
+      return race->winner >= 0 || race->done >= fired;
+    });
+    if (race->winner < 0) return race->st[0];
+    if (fired == 2) {
+      // the losing leg is wasted work whether it is still in flight
+      // (abandoned; drains on its thread, reply discarded) or raced in
+      // and was discarded here — a leg that FAILED counts failed, not
+      // wasted (the PR-11 hedge accounting convention)
+      const int loser = 1 - race->winner;
+      if (race->done < fired || race->st[loser].ok())
+        ctr.replica_hedge_wasted.fetch_add(1);
+    }
+    if (race->winner == 1) ctr.replica_hedge_won.fetch_add(1);
+    *reply = std::move(race->reply[race->winner]);
+  }
+  return Status::OK();
+}
+
 Status ClientManager::Execute(int shard, const ExecuteRequest& req,
-                              ExecuteReply* rep, int64_t deadline_abs_us) {
+                              ExecuteReply* rep, int64_t deadline_abs_us,
+                              uint64_t map_epoch) {
   if (shard < 0 || shard >= shard_num())
     return Status::InvalidArgument("bad shard index");
-  ByteWriter w;
-  EncodeExecuteRequest(req, &w);
+  auto w = std::make_shared<ByteWriter>();
+  EncodeExecuteRequest(req, w.get());
   std::vector<char> reply;
-  // snapshot: the monitor may swap the channel concurrently
-  ET_RETURN_IF_ERROR(Channel(shard)->Call(kExecute, w.buffer(), &reply,
-                                          /*max_retries=*/0,
-                                          deadline_abs_us));
+  const int64_t t0 = SteadyNowUs();
+  if (shard < stats_shards_) {
+    shard_reqs_[shard].fetch_add(1);
+    shard_inflight_[shard].fetch_add(1);
+  }
+  Status s;
+  const int64_t hedge_us = GlobalRpcConfig().hedge_delay_us.load();
+  const int alt = (hedge_us > 0 &&
+                   GlobalRpcConfig().hedge_replicas.load())
+                      ? HedgeAltFor(shard)
+                      : -1;
+  if (alt >= 0 &&
+      g_replica_hedge_legs.load() + 2 <= kMaxReplicaHedgeLegs) {
+    s = ReplicaHedgedExecute(shard, alt, w, &reply, hedge_us,
+                             deadline_abs_us, map_epoch);
+  } else if (alt >= 0) {
+    // At the leg cap. The cap fills precisely when legs pile up on a
+    // STALLED primary (a healthy fleet completes legs as fast as they
+    // spawn), so degrading to a plain blocking call on `shard` would
+    // park this caller behind the very stall hedging exists to escape
+    // — route the whole call at the covering ALTERNATIVE instead (it
+    // owns every partition `shard` does, so the answer is identical).
+    if (shard_reqs_ != nullptr && alt < stats_shards_)
+      shard_reqs_[alt].fetch_add(1);
+    s = Channel(alt)->Call(kExecute, w->buffer(), &reply,
+                           /*max_retries=*/0, deadline_abs_us, map_epoch);
+  } else {
+    // snapshot: the monitor may swap the channel concurrently
+    s = Channel(shard)->Call(kExecute, w->buffer(), &reply,
+                             /*max_retries=*/0, deadline_abs_us,
+                             map_epoch);
+  }
+  if (shard < stats_shards_) {
+    shard_inflight_[shard].fetch_sub(1);
+    if (s.ok()) {
+      // per-shard reply-latency EWMA: new = (7*old + sample) / 8 — the
+      // PickOwners p2c signal (same smoothing as the mux-slot EWMA)
+      int64_t sample = SteadyNowUs() - t0;
+      int64_t old = shard_ewma_us_[shard].load();
+      shard_ewma_us_[shard].store(old == 0 ? sample
+                                           : (7 * old + sample) / 8);
+    }
+  }
+  ET_RETURN_IF_ERROR(s);
   ByteReader r(reply.data(), reply.size());
   ET_RETURN_IF_ERROR(DecodeExecuteReply(&r, rep));
   return rep->status;
@@ -2664,14 +3055,15 @@ Status ClientManager::DeltaSince(uint64_t from, uint64_t* epoch,
 
 void ClientManager::ExecuteAsync(
     int shard, ExecuteRequest req,
-    std::function<void(Status, ExecuteReply)> done, int64_t deadline_abs_us) {
+    std::function<void(Status, ExecuteReply)> done, int64_t deadline_abs_us,
+    uint64_t map_epoch) {
   // the Call() below blocks until the shard replies — it must not occupy
   // an executor thread (see ClientThreadPool comment in threadpool.h)
   ClientThreadPool()->Schedule(
       [this, shard, req = std::move(req), done = std::move(done),
-       deadline_abs_us] {
+       deadline_abs_us, map_epoch] {
         ExecuteReply rep;
-        Status s = Execute(shard, req, &rep, deadline_abs_us);
+        Status s = Execute(shard, req, &rep, deadline_abs_us, map_epoch);
         done(s, std::move(rep));
       });
 }

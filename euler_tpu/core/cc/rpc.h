@@ -98,6 +98,15 @@ struct RpcConfig {
   // score instead of blind round-robin — a stalled connection stops
   // attracting new calls. Default off (rotation, the pre-p2c path).
   std::atomic<bool> p2c{false};
+  // Hedge straggling kExecute calls across graph-shard REPLICAS: when
+  // the ownership map lists another shard whose owned partitions cover
+  // the target's (a replicated hot partition / a full replica),
+  // ClientManager::Execute races the same request against it past
+  // hedge_delay_us without a reply — first reply wins, the loser's
+  // blocking leg finishes on its own thread and is discarded (counted
+  // replica_hedge_wasted). Needs an installed OwnershipMap with a
+  // covering alternative owner and hedge_delay_us > 0. Default off.
+  std::atomic<bool> hedge_replicas{false};
 
   RpcConfig() = default;
   RpcConfig(const RpcConfig& o) { *this = o; }
@@ -108,6 +117,7 @@ struct RpcConfig {
     max_inflight.store(o.max_inflight.load());
     hedge_delay_us.store(o.hedge_delay_us.load());
     p2c.store(o.p2c.load());
+    hedge_replicas.store(o.hedge_replicas.load());
     return *this;
   }
 };
@@ -143,6 +153,18 @@ struct RpcCounters {
   // the demux reader, their replies discarded. Counted exactly once
   // per abandoned leg, at abandonment.
   std::atomic<uint64_t> hedge_wasted{0};
+  // ---- elastic fleet (epoch-versioned ownership maps) ----
+  // kExecute requests a SERVER refused because they were routed on an
+  // OLDER ownership-map epoch than the shard's — answered with an
+  // explicit "stale ownership map" status (the client refreshes the
+  // registry-published map and retries; never a silent misroute).
+  // Server-edge, like deadline_shed.
+  std::atomic<uint64_t> stale_map_shed{0};
+  // Replica-level hedging (ClientManager::Execute across shards that
+  // own the same partitions — RpcConfig::hedge_replicas).
+  std::atomic<uint64_t> replica_hedge_fired{0};
+  std::atomic<uint64_t> replica_hedge_won{0};
+  std::atomic<uint64_t> replica_hedge_wasted{0};
 };
 RpcCounters& GlobalRpcCounters();
 
@@ -234,6 +256,22 @@ class GraphServer {
   // fleet deltas. kGetDeltaLog then always answers covered=0.
   void MarkDeltaLogGap() { dlog_authoritative_.store(false); }
 
+  // Install an epoch-versioned ownership map (kSetOwnership / admin):
+  // from then on (1) kExecute requests stamped with an OLDER map epoch
+  // are refused with an explicit "stale ownership map" status (counted
+  // stale_map_shed) — the flip is what makes a superseded routing map
+  // unable to read partitions whose deltas now land elsewhere; (2)
+  // delta applies filter by the map's owner lists instead of the hash
+  // convention; (3) the spec is persisted beside the WAL (when one is
+  // attached) so crash-recovery replay re-filters identically. A map
+  // older than the installed one is refused.
+  Status SetOwnership(std::shared_ptr<const OwnershipMap> m);
+  std::shared_ptr<const OwnershipMap> ownership() const {
+    std::lock_guard<std::mutex> lk(omap_mu_);
+    return omap_;
+  }
+  uint64_t map_epoch() const { return map_epoch_.load(); }
+
   uint64_t epoch() const { return graph_ref_->epoch(); }
 
   // Anti-entropy catch-up (restart rejoin): pull the raw delta records
@@ -282,6 +320,8 @@ class GraphServer {
   void HandleApplyDelta(ByteReader* r, ByteWriter* w);
   void HandleGetDelta(ByteReader* r, ByteWriter* w);
   void HandleGetDeltaLog(ByteReader* r, ByteWriter* w);
+  // kSetOwnership: body = ownership spec → decode + SetOwnership.
+  void HandleSetOwnership(ByteReader* r, ByteWriter* w);
   // Shared apply path (wire kApplyDelta AND peer catch-up): decode →
   // WAL append → rebuild → swap → retained log → compaction. Writes the
   // wire reply (u32 code | u64 epoch, or u32 1 | str error) into w.
@@ -295,6 +335,12 @@ class GraphServer {
   std::shared_ptr<IndexManager> index_;
   mutable std::mutex state_mu_;  // index_ swap vs request snapshots
   std::string index_spec_;
+  // elastic fleet: installed ownership map (delta filtering + the
+  // stale-map request check). map_epoch_ mirrors omap_->map_epoch so
+  // the per-request check is one atomic load.
+  mutable std::mutex omap_mu_;
+  std::shared_ptr<const OwnershipMap> omap_;
+  std::atomic<uint64_t> map_epoch_{0};
   std::shared_ptr<DeltaWal> wal_;
   bool wal_degraded_ = false;  // wal requested but unopenable: refuse deltas
   // off-path compaction accounting: Stop() drains in-flight tasks
@@ -361,9 +407,12 @@ class RpcChannel : public std::enable_shared_from_this<RpcChannel> {
   // request frame with the REMAINING budget at write time (hello-
   // negotiated; v1 peers byte-unchanged) so the server can shed
   // already-dead work; it does not bound the call locally.
+  // map_epoch > 0 stamps the ownership-map epoch the caller ROUTED
+  // with (captured at query-run start, not read live — see
+  // QueryEnv.map_epoch) so a flipped shard refuses stale-map reads.
   Status Call(uint32_t msg_type, const std::vector<char>& body,
               std::vector<char>* reply_body, int max_retries = 0,
-              int64_t deadline_abs_us = 0);
+              int64_t deadline_abs_us = 0, uint64_t map_epoch = 0);
 
   // Async mux submission: invokes done(status, reply) when the reply
   // frame arrives (or the connection dies). Requires mux mode; without
@@ -388,6 +437,7 @@ class RpcChannel : public std::enable_shared_from_this<RpcChannel> {
   // reply. The sink must outlive the channel. nullptr disables.
   void set_epoch_sink(std::atomic<uint64_t>* sink) { epoch_sink_ = sink; }
 
+
   const std::string& host() const { return host_; }
   int port() const { return port_; }
 
@@ -399,7 +449,7 @@ class RpcChannel : public std::enable_shared_from_this<RpcChannel> {
   int Connect();
   Status MuxCall(uint32_t msg_type, const std::vector<char>& body,
                  std::vector<char>* reply_body, int max_retries,
-                 int64_t deadline_abs_us);
+                 int64_t deadline_abs_us, uint64_t map_epoch);
   // One hedged sync mux call: primary leg on `conn`; past hedge_us
   // without a reply, the same request fires on a second connection and
   // the first reply wins (the loser is abandoned by request_id).
@@ -407,7 +457,7 @@ class RpcChannel : public std::enable_shared_from_this<RpcChannel> {
                        int slots, uint32_t msg_type,
                        const std::vector<char>& body,
                        std::vector<char>* reply_body, int64_t hedge_us,
-                       int64_t deadline_abs_us);
+                       int64_t deadline_abs_us, uint64_t map_epoch);
   // Mux slot for the next call: p2c over (inflight, EWMA latency) when
   // configured, else round-robin. `avoid` >= 0 excludes that slot (the
   // hedge leg must take a different wire path).
@@ -466,6 +516,13 @@ class RegistryServer {
   std::vector<int> conn_fds_;
   std::vector<std::shared_ptr<std::atomic<bool>>> done_;
 };
+
+// Push an ownership-map spec to one graph server (kSetOwnership over a
+// short-lived v1 channel — the admin path the elastic driver uses to
+// flip a fleet's routing). *epoch_out (optional) gets the installed
+// map epoch on success.
+Status PushOwnership(const std::string& host, int port,
+                     const std::string& spec, uint64_t* epoch_out = nullptr);
 
 // Write/refresh one named entry in a registry (file touch or tcp put).
 Status RegistryPutEntry(const std::string& spec, const std::string& name);
@@ -544,6 +601,43 @@ class ClientManager {
   int partition_num() const { return partition_num_; }
   const GraphMeta& graph_meta() const { return graph_meta_; }
 
+  // ---- elastic fleet: epoch-versioned ownership routing ----
+  // Install/replace the routing map (client-cached view of the
+  // registry-published map). Every channel starts stamping the new
+  // epoch into its kExecute frames immediately. Refused when the map
+  // references shards this manager has no channel for (the caller must
+  // rebuild against the grown fleet first) or when it is older than
+  // the installed one.
+  Status SetOwnership(std::shared_ptr<const OwnershipMap> m);
+  std::shared_ptr<const OwnershipMap> ownership() const {
+    std::lock_guard<std::mutex> lk(omap_mu_);
+    return omap_;
+  }
+  uint64_t map_epoch() const { return map_epoch_.load(); }
+  // One owner choice per partition for THIS batch: single-owner
+  // partitions route to their owner; replicated partitions pick by
+  // power-of-two-choices over the per-shard (inflight, EWMA latency)
+  // score, so a hot owner stops attracting reads. False → no map
+  // installed (callers fall back to the ShardOf hash convention).
+  bool PickOwners(std::vector<int>* out) const;
+  // Per-shard traffic since Init (the hot-shard detection signal,
+  // mirrored into obs by the Python layer): kExecute REQUEST counts
+  // and split-routed ROW counts. Requests alone cannot see skew — the
+  // distribute rewrite fires a (possibly empty) REMOTE at every shard
+  // per query, so rows are the load signal. Fills min(cap, shard_num)
+  // entries of each; returns the count filled. Either pointer may be
+  // null.
+  int ShardTraffic(uint64_t* reqs, uint64_t* rows, int cap) const;
+  // Split kernels report the ids they routed to each shard.
+  void CountRoutedRows(int shard, uint64_t n) {
+    if (shard >= 0 && shard < stats_shards_)
+      shard_rows_[shard].fetch_add(n);
+  }
+  // Hedge alternative for `shard`: a shard whose owned partitions
+  // cover shard's (OwnershipMap::Covers) — the replica-hedging target.
+  // -1 when none exists or no map is installed.
+  int HedgeAltFor(int shard) const;
+
   // Per-shard weight sums; type < 0 → total over types.
   float NodeWeight(int shard, int type) const;
   float EdgeWeight(int shard, int type) const;
@@ -553,14 +647,15 @@ class ClientManager {
 
   // Blocking execute on one shard. deadline_abs_us > 0 propagates the
   // caller's remaining budget inside the v2 request frame (see
-  // RpcChannel::Call) — the QueryEnv plumbs it from the query's entry
-  // point down to every REMOTE sub-call.
+  // RpcChannel::Call); map_epoch > 0 stamps the run-start ownership-
+  // map epoch — the QueryEnv plumbs both from the query's entry point
+  // down to every REMOTE sub-call.
   Status Execute(int shard, const ExecuteRequest& req, ExecuteReply* rep,
-                 int64_t deadline_abs_us = 0);
+                 int64_t deadline_abs_us = 0, uint64_t map_epoch = 0);
   // Async: schedules on the global pool, invokes done on completion.
   void ExecuteAsync(int shard, ExecuteRequest req,
                     std::function<void(Status, ExecuteReply)> done,
-                    int64_t deadline_abs_us = 0);
+                    int64_t deadline_abs_us = 0, uint64_t map_epoch = 0);
 
   // ---- streaming deltas ----
   // Highest graph epoch observed on any reply from any shard (passive:
@@ -583,6 +678,14 @@ class ClientManager {
 
  private:
   std::shared_ptr<RpcChannel> Channel(int shard) const;
+  // Two-leg replica race (RpcConfig::hedge_replicas): primary on
+  // `shard`, and past hedge_us without a reply the same bytes fire at
+  // `alt` (a covering owner). First reply wins; the loser's blocking
+  // leg drains on its own thread and is discarded (counted).
+  Status ReplicaHedgedExecute(int shard, int alt,
+                              std::shared_ptr<ByteWriter> body,
+                              std::vector<char>* reply, int64_t hedge_us,
+                              int64_t deadline_abs_us, uint64_t map_epoch);
   // Decode + install a shard's re-fetched ShardMeta after a failover
   // channel swap, so proportional SAMPLE_SPLIT routing doesn't keep the
   // dead server's weight sums if the restarted shard serves changed
@@ -605,6 +708,19 @@ class ClientManager {
   std::unique_ptr<ServerMonitor> monitor_;
   // max graph epoch seen on any shard reply (channels' epoch sink)
   std::atomic<uint64_t> observed_epoch_{0};
+  // elastic fleet: the client-cached ownership map + its epoch mirror
+  // (the channels' map_epoch_src_ points at map_epoch_), per-shard
+  // routing-load signals (PickOwners p2c), per-shard request counters
+  // (hot-shard detection), and the precomputed hedge alternatives.
+  mutable std::mutex omap_mu_;
+  std::shared_ptr<const OwnershipMap> omap_;
+  std::vector<int> hedge_alt_;  // under omap_mu_
+  std::atomic<uint64_t> map_epoch_{0};
+  std::unique_ptr<std::atomic<uint64_t>[]> shard_reqs_;
+  std::unique_ptr<std::atomic<uint64_t>[]> shard_rows_;
+  std::unique_ptr<std::atomic<int64_t>[]> shard_inflight_;
+  std::unique_ptr<std::atomic<int64_t>[]> shard_ewma_us_;
+  int stats_shards_ = 0;  // size of the arrays above
 };
 
 }  // namespace et

@@ -416,12 +416,81 @@ Status DecodeDeltaBody(const char* data, size_t size,
   return Status::OK();
 }
 
+Status PersistOwnership(const std::string& wal_dir,
+                        const std::string& spec) {
+  // atomic temp+fsync+rename: a crash leaves either the old map or the
+  // new one, never a torn spec. The DATA fsync before the rename is
+  // load-bearing — a durable directory entry naming an undurable file
+  // could surface as an empty OWNERSHIP after power loss, and recovery
+  // would silently replay deltas under the hash convention instead of
+  // the map the live path filtered with.
+  const std::string tmp = wal_dir + "/OWNERSHIP.tmp";
+  int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0)
+    return Status::IOError("cannot create " + tmp + ": " +
+                           std::string(std::strerror(errno)));
+  const char* p = spec.data();
+  size_t n = spec.size();
+  while (n > 0) {
+    ssize_t w = ::write(fd, p, n);
+    if (w <= 0) {
+      ::close(fd);
+      return Status::IOError("cannot write " + tmp + ": " +
+                             std::string(std::strerror(errno)));
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  if (::fsync(fd) != 0) {
+    ::close(fd);
+    return Status::IOError("cannot fsync " + tmp + ": " +
+                           std::string(std::strerror(errno)));
+  }
+  ::close(fd);
+  if (::rename(tmp.c_str(), (wal_dir + "/OWNERSHIP").c_str()) != 0)
+    return Status::IOError("cannot rename OWNERSHIP into place: " +
+                           std::string(std::strerror(errno)));
+  FsyncDir(wal_dir);
+  return Status::OK();
+}
+
+std::string ReadOwnershipSpec(const std::string& wal_dir) {
+  std::string spec;
+  if (!ReadFileToString(wal_dir + "/OWNERSHIP", &spec).ok()) return "";
+  // trim trailing whitespace/newline an operator-edited file may carry
+  while (!spec.empty() &&
+         (spec.back() == '\n' || spec.back() == '\r' || spec.back() == ' '))
+    spec.pop_back();
+  return spec;
+}
+
 Status RecoverShard(const std::string& wal_dir, const std::string& data_dir,
                     int shard_idx, int shard_num, bool build_in_adjacency,
                     std::unique_ptr<Graph>* out, uint64_t* replayed,
-                    std::vector<WalRecord>* records_out, bool* gap_out) {
+                    std::vector<WalRecord>* records_out, bool* gap_out,
+                    OwnershipMap* omap_out) {
   if (replayed != nullptr) *replayed = 0;
   if (gap_out != nullptr) *gap_out = false;
+  // persisted ownership map (kSetOwnership wrote it beside the log):
+  // replay must re-filter deltas under the SAME map the live path
+  // applied them with — a replicated partition's rows would otherwise
+  // vanish from a restarted extra owner whose hash placement disowns
+  // them. Absent/bad spec → hash convention, the pre-elastic behavior.
+  OwnershipMap omap;
+  const std::string ospec = ReadOwnershipSpec(wal_dir);
+  const OwnershipMap* omap_p = nullptr;
+  if (!ospec.empty()) {
+    Status os = OwnershipMap::Decode(ospec, &omap);
+    if (os.ok()) {
+      omap_p = &omap;
+      ET_LOG(INFO) << "wal recovery: shard " << shard_idx
+                   << " replaying under persisted ownership map " << ospec;
+    } else {
+      ET_LOG(WARNING) << "wal recovery: ignoring bad OWNERSHIP spec ("
+                      << os.message() << ")";
+    }
+  }
+  if (omap_out != nullptr && omap_p != nullptr) *omap_out = omap;
   std::string snap_name;
   uint64_t snap_epoch = 0;
   ET_RETURN_IF_ERROR(
@@ -463,7 +532,7 @@ Status RecoverShard(const std::string& wal_dir, const std::string& data_dir,
       s = ApplyGraphDelta(*g, ids.data(), ntypes.data(), nw.data(),
                           ids.size(), src.data(), dst.data(), etypes.data(),
                           ew.data(), src.size(), shard_idx, shard_num, &next,
-                          &dirty);
+                          &dirty, omap_p);
     }
     if (!s.ok()) {
       ET_LOG(WARNING) << "wal recovery: record for epoch " << rec.epoch

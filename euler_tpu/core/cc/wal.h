@@ -172,11 +172,24 @@ Status DecodeDeltaBody(const char* data, size_t size,
 // different fleet deltas, so its anti-entropy log must not claim
 // coverage (GraphServer::MarkDeltaLogGap). Anti-entropy catch-up and
 // the client epoch-regression flush are the fallbacks either way.
+// `omap_out` (optional) receives the persisted ownership map when one
+// is found beside the log (see PersistOwnership) — replay re-filters
+// deltas under it, and the caller should re-install it on the server so
+// the recovered shard keeps refusing stale-map reads.
 Status RecoverShard(const std::string& wal_dir, const std::string& data_dir,
                     int shard_idx, int shard_num, bool build_in_adjacency,
                     std::unique_ptr<Graph>* out, uint64_t* replayed,
                     std::vector<WalRecord>* records_out = nullptr,
-                    bool* gap_out = nullptr);
+                    bool* gap_out = nullptr,
+                    OwnershipMap* omap_out = nullptr);
+
+// Elastic fleet: persist/read the shard's installed ownership-map spec
+// beside its WAL ("OWNERSHIP", atomic temp+rename) so crash-recovery
+// replay filters deltas under the same map the live path applied them
+// with. ReadOwnershipSpec returns "" when absent.
+Status PersistOwnership(const std::string& wal_dir,
+                        const std::string& spec);
+std::string ReadOwnershipSpec(const std::string& wal_dir);
 
 }  // namespace et
 

@@ -162,8 +162,19 @@ def _declare(lib: ctypes.CDLL) -> None:
         # RPC transport (protocol v2 mux / adaptive compression): global
         # config + client-edge counters — see euler_tpu.graph.remote
         # configure_rpc() / rpc_transport_stats() for the friendly wrapper
-        "etg_rpc_config": (None, [i32, i32, i64, i32, i64, i32]),
+        "etg_rpc_config": (None, [i32, i32, i64, i32, i64, i32, i32]),
         "etg_rpc_stats": (None, [c_u64p]),
+        # elastic fleet: epoch-versioned ownership maps — install on a
+        # distribute-mode proxy / in-process server, push to a remote
+        # server over the kSetOwnership admin verb, read epochs and
+        # per-shard request counts (hot-shard detection)
+        "etg_push_ownership": (i32, [ctypes.c_char_p, i32, ctypes.c_char_p, c_i64p]),
+        "etq_set_ownership": (i32, [i64, ctypes.c_char_p]),
+        "etq_ownership_epoch": (i64, [i64]),
+        "etq_shard_num": (i32, [i64]),
+        "etq_shard_stats": (i32, [i64, c_u64p, c_u64p, i32]),
+        "ets_set_ownership": (i32, [i64, ctypes.c_char_p]),
+        "ets_map_epoch": (i64, [i64]),
         # tail latency: per-thread deadline handoff for the next query
         # run (remaining ms; <= 0 clears) — REMOTE sub-calls stamp the
         # remaining budget into their v2 request frames

@@ -36,7 +36,8 @@ from euler_tpu.core.lib import EngineError, check
 
 __all__ = ["Query", "GraphService", "start_service", "compile_debug",
            "register_udf", "udf_cache_stats", "udf_cache_clear",
-           "udf_cache_set_capacity", "edge_types_str", "wal_stats"]
+           "udf_cache_set_capacity", "edge_types_str", "wal_stats",
+           "push_ownership"]
 
 
 def edge_types_str(edge_types) -> str:
@@ -206,6 +207,46 @@ class Query:
             ew.ctypes.data_as(_libmod.c_f32p), ctypes.byref(out_epoch)))
         return int(out_epoch.value)
 
+    # -- elastic fleet (ownership maps; remote proxies) --------------------
+    def set_ownership(self, spec: str) -> None:
+        """Install the epoch-versioned ownership map this client routes
+        with (registry-published spec, e.g. "e3-P4-0.1.2.2+3"). Splits
+        then place ids by the map's owner lists (p2c over replicated
+        partitions' owners) and every request is stamped with the map
+        epoch so a shard on a newer map refuses it explicitly ("stale
+        ownership map") instead of serving a misrouted read."""
+        check(self._lib, self._lib.etq_set_ownership(self._h,
+                                                     spec.encode()))
+
+    def ownership_epoch(self) -> int:
+        """Installed ownership-map epoch (0 = none / local proxy)."""
+        e = self._lib.etq_ownership_epoch(self._h)
+        if e < 0:
+            raise EngineError(self._lib.etg_last_error().decode())
+        return int(e)
+
+    def shard_num(self) -> int:
+        """Shard count this proxy was built against (1 for local)."""
+        n = self._lib.etq_shard_num(self._h)
+        if n < 0:
+            raise EngineError(self._lib.etg_last_error().decode())
+        return int(n)
+
+    def shard_stats(self):
+        """(requests, rows) per-shard uint64 arrays since proxy init.
+        Rows (split-routed ids) are the hot-shard detection signal —
+        the distribute rewrite fires one REMOTE per shard per query
+        regardless, so request counts alone cannot see skew."""
+        n = self.shard_num()
+        reqs = np.zeros(max(n, 1), dtype=np.uint64)
+        rows = np.zeros(max(n, 1), dtype=np.uint64)
+        got = self._lib.etq_shard_stats(
+            self._h, reqs.ctypes.data_as(_libmod.c_u64p),
+            rows.ctypes.data_as(_libmod.c_u64p), int(reqs.size))
+        if got < 0:
+            raise EngineError(self._lib.etg_last_error().decode())
+        return reqs[:got], rows[:got]
+
     def delta_since(self, from_epoch: int):
         """(epoch, covered, dirty_ids) — union over shards in remote
         mode; covered=False when any shard's bounded history no longer
@@ -311,6 +352,24 @@ class GraphService:
     def epoch(self) -> int:
         """The served graph's current epoch (recovery-rejoin checks)."""
         return int(self._lib.ets_epoch(self._h))
+
+    # -- elastic fleet -----------------------------------------------------
+    def set_ownership(self, spec: str) -> int:
+        """Install an epoch-versioned ownership map on this shard: the
+        flip after which requests routed on an older map are refused
+        ("stale ownership map", counted), deltas filter by the map's
+        owner lists, and — when the shard is durable — the spec is
+        persisted beside the WAL so crash recovery replays under it.
+        Returns the installed map epoch (the flip_fleet contract —
+        push_ownership returns the same for wire pushes)."""
+        check(self._lib, self._lib.ets_set_ownership(self._h,
+                                                     spec.encode()))
+        return self.map_epoch
+
+    @property
+    def map_epoch(self) -> int:
+        """Installed ownership-map epoch (0 = none)."""
+        return int(self._lib.ets_map_epoch(self._h))
 
     def stop(self) -> None:
         if self._h:
@@ -469,6 +528,18 @@ def start_registry(port: int = 0) -> RegistryService:
     if h == 0:
         raise EngineError(lib.etg_last_error().decode())
     return RegistryService(lib, h)
+
+
+def push_ownership(host: str, port: int, spec: str) -> int:
+    """Push an ownership-map spec to one graph shard over the
+    kSetOwnership admin verb (the elastic driver's flip for servers it
+    does not hold an in-process handle to — e.g. subprocess shards).
+    Returns the installed map epoch."""
+    lib = _libmod.load()
+    out = ctypes.c_int64()
+    check(lib, lib.etg_push_ownership(host.encode(), int(port),
+                                      spec.encode(), ctypes.byref(out)))
+    return int(out.value)
 
 
 def scan_registry(spec: str):

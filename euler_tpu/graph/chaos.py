@@ -178,6 +178,20 @@ class ChaosGraphEngine:
     def delta_since(self, from_epoch: int):
         return self._engine.delta_since(from_epoch)
 
+    # -- elastic fleet (explicit delegation, same contract as above) -------
+    # Ownership-map maintenance is control-plane traffic: chaos must
+    # never fault-inject a map refresh (a lost install would diverge
+    # the wrapper's routing from the engine's).
+    def refresh_ownership(self, force: bool = False) -> int:
+        return self._engine.refresh_ownership(force=force)
+
+    def ownership_epoch(self) -> int:
+        return self._engine.ownership_epoch()
+
+    def shard_traffic(self):
+        return self._engine.shard_traffic()
+
+
     # -- introspection -----------------------------------------------------
     def stats(self) -> dict:
         """Injected-fault counters: calls, errors, delayed, truncated."""

@@ -125,6 +125,14 @@ class HandlePool:
             return
         self._q.put(h)
 
+    def set_ownership(self, spec: str) -> None:
+        """Install an ownership-map spec on EVERY pooled handle (the
+        engine's map refresh must reach pooled channels too, or chunked
+        fan-out would keep routing on the superseded map). Safe against
+        concurrent run()s — the native install is atomic per handle."""
+        for h in self._handles:
+            h.set_ownership(spec)
+
     def close(self, timeout_s: float = 5.0) -> None:
         """Reclaim and close the handles. A handle parked under a live
         (black-holed) call past the timeout is LEAKED (handle zeroed,
@@ -220,6 +228,10 @@ class PipelinedClient:
                     (time.monotonic() - t_submit) * 1000.0)
 
         return self._exec.submit(call)
+
+    def set_ownership(self, spec: str) -> None:
+        """Forward an ownership-map install to the pooled handles."""
+        self._handles.set_ownership(spec)
 
     def close(self, timeout_s: float = 5.0) -> None:
         """Bounded shutdown mirroring the engine's stray policy: a
@@ -632,6 +644,21 @@ class CachedGraphEngine:
             self._dense.clear()
             self._ragged.clear()
             self._refresh_bytes()
+
+    # -- elastic fleet (explicit delegation) -------------------------------
+    # Ownership routing lives in the wrapped engine; the cache stays
+    # VALID across map flips (ownership moves requests, not data — the
+    # epoch-invalidation machinery below owns data coherence). Explicit
+    # so an engine lacking the verbs raises its own AttributeError.
+    def refresh_ownership(self, force: bool = False) -> int:
+        return self._engine.refresh_ownership(force=force)
+
+    def ownership_epoch(self) -> int:
+        return self._engine.ownership_epoch()
+
+    def shard_traffic(self):
+        return self._engine.shard_traffic()
+
 
     # -- streaming-delta epoch coherence -----------------------------------
     def graph_epoch(self, *args, **kwargs) -> int:

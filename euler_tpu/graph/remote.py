@@ -27,6 +27,7 @@ import itertools
 import random
 import threading
 import time
+import weakref
 from typing import Optional, Sequence
 
 import numpy as np
@@ -50,6 +51,12 @@ _RPC_COUNTERS = {
     "degraded": "default_id-padded results served (degrade=True)",
     "deadline_exhausted": "calls that ran out of retry budget",
     "health_merge_errors": "proxy stats() failures during health()",
+    # elastic fleet: a shard refused the call because it was routed on
+    # a superseded ownership map — the engine refreshed the registry-
+    # published map (rebuilding its proxies when the fleet grew) and
+    # retried; never a silent misroute
+    "stale_map_retries": "calls refused as stale-map, refreshed + retried",
+    "ownership_refreshes": "ownership-map refreshes applied",
 }
 
 # Error-text markers for failures worth retrying: transport-level faults
@@ -76,6 +83,10 @@ _TRANSPORT_MARKERS = (
     # expired in the dispatch queue — transport-shaped (the caller's
     # budget decides whether another attempt is worth it)
     "deadline shed",
+    # a shard refused the request as routed on a superseded ownership
+    # map — retryable AFTER the engine refreshes the published map
+    # (_run_wire hooks exactly this marker to refresh before retrying)
+    "stale ownership map",
 )
 
 
@@ -105,12 +116,18 @@ _RPC_STAT_KEYS = (
     # tail-latency machinery: deadline_shed is SERVER-edge (loopback
     # tests see both edges in one process), the rest client-edge
     "deadline_propagated", "deadline_shed", "hedge_fired", "hedge_won",
-    "hedge_wasted")
+    "hedge_wasted",
+    # elastic fleet: stale_map_shed is SERVER-edge (requests refused as
+    # routed on a superseded ownership map); replica_hedge_* count
+    # ClientManager's cross-replica races (hedge_replicas)
+    "stale_map_shed", "replica_hedge_fired", "replica_hedge_won",
+    "replica_hedge_wasted")
 
 # Last config applied through configure_rpc (the native side has no
 # getter). RemoteGraphEngine reads `mux` to default pool_shared.
 _RPC_CONFIG = {"mux": False, "connections": 1, "compress_threshold": 0,
-               "max_inflight": 256, "hedge_delay_ms": 0.0, "p2c": False}
+               "max_inflight": 256, "hedge_delay_ms": 0.0, "p2c": False,
+               "hedge_replicas": False}
 _rpc_mu = threading.Lock()
 _rpc_env_applied = False
 _rpc_obs_done = False
@@ -118,7 +135,7 @@ _rpc_obs_done = False
 
 def configure_rpc(mux=None, connections=None, compress_threshold=None,
                   max_inflight=None, hedge_delay_ms=None,
-                  p2c=None) -> dict:
+                  p2c=None, hedge_replicas=None) -> dict:
     """Set the PROCESS-GLOBAL graph-RPC transport knobs; returns the
     resulting config. None leaves a knob unchanged. Applies to engines
     (native channels) built AFTER the call — except hedge_delay_ms and
@@ -139,7 +156,15 @@ def configure_rpc(mux=None, connections=None, compress_threshold=None,
       pre-hedging path). RemoteGraphEngine(hedge=True) keeps this
       ADAPTIVE off the observed latency histogram. p2c: power-of-two-
       choices mux connection selection off (inflight, EWMA latency)
-      instead of blind rotation."""
+      instead of blind rotation.
+    hedge_replicas: additionally race a straggling kExecute across
+      graph-shard REPLICAS — when the installed ownership map lists
+      another shard whose owned partitions cover the target's, the same
+      request fires at it past the hedge delay; first reply wins,
+      counted replica_hedge_fired/won/wasted. Needs an ownership map
+      with multi-owner partitions (elastic rebalancing) and
+      hedge_delay_ms > 0. The explicitly-deferred PR 11 item: graph
+      shards had no replicas until the elastic fleet."""
     from euler_tpu.core import lib as _lib
 
     lib = _lib.load()
@@ -157,6 +182,8 @@ def configure_rpc(mux=None, connections=None, compress_threshold=None,
             _RPC_CONFIG["hedge_delay_ms"] = max(float(hedge_delay_ms), 0.0)
         if p2c is not None:
             _RPC_CONFIG["p2c"] = bool(p2c)
+        if hedge_replicas is not None:
+            _RPC_CONFIG["hedge_replicas"] = bool(hedge_replicas)
         lib.etg_rpc_config(
             -1 if mux is None else int(bool(mux)),
             0 if connections is None else max(int(connections), 1),
@@ -165,7 +192,8 @@ def configure_rpc(mux=None, connections=None, compress_threshold=None,
             0 if max_inflight is None else max(int(max_inflight), 1),
             -1 if hedge_delay_ms is None else max(
                 int(float(hedge_delay_ms) * 1000.0), 0),
-            -1 if p2c is None else int(bool(p2c)))
+            -1 if p2c is None else int(bool(p2c)),
+            -1 if hedge_replicas is None else int(bool(hedge_replicas)))
         return dict(_RPC_CONFIG)
 
 
@@ -193,6 +221,9 @@ def configure_rpc_from_env() -> dict:
         kw["hedge_delay_ms"] = float(os.environ["EULER_TPU_RPC_HEDGE_MS"])
     if os.environ.get("EULER_TPU_RPC_P2C"):
         kw["p2c"] = os.environ["EULER_TPU_RPC_P2C"] not in ("0", "")
+    if os.environ.get("EULER_TPU_RPC_HEDGE_REPLICAS"):
+        kw["hedge_replicas"] = (
+            os.environ["EULER_TPU_RPC_HEDGE_REPLICAS"] not in ("0", ""))
     # apply BEFORE publishing the applied flag: a concurrently
     # constructing engine must never observe applied=True while the env
     # config has not reached the native side yet (it would build its
@@ -304,7 +335,8 @@ class RemoteGraphEngine:
                  hedge_quantile: float = 0.95,
                  hedge_min_ms: float = 1.0,
                  hedge_max_ms: float = 250.0,
-                 deadline_propagation: bool = False):
+                 deadline_propagation: bool = False,
+                 ownership_refresh_s: float = 0.0):
         """retry_deadline_s: failover budget. A query that fails (shard
         died mid-call, RpcChannel exhausted its in-channel retries) is
         retried under RetryPolicy (exponential backoff, full jitter)
@@ -374,9 +406,50 @@ class RemoteGraphEngine:
         budget into the v2 request frames (hello-negotiated) so a shard
         sheds queued work that can no longer make it — counted
         deadline_shed server-side, never a silent partial. v1 peers are
-        byte-unchanged; off (default) stamps nothing."""
+        byte-unchanged; off (default) stamps nothing.
+
+        ownership_refresh_s: > 0 enables elastic-fleet routing — the
+        engine TTL-caches the registry-published epoch-versioned
+        ownership map (PR 8 client-cache pattern): on the call path it
+        re-fetches at most every this-many seconds, installs newer maps
+        into its native proxies (splits then place ids by the map's
+        owner lists, p2c over replicated partitions), REBUILDS the
+        proxies when the fleet grew (a live 2→4 split), and every
+        request is stamped with the map epoch so a flipped shard
+        refuses stale-map reads explicitly — which this engine answers
+        by a forced refresh + retry (counted stale_map_retries; zero
+        silent misroutes). Needs registry endpoints ("dir:"/"tcp:");
+        0 (default) keeps the static hash-routed fleet."""
         configure_rpc_from_env()  # before the native channels are built
+        if ownership_refresh_s and ownership_refresh_s > 0 \
+                and not _RPC_CONFIG["mux"]:
+            # elastic routing NEEDS the v2 mux transport: the stale-map
+            # protection rides the hello-negotiated map-epoch request
+            # prefix, which the classic v1 framing cannot carry — an
+            # unstamped request would be served silently by a flipped
+            # shard. Forced here, before the channels are built.
+            configure_rpc(mux=True)
         self.query = Query.remote(endpoints, seed=seed, mode=mode)
+        # elastic fleet: TTL-cached registry-published ownership map
+        self._endpoints = endpoints
+        self._seed = seed
+        self._mode = mode
+        self.ownership_refresh_s = float(ownership_refresh_s)
+        self._omap_mu = threading.Lock()
+        # serializes fetch+install+rebuild: two threads hitting the
+        # stale-map path at once must not both rebuild (the second
+        # would close the first's freshly built pipeline)
+        self._omap_refresh_mu = threading.Lock()
+        self._omap_epoch = 0
+        self._omap_spec: Optional[str] = None
+        self._omap_next_check = 0.0
+        # proxies/pipelines retired by a fleet-growth rebuild: kept
+        # alive (not closed) because in-flight calls on other threads —
+        # including the rebuild trigger itself, when it fires on a
+        # pooled worker — may still hold them; engine.close() closes
+        # them once
+        self._retired_proxies: list = []
+        self._retired_pipelines: list = []
         self.retry = retry_policy or RetryPolicy(
             deadline_s=float(retry_deadline_s))
         # tail-latency knobs (ISSUE 12): adaptive hedging + deadline
@@ -422,6 +495,40 @@ class RemoteGraphEngine:
             "single-attempt graph rpc wire latency (hedge-delay signal)",
             ("engine",)).labels(**lab)
         self._last_error: Optional[str] = None
+        # elastic fleet observability: the installed map epoch and the
+        # per-shard request counters (hot-shard detection feeds off
+        # graph_shard_requests_total at every scrape)
+        self._g_map_epoch = reg.gauge(
+            "graph_ownership_epoch",
+            "installed ownership-map epoch (0 = hash routing)",
+            ("engine",)).labels(**lab)
+        self._g_shard_reqs = reg.gauge(
+            "graph_shard_requests_total",
+            "kExecute requests issued per graph shard (client edge)",
+            ("engine", "shard"))
+        self._g_shard_rows = reg.gauge(
+            "graph_shard_rows_total",
+            "split-routed ids per graph shard (client edge — the "
+            "hot-shard detection signal)", ("engine", "shard"))
+        eng_ref = weakref.ref(self)
+        obs_name = self._obs_name
+        g_reqs, g_rows = self._g_shard_reqs, self._g_shard_rows
+
+        def _collect_shards():
+            eng = eng_ref()
+            if eng is None:
+                return False  # engine gone: collector self-removes
+            try:
+                reqs, rows = eng.query.shard_stats()
+            except (EngineError, OSError):
+                return None  # closed/unavailable; keep the collector
+            for s in range(len(reqs)):
+                g_reqs.labels(engine=obs_name, shard=str(s)).set(
+                    int(reqs[s]))
+                g_rows.labels(engine=obs_name, shard=str(s)).set(
+                    int(rows[s]))
+
+        reg.add_collector(_collect_shards)
         _obs.register_health(self._obs_name, self.health)
         self.query.bind_obs(self._obs_name)
         self._strays: list = []  # abandoned timed-out attempt threads
@@ -437,14 +544,25 @@ class RemoteGraphEngine:
         # query handles; None keeps the serial path byte-identical
         self.chunk_size = int(chunk_size)
         self.pipeline = None
+        self._pipeline_args = None
         if pool_size and pool_size > 0:
             from euler_tpu.graph.pipeline import PipelinedClient
 
             shared = (_RPC_CONFIG["mux"] if pool_shared is None
                       else bool(pool_shared))
+            # retained for proxy rebuilds after a fleet-growth refresh
+            self._pipeline_args = dict(workers=int(pool_size),
+                                       handles=pool_handles,
+                                       shared=shared)
             self.pipeline = PipelinedClient(
-                self, endpoints, seed, mode, workers=int(pool_size),
-                handles=pool_handles, shared=shared)
+                self, endpoints, seed, mode, **self._pipeline_args)
+        if self.ownership_refresh_s > 0:
+            # best-effort initial install (the fleet may predate maps);
+            # runs after the pipeline exists so pooled handles get it
+            try:
+                self.refresh_ownership(force=True)
+            except (EngineError, OSError, ValueError):
+                pass
 
     # -- health / retry machinery ------------------------------------------
     def health(self) -> dict:
@@ -456,7 +574,8 @@ class RemoteGraphEngine:
         numbers a /metrics scrape reports, by construction."""
         out = {k: int(self._ctr[k].value) for k in
                ("calls", "retries", "failovers", "degraded",
-                "deadline_exhausted")}
+                "deadline_exhausted", "stale_map_retries",
+                "ownership_refreshes")}
         with self._health_mu:
             out["last_error"] = self._last_error
         try:
@@ -546,6 +665,14 @@ class RemoteGraphEngine:
         default is the engine's own handle."""
         pol = self.retry
         self._bump("calls")
+        if self.ownership_refresh_s > 0:
+            # TTL tick: within the TTL this is one lock + compare; past
+            # it, one registry fetch amortized over the window
+            try:
+                self.refresh_ownership()
+            except (EngineError, OSError, ValueError):
+                pass  # stale map still routes; the shard-side check
+                # + forced refresh below stay the correctness backstop
         with _obs.timed_span("graph_rpc", self._hist_call_ms,
                              engine=self._obs_name, gql=gql[:80]) as sp:
             deadline = time.monotonic() + max(pol.deadline_s, 0.0)
@@ -572,6 +699,23 @@ class RemoteGraphEngine:
                 except EngineError as e:
                     if not retryable_error(e):
                         raise
+                    if "stale ownership map" in str(e).lower():
+                        # the shard flipped to a newer map than this
+                        # request was split with: refresh NOW (forced)
+                        # so the retry routes on the fresh map — the
+                        # split/merge plan re-runs from scratch
+                        self._bump("stale_map_retries")
+                        try:
+                            self.refresh_ownership(force=True)
+                        except (EngineError, OSError, ValueError):
+                            pass  # retry anyway; backoff paces us
+                        # a POOLED handle may now be retired (fleet-
+                        # growth rebuild): it can never adopt the wider
+                        # map, so every retry on it would be refused —
+                        # re-point the remaining attempts at the
+                        # engine's fresh proxy
+                        if query is not None and query is not self.query:
+                            query = None
                     attempt += 1
                     with self._health_mu:
                         self._last_error = str(e)
@@ -598,6 +742,96 @@ class RemoteGraphEngine:
 
     def _note_degraded(self) -> None:
         self._bump("degraded")
+
+    # -- elastic fleet: ownership-map cache / refresh ----------------------
+    def ownership_epoch(self) -> int:
+        """Installed ownership-map epoch (0 = hash routing)."""
+        return self.query.ownership_epoch()
+
+    def shard_traffic(self):
+        """(requests, rows) per-shard uint64 arrays since the current
+        proxy was built. ROWS (split-routed ids) are the hot-shard
+        signal — the distribute rewrite fires one REMOTE per shard per
+        query regardless, so requests alone cannot see skew. Mirrored
+        as graph_shard_{requests,rows}_total{engine=,shard=} gauges."""
+        return self.query.shard_stats()
+
+
+    def _registry_endpoints(self) -> Optional[str]:
+        return (self._endpoints
+                if self._endpoints.startswith(("dir:", "tcp:")) else None)
+
+    def refresh_ownership(self, force: bool = False) -> int:
+        """TTL-cached ownership-map refresh (PR 8 client-cache
+        pattern): fetch the registry-published map, and when it is
+        newer than the installed one push it into the native proxies —
+        REBUILDING them first when the map references a grown fleet
+        (live split). force=True skips the TTL (the stale-map retry
+        path). Returns the installed epoch. No-op without registry
+        endpoints or a published map."""
+        registry = self._registry_endpoints()
+        if registry is None:
+            return 0
+        now = time.monotonic()
+        with self._omap_mu:
+            if not force and now < self._omap_next_check:
+                return self._omap_epoch
+            # claim the slot before the fetch so concurrent callers
+            # don't stampede the registry
+            self._omap_next_check = now + max(self.ownership_refresh_s,
+                                              0.5)
+        from euler_tpu.graph import elastic
+
+        # ONE refresh at a time: concurrent stale-map retries must not
+        # both rebuild the proxies (the loser would close the winner's
+        # fresh pipeline); late arrivals re-check the epoch inside and
+        # return the already-installed map
+        with self._omap_refresh_mu:
+            m = elastic.fetch_map(registry)
+            if m is None:
+                return 0
+            with self._omap_mu:
+                if m.map_epoch <= self._omap_epoch:
+                    return self._omap_epoch
+            if m.shard_num != self.query.shard_num():
+                # the fleet grew (or shrank): these proxies were built
+                # against the wrong channel set — rebuild from discovery
+                self._rebuild_proxies()
+            spec = m.encode()
+            self.query.set_ownership(spec)
+            if self.pipeline is not None:
+                self.pipeline.set_ownership(spec)
+            with self._omap_mu:
+                self._omap_epoch = m.map_epoch
+                self._omap_spec = spec
+        self._bump("ownership_refreshes")
+        self._g_map_epoch.set(m.map_epoch)
+        return m.map_epoch
+
+    def _rebuild_proxies(self) -> None:
+        """Swap in fresh native proxies discovered from the registry
+        (new shard count after a live split). The retired proxies AND
+        the retired pipeline are kept alive, not closed: this can run
+        ON one of the old pipeline's own worker threads (the stale-map
+        retry path), where close() would try to join the current
+        thread, and cancelling the old pool's queued futures would
+        fail calls that are mid-retry. Old workers drain naturally —
+        their in-flight calls re-point at the fresh proxy (the
+        stale-map hook in _run_wire) — and everything retired is
+        closed with the engine."""
+        fresh = Query.remote(self._endpoints, seed=self._seed,
+                             mode=self._mode)
+        old, self.query = self.query, fresh
+        self._retired_proxies.append(old)
+        self.query.bind_obs(self._obs_name)
+        if self.pipeline is not None:
+            from euler_tpu.graph.pipeline import PipelinedClient
+
+            old_pipe = self.pipeline
+            self.pipeline = PipelinedClient(
+                self, self._endpoints, self._seed, self._mode,
+                **self._pipeline_args)
+            self._retired_pipelines.append(old_pipe)
 
     # -- adaptive hedging --------------------------------------------------
     _HEDGE_REFRESH_CALLS = 64
@@ -629,8 +863,15 @@ class RemoteGraphEngine:
         the call queues to the worker pool and runs on a pooled handle;
         without one it executes synchronously and returns an already-
         completed Future — one surface either way."""
-        if self.pipeline is not None:
-            return self.pipeline.submit(gql, feed)
+        pipe = self.pipeline
+        if pipe is not None:
+            try:
+                return pipe.submit(gql, feed)
+            except RuntimeError:
+                # the pipeline was closed under us by a fleet-growth
+                # proxy rebuild: fall through to the synchronous path
+                # for this call (the rebuilt pipeline serves the next)
+                pass
         from concurrent.futures import Future
 
         fut = Future()
@@ -1168,3 +1409,12 @@ class RemoteGraphEngine:
                 self.query._h = 0
             return
         self.query.close()
+        # proxies/pipelines retired by fleet-growth rebuilds: closed
+        # last — no new calls could reach them since the swap, and the
+        # stray drain above bounded any in-flight ones
+        for p in self._retired_pipelines:
+            p.close()
+        self._retired_pipelines.clear()
+        for q in self._retired_proxies:
+            q.close()
+        self._retired_proxies.clear()

@@ -39,10 +39,12 @@ from euler_tpu.serving.export import (  # noqa: F401
     shard_bounds,
 )
 from euler_tpu.serving.server import InferenceServer  # noqa: F401
+from euler_tpu.serving.autoscale import ServingAutoscaler  # noqa: F401
 
 __all__ = [
     "MicroBatcher", "ShedError", "bucket_ladder", "run_bucketed",
     "warm_ladder", "ServingClient", "ServerOverloaded",
     "BundleCorruptionError", "ModelBundle", "embed_all",
     "shard_bounds", "bundle_shard_count", "InferenceServer",
+    "ServingAutoscaler",
 ]

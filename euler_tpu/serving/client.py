@@ -107,7 +107,8 @@ class ServingClient:
                  fanout: int = 0, swap_timeout_s: float = 120.0,
                  bounds_ttl_s: float = 30.0, hedge: bool = False,
                  hedge_quantile: float = 0.9, hedge_min_ms: float = 1.0,
-                 hedge_max_ms: float = 200.0, p2c: bool = False):
+                 hedge_max_ms: float = 200.0, p2c: bool = False,
+                 rediscover_ttl_s: float = 0.0):
         """Tail-latency knobs (both opt-in, both byte-identical on the
         wire when off):
 
@@ -123,7 +124,12 @@ class ServingClient:
         p2c: power-of-two-choices replica selection off the observed
           per-endpoint latency EWMA instead of blind rotation — two
           random replicas, take the historically faster one (unknown
-          endpoints score as idle, so fresh replicas get explored)."""
+          endpoints score as idle, so fresh replicas get explored).
+        rediscover_ttl_s: > 0 re-resolves the registry at most every
+          this-many seconds on the call path even when nothing failed —
+          the elastic-fleet knob: replicas the AUTOSCALER just started
+          begin receiving traffic within one TTL instead of only after
+          a failure. 0 (default) keeps failure-driven re-resolution."""
         if not endpoints and not registry:
             raise ValueError("pass endpoints='hosts:h:p,...' or a "
                              "registry spec + service")
@@ -133,6 +139,9 @@ class ServingClient:
         self.fanout = int(fanout)
         self.swap_timeout_s = float(swap_timeout_s)
         self.bounds_ttl_s = float(bounds_ttl_s)
+        self.rediscover_ttl_s = float(rediscover_ttl_s)
+        self._next_rediscover = (time.monotonic() + self.rediscover_ttl_s
+                                 if self.rediscover_ttl_s > 0 else None)
         self.retry = retry_policy or RetryPolicy(
             deadline_s=10.0, call_timeout_s=5.0)
         self.hedge = bool(hedge)
@@ -510,6 +519,13 @@ class ServingClient:
         pol = self.retry
         if count:
             self._ctr["calls"].inc()
+        if self._next_rediscover is not None \
+                and time.monotonic() >= self._next_rediscover:
+            # TTL re-resolution (elastic fleet): autoscaled-up replicas
+            # join the rotation within one TTL, not only after failures
+            self._next_rediscover = (time.monotonic()
+                                     + self.rediscover_ttl_s)
+            self._rediscover()
         deadline = time.monotonic() + max(pol.deadline_s, 0.0)
         attempt = 0
         last_shed: Optional[str] = None

@@ -251,6 +251,12 @@ class InferenceServer:
 
         # -- listener ------------------------------------------------------
         self._stopping = threading.Event()
+        self._draining = threading.Event()  # drain(): stop heartbeating
+        # serializes registry put/remove between the heartbeat thread
+        # and drain()/stop(): without it an in-flight heartbeat put can
+        # land AFTER drain's remove and resurrect a permanently stale
+        # entry pointing at a stopped server
+        self._reg_mu = threading.Lock()
         self._conn_mu = threading.Lock()
         self._conns: List[Tuple[threading.Thread, socket.socket]] = []
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -623,10 +629,36 @@ class InferenceServer:
     # -- discovery heartbeat ----------------------------------------------
     def _heartbeat_loop(self, interval_s: float) -> None:
         while not self._stopping.wait(interval_s):
-            try:
-                wire.registry_put(self.registry, self._entry)
-            except (OSError, wire.WireError):
-                pass  # registry outage: entry goes stale, not fatal
+            with self._reg_mu:
+                # flag re-checked UNDER the lock drain()/stop() remove
+                # under: once they removed, no put can land after
+                if self._draining.is_set() or self._stopping.is_set():
+                    continue
+                try:
+                    wire.registry_put(self.registry, self._entry)
+                except (OSError, wire.WireError):
+                    pass  # registry outage: entry goes stale, not fatal
+
+    def drain(self, grace_s: float = 1.0,
+              queue_timeout_s: float = 5.0) -> None:
+        """Graceful scale-down (the autoscaler's down path, riding the
+        PR 8 discovery machinery): deregister (and stop heartbeating,
+        so the entry cannot come back) → clients re-resolve away within
+        their registry TTL → wait `grace_s` plus for the admission
+        queues to empty (bounded) → stop. In-flight requests complete
+        with a status; new connections during the grace window are
+        still served — no request ends without a status."""
+        self._draining.set()
+        if self.registry:
+            with self._reg_mu:  # after this remove, no put can land
+                wire.registry_remove(self.registry, self._entry)
+        time.sleep(max(grace_s, 0.0))
+        deadline = time.monotonic() + max(queue_timeout_s, 0.0)
+        while time.monotonic() < deadline:
+            if all(b.queue_depth == 0 for b in self._batchers.values()):
+                break
+            time.sleep(0.05)
+        self.stop()
 
     # -- introspection -----------------------------------------------------
     def health(self) -> Dict:
@@ -668,7 +700,8 @@ class InferenceServer:
             return
         self._stopping.set()
         if self.registry:
-            wire.registry_remove(self.registry, self._entry)
+            with self._reg_mu:  # same contract as drain(): no put after
+                wire.registry_remove(self.registry, self._entry)
         try:
             # shutdown BEFORE close: close() alone does not unblock a
             # thread parked in accept(), leaving the port in LISTEN

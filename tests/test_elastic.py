@@ -1,0 +1,620 @@
+"""Elastic fleet (ISSUE 13): epoch-versioned ownership maps, live shard
+splits, and hot-partition rebalancing.
+
+The contracts pinned here:
+
+  * the ownership-map spec round-trips byte-identically between the
+    Python mirror and the native decoder, and registry publication is
+    last-epoch-wins;
+  * a request routed on a superseded map is REFUSED with an explicit
+    "stale ownership map" status (counted server-side) and the client
+    refreshes + retries to byte-identical answers — never a silent
+    misroute; a NEWER client against a not-yet-flipped surviving shard
+    is served (the one-sided check);
+  * a live 2→4 split — new shards bootstrapped from a peer's durable
+    state (clone_wal_dir) + anti-entropy catch-up, map flipped by epoch
+    bump under the PR 8 publish-first order — serves byte-identical
+    answers through a client that rebuilds its proxies mid-stream;
+  * graph_partition-mode deltas route through the map (the PR 9
+    hash-distribute-only carry-over);
+  * replica hedging (the PR 11 deferred item) races straggling reads
+    across a replicated partition's owners, counted, and never fires
+    without a covering alternative;
+  * a persisted ownership map survives crash-recovery: WAL replay
+    re-filters deltas under the SAME map the live path applied them
+    with (a replicated partition's rows never vanish on restart);
+  * the serving autoscaler grows 1→3 replicas on the shed rate and
+    drains back down through the registry, with zero
+    lost-without-status;
+  * SIGKILL mid-split (slow): a split shard killed during bootstrap
+    re-bootstraps from the same cloned durable state and rejoins at
+    the fleet epoch.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.graph import GraphBuilder, RemoteGraphEngine
+from euler_tpu.graph.elastic import (OwnershipMap, clone_wal_dir,
+                                     fetch_map, flip_fleet, hottest_shard,
+                                     publish_map)
+from euler_tpu.graph.remote import configure_rpc, rpc_transport_stats
+from euler_tpu.gql import push_ownership, start_registry, start_service
+
+pytestmark = pytest.mark.elastic
+
+P = 4
+
+
+@pytest.fixture(autouse=True)
+def _rpc_config_guard():
+    """Every test leaves the process-global transport config clean."""
+    yield
+    configure_rpc(mux=False, connections=1, compress_threshold=0,
+                  hedge_delay_ms=0, p2c=False, hedge_replicas=False)
+
+
+def _build_graph(n=80):
+    rng = np.random.default_rng(7)
+    b = GraphBuilder()
+    b.set_num_types(2, 2)
+    b.set_feature(0, 0, 3, "feat")
+    ids = np.arange(1, n + 1, dtype=np.uint64)
+    b.add_nodes(ids, types=(ids % 2).astype(np.int32),
+                weights=np.linspace(1, 2, n).astype(np.float32))
+    m = n * 4
+    b.add_edges(rng.integers(1, n + 1, m).astype(np.uint64),
+                rng.integers(1, n + 1, m).astype(np.uint64),
+                types=rng.integers(0, 2, m).astype(np.int32),
+                weights=(rng.random(m) + 0.1).astype(np.float32))
+    b.set_node_dense(ids, 0, rng.random((n, 3), dtype=np.float32))
+    return b.finalize(), ids
+
+
+def _dump(tmp_path, g):
+    data = str(tmp_path / "data")
+    g.dump(data, num_partitions=P)
+    return data
+
+
+def _start_fleet(tmp_path, data, shard_num, wal=True, start=None):
+    """Registry + in-process shards [start or range(shard_num)]."""
+    reg = start_registry()
+    spec = f"tcp:127.0.0.1:{reg.port}"
+    servers = {}
+    for i in (start if start is not None else range(shard_num)):
+        servers[i] = start_service(
+            data, i, shard_num, registry_dir=spec,
+            wal_dir=str(tmp_path / f"wal{i}") if wal else "")
+    return reg, spec, servers
+
+
+def _parity(engine, probe, ref):
+    got = engine.get_full_neighbor(probe, sorted_by_id=True)
+    for a, b in zip(ref, got):
+        assert np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# ownership-map spec + registry publication
+# ---------------------------------------------------------------------------
+
+def test_ownership_map_spec_roundtrip():
+    m = OwnershipMap.default(4, 2)
+    assert m.encode() == "e1-P4-0.1.0.1"
+    assert OwnershipMap.decode(m.encode()) == m
+    s = m.split(4)
+    assert s.map_epoch == 2 and s.owners == [[0], [1], [2], [3]]
+    r = s.add_replica(2, 0)
+    assert r.encode() == "e3-P4-0.1.2+0.3"
+    assert r.shard_num == 4
+    assert OwnershipMap.decode(r.encode()) == r
+    assert r.owner_of(6) == [2, 0]  # 6 % 4 == 2
+    with pytest.raises(ValueError):
+        OwnershipMap.decode("e0-P4-0.1.0.1")  # epoch 0 = "no map"
+    with pytest.raises(ValueError):
+        OwnershipMap.decode("e1-P4-0.1.0")  # owner-list count != P
+    with pytest.raises(ValueError):
+        s.split(2)  # splits never shrink
+
+
+def test_publish_fetch_last_epoch_wins(tmp_path):
+    reg = start_registry()
+    spec = f"tcp:127.0.0.1:{reg.port}"
+    try:
+        assert fetch_map(spec) is None
+        m1 = OwnershipMap.default(4, 2)
+        publish_map(spec, m1)
+        m2 = m1.split(4)
+        publish_map(spec, m2)
+        got = fetch_map(spec)
+        assert got == m2
+        # superseded entries are dropped at publish
+        from euler_tpu.serving import wire
+
+        names = [n for n in wire.registry_list(spec)
+                 if n.startswith("omap_")]
+        assert names == [f"omap_graph__{m2.encode()}"]
+    finally:
+        reg.stop()
+
+
+def test_native_decoder_parity(tmp_path):
+    """The native decoder accepts exactly the Python encoder's output —
+    pushed through a live server handle, the installed epoch matches,
+    and an OLDER map is refused."""
+    g, ids = _build_graph()
+    data = _dump(tmp_path, g)
+    s = start_service(data, 0, 1)
+    try:
+        m = OwnershipMap.default(P, 1).split(1).add_replica(2, 0)
+        s.set_ownership(m.encode())
+        assert s.map_epoch == m.map_epoch
+        with pytest.raises(Exception, match="refusing ownership map"):
+            s.set_ownership(OwnershipMap.default(P, 1).encode())
+        with pytest.raises(Exception, match="bad ownership spec"):
+            s.set_ownership("e9-P4-bogus")
+    finally:
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# stale-map shed + refresh/retry (zero silent misroutes)
+# ---------------------------------------------------------------------------
+
+def test_stale_map_shed_and_retry(tmp_path):
+    g, ids = _build_graph()
+    data = _dump(tmp_path, g)
+    reg, spec, servers = _start_fleet(tmp_path, data, 2, wal=False)
+    eng = None
+    try:
+        m1 = OwnershipMap.default(P, 2)
+        publish_map(spec, m1)
+        for s in servers.values():
+            s.set_ownership(m1.encode())
+        eng = RemoteGraphEngine(spec, seed=1, ownership_refresh_s=30.0)
+        assert eng.ownership_epoch() == 1
+        probe = ids[:16]
+        ref = eng.get_full_neighbor(probe, sorted_by_id=True)
+
+        # flip the fleet to a NEWER map while the client still routes
+        # on the old one (publish-first order)
+        m2 = OwnershipMap(map_epoch=2, partition_num=P,
+                          owners=[[0], [1], [0], [1]])
+        flip_fleet(spec, m2, [s.set_ownership for s in servers.values()])
+        s0 = rpc_transport_stats()
+        _parity(eng, probe, ref)  # refused → refresh → retried, same bytes
+        s1 = rpc_transport_stats()
+        h = eng.health()
+        shed = s1["stale_map_shed"] - s0["stale_map_shed"]
+        # one stale QUERY sheds one per-shard leg at each flipped shard
+        # (the split fans out), and retries once at the query level —
+        # every shed leg belongs to a counted, retried query
+        assert shed >= h["stale_map_retries"] >= 1
+        assert eng.ownership_epoch() == 2
+
+        # one-sided check: a CLIENT ahead of a surviving shard is
+        # served (flips only shrink surviving shards' owned sets)
+        m3 = OwnershipMap(map_epoch=3, partition_num=P,
+                          owners=[[0], [1], [0], [1]])
+        publish_map(spec, m3)
+        eng.refresh_ownership(force=True)
+        assert eng.ownership_epoch() == 3
+        s2 = rpc_transport_stats()
+        _parity(eng, probe, ref)  # servers still at e2: no shed
+        s3 = rpc_transport_stats()
+        assert s3["stale_map_shed"] == s2["stale_map_shed"]
+    finally:
+        if eng is not None:
+            eng.close()
+        for s in servers.values():
+            s.stop()
+        reg.stop()
+
+
+# ---------------------------------------------------------------------------
+# live split 2 → 4: durable bootstrap + flip, byte parity throughout
+# ---------------------------------------------------------------------------
+
+def test_live_split_byte_parity(tmp_path):
+    g, ids = _build_graph()
+    data = _dump(tmp_path, g)
+    reg, spec, servers = _start_fleet(tmp_path, data, 2)
+    eng = None
+    try:
+        m1 = OwnershipMap.default(P, 2)
+        publish_map(spec, m1)
+        for s in servers.values():
+            s.set_ownership(m1.encode())
+        eng = RemoteGraphEngine(spec, seed=1, ownership_refresh_s=30.0)
+        # a pre-split delta the bootstrap must carry (WAL clone +
+        # catch-up): elastic growth composes with streaming mutation
+        d_ids = np.array([100, 101], np.uint64)
+        epoch = eng.apply_delta(
+            node_ids=d_ids,
+            edge_src=np.array([100, 1], np.uint64),
+            edge_dst=np.array([2, 100], np.uint64),
+            edge_weights=np.array([1.5, 2.5], np.float32))
+        assert epoch == 1
+        probe = np.concatenate([ids[:32], d_ids]).astype(np.uint64)
+        ref = eng.get_full_neighbor(probe, sorted_by_id=True)
+        ref_feat = eng.get_dense_feature(ids[:32], "feat")
+
+        # bootstrap shards 2,3 from their split siblings' durable state
+        for i in (2, 3):
+            clone_wal_dir(str(tmp_path / f"wal{i - 2}"),
+                          str(tmp_path / f"wal{i}"))
+            assert not os.path.exists(tmp_path / f"wal{i}" / "OWNERSHIP")
+            servers[i] = start_service(
+                data, i, 4, registry_dir=spec,
+                wal_dir=str(tmp_path / f"wal{i}"))
+            # recovered from the clone at the fleet epoch (replay +
+            # registry catch-up): no client ever sees a regression
+            assert servers[i].epoch == epoch
+        m2 = m1.split(4)
+        for i in (2, 3):
+            servers[i].set_ownership(m2.encode())
+        flip_fleet(spec, m2,
+                   [servers[0].set_ownership, servers[1].set_ownership])
+
+        # the stale client's next read is refused, refreshed, and the
+        # PROXIES REBUILD against the grown fleet — byte parity holds
+        _parity(eng, probe, ref)
+        assert np.array_equal(eng.get_dense_feature(ids[:32], "feat"),
+                              ref_feat)
+        assert eng.query.shard_num() == 4
+        assert eng.ownership_epoch() == 2
+        # post-split deltas route by the map: a node in partition 0
+        # lands on (and only on) shard 0
+        e2 = eng.apply_delta(
+            node_ids=np.array([104], np.uint64),
+            edge_src=np.array([104], np.uint64),
+            edge_dst=np.array([1], np.uint64),
+            edge_weights=np.array([3.0], np.float32))
+        assert e2 == epoch + 1
+        nb = eng.get_full_neighbor(np.array([104], np.uint64))
+        assert nb[1].size == 1 and int(nb[1][0]) == 1
+    finally:
+        if eng is not None:
+            eng.close()
+        for s in servers.values():
+            s.stop()
+        reg.stop()
+
+
+# ---------------------------------------------------------------------------
+# graph_partition-mode deltas route through the map (PR 9 carry-over)
+# ---------------------------------------------------------------------------
+
+def test_gp_mode_delta_through_map(tmp_path):
+    g, ids = _build_graph()
+    data = _dump(tmp_path, g)
+    reg, spec, servers = _start_fleet(tmp_path, data, 2, wal=False)
+    eng = None
+    try:
+        m1 = OwnershipMap.default(P, 2)
+        publish_map(spec, m1)
+        for s in servers.values():
+            s.set_ownership(m1.encode())
+        eng = RemoteGraphEngine(spec, seed=1, mode="graph_partition",
+                                ownership_refresh_s=30.0)
+        # delta rows land on the MAP's owners; the gp broadcast then
+        # answers from whichever shard holds the row
+        new_id = np.array([102], np.uint64)  # 102 % 4 == 2 → shard 0
+        eng.apply_delta(node_ids=new_id, edge_src=new_id,
+                        edge_dst=np.array([3], np.uint64),
+                        edge_weights=np.array([2.0], np.float32))
+        off, nbr, w, t = eng.get_full_neighbor(new_id)
+        assert nbr.size == 1 and int(nbr[0]) == 3
+        # and the owning shard is the map's say: flip p2 to shard 1,
+        # apply another delta — the row must land on shard 1 and ONLY
+        # shard 1 (probed per shard: a gp shard answers an empty row
+        # for ids it does not hold)
+        m2 = OwnershipMap(map_epoch=2, partition_num=P,
+                          owners=[[0], [1], [1], [1]])
+        # shard 1's owned set GROWS (it gains p2): grow pushes flip
+        # BEFORE the registry publish (the flip_fleet order contract)
+        flip_fleet(spec, m2, [servers[0].set_ownership],
+                   grow_push_fns=[servers[1].set_ownership])
+        eng.refresh_ownership(force=True)
+        new2 = np.array([106], np.uint64)  # 106 % 4 == 2 → now shard 1
+        eng.apply_delta(node_ids=new2, edge_src=new2,
+                        edge_dst=np.array([5], np.uint64),
+                        edge_weights=np.array([2.0], np.float32))
+        per_shard = []
+        for i in (0, 1):
+            probe_eng = RemoteGraphEngine(
+                f"hosts:127.0.0.1:{servers[i].port}", seed=1,
+                mode="graph_partition")
+            off, nbr, w, t = probe_eng.get_full_neighbor(new2)
+            per_shard.append(int(nbr.size))
+            probe_eng.close()
+        assert per_shard == [0, 1]  # hash owner 0 skipped it; map owner
+        # 1 applied it — routed through the map, not the modulus
+    finally:
+        if eng is not None:
+            eng.close()
+        for s in servers.values():
+            try:
+                s.stop()
+            except Exception:
+                pass
+        reg.stop()
+
+
+# ---------------------------------------------------------------------------
+# replica hedging across owners (the PR 11 deferred item)
+# ---------------------------------------------------------------------------
+
+def test_replica_hedge_across_owners(tmp_path):
+    g, ids = _build_graph()
+    data = _dump(tmp_path, g)
+    reg, spec, servers = _start_fleet(tmp_path, data, 2, wal=False)
+    eng = None
+    try:
+        m1 = OwnershipMap.default(P, 2)
+        publish_map(spec, m1)
+        for s in servers.values():
+            s.set_ownership(m1.encode())
+        configure_rpc(connections=2)
+        eng = RemoteGraphEngine(spec, seed=1, ownership_refresh_s=30.0)
+        probe = ids[ids % P == 2][:12]  # partition-2 reads
+        ref = eng.get_full_neighbor(probe, sorted_by_id=True)
+
+        # single-owner partitions: hedging configured but NO covering
+        # alternative exists — zero replica hedges fire
+        configure_rpc(hedge_delay_ms=0.01, hedge_replicas=True)
+        s0 = rpc_transport_stats()
+        _parity(eng, probe, ref)
+        s1 = rpc_transport_stats()
+        assert s1["replica_hedge_fired"] == s0["replica_hedge_fired"]
+
+        # replicate p2 onto BOTH hash owners: shard 1 already holds its
+        # hash partitions {1,3} and shard 0 {0,2} — owners [0, 1] for
+        # p2 needs shard 1 to hold p2 rows, which it does NOT; use the
+        # map p0 → {0}, p2 → {0} replicated... instead give shard 0's
+        # partitions a second owner that genuinely holds them: with 2
+        # hash shards only the SAME data layout qualifies, so start a
+        # third server over shard 0's exact slice (idx 0 of 2) as
+        # fleet shard 2.
+        servers[2] = start_service(data, 0, 2, registry_dir="",
+                                   wal_dir="")
+        # register it manually as shard 2 (same rows as shard 0)
+        from euler_tpu.serving import wire
+
+        name = f"shard_2__127.0.0.1_{servers[2].port}"
+        wire.registry_put(spec, name)
+        m2 = OwnershipMap(map_epoch=2, partition_num=P,
+                          owners=[[0], [1], [0, 2], [1]])
+        for s in servers.values():
+            s.set_ownership(m2.encode())
+        publish_map(spec, m2)
+        eng.refresh_ownership(force=True)
+        assert eng.query.shard_num() == 3
+        # with a covering alternative (shard 0 ⊇ shard 2's partitions?
+        # shard 2 owns {p2} and shard 0 owns {p0, p2} ⊇ it) hedges can
+        # fire both ways for p2 batches routed to shard 2
+        s2 = rpc_transport_stats()
+        for _ in range(24):
+            _parity(eng, probe, ref)
+        s3 = rpc_transport_stats()
+        fired = s3["replica_hedge_fired"] - s2["replica_hedge_fired"]
+        wasted = s3["replica_hedge_wasted"] - s2["replica_hedge_wasted"]
+        won = s3["replica_hedge_won"] - s2["replica_hedge_won"]
+        assert fired >= 1  # 0.01ms delay: straggle threshold always hit
+        assert won <= fired and wasted <= fired
+    finally:
+        configure_rpc(hedge_delay_ms=0, hedge_replicas=False)
+        if eng is not None:
+            eng.close()
+        for s in servers.values():
+            s.stop()
+        reg.stop()
+
+
+# ---------------------------------------------------------------------------
+# persisted ownership survives crash recovery (WAL replay under the map)
+# ---------------------------------------------------------------------------
+
+def test_wal_ownership_persistence_recovery(tmp_path):
+    g, ids = _build_graph()
+    data = _dump(tmp_path, g)
+    wal = str(tmp_path / "wal0")
+    # single shard owning EVERYTHING via an explicit replica map — the
+    # hash convention for (idx 0, num 1) would also own everything, so
+    # make the map matter: shard 0 of a DECLARED 2-fleet, owning all 4
+    # partitions by map (hash replay would drop p1/p3 rows)
+    s = start_service(data, 0, 2, wal_dir=wal)
+    try:
+        m = OwnershipMap(map_epoch=5, partition_num=P,
+                         owners=[[0], [0, 1], [0], [0, 1]])
+        s.set_ownership(m.encode())
+        assert os.path.exists(os.path.join(wal, "OWNERSHIP"))
+        q_ids = np.array([101, 103], np.uint64)  # partitions 1 and 3
+        from euler_tpu.gql import Query
+
+        q = Query.remote(f"hosts:127.0.0.1:{s.port}", seed=1)
+        q.apply_delta(node_ids=q_ids, edge_src=q_ids,
+                      edge_dst=np.array([1, 2], np.uint64),
+                      edge_weights=np.array([1.0, 2.0], np.float32))
+        q.close()
+        s.stop()
+        # restart: replay must re-apply the p1/p3 rows under the
+        # PERSISTED map (hash (0 of 2) would filter them out) and the
+        # map epoch must be re-installed
+        s2 = start_service(data, 0, 2, wal_dir=wal)
+        try:
+            assert s2.map_epoch == 5
+            assert s2.epoch == 1
+            q = Query.remote(f"hosts:127.0.0.1:{s2.port}", seed=1)
+            out = q.run("v(r).getSortedNB(*).as(nb)", {"r": q_ids})
+            assert out["nb:1"].size == 2  # both mapped rows replayed
+            q.close()
+        finally:
+            s2.stop()
+    except Exception:
+        s.stop()
+        raise
+
+
+# ---------------------------------------------------------------------------
+# serving autoscaler: 1 → 3 on shed rate, drained back down
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_shed_up_drain_down(tmp_path):
+    from euler_tpu.serving import (InferenceServer, ModelBundle,
+                                   ServingAutoscaler, ServingClient)
+
+    rng = np.random.default_rng(0)
+    emb = rng.normal(size=(120, 8)).astype(np.float32)
+    bids = (np.arange(120, dtype=np.uint64) * 3 + 1)
+    bdir = ModelBundle({}, emb, bids).save(str(tmp_path / "bundle"))
+    reg = start_registry()
+    spec = f"tcp:127.0.0.1:{reg.port}"
+    kw = dict(max_batch=16, flush_ms=1.0, max_queue=32,
+              inject_apply_latency_ms=5.0)
+    scaler = ServingAutoscaler(bdir, spec, service="auto", shard=0,
+                               min_replicas=1, max_replicas=3,
+                               shed_rate_up=0.01, server_kwargs=kw)
+    cli = None
+    try:
+        scaler.adopt(InferenceServer(bdir, registry=spec, service="auto",
+                                     shard=0, replica=0, **kw))
+        cli = ServingClient(registry=spec, service="auto",
+                            rediscover_ttl_s=0.2)
+        stop = threading.Event()
+
+        def load():
+            while not stop.is_set():
+                cli.embed(bids[:64])  # sheds retried inside the client
+
+        threads = [threading.Thread(target=load, daemon=True)
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+        try:
+            deadline = time.monotonic() + 20.0
+            actions = []
+            while (scaler.replica_count() < 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.4)
+                a = scaler.step()
+                if a:
+                    actions.append(a)
+            assert scaler.replica_count() == 3, actions
+            assert actions.count("up") == 2
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(2)
+        # calm traffic: scale back down through the graceful drain
+        time.sleep(0.3)
+        scaler.observe()  # close the loaded window
+        scaler.calm_windows_down = 1
+        assert scaler.step() == "down"
+        assert scaler.replica_count() == 2
+        # the fleet still serves correctly after the drain
+        out = cli.embed(bids[:8])
+        assert np.allclose(out, emb[:8], atol=1e-5)
+        h = cli.health()
+        assert h["calls"] > 0
+    finally:
+        if cli is not None:
+            cli.close()
+        scaler.close()
+        reg.stop()
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid-split rejoin (slow chaos drill)
+# ---------------------------------------------------------------------------
+
+_CHILD_SPLIT_SHARD = r"""
+import sys, time
+data, reg, wal = sys.argv[1], sys.argv[2], sys.argv[3]
+from euler_tpu.gql import start_service
+s = start_service(data, shard_idx=2, shard_num=4, port=0,
+                  registry_dir=reg, wal_dir=wal, wal_fsync="never")
+print("READY", s.port, s.epoch, flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigkill_mid_split_rejoin(tmp_path):
+    """SIGKILL the bootstrapping split shard, re-run the bootstrap over
+    the SAME cloned durable state, and the split completes: the shard
+    rejoins at the fleet epoch, the flip lands, answers byte-identical,
+    zero stale reads."""
+    g, ids = _build_graph()
+    data = _dump(tmp_path, g)
+    reg, spec, servers = _start_fleet(tmp_path, data, 2)
+    eng = None
+    child = None
+    try:
+        m1 = OwnershipMap.default(P, 2)
+        publish_map(spec, m1)
+        for s in servers.values():
+            s.set_ownership(m1.encode())
+        eng = RemoteGraphEngine(spec, seed=1, ownership_refresh_s=30.0)
+        epoch = eng.apply_delta(
+            node_ids=np.array([100], np.uint64),
+            edge_src=np.array([100], np.uint64),
+            edge_dst=np.array([2], np.uint64),
+            edge_weights=np.array([1.5], np.float32))
+        probe = np.concatenate([ids[:32], [100]]).astype(np.uint64)
+        ref = eng.get_full_neighbor(probe, sorted_by_id=True)
+
+        wal2 = str(tmp_path / "wal2")
+        clone_wal_dir(str(tmp_path / "wal0"), wal2)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        child = subprocess.Popen(
+            [sys.executable, "-c", _CHILD_SPLIT_SHARD, data, spec, wal2],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=env)
+        line = child.stdout.readline().strip()
+        assert line.startswith("READY")
+        # SIGKILL mid-split: the shard is up but the flip has NOT
+        # happened — no clean shutdown, wal2 keeps whatever it has
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait()
+        # re-run the bootstrap over the same durable state (wal2 is
+        # non-empty now: RecoverShard replays it like any crash)
+        child = subprocess.Popen(
+            [sys.executable, "-c", _CHILD_SPLIT_SHARD, data, spec, wal2],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=env)
+        line = child.stdout.readline().strip()
+        assert line.startswith("READY")
+        _, port2, child_epoch = line.split()
+        assert int(child_epoch) == epoch  # rejoined at the fleet epoch
+        # shard 3 (in-process) + flip
+        clone_wal_dir(str(tmp_path / "wal1"), str(tmp_path / "wal3"))
+        servers[3] = start_service(data, 3, 4, registry_dir=spec,
+                                   wal_dir=str(tmp_path / "wal3"))
+        m2 = m1.split(4)
+        push_ownership("127.0.0.1", int(port2), m2.encode())
+        servers[3].set_ownership(m2.encode())
+        flip_fleet(spec, m2,
+                   [servers[0].set_ownership, servers[1].set_ownership])
+        _parity(eng, probe, ref)  # zero stale reads through the drill
+        assert eng.query.shard_num() == 4
+        assert eng.health()["stale_map_retries"] >= 1
+    finally:
+        if child is not None:
+            child.kill()
+            child.wait()
+        if eng is not None:
+            eng.close()
+        for s in servers.values():
+            s.stop()
+        reg.stop()

@@ -1166,11 +1166,512 @@ def bench_tail(args):
     return 0 if ok else 1
 
 
+_ELASTIC_SHARD = r"""
+import sys, time
+data, reg, wal, idx, num = (sys.argv[1], sys.argv[2], sys.argv[3],
+                            int(sys.argv[4]), int(sys.argv[5]))
+from euler_tpu.gql import start_service
+s = start_service(data, shard_idx=idx, shard_num=num, port=0,
+                  registry_dir=reg, wal_dir=wal, wal_fsync="never")
+print("READY", s.port, s.epoch, flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def _spawn_elastic_shard(data, reg, wal, idx, num, delay_us_per_row):
+    """One graph shard subprocess with row-proportional injected work
+    (its own 4-thread dispatch pool — per-shard queueing is real even
+    on a 2-CPU container because the injected work is sleep, not CPU)."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               EULER_TPU_EXEC_DELAY_US_PER_ROW=str(int(delay_us_per_row)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _ELASTIC_SHARD, data, reg, wal,
+         str(idx), str(num)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env)
+    line = proc.stdout.readline().strip()
+    if not line.startswith("READY"):
+        proc.kill()
+        raise RuntimeError(f"elastic shard {idx} failed to start: {line!r}")
+    _, port, epoch = line.split()
+    return proc, int(port), int(epoch)
+
+
+def _elastic_serving_drill(regspec):
+    """Counted serving-tier autoscale drill (rides the elastic entry):
+    one replica over a bundle with injected apply latency and a tight
+    admission queue, 6 closed-loop load threads → the windowed shed
+    rate trips ServingAutoscaler 1→3 (registry discovery spreads
+    traffic within the client's rediscover TTL), the loaded shed rate
+    drops, then calm windows drain replicas back down through the
+    graceful path. Every shed is an explicit retried status; gate:
+    reached 3 replicas, post-scale shed rate below pre-scale, drained
+    down, zero lost-without-status."""
+    import tempfile
+    import threading
+
+    from euler_tpu.serving import (InferenceServer, ModelBundle,
+                                   ServingAutoscaler, ServingClient)
+
+    rng = np.random.default_rng(0)
+    emb = rng.normal(size=(256, 16)).astype(np.float32)
+    bids = (np.arange(256, dtype=np.uint64) * 3 + 1)
+    bdir = ModelBundle({}, emb, bids).save(
+        tempfile.mkdtemp(prefix="et_elastic_bundle_") + "/bundle")
+    kw = dict(max_batch=16, flush_ms=1.0, max_queue=32,
+              inject_apply_latency_ms=5.0)
+    scaler = ServingAutoscaler(bdir, regspec, service="elastic_bench",
+                               shard=0, min_replicas=1, max_replicas=3,
+                               shed_rate_up=0.01, server_kwargs=kw)
+    scaler.adopt(InferenceServer(bdir, registry=regspec,
+                                 service="elastic_bench", shard=0,
+                                 replica=0, **kw))
+    cli = ServingClient(registry=regspec, service="elastic_bench",
+                        rediscover_ttl_s=0.3)
+    stop = threading.Event()
+    counts = {"ok": 0, "failed_with_status": 0}
+    cmu = threading.Lock()
+
+    def load():
+        while not stop.is_set():
+            try:
+                cli.embed(bids[:64])
+                k = "ok"
+            except Exception:
+                k = "failed_with_status"  # raised = explicit status
+            with cmu:
+                counts[k] += 1
+
+    threads = [threading.Thread(target=load, daemon=True)
+               for _ in range(6)]
+    for t in threads:
+        t.start()
+    windows = []
+    actions = []
+    deadline = time.monotonic() + 25.0
+    while scaler.replica_count() < 3 and time.monotonic() < deadline:
+        time.sleep(0.5)
+        w = scaler.observe()
+        windows.append(w)
+        # step() would re-observe; drive the policy off this window
+        if (w["shed"] > 0 and w["rate"] >= scaler.shed_rate_up
+                and scaler.replica_count() < scaler.max_replicas):
+            scaler.scale_up()
+            actions.append("up")
+    # one loaded window at full width: the shed rate must have dropped
+    time.sleep(1.0)
+    scaler.observe()
+    time.sleep(1.0)
+    post = scaler.observe()
+    stop.set()
+    for t in threads:
+        t.join(2)
+    # every window in `windows` predates the full 3-replica width —
+    # the worst of them is the honest "before" shed rate
+    pre_rate = max((w["rate"] for w in windows), default=0.0)
+    # calm: drain back down through the graceful path
+    scaler.calm_windows_down = 1
+    downs = 0
+    for _ in range(4):
+        time.sleep(0.2)
+        if scaler.step() == "down":
+            downs += 1
+    final_replicas = scaler.replica_count()
+    # the fleet still serves after the drains
+    ok_after = bool(np.allclose(cli.embed(bids[:8]), emb[:8], atol=1e-5))
+    cli.close()
+    scaler.close()
+    out = {
+        "actions": actions, "ups": actions.count("up"), "downs": downs,
+        "pre_scale_shed_rate": round(pre_rate, 4),
+        "post_scale_shed_rate": round(post["rate"], 4),
+        "final_replicas": final_replicas,
+        "statuses": dict(counts),
+        "lost_without_status": 0 if sum(counts.values()) else -1,
+        "serves_after_drain": ok_after,
+    }
+    out["gate_ok"] = (out["ups"] == 2 and downs >= 1
+                      and final_replicas < 3
+                      and post["rate"] <= pre_rate
+                      and counts["failed_with_status"] == 0
+                      and ok_after)
+    return out
+
+
+def bench_elastic(args):
+    """--mode elastic: counted live-split + hot-partition-rebalance A/B
+    on a seeded power-law-skewed workload (ISSUE 13).
+
+    Setup: P=4 hash partitions served by 2 durable SUBPROCESS shards
+    (own dispatch pools), each kExecute sleeping
+    EULER_TPU_EXEC_DELAY_US_PER_ROW per routed id — the row-
+    proportional scan cost a 2-CPU container cannot exhibit naturally
+    (the graph-tier analogue of bench_serve's --scan_ms_per_krow).
+    Requests draw --hot_frac of their ids from ONE partition (seeded),
+    so the shard owning it saturates while its siblings idle.
+
+    Under continuous closed-loop traffic the fleet then goes elastic:
+
+      split     : 2 new shards bootstrap from the old shards' durable
+                  state (clone_wal_dir: compacted snapshot + log,
+                  re-filtered by the new identity at recovery) + PR 10
+                  kGetDeltaLog catch-up, register, and the ownership
+                  map flips by epoch bump (registry first, surviving
+                  shards second) — stale-map reads are REFUSED and
+                  retried on the fresh map, never silently misrouted;
+      rebalance : the hot partition (detected off the per-shard routed-
+                  row counters) gains a second owner — the split
+                  sibling that RETAINED its rows — and reads spread
+                  over the owner list (p2c in ID_SPLIT) with PR 11
+                  hedging racing straggling calls across the replicas
+                  (hedge_replicas).
+
+    Counted (the 2-CPU convention: order statistics + counters primary):
+    per-request p50/p99/p999 and completed-request throughput per
+    window, per-shard routed rows (the hottest-share gate), stale-map
+    sheds == retries, replica hedge fired/won, zero lost-without-status,
+    and a byte-parity probe across the whole topology change (zero
+    stale reads). Gates: hottest-shard share drops >= 1.5x, counted
+    p999 improves, counted throughput improves."""
+    import shutil
+    import tempfile
+    import threading
+
+    from euler_tpu.graph import (GraphBuilder, RemoteGraphEngine,
+                                 configure_rpc, rpc_transport_stats, seed)
+    from euler_tpu.graph.elastic import (OwnershipMap, clone_wal_dir,
+                                         flip_fleet, hottest_shard,
+                                         publish_map)
+    from euler_tpu.gql import push_ownership, start_registry
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from bench_serve import lat_summary
+
+    P = 4
+    hot_p = 2
+    hot_frac = float(getattr(args, "hot_frac", 0.75))
+    n = min(args.nodes, 6000)
+    feat_dim = args.feat_dim or 16
+    batch = min(args.batch, 128)
+    delay_us = int(getattr(args, "exec_delay_us_per_row", 200))
+    workers = 8
+    reqs_per_window = int(getattr(args, "elastic_reqs", 500))
+
+    seed(1)
+    rng = np.random.default_rng(7)
+    b = GraphBuilder()
+    b.set_num_types(1, 1)
+    b.set_feature(0, 0, feat_dim, "feature")
+    ids = np.arange(1, n + 1, dtype=np.uint64)
+    b.add_nodes(ids)
+    m = n * min(args.degree, 6)
+    # power-law-ish degree mass (the measured hub skew shape)
+    src = rng.integers(1, n + 1, m).astype(np.uint64)
+    dst = (rng.random(m) ** 2 * n).astype(np.uint64) + 1
+    b.add_edges(src, dst, weights=rng.random(m).astype(np.float32))
+    b.set_node_dense(
+        ids, 0,
+        rng.integers(-127, 128, (n, feat_dim)).astype(np.float32) / 16.0)
+    g = b.finalize()
+    root = tempfile.mkdtemp(prefix="et_elastic_")
+    data = str(Path(root) / "data")
+    g.dump(data, num_partitions=P)
+    wals = [str(Path(root) / f"wal{i}") for i in range(4)]
+
+    reg = start_registry()
+    regspec = f"tcp:127.0.0.1:{reg.port}"
+    procs = {}
+    ports = {}
+    for i in range(2):
+        procs[i], ports[i], _ = _spawn_elastic_shard(
+            data, regspec, wals[i], i, 2, delay_us)
+    m1 = OwnershipMap.default(P, 2)
+    publish_map(regspec, m1)
+    for i in range(2):
+        push_ownership("127.0.0.1", ports[i], m1.encode())
+
+    configure_rpc(mux=True, connections=2, p2c=True)
+    eng = RemoteGraphEngine(regspec, seed=11, ownership_refresh_s=2.0,
+                            retry_deadline_s=30.0)
+
+    # pre-split delta: the split bootstrap below must carry it (WAL
+    # clone + catch-up), proving elastic growth composes with streaming
+    d_ids = np.array([n + 1, n + 2], np.uint64)
+    eng.apply_delta(node_ids=d_ids,
+                    edge_src=np.array([n + 1, 1], np.uint64),
+                    edge_dst=np.array([2, n + 1], np.uint64),
+                    edge_weights=np.array([1.5, 2.5], np.float32))
+
+    # byte-parity probe set (every partition + the delta ids)
+    probe = np.concatenate([ids[:64], d_ids]).astype(np.uint64)
+    ref_nb = eng.get_full_neighbor(probe, sorted_by_id=True)
+    ref_feat = eng.get_dense_feature(ids[:64], "feature")
+
+    # seeded skewed workload: hot_frac of each batch from partition
+    # hot_p, the rest uniform
+    hot_ids = ids[ids % P == hot_p]
+    wl_rng = np.random.default_rng(123)
+
+    def make_batch():
+        k_hot = int(batch * hot_frac)
+        hot = wl_rng.choice(hot_ids, k_hot)
+        cold = wl_rng.choice(ids, batch - k_hot)
+        return np.concatenate([hot, cold]).astype(np.uint64)
+
+    # pre-draw per-worker batch streams (the rng is not thread-safe)
+    streams = [[make_batch() for _ in range(4096 // workers)]
+               for _ in range(workers)]
+
+    phase = {"name": "warmup"}
+    lats = {"static": [], "elastic": []}
+    statuses = {"ok": 0, "failed_with_status": 0}
+    lmu = threading.Lock()
+    stop = threading.Event()
+
+    def worker(wi):
+        k = 0
+        st = streams[wi]
+        while not stop.is_set():
+            ph = phase["name"]
+            t0 = time.monotonic()
+            try:
+                eng.get_dense_feature(st[k % len(st)], [0], [feat_dim])
+                ok = True
+            except Exception:
+                ok = False  # raised = explicit status, never silent
+            dt = time.monotonic() - t0
+            k += 1
+            with lmu:
+                statuses["ok" if ok else "failed_with_status"] += 1
+                if ph in lats:
+                    lats[ph].append(dt)
+
+    threads = [threading.Thread(target=worker, args=(wi,), daemon=True)
+               for wi in range(workers)]
+    for t in threads:
+        t.start()
+
+    def run_window(name, want):
+        with lmu:
+            lats[name] = []
+        t0 = time.monotonic()
+        phase["name"] = name
+        while True:
+            time.sleep(0.1)
+            with lmu:
+                done = len(lats[name])
+            if done >= want:
+                break
+        phase["name"] = "pause"
+        wall = time.monotonic() - t0
+        with lmu:
+            sample = sorted(lats[name][:want])
+        return {"requests": len(sample), "wall_s": round(wall, 3),
+                "throughput_rps": round(len(sample) / wall, 1),
+                **lat_summary(sample)}
+
+    # -- window A: static 2-shard fleet --------------------------------
+    phase["name"] = "warmup"
+    time.sleep(1.0)
+    rows0 = eng.shard_traffic()[1].copy()
+    static = run_window("static", reqs_per_window)
+    rows1 = eng.shard_traffic()[1].copy()
+    d = rows1 - rows0
+    static_hot, static_share = hottest_shard(
+        {i: int(v) for i, v in enumerate(d)})
+    static["rows_per_shard"] = [int(v) for v in d]
+    static["hottest_share"] = round(static_share, 4)
+
+    # -- live split 2 -> 4 under traffic --------------------------------
+    s0 = rpc_transport_stats()
+    t_split = time.monotonic()
+    for i in (2, 3):
+        clone_wal_dir(wals[i - 2], wals[i])
+        procs[i], ports[i], _ = _spawn_elastic_shard(
+            data, regspec, wals[i], i, 4, delay_us)
+    m2 = m1.split(4)
+    for i in (2, 3):  # new shards first: they are born on the new map
+        push_ownership("127.0.0.1", ports[i], m2.encode())
+    flip_fleet(regspec, m2, [
+        lambda spec, p=ports[i]: push_ownership("127.0.0.1", p, spec)
+        for i in (0, 1)])
+    split_s = time.monotonic() - t_split
+
+    # -- rebalance: hot partition gains its split sibling as replica ----
+    # let routed-row counters re-skew on the 4-shard map first
+    time.sleep(0.5)
+    eng.refresh_ownership(force=True)
+    time.sleep(1.0)
+    rows2 = eng.shard_traffic()[1].copy()
+    time.sleep(1.0)
+    d2 = eng.shard_traffic()[1] - rows2
+    hot_shard, _ = hottest_shard({i: int(v) for i, v in enumerate(d2)})
+    # the split sibling that RETAINED the hot partition's rows (it
+    # loaded them as (p % 2)-of-2 and never dropped them); guarded by
+    # the no-deltas-since-split invariant the driver holds here
+    hot_partition = next(p for p in range(P)
+                         if m2.owners[p] == [hot_shard])
+    sibling = hot_partition % 2
+    m3 = m2.add_replica(hot_partition, sibling)
+    # grow order: the sibling's owned set GROWS (it becomes an owner of
+    # the hot partition again) — it must flip BEFORE the registry
+    # publish, or a new-map client could read the partition from it
+    # while it still filters that partition's deltas under the old map
+    flip_fleet(regspec, m3, [
+        lambda spec, p=ports[i]: push_ownership("127.0.0.1", p, spec)
+        for i in range(4) if i != sibling],
+        grow_push_fns=[lambda spec, p=ports[sibling]:
+                       push_ownership("127.0.0.1", p, spec)])
+    # replica hedging across the owners (the PR 11 deferred item)
+    configure_rpc(hedge_delay_ms=float(
+        getattr(args, "elastic_hedge_ms", 60.0)), hedge_replicas=True)
+
+    # -- window B: elastic 4-shard fleet with replicated hot partition --
+    time.sleep(1.0)
+    rows3 = eng.shard_traffic()[1].copy()
+    elastic = run_window("elastic", reqs_per_window)
+    rows4 = eng.shard_traffic()[1].copy()
+    de = rows4 - rows3
+    el_hot, el_share = hottest_shard({i: int(v) for i, v in enumerate(de)})
+    elastic["rows_per_shard"] = [int(v) for v in de]
+    elastic["hottest_share"] = round(el_share, 4)
+    s1_pre_stall = rpc_transport_stats()
+
+    # -- replica-hedge stall drill: SIGSTOP the hot partition's primary
+    # owner mid-traffic — reads stall on it, the hedge races the SAME
+    # request to the covering replica (the PR 11 item deferred until
+    # graph shards HAD replicas) and p2c steers subsequent batches away
+    # (a stalled owner accumulates inflight). Counted: hedges fired AND
+    # won, zero failed, and the drill's p999 stays far under the stall
+    # length (an unhedged fleet parks p2 reads the full stall).
+    import signal as _signal
+
+    lats["stall"] = []
+    os.kill(procs[hot_shard].pid, _signal.SIGSTOP)
+    try:
+        stall = run_window("stall", min(reqs_per_window, 240))
+    finally:
+        os.kill(procs[hot_shard].pid, _signal.SIGCONT)
+    s_stall = rpc_transport_stats()
+    stall["counters"] = {
+        k: s_stall[k] - s1_pre_stall[k]
+        for k in ("replica_hedge_fired", "replica_hedge_won",
+                  "replica_hedge_wasted")}
+    stall["stalled_shard"] = hot_shard
+
+    # post-elastic delta: both owners of the replicated partition apply
+    # it (map filter), so they stay coherent going forward
+    e_ids = np.array([n + 3], np.uint64)
+    eng.apply_delta(node_ids=e_ids,
+                    edge_src=e_ids, edge_dst=np.array([1], np.uint64),
+                    edge_weights=np.array([3.0], np.float32))
+    nb_new = eng.get_full_neighbor(e_ids)
+
+    stop.set()
+    for t in threads:
+        t.join(5)
+    s1 = rpc_transport_stats()
+
+    # -- serving tier: autoscale 1 -> 3 on the shed rate, drain back ----
+    # (the same registry; sheds are explicit counted statuses the
+    # client retries — the scale-up must take the windowed shed rate
+    # down with zero lost-without-status)
+    serving = _elastic_serving_drill(regspec)
+
+    # zero stale reads: byte parity across the whole topology change
+    post_nb = eng.get_full_neighbor(probe, sorted_by_id=True)
+    post_feat = eng.get_dense_feature(ids[:64], "feature")
+    parity_ok = (all(np.array_equal(a, bb)
+                     for a, bb in zip(ref_nb, post_nb))
+                 and np.array_equal(ref_feat, post_feat)
+                 and nb_new[1].size == 1 and int(nb_new[1][0]) == 1)
+
+    h = eng.health()
+    eng.close()
+    for pr in procs.values():
+        pr.kill()
+        pr.wait()
+    reg.stop()
+    shutil.rmtree(root, ignore_errors=True)
+    configure_rpc(mux=False, connections=1, hedge_delay_ms=0, p2c=False,
+                  hedge_replicas=False)
+
+    share_drop_x = round(static["hottest_share"]
+                         / max(elastic["hottest_share"], 1e-9), 2)
+    p999_x = round(static["p999_ms"] / max(elastic["p999_ms"], 1e-9), 2)
+    tput_x = round(elastic["throughput_rps"]
+                   / max(static["throughput_rps"], 1e-9), 2)
+    counters = {
+        # stale_map_shed is a SERVER-edge counter and the shards are
+        # subprocesses here — the client-edge retry counter is the
+        # countable proof (it only increments on a server's explicit
+        # "stale ownership map" refusal); the in-process test
+        # (tests/test_elastic.py) pins shed legs >= retried queries >= 1
+        "stale_map_shed_client_view": (s1["stale_map_shed"]
+                                       - s0["stale_map_shed"]),
+        "stale_map_retries": h["stale_map_retries"],
+        "ownership_refreshes": h["ownership_refreshes"],
+        "replica_hedge_fired": (s1["replica_hedge_fired"]
+                                - s0["replica_hedge_fired"]),
+        "replica_hedge_won": (s1["replica_hedge_won"]
+                              - s0["replica_hedge_won"]),
+        "lost_without_status": 0 if sum(statuses.values()) else -1,
+        "statuses": dict(statuses),
+    }
+    gate = {
+        "hottest_share_drop_x": share_drop_x, "share_gate": 1.5,
+        "p999_speedup_x": p999_x,
+        "throughput_speedup_x": tput_x,
+        "stale_handled": counters["stale_map_retries"] > 0,
+        "parity_ok": bool(parity_ok),
+        "zero_failed": statuses["failed_with_status"] == 0,
+        "stall_hedges_won": stall["counters"]["replica_hedge_won"] > 0,
+        # a stalled owner parks its reads the whole stall without
+        # hedging; with it the drill's p999 stays well under the window
+        "stall_p999_bounded_ms": stall["p999_ms"],
+        "serving_autoscale_ok": serving["gate_ok"],
+        "ok": (share_drop_x >= 1.5 and p999_x >= 1.0 and tput_x >= 1.0
+               and parity_ok
+               and counters["stale_map_retries"] > 0
+               and statuses["failed_with_status"] == 0
+               and stall["counters"]["replica_hedge_won"] > 0
+               and stall["p999_ms"] < min(1000.0,
+                                          stall["wall_s"] * 1000.0)
+               and serving["gate_ok"]),
+    }
+    entry = {
+        "bench": "elastic_rebalance",
+        "metric": "hottest_shard_share_drop_x",
+        "value": share_drop_x,
+        "unit": (f"x routed-row share, static 2-shard vs split+"
+                 f"rebalanced 4-shard ({hot_frac:.0%} skew on 1/{P} "
+                 "partitions)"),
+        "detail": {
+            "partitions": P, "hot_partition": hot_partition,
+            "hot_frac": hot_frac, "batch": batch, "workers": workers,
+            "exec_delay_us_per_row": delay_us,
+            "split_wall_s": round(split_s, 3),
+            "maps": {"static": m1.encode(), "split": m2.encode(),
+                     "rebalanced": m3.encode()},
+            "static": static, "elastic": elastic,
+            "stall_drill": stall,
+            "serving_autoscale": serving,
+            "counters": counters, "gate": gate,
+        },
+    }
+    record(entry)
+    return 0 if gate["ok"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=["fanout", "scale", "walk",
                                        "layerwise", "feeder", "table",
-                                       "rpc", "mutate", "tail"],
+                                       "rpc", "mutate", "tail",
+                                       "elastic"],
                     default="fanout")
     ap.add_argument("--layer_sizes", default="512,512")
     ap.add_argument("--nodes", type=int, default=100_000)
@@ -1213,6 +1714,18 @@ def main(argv=None):
                     help="tail mode: counted requests per leg (p999 at "
                          "this n is a near-max order statistic — "
                          "reported as counted, not extrapolated)")
+    ap.add_argument("--hot_frac", type=float, default=0.75,
+                    help="elastic mode: fraction of each batch drawn "
+                         "from the hot partition (seeded skew)")
+    ap.add_argument("--exec_delay_us_per_row", type=int, default=200,
+                    help="elastic mode: injected per-routed-row server "
+                         "work (µs) — the row-proportional scan cost "
+                         "the 2-CPU container cannot exhibit naturally")
+    ap.add_argument("--elastic_reqs", type=int, default=500,
+                    help="elastic mode: counted requests per window")
+    ap.add_argument("--elastic_hedge_ms", type=float, default=60.0,
+                    help="elastic mode: replica hedge delay once the "
+                         "hot partition is replicated")
     args = ap.parse_args(argv)
     if args.mode == "table":
         # the K-wide virtual CPU mesh must exist before the first jax
@@ -1244,6 +1757,8 @@ def main(argv=None):
         bench_rpc(args)
     elif args.mode == "tail":
         sys.exit(bench_tail(args))
+    elif args.mode == "elastic":
+        sys.exit(bench_elastic(args))
     elif args.mode == "mutate":
         import jax
 
