@@ -728,12 +728,13 @@ void etg_set_call_deadline_ms(double remaining_ms) {
           : 0);
 }
 
-// out[21]: round_trips, bytes_sent, bytes_received, bytes_sent_raw,
+// out[22]: round_trips, bytes_sent, bytes_received, bytes_sent_raw,
 // bytes_received_raw, connections_opened, compressed_frames_sent,
 // compressed_frames_received, mux_calls, v1_calls, hello_fallbacks,
 // inflight (gauge), deadline_propagated, deadline_shed (server edge),
 // hedge_fired, hedge_won, hedge_wasted, stale_map_shed (server edge),
-// replica_hedge_fired, replica_hedge_won, replica_hedge_wasted.
+// replica_hedge_fired, replica_hedge_won, replica_hedge_wasted,
+// trace_propagated.
 // Client-edge accounting except the *_shed pair (see RpcCounters).
 void etg_rpc_stats(uint64_t* out) {
   auto& c = et::GlobalRpcCounters();
@@ -758,6 +759,58 @@ void etg_rpc_stats(uint64_t* out) {
   out[18] = c.replica_hedge_fired.load();
   out[19] = c.replica_hedge_won.load();
   out[20] = c.replica_hedge_wasted.load();
+  out[21] = c.trace_propagated.load();
+}
+
+// Per-thread wire-trace handoff for the NEXT query run on this thread
+// (trace_id 0 clears). Set just before etq_exec_run; QueryProxy
+// consumes it into the run's QueryEnv so every REMOTE sub-call stamps
+// the context into its v2 request frame (hello-negotiated kFeatTrace).
+void etg_set_call_trace(uint64_t trace_id, uint64_t parent_span) {
+  et::SetCallTrace(trace_id, parent_span);
+}
+
+// Server-side per-request timing histograms (ServerTraceStats, always
+// on). verb slot: 0 execute, 1 apply_delta, 2 get_delta,
+// 3 get_delta_log, 4 set_ownership, 5 meta. phase: 0 queue-wait,
+// 1 decode, 2 execute, 3 serialize (non-execute verbs record queue +
+// execute only). out[27] = n, sum_us, counts[25] over log2-µs bounds
+// 1µs..2^23µs + overflow (le-inclusive, the obs bucket convention).
+int etg_server_trace_hist(int verb, int phase, uint64_t* out) {
+  if (!et::GlobalServerTraceStats().HistSnapshot(verb, phase, &out[0],
+                                                 &out[1], out + 2))
+    return Fail("bad verb/phase index");
+  return 0;
+}
+
+// Drain the bounded server span ring (requests that carried a wire
+// trace id): res->u64 holds stride-10 records
+// [trace_id, parent_span, span_id, verb, flags, start_unix_us,
+//  queue_us, decode_us, exec_us, serialize_us]. Read-and-clear — the
+// harness dumps once per run; flags: bit0 deadline-shed, bit1
+// stale-map-shed, bit2 non-OK status.
+int etg_server_trace_dump(EtResult* res) {
+  std::vector<et::ServerTraceRecord> recs;
+  et::GlobalServerTraceStats().Drain(&recs);
+  res->offsets.clear();
+  res->f32.clear();
+  res->i32.clear();
+  res->bytes.clear();
+  res->u64.clear();
+  res->u64.reserve(recs.size() * 10);
+  for (const auto& r : recs) {
+    res->u64.push_back(r.trace_id);
+    res->u64.push_back(r.parent_span);
+    res->u64.push_back(r.span_id);
+    res->u64.push_back(r.verb);
+    res->u64.push_back(r.flags);
+    res->u64.push_back(static_cast<uint64_t>(r.start_unix_us));
+    res->u64.push_back(r.queue_us);
+    res->u64.push_back(r.decode_us);
+    res->u64.push_back(r.exec_us);
+    res->u64.push_back(r.serialize_us);
+  }
+  return 0;
 }
 
 // Push an ownership-map spec to one graph server over the admin verb

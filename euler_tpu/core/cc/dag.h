@@ -105,6 +105,13 @@ struct QueryEnv {
   // — a spurious, retried refusal — never newer (which would slip a
   // stale-routed read past the server's one-sided check).
   uint64_t map_epoch = 0;
+  // Wire trace context for this run (0 = untraced). REMOTE sub-calls
+  // stamp it into their v2 request frames (kFeatTrace) so the shard's
+  // timing breakdown carries the client's trace/span ids — every wire
+  // attempt of one run (retries, hedge legs) shares the same context
+  // and the server mints a distinct span per request.
+  uint64_t trace_id = 0;
+  uint64_t trace_parent = 0;
 };
 
 // Stateless kernel; one singleton per op name serves all queries

@@ -586,6 +586,60 @@ void TestRpcHelloFallback() {
   GlobalRpcConfig() = saved;
 }
 
+// ---- rpc: wire trace context → server-side timing breakdown ----
+void TestServerTraceBreakdown() {
+  std::shared_ptr<const Graph> g(RingGraph());
+  auto server = std::make_unique<GraphServer>(g, nullptr, 0, 1, 1);
+  CHECK_OK(server->Start(0));
+  RpcConfig saved = GlobalRpcConfig();
+  GlobalRpcConfig().mux = true;
+  GlobalRpcConfig().mux_connections = 1;
+  auto& ctr = GlobalRpcCounters();
+
+  // drain whatever earlier tests' traffic left in the ring
+  std::vector<ServerTraceRecord> recs;
+  GlobalServerTraceStats().Drain(&recs);
+
+  ExecuteRequest req;  // empty DAG: decode/execute/serialize still run
+  ByteWriter w;
+  EncodeExecuteRequest(req, &w);
+
+  RpcChannel ch("127.0.0.1", server->port());
+  ch.set_mux(true);
+  std::vector<char> reply;
+  uint64_t t0 = ctr.trace_propagated.load();
+
+  // untraced call: nothing stamped, nothing ringed (wire identity is
+  // pinned at the byte level by the Python interop tests)
+  CHECK_OK(ch.Call(0 /*kExecute*/, w.buffer(), &reply, /*max_retries=*/2));
+  CHECK_TRUE(ctr.trace_propagated.load() == t0);
+  GlobalServerTraceStats().Drain(&recs);
+  CHECK_TRUE(recs.empty());
+
+  // traced call: stamped, and the server records the breakdown under
+  // the caller's trace/parent with a freshly minted span id
+  CHECK_OK(ch.Call(0, w.buffer(), &reply, 2, /*deadline=*/0,
+                   /*map_epoch=*/0, WireTrace{77, 5}));
+  CHECK_TRUE(ctr.trace_propagated.load() == t0 + 1);
+  GlobalServerTraceStats().Drain(&recs);
+  CHECK_TRUE(recs.size() == 1);
+  CHECK_TRUE(recs[0].trace_id == 77 && recs[0].parent_span == 5);
+  CHECK_TRUE(recs[0].span_id != 0);
+  CHECK_TRUE(recs[0].verb == 0 && recs[0].flags == 0);
+  CHECK_TRUE(recs[0].start_unix_us > 0);
+
+  // the always-on phase histograms saw both calls (queue + execute)
+  uint64_t n = 0, sum = 0;
+  uint64_t counts[ServerTraceStats::kTraceBuckets + 1];
+  CHECK_TRUE(GlobalServerTraceStats().HistSnapshot(0, 0, &n, &sum, counts));
+  CHECK_TRUE(n >= 2);
+  CHECK_TRUE(GlobalServerTraceStats().HistSnapshot(0, 2, &n, &sum, counts));
+  CHECK_TRUE(n >= 2);
+
+  server->Stop();
+  GlobalRpcConfig() = saved;
+}
+
 }  // namespace
 }  // namespace et
 
@@ -600,6 +654,7 @@ int main() {
   et::TestRegistryServer();
   et::TestRpcMuxTransport();
   et::TestRpcHelloFallback();
+  et::TestServerTraceBreakdown();
   et::TestI32OffsetGuard();
   et::TestGraphStore();
   et::TestConcurrentSampling();

@@ -613,10 +613,13 @@ class RemoteOp : public OpKernel {
           }
           done(s);
         },
-        // propagate the run's remaining deadline + the run-start map
-        // epoch inside the v2 frame: the shard sheds already-dead work
-        // and refuses reads routed on a superseded ownership map
-        env.deadline_us, env.map_epoch);
+        // propagate the run's remaining deadline, the run-start map
+        // epoch, and the wire trace context inside the v2 frame: the
+        // shard sheds already-dead work, refuses reads routed on a
+        // superseded ownership map, and records its timing breakdown
+        // under the caller's trace/span ids
+        env.deadline_us, env.map_epoch,
+        WireTrace{env.trace_id, env.trace_parent});
   }
 };
 ET_REGISTER_KERNEL("REMOTE", RemoteOp);

@@ -203,6 +203,11 @@ Status QueryProxy::RunGremlinTimed(const std::string& query,
   // a live read at frame-write time could stamp a newer epoch than the
   // map the split actually routed with)
   env.map_epoch = client_ ? client_->map_epoch() : 0;
+  // wire trace context (rpc.h SetCallTrace): same handoff pattern as
+  // the deadline — consumed so a later untraced run never inherits it
+  WireTrace wt = TakeCallTrace();
+  env.trace_id = wt.id;
+  env.trace_parent = wt.parent;
   Executor exec(&plan->dag, env, &ctx);
   ET_RETURN_IF_ERROR(exec.RunSync());
   outputs->clear();

@@ -60,11 +60,19 @@ constexpr uint32_t kFrameFlagDeadline = 4u;
 // that stopped receiving that partition's deltas. Clients with no map
 // (epoch 0) stamp nothing; pre-map peers see unchanged bytes.
 constexpr uint32_t kFrameFlagMapEpoch = 8u;
+// REQUEST body is prefixed with the caller's wire trace context (u64
+// trace_id | u64 parent_span, after the deadline and map-epoch
+// prefixes, before compression). Hello-negotiated (kFeatTrace): only
+// stamped for servers that will strip it, and only when the caller set
+// a trace context (id != 0) — pre-trace peers and untraced calls see
+// byte-identical frames.
+constexpr uint32_t kFrameFlagTrace = 16u;
 constexpr uint32_t kProtoV2 = 2;
 constexpr uint32_t kFeatAcceptCompressed = 1u;  // hello feature bit
 constexpr uint32_t kFeatEpoch = 2u;             // hello: send epoch prefixes
 constexpr uint32_t kFeatDeadline = 4u;          // hello: deadline prefixes ok
 constexpr uint32_t kFeatMapEpoch = 8u;          // hello: map-epoch prefixes ok
+constexpr uint32_t kFeatTrace = 16u;            // hello: trace prefixes ok
 
 enum MsgType : uint32_t {
   kExecute = 0,
@@ -261,6 +269,8 @@ void JitteredBackoffUs(int attempt) {
 // sets it on the query's calling thread; QueryProxy consumes it into
 // the run's QueryEnv on the same thread.
 thread_local int64_t tls_call_deadline_us = 0;
+// Per-thread trace handoff (see rpc.h SetCallTrace): same pattern.
+thread_local WireTrace tls_call_trace;
 }  // namespace
 
 int64_t SteadyNowUs() {
@@ -277,6 +287,82 @@ int64_t TakeCallDeadlineUs() {
   int64_t v = tls_call_deadline_us;
   tls_call_deadline_us = 0;
   return v;
+}
+
+void SetCallTrace(uint64_t trace_id, uint64_t parent_span) {
+  tls_call_trace.id = trace_id;
+  tls_call_trace.parent = parent_span;
+}
+
+WireTrace TakeCallTrace() {
+  WireTrace t = tls_call_trace;
+  tls_call_trace = WireTrace{};
+  return t;
+}
+
+int64_t WallNowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// ServerTraceStats — per-verb/phase native histograms + traced-span ring
+// ---------------------------------------------------------------------------
+ServerTraceStats& GlobalServerTraceStats() {
+  static ServerTraceStats* s = new ServerTraceStats();
+  return *s;
+}
+
+int ServerTraceStats::VerbSlot(uint32_t msg_type) {
+  switch (msg_type) {
+    case kExecute: return 0;
+    case kApplyDelta: return 1;
+    case kGetDelta: return 2;
+    case kGetDeltaLog: return 3;
+    case kSetOwnership: return 4;
+    case kMeta: return 5;
+    default: return -1;  // ping / hello / registry verbs: untracked
+  }
+}
+
+void ServerTraceStats::Observe(int verb_slot, int phase, uint64_t us) {
+  if (verb_slot < 0 || verb_slot >= kTraceVerbs || phase < 0 ||
+      phase >= kTracePhases)
+    return;
+  // log2 bucket: bound i covers (2^(i-1), 2^i] µs (le-inclusive, the
+  // obs Histogram convention); values past the last bound overflow
+  int idx = 0;
+  while (idx < kTraceBuckets && us > (1ULL << idx)) ++idx;
+  Hist& h = hist_[verb_slot][phase];
+  h.counts[idx].fetch_add(1);
+  h.sum_us.fetch_add(us);
+  h.n.fetch_add(1);
+}
+
+void ServerTraceStats::Record(const ServerTraceRecord& rec) {
+  std::lock_guard<std::mutex> lk(ring_mu_);
+  ring_.push_back(rec);
+  while (ring_.size() > kRingCap) ring_.pop_front();
+}
+
+void ServerTraceStats::Drain(std::vector<ServerTraceRecord>* out) {
+  std::lock_guard<std::mutex> lk(ring_mu_);
+  out->assign(ring_.begin(), ring_.end());
+  ring_.clear();
+}
+
+bool ServerTraceStats::HistSnapshot(int verb_slot, int phase, uint64_t* n,
+                                    uint64_t* sum_us,
+                                    uint64_t* counts) const {
+  if (verb_slot < 0 || verb_slot >= kTraceVerbs || phase < 0 ||
+      phase >= kTracePhases)
+    return false;
+  const Hist& h = hist_[verb_slot][phase];
+  *n = h.n.load();
+  *sum_us = h.sum_us.load();
+  for (int i = 0; i <= kTraceBuckets; ++i) counts[i] = h.counts[i].load();
+  return true;
 }
 
 // ---------------------------------------------------------------------------
@@ -983,7 +1069,10 @@ void GraphServer::HandleConnection(int fd) {
       continue;
     }
     // v1: serial request/reply on the reader thread, byte-for-byte the
-    // pre-v2 behavior (old 'ETFR' clients see an unchanged server)
+    // pre-v2 behavior (old 'ETFR' clients see an unchanged server).
+    // Handler wall time still lands in the per-verb execute histogram —
+    // the breakdown phases (queue/decode/serialize) are a v2 concept.
+    const int64_t v1_t0 = SteadyNowUs();
     ByteWriter w;
     if (msg_type == kExecute) {
       ByteReader r(body.data(), body.size());
@@ -1005,6 +1094,9 @@ void GraphServer::HandleConnection(int fd) {
     } else {  // ping
       w.Put<uint32_t>(0);
     }
+    GlobalServerTraceStats().Observe(
+        ServerTraceStats::VerbSlot(msg_type), /*phase=execute*/ 2,
+        static_cast<uint64_t>(SteadyNowUs() - v1_t0));
     if (!WriteFrame(fd, msg_type, w.buffer().data(), w.buffer().size()))
       break;
   }
@@ -1107,6 +1199,15 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     std::memcpy(&req_map_epoch, body.data(), 8);
     body.erase(body.begin(), body.begin() + 8);
   }
+  // wire trace context (third prefix): the client span this request's
+  // server-side timing breakdown nests under in a merged trace
+  WireTrace req_trace;
+  if ((flags & kFrameFlagTrace) != 0) {
+    if (body.size() < 16) return false;  // protocol error
+    std::memcpy(&req_trace.id, body.data(), 8);
+    std::memcpy(&req_trace.parent, body.data() + 8, 8);
+    body.erase(body.begin(), body.begin() + 16);
+  }
   if (msg_type == kHello) {
     ByteReader r(body.data(), body.size());
     uint32_t pver = 0, feats = 0;
@@ -1120,7 +1221,7 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     ByteWriter w;
     w.Put<uint32_t>(kProtoV2);
     w.Put<uint32_t>(kFeatAcceptCompressed | kFeatEpoch | kFeatDeadline |
-                    kFeatMapEpoch);
+                    kFeatMapEpoch | kFeatTrace);
     w.Put<uint64_t>(thresh);
     write_reply(kHello, request_id, w.buffer());
     return true;
@@ -1137,8 +1238,13 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
       ++conn->inflight;
     }
     GlobalThreadPool()->Schedule(
-        [this, conn, write_reply, msg_type, request_id,
+        [this, conn, write_reply, msg_type, request_id, arrival_us,
          body = std::move(body)] {
+          auto& trace = GlobalServerTraceStats();
+          const int slot = ServerTraceStats::VerbSlot(msg_type);
+          const int64_t pickup_us = SteadyNowUs();
+          trace.Observe(slot, /*queue*/ 0,
+                        static_cast<uint64_t>(pickup_us - arrival_us));
           ByteWriter w;
           ByteReader r(body.data(), body.size());
           if (msg_type == kApplyDelta) {
@@ -1148,6 +1254,8 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
           } else {
             HandleGetDeltaLog(&r, &w);
           }
+          trace.Observe(slot, /*execute*/ 2,
+                        static_cast<uint64_t>(SteadyNowUs() - pickup_us));
           write_reply(msg_type, request_id, w.buffer());
           std::lock_guard<std::mutex> lk(conn->imu);
           --conn->inflight;
@@ -1168,6 +1276,9 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     } else {  // ping / unknown
       w.Put<uint32_t>(0);
     }
+    GlobalServerTraceStats().Observe(
+        ServerTraceStats::VerbSlot(msg_type), /*execute*/ 2,
+        static_cast<uint64_t>(SteadyNowUs() - arrival_us));
     write_reply(msg_type, request_id, w.buffer());
     return true;
   }
@@ -1195,10 +1306,68 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     std::shared_ptr<const Graph> graph;
     std::shared_ptr<IndexManager> index;
   };
-  auto finish = [conn, write_reply, request_id](const ExecuteReply& rep) {
+  // Per-request timing breakdown (queue-wait / decode / execute /
+  // serialize — exactly the quantities the deadline shed measures
+  // implicitly): always observed into the native phase histograms;
+  // additionally recorded into the bounded server span ring when the
+  // request carried a wire trace context (kFeatTrace), so a merged
+  // chrome trace stitches this shard's time under the client span.
+  struct ReqTiming {
+    WireTrace trace;
+    int64_t arrival_us = 0;    // steady, at frame read
+    int64_t wall_arrival_us = 0;
+    int64_t pickup_us = 0;     // steady, at dispatch pickup
+    int64_t decoded_us = 0;    // 0 when shed before decode
+    int64_t exec_done_us = 0;  // 0 when the DAG never ran
+    uint32_t flags = 0;  // bit0 deadline-shed, bit1 stale-map-shed,
+                         // bit2 non-OK status
+  };
+  auto tm = std::make_shared<ReqTiming>();
+  tm->trace = req_trace;
+  tm->arrival_us = arrival_us;
+  tm->wall_arrival_us = WallNowUs();
+  auto finish = [conn, write_reply, request_id,
+                 tm](const ExecuteReply& rep) {
+    const int64_t ser0 = SteadyNowUs();
     ByteWriter w;
     EncodeExecuteReply(rep, &w);
     write_reply(kExecute, request_id, w.buffer());
+    const uint64_t ser_us =
+        static_cast<uint64_t>(SteadyNowUs() - ser0);
+    auto& trace = GlobalServerTraceStats();
+    const int64_t pickup = tm->pickup_us > 0 ? tm->pickup_us : ser0;
+    const uint64_t queue_us =
+        static_cast<uint64_t>(pickup - tm->arrival_us);
+    const uint64_t decode_us =
+        tm->decoded_us > 0 ? static_cast<uint64_t>(tm->decoded_us - pickup)
+                           : 0;
+    const uint64_t exec_us =
+        tm->exec_done_us > 0 && tm->decoded_us > 0
+            ? static_cast<uint64_t>(tm->exec_done_us - tm->decoded_us)
+            : 0;
+    trace.Observe(0, /*queue*/ 0, queue_us);
+    if (tm->decoded_us > 0) trace.Observe(0, /*decode*/ 1, decode_us);
+    if (tm->exec_done_us > 0) trace.Observe(0, /*execute*/ 2, exec_us);
+    trace.Observe(0, /*serialize*/ 3, ser_us);
+    if (tm->trace.id != 0) {
+      if (!rep.status.ok()) tm->flags |= 4u;
+      auto clamp = [](uint64_t v) {
+        return static_cast<uint32_t>(
+            std::min<uint64_t>(v, 0xffffffffULL));
+      };
+      ServerTraceRecord rec;
+      rec.trace_id = tm->trace.id;
+      rec.parent_span = tm->trace.parent;
+      rec.span_id = trace.NextSpanId();
+      rec.verb = kExecute;
+      rec.flags = tm->flags;
+      rec.start_unix_us = tm->wall_arrival_us;
+      rec.queue_us = clamp(queue_us);
+      rec.decode_us = clamp(decode_us);
+      rec.exec_us = clamp(exec_us);
+      rec.serialize_us = clamp(ser_us);
+      trace.Record(rec);
+    }
     std::lock_guard<std::mutex> lk(conn->imu);
     --conn->inflight;
     conn->icv.notify_all();
@@ -1208,8 +1377,9 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
   // measures — a request whose budget already expired by pickup is
   // SHED with an explicit status (counted), its DAG never run.
   GlobalThreadPool()->Schedule(
-      [this, finish, deadline_us, arrival_us, req_map_epoch,
+      [this, finish, tm, deadline_us, arrival_us, req_map_epoch,
        body = std::move(body)] {
+        tm->pickup_us = SteadyNowUs();
         // stale ownership map: the request was SPLIT with a routing map
         // this shard has since superseded — partitions it stopped
         // owning no longer receive deltas here, so serving the read
@@ -1222,6 +1392,7 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
         if (req_map_epoch != 0 && have_map != 0 &&
             req_map_epoch < have_map) {
           GlobalRpcCounters().stale_map_shed.fetch_add(1);
+          tm->flags |= 2u;
           ExecuteReply rep;
           rep.status = Status::Internal(
               "stale ownership map: request routed on map epoch " +
@@ -1230,12 +1401,13 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
           finish(rep);
           return;
         }
-        if (deadline_us > 0 && SteadyNowUs() - arrival_us > deadline_us) {
+        if (deadline_us > 0 && tm->pickup_us - arrival_us > deadline_us) {
           GlobalRpcCounters().deadline_shed.fetch_add(1);
+          tm->flags |= 1u;
           ExecuteReply rep;
           rep.status = Status::Internal(
               "deadline shed: request waited " +
-              std::to_string(SteadyNowUs() - arrival_us) +
+              std::to_string(tm->pickup_us - arrival_us) +
               "us in dispatch, past its " + std::to_string(deadline_us) +
               "us remaining budget");
           finish(rep);
@@ -1251,6 +1423,9 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
           finish(rep);
           return;
         }
+        // decode ends here; the bench-only injected per-row work below
+        // models row-proportional scan cost and belongs to EXECUTE
+        tm->decoded_us = SteadyNowUs();
         const int64_t per_row_us = ExecDelayUsPerRow();
         if (per_row_us > 0) {
           uint64_t rows = 0;
@@ -1276,7 +1451,8 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
         // stored callback before invoking (see Executor::OnNodeDone), so
         // destroying the Executor from inside its own done is the
         // sanctioned pattern
-        p->exec->Run([p, finish](Status rs) {
+        p->exec->Run([p, finish, tm](Status rs) {
+          tm->exec_done_us = SteadyNowUs();
           ExecuteReply rep;
           rep.status = rs;
           if (rs.ok()) {
@@ -1361,11 +1537,12 @@ class RpcChannel::MuxConn {
 
   MuxConn(int fd, bool peer_compress, int64_t compress_threshold,
           int max_inflight, std::atomic<uint64_t>* epoch_sink,
-          bool peer_deadline, bool peer_map)
+          bool peer_deadline, bool peer_map, bool peer_trace)
       : fd_(fd),
         peer_compress_(peer_compress),
         peer_deadline_(peer_deadline),
         peer_map_(peer_map),
+        peer_trace_(peer_trace),
         compress_threshold_(compress_threshold),
         max_inflight_(std::max(max_inflight, 1)),
         epoch_sink_(epoch_sink) {
@@ -1397,7 +1574,7 @@ class RpcChannel::MuxConn {
 
   Status Call(uint32_t msg_type, const std::vector<char>& body,
               std::vector<char>* reply_body, int64_t deadline_abs_us = 0,
-              uint64_t map_epoch = 0) {
+              uint64_t map_epoch = 0, WireTrace trace = {}) {
     auto& ctr = GlobalRpcCounters();
     Waiter w;
     w.start_us = SteadyNowUs();
@@ -1414,7 +1591,8 @@ class RpcChannel::MuxConn {
       waiters_[id] = &w;
     }
     ctr.inflight.fetch_add(1);
-    if (!WriteRequest(msg_type, id, body, deadline_abs_us, map_epoch)) {
+    if (!WriteRequest(msg_type, id, body, deadline_abs_us, map_epoch,
+                      trace)) {
       // socket dead: tear the whole conn down so every parked waiter
       // (not just this call) gets a status promptly
       Shutdown();
@@ -1455,7 +1633,8 @@ class RpcChannel::MuxConn {
       waiters_[id] = w;
     }
     GlobalRpcCounters().inflight.fetch_add(1);
-    if (!WriteRequest(msg_type, id, body, deadline_abs_us, 0)) Shutdown();
+    if (!WriteRequest(msg_type, id, body, deadline_abs_us, 0, {}))
+      Shutdown();
   }
 
   // One leg of a hedged call: heap waiter bound to the shared group.
@@ -1464,7 +1643,8 @@ class RpcChannel::MuxConn {
   // group so the caller's wait predicate stays truthful).
   uint64_t SubmitHedged(uint32_t msg_type, const std::vector<char>& body,
                         const std::shared_ptr<HedgeGroup>& g, int leg,
-                        int64_t deadline_abs_us, uint64_t map_epoch) {
+                        int64_t deadline_abs_us, uint64_t map_epoch,
+                        WireTrace trace) {
     auto* w = new Waiter();
     w->hedge = g;
     w->leg = leg;
@@ -1496,7 +1676,8 @@ class RpcChannel::MuxConn {
       waiters_[id] = w;
     }
     GlobalRpcCounters().inflight.fetch_add(1);
-    if (!WriteRequest(msg_type, id, body, deadline_abs_us, map_epoch))
+    if (!WriteRequest(msg_type, id, body, deadline_abs_us, map_epoch,
+                      trace))
       Shutdown();
     return id;
   }
@@ -1541,17 +1722,18 @@ class RpcChannel::MuxConn {
 
   bool WriteRequest(uint32_t msg_type, uint64_t id,
                     const std::vector<char>& body, int64_t deadline_abs_us,
-                    uint64_t map_epoch) {
+                    uint64_t map_epoch, WireTrace trace) {
     auto& ctr = GlobalRpcCounters();
     uint32_t flags = 0;
-    // request prefixes, in wire order: [deadline u64][map_epoch u64],
-    // each hello-negotiated and kExecute-only. Deadline stamps the
-    // REMAINING budget at write time (an already-expired budget stamps
-    // 1µs so the SERVER sheds it); map_epoch stamps the routing map
-    // this request was split with, so a server on a NEWER map refuses
-    // it instead of serving a partition whose deltas now land
-    // elsewhere.
-    char prefix[16];
+    // request prefixes, in wire order: [deadline u64][map_epoch u64]
+    // [trace u64 id | u64 parent], each hello-negotiated and
+    // kExecute-only. Deadline stamps the REMAINING budget at write
+    // time (an already-expired budget stamps 1µs so the SERVER sheds
+    // it); map_epoch stamps the routing map this request was split
+    // with, so a server on a NEWER map refuses it instead of serving a
+    // partition whose deltas now land elsewhere; trace carries the
+    // client span this request's server-side breakdown nests under.
+    char prefix[32];
     size_t npfx = 0;
     if (peer_deadline_ && deadline_abs_us > 0 && msg_type == kExecute) {
       uint64_t remaining_us = static_cast<uint64_t>(
@@ -1568,6 +1750,16 @@ class RpcChannel::MuxConn {
       std::memcpy(prefix + npfx, &map_epoch, 8);
       npfx += 8;
       flags |= kFrameFlagMapEpoch;
+    }
+    if (peer_trace_ && trace.id != 0 && msg_type == kExecute) {
+      // same context on every wire attempt of one logical call — the
+      // SERVER mints a distinct span id per request, so hedge legs and
+      // retries show as siblings under the same client span
+      std::memcpy(prefix + npfx, &trace.id, 8);
+      std::memcpy(prefix + npfx + 8, &trace.parent, 8);
+      npfx += 16;
+      flags |= kFrameFlagTrace;
+      ctr.trace_propagated.fetch_add(1);
     }
     // adaptive request compression (negotiated in the hello); the
     // prefixes ride INSIDE the deflate stream like the reply epoch
@@ -1758,6 +1950,7 @@ class RpcChannel::MuxConn {
   const bool peer_compress_;
   const bool peer_deadline_;
   const bool peer_map_;
+  const bool peer_trace_;
   const int64_t compress_threshold_;
   const int max_inflight_;
   std::atomic<uint64_t>* const epoch_sink_;
@@ -1886,7 +2079,7 @@ std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
   ByteWriter hw;
   hw.Put<uint32_t>(kProtoV2);
   hw.Put<uint32_t>(kFeatAcceptCompressed | kFeatEpoch | kFeatDeadline |
-                   kFeatMapEpoch);
+                   kFeatMapEpoch | kFeatTrace);
   const int64_t hello_thr = cfg.compress_threshold.load();
   hw.Put<uint64_t>(static_cast<uint64_t>(hello_thr > 0 ? hello_thr : 0));
   std::vector<char> hbody;
@@ -1900,15 +2093,18 @@ std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
   bool peer_compress = false;
   bool peer_deadline = false;
   bool peer_map = false;
+  bool peer_trace = false;
   if (hello_ok) {
     ByteReader r(hbody.data(), hbody.size());
     uint32_t pver = 0, feats = 0;
     if (!r.Get(&pver) || !r.Get(&feats) || pver < kProtoV2) hello_ok = false;
     peer_compress = (feats & kFeatAcceptCompressed) != 0;
-    // only stamp deadline/map-epoch prefixes for servers that will
-    // strip them — older v2 servers keep seeing byte-identical requests
+    // only stamp deadline/map-epoch/trace prefixes for servers that
+    // will strip them — older v2 servers keep seeing byte-identical
+    // requests
     peer_deadline = (feats & kFeatDeadline) != 0;
     peer_map = (feats & kFeatMapEpoch) != 0;
+    peer_trace = (feats & kFeatTrace) != 0;
   }
   if (!hello_ok) {
     ::close(fd);
@@ -1933,7 +2129,7 @@ std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
   }
   auto conn = std::make_shared<MuxConn>(
       fd, peer_compress, cfg.compress_threshold, cfg.max_inflight,
-      epoch_sink_, peer_deadline, peer_map);
+      epoch_sink_, peer_deadline, peer_map, peer_trace);
   if (slot >= static_cast<int>(mux_conns_.size()))
     mux_conns_.resize(slot + 1);
   mux_conns_[slot] = conn;
@@ -1979,7 +2175,8 @@ int RpcChannel::PickSlot(int slots, int avoid) {
 
 Status RpcChannel::MuxCall(uint32_t msg_type, const std::vector<char>& body,
                            std::vector<char>* reply_body, int max_retries,
-                           int64_t deadline_abs_us, uint64_t map_epoch) {
+                           int64_t deadline_abs_us, uint64_t map_epoch,
+                           WireTrace trace) {
   Status last = Status::IOError("rpc not attempted");
   for (int attempt = 0; attempt < max_retries; ++attempt) {
     if (v1_fallback_.load()) return last;  // caller switches to v1
@@ -1997,10 +2194,10 @@ Status RpcChannel::MuxCall(uint32_t msg_type, const std::vector<char>& body,
     int64_t hedge_us = GlobalRpcConfig().hedge_delay_us.load();
     if (hedge_us > 0 && slots >= 2 && msg_type == kExecute) {
       last = HedgedMuxCall(conn, slot, slots, msg_type, body, reply_body,
-                           hedge_us, deadline_abs_us, map_epoch);
+                           hedge_us, deadline_abs_us, map_epoch, trace);
     } else {
       last = conn->Call(msg_type, body, reply_body, deadline_abs_us,
-                        map_epoch);
+                        map_epoch, trace);
     }
     if (last.ok()) return last;
     // transport failure: the conn marked itself broken; the next attempt
@@ -2023,11 +2220,11 @@ Status RpcChannel::HedgedMuxCall(const std::shared_ptr<MuxConn>& conn,
                                  const std::vector<char>& body,
                                  std::vector<char>* reply_body,
                                  int64_t hedge_us, int64_t deadline_abs_us,
-                                 uint64_t map_epoch) {
+                                 uint64_t map_epoch, WireTrace trace) {
   auto& ctr = GlobalRpcCounters();
   auto g = std::make_shared<MuxConn::HedgeGroup>();
-  uint64_t id0 =
-      conn->SubmitHedged(msg_type, body, g, 0, deadline_abs_us, map_epoch);
+  uint64_t id0 = conn->SubmitHedged(msg_type, body, g, 0, deadline_abs_us,
+                                    map_epoch, trace);
   std::shared_ptr<MuxConn> conn1;
   uint64_t id1 = 0;
   {
@@ -2044,7 +2241,7 @@ Status RpcChannel::HedgedMuxCall(const std::shared_ptr<MuxConn>& conn,
       if (conn1 != nullptr) {
         ctr.hedge_fired.fetch_add(1);
         id1 = conn1->SubmitHedged(msg_type, body, g, 1, deadline_abs_us,
-                                  map_epoch);
+                                  map_epoch, trace);
       }
       lk.lock();
     }
@@ -2119,11 +2316,12 @@ void RpcChannel::CallAsync(
 
 Status RpcChannel::Call(uint32_t msg_type, const std::vector<char>& body,
                         std::vector<char>* reply_body, int max_retries,
-                        int64_t deadline_abs_us, uint64_t map_epoch) {
+                        int64_t deadline_abs_us, uint64_t map_epoch,
+                        WireTrace trace) {
   if (max_retries <= 0) max_retries = kRetryCount;
   if (mux_ && !v1_fallback_.load()) {
     Status s = MuxCall(msg_type, body, reply_body, max_retries,
-                       deadline_abs_us, map_epoch);
+                       deadline_abs_us, map_epoch, trace);
     if (s.ok() || !v1_fallback_.load()) return s;
     // the server refused the hello mid-call: finish this call on v1
   }
@@ -2794,7 +2992,7 @@ constexpr int kMaxReplicaHedgeLegs = 128;
 Status ClientManager::ReplicaHedgedExecute(
     int shard, int alt, std::shared_ptr<ByteWriter> body,
     std::vector<char>* reply, int64_t hedge_us, int64_t deadline_abs_us,
-    uint64_t map_epoch) {
+    uint64_t map_epoch, WireTrace trace) {
   auto& ctr = GlobalRpcCounters();
   // Two blocking legs race on their own detached threads; this thread
   // coordinates on the shared state. Dedicated threads (not the client
@@ -2813,14 +3011,16 @@ Status ClientManager::ReplicaHedgedExecute(
     std::vector<char> reply[2];
   };
   auto race = std::make_shared<Race>();
-  auto fire = [this, body, race, deadline_abs_us,
-               map_epoch](int leg_idx, int target) {
+  auto fire = [this, body, race, deadline_abs_us, map_epoch,
+               trace](int leg_idx, int target) {
     g_replica_hedge_legs.fetch_add(1);
     auto chan = Channel(target);
-    std::thread([chan, body, race, deadline_abs_us, map_epoch, leg_idx] {
+    std::thread([chan, body, race, deadline_abs_us, map_epoch, trace,
+                 leg_idx] {
       std::vector<char> rep;
       Status s = chan->Call(kExecute, body->buffer(), &rep,
-                            /*max_retries=*/0, deadline_abs_us, map_epoch);
+                            /*max_retries=*/0, deadline_abs_us, map_epoch,
+                            trace);
       {
         std::lock_guard<std::mutex> lk(race->mu);
         race->st[leg_idx] = s;
@@ -2869,7 +3069,7 @@ Status ClientManager::ReplicaHedgedExecute(
 
 Status ClientManager::Execute(int shard, const ExecuteRequest& req,
                               ExecuteReply* rep, int64_t deadline_abs_us,
-                              uint64_t map_epoch) {
+                              uint64_t map_epoch, WireTrace trace) {
   if (shard < 0 || shard >= shard_num())
     return Status::InvalidArgument("bad shard index");
   auto w = std::make_shared<ByteWriter>();
@@ -2889,7 +3089,7 @@ Status ClientManager::Execute(int shard, const ExecuteRequest& req,
   if (alt >= 0 &&
       g_replica_hedge_legs.load() + 2 <= kMaxReplicaHedgeLegs) {
     s = ReplicaHedgedExecute(shard, alt, w, &reply, hedge_us,
-                             deadline_abs_us, map_epoch);
+                             deadline_abs_us, map_epoch, trace);
   } else if (alt >= 0) {
     // At the leg cap. The cap fills precisely when legs pile up on a
     // STALLED primary (a healthy fleet completes legs as fast as they
@@ -2900,12 +3100,13 @@ Status ClientManager::Execute(int shard, const ExecuteRequest& req,
     if (shard_reqs_ != nullptr && alt < stats_shards_)
       shard_reqs_[alt].fetch_add(1);
     s = Channel(alt)->Call(kExecute, w->buffer(), &reply,
-                           /*max_retries=*/0, deadline_abs_us, map_epoch);
+                           /*max_retries=*/0, deadline_abs_us, map_epoch,
+                           trace);
   } else {
     // snapshot: the monitor may swap the channel concurrently
     s = Channel(shard)->Call(kExecute, w->buffer(), &reply,
                              /*max_retries=*/0, deadline_abs_us,
-                             map_epoch);
+                             map_epoch, trace);
   }
   if (shard < stats_shards_) {
     shard_inflight_[shard].fetch_sub(1);
@@ -3056,14 +3257,15 @@ Status ClientManager::DeltaSince(uint64_t from, uint64_t* epoch,
 void ClientManager::ExecuteAsync(
     int shard, ExecuteRequest req,
     std::function<void(Status, ExecuteReply)> done, int64_t deadline_abs_us,
-    uint64_t map_epoch) {
+    uint64_t map_epoch, WireTrace trace) {
   // the Call() below blocks until the shard replies — it must not occupy
   // an executor thread (see ClientThreadPool comment in threadpool.h)
   ClientThreadPool()->Schedule(
       [this, shard, req = std::move(req), done = std::move(done),
-       deadline_abs_us, map_epoch] {
+       deadline_abs_us, map_epoch, trace] {
         ExecuteReply rep;
-        Status s = Execute(shard, req, &rep, deadline_abs_us, map_epoch);
+        Status s = Execute(shard, req, &rep, deadline_abs_us, map_epoch,
+                           trace);
         done(s, std::move(rep));
       });
 }

@@ -23,7 +23,13 @@
 //           REQUEST body prefixed with the caller's remaining deadline
 //           as u64 µs — hello-negotiated (kFeatDeadline), applied
 //           before compression; the server sheds a kExecute whose
-//           deadline expired before dispatch pickup)
+//           deadline expired before dispatch pickup; flags bit 4:
+//           REQUEST body prefixed with the caller's wire trace context
+//           as u64 trace_id | u64 parent_span — hello-negotiated
+//           (kFeatTrace), after the deadline and map-epoch prefixes;
+//           the server's per-request timing breakdown records it so a
+//           merged chrome trace stitches shard time under the client
+//           span)
 // msg types: 0 = Execute, 1 = ShardMeta, 2 = Ping, 6 = Hello (v2 only),
 //            7 = ApplyDelta, 8 = GetDelta (streaming graph deltas),
 //            9 = GetDeltaLog (raw retained delta records — the
@@ -165,8 +171,101 @@ struct RpcCounters {
   std::atomic<uint64_t> replica_hedge_fired{0};
   std::atomic<uint64_t> replica_hedge_won{0};
   std::atomic<uint64_t> replica_hedge_wasted{0};
+  // ---- cross-process tracing (hello feature kFeatTrace) ----
+  // kExecute requests stamped with a wire trace context (client edge).
+  // Zero whenever the feature is off, no trace is set, or the peer
+  // predates it — the wire-identity tests pin exactly that.
+  std::atomic<uint64_t> trace_propagated{0};
 };
 RpcCounters& GlobalRpcCounters();
+
+// ---------------------------------------------------------------------------
+// Wire-level trace propagation (protocol v2, hello feature kFeatTrace).
+// ---------------------------------------------------------------------------
+// A client-generated trace context riding a kExecute request frame:
+// `id` correlates every hop of one logical client call (hedged legs and
+// stale-map retries share it), `parent` is the CLIENT span the server-
+// side breakdown nests under in a merged chrome trace. id == 0 means
+// "untraced" and stamps nothing — the wire stays byte-identical.
+struct WireTrace {
+  uint64_t id = 0;
+  uint64_t parent = 0;
+};
+
+// Per-thread trace handoff, the SetCallDeadlineUs pattern: the capi
+// sets it just before etq_exec_run on the query's calling thread;
+// QueryProxy::RunGremlinTimed consumes it into the run's QueryEnv and
+// every REMOTE sub-call stamps it into its v2 request frame (each wire
+// attempt — retries, hedge legs — carries the same context; the server
+// mints a distinct span id per request).
+void SetCallTrace(uint64_t trace_id, uint64_t parent_span);
+WireTrace TakeCallTrace();
+
+// Unix wall-clock now in microseconds (server span timestamps must be
+// comparable ACROSS processes, which steady_clock is not).
+int64_t WallNowUs();
+
+// Server-side per-request timing breakdown — the cross-process half of
+// the observability subsystem. Two sinks:
+//   * always-on native histograms, per verb and per phase (queue-wait /
+//     decode / execute / serialize; non-kExecute verbs record queue +
+//     execute only), log2-µs buckets — one /metrics scrape of a shard
+//     shows queue-wait and execute quantiles with no Python in the
+//     measurement path;
+//   * a bounded ring of finished server spans for requests that carried
+//     a wire trace context (kFeatTrace), drained by etg_server_trace_dump
+//     and stitched under the client span in a merged chrome trace.
+struct ServerTraceRecord {
+  uint64_t trace_id = 0;
+  uint64_t parent_span = 0;  // the client span the request rode under
+  uint64_t span_id = 0;      // server-minted, unique per process
+  uint32_t verb = 0;         // wire msg_type
+  // bit 0: deadline-shed, bit 1: stale-map-shed, bit 2: non-OK status
+  uint32_t flags = 0;
+  int64_t start_unix_us = 0;  // wall clock at request arrival
+  uint32_t queue_us = 0;      // arrival → dispatch pickup
+  uint32_t decode_us = 0;
+  uint32_t exec_us = 0;
+  uint32_t serialize_us = 0;  // encode + reply write
+};
+
+class ServerTraceStats {
+ public:
+  // Histogram axes. Verb slots index the hist matrix; phases follow the
+  // request's wire lifecycle. kTraceBuckets log2-µs bounds (1µs, 2µs,
+  // ... 2^23µs ≈ 8.4s) + one overflow bucket.
+  static constexpr int kTraceVerbs = 6;    // execute, apply_delta,
+                                           // get_delta, get_delta_log,
+                                           // set_ownership, meta
+  static constexpr int kTracePhases = 4;   // queue, decode, exec, ser
+  static constexpr int kTraceBuckets = 24;
+  static constexpr size_t kRingCap = 8192;
+
+  // msg_type → verb slot, -1 for untracked verbs (ping, hello, ...).
+  static int VerbSlot(uint32_t msg_type);
+
+  void Observe(int verb_slot, int phase, uint64_t us);
+  // Ring append (only requests that carried a trace id land here).
+  void Record(const ServerTraceRecord& rec);
+  // Read-and-clear the span ring (the harness dumps once per run).
+  void Drain(std::vector<ServerTraceRecord>* out);
+  // Copy one (verb, phase) histogram: *n, *sum_us, counts[kTraceBuckets+1].
+  bool HistSnapshot(int verb_slot, int phase, uint64_t* n,
+                    uint64_t* sum_us, uint64_t* counts) const;
+  uint64_t NextSpanId() { return next_span_.fetch_add(1); }
+
+ private:
+  struct Hist {
+    std::atomic<uint64_t> n{0};
+    std::atomic<uint64_t> sum_us{0};
+    std::atomic<uint64_t> counts[kTraceBuckets + 1] = {};
+  };
+  Hist hist_[kTraceVerbs][kTracePhases];
+  std::atomic<uint64_t> next_span_{1};
+  mutable std::mutex ring_mu_;
+  std::deque<ServerTraceRecord> ring_;
+};
+ServerTraceStats& GlobalServerTraceStats();
 
 // ---------------------------------------------------------------------------
 // Per-call deadline propagation (protocol v2, hello feature kFeatDeadline).
@@ -410,9 +509,13 @@ class RpcChannel : public std::enable_shared_from_this<RpcChannel> {
   // map_epoch > 0 stamps the ownership-map epoch the caller ROUTED
   // with (captured at query-run start, not read live — see
   // QueryEnv.map_epoch) so a flipped shard refuses stale-map reads.
+  // trace.id != 0 stamps the caller's trace context (hello-negotiated
+  // kFeatTrace) so the shard's timing breakdown stitches under the
+  // client span; untraced calls are byte-unchanged.
   Status Call(uint32_t msg_type, const std::vector<char>& body,
               std::vector<char>* reply_body, int max_retries = 0,
-              int64_t deadline_abs_us = 0, uint64_t map_epoch = 0);
+              int64_t deadline_abs_us = 0, uint64_t map_epoch = 0,
+              WireTrace trace = {});
 
   // Async mux submission: invokes done(status, reply) when the reply
   // frame arrives (or the connection dies). Requires mux mode; without
@@ -449,7 +552,8 @@ class RpcChannel : public std::enable_shared_from_this<RpcChannel> {
   int Connect();
   Status MuxCall(uint32_t msg_type, const std::vector<char>& body,
                  std::vector<char>* reply_body, int max_retries,
-                 int64_t deadline_abs_us, uint64_t map_epoch);
+                 int64_t deadline_abs_us, uint64_t map_epoch,
+                 WireTrace trace);
   // One hedged sync mux call: primary leg on `conn`; past hedge_us
   // without a reply, the same request fires on a second connection and
   // the first reply wins (the loser is abandoned by request_id).
@@ -457,7 +561,8 @@ class RpcChannel : public std::enable_shared_from_this<RpcChannel> {
                        int slots, uint32_t msg_type,
                        const std::vector<char>& body,
                        std::vector<char>* reply_body, int64_t hedge_us,
-                       int64_t deadline_abs_us, uint64_t map_epoch);
+                       int64_t deadline_abs_us, uint64_t map_epoch,
+                       WireTrace trace);
   // Mux slot for the next call: p2c over (inflight, EWMA latency) when
   // configured, else round-robin. `avoid` >= 0 excludes that slot (the
   // hedge leg must take a different wire path).
@@ -648,14 +753,17 @@ class ClientManager {
   // Blocking execute on one shard. deadline_abs_us > 0 propagates the
   // caller's remaining budget inside the v2 request frame (see
   // RpcChannel::Call); map_epoch > 0 stamps the run-start ownership-
-  // map epoch — the QueryEnv plumbs both from the query's entry point
-  // down to every REMOTE sub-call.
+  // map epoch; trace stamps the caller's wire trace context — the
+  // QueryEnv plumbs all three from the query's entry point down to
+  // every REMOTE sub-call.
   Status Execute(int shard, const ExecuteRequest& req, ExecuteReply* rep,
-                 int64_t deadline_abs_us = 0, uint64_t map_epoch = 0);
+                 int64_t deadline_abs_us = 0, uint64_t map_epoch = 0,
+                 WireTrace trace = {});
   // Async: schedules on the global pool, invokes done on completion.
   void ExecuteAsync(int shard, ExecuteRequest req,
                     std::function<void(Status, ExecuteReply)> done,
-                    int64_t deadline_abs_us = 0, uint64_t map_epoch = 0);
+                    int64_t deadline_abs_us = 0, uint64_t map_epoch = 0,
+                    WireTrace trace = {});
 
   // ---- streaming deltas ----
   // Highest graph epoch observed on any reply from any shard (passive:
@@ -685,7 +793,8 @@ class ClientManager {
   Status ReplicaHedgedExecute(int shard, int alt,
                               std::shared_ptr<ByteWriter> body,
                               std::vector<char>* reply, int64_t hedge_us,
-                              int64_t deadline_abs_us, uint64_t map_epoch);
+                              int64_t deadline_abs_us, uint64_t map_epoch,
+                              WireTrace trace);
   // Decode + install a shard's re-fetched ShardMeta after a failover
   // channel swap, so proportional SAMPLE_SPLIT routing doesn't keep the
   // dead server's weight sums if the restarted shard serves changed

@@ -179,6 +179,14 @@ def _declare(lib: ctypes.CDLL) -> None:
         # run (remaining ms; <= 0 clears) — REMOTE sub-calls stamp the
         # remaining budget into their v2 request frames
         "etg_set_call_deadline_ms": (None, [ctypes.c_double]),
+        # cross-process tracing: per-thread (trace_id, parent_span)
+        # handoff for the next query run (trace_id 0 clears); server-
+        # side per-verb/phase timing histograms (out[27] = n, sum_us,
+        # counts[25]) and the traced-span ring dump (stride-10 u64
+        # records into an EtResult)
+        "etg_set_call_trace": (None, [u64, u64]),
+        "etg_server_trace_hist": (i32, [i32, i32, c_u64p]),
+        "etg_server_trace_dump": (i32, [c_voidp]),
         # streaming deltas: graph epoch + batched O(delta) apply +
         # dirty-set retrieval, on embedded handles (etg_*) and query
         # proxies (etq_* — local swaps the handle's graph, distribute

@@ -37,7 +37,8 @@ from euler_tpu.core.lib import EngineError, check
 __all__ = ["Query", "GraphService", "start_service", "compile_debug",
            "register_udf", "udf_cache_stats", "udf_cache_clear",
            "udf_cache_set_capacity", "edge_types_str", "wal_stats",
-           "push_ownership"]
+           "push_ownership", "server_trace_hist", "server_trace_spans",
+           "server_trace_chrome"]
 
 
 def edge_types_str(edge_types) -> str:
@@ -114,7 +115,8 @@ class Query:
 
     def run(self, gremlin: str,
             inputs: Optional[Dict[str, np.ndarray]] = None,
-            deadline_ms: Optional[float] = None
+            deadline_ms: Optional[float] = None,
+            trace: Optional[tuple] = None
             ) -> Dict[str, np.ndarray]:
         """Execute a chain; returns alias outputs ("name:i") + terminals.
 
@@ -122,7 +124,14 @@ class Query:
         shards (v2 frames carry it; a shard sheds a request whose
         budget expired before dispatch — counted deadline_shed, never a
         silent partial). Does not bound the call locally; local proxies
-        and v1 peers ignore it."""
+        and v1 peers ignore it.
+
+        trace: (trace_id, parent_span_id) wire trace context to stamp
+        into every REMOTE sub-call's v2 frame (hello-negotiated
+        kFeatTrace) — the shard records its queue/decode/execute/
+        serialize breakdown under it, so a merged chrome trace stitches
+        server time beneath the client span. None (or a 0 trace id)
+        stamps nothing: the wire stays byte-identical."""
         lib = self._lib
         eh = lib.etq_exec_new(self._h)
         if eh == 0:
@@ -132,6 +141,10 @@ class Query:
             # finally clears it so a failed run can't leak the budget
             # into the next deadline-less call on this thread
             lib.etg_set_call_deadline_ms(float(deadline_ms))
+        traced = trace is not None and int(trace[0]) != 0
+        if traced:
+            lib.etg_set_call_trace(int(trace[0]) & (2 ** 64 - 1),
+                                   int(trace[1]) & (2 ** 64 - 1))
         try:
             for name, arr in (inputs or {}).items():
                 a = np.ascontiguousarray(arr)
@@ -171,6 +184,8 @@ class Query:
         finally:
             if deadline_ms is not None and deadline_ms > 0:
                 lib.etg_set_call_deadline_ms(0.0)
+            if traced:
+                lib.etg_set_call_trace(0, 0)
             lib.etq_exec_free(eh)
 
     # -- streaming deltas --------------------------------------------------
@@ -385,6 +400,189 @@ class GraphService:
             _note_unexpected("graph_service_del", e)
 
 
+# ---------------------------------------------------------------------------
+# Server-side timing breakdown (cross-process tracing; etg_server_trace_*)
+# ---------------------------------------------------------------------------
+# Axis names — order must match rpc.h ServerTraceStats::VerbSlot and the
+# phase constants in rpc.cc's kExecute dispatch.
+_TRACE_VERBS = ("execute", "apply_delta", "get_delta", "get_delta_log",
+                "set_ownership", "meta")
+_TRACE_PHASES = ("queue", "decode", "execute", "serialize")
+# log2-µs bucket bounds: 1µs, 2µs, ... 2^23µs (~8.4s); index 24 is the
+# overflow bucket (mirrors ServerTraceStats::kTraceBuckets).
+_TRACE_BOUNDS_US = tuple(float(1 << i) for i in range(24))
+# ring-record flag bits (ServerTraceRecord.flags)
+TRACE_FLAG_DEADLINE_SHED = 1
+TRACE_FLAG_STALE_MAP_SHED = 2
+TRACE_FLAG_ERROR = 4
+
+
+def server_trace_hist(verb: str = "execute",
+                      phase: str = "queue") -> dict:
+    """One native per-verb/per-phase server timing histogram (always
+    on — no negotiation, no Python in the measurement path): the
+    queue-wait / decode / execute / serialize breakdown every request
+    through this process's GraphServers lands in. Returns {count,
+    sum_us, buckets: [[le_us, count], ...]} with raw (non-cumulative)
+    per-bucket counts; non-"execute" verbs record queue + execute
+    only."""
+    lib = _libmod.load()
+    out = np.zeros(27, dtype=np.uint64)
+    check(lib, lib.etg_server_trace_hist(
+        _TRACE_VERBS.index(verb), _TRACE_PHASES.index(phase),
+        out.ctypes.data_as(_libmod.c_u64p)))
+    counts = [int(v) for v in out[2:]]
+    return {"count": int(out[0]), "sum_us": int(out[1]),
+            "buckets": [[le, c] for le, c in
+                        zip(list(_TRACE_BOUNDS_US) + ["+Inf"], counts)]}
+
+
+def server_trace_spans() -> list:
+    """Drain the bounded server-side span ring: one dict per request
+    that carried a wire trace context (kFeatTrace), with the
+    queue/decode/execute/serialize breakdown in µs, the client's
+    trace/parent-span ids, and the server-minted span id. Read-and-
+    clear — the harness dumps once per run."""
+    lib = _libmod.load()
+    res = lib.etres_new()
+    try:
+        check(lib, lib.etg_server_trace_dump(res))
+        n = lib.etres_u64_len(res)
+        flat = (np.ctypeslib.as_array(lib.etres_u64(res), (n,)).copy()
+                if n else np.zeros(0, dtype=np.uint64))
+    finally:
+        lib.etres_free(res)
+    out = []
+    for i in range(0, flat.size, 10):
+        r = flat[i:i + 10]
+        out.append({
+            "trace_id": int(r[0]), "parent_span": int(r[1]),
+            "span_id": int(r[2]), "verb": int(r[3]), "flags": int(r[4]),
+            "start_unix_us": int(r[5]), "queue_us": int(r[6]),
+            "decode_us": int(r[7]), "exec_us": int(r[8]),
+            "serialize_us": int(r[9]),
+        })
+    return out
+
+
+def server_trace_chrome(path: str, spans: Optional[list] = None) -> str:
+    """Export the server-side span ring (drained, unless `spans` from a
+    prior server_trace_spans() call is given) as chrome://tracing JSON:
+    per request one "server:execute" parent span with its queue_wait /
+    decode / execute / serialize children laid out sequentially, each
+    request on its own chrome tid so concurrent requests never
+    corrupt nesting. args carry trace_id / parent_span (the CLIENT
+    span) / span_id, so tools/trace_dump.py --merge stitches these
+    under the client's graph_rpc spans on one timeline.
+    otherData.epoch_unix anchors ts=0 on the wall clock, the same
+    convention Tracer.chrome_trace uses."""
+    import json as _json
+    import os as _os
+    import tempfile as _tempfile
+
+    if spans is None:
+        spans = server_trace_spans()
+    epoch_us = min((s["start_unix_us"] for s in spans), default=0)
+    pid = _os.getpid()
+    events = []
+    for s in spans:
+        base = s["start_unix_us"] - epoch_us
+        tid = s["span_id"] & 0xFFFFFFFF
+        args = {"trace_id": s["trace_id"], "parent_span": s["parent_span"],
+                "span_id": s["span_id"], "flags": s["flags"]}
+        total = (s["queue_us"] + s["decode_us"] + s["exec_us"]
+                 + s["serialize_us"])
+        name = _TRACE_VERBS[0] if s["verb"] == 0 else f"verb{s['verb']}"
+        events.append({"name": f"server:{name}", "ph": "X", "cat": "srv",
+                       "ts": base, "dur": total, "pid": pid, "tid": tid,
+                       "args": args})
+        off = 0
+        for phase, key in (("queue_wait", "queue_us"),
+                           ("decode", "decode_us"),
+                           ("execute", "exec_us"),
+                           ("serialize", "serialize_us")):
+            if s[key] == 0 and phase != "queue_wait":
+                continue
+            events.append({"name": phase, "ph": "X", "cat": "srv",
+                           "ts": base + off, "dur": s[key], "pid": pid,
+                           "tid": tid, "args": {"trace_id": s["trace_id"]}})
+            off += s[key]
+    trace = {"traceEvents": events, "displayTimeUnit": "ms",
+             "otherData": {"epoch_unix": epoch_us / 1e6,
+                           "exporter": "euler_tpu.gql.server_trace"}}
+    d = _os.path.dirname(_os.path.abspath(path)) or "."
+    fd, tmp = _tempfile.mkstemp(
+        prefix=_os.path.basename(path) + ".", suffix=".tmp", dir=d)
+    with _os.fdopen(fd, "w") as f:
+        _json.dump(trace, f)
+    _os.replace(tmp, path)
+    return path
+
+
+_server_trace_obs_done = False
+_server_trace_obs_mu = threading.Lock()
+
+
+def _ensure_server_trace_obs() -> None:
+    """Bridge the native per-verb server timing histograms into obs
+    gauges (the etg_rpc_stats → rpc_* pattern), once per process, on
+    the first start_service: a /metrics scrape of one shard process
+    then shows queue-wait and execute quantiles measured entirely in
+    the native layer. Per (verb, phase): graph_server_phase_us_count /
+    _sum, and bucket-interpolated p50/p99/p999 as
+    graph_server_phase_ms_quantile{verb,phase,q}."""
+    global _server_trace_obs_done
+    with _server_trace_obs_mu:
+        if _server_trace_obs_done:
+            return
+        from euler_tpu import obs as _obs
+
+        reg = _obs.default_registry()
+        g_count = reg.gauge(
+            "graph_server_phase_us_count",
+            "server-side per-request phase observations (native)",
+            ("verb", "phase"))
+        g_sum = reg.gauge(
+            "graph_server_phase_us_sum",
+            "server-side per-request phase time total, µs (native)",
+            ("verb", "phase"))
+        g_q = reg.gauge(
+            "graph_server_phase_ms_quantile",
+            "server-side phase latency quantile, ms "
+            "(bucket-interpolated from the native log2-µs histogram)",
+            ("verb", "phase", "q"))
+
+        from euler_tpu.obs.metrics import bucket_quantile
+
+        def _collect():
+            for verb in _TRACE_VERBS:
+                for phase in _TRACE_PHASES:
+                    if verb != "execute" and phase in ("decode",
+                                                       "serialize"):
+                        continue  # never observed for these verbs
+                    h = server_trace_hist(verb, phase)
+                    if h["count"] == 0:
+                        continue
+                    g_count.labels(verb=verb, phase=phase).set(h["count"])
+                    g_sum.labels(verb=verb, phase=phase).set(h["sum_us"])
+                    counts = [c for _, c in h["buckets"]]
+                    for q in (0.5, 0.99, 0.999):
+                        v = bucket_quantile(counts, _TRACE_BOUNDS_US, q)
+                        if v is not None:
+                            g_q.labels(verb=verb, phase=phase,
+                                       q=str(q)).set(v / 1000.0)
+
+        reg.add_collector(_collect)
+        _obs.register_health(
+            "graph_server_trace",
+            lambda: {"execute_queue": server_trace_hist("execute", "queue")
+                     ["count"],
+                     "execute_exec": server_trace_hist("execute", "execute")
+                     ["count"]})
+        # flag only after every registration succeeded (wal-obs pattern)
+        _server_trace_obs_done = True
+
+
 # native durability counter layout (etg_wal_stats) — order must match
 # capi.cc. `degraded` is a gauge counting the process's degraded wal
 # INSTANCES (shards currently refusing deltas because their log is
@@ -484,6 +682,9 @@ def start_service(data_dir: str, shard_idx: int = 0, shard_num: int = 1,
             f"{wal_fsync!r}")
     if wal_dir:
         _ensure_wal_obs()
+    # every serving shard process exposes its native timing breakdown
+    # (queue-wait/execute quantiles) on /metrics — no opt-in needed
+    _ensure_server_trace_obs()
     h = lib.ets_start2(data_dir.encode(), shard_idx, shard_num, port,
                        registry_dir.encode(), host.encode(),
                        index_spec.encode(), wal_dir.encode(),

@@ -121,7 +121,11 @@ _RPC_STAT_KEYS = (
     # routed on a superseded ownership map); replica_hedge_* count
     # ClientManager's cross-replica races (hedge_replicas)
     "stale_map_shed", "replica_hedge_fired", "replica_hedge_won",
-    "replica_hedge_wasted")
+    "replica_hedge_wasted",
+    # cross-process tracing: kExecute requests stamped with a wire
+    # trace context (zero with tracing off / against pre-trace peers —
+    # the wire-identity pins read exactly this)
+    "trace_propagated")
 
 # Last config applied through configure_rpc (the native side has no
 # getter). RemoteGraphEngine reads `mux` to default pool_shared.
@@ -599,17 +603,20 @@ class RemoteGraphEngine:
     # degrade=True must not accumulate threads/sockets without limit
     _MAX_STRAYS = 32
 
-    def _attempt(self, gql: str, feed, query=None, deadline_ms=None):
+    def _attempt(self, gql: str, feed, query=None, deadline_ms=None,
+                 trace=None):
         """One query attempt, bounded by retry.call_timeout_s when set
         (the RPC sockets block, so a black-holed connection can only be
         escaped by abandoning the attempt thread). `query` selects a
-        pooled handle; None uses the engine's own. deadline_ms rides to
-        the shards inside the v2 frames (Query.run)."""
+        pooled handle; None uses the engine's own. deadline_ms and the
+        wire trace context ride to the shards inside the v2 frames
+        (Query.run)."""
         query = query if query is not None else self.query
         t = self.retry.call_timeout_s
         t_att = time.monotonic()
         if not t or t <= 0:
-            out = query.run(gql, feed, deadline_ms=deadline_ms)
+            out = query.run(gql, feed, deadline_ms=deadline_ms,
+                            trace=trace)
             self._hist_attempt_ms.observe(
                 (time.monotonic() - t_att) * 1000.0)
             return out
@@ -626,7 +633,8 @@ class RemoteGraphEngine:
 
         def work():
             try:
-                box["out"] = query.run(gql, feed, deadline_ms=deadline_ms)
+                box["out"] = query.run(gql, feed, deadline_ms=deadline_ms,
+                                       trace=trace)
             except BaseException as e:  # surfaced on join below
                 box["err"] = e
 
@@ -677,6 +685,12 @@ class RemoteGraphEngine:
                              engine=self._obs_name, gql=gql[:80]) as sp:
             deadline = time.monotonic() + max(pol.deadline_s, 0.0)
             attempt = 0
+            # wire trace context: every attempt (and every hedge leg the
+            # native layer fires) carries THIS span's (trace_id,
+            # span_id), so the shards' timing breakdowns stitch under
+            # the graph_rpc span in a merged chrome trace. 0 when
+            # tracing is disabled — nothing is stamped on the wire.
+            wire_trace = (sp.trace_id, sp.span_id)
             while True:
                 try:
                     dl_ms = None
@@ -686,7 +700,8 @@ class RemoteGraphEngine:
                         dl_ms = max(
                             (deadline - time.monotonic()) * 1000.0, 1.0)
                     out = self._attempt(gql, feed, query,
-                                        deadline_ms=dl_ms)
+                                        deadline_ms=dl_ms,
+                                        trace=wire_trace)
                     if attempt:
                         # the call came back after ≥1 transport failure:
                         # the shard (or its replacement channel)

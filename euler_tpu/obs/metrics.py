@@ -38,7 +38,7 @@ import threading
 from typing import Dict, Optional, Sequence, Tuple
 
 __all__ = ["Registry", "Counter", "Gauge", "Histogram", "log2_buckets",
-           "DEFAULT_MS_BUCKETS", "snapshot_delta"]
+           "DEFAULT_MS_BUCKETS", "snapshot_delta", "bucket_quantile"]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -54,6 +54,33 @@ def log2_buckets(lo: float = 0.001, count: int = 24) -> Tuple[float, ...]:
 
 
 DEFAULT_MS_BUCKETS = log2_buckets()
+
+
+def bucket_quantile(counts, bounds, q: float) -> Optional[float]:
+    """Bucket-interpolated quantile over RAW (non-cumulative) per-bucket
+    counts — the Prometheus histogram_quantile convention: find the
+    bucket holding the q-th observation and interpolate linearly inside
+    its [lower, upper] bounds; the first bucket interpolates from 0 and
+    the +Inf bucket clamps to the last finite bound. None when empty.
+    `counts` may have one more entry than `bounds` (the overflow
+    bucket). Shared by _HistogramChild.quantile and the native
+    server-trace bridge (euler_tpu.gql.server_trace_hist)."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    n = sum(counts)
+    if n == 0:
+        return None
+    target = q * n
+    cum = 0.0
+    lower = 0.0
+    for le, c in zip(bounds, counts):
+        if cum + c >= target and c > 0:
+            frac = (target - cum) / c
+            return lower + (le - lower) * min(max(frac, 0.0), 1.0)
+        cum += c
+        lower = le
+    # target lands in the overflow bucket: clamp to the last finite edge
+    return float(bounds[-1])
 
 
 def _fmt(v: float) -> str:
@@ -169,24 +196,9 @@ class _HistogramChild:
         Exact for values ON bucket edges, within one bucket's width
         otherwise — good enough for adaptive hedge delays and p2c,
         which only need the tail's order of magnitude."""
-        if not 0.0 <= q <= 1.0:
-            raise ValueError(f"quantile must be in [0, 1], got {q}")
         with self._mu:
             counts = list(self._counts)
-            n = self._n
-        if n == 0:
-            return None
-        target = q * n
-        cum = 0.0
-        lower = 0.0
-        for le, c in zip(self.bounds, counts):
-            if cum + c >= target and c > 0:
-                frac = (target - cum) / c
-                return lower + (le - lower) * min(max(frac, 0.0), 1.0)
-            cum += c
-            lower = le
-        # target lands in the +Inf bucket: clamp to the last finite edge
-        return float(self.bounds[-1])
+        return bucket_quantile(counts, self.bounds, q)
 
 
 class _Metric:

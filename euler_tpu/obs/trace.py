@@ -26,6 +26,8 @@ import collections
 import itertools
 import json
 import os
+import random
+import tempfile
 import threading
 import time
 from typing import Dict, List, Optional
@@ -39,6 +41,7 @@ class _NullSpan:
     __slots__ = ()
     span_id = 0
     parent_id = 0
+    trace_id = 0
 
     def __enter__(self):
         return self
@@ -55,10 +58,16 @@ NULL_SPAN = _NullSpan()
 
 class Span:
     """One wall-clock interval. Use as a context manager; `set(**attrs)`
-    attaches attributes mid-flight (they export under chrome `args`)."""
+    attaches attributes mid-flight (they export under chrome `args`).
+
+    `trace_id` correlates spans ACROSS processes: a root span (no parent
+    on its thread) draws a fresh process-unique 64-bit trace id at
+    __enter__, children inherit their parent's. The graph client stamps
+    (trace_id, span_id) into v2 request frames so a shard's server-side
+    timing breakdown stitches under this span in a merged trace."""
 
     __slots__ = ("_tracer", "name", "attrs", "span_id", "parent_id",
-                 "_t0", "ts_us", "dur_us", "tid")
+                 "trace_id", "_t0", "ts_us", "dur_us", "tid")
 
     def __init__(self, tracer: "Tracer", name: str, attrs: Dict):
         self._tracer = tracer
@@ -66,6 +75,7 @@ class Span:
         self.attrs = attrs
         self.span_id = next(tracer._ids)
         self.parent_id = 0
+        self.trace_id = 0
         self._t0 = 0.0
         self.ts_us = 0.0
         self.dur_us = 0.0
@@ -80,6 +90,11 @@ class Span:
         stack = tr._stack()
         if stack:
             self.parent_id = stack[-1].span_id
+            self.trace_id = stack[-1].trace_id
+        else:
+            # a new root: fresh trace id (process-unique base + counter
+            # so two processes' traces can never collide in a merge)
+            self.trace_id = tr._trace_base + next(tr._trace_ids)
         stack.append(self)
         self.tid = threading.get_ident()
         self._t0 = time.perf_counter()
@@ -106,6 +121,10 @@ class Tracer:
         self._mu = threading.Lock()
         self._tls = threading.local()
         self._ids = itertools.count(1)
+        # trace-id space: 64-bit random base (never 0) + counter — ids
+        # stay unique across the processes a merged trace combines
+        self._trace_base = (random.getrandbits(63) | (1 << 62)) & ~0xFFFFF
+        self._trace_ids = itertools.count(1)
         self._epoch = time.perf_counter()
         self._epoch_unix = time.time()
         self.enabled = True
@@ -148,14 +167,27 @@ class Tracer:
     # -- export ------------------------------------------------------------
     def chrome_trace(self) -> Dict:
         """Trace Event Format dict: complete ("ph": "X") events with
-        microsecond ts/dur, one chrome 'thread' per real thread, span
-        ids/parents under args. Loadable by chrome://tracing and
-        Perfetto as-is."""
+        microsecond ts/dur, one chrome 'thread' per real thread, span/
+        trace ids and parents under args. Loadable by chrome://tracing
+        and Perfetto as-is; `otherData.epoch_unix` anchors ts=0 on the
+        wall clock so tools/trace_dump.py --merge can align exports
+        from different processes onto one timeline.
+
+        Safe under concurrent recording: the ring is snapshotted under
+        the tracer lock and each span's attrs dict is copied before
+        iteration (a recording thread may still be attaching attributes
+        to a span another thread is exporting — the harness dumps
+        traces while load is draining)."""
         pid = os.getpid()
         events = []
         for s in self.spans():
-            args = {"span_id": s.span_id, "parent_id": s.parent_id}
-            for k, v in s.attrs.items():
+            args = {"span_id": s.span_id, "parent_id": s.parent_id,
+                    "trace_id": s.trace_id}
+            # dict(...) snapshots attrs: iterating the live dict races
+            # a concurrent sp.set() ("dict changed size during
+            # iteration"). The copy itself is safe — dict reads/writes
+            # are GIL-atomic per op and copy retries internally.
+            for k, v in dict(s.attrs).items():
                 args[k] = v if isinstance(v, (int, float, bool, str)) \
                     or v is None else str(v)
             events.append({
@@ -176,10 +208,23 @@ class Tracer:
     def export(self, path: str) -> str:
         """Write chrome_trace() JSON to `path` (atomic rename). Returns
         the path; view with chrome://tracing, ui.perfetto.dev, or
-        `python tools/trace_dump.py <path>`."""
+        `python tools/trace_dump.py <path>`. Concurrency-safe: the temp
+        file is unique per call (two threads exporting to the same path
+        used to share one ".tmp" and could interleave writes into a
+        corrupt file), and recording threads may keep appending spans
+        throughout."""
         trace = self.chrome_trace()
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(trace, f)
-        os.replace(tmp, path)
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".",
+                                   suffix=".tmp", dir=d)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(trace, f)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         return path

@@ -1,0 +1,152 @@
+"""Acceptance-harness tests (ISSUE 14 tentpole, ROADMAP item 5).
+
+The fast smoke runs the REAL harness end to end at tiny sizes — load
+generator, streaming delta, fine-tune, sharded export, rolling swap,
+chaos schedule (wire cut, replica restart, stale-map flip) — and pins:
+
+  * every SLO gate passes and ``accept.json`` is schema-valid (the
+    artifact stays machine-diffable across PRs);
+  * the merged chrome trace stitches at least one client span to its
+    server-side breakdown across the wire, with a hedged leg and a
+    stale-map-refused attempt visible;
+  * the schema validator actually rejects malformed artifacts.
+
+The full chaos schedule (subprocess graph shard SIGKILLed mid-delta,
+WAL + peer-catch-up recovery inside the gated bound) is ``slow``.
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from euler_tpu.graph import configure_rpc
+
+pytestmark = pytest.mark.accept
+
+
+@pytest.fixture(autouse=True)
+def _restore_rpc_config():
+    yield
+    configure_rpc(mux=False, connections=1, compress_threshold=0,
+                  max_inflight=256, hedge_delay_ms=0.0, p2c=False,
+                  hedge_replicas=False)
+
+
+def _args(tmp_path, **over):
+    # the CLI's config surface at smoke scale
+    ns = argparse.Namespace(
+        nodes=280, dim=12, train_steps=2, load_s=6.0, rps=30.0,
+        threads=3, mix_knn=0.6, q=6, k=8, inject_ms=2.0,
+        slo_p99_ms=500.0, slo_p999_ms=2000.0, slo_shed_rate=0.05,
+        degraded_budget=0, recovery_bound_s=45.0, chaos=True,
+        full=False, out=str(tmp_path / "accept_out"), record=False)
+    for k, v in over.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_accept_smoke_passes_and_artifact_is_valid(tmp_path):
+    """The in-process harness (chaos schedule minus the SIGKILL drill)
+    passes every SLO gate and emits a schema-valid accept.json whose
+    merged trace shows client→server stitching, a hedged leg, and a
+    retried stale-map read."""
+    from tools import accept
+
+    result = accept.run_accept(_args(tmp_path))
+    assert result["pass"], result["gates"]
+    # the artifact on disk is the same verdict, schema-valid
+    on_disk = json.loads((tmp_path / "accept_out" /
+                          "accept.json").read_text())
+    assert accept.validate_accept(on_disk) == []
+    assert on_disk["pass"] is True
+    assert on_disk["gates"]["lost_without_status"]["value"] == 0
+    assert on_disk["gates"]["stale_reads"]["value"] == 0
+
+    # cross-process observability: ≥1 trace id appears on BOTH sides
+    # of the wire, a hedged pair of server spans shares one client
+    # span, and the stale-map-refused attempt was traced
+    tr = on_disk["trace"]
+    assert tr["stitched_trace_ids"] >= 1
+    assert tr["hedged_leg_groups"] >= 1
+    assert tr["stale_refusals_traced"] >= 1
+    assert on_disk["chaos"]["stale_map"]["retries_counted"] >= 1
+    assert on_disk["chaos"]["wire_cut"]["cuts_fired"] >= 1
+    assert on_disk["chaos"]["wire_cut"]["surfaced_as_status"] is True
+    # the streaming round made it to serving mid-load
+    assert on_disk["streaming"]["served_version"] == "v2"
+    assert on_disk["streaming"]["new_node_served"] is True
+
+    # the merged trace file itself: loadable, stitches, and the server
+    # breakdown exposes queue-wait + execute as distinct child spans
+    from tools import trace_dump
+
+    merged = trace_dump.load_trace(
+        str(tmp_path / "accept_out" / "accept_trace.json"))
+    st = trace_dump.stitch_summary(merged)
+    assert st["stitched"] >= 1
+    names = {e["name"] for e in merged["traceEvents"]
+             if e.get("cat") == "srv"}
+    assert "queue_wait" in names and "execute" in names
+    assert any(e["name"] == "graph_rpc" for e in merged["traceEvents"])
+
+
+def test_accept_schema_validator_rejects_malformed(tmp_path):
+    """validate_accept flags the failure modes a drifting artifact
+    would exhibit — missing keys, missing gates, pass/gates
+    disagreement — so the cross-PR diff never silently reads a broken
+    file."""
+    from tools import accept
+
+    good = {
+        "schema_version": accept.SCHEMA_VERSION, "mode": "smoke",
+        "config": {}, "phases": {},
+        "serving": {"requests": 1, "lost": 0, "shed": 0},
+        "graph": {}, "streaming": {}, "chaos": {}, "trace": {},
+        "gates": {g: {"value": 0, "gate": 0, "ok": True}
+                  for g in accept._GATE_KEYS},
+        "pass": True,
+    }
+    assert accept.validate_accept(good) == []
+
+    bad = dict(good)
+    bad.pop("gates")
+    assert any("gates" in p for p in accept.validate_accept(bad))
+
+    bad = dict(good, schema_version=99)
+    assert any("schema_version" in p for p in accept.validate_accept(bad))
+
+    bad = dict(good, gates={g: {"value": 0, "gate": 0, "ok": True}
+                            for g in accept._GATE_KEYS
+                            if g != "stale_reads"})
+    assert any("stale_reads" in p for p in accept.validate_accept(bad))
+
+    # pass must agree with the gates
+    gates = {g: {"value": 0, "gate": 0, "ok": True}
+             for g in accept._GATE_KEYS}
+    gates["p99_ms"] = {"value": 9e9, "gate": 1, "ok": False}
+    bad = dict(good, gates=gates)  # still claims pass=True
+    assert any("disagrees" in p for p in accept.validate_accept(bad))
+
+    assert accept.validate_accept([]) != []
+
+
+@pytest.mark.slow
+def test_accept_full_chaos_schedule(tmp_path):
+    """The full schedule: a SUBPROCESS graph shard is SIGKILLed
+    mid-delta and recovers (WAL replay + peer catch-up) inside the
+    gated recovery bound; the merged trace combines three per-process
+    files (driver / in-process server ring / subprocess shard)."""
+    from tools import accept
+
+    result = accept.run_accept(_args(
+        tmp_path, full=True, load_s=18.0, nodes=320))
+    assert result["pass"], result["gates"]
+    assert result["chaos"]["sigkill"]["recovery_s"] <= 45.0
+    assert result["gates"]["recovery_s"]["ok"] is True
+    assert not result["gates"]["recovery_s"].get("skipped")
+    assert result["trace"]["merged_files"] == 3

@@ -277,6 +277,82 @@ def test_chrome_trace_json_fields(tmp_path):
     assert child["dur"] >= 1000  # the 1ms sleep, in µs
 
 
+def test_trace_ids_roots_fresh_children_inherit():
+    """Cross-process correlation ids (ISSUE 14): a ROOT span draws a
+    fresh nonzero trace id, children inherit it, the next root gets a
+    different one, and chrome args carry it on every event."""
+    tr = obs.Tracer()
+    with tr.span("root1") as a:
+        assert a.trace_id != 0
+        with tr.span("child") as b:
+            assert b.trace_id == a.trace_id
+    with tr.span("root2") as c:
+        assert c.trace_id not in (0, a.trace_id)
+    ev = tr.chrome_trace()["traceEvents"]
+    assert all("trace_id" in e["args"] for e in ev)
+    ids = {e["args"]["trace_id"] for e in ev}
+    assert len(ids) == 2  # two traces, child shares root1's
+    # two tracers (≈ two processes) never collide in a merge
+    other = obs.Tracer()
+    with other.span("elsewhere") as d:
+        pass
+    assert d.trace_id not in ids
+
+
+def test_tracer_export_under_concurrent_recording(tmp_path):
+    """ISSUE 14 satellite pin: chrome_trace()/export() while recording
+    threads are still appending (and mutating span attrs via set()) —
+    the harness dumps traces while load is draining. Every export must
+    succeed and leave parseable JSON; concurrent exports to the SAME
+    path must never corrupt each other (the shared-.tmp race)."""
+    tr = obs.Tracer(capacity=4096)
+    stop = threading.Event()
+    errs = []
+
+    def recorder(widx):
+        i = 0
+        try:
+            while not stop.is_set():
+                with tr.span("work", w=widx) as sp:
+                    sp.set(i=i, extra=f"e{i}")
+                i += 1
+        except BaseException as e:  # pragma: no cover - diagnostics
+            errs.append(e)
+
+    path = str(tmp_path / "live.json")
+
+    def exporter():
+        try:
+            for _ in range(15):
+                tr.export(path)
+        except BaseException as e:  # pragma: no cover - diagnostics
+            errs.append(e)
+
+    recs = [threading.Thread(target=recorder, args=(w,))
+            for w in range(3)]
+    exps = [threading.Thread(target=exporter) for _ in range(2)]
+    for t in recs + exps:
+        t.start()
+    try:
+        for _ in range(15):
+            p = tr.export(path)
+            with open(p) as f:
+                trace = json.load(f)  # parseable EVERY time
+            assert "traceEvents" in trace
+    finally:
+        stop.set()
+        for t in recs + exps:
+            t.join(timeout=10)
+    assert not errs, errs
+    assert not any(t.is_alive() for t in recs + exps)
+    # final export is complete and well-formed
+    final = json.load(open(tr.export(path)))
+    assert all(e["ph"] == "X" for e in final["traceEvents"])
+    # no .tmp litter from the concurrent exports
+    leftovers = [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    assert leftovers == []
+
+
 def test_disabled_span_is_shared_noop():
     tr = obs.Tracer()
     tr.enabled = False

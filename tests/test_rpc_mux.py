@@ -47,7 +47,7 @@ pytestmark = pytest.mark.rpc_mux
 def _restore_rpc_config():
     yield
     configure_rpc(mux=False, connections=1, compress_threshold=0,
-                  max_inflight=256)
+                  max_inflight=256, hedge_delay_ms=0.0, p2c=False)
 
 
 def _quantized_graph(tmp_path, n=64, dim=32):
@@ -383,6 +383,130 @@ def test_mux_shard_kill_restart_failover(tmp_path):
         assert not errs, errs
         assert done[0] >= 4
         assert eng.health()["failovers"] >= 1
+    finally:
+        eng.close()
+        for s in servers:
+            s.stop()
+
+
+def test_trace_off_and_pre_trace_peer_byte_identical(tmp_path):
+    """Wire identity for the tracing feature (ISSUE 14): (a) with span
+    recording DISABLED, a traced-capable mux client stamps nothing —
+    per-call wire bytes match exactly and trace_propagated never moves;
+    (b) re-enabling obs adds exactly the 16-byte hello-negotiated trace
+    prefix per kExecute; (c) against a PRE-TRACE peer (the v1-only
+    binary emulation — the strictest downgrade) every knob ON still
+    stamps nothing and results match a plain v1 client byte for byte."""
+    d, ids = _quantized_graph(tmp_path)
+    servers, eps = _cluster(d, shards=1)
+    configure_rpc(mux=True, connections=1)
+    try:
+        obs.disable()
+        eng = RemoteGraphEngine(eps, seed=11)
+        eng.get_dense_feature(ids, [0], [32])  # warm (dial + hello)
+
+        def call_bytes():
+            s0 = rpc_transport_stats()
+            eng.get_dense_feature(ids, [0], [32])
+            s1 = rpc_transport_stats()
+            return (s1["bytes_sent"] - s0["bytes_sent"],
+                    s1["trace_propagated"] - s0["trace_propagated"])
+
+        base_bytes, base_traced = call_bytes()
+        assert base_traced == 0
+        again_bytes, _ = call_bytes()
+        assert again_bytes == base_bytes  # deterministic wire size
+
+        obs.enable()
+        traced_bytes, traced = call_bytes()
+        assert traced == 1
+        # exactly the u64 trace_id | u64 parent_span prefix, once
+        assert traced_bytes == base_bytes + 16
+
+        obs.disable()
+        off_bytes, off_traced = call_bytes()
+        assert (off_bytes, off_traced) == (base_bytes, 0)
+        eng.close()
+    finally:
+        obs.enable()
+        for s in servers:
+            s.stop()
+
+    # (c) pre-trace peer: v1-only server, every knob ON
+    os.environ["EULER_TPU_RPC_SERVER_V1"] = "1"
+    try:
+        servers, eps = _cluster(d, shards=1)
+    finally:
+        del os.environ["EULER_TPU_RPC_SERVER_V1"]
+    try:
+        plain = RemoteGraphEngine(eps, seed=11)
+        ref = plain.get_dense_feature(ids, [0], [32])[0]
+        configure_rpc(mux=True, connections=2, hedge_delay_ms=0.05)
+        s0 = rpc_transport_stats()
+        eng = RemoteGraphEngine(eps, seed=11, deadline_propagation=True)
+        out = eng.get_dense_feature(ids, [0], [32])[0]
+        s1 = rpc_transport_stats()
+        assert np.array_equal(out, ref)
+        for k in ("trace_propagated", "hedge_fired", "hedge_won",
+                  "hedge_wasted", "deadline_propagated"):
+            assert s1[k] == s0[k], f"{k} moved against a pre-trace peer"
+        eng.close()
+        plain.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_hedged_legs_share_trace_id_distinct_span_ids(tmp_path):
+    """Both legs of a hedged kExecute carry the SAME client trace
+    context on the wire; the server mints a DISTINCT span id per
+    request — so the merged trace shows the hedge as two sibling
+    server spans under one client span."""
+    from euler_tpu.gql import server_trace_spans
+
+    # a read heavy enough (512×64 feature rows) that the reply can
+    # never beat the 50µs hedge delay — the race leg always fires
+    d, ids = _quantized_graph(tmp_path, n=512, dim=64)
+    servers, eps = _cluster(d, shards=1)
+    configure_rpc(mux=True, connections=2, hedge_delay_ms=0.05)
+    obs.enable()
+    eng = RemoteGraphEngine(eps, seed=11)
+    try:
+        s0 = rpc_transport_stats()
+        server_trace_spans()  # drain other tests' leftovers
+        for _ in range(20):
+            eng.get_dense_feature(ids, [0], [64])
+        s1 = rpc_transport_stats()
+        assert s1["hedge_fired"] > s0["hedge_fired"], \
+            "no hedge fired at a 50µs delay"
+        spans = server_trace_spans()
+        assert spans, "traced requests never reached the server ring"
+        groups = {}
+        for s in spans:
+            groups.setdefault((s["trace_id"], s["parent_span"]),
+                              []).append(s["span_id"])
+        multi = [v for v in groups.values() if len(v) > 1]
+        assert multi, "no hedged pair shares a client span"
+        for span_ids in multi:
+            # distinct server span ids per leg — never aliased
+            assert len(set(span_ids)) == len(span_ids)
+        # breakdown recorded on every ringed request
+        for s in spans:
+            assert s["trace_id"] != 0
+            assert s["start_unix_us"] > 0
+        # /metrics exposition carries the NATIVE per-verb phase
+        # histograms (queue-wait + execute quantiles measured with no
+        # Python in the loop — bridged like etg_rpc_stats → gauges)
+        text = obs.render_prometheus()
+        assert 'graph_server_phase_us_count{verb="execute",' \
+               'phase="queue"}' in text
+        assert 'graph_server_phase_us_count{verb="execute",' \
+               'phase="execute"}' in text
+        assert 'graph_server_phase_ms_quantile{verb="execute",' \
+               'phase="queue",q="0.99"}' in text
+        from euler_tpu.gql import server_trace_hist
+        h = server_trace_hist("execute", "queue")
+        assert h["count"] > 0 and len(h["buckets"]) == 25
     finally:
         eng.close()
         for s in servers:

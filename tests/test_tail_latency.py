@@ -403,7 +403,8 @@ def test_v1_interop_unchanged_with_knobs_on(tmp_path):
         s1 = rpc_transport_stats()
         assert np.array_equal(out, ref)
         assert s1["hello_fallbacks"] > s0["hello_fallbacks"]
-        for k in ("hedge_fired", "deadline_propagated", "deadline_shed"):
+        for k in ("hedge_fired", "deadline_propagated", "deadline_shed",
+                  "trace_propagated"):
             assert s1[k] == s0[k], f"{k} moved against a v1 server"
         eng.close()
         plain.close()

@@ -1,0 +1,914 @@
+#!/usr/bin/env python
+"""SLO-gated full-loop acceptance harness (ROADMAP item 5 — the
+production keystone).
+
+One run composes the WHOLE production loop against the real stack and
+GATES it on SLOs read off the obs registry, emitting one diffable
+``accept.json`` verdict artifact per run (the BENCH_*.json convention —
+perf/resilience regressions diff across PRs):
+
+  load generator → streaming graph deltas (durable WAL shards) →
+  fine-tune → sharded bundle export → rolling fleet hot-swap → serve
+  at a stated RPS mix, while a chaos schedule runs:
+
+    * chaos-proxy ``cut`` mode tears live wire frames mid-request
+      (surfaces as an explicit transport status; idempotent re-issue
+      converges);
+    * a serving replica restarts mid-traffic (client failover, nothing
+      lost without a status);
+    * an ownership-map flip lands on the shards before the client
+      refreshes (stale-map refusal → forced refresh → retry; zero
+      silent misroutes);
+    * ``--full`` only: a graph shard is SIGKILLed mid-delta-stream and
+      recovers from its WAL + peer catch-up inside the recovery bound.
+
+  SLO gates: p99 / p999 serving latency, shed rate, zero
+  lost-without-status (serving AND graph tiers), zero stale reads
+  (every stale-map refusal retried + post-swap visibility probes),
+  degraded-step budget, recovery-time bound, and a stitched-trace
+  check.
+
+Observability: the run is traced END TO END — client ``graph_rpc``
+spans (euler_tpu.obs) carry wire trace ids into the shards
+(kFeatTrace), whose native queue-wait/decode/execute/serialize
+breakdowns come back via the server span ring. The harness writes one
+trace file per process role (driver / graph-server ring / any
+subprocess shard) and merges them with tools/trace_dump.py into one
+chrome://tracing timeline keyed by trace id — a client span stitched
+to its server-side breakdown across the wire, hedged legs and
+stale-map-refused attempts included.
+
+Load model (2-CPU container convention, PERF.md): counters and counted
+order statistics are primary. Serving replicas inject a fixed
+per-flush apply latency (--inject_ms) standing in for a real device
+dispatch, so micro-batching and the latency gates measure something;
+the graph tier runs un-injected (reads are real C++ engine work).
+
+    python tools/accept.py                    # smoke (seconds)
+    python tools/accept.py --full --record    # full chaos schedule,
+                                              # perf.json `acceptance`
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from tools.bench_serve import lat_summary, slo_verdict  # noqa: E402
+from tools import trace_dump  # noqa: E402
+
+PERF_JSON = Path(__file__).resolve().parents[1] / "perf.json"
+SCHEMA_VERSION = 1
+
+# ---------------------------------------------------------------------------
+# accept.json schema (validated by the tier-1 smoke so the artifact
+# stays machine-diffable)
+# ---------------------------------------------------------------------------
+_TOP_KEYS = {
+    "schema_version": int, "mode": str, "config": dict, "phases": dict,
+    "serving": dict, "graph": dict, "streaming": dict, "chaos": dict,
+    "trace": dict, "gates": dict, "pass": bool,
+}
+_GATE_KEYS = ("p99_ms", "p999_ms", "shed_rate", "lost_without_status",
+              "stale_reads", "degraded_steps", "recovery_s",
+              "trace_stitched")
+
+
+def validate_accept(obj) -> list:
+    """Schema check for an accept.json dict; returns a list of
+    problems (empty == valid). Kept permissive about EXTRA keys — the
+    artifact may grow — and strict about the required surface the
+    cross-PR diff relies on."""
+    problems = []
+    if not isinstance(obj, dict):
+        return [f"top level must be a dict, got {type(obj).__name__}"]
+    for k, t in _TOP_KEYS.items():
+        if k not in obj:
+            problems.append(f"missing key {k!r}")
+        elif not isinstance(obj[k], t):
+            problems.append(f"{k!r} must be {t.__name__}, "
+                            f"got {type(obj[k]).__name__}")
+    if obj.get("schema_version") != SCHEMA_VERSION:
+        problems.append(f"schema_version must be {SCHEMA_VERSION}")
+    gates = obj.get("gates", {})
+    if isinstance(gates, dict):
+        for g in _GATE_KEYS:
+            if g not in gates:
+                problems.append(f"missing gate {g!r}")
+                continue
+            e = gates[g]
+            if not isinstance(e, dict) or "ok" not in e \
+                    or not isinstance(e["ok"], bool):
+                problems.append(f"gate {g!r} needs a boolean 'ok'")
+            elif not e.get("skipped") and "value" not in e:
+                problems.append(f"gate {g!r} needs 'value'")
+        if isinstance(obj.get("pass"), bool):
+            want = all(e.get("ok") for e in gates.values()
+                       if isinstance(e, dict))
+            if obj["pass"] != want:
+                problems.append("'pass' disagrees with the gates")
+    for k in ("requests", "lost", "shed"):
+        s = obj.get("serving", {})
+        if isinstance(s, dict) and not isinstance(s.get(k), int):
+            problems.append(f"serving.{k} must be an int")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# pieces
+# ---------------------------------------------------------------------------
+
+def _build_graph(td: str, n: int, dim: int):
+    from euler_tpu.graph import GraphBuilder
+
+    rng = np.random.default_rng(7)
+    b = GraphBuilder()
+    b.set_num_types(2, 1)
+    b.set_feature(0, 0, dim, "feature")
+    ids = np.arange(1, n + 1, dtype=np.uint64)
+    b.add_nodes(ids, types=(ids % 2).astype(np.int32),
+                weights=np.ones(n, np.float32))
+    src = np.concatenate([ids, ids])
+    dst = np.concatenate([np.roll(ids, -1), np.roll(ids, -5)])
+    b.add_edges(src, dst, types=np.zeros(2 * n, np.int32),
+                weights=(rng.random(2 * n) + 0.25).astype(np.float32))
+    # quantized-level features: realistic redundancy for compression
+    b.set_node_dense(ids, 0,
+                     rng.integers(-64, 64, (n, dim)).astype(np.float32)
+                     / 16.0)
+    d = os.path.join(td, "graph")
+    b.finalize().dump(d, num_partitions=2)
+    return d, ids
+
+
+# Subprocess graph shard (the SIGKILL target): dumps its own server
+# span ring as a chrome trace on SIGTERM — the "one trace file per
+# shard" the merge step combines. A SIGKILLed incarnation loses its
+# ring (that is what SIGKILL means); the restarted one dumps at
+# teardown.
+_SHARD_SRC = r"""
+import os, signal, sys, time
+data, reg, wal, idx, num, trace_out = sys.argv[1:7]
+from euler_tpu.gql import start_service, server_trace_chrome
+s = start_service(data, shard_idx=int(idx), shard_num=int(num), port=0,
+                  registry_dir=reg, wal_dir=wal, wal_fsync="never")
+def _dump(sig, frm):
+    try:
+        server_trace_chrome(trace_out)
+    finally:
+        os._exit(0)
+signal.signal(signal.SIGTERM, _dump)
+print("READY", s.port, s.epoch, flush=True)
+while True:
+    time.sleep(0.2)
+"""
+
+
+def _spawn_shard(data: str, reg: str, wal: str, idx: int, num: int,
+                 trace_out: str):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SHARD_SRC, data, reg, wal, str(idx),
+         str(num), trace_out],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env)
+    line = proc.stdout.readline().strip()
+    if not line.startswith("READY"):
+        proc.kill()
+        raise RuntimeError(f"graph shard {idx} failed to start: {line!r}")
+    _, port, epoch = line.split()
+    return proc, int(port), int(epoch)
+
+
+def _estimator(eng, dim: int, universe: list, batch: int):
+    """A small projection model whose training input is REAL remote
+    graph traffic (sampled roots + feature reads ride the traced RPC
+    stack), plus the export sweep over the known id universe."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from euler_tpu.estimator import BaseEstimator
+    from euler_tpu.mp_utils.base import ModelOutput
+
+    class Proj(nn.Module):
+        @nn.compact
+        def __call__(self, batch_in):
+            v = nn.Dense(8, name="proj")(batch_in["feat"])
+            loss = jnp.mean(v ** 2)
+            return ModelOutput(v, loss, "l2", loss)
+
+    def train_fn():
+        while True:
+            rid = eng.sample_node(batch, -1)
+            feat = eng.get_dense_feature(rid, [0], [dim])[0]
+            yield {"feat": feat, "infer_ids": rid}
+
+    def sweep_fn():
+        ids = np.asarray(sorted(universe), dtype=np.uint64)
+        for i in range(0, len(ids), batch):
+            part = ids[i:i + batch]
+            if len(part) < batch:
+                part = np.concatenate(
+                    [part, np.full(batch - len(part), part[-1],
+                                   np.uint64)])
+            feat = eng.get_dense_feature(part, [0], [dim])[0]
+            yield {"feat": feat, "infer_ids": part}
+
+    est = BaseEstimator(Proj(), {"log_steps": 100000,
+                                 "checkpoint_steps": 0})
+    return est, train_fn, sweep_fn
+
+
+def _serving_load(reg: str, service: str, ids, *, threads: int, rps: float,
+                  duration_s: float, mix_knn: float, k: int, q: int,
+                  stop_evt: threading.Event):
+    """Paced (open-ish loop) serving load at a stated RPS mix: each of
+    `threads` workers fires rps/threads requests per second, knn with
+    probability mix_knn else embed. Every request ends in exactly one
+    bucket: ok / shed / error — lost-without-status is the residue and
+    gates at zero."""
+    from euler_tpu.graph.remote import RetryPolicy
+    from euler_tpu.serving import ServerOverloaded, ServingClient
+
+    lat_mu = threading.Lock()
+    lats: list = []
+    counts = {"issued": 0, "ok": 0, "shed": 0, "errors": 0}
+    interval = threads / max(rps, 0.1)
+    deadline = time.monotonic() + duration_s
+
+    def worker(widx: int):
+        cli = ServingClient(
+            registry=reg, service=service, rediscover_ttl_s=0.5,
+            retry_policy=RetryPolicy(deadline_s=15.0, call_timeout_s=10.0))
+        rng = np.random.default_rng(1000 + widx)
+        next_t = time.monotonic() + rng.uniform(0, interval)
+        while time.monotonic() < deadline and not stop_evt.is_set():
+            now = time.monotonic()
+            if now < next_t:
+                time.sleep(min(next_t - now, 0.05))
+                continue
+            next_t += interval
+            qs = rng.choice(ids, size=q).astype(np.uint64)
+            t0 = time.monotonic()
+            try:
+                with lat_mu:
+                    counts["issued"] += 1
+                if rng.random() < mix_knn:
+                    cli.knn(qs, k=k)
+                else:
+                    cli.embed(qs)
+                dt = time.monotonic() - t0
+                with lat_mu:
+                    counts["ok"] += 1
+                    lats.append(dt)
+            except ServerOverloaded:
+                with lat_mu:
+                    counts["shed"] += 1
+            except Exception:
+                with lat_mu:
+                    counts["errors"] += 1
+        cli.close()
+
+    ts = [threading.Thread(target=worker, args=(i,), daemon=True)
+          for i in range(threads)]
+    t0 = time.monotonic()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=duration_s + 60.0)
+    hung = sum(1 for t in ts if t.is_alive())
+    wall = time.monotonic() - t0
+    lats.sort()
+    return {
+        "threads": threads, "target_rps": rps, "mix_knn": mix_knn,
+        "requests": counts["ok"], "issued": counts["issued"],
+        "shed": counts["shed"], "errors": counts["errors"],
+        # a hung worker's in-flight request is already part of this
+        # residue (issued, no outcome bucket) — hung is reported
+        # separately, never added on top
+        "lost": counts["issued"] - counts["ok"] - counts["shed"]
+        - counts["errors"],
+        "hung_workers": hung,
+        **lat_summary(lats),
+        "reqs_per_s": round(counts["ok"] / max(wall, 1e-9), 1),
+        "wall_s": round(wall, 2),
+    }
+
+
+def _graph_load(eng, ids, dim: int, *, threads: int, duration_s: float,
+                stop_evt: threading.Event):
+    """Closed-loop graph-tier reads (feature gets + sampling) riding
+    the traced RPC stack for the whole load window — the traffic the
+    chaos schedule (wire cut, stale-map flip, shard SIGKILL) lands
+    on."""
+    lat_mu = threading.Lock()
+    lats: list = []
+    counts = {"issued": 0, "ok": 0, "errors": 0}
+    deadline = time.monotonic() + duration_s
+
+    def worker(widx: int):
+        rng = np.random.default_rng(2000 + widx)
+        while time.monotonic() < deadline and not stop_evt.is_set():
+            sub = rng.choice(ids, size=16).astype(np.uint64)
+            t0 = time.monotonic()
+            try:
+                with lat_mu:
+                    counts["issued"] += 1
+                if widx % 2 == 0:
+                    eng.get_dense_feature(sub, [0], [dim])
+                else:
+                    eng.sample_neighbor(sub, 3)
+                dt = time.monotonic() - t0
+                with lat_mu:
+                    counts["ok"] += 1
+                    lats.append(dt)
+            except Exception:
+                with lat_mu:
+                    counts["errors"] += 1
+            time.sleep(0.01)
+        return
+
+    ts = [threading.Thread(target=worker, args=(i,), daemon=True)
+          for i in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=duration_s + 60.0)
+    hung = sum(1 for t in ts if t.is_alive())
+    lats.sort()
+    return {
+        "threads": threads, "reads": counts["ok"],
+        "issued": counts["issued"], "errors": counts["errors"],
+        # the residue already covers a hung worker's in-flight read
+        "lost": counts["issued"] - counts["ok"] - counts["errors"],
+        "hung_workers": hung,
+        **lat_summary(lats),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the run
+# ---------------------------------------------------------------------------
+
+def run_accept(args) -> dict:
+    out_dir = Path(args.out)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    td = tempfile.mkdtemp(prefix="et_accept_")
+    phases: dict = {}
+    chaos: dict = {"enabled": bool(args.chaos)}
+    t0 = time.monotonic()
+
+    # Abort-path teardown: a mid-run exception (a failed gate is NOT an
+    # exception — those still write the artifact) must not leak the
+    # subprocess shard (it loops forever), serving replicas, native
+    # engines, or still-pacing load threads. Resources register a
+    # best-effort closer as they are created; the happy path's inline
+    # teardown runs first and every closer is idempotent, so the
+    # finally is a no-op on success.
+    closers: list = []
+
+    def _teardown():
+        for fn in reversed(closers):
+            try:
+                fn()
+            except Exception:
+                pass
+
+    try:
+        return _run_accept_body(args, out_dir, td, phases, chaos, t0,
+                                closers)
+    finally:
+        _teardown()
+
+
+def _run_accept_body(args, out_dir, td, phases, chaos, t0,
+                     closers) -> dict:
+    from euler_tpu import obs
+    from euler_tpu import gql
+    from euler_tpu.estimator import StreamingDriver
+    from euler_tpu.graph import (RemoteGraphEngine, RetryPolicy,
+                                 configure_rpc, rpc_transport_stats)
+    from euler_tpu.graph import elastic
+    from euler_tpu.gql import start_service
+    from euler_tpu.serving import InferenceServer
+    from tools.chaos_proxy import ChaosProxy
+
+    # -- build + graph fleet ------------------------------------------------
+    data, ids = _build_graph(td, args.nodes, args.dim)
+    reg = os.path.join(td, "reg")
+    os.makedirs(reg, exist_ok=True)
+    wal0 = os.path.join(td, "wal0")
+    wal1 = os.path.join(td, "wal1")
+
+    shard0 = start_service(data, shard_idx=0, shard_num=2, port=0,
+                           registry_dir=reg, wal_dir=wal0,
+                           wal_fsync="never")
+    closers.append(shard0.stop)
+    shard1_proc = None
+    shard1 = None
+    shard1_trace = str(out_dir / "shard1.trace.json")
+    # the SIGKILL drill respawns the subprocess: the closer reads the
+    # cell so an abort always kills the CURRENT incarnation
+    proc_cell: dict = {"p": None}
+
+    def _kill_subproc():
+        p = proc_cell.get("p")
+        if p is not None and p.poll() is None:
+            p.kill()
+
+    closers.append(_kill_subproc)
+    if args.full:
+        shard1_proc, shard1_port, _ = _spawn_shard(
+            data, reg, wal1, 1, 2, shard1_trace)
+        proc_cell["p"] = shard1_proc
+    else:
+        shard1 = start_service(data, shard_idx=1, shard_num=2, port=0,
+                               registry_dir=reg, wal_dir=wal1,
+                               wal_fsync="never")
+        closers.append(shard1.stop)
+        shard1_port = shard1.port
+
+    # traced, hedged, deadline-propagating, elastic-routing client —
+    # every production knob ON
+    configure_rpc(mux=True, connections=2, compress_threshold=512)
+    eng = RemoteGraphEngine(
+        f"dir:{reg}", seed=11,
+        retry_policy=RetryPolicy(deadline_s=25.0, base_backoff_s=0.05,
+                                 max_backoff_s=0.5, call_timeout_s=10.0),
+        hedge=True, hedge_max_ms=25.0, deadline_propagation=True,
+        ownership_refresh_s=60.0)
+    closers.append(eng.close)
+    phases["setup_s"] = round(time.monotonic() - t0, 2)
+
+    # -- train + export + serving fleet -------------------------------------
+    t1 = time.monotonic()
+    universe = [int(i) for i in ids]
+    est, train_fn, sweep_fn = _estimator(eng, args.dim, universe,
+                                         batch=16)
+    est.train(train_fn(), max_steps=args.train_steps)
+    v1_dir = os.path.join(td, "bundles", "v1")
+    est.export_bundle(v1_dir, input_fn=sweep_fn, shards=2, nlist=2,
+                      nprobe=2, version="v1")
+    srv_kw = dict(registry=reg, service="accept", max_batch=32,
+                  flush_ms=1.0, inject_apply_latency_ms=args.inject_ms)
+    # shard 0 runs TWO replicas: it is the restart-drill target, and a
+    # production fleet restarts replicas behind surviving capacity —
+    # the drill then measures failover, not a self-inflicted outage
+    replicas = [InferenceServer(v1_dir, shard=0, replica=0, **srv_kw),
+                InferenceServer(v1_dir, shard=0, replica=1, **srv_kw),
+                InferenceServer(v1_dir, shard=1, replica=0, **srv_kw)]
+    # the restart drill replaces replicas[0] — close whatever the list
+    # holds at abort time (InferenceServer.stop is idempotent)
+    closers.append(lambda: [r.stop() for r in replicas])
+    phases["train_export_s"] = round(time.monotonic() - t1, 2)
+
+    # -- the measured region: load + chaos schedule --------------------------
+    # clear both trace sinks so the export shows exactly this window
+    obs.clear_trace()
+    gql.server_trace_spans()
+    rpc0 = rpc_transport_stats()
+    h0 = dict(eng.health())
+
+    stop_evt = threading.Event()
+    closers.append(stop_evt.set)  # abort cuts the load short
+    serving_out: dict = {}
+    graph_out: dict = {}
+    load_t = args.load_s
+
+    def serve_side():
+        serving_out.update(_serving_load(
+            reg, "accept", ids, threads=args.threads, rps=args.rps,
+            duration_s=load_t, mix_knn=args.mix_knn, k=args.k, q=args.q,
+            stop_evt=stop_evt))
+
+    def graph_side():
+        graph_out.update(_graph_load(
+            eng, ids, args.dim, threads=2, duration_s=load_t,
+            stop_evt=stop_evt))
+
+    driver = StreamingDriver(est, eng, serving_client=None,
+                             export_dir=os.path.join(td, "bundles"),
+                             shards=2)
+
+    t2 = time.monotonic()
+    loaders = [threading.Thread(target=serve_side, daemon=True),
+               threading.Thread(target=graph_side, daemon=True)]
+    for t in loaders:
+        t.start()
+
+    # ---- chaos schedule (one thread, deterministic order) -----------------
+    def wait_frac(f):
+        dt = t2 + load_t * f - time.monotonic()
+        if dt > 0:
+            time.sleep(dt)
+
+    new_id = int(ids.max()) + 1
+
+    if args.chaos:
+        # (1) wire cut: a probe client through a cut-mode proxy sees a
+        # genuinely torn frame surface as a transport STATUS; the fleet
+        # is unharmed and the idempotent re-issue converges direct.
+        wait_frac(0.10)
+        tcut = time.monotonic()
+        proxy = ChaosProxy("127.0.0.1", shard0.port, mode="ok").start()
+        probe = None
+        try:
+            probe = RemoteGraphEngine(
+                f"hosts:127.0.0.1:{proxy.port},127.0.0.1:{shard1_port}",
+                seed=13,
+                retry_policy=RetryPolicy(deadline_s=3.0,
+                                         base_backoff_s=0.05,
+                                         max_backoff_s=0.2,
+                                         call_timeout_s=2.0))
+            probe.get_dense_feature(ids[:8], [0], [args.dim])
+            proxy.set_mode("cut")
+            cut_surfaced = False
+            try:
+                probe.get_dense_feature(ids[:64], [0], [args.dim])
+            except Exception:
+                cut_surfaced = True  # explicit status, not a hang
+            proxy.set_mode("ok")
+            cuts = int(proxy.counters["cuts_fired"])
+        finally:
+            if probe is not None:
+                probe.close()
+            proxy.stop()
+        # fleet unharmed: a direct read still serves
+        eng.get_dense_feature(ids[:8], [0], [args.dim])
+        chaos["wire_cut"] = {
+            "cuts_fired": cuts, "surfaced_as_status": cut_surfaced,
+            "fleet_unharmed": True,
+            "wall_s": round(time.monotonic() - tcut, 2)}
+
+        # (2) serving replica restart mid-traffic: shard 0's replica 0
+        # goes away and comes back; replica 1 keeps the shard covered,
+        # clients fail over on the explicit transport error
+        wait_frac(0.30)
+        trr = time.monotonic()
+        replicas[0].stop()
+        time.sleep(0.3)
+        replicas[0] = InferenceServer(v1_dir, shard=0, replica=0,
+                                      **srv_kw)
+        chaos["replica_restart"] = {
+            "shard": 0, "replica": 0, "surviving_replicas": 1,
+            "wall_s": round(time.monotonic() - trr, 2)}
+
+        # (3) stale-map flip: publish the next map epoch, flip the
+        # SHARDS first — in-flight client routing (still stamped with
+        # the old epoch) is refused explicitly, force-refreshes, and
+        # retries on the fresh map. Zero silent misroutes by
+        # construction.
+        wait_frac(0.45)
+        m1 = elastic.OwnershipMap.default(2, 2, epoch=1)
+        elastic.publish_map(reg, m1)
+        eng.refresh_ownership(force=True)
+        shard0.set_ownership(m1.encode())
+        gql.push_ownership("127.0.0.1", shard1_port, m1.encode())
+        time.sleep(0.2)
+        m2 = elastic.OwnershipMap(map_epoch=2, partition_num=2,
+                                  owners=[list(o) for o in m1.owners])
+        elastic.publish_map(reg, m2)
+        shard0.set_ownership(m2.encode())
+        gql.push_ownership("127.0.0.1", shard1_port, m2.encode())
+        # wait for the load threads to trip the refusal + refresh path
+        sdeadline = time.monotonic() + max(load_t * 0.25, 3.0)
+        while time.monotonic() < sdeadline:
+            if eng.health()["stale_map_retries"] > h0.get(
+                    "stale_map_retries", 0):
+                break
+            time.sleep(0.1)
+        chaos["stale_map"] = {
+            "flipped_to_epoch": 2,
+            "retries_counted": int(eng.health()["stale_map_retries"]
+                                   - h0.get("stale_map_retries", 0)),
+        }
+
+    # (4) the streaming round mid-load: delta (durable WAL append on
+    # every shard) → fine-tune → sharded export → rolling fleet swap.
+    wait_frac(0.55 if args.chaos else 0.20)
+    tsr = time.monotonic()
+    # the swap client discovers the fleet NOW — after the replica-
+    # restart drill — so the rolling swap reaches the current replicas,
+    # not the pre-restart endpoints
+    from euler_tpu.serving import ServingClient as _SwapClient
+    from euler_tpu.graph.remote import RetryPolicy as _SwapRP
+
+    swap_cli = _SwapClient(registry=reg, service="accept",
+                           retry_policy=_SwapRP(deadline_s=20.0,
+                                                call_timeout_s=10.0))
+    closers.append(swap_cli.close)
+    driver.serving_client = swap_cli
+    universe.append(new_id)
+    stream = driver.round(
+        {"node_ids": np.array([new_id], np.uint64),
+         "edge_src": np.array([new_id], np.uint64),
+         "edge_dst": np.array([1], np.uint64)},
+        steps=args.train_steps, train_input_fn=train_fn(),
+        version="v2", input_fn=sweep_fn, nlist=2, nprobe=2)
+    exported_count = len(universe)  # rows the v2 bundle must serve
+    phases["streaming_round_s"] = round(time.monotonic() - tsr, 2)
+
+    # (5) --full: SIGKILL a graph shard right after a delta lands, mid
+    # load; it recovers snapshot+WAL and rejoins at the fleet epoch via
+    # peer catch-up BEFORE re-registering. Recovery time is gated.
+    recovery_s = None
+    if args.chaos and args.full and shard1_proc is not None:
+        wait_frac(0.75)
+        tk = time.monotonic()
+        pre_epoch = int(stream["delta"]["epoch"])
+        d2 = {"node_ids": np.array([new_id + 1], np.uint64),
+              "edge_src": np.array([new_id + 1], np.uint64),
+              "edge_dst": np.array([2], np.uint64)}
+        killer = threading.Timer(0.0, lambda: os.kill(
+            shard1_proc.pid, signal.SIGKILL))
+        killer.start()
+        try:
+            eng.apply_delta(**d2)
+            applied_during_kill = True
+        except Exception:
+            applied_during_kill = False
+        killer.join()
+        shard1_proc.wait(timeout=10)
+        shard1_proc, shard1_port, rec_epoch = _spawn_shard(
+            data, reg, wal1, 1, 2, shard1_trace)
+        proc_cell["p"] = shard1_proc
+        # idempotent re-issue until the fleet converges post-restart
+        rdeadline = time.monotonic() + 60.0
+        while time.monotonic() < rdeadline:
+            try:
+                if eng.apply_delta(**d2) >= pre_epoch + 1:
+                    break
+            except Exception:
+                time.sleep(0.5)
+        recovery_s = round(time.monotonic() - tk, 2)
+        universe.append(new_id + 1)
+        chaos["sigkill"] = {
+            "recovered_epoch": rec_epoch,
+            "applied_during_kill": applied_during_kill,
+            "recovery_s": recovery_s,
+        }
+
+    for t in loaders:
+        t.join(timeout=load_t + 90.0)
+    phases["load_s"] = round(time.monotonic() - t2, 2)
+
+    # -- post-run probes: zero stale reads -----------------------------------
+    stale_probe_failures = 0
+    # the delta is visible on the graph tier (new node's edge serves)
+    off, nbr, _, _ = eng.get_full_neighbor(np.array([new_id], np.uint64))
+    if 1 not in nbr.astype(np.uint64):
+        stale_probe_failures += 1
+    # the rolling swap landed fleet-wide, and the delta-born node
+    # ENTERED the served index (count-based membership — the node
+    # carries default features, so a rank assertion would test
+    # embedding quality, not serving freshness)
+    from euler_tpu.graph.remote import RetryPolicy as _RP
+    from euler_tpu.serving import ServingClient
+    cli = ServingClient(registry=reg, service="accept",
+                        retry_policy=_RP(deadline_s=15.0,
+                                         call_timeout_s=10.0))
+    fleet = cli.fleet_info()
+    versions = sorted({i["bundle_version"] for i in fleet.values()})
+    if versions != ["v2"]:
+        stale_probe_failures += 1
+    served_count = sum(int(i["count"]) for i in fleet.values())
+    new_served = served_count == exported_count
+    if not new_served:
+        stale_probe_failures += 1
+    # and the fleet kNN path answers with a full result
+    nbr_ids, _ = cli.knn(np.array([int(ids[0])], np.uint64), k=args.k)
+    if nbr_ids.shape != (1, args.k):
+        stale_probe_failures += 1
+    info = {"bundle_version": versions[-1] if versions else None,
+            "count": served_count}
+    cli.close()
+
+    # -- traces: dump per-process files, merge, inspect ----------------------
+    hedge_probe = False
+    rpc_now = rpc_transport_stats()
+    if args.chaos and rpc_now["hedge_fired"] == rpc0["hedge_fired"]:
+        # load alone produced no straggler: force one hedged, traced
+        # read so the merged trace always SHOWS a hedged leg (stated in
+        # the artifact as a probe, not organic traffic)
+        hedge_probe = True
+        configure_rpc(hedge_delay_ms=0.05)
+        for _ in range(5):
+            eng.get_dense_feature(ids[:256], [0], [args.dim])
+        eng.update_hedge_delay()  # restore the adaptive delay
+    srv_spans = gql.server_trace_spans()
+    driver_trace = str(out_dir / "driver.trace.json")
+    server_trace = str(out_dir / "graph_server.trace.json")
+    obs.dump_trace(driver_trace)
+    gql.server_trace_chrome(server_trace, spans=srv_spans)
+    merge_in = [driver_trace, server_trace]
+    if shard1_proc is not None:
+        # the subprocess shard dumps ITS server span ring on SIGTERM —
+        # stop it now so its per-process trace file joins the merge
+        shard1_proc.terminate()
+        try:
+            shard1_proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            shard1_proc.kill()
+        shard1_proc = None
+        if os.path.exists(shard1_trace):
+            merge_in.append(shard1_trace)
+    merged_path = str(out_dir / "accept_trace.json")
+    stitch = trace_dump.write_merged(merged_path, merge_in)
+    # hedged legs: >1 server record under one (trace, parent) pair —
+    # distinct server span ids by construction
+    groups: dict = {}
+    for s in srv_spans:
+        groups.setdefault((s["trace_id"], s["parent_span"]),
+                          []).append(s["span_id"])
+    hedged_groups = sum(1 for v in groups.values() if len(set(v)) > 1)
+    stale_traced = sum(1 for s in srv_spans if s["flags"] & 2)
+    trace_out = {
+        "driver": os.path.basename(driver_trace),
+        "graph_server": os.path.basename(server_trace),
+        "merged": os.path.basename(merged_path),
+        "merged_files": len(merge_in),
+        "server_spans": len(srv_spans),
+        "stitched_trace_ids": stitch["stitched"],
+        "hedged_leg_groups": hedged_groups,
+        "hedge_probe": hedge_probe,
+        "stale_refusals_traced": stale_traced,
+    }
+
+    # -- counters + teardown --------------------------------------------------
+    health = eng.health()
+    rpc1 = rpc_transport_stats()
+    rpc_delta = {k: int(rpc1[k] - rpc0[k]) for k in rpc1}
+    est_health = est.health() if hasattr(est, "health") else {}
+    skipped = int(est_health.get("skipped_steps", 0) or 0)
+
+    swap_cli.close()
+    eng.close()
+    for r in replicas:
+        r.stop()
+    shard0.stop()
+    if shard1 is not None:
+        shard1.stop()
+
+    # -- gates ----------------------------------------------------------------
+    slo = slo_verdict(serving_out.get("p99_ms"),
+                      serving_out.get("requests", 0),
+                      serving_out.get("shed", 0),
+                      serving_out.get("lost", 0)
+                      + graph_out.get("lost", 0),
+                      args.slo_p99_ms, args.slo_shed_rate,
+                      p999_ms=serving_out.get("p999_ms"),
+                      p999_gate_ms=args.slo_p999_ms)
+    gates = {k: slo[k] for k in ("p99_ms", "shed_rate",
+                                 "lost_without_status")}
+    # slo_verdict omits the p999 block when its gate is 0 (the
+    # bench_serve "gate disabled" convention) — the schema still wants
+    # the entry, marked skipped
+    gates["p999_ms"] = slo.get("p999_ms", {
+        "value": serving_out.get("p999_ms"), "gate": 0, "ok": True,
+        "skipped": True})
+    # zero stale reads: every stale-map refusal was refreshed+retried
+    # (graph loop finished with zero unrecovered errors) AND the
+    # post-run visibility probes all passed
+    stale_value = stale_probe_failures + graph_out.get("errors", 0)
+    gates["stale_reads"] = {"value": stale_value, "gate": 0,
+                            "ok": stale_value == 0}
+    degraded = int(health.get("degraded", 0)) + skipped
+    gates["degraded_steps"] = {"value": degraded,
+                               "gate": args.degraded_budget,
+                               "ok": degraded <= args.degraded_budget}
+    if recovery_s is not None:
+        gates["recovery_s"] = {"value": recovery_s,
+                               "gate": args.recovery_bound_s,
+                               "ok": recovery_s <= args.recovery_bound_s}
+    else:
+        gates["recovery_s"] = {"value": None, "gate":
+                               args.recovery_bound_s, "ok": True,
+                               "skipped": True}
+    trace_ok = (stitch["stitched"] >= 1
+                and (not args.chaos or hedged_groups >= 1)
+                and (not args.chaos or stale_traced >= 1)
+                and (not args.chaos
+                     or chaos.get("stale_map", {}).get(
+                         "retries_counted", 0) >= 1))
+    gates["trace_stitched"] = {
+        "value": stitch["stitched"], "gate": 1, "ok": trace_ok}
+
+    result = {
+        "schema_version": SCHEMA_VERSION,
+        "mode": "full" if args.full else "smoke",
+        "config": {
+            "nodes": args.nodes, "dim": args.dim,
+            "train_steps": args.train_steps, "load_s": args.load_s,
+            "rps": args.rps, "threads": args.threads,
+            "mix": {"knn": args.mix_knn, "embed":
+                    round(1 - args.mix_knn, 3)},
+            "inject_ms": args.inject_ms, "chaos": bool(args.chaos),
+            "graph_shards": 2, "serve_shards": 2,
+            "rpc": {"mux": True, "connections": 2, "hedge": True,
+                    "deadline_propagation": True,
+                    "compress_threshold": 512},
+        },
+        "phases": phases,
+        "serving": serving_out,
+        "graph": {**graph_out,
+                  "health": {k: int(v) if isinstance(v, (int, float))
+                             else v for k, v in health.items()},
+                  "rpc_delta": rpc_delta},
+        "streaming": {
+            "epoch": int(stream["delta"]["epoch"]),
+            "swap_version": stream["version"],
+            "served_version": info.get("bundle_version"),
+            "new_node_served": bool(new_served),
+        },
+        "chaos": chaos,
+        "trace": trace_out,
+        "gates": gates,
+        "pass": all(e.get("ok") for e in gates.values()),
+    }
+    problems = validate_accept(result)
+    if problems:  # the harness must never emit an off-schema artifact
+        raise RuntimeError(f"accept.json schema violations: {problems}")
+    out_path = out_dir / "accept.json"
+    out_path.write_text(json.dumps(result, indent=1, sort_keys=True))
+    result["_path"] = str(out_path)
+    return result
+
+
+def record_perf(result: dict) -> None:
+    perf = {}
+    if PERF_JSON.exists():
+        perf = json.loads(PERF_JSON.read_text())
+    entry = {
+        "bench": "acceptance",
+        "metric": "slo_gates_passed",
+        "value": sum(1 for e in result["gates"].values() if e["ok"]),
+        "unit": f"of {len(result['gates'])} gates "
+                f"({result['mode']} run)",
+        "detail": {k: v for k, v in result.items()
+                   if not k.startswith("_")},
+    }
+    perf["acceptance"] = entry
+    PERF_JSON.write_text(json.dumps(perf, indent=1, sort_keys=True))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--nodes", type=int, default=400)
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--train_steps", type=int, default=3)
+    ap.add_argument("--load_s", type=float, default=None,
+                    help="load window seconds (default 12 smoke / 30 "
+                         "full)")
+    ap.add_argument("--rps", type=float, default=40.0,
+                    help="stated serving request rate (paced)")
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--mix_knn", type=float, default=0.6,
+                    help="fraction of serving requests that are knn "
+                         "(rest embed)")
+    ap.add_argument("--q", type=int, default=8, help="ids per request")
+    ap.add_argument("--k", type=int, default=10, help="knn k")
+    ap.add_argument("--inject_ms", type=float, default=2.0,
+                    help="per-flush serving apply latency (the stated "
+                         "injected-work load model; 2-CPU convention)")
+    ap.add_argument("--slo_p99_ms", type=float, default=500.0)
+    ap.add_argument("--slo_p999_ms", type=float, default=2000.0)
+    ap.add_argument("--slo_shed_rate", type=float, default=0.05)
+    ap.add_argument("--degraded_budget", type=int, default=0)
+    ap.add_argument("--recovery_bound_s", type=float, default=45.0)
+    ap.add_argument("--no_chaos", dest="chaos", action="store_false",
+                    help="skip the chaos schedule (plain SLO run)")
+    ap.add_argument("--full", action="store_true",
+                    help="full run: subprocess graph shard + SIGKILL "
+                         "mid-delta recovery drill")
+    ap.add_argument("--out", default="accept_out",
+                    help="artifact directory (accept.json + traces)")
+    ap.add_argument("--record", action="store_true",
+                    help="merge the verdict into perf.json "
+                         "('acceptance' entry)")
+    args = ap.parse_args(argv)
+    if args.load_s is None:
+        args.load_s = 30.0 if args.full else 12.0
+
+    result = run_accept(args)
+    print(json.dumps({k: v for k, v in result.items()
+                      if k in ("mode", "gates", "pass", "_path")},
+                     indent=1, sort_keys=True))
+    if args.record:
+        record_perf(result)
+    return 0 if result["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

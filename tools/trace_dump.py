@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""View / validate chrome://tracing JSON dumps from euler_tpu.obs.
+"""View / validate / MERGE chrome://tracing JSON dumps from
+euler_tpu.obs.
 
 Any run that called `obs.dump_trace(path)` (or `bench.py --trace path`)
 leaves a Trace Event Format file; this CLI summarizes it in the
@@ -8,8 +9,18 @@ slowest individual spans — so the host/device time split is readable
 without opening a browser. For the full flame view load the same file
 in chrome://tracing or https://ui.perfetto.dev.
 
+--merge combines multiple per-process trace files (the acceptance
+harness emits one per shard/replica/driver: client spans from the
+Python tracer, server-side breakdowns from gql.server_trace_chrome)
+into ONE timeline: each file's events are shifted by its
+`otherData.epoch_unix` wall-clock anchor onto a shared time base and
+given a unique synthetic pid (labeled with the source file name), so
+a client `graph_rpc` span and the shard's `server:execute` breakdown
+it caused line up, correlated by the `trace_id` both carry in args.
+
     python tools/trace_dump.py run.json
     python tools/trace_dump.py run.json --top 20
+    python tools/trace_dump.py --merge merged.json a.json b.json ...
     python tools/trace_dump.py --self-test   # exercises span → export →
                                              # reload end to end (CI)
 """
@@ -66,6 +77,73 @@ def summarize(trace: dict, top: int = 12) -> str:
     return "\n".join(lines)
 
 
+def merge_traces(paths) -> dict:
+    """Merge per-process trace files onto one wall-clock-aligned
+    timeline. Each file's `otherData.epoch_unix` anchors its ts=0; the
+    earliest anchor becomes the merged time base and every event shifts
+    by the difference. Every input file gets its own synthetic pid
+    (chrome process row), labeled with the file name via process_name
+    metadata — two processes (or one process's client + server
+    exporters) can then never collide on a real OS pid."""
+    files = [(p, load_trace(p)) for p in paths]
+    anchors = [float(t.get("otherData", {}).get("epoch_unix", 0.0))
+               for _, t in files]
+    nonzero = [a for a in anchors if a > 0]
+    base = min(nonzero) if nonzero else 0.0
+    events, meta = [], []
+    for idx, ((path, t), anchor) in enumerate(zip(files, anchors)):
+        off_us = (anchor - base) * 1e6 if anchor > 0 else 0.0
+        pid = idx + 1
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "tid": 0,
+                     "args": {"name": os.path.basename(path)}})
+        for e in t.get("traceEvents", []):
+            if e.get("ph") == "M":
+                continue  # re-labeled above
+            e = dict(e)
+            e["ts"] = float(e.get("ts", 0.0)) + off_us
+            e["pid"] = pid
+            events.append(e)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "epoch_unix": base,
+            "exporter": "trace_dump.merge",
+            "sources": [os.path.basename(p) for p, _ in files],
+        },
+    }
+
+
+def stitch_summary(trace: dict) -> dict:
+    """How well a (merged) trace stitches across the wire, keyed by
+    trace id: for every trace_id seen in args, whether it appears on
+    BOTH a client span (cat 'obs' — the Python tracer) and a server
+    breakdown (cat 'srv' — gql.server_trace_chrome). The acceptance
+    harness gates on stitched >= 1."""
+    sides = {}
+    for e in trace.get("traceEvents", []):
+        tid = e.get("args", {}).get("trace_id", 0)
+        if not tid:
+            continue
+        side = "srv" if e.get("cat") == "srv" else "cli"
+        sides.setdefault(tid, set()).add(side)
+    stitched = [t for t, s in sides.items() if {"cli", "srv"} <= s]
+    return {"trace_ids": len(sides), "stitched": len(stitched),
+            "stitched_ids": stitched[:16]}
+
+
+def write_merged(out_path: str, paths) -> dict:
+    """merge_traces + atomic write; returns the stitch summary."""
+    merged = merge_traces(paths)
+    tmp = f"{out_path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f)
+    os.replace(tmp, out_path)
+    return stitch_summary(merged)
+
+
 def self_test() -> int:
     """End-to-end: spans → ring → export → reload → field/nesting
     checks. Zero imports beyond euler_tpu.obs; exits nonzero on any
@@ -107,18 +185,36 @@ def self_test() -> int:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="summarize a euler_tpu.obs chrome trace")
-    ap.add_argument("path", nargs="?", help="trace JSON to summarize")
+        description="summarize or merge euler_tpu.obs chrome traces")
+    ap.add_argument("path", nargs="*",
+                    help="trace JSON to summarize (or, with --merge, "
+                         "the input files)")
     ap.add_argument("--top", type=int, default=12,
                     help="show the N heaviest span names (default 12)")
+    ap.add_argument("--merge", metavar="OUT",
+                    help="merge the input trace files into OUT (one "
+                         "timeline, per-file chrome processes, events "
+                         "aligned by each file's epoch_unix anchor)")
     ap.add_argument("--self-test", action="store_true",
                     help="exercise span → export → reload and exit")
     args = ap.parse_args(argv)
     if args.self_test:
         return self_test()
+    if args.merge:
+        if len(args.path) < 2:
+            ap.error("--merge needs at least two input trace files")
+        st = write_merged(args.merge, args.path)
+        print(f"merged {len(args.path)} files -> {args.merge}: "
+              f"{st['trace_ids']} trace ids, {st['stitched']} stitched "
+              "across client and server")
+        print(summarize(load_trace(args.merge), top=args.top))
+        return 0
     if not args.path:
-        ap.error("give a trace path or --self-test")
-    print(summarize(load_trace(args.path), top=args.top))
+        ap.error("give a trace path, --merge, or --self-test")
+    if len(args.path) > 1:
+        ap.error("multiple trace files need --merge OUT (summarizing "
+                 "only one of them silently would lie)")
+    print(summarize(load_trace(args.path[0]), top=args.top))
     return 0
 
 
