@@ -706,7 +706,8 @@ int etg_get_edge_binary_feature(int64_t h, const uint64_t* src,
 // leave the corresponding knob unchanged.
 void etg_rpc_config(int mux, int mux_connections, int64_t compress_threshold,
                     int max_inflight, int64_t hedge_delay_us, int p2c,
-                    int hedge_replicas) {
+                    int hedge_replicas, int prepared, int plan_cache,
+                    int deflate_reuse) {
   auto& c = et::GlobalRpcConfig();
   if (mux >= 0) c.mux = mux != 0;
   if (mux_connections > 0) c.mux_connections = mux_connections;
@@ -715,6 +716,10 @@ void etg_rpc_config(int mux, int mux_connections, int64_t compress_threshold,
   if (hedge_delay_us >= 0) c.hedge_delay_us = hedge_delay_us;
   if (p2c >= 0) c.p2c = p2c != 0;
   if (hedge_replicas >= 0) c.hedge_replicas = hedge_replicas != 0;
+  // wire path (prepared query plans + reply/deflate reuse knobs)
+  if (prepared >= 0) c.prepared = prepared != 0;
+  if (plan_cache > 0) c.plan_cache = plan_cache;
+  if (deflate_reuse >= 0) c.deflate_reuse = deflate_reuse != 0;
 }
 
 // Per-thread deadline handoff for the NEXT query run on this thread
@@ -728,14 +733,17 @@ void etg_set_call_deadline_ms(double remaining_ms) {
           : 0);
 }
 
-// out[22]: round_trips, bytes_sent, bytes_received, bytes_sent_raw,
+// out[27]: round_trips, bytes_sent, bytes_received, bytes_sent_raw,
 // bytes_received_raw, connections_opened, compressed_frames_sent,
 // compressed_frames_received, mux_calls, v1_calls, hello_fallbacks,
 // inflight (gauge), deadline_propagated, deadline_shed (server edge),
 // hedge_fired, hedge_won, hedge_wasted, stale_map_shed (server edge),
 // replica_hedge_fired, replica_hedge_won, replica_hedge_wasted,
-// trace_propagated.
-// Client-edge accounting except the *_shed pair (see RpcCounters).
+// trace_propagated, prepared_registered, prepared_hits,
+// prepared_misses, prepared_invalidated (all four server edge),
+// prepared_fallbacks (client edge).
+// Client-edge accounting except the *_shed pair and the prepared plan
+// cache counters (see RpcCounters).
 void etg_rpc_stats(uint64_t* out) {
   auto& c = et::GlobalRpcCounters();
   out[0] = c.round_trips.load();
@@ -760,6 +768,11 @@ void etg_rpc_stats(uint64_t* out) {
   out[19] = c.replica_hedge_won.load();
   out[20] = c.replica_hedge_wasted.load();
   out[21] = c.trace_propagated.load();
+  out[22] = c.prepared_registered.load();
+  out[23] = c.prepared_hits.load();
+  out[24] = c.prepared_misses.load();
+  out[25] = c.prepared_invalidated.load();
+  out[26] = c.prepared_fallbacks.load();
 }
 
 // Per-thread wire-trace handoff for the NEXT query run on this thread

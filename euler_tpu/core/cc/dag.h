@@ -63,6 +63,14 @@ struct NodeDef {
 
 // A mutable query plan: ordered list of NodeDefs with unique names.
 // The GQL translator emits one, optimizer passes rewrite it in place.
+//
+// Concurrency contract (load-bearing for the server-side prepared-plan
+// cache, rpc.h kFeatPrepared): once construction/rewrites finish, a
+// DAGDef is READ-ONLY to execution — any number of Executors may run
+// over one shared const DAGDef concurrently (each builds its own
+// runtime node table; kernels receive const NodeDef&). A cached
+// decoded plan is therefore executed in place, never copied per
+// request.
 struct DAGDef {
   std::vector<NodeDef> nodes;
   int next_id = 0;

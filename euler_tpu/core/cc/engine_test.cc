@@ -640,6 +640,199 @@ void TestServerTraceBreakdown() {
   GlobalRpcConfig() = saved;
 }
 
+// ---- serde: sizing-reserved encodes + split-plan + reply segments ----
+void TestSerdeSizingSplitSegments() {
+  // request with a payload-bearing feed and a small multi-node plan
+  ExecuteRequest req;
+  Tensor roots(DType::kU64, {4});
+  for (int i = 0; i < 4; ++i) roots.Flat<uint64_t>()[i] = 100 + i;
+  req.inputs.emplace_back("roots", roots);
+  NodeDef nd;
+  nd.name = "SAMPLE_NB_0";
+  nd.op = "SAMPLE_NB";
+  nd.inputs = {"roots"};
+  nd.attrs = {"*", "3", "0"};
+  req.nodes.push_back(nd);
+  req.outputs = {"SAMPLE_NB_0:0", "SAMPLE_NB_0:1"};
+
+  // the documented invariant: 'ETEY' + feeds[4:] + plan[4:] is byte-
+  // identical to the classic full encoding (the fallback reassembly)
+  ByteWriter full, pw, fw;
+  EncodeExecuteRequest(req, &full);
+  EncodeExecutePlan(req, &pw);
+  EncodeExecuteFeeds(req, &fw);
+  std::vector<char> assembled;
+  CHECK_OK(AssembleFullExecuteRequest(fw.buffer(), pw.buffer(), &assembled));
+  CHECK_TRUE(assembled == full.buffer());
+  // swapped arguments must fail fast, not misread
+  CHECK_TRUE(
+      !AssembleFullExecuteRequest(pw.buffer(), fw.buffer(), &assembled)
+           .ok());
+
+  // split halves decode back to the original request
+  ExecuteRequest back;
+  {
+    ByteReader r(pw.buffer().data(), pw.buffer().size());
+    CHECK_OK(DecodeExecutePlan(&r, &back));
+    CHECK_TRUE(r.remaining() == 0);
+    ByteReader r2(fw.buffer().data(), fw.buffer().size());
+    CHECK_OK(DecodeExecuteFeeds(&r2, &back));
+    CHECK_TRUE(r2.remaining() == 0);
+  }
+  CHECK_TRUE(back.nodes.size() == 1 && back.nodes[0].op == "SAMPLE_NB");
+  CHECK_TRUE(back.outputs == req.outputs);
+  CHECK_TRUE(back.inputs.size() == 1 &&
+             std::memcmp(back.inputs[0].second.raw(), roots.raw(),
+                         roots.ByteSize()) == 0);
+
+  // content hash: stable, non-zero, and sensitive to any plan byte
+  uint64_t h1 = PlanContentHash(pw.buffer().data(), pw.buffer().size());
+  uint64_t h2 = PlanContentHash(pw.buffer().data(), pw.buffer().size());
+  CHECK_TRUE(h1 == h2 && h1 != 0);
+  std::vector<char> tweaked(pw.buffer());
+  tweaked.back() ^= 1;
+  CHECK_TRUE(PlanContentHash(tweaked.data(), tweaked.size()) != h1);
+
+  // reply segments: runs concatenated in order == EncodeExecuteReply
+  ExecuteReply rep;
+  rep.status = Status::OK();
+  Tensor t1(DType::kF32, {3, 5});
+  for (int i = 0; i < 15; ++i) t1.Flat<float>()[i] = i * 0.5f;
+  Tensor t2(DType::kU64, {0});  // empty payload: meta-only run
+  Tensor t3(DType::kI32, {7});
+  for (int i = 0; i < 7; ++i) t3.Flat<int32_t>()[i] = -i;
+  rep.outputs.emplace_back("a:0", t1);
+  rep.outputs.emplace_back("b:0", t2);
+  rep.outputs.emplace_back("c:0", t3);
+  ByteWriter contiguous;
+  EncodeExecuteReply(rep, &contiguous);
+  ReplySegments segs;
+  EncodeExecuteReplySegments(std::move(rep), &segs);
+  std::vector<char> glued;
+  for (const auto& run : segs.runs) {
+    const char* p = run.tensor >= 0
+                        ? reinterpret_cast<const char*>(
+                              segs.tensors[run.tensor].raw())
+                        : segs.meta.buffer().data() + run.off;
+    glued.insert(glued.end(), p, p + run.len);
+  }
+  CHECK_TRUE(glued == contiguous.buffer());
+  CHECK_TRUE(segs.total == contiguous.buffer().size());
+  // tensor payloads are VIEWS (two payload-bearing tensors pinned)
+  CHECK_TRUE(segs.tensors.size() == 2);
+
+  // error replies segment too (no outputs encoded)
+  ExecuteReply bad;
+  bad.status = Status::Internal("boom");
+  ByteWriter bad_c;
+  EncodeExecuteReply(bad, &bad_c);
+  ReplySegments bad_s;
+  EncodeExecuteReplySegments(std::move(bad), &bad_s);
+  CHECK_TRUE(bad_s.runs.size() == 1 && bad_s.total == bad_c.buffer().size());
+}
+
+// ---- rpc: prepared plans (kPrepare + flagged kExecute) end to end ----
+void TestPreparedPlanExecution() {
+  std::shared_ptr<const Graph> g(RingGraph());
+  auto server = std::make_unique<GraphServer>(g, nullptr, 0, 1, 1);
+  CHECK_OK(server->Start(0));
+  RpcConfig saved = GlobalRpcConfig();
+  GlobalRpcConfig().mux = true;
+  GlobalRpcConfig().mux_connections = 1;
+  GlobalRpcConfig().prepared = true;
+  auto& ctr = GlobalRpcCounters();
+
+  CompileOptions opts;
+  opts.mode = "local";
+  GqlCompiler compiler(opts);
+  std::shared_ptr<const TranslateResult> plan;
+  CHECK_OK(compiler.Compile("v(roots).getNB(*).as(nb)", &plan));
+  ExecuteRequest req;
+  Tensor roots(DType::kU64, {2});
+  roots.Flat<uint64_t>()[0] = 3;
+  roots.Flat<uint64_t>()[1] = 9;
+  req.inputs.emplace_back("roots", roots);
+  req.nodes = plan->dag.nodes;
+  req.outputs = {"nb:1"};
+
+  ByteWriter full, pw, fw;
+  EncodeExecuteRequest(req, &full);
+  EncodeExecutePlan(req, &pw);
+  EncodeExecuteFeeds(req, &fw);
+  const uint64_t pid =
+      PlanContentHash(pw.buffer().data(), pw.buffer().size());
+
+  RpcChannel ch("127.0.0.1", server->port());
+  ch.set_mux(true);
+  // classic full-frame reference reply (same v2 connection family)
+  std::vector<char> ref;
+  CHECK_OK(ch.Call(0 /*kExecute*/, full.buffer(), &ref, 2));
+
+  // prepared: first call registers once, later calls hit; replies are
+  // byte-identical to the classic path (the zero-copy writer included)
+  const uint64_t reg0 = ctr.prepared_registered.load();
+  const uint64_t hit0 = ctr.prepared_hits.load();
+  std::vector<char> rep1, rep2;
+  CHECK_OK(ch.CallExecutePrepared(pw.buffer(), pid, fw.buffer(), &rep1, 2));
+  CHECK_OK(ch.CallExecutePrepared(pw.buffer(), pid, fw.buffer(), &rep2, 2));
+  CHECK_TRUE(rep1 == ref && rep2 == ref);
+  CHECK_TRUE(ctr.prepared_registered.load() == reg0 + 1);
+  CHECK_TRUE(ctr.prepared_hits.load() == hit0 + 2);
+
+  // a prepared frame ships FEWER bytes than the full frame: the saved
+  // wire is the plan bytes minus the 8-byte id prefix
+  CHECK_TRUE(fw.buffer().size() + 8 < full.buffer().size());
+
+  // LRU eviction → explicit miss → client re-prepares and converges
+  GlobalRpcConfig().plan_cache = 1;
+  ExecuteRequest req2 = req;
+  req2.outputs = {"nb:0"};  // different plan content → different id
+  ByteWriter pw2, fw2;
+  EncodeExecutePlan(req2, &pw2);
+  EncodeExecuteFeeds(req2, &fw2);
+  const uint64_t pid2 =
+      PlanContentHash(pw2.buffer().data(), pw2.buffer().size());
+  std::vector<char> repB;
+  CHECK_OK(
+      ch.CallExecutePrepared(pw2.buffer(), pid2, fw2.buffer(), &repB, 2));
+  const uint64_t miss0 = ctr.prepared_misses.load();
+  std::vector<char> rep3;
+  CHECK_OK(ch.CallExecutePrepared(pw.buffer(), pid, fw.buffer(), &rep3, 3));
+  CHECK_TRUE(rep3 == ref);
+  CHECK_TRUE(ctr.prepared_misses.load() >= miss0 + 1);
+  GlobalRpcConfig().plan_cache = 64;
+
+  // ownership-map flip strands every cached plan: the next prepared
+  // execute answers the counted invalidation miss, the client
+  // re-prepares, and the result is still byte-identical — a stale plan
+  // never executes silently
+  const uint64_t inv0 = ctr.prepared_invalidated.load();
+  auto om = std::make_shared<OwnershipMap>();
+  CHECK_OK(OwnershipMap::Decode("e1-P1-0", om.get()));
+  CHECK_OK(server->SetOwnership(om));
+  std::vector<char> rep4;
+  CHECK_OK(ch.CallExecutePrepared(pw.buffer(), pid, fw.buffer(), &rep4, 3));
+  CHECK_TRUE(ctr.prepared_invalidated.load() == inv0 + 1);
+
+  // prepared request against a v1-only server: counted fallback, same
+  // answer through the classic framing
+  ::setenv("EULER_TPU_RPC_SERVER_V1", "1", 1);
+  auto v1srv = std::make_unique<GraphServer>(g, nullptr, 0, 1, 1);
+  CHECK_OK(v1srv->Start(0));
+  ::unsetenv("EULER_TPU_RPC_SERVER_V1");
+  RpcChannel chv1("127.0.0.1", v1srv->port());
+  chv1.set_mux(true);
+  const uint64_t fb0 = ctr.prepared_fallbacks.load();
+  std::vector<char> repv1;
+  CHECK_OK(
+      chv1.CallExecutePrepared(pw.buffer(), pid, fw.buffer(), &repv1, 3));
+  CHECK_TRUE(ctr.prepared_fallbacks.load() >= fb0 + 1);
+  v1srv->Stop();
+
+  server->Stop();
+  GlobalRpcConfig() = saved;
+}
+
 }  // namespace
 }  // namespace et
 
@@ -655,6 +848,8 @@ int main() {
   et::TestRpcMuxTransport();
   et::TestRpcHelloFallback();
   et::TestServerTraceBreakdown();
+  et::TestSerdeSizingSplitSegments();
+  et::TestPreparedPlanExecution();
   et::TestI32OffsetGuard();
   et::TestGraphStore();
   et::TestConcurrentSampling();

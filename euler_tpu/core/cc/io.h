@@ -34,6 +34,11 @@ namespace et {
 
 class ByteWriter {
  public:
+  // Pre-reserve capacity for `extra` MORE bytes beyond what is already
+  // buffered. Encoders with a cheap sizing pass (EncodeTensor,
+  // EncodeExecuteReply) call this so large payloads append without
+  // vector doubling-reallocs; encoded bytes are unchanged.
+  void Reserve(size_t extra) { buf_.reserve(buf_.size() + extra); }
   void PutRaw(const void* p, size_t n) {
     const char* c = static_cast<const char*>(p);
     buf_.insert(buf_.end(), c, c + n);

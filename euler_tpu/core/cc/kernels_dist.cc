@@ -551,6 +551,14 @@ ET_REGISTER_KERNEL("QUAD_FILTER_APPLY", QuadFilterApplyOp);
 // (reference remote_op.cc:60-120). Async: the RPC runs on the pool via
 // ClientManager::ExecuteAsync; with no ClientManager (single-process
 // tests) the inner plan runs loopback against the local graph.
+//
+// Prepared plans (RpcConfig::prepared, rpc.h kFeatPrepared): the inner
+// sub-DAG + output names a training loop re-ships every step are the
+// content-stable PLAN half of this request — ClientManager::Execute
+// splits it from the feed tensors, registers it once per connection,
+// and stamps its content-hash id on EVERY wire attempt of this call
+// (transport retries, mux-hedge legs, replica-hedge legs all carry the
+// same id), so steady-state kExecute frames ship feeds only.
 // ---------------------------------------------------------------------------
 class RemoteOp : public OpKernel {
  public:

@@ -8,6 +8,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <sys/stat.h>
@@ -19,9 +20,11 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <list>
 #include <map>
 #include <sstream>
 #include <unordered_map>
+#include <unordered_set>
 
 #include "threadpool.h"
 #include "udf.h"
@@ -67,12 +70,22 @@ constexpr uint32_t kFrameFlagMapEpoch = 8u;
 // a trace context (id != 0) — pre-trace peers and untraced calls see
 // byte-identical frames.
 constexpr uint32_t kFrameFlagTrace = 16u;
+// REQUEST body is a PREPARED kExecute: u64 plan id (after every other
+// prefix, before compression) followed by the feed tensors only — the
+// DAG + output names were registered earlier via kPrepare, keyed by
+// the plan's content hash. Hello-negotiated (kFeatPrepared): only
+// stamped for servers that advertised the feature; prepared-off calls
+// and pre-prepared peers see byte-identical classic frames. An id the
+// server does not have answers an explicit counted miss status, never
+// a silent wrong-plan execute (the id IS the content hash).
+constexpr uint32_t kFrameFlagPrepared = 32u;
 constexpr uint32_t kProtoV2 = 2;
 constexpr uint32_t kFeatAcceptCompressed = 1u;  // hello feature bit
 constexpr uint32_t kFeatEpoch = 2u;             // hello: send epoch prefixes
 constexpr uint32_t kFeatDeadline = 4u;          // hello: deadline prefixes ok
 constexpr uint32_t kFeatMapEpoch = 8u;          // hello: map-epoch prefixes ok
 constexpr uint32_t kFeatTrace = 16u;            // hello: trace prefixes ok
+constexpr uint32_t kFeatPrepared = 32u;         // hello: prepared plans ok
 
 enum MsgType : uint32_t {
   kExecute = 0,
@@ -95,6 +108,12 @@ enum MsgType : uint32_t {
                        // → u32 code | u64 map_epoch / u32 1 | str error.
                        // Installs the epoch-versioned ownership map
                        // (elastic fleet: live splits / rebalancing).
+  kPrepare = 11,  // v2 only. body: encoded execute plan ('ETPN' dag +
+                  // outputs) → u32 code | u64 plan_id (the server-
+                  // computed content hash) / u32 1 | str error. Decoded
+                  // ONCE into the connection's bounded plan LRU;
+                  // subsequent kExecute frames flagged kFrameFlagPrepared
+                  // carry the id + feed tensors only.
 };
 
 // Bench/chaos-only injected per-row work (env
@@ -144,41 +163,75 @@ bool ReadAll(int fd, char* p, size_t n) {
   return true;
 }
 
-bool WriteFrame(int fd, uint32_t msg_type, const char* body, size_t len) {
-  char hdr[16];
-  std::memcpy(hdr, &kFrameMagic, 4);
-  std::memcpy(hdr + 4, &msg_type, 4);
-  uint64_t l = len;
-  std::memcpy(hdr + 8, &l, 8);
-  return WriteAll(fd, hdr, 16) && WriteAll(fd, body, len);
-}
+// --- frame headers (one choreography shared by the v1 and v2 paths) ------
 
-bool ReadFrame(int fd, uint32_t* msg_type, std::vector<char>* body) {
-  char hdr[16];
-  if (!ReadAll(fd, hdr, 16)) return false;
-  uint32_t magic;
-  std::memcpy(&magic, hdr, 4);
-  if (magic != kFrameMagic) return false;
-  std::memcpy(msg_type, hdr + 4, 4);
-  uint64_t len;
-  std::memcpy(&len, hdr + 8, 8);
-  if (len > (1ULL << 33)) return false;  // 8 GiB sanity cap
-  body->resize(len);
-  return len == 0 || ReadAll(fd, body->data(), len);
-}
-
-// --- protocol v2: correlated frames + adaptive zlib-1 bodies --------------
-
+// v1 header: magic | msg_type | body_len (16 bytes).
+constexpr size_t kV1HdrLen = 16;
 // v2 header: magic | msg_type | flags | request_id | body_len (28 bytes).
 constexpr size_t kV2HdrLen = 28;
 
-void FillV2Hdr(char* hdr, uint32_t msg_type, uint32_t flags,
-               uint64_t request_id, uint64_t len) {
+// The single header fill/parse pair every encode/decode path shares —
+// the four WriteFrame/ReadFrame/WriteFrameV2/ReadAnyFrame siblings
+// used to each hand-roll the same memcpy choreography; behavior is
+// pinned by the v1/v2 interop tests. v1 fields occupy the same first
+// 16 bytes in both layouts except body_len (offset 8 in v1, 20 in v2).
+size_t FillFrameHdr(char* hdr, int ver, uint32_t msg_type, uint32_t flags,
+                    uint64_t request_id, uint64_t len) {
+  if (ver == 1) {
+    std::memcpy(hdr, &kFrameMagic, 4);
+    std::memcpy(hdr + 4, &msg_type, 4);
+    std::memcpy(hdr + 8, &len, 8);
+    return kV1HdrLen;
+  }
   std::memcpy(hdr, &kFrameMagicV2, 4);
   std::memcpy(hdr + 4, &msg_type, 4);
   std::memcpy(hdr + 8, &flags, 4);
   std::memcpy(hdr + 12, &request_id, 8);
   std::memcpy(hdr + 20, &len, 8);
+  return kV2HdrLen;
+}
+
+// Parse a header whose first 16 bytes are in hdr; *ver is set from the
+// magic. Returns false on an unknown magic (or v2 when !accept_v2 —
+// how EULER_TPU_RPC_SERVER_V1 emulates a pre-v2 binary). When it
+// returns true and *ver == 2, the caller must read the remaining
+// kV2HdrLen - 16 bytes into hdr before ParseFrameHdrV2Tail.
+bool ParseFrameHdr16(const char* hdr, bool accept_v2, int* ver,
+                     uint32_t* msg_type, uint32_t* flags,
+                     uint64_t* request_id, uint64_t* len) {
+  uint32_t magic;
+  std::memcpy(&magic, hdr, 4);
+  std::memcpy(msg_type, hdr + 4, 4);
+  if (magic == kFrameMagic) {
+    *ver = 1;
+    *flags = 0;
+    *request_id = 0;
+    std::memcpy(len, hdr + 8, 8);
+    return true;
+  }
+  if (magic == kFrameMagicV2 && accept_v2) {
+    *ver = 2;
+    std::memcpy(flags, hdr + 8, 4);
+    return true;
+  }
+  return false;
+}
+
+void ParseFrameHdrV2Tail(const char* hdr, uint64_t* request_id,
+                         uint64_t* len) {
+  std::memcpy(request_id, hdr + 12, 8);
+  std::memcpy(len, hdr + 20, 8);
+}
+
+void FillV2Hdr(char* hdr, uint32_t msg_type, uint32_t flags,
+               uint64_t request_id, uint64_t len) {
+  FillFrameHdr(hdr, 2, msg_type, flags, request_id, len);
+}
+
+bool WriteFrame(int fd, uint32_t msg_type, const char* body, size_t len) {
+  char hdr[kV1HdrLen];
+  FillFrameHdr(hdr, 1, msg_type, 0, 0, len);
+  return WriteAll(fd, hdr, kV1HdrLen) && WriteAll(fd, body, len);
 }
 
 bool WriteFrameV2(int fd, uint32_t msg_type, uint32_t flags,
@@ -197,28 +250,49 @@ bool ReadAnyFrame(int fd, int* ver, uint32_t* msg_type, uint32_t* flags,
                   bool accept_v2 = true) {
   char hdr[kV2HdrLen];
   if (!ReadAll(fd, hdr, 16)) return false;
-  uint32_t magic;
-  std::memcpy(&magic, hdr, 4);
   uint64_t len;
-  if (magic == kFrameMagic) {
-    *ver = 1;
-    *flags = 0;
-    *request_id = 0;
-    std::memcpy(msg_type, hdr + 4, 4);
-    std::memcpy(&len, hdr + 8, 8);
-  } else if (magic == kFrameMagicV2 && accept_v2) {
-    *ver = 2;
-    if (!ReadAll(fd, hdr + 16, kV2HdrLen - 16)) return false;
-    std::memcpy(msg_type, hdr + 4, 4);
-    std::memcpy(flags, hdr + 8, 4);
-    std::memcpy(request_id, hdr + 12, 8);
-    std::memcpy(&len, hdr + 20, 8);
-  } else {
+  if (!ParseFrameHdr16(hdr, accept_v2, ver, msg_type, flags, request_id,
+                       &len))
     return false;
+  if (*ver == 2) {
+    if (!ReadAll(fd, hdr + 16, kV2HdrLen - 16)) return false;
+    ParseFrameHdrV2Tail(hdr, request_id, &len);
   }
   if (len > (1ULL << 33)) return false;  // 8 GiB sanity cap
   body->resize(len);
   return len == 0 || ReadAll(fd, body->data(), len);
+}
+
+// v1 frames only (registry protocol + classic clients) — the shared
+// parser with v2 refused, byte-for-byte the pre-dedupe behavior.
+bool ReadFrame(int fd, uint32_t* msg_type, std::vector<char>* body) {
+  int ver = 0;
+  uint32_t flags = 0;
+  uint64_t rid = 0;
+  return ReadAnyFrame(fd, &ver, msg_type, &flags, &rid, body,
+                      /*accept_v2=*/false);
+}
+
+// Gathered write of header + prefixes + payload views (the zero-copy
+// reply path): partial writes advance through the iovec array, counts
+// past the kernel's IOV_MAX batch in chunks.
+bool WritevAll(int fd, std::vector<iovec>* iov) {
+  size_t idx = 0;
+  while (idx < iov->size()) {
+    int cnt = static_cast<int>(std::min<size_t>(iov->size() - idx, 1024));
+    ssize_t w = ::writev(fd, iov->data() + idx, cnt);
+    if (w <= 0) return false;
+    size_t n = static_cast<size_t>(w);
+    while (idx < iov->size() && n >= (*iov)[idx].iov_len) {
+      n -= (*iov)[idx].iov_len;
+      ++idx;
+    }
+    if (n > 0) {
+      (*iov)[idx].iov_base = static_cast<char*>((*iov)[idx].iov_base) + n;
+      (*iov)[idx].iov_len -= n;
+    }
+  }
+  return true;
 }
 
 // Compressed body layout: u64 raw_len | zlib stream (level 1 — the
@@ -255,6 +329,55 @@ bool InflateBody(const std::vector<char>& comp, std::vector<char>* out) {
     return false;
   return dest_len == raw_len;
 }
+
+// Per-connection-writer deflate state: one deflateInit for the
+// connection's lifetime, deflateReset between frames — compress2 pays
+// the full init (window + hash table setup) on EVERY frame. Identical
+// output bytes (same level-1 / default window / default strategy), so
+// the adaptive shrink check and wire parity are unchanged. Callers
+// already serialize frame writes (wmu), which serializes this too.
+// RpcConfig::deflate_reuse=false restores the per-frame compress2 path
+// (the A/B lever); an init failure falls back the same way.
+class DeflateCtx {
+ public:
+  ~DeflateCtx() {
+    if (init_) deflateEnd(&zs_);
+  }
+  // Same contract as DeflateBody: false when deflate would not shrink.
+  bool Deflate(const std::vector<char>& raw, std::vector<char>* out) {
+    if (!GlobalRpcConfig().deflate_reuse.load() ||
+        raw.size() > (1ULL << 31))  // one-shot avail_in is 32-bit
+      return DeflateBody(raw, out);
+    if (!init_) {
+      std::memset(&zs_, 0, sizeof(zs_));
+      if (deflateInit(&zs_, 1) != Z_OK) return DeflateBody(raw, out);
+      init_ = true;
+    } else {
+      deflateReset(&zs_);
+    }
+    uLong bound = deflateBound(&zs_, static_cast<uLong>(raw.size()));
+    out->resize(8 + bound);
+    uint64_t raw_len = raw.size();
+    std::memcpy(out->data(), &raw_len, 8);
+    zs_.next_in = reinterpret_cast<Bytef*>(
+        const_cast<char*>(raw.data()));
+    zs_.avail_in = static_cast<uInt>(raw.size());
+    zs_.next_out = reinterpret_cast<Bytef*>(out->data() + 8);
+    zs_.avail_out = static_cast<uInt>(bound);
+    if (deflate(&zs_, Z_FINISH) != Z_STREAM_END) {
+      deflateEnd(&zs_);
+      init_ = false;
+      return DeflateBody(raw, out);
+    }
+    if (8 + zs_.total_out >= raw.size()) return false;
+    out->resize(8 + zs_.total_out);
+    return true;
+  }
+
+ private:
+  z_stream zs_;
+  bool init_ = false;
+};
 
 // Full-jitter retry sleep: U(0, 2^attempt ms), capped at 64ms. The old
 // fixed 2^attempt ladder fired synchronized retry stampedes — every
@@ -632,6 +755,18 @@ void GraphServer::AcceptLoop() {
   }
 }
 
+// A decoded, registered execute plan (kPrepare): the DAG + requested
+// output names, executed IN PLACE by every prepared request that names
+// its id (the DAGDef read-only concurrency contract, dag.h). `gen`
+// snapshots the server's plan generation at registration — an
+// ownership-map flip bumps it and strands every older entry (client
+// plans bake in shard routing; a flip must force a re-prepare).
+struct PreparedPlan {
+  DAGDef dag;
+  std::vector<std::string> outputs;
+  uint64_t gen = 0;
+};
+
 // Per-connection v2 state: the reply write lock (out-of-order completions
 // serialize on it), the hello-negotiated compression caps, and the
 // in-flight dispatch bound. shared_ptr-held because executor completions
@@ -644,6 +779,18 @@ struct GraphServer::ConnState {
   bool peer_compress = false;  // hello: client accepts deflated replies
   bool peer_epoch = false;     // hello: client wants epoch reply prefixes
   uint64_t peer_threshold = 0;
+  // reused per-connection deflate state (under wmu, like the writes)
+  DeflateCtx deflate;
+  // bounded LRU of registered plans (kPrepare), id = content hash.
+  // Touched on the reader thread only EXCEPT that lookups check the
+  // server's plan generation — the mutex keeps a concurrent
+  // SetOwnership bump well-defined.
+  std::mutex plan_mu;
+  std::list<uint64_t> plan_lru;  // front = most recently used
+  std::unordered_map<uint64_t,
+                     std::pair<std::shared_ptr<const PreparedPlan>,
+                               std::list<uint64_t>::iterator>>
+      plans;
   std::mutex imu;
   std::condition_variable icv;
   int inflight = 0;  // dispatched requests whose reply is not yet written
@@ -849,6 +996,12 @@ Status GraphServer::SetOwnership(std::shared_ptr<const OwnershipMap> m) {
     omap_ = m;
   }
   map_epoch_.store(m->map_epoch);
+  // strand every cached prepared plan (all connections): the distribute
+  // rewrite bakes shard routing into client plans, so a flip makes them
+  // stale — the next prepared execute against an old-generation entry
+  // answers the counted miss status and the client re-prepares against
+  // the new map. Never a silent stale-plan execute.
+  plan_gen_.fetch_add(1);
   ET_LOG(INFO) << "shard " << shard_idx_ << " installed ownership map "
                << m->Encode();
   return Status::OK();
@@ -1091,6 +1244,12 @@ void GraphServer::HandleConnection(int fd) {
     } else if (msg_type == kSetOwnership) {
       ByteReader r(body.data(), body.size());
       HandleSetOwnership(&r, &w);
+    } else if (msg_type == kPrepare) {
+      // per-connection plan state is a v2 concept; a v1 peer can only
+      // have sent this by mistake — refuse explicitly, never a silent
+      // ping-shaped 0 that would misparse as a registered plan
+      w.Put<uint32_t>(1);
+      w.PutStr("prepared plans require the v2 transport");
     } else {  // ping
       w.Put<uint32_t>(0);
     }
@@ -1137,24 +1296,28 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     const size_t raw_len = payload.size() + (stamp ? 8 : 0);
     std::vector<char> comp;
     bool compressed = false;
-    if (conn->peer_compress && conn->peer_threshold > 0 &&
-        raw_len >= conn->peer_threshold) {
-      // the epoch prefix lives INSIDE the deflate stream; this branch
-      // already pays buffer copies, so stamping-by-copy is free here
-      std::vector<char> stamped;
-      const std::vector<char>* src = &payload;
-      if (stamp) {
-        stamped.reserve(raw_len);
-        stamped.resize(8);
-        std::memcpy(stamped.data(), &epoch, 8);
-        stamped.insert(stamped.end(), payload.begin(), payload.end());
-        src = &stamped;
-      }
-      compressed = DeflateBody(*src, &comp);
-      if (compressed) out_flags |= kFrameFlagCompressed;
+    const bool try_compress = conn->peer_compress &&
+                              conn->peer_threshold > 0 &&
+                              raw_len >= conn->peer_threshold;
+    // the epoch prefix lives INSIDE the deflate stream; this branch
+    // already pays buffer copies, so stamping-by-copy is free here
+    std::vector<char> stamped;
+    const std::vector<char>* src = &payload;
+    if (try_compress && stamp) {
+      stamped.reserve(raw_len);
+      stamped.resize(8);
+      std::memcpy(stamped.data(), &epoch, 8);
+      stamped.insert(stamped.end(), payload.begin(), payload.end());
+      src = &stamped;
     }
     std::lock_guard<std::mutex> lk(conn->wmu);
     if (conn->write_broken) return;
+    if (try_compress) {
+      // deflate under wmu: the reused per-connection context (one
+      // deflateInit per connection, reset per frame) is single-writer
+      compressed = conn->deflate.Deflate(*src, &comp);
+      if (compressed) out_flags |= kFrameFlagCompressed;
+    }
     bool ok;
     if (compressed) {
       ok = WriteFrameV2(conn->fd, mt, out_flags, rid, comp.data(),
@@ -1208,6 +1371,15 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     std::memcpy(&req_trace.parent, body.data() + 8, 8);
     body.erase(body.begin(), body.begin() + 16);
   }
+  // prepared-plan id (fourth prefix): the remaining body is feed
+  // tensors only; the DAG + outputs come from the connection's plan
+  // cache (or the request answers the explicit miss status below)
+  uint64_t plan_id = 0;
+  if ((flags & kFrameFlagPrepared) != 0) {
+    if (body.size() < 8) return false;  // protocol error
+    std::memcpy(&plan_id, body.data(), 8);
+    body.erase(body.begin(), body.begin() + 8);
+  }
   if (msg_type == kHello) {
     ByteReader r(body.data(), body.size());
     uint32_t pver = 0, feats = 0;
@@ -1221,9 +1393,50 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     ByteWriter w;
     w.Put<uint32_t>(kProtoV2);
     w.Put<uint32_t>(kFeatAcceptCompressed | kFeatEpoch | kFeatDeadline |
-                    kFeatMapEpoch | kFeatTrace);
+                    kFeatMapEpoch | kFeatTrace | kFeatPrepared);
     w.Put<uint64_t>(thresh);
     write_reply(kHello, request_id, w.buffer());
+    return true;
+  }
+  if (msg_type == kPrepare) {
+    // register on the reader thread: decode is O(plan) exactly once per
+    // plan per connection — the cost every later prepared kExecute on
+    // this connection stops paying
+    ByteWriter w;
+    ExecuteRequest preq;
+    ByteReader r(body.data(), body.size());
+    Status ps = DecodeExecutePlan(&r, &preq);
+    if (ps.ok() && r.remaining() != 0)
+      ps = Status::IOError("trailing bytes after execute plan");
+    if (!ps.ok()) {
+      w.Put<uint32_t>(1);
+      w.PutStr(ps.message());
+    } else {
+      const uint64_t id = PlanContentHash(body.data(), body.size());
+      auto plan = std::make_shared<PreparedPlan>();
+      plan->dag.nodes = std::move(preq.nodes);
+      plan->outputs = std::move(preq.outputs);
+      plan->gen = plan_gen_.load();
+      const int cap = std::max(GlobalRpcConfig().plan_cache.load(), 1);
+      {
+        std::lock_guard<std::mutex> lk(conn->plan_mu);
+        auto it = conn->plans.find(id);
+        if (it != conn->plans.end()) {
+          conn->plan_lru.erase(it->second.second);
+          conn->plans.erase(it);
+        }
+        conn->plan_lru.push_front(id);
+        conn->plans[id] = {std::move(plan), conn->plan_lru.begin()};
+        while (static_cast<int>(conn->plans.size()) > cap) {
+          conn->plans.erase(conn->plan_lru.back());
+          conn->plan_lru.pop_back();
+        }
+      }
+      GlobalRpcCounters().prepared_registered.fetch_add(1);
+      w.Put<uint32_t>(0);
+      w.Put<uint64_t>(id);
+    }
+    write_reply(kPrepare, request_id, w.buffer());
     return true;
   }
   if (msg_type == kApplyDelta || msg_type == kGetDelta ||
@@ -1287,6 +1500,49 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
   // completion fires on a pool thread), so one connection can have many
   // requests executing while this reader keeps reading; no server thread
   // is parked per in-flight request.
+  //
+  // Prepared execute: resolve the plan id against this connection's
+  // cache FIRST. An unknown / evicted / generation-stale id answers an
+  // explicit counted miss status right here — the feeds are never
+  // guessed against some other plan, and the client re-prepares.
+  std::shared_ptr<const PreparedPlan> prep;
+  if (plan_id != 0) {
+    auto& ctr = GlobalRpcCounters();
+    bool invalidated = false;
+    const uint64_t cur_gen = plan_gen_.load();
+    {
+      std::lock_guard<std::mutex> lk(conn->plan_mu);
+      auto it = conn->plans.find(plan_id);
+      if (it != conn->plans.end()) {
+        if (it->second.first->gen != cur_gen) {
+          // registered against a superseded ownership map: the client
+          // plan bakes in shard routing the flip just moved
+          conn->plan_lru.erase(it->second.second);
+          conn->plans.erase(it);
+          invalidated = true;
+        } else {
+          conn->plan_lru.splice(conn->plan_lru.begin(), conn->plan_lru,
+                                it->second.second);
+          prep = it->second.first;
+        }
+      }
+    }
+    if (prep == nullptr) {
+      if (invalidated) ctr.prepared_invalidated.fetch_add(1);
+      ctr.prepared_misses.fetch_add(1);
+      ExecuteReply rep;
+      rep.status = Status::Internal(
+          "unknown prepared plan " + std::to_string(plan_id) +
+          (invalidated
+               ? " (invalidated by an ownership-map flip); re-prepare"
+               : " on this connection; re-prepare"));
+      ByteWriter w;
+      EncodeExecuteReply(rep, &w);
+      write_reply(kExecute, request_id, w.buffer());
+      return true;
+    }
+    ctr.prepared_hits.fetch_add(1);
+  }
   int cap = std::max(GlobalRpcConfig().max_inflight.load(), 1);
   {
     std::unique_lock<std::mutex> lk(conn->imu);
@@ -1298,13 +1554,21 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
   }
   struct Pending {
     OpKernelContext ctx;
+    // full-frame path: the request owns its decoded DAG + output names.
     DAGDef dag;
     std::vector<std::string> outputs;
+    // prepared path: the DAG + outputs live in the shared cached plan,
+    // executed in place (dag.h concurrency contract) — no per-request
+    // decode or copy of the plan half.
+    std::shared_ptr<const PreparedPlan> plan;
     std::unique_ptr<Executor> exec;
     // pins the snapshot this request runs against: a concurrent delta
     // apply swaps the ref, and the old graph must outlive the execution
     std::shared_ptr<const Graph> graph;
     std::shared_ptr<IndexManager> index;
+    const std::vector<std::string>& out_names() const {
+      return plan != nullptr ? plan->outputs : outputs;
+    }
   };
   // Per-request timing breakdown (queue-wait / decode / execute /
   // serialize — exactly the quantities the deadline shed measures
@@ -1326,12 +1590,81 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
   tm->trace = req_trace;
   tm->arrival_us = arrival_us;
   tm->wall_arrival_us = WallNowUs();
-  auto finish = [conn, write_reply, request_id,
-                 tm](const ExecuteReply& rep) {
+  // Zero-copy reply writer for kExecute: the reply is encoded as
+  // SEGMENTS (metadata stream + views into the pinned output tensors)
+  // and gather-written header | epoch | segments in one writev — an
+  // uncompressed reply never copies its tensor payloads into one
+  // contiguous buffer. Compression still needs contiguous bytes, so
+  // that branch materializes them (it pays buffer passes anyway), and
+  // the deflate state is the connection's reused context. Wire bytes
+  // are identical to the EncodeExecuteReply path on every branch
+  // (pinned by the native segments-parity test).
+  auto write_exec_reply = [this, conn](uint64_t rid, ExecuteReply rep) {
+    ReplySegments segs;
+    EncodeExecuteReplySegments(std::move(rep), &segs);
+    uint32_t out_flags = 0;
+    uint64_t epoch = 0;
+    const bool stamp = conn->peer_epoch;
+    if (stamp) {
+      epoch = graph_ref_->epoch();
+      out_flags |= kFrameFlagEpoch;
+    }
+    const size_t raw_len = segs.total + (stamp ? 8 : 0);
+    auto seg_ptr = [&segs](const ReplySegments::Run& r) {
+      return r.tensor >= 0 ? reinterpret_cast<const char*>(
+                                 segs.tensors[r.tensor].raw())
+                           : segs.meta.buffer().data() + r.off;
+    };
+    const bool try_compress = conn->peer_compress &&
+                              conn->peer_threshold > 0 &&
+                              raw_len >= conn->peer_threshold;
+    std::vector<char> contig;
+    if (try_compress) {
+      contig.reserve(raw_len);
+      if (stamp)
+        contig.insert(contig.end(), reinterpret_cast<const char*>(&epoch),
+                      reinterpret_cast<const char*>(&epoch) + 8);
+      for (const auto& r : segs.runs) {
+        const char* p = seg_ptr(r);
+        contig.insert(contig.end(), p, p + r.len);
+      }
+    }
+    std::lock_guard<std::mutex> lk(conn->wmu);
+    if (conn->write_broken) return;
+    bool ok;
+    std::vector<char> comp;
+    // deflate under wmu: the per-connection context is single-writer
+    if (try_compress && conn->deflate.Deflate(contig, &comp)) {
+      out_flags |= kFrameFlagCompressed;
+      ok = WriteFrameV2(conn->fd, kExecute, out_flags, rid, comp.data(),
+                        comp.size());
+    } else if (try_compress) {
+      // would not shrink: the materialized raw bytes ship as-is
+      ok = WriteFrameV2(conn->fd, kExecute, out_flags, rid, contig.data(),
+                        contig.size());
+    } else {
+      char hdr[kV2HdrLen];
+      FillV2Hdr(hdr, kExecute, out_flags, rid, raw_len);
+      std::vector<iovec> iov;
+      iov.reserve(2 + segs.runs.size());
+      auto add_iov = [&iov](const void* p, size_t n) {
+        iovec v;
+        v.iov_base = const_cast<void*>(p);
+        v.iov_len = n;
+        iov.push_back(v);
+      };
+      add_iov(hdr, kV2HdrLen);
+      if (stamp) add_iov(&epoch, 8);
+      for (const auto& r : segs.runs) add_iov(seg_ptr(r), r.len);
+      ok = WritevAll(conn->fd, &iov);
+    }
+    if (!ok) conn->write_broken = true;
+  };
+  auto finish = [conn, write_exec_reply, request_id,
+                 tm](ExecuteReply rep) {
     const int64_t ser0 = SteadyNowUs();
-    ByteWriter w;
-    EncodeExecuteReply(rep, &w);
-    write_reply(kExecute, request_id, w.buffer());
+    const bool rep_ok = rep.status.ok();
+    write_exec_reply(request_id, std::move(rep));
     const uint64_t ser_us =
         static_cast<uint64_t>(SteadyNowUs() - ser0);
     auto& trace = GlobalServerTraceStats();
@@ -1350,7 +1683,7 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     if (tm->exec_done_us > 0) trace.Observe(0, /*execute*/ 2, exec_us);
     trace.Observe(0, /*serialize*/ 3, ser_us);
     if (tm->trace.id != 0) {
-      if (!rep.status.ok()) tm->flags |= 4u;
+      if (!rep_ok) tm->flags |= 4u;
       auto clamp = [](uint64_t v) {
         return static_cast<uint32_t>(
             std::min<uint64_t>(v, 0xffffffffULL));
@@ -1377,7 +1710,7 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
   // measures — a request whose budget already expired by pickup is
   // SHED with an explicit status (counted), its DAG never run.
   GlobalThreadPool()->Schedule(
-      [this, finish, tm, deadline_us, arrival_us, req_map_epoch,
+      [this, finish, tm, deadline_us, arrival_us, req_map_epoch, prep,
        body = std::move(body)] {
         tm->pickup_us = SteadyNowUs();
         // stale ownership map: the request was SPLIT with a routing map
@@ -1416,7 +1749,10 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
         auto p = std::make_shared<Pending>();
         ExecuteRequest req;
         ByteReader r(body.data(), body.size());
-        Status ds = DecodeExecuteRequest(&r, &req);
+        // prepared path: the body is feed tensors only — the decode
+        // phase the histogram counts shrinks to exactly that
+        Status ds = prep != nullptr ? DecodeExecuteFeeds(&r, &req)
+                                    : DecodeExecuteRequest(&r, &req);
         if (!ds.ok()) {
           ExecuteReply rep;
           rep.status = ds;
@@ -1438,15 +1774,22 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
         }
         for (auto& kv : req.inputs)
           p->ctx.Put(kv.first, std::move(kv.second));
-        p->dag.nodes = std::move(req.nodes);
-        p->outputs = std::move(req.outputs);
+        const DAGDef* dag_ptr;
+        if (prep != nullptr) {
+          p->plan = prep;  // executed in place, pinned for the run
+          dag_ptr = &prep->dag;
+        } else {
+          p->dag.nodes = std::move(req.nodes);
+          p->outputs = std::move(req.outputs);
+          dag_ptr = &p->dag;
+        }
         SnapshotState(&p->graph, &p->index);
         QueryEnv env;
         env.graph = p->graph.get();
         env.index = p->index.get();
         env.pool = GlobalThreadPool();
         if (deadline_us > 0) env.deadline_us = arrival_us + deadline_us;
-        p->exec = std::make_unique<Executor>(&p->dag, env, &p->ctx);
+        p->exec = std::make_unique<Executor>(dag_ptr, env, &p->ctx);
         // completion owns the last ref to p: the executor releases its
         // stored callback before invoking (see Executor::OnNodeDone), so
         // destroying the Executor from inside its own done is the
@@ -1456,7 +1799,7 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
           ExecuteReply rep;
           rep.status = rs;
           if (rs.ok()) {
-            for (const auto& name : p->outputs) {
+            for (const auto& name : p->out_names()) {
               Tensor t;
               if (!p->ctx.Get(name, &t)) {
                 rep.status = Status::NotFound(
@@ -1467,7 +1810,7 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
               rep.outputs.emplace_back(name, std::move(t));
             }
           }
-          finish(rep);
+          finish(std::move(rep));
         });
       });
   return true;
@@ -1537,12 +1880,14 @@ class RpcChannel::MuxConn {
 
   MuxConn(int fd, bool peer_compress, int64_t compress_threshold,
           int max_inflight, std::atomic<uint64_t>* epoch_sink,
-          bool peer_deadline, bool peer_map, bool peer_trace)
+          bool peer_deadline, bool peer_map, bool peer_trace,
+          bool peer_prepared)
       : fd_(fd),
         peer_compress_(peer_compress),
         peer_deadline_(peer_deadline),
         peer_map_(peer_map),
         peer_trace_(peer_trace),
+        peer_prepared_(peer_prepared),
         compress_threshold_(compress_threshold),
         max_inflight_(std::max(max_inflight, 1)),
         epoch_sink_(epoch_sink) {
@@ -1572,9 +1917,49 @@ class RpcChannel::MuxConn {
   }
   int64_t ewma_us() { return ewma_us_.load(); }
 
+  // ---- prepared plans (client half) ----
+  bool peer_prepared() const { return peer_prepared_; }
+  bool HasPrepared(uint64_t plan_id) {
+    std::lock_guard<std::mutex> lk(prep_mu_);
+    return prepared_ids_.count(plan_id) != 0;
+  }
+  // A server miss means the plan fell out of the connection's LRU (or
+  // an ownership flip stranded it): drop the local record so the next
+  // attempt re-prepares.
+  void ForgetPrepared(uint64_t plan_id) {
+    std::lock_guard<std::mutex> lk(prep_mu_);
+    prepared_ids_.erase(plan_id);
+  }
+  // Register `plan` on THIS connection (kPrepare round trip). The
+  // server recomputes the id from the same bytes; a mismatch refuses
+  // the registration rather than recording an id that would execute a
+  // different plan.
+  Status Prepare(const std::vector<char>& plan, uint64_t plan_id) {
+    std::vector<char> reply;
+    Status s = Call(kPrepare, plan, &reply);
+    if (!s.ok()) return s;
+    ByteReader r(reply.data(), reply.size());
+    uint32_t code = 1;
+    if (!r.Get(&code)) return Status::IOError("truncated prepare reply");
+    if (code != 0) {
+      std::string msg;
+      r.GetStr(&msg);
+      return Status::Internal("prepare refused: " + msg);
+    }
+    uint64_t id = 0;
+    if (!r.Get(&id) || id != plan_id)
+      return Status::Internal("prepare id mismatch (client " +
+                              std::to_string(plan_id) + " vs server " +
+                              std::to_string(id) + ")");
+    std::lock_guard<std::mutex> lk(prep_mu_);
+    prepared_ids_.insert(plan_id);
+    return Status::OK();
+  }
+
   Status Call(uint32_t msg_type, const std::vector<char>& body,
               std::vector<char>* reply_body, int64_t deadline_abs_us = 0,
-              uint64_t map_epoch = 0, WireTrace trace = {}) {
+              uint64_t map_epoch = 0, WireTrace trace = {},
+              uint64_t plan_id = 0) {
     auto& ctr = GlobalRpcCounters();
     Waiter w;
     w.start_us = SteadyNowUs();
@@ -1592,7 +1977,7 @@ class RpcChannel::MuxConn {
     }
     ctr.inflight.fetch_add(1);
     if (!WriteRequest(msg_type, id, body, deadline_abs_us, map_epoch,
-                      trace)) {
+                      trace, plan_id)) {
       // socket dead: tear the whole conn down so every parked waiter
       // (not just this call) gets a status promptly
       Shutdown();
@@ -1644,7 +2029,7 @@ class RpcChannel::MuxConn {
   uint64_t SubmitHedged(uint32_t msg_type, const std::vector<char>& body,
                         const std::shared_ptr<HedgeGroup>& g, int leg,
                         int64_t deadline_abs_us, uint64_t map_epoch,
-                        WireTrace trace) {
+                        WireTrace trace, uint64_t plan_id = 0) {
     auto* w = new Waiter();
     w->hedge = g;
     w->leg = leg;
@@ -1677,7 +2062,7 @@ class RpcChannel::MuxConn {
     }
     GlobalRpcCounters().inflight.fetch_add(1);
     if (!WriteRequest(msg_type, id, body, deadline_abs_us, map_epoch,
-                      trace))
+                      trace, plan_id))
       Shutdown();
     return id;
   }
@@ -1722,18 +2107,22 @@ class RpcChannel::MuxConn {
 
   bool WriteRequest(uint32_t msg_type, uint64_t id,
                     const std::vector<char>& body, int64_t deadline_abs_us,
-                    uint64_t map_epoch, WireTrace trace) {
+                    uint64_t map_epoch, WireTrace trace,
+                    uint64_t plan_id = 0) {
     auto& ctr = GlobalRpcCounters();
     uint32_t flags = 0;
     // request prefixes, in wire order: [deadline u64][map_epoch u64]
-    // [trace u64 id | u64 parent], each hello-negotiated and
-    // kExecute-only. Deadline stamps the REMAINING budget at write
+    // [trace u64 id | u64 parent][plan_id u64], each hello-negotiated
+    // and kExecute-only. Deadline stamps the REMAINING budget at write
     // time (an already-expired budget stamps 1µs so the SERVER sheds
     // it); map_epoch stamps the routing map this request was split
     // with, so a server on a NEWER map refuses it instead of serving a
     // partition whose deltas now land elsewhere; trace carries the
-    // client span this request's server-side breakdown nests under.
-    char prefix[32];
+    // client span this request's server-side breakdown nests under;
+    // plan_id marks a PREPARED execute whose body is feed tensors only
+    // (the DAG was registered via kPrepare — CallExecutePrepared only
+    // passes it when the peer advertised kFeatPrepared).
+    char prefix[40];
     size_t npfx = 0;
     if (peer_deadline_ && deadline_abs_us > 0 && msg_type == kExecute) {
       uint64_t remaining_us = static_cast<uint64_t>(
@@ -1761,32 +2150,47 @@ class RpcChannel::MuxConn {
       flags |= kFrameFlagTrace;
       ctr.trace_propagated.fetch_add(1);
     }
+    if (peer_prepared_ && plan_id != 0 && msg_type == kExecute) {
+      std::memcpy(prefix + npfx, &plan_id, 8);
+      npfx += 8;
+      flags |= kFrameFlagPrepared;
+    }
     // adaptive request compression (negotiated in the hello); the
     // prefixes ride INSIDE the deflate stream like the reply epoch
     // prefix does
-    const std::vector<char>* out = &body;
-    std::vector<char> comp;
-    std::vector<char> stamped;
     const size_t raw_len = body.size() + npfx;
-    if (peer_compress_ && compress_threshold_ > 0 &&
-        static_cast<int64_t>(raw_len) >= compress_threshold_) {
-      const std::vector<char>* src = &body;
-      if (npfx > 0) {
-        stamped.resize(npfx);
-        std::memcpy(stamped.data(), prefix, npfx);
-        stamped.insert(stamped.end(), body.begin(), body.end());
-        src = &stamped;
-      }
-      if (DeflateBody(*src, &comp)) {
-        out = &comp;
-        flags |= kFrameFlagCompressed;
-        ctr.compressed_frames_sent.fetch_add(1);
-      }
+    std::vector<char> stamped;
+    const std::vector<char>* src = &body;
+    const bool try_compress =
+        peer_compress_ && compress_threshold_ > 0 &&
+        static_cast<int64_t>(raw_len) >= compress_threshold_;
+    if (try_compress && npfx > 0) {
+      stamped.resize(npfx);
+      std::memcpy(stamped.data(), prefix, npfx);
+      stamped.insert(stamped.end(), body.begin(), body.end());
+      src = &stamped;
     }
     bool wrote;
+    size_t wire_len = raw_len;
     {
       std::lock_guard<std::mutex> lk(wmu_);
-      if (npfx > 0 && (flags & kFrameFlagCompressed) == 0) {
+      const std::vector<char>* out = &body;
+      std::vector<char> comp;
+      if (try_compress) {
+        // deflate under wmu: the reused per-connection deflate state
+        // (deflateInit once, reset per frame) is single-writer, like
+        // the fd itself
+        if (dctx_.Deflate(*src, &comp)) {
+          out = &comp;
+          flags |= kFrameFlagCompressed;
+          ctr.compressed_frames_sent.fetch_add(1);
+        }
+      }
+      if ((flags & kFrameFlagCompressed) != 0) {
+        wire_len = out->size();
+        wrote = WriteFrameV2(fd_, msg_type, flags, id, out->data(),
+                             out->size());
+      } else if (npfx > 0) {
         // scatter write (header | prefixes | body): prefixing must not
         // cost an O(body) copy on every uncompressed stamped request
         char hdr[kV2HdrLen];
@@ -1795,15 +2199,12 @@ class RpcChannel::MuxConn {
                 WriteAll(fd_, prefix, npfx) &&
                 WriteAll(fd_, body.data(), body.size());
       } else {
-        wrote = WriteFrameV2(fd_, msg_type, flags, id, out->data(),
-                             out->size());
+        wrote = WriteFrameV2(fd_, msg_type, flags, id, body.data(),
+                             body.size());
       }
     }
     ctr.bytes_sent_raw.fetch_add(kV2HdrLen + raw_len);
-    if (wrote)
-      ctr.bytes_sent.fetch_add(
-          kV2HdrLen +
-          ((flags & kFrameFlagCompressed) != 0 ? out->size() : raw_len));
+    if (wrote) ctr.bytes_sent.fetch_add(kV2HdrLen + wire_len);
     return wrote;
   }
 
@@ -1951,9 +2352,16 @@ class RpcChannel::MuxConn {
   const bool peer_deadline_;
   const bool peer_map_;
   const bool peer_trace_;
+  const bool peer_prepared_;
   const int64_t compress_threshold_;
   const int max_inflight_;
   std::atomic<uint64_t>* const epoch_sink_;
+  // plan ids registered on THIS connection (a reconnect starts empty —
+  // server plan caches are per-connection state)
+  std::mutex prep_mu_;
+  std::unordered_set<uint64_t> prepared_ids_;
+  // reused request-deflate state, serialized by wmu_ like the fd
+  DeflateCtx dctx_;
   std::atomic<int64_t> ewma_us_{0};  // recent reply latency (p2c signal)
   std::atomic<uint64_t> next_id_{1};
   std::mutex wmu_;  // one writer at a time on the shared fd
@@ -2079,7 +2487,7 @@ std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
   ByteWriter hw;
   hw.Put<uint32_t>(kProtoV2);
   hw.Put<uint32_t>(kFeatAcceptCompressed | kFeatEpoch | kFeatDeadline |
-                   kFeatMapEpoch | kFeatTrace);
+                   kFeatMapEpoch | kFeatTrace | kFeatPrepared);
   const int64_t hello_thr = cfg.compress_threshold.load();
   hw.Put<uint64_t>(static_cast<uint64_t>(hello_thr > 0 ? hello_thr : 0));
   std::vector<char> hbody;
@@ -2094,17 +2502,19 @@ std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
   bool peer_deadline = false;
   bool peer_map = false;
   bool peer_trace = false;
+  bool peer_prepared = false;
   if (hello_ok) {
     ByteReader r(hbody.data(), hbody.size());
     uint32_t pver = 0, feats = 0;
     if (!r.Get(&pver) || !r.Get(&feats) || pver < kProtoV2) hello_ok = false;
     peer_compress = (feats & kFeatAcceptCompressed) != 0;
-    // only stamp deadline/map-epoch/trace prefixes for servers that
-    // will strip them — older v2 servers keep seeing byte-identical
-    // requests
+    // only stamp deadline/map-epoch/trace/prepared prefixes for servers
+    // that will strip them — older v2 servers keep seeing
+    // byte-identical requests
     peer_deadline = (feats & kFeatDeadline) != 0;
     peer_map = (feats & kFeatMapEpoch) != 0;
     peer_trace = (feats & kFeatTrace) != 0;
+    peer_prepared = (feats & kFeatPrepared) != 0;
   }
   if (!hello_ok) {
     ::close(fd);
@@ -2129,7 +2539,7 @@ std::shared_ptr<RpcChannel::MuxConn> RpcChannel::MuxGet(int slot) {
   }
   auto conn = std::make_shared<MuxConn>(
       fd, peer_compress, cfg.compress_threshold, cfg.max_inflight,
-      epoch_sink_, peer_deadline, peer_map, peer_trace);
+      epoch_sink_, peer_deadline, peer_map, peer_trace, peer_prepared);
   if (slot >= static_cast<int>(mux_conns_.size()))
     mux_conns_.resize(slot + 1);
   mux_conns_[slot] = conn;
@@ -2207,6 +2617,83 @@ Status RpcChannel::MuxCall(uint32_t msg_type, const std::vector<char>& body,
                          " failed after retries: " + last.message());
 }
 
+namespace {
+// Does this decoded-enough reply carry the server's prepared-plan miss
+// status? Only the leading code + message are peeked — the marker
+// prefix is the contract (like "stale ownership map" / "deadline
+// shed"), so a legitimate query error can never trigger a re-prepare
+// loop.
+bool IsPreparedMissReply(const std::vector<char>& reply) {
+  ByteReader r(reply.data(), reply.size());
+  uint32_t code = 0;
+  std::string msg;
+  if (!r.Get(&code) || code == 0 || !r.GetStr(&msg)) return false;
+  return msg.rfind("unknown prepared plan", 0) == 0;
+}
+}  // namespace
+
+Status RpcChannel::CallExecutePrepared(const std::vector<char>& plan,
+                                       uint64_t plan_id,
+                                       const std::vector<char>& feeds,
+                                       std::vector<char>* reply_body,
+                                       int max_retries,
+                                       int64_t deadline_abs_us,
+                                       uint64_t map_epoch,
+                                       WireTrace trace) {
+  if (max_retries <= 0) max_retries = kRetryCount;
+  auto& ctr = GlobalRpcCounters();
+  // correctness fallback: the classic full-plan frame, byte-identical
+  // to EncodeExecuteRequest (serde invariant) — used whenever the
+  // prepared path is unavailable or keeps missing
+  auto full_call = [&]() -> Status {
+    ctr.prepared_fallbacks.fetch_add(1);
+    std::vector<char> full;
+    Status as = AssembleFullExecuteRequest(feeds, plan, &full);
+    if (!as.ok()) return as;
+    return Call(kExecute, full, reply_body, max_retries, deadline_abs_us,
+                map_epoch, trace);
+  };
+  if (!(mux_ && !v1_fallback_.load())) return full_call();
+  Status last = Status::IOError("rpc not attempted");
+  for (int attempt = 0; attempt < max_retries; ++attempt) {
+    if (v1_fallback_.load()) return full_call();
+    int slots = std::max(GlobalRpcConfig().mux_connections.load(), 1);
+    int slot = PickSlot(slots);
+    auto conn = MuxGet(slot);
+    if (conn == nullptr) {
+      if (v1_fallback_.load()) return full_call();
+      JitteredBackoffUs(attempt);  // connect failed — dead endpoint
+      continue;
+    }
+    if (!conn->peer_prepared()) return full_call();  // pre-feature peer
+    if (!conn->HasPrepared(plan_id)) {
+      last = conn->Prepare(plan, plan_id);
+      if (!last.ok()) continue;  // transport died / server refused
+    }
+    int64_t hedge_us = GlobalRpcConfig().hedge_delay_us.load();
+    if (hedge_us > 0 && slots >= 2) {
+      last = HedgedMuxCall(conn, slot, slots, kExecute, feeds, reply_body,
+                           hedge_us, deadline_abs_us, map_epoch, trace,
+                           plan_id, &plan);
+    } else {
+      last = conn->Call(kExecute, feeds, reply_body, deadline_abs_us,
+                        map_epoch, trace, plan_id);
+    }
+    if (!last.ok()) continue;  // transport failure: re-dial next attempt
+    if (IsPreparedMissReply(*reply_body)) {
+      // the server evicted or invalidated the plan (both counted on its
+      // edge) — drop the local registration and re-prepare next attempt
+      conn->ForgetPrepared(plan_id);
+      last = Status::Internal("prepared plan missed; re-preparing");
+      continue;
+    }
+    return Status::OK();
+  }
+  // attempts exhausted on the prepared path (endpoint flapping or a
+  // pathological miss loop): the full frame is always correct
+  return full_call();
+}
+
 // One hedged sync call (see RpcConfig::hedge_delay_us): primary leg on
 // `conn`; if no reply lands inside hedge_us, the same request fires on
 // a different mux connection and the FIRST reply wins. The loser is
@@ -2220,11 +2707,13 @@ Status RpcChannel::HedgedMuxCall(const std::shared_ptr<MuxConn>& conn,
                                  const std::vector<char>& body,
                                  std::vector<char>* reply_body,
                                  int64_t hedge_us, int64_t deadline_abs_us,
-                                 uint64_t map_epoch, WireTrace trace) {
+                                 uint64_t map_epoch, WireTrace trace,
+                                 uint64_t plan_id,
+                                 const std::vector<char>* plan) {
   auto& ctr = GlobalRpcCounters();
   auto g = std::make_shared<MuxConn::HedgeGroup>();
   uint64_t id0 = conn->SubmitHedged(msg_type, body, g, 0, deadline_abs_us,
-                                    map_epoch, trace);
+                                    map_epoch, trace, plan_id);
   std::shared_ptr<MuxConn> conn1;
   uint64_t id1 = 0;
   {
@@ -2238,10 +2727,20 @@ Status RpcChannel::HedgedMuxCall(const std::shared_ptr<MuxConn>& conn,
       // primary leg is straggling: fire the hedge on a different conn
       lk.unlock();
       conn1 = MuxGet(PickSlot(slots, /*avoid=*/slot));
+      if (conn1 != nullptr && plan_id != 0 &&
+          !conn1->HasPrepared(plan_id)) {
+        // the hedge leg carries the SAME plan id as the primary, so
+        // its connection must know the plan before the leg fires — a
+        // one-time kPrepare round trip on a fresh hedge conn (later
+        // hedges hit the registration). A failed prepare skips the
+        // hedge rather than firing a leg guaranteed to miss.
+        if (plan == nullptr || !conn1->Prepare(*plan, plan_id).ok())
+          conn1 = nullptr;
+      }
       if (conn1 != nullptr) {
         ctr.hedge_fired.fetch_add(1);
         id1 = conn1->SubmitHedged(msg_type, body, g, 1, deadline_abs_us,
-                                  map_epoch, trace);
+                                  map_epoch, trace, plan_id);
       }
       lk.lock();
     }
@@ -2989,8 +3488,25 @@ int ClientManager::HedgeAltFor(int shard) const {
 static std::atomic<int> g_replica_hedge_legs{0};
 constexpr int kMaxReplicaHedgeLegs = 128;
 
+Status ClientManager::CallExecWire(const std::shared_ptr<RpcChannel>& chan,
+                                   const ExecWire& wire,
+                                   std::vector<char>* reply,
+                                   int64_t deadline_abs_us,
+                                   uint64_t map_epoch, WireTrace trace) {
+  // prepared mode: the channel owns registration + miss-fallback;
+  // every leg of one logical request (retries, replica-hedge legs)
+  // stamps the SAME content-hash plan id
+  if (wire.plan_id != 0)
+    return chan->CallExecutePrepared(wire.plan->buffer(), wire.plan_id,
+                                     wire.feeds->buffer(), reply,
+                                     /*max_retries=*/0, deadline_abs_us,
+                                     map_epoch, trace);
+  return chan->Call(kExecute, wire.full->buffer(), reply,
+                    /*max_retries=*/0, deadline_abs_us, map_epoch, trace);
+}
+
 Status ClientManager::ReplicaHedgedExecute(
-    int shard, int alt, std::shared_ptr<ByteWriter> body,
+    int shard, int alt, ExecWire wire,
     std::vector<char>* reply, int64_t hedge_us, int64_t deadline_abs_us,
     uint64_t map_epoch, WireTrace trace) {
   auto& ctr = GlobalRpcCounters();
@@ -3011,16 +3527,15 @@ Status ClientManager::ReplicaHedgedExecute(
     std::vector<char> reply[2];
   };
   auto race = std::make_shared<Race>();
-  auto fire = [this, body, race, deadline_abs_us, map_epoch,
+  auto fire = [this, wire, race, deadline_abs_us, map_epoch,
                trace](int leg_idx, int target) {
     g_replica_hedge_legs.fetch_add(1);
     auto chan = Channel(target);
-    std::thread([chan, body, race, deadline_abs_us, map_epoch, trace,
+    std::thread([chan, wire, race, deadline_abs_us, map_epoch, trace,
                  leg_idx] {
       std::vector<char> rep;
-      Status s = chan->Call(kExecute, body->buffer(), &rep,
-                            /*max_retries=*/0, deadline_abs_us, map_epoch,
-                            trace);
+      Status s = CallExecWire(chan, wire, &rep, deadline_abs_us,
+                              map_epoch, trace);
       {
         std::lock_guard<std::mutex> lk(race->mu);
         race->st[leg_idx] = s;
@@ -3072,8 +3587,24 @@ Status ClientManager::Execute(int shard, const ExecuteRequest& req,
                               uint64_t map_epoch, WireTrace trace) {
   if (shard < 0 || shard >= shard_num())
     return Status::InvalidArgument("bad shard index");
-  auto w = std::make_shared<ByteWriter>();
-  EncodeExecuteRequest(req, w.get());
+  ExecWire wire;
+  if (GlobalRpcConfig().prepared.load()) {
+    // split encoding: the plan half (inner DAG + output names — the
+    // part a training loop repeats thousands of times) ships at most
+    // once per connection, the feeds ship per request. The content
+    // hash is computed fresh from the encoded bytes every call, so a
+    // cached server plan can never diverge from what this request
+    // means.
+    wire.plan = std::make_shared<ByteWriter>();
+    EncodeExecutePlan(req, wire.plan.get());
+    wire.feeds = std::make_shared<ByteWriter>();
+    EncodeExecuteFeeds(req, wire.feeds.get());
+    wire.plan_id = PlanContentHash(wire.plan->buffer().data(),
+                                   wire.plan->buffer().size());
+  } else {
+    wire.full = std::make_shared<ByteWriter>();
+    EncodeExecuteRequest(req, wire.full.get());
+  }
   std::vector<char> reply;
   const int64_t t0 = SteadyNowUs();
   if (shard < stats_shards_) {
@@ -3088,7 +3619,7 @@ Status ClientManager::Execute(int shard, const ExecuteRequest& req,
                       : -1;
   if (alt >= 0 &&
       g_replica_hedge_legs.load() + 2 <= kMaxReplicaHedgeLegs) {
-    s = ReplicaHedgedExecute(shard, alt, w, &reply, hedge_us,
+    s = ReplicaHedgedExecute(shard, alt, wire, &reply, hedge_us,
                              deadline_abs_us, map_epoch, trace);
   } else if (alt >= 0) {
     // At the leg cap. The cap fills precisely when legs pile up on a
@@ -3099,14 +3630,12 @@ Status ClientManager::Execute(int shard, const ExecuteRequest& req,
     // owns every partition `shard` does, so the answer is identical).
     if (shard_reqs_ != nullptr && alt < stats_shards_)
       shard_reqs_[alt].fetch_add(1);
-    s = Channel(alt)->Call(kExecute, w->buffer(), &reply,
-                           /*max_retries=*/0, deadline_abs_us, map_epoch,
-                           trace);
+    s = CallExecWire(Channel(alt), wire, &reply, deadline_abs_us,
+                     map_epoch, trace);
   } else {
     // snapshot: the monitor may swap the channel concurrently
-    s = Channel(shard)->Call(kExecute, w->buffer(), &reply,
-                             /*max_retries=*/0, deadline_abs_us,
-                             map_epoch, trace);
+    s = CallExecWire(Channel(shard), wire, &reply, deadline_abs_us,
+                     map_epoch, trace);
   }
   if (shard < stats_shards_) {
     shard_inflight_[shard].fetch_sub(1);

@@ -33,7 +33,12 @@
 // msg types: 0 = Execute, 1 = ShardMeta, 2 = Ping, 6 = Hello (v2 only),
 //            7 = ApplyDelta, 8 = GetDelta (streaming graph deltas),
 //            9 = GetDeltaLog (raw retained delta records — the
-//                anti-entropy catch-up source for recovering shards).
+//                anti-entropy catch-up source for recovering shards),
+//            11 = Prepare (v2 only: register a content-hashed execute
+//                 plan in the connection's bounded plan LRU; flags bit
+//                 5 then marks a kExecute REQUEST whose body is a u64
+//                 plan id + feed tensors only — hello-negotiated
+//                 kFeatPrepared, the read-hot-path decode/bytes saver).
 //
 // v2 is negotiated per connection: a v2 client opens with a Hello frame
 // carrying (version, feature bits, compress threshold); a v2 server
@@ -113,6 +118,24 @@ struct RpcConfig {
   // replica_hedge_wasted). Needs an installed OwnershipMap with a
   // covering alternative owner and hedge_delay_us > 0. Default off.
   std::atomic<bool> hedge_replicas{false};
+  // Prepared-plan execution (hello feature kFeatPrepared): register
+  // each distinct kExecute plan (inner DAG + output names) once per
+  // connection via kPrepare, keyed by its content hash, then stamp
+  // subsequent kExecute frames with the plan id and ship ONLY the feed
+  // tensors. A server that does not know the id answers an explicit
+  // counted miss status and the client re-prepares (or falls back to
+  // the classic full-plan frame) — never a silent wrong-plan execute.
+  // Default off: the wire is byte-identical to pre-prepared builds.
+  std::atomic<bool> prepared{false};
+  // Server-side bound on the per-connection LRU of decoded plans. An
+  // evicted plan is a counted miss on its next use; the client
+  // re-prepares and converges.
+  std::atomic<int> plan_cache{64};
+  // Reuse one zlib deflate state per connection writer (deflateReset
+  // between frames) instead of a full per-frame init. Identical output
+  // bytes (same level/window/strategy); off restores the per-frame
+  // compress2 path for A/B.
+  std::atomic<bool> deflate_reuse{true};
 
   RpcConfig() = default;
   RpcConfig(const RpcConfig& o) { *this = o; }
@@ -124,6 +147,9 @@ struct RpcConfig {
     hedge_delay_us.store(o.hedge_delay_us.load());
     p2c.store(o.p2c.load());
     hedge_replicas.store(o.hedge_replicas.load());
+    prepared.store(o.prepared.load());
+    plan_cache.store(o.plan_cache.load());
+    deflate_reuse.store(o.deflate_reuse.load());
     return *this;
   }
 };
@@ -176,6 +202,22 @@ struct RpcCounters {
   // Zero whenever the feature is off, no trace is set, or the peer
   // predates it — the wire-identity tests pin exactly that.
   std::atomic<uint64_t> trace_propagated{0};
+  // ---- prepared plans (hello feature kFeatPrepared) ----
+  // SERVER-edge (loopback tests see both edges in one process):
+  // registered = plans installed via kPrepare; hits = prepared
+  // kExecutes served from the per-connection plan cache; misses =
+  // prepared kExecutes whose id the server did not know (evicted /
+  // never registered on this connection) — answered with an explicit
+  // miss status; invalidated = cache entries rejected because an
+  // ownership-map flip superseded the routing baked into client plans.
+  std::atomic<uint64_t> prepared_registered{0};
+  std::atomic<uint64_t> prepared_hits{0};
+  std::atomic<uint64_t> prepared_misses{0};
+  std::atomic<uint64_t> prepared_invalidated{0};
+  // CLIENT-edge: prepared execution requested but the call went out as
+  // a classic full-plan frame (peer lacks the feature / v1 fallback /
+  // persistent miss) — the correctness fallback, counted never silent.
+  std::atomic<uint64_t> prepared_fallbacks{0};
 };
 RpcCounters& GlobalRpcCounters();
 
@@ -440,6 +482,12 @@ class GraphServer {
   mutable std::mutex omap_mu_;
   std::shared_ptr<const OwnershipMap> omap_;
   std::atomic<uint64_t> map_epoch_{0};
+  // prepared-plan cache generation: bumped on every ownership-map
+  // install — the distribute rewrite bakes shard routing into client
+  // plans, so a flip invalidates every cached plan on this server
+  // (entries from an older generation answer the counted miss status
+  // and the client re-prepares against the new map)
+  std::atomic<uint64_t> plan_gen_{1};
   std::shared_ptr<DeltaWal> wal_;
   bool wal_degraded_ = false;  // wal requested but unopenable: refuse deltas
   // off-path compaction accounting: Stop() drains in-flight tasks
@@ -517,6 +565,25 @@ class RpcChannel : public std::enable_shared_from_this<RpcChannel> {
               int64_t deadline_abs_us = 0, uint64_t map_epoch = 0,
               WireTrace trace = {});
 
+  // Prepared-plan kExecute (RpcConfig::prepared, hello kFeatPrepared):
+  // ensures `plan` (keyed by plan_id, its content hash) is registered
+  // on the mux connection the call rides, then ships ONLY `feeds`,
+  // stamped with the plan id. A server miss (evicted / invalidated /
+  // unknown id — always an explicit counted status) forgets the local
+  // registration and re-prepares on the next attempt; a peer without
+  // the feature, a v1 fallback, or retry exhaustion reassembles the
+  // classic full-plan frame ('ETEY' bytes identical to Call) — counted
+  // prepared_fallbacks, never a silent wrong or dropped plan. Hedged
+  // legs (hedge_delay_us) carry the SAME plan id, each leg's
+  // connection registered before it fires.
+  Status CallExecutePrepared(const std::vector<char>& plan,
+                             uint64_t plan_id,
+                             const std::vector<char>& feeds,
+                             std::vector<char>* reply_body,
+                             int max_retries = 0,
+                             int64_t deadline_abs_us = 0,
+                             uint64_t map_epoch = 0, WireTrace trace = {});
+
   // Async mux submission: invokes done(status, reply) when the reply
   // frame arrives (or the connection dies). Requires mux mode; without
   // it the call is executed inline (blocking) before done fires.
@@ -557,12 +624,16 @@ class RpcChannel : public std::enable_shared_from_this<RpcChannel> {
   // One hedged sync mux call: primary leg on `conn`; past hedge_us
   // without a reply, the same request fires on a second connection and
   // the first reply wins (the loser is abandoned by request_id).
+  // plan_id != 0: both legs are prepared executes stamped with the
+  // SAME plan id (`plan` is registered on the hedge connection before
+  // its leg fires, so a fresh conn can never miss by construction).
   Status HedgedMuxCall(const std::shared_ptr<MuxConn>& conn, int slot,
                        int slots, uint32_t msg_type,
                        const std::vector<char>& body,
                        std::vector<char>* reply_body, int64_t hedge_us,
                        int64_t deadline_abs_us, uint64_t map_epoch,
-                       WireTrace trace);
+                       WireTrace trace, uint64_t plan_id = 0,
+                       const std::vector<char>* plan = nullptr);
   // Mux slot for the next call: p2c over (inflight, EWMA latency) when
   // configured, else round-robin. `avoid` >= 0 excludes that slot (the
   // hedge leg must take a different wire path).
@@ -786,12 +857,27 @@ class ClientManager {
 
  private:
   std::shared_ptr<RpcChannel> Channel(int shard) const;
+  // Encoded wire forms of one kExecute: the classic full frame
+  // (prepared off — today's byte-identical path) OR the split
+  // plan/feeds pair + content-hash plan id (RpcConfig::prepared; the
+  // channel reassembles the full frame itself on fallback). Shared so
+  // replica-hedge legs race the same logical request — both legs stamp
+  // the SAME plan id.
+  struct ExecWire {
+    std::shared_ptr<ByteWriter> full;
+    std::shared_ptr<ByteWriter> plan;
+    std::shared_ptr<ByteWriter> feeds;
+    uint64_t plan_id = 0;
+  };
+  static Status CallExecWire(const std::shared_ptr<RpcChannel>& chan,
+                             const ExecWire& wire, std::vector<char>* reply,
+                             int64_t deadline_abs_us, uint64_t map_epoch,
+                             WireTrace trace);
   // Two-leg replica race (RpcConfig::hedge_replicas): primary on
   // `shard`, and past hedge_us without a reply the same bytes fire at
   // `alt` (a covering owner). First reply wins; the loser's blocking
   // leg drains on its own thread and is discarded (counted).
-  Status ReplicaHedgedExecute(int shard, int alt,
-                              std::shared_ptr<ByteWriter> body,
+  Status ReplicaHedgedExecute(int shard, int alt, ExecWire wire,
                               std::vector<char>* reply, int64_t hedge_us,
                               int64_t deadline_abs_us, uint64_t map_epoch,
                               WireTrace trace);

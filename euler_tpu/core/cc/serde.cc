@@ -7,6 +7,11 @@ namespace {
 // a mixed-version client/server pair fails fast on the magic check
 // instead of misreading the record.
 constexpr uint32_t kExecMagic = 0x59455445;  // 'ETEY'
+// Prepared-plan split pieces (see serde.h): the plan half and the
+// feeds half of one ExecuteRequest, each self-tagged so a frame that
+// lands on the wrong decoder fails fast instead of misreading.
+constexpr uint32_t kPlanMagic = 0x4e505445;   // 'ETPN'
+constexpr uint32_t kFeedsMagic = 0x46455445;  // 'ETEF'
 
 void PutStrList(const std::vector<std::string>& v, ByteWriter* w) {
   w->Put<uint32_t>(static_cast<uint32_t>(v.size()));
@@ -23,7 +28,14 @@ Status GetStrList(ByteReader* r, std::vector<std::string>* out) {
 }
 }  // namespace
 
+size_t EncodedTensorSize(const Tensor& t) {
+  return 4 + 4 + 8 * static_cast<size_t>(t.rank()) + t.ByteSize();
+}
+
 void EncodeTensor(const Tensor& t, ByteWriter* w) {
+  // sizing pass: one reserve instead of doubling-reallocs while a
+  // large gather payload appends (encoded bytes unchanged)
+  w->Reserve(EncodedTensorSize(t));
   w->Put<int32_t>(static_cast<int32_t>(t.dtype()));
   w->Put<uint32_t>(static_cast<uint32_t>(t.rank()));
   for (int64_t d : t.dims()) w->Put<int64_t>(d);
@@ -133,6 +145,14 @@ Status DecodeExecuteRequest(ByteReader* r, ExecuteRequest* out) {
 }
 
 void EncodeExecuteReply(const ExecuteReply& rep, ByteWriter* w) {
+  // sizing pass: total reply size is cheap to compute up front (names
+  // + tensor headers + payload bytes), so one reserve kills the
+  // realloc churn a multi-megabyte gather reply used to pay
+  size_t total = 4 + 4 + rep.status.message().size();
+  if (rep.status.ok())
+    for (const auto& kv : rep.outputs)
+      total += 4 + kv.first.size() + EncodedTensorSize(kv.second);
+  w->Reserve(total);
   w->Put<uint32_t>(static_cast<uint32_t>(rep.status.code()));
   w->PutStr(rep.status.message());
   if (!rep.status.ok()) return;
@@ -141,6 +161,116 @@ void EncodeExecuteReply(const ExecuteReply& rep, ByteWriter* w) {
     w->PutStr(kv.first);
     EncodeTensor(kv.second, w);
   }
+}
+
+void EncodeExecutePlan(const ExecuteRequest& req, ByteWriter* w) {
+  w->Put<uint32_t>(kPlanMagic);
+  EncodeDag(req.nodes, w);
+  PutStrList(req.outputs, w);
+}
+
+Status DecodeExecutePlan(ByteReader* r, ExecuteRequest* out) {
+  uint32_t magic;
+  if (!r->Get(&magic) || magic != kPlanMagic)
+    return Status::IOError("bad execute plan magic");
+  ET_RETURN_IF_ERROR(DecodeDag(r, &out->nodes));
+  return GetStrList(r, &out->outputs);
+}
+
+void EncodeExecuteFeeds(const ExecuteRequest& req, ByteWriter* w) {
+  size_t total = 8;
+  for (const auto& kv : req.inputs)
+    total += 4 + kv.first.size() + EncodedTensorSize(kv.second);
+  w->Reserve(total);
+  w->Put<uint32_t>(kFeedsMagic);
+  w->Put<uint32_t>(static_cast<uint32_t>(req.inputs.size()));
+  for (const auto& kv : req.inputs) {
+    w->PutStr(kv.first);
+    EncodeTensor(kv.second, w);
+  }
+}
+
+Status DecodeExecuteFeeds(ByteReader* r, ExecuteRequest* out) {
+  uint32_t magic, n_in;
+  if (!r->Get(&magic) || magic != kFeedsMagic)
+    return Status::IOError("bad execute feeds magic");
+  if (!r->Get(&n_in)) return Status::IOError("truncated feeds");
+  out->inputs.resize(n_in);
+  for (uint32_t i = 0; i < n_in; ++i) {
+    if (!r->GetStr(&out->inputs[i].first))
+      return Status::IOError("truncated feed name");
+    ET_RETURN_IF_ERROR(DecodeTensor(r, &out->inputs[i].second));
+  }
+  return Status::OK();
+}
+
+Status AssembleFullExecuteRequest(const std::vector<char>& feeds,
+                                  const std::vector<char>& plan,
+                                  std::vector<char>* out) {
+  // 'ETEY' | feeds minus its magic | plan minus its magic — exactly the
+  // EncodeExecuteRequest layout (magic | n_inputs | inputs | dag |
+  // outputs). Magic-checked so a swapped-argument caller fails fast.
+  uint32_t fm = 0, pm = 0;
+  if (feeds.size() < 4 || plan.size() < 4) return Status::IOError("short");
+  std::memcpy(&fm, feeds.data(), 4);
+  std::memcpy(&pm, plan.data(), 4);
+  if (fm != kFeedsMagic || pm != kPlanMagic)
+    return Status::IOError("assemble: bad feeds/plan magic");
+  out->clear();
+  out->reserve(feeds.size() + plan.size() - 4);
+  out->insert(out->end(), reinterpret_cast<const char*>(&kExecMagic),
+              reinterpret_cast<const char*>(&kExecMagic) + 4);
+  out->insert(out->end(), feeds.begin() + 4, feeds.end());
+  out->insert(out->end(), plan.begin() + 4, plan.end());
+  return Status::OK();
+}
+
+uint64_t PlanContentHash(const char* p, size_t n) {
+  uint64_t h = 1469598103934665603ULL;  // FNV-1a 64 offset basis
+  for (size_t i = 0; i < n; ++i) {
+    h ^= static_cast<unsigned char>(p[i]);
+    h *= 1099511628211ULL;
+  }
+  return h != 0 ? h : 1;  // 0 is the "no plan" sentinel on the wire
+}
+
+void EncodeExecuteReplySegments(ExecuteReply&& rep, ReplySegments* out) {
+  out->runs.clear();
+  out->tensors.clear();
+  out->total = 0;
+  ByteWriter& m = out->meta;
+  size_t meta_total = 4 + 4 + rep.status.message().size();
+  if (rep.status.ok())
+    for (const auto& kv : rep.outputs)
+      meta_total += 4 + kv.first.size() + 16 + 8 * kv.second.rank();
+  m.Reserve(meta_total);
+  size_t run_start = 0;
+  auto close_meta_run = [&] {
+    if (m.buffer().size() > run_start)
+      out->runs.push_back({run_start, m.buffer().size() - run_start, -1});
+    run_start = m.buffer().size();
+  };
+  m.Put<uint32_t>(static_cast<uint32_t>(rep.status.code()));
+  m.PutStr(rep.status.message());
+  if (rep.status.ok()) {
+    m.Put<uint32_t>(static_cast<uint32_t>(rep.outputs.size()));
+    for (auto& kv : rep.outputs) {
+      m.PutStr(kv.first);
+      // the EncodeTensor header, inline in the meta stream; the payload
+      // becomes a view into the pinned tensor instead of a copy
+      m.Put<int32_t>(static_cast<int32_t>(kv.second.dtype()));
+      m.Put<uint32_t>(static_cast<uint32_t>(kv.second.rank()));
+      for (int64_t d : kv.second.dims()) m.Put<int64_t>(d);
+      if (kv.second.ByteSize() > 0) {
+        close_meta_run();
+        out->runs.push_back({0, kv.second.ByteSize(),
+                             static_cast<int>(out->tensors.size())});
+        out->tensors.push_back(std::move(kv.second));
+      }
+    }
+  }
+  close_meta_run();
+  for (const auto& r : out->runs) out->total += r.len;
 }
 
 Status DecodeExecuteReply(ByteReader* r, ExecuteReply* out) {

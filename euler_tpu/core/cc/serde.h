@@ -49,10 +49,67 @@ struct ExecuteReply {
   std::vector<std::pair<std::string, Tensor>> outputs;
 };
 
+// Exact encoded size of one tensor (header + dims + payload) — the
+// sizing pass EncodeTensor / EncodeExecuteReply reserve from.
+size_t EncodedTensorSize(const Tensor& t);
+
 void EncodeExecuteRequest(const ExecuteRequest& req, ByteWriter* w);
 Status DecodeExecuteRequest(ByteReader* r, ExecuteRequest* out);
 void EncodeExecuteReply(const ExecuteReply& rep, ByteWriter* w);
 Status DecodeExecuteReply(ByteReader* r, ExecuteReply* out);
+
+// ---------------------------------------------------------------------------
+// Prepared-plan split (rpc.h kFeatPrepared): one ExecuteRequest is the
+// concatenation of a content-stable PLAN (the inner DAG + requested
+// output names — identical across the thousands of steps of a training
+// loop) and the per-request FEEDS (the named input tensors). The client
+// registers the plan once per connection (kPrepare, keyed by its
+// content hash) and then ships only the feeds.
+//
+//   plan  : u32 'ETPN' | dag | u32 n_outputs | n×str
+//   feeds : u32 'ETEF' | u32 n_inputs | n×(str name, tensor)
+//
+// Invariant (pinned by native test): 'ETEY' + feeds[4:] + plan[4:] is
+// byte-identical to EncodeExecuteRequest of the same request — the
+// transport can always reassemble the classic full frame for fallback.
+// ---------------------------------------------------------------------------
+void EncodeExecutePlan(const ExecuteRequest& req, ByteWriter* w);
+Status DecodeExecutePlan(ByteReader* r, ExecuteRequest* out);
+void EncodeExecuteFeeds(const ExecuteRequest& req, ByteWriter* w);
+Status DecodeExecuteFeeds(ByteReader* r, ExecuteRequest* out);
+// Reassemble the classic EncodeExecuteRequest bytes from the split
+// pieces (full-plan fallback when a peer lacks kFeatPrepared or a
+// prepared execute keeps missing).
+Status AssembleFullExecuteRequest(const std::vector<char>& feeds,
+                                  const std::vector<char>& plan,
+                                  std::vector<char>* out);
+// FNV-1a 64 over the encoded plan bytes — the prepared-plan id. Both
+// sides compute it from the same bytes, so a cache hit can never
+// execute a different plan than the client encoded (an unknown or
+// stale id is an explicit miss status, never a silent wrong plan).
+uint64_t PlanContentHash(const char* p, size_t n);
+
+// ---------------------------------------------------------------------------
+// Zero-copy reply segments: EncodeExecuteReply's bytes, split into the
+// metadata stream (status / names / tensor headers, owned by `meta`)
+// and views into the reply's tensor payloads (pinned by `tensors`), so
+// an uncompressed reply can be writev'd header+prefix+bodies without
+// ever copying the tensor bytes into one contiguous buffer. The runs
+// concatenated in order are byte-identical to EncodeExecuteReply
+// (pinned by native test).
+// ---------------------------------------------------------------------------
+struct ReplySegments {
+  struct Run {
+    size_t off = 0;  // meta-run: offset into meta.buffer()
+    size_t len = 0;
+    int tensor = -1;  // >= 0: this run is tensors[tensor].raw() bytes
+  };
+  ByteWriter meta;
+  std::vector<Run> runs;
+  std::vector<Tensor> tensors;  // payload owners (moved from the reply)
+  size_t total = 0;             // sum of run lengths
+};
+void EncodeExecuteReplySegments(ExecuteReply&& rep, ReplySegments* out);
 
 }  // namespace et
 

@@ -162,7 +162,10 @@ def _declare(lib: ctypes.CDLL) -> None:
         # RPC transport (protocol v2 mux / adaptive compression): global
         # config + client-edge counters — see euler_tpu.graph.remote
         # configure_rpc() / rpc_transport_stats() for the friendly wrapper
-        "etg_rpc_config": (None, [i32, i32, i64, i32, i64, i32, i32]),
+        # (+ prepared plans / plan-cache size / deflate reuse — the
+        # wire-path knobs; stats out buffer is 27 u64s)
+        "etg_rpc_config": (None, [i32, i32, i64, i32, i64, i32, i32,
+                                  i32, i32, i32]),
         "etg_rpc_stats": (None, [c_u64p]),
         # elastic fleet: epoch-versioned ownership maps — install on a
         # distribute-mode proxy / in-process server, push to a remote

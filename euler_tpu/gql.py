@@ -437,6 +437,28 @@ def server_trace_hist(verb: str = "execute",
                         zip(list(_TRACE_BOUNDS_US) + ["+Inf"], counts)]}
 
 
+def server_phase_quantile(verb: str = "execute", phase: str = "decode",
+                          q: float = 0.99, baseline: dict = None):
+    """Bucket-interpolated quantile (ms) of one native server phase
+    histogram — the counted ruler the wire-path work is judged by
+    (accept.py's decode-phase gate, bench_host --mode wire). With
+    `baseline` (a prior server_trace_hist snapshot of the SAME
+    verb/phase), the quantile is computed over the DELTA since that
+    snapshot, so an A/B leg reads only its own requests. None when the
+    (delta) histogram is empty."""
+    from euler_tpu.obs.metrics import bucket_quantile
+
+    h = server_trace_hist(verb, phase)
+    counts = [c for _, c in h["buckets"]]
+    if baseline is not None:
+        base = [c for _, c in baseline["buckets"]]
+        counts = [max(c - b, 0) for c, b in zip(counts, base)]
+    if sum(counts) == 0:
+        return None
+    v = bucket_quantile(counts, _TRACE_BOUNDS_US, q)
+    return None if v is None else v / 1000.0
+
+
 def server_trace_spans() -> list:
     """Drain the bounded server-side span ring: one dict per request
     that carried a wire trace context (kFeatTrace), with the

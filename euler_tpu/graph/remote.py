@@ -125,13 +125,21 @@ _RPC_STAT_KEYS = (
     # cross-process tracing: kExecute requests stamped with a wire
     # trace context (zero with tracing off / against pre-trace peers —
     # the wire-identity pins read exactly this)
-    "trace_propagated")
+    "trace_propagated",
+    # prepared query plans (wire path): registered/hits/misses/
+    # invalidated are SERVER-edge plan-cache accounting (a miss or an
+    # ownership-flip invalidation is always an explicit status the
+    # client answers by re-preparing); fallbacks is CLIENT-edge — a
+    # prepared call that went out as a classic full-plan frame
+    "prepared_registered", "prepared_hits", "prepared_misses",
+    "prepared_invalidated", "prepared_fallbacks")
 
 # Last config applied through configure_rpc (the native side has no
 # getter). RemoteGraphEngine reads `mux` to default pool_shared.
 _RPC_CONFIG = {"mux": False, "connections": 1, "compress_threshold": 0,
                "max_inflight": 256, "hedge_delay_ms": 0.0, "p2c": False,
-               "hedge_replicas": False}
+               "hedge_replicas": False, "prepared": False,
+               "plan_cache": 64, "deflate_reuse": True}
 _rpc_mu = threading.Lock()
 _rpc_env_applied = False
 _rpc_obs_done = False
@@ -139,7 +147,8 @@ _rpc_obs_done = False
 
 def configure_rpc(mux=None, connections=None, compress_threshold=None,
                   max_inflight=None, hedge_delay_ms=None,
-                  p2c=None, hedge_replicas=None) -> dict:
+                  p2c=None, hedge_replicas=None, prepared=None,
+                  plan_cache=None, deflate_reuse=None) -> dict:
     """Set the PROCESS-GLOBAL graph-RPC transport knobs; returns the
     resulting config. None leaves a knob unchanged. Applies to engines
     (native channels) built AFTER the call — except hedge_delay_ms and
@@ -168,7 +177,21 @@ def configure_rpc(mux=None, connections=None, compress_threshold=None,
       counted replica_hedge_fired/won/wasted. Needs an ownership map
       with multi-owner partitions (elastic rebalancing) and
       hedge_delay_ms > 0. The explicitly-deferred PR 11 item: graph
-      shards had no replicas until the elastic fleet."""
+      shards had no replicas until the elastic fleet.
+    prepared: prepared query plans (the read-hot-path wire saver, needs
+      mux): each distinct kExecute plan (inner DAG + output names)
+      registers ONCE per connection keyed by its content hash, then
+      steady-state requests ship only the feed tensors stamped with the
+      plan id — request bytes and server decode time stop paying for
+      the plan a training loop repeats thousands of times. An unknown /
+      evicted / ownership-flip-invalidated id is an explicit counted
+      miss status (prepared_misses / prepared_invalidated) the client
+      answers by re-preparing; pre-feature peers and prepared-off calls
+      are byte-identical to today (prepared_fallbacks counts full-frame
+      sends). plan_cache: server-side per-connection LRU bound on
+      decoded plans. deflate_reuse: reuse one zlib deflate state per
+      connection writer (deflateReset per frame, identical bytes)
+      instead of a per-frame init; off restores compress2 for A/B."""
     from euler_tpu.core import lib as _lib
 
     lib = _lib.load()
@@ -188,6 +211,12 @@ def configure_rpc(mux=None, connections=None, compress_threshold=None,
             _RPC_CONFIG["p2c"] = bool(p2c)
         if hedge_replicas is not None:
             _RPC_CONFIG["hedge_replicas"] = bool(hedge_replicas)
+        if prepared is not None:
+            _RPC_CONFIG["prepared"] = bool(prepared)
+        if plan_cache is not None:
+            _RPC_CONFIG["plan_cache"] = max(int(plan_cache), 1)
+        if deflate_reuse is not None:
+            _RPC_CONFIG["deflate_reuse"] = bool(deflate_reuse)
         lib.etg_rpc_config(
             -1 if mux is None else int(bool(mux)),
             0 if connections is None else max(int(connections), 1),
@@ -197,7 +226,10 @@ def configure_rpc(mux=None, connections=None, compress_threshold=None,
             -1 if hedge_delay_ms is None else max(
                 int(float(hedge_delay_ms) * 1000.0), 0),
             -1 if p2c is None else int(bool(p2c)),
-            -1 if hedge_replicas is None else int(bool(hedge_replicas)))
+            -1 if hedge_replicas is None else int(bool(hedge_replicas)),
+            -1 if prepared is None else int(bool(prepared)),
+            0 if plan_cache is None else max(int(plan_cache), 1),
+            -1 if deflate_reuse is None else int(bool(deflate_reuse)))
         return dict(_RPC_CONFIG)
 
 
@@ -228,6 +260,14 @@ def configure_rpc_from_env() -> dict:
     if os.environ.get("EULER_TPU_RPC_HEDGE_REPLICAS"):
         kw["hedge_replicas"] = (
             os.environ["EULER_TPU_RPC_HEDGE_REPLICAS"] not in ("0", ""))
+    if os.environ.get("EULER_TPU_RPC_PREPARED"):
+        kw["prepared"] = os.environ["EULER_TPU_RPC_PREPARED"] not in (
+            "0", "")
+    if os.environ.get("EULER_TPU_RPC_PLAN_CACHE"):
+        kw["plan_cache"] = int(os.environ["EULER_TPU_RPC_PLAN_CACHE"])
+    if os.environ.get("EULER_TPU_RPC_DEFLATE_REUSE"):
+        kw["deflate_reuse"] = os.environ[
+            "EULER_TPU_RPC_DEFLATE_REUSE"] not in ("0", "")
     # apply BEFORE publishing the applied flag: a concurrently
     # constructing engine must never observe applied=True while the env
     # config has not reached the native side yet (it would build its

@@ -238,7 +238,13 @@ class MicroBatcher:
             batch, reason = taken
             now = time.monotonic()
             for p in batch:
-                self._hist_wait.observe((now - p.t_enq) * 1000.0)
+                # per-request queue wait, stamped onto the Future BEFORE
+                # it resolves so the server's phase breakdown
+                # (InferenceServer._wait → serving_phase_ms / request
+                # spans) can read it after result() without extra
+                # plumbing through the batcher API
+                p.future.queue_wait_ms = (now - p.t_enq) * 1000.0
+                self._hist_wait.observe(p.future.queue_wait_ms)
             self._hist_rows.observe(sum(p.rows for p in batch))
             self._hist_reqs.observe(len(batch))
             self._ctr_flush.labels(batcher=self.name, reason=reason).inc()
@@ -249,11 +255,19 @@ class MicroBatcher:
                         f"run_batch returned {len(results)} results for "
                         f"{len(batch)} requests")
             except BaseException as e:
+                exec_ms = (time.monotonic() - now) * 1000.0
                 for p in batch:
+                    p.future.exec_ms = exec_ms
                     if not p.future.done():
                         p.future.set_exception(e)
             else:
+                # the flush's run time, attributed to every coalesced
+                # request in it (micro-batching makes execute a shared
+                # phase — that sharing is exactly what the breakdown
+                # should show)
+                exec_ms = (time.monotonic() - now) * 1000.0
                 for p, r in zip(batch, results):
+                    p.future.exec_ms = exec_ms
                     if not p.future.done():
                         p.future.set_result(r)
             finally:

@@ -216,6 +216,15 @@ class InferenceServer:
         self._hist_request_ms = reg.histogram(
             "serving_request_ms", "end-to-end in-server request latency",
             ("service", "shard", "replica", "verb"))
+        # per-request phase breakdown — the serving-tier analogue of the
+        # graph server's native queue/decode/execute/serialize
+        # histograms: queue = admission→flush pickup in the micro-
+        # batcher, execute = the flush run serving this request
+        self._hist_phase_ms = reg.histogram(
+            "serving_phase_ms",
+            "per-request serving phase time (queue = batcher wait, "
+            "execute = micro-batch flush run)",
+            ("service", "shard", "replica", "verb", "phase"))
         self._ctr_deadline = reg.counter(
             "serving_deadline_shed_total",
             "admitted requests whose deadline expired in queue (SHED "
@@ -552,6 +561,16 @@ class InferenceServer:
             raise ValueError(f"unknown serving msg_type {msg_type}")
         self._ctr_requests.labels(verb=verb, **self._lab).inc()
         t0 = time.monotonic()
+        # One tracer span per request (the PR-13 deferred serving-tier
+        # item): this process's exported trace file now carries the
+        # serving requests, so tools/trace_dump.py --merge lays the
+        # serving tier onto the same wall-clock timeline as the train
+        # loop and the graph shards. Queue/execute phase attrs are
+        # attached in _wait once the batcher stamps them.
+        sp = _obs.span("serving_request", verb=verb,
+                       shard=self._lab["shard"],
+                       replica=self._lab["replica"])
+        sp.__enter__()
         try:
             if msg_type == wire.MSG_HEALTH:
                 return struct.pack("<I", wire.STATUS_OK) + \
@@ -581,7 +600,7 @@ class InferenceServer:
                 n = r.u32()
                 ids = r.array(np.uint64, n)
                 fut = self._batchers["embed"].submit(ids, rows=n)
-                emb = self._wait(fut, timeout)
+                emb = self._wait(fut, timeout, verb=verb, span=sp)
                 return (struct.pack("<III", wire.STATUS_OK, n,
                                     emb.shape[1] if emb.ndim == 2 else 0)
                         + np.ascontiguousarray(emb, np.float32).tobytes())
@@ -595,7 +614,7 @@ class InferenceServer:
                     dim = r.u32()
                     q = r.array(np.float32, n * dim).reshape(n, dim)
                 fut = self._batchers["knn"].submit((q, k, exact), rows=n)
-                res = self._wait(fut, timeout)
+                res = self._wait(fut, timeout, verb=verb, span=sp)
                 if isinstance(res, Exception):
                     raise res  # per-request validation failure
                 nbr, sims = res
@@ -608,23 +627,45 @@ class InferenceServer:
             src = r.array(np.uint64, n)
             dst = r.array(np.uint64, n)
             fut = self._batchers["score"].submit((src, dst), rows=n)
-            scores = self._wait(fut, timeout)
+            scores = self._wait(fut, timeout, verb=verb, span=sp)
             return (struct.pack("<II", wire.STATUS_OK, n)
                     + np.ascontiguousarray(scores, np.float32).tobytes())
         finally:
             self._hist_request_ms.labels(verb=verb, **self._lab).observe(
                 (time.monotonic() - t0) * 1000.0)
+            sp.__exit__(None, None, None)
 
-    def _wait(self, fut, timeout: float):
+    def _wait(self, fut, timeout: float, verb: str = "", span=None):
         from concurrent.futures import TimeoutError as FutTimeout
 
         try:
-            return fut.result(timeout=max(timeout, 0.001))
+            result = fut.result(timeout=max(timeout, 0.001))
         except FutTimeout:
             # the flush may still land later; its result is discarded.
             # The client gets an EXPLICIT shed, never a hang.
             self._ctr_deadline.inc()
+            if span is not None:
+                span.set(shed=True)
             raise ShedError("deadline expired while queued") from None
+        # phase breakdown: the batcher stamped queue wait (admission →
+        # flush pickup) and the flush run time onto the future before
+        # resolving it — record both into the registry and onto the
+        # request span so trace_dump --merge shows where serving time
+        # went without any Python in the batcher's measurement path
+        if verb:
+            q_ms = getattr(fut, "queue_wait_ms", None)
+            e_ms = getattr(fut, "exec_ms", None)
+            if q_ms is not None:
+                self._hist_phase_ms.labels(
+                    verb=verb, phase="queue", **self._lab).observe(q_ms)
+            if e_ms is not None:
+                self._hist_phase_ms.labels(
+                    verb=verb, phase="execute", **self._lab).observe(e_ms)
+            if span is not None and q_ms is not None:
+                span.set(queue_ms=round(q_ms, 3),
+                         exec_ms=round(e_ms, 3) if e_ms is not None
+                         else None)
+        return result
 
     # -- discovery heartbeat ----------------------------------------------
     def _heartbeat_loop(self, interval_s: float) -> None:

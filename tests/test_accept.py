@@ -43,6 +43,7 @@ def _args(tmp_path, **over):
         nodes=280, dim=12, train_steps=2, load_s=6.0, rps=30.0,
         threads=3, mix_knn=0.6, q=6, k=8, inject_ms=2.0,
         slo_p99_ms=500.0, slo_p999_ms=2000.0, slo_shed_rate=0.05,
+        graph_decode_p99_ms=50.0,
         degraded_budget=0, recovery_bound_s=45.0, chaos=True,
         full=False, out=str(tmp_path / "accept_out"), record=False)
     for k, v in over.items():
@@ -66,6 +67,11 @@ def test_accept_smoke_passes_and_artifact_is_valid(tmp_path):
     assert on_disk["pass"] is True
     assert on_disk["gates"]["lost_without_status"]["value"] == 0
     assert on_disk["gates"]["stale_reads"]["value"] == 0
+    # the schema-v2 wire-path gate: the graph tier's decode-phase p99
+    # was measured (the load loop drove v2 kExecutes through the
+    # native histogram) and sits under its bound
+    dec = on_disk["gates"]["graph_decode_p99_ms"]
+    assert dec["ok"] and not dec.get("skipped") and dec["value"] >= 0
 
     # cross-process observability: ≥1 trace id appears on BOTH sides
     # of the wire, a hedged pair of server spans shares one client
@@ -124,6 +130,21 @@ def test_accept_schema_validator_rejects_malformed(tmp_path):
                             for g in accept._GATE_KEYS
                             if g != "stale_reads"})
     assert any("stale_reads" in p for p in accept.validate_accept(bad))
+
+    # the schema-v2 decode-phase gate is REQUIRED: a pre-v2 artifact
+    # (or a harness that silently dropped the wire-path ruler) fails
+    # validation instead of passing with one gate fewer
+    bad = dict(good, gates={g: {"value": 0, "gate": 0, "ok": True}
+                            for g in accept._GATE_KEYS
+                            if g != "graph_decode_p99_ms"})
+    assert any("graph_decode_p99_ms" in p
+               for p in accept.validate_accept(bad))
+    # and a non-skipped decode gate must carry a value
+    gates = {g: {"value": 0, "gate": 0, "ok": True}
+             for g in accept._GATE_KEYS}
+    gates["graph_decode_p99_ms"] = {"gate": 50.0, "ok": True}
+    bad = dict(good, gates=gates)
+    assert any("needs 'value'" in p for p in accept.validate_accept(bad))
 
     # pass must agree with the gates
     gates = {g: {"value": 0, "gate": 0, "ok": True}

@@ -1,0 +1,398 @@
+"""Prepared query plans + zero-copy reply path (ISSUE 15 tentpole).
+
+Python-level coverage of the wire hot path against REAL shard servers
+(the native frame/plan-cache/segments mechanics are pinned in
+engine_test.cc — TestSerdeSizingSplitSegments /
+TestPreparedPlanExecution):
+
+  * wire identity — prepared OFF is byte-identical to today (per-call
+    wire bytes deterministic, every prepared counter frozen at zero);
+    a prepared client against a v1-only server falls back to the
+    classic full-plan frame (counted prepared_fallbacks) with
+    byte-identical results;
+  * hit/miss accounting — one registration per plan per connection,
+    steady-state calls hit, request bytes per call drop;
+  * LRU eviction — a plan-cache bound of 1 forces explicit misses when
+    two plans alternate; the client re-prepares and every answer stays
+    byte-identical (convergence, never a wrong plan);
+  * ownership-flip invalidation — installing a new ownership map
+    mid-stream strands every cached plan (counted
+    prepared_invalidated); the very next prepared execute re-prepares
+    and answers correctly — a stale plan never executes silently;
+  * hedged legs — with mux hedging on, both legs of a raced kExecute
+    carry the SAME prepared plan id (no fallbacks, no misses once both
+    connections registered, results intact);
+  * serving-tier spans — InferenceServer records per-request
+    queue-wait/execute into serving_phase_ms and emits one tracer span
+    per request (the PR-13 deferred item), so trace_dump --merge can
+    stitch the serving tier onto the shared timeline.
+
+The transport config is process-global (configure_rpc) — the autouse
+fixture restores defaults so no other test file runs on a leaked
+prepared/mux config.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from euler_tpu import obs
+from euler_tpu.graph import (
+    GraphBuilder,
+    configure_rpc,
+    rpc_transport_stats,
+    seed,
+)
+
+pytestmark = pytest.mark.wire_path
+
+PREPARED_KEYS = ("prepared_registered", "prepared_hits",
+                 "prepared_misses", "prepared_invalidated",
+                 "prepared_fallbacks")
+
+
+@pytest.fixture(autouse=True)
+def _restore_rpc_config():
+    yield
+    configure_rpc(mux=False, connections=1, compress_threshold=0,
+                  max_inflight=256, hedge_delay_ms=0.0, p2c=False,
+                  prepared=False, plan_cache=64, deflate_reuse=True)
+
+
+def _graph(tmp_path, n=64):
+    seed(7)
+    rng = np.random.default_rng(5)
+    b = GraphBuilder()
+    b.set_num_types(2, 2)
+    b.set_feature(0, 0, 1, "price")
+    ids = np.arange(1, n + 1, dtype=np.uint64)
+    b.add_nodes(ids, types=(ids % 2).astype(np.int32),
+                weights=np.ones(n, np.float32))
+    src = np.concatenate([ids, ids])
+    dst = np.concatenate([np.roll(ids, -1), np.roll(ids, -7)])
+    b.add_edges(src, dst,
+                types=(np.arange(2 * n) % 2).astype(np.int32),
+                weights=(rng.random(2 * n) + 0.25).astype(np.float32))
+    b.set_node_dense(ids, 0,
+                     (rng.random((n, 1)) * 10).astype(np.float32))
+    d = str(tmp_path / "g")
+    b.finalize().dump(d, num_partitions=2)
+    return d, ids
+
+
+def _cluster(data_dir, shards=2):
+    from euler_tpu.gql import start_service
+
+    servers = [start_service(data_dir, shard_idx=i, shard_num=shards,
+                             port=0) for i in range(shards)]
+    eps = "hosts:" + ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    return servers, eps
+
+
+def _prepared_delta(s0, s1):
+    return {k: s1[k] - s0[k] for k in PREPARED_KEYS}
+
+
+QDET = "v(roots).getNB(*).as(nb)"  # deterministic: the parity probe
+
+
+def _run_det(q, roots):
+    out = q.run(QDET, {"roots": roots})
+    return {k: v.tobytes() for k, v in out.items()}
+
+
+# ---------------------------------------------------------------------------
+# wire identity (prepared off + pre-feature peer)
+# ---------------------------------------------------------------------------
+
+def test_prepared_off_byte_identical_and_counters_frozen(tmp_path):
+    """Prepared OFF (the default): per-call wire bytes are
+    deterministic call over call (nothing new rides the frames) and
+    every prepared counter stays exactly zero — the pinned
+    byte-identity of today's wire."""
+    from euler_tpu.gql import Query
+
+    d, ids = _graph(tmp_path)
+    servers, eps = _cluster(d)
+    try:
+        configure_rpc(mux=True, connections=1)
+        q = Query.remote(eps, seed=1)
+        roots = ids[:16]
+        ref = _run_det(q, roots)
+
+        def call_bytes():
+            s0 = rpc_transport_stats()
+            out = _run_det(q, roots)
+            s1 = rpc_transport_stats()
+            assert out == ref
+            return (s1["bytes_sent"] - s0["bytes_sent"],
+                    _prepared_delta(s0, s1))
+
+        b1, d1 = call_bytes()
+        b2, d2 = call_bytes()
+        assert b1 == b2  # deterministic wire size, nothing stamped
+        assert d1 == d2 == {k: 0 for k in PREPARED_KEYS}
+        q.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_prepared_client_v1_server_falls_back_byte_identical(tmp_path):
+    """A prepared-mode client against a pre-v2 binary: the hello is
+    refused, the call reassembles the classic full-plan frame (counted
+    prepared_fallbacks), and the results are byte-identical to a plain
+    v1 client."""
+    from euler_tpu.gql import Query
+
+    d, ids = _graph(tmp_path)
+    os.environ["EULER_TPU_RPC_SERVER_V1"] = "1"
+    try:
+        servers, eps = _cluster(d)
+    finally:
+        del os.environ["EULER_TPU_RPC_SERVER_V1"]
+    try:
+        roots = ids[:16]
+        configure_rpc(mux=False, connections=1, prepared=False)
+        qv1 = Query.remote(eps, seed=1)
+        ref = _run_det(qv1, roots)
+        qv1.close()
+
+        configure_rpc(mux=True, connections=2, prepared=True)
+        s0 = rpc_transport_stats()
+        q = Query.remote(eps, seed=1)
+        out = _run_det(q, roots)
+        s1 = rpc_transport_stats()
+        assert out == ref
+        delta = _prepared_delta(s0, s1)
+        assert delta["prepared_fallbacks"] >= 1
+        # nothing ever registered or missed — the v1 peer never saw a
+        # prepared frame, only classic ones
+        assert delta["prepared_registered"] == 0
+        assert delta["prepared_hits"] == 0
+        assert delta["prepared_misses"] == 0
+        q.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# hit/miss accounting + LRU convergence
+# ---------------------------------------------------------------------------
+
+def test_prepared_hit_accounting_and_bytes_drop(tmp_path):
+    """Steady state: one kPrepare per plan per connection, then every
+    call hits and ships feeds only — the per-call request bytes drop by
+    the (plan - 8B id) margin, with byte-identical results."""
+    from euler_tpu.gql import Query
+
+    d, ids = _graph(tmp_path)
+    servers, eps = _cluster(d)
+    try:
+        roots = ids[:16]
+        configure_rpc(mux=True, connections=1, prepared=False)
+        q0 = Query.remote(eps, seed=1)
+        ref = _run_det(q0, roots)
+        s0 = rpc_transport_stats()
+        _run_det(q0, roots)
+        s1 = rpc_transport_stats()
+        full_bytes = s1["bytes_sent"] - s0["bytes_sent"]
+        q0.close()
+
+        configure_rpc(prepared=True)
+        q = Query.remote(eps, seed=1)
+        s2 = rpc_transport_stats()
+        assert _run_det(q, roots) == ref  # registers (cold)
+        s3 = rpc_transport_stats()
+        assert _run_det(q, roots) == ref  # hits (steady state)
+        s4 = rpc_transport_stats()
+        cold = _prepared_delta(s2, s3)
+        warm = _prepared_delta(s3, s4)
+        # cold call: one registration per connection it rode (2 shards)
+        assert cold["prepared_registered"] >= 1
+        assert warm["prepared_registered"] == 0
+        assert warm["prepared_hits"] >= 2  # one per shard
+        assert warm["prepared_misses"] == 0
+        assert warm["prepared_fallbacks"] == 0
+        warm_bytes = s4["bytes_sent"] - s3["bytes_sent"]
+        assert warm_bytes < full_bytes
+        q.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_lru_eviction_reprepare_convergence(tmp_path):
+    """plan_cache=1: two alternating plans evict each other on the
+    server. Every round after the first answers at least one explicit
+    miss, the client re-prepares, and every result stays byte-identical
+    — convergence, never a wrong or dropped plan."""
+    from euler_tpu.gql import Query
+
+    d, ids = _graph(tmp_path)
+    servers, eps = _cluster(d)
+    try:
+        roots = ids[:16]
+        QB = "v(roots).getNB(0).as(nb0)"  # a second, distinct plan
+        configure_rpc(mux=True, connections=1, prepared=False)
+        q0 = Query.remote(eps, seed=1)
+        ref_a = _run_det(q0, roots)
+        ref_b = {k: v.tobytes()
+                 for k, v in q0.run(QB, {"roots": roots}).items()}
+        q0.close()
+
+        configure_rpc(prepared=True, plan_cache=1)
+        q = Query.remote(eps, seed=1)
+        s0 = rpc_transport_stats()
+        for _ in range(4):
+            assert _run_det(q, roots) == ref_a
+            out_b = {k: v.tobytes()
+                     for k, v in q.run(QB, {"roots": roots}).items()}
+            assert out_b == ref_b
+        s1 = rpc_transport_stats()
+        delta = _prepared_delta(s0, s1)
+        # evictions forced explicit misses AND re-registrations; the
+        # full-frame fallback never had to fire (re-prepare converged)
+        assert delta["prepared_misses"] >= 3
+        assert delta["prepared_registered"] >= 3
+        assert delta["prepared_fallbacks"] == 0
+        q.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# ownership-flip invalidation
+# ---------------------------------------------------------------------------
+
+def test_ownership_flip_invalidates_cached_plans(tmp_path):
+    """Installing a new ownership map mid-stream strands every cached
+    plan on the flipped shard: the next prepared execute answers the
+    counted invalidation miss, the client re-prepares, and the answer
+    is byte-identical — a plan registered under the old routing can
+    never execute silently after the flip."""
+    from euler_tpu.gql import Query
+
+    d, ids = _graph(tmp_path)
+    servers, eps = _cluster(d)
+    try:
+        roots = ids[:16]
+        configure_rpc(mux=True, connections=1, prepared=True)
+        q = Query.remote(eps, seed=1)
+        ref = _run_det(q, roots)       # registers
+        assert _run_det(q, roots) == ref  # steady-state hit
+
+        # the flip: same partition→shard layout as the hash convention
+        # (routing unchanged — this isolates plan invalidation), new
+        # map epoch on both shards
+        for s in servers:
+            s.set_ownership("e1-P2-0.1")
+
+        s0 = rpc_transport_stats()
+        assert _run_det(q, roots) == ref  # invalidated → re-prepared
+        s1 = rpc_transport_stats()
+        delta = _prepared_delta(s0, s1)
+        assert delta["prepared_invalidated"] >= 1
+        assert delta["prepared_misses"] >= 1
+        assert delta["prepared_registered"] >= 1
+        # and steady state resumes
+        s2 = rpc_transport_stats()
+        assert _run_det(q, roots) == ref
+        s3 = rpc_transport_stats()
+        after = _prepared_delta(s2, s3)
+        assert after["prepared_misses"] == 0
+        assert after["prepared_hits"] >= 2
+        q.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# hedged legs share the prepared plan id
+# ---------------------------------------------------------------------------
+
+def test_hedged_legs_share_prepared_plan(tmp_path):
+    """Mux hedging + prepared plans: an aggressive hedge delay makes
+    (nearly) every call race two connections. Both legs carry the SAME
+    plan id — once both connections registered, the counters show
+    hits with zero misses and zero fallbacks, and results stay
+    byte-identical."""
+    from euler_tpu.gql import Query
+
+    d, ids = _graph(tmp_path)
+    servers, eps = _cluster(d)
+    try:
+        roots = ids[:16]
+        configure_rpc(mux=True, connections=2, prepared=True)
+        q = Query.remote(eps, seed=1)
+        ref = _run_det(q, roots)  # warm: dial + register (no hedging)
+        configure_rpc(hedge_delay_ms=0.01)  # now race everything
+        s0 = rpc_transport_stats()
+        for _ in range(10):
+            assert _run_det(q, roots) == ref
+        s1 = rpc_transport_stats()
+        configure_rpc(hedge_delay_ms=0.0)
+        assert s1["hedge_fired"] - s0["hedge_fired"] >= 1
+        delta = _prepared_delta(s0, s1)
+        # hedge legs rode the prepared id: no classic-frame fallbacks,
+        # and any first-touch of the second connection registered
+        # rather than missed (the leg prepares before it fires)
+        assert delta["prepared_fallbacks"] == 0
+        assert delta["prepared_misses"] == 0
+        assert delta["prepared_hits"] >= 20  # 2 shards x 10 calls
+        q.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving-tier per-request spans (the PR-13 deferred item)
+# ---------------------------------------------------------------------------
+
+def test_serving_phase_histograms_and_request_spans(tmp_path):
+    """InferenceServer records queue-wait/execute per request into
+    serving_phase_ms{verb,phase} and one serving_request tracer span
+    per request with the phase attrs — the serving tier's trace file
+    now merges onto the shared timeline."""
+    from euler_tpu.serving import (
+        InferenceServer,
+        ModelBundle,
+        ServingClient,
+    )
+
+    rng = np.random.default_rng(0)
+    emb = rng.normal(size=(60, 8)).astype(np.float32)
+    ids = (np.arange(60, dtype=np.uint64) * 3 + 1)
+    bundle_dir = str(tmp_path / "b")
+    ModelBundle({}, emb, ids).save(bundle_dir)
+    spec = str(tmp_path / "reg")
+    tracer = obs.default_tracer()
+    tracer.clear()
+    with InferenceServer(bundle_dir, registry=spec, service="wp",
+                         replica=0, max_batch=16) as srv, \
+            ServingClient(registry=spec, service="wp") as cli:
+        del srv
+        got = cli.embed(ids[:5])
+        assert got.shape == (5, 8)
+        cli.knn(ids[:3], k=4)
+
+    snap = obs.snapshot()
+    phase = snap.get("serving_phase_ms", {}).get("values", {})
+    q_keys = [k for k in phase if "phase=queue" in k and "verb=embed" in k]
+    e_keys = [k for k in phase
+              if "phase=execute" in k and "verb=embed" in k]
+    assert q_keys and e_keys, sorted(phase)[:8]
+    assert phase[q_keys[0]]["count"] >= 1
+    assert phase[e_keys[0]]["count"] >= 1
+
+    spans = [s for s in tracer.spans() if s.name == "serving_request"]
+    assert len(spans) >= 2  # embed + knn at least
+    verbs = {s.attrs.get("verb") for s in spans}
+    assert "embed" in verbs and "knn" in verbs
+    assert any("queue_ms" in s.attrs for s in spans)

@@ -70,7 +70,10 @@ from tools.bench_serve import lat_summary, slo_verdict  # noqa: E402
 from tools import trace_dump  # noqa: E402
 
 PERF_JSON = Path(__file__).resolve().parents[1] / "perf.json"
-SCHEMA_VERSION = 1
+# v2: + the graph decode-phase p99 gate (graph_decode_p99_ms) read off
+# the native server phase histograms — wire-path regressions (a plan
+# re-decoded per request, a decoder slowdown) now fail acceptance.
+SCHEMA_VERSION = 2
 
 # ---------------------------------------------------------------------------
 # accept.json schema (validated by the tier-1 smoke so the artifact
@@ -83,7 +86,7 @@ _TOP_KEYS = {
 }
 _GATE_KEYS = ("p99_ms", "p999_ms", "shed_rate", "lost_without_status",
               "stale_reads", "degraded_steps", "recovery_s",
-              "trace_stitched")
+              "trace_stitched", "graph_decode_p99_ms")
 
 
 def validate_accept(obj) -> list:
@@ -804,6 +807,26 @@ def _run_accept_body(args, out_dir, td, phases, chaos, t0,
                          "retries_counted", 0) >= 1))
     gates["trace_stitched"] = {
         "value": stitch["stitched"], "gate": 1, "ok": trace_ok}
+    # graph decode-phase p99 off the ALWAYS-ON native phase histogram
+    # (schema v2): the wire-path ruler — a regression that re-inflates
+    # per-request decode (plan re-shipped per call, a decoder slowdown)
+    # fails acceptance here, with no Python in the measurement path.
+    # The in-process graph shards of this harness land their kExecute
+    # decode in the process-global histogram the load loop just drove.
+    from euler_tpu import gql as _gql
+
+    decode_p99 = _gql.server_phase_quantile("execute", "decode", 0.99)
+    if decode_p99 is not None:
+        gates["graph_decode_p99_ms"] = {
+            "value": round(decode_p99, 4),
+            "gate": args.graph_decode_p99_ms,
+            "ok": decode_p99 <= args.graph_decode_p99_ms}
+    else:
+        # no v2 kExecute decode samples (e.g. a v1-forced interop run):
+        # explicit skip, never a vacuous pass hidden as a number
+        gates["graph_decode_p99_ms"] = {
+            "value": None, "gate": args.graph_decode_p99_ms,
+            "ok": True, "skipped": True}
 
     result = {
         "schema_version": SCHEMA_VERSION,
@@ -884,6 +907,10 @@ def main(argv=None) -> int:
                          "injected-work load model; 2-CPU convention)")
     ap.add_argument("--slo_p99_ms", type=float, default=500.0)
     ap.add_argument("--slo_p999_ms", type=float, default=2000.0)
+    ap.add_argument("--graph_decode_p99_ms", type=float, default=50.0,
+                    help="gate on the graph-tier kExecute decode-phase "
+                         "p99 (native histogram, ms) — the wire-path "
+                         "regression tripwire")
     ap.add_argument("--slo_shed_rate", type=float, default=0.05)
     ap.add_argument("--degraded_budget", type=int, default=0)
     ap.add_argument("--recovery_bound_s", type=float, default=45.0)

@@ -731,6 +731,205 @@ def bench_rpc(args):
     })
 
 
+def bench_wire(args):
+    """--mode wire: counted A/B of the prepared-plan wire path (ISSUE
+    15) against a live 2-shard cluster. The steady-state step is one
+    unsupervised-GraphSAGE training draw — the read-hot-path shape the
+    GNN-sampling-bottleneck papers name (features device-resident per
+    the partitioned-table tier; the host serves SAMPLING):
+
+      sampleE(0:1, 32)                      positive pairs (no feeds)
+      sampleN(-1, 64).has(price gt 1)       filtered negatives (no feeds)
+      v(roots).sampleNB(0:1,5,0)x2          2-hop fanout on the batch
+
+    The three gremlins are step-invariant; only the feed tensors (root
+    ids) change — so with prepared plans ON the plan half of every wire
+    request collapses to an 8-byte content-hash id after the one-time
+    per-connection kPrepare. Two legs at depth --pool behind per-shard
+    jitter proxies (injected RTT — the 2-CPU wall-clock context):
+
+      off : protocol-v2 mux, prepared OFF — every kExecute re-ships and
+            the server re-decodes the full inner sub-DAG (today's wire,
+            byte-identical, pinned by tests).
+      on  : prepared ON (kPrepare + plan-id frames, feeds only).
+
+    Judged the COUNTED way: request bytes per step / per round trip
+    from rpc_transport_stats() deltas, and the SERVER decode-phase
+    p50/p99 shift read off the always-on native phase histograms
+    (per-leg baseline-delta quantiles — no Python in the measurement
+    path). Byte parity of deterministic reads is asserted across legs;
+    every request must end with a result or a raised status.
+
+    Gates (ISSUE 15): request bytes/step drop >= 2x with prepare on,
+    decode-phase p50 drop >= 1.5x, parity ok, zero lost."""
+    import tempfile
+    import threading as _threading
+
+    from chaos_proxy import ChaosProxy
+    from euler_tpu import gql as _gql
+    from euler_tpu.gql import Query, start_service
+    from euler_tpu.graph import (GraphBuilder, configure_rpc,
+                                 rpc_transport_stats, seed)
+
+    seed(1)
+    rng = np.random.default_rng(0)
+    n = args.nodes
+    b = GraphBuilder()
+    b.set_num_types(2, 2)
+    b.set_feature(0, 0, 1, "price")
+    ids = np.arange(1, n + 1, dtype=np.uint64)
+    b.add_nodes(ids, types=(ids % 2).astype(np.int32))
+    b.set_node_dense(ids, 0, (rng.random((n, 1)) * 10).astype(np.float32))
+    m = n * args.degree
+    src = rng.integers(1, n + 1, m).astype(np.uint64)
+    dst = (rng.random(m) ** 2 * n).astype(np.uint64) + 1
+    b.add_edges(src, dst, weights=rng.random(m).astype(np.float32),
+                types=rng.integers(0, 2, m).astype(np.int32))
+    g = b.finalize()
+    d = tempfile.mkdtemp(prefix="et_wire_")
+    g.dump(d, num_partitions=2)
+    servers = [start_service(d, shard_idx=i, shard_num=2, port=0,
+                             index_spec="price:range_index")
+               for i in range(2)]
+    # injected RTT: each shard behind a jitter proxy, U(0, 2*delay) per
+    # connection (mean ~= --rpc_delay_ms) — the latency-bound regime a
+    # real remote cluster runs in
+    proxies = []
+    eps_hosts = []
+    for s in servers:
+        if args.rpc_delay_ms > 0:
+            px = ChaosProxy("127.0.0.1", s.port, mode="jitter",
+                            jitter_ms=2.0 * args.rpc_delay_ms,
+                            seed=7).start()
+            proxies.append(px)
+            eps_hosts.append(f"127.0.0.1:{px.port}")
+        else:
+            eps_hosts.append(f"127.0.0.1:{s.port}")
+    eps = "hosts:" + ",".join(eps_hosts)
+    depth = max(int(args.pool), 2)
+
+    QPOS = "sampleE(0:1, 32).as(pos)"
+    QNEG = "sampleN(-1, 64).has(price gt 1).as(neg)"
+    QFAN = ("v(roots).sampleNB(0:1, 5, 0).as(h1)"
+            ".sampleNB(0:1, 5, 0).as(h2)")
+    QPROBE = "v(roots).getNB(*).as(nb)"
+    probe = ids[:64]
+
+    def run_leg():
+        """depth workers x own Query handle, each looping the 3-query
+        training step for --seconds; counted wire/decode deltas."""
+        qs = [Query.remote(eps, seed=1 + w) for w in range(depth)]
+        steps = [0] * depth
+        errors = [0] * depth
+
+        def step(q):
+            # per-step randomness comes from the server-side sampling
+            # verbs (each handle's seeded native stream)
+            pos = q.run(QPOS)["pos:0"]
+            neg = q.run(QNEG)["neg:0"]
+            roots = np.unique(np.concatenate(
+                [pos.reshape(-1)[:32], neg[:32]])).astype(np.uint64)[:16]
+            q.run(QFAN, {"roots": roots})
+
+        for q in qs:  # warm: dial + (on-leg) one-time plan registration
+            step(q)
+        # baseline AFTER warm-up: the deltas count steady state only
+        # (the dial hellos and the one-time kPrepare stay outside)
+        s0 = rpc_transport_stats()
+        dec0 = _gql.server_trace_hist("execute", "decode")
+        stop_at = time.time() + args.seconds
+
+        def worker(w):
+            try:
+                while time.time() < stop_at:
+                    step(qs[w])
+                    steps[w] += 1
+            except Exception:
+                errors[w] += 1  # an explicit raised status, reported
+
+        ts = [_threading.Thread(target=worker, args=(w,))
+              for w in range(depth)]
+        t0 = time.time()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wall = time.time() - t0
+        pr = qs[0].run(QPROBE, {"roots": probe})
+        s1 = rpc_transport_stats()
+        for q in qs:
+            q.close()
+        nsteps = sum(steps)
+        rts = max(s1["round_trips"] - s0["round_trips"], 1)
+        sent = s1["bytes_sent"] - s0["bytes_sent"]
+        out = {
+            "steps": nsteps,
+            "steps_per_sec": round(nsteps / wall, 2),
+            "round_trips": rts,
+            "bytes_sent": sent,
+            "req_bytes_per_step": round(sent / max(nsteps, 1), 1),
+            "req_bytes_per_round_trip": round(sent / rts, 1),
+            "bytes_received": s1["bytes_received"] - s0["bytes_received"],
+            "decode_p50_ms": _gql.server_phase_quantile(
+                "execute", "decode", 0.5, baseline=dec0),
+            "decode_p99_ms": _gql.server_phase_quantile(
+                "execute", "decode", 0.99, baseline=dec0),
+            "errors_raised": sum(errors),
+        }
+        for k in ("prepared_registered", "prepared_hits",
+                  "prepared_misses", "prepared_invalidated",
+                  "prepared_fallbacks"):
+            out[k] = s1[k] - s0[k]
+        return out, {k: v.tobytes() for k, v in pr.items()}
+
+    # leg 1: mux transport, prepared OFF (today's wire)
+    configure_rpc(mux=True, connections=max(int(args.mux_conns), 2),
+                  compress_threshold=0, prepared=False)
+    legs = {}
+    legs["off"], ref_pr = run_leg()
+    # leg 2: prepared ON — same step, same depth, same injected RTT
+    configure_rpc(prepared=True)
+    legs["on"], on_pr = run_leg()
+    configure_rpc(mux=False, connections=1, prepared=False)
+    for px in proxies:
+        px.stop()
+    for s in servers:
+        s.stop()
+
+    parity = (set(ref_pr) == set(on_pr)
+              and all(ref_pr[k] == on_pr[k] for k in ref_pr))
+    bytes_ratio = (legs["off"]["req_bytes_per_step"]
+                   / max(legs["on"]["req_bytes_per_step"], 1e-9))
+    p50_off = legs["off"]["decode_p50_ms"] or 0.0
+    p50_on = legs["on"]["decode_p50_ms"] or 1e9
+    decode_ratio = p50_off / max(p50_on, 1e-9)
+    lost = legs["off"]["errors_raised"] + legs["on"]["errors_raised"]
+    record({
+        "bench": "wire_path",
+        "nodes": n, "degree": args.degree,
+        "step": {"pos": QPOS, "neg": QNEG, "fanout": QFAN,
+                 "roots_per_step": 16},
+        "inflight_depth": depth,
+        "mux_conns": max(int(args.mux_conns), 2),
+        "rpc_delay_ms": args.rpc_delay_ms,
+        "legs": legs,
+        "req_bytes_reduction": round(bytes_ratio, 2),
+        "gate_req_bytes_2x": bool(bytes_ratio >= 2.0),
+        "decode_p50_reduction": round(decode_ratio, 2),
+        "gate_decode_p50_1p5x": bool(decode_ratio >= 1.5),
+        "parity_ok": bool(parity),
+        "errors_raised": lost,
+        "lost_without_status": 0,
+        "throughput_ratio_on_vs_off": round(
+            legs["on"]["steps_per_sec"]
+            / max(legs["off"]["steps_per_sec"], 1e-9), 3),
+        "note": "counted A/B (2-CPU container): request bytes and the "
+                "native decode-phase quantiles are the primary "
+                "metrics; wall-clock throughput is context under the "
+                "jitter-proxy injected RTT only — PERF.md",
+    })
+
+
 def rpc_smoke():
     """bench.py --rpc_mux hook: a quick counted mux-vs-pool A/B under
     10ms injected RTT, returned as detail.rpc (never the headline
@@ -1671,7 +1870,7 @@ def main(argv=None):
     ap.add_argument("--mode", choices=["fanout", "scale", "walk",
                                        "layerwise", "feeder", "table",
                                        "rpc", "mutate", "tail",
-                                       "elastic"],
+                                       "elastic", "wire"],
                     default="fanout")
     ap.add_argument("--layer_sizes", default="512,512")
     ap.add_argument("--nodes", type=int, default=100_000)
@@ -1755,6 +1954,8 @@ def main(argv=None):
         bench_feeder(args)
     elif args.mode == "rpc":
         bench_rpc(args)
+    elif args.mode == "wire":
+        bench_wire(args)
     elif args.mode == "tail":
         sys.exit(bench_tail(args))
     elif args.mode == "elastic":
