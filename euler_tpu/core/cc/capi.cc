@@ -707,7 +707,8 @@ int etg_get_edge_binary_feature(int64_t h, const uint64_t* src,
 void etg_rpc_config(int mux, int mux_connections, int64_t compress_threshold,
                     int max_inflight, int64_t hedge_delay_us, int p2c,
                     int hedge_replicas, int prepared, int plan_cache,
-                    int deflate_reuse) {
+                    int deflate_reuse, int plan_optimize,
+                    int64_t coalesce_window_us, int reuse_window) {
   auto& c = et::GlobalRpcConfig();
   if (mux >= 0) c.mux = mux != 0;
   if (mux_connections > 0) c.mux_connections = mux_connections;
@@ -720,6 +721,10 @@ void etg_rpc_config(int mux, int mux_connections, int64_t compress_threshold,
   if (prepared >= 0) c.prepared = prepared != 0;
   if (plan_cache > 0) c.plan_cache = plan_cache;
   if (deflate_reuse >= 0) c.deflate_reuse = deflate_reuse != 0;
+  // plan optimizer + deterministic fast paths (server side)
+  if (plan_optimize >= 0) c.plan_optimize = plan_optimize != 0;
+  if (coalesce_window_us >= 0) c.coalesce_window_us = coalesce_window_us;
+  if (reuse_window >= 0) c.reuse_window = reuse_window;
 }
 
 // Per-thread deadline handoff for the NEXT query run on this thread
@@ -741,9 +746,13 @@ void etg_set_call_deadline_ms(double remaining_ms) {
 // replica_hedge_fired, replica_hedge_won, replica_hedge_wasted,
 // trace_propagated, prepared_registered, prepared_hits,
 // prepared_misses, prepared_invalidated (all four server edge),
-// prepared_fallbacks (client edge).
-// Client-edge accounting except the *_shed pair and the prepared plan
-// cache counters (see RpcCounters).
+// prepared_fallbacks (client edge), plan_optimized, plan_rewrites_fuse,
+// plan_rewrites_pushdown, plan_rewrites_dedup, plan_rewrites_epoch,
+// coalesced_requests, coalesce_batches, reuse_hits, reuse_misses,
+// reuse_invalidated (the last ten all server edge — plan optimizer +
+// deterministic fast paths). out is 37 slots.
+// Client-edge accounting except the *_shed pair, the prepared plan
+// cache counters, and the optimizer/fast-path block (see RpcCounters).
 void etg_rpc_stats(uint64_t* out) {
   auto& c = et::GlobalRpcCounters();
   out[0] = c.round_trips.load();
@@ -773,6 +782,16 @@ void etg_rpc_stats(uint64_t* out) {
   out[24] = c.prepared_misses.load();
   out[25] = c.prepared_invalidated.load();
   out[26] = c.prepared_fallbacks.load();
+  out[27] = c.plan_optimized.load();
+  out[28] = c.plan_rewrites_fuse.load();
+  out[29] = c.plan_rewrites_pushdown.load();
+  out[30] = c.plan_rewrites_dedup.load();
+  out[31] = c.plan_rewrites_epoch.load();
+  out[32] = c.coalesced_requests.load();
+  out[33] = c.coalesce_batches.load();
+  out[34] = c.reuse_hits.load();
+  out[35] = c.reuse_misses.load();
+  out[36] = c.reuse_invalidated.load();
 }
 
 // Per-thread wire-trace handoff for the NEXT query run on this thread

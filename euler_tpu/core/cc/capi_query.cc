@@ -638,4 +638,80 @@ int64_t etq_compile_debug(const char* gremlin, int shard_num,
   return n;
 }
 
+// Query.explain(): compile a gremlin and report either the form the
+// client registers (stage 0) or what the server's prepare-time
+// optimizer turns it into (stage 1, header line = rewrite counts +
+// determinism verdict). Same probe-then-fill contract as
+// etq_compile_debug.
+int64_t etq_compile_debug2(const char* gremlin, int shard_num,
+                           int partition_num, const char* mode, int stage,
+                           char* buf, int64_t buf_len) {
+  et::CompileOptions opts;
+  opts.shard_num = shard_num;
+  opts.partition_num = partition_num;
+  opts.mode = mode;
+  et::GqlCompiler compiler(opts);
+  std::shared_ptr<const et::TranslateResult> plan;
+  et::Status s = compiler.Compile(gremlin, &plan);
+  if (!s.ok()) {
+    FailWith(s.message());
+    return -1;
+  }
+  std::string text;
+  if (stage <= 0) {
+    text = et::DagToString(plan->dag);
+  } else {
+    et::DAGDef opt;
+    opt.nodes = plan->dag.nodes;
+    // decoded-plan convention (rpc.cc kPrepare): fresh ids start past
+    // every registered name so FUSED group names cannot collide
+    opt.next_id = static_cast<int>(opt.nodes.size()) + 1000;
+    std::vector<std::string> outs = plan->last_outputs;
+    for (const auto& a : plan->aliases) outs.push_back(a);
+    et::PlanOptStats st;
+    s = et::OptimizePreparedPlan(&opt, outs, &st);
+    if (!s.ok()) {
+      FailWith(s.message());
+      return -1;
+    }
+    text = "optimized rewrites[fuse=" + std::to_string(st.fuse) +
+           " pushdown=" + std::to_string(st.pushdown) +
+           " dedup=" + std::to_string(st.dedup) + "] deterministic=" +
+           (et::DagIsDeterministic(opt) ? "1" : "0") + "\n" +
+           et::DagToString(opt);
+  }
+  int64_t n = static_cast<int64_t>(text.size());
+  if (buf != nullptr && buf_len > 0) {
+    int64_t c = std::min(buf_len - 1, n);
+    std::memcpy(buf, text.data(), c);
+    buf[c] = '\0';
+  }
+  return n;
+}
+
+// Server-side explain: dump every plan registered in server h's shared
+// store (generation, determinism, rewrite counts, executing DAG, and
+// the verbatim registered form when the optimizer rewrote it).
+int64_t ets_plan_debug(int64_t h, char* buf, int64_t buf_len) {
+  std::shared_ptr<et::GraphServer> server;
+  {
+    auto& r = QReg();
+    std::lock_guard<std::mutex> lk(r.mu);
+    auto it = r.servers.find(h);
+    if (it != r.servers.end()) server = it->second;
+  }
+  if (!server) {
+    FailWith("bad server handle");
+    return -1;
+  }
+  std::string text = server->DebugPlans();
+  int64_t n = static_cast<int64_t>(text.size());
+  if (buf != nullptr && buf_len > 0) {
+    int64_t c = std::min(buf_len - 1, n);
+    std::memcpy(buf, text.data(), c);
+    buf[c] = '\0';
+  }
+  return n;
+}
+
 }  // extern "C"

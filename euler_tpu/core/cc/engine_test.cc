@@ -11,6 +11,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <csignal>
 #include <cmath>
 #include <cstdio>
 #include <cstdlib>
@@ -833,11 +834,247 @@ void TestPreparedPlanExecution() {
   GlobalRpcConfig() = saved;
 }
 
+// ---- gql: prepare-time plan optimizer passes (golden rewrites) ----
+void TestPlanOptimizerPasses() {
+  // dedup: two identical deterministic gathers; one is a requested
+  // output (protected), the duplicate folds into it
+  {
+    DAGDef dag;
+    NodeDef a;
+    a.name = "API_GET_P_0";
+    a.op = "API_GET_P";
+    a.inputs = {"roots"};
+    a.attrs = {"price"};
+    NodeDef b = a;
+    b.name = "API_GET_P_1";
+    NodeDef c;
+    c.name = "SUM_2";
+    c.op = "POST_PROCESS";
+    c.inputs = {"API_GET_P_0:0", "API_GET_P_1:0"};
+    dag.nodes = {a, b, c};
+    dag.next_id = 100;
+    PlanOptStats st;
+    CHECK_OK(OptimizePreparedPlan(&dag, {"SUM_2:0"}, &st));
+    CHECK_TRUE(st.dedup == 1);
+    // the duplicate's consumers were rewired onto the survivor
+    const NodeDef* kept = dag.Find("SUM_2");
+    if (kept == nullptr) {  // whole plan may have fused
+      CHECK_TRUE(dag.nodes.size() == 1 && dag.nodes[0].op == "FUSED");
+      for (const auto& n : dag.nodes[0].inner)
+        if (n.name == "SUM_2") kept = &n;
+    }
+    CHECK_TRUE(kept != nullptr &&
+               kept->inputs == std::vector<std::string>(
+                                   {"API_GET_P_0:0", "API_GET_P_0:0"}));
+  }
+  // filter pushdown: GET_NODE(dnf2) ∘ GET_NODE(dnf1) → one node with
+  // dnf1 ∧ dnf2 — but ONLY while the child's :1 positions are unread
+  {
+    DAGDef dag;
+    NodeDef f1;
+    f1.name = "API_GET_NODE_0";
+    f1.op = "API_GET_NODE";
+    f1.inputs = {"roots"};
+    f1.dnf = {{"price gt 1"}};
+    NodeDef f2;
+    f2.name = "API_GET_NODE_1";
+    f2.op = "API_GET_NODE";
+    f2.inputs = {"API_GET_NODE_0:0"};
+    f2.dnf = {{"price lt 9"}};
+    dag.nodes = {f1, f2};
+    dag.next_id = 100;
+    PlanOptStats st;
+    CHECK_OK(OptimizePreparedPlan(&dag, {"API_GET_NODE_1:0"}, &st));
+    CHECK_TRUE(st.pushdown == 1);
+    std::string text = DagToString(dag);
+    CHECK_TRUE(text.find("price gt 1 & price lt 9") != std::string::npos);
+    // same chain, but the child's :1 (positions) is fetched → no merge
+    DAGDef dag2;
+    dag2.nodes = {f1, f2};
+    dag2.next_id = 100;
+    PlanOptStats st2;
+    CHECK_OK(OptimizePreparedPlan(
+        &dag2, {"API_GET_NODE_1:0", "API_GET_NODE_1:1"}, &st2));
+    CHECK_TRUE(st2.pushdown == 0);
+  }
+  // fusion: a sync multi-node plan collapses into one FUSED group and
+  // the executed form stays deterministic
+  {
+    DAGDef dag;
+    NodeDef own;
+    own.name = "API_GET_NODE_0";
+    own.op = "API_GET_NODE";
+    own.inputs = {"roots"};
+    NodeDef gp;
+    gp.name = "API_GET_P_1";
+    gp.op = "API_GET_P";
+    gp.inputs = {"API_GET_NODE_0:0"};
+    gp.attrs = {"price"};
+    dag.nodes = {own, gp};
+    dag.next_id = 100;
+    PlanOptStats st;
+    CHECK_OK(OptimizePreparedPlan(&dag, {"API_GET_P_1:0"}, &st));
+    CHECK_TRUE(st.fuse == 2);
+    CHECK_TRUE(dag.nodes.size() == 1 && dag.nodes[0].op == "FUSED");
+    CHECK_TRUE(DagIsDeterministic(dag));
+  }
+  // determinism gate: sampling verbs disqualify a plan, FUSED recurses
+  {
+    DAGDef dag;
+    NodeDef s;
+    s.name = "API_SAMPLE_NB_0";
+    s.op = "API_SAMPLE_NB";
+    s.inputs = {"roots"};
+    s.attrs = {"*", "3", "0"};
+    dag.nodes = {s};
+    CHECK_TRUE(!DagIsDeterministic(dag));
+    DAGDef fused;
+    NodeDef f;
+    f.name = "FUSED_1";
+    f.op = "FUSED";
+    f.inputs = {"roots"};
+    f.inner = {s};
+    fused.nodes = {f};
+    CHECK_TRUE(!DagIsDeterministic(fused));
+    CHECK_TRUE(IsDeterministicOp("API_GET_NB_NODE"));
+    CHECK_TRUE(!IsDeterministicOp("API_SAMPLE_NB"));
+  }
+  // compile cache: bounded LRU — a distinct-query flood stays capped
+  {
+    CompileOptions opts;
+    opts.mode = "local";
+    GqlCompiler compiler(opts);
+    for (int i = 0; i < 300; ++i) {
+      std::shared_ptr<const TranslateResult> plan;
+      CHECK_OK(compiler.Compile(
+          "v(roots).getNB(" + std::to_string(i % 2) + ").as(nb" +
+              std::to_string(i) + ")",
+          &plan));
+    }
+    CHECK_TRUE(compiler.cache_size() == GqlCompiler::kCacheCap);
+    // an entry still resident answers from cache (same pointer)
+    std::shared_ptr<const TranslateResult> p1, p2;
+    CHECK_OK(compiler.Compile("v(roots).getNB(0).as(nb299)", &p1));
+    CHECK_OK(compiler.Compile("v(roots).getNB(0).as(nb299)", &p2));
+    CHECK_TRUE(p1.get() == p2.get());
+  }
+}
+
+// ---- rpc: deterministic result reuse + cross-request coalescing ----
+void TestExecuteReuseAndCoalesce() {
+  std::shared_ptr<const Graph> g(RingGraph());
+  auto server = std::make_unique<GraphServer>(g, nullptr, 0, 1, 1);
+  CHECK_OK(server->Start(0));
+  RpcConfig saved = GlobalRpcConfig();
+  GlobalRpcConfig().mux = true;
+  GlobalRpcConfig().mux_connections = 1;
+  GlobalRpcConfig().prepared = true;
+  GlobalRpcConfig().reuse_window = 8;
+  auto& ctr = GlobalRpcCounters();
+
+  CompileOptions opts;
+  opts.mode = "local";
+  opts.fuse_local = false;  // keep the plan multi-node for the optimizer
+  GqlCompiler compiler(opts);
+  std::shared_ptr<const TranslateResult> plan;
+  CHECK_OK(compiler.Compile("v(roots).getNB(*).as(nb)", &plan));
+  ExecuteRequest req;
+  Tensor roots(DType::kU64, {2});
+  roots.Flat<uint64_t>()[0] = 3;
+  roots.Flat<uint64_t>()[1] = 9;
+  req.inputs.emplace_back("roots", roots);
+  req.nodes = plan->dag.nodes;
+  req.outputs = {"nb:1"};
+  ByteWriter pw, fw;
+  EncodeExecutePlan(req, &pw);
+  EncodeExecuteFeeds(req, &fw);
+  const uint64_t pid =
+      PlanContentHash(pw.buffer().data(), pw.buffer().size());
+
+  RpcChannel ch("127.0.0.1", server->port());
+  ch.set_mux(true);
+  // cold call: registers + executes + installs the reuse entry
+  const uint64_t hit0 = ctr.reuse_hits.load();
+  const uint64_t miss0 = ctr.reuse_misses.load();
+  std::vector<char> rep1, rep2;
+  CHECK_OK(ch.CallExecutePrepared(pw.buffer(), pid, fw.buffer(), &rep1, 2));
+  CHECK_TRUE(ctr.reuse_misses.load() == miss0 + 1);
+  // warm call: byte-identical reply straight from the window
+  CHECK_OK(ch.CallExecutePrepared(pw.buffer(), pid, fw.buffer(), &rep2, 2));
+  CHECK_TRUE(ctr.reuse_hits.load() == hit0 + 1);
+  CHECK_TRUE(rep1 == rep2);
+  // different feeds: never served from the window (exact-byte compare)
+  ExecuteRequest reqB = req;
+  reqB.inputs[0].second.Flat<uint64_t>()[1] = 11;
+  ByteWriter fwB;
+  EncodeExecuteFeeds(reqB, &fwB);
+  std::vector<char> repB;
+  CHECK_OK(
+      ch.CallExecutePrepared(pw.buffer(), pid, fwB.buffer(), &repB, 2));
+  CHECK_TRUE(repB != rep1);
+
+  // ownership flip purges the window (counted) — a post-flip call can
+  // never be answered with a pre-flip result
+  const uint64_t inv0 = ctr.reuse_invalidated.load();
+  auto om = std::make_shared<OwnershipMap>();
+  CHECK_OK(OwnershipMap::Decode("e1-P1-0", om.get()));
+  CHECK_OK(server->SetOwnership(om));
+  CHECK_TRUE(ctr.reuse_invalidated.load() >= inv0 + 2);
+
+  // nondeterministic plan: the fast path must not engage at all
+  std::shared_ptr<const TranslateResult> splan;
+  CHECK_OK(compiler.Compile("v(roots).sampleNB(0, 3, -1).as(snb)", &splan));
+  ExecuteRequest sreq;
+  sreq.inputs.emplace_back("roots", roots);
+  sreq.nodes = splan->dag.nodes;
+  sreq.outputs = {"snb:1"};
+  ByteWriter spw, sfw;
+  EncodeExecutePlan(sreq, &spw);
+  EncodeExecuteFeeds(sreq, &sfw);
+  const uint64_t spid =
+      PlanContentHash(spw.buffer().data(), spw.buffer().size());
+  const uint64_t h1 = ctr.reuse_hits.load();
+  const uint64_t m1 = ctr.reuse_misses.load();
+  std::vector<char> sr1, sr2;
+  CHECK_OK(
+      ch.CallExecutePrepared(spw.buffer(), spid, sfw.buffer(), &sr1, 2));
+  CHECK_OK(
+      ch.CallExecutePrepared(spw.buffer(), spid, sfw.buffer(), &sr2, 2));
+  CHECK_TRUE(ctr.reuse_hits.load() == h1);
+  CHECK_TRUE(ctr.reuse_misses.load() == m1);
+
+  // coalescing: two identical deterministic executes inside one window
+  // → one shared run answers both, byte-identically
+  GlobalRpcConfig().reuse_window = 0;  // isolate the coalescer
+  GlobalRpcConfig().coalesce_window_us = 60000;
+  const uint64_t co0 = ctr.coalesced_requests.load();
+  const uint64_t cb0 = ctr.coalesce_batches.load();
+  std::vector<char> ra, rb;
+  std::thread t1([&] {
+    CHECK_OK(ch.CallExecutePrepared(pw.buffer(), pid, fw.buffer(), &ra, 2));
+  });
+  ::usleep(5000);  // let the leader open its bucket
+  std::thread t2([&] {
+    CHECK_OK(ch.CallExecutePrepared(pw.buffer(), pid, fw.buffer(), &rb, 2));
+  });
+  t1.join();
+  t2.join();
+  CHECK_TRUE(ra == rb && ra == rep1);
+  CHECK_TRUE(ctr.coalesced_requests.load() >= co0 + 1);
+  CHECK_TRUE(ctr.coalesce_batches.load() >= cb0 + 1);
+
+  server->Stop();
+  GlobalRpcConfig() = saved;
+}
+
 }  // namespace
 }  // namespace et
 
 
 int main() {
+  // server/client teardown races write to closing sockets on purpose
+  // (hedge losers, coalesce fan-out) — EPIPE is handled, SIGPIPE kills
+  ::signal(SIGPIPE, SIG_IGN);
   et::MinLogLevel() = 2;  // quiet
   et::TestPcg32Determinism();
   et::TestAliasSamplerStatistics();
@@ -850,6 +1087,8 @@ int main() {
   et::TestServerTraceBreakdown();
   et::TestSerdeSizingSplitSegments();
   et::TestPreparedPlanExecution();
+  et::TestPlanOptimizerPasses();
+  et::TestExecuteReuseAndCoalesce();
   et::TestI32OffsetGuard();
   et::TestGraphStore();
   et::TestConcurrentSampling();

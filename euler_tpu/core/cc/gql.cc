@@ -525,9 +525,15 @@ void RenameInputs(DAGDef* dag, const std::string& from_node,
   }
 }
 
-void CsePass(DAGDef* dag) {
+// protect: node names that must survive (their outputs are fetched by
+// name — requested plan outputs / aliases). A protected duplicate is
+// kept; an unprotected duplicate of a protected original still folds.
+// Returns the number of removed nodes.
+int CsePassProtected(DAGDef* dag,
+                     const std::unordered_set<std::string>& protect) {
   std::unordered_map<std::string, std::string> seen;  // key → node name
   std::vector<NodeDef> kept;
+  int removed = 0;
   for (auto& n : dag->nodes) {
     if (DeterministicOps().count(n.op) == 0) {
       kept.push_back(std::move(n));
@@ -535,8 +541,8 @@ void CsePass(DAGDef* dag) {
     }
     std::string key = NodeKey(n);
     auto it = seen.find(key);
-    if (it == seen.end()) {
-      seen.emplace(std::move(key), n.name);
+    if (it == seen.end() || protect.count(n.name) > 0) {
+      if (it == seen.end()) seen.emplace(std::move(key), n.name);
       kept.push_back(std::move(n));
     } else {
       // later duplicate → retarget all readers, drop the node
@@ -547,10 +553,14 @@ void CsePass(DAGDef* dag) {
         for (auto& in : k.inputs)
           if (in.rfind(prefix, 0) == 0)
             in = it->second + in.substr(n.name.size());
+      ++removed;
     }
   }
   dag->nodes = std::move(kept);
+  return removed;
 }
+
+void CsePass(DAGDef* dag) { CsePassProtected(dag, {}); }
 
 // The graph-touching ops that must run on the shard owning the data.
 bool IsGraphOp(const std::string& op) {
@@ -827,12 +837,12 @@ Status GpRewrite(const CompileOptions& opts, DAGDef* dag) {
 // thread-pool handoff per node) from the hot sampling path; tensors keep
 // their original names via also_produces, and seeded RNG streams hash the
 // original node names, so fused and unfused plans sample identically.
-void FuseLocalPass(DAGDef* dag) {
-  if (dag->nodes.size() < 2) return;
+int FuseLocalPass(DAGDef* dag) {
+  if (dag->nodes.size() < 2) return 0;
   for (const auto& n : dag->nodes)
-    if (n.op == "REMOTE" || LookupKernel(n.op) == nullptr) return;
+    if (n.op == "REMOTE" || LookupKernel(n.op) == nullptr) return 0;
   std::vector<int> order;
-  if (!TopologicSort(*dag, &order)) return;  // cycle → let the executor report
+  if (!TopologicSort(*dag, &order)) return 0;  // cycle → executor reports
   NodeDef fused;
   fused.name = dag->UniqueName("FUSED");
   fused.op = "FUSED";
@@ -856,6 +866,141 @@ void FuseLocalPass(DAGDef* dag) {
   fused.inner = std::move(inner);
   dag->nodes.clear();
   dag->nodes.push_back(std::move(fused));
+  return static_cast<int>(order.size());
+}
+
+bool IsDeterministicOp(const std::string& op) {
+  return DeterministicOps().count(op) > 0;
+}
+
+bool DagIsDeterministic(const DAGDef& dag) {
+  // AS / COLLECT / FUSED are pure plumbing (alias, passthrough, inline
+  // group) — deterministic iff what they wrap is.
+  std::function<bool(const std::vector<NodeDef>&)> det =
+      [&](const std::vector<NodeDef>& nodes) {
+        for (const auto& n : nodes) {
+          if (n.op == "AS" || n.op == "COLLECT") continue;
+          if (n.op == "FUSED") {
+            if (!det(n.inner)) return false;
+            continue;
+          }
+          if (DeterministicOps().count(n.op) == 0) return false;
+        }
+        return true;
+      };
+  return det(dag.nodes);
+}
+
+namespace {
+
+// Filter / post-process pushdown over a registered plan: an adjacent
+// sole-consumer chain of the same shaping op collapses into one node —
+// the CHILD absorbs its producer (the child's name may be a requested
+// output; the producer's never is, guarded below). Patterns:
+//   API_GET_NODE(dnf2) ∘ API_GET_NODE(dnf1)  →  API_GET_NODE(dnf1∧dnf2)
+//   POST_PROCESS(pp2)  ∘ POST_PROCESS(pp1)   →  POST_PROCESS(pp1;pp2)
+//   ID_UNIQUE          ∘ ID_UNIQUE           →  ID_UNIQUE
+// Legal only when the producer's outputs feed NOTHING but the child
+// (GET_NODE:1 / chained positions change meaning otherwise) and the
+// producer's name is not a requested output. For GET_NODE / ID_UNIQUE
+// additionally nothing may consume the CHILD's :1+ outputs (positions /
+// inverse index — relative to the producer's output before the merge,
+// to the original input after; `consumed` carries the plan's requested
+// output strings so a fetched child:1 also blocks). Returns removed.
+int PushdownPass(DAGDef* dag, const std::unordered_set<std::string>& protect,
+                 const std::unordered_set<std::string>& consumed) {
+  int removed = 0;
+  bool changed = true;
+  while (changed) {
+    changed = false;
+    for (size_t ci = 0; ci < dag->nodes.size() && !changed; ++ci) {
+      NodeDef& child = dag->nodes[ci];
+      if (child.op != "API_GET_NODE" && child.op != "POST_PROCESS" &&
+          child.op != "ID_UNIQUE")
+        continue;
+      if (child.inputs.empty()) continue;
+      auto pos = child.inputs[0].rfind(':');
+      if (pos == std::string::npos) continue;
+      const std::string pname = child.inputs[0].substr(0, pos);
+      NodeDef* prod = dag->Find(pname);
+      if (prod == nullptr || prod->op != child.op) continue;
+      if (protect.count(pname) > 0) continue;
+      // the child must consume the producer positionally: input i is
+      // exactly producer:i (a shuffled wiring is not a simple chain)
+      bool chained = true;
+      for (size_t i = 0; i < child.inputs.size() && chained; ++i)
+        chained = child.inputs[i] == prod->OutName(static_cast<int>(i));
+      if (!chained) continue;
+      // sole consumer: no OTHER node reads any producer output
+      const std::string prefix = pname + ":";
+      bool sole = true;
+      for (const auto& other : dag->nodes) {
+        if (&other == &child) continue;
+        for (const auto& in : other.inputs)
+          if (in.rfind(prefix, 0) == 0) sole = false;
+      }
+      if (!sole) continue;
+      // GET_NODE / ID_UNIQUE: the child's :1+ outputs index into what
+      // the child CONSUMED — the merge rebases them onto the original
+      // input, so any consumer of them blocks the rewrite
+      if (child.op != "POST_PROCESS") {
+        bool aux_read = false;
+        for (int slot = 1; slot < 8 && !aux_read; ++slot) {
+          const std::string out = child.OutName(slot);
+          if (consumed.count(out) > 0) aux_read = true;
+          for (const auto& other : dag->nodes)
+            for (const auto& in : other.inputs)
+              if (in == out) aux_read = true;
+        }
+        if (aux_read) continue;
+      }
+      if (child.op == "API_GET_NODE") {
+        // dnf1 ∧ dnf2: survivors of both filters, positions now
+        // relative to the PRODUCER's input — legal because nothing else
+        // read the intermediate positions (sole-consumer guard)
+        child.dnf = AndDnf(prod->dnf, child.dnf);
+      } else if (child.op == "POST_PROCESS") {
+        std::vector<std::string> pp = prod->post_process;
+        pp.insert(pp.end(), child.post_process.begin(),
+                  child.post_process.end());
+        child.post_process = std::move(pp);
+      }  // ID_UNIQUE ∘ ID_UNIQUE: idempotent, nothing to merge
+      child.inputs = prod->inputs;
+      for (size_t i = 0; i < dag->nodes.size(); ++i) {
+        if (dag->nodes[i].name == pname) {
+          dag->nodes.erase(dag->nodes.begin() + i);
+          break;
+        }
+      }
+      ++removed;
+      changed = true;
+    }
+  }
+  return removed;
+}
+
+}  // namespace
+
+Status OptimizePreparedPlan(DAGDef* dag,
+                            const std::vector<std::string>& outputs,
+                            PlanOptStats* stats) {
+  PlanOptStats local;
+  PlanOptStats* st = stats != nullptr ? stats : &local;
+  // producers of requested outputs must keep their names: the reply is
+  // assembled by ctx lookup of these exact strings
+  std::unordered_set<std::string> protect;
+  std::unordered_set<std::string> consumed(outputs.begin(), outputs.end());
+  for (const auto& out : outputs) {
+    auto pos = out.rfind(':');
+    protect.insert(pos == std::string::npos ? out : out.substr(0, pos));
+  }
+  st->dedup += CsePassProtected(dag, protect);
+  st->pushdown += PushdownPass(dag, protect, consumed);
+  st->fuse += FuseLocalPass(dag);
+  std::vector<int> order;
+  if (!TopologicSort(*dag, &order))
+    return Status::Internal("optimized plan has a cycle");
+  return Status::OK();
 }
 
 Status OptimizeDag(const CompileOptions& opts, DAGDef* dag) {
@@ -1241,7 +1386,8 @@ Status GqlCompiler::Compile(const std::string& query,
     std::lock_guard<std::mutex> lk(mu_);
     auto it = cache_.find(query);
     if (it != cache_.end()) {
-      *out = it->second;
+      lru_.splice(lru_.begin(), lru_, it->second.second);
+      *out = it->second.first;
       return Status::OK();
     }
   }
@@ -1254,11 +1400,29 @@ Status GqlCompiler::Compile(const std::string& query,
   if (!TopologicSort(result->dag, &order))
     return Status::Internal("compiled DAG has a cycle: " + query);
   {
+    // bounded LRU (kCacheCap): a proxy fed an unbounded stream of
+    // distinct query strings stays flat; an evicted entry recompiles
     std::lock_guard<std::mutex> lk(mu_);
-    cache_[query] = result;
+    auto it = cache_.find(query);
+    if (it != cache_.end()) {
+      lru_.splice(lru_.begin(), lru_, it->second.second);
+      it->second.first = result;
+    } else {
+      lru_.push_front(query);
+      cache_[query] = {result, lru_.begin()};
+      while (cache_.size() > kCacheCap) {
+        cache_.erase(lru_.back());
+        lru_.pop_back();
+      }
+    }
   }
   *out = result;
   return Status::OK();
+}
+
+size_t GqlCompiler::cache_size() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return cache_.size();
 }
 
 std::string DagToString(const DAGDef& dag) {

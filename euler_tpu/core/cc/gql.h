@@ -20,6 +20,7 @@
 #ifndef EULER_TPU_GQL_H_
 #define EULER_TPU_GQL_H_
 
+#include <list>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -74,20 +75,67 @@ inline int ShardOf(uint64_t id, int partition_num, int shard_num) {
 //  - DistributeRewrite: wrap graph-touching ops in split/REMOTE/merge
 Status OptimizeDag(const CompileOptions& opts, DAGDef* dag);
 
+// True for ops whose output is a pure function of (inputs, graph
+// snapshot) — CSE-safe and result-reuse-safe. Sampling verbs are not.
+bool IsDeterministicOp(const std::string& op);
+
+// True when every node of the plan (FUSED groups included) is
+// deterministic — the gate for the server-side result-reuse window and
+// cross-request execute coalescing (rpc.h RpcConfig::reuse_window /
+// coalesce_window_us): only a plan whose bytes-in fully determine its
+// bytes-out may ever be answered from a cached or shared execution.
+bool DagIsDeterministic(const DAGDef& dag);
+
+// Per-pass rewrite counts from one OptimizePreparedPlan run — surfaced
+// through RpcCounters::plan_rewrites_* so every rewrite is countable.
+struct PlanOptStats {
+  int fuse = 0;      // nodes collapsed into a FUSED group
+  int pushdown = 0;  // filter / post-process nodes absorbed downstream
+  int dedup = 0;     // duplicate deterministic sub-plans removed
+};
+
+// Prepare-time plan optimizer (the server side of kPrepare, rpc.cc):
+// rewrites a REGISTERED execute plan in place, once per registration,
+// so every later prepared kExecute runs the optimized form. Passes, in
+// order: sub-plan dedup (CSE, protecting requested output names),
+// filter/post-process pushdown (adjacent sole-consumer GET_NODE dnf
+// chains, POST_PROCESS chains and ID_UNIQUE chains absorb their
+// producer), and whole-plan fusion into one FUSED node (sample→gather
+// hops execute inline — no per-op executor scheduling). Result parity:
+// tensors keep their original names (also_produces) and seeded RNG
+// streams hash node names, so optimized and verbatim plans produce
+// identical bytes for identical feeds. `outputs` are the plan's
+// requested output tensor names — their producers are never removed.
+Status OptimizePreparedPlan(DAGDef* dag,
+                            const std::vector<std::string>& outputs,
+                            PlanOptStats* stats);
+
 class GqlCompiler {
  public:
   explicit GqlCompiler(CompileOptions opts) : opts_(std::move(opts)) {}
 
-  // Parse + translate + optimize, with a query-text cache.
+  // Parse + translate + optimize, with a bounded LRU query-text cache
+  // (same discipline as the server plan cache, rpc.h plan_cache: a
+  // long-lived proxy fed an unbounded stream of distinct query strings
+  // must not grow without limit; an evicted entry just recompiles).
   Status Compile(const std::string& query,
                  std::shared_ptr<const TranslateResult>* out);
 
   const CompileOptions& options() const { return opts_; }
 
+  size_t cache_size() const;
+
+  // Compiled-plan cache bound. Training loops cycle a handful of query
+  // strings; 256 keeps every realistic working set resident.
+  static constexpr size_t kCacheCap = 256;
+
  private:
   CompileOptions opts_;
-  std::mutex mu_;
-  std::unordered_map<std::string, std::shared_ptr<const TranslateResult>>
+  mutable std::mutex mu_;
+  std::list<std::string> lru_;  // front = most recently used
+  std::unordered_map<std::string,
+                     std::pair<std::shared_ptr<const TranslateResult>,
+                               std::list<std::string>::iterator>>
       cache_;
 };
 

@@ -1,6 +1,7 @@
 #include "rpc.h"
 
 #include <arpa/inet.h>
+#include <csignal>
 #include <fcntl.h>
 #include <poll.h>
 #include <dirent.h>
@@ -26,6 +27,7 @@
 #include <unordered_map>
 #include <unordered_set>
 
+#include "gql.h"
 #include "threadpool.h"
 #include "udf.h"
 
@@ -594,6 +596,19 @@ GraphServer::GraphServer(std::shared_ptr<GraphRef> graph_ref,
 
 GraphServer::~GraphServer() { Stop(); }
 
+void GraphServer::InvalidateReuse() {
+  size_t dropped = 0;
+  {
+    std::lock_guard<std::mutex> lk(reuse_mu_);
+    dropped = reuse_.size();
+    reuse_.clear();
+    reuse_lru_.clear();
+  }
+  if (dropped > 0)
+    GlobalRpcCounters().reuse_invalidated.fetch_add(
+        static_cast<uint64_t>(dropped));
+}
+
 void GraphServer::SnapshotState(std::shared_ptr<const Graph>* g,
                                 std::shared_ptr<IndexManager>* idx) const {
   // one lock for both: a request must never pair a new graph with the
@@ -604,6 +619,11 @@ void GraphServer::SnapshotState(std::shared_ptr<const Graph>* g,
 }
 
 Status GraphServer::Start(int port) {
+  // a reply racing a peer close (hedge losers, coalesce fan-out after a
+  // client gave up) must surface as an EPIPE write error, not kill the
+  // process — CPython embeds already ignore SIGPIPE, standalone
+  // binaries (engine_test) get the default terminate without this
+  ::signal(SIGPIPE, SIG_IGN);
   // interop test hook: serve exactly like a pre-v2 binary (v2 hellos are
   // an unknown magic → connection dropped, clients fall back to v1)
   const char* v1_env = std::getenv("EULER_TPU_RPC_SERVER_V1");
@@ -765,7 +785,69 @@ struct PreparedPlan {
   DAGDef dag;
   std::vector<std::string> outputs;
   uint64_t gen = 0;
+  // prepare-time optimizer (RpcConfig::plan_optimize, gql.h
+  // OptimizePreparedPlan): the INSTALLED dag above is the optimized
+  // form; verbatim_text keeps the registered form's DagToString when
+  // any pass rewrote it (introspection probe), empty otherwise.
+  bool optimized = false;
+  PlanOptStats opt_stats;
+  std::string verbatim_text;
+  // every op deterministic (gql.h DagIsDeterministic): eligible for the
+  // result-reuse window and cross-request coalescing
+  bool deterministic = false;
 };
+
+// One completed deterministic execution, pinned for the reuse window.
+// feeds are the EXACT request body bytes — a key hit still memcmps them
+// so a 64-bit collision can never serve foreign results. Outputs hold
+// refcounted tensors; serving a hit copies the vector, not the payloads.
+struct GraphServer::ReuseEntry {
+  uint64_t plan_id = 0;
+  uint64_t graph_uid = 0;
+  std::vector<char> feeds;
+  std::vector<std::pair<std::string, Tensor>> outputs;
+};
+
+// An open coalescing batch: the first arrival (leader) holds execution
+// for the bounded window; same-key arrivals park their reply
+// continuation here and the leader answers everyone from its single
+// run. closed flips under coalesce_mu_ when the leader starts
+// executing — later arrivals start a fresh bucket.
+struct GraphServer::CoalesceBucket {
+  uint64_t plan_id = 0;
+  uint64_t graph_uid = 0;
+  std::vector<char> feeds;  // leader's body bytes (followers must match)
+  bool closed = false;
+  // each waiter stamps its own timing and writes its own reply frame
+  std::vector<std::function<void(const ExecuteReply&)>> waiters;
+};
+
+std::string GraphServer::DebugPlans() const {
+  // explain() server probe: every registered plan, its generation, its
+  // determinism verdict, the per-pass rewrite counts and the form that
+  // actually executes (plus the verbatim form when the optimizer rewrote)
+  std::string out;
+  std::lock_guard<std::mutex> lk(plan_mu_);
+  for (uint64_t id : plan_lru_) {
+    auto it = plans_.find(id);
+    if (it == plans_.end()) continue;
+    const PreparedPlan& pl = *it->second.first;
+    out += "plan " + std::to_string(id) + " gen=" + std::to_string(pl.gen) +
+           " deterministic=" + (pl.deterministic ? "1" : "0") +
+           " optimized=" + (pl.optimized ? "1" : "0");
+    if (pl.optimized)
+      out += " rewrites[fuse=" + std::to_string(pl.opt_stats.fuse) +
+             " pushdown=" + std::to_string(pl.opt_stats.pushdown) +
+             " dedup=" + std::to_string(pl.opt_stats.dedup) + "]";
+    out += "\n";
+    out += DagToString(pl.dag);
+    if (pl.optimized && !pl.verbatim_text.empty()) {
+      out += "-- as registered (pre-optimize):\n";
+      out += pl.verbatim_text;
+    }
+  }
+  return out;
+}
 
 // Per-connection v2 state: the reply write lock (out-of-order completions
 // serialize on it), the hello-negotiated compression caps, and the
@@ -781,16 +863,9 @@ struct GraphServer::ConnState {
   uint64_t peer_threshold = 0;
   // reused per-connection deflate state (under wmu, like the writes)
   DeflateCtx deflate;
-  // bounded LRU of registered plans (kPrepare), id = content hash.
-  // Touched on the reader thread only EXCEPT that lookups check the
-  // server's plan generation — the mutex keeps a concurrent
-  // SetOwnership bump well-defined.
-  std::mutex plan_mu;
-  std::list<uint64_t> plan_lru;  // front = most recently used
-  std::unordered_map<uint64_t,
-                     std::pair<std::shared_ptr<const PreparedPlan>,
-                               std::list<uint64_t>::iterator>>
-      plans;
+  // registered plans live in the SERVER's shared store (GraphServer::
+  // plans_) — one decode per plan per process, shared across
+  // connections and surviving reconnects.
   std::mutex imu;
   std::condition_variable icv;
   int inflight = 0;  // dispatched requests whose reply is not yet written
@@ -916,6 +991,10 @@ void GraphServer::ApplyDeltaBody(const char* body, size_t len,
     index_ = new_index;  // null when the server has no index
   }
   UdfResultCache::Instance().EvictGraph(old_uid);
+  // the reuse window is keyed on the pre-delta snapshot uid — every
+  // entry is now stale; purge (counted) before any post-swap execute
+  // can look one up
+  InvalidateReuse();
   {
     // retained raw body: what kGetDeltaLog serves to a recovering peer
     std::lock_guard<std::mutex> lk(dlog_mu_);
@@ -1002,6 +1081,9 @@ Status GraphServer::SetOwnership(std::shared_ptr<const OwnershipMap> m) {
   // answers the counted miss status and the client re-prepares against
   // the new map. Never a silent stale-plan execute.
   plan_gen_.fetch_add(1);
+  // routing flipped: cached replies may have been computed for rows this
+  // shard no longer owns — drop the whole reuse window (counted)
+  InvalidateReuse();
   ET_LOG(INFO) << "shard " << shard_idx_ << " installed ownership map "
                << m->Encode();
   return Status::OK();
@@ -1399,9 +1481,9 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
     return true;
   }
   if (msg_type == kPrepare) {
-    // register on the reader thread: decode is O(plan) exactly once per
-    // plan per connection — the cost every later prepared kExecute on
-    // this connection stops paying
+    // register on the reader thread: decode + optimize is O(plan)
+    // exactly once per plan per PROCESS (shared store) — the cost every
+    // later prepared kExecute from any connection stops paying
     ByteWriter w;
     ExecuteRequest preq;
     ByteReader r(body.data(), body.size());
@@ -1412,27 +1494,56 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
       w.Put<uint32_t>(1);
       w.PutStr(ps.message());
     } else {
+      auto& ctr = GlobalRpcCounters();
       const uint64_t id = PlanContentHash(body.data(), body.size());
       auto plan = std::make_shared<PreparedPlan>();
       plan->dag.nodes = std::move(preq.nodes);
       plan->outputs = std::move(preq.outputs);
       plan->gen = plan_gen_.load();
-      const int cap = std::max(GlobalRpcConfig().plan_cache.load(), 1);
-      {
-        std::lock_guard<std::mutex> lk(conn->plan_mu);
-        auto it = conn->plans.find(id);
-        if (it != conn->plans.end()) {
-          conn->plan_lru.erase(it->second.second);
-          conn->plans.erase(it);
-        }
-        conn->plan_lru.push_front(id);
-        conn->plans[id] = {std::move(plan), conn->plan_lru.begin()};
-        while (static_cast<int>(conn->plans.size()) > cap) {
-          conn->plans.erase(conn->plan_lru.back());
-          conn->plan_lru.pop_back();
+      // prepare-time optimizer: rewrite ONCE here so every execute of
+      // this plan runs the optimized form. A pass failure keeps the
+      // verbatim plan (registration never fails on optimizer grounds).
+      if (GlobalRpcConfig().plan_optimize.load()) {
+        std::string before = DagToString(plan->dag);
+        DAGDef opt;
+        opt.nodes = plan->dag.nodes;  // copy; rewrite the copy
+        opt.next_id = static_cast<int>(opt.nodes.size()) + 1000;
+        PlanOptStats st;
+        if (OptimizePreparedPlan(&opt, plan->outputs, &st).ok()) {
+          const bool rewrote = st.fuse + st.pushdown + st.dedup > 0;
+          if (rewrote) {
+            plan->dag = std::move(opt);
+            plan->optimized = true;
+            plan->opt_stats = st;
+            plan->verbatim_text = std::move(before);
+            ctr.plan_optimized.fetch_add(1);
+            ctr.plan_rewrites_fuse.fetch_add(st.fuse);
+            ctr.plan_rewrites_pushdown.fetch_add(st.pushdown);
+            ctr.plan_rewrites_dedup.fetch_add(st.dedup);
+          }
         }
       }
-      GlobalRpcCounters().prepared_registered.fetch_add(1);
+      plan->deterministic = DagIsDeterministic(plan->dag);
+      const int cap = std::max(GlobalRpcConfig().plan_cache.load(), 1);
+      {
+        std::lock_guard<std::mutex> lk(plan_mu_);
+        auto it = plans_.find(id);
+        if (it != plans_.end()) {
+          // re-registration after a generation bump = the per-epoch
+          // re-derivation of the routing the client plan bakes in
+          if (it->second.first->gen != plan->gen)
+            ctr.plan_rewrites_epoch.fetch_add(1);
+          plan_lru_.erase(it->second.second);
+          plans_.erase(it);
+        }
+        plan_lru_.push_front(id);
+        plans_[id] = {std::move(plan), plan_lru_.begin()};
+        while (static_cast<int>(plans_.size()) > cap) {
+          plans_.erase(plan_lru_.back());
+          plan_lru_.pop_back();
+        }
+      }
+      ctr.prepared_registered.fetch_add(1);
       w.Put<uint32_t>(0);
       w.Put<uint64_t>(id);
     }
@@ -1501,41 +1612,48 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
   // requests executing while this reader keeps reading; no server thread
   // is parked per in-flight request.
   //
-  // Prepared execute: resolve the plan id against this connection's
-  // cache FIRST. An unknown / evicted / generation-stale id answers an
-  // explicit counted miss status right here — the feeds are never
-  // guessed against some other plan, and the client re-prepares.
+  // Prepared execute: resolve the plan id against the server's SHARED
+  // plan store FIRST. An unknown / evicted / generation-stale id
+  // answers an explicit counted miss status right here — the feeds are
+  // never guessed against some other plan, and the client re-prepares.
   std::shared_ptr<const PreparedPlan> prep;
   if (plan_id != 0) {
     auto& ctr = GlobalRpcCounters();
     bool invalidated = false;
     const uint64_t cur_gen = plan_gen_.load();
     {
-      std::lock_guard<std::mutex> lk(conn->plan_mu);
-      auto it = conn->plans.find(plan_id);
-      if (it != conn->plans.end()) {
+      std::lock_guard<std::mutex> lk(plan_mu_);
+      auto it = plans_.find(plan_id);
+      if (it != plans_.end()) {
         if (it->second.first->gen != cur_gen) {
           // registered against a superseded ownership map: the client
           // plan bakes in shard routing the flip just moved
-          conn->plan_lru.erase(it->second.second);
-          conn->plans.erase(it);
+          plan_lru_.erase(it->second.second);
+          plans_.erase(it);
           invalidated = true;
         } else {
-          conn->plan_lru.splice(conn->plan_lru.begin(), conn->plan_lru,
-                                it->second.second);
+          plan_lru_.splice(plan_lru_.begin(), plan_lru_,
+                           it->second.second);
           prep = it->second.first;
         }
       }
     }
     if (prep == nullptr) {
-      if (invalidated) ctr.prepared_invalidated.fetch_add(1);
+      if (invalidated) {
+        ctr.prepared_invalidated.fetch_add(1);
+        // the stranded plan's distribute rewrite is about to be
+        // re-derived under the new ownership epoch (the client answers
+        // this miss with a fresh kPrepare) — the counted per-epoch
+        // re-derivation, one per stranded plan
+        ctr.plan_rewrites_epoch.fetch_add(1);
+      }
       ctr.prepared_misses.fetch_add(1);
       ExecuteReply rep;
       rep.status = Status::Internal(
           "unknown prepared plan " + std::to_string(plan_id) +
           (invalidated
                ? " (invalidated by an ownership-map flip); re-prepare"
-               : " on this connection; re-prepare"));
+               : " on this server; re-prepare"));
       ByteWriter w;
       EncodeExecuteReply(rep, &w);
       write_reply(kExecute, request_id, w.buffer());
@@ -1711,7 +1829,7 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
   // SHED with an explicit status (counted), its DAG never run.
   GlobalThreadPool()->Schedule(
       [this, finish, tm, deadline_us, arrival_us, req_map_epoch, prep,
-       body = std::move(body)] {
+       plan_id, body = std::move(body)]() mutable {
         tm->pickup_us = SteadyNowUs();
         // stale ownership map: the request was SPLIT with a routing map
         // this shard has since superseded — partitions it stopped
@@ -1747,6 +1865,108 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
           return;
         }
         auto p = std::make_shared<Pending>();
+        // snapshot FIRST: the reuse/coalesce key must name the exact
+        // graph this request will execute against
+        SnapshotState(&p->graph, &p->index);
+        // ---- deterministic fast paths (tentpole): result reuse +
+        // cross-request coalescing. Gated on a DETERMINISTIC prepared
+        // plan — a plan whose feed bytes fully determine its reply —
+        // and keyed (plan id, graph snapshot uid, feed-byte hash) with
+        // an exact feed compare on every match.
+        const int reuse_cap = GlobalRpcConfig().reuse_window.load();
+        const int64_t co_win = GlobalRpcConfig().coalesce_window_us.load();
+        const bool fast_eligible =
+            prep != nullptr && prep->deterministic &&
+            (reuse_cap > 0 || co_win > 0);
+        uint64_t key = 0;
+        if (fast_eligible) {
+          auto mix = [](uint64_t a, uint64_t b) {
+            return a ^ (b + 0x9e3779b97f4a7c15ULL + (a << 6) + (a >> 2));
+          };
+          key = mix(mix(plan_id, p->graph->uid()),
+                    PlanContentHash(body.data(), body.size()));
+        }
+        auto feeds_match = [&body](const std::vector<char>& feeds) {
+          return feeds.size() == body.size() &&
+                 (body.empty() ||
+                  std::memcmp(feeds.data(), body.data(), body.size()) == 0);
+        };
+        if (fast_eligible && reuse_cap > 0) {
+          std::shared_ptr<const ReuseEntry> hit;
+          {
+            std::lock_guard<std::mutex> lk(reuse_mu_);
+            auto it = reuse_.find(key);
+            if (it != reuse_.end() &&
+                it->second.first->plan_id == plan_id &&
+                it->second.first->graph_uid == p->graph->uid() &&
+                feeds_match(it->second.first->feeds)) {
+              reuse_lru_.splice(reuse_lru_.begin(), reuse_lru_,
+                                it->second.second);
+              hit = it->second.first;
+            }
+          }
+          if (hit != nullptr) {
+            // served from the window: no decode, no execute — the
+            // phases the histograms see shrink to exactly the saved work
+            GlobalRpcCounters().reuse_hits.fetch_add(1);
+            tm->decoded_us = SteadyNowUs();
+            tm->exec_done_us = tm->decoded_us;
+            ExecuteReply rep;
+            rep.outputs = hit->outputs;  // refcounted payload shares
+            finish(std::move(rep));
+            return;
+          }
+          GlobalRpcCounters().reuse_misses.fetch_add(1);
+        }
+        std::shared_ptr<CoalesceBucket> bucket;
+        if (fast_eligible && co_win > 0) {
+          std::unique_lock<std::mutex> lk(coalesce_mu_);
+          auto it = coalesce_.find(key);
+          if (it != coalesce_.end() && !it->second->closed &&
+              it->second->plan_id == plan_id &&
+              it->second->graph_uid == p->graph->uid() &&
+              feeds_match(it->second->feeds)) {
+            // follower: park the reply continuation; the open bucket's
+            // leader answers it from the single shared execution. The
+            // follower's execute phase is the shared run (MicroBatcher
+            // attribution: coalescing makes execute a shared phase).
+            tm->decoded_us = SteadyNowUs();
+            it->second->waiters.push_back(
+                [finish, tm](const ExecuteReply& rep) {
+                  tm->exec_done_us = SteadyNowUs();
+                  finish(rep);
+                });
+            GlobalRpcCounters().coalesced_requests.fetch_add(1);
+            return;
+          }
+          bucket = std::make_shared<CoalesceBucket>();
+          bucket->plan_id = plan_id;
+          bucket->graph_uid = p->graph->uid();
+          bucket->feeds.assign(body.begin(), body.end());
+          coalesce_[key] = bucket;
+          lk.unlock();
+          // leader: bounded hold collecting same-key arrivals, then
+          // close the bucket and execute once for everyone in it
+          ::usleep(static_cast<useconds_t>(
+              std::min<int64_t>(co_win, 100000)));
+          lk.lock();
+          bucket->closed = true;
+          coalesce_.erase(key);
+        }
+        // every exit past this point must answer parked followers too
+        auto deliver = [this, finish, bucket](ExecuteReply rep) {
+          if (bucket != nullptr) {
+            std::vector<std::function<void(const ExecuteReply&)>> ws;
+            {
+              std::lock_guard<std::mutex> lk(coalesce_mu_);
+              ws = std::move(bucket->waiters);
+            }
+            if (!ws.empty())
+              GlobalRpcCounters().coalesce_batches.fetch_add(1);
+            for (auto& fn : ws) fn(rep);
+          }
+          finish(std::move(rep));
+        };
         ExecuteRequest req;
         ByteReader r(body.data(), body.size());
         // prepared path: the body is feed tensors only — the decode
@@ -1756,7 +1976,7 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
         if (!ds.ok()) {
           ExecuteReply rep;
           rep.status = ds;
-          finish(rep);
+          deliver(std::move(rep));
           return;
         }
         // decode ends here; the bench-only injected per-row work below
@@ -1783,18 +2003,19 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
           p->outputs = std::move(req.outputs);
           dag_ptr = &p->dag;
         }
-        SnapshotState(&p->graph, &p->index);
         QueryEnv env;
         env.graph = p->graph.get();
         env.index = p->index.get();
         env.pool = GlobalThreadPool();
         if (deadline_us > 0) env.deadline_us = arrival_us + deadline_us;
         p->exec = std::make_unique<Executor>(dag_ptr, env, &p->ctx);
+        const bool store_reuse = fast_eligible && reuse_cap > 0;
         // completion owns the last ref to p: the executor releases its
         // stored callback before invoking (see Executor::OnNodeDone), so
         // destroying the Executor from inside its own done is the
         // sanctioned pattern
-        p->exec->Run([p, finish, tm](Status rs) {
+        p->exec->Run([this, p, deliver, tm, store_reuse, reuse_cap, key,
+                      plan_id, body = std::move(body)](Status rs) {
           tm->exec_done_us = SteadyNowUs();
           ExecuteReply rep;
           rep.status = rs;
@@ -1810,7 +2031,28 @@ bool GraphServer::HandleV2Frame(const std::shared_ptr<ConnState>& conn,
               rep.outputs.emplace_back(name, std::move(t));
             }
           }
-          finish(std::move(rep));
+          if (store_reuse && rep.status.ok()) {
+            // install BEFORE replying so a closed loop on this result
+            // hits from its next request on
+            auto e = std::make_shared<ReuseEntry>();
+            e->plan_id = plan_id;
+            e->graph_uid = p->graph->uid();
+            e->feeds = std::move(body);
+            e->outputs = rep.outputs;  // refcounted payload shares
+            std::lock_guard<std::mutex> lk(reuse_mu_);
+            auto it = reuse_.find(key);
+            if (it != reuse_.end()) {
+              reuse_lru_.erase(it->second.second);
+              reuse_.erase(it);
+            }
+            reuse_lru_.push_front(key);
+            reuse_[key] = {std::move(e), reuse_lru_.begin()};
+            while (static_cast<int>(reuse_.size()) > reuse_cap) {
+              reuse_.erase(reuse_lru_.back());
+              reuse_lru_.pop_back();
+            }
+          }
+          deliver(std::move(rep));
         });
       });
   return true;

@@ -55,7 +55,9 @@
 #include <condition_variable>
 #include <deque>
 #include <functional>
+#include <list>
 #include <map>
+#include <unordered_map>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -136,6 +138,29 @@ struct RpcConfig {
   // bytes (same level/window/strategy); off restores the per-frame
   // compress2 path for A/B.
   std::atomic<bool> deflate_reuse{true};
+  // ---- plan optimizer / execute coalescing / result reuse ----
+  // Run the prepare-time plan optimizer (gql.h OptimizePreparedPlan) on
+  // every kPrepare registration: CSE sub-plan dedup, filter/post-process
+  // pushdown, whole-plan fusion. Pure server-side — the wire and the
+  // reply bytes are identical with it on or off (optimized plans keep
+  // tensor names via also_produces, and RNG streams hash node names).
+  std::atomic<bool> plan_optimize{true};
+  // > 0: cross-request execute coalescing — a prepared kExecute of a
+  // DETERMINISTIC plan holds for up to this many µs collecting other
+  // requests with the same (plan id, graph epoch, feed bytes) — across
+  // connections, via the shared plan store — then executes ONCE and
+  // answers every coalesced request from that single run (each gets its
+  // own reply frame). The MicroBatcher pattern (serving/batcher.py)
+  // applied to the graph tier. 0 (default) disables: per-request
+  // execution, byte-identical to pre-coalescing builds.
+  std::atomic<int64_t> coalesce_window_us{0};
+  // > 0: bounded server-side result-reuse window (entry count, LRU) for
+  // DETERMINISTIC prepared plans, keyed (plan hash, graph epoch, feed
+  // bytes) with exact feed-byte compare — a hash collision can never
+  // serve foreign results. Every graph-epoch or ownership-map bump
+  // purges the window (counted reuse_invalidated): a stale sample is
+  // never served silently. 0 (default) disables.
+  std::atomic<int> reuse_window{0};
 
   RpcConfig() = default;
   RpcConfig(const RpcConfig& o) { *this = o; }
@@ -150,6 +175,9 @@ struct RpcConfig {
     prepared.store(o.prepared.load());
     plan_cache.store(o.plan_cache.load());
     deflate_reuse.store(o.deflate_reuse.load());
+    plan_optimize.store(o.plan_optimize.load());
+    coalesce_window_us.store(o.coalesce_window_us.load());
+    reuse_window.store(o.reuse_window.load());
     return *this;
   }
 };
@@ -218,6 +246,33 @@ struct RpcCounters {
   // a classic full-plan frame (peer lacks the feature / v1 fallback /
   // persistent miss) — the correctness fallback, counted never silent.
   std::atomic<uint64_t> prepared_fallbacks{0};
+  // ---- prepare-time plan optimizer (RpcConfig::plan_optimize) ----
+  // SERVER-edge, like the prepared_* cache counters.
+  // registrations that ran the optimizer (whether or not any pass fired)
+  std::atomic<uint64_t> plan_optimized{0};
+  // per-pass rewrite counts (gql.h PlanOptStats): nodes collapsed into
+  // FUSED groups / filter+post-process nodes absorbed / CSE duplicates
+  // removed, summed over registrations
+  std::atomic<uint64_t> plan_rewrites_fuse{0};
+  std::atomic<uint64_t> plan_rewrites_pushdown{0};
+  std::atomic<uint64_t> plan_rewrites_dedup{0};
+  // re-registrations after a plan-generation bump (ownership-map flip):
+  // the optimized form was re-derived for the new epoch — PR 14's
+  // invalidation machinery driving per-epoch recompute, counted
+  std::atomic<uint64_t> plan_rewrites_epoch{0};
+  // ---- cross-request execute coalescing (coalesce_window_us) ----
+  // requests answered from ANOTHER request's execution (the followers
+  // of a coalesced batch; the leader's run is not counted)
+  std::atomic<uint64_t> coalesced_requests{0};
+  // leader executions that served more than one request
+  std::atomic<uint64_t> coalesce_batches{0};
+  // ---- deterministic result-reuse window (reuse_window) ----
+  std::atomic<uint64_t> reuse_hits{0};
+  std::atomic<uint64_t> reuse_misses{0};
+  // entries purged by a graph-epoch / ownership-map bump — every bump
+  // counts every dropped entry, so "stale but silently served" is
+  // structurally impossible to miss in the A/B accounting
+  std::atomic<uint64_t> reuse_invalidated{0};
 };
 RpcCounters& GlobalRpcCounters();
 
@@ -350,6 +405,8 @@ Status DecodeShardMeta(ByteReader* r, ShardMeta* m);
 // ---------------------------------------------------------------------------
 // Server
 // ---------------------------------------------------------------------------
+struct PreparedPlan;  // a decoded, registered execute plan (rpc.cc)
+
 class GraphServer {
  public:
   // Serves the given graph shard (+ optional index) on port (0 → ephemeral).
@@ -441,6 +498,12 @@ class GraphServer {
   Status Register(const std::string& registry, const std::string& host,
                   int heartbeat_ms = 2000);
 
+  // Introspection probe (capi ets_plan_debug): one block per plan in
+  // the shared store — id, generation, deterministic flag, per-pass
+  // rewrite counts, and the INSTALLED (optimized) DagToString, with the
+  // verbatim registered form when the optimizer changed it.
+  std::string DebugPlans() const;
+
  private:
   struct Conn {
     std::thread thread;
@@ -471,6 +534,10 @@ class GraphServer {
   // with it — index_ swaps under state_mu_ on delta apply).
   void SnapshotState(std::shared_ptr<const Graph>* g,
                      std::shared_ptr<IndexManager>* idx) const;
+  // Purge the result-reuse window, counting every dropped entry into
+  // reuse_invalidated. Called on EVERY epoch bump — graph delta apply
+  // and ownership-map install — so a stale sample is never served.
+  void InvalidateReuse();
 
   std::shared_ptr<GraphRef> graph_ref_;
   std::shared_ptr<IndexManager> index_;
@@ -488,6 +555,36 @@ class GraphServer {
   // (entries from an older generation answer the counted miss status
   // and the client re-prepares against the new map)
   std::atomic<uint64_t> plan_gen_{1};
+  // Shared per-process plan store (kPrepare): ONE bounded LRU of
+  // decoded plans per server, shared by every connection — a plan
+  // registered on one connection hits from any other, and registrations
+  // survive reconnects (the store outlives connection state). Entries
+  // are immutable once installed (dag.h read-only contract); plan_mu_
+  // covers the map/LRU structure only.
+  mutable std::mutex plan_mu_;
+  std::list<uint64_t> plan_lru_;  // front = most recently used
+  std::unordered_map<uint64_t,
+                     std::pair<std::shared_ptr<const PreparedPlan>,
+                               std::list<uint64_t>::iterator>>
+      plans_;
+  // Bounded deterministic result-reuse window (RpcConfig::reuse_window):
+  // LRU of completed execute results keyed by a 64-bit mix of
+  // (plan id, graph snapshot uid, feed-byte hash); entries carry the
+  // exact feed bytes for a full compare on hit.
+  struct ReuseEntry;
+  mutable std::mutex reuse_mu_;
+  std::list<uint64_t> reuse_lru_;
+  std::unordered_map<uint64_t,
+                     std::pair<std::shared_ptr<const ReuseEntry>,
+                               std::list<uint64_t>::iterator>>
+      reuse_;
+  // Cross-request execute coalescing (RpcConfig::coalesce_window_us):
+  // open batches keyed like the reuse window; a request that finds an
+  // open bucket parks its reply continuation and the bucket leader
+  // answers it from the single shared execution.
+  struct CoalesceBucket;
+  std::mutex coalesce_mu_;
+  std::unordered_map<uint64_t, std::shared_ptr<CoalesceBucket>> coalesce_;
   std::shared_ptr<DeltaWal> wal_;
   bool wal_degraded_ = false;  // wal requested but unopenable: refuse deltas
   // off-path compaction accounting: Stop() drains in-flight tasks

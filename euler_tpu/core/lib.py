@@ -163,9 +163,10 @@ def _declare(lib: ctypes.CDLL) -> None:
         # config + client-edge counters — see euler_tpu.graph.remote
         # configure_rpc() / rpc_transport_stats() for the friendly wrapper
         # (+ prepared plans / plan-cache size / deflate reuse — the
-        # wire-path knobs; stats out buffer is 27 u64s)
+        # wire-path knobs — and the plan-optimizer block: plan_optimize,
+        # coalesce_window_us, reuse_window; stats out buffer is 37 u64s)
         "etg_rpc_config": (None, [i32, i32, i64, i32, i64, i32, i32,
-                                  i32, i32, i32]),
+                                  i32, i32, i32, i32, i64, i32]),
         "etg_rpc_stats": (None, [c_u64p]),
         # elastic fleet: epoch-versioned ownership maps — install on a
         # distribute-mode proxy / in-process server, push to a remote
@@ -226,6 +227,12 @@ def _declare(lib: ctypes.CDLL) -> None:
         "etr_stop": (i32, [i64]),
         "etr_scan": (i64, [ctypes.c_char_p, ctypes.c_char_p, i64]),
         "etq_compile_debug": (i64, [ctypes.c_char_p, i32, i32, ctypes.c_char_p, ctypes.c_char_p, i64]),
+        # explain(): stage 0 = as-registered plan, stage 1 = what the
+        # server's prepare-time optimizer executes (+ rewrite counts,
+        # determinism verdict); ets_plan_debug dumps a live server's
+        # shared prepared-plan store
+        "etq_compile_debug2": (i64, [ctypes.c_char_p, i32, i32, ctypes.c_char_p, i32, ctypes.c_char_p, i64]),
+        "ets_plan_debug": (i64, [i64, ctypes.c_char_p, i64]),
     }
     for name, (restype, argtypes) in sigs.items():
         fn = getattr(lib, name)

@@ -85,9 +85,10 @@ _DTYPE_CODES = {
 class Query:
     """A query proxy bound to a local engine or a remote shard set."""
 
-    def __init__(self, lib, handle: int):
+    def __init__(self, lib, handle: int, mode: str = "local"):
         self._lib = lib
         self._h = handle
+        self._mode = mode  # "local" | "distribute" — explain() renders it
         # guards _h for stats()/close(): a /metrics scrape thread polls
         # stats() via the bind_obs collector while the owner may be
         # close()ing — without the lock that is a use-after-free on the
@@ -111,7 +112,7 @@ class Query:
         h = lib.etq_new_remote(endpoints.encode(), seed, mode.encode())
         if h == 0:
             raise EngineError(lib.etg_last_error().decode())
-        return cls(lib, h)
+        return cls(lib, h, mode=mode)
 
     def run(self, gremlin: str,
             inputs: Optional[Dict[str, np.ndarray]] = None,
@@ -289,6 +290,38 @@ class Query:
         check(self._lib, self._lib.etq_index_dump(self._h,
                                                   directory.encode()))
 
+    def explain(self, gremlin: str) -> str:
+        """Render what this proxy registers for `gremlin` and what a
+        server's prepare-time optimizer turns that registration into:
+        a "-- as registered --" DAG (the proxy's compile mode) followed
+        by a "-- server optimized --" block whose header carries the
+        per-pass rewrite counts (fuse/pushdown/dedup) and the
+        determinism verdict that gates the result-reuse / coalescing
+        fast paths. Distribute-mode note: shards optimize each REMOTE
+        sub-plan they receive, so the local-form optimized block is the
+        per-shard view. Pure client-side compile — nothing executes."""
+        lib = self._lib
+        shard_num = max(int(lib.etq_shard_num(self._h)), 1)
+        mode = self._mode if shard_num > 1 else "local"
+
+        def _probe(stage: int, m: str, n_shards: int) -> str:
+            n = lib.etq_compile_debug2(gremlin.encode(), n_shards,
+                                       n_shards, m.encode(), stage,
+                                       None, 0)
+            if n < 0:
+                raise EngineError(lib.etg_last_error().decode())
+            buf = ctypes.create_string_buffer(int(n) + 1)
+            lib.etq_compile_debug2(gremlin.encode(), n_shards, n_shards,
+                                   m.encode(), stage, buf, n + 1)
+            return buf.value.decode()
+
+        registered = _probe(0, mode, shard_num)
+        # the optimizer runs on the plan a SHARD receives — local form
+        optimized = _probe(1, "local", 1)
+        return ("-- as registered (mode=%s, shards=%d) --\n%s"
+                "-- server optimized --\n%s"
+                % (mode, shard_num, registered, optimized))
+
     def stats(self) -> dict:
         """Per-proxy query counters: queries, errors, total_us, last_us
         (aux parity: engine-side query timing)."""
@@ -385,6 +418,20 @@ class GraphService:
     def map_epoch(self) -> int:
         """Installed ownership-map epoch (0 = none)."""
         return int(self._lib.ets_map_epoch(self._h))
+
+    def plan_debug(self) -> str:
+        """Dump this shard's shared prepared-plan store: one block per
+        registered plan — id, generation, determinism verdict, the
+        prepare-time optimizer's per-pass rewrite counts, the DAG that
+        actually executes, and (when rewritten) the verbatim form the
+        client registered. The server half of Query.explain()."""
+        lib = self._lib
+        n = lib.ets_plan_debug(self._h, None, 0)
+        if n < 0:
+            raise EngineError(lib.etg_last_error().decode())
+        buf = ctypes.create_string_buffer(int(n) + 1)
+        lib.ets_plan_debug(self._h, buf, n + 1)
+        return buf.value.decode()
 
     def stop(self) -> None:
         if self._h:

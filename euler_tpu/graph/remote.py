@@ -132,14 +132,29 @@ _RPC_STAT_KEYS = (
     # client answers by re-preparing); fallbacks is CLIENT-edge — a
     # prepared call that went out as a classic full-plan frame
     "prepared_registered", "prepared_hits", "prepared_misses",
-    "prepared_invalidated", "prepared_fallbacks")
+    "prepared_invalidated", "prepared_fallbacks",
+    # prepare-time plan optimizer + deterministic fast paths (all
+    # SERVER-edge): plan_optimized counts registrations the optimizer
+    # rewrote, plan_rewrites_* the per-pass rewrite totals
+    # (fuse/pushdown/dedup; epoch = per-epoch distribute re-derivations
+    # on a generation-bumped re-registration); coalesced_requests rode a
+    # neighbor's identical execute, coalesce_batches answered > 1
+    # request; reuse_hits/misses/invalidated account the bounded
+    # deterministic result-reuse window (invalidated = entries purged on
+    # a graph-epoch or ownership-map bump — staleness is structurally
+    # impossible, every bump empties the window)
+    "plan_optimized", "plan_rewrites_fuse", "plan_rewrites_pushdown",
+    "plan_rewrites_dedup", "plan_rewrites_epoch", "coalesced_requests",
+    "coalesce_batches", "reuse_hits", "reuse_misses", "reuse_invalidated")
 
 # Last config applied through configure_rpc (the native side has no
 # getter). RemoteGraphEngine reads `mux` to default pool_shared.
 _RPC_CONFIG = {"mux": False, "connections": 1, "compress_threshold": 0,
                "max_inflight": 256, "hedge_delay_ms": 0.0, "p2c": False,
                "hedge_replicas": False, "prepared": False,
-               "plan_cache": 64, "deflate_reuse": True}
+               "plan_cache": 64, "deflate_reuse": True,
+               "plan_optimize": True, "coalesce_window_us": 0,
+               "reuse_window": 0}
 _rpc_mu = threading.Lock()
 _rpc_env_applied = False
 _rpc_obs_done = False
@@ -148,7 +163,8 @@ _rpc_obs_done = False
 def configure_rpc(mux=None, connections=None, compress_threshold=None,
                   max_inflight=None, hedge_delay_ms=None,
                   p2c=None, hedge_replicas=None, prepared=None,
-                  plan_cache=None, deflate_reuse=None) -> dict:
+                  plan_cache=None, deflate_reuse=None, plan_optimize=None,
+                  coalesce_window_us=None, reuse_window=None) -> dict:
     """Set the PROCESS-GLOBAL graph-RPC transport knobs; returns the
     resulting config. None leaves a knob unchanged. Applies to engines
     (native channels) built AFTER the call — except hedge_delay_ms and
@@ -191,7 +207,21 @@ def configure_rpc(mux=None, connections=None, compress_threshold=None,
       sends). plan_cache: server-side per-connection LRU bound on
       decoded plans. deflate_reuse: reuse one zlib deflate state per
       connection writer (deflateReset per frame, identical bytes)
-      instead of a per-frame init; off restores compress2 for A/B."""
+      instead of a per-frame init; off restores compress2 for A/B.
+    plan_optimize: run the server's prepare-time plan optimizer on every
+      kPrepare registration (sub-plan dedup, filter/post-process
+      pushdown, whole-plan fusion) — the optimized form executes, the
+      wire and the results are byte-identical (default ON; off keeps
+      the registered plan verbatim for A/B). coalesce_window_us: > 0
+      lets a DETERMINISTIC prepared execute wait up to this long for
+      identical requests (same plan id, graph snapshot and feed bytes,
+      across connections) and answers them all from ONE execution
+      (coalesced_requests / coalesce_batches). reuse_window: > 0 keeps
+      that many deterministic results server-side keyed (plan, graph
+      uid, feed bytes) — an identical request inside the window skips
+      decode AND execute entirely (reuse_hits); every graph-epoch or
+      ownership bump purges the window (reuse_invalidated), so a stale
+      reply is impossible. Both default 0 = off, byte-identical wire."""
     from euler_tpu.core import lib as _lib
 
     lib = _lib.load()
@@ -217,6 +247,13 @@ def configure_rpc(mux=None, connections=None, compress_threshold=None,
             _RPC_CONFIG["plan_cache"] = max(int(plan_cache), 1)
         if deflate_reuse is not None:
             _RPC_CONFIG["deflate_reuse"] = bool(deflate_reuse)
+        if plan_optimize is not None:
+            _RPC_CONFIG["plan_optimize"] = bool(plan_optimize)
+        if coalesce_window_us is not None:
+            _RPC_CONFIG["coalesce_window_us"] = max(
+                int(coalesce_window_us), 0)
+        if reuse_window is not None:
+            _RPC_CONFIG["reuse_window"] = max(int(reuse_window), 0)
         lib.etg_rpc_config(
             -1 if mux is None else int(bool(mux)),
             0 if connections is None else max(int(connections), 1),
@@ -229,7 +266,11 @@ def configure_rpc(mux=None, connections=None, compress_threshold=None,
             -1 if hedge_replicas is None else int(bool(hedge_replicas)),
             -1 if prepared is None else int(bool(prepared)),
             0 if plan_cache is None else max(int(plan_cache), 1),
-            -1 if deflate_reuse is None else int(bool(deflate_reuse)))
+            -1 if deflate_reuse is None else int(bool(deflate_reuse)),
+            -1 if plan_optimize is None else int(bool(plan_optimize)),
+            -1 if coalesce_window_us is None else max(
+                int(coalesce_window_us), 0),
+            -1 if reuse_window is None else max(int(reuse_window), 0))
         return dict(_RPC_CONFIG)
 
 
@@ -268,6 +309,14 @@ def configure_rpc_from_env() -> dict:
     if os.environ.get("EULER_TPU_RPC_DEFLATE_REUSE"):
         kw["deflate_reuse"] = os.environ[
             "EULER_TPU_RPC_DEFLATE_REUSE"] not in ("0", "")
+    if os.environ.get("EULER_TPU_RPC_PLAN_OPTIMIZE"):
+        kw["plan_optimize"] = os.environ[
+            "EULER_TPU_RPC_PLAN_OPTIMIZE"] not in ("0", "")
+    if os.environ.get("EULER_TPU_RPC_COALESCE_US"):
+        kw["coalesce_window_us"] = int(
+            os.environ["EULER_TPU_RPC_COALESCE_US"])
+    if os.environ.get("EULER_TPU_RPC_REUSE_WINDOW"):
+        kw["reuse_window"] = int(os.environ["EULER_TPU_RPC_REUSE_WINDOW"])
     # apply BEFORE publishing the applied flag: a concurrently
     # constructing engine must never observe applied=True while the env
     # config has not reached the native side yet (it would build its

@@ -43,7 +43,7 @@ def _args(tmp_path, **over):
         nodes=280, dim=12, train_steps=2, load_s=6.0, rps=30.0,
         threads=3, mix_knn=0.6, q=6, k=8, inject_ms=2.0,
         slo_p99_ms=500.0, slo_p999_ms=2000.0, slo_shed_rate=0.05,
-        graph_decode_p99_ms=50.0,
+        graph_decode_p99_ms=50.0, graph_execute_p99_ms=250.0,
         degraded_budget=0, recovery_bound_s=45.0, chaos=True,
         full=False, out=str(tmp_path / "accept_out"), record=False)
     for k, v in over.items():
@@ -72,6 +72,10 @@ def test_accept_smoke_passes_and_artifact_is_valid(tmp_path):
     # native histogram) and sits under its bound
     dec = on_disk["gates"]["graph_decode_p99_ms"]
     assert dec["ok"] and not dec.get("skipped") and dec["value"] >= 0
+    # the schema-v3 plan-optimizer-era gate: the execute-phase p99 was
+    # measured off the same always-on histogram and sits under bound
+    exe = on_disk["gates"]["graph_execute_p99_ms"]
+    assert exe["ok"] and not exe.get("skipped") and exe["value"] >= 0
 
     # cross-process observability: ≥1 trace id appears on BOTH sides
     # of the wire, a hedged pair of server spans shares one client

@@ -1,0 +1,363 @@
+"""Prepare-time plan optimizer + execute coalescing + result reuse
+(ISSUE 16 tentpole).
+
+Python-level coverage against REAL shard servers (the per-pass golden
+rewrites and the native fast-path mechanics are pinned in
+engine_test.cc — TestPlanOptimizerPasses / TestExecuteReuseAndCoalesce):
+
+  * knob-off identity — with plan_optimize / coalesce_window_us /
+    reuse_window all off, per-call wire bytes stay deterministic and
+    every optimizer/fast-path counter is frozen at zero (the PR-14
+    wire, untouched);
+  * optimizer parity — graph_partition mode ships multi-node sub-plans,
+    the server's kPrepare optimizer fuses them (counted plan_optimized
+    / plan_rewrites_fuse) and every deterministic verb answers
+    byte-identically to the optimizer-off reference;
+  * shared plan store — one store entry per plan per SERVER (not per
+    connection): a second connection re-preparing the same plan leaves
+    one plan_debug block;
+  * result reuse — identical deterministic prepared executes inside the
+    window answer from the server cache (reuse_hits), and both the
+    streaming-delta epoch bump and an ownership flip purge the window
+    (reuse_invalidated > 0) with ZERO stale replies;
+  * coalescing — concurrent identical deterministic executes inside the
+    window share one execution (coalesced_requests / coalesce_batches)
+    with byte-identical fan-out;
+  * explain — Query.explain() renders the as-registered and
+    server-optimized forms; GraphService.plan_debug() dumps the live
+    store with rewrite counts and determinism verdicts.
+
+The transport config is process-global (configure_rpc) — the autouse
+fixture restores defaults so no other test file runs on leaked knobs.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from euler_tpu.graph import (
+    GraphBuilder,
+    configure_rpc,
+    rpc_transport_stats,
+    seed,
+)
+
+pytestmark = pytest.mark.plan_opt
+
+OPT_KEYS = ("plan_optimized", "plan_rewrites_fuse",
+            "plan_rewrites_pushdown", "plan_rewrites_dedup",
+            "plan_rewrites_epoch", "coalesced_requests",
+            "coalesce_batches", "reuse_hits", "reuse_misses",
+            "reuse_invalidated")
+
+
+@pytest.fixture(autouse=True)
+def _restore_rpc_config():
+    yield
+    configure_rpc(mux=False, connections=1, compress_threshold=0,
+                  max_inflight=256, hedge_delay_ms=0.0, p2c=False,
+                  prepared=False, plan_cache=64, deflate_reuse=True,
+                  plan_optimize=True, coalesce_window_us=0,
+                  reuse_window=0)
+
+
+def _graph(tmp_path, n=64):
+    seed(7)
+    rng = np.random.default_rng(5)
+    b = GraphBuilder()
+    b.set_num_types(2, 2)
+    b.set_feature(0, 0, 1, "price")
+    ids = np.arange(1, n + 1, dtype=np.uint64)
+    b.add_nodes(ids, types=(ids % 2).astype(np.int32),
+                weights=np.ones(n, np.float32))
+    src = np.concatenate([ids, ids])
+    dst = np.concatenate([np.roll(ids, -1), np.roll(ids, -7)])
+    b.add_edges(src, dst,
+                types=(np.arange(2 * n) % 2).astype(np.int32),
+                weights=(rng.random(2 * n) + 0.25).astype(np.float32))
+    b.set_node_dense(ids, 0,
+                     (rng.random((n, 1)) * 10).astype(np.float32))
+    d = str(tmp_path / "g")
+    b.finalize().dump(d, num_partitions=2)
+    return d, ids
+
+
+def _cluster(data_dir, shards=2):
+    from euler_tpu.gql import start_service
+
+    servers = [start_service(data_dir, shard_idx=i, shard_num=shards,
+                             port=0) for i in range(shards)]
+    eps = "hosts:" + ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    return servers, eps
+
+
+def _delta(s0, s1):
+    return {k: s1[k] - s0[k] for k in OPT_KEYS}
+
+
+QDET = "v(roots).getNB(*).as(nb)"           # deterministic, single hop
+QGATHER = "v(roots).getNB(*).values(price).as(p)"  # two-hop gather
+
+
+def _run(q, gremlin, roots):
+    return {k: v.tobytes() for k, v in q.run(gremlin,
+                                             {"roots": roots}).items()}
+
+
+# ---------------------------------------------------------------------------
+# knob-off identity (the PR-14 wire, untouched)
+# ---------------------------------------------------------------------------
+
+def test_knobs_off_wire_identical_and_counters_frozen(tmp_path):
+    from euler_tpu.gql import Query
+
+    d, ids = _graph(tmp_path)
+    servers, eps = _cluster(d)
+    try:
+        configure_rpc(mux=True, connections=1, prepared=True,
+                      plan_optimize=False, coalesce_window_us=0,
+                      reuse_window=0)
+        q = Query.remote(eps, seed=1)
+        roots = ids[:16]
+        ref = _run(q, QDET, roots)
+
+        def call_bytes():
+            s0 = rpc_transport_stats()
+            out = _run(q, QDET, roots)
+            s1 = rpc_transport_stats()
+            assert out == ref
+            return (s1["bytes_sent"] - s0["bytes_sent"], _delta(s0, s1))
+
+        b1, d1 = call_bytes()
+        b2, d2 = call_bytes()
+        assert b1 == b2  # deterministic wire size, nothing stamped
+        assert d1 == d2 == {k: 0 for k in OPT_KEYS}
+        q.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# optimizer parity + accounting (graph_partition ships multi-node plans)
+# ---------------------------------------------------------------------------
+
+def test_optimizer_rewrites_counted_and_byte_parity(tmp_path):
+    from euler_tpu.gql import Query
+
+    d, ids = _graph(tmp_path)
+    servers, eps = _cluster(d)
+    try:
+        roots = ids[:8]
+        # optimizer-off references, per deterministic verb
+        configure_rpc(mux=True, connections=1, prepared=True,
+                      plan_optimize=False)
+        q0 = Query.remote(eps, seed=1, mode="graph_partition")
+        refs = {g: _run(q0, g, roots) for g in (QDET, QGATHER)}
+        q0.close()
+
+        configure_rpc(plan_optimize=True)
+        s0 = rpc_transport_stats()
+        q = Query.remote(eps, seed=1, mode="graph_partition")
+        for g, ref in refs.items():
+            assert _run(q, g, roots) == ref  # byte parity
+        s1 = rpc_transport_stats()
+        delta = _delta(s0, s1)
+        # gp sub-plans are (ownership filter, op) pairs — fused at
+        # registration, every registration counted
+        assert delta["plan_optimized"] >= 1
+        assert delta["plan_rewrites_fuse"] >= 2
+        # the store dump names the rewrite and keeps the verbatim form
+        dump = servers[0].plan_debug()
+        assert "optimized=1" in dump
+        assert "FUSED" in dump
+        assert "as registered (pre-optimize)" in dump
+        q.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# shared per-process plan store
+# ---------------------------------------------------------------------------
+
+def test_shared_plan_store_one_entry_across_connections(tmp_path):
+    from euler_tpu.gql import Query
+
+    d, ids = _graph(tmp_path)
+    servers, eps = _cluster(d, shards=1)
+    try:
+        roots = ids[:16]
+        configure_rpc(mux=True, connections=2, prepared=True,
+                      hedge_delay_ms=0.01)  # race both connections
+        q = Query.remote(eps, seed=1)
+        ref = _run(q, QDET, roots)
+        for _ in range(6):
+            assert _run(q, QDET, roots) == ref
+        configure_rpc(hedge_delay_ms=0.0)
+        # both connections prepared the plan — the SERVER holds one
+        # entry (the second registration refreshed, not duplicated)
+        dump = servers[0].plan_debug()
+        assert dump.count("\nplan ") + dump.startswith("plan ") == 1
+        q.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# result reuse: hits, then counted invalidation on every epoch bump
+# ---------------------------------------------------------------------------
+
+def test_reuse_hits_and_epoch_bump_drill(tmp_path):
+    from euler_tpu.gql import Query
+
+    d, ids = _graph(tmp_path)
+    servers, eps = _cluster(d)
+    try:
+        roots = ids[:16]
+        configure_rpc(mux=True, connections=1, prepared=True,
+                      reuse_window=64)
+        q = Query.remote(eps, seed=1)
+        ref = _run(q, QDET, roots)  # cold: registers + installs
+        s0 = rpc_transport_stats()
+        for _ in range(4):
+            assert _run(q, QDET, roots) == ref
+        s1 = rpc_transport_stats()
+        warm = _delta(s0, s1)
+        assert warm["reuse_hits"] >= 8  # 2 shards x 4 calls
+        assert warm["reuse_invalidated"] == 0
+
+        # epoch drill 1 — streaming delta: new edge 1->5 changes the
+        # answer; the bump must purge the window, the next call must
+        # see the NEW graph (zero stale), then reuse resumes
+        s2 = rpc_transport_stats()
+        q.apply_delta(np.array([1], np.uint64), np.array([0], np.int32),
+                      np.array([2.0], np.float32),
+                      np.array([1], np.uint64), np.array([5], np.uint64),
+                      np.array([0], np.int32),
+                      np.array([9.9], np.float32))
+        fresh = _run(q, QDET, roots)
+        s3 = rpc_transport_stats()
+        drill = _delta(s2, s3)
+        assert drill["reuse_invalidated"] >= 1
+        assert fresh != ref  # the delta is visible — no stale reply
+        s4 = rpc_transport_stats()
+        assert _run(q, QDET, roots) == fresh
+        s5 = rpc_transport_stats()
+        assert _delta(s4, s5)["reuse_hits"] >= 2
+
+        # epoch drill 2 — ownership flip purges the window too
+        s6 = rpc_transport_stats()
+        for s in servers:
+            s.set_ownership("e1-P2-0.1")
+        assert _run(q, QDET, roots) == fresh
+        s7 = rpc_transport_stats()
+        assert _delta(s6, s7)["reuse_invalidated"] >= 1
+        q.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# cross-request coalescing
+# ---------------------------------------------------------------------------
+
+def test_coalescing_shares_one_execution(tmp_path):
+    from euler_tpu.gql import Query
+
+    d, ids = _graph(tmp_path)
+    servers, eps = _cluster(d)
+    try:
+        roots = ids[:16]
+        configure_rpc(mux=True, connections=1, prepared=True)
+        q = Query.remote(eps, seed=1)
+        ref = _run(q, QDET, roots)  # register outside the window
+
+        configure_rpc(coalesce_window_us=5000)
+        s0 = rpc_transport_stats()
+        errs = []
+
+        def worker():
+            try:
+                if _run(q, QDET, roots) != ref:
+                    errs.append("parity")
+            except Exception as e:  # pragma: no cover - surfaced below
+                errs.append(repr(e))
+
+        ts = [threading.Thread(target=worker) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        s1 = rpc_transport_stats()
+        configure_rpc(coalesce_window_us=0)
+        assert not errs
+        delta = _delta(s0, s1)
+        assert delta["coalesced_requests"] >= 1
+        assert delta["coalesce_batches"] >= 1
+        q.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-epoch distribute re-derivation (gen-bumped re-registration)
+# ---------------------------------------------------------------------------
+
+def test_epoch_rederive_counted_on_ownership_flip(tmp_path):
+    from euler_tpu.gql import Query
+
+    d, ids = _graph(tmp_path)
+    servers, eps = _cluster(d)
+    try:
+        roots = ids[:16]
+        configure_rpc(mux=True, connections=1, prepared=True)
+        q = Query.remote(eps, seed=1)
+        ref = _run(q, QDET, roots)  # registers under gen 0
+        for s in servers:
+            s.set_ownership("e1-P2-0.1")  # gen bump, routing unchanged
+        s0 = rpc_transport_stats()
+        assert _run(q, QDET, roots) == ref  # miss -> re-prepare
+        s1 = rpc_transport_stats()
+        # the re-registration under the new generation is the counted
+        # per-epoch re-derivation of the plan's distribute rewrite
+        assert _delta(s0, s1)["plan_rewrites_epoch"] >= 1
+        q.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# explain surfaces
+# ---------------------------------------------------------------------------
+
+def test_explain_and_plan_debug_render(tmp_path):
+    from euler_tpu.gql import Query
+
+    d, ids = _graph(tmp_path)
+    servers, eps = _cluster(d)
+    try:
+        configure_rpc(mux=True, connections=1, prepared=True)
+        q = Query.remote(eps, seed=1)
+        text = q.explain(QDET)
+        assert "-- as registered (mode=distribute, shards=2) --" in text
+        assert "-- server optimized --" in text
+        assert "deterministic=1" in text
+        # a sampling chain is flagged non-reusable
+        text2 = q.explain("v(roots).sampleNB(0, 4, -1).as(nb)")
+        assert "deterministic=0" in text2
+        # nothing registered yet -> empty store; after a run the store
+        # dumps the plan with its generation + determinism verdict
+        _run(q, QDET, ids[:8])
+        dump = servers[0].plan_debug()
+        assert "gen=" in dump and "deterministic=1" in dump
+        q.close()
+    finally:
+        for s in servers:
+            s.stop()

@@ -73,7 +73,11 @@ PERF_JSON = Path(__file__).resolve().parents[1] / "perf.json"
 # v2: + the graph decode-phase p99 gate (graph_decode_p99_ms) read off
 # the native server phase histograms — wire-path regressions (a plan
 # re-decoded per request, a decoder slowdown) now fail acceptance.
-SCHEMA_VERSION = 2
+# v3: + the graph execute-phase p99 gate (graph_execute_p99_ms), the
+# plan-optimizer-era ruler — a regression that re-inflates per-request
+# execution (an optimizer pass gone wrong, a reuse/coalesce stall on
+# the fast path) fails acceptance the same counted way.
+SCHEMA_VERSION = 3
 
 # ---------------------------------------------------------------------------
 # accept.json schema (validated by the tier-1 smoke so the artifact
@@ -86,7 +90,8 @@ _TOP_KEYS = {
 }
 _GATE_KEYS = ("p99_ms", "p999_ms", "shed_rate", "lost_without_status",
               "stale_reads", "degraded_steps", "recovery_s",
-              "trace_stitched", "graph_decode_p99_ms")
+              "trace_stitched", "graph_decode_p99_ms",
+              "graph_execute_p99_ms")
 
 
 def validate_accept(obj) -> list:
@@ -827,6 +832,20 @@ def _run_accept_body(args, out_dir, td, phases, chaos, t0,
         gates["graph_decode_p99_ms"] = {
             "value": None, "gate": args.graph_decode_p99_ms,
             "ok": True, "skipped": True}
+    # execute-phase p99 off the same always-on histogram (schema v3):
+    # the plan-optimizer-era tripwire — a kPrepare rewrite pass that
+    # pessimizes plans, or a coalesce/reuse stall on the execute fast
+    # path, lands HERE before it shows anywhere else.
+    exec_p99 = _gql.server_phase_quantile("execute", "execute", 0.99)
+    if exec_p99 is not None:
+        gates["graph_execute_p99_ms"] = {
+            "value": round(exec_p99, 4),
+            "gate": args.graph_execute_p99_ms,
+            "ok": exec_p99 <= args.graph_execute_p99_ms}
+    else:
+        gates["graph_execute_p99_ms"] = {
+            "value": None, "gate": args.graph_execute_p99_ms,
+            "ok": True, "skipped": True}
 
     result = {
         "schema_version": SCHEMA_VERSION,
@@ -910,6 +929,11 @@ def main(argv=None) -> int:
     ap.add_argument("--graph_decode_p99_ms", type=float, default=50.0,
                     help="gate on the graph-tier kExecute decode-phase "
                          "p99 (native histogram, ms) — the wire-path "
+                         "regression tripwire")
+    ap.add_argument("--graph_execute_p99_ms", type=float, default=250.0,
+                    help="gate on the graph-tier kExecute execute-phase "
+                         "p99 (native histogram, ms) — the "
+                         "plan-optimizer / execute-fast-path "
                          "regression tripwire")
     ap.add_argument("--slo_shed_rate", type=float, default=0.05)
     ap.add_argument("--degraded_budget", type=int, default=0)
