@@ -26,6 +26,7 @@
 #include "io.h"
 #include "ops.h"
 #include "rpc.h"
+#include "store.h"
 #include "threadpool.h"
 #include "udf.h"
 
@@ -299,6 +300,37 @@ int etg_dump(int64_t h, const char* dir, int num_partitions, int by_graph) {
                                           by_graph != 0);
   return s.ok() ? 0 : Fail(s.message());
 }
+
+// ---- out-of-core columnar store (store.h) ----
+// Serialize handle h's CURRENT snapshot into a columnar store file at
+// `path` (atomic tmp+rename). The file is byte-parity with the graph's
+// in-memory arrays — attaching it reproduces every sampler draw.
+int etg_store_write(int64_t h, const char* path) {
+  auto g = GetGraph(h);
+  if (!g) return Fail("bad graph handle");
+  et::Status s = et::WriteColumnarStore(*g, path ? path : "");
+  return s.ok() ? 0 : Fail(s.message());
+}
+
+// mmap a columnar store and register the attached graph as a handle.
+// hot_bytes = hub-pinned hot-set budget (0 = accounting only, nothing
+// pinned). -1 on error.
+int64_t etg_store_open(const char* path, int64_t hot_bytes) {
+  std::unique_ptr<et::Graph> g;
+  et::Status s = et::LoadGraphFromStore(path ? path : "", hot_bytes, &g);
+  if (!s.ok()) {
+    Fail(s.message());
+    return -1;
+  }
+  return RegisterGraph(std::shared_ptr<const et::Graph>(std::move(g)));
+}
+
+// Process-global out-of-core counters (store.h slot order):
+// 0 hot_hits | 1 cold_reads | 2 page_in | 3 page_out | 4 resident_bytes
+// | 5 mapped_bytes | 6 hot_pinned_bytes | 7 attaches | 8 cold_n
+// | 9 cold_sum_us | 10..34 cold-read log2-µs bucket counts (1µs..2^23µs
+// + overflow, the trace-hist convention). Polls mincore residency.
+void etg_store_stats(uint64_t* out) { et::StoreStatsSnapshot(out); }
 
 int etg_free(int64_t h) {
   auto& r = Reg();

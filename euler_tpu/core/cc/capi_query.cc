@@ -21,6 +21,7 @@
 #include "io.h"
 #include "query_proxy.h"
 #include "rpc.h"
+#include "store.h"
 #include "tensor.h"
 
 namespace {
@@ -367,10 +368,17 @@ int etq_exec_free(int64_t h) {
 // delta log — restart recovers snapshot+WAL to the pre-crash epoch,
 // then (catchup != 0 and a registry given) closes any remaining gap via
 // peer kGetDeltaLog anti-entropy BEFORE registering for traffic.
-int64_t ets_start2(const char* data_dir, int shard_idx, int shard_num,
-                   int port, const char* registry_dir, const char* host,
-                   const char* index_spec, const char* wal_dir,
-                   int fsync_policy, int64_t compact_bytes, int catchup) {
+// Shared implementation behind ets_start2/ets_start3. storage: 0 = heap
+// (unchanged), 1 = mmap out-of-core tier (store.h) with `hot_bytes` of
+// hub-pinned hot set — the graph serves from a mapped columnar store
+// and WAL compactions re-attach fresh generations.
+static int64_t StartShardService(const char* data_dir, int shard_idx,
+                                 int shard_num, int port,
+                                 const char* registry_dir, const char* host,
+                                 const char* index_spec, const char* wal_dir,
+                                 int fsync_policy, int64_t compact_bytes,
+                                 int catchup, int storage,
+                                 int64_t hot_bytes) {
   const bool durable = wal_dir != nullptr && wal_dir[0] != '\0';
   std::unique_ptr<et::Graph> g;
   std::unique_ptr<et::DeltaWal> wal;
@@ -383,7 +391,8 @@ int64_t ets_start2(const char* data_dir, int shard_idx, int shard_num,
     uint64_t replayed = 0;
     s = et::RecoverShard(wal_dir, data_dir, shard_idx, shard_num,
                          /*build_in_adjacency=*/true, &g, &replayed,
-                         &wal_records, &wal_gap, &recovered_map);
+                         &wal_records, &wal_gap, &recovered_map, storage,
+                         hot_bytes);
     if (!s.ok()) {
       FailWith(s.message());
       return 0;
@@ -402,12 +411,44 @@ int64_t ets_start2(const char* data_dir, int shard_idx, int shard_num,
                      << ws.message() << "): deltas will be refused";
     }
   } else {
-    s = et::LoadShard(data_dir, shard_idx, shard_num,
-                      /*data_type=*/0,
-                      /*build_in_adjacency=*/true, &g);
-    if (!s.ok()) {
-      FailWith(s.message());
-      return 0;
+    // Non-durable + mmap: attach the data dir's columnar sidecar when
+    // one exists; otherwise load once on the heap, spill the sidecar
+    // beside the partition files (so the NEXT start attaches directly),
+    // and re-attach. Any failure degrades to the heap path.
+    std::string sidecar;
+    if (storage == 1)
+      sidecar = std::string(data_dir ? data_dir : "") + "/" +
+                et::kColumnarFileName;
+    if (storage == 1 && sidecar.size() > 1) {
+      et::Status as = et::LoadGraphFromStore(sidecar, hot_bytes, &g);
+      if (!as.ok()) {
+        g.reset();
+        s = et::LoadShard(data_dir, shard_idx, shard_num,
+                          /*data_type=*/0,
+                          /*build_in_adjacency=*/true, &g);
+        if (!s.ok()) {
+          FailWith(s.message());
+          return 0;
+        }
+        as = et::WriteColumnarStore(*g, sidecar);
+        if (as.ok()) {
+          std::unique_ptr<et::Graph> attached;
+          as = et::LoadGraphFromStore(sidecar, hot_bytes, &attached);
+          if (as.ok()) g = std::move(attached);
+        }
+        if (!as.ok())
+          ET_LOG_WARNING << "shard " << shard_idx
+                         << " columnar attach failed (" << as.message()
+                         << "): serving from heap";
+      }
+    } else {
+      s = et::LoadShard(data_dir, shard_idx, shard_num,
+                        /*data_type=*/0,
+                        /*build_in_adjacency=*/true, &g);
+      if (!s.ok()) {
+        FailWith(s.message());
+        return 0;
+      }
     }
   }
   std::shared_ptr<const et::Graph> graph(std::move(g));
@@ -427,6 +468,7 @@ int64_t ets_start2(const char* data_dir, int shard_idx, int shard_num,
   // spec retained so kApplyDelta can rebuild the index on the new
   // snapshot (a server with an index but no spec refuses deltas)
   server->set_index_spec(index_spec != nullptr ? index_spec : "");
+  if (storage != 0) server->set_storage(storage, hot_bytes);
   if (durable) {
     server->set_wal(std::shared_ptr<et::DeltaWal>(std::move(wal)),
                     wal_degraded);
@@ -477,6 +519,29 @@ int64_t ets_start2(const char* data_dir, int shard_idx, int shard_num,
   r.servers[h] = server;
   r.server_graphs[h] = graph_ref;
   return h;
+}
+
+int64_t ets_start2(const char* data_dir, int shard_idx, int shard_num,
+                   int port, const char* registry_dir, const char* host,
+                   const char* index_spec, const char* wal_dir,
+                   int fsync_policy, int64_t compact_bytes, int catchup) {
+  return StartShardService(data_dir, shard_idx, shard_num, port,
+                           registry_dir, host, index_spec, wal_dir,
+                           fsync_policy, compact_bytes, catchup,
+                           /*storage=*/0, /*hot_bytes=*/0);
+}
+
+// ets_start2 + out-of-core storage selection: storage 0 = heap,
+// 1 = mmap columnar tier with a `hot_bytes` hub-pinned hot set.
+int64_t ets_start3(const char* data_dir, int shard_idx, int shard_num,
+                   int port, const char* registry_dir, const char* host,
+                   const char* index_spec, const char* wal_dir,
+                   int fsync_policy, int64_t compact_bytes, int catchup,
+                   int storage, int64_t hot_bytes) {
+  return StartShardService(data_dir, shard_idx, shard_num, port,
+                           registry_dir, host, index_spec, wal_dir,
+                           fsync_policy, compact_bytes, catchup, storage,
+                           hot_bytes);
 }
 
 int64_t ets_start(const char* data_dir, int shard_idx, int shard_num,

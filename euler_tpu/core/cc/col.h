@@ -1,0 +1,134 @@
+// Col<T> — an owning-or-external column view, the storage seam under the
+// SoA graph store.
+//
+// The graph's big arrays (CSR adjacency, feature matrices, alias tables)
+// historically were std::vectors: always heap-resident, so a shard could
+// never serve a graph bigger than RAM. Col<T> keeps the exact vector
+// surface the build path uses (resize/assign/push_back/operator[]) while
+// adding ONE new mode: AttachExternal(ptr, n) points the column at
+// read-only memory owned by someone else — in practice an mmap'd
+// columnar store file (store.h) — and frees the heap copy. Reads are
+// identical in both modes (ptr_/n_ are kept in sync by every mutator),
+// so the sampling/feature accessors run byte-for-byte the same whether
+// the bytes live on the heap or in the page cache.
+//
+// Contract:
+//   * const access (operator[], data(), begin()/end(), back()) works in
+//     both modes and is branch-free — one pointer indirection, same as
+//     std::vector.
+//   * mutators (resize/assign/push_back/clear/non-const operator[]/
+//     non-const data()) are OWNING-mode only; calling one on an attached
+//     column silently detaches it into an empty owning column first
+//     (mutating an mmap'd base is a logic error the build path never
+//     performs; Finalize always starts from fresh owning columns).
+//   * copying an owning column copies the heap vector; copying an
+//     attached column copies the (ptr, n) view — both keep reads valid
+//     as long as the backing store outlives the copy (Graph holds a
+//     shared_ptr to its ColumnarStore for exactly this reason).
+#ifndef EULER_TPU_COL_H_
+#define EULER_TPU_COL_H_
+
+#include <cstddef>
+#include <vector>
+
+namespace et {
+
+template <typename T>
+class Col {
+ public:
+  using value_type = T;
+
+  Col() = default;
+  Col(const Col& o) { *this = o; }
+  Col(Col&& o) noexcept { *this = static_cast<Col&&>(o); }
+  Col& operator=(const Col& o) {
+    if (this == &o) return *this;
+    if (o.external_) {
+      own_.clear();
+      own_.shrink_to_fit();
+      ptr_ = o.ptr_;
+      n_ = o.n_;
+      external_ = true;
+    } else {
+      own_ = o.own_;
+      Refresh();
+    }
+    return *this;
+  }
+  Col& operator=(Col&& o) noexcept {
+    if (this == &o) return *this;
+    if (o.external_) {
+      own_.clear();
+      ptr_ = o.ptr_;
+      n_ = o.n_;
+      external_ = true;
+    } else {
+      own_ = std::move(o.own_);
+      Refresh();
+    }
+    return *this;
+  }
+
+  // ---- reads (both modes) ----
+  const T& operator[](size_t i) const { return ptr_[i]; }
+  const T* data() const { return ptr_; }
+  const T* begin() const { return ptr_; }
+  const T* end() const { return ptr_ + n_; }
+  size_t size() const { return n_; }
+  bool empty() const { return n_ == 0; }
+  const T& back() const { return ptr_[n_ - 1]; }
+  bool external() const { return external_; }
+
+  // ---- owning-mode mutators (vector-compatible surface) ----
+  T& operator[](size_t i) { return Own()[i]; }
+  T* data() { return Own().data(); }
+  T* begin() { return Own().data(); }
+  T* end() { T* p = Own().data(); return p + own_.size(); }
+  void resize(size_t n) { Own().resize(n); Refresh(); }
+  void resize(size_t n, const T& v) { Own().resize(n, v); Refresh(); }
+  void assign(size_t n, const T& v) { Own().assign(n, v); Refresh(); }
+  template <typename It>
+  void assign(It first, It last) { Own().assign(first, last); Refresh(); }
+  void push_back(const T& v) { Own().push_back(v); Refresh(); }
+  void reserve(size_t n) { Own().reserve(n); Refresh(); }
+  void clear() { Own().clear(); Refresh(); }
+  void shrink_to_fit() { Own().shrink_to_fit(); Refresh(); }
+  // Move a prepared vector in without copying.
+  void adopt(std::vector<T>&& v) { own_ = std::move(v); Refresh(); }
+
+  // ---- external mode ----
+  // Point the column at `n` elements of externally owned, read-only
+  // memory (an mmap'd store column) and free the heap copy. The backing
+  // memory must outlive every read.
+  void AttachExternal(const T* p, size_t n) {
+    own_.clear();
+    own_.shrink_to_fit();
+    ptr_ = p;
+    n_ = n;
+    external_ = true;
+  }
+
+ private:
+  std::vector<T>& Own() {
+    if (external_) {  // mutating an attached column detaches it (empty)
+      ptr_ = nullptr;
+      n_ = 0;
+      external_ = false;
+    }
+    return own_;
+  }
+  void Refresh() {
+    ptr_ = own_.data();
+    n_ = own_.size();
+    external_ = false;
+  }
+
+  std::vector<T> own_;
+  const T* ptr_ = nullptr;
+  size_t n_ = 0;
+  bool external_ = false;
+};
+
+}  // namespace et
+
+#endif  // EULER_TPU_COL_H_

@@ -30,9 +30,11 @@
 #include "rpc.h"
 #include "sampling.h"
 #include "serde.h"
+#include "store.h"
 #include "tensor.h"
 #include "threadpool.h"
 #include "udf.h"
+#include "wal.h"
 
 namespace et {
 namespace {
@@ -396,6 +398,215 @@ void TestDumpLoadRoundtrip() {
   CHECK_OK(LoadShard(dir, 0, 1, 0, true, &back));
   CHECK_TRUE(back->node_count() == 10);
   CHECK_TRUE(back->edge_count() == 10);
+}
+
+// ---- out-of-core columnar store ----
+// One hub (node 1, degree 63) plus a sparse tail, two node/edge types,
+// every feature kind — exercises each column family the store
+// serializes and gives the hub-first hot-set chooser a clear winner.
+std::unique_ptr<Graph> OutcoreGraph() {
+  GraphBuilder b;
+  for (uint64_t i = 1; i <= 64; ++i)
+    b.AddNode(i, static_cast<int32_t>(i % 2), static_cast<float>(i));
+  for (uint64_t i = 2; i <= 64; ++i)
+    b.AddEdge(1, i, 0, static_cast<float>(i));
+  for (uint64_t i = 2; i <= 64; ++i) b.AddEdge(i, i % 64 + 1, 1, 1.0f);
+  b.mutable_meta()->node_features.push_back({"d", FeatureKind::kDense, 4});
+  b.mutable_meta()->node_features.push_back({"s", FeatureKind::kSparse, 0});
+  b.mutable_meta()->node_features.push_back({"b", FeatureKind::kBinary, 0});
+  b.mutable_meta()->edge_features.push_back({"ed", FeatureKind::kDense, 2});
+  for (uint64_t i = 1; i <= 64; ++i) {
+    float v[4];
+    for (int k = 0; k < 4; ++k) v[k] = static_cast<float>(i * 10 + k);
+    b.SetNodeDense(i, 0, v, 4);
+    uint64_t sp[2] = {i, i * 7};
+    b.SetNodeSparse(i, 1, sp, 2);
+    std::string bytes = "blob_" + std::to_string(i);
+    b.SetNodeBinary(i, 2, bytes.data(), static_cast<int64_t>(bytes.size()));
+  }
+  for (uint64_t i = 2; i <= 64; ++i) {
+    float ev[2] = {static_cast<float>(i), static_cast<float>(-2.0 * i)};
+    b.SetEdgeDense(1, i, 0, 0, ev, 2);
+  }
+  return b.Finalize();
+}
+
+// Full-read parity between two graphs: adjacency (both directions),
+// every feature kind, and seeded sampler draws. The store's contract is
+// byte-identity with its heap twin, so equality here is exact.
+void CheckGraphParity(const Graph& a, const Graph& b) {
+  CHECK_TRUE(a.node_count() == b.node_count());
+  CHECK_TRUE(a.edge_count() == b.edge_count());
+  CHECK_TRUE(a.epoch() == b.epoch());
+  for (uint64_t id = 1; id <= a.node_count() + 1; ++id) {
+    std::vector<NodeId> ia, ib;
+    std::vector<float> wa, wb;
+    std::vector<int32_t> ta, tb;
+    a.GetFullNeighbor(id, nullptr, 0, &ia, &wa, &ta);
+    b.GetFullNeighbor(id, nullptr, 0, &ib, &wb, &tb);
+    CHECK_TRUE(ia == ib && wa == wb && ta == tb);
+    ia.clear(); ib.clear(); wa.clear(); wb.clear(); ta.clear(); tb.clear();
+    a.GetFullInNeighbor(id, nullptr, 0, &ia, &wa, &ta);
+    b.GetFullInNeighbor(id, nullptr, 0, &ib, &wb, &tb);
+    CHECK_TRUE(ia == ib && wa == wb && ta == tb);
+    NodeId nid = id;
+    float da[4] = {0}, db[4] = {0};
+    a.GetDenseFeature(&nid, 1, 0, 4, da);
+    b.GetDenseFeature(&nid, 1, 0, 4, db);
+    CHECK_TRUE(std::memcmp(da, db, sizeof(da)) == 0);
+    std::vector<uint64_t> oa, ob, va, vb;
+    a.GetSparseFeature(&nid, 1, 1, &oa, &va);
+    b.GetSparseFeature(&nid, 1, 1, &ob, &vb);
+    CHECK_TRUE(oa == ob && va == vb);
+    std::vector<uint64_t> boa, bob;
+    std::vector<char> bva, bvb;
+    a.GetBinaryFeature(&nid, 1, 2, &boa, &bva);
+    b.GetBinaryFeature(&nid, 1, 2, &bob, &bvb);
+    CHECK_TRUE(boa == bob && bva == bvb);
+  }
+  {
+    NodeId s = 1, d = 5;
+    int32_t t = 0;
+    float ea[2] = {0}, eb[2] = {0};
+    a.GetEdgeDenseFeature(&s, &d, &t, 1, 0, 2, ea);
+    b.GetEdgeDenseFeature(&s, &d, &t, 1, 0, 2, eb);
+    CHECK_TRUE(std::memcmp(ea, eb, sizeof(ea)) == 0);
+  }
+  // Seeded draws must match stream-for-stream: the alias tables and the
+  // row order serialized verbatim (never hub-sorted).
+  Pcg32 ra(99), rb(99);
+  NodeId sa[16], sb[16];
+  a.SampleNode(-1, 16, &ra, sa);
+  b.SampleNode(-1, 16, &rb, sb);
+  CHECK_TRUE(std::memcmp(sa, sb, sizeof(sa)) == 0);
+  float wsa[8], wsb[8];
+  int32_t tsa[8], tsb[8];
+  a.SampleNeighbor(1, nullptr, 0, 8, 0, &ra, sa, wsa, tsa);
+  b.SampleNeighbor(1, nullptr, 0, 8, 0, &rb, sb, wsb, tsb);
+  CHECK_TRUE(std::memcmp(sa, sb, 8 * sizeof(NodeId)) == 0);
+  CHECK_TRUE(std::memcmp(wsa, wsb, sizeof(wsa)) == 0);
+}
+
+void TestColumnarStoreRoundtrip() {
+  auto g = OutcoreGraph();
+  CHECK_TRUE(std::system("mkdir -p /tmp/et_engine_test_store") == 0);
+  std::string path = "/tmp/et_engine_test_store/columnar.etc";
+  CHECK_OK(WriteColumnarStore(*g, path));
+
+  auto& c = GlobalStoreCounters();
+  uint64_t hits0 = c.hot_hits.load(), cold0 = c.cold_reads.load();
+  // All-hot attach: every read classifies hot, none cold.
+  std::unique_ptr<Graph> hot;
+  CHECK_OK(LoadGraphFromStore(path, 1LL << 30, &hot));
+  CHECK_TRUE(hot->attached());
+  CHECK_TRUE(hot->tier() != nullptr);
+  CHECK_TRUE(hot->tier()->hot_rows() == hot->node_count());
+  CheckGraphParity(*g, *hot);
+  CHECK_TRUE(c.hot_hits.load() > hits0);
+  CHECK_TRUE(c.cold_reads.load() == cold0);
+
+  // Zero-budget attach: parity still exact, reads classify cold and the
+  // cold-read histogram moves.
+  uint64_t hist_n0 = c.cold_hist.n.load();
+  std::unique_ptr<Graph> cold;
+  CHECK_OK(LoadGraphFromStore(path, 0, &cold));
+  CHECK_TRUE(cold->tier()->hot_rows() == 0);
+  CheckGraphParity(*g, *cold);
+  CHECK_TRUE(c.cold_reads.load() > cold0);
+  CHECK_TRUE(c.cold_hist.n.load() > hist_n0);
+
+  // The stats snapshot surfaces the mapping gauges.
+  uint64_t st[kStoreStatSlots];
+  StoreStatsSnapshot(st);
+  CHECK_TRUE(st[5] > 0);   // mapped_bytes
+  CHECK_TRUE(st[7] >= 2);  // attaches
+}
+
+// The RAM overlay above the mmap base: applying the same delta to the
+// heap twin and the attached graph must yield byte-identical snapshots
+// (ISSUE gate: post-delta reads byte-identical to the RAM engine).
+void TestColumnarStorePostDelta() {
+  auto base = OutcoreGraph();
+  CHECK_TRUE(std::system("mkdir -p /tmp/et_engine_test_store") == 0);
+  std::string path = "/tmp/et_engine_test_store/delta.etc";
+  CHECK_OK(WriteColumnarStore(*base, path));
+  std::unique_ptr<Graph> mm;
+  CHECK_OK(LoadGraphFromStore(path, 1 << 20, &mm));
+
+  // update node 5's weight, add node 100, re-weight hub edge (1,2,0),
+  // add a fresh edge (3,7,1)
+  NodeId nids[2] = {5, 100};
+  int32_t ntypes[2] = {1, 0};
+  float nws[2] = {50.0f, 1.0f};
+  NodeId esrc[2] = {1, 3}, edst[2] = {2, 7};
+  int32_t etypes[2] = {0, 1};
+  float ews[2] = {9.0f, 2.5f};
+  std::unique_ptr<Graph> next_heap, next_mm;
+  std::vector<NodeId> dirty_h, dirty_m;
+  CHECK_OK(ApplyGraphDelta(*base, nids, ntypes, nws, 2, esrc, edst, etypes,
+                           ews, 2, 0, 1, &next_heap, &dirty_h));
+  CHECK_OK(ApplyGraphDelta(*mm, nids, ntypes, nws, 2, esrc, edst, etypes,
+                           ews, 2, 0, 1, &next_mm, &dirty_m));
+  CHECK_TRUE(dirty_h == dirty_m);
+  CheckGraphParity(*next_heap, *next_mm);
+  // the delta snapshot itself is a heap overlay until the next spill
+  CHECK_TRUE(!next_mm->attached());
+}
+
+// WAL compaction emits the columnar sidecar; recovery with storage=mmap
+// attaches it and replays the tail to the same graph the heap path
+// rebuilds.
+void TestWalColumnarSidecarRecovery() {
+  std::string root = "/tmp/et_engine_test_walcol";
+  CHECK_TRUE(std::system(("rm -rf " + root + " && mkdir -p " + root +
+                          "/data " + root + "/wal").c_str()) == 0);
+  auto g = OutcoreGraph();
+  CHECK_OK(DumpGraphPartitioned(*g, root + "/data", 1));
+
+  std::unique_ptr<DeltaWal> wal;
+  CHECK_OK(DeltaWal::Open(root + "/wal", FsyncPolicy::kNever, 1, &wal));
+  wal->set_columnar_sidecar(true);
+  // one delta record (kApplyDelta wire body), epoch 0 -> 1
+  ByteWriter body;
+  NodeId nid = 200;
+  int32_t ntype = 1;
+  float nw = 3.0f;
+  NodeId esrc = 200, edst = 1;
+  int32_t etype = 0;
+  float ew = 4.0f;
+  body.Put<uint64_t>(1);
+  body.PutRaw(&nid, sizeof(nid));
+  body.PutRaw(&ntype, sizeof(ntype));
+  body.PutRaw(&nw, sizeof(nw));
+  body.Put<uint64_t>(1);
+  body.PutRaw(&esrc, sizeof(esrc));
+  body.PutRaw(&edst, sizeof(edst));
+  body.PutRaw(&etype, sizeof(etype));
+  body.PutRaw(&ew, sizeof(ew));
+  CHECK_OK(wal->Append(1, body.buffer().data(), body.buffer().size()));
+
+  // heap-path recovery replays the record…
+  std::unique_ptr<Graph> heap_g;
+  uint64_t replayed = 0;
+  CHECK_OK(RecoverShard(root + "/wal", root + "/data", 0, 1, true, &heap_g,
+                        &replayed));
+  CHECK_TRUE(replayed == 1);
+  CHECK_TRUE(heap_g->epoch() == 1);
+
+  // …compaction snapshots it WITH the sidecar…
+  CHECK_OK(wal->Compact(*heap_g));
+  CHECK_TRUE(!wal->last_snapshot_dir().empty());
+  std::string sidecar = wal->last_snapshot_dir() + "/" + kColumnarFileName;
+  std::unique_ptr<Graph> side_g;
+  CHECK_OK(LoadGraphFromStore(sidecar, 0, &side_g));
+  CheckGraphParity(*heap_g, *side_g);
+
+  // …and a fresh mmap-mode recovery attaches it (no pending tail).
+  std::unique_ptr<Graph> mm_g;
+  CHECK_OK(RecoverShard(root + "/wal", root + "/data", 0, 1, true, &mm_g,
+                        nullptr, nullptr, nullptr, nullptr, 1, 1 << 20));
+  CHECK_TRUE(mm_g->attached());
+  CheckGraphParity(*heap_g, *mm_g);
 }
 
 // Ragged offsets travel as i32 [n,2]; every merge producer range-checks
@@ -1097,6 +1308,9 @@ int main() {
   et::TestExecutorRunsDag();
   et::TestIndexDnf();
   et::TestDumpLoadRoundtrip();
+  et::TestColumnarStoreRoundtrip();
+  et::TestColumnarStorePostDelta();
+  et::TestWalColumnarSidecarRecovery();
   if (et::g_failures == 0) {
     std::printf("engine_test: ALL OK\n");
     return 0;

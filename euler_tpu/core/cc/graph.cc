@@ -689,6 +689,7 @@ void Graph::SampleNeighbor(NodeId id, const int32_t* edge_types,
   s.clear();
   float grand = 0.f;
   if (idx != kInvalidIndex) {
+    TouchRow(idx);
     auto consider = [&](int et) {
       if (et < 0 || et >= ET) return;
       size_t b, e;
@@ -779,6 +780,7 @@ void Graph::SampleNeighborBatch(const NodeId* ids, size_t n,
           &adj_offsets_[static_cast<size_t>(s.idx[i + D]) * ET]);
     }
     if (s.idx[i] == kInvalidIndex) continue;
+    TouchRow(s.idx[i]);
     for (size_t t = 0; t < n_et; ++t) {
       int et = ets[t];
       if (et < 0 || et >= ET) continue;
@@ -855,6 +857,7 @@ void Graph::GetFullNeighbor(NodeId id, const int32_t* edge_types,
                             bool sorted_by_id) const {
   uint32_t idx = NodeIndex(id);
   if (idx == kInvalidIndex) return;
+  TouchRow(idx);
   const int ET = meta_.num_edge_types;
   auto grab = [&](int et) {
     if (et < 0 || et >= ET) return;
@@ -927,6 +930,7 @@ void Graph::GetFullInNeighbor(NodeId id, const int32_t* edge_types,
                               std::vector<int32_t>* ts) const {
   uint32_t idx = NodeIndex(id);
   if (idx == kInvalidIndex || in_adj_offsets_.empty()) return;
+  TouchRow(idx);
   const int ET = meta_.num_edge_types;
   auto grab = [&](int et) {
     if (et < 0 || et >= ET) return;
@@ -959,6 +963,7 @@ void Graph::SampleInNeighbor(NodeId id, const int32_t* edge_types,
     }
     return;
   }
+  TouchRow(idx);
   GroupScratch& s = TlsGroupScratch();
   s.clear();
   float grand = 0.f;
@@ -1008,6 +1013,7 @@ size_t Graph::OutDegree(NodeId id, const int32_t* edge_types,
                         size_t n_types) const {
   uint32_t idx = NodeIndex(id);
   if (idx == kInvalidIndex) return 0;
+  TouchRow(idx);
   const int ET = meta_.num_edge_types;
   size_t total = 0;
   auto add = [&](int et) {
@@ -1041,6 +1047,7 @@ void Graph::GetDenseFeature(const NodeId* ids, size_t count, int fid,
       std::memset(dst, 0, dim * sizeof(float));
       continue;
     }
+    TouchRow(idx);
     int64_t n = std::min(dim, stored_dim);
     std::memcpy(dst, node_dense_[fid].data() + idx * stored_dim,
                 n * sizeof(float));
@@ -1061,6 +1068,7 @@ void Graph::GetSparseFeature(const NodeId* ids, size_t count, int fid,
       (*offsets)[i + 1] = (*offsets)[i];
       continue;
     }
+    TouchRow(idx);
     const auto& vf = node_var_[fid];
     uint64_t b = vf.offsets[idx], e = vf.offsets[idx + 1];
     values->insert(values->end(), vf.values_u64.begin() + b,
@@ -1082,6 +1090,7 @@ void Graph::GetBinaryFeature(const NodeId* ids, size_t count, int fid,
       (*offsets)[i + 1] = (*offsets)[i];
       continue;
     }
+    TouchRow(idx);
     const auto& vf = node_var_[fid];
     uint64_t b = vf.offsets[idx], e = vf.offsets[idx + 1];
     values->insert(values->end(), vf.values_bytes.begin() + b,
@@ -1093,6 +1102,7 @@ void Graph::GetBinaryFeature(const NodeId* ids, size_t count, int fid,
 uint64_t Graph::EdgeSlot(NodeId src, NodeId dst, int32_t type) const {
   uint32_t idx = NodeIndex(src);
   if (idx == kInvalidIndex) return kNoSlot;
+  TouchRow(idx);
   int32_t et = meta_.num_edge_types;
   if (type < 0 || type >= et) return kNoSlot;
   // each (src row, type) group is sorted by dst — binary search beats a

@@ -29,6 +29,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "col.h"
 #include "common.h"
 #include "sampling.h"
 
@@ -107,14 +108,17 @@ struct GraphMeta {
   std::vector<std::string> edge_type_names;
 };
 
-// CSR store for one variable-length feature over all rows.
+// CSR store for one variable-length feature over all rows. Columns are
+// Col<T> so the whole feature can live in an mmap'd store (store.h).
 struct VarFeature {
-  std::vector<uint64_t> offsets;  // size rows+1
-  std::vector<uint64_t> values_u64;  // sparse kind
-  std::vector<char> values_bytes;    // binary kind
+  Col<uint64_t> offsets;  // size rows+1
+  Col<uint64_t> values_u64;  // sparse kind
+  Col<char> values_bytes;    // binary kind
 };
 
 class GraphBuilder;
+class ColumnarStore;  // store.h — mmap'd columnar file backing a Graph
+class StorageTier;    // store.h — hot-set accounting over attached columns
 
 class Graph {
  public:
@@ -284,10 +288,27 @@ class Graph {
   // rebuild with the same setting for byte parity).
   bool has_in_adjacency() const { return !in_adj_offsets_.empty(); }
 
+  // ---- out-of-core storage tier ----
+  // True when the big columns are mmap-attached to a ColumnarStore file
+  // instead of heap-resident (store.h LoadGraphFromStore).
+  bool attached() const { return store_ != nullptr; }
+  const std::shared_ptr<ColumnarStore>& store() const { return store_; }
+  StorageTier* tier() const { return tier_raw_; }
+
  private:
   friend class GraphBuilder;
   friend std::unique_ptr<GraphBuilder> BuilderFromGraph(const Graph&);
+  friend struct StoreAccess;  // store.cc serializer/attacher
   Graph();
+
+  // Hot/cold accounting hook: every row-addressed accessor calls this
+  // once per resolved row. One predictable branch on the RAM path;
+  // TierTouchRow (graph.cc) does the bitmask check + cold latency
+  // timing only when a tier is attached.
+  inline void TouchRow(uint32_t idx) const {
+    if (tier_raw_ != nullptr) TierTouchRow(idx);
+  }
+  void TierTouchRow(uint32_t idx) const;
 
   // Weighted choice among the (begin,end) cumw groups selected by edge_types;
   // returns adjacency slot or kNoSlot when all groups are empty/zero.
@@ -297,25 +318,31 @@ class Graph {
   uint64_t uid_ = 0;
   uint64_t epoch_ = 0;
   GraphMeta meta_;
+  // Out-of-core backing: when non-null, the Col members below are
+  // attached to this mmap'd store (which must outlive them) and tier_
+  // does hot/cold accounting. Null for ordinary heap-resident graphs.
+  std::shared_ptr<ColumnarStore> store_;
+  std::shared_ptr<StorageTier> tier_;
+  StorageTier* tier_raw_ = nullptr;  // branch-cheap hook (TouchRow)
   // nodes
-  std::vector<NodeId> node_ids_;
-  std::vector<int32_t> node_types_;
-  std::vector<float> node_weights_;
+  Col<NodeId> node_ids_;
+  Col<int32_t> node_types_;
+  Col<float> node_weights_;
   std::unordered_map<NodeId, uint32_t> id2idx_;
   // direct id→row table when the id range is ≤ 4× node count (built at
   // Finalize); empty → fall back to the hash map
-  std::vector<uint32_t> dense_idx_;
+  Col<uint32_t> dense_idx_;
   NodeId dense_base_ = 0;
   // out-adjacency: group g = idx*num_edge_types + et
-  std::vector<uint64_t> adj_offsets_;  // size N*ET + 1
-  std::vector<NodeId> adj_nbr_;
-  std::vector<float> adj_w_;
-  std::vector<float> adj_cumw_;  // per-group inclusive prefix sums
+  Col<uint64_t> adj_offsets_;  // size N*ET + 1
+  Col<NodeId> adj_nbr_;
+  Col<float> adj_w_;
+  Col<float> adj_cumw_;  // per-group inclusive prefix sums
   // in-adjacency (same layout; slot order independent of out slots)
-  std::vector<uint64_t> in_adj_offsets_;
-  std::vector<NodeId> in_adj_nbr_;
-  std::vector<float> in_adj_w_;
-  std::vector<float> in_adj_cumw_;
+  Col<uint64_t> in_adj_offsets_;
+  Col<NodeId> in_adj_nbr_;
+  Col<float> in_adj_w_;
+  Col<float> in_adj_cumw_;
   // Edge slot lookup needs no map: each (src row, type) group's slots
   // are sorted by dst, so EdgeSlot binary-searches the group — O(log d)
   // with zero build/memory cost (a 100M+-entry hash map here once
@@ -330,25 +357,25 @@ class Graph {
   };
   // global samplers
   // whole-graph labels
-  std::vector<uint64_t> graph_labels_;  // per node row; empty → unlabeled
+  Col<uint64_t> graph_labels_;  // per node row; empty → unlabeled
   std::vector<uint64_t> label_ids_;     // distinct labels, sorted
   std::unordered_map<uint64_t, std::vector<uint32_t>> label_rows_;
   // OwnedLabels single-entry cache (see graph.cc)
   mutable std::mutex owned_mu_;
   mutable int owned_sidx_ = -1, owned_snum_ = -1;
   mutable std::shared_ptr<const std::vector<uint64_t>> owned_ids_;
-  std::vector<std::vector<uint32_t>> nodes_by_type_;  // type → node indices
+  std::vector<Col<uint32_t>> nodes_by_type_;  // type → node indices
   std::vector<AliasSampler> node_sampler_by_type_;
   AliasSampler node_sampler_all_;  // over node indices 0..N-1
-  std::vector<std::vector<uint64_t>> edges_by_type_;  // type → adj slots
+  std::vector<Col<uint64_t>> edges_by_type_;  // type → adj slots
   std::vector<AliasSampler> edge_sampler_by_type_;
   AliasSampler edge_sampler_all_;  // over adjacency slots 0..E-1
   std::vector<float> node_type_wsum_;
   std::vector<float> edge_type_wsum_;
   // features: [fid] → flat matrix (dense) or CSR (sparse/binary)
-  std::vector<std::vector<float>> node_dense_;   // size N*dim, zero-filled
+  std::vector<Col<float>> node_dense_;   // size N*dim, zero-filled
   std::vector<VarFeature> node_var_;
-  std::vector<std::vector<float>> edge_dense_;   // size E*dim (adj slot order)
+  std::vector<Col<float>> edge_dense_;   // size E*dim (adj slot order)
   std::vector<VarFeature> edge_var_;
 
   void FindAdjSlots(NodeId src, NodeId dst, int32_t type, uint64_t* slot) const;

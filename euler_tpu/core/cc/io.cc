@@ -43,6 +43,12 @@ Status WriteStringToFile(const std::string& path, const char* data,
 
 Status SaveMeta(const GraphMeta& meta, const std::string& path) {
   ByteWriter w;
+  EncodeMeta(meta, &w);
+  return WriteStringToFile(path, w.buffer().data(), w.buffer().size());
+}
+
+void EncodeMeta(const GraphMeta& meta, ByteWriter* wp) {
+  ByteWriter& w = *wp;
   w.PutRaw(kMetaMagic, 4);
   w.Put<uint32_t>(kVersion);
   w.Put<uint32_t>(meta.num_node_types);
@@ -65,17 +71,23 @@ Status SaveMeta(const GraphMeta& meta, const std::string& path) {
   };
   put_feats(meta.node_features);
   put_feats(meta.edge_features);
-  return WriteStringToFile(path, w.buffer().data(), w.buffer().size());
 }
 
 Status LoadMeta(const std::string& path, GraphMeta* meta) {
   std::string blob;
   ET_RETURN_IF_ERROR(ReadFileToString(path, &blob));
   ByteReader r(blob.data(), blob.size());
+  Status s = DecodeMeta(&r, meta);
+  if (!s.ok()) return Status::IOError(s.message() + " in " + path);
+  return Status::OK();
+}
+
+Status DecodeMeta(ByteReader* rp, GraphMeta* meta) {
+  ByteReader& r = *rp;
   char magic[4];
   uint32_t ver, nt, et, pn;
   if (!r.GetRaw(magic, 4) || std::memcmp(magic, kMetaMagic, 4) != 0) {
-    return Status::IOError("bad meta magic in " + path);
+    return Status::IOError("bad meta magic");
   }
   if (!r.Get(&ver) || ver < 1 || ver > kVersion) {
     return Status::IOError("unsupported meta version");

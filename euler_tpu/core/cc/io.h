@@ -97,6 +97,12 @@ Status WriteStringToFile(const std::string& path, const char* data,
 Status SaveMeta(const GraphMeta& meta, const std::string& path);
 Status LoadMeta(const std::string& path, GraphMeta* meta);
 
+// In-memory forms of the meta.bin encoding (shared with the columnar
+// store's embedded meta section — store.cc): identical bytes to
+// SaveMeta/LoadMeta, minus the file I/O.
+void EncodeMeta(const GraphMeta& meta, ByteWriter* w);
+Status DecodeMeta(ByteReader* r, GraphMeta* meta);
+
 // Appends one partition's records into the builder. data_type: 0=all,
 // 1=node-only, 2=edge-only (mirrors reference GraphDataType,
 // graph_builder.h:42-47).

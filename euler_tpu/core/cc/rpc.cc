@@ -28,6 +28,7 @@
 #include <unordered_set>
 
 #include "gql.h"
+#include "store.h"
 #include "threadpool.h"
 #include "udf.h"
 
@@ -451,18 +452,28 @@ int ServerTraceStats::VerbSlot(uint32_t msg_type) {
   }
 }
 
+void LatencyHist::Observe(uint64_t us) {
+  // log2 bucket: bound i covers (2^(i-1), 2^i] µs (le-inclusive, the
+  // obs Histogram convention); values past the last bound overflow
+  int idx = 0;
+  while (idx < kBuckets && us > (1ULL << idx)) ++idx;
+  counts[idx].fetch_add(1);
+  sum_us.fetch_add(us);
+  n.fetch_add(1);
+}
+
+void LatencyHist::Snapshot(uint64_t* n_out, uint64_t* sum_us_out,
+                           uint64_t* counts_out) const {
+  *n_out = n.load();
+  *sum_us_out = sum_us.load();
+  for (int i = 0; i <= kBuckets; ++i) counts_out[i] = counts[i].load();
+}
+
 void ServerTraceStats::Observe(int verb_slot, int phase, uint64_t us) {
   if (verb_slot < 0 || verb_slot >= kTraceVerbs || phase < 0 ||
       phase >= kTracePhases)
     return;
-  // log2 bucket: bound i covers (2^(i-1), 2^i] µs (le-inclusive, the
-  // obs Histogram convention); values past the last bound overflow
-  int idx = 0;
-  while (idx < kTraceBuckets && us > (1ULL << idx)) ++idx;
-  Hist& h = hist_[verb_slot][phase];
-  h.counts[idx].fetch_add(1);
-  h.sum_us.fetch_add(us);
-  h.n.fetch_add(1);
+  hist_[verb_slot][phase].Observe(us);
 }
 
 void ServerTraceStats::Record(const ServerTraceRecord& rec) {
@@ -483,10 +494,7 @@ bool ServerTraceStats::HistSnapshot(int verb_slot, int phase, uint64_t* n,
   if (verb_slot < 0 || verb_slot >= kTraceVerbs || phase < 0 ||
       phase >= kTracePhases)
     return false;
-  const Hist& h = hist_[verb_slot][phase];
-  *n = h.n.load();
-  *sum_us = h.sum_us.load();
-  for (int i = 0; i <= kTraceBuckets; ++i) counts[i] = h.counts[i].load();
+  hist_[verb_slot][phase].Snapshot(n, sum_us, counts);
   return true;
 }
 
@@ -607,6 +615,58 @@ void GraphServer::InvalidateReuse() {
   if (dropped > 0)
     GlobalRpcCounters().reuse_invalidated.fetch_add(
         static_cast<uint64_t>(dropped));
+}
+
+void GraphServer::ReattachFromSidecar(DeltaWal* wal) {
+  // Caller holds apply_mutex: no delta can race the swap, so the mmap
+  // twin is attached from the exact bytes the compaction just dumped.
+  // Failure is non-fatal — the shard keeps serving the heap snapshot
+  // and the next compaction retries.
+  if (wal->last_snapshot_dir().empty()) return;
+  const std::string sidecar =
+      wal->last_snapshot_dir() + "/" + kColumnarFileName;
+  std::shared_ptr<const Graph> base = graph_ref_->get();
+  std::unique_ptr<Graph> next;
+  Status s = LoadGraphFromStore(sidecar, storage_hot_bytes_, &next);
+  if (s.ok() && base->has_in_adjacency() && !next->has_in_adjacency() &&
+      next->edge_count() > 0)
+    s = Status::IOError("sidecar lacks in-adjacency");
+  if (!s.ok()) {
+    ET_LOG(WARNING) << "shard " << shard_idx_
+                    << " mmap reattach skipped: " << s.message();
+    return;
+  }
+  next->set_epoch(base->epoch());
+  std::shared_ptr<const Graph> fresh(std::move(next));
+  std::shared_ptr<IndexManager> new_index;
+  if (!index_spec_.empty()) {
+    new_index = std::make_shared<IndexManager>();
+    s = new_index->BuildFromSpec(*fresh, index_spec_);
+    if (!s.ok()) {
+      ET_LOG(WARNING) << "shard " << shard_idx_
+                      << " mmap reattach skipped (index rebuild): "
+                      << s.message();
+      return;
+    }
+  }
+  uint64_t old_uid = base->uid();
+  {
+    std::lock_guard<std::mutex> lk(state_mu_);
+    // same epoch, empty dirty set: the twin is byte-identical, clients'
+    // incremental caches stay valid (DirtySince gains nothing new)
+    if (!graph_ref_->SwapFrom(base, std::move(fresh), {})) {
+      ET_LOG(WARNING) << "shard " << shard_idx_
+                      << " mmap reattach lost a swap race; skipped";
+      return;
+    }
+    index_ = new_index;
+  }
+  // the snapshot uid changed: anything keyed on the old uid is garbage
+  UdfResultCache::Instance().EvictGraph(old_uid);
+  InvalidateReuse();
+  ET_LOG(INFO) << "shard " << shard_idx_
+               << " reattached mmap columnar generation " << sidecar
+               << " (epoch " << graph_ref_->epoch() << ")";
 }
 
 void GraphServer::SnapshotState(std::shared_ptr<const Graph>* g,
@@ -1029,11 +1089,20 @@ void GraphServer::ApplyDeltaBody(const char* body, size_t len,
           auto wal = wwal.lock();
           if (wal != nullptr && !stopping_.load()) {
             std::lock_guard<std::mutex> alk(ref->apply_mutex());
+            int64_t before = wal->log_bytes();
             Status cs = wal->MaybeCompact(*ref->get());
             if (!cs.ok())
               ET_LOG(WARNING) << "shard " << shard
                               << " wal compaction failed: "
                               << cs.message();
+            // out-of-core mode: a compaction that actually ran (log
+            // reset) just wrote the columnar sidecar for the CURRENT
+            // snapshot — swap the heap graph for its mmap twin while
+            // still under apply_mutex (serialized against applies,
+            // exactly like the compact itself)
+            if (cs.ok() && storage_mode_ == 1 &&
+                wal->log_bytes() < before && wal->columnar_sidecar())
+              ReattachFromSidecar(wal.get());
           }
           std::lock_guard<std::mutex> lk(compact_mu_);
           --compact_inflight_;

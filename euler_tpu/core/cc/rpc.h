@@ -326,6 +326,23 @@ struct ServerTraceRecord {
   uint32_t serialize_us = 0;  // encode + reply write
 };
 
+// One lock-free log2-µs latency histogram — THE bucket convention every
+// native latency surface shares (server verb/phase timings here, the
+// storage tier's cold-read penalty in store.h): 24 le-inclusive bounds
+// (1µs, 2µs, ... 2^23µs ≈ 8.4s) + one overflow bucket, plus n/sum_us so
+// a scraper can derive both quantiles and the mean from one snapshot.
+struct LatencyHist {
+  static constexpr int kBuckets = 24;
+  std::atomic<uint64_t> n{0};
+  std::atomic<uint64_t> sum_us{0};
+  std::atomic<uint64_t> counts[kBuckets + 1] = {};
+
+  void Observe(uint64_t us);
+  // counts must hold kBuckets+1 slots.
+  void Snapshot(uint64_t* n_out, uint64_t* sum_us_out,
+                uint64_t* counts_out) const;
+};
+
 class ServerTraceStats {
  public:
   // Histogram axes. Verb slots index the hist matrix; phases follow the
@@ -335,7 +352,7 @@ class ServerTraceStats {
                                            // get_delta, get_delta_log,
                                            // set_ownership, meta
   static constexpr int kTracePhases = 4;   // queue, decode, exec, ser
-  static constexpr int kTraceBuckets = 24;
+  static constexpr int kTraceBuckets = LatencyHist::kBuckets;
   static constexpr size_t kRingCap = 8192;
 
   // msg_type → verb slot, -1 for untracked verbs (ping, hello, ...).
@@ -352,12 +369,7 @@ class ServerTraceStats {
   uint64_t NextSpanId() { return next_span_.fetch_add(1); }
 
  private:
-  struct Hist {
-    std::atomic<uint64_t> n{0};
-    std::atomic<uint64_t> sum_us{0};
-    std::atomic<uint64_t> counts[kTraceBuckets + 1] = {};
-  };
-  Hist hist_[kTraceVerbs][kTracePhases];
+  LatencyHist hist_[kTraceVerbs][kTracePhases];
   std::atomic<uint64_t> next_span_{1};
   mutable std::mutex ring_mu_;
   std::deque<ServerTraceRecord> ring_;
@@ -441,7 +453,25 @@ class GraphServer {
     // an unopenable wal contributes to the degraded-instance gauge for
     // this server's lifetime (Stop releases it)
     if (degraded) GlobalWalCounters().degraded.fetch_add(1);
+    if (storage_mode_ == 1 && wal_ != nullptr)
+      wal_->set_columnar_sidecar(true);
   }
+
+  // Out-of-core storage (store.h): mode 1 = mmap columnar tier. The
+  // server's WAL compactions write the columnar sidecar, and after each
+  // successful compaction the shard RE-ATTACHES the fresh generation —
+  // swapping the heap snapshot (the RAM overlay deltas build on) for
+  // its byte-identical mmap twin at the same epoch, so the heap copy is
+  // only ever as old as one compaction interval. hot_bytes is the
+  // hub-pinned hot-set budget per attach. Order-independent with
+  // set_wal; set both before Start.
+  void set_storage(int mode, int64_t hot_bytes) {
+    storage_mode_ = mode;
+    storage_hot_bytes_ = hot_bytes;
+    if (storage_mode_ == 1 && wal_ != nullptr)
+      wal_->set_columnar_sidecar(true);
+  }
+  int storage_mode() const { return storage_mode_; }
 
   // Pre-populate the retained anti-entropy delta log (kGetDeltaLog)
   // with records recovered from this shard's own WAL, so a freshly
@@ -587,6 +617,10 @@ class GraphServer {
   std::unordered_map<uint64_t, std::shared_ptr<CoalesceBucket>> coalesce_;
   std::shared_ptr<DeltaWal> wal_;
   bool wal_degraded_ = false;  // wal requested but unopenable: refuse deltas
+  int storage_mode_ = 0;       // 0 heap, 1 mmap out-of-core (store.h)
+  int64_t storage_hot_bytes_ = 0;
+  // Post-compaction mmap re-attach (rpc.cc; caller holds apply_mutex).
+  void ReattachFromSidecar(DeltaWal* wal);
   // off-path compaction accounting: Stop() drains in-flight tasks
   // before releasing the wal, so a successor reopening the same
   // wal_dir can never race a still-running dump

@@ -13,19 +13,26 @@
 #include <cstdint>
 #include <vector>
 
+#include "col.h"
 #include "common.h"
 
 namespace et {
 
 // O(1) weighted sampling via Vose's alias method. Built once over a weight
 // array; Sample() returns an index in [0, size) with probability
-// weight[i] / sum(weight).
+// weight[i] / sum(weight). The prob/alias tables are Col<T> so a
+// finalized sampler can be serialized into (and re-attached from) the
+// mmap'd columnar store — the O(E) global edge sampler must not force
+// the whole edge set back onto the heap (store.h).
 class AliasSampler {
  public:
   AliasSampler() = default;
 
   void Init(const float* weights, size_t n);
   void Init(const std::vector<float>& weights) {
+    Init(weights.data(), weights.size());
+  }
+  void Init(const Col<float>& weights) {
     Init(weights.data(), weights.size());
   }
 
@@ -38,9 +45,21 @@ class AliasSampler {
     return rng->NextFloat() < prob_[col] ? col : alias_[col];
   }
 
+  // Serialization seam (store.cc): read the finalized tables, or attach
+  // them to externally owned memory (total_weight rides the store's aux
+  // section — it is not derivable from prob/alias alone).
+  const Col<float>& prob_col() const { return prob_; }
+  const Col<uint32_t>& alias_col() const { return alias_; }
+  void Attach(const float* prob, const uint32_t* alias, size_t n,
+              float total_weight) {
+    prob_.AttachExternal(prob, n);
+    alias_.AttachExternal(alias, n);
+    total_weight_ = total_weight;
+  }
+
  private:
-  std::vector<float> prob_;
-  std::vector<uint32_t> alias_;
+  Col<float> prob_;
+  Col<uint32_t> alias_;
   float total_weight_ = 0.f;
 };
 

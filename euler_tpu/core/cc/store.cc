@@ -1,0 +1,644 @@
+// Out-of-core columnar store: writer, mmap attach, hot-set accounting.
+// See store.h for the design contract.
+#include "store.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <set>
+
+#include "io.h"
+
+namespace et {
+
+const char kColumnarFileName[] = "columnar.etc";
+
+StoreCounters& GlobalStoreCounters() {
+  static StoreCounters* c = new StoreCounters();
+  return *c;
+}
+
+namespace {
+
+constexpr char kStoreMagic[4] = {'E', 'T', 'S', '1'};
+constexpr uint32_t kStoreVersion = 1;
+constexpr size_t kAlign = 64;
+constexpr size_t kPage = 4096;
+
+inline int64_t MonoNowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+inline size_t AlignUp(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+// Live-tier registry for the process-wide residency gauges.
+std::mutex& TierRegMu() {
+  static std::mutex* m = new std::mutex();
+  return *m;
+}
+std::set<StorageTier*>& TierReg() {
+  static std::set<StorageTier*>* s = new std::set<StorageTier*>();
+  return *s;
+}
+
+// One serialized column: name + element geometry + a pointer to the
+// source bytes (writer side).
+struct ColSpec {
+  std::string name;
+  uint32_t elem_size = 1;
+  uint64_t count = 0;
+  const void* data = nullptr;
+};
+
+template <typename T>
+void AddCol(std::vector<ColSpec>* specs, const std::string& name,
+            const Col<T>& c) {
+  specs->push_back({name, static_cast<uint32_t>(sizeof(T)), c.size(),
+                    static_cast<const void*>(c.data())});
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// StoreAccess — the single friend through which store.cc reads/wires
+// Graph internals. Writer and attacher walk the SAME column list so the
+// two directions can never diverge silently.
+// ---------------------------------------------------------------------------
+struct StoreAccess {
+  // Serialize the aux section: meta + every scalar an attached Graph
+  // needs that is not itself a column.
+  static void EncodeAux(const Graph& g, ByteWriter* w) {
+    EncodeMeta(g.meta_, w);
+    w->Put<uint64_t>(g.dense_base_);
+    w->Put<uint32_t>(static_cast<uint32_t>(g.node_type_wsum_.size()));
+    for (float f : g.node_type_wsum_) w->Put<float>(f);
+    w->Put<uint32_t>(static_cast<uint32_t>(g.edge_type_wsum_.size()));
+    for (float f : g.edge_type_wsum_) w->Put<float>(f);
+    w->Put<float>(g.node_sampler_all_.total_weight());
+    w->Put<float>(g.edge_sampler_all_.total_weight());
+    w->Put<uint32_t>(static_cast<uint32_t>(g.node_sampler_by_type_.size()));
+    for (const auto& s : g.node_sampler_by_type_)
+      w->Put<float>(s.total_weight());
+    w->Put<uint32_t>(static_cast<uint32_t>(g.edge_sampler_by_type_.size()));
+    for (const auto& s : g.edge_sampler_by_type_)
+      w->Put<float>(s.total_weight());
+  }
+
+  static void CollectColumns(const Graph& g, std::vector<ColSpec>* specs) {
+    AddCol(specs, "node_ids", g.node_ids_);
+    AddCol(specs, "node_types", g.node_types_);
+    AddCol(specs, "node_weights", g.node_weights_);
+    AddCol(specs, "dense_idx", g.dense_idx_);
+    AddCol(specs, "graph_labels", g.graph_labels_);
+    AddCol(specs, "adj_offsets", g.adj_offsets_);
+    AddCol(specs, "adj_nbr", g.adj_nbr_);
+    AddCol(specs, "adj_w", g.adj_w_);
+    AddCol(specs, "adj_cumw", g.adj_cumw_);
+    AddCol(specs, "in_adj_offsets", g.in_adj_offsets_);
+    AddCol(specs, "in_adj_nbr", g.in_adj_nbr_);
+    AddCol(specs, "in_adj_w", g.in_adj_w_);
+    AddCol(specs, "in_adj_cumw", g.in_adj_cumw_);
+    for (size_t t = 0; t < g.nodes_by_type_.size(); ++t)
+      AddCol(specs, "nbt_" + std::to_string(t), g.nodes_by_type_[t]);
+    for (size_t t = 0; t < g.edges_by_type_.size(); ++t)
+      AddCol(specs, "ebt_" + std::to_string(t), g.edges_by_type_[t]);
+    AddCol(specs, "nsp_all", g.node_sampler_all_.prob_col());
+    AddCol(specs, "nsa_all", g.node_sampler_all_.alias_col());
+    AddCol(specs, "esp_all", g.edge_sampler_all_.prob_col());
+    AddCol(specs, "esa_all", g.edge_sampler_all_.alias_col());
+    for (size_t t = 0; t < g.node_sampler_by_type_.size(); ++t) {
+      AddCol(specs, "nsp_" + std::to_string(t),
+             g.node_sampler_by_type_[t].prob_col());
+      AddCol(specs, "nsa_" + std::to_string(t),
+             g.node_sampler_by_type_[t].alias_col());
+    }
+    for (size_t t = 0; t < g.edge_sampler_by_type_.size(); ++t) {
+      AddCol(specs, "esp_" + std::to_string(t),
+             g.edge_sampler_by_type_[t].prob_col());
+      AddCol(specs, "esa_" + std::to_string(t),
+             g.edge_sampler_by_type_[t].alias_col());
+    }
+    for (size_t f = 0; f < g.node_dense_.size(); ++f)
+      AddCol(specs, "nd_" + std::to_string(f), g.node_dense_[f]);
+    for (size_t f = 0; f < g.node_var_.size(); ++f) {
+      AddCol(specs, "nvo_" + std::to_string(f), g.node_var_[f].offsets);
+      AddCol(specs, "nvu_" + std::to_string(f), g.node_var_[f].values_u64);
+      AddCol(specs, "nvb_" + std::to_string(f), g.node_var_[f].values_bytes);
+    }
+    for (size_t f = 0; f < g.edge_dense_.size(); ++f)
+      AddCol(specs, "ed_" + std::to_string(f), g.edge_dense_[f]);
+    for (size_t f = 0; f < g.edge_var_.size(); ++f) {
+      AddCol(specs, "evo_" + std::to_string(f), g.edge_var_[f].offsets);
+      AddCol(specs, "evu_" + std::to_string(f), g.edge_var_[f].values_u64);
+      AddCol(specs, "evb_" + std::to_string(f), g.edge_var_[f].values_bytes);
+    }
+  }
+
+  static Status Attach(std::shared_ptr<ColumnarStore> store,
+                       int64_t hot_bytes, std::unique_ptr<Graph>* out);
+};
+
+// ---------------------------------------------------------------------------
+// Writer
+// ---------------------------------------------------------------------------
+Status WriteColumnarStore(const Graph& g, const std::string& path) {
+  ByteWriter aux;
+  StoreAccess::EncodeAux(g, &aux);
+  std::vector<ColSpec> specs;
+  specs.push_back({"aux", 1, aux.buffer().size(),
+                   static_cast<const void*>(aux.buffer().data())});
+  StoreAccess::CollectColumns(g, &specs);
+
+  // Header: magic | version | epoch | n_cols, then the column table with
+  // absolute 64-aligned payload offsets. Two passes: size the header,
+  // then lay out payloads after it.
+  size_t header_size = 4 + 4 + 8 + 4;
+  for (const auto& s : specs) header_size += 4 + s.name.size() + 4 + 8 + 8;
+  std::vector<uint64_t> offsets(specs.size());
+  size_t cur = AlignUp(header_size);
+  for (size_t i = 0; i < specs.size(); ++i) {
+    offsets[i] = cur;
+    cur = AlignUp(cur + specs[i].count * specs[i].elem_size);
+  }
+
+  ByteWriter h;
+  h.PutRaw(kStoreMagic, 4);
+  h.Put<uint32_t>(kStoreVersion);
+  h.Put<uint64_t>(g.epoch());
+  h.Put<uint32_t>(static_cast<uint32_t>(specs.size()));
+  for (size_t i = 0; i < specs.size(); ++i) {
+    h.PutStr(specs[i].name);
+    h.Put<uint32_t>(specs[i].elem_size);
+    h.Put<uint64_t>(specs[i].count);
+    h.Put<uint64_t>(offsets[i]);
+  }
+
+  // Atomic tmp+rename (the ModelBundle convention): a crashed writer
+  // never leaves a half-written store under the canonical name.
+  std::string tmp = path + ".tmp";
+  std::FILE* f = std::fopen(tmp.c_str(), "wb");
+  if (!f) return Status::IOError("cannot open " + tmp + " for write");
+  auto write_all = [&](const void* p, size_t n) {
+    return n == 0 || std::fwrite(p, 1, n, f) == n;
+  };
+  static const char zeros[kAlign] = {};
+  bool ok = write_all(h.buffer().data(), h.buffer().size());
+  size_t written = h.buffer().size();
+  for (size_t i = 0; ok && i < specs.size(); ++i) {
+    if (offsets[i] > written) {
+      ok = write_all(zeros, offsets[i] - written);
+      written = offsets[i];
+    }
+    size_t n = specs[i].count * specs[i].elem_size;
+    ok = ok && write_all(specs[i].data, n);
+    written += n;
+  }
+  ok = ok && std::fflush(f) == 0;
+  int fd = fileno(f);
+  ok = ok && fd >= 0 && fsync(fd) == 0;
+  std::fclose(f);
+  if (!ok) {
+    std::remove(tmp.c_str());
+    return Status::IOError("short write on " + tmp);
+  }
+  if (std::rename(tmp.c_str(), path.c_str()) != 0) {
+    std::remove(tmp.c_str());
+    return Status::IOError("rename " + tmp + " -> " + path + " failed");
+  }
+  return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// ColumnarStore
+// ---------------------------------------------------------------------------
+ColumnarStore::~ColumnarStore() {
+  if (base_ != nullptr) munmap(const_cast<char*>(base_), mapped_bytes_);
+  if (fd_ >= 0) close(fd_);
+}
+
+Status ColumnarStore::Open(const std::string& path,
+                           std::shared_ptr<ColumnarStore>* out) {
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) return Status::IOError("cannot open " + path);
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 16) {
+    close(fd);
+    return Status::IOError("bad columnar store " + path);
+  }
+  size_t size = static_cast<size_t>(st.st_size);
+  void* base = mmap(nullptr, size, PROT_READ, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return Status::IOError("mmap failed on " + path);
+  }
+  auto store = std::shared_ptr<ColumnarStore>(new ColumnarStore());
+  store->path_ = path;
+  store->fd_ = fd;
+  store->base_ = static_cast<const char*>(base);
+  store->mapped_bytes_ = size;
+
+  ByteReader r(store->base_, size);
+  char magic[4];
+  uint32_t ver = 0, n_cols = 0;
+  if (!r.GetRaw(magic, 4) || std::memcmp(magic, kStoreMagic, 4) != 0)
+    return Status::IOError("bad store magic in " + path);
+  if (!r.Get(&ver) || ver != kStoreVersion)
+    return Status::IOError("unsupported store version in " + path);
+  if (!r.Get(&store->epoch_) || !r.Get(&n_cols))
+    return Status::IOError("truncated store header in " + path);
+  for (uint32_t i = 0; i < n_cols; ++i) {
+    std::string name;
+    uint32_t elem_size = 0;
+    uint64_t count = 0, off = 0;
+    if (!r.GetStr(&name) || !r.Get(&elem_size) || !r.Get(&count) ||
+        !r.Get(&off))
+      return Status::IOError("truncated store column table in " + path);
+    if (off + count * elem_size > size)
+      return Status::IOError("column " + name + " exceeds file in " + path);
+    Column c;
+    c.data = store->base_ + off;
+    c.count = count;
+    c.elem_size = elem_size;
+    store->cols_[name] = c;
+  }
+  *out = std::move(store);
+  return Status::OK();
+}
+
+const ColumnarStore::Column* ColumnarStore::aux() const {
+  auto it = cols_.find("aux");
+  return it == cols_.end() ? nullptr : &it->second;
+}
+
+// ---------------------------------------------------------------------------
+// StorageTier
+// ---------------------------------------------------------------------------
+StorageTier::StorageTier(std::shared_ptr<ColumnarStore> store)
+    : store_(std::move(store)) {
+  std::lock_guard<std::mutex> lk(TierRegMu());
+  TierReg().insert(this);
+}
+
+StorageTier::~StorageTier() {
+  std::lock_guard<std::mutex> lk(TierRegMu());
+  TierReg().erase(this);
+}
+
+void StorageTier::OnRowAccess(uint32_t row) {
+  StoreCounters& c = GlobalStoreCounters();
+  if (IsHot(row)) {
+    c.hot_hits.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  c.cold_reads.fetch_add(1, std::memory_order_relaxed);
+  if (adj_offsets_ == nullptr || row >= n_rows_) return;
+  // Pre-fault the row's adjacency pages under the cold-read timer: the
+  // gather that follows would take these faults anyway; fronting them
+  // here makes the penalty a measured, bucketed quantity instead of
+  // noise smeared over the request.
+  uint64_t b = adj_offsets_[static_cast<uint64_t>(row) * num_edge_types_];
+  uint64_t e = adj_offsets_[static_cast<uint64_t>(row + 1) * num_edge_types_];
+  int64_t t0 = MonoNowUs();
+  if (e > b) {
+    // cap the touch at 1024 pages per array — a pathological hub read
+    // must not stall the timer for seconds
+    size_t nbytes8 = std::min<size_t>((e - b) * 8, 1024 * kPage);
+    size_t nbytes4 = std::min<size_t>((e - b) * 4, 1024 * kPage);
+    volatile const char* p;
+    unsigned sink = 0;
+    if (adj_nbr_ != nullptr) {
+      p = adj_nbr_ + b * 8;
+      for (size_t o = 0; o < nbytes8; o += kPage) sink += p[o];
+    }
+    if (adj_w_ != nullptr) {
+      p = adj_w_ + b * 4;
+      for (size_t o = 0; o < nbytes4; o += kPage) sink += p[o];
+    }
+    if (adj_cumw_ != nullptr) {
+      p = adj_cumw_ + b * 4;
+      for (size_t o = 0; o < nbytes4; o += kPage) sink += p[o];
+    }
+    (void)sink;
+  }
+  c.cold_hist.Observe(static_cast<uint64_t>(MonoNowUs() - t0));
+}
+
+int64_t StorageTier::PollResidentBytes() {
+  std::lock_guard<std::mutex> lk(resid_mu_);
+  size_t pages = (store_->mapped_bytes() + kPage - 1) / kPage;
+  std::vector<unsigned char> now(pages, 0);
+  if (mincore(const_cast<char*>(store_->base()), store_->mapped_bytes(),
+              now.data()) != 0)
+    return -1;
+  int64_t resident = 0;
+  uint64_t in = 0, out = 0;
+  bool have_prev = prev_resident_.size() == pages;
+  for (size_t i = 0; i < pages; ++i) {
+    bool r = (now[i] & 1) != 0;
+    if (r) ++resident;
+    if (have_prev) {
+      bool was = (prev_resident_[i] & 1) != 0;
+      if (r && !was) ++in;
+      if (!r && was) ++out;
+    } else if (r) {
+      ++in;  // first poll: everything resident was paged in since attach
+    }
+  }
+  prev_resident_ = std::move(now);
+  StoreCounters& c = GlobalStoreCounters();
+  c.page_in.fetch_add(in, std::memory_order_relaxed);
+  c.page_out.fetch_add(out, std::memory_order_relaxed);
+  return resident * static_cast<int64_t>(kPage);
+}
+
+void StorageTier::GlobalResidency(int64_t* resident, int64_t* mapped,
+                                  int64_t* hot_pinned) {
+  *resident = 0;
+  *mapped = 0;
+  *hot_pinned = 0;
+  std::vector<StorageTier*> tiers;
+  {
+    std::lock_guard<std::mutex> lk(TierRegMu());
+    tiers.assign(TierReg().begin(), TierReg().end());
+  }
+  for (StorageTier* t : tiers) {
+    int64_t r = t->PollResidentBytes();
+    if (r > 0) *resident += r;
+    *mapped += static_cast<int64_t>(t->mapped_bytes());
+    *hot_pinned += t->hot_pinned_bytes();
+  }
+}
+
+void StoreStatsSnapshot(uint64_t out[kStoreStatSlots]) {
+  StoreCounters& c = GlobalStoreCounters();
+  int64_t resident = 0, mapped = 0, pinned = 0;
+  StorageTier::GlobalResidency(&resident, &mapped, &pinned);
+  out[0] = c.hot_hits.load();
+  out[1] = c.cold_reads.load();
+  out[2] = c.page_in.load();
+  out[3] = c.page_out.load();
+  out[4] = static_cast<uint64_t>(resident);
+  out[5] = static_cast<uint64_t>(mapped);
+  out[6] = static_cast<uint64_t>(pinned);
+  out[7] = c.attaches.load();
+  c.cold_hist.Snapshot(&out[8], &out[9], &out[10]);
+}
+
+// Graph-side hook (declared in graph.h; lives here so graph.cc does not
+// need store.h).
+void Graph::TierTouchRow(uint32_t idx) const { tier_raw_->OnRowAccess(idx); }
+
+// ---------------------------------------------------------------------------
+// Attach
+// ---------------------------------------------------------------------------
+namespace {
+
+template <typename T>
+Status AttachCol(const ColumnarStore& s, const std::string& name,
+                 Col<T>* col) {
+  const T* p = nullptr;
+  size_t n = 0;
+  if (!s.Find(name, &p, &n))
+    return Status::IOError("store missing column " + name);
+  col->AttachExternal(p, n);
+  return Status::OK();
+}
+
+}  // namespace
+
+Status StoreAccess::Attach(std::shared_ptr<ColumnarStore> store,
+                           int64_t hot_bytes, std::unique_ptr<Graph>* out) {
+  auto g = std::unique_ptr<Graph>(new Graph());
+  const ColumnarStore& s = *store;
+
+  // aux: meta + scalars
+  const ColumnarStore::Column* aux = s.aux();
+  if (aux == nullptr) return Status::IOError("store has no aux section");
+  ByteReader r(static_cast<const char*>(aux->data), aux->count);
+  ET_RETURN_IF_ERROR(DecodeMeta(&r, &g->meta_));
+  uint64_t dense_base = 0;
+  if (!r.Get(&dense_base)) return Status::IOError("truncated store aux");
+  g->dense_base_ = dense_base;
+  auto get_floats = [&r](std::vector<float>* v) {
+    uint32_t n;
+    if (!r.Get(&n)) return false;
+    v->resize(n);
+    for (uint32_t i = 0; i < n; ++i)
+      if (!r.Get(&(*v)[i])) return false;
+    return true;
+  };
+  if (!get_floats(&g->node_type_wsum_) || !get_floats(&g->edge_type_wsum_))
+    return Status::IOError("truncated store aux (wsums)");
+  float ns_total = 0.f, es_total = 0.f;
+  std::vector<float> ns_tot_t, es_tot_t;
+  if (!r.Get(&ns_total) || !r.Get(&es_total) || !get_floats(&ns_tot_t) ||
+      !get_floats(&es_tot_t))
+    return Status::IOError("truncated store aux (sampler totals)");
+
+  // columns
+  ET_RETURN_IF_ERROR(AttachCol(s, "node_ids", &g->node_ids_));
+  ET_RETURN_IF_ERROR(AttachCol(s, "node_types", &g->node_types_));
+  ET_RETURN_IF_ERROR(AttachCol(s, "node_weights", &g->node_weights_));
+  ET_RETURN_IF_ERROR(AttachCol(s, "dense_idx", &g->dense_idx_));
+  ET_RETURN_IF_ERROR(AttachCol(s, "graph_labels", &g->graph_labels_));
+  ET_RETURN_IF_ERROR(AttachCol(s, "adj_offsets", &g->adj_offsets_));
+  ET_RETURN_IF_ERROR(AttachCol(s, "adj_nbr", &g->adj_nbr_));
+  ET_RETURN_IF_ERROR(AttachCol(s, "adj_w", &g->adj_w_));
+  ET_RETURN_IF_ERROR(AttachCol(s, "adj_cumw", &g->adj_cumw_));
+  ET_RETURN_IF_ERROR(AttachCol(s, "in_adj_offsets", &g->in_adj_offsets_));
+  ET_RETURN_IF_ERROR(AttachCol(s, "in_adj_nbr", &g->in_adj_nbr_));
+  ET_RETURN_IF_ERROR(AttachCol(s, "in_adj_w", &g->in_adj_w_));
+  ET_RETURN_IF_ERROR(AttachCol(s, "in_adj_cumw", &g->in_adj_cumw_));
+
+  const int NT = std::max(1, g->meta_.num_node_types);
+  const int ET = std::max(1, g->meta_.num_edge_types);
+  g->nodes_by_type_.resize(NT);
+  for (int t = 0; t < NT; ++t)
+    ET_RETURN_IF_ERROR(
+        AttachCol(s, "nbt_" + std::to_string(t), &g->nodes_by_type_[t]));
+  g->edges_by_type_.resize(ET);
+  for (int t = 0; t < ET; ++t)
+    ET_RETURN_IF_ERROR(
+        AttachCol(s, "ebt_" + std::to_string(t), &g->edges_by_type_[t]));
+
+  auto attach_sampler = [&s](const std::string& p_name,
+                             const std::string& a_name, float total,
+                             AliasSampler* samp) -> Status {
+    const float* prob = nullptr;
+    const uint32_t* alias = nullptr;
+    size_t np = 0, na = 0;
+    if (!s.Find(p_name, &prob, &np) || !s.Find(a_name, &alias, &na))
+      return Status::IOError("store missing sampler " + p_name);
+    if (np != na) return Status::IOError("sampler size mismatch " + p_name);
+    samp->Attach(prob, alias, np, total);
+    return Status::OK();
+  };
+  ET_RETURN_IF_ERROR(
+      attach_sampler("nsp_all", "nsa_all", ns_total, &g->node_sampler_all_));
+  ET_RETURN_IF_ERROR(
+      attach_sampler("esp_all", "esa_all", es_total, &g->edge_sampler_all_));
+  if (ns_tot_t.size() != static_cast<size_t>(NT) ||
+      es_tot_t.size() != static_cast<size_t>(ET))
+    return Status::IOError("store sampler totals do not match type counts");
+  g->node_sampler_by_type_.resize(NT);
+  for (int t = 0; t < NT; ++t)
+    ET_RETURN_IF_ERROR(attach_sampler("nsp_" + std::to_string(t),
+                                      "nsa_" + std::to_string(t), ns_tot_t[t],
+                                      &g->node_sampler_by_type_[t]));
+  g->edge_sampler_by_type_.resize(ET);
+  for (int t = 0; t < ET; ++t)
+    ET_RETURN_IF_ERROR(attach_sampler("esp_" + std::to_string(t),
+                                      "esa_" + std::to_string(t), es_tot_t[t],
+                                      &g->edge_sampler_by_type_[t]));
+
+  size_t nnf = g->meta_.node_features.size();
+  size_t nef = g->meta_.edge_features.size();
+  g->node_dense_.resize(nnf);
+  g->node_var_.resize(nnf);
+  for (size_t f = 0; f < nnf; ++f) {
+    ET_RETURN_IF_ERROR(
+        AttachCol(s, "nd_" + std::to_string(f), &g->node_dense_[f]));
+    ET_RETURN_IF_ERROR(
+        AttachCol(s, "nvo_" + std::to_string(f), &g->node_var_[f].offsets));
+    ET_RETURN_IF_ERROR(
+        AttachCol(s, "nvu_" + std::to_string(f), &g->node_var_[f].values_u64));
+    ET_RETURN_IF_ERROR(AttachCol(s, "nvb_" + std::to_string(f),
+                                 &g->node_var_[f].values_bytes));
+  }
+  g->edge_dense_.resize(nef);
+  g->edge_var_.resize(nef);
+  for (size_t f = 0; f < nef; ++f) {
+    ET_RETURN_IF_ERROR(
+        AttachCol(s, "ed_" + std::to_string(f), &g->edge_dense_[f]));
+    ET_RETURN_IF_ERROR(
+        AttachCol(s, "evo_" + std::to_string(f), &g->edge_var_[f].offsets));
+    ET_RETURN_IF_ERROR(
+        AttachCol(s, "evu_" + std::to_string(f), &g->edge_var_[f].values_u64));
+    ET_RETURN_IF_ERROR(AttachCol(s, "evb_" + std::to_string(f),
+                                 &g->edge_var_[f].values_bytes));
+  }
+
+  // small derived state the store does not carry. CRITICAL: all reads
+  // below go through `cg` — a non-const Col access (operator[]/data())
+  // resolves to the OWNING-mode mutator overload, which silently
+  // detaches the just-attached column back to an empty heap vector.
+  const Graph& cg = *g;
+  const size_t N = cg.node_ids_.size();
+  if (cg.dense_idx_.empty()) {
+    // no compact-id table: rebuild the hash fallback (O(N) heap — the
+    // one index the out-of-core tier keeps in RAM for sparse id spaces)
+    g->id2idx_.reserve(N);
+    for (size_t i = 0; i < N; ++i)
+      g->id2idx_[cg.node_ids_[i]] = static_cast<uint32_t>(i);
+  }
+  if (!cg.graph_labels_.empty()) {
+    for (size_t i = 0; i < N && i < cg.graph_labels_.size(); ++i) {
+      uint64_t gl = cg.graph_labels_[i];
+      if (gl != 0) g->label_rows_[gl].push_back(static_cast<uint32_t>(i));
+    }
+    g->label_ids_.reserve(g->label_rows_.size());
+    for (const auto& kv : g->label_rows_) g->label_ids_.push_back(kv.first);
+    std::sort(g->label_ids_.begin(), g->label_ids_.end());
+  }
+  g->epoch_ = store->epoch();
+
+  // storage tier: hub-first hot set + accounting
+  auto tier = std::make_shared<StorageTier>(store);
+  tier->n_rows_ = N;
+  tier->num_edge_types_ = ET;
+  tier->adj_offsets_ = cg.adj_offsets_.data();
+  tier->adj_nbr_ = reinterpret_cast<const char*>(cg.adj_nbr_.data());
+  tier->adj_w_ = reinterpret_cast<const char*>(cg.adj_w_.data());
+  tier->adj_cumw_ = reinterpret_cast<const char*>(cg.adj_cumw_.data());
+  for (size_t f = 0; f < cg.node_dense_.size(); ++f) {
+    if (cg.node_dense_[f].empty() || N == 0) continue;
+    tier->dense_rows_.push_back(
+        {reinterpret_cast<const char*>(cg.node_dense_[f].data()),
+         cg.node_dense_[f].size() / N * sizeof(float)});
+  }
+  tier->hot_bytes_ = hot_bytes;
+  tier->hot_.assign((N + 63) / 64, 0);
+  if (hot_bytes > 0 && N > 0 && !cg.adj_offsets_.empty()) {
+    // hub-first: order rows by out-degree (the degree statistics the
+    // device hub tables use) and pin until the byte budget is spent
+    std::vector<std::pair<uint64_t, uint32_t>> by_deg(N);
+    for (size_t i = 0; i < N; ++i) {
+      uint64_t deg =
+          cg.adj_offsets_[(i + 1) * ET] - cg.adj_offsets_[i * ET];
+      by_deg[i] = {deg, static_cast<uint32_t>(i)};
+    }
+    std::sort(by_deg.begin(), by_deg.end(),
+              [](const auto& a, const auto& b) {
+                return a.first != b.first ? a.first > b.first
+                                          : a.second < b.second;
+              });
+    size_t dense_row_bytes = 0;
+    for (const auto& dr : tier->dense_rows_) dense_row_bytes += dr.second;
+    int64_t spent = 0;
+    bool try_mlock = true;
+    for (const auto& dv : by_deg) {
+      uint32_t row = dv.second;
+      int64_t row_bytes =
+          static_cast<int64_t>(dv.first) * (8 + 4 + 4) +
+          static_cast<int64_t>(dense_row_bytes) + 8 /* node arrays */;
+      if (spent + row_bytes > hot_bytes && tier->hot_rows_ > 0) break;
+      tier->hot_[row >> 6] |= 1ULL << (row & 63);
+      ++tier->hot_rows_;
+      spent += row_bytes;
+      // pre-fault + advise + best-effort mlock of the row's adjacency
+      uint64_t b = cg.adj_offsets_[static_cast<uint64_t>(row) * ET];
+      uint64_t e = cg.adj_offsets_[static_cast<uint64_t>(row + 1) * ET];
+      auto pin = [&](const char* base, size_t lo, size_t hi) {
+        if (base == nullptr || hi <= lo) return;
+        uintptr_t start = reinterpret_cast<uintptr_t>(base + lo) & ~(kPage - 1);
+        uintptr_t end = reinterpret_cast<uintptr_t>(base + hi);
+        madvise(reinterpret_cast<void*>(start), end - start, MADV_WILLNEED);
+        volatile const char* p = base + lo;
+        for (size_t o = 0; o < hi - lo; o += kPage) (void)p[o];
+        (void)p[hi - lo - 1];
+        if (try_mlock &&
+            mlock(reinterpret_cast<void*>(start), end - start) == 0) {
+          tier->mlocked_bytes_ += static_cast<int64_t>(end - start);
+        } else if (try_mlock) {
+          try_mlock = false;  // RLIMIT_MEMLOCK exhausted: touch-only
+        }
+      };
+      pin(tier->adj_nbr_, b * 8, e * 8);
+      pin(tier->adj_w_, b * 4, e * 4);
+      pin(tier->adj_cumw_, b * 4, e * 4);
+      for (const auto& dr : tier->dense_rows_)
+        pin(dr.first, static_cast<size_t>(row) * dr.second,
+            static_cast<size_t>(row + 1) * dr.second);
+      if (spent >= hot_bytes) break;
+    }
+    tier->hot_pinned_bytes_ = spent;
+  }
+  g->store_ = std::move(store);
+  g->tier_ = tier;
+  g->tier_raw_ = tier.get();
+  GlobalStoreCounters().attaches.fetch_add(1);
+  *out = std::move(g);
+  return Status::OK();
+}
+
+Status LoadGraphFromStore(const std::string& path, int64_t hot_bytes,
+                          std::unique_ptr<Graph>* out) {
+  std::shared_ptr<ColumnarStore> store;
+  ET_RETURN_IF_ERROR(ColumnarStore::Open(path, &store));
+  ET_RETURN_IF_ERROR(StoreAccess::Attach(std::move(store), hot_bytes, out));
+  ET_LOG(INFO) << "attached graph from columnar store " << path << " ("
+               << (*out)->node_count() << " nodes, " << (*out)->edge_count()
+               << " edges, hot_rows=" << (*out)->tier()->hot_rows() << ")";
+  return Status::OK();
+}
+
+}  // namespace et

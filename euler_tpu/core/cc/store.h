@@ -1,0 +1,193 @@
+// Out-of-core graph tier: mmap'd columnar store + hub-pinned hot set.
+//
+// The storage hierarchy beneath the immutable-snapshot GraphRef. A
+// finalized Graph's big columns (CSR adjacency, feature matrices, alias
+// tables — everything O(N) or O(E)) serialize verbatim into one
+// columnar file; LoadGraphFromStore maps that file PROT_READ/MAP_SHARED
+// and attaches every Col<T> (col.h) to it, producing a Graph that is
+// byte-identical to its heap twin — same arrays, same row order, same
+// alias tables, so every sampler draw and feature read matches the
+// in-RAM engine exactly — while the page cache, not the heap, owns the
+// bytes. RAM then holds only an explicit HOT SET, chosen hub-first by
+// out-degree (the same degree statistics the device tables use): hub
+// rows' adjacency + dense-feature pages are pre-faulted, advised
+// MADV_WILLNEED, and mlock'd as far as RLIMIT_MEMLOCK allows.
+//
+// Who writes the file: WAL compaction (wal.cc DeltaWal::Compact) emits
+// `columnar.etc` beside each snapshot generation when the sidecar is
+// enabled — the on-disk tier's writer for free — and recovery/start
+// paths write a boot store when attaching a graph that has none yet.
+// A delta apply still builds its new snapshot on the heap (the RAM
+// overlay above the mmap base); the next compaction re-spills it to a
+// new columnar generation and the server re-attaches.
+//
+// Accounting (the observable half of the 10×-RAM claim):
+//   * hot_hits / cold_reads — every row-addressed accessor classifies
+//     the row against the hot bitmask (Graph::TouchRow); hub reads
+//     never count as cold.
+//   * cold-read latency — a cold row's adjacency pages are touched
+//     (pre-faulted) under a timer; the log2-µs histogram rides the
+//     ServerTraceStats bucket convention (rpc.h LatencyHist).
+//   * page_in / page_out / resident_bytes — mincore() polling over the
+//     mapping, diffed page-by-page between polls.
+// All counters are process-global (StoreCounters, the WalCounters
+// pattern) and exported through etg_store_stats / gql.store_stats().
+#ifndef EULER_TPU_STORE_H_
+#define EULER_TPU_STORE_H_
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "graph.h"
+#include "rpc.h"  // LatencyHist — the shared log2-µs bucket convention
+
+namespace et {
+
+// Default sidecar file name, written beside meta.bin / part_*.dat in a
+// data or snapshot directory.
+extern const char kColumnarFileName[];  // "columnar.etc"
+
+// Process-global out-of-core counters (obs mirrors them via
+// etg_store_stats — same pattern as WalCounters/RpcCounters).
+struct StoreCounters {
+  std::atomic<uint64_t> hot_hits{0};    // row reads that hit the hot set
+  std::atomic<uint64_t> cold_reads{0};  // row reads outside it
+  std::atomic<uint64_t> page_in{0};     // pages that became resident
+  std::atomic<uint64_t> page_out{0};    // pages the kernel evicted
+  std::atomic<uint64_t> attaches{0};    // graphs attached over process life
+  LatencyHist cold_hist;                // cold-read page-in latency (µs)
+};
+StoreCounters& GlobalStoreCounters();
+
+// One mmap'd columnar store file. Owns the fd + mapping; Graphs attach
+// their Col<T> members to the mapped columns and hold a shared_ptr so
+// the mapping outlives every reader.
+class ColumnarStore {
+ public:
+  ~ColumnarStore();
+
+  static Status Open(const std::string& path,
+                     std::shared_ptr<ColumnarStore>* out);
+
+  struct Column {
+    const void* data = nullptr;
+    uint64_t count = 0;
+    uint32_t elem_size = 0;
+  };
+  // Typed lookup; returns (nullptr, 0) for an absent or empty column —
+  // attaching that yields an empty Col, which is exactly what an empty
+  // vector serialized to.
+  template <typename T>
+  bool Find(const std::string& name, const T** ptr, size_t* n) const {
+    auto it = cols_.find(name);
+    if (it == cols_.end() || it->second.count == 0) {
+      *ptr = nullptr;
+      *n = 0;
+      return it != cols_.end();
+    }
+    *ptr = static_cast<const T*>(it->second.data);
+    *n = static_cast<size_t>(it->second.count);
+    return true;
+  }
+  bool Has(const std::string& name) const { return cols_.count(name) != 0; }
+  // Raw aux blob (meta + scalars section).
+  const Column* aux() const;
+
+  const std::string& path() const { return path_; }
+  uint64_t epoch() const { return epoch_; }
+  const char* base() const { return base_; }
+  size_t mapped_bytes() const { return mapped_bytes_; }
+
+ private:
+  ColumnarStore() = default;
+  std::string path_;
+  int fd_ = -1;
+  const char* base_ = nullptr;
+  size_t mapped_bytes_ = 0;
+  uint64_t epoch_ = 0;
+  std::unordered_map<std::string, Column> cols_;
+};
+
+// Hot-set accounting + residency tracking for one attached Graph.
+// Immutable after Build (the hot bitmask never changes for a given
+// snapshot); counters go to GlobalStoreCounters.
+class StorageTier {
+ public:
+  explicit StorageTier(std::shared_ptr<ColumnarStore> store);
+  ~StorageTier();
+
+  // Row-access classification (Graph::TouchRow hook). Hot rows count a
+  // hit and return immediately; cold rows count a read and pre-fault
+  // the row's adjacency pages under the cold-read timer.
+  void OnRowAccess(uint32_t row);
+
+  bool IsHot(uint32_t row) const {
+    return row < n_rows_ && ((hot_[row >> 6] >> (row & 63)) & 1) != 0;
+  }
+  size_t hot_rows() const { return hot_rows_; }
+  int64_t hot_bytes_budget() const { return hot_bytes_; }
+  int64_t hot_pinned_bytes() const { return hot_pinned_bytes_; }
+  int64_t mlocked_bytes() const { return mlocked_bytes_; }
+  size_t mapped_bytes() const { return store_->mapped_bytes(); }
+
+  // mincore() poll over the whole mapping: returns resident bytes and
+  // accumulates page_in/page_out deltas into the global counters.
+  int64_t PollResidentBytes();
+
+  // Sum of PollResidentBytes / mapped bytes / pinned bytes over every
+  // live tier in the process (the etg_store_stats gauges).
+  static void GlobalResidency(int64_t* resident, int64_t* mapped,
+                              int64_t* hot_pinned);
+
+ private:
+  friend struct StoreAccess;  // Build() wiring (store.cc)
+
+  std::shared_ptr<ColumnarStore> store_;
+  size_t n_rows_ = 0;
+  int num_edge_types_ = 1;
+  const uint64_t* adj_offsets_ = nullptr;  // n_rows*ET + 1
+  const char* adj_nbr_ = nullptr;   // spans touched on cold reads
+  const char* adj_w_ = nullptr;
+  const char* adj_cumw_ = nullptr;
+  // per-row dense feature ranges: (base, bytes_per_row)
+  std::vector<std::pair<const char*, size_t>> dense_rows_;
+  std::vector<uint64_t> hot_;  // bitmask over rows
+  size_t hot_rows_ = 0;
+  int64_t hot_bytes_ = 0;
+  int64_t hot_pinned_bytes_ = 0;
+  int64_t mlocked_bytes_ = 0;
+  std::mutex resid_mu_;
+  std::vector<unsigned char> prev_resident_;  // mincore bitmap, last poll
+};
+
+// Serialize a finalized graph's columns into `path` (atomic tmp+rename).
+// The written arrays are the graph's in-memory arrays verbatim — the
+// byte-parity invariant the sampling tests pin.
+Status WriteColumnarStore(const Graph& g, const std::string& path);
+
+// Open `path` and build an attached Graph over it: every big column
+// mmap'd, hot set of `hot_bytes` chosen hub-first, heap holding only
+// small derived state (id hash when the dense id table is absent,
+// label maps). The result is byte-identical to the graph that wrote
+// the store.
+Status LoadGraphFromStore(const std::string& path, int64_t hot_bytes,
+                          std::unique_ptr<Graph>* out);
+
+// Flat stats export (capi etg_store_stats). Slot order:
+//   0 hot_hits | 1 cold_reads | 2 page_in | 3 page_out
+//   4 resident_bytes | 5 mapped_bytes | 6 hot_pinned_bytes | 7 attaches
+//   8 cold_n | 9 cold_sum_us | 10..34 cold log2-µs bucket counts
+// (buckets follow the ServerTraceStats convention: 24 bounds 1µs..2^23µs
+// + overflow). Polls residency on every call.
+constexpr int kStoreStatSlots = 35;
+void StoreStatsSnapshot(uint64_t out[kStoreStatSlots]);
+
+}  // namespace et
+
+#endif  // EULER_TPU_STORE_H_

@@ -10,7 +10,10 @@
 #include <cerrno>
 #include <cstring>
 
+#include <cstdlib>
+
 #include "io.h"
+#include "store.h"
 
 namespace et {
 
@@ -176,6 +179,8 @@ Status DeltaWal::Open(const std::string& dir, FsyncPolicy fsync,
   wal->dir_ = dir;
   wal->fsync_ = fsync;
   wal->compact_bytes_ = compact_bytes;
+  const char* col = std::getenv("ETG_WAL_COLUMNAR");
+  if (col != nullptr && col[0] == '1') wal->columnar_sidecar_ = true;
   ET_RETURN_IF_ERROR(wal->OpenActiveLog());
   *out = std::move(wal);
   return Status::OK();
@@ -285,9 +290,19 @@ Status DeltaWal::Compact(const Graph& g) {
   const std::string epoch_str = std::to_string(epoch);
   ET_RETURN_IF_ERROR(WriteStringToFile(tmp_dir + "/EPOCH", epoch_str.data(),
                                        epoch_str.size()));
+  if (columnar_sidecar_) {
+    // out-of-core tier writer: the same snapshot generation doubles as
+    // the mmap base the server can re-attach (store.h)
+    Status cs = WriteColumnarStore(
+        g, tmp_dir + "/" + std::string(kColumnarFileName));
+    if (!cs.ok())
+      ET_LOG(WARNING) << "wal " << dir_ << ": columnar sidecar failed ("
+                      << cs.message() << ") — snapshot published without it";
+  }
   if (::rename(tmp_dir.c_str(), snap_dir.c_str()) != 0)
     return Status::IOError("cannot publish snapshot " + snap_dir + ": " +
                            std::strerror(errno));
+  last_snapshot_dir_ = snap_dir;
   // CURRENT flip is itself temp+rename — a crash leaves either the old
   // or the new pointer, never a torn file
   const std::string cur_tmp = dir_ + "/CURRENT.tmp";
@@ -468,7 +483,7 @@ Status RecoverShard(const std::string& wal_dir, const std::string& data_dir,
                     int shard_idx, int shard_num, bool build_in_adjacency,
                     std::unique_ptr<Graph>* out, uint64_t* replayed,
                     std::vector<WalRecord>* records_out, bool* gap_out,
-                    OwnershipMap* omap_out) {
+                    OwnershipMap* omap_out, int storage, int64_t hot_bytes) {
   if (replayed != nullptr) *replayed = 0;
   if (gap_out != nullptr) *gap_out = false;
   // persisted ownership map (kSetOwnership wrote it beside the log):
@@ -495,20 +510,53 @@ Status RecoverShard(const std::string& wal_dir, const std::string& data_dir,
   uint64_t snap_epoch = 0;
   ET_RETURN_IF_ERROR(
       DeltaWal::ReadCurrentSnapshot(wal_dir, &snap_name, &snap_epoch));
-  std::unique_ptr<Graph> g;
-  if (!snap_name.empty()) {
-    ET_RETURN_IF_ERROR(LoadShard(wal_dir + "/" + snap_name, shard_idx,
-                                 shard_num, /*data_type=*/0,
-                                 build_in_adjacency, &g));
-    g->set_epoch(snap_epoch);
-    ET_LOG(INFO) << "wal recovery: shard " << shard_idx << " loaded "
-                 << snap_name << " (epoch " << snap_epoch << ")";
-  } else {
-    ET_RETURN_IF_ERROR(LoadShard(data_dir, shard_idx, shard_num,
-                                 /*data_type=*/0, build_in_adjacency, &g));
-  }
+  // Records are read BEFORE loading the base: with nothing to replay
+  // and a columnar sidecar beside the base, the out-of-core path can
+  // attach the mmap directly and never materialize the graph on heap —
+  // the fast restart the 10×-RAM tier exists for.
   std::vector<WalRecord> recs;
   ET_RETURN_IF_ERROR(DeltaWal::ReadAll(wal_dir, &recs));
+  const std::string base_dir =
+      snap_name.empty() ? data_dir : wal_dir + "/" + snap_name;
+  std::unique_ptr<Graph> g;
+  if (storage == 1) {
+    bool pending = false;
+    for (const auto& rec : recs)
+      if (rec.epoch > snap_epoch) pending = true;
+    const std::string sidecar = base_dir + "/" + kColumnarFileName;
+    struct stat sst;
+    if (!pending && ::stat(sidecar.c_str(), &sst) == 0) {
+      std::unique_ptr<Graph> attached;
+      Status as = LoadGraphFromStore(sidecar, hot_bytes, &attached);
+      if (as.ok() && build_in_adjacency && !attached->has_in_adjacency() &&
+          attached->edge_count() > 0) {
+        // sidecar written without in-adjacency but the server wants it:
+        // fall back to the heap build below
+        as = Status::IOError("sidecar lacks in-adjacency");
+        attached.reset();
+      }
+      if (as.ok()) {
+        attached->set_epoch(snap_epoch);
+        ET_LOG(INFO) << "wal recovery: shard " << shard_idx
+                     << " attached columnar sidecar " << sidecar
+                     << " (epoch " << snap_epoch << ", no replay)";
+        g = std::move(attached);
+      } else {
+        ET_LOG(WARNING) << "wal recovery: columnar sidecar " << sidecar
+                        << " unusable (" << as.message()
+                        << ") — recovering on heap";
+      }
+    }
+  }
+  if (g == nullptr) {
+    ET_RETURN_IF_ERROR(LoadShard(base_dir, shard_idx, shard_num,
+                                 /*data_type=*/0, build_in_adjacency, &g));
+    if (!snap_name.empty()) {
+      g->set_epoch(snap_epoch);
+      ET_LOG(INFO) << "wal recovery: shard " << shard_idx << " loaded "
+                   << snap_name << " (epoch " << snap_epoch << ")";
+    }
+  }
   uint64_t applied = 0;
   for (const auto& rec : recs) {
     uint64_t cur = g->epoch();
@@ -550,6 +598,28 @@ Status RecoverShard(const std::string& wal_dir, const std::string& data_dir,
     ET_LOG(INFO) << "wal recovery: shard " << shard_idx << " replayed "
                  << applied << " record(s) -> epoch " << g->epoch();
   if (records_out != nullptr) *records_out = std::move(recs);
+  if (storage == 1 && !g->attached()) {
+    // heap recovery under the out-of-core mode: spill a boot store
+    // beside the log and re-attach so serving starts mmap'd even when
+    // replay was needed. Failure degrades to serving the heap graph.
+    const std::string boot = wal_dir + "/boot_columnar.etc";
+    // first-ever start: RecoverShard runs before DeltaWal::Open creates
+    // the log directory, so the spill must create it itself
+    ::mkdir(wal_dir.c_str(), 0755);
+    Status ws = WriteColumnarStore(*g, boot);
+    if (ws.ok()) {
+      std::unique_ptr<Graph> attached;
+      uint64_t ep = g->epoch();
+      ws = LoadGraphFromStore(boot, hot_bytes, &attached);
+      if (ws.ok()) {
+        attached->set_epoch(ep);
+        g = std::move(attached);
+      }
+    }
+    if (!ws.ok())
+      ET_LOG(WARNING) << "wal recovery: boot columnar store failed ("
+                      << ws.message() << ") — serving from heap";
+  }
   *out = std::move(g);
   return Status::OK();
 }

@@ -130,6 +130,21 @@ class DeltaWal {
     return compact_bytes_ > 0 && log_bytes_ >= compact_bytes_;
   }
 
+  // Out-of-core sidecar: when enabled, Compact also writes the graph as
+  // a columnar store (store.h, "columnar.etc") inside the snapshot dir —
+  // compaction doubles as the on-disk tier's writer, and the server can
+  // re-attach the fresh generation mmap'd instead of keeping the heap
+  // copy. Defaults from ETG_WAL_COLUMNAR at Open ("1" enables); servers
+  // started with storage="mmap" force it on. Sidecar write failure
+  // degrades to a plain snapshot (warning) — recovery and reattach
+  // simply skip the missing file.
+  void set_columnar_sidecar(bool on) { columnar_sidecar_ = on; }
+  bool columnar_sidecar() const { return columnar_sidecar_; }
+  // Directory of the most recent snapshot THIS instance published (""
+  // until the first Compact) — where the reattach path looks for the
+  // sidecar without re-reading CURRENT.
+  const std::string& last_snapshot_dir() const { return last_snapshot_dir_; }
+
  private:
   DeltaWal() = default;
   Status OpenActiveLog();
@@ -145,6 +160,8 @@ class DeltaWal {
   std::string active_path_;
   int64_t log_bytes_ = 0;   // bytes in the active generation
   bool degraded_ = false;   // this instance's contribution to the gauge
+  bool columnar_sidecar_ = false;
+  std::string last_snapshot_dir_;
 };
 
 // Decode a kApplyDelta wire body (the WAL record payload) into its
@@ -176,12 +193,21 @@ Status DecodeDeltaBody(const char* data, size_t size,
 // is found beside the log (see PersistOwnership) — replay re-filters
 // deltas under it, and the caller should re-install it on the server so
 // the recovered shard keeps refusing stale-map reads.
+// `storage` selects the recovered graph's storage tier: 0 = heap (the
+// default, unchanged behavior); 1 = mmap out-of-core (store.h). With
+// storage=1 and nothing to replay, a snapshot that carries a columnar
+// sidecar is attached directly (no heap materialization — the fast
+// restart path); otherwise recovery builds on the heap as usual, spills
+// a boot store ("boot_columnar.etc" beside the log), and re-attaches.
+// `hot_bytes` is the attached tier's hub hot-set budget. Attach
+// failures degrade to serving the heap graph with a warning.
 Status RecoverShard(const std::string& wal_dir, const std::string& data_dir,
                     int shard_idx, int shard_num, bool build_in_adjacency,
                     std::unique_ptr<Graph>* out, uint64_t* replayed,
                     std::vector<WalRecord>* records_out = nullptr,
                     bool* gap_out = nullptr,
-                    OwnershipMap* omap_out = nullptr);
+                    OwnershipMap* omap_out = nullptr,
+                    int storage = 0, int64_t hot_bytes = 0);
 
 // Elastic fleet: persist/read the shard's installed ownership-map spec
 // beside its WAL ("OWNERSHIP", atomic temp+rename) so crash-recovery

@@ -216,12 +216,21 @@ def _declare(lib: ctypes.CDLL) -> None:
         # durable form: + wal_dir, fsync_policy (0=never 1=always),
         # compact_bytes, catchup (registry anti-entropy on restart)
         "ets_start2": (i64, [ctypes.c_char_p, i32, i32, i32, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, i32, i64, i32]),
+        # out-of-core form: + storage (0=ram 1=mmap), hot_bytes (hub
+        # hot-set budget for the mmap tier)
+        "ets_start3": (i64, [ctypes.c_char_p, i32, i32, i32, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, i32, i64, i32, i32, i64]),
         "ets_epoch": (i64, [i64]),
         "ets_port": (i32, [i64]),
         "ets_stop": (i32, [i64]),
         # durability counters: appends, fsyncs, replayed_records,
         # compactions, catchup_deltas, refused, torn_records, degraded
         "etg_wal_stats": (None, [c_u64p]),
+        # out-of-core columnar store: write a handle's snapshot to a
+        # store file / mmap-attach one as a new handle / process-global
+        # tier counters (35 slots, store.h slot order)
+        "etg_store_write": (i32, [i64, ctypes.c_char_p]),
+        "etg_store_open": (i64, [ctypes.c_char_p, i64]),
+        "etg_store_stats": (None, [c_u64p]),
         "etr_start": (i64, [i32]),
         "etr_port": (i32, [i64]),
         "etr_stop": (i32, [i64]),

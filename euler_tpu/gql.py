@@ -25,6 +25,7 @@ values, udf, label, has, hasLabel, hasKey, hasId, orderBy, limit, as.
 from __future__ import annotations
 
 import ctypes
+import os
 import threading
 import weakref
 from typing import Dict, Optional
@@ -38,7 +39,7 @@ __all__ = ["Query", "GraphService", "start_service", "compile_debug",
            "register_udf", "udf_cache_stats", "udf_cache_clear",
            "udf_cache_set_capacity", "edge_types_str", "wal_stats",
            "push_ownership", "server_trace_hist", "server_trace_spans",
-           "server_trace_chrome"]
+           "server_trace_chrome", "store_stats", "cold_read_quantile"]
 
 
 def edge_types_str(edge_types) -> str:
@@ -717,12 +718,118 @@ def _ensure_wal_obs() -> None:
         _wal_obs_done = True
 
 
+# native out-of-core tier counter layout (etg_store_stats) — order must
+# match store.h kStoreStatSlots. Slots 10..34 are the cold-read log2-µs
+# histogram buckets (the _TRACE_BOUNDS_US convention + overflow).
+_STORE_STAT_KEYS = (
+    "hot_hits", "cold_reads", "page_in", "page_out", "resident_bytes",
+    "mapped_bytes", "hot_pinned_bytes", "attaches", "cold_n",
+    "cold_sum_us")
+
+_store_obs_done = False
+_store_obs_mu = threading.Lock()
+
+
+def store_stats() -> dict:
+    """Process-global out-of-core storage-tier counters (store.h):
+    hot-set hits vs cold row reads, mincore-observed page_in/page_out
+    and resident bytes across every live mmap'd graph, hot-set pinned
+    bytes, attach count, and the cold-read page-in latency histogram
+    under "cold_buckets" ([[le_us, count], ...] raw per-bucket counts).
+    All zeros when no graph is attached. Benches snapshot before/after
+    a leg and diff."""
+    lib = _libmod.load()
+    out = np.zeros(10 + 25, dtype=np.uint64)
+    lib.etg_store_stats(out.ctypes.data_as(_libmod.c_u64p))
+    d = {k: int(v) for k, v in zip(_STORE_STAT_KEYS, out)}
+    d["cold_buckets"] = [
+        [le, int(c)] for le, c in
+        zip(list(_TRACE_BOUNDS_US) + ["+Inf"], out[10:])]
+    return d
+
+
+def cold_read_quantile(q: float = 0.999, baseline: dict = None):
+    """Bucket-interpolated quantile (ms) of the cold-read page-in
+    latency histogram — the counted bound on the out-of-core tier's
+    miss penalty (bench_host --mode outcore's p999 gate). With
+    `baseline` (a prior store_stats snapshot), computes over the delta
+    since it. None when the (delta) histogram is empty."""
+    from euler_tpu.obs.metrics import bucket_quantile
+
+    counts = [c for _, c in store_stats()["cold_buckets"]]
+    if baseline is not None:
+        base = [c for _, c in baseline["cold_buckets"]]
+        counts = [max(c - b, 0) for c, b in zip(counts, base)]
+    if sum(counts) == 0:
+        return None
+    v = bucket_quantile(counts, _TRACE_BOUNDS_US, q)
+    return None if v is None else v / 1000.0
+
+
+def _process_rss_bytes() -> int:
+    """This process's resident set size, from /proc/self/status VmRSS
+    (kB). 0 where /proc is unavailable."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
+def _ensure_store_obs() -> None:
+    """Mirror the out-of-core tier counters into obs gauges — the
+    residency pair the 10×-RAM claim is judged by on /metrics
+    (process_rss_bytes vs graph_storage_resident_bytes +
+    graph_storage_mapped_bytes), plus hot/cold accounting — and expose
+    a "graph_storage" health provider. Once per process, first
+    storage="mmap" start_service (or explicit call)."""
+    global _store_obs_done
+    with _store_obs_mu:
+        if _store_obs_done:
+            return
+        from euler_tpu import obs as _obs
+
+        reg = _obs.default_registry()
+        names = {
+            "hot_hits": "graph_storage_hot_hits_total",
+            "cold_reads": "graph_storage_cold_reads_total",
+            "page_in": "graph_storage_page_in_total",
+            "page_out": "graph_storage_page_out_total",
+            "resident_bytes": "graph_storage_resident_bytes",
+            "mapped_bytes": "graph_storage_mapped_bytes",
+            "hot_pinned_bytes": "graph_storage_hot_pinned_bytes",
+        }
+        gauges = {
+            k: reg.gauge(n, f"out-of-core graph storage tier {k} "
+                            "(process-global, native counter mirror)")
+            for k, n in names.items()}
+        rss = reg.gauge(
+            "process_rss_bytes",
+            "process resident set size (/proc/self/status VmRSS) — "
+            "read against graph_storage_resident_bytes to see how much "
+            "of the mapped graph the kernel is holding in RAM")
+
+        def _collect():
+            s = store_stats()
+            for k in names:
+                gauges[k].set(s[k])
+            rss.set(_process_rss_bytes())
+
+        reg.add_collector(_collect)
+        _obs.register_health("graph_storage", store_stats)
+        _store_obs_done = True
+
+
 def start_service(data_dir: str, shard_idx: int = 0, shard_num: int = 1,
                   port: int = 0, registry_dir: str = "",
                   host: str = "127.0.0.1", index_spec: str = "",
                   wal_dir: str = "", wal_fsync: str = "always",
                   wal_compact_bytes: int = 64 << 20,
-                  catchup: bool = True) -> GraphService:
+                  catchup: bool = True, storage: str = None,
+                  hot_bytes: int = None) -> GraphService:
     """Load shard `shard_idx`/`shard_num` from data_dir and serve it.
 
     registry_dir: where the shard registers for discovery — a shared
@@ -742,23 +849,46 @@ def start_service(data_dir: str, shard_idx: int = 0, shard_num: int = 1,
     "never" rides the page cache (survives process death/SIGKILL only).
     wal_compact_bytes: once the log exceeds this, the snapshot is
     re-dumped (atomic temp+rename) and the log truncated; <= 0 disables
-    compaction."""
+    compaction.
+
+    storage: "ram" (default) serves from the heap; "mmap" serves from
+    the out-of-core columnar tier — the graph's big columns are mmap'd
+    from a columnar store file (written beside the data files on first
+    start, and by every WAL compaction thereafter), with `hot_bytes` of
+    hub-first hot set pinned in RAM. Reads are byte-identical to the
+    RAM engine; the page cache absorbs everything beyond the hot set,
+    so the shard can serve graphs far larger than RAM at a counted
+    cold-read penalty (store_stats() / cold_read_quantile()). Both
+    default from the ETG_STORAGE / ETG_HOT_BYTES environment (so
+    launchers flip a fleet without code changes)."""
     lib = _libmod.load()
     fsync_map = {"always": 1, "never": 0}
     if wal_fsync not in fsync_map:
         raise ValueError(
             f"wal_fsync must be one of {sorted(fsync_map)}, got "
             f"{wal_fsync!r}")
+    if storage is None:
+        storage = os.environ.get("ETG_STORAGE", "ram")
+    storage_map = {"ram": 0, "mmap": 1}
+    if storage not in storage_map:
+        raise ValueError(
+            f"storage must be one of {sorted(storage_map)}, got "
+            f"{storage!r}")
+    if hot_bytes is None:
+        hot_bytes = int(os.environ.get("ETG_HOT_BYTES", "0"))
     if wal_dir:
         _ensure_wal_obs()
+    if storage == "mmap":
+        _ensure_store_obs()
     # every serving shard process exposes its native timing breakdown
     # (queue-wait/execute quantiles) on /metrics — no opt-in needed
     _ensure_server_trace_obs()
-    h = lib.ets_start2(data_dir.encode(), shard_idx, shard_num, port,
+    h = lib.ets_start3(data_dir.encode(), shard_idx, shard_num, port,
                        registry_dir.encode(), host.encode(),
                        index_spec.encode(), wal_dir.encode(),
                        fsync_map[wal_fsync], int(wal_compact_bytes),
-                       1 if catchup else 0)
+                       1 if catchup else 0, storage_map[storage],
+                       int(hot_bytes))
     if h == 0:
         raise EngineError(lib.etg_last_error().decode())
     return GraphService(lib, h)

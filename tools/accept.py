@@ -858,6 +858,10 @@ def _run_accept_body(args, out_dir, td, phases, chaos, t0,
                     round(1 - args.mix_knn, 3)},
             "inject_ms": args.inject_ms, "chaos": bool(args.chaos),
             "graph_shards": 2, "serve_shards": 2,
+            # tests drive run_accept with a hand-built Namespace that
+            # predates the storage knob — default, don't require
+            "storage": getattr(args, "storage", "ram"),
+            "hot_bytes": getattr(args, "hot_bytes", 0),
             "rpc": {"mux": True, "connections": 2, "hedge": True,
                     "deadline_propagation": True,
                     "compress_threshold": 512},
@@ -948,7 +952,22 @@ def main(argv=None) -> int:
     ap.add_argument("--record", action="store_true",
                     help="merge the verdict into perf.json "
                          "('acceptance' entry)")
+    ap.add_argument("--storage", choices=["ram", "mmap"], default="ram",
+                    help="graph shard storage tier: \"mmap\" runs the "
+                         "whole loop (load -> delta -> swap -> serve, "
+                         "SIGKILL drill included) on the out-of-core "
+                         "columnar tier; the gates are unchanged — the "
+                         "tier must be indistinguishable except for the "
+                         "storage gauges")
+    ap.add_argument("--hot_bytes", type=int, default=1 << 20,
+                    help="mmap storage: hub hot-set budget per shard")
     args = ap.parse_args(argv)
+    if args.storage == "mmap":
+        # the env mirrors flip every shard — the in-process services AND
+        # the SIGKILL-drill subprocess (and its respawn) — without
+        # threading a knob through each start_service call site
+        os.environ["ETG_STORAGE"] = "mmap"
+        os.environ["ETG_HOT_BYTES"] = str(args.hot_bytes)
     if args.load_s is None:
         args.load_s = 30.0 if args.full else 12.0
 

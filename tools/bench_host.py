@@ -2082,12 +2082,181 @@ def bench_elastic(args):
     return 0 if gate["ok"] else 1
 
 
+# ---------------------------------------------------------------------------
+# --mode outcore: out-of-core columnar tier A/B (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+_OUTCORE_CHILD = r"""
+import hashlib, json, os, resource, sys
+import numpy as np
+data, mode, hot_bytes, clamp, n, batches = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]))
+from euler_tpu.gql import start_service, store_stats, cold_read_quantile
+from euler_tpu.graph import RemoteGraphEngine
+
+
+def vm_field(key):
+    with open("/proc/self/status") as f:
+        for ln in f:
+            if ln.startswith(key + ":"):
+                return int(ln.split()[1]) * 1024
+    return 0
+
+
+def vm_data():
+    return vm_field("VmData")
+
+
+base_rss = vm_field("VmRSS")  # current, not peak: imports already peaked
+if clamp > 0:
+    lim = vm_data() + hot_bytes + clamp
+    resource.setrlimit(resource.RLIMIT_DATA, (lim, lim))
+st0 = store_stats()
+s = start_service(data, 0, 1, storage=mode,
+                  hot_bytes=hot_bytes if mode == "mmap" else 0)
+eng = RemoteGraphEngine("hosts:127.0.0.1:%d" % s.port, seed=1)
+h = hashlib.sha256()
+rng = np.random.default_rng(42)
+for b in range(batches):
+    # half skew-hot (the build's dst skew), half uniform (the cold tail)
+    hot_ids = (rng.random(256) ** 2 * n).astype(np.uint64) + 1
+    cold_ids = rng.integers(1, n + 1, 256).astype(np.uint64)
+    ids = np.concatenate([hot_ids, cold_ids])
+    for a in eng.get_full_neighbor(ids, sorted_by_id=True):
+        h.update(np.ascontiguousarray(a).tobytes())
+    h.update(np.ascontiguousarray(
+        eng.get_dense_feature(ids, "feature")).tobytes())
+st = store_stats()
+out = {
+    "digest": h.hexdigest(),
+    "rss_delta_bytes": max(vm_field("VmRSS") - base_rss, 0),
+    "stats": {k: st[k] - st0[k] for k in st0 if k != "cold_buckets"},
+    "resident_bytes": st["resident_bytes"],
+    "mapped_bytes": st["mapped_bytes"],
+    "hot_pinned_bytes": st["hot_pinned_bytes"],
+    "cold_p999_ms": cold_read_quantile(0.999, st0),
+    "cold_p50_ms": cold_read_quantile(0.5, st0),
+}
+eng.close()
+s.stop()
+print("RESULT " + json.dumps(out), flush=True)
+"""
+
+
+def bench_outcore(args):
+    """--mode outcore: serve-bigger-than-RAM A/B (ISSUE 19). Build one
+    seeded graph, dump it, spill its columnar store, then serve the
+    SAME read workload from two fresh subprocesses:
+
+      ram    : heap engine — its ru_maxrss delta is the in-RAM graph
+               footprint the out-of-core tier must undercut;
+      outcore: storage="mmap" with a hub-first hot set, RLIMIT_DATA
+               clamped to baseline + hot_bytes + a fixed headroom (the
+               clamp makes a heap copy of the columns impossible — the
+               interpreter/thread-stack virtual baseline is measured in
+               the child, not guessed here).
+
+    Gates (recorded in perf.json, exit 1 on failure):
+      * byte parity — both legs hash identical sorted-neighbor + dense
+        feature answers over the same seeded probe stream;
+      * the accounting moved — hot_hits > 0 AND cold_reads > 0 (the
+        probe mix spans the hot set and the cold tail);
+      * RAM budget — the outcore leg's unreclaimable RAM (hot_bytes +
+        anon heap growth, i.e. rss delta minus file-backed residency)
+        is >= 5x smaller than the ram leg's footprint;
+      * bounded cold-read penalty — counted cold p999 <= --cold_p999_ms.
+    """
+    import subprocess
+    import tempfile
+
+    from euler_tpu.core import lib as _libmod
+
+    n = args.nodes
+    feat = args.feat_dim or 48
+    print(f"[outcore] building n={n} deg={args.degree} feat={feat} "
+          "(unclamped parent)", flush=True)
+    g, ingest_s, finalize_s, n_edges = build_graph(n, args.degree, feat)
+    dump = args.dump_dir or tempfile.mkdtemp(prefix="etg_outcore_")
+    g.dump(dump, num_partitions=1)
+    lib = _libmod.load()
+    sidecar = os.path.join(dump, "columnar.etc")
+    t0 = time.time()
+    if lib.etg_store_write(g.h, sidecar.encode()) != 0:
+        print("store write failed:", lib.etg_last_error().decode())
+        return 1
+    spill_s = time.time() - t0
+    columnar_bytes = os.path.getsize(sidecar)
+    g.close()
+    hot_bytes = args.hot_bytes or columnar_bytes // 20
+    batches = max(int(args.seconds * 8), 8)
+
+    def leg(mode, clamp):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", _OUTCORE_CHILD, dump, mode,
+             str(hot_bytes), str(clamp), str(n), str(batches)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=env, timeout=600)
+        for ln in proc.stdout.splitlines():
+            if ln.startswith("RESULT "):
+                return json.loads(ln[len("RESULT "):])
+        raise RuntimeError(f"{mode} leg died (exit {proc.returncode})")
+
+    ram = leg("ram", 0)
+    clamp = args.clamp_headroom_mb << 20
+    oc = leg("mmap", clamp)
+
+    in_ram = ram["rss_delta_bytes"]
+    # unreclaimable RAM the tier actually committed: the pinned hot set
+    # plus anon heap growth (rss delta minus the file-backed pages the
+    # kernel may reclaim at will)
+    oc_anon = max(oc["rss_delta_bytes"] - oc["resident_bytes"], 0)
+    oc_budget = hot_bytes + oc_anon
+    budget_x = round(in_ram / max(oc_budget, 1), 2)
+    st = oc["stats"]
+    gates = {
+        "byte_parity": ram["digest"] == oc["digest"],
+        "hot_hits_counted": st["hot_hits"] > 0,
+        "cold_reads_counted": st["cold_reads"] > 0,
+        "budget_x_smaller": budget_x, "budget_gate": 5.0,
+        "budget_ok": budget_x >= 5.0,
+        "cold_p999_ms": oc["cold_p999_ms"],
+        "cold_p999_gate_ms": args.cold_p999_ms,
+        "cold_p999_ok": (oc["cold_p999_ms"] is not None
+                         and oc["cold_p999_ms"] <= args.cold_p999_ms),
+    }
+    entry = {
+        "bench": "outcore_storage_tier",
+        "metric": "ram_footprint_shrink_x",
+        "value": budget_x,
+        "unit": ("x in-RAM footprint / outcore committed RAM "
+                 "(hot set + anon heap), byte-parity pinned"),
+        "detail": {
+            "nodes": n, "edges": n_edges, "feat_dim": feat,
+            "columnar_bytes": columnar_bytes, "spill_s": round(spill_s, 2),
+            "ingest_s": round(ingest_s, 2),
+            "finalize_s": round(finalize_s, 2),
+            "hot_bytes": hot_bytes, "rlimit_headroom_bytes": clamp,
+            "batches": batches, "probe_ids_per_batch": 512,
+            "ram_leg": ram, "outcore_leg": oc,
+            "gate": gates,
+        },
+    }
+    record(entry)
+    ok = (gates["byte_parity"] and gates["hot_hits_counted"]
+          and gates["cold_reads_counted"] and gates["budget_ok"]
+          and gates["cold_p999_ok"])
+    return 0 if ok else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=["fanout", "scale", "walk",
                                        "layerwise", "feeder", "table",
                                        "rpc", "mutate", "tail",
-                                       "elastic", "wire", "plan"],
+                                       "elastic", "wire", "plan",
+                                       "outcore"],
                     default="fanout")
     ap.add_argument("--layer_sizes", default="512,512")
     ap.add_argument("--nodes", type=int, default=100_000)
@@ -2151,6 +2320,15 @@ def main(argv=None):
     ap.add_argument("--root_batches", type=int, default=8,
                     help="plan mode: fixed pool of pre-sampled root "
                          "batches the closed-loop workers cycle")
+    ap.add_argument("--hot_bytes", type=int, default=0,
+                    help="outcore mode: hub hot-set budget (bytes); 0 "
+                         "defaults to columnar_bytes/20")
+    ap.add_argument("--clamp_headroom_mb", type=int, default=192,
+                    help="outcore mode: RLIMIT_DATA headroom above the "
+                         "child's measured baseline + hot_bytes (thread "
+                         "stacks + reply buffers are virtual anon data)")
+    ap.add_argument("--cold_p999_ms", type=float, default=50.0,
+                    help="outcore mode: counted cold-read p999 gate (ms)")
     args = ap.parse_args(argv)
     if args.mode == "table":
         # the K-wide virtual CPU mesh must exist before the first jax
@@ -2184,6 +2362,8 @@ def main(argv=None):
         bench_wire(args)
     elif args.mode == "plan":
         bench_plan(args)
+    elif args.mode == "outcore":
+        sys.exit(bench_outcore(args))
     elif args.mode == "tail":
         sys.exit(bench_tail(args))
     elif args.mode == "elastic":
