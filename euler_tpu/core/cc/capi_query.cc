@@ -411,16 +411,20 @@ static int64_t StartShardService(const char* data_dir, int shard_idx,
                      << ws.message() << "): deltas will be refused";
     }
   } else {
-    // Non-durable + mmap: attach the data dir's columnar sidecar when
-    // one exists; otherwise load once on the heap, spill the sidecar
-    // beside the partition files (so the NEXT start attaches directly),
-    // and re-attach. Any failure degrades to the heap path.
-    std::string sidecar;
-    if (storage == 1)
-      sidecar = std::string(data_dir ? data_dir : "") + "/" +
-                et::kColumnarFileName;
-    if (storage == 1 && sidecar.size() > 1) {
-      et::Status as = et::LoadGraphFromStore(sidecar, hot_bytes, &g);
+    // Non-durable + mmap: attach the data dir's shard-qualified columnar
+    // sidecar when one exists AND is at least as new as the partition
+    // files it was spilled from (a re-dumped dataset must never be
+    // shadowed by a stale spill); otherwise load once on the heap, spill
+    // the sidecar beside the partition files (so the NEXT start attaches
+    // directly), and re-attach. Any failure degrades to the heap path.
+    if (storage == 1 && data_dir != nullptr && data_dir[0] != '\0') {
+      const std::string sidecar =
+          std::string(data_dir) + "/" +
+          et::ColumnarSidecarName(shard_idx, shard_num);
+      et::Status as =
+          et::SidecarIsFresh(data_dir, sidecar)
+              ? et::LoadGraphFromStore(sidecar, hot_bytes, &g)
+              : et::Status::IOError("no fresh sidecar at " + sidecar);
       if (!as.ok()) {
         g.reset();
         s = et::LoadShard(data_dir, shard_idx, shard_num,

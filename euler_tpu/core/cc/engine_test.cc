@@ -8,9 +8,12 @@
 //
 // Mirrors the reference's gtest tiers (SURVEY.md §4): common (samplers,
 // threadpool, rng), graph store, serde, executor, index, compiler.
+#include <fcntl.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <atomic>
+#include <thread>
 #include <csignal>
 #include <cmath>
 #include <cstdio>
@@ -607,6 +610,88 @@ void TestWalColumnarSidecarRecovery() {
                         nullptr, nullptr, nullptr, nullptr, 1, 1 << 20));
   CHECK_TRUE(mm_g->attached());
   CheckGraphParity(*heap_g, *mm_g);
+}
+
+// Hardening (review r18): shard-qualified sidecar names, freshness
+// gating against re-dumped partition files, overflow-safe header
+// bounds, typed-column size verification, and residency-gauge walks
+// racing tier teardown.
+void TestColumnarStoreHardening() {
+  CHECK_TRUE(ColumnarSidecarName(0, 1) == std::string(kColumnarFileName));
+  CHECK_TRUE(ColumnarSidecarName(2, 4) == "columnar.2of4.etc");
+
+  std::string root = "/tmp/et_engine_test_fresh";
+  CHECK_TRUE(
+      std::system(("rm -rf " + root + " && mkdir -p " + root).c_str()) == 0);
+  auto g = OutcoreGraph();
+  CHECK_OK(DumpGraphPartitioned(*g, root, 1));
+  std::string sidecar = root + "/" + kColumnarFileName;
+  CHECK_TRUE(!SidecarIsFresh(root, sidecar));  // nothing spilled yet
+  CHECK_OK(WriteColumnarStore(*g, sidecar));
+  CHECK_TRUE(SidecarIsFresh(root, sidecar));  // spill postdates the parts
+  // simulate an in-place re-dump (partition files newer than the
+  // spill) by backdating the sidecar — deterministic even on coarse
+  // mtime clocks, where touching a part file "now" can tie the spill
+  struct timespec back[2];
+  back[0].tv_sec = 0;
+  back[0].tv_nsec = UTIME_OMIT;
+  back[1].tv_sec = 1;  // epoch+1s: long before the partition files
+  back[1].tv_nsec = 0;
+  CHECK_TRUE(utimensat(AT_FDCWD, sidecar.c_str(), back, 0) == 0);
+  CHECK_TRUE(!SidecarIsFresh(root, sidecar));
+  // a sibling shard's spill is NOT a source file: it must not re-stale
+  // this shard's fresh sidecar
+  CHECK_OK(WriteColumnarStore(*g, sidecar));  // re-spill -> fresh again
+  CHECK_TRUE(SidecarIsFresh(root, sidecar));
+  CHECK_OK(WriteColumnarStore(*g, root + "/" + ColumnarSidecarName(1, 2)));
+  CHECK_TRUE(SidecarIsFresh(root, sidecar));
+
+  // typed Find rejects a size-mismatched column instead of
+  // reinterpreting it (reads past the mapping otherwise)
+  std::shared_ptr<ColumnarStore> store;
+  CHECK_OK(ColumnarStore::Open(sidecar, &store));
+  const uint64_t* p64 = nullptr;
+  const float* p32 = nullptr;
+  size_t n = 0;
+  CHECK_TRUE(store->Find("node_ids", &p64, &n) && n > 0);  // u64: matches
+  CHECK_TRUE(!store->Find("node_ids", &p32, &n));          // f32: rejected
+
+  // corrupt header: a count whose byte size wraps uint64 must be
+  // rejected, not accepted by an overflowed bounds check. The first
+  // column entry ("aux", elem_size 1) puts count at byte 31.
+  {
+    std::FILE* f = std::fopen(sidecar.c_str(), "rb");
+    CHECK_TRUE(f != nullptr);
+    std::fseek(f, 0, SEEK_END);
+    std::vector<char> bytes(std::ftell(f));
+    std::fseek(f, 0, SEEK_SET);
+    CHECK_TRUE(std::fread(bytes.data(), 1, bytes.size(), f) == bytes.size());
+    std::fclose(f);
+    uint64_t huge = ~0ULL;
+    std::memcpy(bytes.data() + 31, &huge, sizeof(huge));
+    std::string bad = root + "/bad.etc";
+    f = std::fopen(bad.c_str(), "wb");
+    CHECK_TRUE(f != nullptr &&
+               std::fwrite(bytes.data(), 1, bytes.size(), f) == bytes.size());
+    std::fclose(f);
+    std::shared_ptr<ColumnarStore> rejected;
+    CHECK_TRUE(!ColumnarStore::Open(bad, &rejected).ok());
+  }
+
+  // residency gauges vs. tier teardown: StoreStatsSnapshot walks the
+  // tier registry while attach/destroy churns it (the reattach swap) —
+  // the sanitizer targets fail here if the walk reads a dead tier
+  std::atomic<bool> stop{false};
+  std::thread scraper([&] {
+    uint64_t st[kStoreStatSlots];
+    while (!stop.load()) StoreStatsSnapshot(st);
+  });
+  for (int i = 0; i < 50; ++i) {
+    std::unique_ptr<Graph> att;
+    CHECK_OK(LoadGraphFromStore(sidecar, 1 << 16, &att));
+  }
+  stop.store(true);
+  scraper.join();
 }
 
 // Ragged offsets travel as i32 [n,2]; every merge producer range-checks
@@ -1311,6 +1396,7 @@ int main() {
   et::TestColumnarStoreRoundtrip();
   et::TestColumnarStorePostDelta();
   et::TestWalColumnarSidecarRecovery();
+  et::TestColumnarStoreHardening();
   if (et::g_failures == 0) {
     std::printf("engine_test: ALL OK\n");
     return 0;

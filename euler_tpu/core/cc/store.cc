@@ -2,6 +2,7 @@
 // See store.h for the design contract.
 #include "store.h"
 
+#include <dirent.h>
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
@@ -19,6 +20,38 @@
 namespace et {
 
 const char kColumnarFileName[] = "columnar.etc";
+
+std::string ColumnarSidecarName(int shard_idx, int shard_num) {
+  if (shard_num <= 1) return kColumnarFileName;
+  return "columnar." + std::to_string(shard_idx) + "of" +
+         std::to_string(shard_num) + ".etc";
+}
+
+bool SidecarIsFresh(const std::string& dir, const std::string& sidecar_path) {
+  struct stat sc;
+  if (::stat(sidecar_path.c_str(), &sc) != 0) return false;
+  DIR* d = ::opendir(dir.c_str());
+  if (d == nullptr) return false;
+  bool fresh = true;
+  while (struct dirent* e = ::readdir(d)) {
+    const std::string name = e->d_name;
+    if (name == "." || name == "..") continue;
+    // other shards' sidecars and in-flight spills are not source files
+    if (name.find(".etc") != std::string::npos) continue;
+    struct stat st;
+    if (::stat((dir + "/" + name).c_str(), &st) != 0 ||
+        !S_ISREG(st.st_mode))
+      continue;
+    if (st.st_mtim.tv_sec > sc.st_mtim.tv_sec ||
+        (st.st_mtim.tv_sec == sc.st_mtim.tv_sec &&
+         st.st_mtim.tv_nsec > sc.st_mtim.tv_nsec)) {
+      fresh = false;  // a partition file is newer than the spill
+      break;
+    }
+  }
+  ::closedir(d);
+  return fresh;
+}
 
 StoreCounters& GlobalStoreCounters() {
   static StoreCounters* c = new StoreCounters();
@@ -183,8 +216,10 @@ Status WriteColumnarStore(const Graph& g, const std::string& path) {
   }
 
   // Atomic tmp+rename (the ModelBundle convention): a crashed writer
-  // never leaves a half-written store under the canonical name.
-  std::string tmp = path + ".tmp";
+  // never leaves a half-written store under the canonical name. The tmp
+  // is pid-qualified so concurrent first-starts spilling the same path
+  // never interleave writes; both renames land identical bytes.
+  std::string tmp = path + ".tmp." + std::to_string(::getpid());
   std::FILE* f = std::fopen(tmp.c_str(), "wb");
   if (!f) return Status::IOError("cannot open " + tmp + " for write");
   auto write_all = [&](const void* p, size_t n) {
@@ -262,7 +297,10 @@ Status ColumnarStore::Open(const std::string& path,
     if (!r.GetStr(&name) || !r.Get(&elem_size) || !r.Get(&count) ||
         !r.Get(&off))
       return Status::IOError("truncated store column table in " + path);
-    if (off + count * elem_size > size)
+    // overflow-safe: off + count*elem_size can wrap on a corrupt header
+    if (off > size ||
+        (count > 0 &&
+         (elem_size == 0 || count > (size - off) / elem_size)))
       return Status::IOError("column " + name + " exceeds file in " + path);
     Column c;
     c.data = store->base_ + off;
@@ -283,14 +321,20 @@ const ColumnarStore::Column* ColumnarStore::aux() const {
 // StorageTier
 // ---------------------------------------------------------------------------
 StorageTier::StorageTier(std::shared_ptr<ColumnarStore> store)
-    : store_(std::move(store)) {
+    : store_(std::move(store)) {}
+
+// Registration is deferred until Attach has fully built the tier: the
+// ctor registering itself would expose half-initialized fields to a
+// concurrent GlobalResidency walk. The mutex hand-off publishes every
+// field written before Register() to any walk that locks after it.
+void StorageTier::Register() {
   std::lock_guard<std::mutex> lk(TierRegMu());
   TierReg().insert(this);
 }
 
 StorageTier::~StorageTier() {
   std::lock_guard<std::mutex> lk(TierRegMu());
-  TierReg().erase(this);
+  TierReg().erase(this);  // no-op for a tier that never registered
 }
 
 void StorageTier::OnRowAccess(uint32_t row) {
@@ -365,12 +409,13 @@ void StorageTier::GlobalResidency(int64_t* resident, int64_t* mapped,
   *resident = 0;
   *mapped = 0;
   *hot_pinned = 0;
-  std::vector<StorageTier*> tiers;
-  {
-    std::lock_guard<std::mutex> lk(TierRegMu());
-    tiers.assign(TierReg().begin(), TierReg().end());
-  }
-  for (StorageTier* t : tiers) {
+  // Hold the registry lock for the whole walk: ~StorageTier serializes
+  // on TierRegMu before erasing itself, so every pointer in the set
+  // stays alive while we poll it. Snapshotting the set and polling
+  // unlocked raced a reattach's tier teardown (use-after-free on a
+  // /metrics scrape concurrent with compaction).
+  std::lock_guard<std::mutex> lk(TierRegMu());
+  for (StorageTier* t : TierReg()) {
     int64_t r = t->PollResidentBytes();
     if (r > 0) *resident += r;
     *mapped += static_cast<int64_t>(t->mapped_bytes());
@@ -622,6 +667,7 @@ Status StoreAccess::Attach(std::shared_ptr<ColumnarStore> store,
     }
     tier->hot_pinned_bytes_ = spent;
   }
+  tier->Register();  // tier fully built: publish to the gauge registry
   g->store_ = std::move(store);
   g->tier_ = tier;
   g->tier_raw_ = tier.get();

@@ -53,6 +53,21 @@ namespace et {
 // data or snapshot directory.
 extern const char kColumnarFileName[];  // "columnar.etc"
 
+// Sidecar name for a shard's slice of a SHARED data directory:
+// "columnar.etc" for the 1-shard case, otherwise
+// "columnar.<idx>of<num>.etc" — co-located shards each spill/attach
+// their own partition and never serve a sibling's. Snapshot dirs are
+// per-shard already and keep the plain name.
+std::string ColumnarSidecarName(int shard_idx, int shard_num);
+
+// True when `sidecar_path` exists and is at least as new (mtime, ns
+// precision) as every other regular file in `dir` — the partition
+// files it was spilled from. Other sidecars / in-flight spills
+// (*.etc*) are not source files and are ignored. Missing or stale →
+// false: callers fall back to heap load + re-spill, so a re-dumped
+// dataset can never be shadowed by an old sidecar.
+bool SidecarIsFresh(const std::string& dir, const std::string& sidecar_path);
+
 // Process-global out-of-core counters (obs mirrors them via
 // etg_store_stats — same pattern as WalCounters/RpcCounters).
 struct StoreCounters {
@@ -90,6 +105,13 @@ class ColumnarStore {
       *ptr = nullptr;
       *n = 0;
       return it != cols_.end();
+    }
+    if (it->second.elem_size != sizeof(T)) {
+      // size-mismatched column (corrupt or foreign store): reinterpreting
+      // would index past the mapping — report absent so attach fails loudly
+      *ptr = nullptr;
+      *n = 0;
+      return false;
     }
     *ptr = static_cast<const T*>(it->second.data);
     *n = static_cast<size_t>(it->second.count);
@@ -147,6 +169,11 @@ class StorageTier {
 
  private:
   friend struct StoreAccess;  // Build() wiring (store.cc)
+
+  // Publish to the residency-gauge registry; called by Attach only
+  // after every field is built (a ctor-time insert would expose a
+  // half-initialized tier to a concurrent GlobalResidency walk).
+  void Register();
 
   std::shared_ptr<ColumnarStore> store_;
   size_t n_rows_ = 0;

@@ -523,9 +523,20 @@ Status RecoverShard(const std::string& wal_dir, const std::string& data_dir,
     bool pending = false;
     for (const auto& rec : recs)
       if (rec.epoch > snap_epoch) pending = true;
-    const std::string sidecar = base_dir + "/" + kColumnarFileName;
+    // Snapshot dirs are per-shard and written atomically with their
+    // sidecar; a shared data_dir base needs the shard-qualified name
+    // plus a freshness check against the partition files (a stale or
+    // sibling-shard spill must never shadow this shard's data).
+    const std::string sidecar =
+        base_dir + "/" + (snap_name.empty()
+                              ? ColumnarSidecarName(shard_idx, shard_num)
+                              : std::string(kColumnarFileName));
     struct stat sst;
-    if (!pending && ::stat(sidecar.c_str(), &sst) == 0) {
+    const bool usable =
+        !pending && (snap_name.empty()
+                         ? SidecarIsFresh(base_dir, sidecar)
+                         : ::stat(sidecar.c_str(), &sst) == 0);
+    if (usable) {
       std::unique_ptr<Graph> attached;
       Status as = LoadGraphFromStore(sidecar, hot_bytes, &attached);
       if (as.ok() && build_in_adjacency && !attached->has_in_adjacency() &&

@@ -208,6 +208,60 @@ def test_mmap_service_matches_ram_service(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Sidecar hygiene: per-shard names, stale spills never shadow a re-dump
+# ---------------------------------------------------------------------------
+
+def test_sharded_sidecars_are_per_shard(tmp_path):
+    """Two co-located mmap shards spill shard-qualified sidecars
+    (columnar.<i>of<n>.etc) — never a shared columnar.etc a sibling
+    could attach, silently serving the wrong partition — and the mmap
+    cluster answers match the 2-shard RAM cluster."""
+    g, ids = _build_graph()
+    data = str(tmp_path / "data")
+    g.dump(data, num_partitions=2)
+    ram = [start_service(data, i, 2) for i in range(2)]
+    mm = [start_service(data, i, 2, storage="mmap", hot_bytes=1 << 20)
+          for i in range(2)]
+    r_ram = RemoteGraphEngine(
+        "hosts:" + ",".join(f"127.0.0.1:{s.port}" for s in ram), seed=1)
+    r_mm = RemoteGraphEngine(
+        "hosts:" + ",".join(f"127.0.0.1:{s.port}" for s in mm), seed=1)
+    try:
+        assert os.path.exists(os.path.join(data, "columnar.0of2.etc"))
+        assert os.path.exists(os.path.join(data, "columnar.1of2.etc"))
+        assert not os.path.exists(os.path.join(data, "columnar.etc"))
+        _assert_graph_parity(r_ram, r_mm, ids, sample=False)
+    finally:
+        r_ram.close()
+        r_mm.close()
+        for s in ram + mm:
+            s.stop()
+
+
+def test_stale_sidecar_rebuilt_on_redump(tmp_path):
+    """Re-dumping the dataset in place invalidates the spilled sidecar:
+    the next mmap start rebuilds it from the new partition files instead
+    of silently serving the old graph's data."""
+    g1, _ = _build_graph(n=30)
+    data = str(tmp_path / "data")
+    g1.dump(data, num_partitions=1)
+    s = start_service(data, 0, 1, storage="mmap", hot_bytes=1 << 20)
+    s.stop()
+    assert os.path.exists(os.path.join(data, "columnar.etc"))
+    # a DIFFERENT graph re-dumped over the same directory: a stale
+    # sidecar would keep answering with g1's 30-node graph
+    g2, ids2 = _build_graph(n=50)
+    g2.dump(data, num_partitions=1)
+    s = start_service(data, 0, 1, storage="mmap", hot_bytes=1 << 20)
+    r = RemoteGraphEngine(f"hosts:127.0.0.1:{s.port}", seed=1)
+    try:
+        _assert_graph_parity(g2, r, ids2, sample=False)
+    finally:
+        r.close()
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
 # SIGKILL crash-recovery reattach
 # ---------------------------------------------------------------------------
 
